@@ -1,0 +1,19 @@
+"""parallax_tpu: a TPU-native decentralized pipeline-parallel LLM serving framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of GradientHQ/parallax
+(reference layer map: SURVEY.md section 1):
+
+- A *global scheduler* assigns contiguous layer ranges of one model to a swarm of
+  TPU hosts and routes requests along pipelines (``parallax_tpu.scheduling``).
+- Each host runs a *node runtime*: a continuous-batching executor whose pipeline
+  stage is a jit-compiled block stack over a paged KV cache living in TPU HBM,
+  with on-device sampling (``parallax_tpu.runtime``, ``parallax_tpu.models``).
+- Stages exchange activations over a pluggable transport (in-process loopback,
+  TCP/msgpack over DCN) (``parallax_tpu.p2p``).
+- Intra-host scaling uses jax.sharding over the chip mesh (ICI collectives),
+  not per-rank processes (``parallax_tpu.parallel``).
+"""
+
+from parallax_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,348 @@
+"""Model configuration normalization.
+
+Turns a HuggingFace ``config.json``-style dict into a single normalized
+:class:`ModelConfig` used everywhere in the framework (models, cache sizing,
+the global scheduler's FLOPs/bytes estimates).
+
+Capability parity: reference ``src/scheduling/model_info.py:18-193`` and
+``src/parallax/utils/utils.py`` (normalize_model_config, get_layer_types).
+Design is TPU-first: everything that feeds a jitted function is a static
+Python int here, so shapes are known at trace time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+
+# Per-layer cache kinds (reference: src/parallax/utils/layer_types.py).
+LAYER_ATTENTION = "attention"          # full paged KV
+LAYER_SLIDING = "sliding_attention"    # windowed paged KV
+LAYER_MLA = "mla"                      # compressed-latent cache (DeepSeek)
+LAYER_LINEAR = "linear_attention"      # conv + recurrent state slots (hybrid)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    """Mixture-of-experts shape info (used for EP sharding + FLOPs estimates)."""
+
+    num_experts: int
+    num_experts_per_tok: int
+    moe_intermediate_size: int
+    num_shared_experts: int = 0
+    shared_expert_intermediate_size: int = 0
+    norm_topk_prob: bool = True
+    # Layers < this index are dense FFN even in an MoE model (DeepSeek style).
+    first_k_dense_replace: int = 0
+    # Every n-th layer is MoE (1 = all layers past first_k_dense_replace).
+    moe_layer_freq: int = 1
+    routed_scaling_factor: float = 1.0
+    n_group: int = 0
+    topk_group: int = 0
+    scoring_func: str = "softmax"   # or "sigmoid" (DeepSeek-V3)
+
+
+@dataclasses.dataclass(frozen=True)
+class MLAConfig:
+    """Multi-head latent attention dims (DeepSeek V2/V3 family).
+
+    Reference derives these in ``src/scheduling/model_info.py:45-60``.
+    """
+
+    kv_lora_rank: int
+    q_lora_rank: int
+    qk_nope_head_dim: int
+    qk_rope_head_dim: int
+    v_head_dim: int
+
+
+@dataclasses.dataclass(frozen=True)
+class LinearAttnConfig:
+    """State shapes for linear-attention / hybrid layers (Qwen3-Next style)."""
+
+    conv_kernel_size: int
+    num_k_heads: int
+    num_v_heads: int
+    head_k_dim: int
+    head_v_dim: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Normalized, immutable model architecture description."""
+
+    model_name: str
+    architecture: str
+    vocab_size: int
+    hidden_size: int
+    num_hidden_layers: int
+    num_attention_heads: int
+    num_key_value_heads: int
+    head_dim: int
+    intermediate_size: int
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    rope_scaling: dict | None = None
+    max_position_embeddings: int = 32768
+    tie_word_embeddings: bool = False
+    attention_bias: bool = False
+    mlp_bias: bool = False
+    # qk-norm per head (Qwen3 family).
+    use_qk_norm: bool = False
+    sliding_window: int | None = None
+    # Per-layer cache kind, length == num_hidden_layers.
+    layer_types: tuple[str, ...] = ()
+    # Attention sinks (gpt-oss): a learned logit per head that joins the softmax.
+    use_attention_sinks: bool = False
+    moe: MoEConfig | None = None
+    mla: MLAConfig | None = None
+    linear_attn: LinearAttnConfig | None = None
+    dtype: str = "bfloat16"
+    # Bytes per parameter after quantization (bf16 => 2.0).
+    param_bytes_per_element: float = 2.0
+    partial_rotary_factor: float = 1.0
+    extra: dict = dataclasses.field(default_factory=dict)
+
+    # ---- derived helpers -------------------------------------------------
+
+    @property
+    def q_heads_per_kv_head(self) -> int:
+        return self.num_attention_heads // self.num_key_value_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.moe is not None
+
+    @property
+    def is_mla(self) -> bool:
+        return self.mla is not None
+
+    def layer_type(self, layer_idx: int) -> str:
+        if self.layer_types:
+            return self.layer_types[layer_idx]
+        return LAYER_ATTENTION
+
+    def kv_bytes_per_token_per_layer(self) -> int:
+        """HBM bytes of KV state one token occupies in one attention layer.
+
+        Reference estimate: ``src/scheduling/model_info.py:87-93``.
+        """
+        elem = 2  # bf16 cache
+        if self.mla is not None:
+            # Compressed latent + rope key, shared across heads.
+            return elem * (self.mla.kv_lora_rank + self.mla.qk_rope_head_dim)
+        return 2 * elem * self.num_key_value_heads * self.head_dim
+
+    def embedding_params(self) -> int:
+        return self.vocab_size * self.hidden_size
+
+    def decoder_layer_params(self, layer_idx: int = 0) -> int:
+        """Approximate parameter count of one decoder layer (for allocation)."""
+        h = self.hidden_size
+        if self.mla is not None:
+            m = self.mla
+            attn = (
+                h * (m.q_lora_rank or h)
+                + (m.q_lora_rank or h) * self.num_attention_heads
+                * (m.qk_nope_head_dim + m.qk_rope_head_dim)
+                + h * (m.kv_lora_rank + m.qk_rope_head_dim)
+                + m.kv_lora_rank * self.num_attention_heads
+                * (m.qk_nope_head_dim + m.v_head_dim)
+                + self.num_attention_heads * m.v_head_dim * h
+            )
+        else:
+            attn = (
+                h * self.num_attention_heads * self.head_dim      # q
+                + 2 * h * self.num_key_value_heads * self.head_dim  # k, v
+                + self.num_attention_heads * self.head_dim * h    # o
+            )
+        if self.moe is not None and self._is_moe_layer(layer_idx):
+            e = self.moe
+            ffn = 3 * h * e.moe_intermediate_size * e.num_experts
+            ffn += 3 * h * e.shared_expert_intermediate_size * e.num_shared_experts
+            ffn += h * e.num_experts  # router
+        else:
+            ffn = 3 * h * self.intermediate_size
+        return attn + ffn + 2 * h  # + 2 rmsnorm vectors
+
+    def _is_moe_layer(self, layer_idx: int) -> bool:
+        if self.moe is None:
+            return False
+        if layer_idx < self.moe.first_k_dense_replace:
+            return False
+        return (layer_idx - self.moe.first_k_dense_replace) % self.moe.moe_layer_freq == 0
+
+    def decoder_layer_flops(self, num_tokens: int, context_len: int) -> float:
+        """FLOPs of one decoder layer forward over ``num_tokens`` new tokens.
+
+        Mirrors the roofline inputs of ``src/scheduling/model_info.py:107-144``
+        (2*params matmul FLOPs + attention score FLOPs; MoE counts only the
+        activated experts).
+        """
+        h = self.hidden_size
+        attn_proj = 2 * num_tokens * (
+            h * self.num_attention_heads * self.head_dim * 2
+            + 2 * h * self.num_key_value_heads * self.head_dim
+        )
+        attn_score = (
+            4 * num_tokens * context_len * self.num_attention_heads * self.head_dim
+        )
+        if self.moe is not None:
+            e = self.moe
+            active = e.num_experts_per_tok + e.num_shared_experts
+            ffn = 2 * num_tokens * 3 * h * e.moe_intermediate_size * active
+        else:
+            ffn = 2 * num_tokens * 3 * h * self.intermediate_size
+        return float(attn_proj + attn_score + ffn)
+
+    def lm_head_flops(self, num_tokens: int) -> float:
+        return float(2 * num_tokens * self.hidden_size * self.vocab_size)
+
+
+def _get(cfg: dict, *names: str, default: Any = None) -> Any:
+    for n in names:
+        if n in cfg and cfg[n] is not None:
+            return cfg[n]
+    return default
+
+
+def normalize_config(raw: dict, model_name: str = "") -> ModelConfig:
+    """Build a :class:`ModelConfig` from a HF ``config.json`` dict.
+
+    Handles the key aliases the reference normalizes in
+    ``src/parallax/utils/utils.py:343`` (text_config nesting, head_dim
+    inference, MoE/MLA/linear detection, per-layer types).
+    """
+    cfg = dict(raw)
+    # Multimodal wrappers nest the LM config.
+    if "text_config" in cfg and isinstance(cfg["text_config"], dict):
+        inner = dict(cfg["text_config"])
+        inner.setdefault("architectures", cfg.get("architectures"))
+        cfg = inner
+
+    archs = cfg.get("architectures") or ["UnknownForCausalLM"]
+    architecture = archs[0]
+
+    hidden_size = int(_get(cfg, "hidden_size", "n_embd", "d_model"))
+    num_layers = int(_get(cfg, "num_hidden_layers", "n_layer", "num_layers"))
+    num_heads = int(_get(cfg, "num_attention_heads", "n_head"))
+    num_kv = int(_get(cfg, "num_key_value_heads", default=num_heads))
+    head_dim = int(_get(cfg, "head_dim", default=hidden_size // num_heads))
+    vocab = int(_get(cfg, "vocab_size", default=32000))
+    inter = int(_get(cfg, "intermediate_size", "n_inner", default=4 * hidden_size))
+
+    moe = None
+    n_experts = _get(cfg, "num_experts", "n_routed_experts", "num_local_experts")
+    if n_experts:
+        moe = MoEConfig(
+            num_experts=int(n_experts),
+            num_experts_per_tok=int(_get(cfg, "num_experts_per_tok", "top_k", default=2)),
+            moe_intermediate_size=int(_get(cfg, "moe_intermediate_size", default=inter)),
+            num_shared_experts=int(_get(cfg, "n_shared_experts", "num_shared_experts", default=0) or 0),
+            shared_expert_intermediate_size=int(
+                _get(cfg, "shared_expert_intermediate_size",
+                     default=_get(cfg, "moe_intermediate_size", default=inter))
+            ),
+            norm_topk_prob=bool(_get(cfg, "norm_topk_prob", default=True)),
+            first_k_dense_replace=int(_get(cfg, "first_k_dense_replace", default=0) or 0),
+            moe_layer_freq=int(_get(cfg, "moe_layer_freq", "decoder_sparse_step", default=1) or 1),
+            routed_scaling_factor=float(_get(cfg, "routed_scaling_factor", default=1.0) or 1.0),
+            n_group=int(_get(cfg, "n_group", default=0) or 0),
+            topk_group=int(_get(cfg, "topk_group", default=0) or 0),
+            scoring_func=str(_get(cfg, "scoring_func", default="softmax")),
+        )
+
+    mla = None
+    if _get(cfg, "kv_lora_rank"):
+        mla = MLAConfig(
+            kv_lora_rank=int(cfg["kv_lora_rank"]),
+            q_lora_rank=int(_get(cfg, "q_lora_rank", default=0) or 0),
+            qk_nope_head_dim=int(_get(cfg, "qk_nope_head_dim", default=128)),
+            qk_rope_head_dim=int(_get(cfg, "qk_rope_head_dim", default=64)),
+            v_head_dim=int(_get(cfg, "v_head_dim", default=128)),
+        )
+        head_dim = mla.qk_nope_head_dim + mla.qk_rope_head_dim
+
+    linear_attn = None
+    if _get(cfg, "linear_conv_kernel_dim", "conv_kernel"):
+        linear_attn = LinearAttnConfig(
+            conv_kernel_size=int(_get(cfg, "linear_conv_kernel_dim", "conv_kernel", default=4)),
+            num_k_heads=int(_get(cfg, "linear_num_key_heads", default=num_kv)),
+            num_v_heads=int(_get(cfg, "linear_num_value_heads", default=num_heads)),
+            head_k_dim=int(_get(cfg, "linear_key_head_dim", default=head_dim)),
+            head_v_dim=int(_get(cfg, "linear_value_head_dim", default=head_dim)),
+        )
+
+    # Per-layer types: explicit list (gpt-oss/qwen3-next style) or uniform.
+    layer_types: tuple[str, ...]
+    raw_types = cfg.get("layer_types")
+    sliding = _get(cfg, "sliding_window", default=None)
+    if raw_types:
+        mapping = {
+            "full_attention": LAYER_ATTENTION,
+            "attention": LAYER_ATTENTION,
+            "sliding_attention": LAYER_SLIDING,
+            "linear_attention": LAYER_LINEAR,
+            "mla": LAYER_MLA,
+        }
+        layer_types = tuple(mapping.get(t, LAYER_ATTENTION) for t in raw_types)
+    elif mla is not None:
+        layer_types = (LAYER_MLA,) * num_layers
+    elif sliding and bool(_get(cfg, "use_sliding_window", default=True)):
+        # Uniform sliding window (Mistral-style), possibly with full layers
+        # below max_window_layers (Qwen2 style).
+        max_win_layers = int(_get(cfg, "max_window_layers", default=0) or 0)
+        layer_types = tuple(
+            LAYER_ATTENTION if i < max_win_layers else LAYER_SLIDING
+            for i in range(num_layers)
+        )
+    else:
+        layer_types = (LAYER_ATTENTION,) * num_layers
+
+    quant = cfg.get("quantization_config") or cfg.get("quantization")
+    pbpe = 2.0
+    if isinstance(quant, dict):
+        bits = quant.get("bits") or quant.get("weight_bits")
+        if bits:
+            pbpe = float(bits) / 8.0
+
+    return ModelConfig(
+        model_name=model_name or str(cfg.get("_name_or_path", architecture)),
+        architecture=architecture,
+        vocab_size=vocab,
+        hidden_size=hidden_size,
+        num_hidden_layers=num_layers,
+        num_attention_heads=num_heads,
+        num_key_value_heads=num_kv,
+        head_dim=head_dim,
+        intermediate_size=inter,
+        rms_norm_eps=float(_get(cfg, "rms_norm_eps", "layer_norm_epsilon", default=1e-6)),
+        rope_theta=float(_get(cfg, "rope_theta", default=10000.0)),
+        rope_scaling=cfg.get("rope_scaling"),
+        max_position_embeddings=int(_get(cfg, "max_position_embeddings", default=32768)),
+        tie_word_embeddings=bool(_get(cfg, "tie_word_embeddings", default=False)),
+        attention_bias=bool(_get(cfg, "attention_bias", "qkv_bias", default=False)),
+        use_qk_norm=bool(_get(cfg, "use_qk_norm", default="Qwen3" in architecture)),
+        sliding_window=int(sliding) if sliding else None,
+        layer_types=layer_types,
+        use_attention_sinks="GptOss" in architecture or bool(cfg.get("attention_sinks")),
+        moe=moe,
+        mla=mla,
+        linear_attn=linear_attn,
+        dtype=str(_get(cfg, "torch_dtype", "dtype", default="bfloat16")),
+        param_bytes_per_element=pbpe,
+        partial_rotary_factor=float(_get(cfg, "partial_rotary_factor", default=1.0)),
+        extra={k: v for k, v in cfg.items()
+               if k in ("moe_intermediate_size", "num_attention_groups", "rotary_dim")},
+    )
+
+
+def load_config(model_path: str, model_name: str = "") -> ModelConfig:
+    """Load and normalize ``config.json`` from a local model directory."""
+    path = os.path.join(model_path, "config.json")
+    with open(path, encoding="utf-8") as f:
+        raw = json.load(f)
+    return normalize_config(raw, model_name=model_name or os.path.basename(model_path))

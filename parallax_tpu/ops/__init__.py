@@ -1,0 +1,23 @@
+"""TPU-native ops: attention over paged KV, cache scatter, RoPE, sampling.
+
+This package is the in-kind replacement for the reference's C++/Metal custom
+kernels (``src/parallax_extensions/``, SURVEY.md section 2.6): on TPU the hot
+ops dispatch to Pallas kernels (bundled `ragged_paged_attention` or our own),
+elsewhere to jittable pure-XLA fallbacks with identical semantics, behind one
+validated Python facade (mirroring the role of the reference's ``ops.py``).
+"""
+
+from parallax_tpu.ops.attention import ragged_paged_attention
+from parallax_tpu.ops.kv_cache_ops import (
+    new_kv_pages,
+    reshape_and_cache,
+)
+from parallax_tpu.ops.rope import apply_rope, rope_frequencies
+
+__all__ = [
+    "ragged_paged_attention",
+    "reshape_and_cache",
+    "new_kv_pages",
+    "apply_rope",
+    "rope_frequencies",
+]

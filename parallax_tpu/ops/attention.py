@@ -1,0 +1,183 @@
+"""Ragged paged attention: the single attention op for prefill, chunked
+prefill, decode, and mixed batches.
+
+Semantics match the reference's paged attention suite
+(``src/parallax_extensions/ops.py:517-591`` decode kernel +
+``src/parallax/utils/prefix_cache_utils.py`` prefix-aware prefill), unified
+the TPU way: queries for *all* sequences in the step are flattened into one
+``[num_tokens, num_q_heads, head_dim]`` array, keys/values are always read
+from the paged cache (so prefix-cache hits and chunked prefill need no
+special path — earlier tokens are simply already in the cache).
+
+On TPU this dispatches to the Pallas flash kernel
+(`jax.experimental.pallas.ops.tpu.ragged_paged_attention`); elsewhere (CPU
+tests, debugging) to a jittable vectorized XLA fallback with identical
+semantics, including GQA, sliding windows, logit soft cap and attention
+sinks.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _tpu_available() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def ragged_paged_attention(
+    q: jax.Array,
+    kv_pages: jax.Array,
+    kv_lens: jax.Array,
+    page_indices: jax.Array,
+    cu_q_lens: jax.Array,
+    num_seqs: jax.Array,
+    *,
+    sm_scale: float = 1.0,
+    sliding_window: int | None = None,
+    soft_cap: float | None = None,
+    sinks: jax.Array | None = None,
+    use_pallas: bool | None = None,
+) -> jax.Array:
+    """Attention over the paged KV cache for a ragged batch of sequences.
+
+    Args:
+      q: [T, num_q_heads, head_dim] — all sequences' query tokens, flattened.
+      kv_pages: [P, page_size, 2*num_kv_heads, head_dim] paged cache; the
+        current step's K/V must already be written (see ``reshape_and_cache``).
+      kv_lens: i32[S] total context length per sequence (including this
+        step's tokens); entries past ``num_seqs`` ignored.
+      page_indices: i32[S, pages_per_seq] page table per sequence.
+      cu_q_lens: i32[S+1] cumulative query lengths; seq i owns q rows
+        ``[cu_q_lens[i], cu_q_lens[i+1])``.
+      num_seqs: i32[1] live sequence count (dynamic — no recompile when the
+        batch occupancy changes, only when T/S buckets change).
+      sm_scale: softmax scale.
+      sliding_window: optional window size (keys older than
+        ``pos - window + 1`` are masked).
+      soft_cap: optional logit soft cap ``cap * tanh(x / cap)``.
+      sinks: optional f32[num_q_heads] attention-sink logits (gpt-oss): one
+        extra virtual key per head that joins the softmax but contributes no
+        value (reference: ``src/parallax_extensions/ops.py:556-572``).
+      use_pallas: force kernel choice; default = TPU availability.
+
+    Returns:
+      [T, num_q_heads, head_dim] attention output.
+    """
+    if use_pallas is None:
+        use_pallas = _tpu_available()
+    if use_pallas and sinks is None:
+        from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (
+            ragged_paged_attention as _pallas_rpa,
+        )
+
+        return _pallas_rpa(
+            q,
+            kv_pages,
+            kv_lens,
+            page_indices,
+            cu_q_lens,
+            num_seqs,
+            sm_scale=sm_scale,
+            sliding_window=sliding_window,
+            soft_cap=soft_cap,
+        )
+    return _ragged_paged_attention_xla(
+        q,
+        kv_pages,
+        kv_lens,
+        page_indices,
+        cu_q_lens,
+        num_seqs,
+        sm_scale=sm_scale,
+        sliding_window=sliding_window,
+        soft_cap=soft_cap,
+        sinks=sinks,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sm_scale", "sliding_window", "soft_cap")
+)
+def _ragged_paged_attention_xla(
+    q: jax.Array,
+    kv_pages: jax.Array,
+    kv_lens: jax.Array,
+    page_indices: jax.Array,
+    cu_q_lens: jax.Array,
+    num_seqs: jax.Array,
+    *,
+    sm_scale: float,
+    sliding_window: int | None,
+    soft_cap: float | None,
+    sinks: jax.Array | None,
+) -> jax.Array:
+    """Jittable pure-XLA fallback (gather KV per token, masked softmax)."""
+    t, num_q_heads, head_dim = q.shape
+    _, page_size, combined, _ = kv_pages.shape
+    num_kv_heads = combined // 2
+    group = num_q_heads // num_kv_heads
+    s, pages_per_seq = page_indices.shape
+    kv_cap = pages_per_seq * page_size
+
+    # Which sequence does each query token belong to?
+    token_ids = jnp.arange(t, dtype=jnp.int32)
+    seq_of_tok = (
+        jnp.searchsorted(cu_q_lens[1:], token_ids, side="right")
+        .clip(0, s - 1)
+        .astype(jnp.int32)
+    )
+    q_len = cu_q_lens[seq_of_tok + 1] - cu_q_lens[seq_of_tok]
+    # Query token position within its sequence's full context.
+    q_pos = kv_lens[seq_of_tok] - q_len + (token_ids - cu_q_lens[seq_of_tok])
+
+    # Gather each sequence's K/V: [S, kv_cap, Hkv, D].
+    pages = kv_pages[page_indices.reshape(-1)].reshape(
+        s, kv_cap, combined, head_dim
+    )
+    k_seq = pages[:, :, 0::2, :]
+    v_seq = pages[:, :, 1::2, :]
+    # Per-token views: [T, kv_cap, Hkv, D].
+    k_tok = k_seq[seq_of_tok]
+    v_tok = v_seq[seq_of_tok]
+
+    qg = q.reshape(t, num_kv_heads, group, head_dim)
+    scores = jnp.einsum(
+        "thgd,tlhd->thgl", qg, k_tok, preferred_element_type=jnp.float32
+    )
+    scores = scores * sm_scale
+    if soft_cap is not None:
+        scores = soft_cap * jnp.tanh(scores / soft_cap)
+
+    kv_pos = jnp.arange(kv_cap, dtype=jnp.int32)
+    valid = (kv_pos[None, :] <= q_pos[:, None]) & (
+        kv_pos[None, :] < kv_lens[seq_of_tok][:, None]
+    )
+    if sliding_window is not None:
+        valid &= kv_pos[None, :] > q_pos[:, None] - sliding_window
+    scores = jnp.where(valid[:, None, None, :], scores, _MASK_VALUE)
+
+    if sinks is not None:
+        # One virtual key per head with logit `sinks[h]`, no value payload.
+        sink = sinks.reshape(num_kv_heads, group).astype(jnp.float32)
+        sink = jnp.broadcast_to(sink[None, :, :, None], (t, num_kv_heads, group, 1))
+        scores = jnp.concatenate([scores, sink], axis=-1)
+
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    unnorm = jnp.exp(scores - m)
+    denom = jnp.sum(unnorm, axis=-1, keepdims=True)
+    probs = (unnorm / jnp.maximum(denom, 1e-30))[..., :kv_cap]
+
+    out = jnp.einsum(
+        "thgl,tlhd->thgd", probs.astype(v_tok.dtype), v_tok,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(t, num_q_heads, head_dim).astype(q.dtype)

@@ -1,0 +1,64 @@
+"""Paged KV cache layout and the cache-scatter op.
+
+Layout (one array per attention layer), chosen to feed the TPU ragged paged
+attention kernel directly:
+
+    kv_pages: [num_pages, page_size, 2 * num_kv_heads, head_dim]
+
+with K at even combined-head indices and V at odd ones. The scatter op is the
+semantic equivalent of the reference's ``reshape_and_cache`` Metal kernel
+(``src/parallax_extensions/kernels/reshape_and_cache``, facade
+``src/parallax_extensions/ops.py:370-413``): slot_mapping is a flat
+``page * page_size + offset`` index per token, ``-1`` marks padding tokens that
+must not be written. Here it is one XLA scatter with out-of-bounds drop — XLA
+lowers this to an efficient in-place dynamic-update when the cache buffer is
+donated, so a handwritten kernel is unnecessary.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def new_kv_pages(
+    num_pages: int,
+    page_size: int,
+    num_kv_heads: int,
+    head_dim: int,
+    dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Allocate one layer's empty paged KV cache."""
+    return jnp.zeros((num_pages, page_size, 2 * num_kv_heads, head_dim), dtype=dtype)
+
+
+def interleave_kv(k: jax.Array, v: jax.Array) -> jax.Array:
+    """[T, H, D] x 2 -> [T, 2H, D] with K at even, V at odd combined heads."""
+    t, h, d = k.shape
+    return jnp.stack([k, v], axis=2).reshape(t, 2 * h, d)
+
+
+def reshape_and_cache(
+    kv_pages: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    slot_mapping: jax.Array,
+) -> jax.Array:
+    """Scatter new K/V token vectors into the paged cache.
+
+    Args:
+      kv_pages: [P, page, 2H, D] cache (donate for in-place update).
+      k, v: [T, H, D] new per-token keys/values.
+      slot_mapping: i32[T] flat slot per token; ``-1`` (or any negative) =
+        padding, dropped.
+
+    Returns:
+      Updated kv_pages.
+    """
+    p, page, h2, d = kv_pages.shape
+    kv_new = interleave_kv(k, v).astype(kv_pages.dtype)
+    flat = kv_pages.reshape(p * page, h2, d)
+    # Negative slots -> a huge index, dropped by scatter mode="drop".
+    slots = jnp.where(slot_mapping < 0, p * page, slot_mapping)
+    flat = flat.at[slots].set(kv_new, mode="drop")
+    return flat.reshape(p, page, h2, d)

@@ -1,0 +1,103 @@
+"""Rotary position embeddings, applied per-token by absolute position.
+
+Because the executor passes explicit per-token positions (continuous
+batching means every token in a step can be at a different offset), RoPE is
+a gather of precomputed cos/sin rows — the TPU-friendly equivalent of the
+reference's per-request ``rope(offset=...)`` calls
+(``src/parallax/models/qwen3.py:70-92``).
+
+Supports NeoX-style rotate-half, partial rotary dims, linear/dynamic-NTK
+scaling, and Llama-3 / YaRN frequency correction.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(
+    head_dim: int,
+    rope_theta: float,
+    rope_scaling: dict | None = None,
+    partial_rotary_factor: float = 1.0,
+) -> jax.Array:
+    """Per-dimension inverse frequencies, with HF rope_scaling applied."""
+    rot_dim = int(head_dim * partial_rotary_factor)
+    inv_freq = 1.0 / (
+        rope_theta
+        ** (jnp.arange(0, rot_dim, 2, dtype=jnp.float32) / rot_dim)
+    )
+    if not rope_scaling:
+        return inv_freq
+    rtype = rope_scaling.get("rope_type") or rope_scaling.get("type") or "default"
+    factor = float(rope_scaling.get("factor", 1.0))
+    if rtype == "linear":
+        inv_freq = inv_freq / factor
+    elif rtype == "llama3":
+        low = float(rope_scaling.get("low_freq_factor", 1.0))
+        high = float(rope_scaling.get("high_freq_factor", 4.0))
+        orig = float(rope_scaling.get("original_max_position_embeddings", 8192))
+        wavelen = 2.0 * math.pi / inv_freq
+        ratio = orig / wavelen
+        smooth = jnp.clip((ratio - low) / (high - low), 0.0, 1.0)
+        # High-freq (short wavelength): keep; low-freq: divide by factor;
+        # mid band: smooth interpolation between the two.
+        mid = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+        inv_freq = jnp.where(
+            wavelen < orig / high,
+            inv_freq,
+            jnp.where(wavelen > orig / low, inv_freq / factor, mid),
+        )
+    elif rtype in ("yarn", "dynamic"):
+        # Conservative fallback: plain interpolation by factor.
+        inv_freq = inv_freq / factor
+    return inv_freq
+
+
+def rope_table(
+    inv_freq: jax.Array, max_positions: int, attention_scaling: float = 1.0
+) -> tuple[jax.Array, jax.Array]:
+    """Precompute (cos, sin) tables of shape [max_positions, rot_dim/2]."""
+    pos = jnp.arange(max_positions, dtype=jnp.float32)
+    freqs = jnp.outer(pos, inv_freq)
+    return jnp.cos(freqs) * attention_scaling, jnp.sin(freqs) * attention_scaling
+
+
+def apply_rope(
+    x: jax.Array,
+    positions: jax.Array,
+    cos_table: jax.Array,
+    sin_table: jax.Array,
+) -> jax.Array:
+    """Rotate queries/keys by their absolute positions.
+
+    Args:
+      x: [T, H, D] (or [T, D] for MLA rope parts).
+      positions: i32[T] absolute position of each token.
+      cos_table/sin_table: [max_pos, rot/2] precomputed tables.
+
+    Returns:
+      x with the first ``rot`` dims rotated (NeoX halves convention).
+    """
+    squeeze = x.ndim == 2
+    if squeeze:
+        x = x[:, None, :]
+    t, h, d = x.shape
+    rot = cos_table.shape[-1] * 2
+    cos = cos_table[positions][:, None, :]  # [T, 1, rot/2]
+    sin = sin_table[positions][:, None, :]
+    x_rot, x_pass = x[..., :rot], x[..., rot:]
+    x1, x2 = x_rot[..., : rot // 2], x_rot[..., rot // 2 :]
+    xf1 = x1.astype(jnp.float32)
+    xf2 = x2.astype(jnp.float32)
+    out1 = xf1 * cos - xf2 * sin
+    out2 = xf2 * cos + xf1 * sin
+    out = jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
+    if d > rot:
+        out = jnp.concatenate([out, x_pass], axis=-1)
+    if squeeze:
+        out = out[:, 0, :]
+    return out

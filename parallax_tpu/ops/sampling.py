@@ -1,0 +1,84 @@
+"""Batched on-device sampling: temperature / top-k / top-p / min-p + penalties.
+
+Capability parity with the reference sampler
+(``src/parallax/server/sampling/sampler.py:22-143``): per-request parameter
+vectors, an all-greedy fast path, and filtered categorical sampling. The TPU
+design differs: one sort of the logits per step drives all three filters at
+once, and randomness comes from a per-step key + per-request fold-in so the
+whole batch samples in a single fused kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e10
+
+
+@functools.partial(jax.jit, donate_argnums=())
+def sample_tokens(
+    logits: jax.Array,            # [B, V] float
+    key: jax.Array,               # PRNG key for this step
+    temperature: jax.Array,       # f32[B]; <=0 means greedy
+    top_k: jax.Array,             # i32[B]; <=0 disables
+    top_p: jax.Array,             # f32[B] in (0, 1]; 1 disables
+    min_p: jax.Array,             # f32[B] in [0, 1); 0 disables
+) -> jax.Array:
+    """Sample one token per row. Returns i32[B]."""
+    b, v = logits.shape
+    logits = logits.astype(jnp.float32)
+    greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # One descending sort powers top-k, top-p and min-p simultaneously.
+    sorted_logits, sorted_idx = jax.lax.sort_key_val(
+        -scaled, jnp.broadcast_to(jnp.arange(v, dtype=jnp.int32), (b, v))
+    )
+    sorted_logits = -sorted_logits
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    ranks = jnp.arange(v, dtype=jnp.int32)[None, :]
+
+    keep = jnp.ones((b, v), dtype=bool)
+    # top-k: keep the k highest-ranked entries.
+    k = jnp.where(top_k <= 0, v, top_k)[:, None]
+    keep &= ranks < k
+    # top-p: smallest prefix with cumulative prob >= p (always keep rank 0).
+    cum = jnp.cumsum(probs, axis=-1)
+    keep &= (cum - probs) < top_p[:, None]
+    # min-p: drop tokens below min_p * max_prob.
+    keep &= probs >= min_p[:, None] * probs[:, 0:1]
+
+    filtered = jnp.where(keep, sorted_logits, NEG_INF)
+    # Gumbel-max over the filtered sorted logits.
+    gumbel = jax.random.gumbel(key, (b, v), dtype=jnp.float32)
+    choice_rank = jnp.argmax(filtered + gumbel, axis=-1)
+    sampled_ids = jnp.take_along_axis(
+        sorted_idx, choice_rank[:, None], axis=-1
+    )[:, 0].astype(jnp.int32)
+
+    return jnp.where(temperature <= 0.0, greedy_ids, sampled_ids)
+
+
+def apply_penalties(
+    logits: jax.Array,                 # [B, V]
+    output_token_counts: jax.Array,    # i32[B, V] counts of generated tokens
+    presence_penalty: jax.Array,       # f32[B]
+    frequency_penalty: jax.Array,      # f32[B]
+    repetition_penalty: jax.Array,     # f32[B]; 1.0 disables
+) -> jax.Array:
+    """OpenAI-style presence/frequency + HF repetition penalties."""
+    logits = logits.astype(jnp.float32)
+    present = (output_token_counts > 0).astype(jnp.float32)
+    logits = logits - presence_penalty[:, None] * present
+    logits = logits - frequency_penalty[:, None] * output_token_counts.astype(
+        jnp.float32
+    )
+    rep = repetition_penalty[:, None]
+    penalized = jnp.where(logits > 0, logits / rep, logits * rep)
+    logits = jnp.where(present > 0, penalized, logits)
+    return logits

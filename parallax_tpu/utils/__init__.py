@@ -1,0 +1,3 @@
+from parallax_tpu.utils.logging import get_logger, set_log_level
+
+__all__ = ["get_logger", "set_log_level"]

@@ -1,0 +1,102 @@
+"""Hardware detection for TPU hosts.
+
+Capability parity: reference ``src/parallax/server/server_info.py:28-229``
+(Apple/NVIDIA device DBs + detect_node_hardware). Here the node is a TPU
+host: we report per-chip peak bf16 TFLOPS, HBM capacity/bandwidth and the
+local chip count so the global scheduler's roofline model can place layers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# Peak specs per chip: (bf16 TFLOPS, HBM GiB, HBM GB/s, ICI GB/s per link).
+TPU_CHIP_DB: dict[str, tuple[float, float, float, float]] = {
+    "v4": (275.0, 32.0, 1228.0, 100.0),
+    "v5e": (197.0, 16.0, 819.0, 186.0),
+    "v5p": (459.0, 95.0, 2765.0, 200.0),
+    "v6e": (918.0, 32.0, 1640.0, 227.0),
+    "cpu": (1.0, 8.0, 50.0, 10.0),       # host fallback for tests
+}
+
+
+@dataclasses.dataclass
+class HardwareInfo:
+    """Per-node hardware summary shipped to the global scheduler on join."""
+
+    device_kind: str          # e.g. "v5e"
+    num_chips: int            # chips visible to this host (the TP degree)
+    tflops_bf16: float        # per chip
+    hbm_gib: float            # per chip
+    hbm_gbps: float           # per chip
+    ici_gbps: float
+
+    @property
+    def total_tflops(self) -> float:
+        return self.tflops_bf16 * self.num_chips
+
+    @property
+    def total_hbm_bytes(self) -> int:
+        return int(self.hbm_gib * self.num_chips * (1 << 30))
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "HardwareInfo":
+        return cls(**d)
+
+
+def _device_kind_key(kind: str) -> str:
+    kind = kind.lower()
+    for key in ("v6e", "v5p", "v5e", "v4"):
+        if key in kind or key.replace("v5e", "v5 lite") in kind:
+            return key
+    if "tpu" in kind:
+        return "v5e"
+    return "cpu"
+
+
+def detect_hardware() -> HardwareInfo:
+    """Probe jax for the local device topology."""
+    import jax
+
+    devices = jax.local_devices()
+    kind = _device_kind_key(devices[0].device_kind if devices else "cpu")
+    tflops, hbm, bw, ici = TPU_CHIP_DB[kind]
+    # Prefer live memory stats when the runtime exposes them.
+    try:
+        stats = devices[0].memory_stats()
+        if stats and "bytes_limit" in stats:
+            hbm = stats["bytes_limit"] / (1 << 30)
+    except Exception:
+        pass
+    return HardwareInfo(
+        device_kind=kind,
+        num_chips=len(devices),
+        tflops_bf16=tflops,
+        hbm_gib=hbm,
+        hbm_gbps=bw,
+        ici_gbps=ici,
+    )
+
+
+def device_free_memory_bytes(fraction: float = 0.9) -> int:
+    """Usable HBM bytes on device 0 for KV-cache budgeting.
+
+    Reference counterpart: ``cache_manager._calculate_cache_allocation``
+    reading device free memory (src/parallax/server/cache_manager.py:354-420).
+    """
+    import jax
+
+    dev = jax.local_devices()[0]
+    try:
+        stats = dev.memory_stats()
+        limit = stats.get("bytes_limit")
+        used = stats.get("bytes_in_use", 0)
+        if limit:
+            return int((limit - used) * fraction)
+    except Exception:
+        pass
+    kind = _device_kind_key(dev.device_kind)
+    return int(TPU_CHIP_DB[kind][1] * (1 << 30) * fraction)

@@ -1,0 +1,25 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip hardware is unavailable in CI; all sharding tests (tp/pp/dp/sp)
+run over ``--xla_force_host_platform_device_count=8`` CPU devices, mirroring
+how the driver dry-runs the multi-chip path.
+"""
+
+import os
+
+# Hard override: the driver environment pins JAX_PLATFORMS to the real TPU
+# tunnel; tests always run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402  (import after env setup)
+
+# The driver environment's PJRT plugin (axon) force-sets
+# jax_platforms="axon,cpu" at the config level, overriding the env var —
+# override it back so tests never touch the tunneled TPU.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,113 @@
+"""Sampler tests (capability parity: reference tests/test_sampler.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from parallax_tpu.ops.sampling import apply_penalties, sample_tokens
+
+
+def _params(b, temperature=1.0, top_k=0, top_p=1.0, min_p=0.0):
+    return dict(
+        temperature=jnp.full((b,), temperature, jnp.float32),
+        top_k=jnp.full((b,), top_k, jnp.int32),
+        top_p=jnp.full((b,), top_p, jnp.float32),
+        min_p=jnp.full((b,), min_p, jnp.float32),
+    )
+
+
+def test_greedy_when_temperature_zero():
+    logits = jnp.asarray(np.random.default_rng(0).standard_normal((4, 50)))
+    ids = sample_tokens(logits, jax.random.key(0), **_params(4, temperature=0.0))
+    np.testing.assert_array_equal(np.asarray(ids), np.argmax(logits, axis=-1))
+
+
+def test_top_k_one_is_greedy():
+    logits = jnp.asarray(np.random.default_rng(1).standard_normal((8, 100)))
+    ids = sample_tokens(
+        logits, jax.random.key(1), **_params(8, temperature=1.0, top_k=1)
+    )
+    np.testing.assert_array_equal(np.asarray(ids), np.argmax(logits, axis=-1))
+
+
+def test_top_k_restricts_support():
+    rng = np.random.default_rng(2)
+    logits = jnp.asarray(rng.standard_normal((16, 64)).astype(np.float32))
+    top5 = np.argsort(-np.asarray(logits), axis=-1)[:, :5]
+    for seed in range(5):
+        ids = np.asarray(
+            sample_tokens(
+                logits, jax.random.key(seed), **_params(16, top_k=5)
+            )
+        )
+        for b in range(16):
+            assert ids[b] in top5[b]
+
+
+def test_top_p_restricts_support():
+    # One dominant token (p>0.9), rest tiny: top_p=0.5 must always pick it.
+    logits = np.full((4, 32), -10.0, dtype=np.float32)
+    logits[:, 7] = 5.0
+    ids = np.asarray(
+        sample_tokens(
+            jnp.asarray(logits), jax.random.key(3), **_params(4, top_p=0.5)
+        )
+    )
+    assert np.all(ids == 7)
+
+
+def test_min_p_filters_tail():
+    logits = np.zeros((2, 10), dtype=np.float32)
+    logits[:, 0] = 10.0  # max prob ~1; min_p=0.5 excludes everything else
+    ids = np.asarray(
+        sample_tokens(
+            jnp.asarray(logits), jax.random.key(4), **_params(2, min_p=0.5)
+        )
+    )
+    assert np.all(ids == 0)
+
+
+def test_mixed_batch_params():
+    rng = np.random.default_rng(5)
+    logits = jnp.asarray(rng.standard_normal((3, 40)).astype(np.float32))
+    ids = np.asarray(
+        sample_tokens(
+            logits,
+            jax.random.key(5),
+            temperature=jnp.asarray([0.0, 1.0, 0.7], jnp.float32),
+            top_k=jnp.asarray([0, 1, 3], jnp.int32),
+            top_p=jnp.asarray([1.0, 1.0, 0.9], jnp.float32),
+            min_p=jnp.asarray([0.0, 0.0, 0.0], jnp.float32),
+        )
+    )
+    assert ids[0] == int(np.argmax(logits[0]))
+    assert ids[1] == int(np.argmax(logits[1]))
+
+
+def test_penalties():
+    logits = jnp.zeros((2, 8), jnp.float32)
+    counts = jnp.zeros((2, 8), jnp.int32).at[0, 3].set(2)
+    out = np.asarray(
+        apply_penalties(
+            logits,
+            counts,
+            presence_penalty=jnp.asarray([1.0, 1.0]),
+            frequency_penalty=jnp.asarray([0.5, 0.5]),
+            repetition_penalty=jnp.asarray([1.0, 1.0]),
+        )
+    )
+    assert out[0, 3] == -1.0 - 0.5 * 2
+    assert np.all(out[1] == 0.0)
+    # repetition penalty scales positive logits down, negative up
+    logits2 = jnp.asarray([[2.0, -2.0, 0.0]])
+    counts2 = jnp.asarray([[1, 1, 0]], jnp.int32)
+    out2 = np.asarray(
+        apply_penalties(
+            logits2,
+            counts2,
+            presence_penalty=jnp.asarray([0.0]),
+            frequency_penalty=jnp.asarray([0.0]),
+            repetition_penalty=jnp.asarray([2.0]),
+        )
+    )
+    np.testing.assert_allclose(out2[0], [1.0, -4.0, 0.0])
