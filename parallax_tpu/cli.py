@@ -1,0 +1,77 @@
+"""parallax-tpu command line interface.
+
+Capability parity target: reference ``src/parallax/cli.py:26-473``
+(``parallax run/join/serve/chat``). Subcommands grow with the framework:
+
+- ``serve``  — single-host OpenAI-compatible server (model + layer range)
+- ``run``    — launch the global scheduler + HTTP frontend
+- ``join``   — join a swarm as a worker node
+- ``bench``  — run the offline throughput benchmark
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="parallax-tpu",
+        description="TPU-native decentralized LLM serving",
+    )
+    sub = p.add_subparsers(dest="command")
+
+    serve = sub.add_parser("serve", help="serve a model on this host")
+    serve.add_argument("--model-path", required=True)
+    serve.add_argument("--start-layer", type=int, default=None)
+    serve.add_argument("--end-layer", type=int, default=None)
+    serve.add_argument("--port", type=int, default=8000)
+    serve.add_argument("--host", default="0.0.0.0")
+    serve.add_argument("--page-size", type=int, default=64)
+    serve.add_argument("--max-batch-size", type=int, default=64)
+    serve.add_argument("--max-model-len", type=int, default=8192)
+    serve.add_argument("--kv-utilization", type=float, default=0.9)
+
+    run = sub.add_parser("run", help="launch the scheduler + web frontend")
+    run.add_argument("--model-name", required=True)
+    run.add_argument("--min-nodes", type=int, default=1)
+    run.add_argument("--port", type=int, default=3001)
+
+    join = sub.add_parser("join", help="join a swarm as a worker")
+    join.add_argument("--scheduler-addr", required=True)
+    join.add_argument("--model-path", default=None)
+    join.add_argument("--port", type=int, default=0)
+
+    bench = sub.add_parser("bench", help="offline throughput benchmark")
+    bench.add_argument("--config", default="qwen2-7b")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command is None:
+        build_parser().print_help()
+        return 1
+    if args.command == "serve":
+        from parallax_tpu.backend.serve import serve_main
+
+        return serve_main(args)
+    if args.command == "run":
+        from parallax_tpu.backend.run import run_main
+
+        return run_main(args)
+    if args.command == "join":
+        from parallax_tpu.p2p.join import join_main
+
+        return join_main(args)
+    if args.command == "bench":
+        import bench
+
+        bench.main()
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

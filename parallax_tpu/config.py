@@ -43,6 +43,10 @@ class MoEConfig:
     n_group: int = 0
     topk_group: int = 0
     scoring_func: str = "softmax"   # or "sigmoid" (DeepSeek-V3)
+    # Explicit per-layer MoE mask, resolved at normalize time from the source
+    # convention (DeepSeek first_k_dense_replace/moe_layer_freq vs Qwen
+    # decoder_sparse_step/mlp_only_layers use different off-by-one rules).
+    layer_mask: tuple[bool, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -168,12 +172,15 @@ class ModelConfig:
             ffn = 3 * h * self.intermediate_size
         return attn + ffn + 2 * h  # + 2 rmsnorm vectors
 
-    def _is_moe_layer(self, layer_idx: int) -> bool:
+    def is_moe_layer(self, layer_idx: int) -> bool:
         if self.moe is None:
             return False
-        if layer_idx < self.moe.first_k_dense_replace:
-            return False
-        return (layer_idx - self.moe.first_k_dense_replace) % self.moe.moe_layer_freq == 0
+        if self.moe.layer_mask:
+            return self.moe.layer_mask[layer_idx]
+        return layer_idx >= self.moe.first_k_dense_replace
+
+    # Backwards-compat internal alias.
+    _is_moe_layer = is_moe_layer
 
     def decoder_layer_flops(self, num_tokens: int, context_len: int) -> float:
         """FLOPs of one decoder layer forward over ``num_tokens`` new tokens.
@@ -237,7 +244,25 @@ def normalize_config(raw: dict, model_name: str = "") -> ModelConfig:
     moe = None
     n_experts = _get(cfg, "num_experts", "n_routed_experts", "num_local_experts")
     if n_experts:
+        # Resolve the per-layer MoE mask under the source convention:
+        # Qwen: MoE iff (idx+1) % decoder_sparse_step == 0 and idx not in
+        # mlp_only_layers; DeepSeek: MoE iff idx >= first_k_dense_replace
+        # and idx % moe_layer_freq == 0.
+        first_k = int(_get(cfg, "first_k_dense_replace", default=0) or 0)
+        mlp_only = set(_get(cfg, "mlp_only_layers", default=[]) or [])
+        if "decoder_sparse_step" in cfg:
+            step = int(cfg["decoder_sparse_step"] or 1)
+            mask = tuple(
+                (i + 1) % step == 0 and i not in mlp_only
+                for i in range(num_layers)
+            )
+        else:
+            freq = int(_get(cfg, "moe_layer_freq", default=1) or 1)
+            mask = tuple(
+                i >= first_k and i % freq == 0 for i in range(num_layers)
+            )
         moe = MoEConfig(
+            layer_mask=mask,
             num_experts=int(n_experts),
             num_experts_per_tok=int(_get(cfg, "num_experts_per_tok", "top_k", default=2)),
             moe_intermediate_size=int(_get(cfg, "moe_intermediate_size", default=inter)),

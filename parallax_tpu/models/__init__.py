@@ -1,0 +1,14 @@
+"""Model zoo: jit-compiled pipeline-stage forward functions per architecture.
+
+Capability parity: reference ``src/parallax/models`` (MLX Parallax blocks,
+SURVEY.md section 2.5). The TPU design replaces per-model attention-cache
+plumbing with one functional block family operating on flattened ragged
+batches over paged KV; architectures register themselves by HF
+``architectures[0]`` name, mirroring the reference's EntryClass registry
+(``shard_loader.py:79-112``).
+"""
+
+from parallax_tpu.models.base import BatchInputs, StageModel
+from parallax_tpu.models.registry import MODEL_REGISTRY, get_model_class
+
+__all__ = ["StageModel", "BatchInputs", "MODEL_REGISTRY", "get_model_class"]

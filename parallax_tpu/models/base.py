@@ -1,0 +1,240 @@
+"""StageModel: one pipeline stage as a pure jit-compiled function.
+
+Capability parity: reference ``src/parallax/server/model.py:17-189``
+(ShardedModel: embed iff first shard, norm+lm_head iff last, block
+iteration threading cache state). The TPU design makes the stage a pure
+function ``(params, kv_caches, BatchInputs) -> (output, kv_caches)`` so the
+executor can jit it once per shape bucket with the KV pytree donated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from parallax_tpu.config import LAYER_SLIDING, ModelConfig
+from parallax_tpu.models import layers as L
+from parallax_tpu.ops import new_kv_pages
+from parallax_tpu.ops.rope import rope_frequencies, rope_table
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BatchInputs:
+    """Device inputs for one engine step (all fixed-shape per bucket).
+
+    ``token_ids`` is used by the first stage, ``hidden_states`` by later
+    stages; exactly one is non-None.
+    """
+
+    token_ids: jax.Array | None      # i32[T]
+    hidden_states: jax.Array | None  # [T, hidden]
+    positions: jax.Array             # i32[T] absolute positions
+    kv_lens: jax.Array               # i32[S]
+    page_indices: jax.Array          # i32[S, pages_per_seq]
+    cu_q_lens: jax.Array             # i32[S+1]
+    num_seqs: jax.Array              # i32[1]
+    slot_mapping: jax.Array          # i32[T]
+    logits_indices: jax.Array        # i32[S] last-token row per sequence
+
+
+class StageModel:
+    """A contiguous range ``[start_layer, end_layer)`` of decoder blocks."""
+
+    def __init__(
+        self,
+        config: ModelConfig,
+        start_layer: int,
+        end_layer: int,
+        use_pallas: bool | None = None,
+    ):
+        self.config = config
+        self.start_layer = start_layer
+        self.end_layer = end_layer
+        self.is_first = start_layer == 0
+        self.is_last = end_layer == config.num_hidden_layers
+        self.use_pallas = use_pallas
+        inv = rope_frequencies(
+            config.head_dim,
+            config.rope_theta,
+            config.rope_scaling,
+            config.partial_rotary_factor,
+        )
+        scaling = 1.0
+        if config.rope_scaling and "attention_factor" in config.rope_scaling:
+            scaling = float(config.rope_scaling["attention_factor"])
+        self.cos_table, self.sin_table = rope_table(
+            inv, config.max_position_embeddings, scaling
+        )
+
+    # -- structure --------------------------------------------------------
+
+    @property
+    def num_local_layers(self) -> int:
+        return self.end_layer - self.start_layer
+
+    def local_layer_types(self) -> list[str]:
+        return [
+            self.config.layer_type(i)
+            for i in range(self.start_layer, self.end_layer)
+        ]
+
+    def new_kv_caches(
+        self, num_pages: int, page_size: int, dtype=jnp.bfloat16
+    ) -> list[jax.Array]:
+        """One paged cache per local layer."""
+        return [
+            new_kv_pages(
+                num_pages,
+                page_size,
+                self.config.num_key_value_heads,
+                self.config.head_dim,
+                dtype,
+            )
+            for _ in range(self.num_local_layers)
+        ]
+
+    # -- parameters -------------------------------------------------------
+
+    def init_params(self, rng: jax.Array, dtype=jnp.bfloat16) -> dict:
+        """Random init (tests / benchmarks with synthetic weights)."""
+        cfg = self.config
+        keys = jax.random.split(rng, self.num_local_layers + 2)
+
+        def dense(key, out_dim, in_dim, bias=False):
+            p = {
+                "weight": (
+                    jax.random.normal(key, (out_dim, in_dim), jnp.float32)
+                    * (in_dim**-0.5)
+                ).astype(dtype)
+            }
+            if bias:
+                p["bias"] = jnp.zeros((out_dim,), dtype)
+            return p
+
+        params: dict = {"layers": []}
+        for li in range(self.num_local_layers):
+            k = jax.random.split(keys[li], 8)
+            h, d = cfg.hidden_size, cfg.head_dim
+            layer = {
+                "input_layernorm": {"weight": jnp.ones((h,), dtype)},
+                "post_attention_layernorm": {"weight": jnp.ones((h,), dtype)},
+                "self_attn": {
+                    "q_proj": dense(k[0], cfg.num_attention_heads * d, h,
+                                    cfg.attention_bias),
+                    "k_proj": dense(k[1], cfg.num_key_value_heads * d, h,
+                                    cfg.attention_bias),
+                    "v_proj": dense(k[2], cfg.num_key_value_heads * d, h,
+                                    cfg.attention_bias),
+                    "o_proj": dense(k[3], h, cfg.num_attention_heads * d),
+                },
+                "mlp": {
+                    "gate_proj": dense(k[4], cfg.intermediate_size, h),
+                    "up_proj": dense(k[5], cfg.intermediate_size, h),
+                    "down_proj": dense(k[6], h, cfg.intermediate_size),
+                },
+            }
+            if cfg.use_qk_norm:
+                layer["self_attn"]["q_norm"] = {"weight": jnp.ones((d,), dtype)}
+                layer["self_attn"]["k_norm"] = {"weight": jnp.ones((d,), dtype)}
+            params["layers"].append(layer)
+
+        if self.is_first:
+            params["embed_tokens"] = {
+                "weight": (
+                    jax.random.normal(
+                        keys[-2], (cfg.vocab_size, cfg.hidden_size), jnp.float32
+                    )
+                    * 0.02
+                ).astype(dtype)
+            }
+        if self.is_last:
+            params["norm"] = {"weight": jnp.ones((cfg.hidden_size,), dtype)}
+            if not cfg.tie_word_embeddings:
+                params["lm_head"] = {
+                    "weight": (
+                        jax.random.normal(
+                            keys[-1], (cfg.vocab_size, cfg.hidden_size), jnp.float32
+                        )
+                        * 0.02
+                    ).astype(dtype)
+                }
+        return params
+
+    # -- forward ----------------------------------------------------------
+
+    def __call__(
+        self,
+        params: dict,
+        kv_caches: list[jax.Array],
+        inputs: BatchInputs,
+    ) -> tuple[jax.Array, list[jax.Array]]:
+        """Run the stage.
+
+        Returns ``(hidden [T, hidden], kv)`` for intermediate stages, or
+        ``(logits [S, vocab], kv)`` on the last stage (gathered at each
+        sequence's final token — reference ``logits_to_tokens``,
+        model.py:88-124).
+        """
+        cfg = self.config
+        if self.is_first:
+            x = L.embed_lookup(params["embed_tokens"]["weight"], inputs.token_ids)
+        else:
+            x = inputs.hidden_states
+
+        new_kv: list[jax.Array] = []
+        for li in range(self.num_local_layers):
+            lp = params["layers"][li]
+            gi = self.start_layer + li
+            window = (
+                cfg.sliding_window
+                if cfg.layer_type(gi) == LAYER_SLIDING
+                else None
+            )
+            x, kv_l = self._decoder_layer(lp, x, kv_caches[li], inputs, window)
+            new_kv.append(kv_l)
+
+        if not self.is_last:
+            return x, new_kv
+
+        x = L.rms_norm(x, params["norm"]["weight"], cfg.rms_norm_eps)
+        x = x[inputs.logits_indices]
+        head = params.get("lm_head") or params["embed_tokens"]
+        logits = L.lm_head_logits(x, head)
+        return logits, new_kv
+
+    def _decoder_layer(
+        self,
+        lp: dict,
+        x: jax.Array,
+        kv: jax.Array,
+        inputs: BatchInputs,
+        window: int | None,
+    ) -> tuple[jax.Array, jax.Array]:
+        cfg = self.config
+        h = L.rms_norm(x, lp["input_layernorm"]["weight"], cfg.rms_norm_eps)
+        attn_out, kv = L.paged_attention_block(
+            h,
+            lp["self_attn"],
+            kv,
+            config=cfg,
+            positions=inputs.positions,
+            kv_lens=inputs.kv_lens,
+            page_indices=inputs.page_indices,
+            cu_q_lens=inputs.cu_q_lens,
+            num_seqs=inputs.num_seqs,
+            slot_mapping=inputs.slot_mapping,
+            cos_table=self.cos_table,
+            sin_table=self.sin_table,
+            sliding_window=window,
+            use_pallas=self.use_pallas,
+        )
+        x = x + attn_out
+        h = L.rms_norm(x, lp["post_attention_layernorm"]["weight"], cfg.rms_norm_eps)
+        x = x + self._mlp(lp, h)
+        return x, kv
+
+    def _mlp(self, lp: dict, h: jax.Array) -> jax.Array:
+        return L.swiglu_mlp(h, lp["mlp"])

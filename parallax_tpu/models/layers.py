@@ -1,0 +1,117 @@
+"""Functional building blocks shared by the model zoo.
+
+All blocks operate on a flattened ragged token batch ``x: [T, hidden]`` —
+never [batch, seq]: continuous batching means every step mixes sequences of
+different lengths, and a flat layout keeps every matmul dense on the MXU
+with zero per-sequence padding. Params are plain dicts of jnp arrays keyed
+with HF weight names (so the safetensors loader needs no remapping tables).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from parallax_tpu.config import ModelConfig
+from parallax_tpu.ops import apply_rope, ragged_paged_attention, reshape_and_cache
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    orig_dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    x = x * jax.lax.rsqrt(var + eps)
+    return (x * weight.astype(jnp.float32)).astype(orig_dtype)
+
+
+def linear(x: jax.Array, p: dict) -> jax.Array:
+    """x @ W^T + b with HF [out, in] weight layout kept as stored.
+
+    Keeping the HF layout (contracting on dim 1) avoids a transpose at load
+    time; XLA folds the contraction orientation into the matmul tiling.
+    """
+    out = jax.lax.dot_general(
+        x, p["weight"],
+        dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    if "bias" in p:
+        out = out + p["bias"].astype(out.dtype)
+    return out
+
+
+def embed_lookup(embedding: jax.Array, token_ids: jax.Array) -> jax.Array:
+    return embedding[token_ids]
+
+
+def lm_head_logits(x: jax.Array, p: dict) -> jax.Array:
+    """Final projection in fp32 for a numerically stable softmax/sampler."""
+    return jax.lax.dot_general(
+        x, p["weight"],
+        dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def swiglu_mlp(x: jax.Array, p: dict) -> jax.Array:
+    """SwiGLU FFN (gate/up/down)."""
+    gate = linear(x, p["gate_proj"])
+    up = linear(x, p["up_proj"])
+    return linear(jax.nn.silu(gate) * up, p["down_proj"])
+
+
+def paged_attention_block(
+    x: jax.Array,
+    p: dict,
+    kv_pages: jax.Array,
+    *,
+    config: ModelConfig,
+    positions: jax.Array,
+    kv_lens: jax.Array,
+    page_indices: jax.Array,
+    cu_q_lens: jax.Array,
+    num_seqs: jax.Array,
+    slot_mapping: jax.Array,
+    cos_table: jax.Array,
+    sin_table: jax.Array,
+    sliding_window: int | None = None,
+    use_pallas: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """GQA attention over the paged cache: project, rope, scatter, attend.
+
+    Semantics of the reference per-model attention
+    (``src/parallax/models/qwen3.py:30-143``): new K/V always enter the
+    cache first, attention always reads from the cache, so prefix hits and
+    chunked prefill need no separate code path.
+    """
+    t = x.shape[0]
+    hq, hkv, d = (
+        config.num_attention_heads,
+        config.num_key_value_heads,
+        config.head_dim,
+    )
+    q = linear(x, p["q_proj"]).reshape(t, hq, d)
+    k = linear(x, p["k_proj"]).reshape(t, hkv, d)
+    v = linear(x, p["v_proj"]).reshape(t, hkv, d)
+
+    if config.use_qk_norm and "q_norm" in p:
+        q = rms_norm(q, p["q_norm"]["weight"], config.rms_norm_eps)
+        k = rms_norm(k, p["k_norm"]["weight"], config.rms_norm_eps)
+
+    q = apply_rope(q, positions, cos_table, sin_table)
+    k = apply_rope(k, positions, cos_table, sin_table)
+
+    kv_pages = reshape_and_cache(kv_pages, k, v, slot_mapping)
+    out = ragged_paged_attention(
+        q,
+        kv_pages,
+        kv_lens,
+        page_indices,
+        cu_q_lens,
+        num_seqs,
+        sm_scale=d**-0.5,
+        sliding_window=sliding_window,
+        sinks=p.get("sinks"),
+        use_pallas=use_pallas,
+    )
+    return linear(out.reshape(t, hq * d), p["o_proj"]), kv_pages

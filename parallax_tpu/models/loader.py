@@ -1,0 +1,136 @@
+"""Shard-selective safetensors weight loading.
+
+Capability parity: reference ``src/parallax/server/shard_loader.py:47-653``
+(MLXModelLoader: select only the files/keys containing the shard's layers,
+remap global layer indices to stage-local ones, tied embeddings). The TPU
+loader materializes the stage param pytree directly as jnp arrays in the
+target dtype — weights keep the HF [out, in] layout (see
+``models/layers.linear``), so no transposition pass is needed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+import jax.numpy as jnp
+import numpy as np
+
+from parallax_tpu.config import ModelConfig
+from parallax_tpu.models.base import StageModel
+from parallax_tpu.utils import get_logger
+
+logger = get_logger(__name__)
+
+_LAYER_RE = re.compile(r"^model\.layers\.(\d+)\.(.+)$")
+
+_DTYPE_MAP = {
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+    "float32": jnp.float32,
+}
+
+
+def _weight_files(model_path: str) -> list[str]:
+    index = os.path.join(model_path, "model.safetensors.index.json")
+    if os.path.exists(index):
+        with open(index, encoding="utf-8") as f:
+            files = sorted(set(json.load(f)["weight_map"].values()))
+        return [os.path.join(model_path, f) for f in files]
+    single = os.path.join(model_path, "model.safetensors")
+    if os.path.exists(single):
+        return [single]
+    raise FileNotFoundError(f"no safetensors weights under {model_path}")
+
+
+def shard_key_filter(
+    key: str, start_layer: int, end_layer: int, num_layers: int
+) -> str | None:
+    """Map a global HF weight key to a stage-local param path, or None if the
+    key belongs to another stage (the selective-download filter of reference
+    ``model_download.py`` / ``weight_filter_utils.py``)."""
+    m = _LAYER_RE.match(key)
+    if m:
+        gi = int(m.group(1))
+        if start_layer <= gi < end_layer:
+            return f"layers.{gi - start_layer}.{m.group(2)}"
+        return None
+    if key.startswith("model.embed_tokens."):
+        # embed needed on first stage; also on last for tied lm_head.
+        return "embed_tokens." + key.split(".", 2)[2]
+    if key.startswith("model.norm."):
+        return "norm." + key.split(".", 2)[2] if end_layer == num_layers else None
+    if key.startswith("lm_head."):
+        return key if end_layer == num_layers else None
+    return None
+
+
+def _assign(tree: dict, path: str, value) -> None:
+    parts = path.split(".")
+    node = tree
+    for i, part in enumerate(parts[:-1]):
+        if part == "layers" and i == 0:
+            node = node.setdefault("layers", {})
+        else:
+            node = node.setdefault(part, {})
+    node[parts[-1]] = value
+
+
+def load_stage_params(
+    model: StageModel, model_path: str, dtype=jnp.bfloat16
+) -> dict:
+    """Load this stage's weights from a local HF checkpoint directory."""
+    from safetensors import safe_open
+
+    cfg = model.config
+    tree: dict = {}
+    want_embed = model.is_first or (model.is_last and cfg.tie_word_embeddings)
+    n_loaded = 0
+    for path in _weight_files(model_path):
+        with safe_open(path, framework="numpy") as f:
+            for key in f.keys():
+                local = shard_key_filter(
+                    key, model.start_layer, model.end_layer, cfg.num_hidden_layers
+                )
+                if local is None:
+                    continue
+                if local.startswith("embed_tokens") and not want_embed:
+                    continue
+                arr = f.get_tensor(key)
+                _assign(tree, local, jnp.asarray(arr).astype(dtype))
+                n_loaded += 1
+
+    # layers dict {local_idx_str: {...}} -> ordered list
+    layer_map = tree.get("layers", {})
+    tree["layers"] = [
+        layer_map[str(i)] for i in range(model.num_local_layers)
+    ]
+    logger.info(
+        "loaded %d tensors for layers [%d, %d) from %s",
+        n_loaded, model.start_layer, model.end_layer, model_path,
+    )
+    return tree
+
+
+def params_from_torch_state_dict(
+    model: StageModel, state_dict, dtype=jnp.bfloat16
+) -> dict:
+    """Build stage params from an in-memory torch state dict (tests compare
+    against HF transformers reference models)."""
+    cfg = model.config
+    tree: dict = {}
+    want_embed = model.is_first or (model.is_last and cfg.tie_word_embeddings)
+    for key, tensor in state_dict.items():
+        local = shard_key_filter(
+            key, model.start_layer, model.end_layer, cfg.num_hidden_layers
+        )
+        if local is None:
+            continue
+        if local.startswith("embed_tokens") and not want_embed:
+            continue
+        arr = np.asarray(tensor.detach().to("cpu").float().numpy())
+        _assign(tree, local, jnp.asarray(arr).astype(dtype))
+    layer_map = tree.get("layers", {})
+    tree["layers"] = [layer_map[str(i)] for i in range(model.num_local_layers)]
+    return tree

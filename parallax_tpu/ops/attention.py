@@ -74,6 +74,17 @@ def ragged_paged_attention(
     """
     if use_pallas is None:
         use_pallas = _tpu_available()
+    if use_pallas and sinks is not None:
+        # The bundled kernel has no sink support yet; fall back loudly — the
+        # XLA path materializes per-token KV copies and is not HBM-safe at
+        # scale (tracked for a custom Pallas kernel).
+        import warnings
+
+        warnings.warn(
+            "attention sinks requested on TPU: using the XLA fallback "
+            "attention path (memory-heavy); Pallas sink kernel pending",
+            stacklevel=2,
+        )
     if use_pallas and sinks is None:
         from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (
             ragged_paged_attention as _pallas_rpa,

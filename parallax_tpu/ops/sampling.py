@@ -71,14 +71,19 @@ def apply_penalties(
     frequency_penalty: jax.Array,      # f32[B]
     repetition_penalty: jax.Array,     # f32[B]; 1.0 disables
 ) -> jax.Array:
-    """OpenAI-style presence/frequency + HF repetition penalties."""
+    """OpenAI-style presence/frequency + HF repetition penalties.
+
+    The multiplicative repetition penalty applies to the *raw* logits (HF
+    convention); the additive presence/frequency shifts come after, so the
+    penalties compose linearly rather than compounding.
+    """
     logits = logits.astype(jnp.float32)
     present = (output_token_counts > 0).astype(jnp.float32)
+    rep = repetition_penalty[:, None]
+    penalized = jnp.where(logits > 0, logits / rep, logits * rep)
+    logits = jnp.where(present > 0, penalized, logits)
     logits = logits - presence_penalty[:, None] * present
     logits = logits - frequency_penalty[:, None] * output_token_counts.astype(
         jnp.float32
     )
-    rep = repetition_penalty[:, None]
-    penalized = jnp.where(logits > 0, logits / rep, logits * rep)
-    logits = jnp.where(present > 0, penalized, logits)
     return logits

@@ -1,0 +1,18 @@
+"""Node runtime: continuous-batching engine around a jit-compiled stage.
+
+Capability parity with the reference node runtime (``src/parallax/server``,
+SURVEY.md section 2.3): request lifecycle, paged-KV cache management with a
+radix prefix cache, a two-phase continuous-batching scheduler, on-device
+sampling, and the executor run loop. The compute path is re-designed for
+XLA: one flattened ragged batch per step, shape-bucketed to a small lattice
+of compiled programs, with the KV cache donated through every step.
+"""
+
+from parallax_tpu.runtime.request import (
+    IntermediateRequest,
+    Request,
+    RequestStatus,
+    SamplingParams,
+)
+
+__all__ = ["Request", "IntermediateRequest", "RequestStatus", "SamplingParams"]

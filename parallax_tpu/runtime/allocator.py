@@ -1,0 +1,65 @@
+"""Free-list allocators for KV pages and linear-state slots.
+
+Capability parity: reference ``src/parallax/server/cache/allocator.py``
+(BlockAllocator/SlotAllocator). Pages index into the device-side
+``kv_pages`` arrays; slots index into linear-attention state arrays.
+"""
+
+from __future__ import annotations
+
+
+class OutOfPages(Exception):
+    pass
+
+
+class PageAllocator:
+    """O(1) free-list allocator over ``num_pages`` device pages.
+
+    Page 0 is reserved as the null page: padded page-table entries point at
+    it so gathers stay in bounds without branching.
+    """
+
+    def __init__(self, num_pages: int, reserve_null_page: bool = True):
+        self.num_pages = num_pages
+        start = 1 if reserve_null_page else 0
+        self._free = list(range(num_pages - 1, start - 1, -1))
+        self.null_page = 0 if reserve_null_page else -1
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise OutOfPages(f"need {n} pages, {len(self._free)} free")
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, pages: list[int]) -> None:
+        for p in pages:
+            if p == self.null_page:
+                continue
+            self._free.append(p)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+
+class SlotAllocator:
+    """Free-list over fixed-size state slots (linear-attention caches)."""
+
+    def __init__(self, num_slots: int):
+        self.num_slots = num_slots
+        self._free = list(range(num_slots - 1, -1, -1))
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self) -> int:
+        if not self._free:
+            raise OutOfPages("no free slots")
+        return self._free.pop()
+
+    def free(self, slot: int) -> None:
+        self._free.append(slot)

@@ -1,0 +1,121 @@
+"""Host-side batch assembly: BatchPlan -> bucketed device inputs.
+
+This is the TPU-specific piece the reference never needed (SURVEY.md §7
+"Dynamic shapes vs XLA"): continuous batching produces ragged batches every
+step; to avoid recompiles the token count and sequence count are padded up
+to a small lattice of power-of-two buckets, so the engine runs a handful of
+compiled programs regardless of load. Occupancy within a bucket is dynamic
+(``num_seqs``), costing no recompile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from parallax_tpu.models.base import BatchInputs
+from parallax_tpu.runtime.scheduler import BatchPlan
+
+
+def next_bucket(n: int, buckets: list[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"{n} exceeds largest bucket {buckets[-1]}")
+
+
+def default_buckets(max_value: int, floor: int = 8) -> list[int]:
+    out, b = [], floor
+    while b < max_value:
+        out.append(b)
+        b *= 2
+    out.append(max_value)
+    return out
+
+
+@dataclasses.dataclass
+class BucketSpec:
+    """The compile lattice: (token bucket, seq bucket, fixed pages/seq)."""
+
+    token_buckets: list[int]
+    seq_buckets: list[int]
+    pages_per_seq: int
+
+    @classmethod
+    def build(
+        cls, max_num_tokens: int, max_batch_size: int, max_model_len: int,
+        page_size: int,
+    ) -> "BucketSpec":
+        return cls(
+            token_buckets=default_buckets(max_num_tokens),
+            seq_buckets=default_buckets(max_batch_size),
+            pages_per_seq=(max_model_len + page_size - 1) // page_size,
+        )
+
+
+def assemble(
+    plan: BatchPlan,
+    spec: BucketSpec,
+    page_size: int,
+    hidden_states: np.ndarray | None = None,
+) -> BatchInputs:
+    """Build fixed-shape arrays from a ragged plan.
+
+    ``hidden_states`` replaces token ids on non-first stages; rows must be
+    ordered exactly as the plan's segments (already padded to the token
+    bucket by the caller, or padded here).
+    """
+    seqs = plan.seqs
+    t_real = plan.total_new_tokens
+    s_real = len(seqs)
+    t = next_bucket(max(t_real, 1), spec.token_buckets)
+    s = next_bucket(max(s_real, 1), spec.seq_buckets)
+
+    token_ids = np.zeros((t,), np.int32)
+    positions = np.zeros((t,), np.int32)
+    slot_mapping = np.full((t,), -1, np.int32)
+    kv_lens = np.zeros((s,), np.int32)
+    page_indices = np.zeros((s, spec.pages_per_seq), np.int32)
+    cu_q_lens = np.zeros((s + 1,), np.int32)
+    logits_indices = np.zeros((s,), np.int32)
+
+    row = 0
+    for i, seg in enumerate(seqs):
+        n = seg.num_new_tokens
+        start_pos = seg.context_len - n
+        req = seg.request
+        token_ids[row : row + n] = seg.token_ids
+        positions[row : row + n] = np.arange(start_pos, seg.context_len)
+        pages = np.asarray(req.page_ids, np.int32)
+        pos = np.arange(start_pos, seg.context_len)
+        slot_mapping[row : row + n] = pages[pos // page_size] * page_size + pos % page_size
+        kv_lens[i] = seg.context_len
+        page_indices[i, : len(pages)] = pages
+        cu_q_lens[i + 1] = cu_q_lens[i] + n
+        logits_indices[i] = row + n - 1
+        row += n
+    cu_q_lens[s_real + 1 :] = cu_q_lens[s_real]
+
+    return BatchInputs(
+        token_ids=jnp.asarray(token_ids),
+        hidden_states=(
+            None if hidden_states is None
+            else jnp.asarray(_pad_rows(hidden_states, t))
+        ),
+        positions=jnp.asarray(positions),
+        kv_lens=jnp.asarray(kv_lens),
+        page_indices=jnp.asarray(page_indices),
+        cu_q_lens=jnp.asarray(cu_q_lens),
+        num_seqs=jnp.asarray([s_real], jnp.int32),
+        slot_mapping=jnp.asarray(slot_mapping),
+        logits_indices=jnp.asarray(logits_indices),
+    )
+
+
+def _pad_rows(x: np.ndarray, t: int) -> np.ndarray:
+    if x.shape[0] == t:
+        return x
+    pad = np.zeros((t - x.shape[0], x.shape[1]), x.dtype)
+    return np.concatenate([x, pad], axis=0)

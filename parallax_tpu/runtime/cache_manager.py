@@ -1,0 +1,174 @@
+"""KV cache orchestration: page ownership, prefix reuse, memory budgeting.
+
+Capability parity: reference ``src/parallax/server/cache_manager.py:25-804``
+(CacheManager: allocation w/ prefix match + eviction on pressure, decode
+append, prefix insertion on release, HBM budgeting). The device arrays
+themselves live in the executor's jit state; this class only does the
+host-side bookkeeping — pages never move on device, only ids are shared.
+
+Ownership model: every device page has one owner — an in-flight request or
+the radix tree. Prefix-cache hits share tree-owned pages read-only, pinned
+via lock refs for the request's lifetime.
+"""
+
+from __future__ import annotations
+
+import math
+
+from parallax_tpu.config import LAYER_ATTENTION, LAYER_SLIDING, ModelConfig
+from parallax_tpu.runtime.allocator import OutOfPages, PageAllocator
+from parallax_tpu.runtime.radix_cache import RadixPageCache
+from parallax_tpu.runtime.request import Request
+from parallax_tpu.utils import get_logger
+
+logger = get_logger(__name__)
+
+
+def kv_bytes_per_page(
+    config: ModelConfig, num_local_layers: int, page_size: int, dtype_bytes: int = 2
+) -> int:
+    """Device bytes one page occupies across this shard's attention layers."""
+    per_token = 2 * config.num_key_value_heads * config.head_dim * dtype_bytes
+    return per_token * page_size * num_local_layers
+
+
+def derive_num_pages(
+    free_bytes: int,
+    config: ModelConfig,
+    num_local_layers: int,
+    page_size: int,
+    utilization: float = 0.9,
+    dtype_bytes: int = 2,
+) -> int:
+    """KV page budget from free HBM (reference
+    ``cache_manager._calculate_cache_allocation``, cache_manager.py:354-420)."""
+    per_page = kv_bytes_per_page(config, num_local_layers, page_size, dtype_bytes)
+    return max(8, int(free_bytes * utilization) // per_page)
+
+
+class CacheManager:
+    """Host-side paged-KV bookkeeping for one pipeline stage."""
+
+    def __init__(
+        self,
+        page_size: int,
+        num_pages: int,
+        enable_prefix_cache: bool = True,
+        max_model_len: int = 32768,
+    ):
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.max_model_len = max_model_len
+        self.allocator = PageAllocator(num_pages)
+        self.enable_prefix_cache = enable_prefix_cache
+        self.prefix_cache = RadixPageCache(page_size)
+        # rid -> (locked node path, number of shared tree-owned pages)
+        self._locked: dict[str, tuple[list, int]] = {}
+
+    # -- capacity ---------------------------------------------------------
+
+    @property
+    def num_free_pages(self) -> int:
+        return self.allocator.num_free
+
+    def pages_needed(self, num_tokens: int) -> int:
+        return math.ceil(num_tokens / self.page_size)
+
+    def _reclaim(self, need: int) -> bool:
+        """Free pages from the prefix cache until ``need`` are available."""
+        if self.allocator.num_free >= need:
+            return True
+        deficit = need - self.allocator.num_free
+        freed = self.prefix_cache.evict(deficit)
+        self.allocator.free(freed)
+        return self.allocator.num_free >= need
+
+    def can_admit(self, request: Request) -> bool:
+        """Cheap admission check used by the scheduler's wait-queue scan."""
+        matched = 0
+        if self.enable_prefix_cache:
+            pages, _ = self.prefix_cache.match_prefix(request.prompt_ids)
+            matched = min(len(pages), max(0, (request.num_prompt_tokens - 1)) // self.page_size)
+        need = self.pages_needed(request.num_prompt_tokens) - matched
+        return (
+            self.allocator.num_free + self.prefix_cache.num_cached_pages >= need
+        )
+
+    # -- request lifecycle ------------------------------------------------
+
+    def allocate_for_prompt(self, request: Request) -> bool:
+        """Admit a request: prefix-match, pin, allocate the rest.
+
+        Sets ``request.page_ids`` / ``num_cached_tokens`` /
+        ``num_computed_tokens``. Returns False (no side effects) when memory
+        is insufficient even after eviction.
+        Reference: ``allocate_request`` (cache_manager.py:462-564).
+        """
+        prompt_len = request.num_prompt_tokens
+        shared_pages: list[int] = []
+        path: list = []
+        if self.enable_prefix_cache and prompt_len > 1:
+            pages, full_path = self.prefix_cache.match_prefix(request.prompt_ids)
+            # Always leave >=1 prompt token to recompute so the stage emits a
+            # hidden state for sampling.
+            usable = min(len(pages), (prompt_len - 1) // self.page_size)
+            shared_pages = pages[:usable]
+            path = full_path[:usable]
+
+        total_pages = self.pages_needed(prompt_len)
+        fresh_needed = total_pages - len(shared_pages)
+        if not self._reclaim(fresh_needed):
+            return False
+        self.prefix_cache.lock(path)
+        try:
+            fresh = self.allocator.alloc(fresh_needed)
+        except OutOfPages:
+            self.prefix_cache.unlock(path)
+            return False
+        request.page_ids = shared_pages + fresh
+        request.num_cached_tokens = len(shared_pages) * self.page_size
+        request.num_computed_tokens = request.num_cached_tokens
+        self._locked[request.request_id] = (path, len(shared_pages))
+        return True
+
+    def ensure_capacity(self, request: Request, new_total_tokens: int) -> bool:
+        """Grow the page list to cover ``new_total_tokens`` (decode append).
+
+        Reference: ``append_slot`` (cache_manager.py:606-629).
+        """
+        need = self.pages_needed(new_total_tokens) - len(request.page_ids)
+        if need <= 0:
+            return True
+        if not self._reclaim(need):
+            return False
+        try:
+            request.page_ids.extend(self.allocator.alloc(need))
+        except OutOfPages:
+            return False
+        return True
+
+    def release(self, request: Request) -> None:
+        """Return a finished/aborted request's pages.
+
+        Full pages of the final context are donated to the prefix cache;
+        duplicates and the ragged tail are freed.
+        Reference: ``insert_full_blocks_to_cache`` (cache_manager.py:704-791).
+        """
+        path, num_shared = self._locked.pop(request.request_id, ([], 0))
+        self.prefix_cache.unlock(path)
+        owned = request.page_ids[num_shared:]
+        if not owned:
+            request.page_ids = []
+            return
+        if self.enable_prefix_cache and request.status.value != "finished_abort":
+            tokens = request.all_token_ids
+            n_full = len(tokens) // self.page_size
+            tail = owned[max(0, n_full - num_shared):]
+            duplicates = self.prefix_cache.insert(tokens, request.page_ids[:n_full])
+            self.allocator.free(duplicates + tail)
+        else:
+            self.allocator.free(owned)
+        request.page_ids = []
+
+    def reset_prefix_cache(self) -> None:
+        self.allocator.free(self.prefix_cache.reset())

@@ -1,0 +1,356 @@
+"""StageEngine: the per-node execution engine around one jit-compiled stage.
+
+Capability parity: reference executor layer
+(``src/parallax/server/executor/base_executor.py:58-877`` +
+``mlx_executor.py:41-856``): continuous-batching run loop, prefill/decode
+batch preparation, on-last-stage sampling, request mirrors on non-head
+stages, OOM/abort handling. TPU re-design: one jitted pure function per
+shape bucket with the KV cache donated through every call; batch prep is
+O(tokens) numpy; sampling is a second fused jit call.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from parallax_tpu.config import ModelConfig
+from parallax_tpu.models.base import BatchInputs, StageModel
+from parallax_tpu.ops.sampling import sample_tokens
+from parallax_tpu.runtime.batch import BucketSpec, assemble
+from parallax_tpu.runtime.cache_manager import CacheManager
+from parallax_tpu.runtime.request import (
+    IntermediateRequest,
+    Request,
+    RequestStatus,
+    SamplingParams,
+)
+from parallax_tpu.runtime.scheduler import BatchPlan, Scheduler
+from parallax_tpu.utils import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    page_size: int = 64
+    num_pages: int = 1024
+    max_batch_size: int = 64
+    max_num_tokens_per_batch: int = 2048
+    prefill_chunk_size: int = 1024
+    max_model_len: int = 8192
+    enable_prefix_cache: bool = True
+    kv_dtype: str = "bfloat16"
+    seed: int = 0
+    request_timeout_s: float = 600.0
+
+
+@dataclasses.dataclass
+class StepOutputs:
+    """What one engine step produced."""
+
+    # Packets to forward to the next stage (hidden) or back to the head
+    # (sampled token).
+    forward: list[IntermediateRequest]
+    # Head only: requests that finished this step.
+    finished: list[Request]
+    # Diagnostics.
+    num_tokens: int = 0
+    step_time_ms: float = 0.0
+
+
+class StageEngine:
+    """Continuous-batching engine for one pipeline stage."""
+
+    def __init__(
+        self,
+        model: StageModel,
+        params: dict,
+        config: EngineConfig | None = None,
+    ):
+        self.model = model
+        self.params = params
+        self.cfg = config or EngineConfig()
+        kv_dtype = jnp.bfloat16 if self.cfg.kv_dtype == "bfloat16" else jnp.float32
+        self.kv = model.new_kv_caches(
+            self.cfg.num_pages, self.cfg.page_size, kv_dtype
+        )
+        self.cache = CacheManager(
+            self.cfg.page_size,
+            self.cfg.num_pages,
+            enable_prefix_cache=self.cfg.enable_prefix_cache,
+            max_model_len=self.cfg.max_model_len,
+        )
+        self.scheduler = Scheduler(
+            self.cache,
+            max_batch_size=self.cfg.max_batch_size,
+            max_num_tokens_per_batch=self.cfg.max_num_tokens_per_batch,
+            prefill_chunk_size=self.cfg.prefill_chunk_size,
+            request_timeout_s=self.cfg.request_timeout_s,
+            is_first_stage=model.is_first,
+        )
+        self.spec = BucketSpec.build(
+            self.cfg.max_num_tokens_per_batch,
+            self.cfg.max_batch_size,
+            self.cfg.max_model_len,
+            self.cfg.page_size,
+        )
+        self._jit_step = jax.jit(self._stage_fn, donate_argnums=(1,))
+        self._base_key = jax.random.key(self.cfg.seed)
+        self._step_count = 0
+        # Non-head stages: hidden rows waiting per request id.
+        self._pending_hidden: dict[str, np.ndarray] = {}
+        self._sampling_cache: dict[str, SamplingParams] = {}
+        # EWMA per-layer decode latency published to the global scheduler
+        # (reference base_executor.py:716-732).
+        self.layer_latency_ms_ewma: float | None = None
+
+    def _stage_fn(self, params, kv, inputs: BatchInputs):
+        return self.model(params, kv, inputs)
+
+    # -- intake -----------------------------------------------------------
+
+    def submit(self, request: Request) -> bool:
+        """Head node: accept a fresh user request."""
+        assert self.model.is_first, "submit() is for the head stage"
+        if not request.prompt_ids:
+            raise ValueError("prompt must contain at least one token")
+        if request.num_prompt_tokens >= self.cfg.max_model_len:
+            raise ValueError(
+                f"prompt length {request.num_prompt_tokens} exceeds "
+                f"max_model_len {self.cfg.max_model_len}"
+            )
+        return self.scheduler.enqueue(request)
+
+    def submit_intermediate(self, ireq: IntermediateRequest) -> None:
+        """Non-head stage: accept an inter-stage packet.
+
+        Builds/extends a mirror Request tracking this stage's KV state
+        (the reference's handle_input_requests path,
+        base_executor.py:811-877).
+        """
+        rid = ireq.request_id
+        req = self.scheduler.running.get(rid) or self.scheduler.wait_queue.get(rid)
+        if ireq.abort:
+            if req is not None:
+                req.abort("upstream")
+            return
+        new_tokens = ireq.token_ids or [0] * ireq.num_new_tokens
+        if req is None:
+            req = Request(
+                request_id=rid,
+                prompt_ids=list(new_tokens),
+                sampling_params=SamplingParams.from_dict(ireq.sampling_params or {}),
+                routing_table=list(ireq.routing_table),
+            )
+            req.is_mirror = True  # type: ignore[attr-defined]
+            self.scheduler.enqueue(req)
+        else:
+            req.prompt_ids.extend(new_tokens)
+            req.status = RequestStatus.PREFILLING
+            req.ready_for_step = True
+        req.last_chunk_flag = ireq.is_last_chunk  # type: ignore[attr-defined]
+        if ireq.hidden_states is not None:
+            prev = self._pending_hidden.get(rid)
+            h = ireq.hidden_states
+            self._pending_hidden[rid] = (
+                h if prev is None else np.concatenate([prev, h], axis=0)
+            )
+
+    def release(self, request_id: str, abort: bool = False) -> None:
+        """Finish/abort broadcast: free this stage's state for a request.
+
+        On a normal finish the mirror's full pages are donated to this
+        stage's prefix cache (so every stage, not just the head, serves
+        prefix hits); on abort they are freed outright.
+        """
+        req = self.scheduler.running.get(request_id) or self.scheduler.wait_queue.get(
+            request_id
+        )
+        self._pending_hidden.pop(request_id, None)
+        if req is not None:
+            if not req.status.is_finished:
+                if abort:
+                    req.abort("released")
+                else:
+                    req.status = RequestStatus.FINISHED_EOS
+            self.scheduler.release_request(req)
+
+    # -- stepping ---------------------------------------------------------
+
+    def has_work(self) -> bool:
+        return self.scheduler.num_requests() > 0
+
+    def step(self) -> StepOutputs:
+        t0 = time.perf_counter()
+        plan = self._form_plan()
+        if plan.is_empty:
+            return StepOutputs(forward=[], finished=self._collect_finished())
+
+        hidden = None
+        if not self.model.is_first:
+            hidden = np.concatenate(
+                [
+                    self._take_hidden(s.request.request_id, s.num_new_tokens)
+                    for s in plan.seqs
+                ],
+                axis=0,
+            )
+        inputs = assemble(plan, self.spec, self.cfg.page_size, hidden_states=hidden)
+        out, self.kv = self._jit_step(self.params, self.kv, inputs)
+
+        # Advance scheduler state first: a locally-committed sampled token
+        # (single-stage ring closure) must not be clobbered by the
+        # prefill-progress bookkeeping.
+        self.scheduler.on_batch_computed(plan)
+
+        forwards: list[IntermediateRequest] = []
+        if self.model.is_last:
+            tokens = self._sample(out, inputs, plan)
+            forwards = self._emit_tokens(plan, tokens)
+        else:
+            forwards = self._emit_hidden(plan, np.asarray(out))
+        dt = (time.perf_counter() - t0) * 1000.0
+        self._record_latency(plan, dt)
+        self._step_count += 1
+        return StepOutputs(
+            forward=forwards,
+            finished=self._collect_finished(),
+            num_tokens=plan.total_new_tokens,
+            step_time_ms=dt,
+        )
+
+    # -- internals --------------------------------------------------------
+
+    def _form_plan(self) -> BatchPlan:
+        plan = self.scheduler.form_batch()
+        if self.model.is_first:
+            return plan
+        # Non-head stages may only schedule tokens whose activations arrived.
+        usable = []
+        for s in plan.seqs:
+            avail = self._pending_hidden.get(s.request.request_id)
+            n_avail = 0 if avail is None else avail.shape[0]
+            if s.num_new_tokens <= n_avail:
+                usable.append(s)
+        return BatchPlan(usable)
+
+    def _take_hidden(self, rid: str, n: int) -> np.ndarray:
+        buf = self._pending_hidden[rid]
+        take, rest = buf[:n], buf[n:]
+        if rest.shape[0]:
+            self._pending_hidden[rid] = rest
+        else:
+            self._pending_hidden.pop(rid)
+        return take
+
+    def _sample(self, logits: jax.Array, inputs: BatchInputs, plan: BatchPlan):
+        s = int(inputs.kv_lens.shape[0])
+        temp = np.zeros((s,), np.float32)
+        top_k = np.zeros((s,), np.int32)
+        top_p = np.ones((s,), np.float32)
+        min_p = np.zeros((s,), np.float32)
+        for i, seg in enumerate(plan.seqs):
+            sp = seg.request.sampling_params
+            temp[i] = sp.temperature
+            top_k[i] = sp.top_k
+            top_p[i] = sp.top_p
+            min_p[i] = sp.min_p
+        key = jax.random.fold_in(self._base_key, self._step_count)
+        tokens = sample_tokens(
+            logits,
+            key,
+            jnp.asarray(temp),
+            jnp.asarray(top_k),
+            jnp.asarray(top_p),
+            jnp.asarray(min_p),
+        )
+        return np.asarray(tokens)
+
+    def _needs_token(self, seg) -> bool:
+        """Does this segment's sequence produce a sampled token this step?"""
+        req = seg.request
+        if getattr(req, "is_mirror", False):
+            return bool(getattr(req, "last_chunk_flag", True))
+        return seg.is_last_prefill_chunk
+
+    def _emit_tokens(self, plan: BatchPlan, tokens: np.ndarray):
+        forwards = []
+        for i, seg in enumerate(plan.seqs):
+            if not self._needs_token(seg):
+                continue
+            req = seg.request
+            token = int(tokens[i])
+            if self.model.is_first:
+                # Single-stage: commit locally, ring closed trivially.
+                self._commit(req, token)
+            else:
+                forwards.append(
+                    IntermediateRequest(
+                        request_id=req.request_id,
+                        routing_table=req.routing_table,
+                        context_len=seg.context_len + 1,
+                        num_new_tokens=1,
+                        next_token_id=token,
+                    )
+                )
+        return forwards
+
+    def _emit_hidden(self, plan: BatchPlan, hidden: np.ndarray):
+        forwards = []
+        row = 0
+        for seg in plan.seqs:
+            n = seg.num_new_tokens
+            req = seg.request
+            forwards.append(
+                IntermediateRequest(
+                    request_id=req.request_id,
+                    routing_table=req.routing_table,
+                    context_len=seg.context_len,
+                    num_new_tokens=n,
+                    token_ids=list(seg.token_ids),
+                    hidden_states=hidden[row : row + n],
+                    sampling_params=req.sampling_params.to_dict(),
+                    is_last_chunk=(
+                        self._needs_token(seg)
+                        if not self.model.is_first
+                        else seg.is_last_prefill_chunk
+                        or seg.request.status is RequestStatus.DECODING
+                    ),
+                )
+            )
+            row += n
+        return forwards
+
+    def commit_token(self, request_id: str, token: int) -> None:
+        """Head: the ring delivered a sampled token for ``request_id``."""
+        req = self.scheduler.running.get(request_id)
+        if req is None:
+            return
+        self._commit(req, token)
+
+    def _commit(self, req: Request, token: int) -> None:
+        req.commit_token(token)
+        self.scheduler.on_token_committed(req)
+
+    def _collect_finished(self) -> list[Request]:
+        finished = self.scheduler.finished_requests()
+        for req in finished:
+            self.scheduler.release_request(req)
+            self._pending_hidden.pop(req.request_id, None)
+        return finished
+
+    def _record_latency(self, plan: BatchPlan, ms: float) -> None:
+        if plan.has_prefill or plan.is_empty:
+            return
+        per_layer = ms / max(1, self.model.num_local_layers)
+        if self.layer_latency_ms_ewma is None:
+            self.layer_latency_ms_ewma = per_layer
+        else:
+            self.layer_latency_ms_ewma = (
+                0.8 * self.layer_latency_ms_ewma + 0.2 * per_layer
+            )

@@ -1,0 +1,142 @@
+"""Page-granularity radix prefix cache.
+
+Capability parity: reference ``src/parallax/server/block_radix_cache.py:14-333``
+(BlockRadixCache). Each tree node holds exactly one *full* KV page's token
+ids; matching walks full-page keys, insertion reuses existing device pages,
+and eviction walks LRU leaves with a pin refcount protecting in-flight
+requests. Device KV never moves: the cache only shares page ids.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+
+class _Node:
+    __slots__ = ("key", "page_id", "children", "parent", "lock_ref", "last_access")
+
+    def __init__(self, key: tuple[int, ...], page_id: int, parent: "_Node | None"):
+        self.key = key                      # the page's token ids
+        self.page_id = page_id
+        self.children: dict[tuple[int, ...], _Node] = {}
+        self.parent = parent
+        self.lock_ref = 0
+        self.last_access = time.monotonic()
+
+
+class RadixPageCache:
+    """Prefix cache over full KV pages."""
+
+    def __init__(self, page_size: int, on_evict: Callable[[int], None] | None = None):
+        self.page_size = page_size
+        self.on_evict = on_evict
+        self._root = _Node((), -1, None)
+        self._num_pages = 0
+
+    @property
+    def num_cached_pages(self) -> int:
+        return self._num_pages
+
+    # -- matching ---------------------------------------------------------
+
+    def match_prefix(self, token_ids: list[int]) -> tuple[list[int], list[_Node]]:
+        """Longest full-page prefix match.
+
+        Returns (page_ids, node_path). Only complete pages match; the caller
+        recomputes the ragged tail.
+        """
+        node = self._root
+        pages: list[int] = []
+        path: list[_Node] = []
+        now = time.monotonic()
+        for start in range(0, len(token_ids) - self.page_size + 1, self.page_size):
+            key = tuple(token_ids[start : start + self.page_size])
+            child = node.children.get(key)
+            if child is None:
+                break
+            child.last_access = now
+            pages.append(child.page_id)
+            path.append(child)
+            node = child
+        return pages, path
+
+    def lock(self, path: list[_Node]) -> None:
+        """Pin matched nodes so eviction cannot free their pages mid-request."""
+        for n in path:
+            n.lock_ref += 1
+
+    def unlock(self, path: list[_Node]) -> None:
+        for n in path:
+            n.lock_ref -= 1
+
+    # -- insertion --------------------------------------------------------
+
+    def insert(self, token_ids: list[int], page_ids: list[int]) -> list[int]:
+        """Insert full pages of a finished request's context.
+
+        The tree takes ownership of pages for keys it does not already hold.
+        Returns the *duplicate* page ids — pages the caller computed but whose
+        key already exists in the tree — which the caller must free (the tree
+        keeps its original copy; device KV contents are identical).
+        """
+        node = self._root
+        duplicates: list[int] = []
+        now = time.monotonic()
+        n_full = len(token_ids) // self.page_size
+        for i in range(min(n_full, len(page_ids))):
+            key = tuple(token_ids[i * self.page_size : (i + 1) * self.page_size])
+            child = node.children.get(key)
+            if child is None:
+                child = _Node(key, page_ids[i], node)
+                node.children[key] = child
+                self._num_pages += 1
+            elif child.page_id != page_ids[i]:
+                duplicates.append(page_ids[i])
+            child.last_access = now
+            node = child
+        return duplicates
+
+    # -- eviction ---------------------------------------------------------
+
+    def evict(self, num_pages: int) -> list[int]:
+        """Evict up to ``num_pages`` unpinned LRU leaf pages.
+
+        Returns freed device page ids (also passed to ``on_evict``).
+        Reference: ``evict_lru_blocks`` (block_radix_cache.py:252-291).
+        """
+        freed: list[int] = []
+        while len(freed) < num_pages:
+            leaf = self._lru_unpinned_leaf()
+            if leaf is None:
+                break
+            del leaf.parent.children[leaf.key]
+            self._num_pages -= 1
+            freed.append(leaf.page_id)
+            if self.on_evict:
+                self.on_evict(leaf.page_id)
+        return freed
+
+    def _lru_unpinned_leaf(self) -> _Node | None:
+        best: _Node | None = None
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            elif n.lock_ref <= 0:
+                if best is None or n.last_access < best.last_access:
+                    best = n
+        return best
+
+    def reset(self) -> list[int]:
+        """Drop the whole tree, returning every owned page id."""
+        pages: list[int] = []
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            pages.append(n.page_id)
+            stack.extend(n.children.values())
+        self._root = _Node((), -1, None)
+        self._num_pages = 0
+        return pages

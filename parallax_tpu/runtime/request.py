@@ -1,0 +1,175 @@
+"""Request lifecycle types.
+
+Pipeline protocol (capability parity with reference
+``src/parallax/server/request.py:23-55``):
+
+- The *head* node owns the full :class:`Request` state: prompt ids, generated
+  ids, sampling params, KV bookkeeping.
+- Between stages only an :class:`IntermediateRequest` travels: request id,
+  routing table, current position, and either ``hidden_states`` (stage k ->
+  k+1) or the freshly sampled ``next_token_id`` (last stage -> head, closing
+  the ring).
+- Chunked prefill: the head advances ``num_computed_tokens`` chunk by chunk;
+  downstream stages see each chunk as an independent ragged segment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Any
+
+import numpy as np
+
+
+class RequestStatus(enum.Enum):
+    """Lifecycle states (reference: request.py:71-80)."""
+
+    PENDING = "pending"          # waiting for admission (KV not allocated)
+    PREFILLING = "prefilling"    # admitted, prompt chunks in flight
+    DECODING = "decoding"        # generating, one token per pipeline round
+    FINISHED_EOS = "finished_eos"
+    FINISHED_LENGTH = "finished_length"
+    FINISHED_STOP = "finished_stop"
+    FINISHED_ABORT = "finished_abort"
+
+    @property
+    def is_finished(self) -> bool:
+        return self.value.startswith("finished")
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    """Per-request sampling configuration (reference sampling_params.py:8-60)."""
+
+    temperature: float = 1.0
+    top_k: int = -1
+    top_p: float = 1.0
+    min_p: float = 0.0
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
+    max_new_tokens: int = 128
+    min_new_tokens: int = 0
+    stop_token_ids: tuple[int, ...] = ()
+    stop_strings: tuple[str, ...] = ()
+    ignore_eos: bool = False
+    seed: int | None = None
+    json_schema: str | None = None
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["stop_token_ids"] = list(self.stop_token_ids)
+        d["stop_strings"] = list(self.stop_strings)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SamplingParams":
+        d = dict(d)
+        d["stop_token_ids"] = tuple(d.get("stop_token_ids", ()))
+        d["stop_strings"] = tuple(d.get("stop_strings", ()))
+        return cls(**{k: v for k, v in d.items()
+                      if k in {f.name for f in dataclasses.fields(cls)}})
+
+
+@dataclasses.dataclass
+class Request:
+    """Full head-node request state."""
+
+    request_id: str
+    prompt_ids: list[int]
+    sampling_params: SamplingParams = dataclasses.field(default_factory=SamplingParams)
+    # Node path assigned by the global scheduler (list of node ids, in stage
+    # order). Empty for single-node serving.
+    routing_table: list[str] = dataclasses.field(default_factory=list)
+    status: RequestStatus = RequestStatus.PENDING
+    output_ids: list[int] = dataclasses.field(default_factory=list)
+    # Prompt tokens whose KV is already computed (prefix-cache hit + finished
+    # prefill chunks).
+    num_computed_tokens: int = 0
+    # Tokens matched in the prefix cache at admission.
+    num_cached_tokens: int = 0
+    # Pages allocated to this request, in order.
+    page_ids: list[int] = dataclasses.field(default_factory=list)
+    arrival_time: float = dataclasses.field(default_factory=time.monotonic)
+    eos_token_ids: tuple[int, ...] = ()
+    # Filled when decoding starts; used by the decode-ready gating.
+    ready_for_step: bool = True
+    abort_reason: str | None = None
+
+    @property
+    def num_prompt_tokens(self) -> int:
+        return len(self.prompt_ids)
+
+    @property
+    def num_output_tokens(self) -> int:
+        return len(self.output_ids)
+
+    @property
+    def total_len(self) -> int:
+        return self.num_prompt_tokens + self.num_output_tokens
+
+    @property
+    def all_token_ids(self) -> list[int]:
+        return self.prompt_ids + self.output_ids
+
+    @property
+    def is_prefill_done(self) -> bool:
+        return self.num_computed_tokens >= self.num_prompt_tokens
+
+    def remaining_prompt_tokens(self) -> int:
+        return max(0, self.num_prompt_tokens - self.num_computed_tokens)
+
+    def commit_token(self, token_id: int) -> None:
+        """Record one generated token and update status.
+
+        Reference: ``InitialRequest.commit_new_token`` (request.py:230-249).
+        """
+        self.output_ids.append(token_id)
+        sp = self.sampling_params
+        if self.num_output_tokens >= sp.min_new_tokens:
+            if not sp.ignore_eos and (
+                token_id in self.eos_token_ids or token_id in sp.stop_token_ids
+            ):
+                self.status = (
+                    RequestStatus.FINISHED_STOP
+                    if token_id in sp.stop_token_ids
+                    else RequestStatus.FINISHED_EOS
+                )
+                return
+        if self.num_output_tokens >= sp.max_new_tokens:
+            self.status = RequestStatus.FINISHED_LENGTH
+            return
+        self.status = RequestStatus.DECODING
+
+    def abort(self, reason: str = "") -> None:
+        self.status = RequestStatus.FINISHED_ABORT
+        self.abort_reason = reason or None
+
+
+@dataclasses.dataclass
+class IntermediateRequest:
+    """The inter-stage wire packet (reference request.py:326-393)."""
+
+    request_id: str
+    routing_table: list[str]
+    # Total context length after this step's tokens (defines KV positions).
+    context_len: int
+    # Number of new tokens this step carries for this request.
+    num_new_tokens: int
+    # Token ids for the first stage (prefill chunk or the single decode
+    # token); None past the first stage.
+    token_ids: list[int] | None = None
+    # Activations entering the next stage: [num_new_tokens, hidden]. None on
+    # the hop back to the head.
+    hidden_states: np.ndarray | None = None
+    # Sampled token (last stage -> head hop only).
+    next_token_id: int | None = None
+    sampling_params: dict | None = None
+    is_last_chunk: bool = True
+    abort: bool = False
+
+    @property
+    def is_prefill(self) -> bool:
+        return self.num_new_tokens > 1 or not self.is_last_chunk

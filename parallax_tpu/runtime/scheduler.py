@@ -1,0 +1,216 @@
+"""Local continuous-batching scheduler for one pipeline stage.
+
+Capability parity: reference ``src/parallax/server/scheduler.py:42-392``
+(two-phase admit/form_batch, chunked prefill token accounting, finish
+checks, timeouts). TPU-specific addition: the formed batch is described by a
+:class:`BatchPlan` of ragged segments that the executor pads onto a bucket
+lattice — batching decisions remain fully host-side and O(batch).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import OrderedDict
+
+from parallax_tpu.runtime.cache_manager import CacheManager
+from parallax_tpu.runtime.request import Request, RequestStatus
+from parallax_tpu.utils import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class ScheduledSeq:
+    """One ragged segment of the step batch."""
+
+    request: Request
+    num_new_tokens: int          # query tokens this step
+    token_ids: list[int]         # the new tokens (head node fills these)
+    context_len: int             # total KV length after this step
+    is_last_prefill_chunk: bool = True
+
+
+@dataclasses.dataclass
+class BatchPlan:
+    """Everything the executor needs to build device inputs for one step."""
+
+    seqs: list[ScheduledSeq]
+
+    @property
+    def total_new_tokens(self) -> int:
+        return sum(s.num_new_tokens for s in self.seqs)
+
+    @property
+    def is_empty(self) -> bool:
+        return not self.seqs
+
+    @property
+    def has_prefill(self) -> bool:
+        return any(s.num_new_tokens > 1 or not s.request.is_prefill_done
+                   for s in self.seqs)
+
+
+class Scheduler:
+    """Continuous batching over a wait queue and a running set."""
+
+    def __init__(
+        self,
+        cache_manager: CacheManager,
+        max_batch_size: int = 64,
+        max_num_tokens_per_batch: int = 2048,
+        prefill_chunk_size: int = 1024,
+        max_queue_size: int = 1024,
+        request_timeout_s: float = 600.0,
+        is_first_stage: bool = True,
+    ):
+        self.cache = cache_manager
+        self.max_batch_size = max_batch_size
+        self.max_num_tokens_per_batch = max_num_tokens_per_batch
+        self.prefill_chunk_size = prefill_chunk_size
+        self.max_queue_size = max_queue_size
+        self.request_timeout_s = request_timeout_s
+        self.is_first_stage = is_first_stage
+        self.wait_queue: OrderedDict[str, Request] = OrderedDict()
+        self.running: OrderedDict[str, Request] = OrderedDict()
+
+    # -- intake -----------------------------------------------------------
+
+    def enqueue(self, request: Request) -> bool:
+        if len(self.wait_queue) >= self.max_queue_size:
+            return False
+        self.wait_queue[request.request_id] = request
+        return True
+
+    def num_requests(self) -> int:
+        return len(self.wait_queue) + len(self.running)
+
+    # -- admission (phase 1) ---------------------------------------------
+
+    def admit_requests(self) -> None:
+        """Move wait-queue requests into the running set with KV allocated.
+
+        Reference: ``admit_requests`` (scheduler.py:251-312) — FCFS, stops at
+        the first request that does not fit to preserve ordering fairness.
+        """
+        while self.wait_queue and len(self.running) < self.max_batch_size:
+            rid, req = next(iter(self.wait_queue.items()))
+            if not self.cache.allocate_for_prompt(req):
+                break
+            del self.wait_queue[rid]
+            req.status = RequestStatus.PREFILLING
+            self.running[rid] = req
+
+    # -- batch formation (phase 2) ---------------------------------------
+
+    def form_batch(self) -> BatchPlan:
+        """Prefill-first batch under token and batch-size budgets.
+
+        Reference: ``form_batch`` (scheduler.py:332-392). Chunked prefill:
+        a long prompt contributes at most ``prefill_chunk_size`` tokens per
+        step and keeps its place in the running set between chunks.
+        """
+        self.check_timeouts()
+        self.admit_requests()
+        seqs: list[ScheduledSeq] = []
+        token_budget = self.max_num_tokens_per_batch
+
+        # Prefill chunks first (including re-chunked long prompts).
+        for req in self.running.values():
+            if len(seqs) >= self.max_batch_size or token_budget <= 0:
+                break
+            if req.status is not RequestStatus.PREFILLING:
+                continue
+            remaining = req.remaining_prompt_tokens()
+            if remaining <= 0:
+                continue
+            n = min(remaining, self.prefill_chunk_size, token_budget)
+            if n < remaining and n < self.cache.page_size:
+                break  # not worth a degenerate chunk; wait for budget
+            start = req.num_computed_tokens
+            # Mirror requests grow their prompt incrementally (chunks arrive
+            # over the wire), so page capacity may lag the prompt length.
+            if not self.cache.ensure_capacity(req, start + n):
+                self._abort_on_oom(req)
+                continue
+            seqs.append(
+                ScheduledSeq(
+                    request=req,
+                    num_new_tokens=n,
+                    token_ids=req.prompt_ids[start : start + n],
+                    context_len=start + n,
+                    is_last_prefill_chunk=(start + n >= req.num_prompt_tokens),
+                )
+            )
+            token_budget -= n
+
+        # Then ready decodes.
+        for req in self.running.values():
+            if len(seqs) >= self.max_batch_size or token_budget <= 0:
+                break
+            if req.status is not RequestStatus.DECODING or not req.ready_for_step:
+                continue
+            if not self.cache.ensure_capacity(req, req.total_len):
+                self._abort_on_oom(req)
+                continue
+            last = req.all_token_ids[-1]
+            seqs.append(
+                ScheduledSeq(
+                    request=req,
+                    num_new_tokens=1,
+                    token_ids=[last],
+                    context_len=req.total_len,
+                )
+            )
+            token_budget -= 1
+        return BatchPlan(seqs)
+
+    # -- step feedback ----------------------------------------------------
+
+    def on_batch_computed(self, plan: BatchPlan) -> None:
+        """Advance prefill progress; mark decodes in-flight.
+
+        Decode requests wait for the pipeline ring to deliver the sampled
+        token (``ready_for_step`` gating, reference scheduler.py:192-249).
+        """
+        for s in plan.seqs:
+            req = s.request
+            if req.status is RequestStatus.PREFILLING:
+                req.num_computed_tokens += s.num_new_tokens
+                if req.is_prefill_done:
+                    req.status = RequestStatus.DECODING
+                    req.ready_for_step = False
+            elif req.status is RequestStatus.DECODING:
+                req.ready_for_step = False
+
+    def on_token_committed(self, request: Request) -> None:
+        """The ring delivered a sampled token for this request."""
+        request.ready_for_step = True
+        if request.status is RequestStatus.DECODING:
+            # KV for the new token is written next step alongside its compute.
+            pass
+
+    # -- completion -------------------------------------------------------
+
+    def finished_requests(self) -> list[Request]:
+        return [r for r in self.running.values() if r.status.is_finished]
+
+    def release_request(self, request: Request) -> None:
+        self.running.pop(request.request_id, None)
+        self.wait_queue.pop(request.request_id, None)
+        self.cache.release(request)
+
+    def _abort_on_oom(self, req: Request) -> None:
+        logger.warning("decode OOM: aborting %s", req.request_id)
+        req.abort("kv_oom")
+
+    def check_timeouts(self) -> list[Request]:
+        """Abort requests exceeding the wall-clock budget
+        (reference scheduler.py:314-330)."""
+        now = time.monotonic()
+        timed_out = []
+        for req in list(self.running.values()) + list(self.wait_queue.values()):
+            if now - req.arrival_time > self.request_timeout_s:
+                req.abort("timeout")
+                timed_out.append(req)
+        return timed_out

@@ -48,10 +48,18 @@ class HardwareInfo:
 
 
 def _device_kind_key(kind: str) -> str:
+    """Map a PJRT device_kind string to our spec-DB key.
+
+    JAX reports e.g. "TPU v4", "TPU v5 lite"/"TPU v5e", "TPU v5p"/"TPU v5",
+    "TPU v6 lite"/"TPU v6e".
+    """
     kind = kind.lower()
-    for key in ("v6e", "v5p", "v5e", "v4"):
-        if key in kind or key.replace("v5e", "v5 lite") in kind:
-            return key
+    if "v6" in kind:
+        return "v6e"
+    if "v5" in kind:
+        return "v5e" if ("lite" in kind or "v5e" in kind) else "v5p"
+    if "v4" in kind:
+        return "v4"
     if "tpu" in kind:
         return "v5e"
     return "cpu"

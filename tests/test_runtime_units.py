@@ -1,0 +1,196 @@
+"""Unit tests for the host-side runtime: allocator, radix cache, cache
+manager, continuous-batching scheduler (capability parity with reference
+tests/test_batch_scheduler.py + test_prefix_cache.py)."""
+
+import pytest
+
+from parallax_tpu.runtime.allocator import OutOfPages, PageAllocator
+from parallax_tpu.runtime.cache_manager import CacheManager
+from parallax_tpu.runtime.radix_cache import RadixPageCache
+from parallax_tpu.runtime.request import Request, RequestStatus, SamplingParams
+from parallax_tpu.runtime.scheduler import Scheduler
+
+
+def make_request(rid, n_prompt, max_new=16):
+    return Request(
+        request_id=rid,
+        prompt_ids=list(range(n_prompt)),
+        sampling_params=SamplingParams(max_new_tokens=max_new),
+    )
+
+
+class TestPageAllocator:
+    def test_null_page_reserved(self):
+        a = PageAllocator(8)
+        pages = a.alloc(7)
+        assert 0 not in pages
+        with pytest.raises(OutOfPages):
+            a.alloc(1)
+        a.free(pages[:3])
+        assert a.num_free == 3
+
+
+class TestRadixPageCache:
+    def test_match_insert_roundtrip(self):
+        c = RadixPageCache(page_size=4)
+        tokens = list(range(10))  # 2 full pages + tail of 2
+        dups = c.insert(tokens, [5, 6])
+        assert dups == []
+        pages, path = c.match_prefix(tokens)
+        assert pages == [5, 6]
+        # diverging suffix shares only first page
+        pages2, _ = c.match_prefix([0, 1, 2, 3, 9, 9, 9, 9])
+        assert pages2 == [5]
+
+    def test_duplicate_insert_returns_loser(self):
+        c = RadixPageCache(page_size=4)
+        c.insert([0, 1, 2, 3], [7])
+        dups = c.insert([0, 1, 2, 3], [8])
+        assert dups == [8]
+
+    def test_eviction_respects_locks(self):
+        c = RadixPageCache(page_size=2)
+        c.insert([1, 2, 3, 4], [10, 11])
+        _, path = c.match_prefix([1, 2, 3, 4])
+        c.lock(path)
+        assert c.evict(2) == []  # everything pinned
+        c.unlock(path)
+        freed = c.evict(2)
+        # leaf-first eviction: deepest page goes first
+        assert freed[0] == 11 and set(freed) == {10, 11}
+
+
+class TestCacheManager:
+    def test_prompt_allocation_and_release(self):
+        cm = CacheManager(page_size=4, num_pages=16)
+        req = make_request("a", 10)
+        assert cm.allocate_for_prompt(req)
+        assert len(req.page_ids) == 3
+        req.status = RequestStatus.FINISHED_EOS
+        cm.release(req)
+        # 2 full pages went to the prefix cache, tail page freed
+        assert cm.prefix_cache.num_cached_pages == 2
+
+    def test_prefix_hit_shares_pages(self):
+        cm = CacheManager(page_size=4, num_pages=16)
+        r1 = make_request("a", 8)
+        cm.allocate_for_prompt(r1)
+        pages1 = list(r1.page_ids)
+        r1.status = RequestStatus.FINISHED_EOS
+        cm.release(r1)
+        r2 = Request("b", prompt_ids=list(range(8)) + [99])
+        assert cm.allocate_for_prompt(r2)
+        assert r2.page_ids[:2] == pages1[:2]
+        assert r2.num_cached_tokens == 8
+
+    def test_full_prompt_match_leaves_one_token(self):
+        cm = CacheManager(page_size=4, num_pages=16)
+        r1 = make_request("a", 8)
+        cm.allocate_for_prompt(r1)
+        r1.status = RequestStatus.FINISHED_EOS
+        cm.release(r1)
+        # identical prompt: must still recompute the last token
+        r2 = make_request("b", 8)
+        cm.allocate_for_prompt(r2)
+        assert r2.num_cached_tokens == 4  # only 1 of 2 matched pages usable
+
+    def test_eviction_under_pressure(self):
+        cm = CacheManager(page_size=4, num_pages=8)  # 7 usable
+        r1 = make_request("a", 16)  # 4 pages
+        cm.allocate_for_prompt(r1)
+        r1.status = RequestStatus.FINISHED_EOS
+        cm.release(r1)  # all 4 full pages cached
+        r2 = Request("b", prompt_ids=[500 + i for i in range(24)])  # 6 pages
+        assert cm.allocate_for_prompt(r2)  # forces eviction
+        assert len(r2.page_ids) == 6
+
+    def test_abort_frees_without_caching(self):
+        cm = CacheManager(page_size=4, num_pages=16)
+        req = make_request("a", 8)
+        cm.allocate_for_prompt(req)
+        req.abort("test")
+        cm.release(req)
+        assert cm.prefix_cache.num_cached_pages == 0
+        assert cm.num_free_pages == 15
+
+
+class TestScheduler:
+    def make(self, **kw):
+        cm = CacheManager(page_size=4, num_pages=64)
+        defaults = dict(max_batch_size=4, max_num_tokens_per_batch=32,
+                        prefill_chunk_size=8)
+        defaults.update(kw)
+        return Scheduler(cm, **defaults), cm
+
+    def test_prefill_then_decode_flow(self):
+        sched, _ = self.make()
+        req = make_request("a", 10)
+        sched.enqueue(req)
+        plan = sched.form_batch()
+        assert [s.num_new_tokens for s in plan.seqs] == [8]  # first chunk
+        sched.on_batch_computed(plan)
+        plan = sched.form_batch()
+        assert [s.num_new_tokens for s in plan.seqs] == [2]
+        assert plan.seqs[0].is_last_prefill_chunk
+        sched.on_batch_computed(plan)
+        assert req.status is RequestStatus.DECODING
+        assert not req.ready_for_step  # waiting for sampled token
+        assert sched.form_batch().is_empty
+        req.commit_token(42)
+        sched.on_token_committed(req)
+        plan = sched.form_batch()
+        assert plan.seqs[0].num_new_tokens == 1
+        assert plan.seqs[0].context_len == 11
+        assert plan.seqs[0].token_ids == [42]
+
+    def test_fcfs_admission_stops_at_first_blocker(self):
+        sched, cm = self.make()
+        big = make_request("big", 300)  # needs 75 pages > 63 available
+        small = make_request("small", 4)
+        sched.enqueue(big)
+        sched.enqueue(small)
+        plan = sched.form_batch()
+        # FCFS: big doesn't fit, small must NOT jump the queue
+        assert plan.is_empty
+        assert "big" in sched.wait_queue and "small" in sched.wait_queue
+
+    def test_token_budget_caps_batch(self):
+        sched, _ = self.make(max_num_tokens_per_batch=10, prefill_chunk_size=8)
+        for i in range(3):
+            sched.enqueue(make_request(f"r{i}", 8))
+        plan = sched.form_batch()
+        assert plan.total_new_tokens <= 10
+
+    def test_decode_batch_mixes_requests(self):
+        sched, _ = self.make()
+        reqs = [make_request(f"r{i}", 4) for i in range(3)]
+        for r in reqs:
+            sched.enqueue(r)
+        plan = sched.form_batch()
+        sched.on_batch_computed(plan)
+        for r in reqs:
+            r.commit_token(7)
+            sched.on_token_committed(r)
+        plan = sched.form_batch()
+        assert len(plan.seqs) == 3
+        assert all(s.num_new_tokens == 1 for s in plan.seqs)
+
+    def test_timeout_aborts(self):
+        sched, _ = self.make(request_timeout_s=0.0)
+        req = make_request("a", 4)
+        sched.enqueue(req)
+        timed_out = sched.check_timeouts()
+        assert req in timed_out
+        assert req.status is RequestStatus.FINISHED_ABORT
+
+    def test_finish_on_eos_and_length(self):
+        req = make_request("a", 4, max_new=3)
+        req.eos_token_ids = (5,)
+        req.commit_token(1)
+        assert req.status is RequestStatus.DECODING
+        req.commit_token(5)
+        assert req.status is RequestStatus.FINISHED_EOS
+        req2 = make_request("b", 4, max_new=2)
+        req2.commit_token(1)
+        req2.commit_token(1)
+        assert req2.status is RequestStatus.FINISHED_LENGTH
