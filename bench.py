@@ -1,0 +1,167 @@
+"""Offline serving benchmark: output tokens/sec/chip on the north-star config.
+
+North-star (BASELINE.md): output tokens/sec/chip, Qwen2.5-7B, 2-stage
+pipeline parallel. One real chip is available, so we run one chip's
+workload of the 2-stage setup — half the model's decoder layers, plus
+embed + lm_head + sampling (a real stage carries one of the two ends; we
+carry both, which over-counts slightly and is therefore conservative) —
+with continuous batching, and report
+
+    tokens/sec/chip = decode_batch / (2 * stage_decode_step_time)
+
+— the steady-state 2-chip pipeline emits one decode batch per stage step
+(stages overlap on different token waves).
+
+``vs_baseline`` compares against a roofline-derived estimate of the
+reference's CUDA backend on 2xA100-80G (the repo publishes no numbers —
+BASELINE.json ``published: {}``): decode at batch 64 is HBM-bound; each
+stage streams ~7.6 GB of bf16 params per step => 2039 GB/s / 7.6 GB ~= 268
+steps/s theoretical, ~40% achieved for SGLang-class engines => ~107
+steps/s => 64 tokens / (2 chips * step) ~= 3400 theoretical, ~1360
+achieved tok/s/chip. We use 1360.
+
+Prints ONE JSON line.
+"""
+
+import dataclasses
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_TOKENS_PER_SEC_PER_CHIP = 1360.0
+
+
+def main():
+    import jax
+
+    if os.environ.get("BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from parallax_tpu.models.base import StageModel
+    from parallax_tpu.models.presets import get_preset
+    from parallax_tpu.runtime.engine import EngineConfig, StageEngine
+    from parallax_tpu.runtime.pipeline import InProcessPipeline
+    from parallax_tpu.runtime.request import Request, SamplingParams
+    from parallax_tpu.utils.hw import detect_hardware, device_free_memory_bytes
+
+    on_tpu = jax.default_backend() == "tpu"
+    hw = detect_hardware()
+
+    if on_tpu:
+        full = get_preset("qwen2.5-7b")
+        # One chip's workload of 2-stage PP: half the layers (+ both ends).
+        cfg = dataclasses.replace(
+            full,
+            num_hidden_layers=full.num_hidden_layers // 2,
+            layer_types=full.layer_types[: full.num_hidden_layers // 2],
+        )
+        batch, prompt_len, gen_len = 64, 128, 64
+        dtype, kv_dtype, page_size = jnp.bfloat16, "bfloat16", 64
+    else:
+        # CPU smoke mode (BENCH_CPU=1): tiny shapes, same code path.
+        cfg = dataclasses.replace(
+            get_preset("qwen2.5-0.5b"),
+            hidden_size=256, num_hidden_layers=4, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=64, intermediate_size=512,
+            vocab_size=1024, layer_types=("attention",) * 4,
+            tie_word_embeddings=False, attention_bias=False,
+        )
+        batch, prompt_len, gen_len = 8, 32, 16
+        dtype, kv_dtype, page_size = jnp.float32, "float32", 16
+
+    model = StageModel(cfg, 0, cfg.num_hidden_layers)
+    params = model.init_params(jax.random.key(0), dtype=dtype)
+    params = jax.tree.map(lambda x: x.block_until_ready(), params)
+
+    max_model_len = prompt_len + gen_len + page_size
+    pages_needed = ((max_model_len + page_size - 1) // page_size + 1) * batch
+    if on_tpu:
+        from parallax_tpu.runtime.cache_manager import derive_num_pages
+
+        free = device_free_memory_bytes(fraction=0.85)
+        num_pages = min(
+            derive_num_pages(free, cfg, cfg.num_hidden_layers, page_size),
+            pages_needed,
+        )
+    else:
+        num_pages = pages_needed
+
+    engine = StageEngine(
+        model,
+        params,
+        EngineConfig(
+            page_size=page_size,
+            num_pages=num_pages,
+            max_batch_size=batch,
+            max_num_tokens_per_batch=2048,
+            prefill_chunk_size=1024,
+            max_model_len=max_model_len,
+            kv_dtype=kv_dtype,
+            enable_prefix_cache=False,   # measure raw compute, not cache hits
+        ),
+    )
+    pipe = InProcessPipeline([engine])
+
+    rng = np.random.default_rng(0)
+    for i in range(batch):
+        prompt = rng.integers(1, cfg.vocab_size - 1, size=prompt_len)
+        pipe.submit(Request(
+            request_id=f"bench{i}",
+            prompt_ids=[int(x) for x in prompt],
+            sampling_params=SamplingParams(
+                temperature=0.0, max_new_tokens=gen_len, ignore_eos=True,
+            ),
+        ))
+
+    decode_times = []
+    decode_tokens = 0
+    t_start = time.perf_counter()
+    while engine.has_work():
+        out = engine.step()
+        if out.num_tokens == 0:
+            continue
+        # Prefill chunks are >> batch tokens; decode steps are <= batch.
+        if out.num_tokens <= batch:
+            decode_times.append(out.step_time_ms)
+            decode_tokens += out.num_tokens
+    total_s = time.perf_counter() - t_start
+
+    # Steady state: drop warm-up (compiles live in the first steps).
+    skip = max(1, len(decode_times) // 8)
+    steady = decode_times[skip:] or decode_times
+    step_ms = statistics.median(steady)
+    tokens_per_sec_per_chip = batch / (2.0 * step_ms / 1000.0)
+
+    result = {
+        "metric": (
+            "output tokens/sec/chip (Qwen2.5-7B, 2-stage PP accounting)"
+            if on_tpu
+            else "output tokens/sec/chip (CPU smoke, tiny model)"
+        ),
+        "value": round(tokens_per_sec_per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(
+            tokens_per_sec_per_chip / BASELINE_TOKENS_PER_SEC_PER_CHIP, 3
+        ),
+        "detail": {
+            "device": hw.device_kind,
+            "stage_layers": cfg.num_hidden_layers,
+            "batch": batch,
+            "decode_step_ms_median": round(step_ms, 2),
+            "decode_steps": len(decode_times),
+            "decode_tokens": decode_tokens,
+            "total_wall_s": round(total_s, 1),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

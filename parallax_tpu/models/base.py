@@ -49,6 +49,8 @@ class StageModel:
         start_layer: int,
         end_layer: int,
         use_pallas: bool | None = None,
+        tp_size: int = 1,
+        axis_name: str = "tp",
     ):
         self.config = config
         self.start_layer = start_layer
@@ -56,6 +58,17 @@ class StageModel:
         self.is_first = start_layer == 0
         self.is_last = end_layer == config.num_hidden_layers
         self.use_pallas = use_pallas
+        self.tp_size = tp_size
+        # psum axis inside shard_map; None when running unsharded.
+        self.axis_name = axis_name if tp_size > 1 else None
+        if tp_size > 1:
+            for dim, name in (
+                (config.num_attention_heads, "num_attention_heads"),
+                (config.num_key_value_heads, "num_key_value_heads"),
+                (config.intermediate_size, "intermediate_size"),
+            ):
+                if dim % tp_size:
+                    raise ValueError(f"{name}={dim} not divisible by tp={tp_size}")
         inv = rope_frequencies(
             config.head_dim,
             config.rope_theta,
@@ -230,6 +243,7 @@ class StageModel:
             sin_table=self.sin_table,
             sliding_window=window,
             use_pallas=self.use_pallas,
+            axis_name=self.axis_name,
         )
         x = x + attn_out
         h = L.rms_norm(x, lp["post_attention_layernorm"]["weight"], cfg.rms_norm_eps)
@@ -237,4 +251,4 @@ class StageModel:
         return x, kv
 
     def _mlp(self, lp: dict, h: jax.Array) -> jax.Array:
-        return L.swiglu_mlp(h, lp["mlp"])
+        return L.swiglu_mlp(h, lp["mlp"], axis_name=self.axis_name)

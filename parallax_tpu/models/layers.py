@@ -53,11 +53,15 @@ def lm_head_logits(x: jax.Array, p: dict) -> jax.Array:
     )
 
 
-def swiglu_mlp(x: jax.Array, p: dict) -> jax.Array:
-    """SwiGLU FFN (gate/up/down)."""
+def swiglu_mlp(x: jax.Array, p: dict, axis_name: str | None = None) -> jax.Array:
+    """SwiGLU FFN (gate/up/down). Under TP the hidden dim is column-sharded
+    and the row-parallel down_proj output is psummed over ``axis_name``."""
     gate = linear(x, p["gate_proj"])
     up = linear(x, p["up_proj"])
-    return linear(jax.nn.silu(gate) * up, p["down_proj"])
+    out = linear(jax.nn.silu(gate) * up, p["down_proj"])
+    if axis_name is not None:
+        out = jax.lax.psum(out, axis_name)
+    return out
 
 
 def paged_attention_block(
@@ -76,6 +80,7 @@ def paged_attention_block(
     sin_table: jax.Array,
     sliding_window: int | None = None,
     use_pallas: bool | None = None,
+    axis_name: str | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """GQA attention over the paged cache: project, rope, scatter, attend.
 
@@ -83,16 +88,18 @@ def paged_attention_block(
     (``src/parallax/models/qwen3.py:30-143``): new K/V always enter the
     cache first, attention always reads from the cache, so prefix hits and
     chunked prefill need no separate code path.
+
+    Head counts are inferred from the weight shapes, so the same code runs
+    unsharded or inside shard_map with column-sharded projections (each chip
+    sees its local heads + its slice of the KV pages); the row-parallel
+    o_proj output is psummed over ``axis_name``.
     """
     t = x.shape[0]
-    hq, hkv, d = (
-        config.num_attention_heads,
-        config.num_key_value_heads,
-        config.head_dim,
-    )
-    q = linear(x, p["q_proj"]).reshape(t, hq, d)
-    k = linear(x, p["k_proj"]).reshape(t, hkv, d)
-    v = linear(x, p["v_proj"]).reshape(t, hkv, d)
+    d = config.head_dim
+    q = linear(x, p["q_proj"]).reshape(t, -1, d)
+    k = linear(x, p["k_proj"]).reshape(t, -1, d)
+    v = linear(x, p["v_proj"]).reshape(t, -1, d)
+    hq = q.shape[1]
 
     if config.use_qk_norm and "q_norm" in p:
         q = rms_norm(q, p["q_norm"]["weight"], config.rms_norm_eps)
@@ -114,4 +121,7 @@ def paged_attention_block(
         sinks=p.get("sinks"),
         use_pallas=use_pallas,
     )
-    return linear(out.reshape(t, hq * d), p["o_proj"]), kv_pages
+    out = linear(out.reshape(t, hq * d), p["o_proj"])
+    if axis_name is not None:
+        out = jax.lax.psum(out, axis_name)
+    return out, kv_pages

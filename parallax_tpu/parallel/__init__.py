@@ -1,0 +1,19 @@
+"""Intra-stage parallelism over the chip mesh (ICI).
+
+Capability parity: the reference's TP layer (per-rank subprocesses +
+NCCL/mx.distributed groups, SURVEY.md section 2.7). The TPU design replaces
+rank processes entirely: one process per host, a ``jax.sharding.Mesh`` over
+the local chips, ``shard_map`` over the stage function with explicit psums —
+XLA lowers the collectives onto ICI.
+
+Axes:
+- ``tp``: attention heads / FFN hidden / KV combined-heads / MoE experts.
+- ``sp``: sequence (ring attention for long-context prefill).
+- ``dp``: replica data parallelism is the *global scheduler's* job
+  (multiple pipelines), not a mesh axis inside a stage.
+"""
+
+from parallax_tpu.parallel.mesh import make_mesh
+from parallax_tpu.parallel.tp import shard_params, stage_param_specs, tp_stage_fn
+
+__all__ = ["make_mesh", "stage_param_specs", "shard_params", "tp_stage_fn"]

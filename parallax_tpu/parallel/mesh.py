@@ -1,0 +1,32 @@
+"""Device mesh construction for a stage host."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    tp_size: int | None = None,
+    sp_size: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """Mesh over the host's local chips with axes ("sp", "tp").
+
+    ``tp_size`` defaults to all local devices. sp x tp must cover exactly
+    the devices used; tp is the fastest-varying axis so TP collectives ride
+    the shortest ICI hops.
+    """
+    devices = devices if devices is not None else jax.local_devices()
+    if tp_size is None:
+        tp_size = len(devices) // sp_size
+    n = sp_size * tp_size
+    if n > len(devices):
+        raise ValueError(
+            f"mesh needs {n} devices (sp={sp_size} x tp={tp_size}), "
+            f"have {len(devices)}"
+        )
+    arr = np.array(devices[:n]).reshape(sp_size, tp_size)
+    return Mesh(arr, ("sp", "tp"))

@@ -1,0 +1,94 @@
+"""Tensor parallelism: megatron-style column/row sharding via shard_map.
+
+The stage function runs SPMD over the ``tp`` mesh axis: q/k/v/gate/up
+projections are column-sharded (each chip owns a head/FFN slice), o/down
+projections are row-sharded with a ``psum`` over ``tp`` restoring the full
+residual (the scaling-book recipe; reference counterpart: per-layer
+``shard()`` + all-to-sharded linears, ``src/parallax/models/qwen3.py:181-195``).
+
+KV pages are sharded on the combined-head axis, so each chip holds its own
+heads' cache and the paged-attention kernel runs purely locally — zero
+collectives in attention itself.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+shard_map = jax.shard_map
+
+# param paths (last two key segments) -> PartitionSpec
+_COLUMN = {"q_proj", "k_proj", "v_proj", "gate_proj", "up_proj"}
+_ROW = {"o_proj", "down_proj"}
+
+
+def _spec_for(path: tuple[str, ...]) -> P:
+    if len(path) >= 2:
+        parent, leaf = path[-2], path[-1]
+        if parent in _COLUMN and leaf == "weight":
+            return P("tp", None)
+        if parent in _COLUMN and leaf == "bias":
+            return P("tp")
+        if parent in _ROW and leaf == "weight":
+            return P(None, "tp")
+    if path[-1] == "sinks":
+        return P("tp")
+    return P()  # replicated (norms, embed, lm_head, biases of row layers)
+
+
+def _tree_map_with_path(fn, tree, path=()):
+    if isinstance(tree, dict):
+        return {k: _tree_map_with_path(fn, v, path + (k,)) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        out = [_tree_map_with_path(fn, v, path) for v in tree]
+        return type(tree)(out) if isinstance(tree, tuple) else out
+    return fn(path, tree)
+
+
+def stage_param_specs(params: dict) -> dict:
+    """PartitionSpec pytree matching a stage param tree."""
+    return _tree_map_with_path(lambda path, _: _spec_for(path), params)
+
+
+KV_SPEC = P(None, None, "tp", None)  # [pages, page, 2*Hkv, D]
+
+
+def shard_params(params: dict, mesh: Mesh) -> dict:
+    """Place a (host/global) param tree onto the mesh with TP sharding."""
+    specs = stage_param_specs(params)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def shard_kv_caches(kv: list, mesh: Mesh) -> list:
+    return [jax.device_put(k, NamedSharding(mesh, KV_SPEC)) for k in kv]
+
+
+def tp_stage_fn(model, params_template: dict, mesh: Mesh):
+    """Wrap ``model.__call__`` for SPMD execution over the tp axis.
+
+    Returns ``fn(params, kv_caches, inputs) -> (out, kv_caches)`` suitable
+    for jit with KV donation. The model must have been constructed with
+    ``tp_size = mesh.shape['tp']`` so its per-shard head counts match.
+    """
+    param_specs = stage_param_specs(params_template)
+    tp = mesh.shape["tp"]
+
+    def fn(params, kv_caches, inputs):
+        return model(params, kv_caches, inputs)
+
+    in_specs = (
+        param_specs,
+        [KV_SPEC] * model.num_local_layers,
+        P(),   # BatchInputs: replicated on every chip
+    )
+    out_specs = (P(), [KV_SPEC] * model.num_local_layers)
+    if tp == 1:
+        return fn
+    return shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+    )

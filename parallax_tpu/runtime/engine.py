@@ -71,10 +71,12 @@ class StageEngine:
         model: StageModel,
         params: dict,
         config: EngineConfig | None = None,
+        mesh=None,
     ):
         self.model = model
         self.params = params
         self.cfg = config or EngineConfig()
+        self.mesh = mesh
         kv_dtype = jnp.bfloat16 if self.cfg.kv_dtype == "bfloat16" else jnp.float32
         self.kv = model.new_kv_caches(
             self.cfg.num_pages, self.cfg.page_size, kv_dtype
@@ -99,7 +101,16 @@ class StageEngine:
             self.cfg.max_model_len,
             self.cfg.page_size,
         )
-        self._jit_step = jax.jit(self._stage_fn, donate_argnums=(1,))
+        if mesh is not None and model.tp_size > 1:
+            from parallax_tpu.parallel import tp as _tp
+
+            self.params = _tp.shard_params(params, mesh)
+            self.kv = _tp.shard_kv_caches(self.kv, mesh)
+            self._jit_step = jax.jit(
+                _tp.tp_stage_fn(model, params, mesh), donate_argnums=(1,)
+            )
+        else:
+            self._jit_step = jax.jit(self._stage_fn, donate_argnums=(1,))
         self._base_key = jax.random.key(self.cfg.seed)
         self._step_count = 0
         # Non-head stages: hidden rows waiting per request id.
