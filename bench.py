@@ -27,6 +27,7 @@ import dataclasses
 import json
 import os
 import statistics
+import subprocess
 import sys
 import time
 
@@ -34,8 +35,39 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_TOKENS_PER_SEC_PER_CHIP = 1360.0
 
+# TPU backend init can hang indefinitely when the tunnel/relay is wedged;
+# run the measurement in a child with a wall-clock watchdog and fall back
+# to the CPU smoke path so the driver always gets its JSON line.
+WATCHDOG_S = int(os.environ.get("BENCH_WATCHDOG_S", "2400"))
+
 
 def main():
+    if os.environ.get("BENCH_CHILD"):
+        return _bench()
+    for attempt_env in (None, "1"):
+        env = dict(os.environ, BENCH_CHILD="1")
+        if attempt_env:
+            env["BENCH_CPU"] = attempt_env
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True, timeout=WATCHDOG_S,
+            )
+            lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+            if out.returncode == 0 and lines:
+                print(lines[-1])
+                return
+            sys.stderr.write(out.stderr[-2000:] + "\n")
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(f"bench attempt timed out ({WATCHDOG_S}s)\n")
+    print(json.dumps({
+        "metric": "output tokens/sec/chip", "value": 0.0,
+        "unit": "tokens/s/chip", "vs_baseline": 0.0,
+        "detail": {"error": "all bench attempts failed"},
+    }))
+
+
+def _bench():
     import jax
 
     if os.environ.get("BENCH_CPU"):
@@ -137,7 +169,10 @@ def main():
     skip = max(1, len(decode_times) // 8)
     steady = decode_times[skip:] or decode_times
     step_ms = statistics.median(steady)
-    tokens_per_sec_per_chip = batch / (2.0 * step_ms / 1000.0)
+    # Use the measured tokens per decode step (page budget or admission may
+    # cap concurrency below the nominal batch).
+    tokens_per_step = decode_tokens / max(1, len(decode_times))
+    tokens_per_sec_per_chip = tokens_per_step / (2.0 * step_ms / 1000.0)
 
     result = {
         "metric": (

@@ -1,0 +1,9 @@
+"""Scheduler web backend: control-plane RPC service + OpenAI-compatible HTTP.
+
+Capability parity: reference ``src/backend`` (SURVEY.md section 2.8) —
+FastAPI app with ``/v1/chat/completions``, ``/scheduler/init``,
+``/cluster/status``; RPCConnectionHandler bridging node join/update/leave
+onto the scheduler; RequestHandler retry ladder. Here the HTTP plane is
+aiohttp (FastAPI is not in the image) and the RPC plane rides the same
+transport as the data plane.
+"""

@@ -1,0 +1,119 @@
+"""Control-plane RPC service: nodes <-> GlobalScheduler.
+
+Capability parity: reference ``src/backend/server/rpc_connection_handler.py``
+(node_join blocking until allocation <=300 s, node_update heartbeat with
+reallocation piggyback + auto-rejoin, node_leave) and the
+``SchedulerManage`` glue (scheduler_manage.py:185-200).
+"""
+
+from __future__ import annotations
+
+import time
+
+from parallax_tpu.p2p import proto
+from parallax_tpu.p2p.transport import Transport
+from parallax_tpu.scheduling.scheduler import GlobalScheduler
+from parallax_tpu.utils import get_logger
+from parallax_tpu.utils.hw import HardwareInfo
+
+logger = get_logger(__name__)
+
+
+class SchedulerService:
+    """Exposes a GlobalScheduler over the transport RPC surface."""
+
+    def __init__(
+        self,
+        scheduler: GlobalScheduler,
+        transport: Transport,
+        join_timeout_s: float = 300.0,
+    ):
+        self.scheduler = scheduler
+        self.transport = transport
+        self.join_timeout_s = join_timeout_s
+        transport.register(proto.NODE_JOIN, self._on_join)
+        transport.register(proto.NODE_UPDATE, self._on_update)
+        transport.register(proto.NODE_LEAVE, self._on_leave)
+        transport.register("request_complete", self._on_request_complete)
+        transport.register("__ping__", lambda *_: "pong")
+
+    def start(self) -> None:
+        self.transport.start()
+        self.scheduler.start()
+
+    def stop(self) -> None:
+        self.scheduler.stop()
+        self.transport.stop()
+
+    # -- handlers (run on transport worker threads) -------------------------
+
+    def _on_join(self, _peer: str, payload: dict) -> dict:
+        """Blocks until the node has an allocation, or returns a STANDBY
+        acknowledgement: once the swarm is bootstrapped, an unneeded joiner
+        goes to standby and will receive layers via heartbeat replies when
+        the topology changes (reference keeps joiners pending in
+        rpc_connection_handler.py:33-58; standby-acking instead keeps the
+        heartbeat channel alive during long waits)."""
+        node_id = payload["node_id"]
+        hw = HardwareInfo.from_dict(payload["hardware"])
+        self.scheduler.enqueue_join(node_id, hw)
+        deadline = time.monotonic() + self.join_timeout_s
+        while time.monotonic() < deadline:
+            alloc = self.scheduler.get_node_allocation(node_id)
+            if alloc is not None:
+                return alloc
+            if self.scheduler.bootstrapped.is_set():
+                grace = time.monotonic() + 2.0
+                while time.monotonic() < grace:
+                    alloc = self.scheduler.get_node_allocation(node_id)
+                    if alloc is not None:
+                        return alloc
+                    time.sleep(0.05)
+                return {"standby": True}
+            time.sleep(0.05)
+        return {"error": "no allocation within timeout"}
+
+    def _on_update(self, _peer: str, payload: dict) -> dict:
+        node_id = payload["node_id"]
+        if self.scheduler.manager.get(node_id) is None:
+            # Auto-rejoin after scheduler restart/eviction (reference
+            # rpc_connection_handler.py:71-113).
+            if "hardware" in payload:
+                self.scheduler.enqueue_join(
+                    node_id, HardwareInfo.from_dict(payload["hardware"])
+                )
+            return {"rejoin": True}
+        self.scheduler.enqueue_update(
+            node_id,
+            layer_latency_ms=payload.get("layer_latency_ms"),
+            load=payload.get("load"),
+            rtt_s=payload.get("rtt_s"),
+            is_ready=payload.get("is_ready"),
+            refit_version=payload.get("refit_version"),
+        )
+        alloc = self.scheduler.get_node_allocation(node_id) or {}
+        alloc["refit_version"] = self.scheduler.refit_version
+        alloc["refit_index"] = (
+            self.scheduler.refit_index
+            if payload.get("refit_version", 0) < self.scheduler.refit_version
+            else None
+        )
+        return alloc
+
+    def _on_leave(self, _peer: str, payload: dict) -> str:
+        self.scheduler.enqueue_leave(payload["node_id"])
+        return "ok"
+
+    def _on_request_complete(self, _peer: str, payload: dict) -> str:
+        self.scheduler.complete_request(payload.get("path") or [])
+        return "ok"
+
+    # -- routing for the HTTP plane -----------------------------------------
+
+    def route_request(self, request_id: str, timeout_s: float = 5.0) -> list[str] | None:
+        """Block until the dispatcher assigns a node path (reference
+        scheduler_manage.get_routing_table, scheduler_manage.py:287-313)."""
+        pr = self.scheduler.receive_request(request_id)
+        if not pr.event.wait(timeout_s):
+            return None
+        return pr.path_ids

@@ -154,7 +154,9 @@ class StageModel:
                 layer["self_attn"]["k_norm"] = {"weight": jnp.ones((d,), dtype)}
             params["layers"].append(layer)
 
-        if self.is_first:
+        # The last stage of a tied-embedding model also needs the embedding
+        # matrix (it IS the lm_head), even when it is not the first stage.
+        if self.is_first or (self.is_last and cfg.tie_word_embeddings):
             params["embed_tokens"] = {
                 "weight": (
                     jax.random.normal(

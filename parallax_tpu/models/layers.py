@@ -53,15 +53,29 @@ def lm_head_logits(x: jax.Array, p: dict) -> jax.Array:
     )
 
 
+def row_parallel_linear(
+    x: jax.Array, p: dict, axis_name: str | None
+) -> jax.Array:
+    """Row-sharded projection: psum the partial matmuls, add the (replicated)
+    bias exactly once *after* the reduction."""
+    out = jax.lax.dot_general(
+        x, p["weight"],
+        dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    if axis_name is not None:
+        out = jax.lax.psum(out, axis_name)
+    if "bias" in p:
+        out = out + p["bias"].astype(out.dtype)
+    return out
+
+
 def swiglu_mlp(x: jax.Array, p: dict, axis_name: str | None = None) -> jax.Array:
     """SwiGLU FFN (gate/up/down). Under TP the hidden dim is column-sharded
     and the row-parallel down_proj output is psummed over ``axis_name``."""
     gate = linear(x, p["gate_proj"])
     up = linear(x, p["up_proj"])
-    out = linear(jax.nn.silu(gate) * up, p["down_proj"])
-    if axis_name is not None:
-        out = jax.lax.psum(out, axis_name)
-    return out
+    return row_parallel_linear(jax.nn.silu(gate) * up, p["down_proj"], axis_name)
 
 
 def paged_attention_block(
@@ -121,7 +135,5 @@ def paged_attention_block(
         sinks=p.get("sinks"),
         use_pallas=use_pallas,
     )
-    out = linear(out.reshape(t, hq * d), p["o_proj"])
-    if axis_name is not None:
-        out = jax.lax.psum(out, axis_name)
+    out = row_parallel_linear(out.reshape(t, hq * d), p["o_proj"], axis_name)
     return out, kv_pages

@@ -1,0 +1,25 @@
+"""P2P communication: inter-stage activation transport + control RPCs.
+
+Capability parity: reference ``src/parallax/p2p`` (SURVEY.md section 2.2) —
+the Lattica libp2p stack carrying ``rpc_pp_forward``/``rpc_abort``/
+``chat_completion`` RPCs plus scheduler control (``node_join``/
+``node_update``/``node_leave``). The TPU-native design keeps the same RPC
+surface over a pluggable transport: in-process loopback for tests and
+single-host, length-prefixed msgpack over TCP for DCN. Tensors travel as
+raw bytes + dtype/shape headers (no pickle).
+"""
+
+from parallax_tpu.p2p.proto import decode_frame, encode_frame
+from parallax_tpu.p2p.transport import (
+    LoopbackTransport,
+    TcpTransport,
+    Transport,
+)
+
+__all__ = [
+    "Transport",
+    "LoopbackTransport",
+    "TcpTransport",
+    "encode_frame",
+    "decode_frame",
+]

@@ -1,0 +1,320 @@
+"""WorkerNode: the per-host serving daemon.
+
+Capability parity: reference ``GradientServer``
+(``src/parallax/p2p/server.py:341-976``): join the scheduler, heartbeat
+announcer with reallocation detection, the node sender loop grouping
+outbound packets by next peer, abort/release broadcast, and elastic reload
+when the scheduler moves the node's layer range.
+
+TPU re-design: one process per host (TP lives inside the engine's mesh, no
+rank subprocesses), a single step thread owning the engine, and an inbox
+queue decoupling transport callbacks from compute. Worker node ids are
+their transport addresses (``host:port``) — the DHT indirection of libp2p
+is unnecessary on DCN.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+
+from parallax_tpu.config import ModelConfig
+from parallax_tpu.models.base import StageModel
+from parallax_tpu.models.registry import create_stage_model
+from parallax_tpu.p2p import proto
+from parallax_tpu.p2p.transport import Transport
+from parallax_tpu.runtime.engine import EngineConfig, StageEngine
+from parallax_tpu.runtime.request import IntermediateRequest, Request
+from parallax_tpu.utils import get_logger
+from parallax_tpu.utils.hw import detect_hardware
+
+logger = get_logger(__name__)
+
+
+class WorkerNode:
+    """Joins a swarm, serves its layer range, forwards activations."""
+
+    def __init__(
+        self,
+        transport: Transport,
+        scheduler_peer: str,
+        model_config: ModelConfig,
+        engine_config: EngineConfig | None = None,
+        load_params=None,          # callable (StageModel) -> params
+        heartbeat_interval_s: float = 2.0,
+        mesh=None,
+        tp_size: int = 1,
+    ):
+        self.transport = transport
+        self.scheduler_peer = scheduler_peer
+        self.model_config = model_config
+        self.engine_config = engine_config or EngineConfig()
+        self.load_params = load_params or self._random_params
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.mesh = mesh
+        self.tp_size = tp_size
+
+        self.node_id = transport.peer_id
+        self.engine: StageEngine | None = None
+        self.start_layer = -1
+        self.end_layer = -1
+        self._inbox: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._reload = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._allocated = threading.Event()
+        # Head-node bookkeeping: finished requests awaiting pickup.
+        self._finished: queue.Queue[Request] = queue.Queue()
+        self._request_events: dict[str, threading.Event] = {}
+
+        transport.register(proto.FORWARD, self._on_forward)
+        transport.register(proto.ABORT, self._on_abort)
+        transport.register(proto.RELEASE, self._on_release)
+        transport.register("__ping__", lambda *_: "pong")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Join, then serve. The join RPC only fetches the allocation; the
+        (slow) engine build happens on the step thread so heartbeats flow
+        from the first moment — the reference loads its executor in separate
+        processes for the same reason (launch.py:250-309)."""
+        self.transport.start()
+        alloc = self._join()
+        for fn in (self._announcer_loop, self._step_loop):
+            t = threading.Thread(target=fn, daemon=True, name=fn.__name__)
+            t.start()
+            self._threads.append(t)
+        if "start_layer" in alloc:
+            self._inbox.put(("reload", alloc))
+        else:
+            logger.info("%s: joined as standby", self.node_id)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=3.0)
+        try:
+            self.transport.call(self.scheduler_peer, proto.NODE_LEAVE,
+                                {"node_id": self.node_id}, timeout=5.0)
+        except Exception:
+            pass
+        self.transport.stop()
+
+    # -- join + elastic reload ----------------------------------------------
+
+    def _join(self) -> dict:
+        hw = detect_hardware()
+        reply = self.transport.call(
+            self.scheduler_peer,
+            proto.NODE_JOIN,
+            {"node_id": self.node_id, "hardware": hw.to_dict()},
+            timeout=300.0,
+        )
+        if not reply or ("start_layer" not in reply and "standby" not in reply):
+            raise RuntimeError(f"join rejected: {reply}")
+        return reply
+
+    def _apply_allocation(self, alloc: dict) -> None:
+        start, end = alloc["start_layer"], alloc["end_layer"]
+        if (start, end) == (self.start_layer, self.end_layer):
+            return
+        logger.info(
+            "%s: (re)loading layers [%d, %d)", self.node_id, start, end
+        )
+        self.start_layer, self.end_layer = start, end
+        model = create_stage_model(
+            self.model_config, start, end, tp_size=self.tp_size
+        )
+        params = self.load_params(model)
+        self.engine = StageEngine(
+            model, params, self.engine_config, mesh=self.mesh
+        )
+        self._allocated.set()
+
+    def _random_params(self, model: StageModel):
+        dtype = (
+            jnp.bfloat16
+            if self.engine_config.kv_dtype == "bfloat16"
+            else jnp.float32
+        )
+        # Deterministic per layer range so every run of a stage agrees.
+        return model.init_params(
+            jax.random.key(model.start_layer * 1000 + model.end_layer),
+            dtype=dtype,
+        )
+
+    # -- announcer (heartbeat) ----------------------------------------------
+
+    def _announcer_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                logger.debug("%s: heartbeat", self.node_id)
+                eng = self.engine
+                reply = self.transport.call(
+                    self.scheduler_peer,
+                    proto.NODE_UPDATE,
+                    {
+                        "node_id": self.node_id,
+                        "is_ready": eng is not None,
+                        "load": eng.scheduler.num_requests() if eng else 0,
+                        "layer_latency_ms": (
+                            eng.layer_latency_ms_ewma if eng else None
+                        ),
+                    },
+                    timeout=10.0,
+                )
+                if reply and reply.get("rejoin"):
+                    # Scheduler lost us (restart or heartbeat eviction):
+                    # auto-rejoin (reference rpc_connection_handler.py:71-113).
+                    logger.warning("%s: scheduler asked for rejoin", self.node_id)
+                    self._inbox.put(("reload", self._join()))
+                elif reply and reply.get("start_layer") is not None:
+                    if (
+                        reply["start_layer"],
+                        reply["end_layer"],
+                    ) != (self.start_layer, self.end_layer):
+                        # Scheduler moved us: reload on the step thread.
+                        self._inbox.put(("reload", reply))
+            except Exception as e:
+                logger.warning("heartbeat failed: %s", e)
+            self._stop.wait(self.heartbeat_interval_s)
+
+    # -- transport handlers (any thread) -------------------------------------
+
+    def _on_forward(self, _peer: str, payload: dict):
+        for wire_req in payload["reqs"]:
+            self._inbox.put(("forward", proto.ireq_from_wire(wire_req)))
+        return "ok"
+
+    def _on_abort(self, _peer: str, payload: dict):
+        for rid in payload["rids"]:
+            self._inbox.put(("release", rid, True))
+        return "ok"
+
+    def _on_release(self, _peer: str, payload: dict):
+        for rid in payload["rids"]:
+            self._inbox.put(("release", rid, payload.get("abort", False)))
+        return "ok"
+
+    def submit(self, request: Request) -> threading.Event:
+        """Head-node API: enqueue a user request; the returned event fires
+        when it finishes."""
+        ev = threading.Event()
+        self._request_events[request.request_id] = ev
+        self._inbox.put(("submit", request))
+        return ev
+
+    def pop_finished(self) -> list[Request]:
+        out = []
+        while True:
+            try:
+                out.append(self._finished.get_nowait())
+            except queue.Empty:
+                return out
+
+    # -- step loop (owns the engine) -----------------------------------------
+
+    def _step_loop(self) -> None:
+        while not self._stop.is_set():
+            worked = self._drain_inbox()
+            eng = self.engine
+            if eng is None:
+                time.sleep(0.01)
+                continue
+            if eng.has_work():
+                out = eng.step()
+                self._route_outputs(out)
+                worked = worked or out.num_tokens > 0
+            if not worked:
+                time.sleep(0.001)
+
+    def _drain_inbox(self) -> bool:
+        worked = False
+        while True:
+            try:
+                item = self._inbox.get_nowait()
+            except queue.Empty:
+                return worked
+            worked = True
+            kind = item[0]
+            if kind == "forward":
+                ireq: IntermediateRequest = item[1]
+                if ireq.next_token_id is not None:
+                    self.engine.commit_token(ireq.request_id, ireq.next_token_id)
+                else:
+                    self.engine.submit_intermediate(ireq)
+            elif kind == "submit":
+                try:
+                    self.engine.submit(item[1])
+                except Exception as e:
+                    req: Request = item[1]
+                    req.abort(str(e))
+                    self._finish(req)
+            elif kind == "release":
+                self.engine.release(item[1], abort=item[2])
+            elif kind == "reload":
+                self._apply_allocation(item[1])
+
+    def _route_outputs(self, out) -> None:
+        """Group packets by next hop and fire rpc_pp_forward (reference
+        start_node_sender, p2p/server.py:628-755)."""
+        by_peer: dict[str, list] = {}
+        for ireq in out.forward:
+            table = ireq.routing_table
+            if ireq.next_token_id is not None:
+                target = table[0] if table else self.node_id
+            else:
+                try:
+                    idx = table.index(self.node_id)
+                    target = table[idx + 1]
+                except (ValueError, IndexError):
+                    logger.error(
+                        "%s: no next hop for %s (table=%s)",
+                        self.node_id, ireq.request_id, table,
+                    )
+                    continue
+            if target == self.node_id:
+                self._inbox.put(("forward", ireq))
+            else:
+                by_peer.setdefault(target, []).append(proto.ireq_to_wire(ireq))
+        for peer, reqs in by_peer.items():
+            try:
+                self.transport.send(peer, proto.FORWARD, {"reqs": reqs})
+            except Exception as e:
+                logger.error("forward to %s failed: %s", peer, e)
+                self._inbox.put(("abort_path", peer))
+
+        for req in out.finished:
+            self._finish(req)
+
+    def _finish(self, req: Request) -> None:
+        # Broadcast release to the rest of the path (reference abort
+        # broadcast, p2p/server.py:713-749).
+        aborted = req.status.value == "finished_abort"
+        for peer in req.routing_table:
+            if peer == self.node_id:
+                continue
+            try:
+                self.transport.send(
+                    peer, proto.RELEASE,
+                    {"rids": [req.request_id], "abort": aborted},
+                )
+            except Exception:
+                pass
+        try:
+            self.transport.call(
+                self.scheduler_peer, "request_complete",
+                {"path": req.routing_table or [self.node_id]},
+                timeout=5.0,
+            )
+        except Exception:
+            pass
+        self._finished.put(req)
+        ev = self._request_events.pop(req.request_id, None)
+        if ev is not None:
+            ev.set()

@@ -1,0 +1,90 @@
+"""Wire format: msgpack frames with raw-bytes tensor payloads.
+
+Field semantics mirror the reference's ``forward.proto``
+(``src/parallax/p2p/proto/forward.proto:1-57``: ForwardRequest{mode,
+repeated Req{rid, routing_table, input_ids, hidden_states, next_token_id,
+sampling_params, ...}}, AbortRequest) — re-encoded as msgpack for a
+dependency-light, schema-evolvable wire. Tensors are serialized as
+``{dtype, shape, data: raw bytes}`` (the reference uses safetensors bytes;
+raw+header avoids a container parse per hop and maps straight into
+``np.frombuffer`` -> ``jax.device_put``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import msgpack
+import numpy as np
+
+from parallax_tpu.runtime.request import IntermediateRequest
+
+# Frame types (the RPC surface, names preserved from the reference).
+FORWARD = "rpc_pp_forward"
+ABORT = "rpc_abort"
+RELEASE = "rpc_release"
+CHAT_COMPLETION = "chat_completion"
+NODE_JOIN = "node_join"
+NODE_UPDATE = "node_update"
+NODE_LEAVE = "node_leave"
+
+
+def tensor_to_wire(arr: np.ndarray | None) -> dict | None:
+    if arr is None:
+        return None
+    arr = np.ascontiguousarray(arr)
+    return {
+        "dtype": arr.dtype.str,
+        "shape": list(arr.shape),
+        "data": arr.tobytes(),
+    }
+
+
+def tensor_from_wire(obj: dict | None) -> np.ndarray | None:
+    if obj is None:
+        return None
+    return np.frombuffer(obj["data"], dtype=np.dtype(obj["dtype"])).reshape(
+        obj["shape"]
+    )
+
+
+def ireq_to_wire(ireq: IntermediateRequest) -> dict:
+    return {
+        "rid": ireq.request_id,
+        "routing_table": list(ireq.routing_table),
+        "context_len": ireq.context_len,
+        "num_new_tokens": ireq.num_new_tokens,
+        "token_ids": ireq.token_ids,
+        "hidden_states": tensor_to_wire(ireq.hidden_states),
+        "next_token_id": ireq.next_token_id,
+        "sampling_params": ireq.sampling_params,
+        "is_last_chunk": ireq.is_last_chunk,
+        "abort": ireq.abort,
+    }
+
+
+def ireq_from_wire(d: dict) -> IntermediateRequest:
+    return IntermediateRequest(
+        request_id=d["rid"],
+        routing_table=list(d.get("routing_table") or []),
+        context_len=d["context_len"],
+        num_new_tokens=d["num_new_tokens"],
+        token_ids=d.get("token_ids"),
+        hidden_states=tensor_from_wire(d.get("hidden_states")),
+        next_token_id=d.get("next_token_id"),
+        sampling_params=d.get("sampling_params"),
+        is_last_chunk=d.get("is_last_chunk", True),
+        abort=d.get("abort", False),
+    )
+
+
+def encode_frame(frame_type: str, payload: Any, msg_id: int = 0,
+                 reply_to: int | None = None) -> bytes:
+    return msgpack.packb(
+        {"t": frame_type, "id": msg_id, "re": reply_to, "p": payload},
+        use_bin_type=True,
+    )
+
+
+def decode_frame(data: bytes) -> dict:
+    return msgpack.unpackb(data, raw=False)

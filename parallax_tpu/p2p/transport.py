@@ -1,0 +1,300 @@
+"""Pluggable transport with the Lattica-equivalent RPC surface.
+
+Capability parity: reference Lattica RPC framework (libp2p DHT/relay,
+``@rpc_method`` handlers — SURVEY.md section 2.6). Two backends:
+
+- :class:`LoopbackTransport` — in-process peer registry (tests,
+  single-host multi-stage).
+- :class:`TcpTransport` — asyncio TCP with 4-byte length-prefixed msgpack
+  frames over DCN. Connections are dialed lazily, kept alive, and redialed
+  on failure.
+
+Both expose the same synchronous facade (the engine loop is a thread):
+``call(peer, method, payload)`` for request/response RPCs and
+``send(peer, method, payload)`` for fire-and-forget data-plane frames.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable
+
+from parallax_tpu.p2p.proto import decode_frame, encode_frame
+from parallax_tpu.utils import get_logger
+
+logger = get_logger(__name__)
+
+Handler = Callable[[str, Any], Any]  # (from_peer, payload) -> reply or None
+
+
+class TransportError(Exception):
+    pass
+
+
+class Transport:
+    """RPC surface shared by all backends."""
+
+    def __init__(self, peer_id: str):
+        self.peer_id = peer_id
+        self._handlers: dict[str, Handler] = {}
+
+    def register(self, method: str, handler: Handler) -> None:
+        self._handlers[method] = handler
+
+    def _dispatch(self, method: str, from_peer: str, payload: Any) -> Any:
+        handler = self._handlers.get(method)
+        if handler is None:
+            raise TransportError(f"{self.peer_id}: no handler for {method}")
+        return handler(from_peer, payload)
+
+    # -- backend API -------------------------------------------------------
+
+    def call(self, peer: str, method: str, payload: Any,
+             timeout: float = 30.0) -> Any:
+        raise NotImplementedError
+
+    def send(self, peer: str, method: str, payload: Any) -> None:
+        """Fire-and-forget; may raise on connection failure."""
+        raise NotImplementedError
+
+    def start(self) -> None:  # pragma: no cover - trivial
+        pass
+
+    def stop(self) -> None:  # pragma: no cover - trivial
+        pass
+
+
+# ---------------------------------------------------------------------------
+
+
+class LoopbackTransport(Transport):
+    """In-process transport: peers share a registry dict."""
+
+    def __init__(self, peer_id: str, registry: dict[str, "LoopbackTransport"]):
+        super().__init__(peer_id)
+        self._registry = registry
+        registry[peer_id] = self
+
+    def call(self, peer: str, method: str, payload: Any,
+             timeout: float = 30.0) -> Any:
+        target = self._registry.get(peer)
+        if target is None:
+            raise TransportError(f"unknown peer {peer}")
+        return target._dispatch(method, self.peer_id, payload)
+
+    def send(self, peer: str, method: str, payload: Any) -> None:
+        self.call(peer, method, payload)
+
+
+# ---------------------------------------------------------------------------
+
+
+class TcpTransport(Transport):
+    """Asyncio TCP transport with a background event-loop thread.
+
+    Peers are addressed as ``"host:port"`` strings. Every frame is
+    ``[u32 length][msgpack bytes]``; requests carry a msg id, replies echo
+    it in ``re``.
+    """
+
+    def __init__(self, peer_id: str, host: str = "127.0.0.1", port: int = 0):
+        super().__init__(peer_id)
+        self.host = host
+        self.port = port
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._server: asyncio.AbstractServer | None = None
+        self._conns: dict[str, tuple] = {}  # peer -> (reader, writer, lock)
+        self._pending: dict[int, "asyncio.Future"] = {}
+        self._msg_id = 0
+        self._started = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._started.is_set():
+            return  # idempotent: callers may pre-start to learn the port
+        self._thread = threading.Thread(
+            target=self._run_loop, daemon=True, name=f"tcp-{self.peer_id}"
+        )
+        self._thread.start()
+        if not self._started.wait(10.0):
+            raise TransportError("transport failed to start")
+
+    def _run_loop(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_until_complete(self._serve())
+        self._loop.run_forever()
+
+    async def _serve(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_connection, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._started.set()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        if self._loop is None:
+            return
+
+        def _shutdown():
+            if self._server is not None:
+                self._server.close()
+            for task in asyncio.all_tasks(self._loop):
+                task.cancel()
+            self._loop.stop()
+
+        self._loop.call_soon_threadsafe(_shutdown)
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+    # -- framing -----------------------------------------------------------
+
+    @staticmethod
+    async def _read_frame(reader: asyncio.StreamReader) -> dict | None:
+        try:
+            header = await reader.readexactly(4)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            return None
+        (length,) = struct.unpack(">I", header)
+        try:
+            data = await reader.readexactly(length)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            return None
+        return decode_frame(data)
+
+    @staticmethod
+    def _write_frame(writer: asyncio.StreamWriter, data: bytes) -> None:
+        writer.write(struct.pack(">I", len(data)) + data)
+
+    # -- server side -------------------------------------------------------
+
+    async def _on_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer_name = "?"
+        while True:
+            frame = await self._read_frame(reader)
+            if frame is None:
+                break
+            if frame["t"] == "__hello__":
+                peer_name = frame["p"]
+                continue
+            if frame.get("re") is not None:
+                fut = self._pending.pop(frame["re"], None)
+                if fut is not None and not fut.done():
+                    fut.set_result(frame["p"])
+                continue
+            asyncio.ensure_future(
+                self._handle_request(frame, peer_name, writer)
+            )
+        writer.close()
+
+    async def _handle_request(self, frame, peer_name, writer) -> None:
+        try:
+            result = await asyncio.to_thread(
+                self._dispatch, frame["t"], peer_name, frame["p"]
+            )
+        except Exception as e:  # reply with an error marker
+            logger.exception("handler %s failed", frame["t"])
+            result = {"__error__": str(e)}
+        if frame["id"]:
+            self._write_frame(
+                writer, encode_frame("__reply__", result, reply_to=frame["id"])
+            )
+            try:
+                await writer.drain()
+            except ConnectionError:
+                pass
+
+    # -- client side -------------------------------------------------------
+
+    async def _get_conn(self, peer: str):
+        conn = self._conns.get(peer)
+        if conn is not None and not conn[1].is_closing():
+            return conn
+        host, port_s = peer.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port_s))
+        self._write_frame(writer, encode_frame("__hello__", self.peer_id))
+        await writer.drain()
+        lock = asyncio.Lock()
+        conn = (reader, writer, lock)
+        self._conns[peer] = conn
+        asyncio.ensure_future(self._pump_replies(peer, reader))
+        return conn
+
+    async def _pump_replies(self, peer: str, reader: asyncio.StreamReader):
+        """Replies to our requests arrive on the connection we dialed."""
+        while True:
+            frame = await self._read_frame(reader)
+            if frame is None:
+                self._conns.pop(peer, None)
+                return
+            if frame.get("re") is not None:
+                fut = self._pending.pop(frame["re"], None)
+                if fut is not None and not fut.done():
+                    fut.set_result(frame["p"])
+            else:
+                # Peer-initiated frame on our client connection.
+                asyncio.ensure_future(
+                    self._handle_request(frame, peer, self._conns[peer][1])
+                )
+
+    async def _send_async(self, peer: str, data: bytes) -> None:
+        reader, writer, lock = await self._get_conn(peer)
+        async with lock:
+            self._write_frame(writer, data)
+            await writer.drain()
+
+    async def _call_async(self, peer: str, method: str, payload, timeout):
+        self._msg_id += 1
+        mid = self._msg_id
+        fut = self._loop.create_future()
+        self._pending[mid] = fut
+        await self._send_async(peer, encode_frame(method, payload, msg_id=mid))
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(mid, None)
+
+    # -- public sync facade --------------------------------------------------
+
+    def call(self, peer: str, method: str, payload: Any,
+             timeout: float = 30.0) -> Any:
+        fut = asyncio.run_coroutine_threadsafe(
+            self._call_async(peer, method, payload, timeout), self._loop
+        )
+        result = fut.result(timeout + 5.0)
+        if isinstance(result, dict) and "__error__" in result:
+            raise TransportError(result["__error__"])
+        return result
+
+    def send(self, peer: str, method: str, payload: Any) -> None:
+        data = encode_frame(method, payload, msg_id=0)
+        fut = asyncio.run_coroutine_threadsafe(
+            self._send_async(peer, data), self._loop
+        )
+        fut.result(30.0)
+
+    def measure_rtt(self, peer: str, samples: int = 3) -> float:
+        """Seconds of round trip to a peer (reference get_node_info RTT
+        probes, p2p/server.py:886-958)."""
+        best = float("inf")
+        for _ in range(samples):
+            t0 = time.perf_counter()
+            self.call(peer, "__ping__", None, timeout=5.0)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+
+def make_ping_handler() -> Handler:
+    return lambda _peer, _payload: "pong"

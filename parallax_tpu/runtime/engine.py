@@ -78,9 +78,27 @@ class StageEngine:
         self.cfg = config or EngineConfig()
         self.mesh = mesh
         kv_dtype = jnp.bfloat16 if self.cfg.kv_dtype == "bfloat16" else jnp.float32
-        self.kv = model.new_kv_caches(
-            self.cfg.num_pages, self.cfg.page_size, kv_dtype
-        )
+        if mesh is not None and model.tp_size > 1:
+            # Allocate the cache directly in its sharded layout — a
+            # materialize-then-reshard would spike one chip's HBM with the
+            # full unsharded cache at startup.
+            from jax.sharding import NamedSharding
+
+            from parallax_tpu.parallel.tp import KV_SPEC
+
+            shardings = [
+                NamedSharding(mesh, KV_SPEC)
+            ] * model.num_local_layers
+            self.kv = jax.jit(
+                lambda: model.new_kv_caches(
+                    self.cfg.num_pages, self.cfg.page_size, kv_dtype
+                ),
+                out_shardings=shardings,
+            )()
+        else:
+            self.kv = model.new_kv_caches(
+                self.cfg.num_pages, self.cfg.page_size, kv_dtype
+            )
         self.cache = CacheManager(
             self.cfg.page_size,
             self.cfg.num_pages,
@@ -105,7 +123,6 @@ class StageEngine:
             from parallax_tpu.parallel import tp as _tp
 
             self.params = _tp.shard_params(params, mesh)
-            self.kv = _tp.shard_kv_caches(self.kv, mesh)
             self._jit_step = jax.jit(
                 _tp.tp_stage_fn(model, params, mesh), donate_argnums=(1,)
             )

@@ -1,0 +1,225 @@
+"""Phase-1 scheduling: assign contiguous layer ranges to nodes.
+
+Capability parity: reference ``src/scheduling/layer_allocation.py:70-1015``
+— water-filling rebalance (solve lambda s.t. sum(min(cap_i,
+lambda*speed_i)) = L), a greedy allocator packing standby nodes into as
+many full pipelines as possible, an exact DP allocator maximizing pipeline
+count, dynamic join, and the coefficient-of-variation global-rebalance
+trigger.
+"""
+
+from __future__ import annotations
+
+import math
+import statistics
+
+from parallax_tpu.scheduling.node import Node
+from parallax_tpu.scheduling.node_management import Pipeline
+from parallax_tpu.utils import get_logger
+
+logger = get_logger(__name__)
+
+
+def water_fill_layers(nodes: list[Node], num_layers: int) -> list[int] | None:
+    """Split ``num_layers`` across ``nodes`` proportional to speed, capped by
+    each node's memory capacity.
+
+    Solves sum_i min(cap_i, lambda * speed_i) = L by bisection on lambda,
+    then rounds to integers preserving the total (reference
+    ``adjust_pipeline_layers``, layer_allocation.py:278-400).
+    Returns per-node layer counts (every node >= 1), or None if the group
+    cannot host the model.
+    """
+    caps = [n.layer_capacity() for n in nodes]
+    if sum(caps) < num_layers or len(nodes) > num_layers:
+        return None
+    speeds = [1.0 / max(1e-9, n.layer_latency_ms()) for n in nodes]
+
+    lo, hi = 0.0, num_layers / max(min(speeds), 1e-9) + 1.0
+    for _ in range(64):
+        mid = (lo + hi) / 2
+        total = sum(min(c, mid * s) for c, s in zip(caps, speeds))
+        if total < num_layers:
+            lo = mid
+        else:
+            hi = mid
+    raw = [min(c, hi * s) for c, s in zip(caps, speeds)]
+
+    # Integer rounding: floor, then hand out the remainder by largest
+    # fractional part, respecting caps and a floor of 1 layer per node.
+    counts = [max(1, min(cap, math.floor(r))) for r, cap in zip(raw, caps)]
+    rem = num_layers - sum(counts)
+    if rem < 0:
+        # Floors of 1 overshot; trim from the slowest nodes.
+        order = sorted(range(len(nodes)), key=lambda i: speeds[i])
+        for i in order:
+            take = min(counts[i] - 1, -rem)
+            counts[i] -= take
+            rem += take
+            if rem == 0:
+                break
+        if rem != 0:
+            return None
+    else:
+        frac_order = sorted(
+            range(len(nodes)), key=lambda i: raw[i] - counts[i], reverse=True
+        )
+        idx = 0
+        while rem > 0 and idx < 4 * len(nodes):
+            i = frac_order[idx % len(nodes)]
+            if counts[i] < caps[i]:
+                counts[i] += 1
+                rem -= 1
+            idx += 1
+        if rem > 0:
+            return None
+    return counts
+
+
+def assign_ranges(nodes: list[Node], counts: list[int]) -> None:
+    start = 0
+    for node, c in zip(nodes, counts):
+        node.set_layers(start, start + c)
+        start += c
+
+
+class BaseLayerAllocator:
+    def __init__(self, num_layers: int):
+        self.num_layers = num_layers
+
+    def allocate(self, standby: list[Node]) -> list[Pipeline]:
+        raise NotImplementedError
+
+    # -- shared machinery -------------------------------------------------
+
+    def _build_pipeline(self, group: list[Node]) -> Pipeline | None:
+        # Faster nodes earlier in the chain slightly reduces TTFT (embedding
+        # + early layers see every chunk first).
+        group = sorted(group, key=lambda n: n.layer_latency_ms())
+        counts = water_fill_layers(group, self.num_layers)
+        if counts is None:
+            return None
+        assign_ranges(group, counts)
+        return Pipeline(nodes=group)
+
+    def should_global_rebalance(
+        self, active: list[Node], cv_threshold: float = 0.5
+    ) -> bool:
+        """Coefficient of variation of per-layer hosting power (reference
+        layer_allocation.py:226-276)."""
+        if not active:
+            return False
+        power = [0.0] * self.num_layers
+        for n in active:
+            if not n.has_allocation:
+                continue
+            p = 1.0 / max(1e-9, n.layer_latency_ms())
+            for layer in range(n.start_layer, min(n.end_layer, self.num_layers)):
+                power[layer] += p
+        if any(p == 0.0 for p in power):
+            return True  # uncovered layer: must rebalance
+        mean = statistics.fmean(power)
+        if mean == 0:
+            return True
+        cv = statistics.pstdev(power) / mean
+        return cv > cv_threshold
+
+
+class GreedyLayerAllocator(BaseLayerAllocator):
+    """Pack standby nodes into full pipelines, largest-capacity first, with
+    smallest-fit tail selection (reference layer_allocation.py:582-755)."""
+
+    def allocate(self, standby: list[Node]) -> list[Pipeline]:
+        pool = sorted(standby, key=lambda n: n.layer_capacity(), reverse=True)
+        pipelines: list[Pipeline] = []
+        while pool:
+            group: list[Node] = []
+            cap = 0
+            for n in list(pool):
+                if cap >= self.num_layers:
+                    break
+                group.append(n)
+                cap += n.layer_capacity()
+            if cap < self.num_layers:
+                break
+            # Smallest-fit tail: shrink the last slot to the smallest node
+            # that still completes the pipeline, keeping big nodes free.
+            deficit = self.num_layers - (cap - group[-1].layer_capacity())
+            best_tail = None
+            for n in pool:
+                if n in group[:-1]:
+                    continue
+                if n.layer_capacity() >= deficit:
+                    if (
+                        best_tail is None
+                        or n.layer_capacity() < best_tail.layer_capacity()
+                    ):
+                        best_tail = n
+            if best_tail is not None:
+                group[-1] = best_tail
+            pipe = self._build_pipeline(group)
+            if pipe is None:
+                break
+            pipelines.append(pipe)
+            for n in pipe.nodes:
+                pool.remove(n)
+        return pipelines
+
+
+class DPLayerAllocator(BaseLayerAllocator):
+    """Exact DP maximizing the number of full pipelines.
+
+    State: (node index, residual layers needed to close the open pipeline);
+    value: pipelines closed (tie-break: total spare capacity). The reference
+    solves a richer variant (layer_allocation.py:758-1015); this captures
+    the same objective for the fixed-pipeline serving mode.
+    """
+
+    def allocate(self, standby: list[Node]) -> list[Pipeline]:
+        nodes = sorted(standby, key=lambda n: n.layer_capacity(), reverse=True)
+        n = len(nodes)
+        L = self.num_layers
+        # dp[residual] = (pipelines_closed, assignment list) best at this point
+        # residual==0 means no open pipeline.
+        from functools import lru_cache
+
+        caps = [min(x.layer_capacity(), L) for x in nodes]
+
+        @lru_cache(maxsize=None)
+        def best(i: int, residual: int) -> tuple[int, tuple]:
+            if i == n:
+                return (0, ())
+            # Option 1: skip node i.
+            score_skip, plan_skip = best(i + 1, residual)
+            # Option 2: add node i to the open pipeline (or open one).
+            r = residual if residual > 0 else L
+            r2 = max(0, r - caps[i])
+            closed = 1 if r2 == 0 else 0
+            s, plan = best(i + 1, r2)
+            score_add = s + closed
+            if score_add > score_skip:
+                return (score_add, ((i, r2 == 0),) + plan)
+            return (score_skip, plan_skip)
+
+        _, plan = best(0, 0)
+        best.cache_clear()
+
+        pipelines: list[Pipeline] = []
+        group: list[Node] = []
+        for idx, closes in plan:
+            group.append(nodes[idx])
+            if closes:
+                pipe = self._build_pipeline(group)
+                if pipe is not None:
+                    pipelines.append(pipe)
+                group = []
+        return pipelines
+
+
+def try_dynamic_join(
+    allocator: BaseLayerAllocator, standby: list[Node]
+) -> list[Pipeline]:
+    """A node joined mid-serve: build new pipelines from standby if possible
+    (reference dynamic_join + extend, layer_allocation.py:193-214,
+    request_routing RR extend)."""
+    return allocator.allocate(standby)

@@ -1,0 +1,153 @@
+"""Per-node serving state and the roofline performance model.
+
+Capability parity: reference ``src/scheduling/node.py:24-427`` (Node,
+NodeHardwareInfo, RooflinePerformanceModel: per-layer latency =
+max(compute, IO) with embed/lm_head terms; KV-derived request capacity;
+measured-latency override; RTT cache).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from parallax_tpu.config import ModelConfig
+from parallax_tpu.utils.hw import HardwareInfo
+
+
+@dataclasses.dataclass
+class RooflinePerformanceModel:
+    """Estimates per-layer decode latency on a node from peak specs."""
+
+    hardware: HardwareInfo
+    model: ModelConfig
+
+    def layer_latency_ms(self, batch_size: int = 1, context_len: int = 1024) -> float:
+        flops = self.model.decoder_layer_flops(batch_size, context_len)
+        # Decode streams the layer's params + the batch's KV for this layer.
+        param_bytes = (
+            self.model.decoder_layer_params(0)
+            * self.model.param_bytes_per_element
+        )
+        kv_bytes = (
+            self.model.kv_bytes_per_token_per_layer() * context_len * batch_size
+        )
+        compute_s = flops / (self.hardware.total_tflops * 1e12)
+        io_s = (param_bytes + kv_bytes) / (
+            self.hardware.hbm_gbps * self.hardware.num_chips * 1e9
+        )
+        return max(compute_s, io_s) * 1e3
+
+    def lm_head_latency_ms(self, batch_size: int = 1) -> float:
+        flops = self.model.lm_head_flops(batch_size)
+        bytes_ = (
+            self.model.embedding_params() * self.model.param_bytes_per_element
+        )
+        return max(
+            flops / (self.hardware.total_tflops * 1e12),
+            bytes_ / (self.hardware.hbm_gbps * self.hardware.num_chips * 1e9),
+        ) * 1e3
+
+    def max_layers_in_memory(self, kv_fraction: float = 0.35) -> int:
+        """How many decoder layers fit in HBM, reserving a KV budget."""
+        usable = self.hardware.total_hbm_bytes * 0.92 * (1 - kv_fraction)
+        per_layer = (
+            self.model.decoder_layer_params(0)
+            * self.model.param_bytes_per_element
+        )
+        return max(1, int(usable // per_layer))
+
+
+@dataclasses.dataclass
+class Node:
+    """A swarm member as the global scheduler sees it."""
+
+    node_id: str
+    hardware: HardwareInfo
+    model: ModelConfig
+    start_layer: int = -1
+    end_layer: int = -1
+    # In-flight requests routed through this node.
+    load: int = 0
+    # Measured per-layer decode latency EWMA from heartbeats (overrides
+    # roofline when present; reference node.py:378-387).
+    measured_layer_latency_ms: float | None = None
+    # RTT cache to peers, node_id -> seconds.
+    rtt_s: dict[str, float] = dataclasses.field(default_factory=dict)
+    last_heartbeat: float = dataclasses.field(default_factory=time.monotonic)
+    # Weight-refit version currently loaded (elastic RL updates).
+    refit_version: int = 0
+    # True once the node reports its executor is serving.
+    is_ready: bool = False
+
+    def __post_init__(self):
+        self.perf = RooflinePerformanceModel(self.hardware, self.model)
+
+    # -- layers -----------------------------------------------------------
+
+    @property
+    def has_allocation(self) -> bool:
+        return 0 <= self.start_layer < self.end_layer
+
+    @property
+    def num_layers(self) -> int:
+        return max(0, self.end_layer - self.start_layer)
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.start_layer == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.end_layer == self.model.num_hidden_layers
+
+    def set_layers(self, start: int, end: int) -> None:
+        self.start_layer, self.end_layer = start, end
+
+    def clear_layers(self) -> None:
+        self.start_layer = self.end_layer = -1
+
+    # -- capacity ---------------------------------------------------------
+
+    def layer_capacity(self) -> int:
+        """Max decoder layers this node can host (HBM-bound)."""
+        cap = self.perf.max_layers_in_memory()
+        return min(cap, self.model.num_hidden_layers)
+
+    def max_concurrent_requests(self, avg_context: int = 2048) -> int:
+        """KV-budget-derived admission cap (reference node.py:212-246)."""
+        layers = self.num_layers or 1
+        kv_budget = self.hardware.total_hbm_bytes * 0.92 * 0.35
+        per_req = (
+            self.model.kv_bytes_per_token_per_layer() * avg_context * layers
+        )
+        return max(1, int(kv_budget // per_req))
+
+    # -- latency ----------------------------------------------------------
+
+    def layer_latency_ms(self, batch_size: int = 8) -> float:
+        base = (
+            self.measured_layer_latency_ms
+            if self.measured_layer_latency_ms is not None
+            else self.perf.layer_latency_ms(batch_size)
+        )
+        # Load compensation (reference: +0.05 * load fraction).
+        cap = self.max_concurrent_requests()
+        return base * (1.0 + 0.05 * min(1.0, self.load / cap))
+
+    def stage_latency_ms(self, batch_size: int = 8) -> float:
+        lat = self.num_layers * self.layer_latency_ms(batch_size)
+        if self.is_last_stage:
+            lat += self.perf.lm_head_latency_ms(batch_size)
+        return lat
+
+    def rtt_to(self, other_id: str) -> float:
+        return self.rtt_s.get(other_id, 0.03)
+
+    # -- liveness ---------------------------------------------------------
+
+    def touch(self) -> None:
+        self.last_heartbeat = time.monotonic()
+
+    def is_stale(self, timeout_s: float) -> bool:
+        return time.monotonic() - self.last_heartbeat > timeout_s

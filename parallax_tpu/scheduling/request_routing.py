@@ -1,0 +1,106 @@
+"""Phase-2 scheduling: route each request along a node path.
+
+Capability parity: reference ``src/scheduling/request_routing.py:180-853``
+— round-robin over fixed registered pipelines (with readiness and
+refit-version skipping) and shortest-latency dynamic-programming routing
+over whatever layer ranges the active nodes currently announce.
+"""
+
+from __future__ import annotations
+
+from parallax_tpu.scheduling.node import Node
+from parallax_tpu.scheduling.node_management import NodeManager, Pipeline
+
+
+class RoutingStrategy:
+    def __init__(self, manager: NodeManager):
+        self.manager = manager
+
+    def find_path(self) -> list[Node] | None:
+        raise NotImplementedError
+
+    def on_dispatch(self, path: list[Node]) -> None:
+        for n in path:
+            n.load += 1
+
+    def on_complete(self, path_ids: list[str]) -> None:
+        for nid in path_ids:
+            n = self.manager.get(nid)
+            if n is not None:
+                n.load = max(0, n.load - 1)
+
+
+class RoundRobinRouting(RoutingStrategy):
+    """RR cursor over registered node-disjoint pipelines (reference
+    request_routing.py:589-680,797-852)."""
+
+    def __init__(self, manager: NodeManager):
+        super().__init__(manager)
+        self._cursor = 0
+
+    def find_path(self) -> list[Node] | None:
+        pipelines = self.manager.pipelines
+        if not pipelines:
+            return None
+        latest_refit = max(p.min_refit_version() for p in pipelines)
+        for off in range(len(pipelines)):
+            p = pipelines[(self._cursor + off) % len(pipelines)]
+            if not p.is_ready():
+                continue
+            if p.min_refit_version() < latest_refit:
+                continue  # stale weights: skip until refit completes
+            if any(
+                n.load >= n.max_concurrent_requests() for n in p.nodes
+            ):
+                continue
+            self._cursor = (self._cursor + off + 1) % len(pipelines)
+            return p.nodes
+        return None
+
+
+class DPRouting(RoutingStrategy):
+    """Shortest-latency path over announced layer ranges (reference
+    request_routing.py:286-426): dp over layer boundaries, cost = stage
+    latency + inter-hop RTT + load compensation."""
+
+    def find_path(self) -> list[Node] | None:
+        nodes = [n for n in self.manager.nodes() if n.has_allocation and n.is_ready]
+        if not nodes:
+            return None
+        num_layers = self.manager.num_layers
+        by_start: dict[int, list[Node]] = {}
+        for n in nodes:
+            by_start.setdefault(n.start_layer, []).append(n)
+
+        INF = float("inf")
+        memo: dict[tuple[int, str | None], tuple[float, list[Node]]] = {}
+
+        def best(boundary: int, prev: Node | None) -> tuple[float, list[Node]]:
+            if boundary == num_layers:
+                return 0.0, []
+            key = (boundary, prev.node_id if prev else None)
+            if key in memo:
+                return memo[key]
+            result = (INF, [])
+            for cand in by_start.get(boundary, []):
+                if cand.load >= cand.max_concurrent_requests():
+                    continue
+                cost = cand.stage_latency_ms()
+                if prev is not None:
+                    cost += prev.rtt_to(cand.node_id) * 1e3
+                tail_cost, tail = best(cand.end_layer, cand)
+                if cost + tail_cost < result[0]:
+                    result = (cost + tail_cost, [cand] + tail)
+            memo[key] = result
+            return result
+
+        cost, path = best(0, None)
+        return path if cost < INF else None
+
+
+def make_router(name: str, manager: NodeManager) -> RoutingStrategy:
+    if name in ("rr", "round_robin"):
+        return RoundRobinRouting(manager)
+    if name in ("dp", "dynamic"):
+        return DPRouting(manager)
+    raise ValueError(f"unknown routing strategy {name!r}")

@@ -1,0 +1,292 @@
+"""The global scheduler orchestrator.
+
+Capability parity: reference ``src/scheduling/scheduler.py:29-649`` — event
+queues for join/leave/update, bootstrap gating on a minimum node count,
+heartbeat timeout sweeping, request dispatch, and serialized global
+rebalance on topology changes.
+
+Threading model mirrors the reference: one event thread owns all topology
+mutations; a dispatch thread assigns routing tables; callers only enqueue.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Callable
+
+from parallax_tpu.config import ModelConfig
+from parallax_tpu.scheduling.layer_allocation import (
+    BaseLayerAllocator,
+    DPLayerAllocator,
+    GreedyLayerAllocator,
+)
+from parallax_tpu.scheduling.node import Node
+from parallax_tpu.scheduling.node_management import NodeManager, NodeState, Pipeline
+from parallax_tpu.scheduling.request_routing import RoutingStrategy, make_router
+from parallax_tpu.utils import get_logger
+from parallax_tpu.utils.hw import HardwareInfo
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class PendingRequest:
+    request_id: str
+    enqueue_time: float = dataclasses.field(default_factory=time.monotonic)
+    # The dispatcher retries routing until this deadline before giving up
+    # (reference RequestHandler retry ladder, request_handler.py:100-245).
+    deadline: float = dataclasses.field(
+        default_factory=lambda: time.monotonic() + 10.0
+    )
+    # Filled by the dispatcher.
+    path_ids: list[str] | None = None
+    event: threading.Event = dataclasses.field(default_factory=threading.Event)
+
+
+class GlobalScheduler:
+    """Assigns layers to nodes and node paths to requests."""
+
+    def __init__(
+        self,
+        model: ModelConfig,
+        min_nodes_bootstrapping: int = 1,
+        allocator: str = "greedy",
+        routing: str = "rr",
+        heartbeat_timeout_s: float = 30.0,
+    ):
+        self.model = model
+        self.min_nodes = min_nodes_bootstrapping
+        self.manager = NodeManager(model.num_hidden_layers)
+        alloc_cls: type[BaseLayerAllocator] = (
+            GreedyLayerAllocator if allocator == "greedy" else DPLayerAllocator
+        )
+        self.allocator = alloc_cls(model.num_hidden_layers)
+        self.router: RoutingStrategy = make_router(routing, self.manager)
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.bootstrapped = threading.Event()
+
+        self._events: queue.Queue = queue.Queue()
+        self._requests: queue.Queue[PendingRequest] = queue.Queue()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        # node_id -> callback payload for the next heartbeat reply
+        # (layer reallocations are piggybacked on heartbeats, reference
+        # p2p/server.py announcer).
+        self._lock = threading.RLock()
+        self.refit_version = 0
+        self.refit_index: dict[str, str] = {}
+
+    # -- public API (thread-safe enqueues) --------------------------------
+
+    def enqueue_join(self, node_id: str, hardware: HardwareInfo) -> None:
+        self._events.put(("join", node_id, hardware))
+
+    def enqueue_leave(self, node_id: str) -> None:
+        self._events.put(("leave", node_id))
+
+    def enqueue_update(
+        self,
+        node_id: str,
+        layer_latency_ms: float | None = None,
+        load: int | None = None,
+        rtt_s: dict | None = None,
+        is_ready: bool | None = None,
+        refit_version: int | None = None,
+    ) -> None:
+        self._events.put(
+            ("update", node_id, layer_latency_ms, load, rtt_s, is_ready,
+             refit_version)
+        )
+
+    def receive_request(self, request_id: str) -> PendingRequest:
+        pr = PendingRequest(request_id)
+        self._requests.put(pr)
+        return pr
+
+    def get_node_allocation(self, node_id: str) -> dict | None:
+        """The worker's view of its assignment (heartbeat reply payload)."""
+        node = self.manager.get(node_id)
+        if node is None or not node.has_allocation:
+            return None
+        return {
+            "start_layer": node.start_layer,
+            "end_layer": node.end_layer,
+            "model_name": self.model.model_name,
+            "refit_version": self.refit_version,
+        }
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        for fn in (self._event_loop, self._dispatch_loop):
+            t = threading.Thread(target=fn, daemon=True, name=fn.__name__)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    # -- event loop (single thread owns topology) -------------------------
+
+    def _event_loop(self) -> None:
+        last_sweep = time.monotonic()
+        while not self._stop.is_set():
+            try:
+                ev = self._events.get(timeout=0.05)
+            except queue.Empty:
+                ev = None
+            if ev is not None:
+                self._handle_event(ev)
+            now = time.monotonic()
+            if now - last_sweep > 1.0:
+                self._sweep_heartbeats()
+                last_sweep = now
+
+    def _handle_event(self, ev: tuple) -> None:
+        kind = ev[0]
+        if kind == "join":
+            _, node_id, hardware = ev
+            node = Node(node_id=node_id, hardware=hardware, model=self.model)
+            self.manager.add(node)
+            logger.info("node %s joined (%s x%d)", node_id,
+                        hardware.device_kind, hardware.num_chips)
+            self._try_bootstrap_or_extend()
+        elif kind == "leave":
+            self._handle_leave(ev[1])
+        elif kind == "update":
+            _, node_id, lat, load, rtt, ready, refit = ev
+            node = self.manager.get(node_id)
+            if node is None:
+                return
+            node.touch()
+            if lat is not None:
+                node.measured_layer_latency_ms = lat
+            if load is not None:
+                node.load = load
+            if rtt:
+                node.rtt_s.update(rtt)
+            if ready is not None:
+                node.is_ready = ready
+            if refit is not None:
+                node.refit_version = refit
+
+    def _try_bootstrap_or_extend(self) -> None:
+        standby = self.manager.nodes(NodeState.STANDBY)
+        if not self.bootstrapped.is_set():
+            if len(self.manager) < self.min_nodes:
+                return
+            pipelines = self.allocator.allocate(standby)
+            if not pipelines:
+                return
+            self.manager.register_pipelines(pipelines)
+            self.bootstrapped.set()
+            self._log_allocation("bootstrap")
+        else:
+            # Serving already: extend with new pipelines when standby nodes
+            # suffice (reference RR extend path).
+            pipelines = self.allocator.allocate(standby)
+            if pipelines:
+                self.manager.register_pipelines(pipelines)
+                self._log_allocation("extend")
+
+    def _handle_leave(self, node_id: str) -> None:
+        displaced = self.manager.remove(node_id)
+        logger.info("node %s left; %d displaced", node_id, len(displaced))
+        active = [n for n in self.manager.nodes(NodeState.ACTIVE)]
+        if not self.manager.pipelines or self.allocator.should_global_rebalance(
+            active
+        ):
+            self._global_rebalance()
+        else:
+            self._try_bootstrap_or_extend()
+
+    def _global_rebalance(self) -> None:
+        """Tear everything down and re-allocate from scratch (reference
+        scheduler.py:581-636). Workers detect new ranges via heartbeat
+        replies and reload."""
+        logger.info("global rebalance")
+        self.manager.standby_all()
+        self.bootstrapped.clear()
+        self._try_bootstrap_or_extend()
+
+    def _sweep_heartbeats(self) -> None:
+        for node in self.manager.nodes():
+            # Standby nodes may legitimately sit in a long blocking join;
+            # give them a much longer leash before eviction.
+            factor = 1.0 if node.has_allocation else 10.0
+            if node.is_stale(self.heartbeat_timeout_s * factor):
+                logger.warning("heartbeat timeout: %s", node.node_id)
+                self._handle_leave(node.node_id)
+
+    # -- dispatch loop ----------------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                pr = self._requests.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            path = self.router.find_path()
+            if path is not None:
+                self.router.on_dispatch(path)
+                pr.path_ids = [n.node_id for n in path]
+                pr.event.set()
+            elif time.monotonic() < pr.deadline:
+                # No serviceable pipeline right now (bootstrap in flight,
+                # all busy, refit) — retry until the deadline.
+                self._requests.put(pr)
+                time.sleep(0.02)
+            else:
+                pr.event.set()
+
+    def complete_request(self, path_ids: list[str]) -> None:
+        self.router.on_complete(path_ids)
+
+    # -- weight refit ------------------------------------------------------
+
+    def begin_refit(self, index_map: dict[str, str]) -> int:
+        """Register a new weight version (name -> content id); nodes pick it
+        up from heartbeat replies (reference backend/main.py:42-73)."""
+        with self._lock:
+            self.refit_version += 1
+            self.refit_index = dict(index_map)
+            return self.refit_version
+
+    # -- introspection ----------------------------------------------------
+
+    def cluster_status(self) -> dict:
+        report = self.manager.capacity_report()
+        report["bootstrapped"] = self.bootstrapped.is_set()
+        report["pipelines"] = [
+            {
+                "id": p.pipeline_id,
+                "nodes": [
+                    {
+                        "node_id": n.node_id,
+                        "layers": [n.start_layer, n.end_layer],
+                        "load": n.load,
+                        "ready": n.is_ready,
+                    }
+                    for n in p.nodes
+                ],
+            }
+            for p in self.manager.pipelines
+        ]
+        return report
+
+    def _log_allocation(self, event: str) -> None:
+        for p in self.manager.pipelines:
+            logger.info(
+                "%s: pipeline %d = %s",
+                event,
+                p.pipeline_id,
+                " -> ".join(
+                    f"{n.node_id}[{n.start_layer},{n.end_layer})"
+                    for n in p.nodes
+                ),
+            )

@@ -1,0 +1,245 @@
+"""Global scheduling tests with a synthetic swarm (capability parity:
+reference tests/scheduler_tests/* — fake-hardware fixtures, allocation,
+routing, bootstrap/dispatch, elastic leave/rebalance)."""
+
+import time
+
+import pytest
+
+from parallax_tpu.config import normalize_config
+from parallax_tpu.scheduling import GlobalScheduler, NodeManager, NodeState, Pipeline
+from parallax_tpu.scheduling.layer_allocation import (
+    DPLayerAllocator,
+    GreedyLayerAllocator,
+    water_fill_layers,
+)
+from parallax_tpu.scheduling.node import Node
+from parallax_tpu.scheduling.request_routing import DPRouting, RoundRobinRouting
+from parallax_tpu.utils.hw import HardwareInfo
+
+MODEL = normalize_config(dict(
+    architectures=["Qwen2ForCausalLM"],
+    hidden_size=3584, num_hidden_layers=28, num_attention_heads=28,
+    num_key_value_heads=4, intermediate_size=18944, vocab_size=152064,
+))
+
+V5E_HOST = HardwareInfo("v5e", 4, 197.0, 16.0, 819.0, 186.0)   # 64 GiB host
+V5E_SMALL = HardwareInfo("v5e", 1, 197.0, 16.0, 819.0, 186.0)  # 16 GiB chip
+V5P_HOST = HardwareInfo("v5p", 4, 459.0, 95.0, 2765.0, 200.0)
+
+
+def make_node(nid, hw=V5E_HOST, ready=True):
+    n = Node(node_id=nid, hardware=hw, model=MODEL)
+    n.is_ready = ready
+    return n
+
+
+class TestWaterFill:
+    def test_proportional_split(self):
+        fast = make_node("fast", V5P_HOST)
+        slow = make_node("slow", V5E_HOST)
+        counts = water_fill_layers([fast, slow], 28)
+        assert sum(counts) == 28
+        assert counts[0] > counts[1]  # faster node hosts more layers
+
+    def test_respects_capacity_cap(self):
+        tiny = make_node("tiny", V5E_SMALL)
+        big = make_node("big", V5P_HOST)
+        counts = water_fill_layers([tiny, big], 28)
+        assert sum(counts) == 28
+        assert counts[0] <= tiny.layer_capacity()
+
+    def test_infeasible_returns_none(self):
+        tiny = make_node("t", V5E_SMALL)
+        assert water_fill_layers([tiny, tiny], 10**6) is None
+
+
+class TestAllocators:
+    @pytest.mark.parametrize("cls", [GreedyLayerAllocator, DPLayerAllocator])
+    def test_two_pipelines_from_four_hosts(self, cls):
+        # Single 16 GiB chips: ~20-layer capacity each => 2 chips/pipeline.
+        nodes = [make_node(f"n{i}", V5E_SMALL) for i in range(4)]
+        pipelines = cls(28).allocate(nodes)
+        assert len(pipelines) == 2
+        used = set()
+        for p in pipelines:
+            p.validate(28)
+            for n in p.nodes:
+                assert n.node_id not in used
+                used.add(n.node_id)
+
+    @pytest.mark.parametrize("cls", [GreedyLayerAllocator, DPLayerAllocator])
+    def test_insufficient_capacity_no_pipeline(self, cls):
+        # One small chip cannot host a 7B-class model alone.
+        assert cls(28).allocate([make_node("solo", V5E_SMALL)]) == []
+
+    def test_dp_beats_greedy_on_adversarial_mix(self):
+        # DP should never produce fewer pipelines than greedy.
+        nodes = [make_node(f"s{i}", V5E_SMALL) for i in range(6)]
+        g = GreedyLayerAllocator(28).allocate([*nodes])
+        for n in nodes:
+            n.clear_layers()
+        d = DPLayerAllocator(28).allocate([*nodes])
+        assert len(d) >= len(g)
+
+    def test_rebalance_trigger_on_uncovered_layer(self):
+        alloc = GreedyLayerAllocator(28)
+        n1 = make_node("a")
+        n1.set_layers(0, 14)  # layers 14..28 uncovered
+        assert alloc.should_global_rebalance([n1])
+
+
+def build_registered_manager(num_pipes=2):
+    mgr = NodeManager(28)
+    pipes = []
+    for i in range(num_pipes):
+        a, b = make_node(f"p{i}a"), make_node(f"p{i}b")
+        a.set_layers(0, 14)
+        b.set_layers(14, 28)
+        mgr.add(a)
+        mgr.add(b)
+        pipes.append(Pipeline(nodes=[a, b]))
+    mgr.register_pipelines(pipes)
+    return mgr
+
+
+class TestRouting:
+    def test_round_robin_cycles(self):
+        mgr = build_registered_manager(2)
+        rr = RoundRobinRouting(mgr)
+        first = rr.find_path()
+        second = rr.find_path()
+        third = rr.find_path()
+        assert first[0].node_id != second[0].node_id
+        assert third[0].node_id == first[0].node_id
+
+    def test_round_robin_skips_not_ready(self):
+        mgr = build_registered_manager(2)
+        mgr.pipelines[0].nodes[0].is_ready = False
+        rr = RoundRobinRouting(mgr)
+        for _ in range(4):
+            path = rr.find_path()
+            assert path[0].node_id.startswith("p1")
+
+    def test_round_robin_skips_stale_refit(self):
+        mgr = build_registered_manager(2)
+        for n in mgr.pipelines[1].nodes:
+            n.refit_version = 2
+        rr = RoundRobinRouting(mgr)
+        for _ in range(3):
+            assert rr.find_path()[0].node_id.startswith("p1")
+
+    def test_dp_routing_picks_fastest_chain(self):
+        mgr = NodeManager(28)
+        slow_a, slow_b = make_node("slow_a"), make_node("slow_b")
+        fast_a, fast_b = make_node("fast_a", V5P_HOST), make_node("fast_b", V5P_HOST)
+        for n, (s, e) in zip(
+            [slow_a, slow_b, fast_a, fast_b], [(0, 14), (14, 28)] * 2
+        ):
+            n.set_layers(s, e)
+            mgr.add(n)
+        path = DPRouting(mgr).find_path()
+        assert [n.node_id for n in path] == ["fast_a", "fast_b"]
+
+    def test_dp_routing_none_when_uncovered(self):
+        mgr = NodeManager(28)
+        a = make_node("a")
+        a.set_layers(0, 14)
+        mgr.add(a)
+        assert DPRouting(mgr).find_path() is None
+
+    def test_load_accounting(self):
+        mgr = build_registered_manager(1)
+        rr = RoundRobinRouting(mgr)
+        path = rr.find_path()
+        rr.on_dispatch(path)
+        assert all(n.load == 1 for n in path)
+        rr.on_complete([n.node_id for n in path])
+        assert all(n.load == 0 for n in path)
+
+
+class TestNodeManager:
+    def test_leave_detaches_pipeline_to_standby(self):
+        mgr = build_registered_manager(2)
+        displaced = mgr.remove("p0a")
+        assert [n.node_id for n in displaced] == ["p0b"]
+        assert mgr.state_of("p0b") == NodeState.STANDBY
+        assert len(mgr.pipelines) == 1
+        assert not displaced[0].has_allocation
+
+    def test_pipeline_validation_rejects_gap(self):
+        a, b = make_node("a"), make_node("b")
+        a.set_layers(0, 10)
+        b.set_layers(12, 28)
+        with pytest.raises(ValueError, match="gap"):
+            Pipeline(nodes=[a, b]).validate(28)
+
+
+class TestGlobalScheduler:
+    def wait_for(self, cond, timeout=5.0):
+        end = time.monotonic() + timeout
+        while time.monotonic() < end:
+            if cond():
+                return True
+            time.sleep(0.01)
+        return False
+
+    def test_bootstrap_and_dispatch(self):
+        sched = GlobalScheduler(MODEL, min_nodes_bootstrapping=2)
+        sched.start()
+        try:
+            sched.enqueue_join("n0", V5E_SMALL)
+            sched.enqueue_join("n1", V5E_SMALL)
+            assert self.wait_for(sched.bootstrapped.is_set)
+            for nid in ("n0", "n1"):
+                sched.enqueue_update(nid, is_ready=True)
+                alloc = None
+                assert self.wait_for(
+                    lambda: sched.get_node_allocation(nid) is not None
+                )
+            pr = sched.receive_request("req1")
+            assert pr.event.wait(5.0)
+            assert pr.path_ids is not None and len(pr.path_ids) == 2
+            status = sched.cluster_status()
+            assert status["num_pipelines"] == 1
+            sched.complete_request(pr.path_ids)
+        finally:
+            sched.stop()
+
+    def test_leave_triggers_rebalance_and_recovery(self):
+        sched = GlobalScheduler(MODEL, min_nodes_bootstrapping=2)
+        sched.start()
+        try:
+            for i in range(3):
+                sched.enqueue_join(f"n{i}", V5E_SMALL)
+            assert self.wait_for(sched.bootstrapped.is_set)
+            sched.enqueue_leave("n0")
+            # Remaining 2 nodes must re-form a pipeline.
+            assert self.wait_for(
+                lambda: sched.manager.pipelines
+                and all(
+                    "n0" not in p.node_ids for p in sched.manager.pipelines
+                )
+            )
+        finally:
+            sched.stop()
+
+    def test_heartbeat_timeout_evicts(self):
+        sched = GlobalScheduler(
+            MODEL, min_nodes_bootstrapping=2, heartbeat_timeout_s=0.2
+        )
+        sched.start()
+        try:
+            sched.enqueue_join("n0", V5E_SMALL)
+            sched.enqueue_join("n1", V5E_SMALL)
+            assert self.wait_for(sched.bootstrapped.is_set)
+            # n1 stops heartbeating; keep n0 alive.
+            assert self.wait_for(
+                lambda: (
+                    sched.enqueue_update("n0", is_ready=True) or
+                    sched.manager.get("n1") is None
+                ),
+                timeout=5.0,
+            )
+        finally:
+            sched.stop()

@@ -1,0 +1,170 @@
+"""Swarm integration test: scheduler + workers over real TCP sockets.
+
+This closes the coverage gap SURVEY.md section 4 calls out in the
+reference ("nothing tests the real P2P path in CI"): a GlobalScheduler
+service and two WorkerNodes run in one process but communicate only
+through length-prefixed msgpack frames over localhost TCP — join,
+allocation, heartbeats, pp-forward, ring closure, release broadcast.
+"""
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from parallax_tpu.backend.scheduler_service import SchedulerService
+from parallax_tpu.config import normalize_config
+from parallax_tpu.models.base import StageModel
+from parallax_tpu.p2p.node import WorkerNode
+from parallax_tpu.p2p.transport import TcpTransport
+from parallax_tpu.runtime.engine import EngineConfig, StageEngine
+from parallax_tpu.runtime.pipeline import InProcessPipeline
+from parallax_tpu.runtime.request import Request, SamplingParams
+from parallax_tpu.scheduling.scheduler import GlobalScheduler
+from parallax_tpu.utils.hw import HardwareInfo
+
+TINY = normalize_config(dict(
+    architectures=["Qwen2ForCausalLM"],
+    hidden_size=64, num_hidden_layers=4, num_attention_heads=4,
+    num_key_value_heads=2, intermediate_size=128, vocab_size=151,
+    max_position_embeddings=256,
+))
+
+ENGINE_CFG = EngineConfig(
+    page_size=8, num_pages=64, max_model_len=128, kv_dtype="float32",
+    max_num_tokens_per_batch=128, max_batch_size=8,
+)
+
+
+def stage_params(model: StageModel):
+    return model.init_params(
+        jax.random.key(model.start_layer * 1000 + model.end_layer),
+        dtype=jnp.float32,
+    )
+
+
+@pytest.fixture
+def swarm(monkeypatch):
+    """Scheduler service + 2 workers over TCP localhost."""
+    # Each worker must look like a 1-chip host that can hold ~half the
+    # (tiny) model, so the allocator builds one 2-stage pipeline. Capacity
+    # for the tiny model is huge on any hardware; force a 2-way split by
+    # capping layer capacity.
+    from parallax_tpu.scheduling import node as node_mod
+
+    monkeypatch.setattr(
+        node_mod.RooflinePerformanceModel, "max_layers_in_memory",
+        lambda self, kv_fraction=0.35: 2,
+    )
+
+    sched = GlobalScheduler(TINY, min_nodes_bootstrapping=2)
+    sched_transport = TcpTransport("scheduler", "127.0.0.1")
+    service = SchedulerService(sched, sched_transport, join_timeout_s=30.0)
+    service.start()
+    sched_addr = sched_transport.address
+
+    workers = []
+    for _ in range(2):
+        t = TcpTransport("", "127.0.0.1")
+        # node id must equal the dial address: start server first.
+        t.start()
+        t.peer_id = t.address
+        w = WorkerNode(
+            transport=t,
+            scheduler_peer=sched_addr,
+            model_config=TINY,
+            engine_config=ENGINE_CFG,
+            load_params=stage_params,
+            heartbeat_interval_s=0.2,
+        )
+        workers.append(w)
+
+    import threading
+
+    starters = [threading.Thread(target=w.start) for w in workers]
+    for s in starters:
+        s.start()
+    for s in starters:
+        s.join(timeout=60.0)
+
+    yield service, workers
+    for w in workers:
+        w.stop()
+    service.stop()
+
+
+def wait_ready(service, n, timeout=10.0):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        status = service.scheduler.cluster_status()
+        if status["num_pipelines"] >= 1 and all(
+            node["ready"]
+            for p in status["pipelines"] for node in p["nodes"]
+        ):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_swarm_serves_request_over_tcp(swarm):
+    service, workers = swarm
+    assert wait_ready(service, 2), service.scheduler.cluster_status()
+
+    path = service.route_request("req-1", timeout_s=10.0)
+    assert path is not None and len(path) == 2
+
+    head = next(w for w in workers if w.node_id == path[0])
+    req = Request(
+        request_id="req-1",
+        prompt_ids=[1, 2, 3, 4, 5, 6, 7],
+        sampling_params=SamplingParams(temperature=0.0, max_new_tokens=6),
+        routing_table=list(path),
+    )
+    done = head.submit(req)
+    assert done.wait(30.0), f"request did not finish: {req.status}"
+    assert len(req.output_ids) == 6
+
+    # Cross-check against the same stages chained in-process.
+    bounds = [(w.start_layer, w.end_layer) for w in workers
+              if w.node_id in path]
+    bounds.sort()
+    engines = []
+    for s, e in bounds:
+        m = StageModel(TINY, s, e, use_pallas=False)
+        engines.append(StageEngine(m, stage_params(m), ENGINE_CFG))
+    pipe = InProcessPipeline(engines)
+    ref = Request(
+        request_id="ref", prompt_ids=[1, 2, 3, 4, 5, 6, 7],
+        sampling_params=SamplingParams(temperature=0.0, max_new_tokens=6),
+    )
+    pipe.submit(ref)
+    pipe.run_until_complete()
+    assert req.output_ids == ref.output_ids
+
+    # Release broadcast freed every stage's pages back to steady state.
+    for w in workers:
+        assert w.engine.scheduler.num_requests() == 0
+
+
+def test_swarm_handles_concurrent_requests(swarm):
+    service, workers = swarm
+    assert wait_ready(service, 2)
+    events = []
+    reqs = []
+    for i in range(4):
+        path = service.route_request(f"c{i}", timeout_s=10.0)
+        assert path
+        head = next(w for w in workers if w.node_id == path[0])
+        req = Request(
+            request_id=f"c{i}",
+            prompt_ids=[10 + i, 20 + i, 30 + i],
+            sampling_params=SamplingParams(temperature=0.0, max_new_tokens=4),
+            routing_table=list(path),
+        )
+        reqs.append(req)
+        events.append(head.submit(req))
+    for ev, req in zip(events, reqs):
+        assert ev.wait(30.0), f"{req.request_id} stuck: {req.status}"
+        assert len(req.output_ids) == 4

@@ -33,9 +33,8 @@ def run_engine(tp_size, prompts, n_new=6):
     config = normalize_config(TINY)
     mesh = make_mesh(tp_size=tp_size) if tp_size > 1 else None
     model = StageModel(config, 0, 2, use_pallas=False, tp_size=tp_size)
-    # Same global weights regardless of tp.
-    ref_model = StageModel(config, 0, 2, use_pallas=False)
-    params = ref_model.init_params(jax.random.key(7), dtype=jnp.float32)
+    # init_params builds global (unsharded) shapes from config alone.
+    params = model.init_params(jax.random.key(7), dtype=jnp.float32)
     eng = StageEngine(
         model,
         params,
@@ -67,3 +66,61 @@ def test_tp_requires_divisible_heads():
     config = normalize_config(dict(TINY, num_key_value_heads=3))
     with pytest.raises(ValueError, match="not divisible"):
         StageModel(config, 0, 2, tp_size=2)
+
+
+def test_tp_row_parallel_bias_added_once():
+    """o_proj/down_proj biases must be added after the psum, not per-shard."""
+    if len(jax.devices()) < 2:
+        pytest.skip("not enough virtual devices")
+    config = normalize_config(TINY)
+    prompts = [[1, 2, 3, 4]]
+
+    def run(tp_size):
+        model = StageModel(config, 0, 2, use_pallas=False, tp_size=tp_size)
+        params = model.init_params(jax.random.key(3), dtype=jnp.float32)
+        for lp in params["layers"]:
+            h = config.hidden_size
+            lp["self_attn"]["o_proj"]["bias"] = (
+                jnp.arange(h, dtype=jnp.float32) * 0.01
+            )
+            lp["mlp"]["down_proj"]["bias"] = (
+                jnp.arange(h, dtype=jnp.float32) * -0.02
+            )
+        mesh = make_mesh(tp_size=tp_size) if tp_size > 1 else None
+        eng = StageEngine(
+            model, params,
+            EngineConfig(page_size=8, num_pages=64, max_model_len=128,
+                         kv_dtype="float32"),
+            mesh=mesh,
+        )
+        pipe = InProcessPipeline([eng])
+        pipe.submit(Request(
+            "r", prompt_ids=list(prompts[0]),
+            sampling_params=SamplingParams(temperature=0.0, max_new_tokens=5),
+        ))
+        pipe.run_until_complete()
+        return pipe.finished[0].output_ids
+
+    assert run(2) == run(1)
+
+
+def test_tied_embeddings_split_pipeline():
+    """A tied-embedding model split across stages must still serve: the last
+    stage needs the embedding matrix as its lm_head."""
+    config = normalize_config(dict(TINY, tie_word_embeddings=True))
+    engines = []
+    for s, e in [(0, 1), (1, 2)]:
+        m = StageModel(config, s, e, use_pallas=False)
+        engines.append(StageEngine(
+            m, m.init_params(jax.random.key(5), dtype=jnp.float32),
+            EngineConfig(page_size=8, num_pages=64, max_model_len=128,
+                         kv_dtype="float32"),
+        ))
+    pipe = InProcessPipeline(engines)
+    req = Request(
+        "r", prompt_ids=[5, 6, 7],
+        sampling_params=SamplingParams(temperature=0.0, max_new_tokens=4),
+    )
+    pipe.submit(req)
+    pipe.run_until_complete()
+    assert len(req.output_ids) == 4
