@@ -1,0 +1,362 @@
+"""OpenAI-compatible HTTP frontend + cluster control endpoints.
+
+Capability parity: reference ``src/backend/main.py:26-277`` —
+``/v1/chat/completions`` (streaming SSE + non-stream), ``/v1/models``,
+``/v1/completions``, ``/scheduler/init`` (model switch), ``/cluster/status``
+(ndjson stream) + ``/cluster/status_json``, ``/weight/refit`` — and the
+RequestHandler retry ladder (``src/backend/server/request_handler.py:24-248``:
+no-route -> 503, empty-route retries -> 429, forward retry, SSE
+passthrough, TPS/TTFT accounting).
+
+Built on aiohttp (FastAPI is not in the image). Tokenization uses a HF
+tokenizer when a model path is available, else a whitespace/byte fallback
+so synthetic deployments still serve.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+
+from aiohttp import web
+
+from parallax_tpu.runtime.request import Request, SamplingParams
+from parallax_tpu.utils import get_logger
+
+logger = get_logger(__name__)
+
+
+class SimpleTokenizer:
+    """Byte-level fallback tokenizer for checkpoints without tokenizer files."""
+
+    vocab_size = 256 + 2
+    bos_id = 256
+    eos_id = 257
+
+    def encode(self, text: str) -> list[int]:
+        if not text:
+            return []
+        return [self.bos_id] + list(text.encode("utf-8"))
+
+    def decode(self, ids) -> str:
+        return bytes(i for i in ids if i < 256).decode("utf-8", errors="replace")
+
+    @property
+    def eos_token_ids(self):
+        return (self.eos_id,)
+
+    def apply_chat_template(self, messages) -> str:
+        return "\n".join(f"{m['role']}: {m['content']}" for m in messages) + "\nassistant:"
+
+
+def load_tokenizer(model_path: str | None):
+    if model_path:
+        try:
+            from transformers import AutoTokenizer
+
+            tok = AutoTokenizer.from_pretrained(model_path)
+
+            class _HF:
+                vocab_size = tok.vocab_size
+
+                def encode(self, text):
+                    return tok.encode(text)
+
+                def decode(self, ids):
+                    return tok.decode(ids, skip_special_tokens=True)
+
+                @property
+                def eos_token_ids(self):
+                    return (tok.eos_token_id,) if tok.eos_token_id else ()
+
+                def apply_chat_template(self, messages):
+                    return tok.apply_chat_template(
+                        messages, tokenize=False, add_generation_prompt=True
+                    )
+
+            return _HF()
+        except Exception as e:
+            logger.warning("tokenizer load failed (%s); using byte fallback", e)
+    return SimpleTokenizer()
+
+
+def _sampling_from_body(body: dict, default_max: int = 512) -> SamplingParams:
+    return SamplingParams(
+        temperature=float(body.get("temperature", 1.0)),
+        top_p=float(body.get("top_p", 1.0)),
+        top_k=int(body.get("top_k", -1)),
+        min_p=float(body.get("min_p", 0.0)),
+        presence_penalty=float(body.get("presence_penalty", 0.0)),
+        frequency_penalty=float(body.get("frequency_penalty", 0.0)),
+        max_new_tokens=int(
+            body.get("max_tokens")
+            or body.get("max_completion_tokens")
+            or default_max
+        ),
+        stop_strings=tuple(
+            [body["stop"]] if isinstance(body.get("stop"), str)
+            else body.get("stop") or ()
+        ),
+        ignore_eos=bool(body.get("ignore_eos", False)),
+        seed=body.get("seed"),
+    )
+
+
+class OpenAIFrontend:
+    """HTTP app serving one swarm (or one local engine pipeline).
+
+    The ``submit_fn(request) -> threading.Event`` and ``route_fn(rid) ->
+    list[str] | None`` callables abstract over local pipelines and the
+    networked swarm, so the same frontend runs on the scheduler host and in
+    single-node mode (reference node_chat_http_server.py does the same via
+    RPC stubs).
+    """
+
+    def __init__(
+        self,
+        tokenizer,
+        submit_fn,
+        route_fn=None,
+        status_fn=None,
+        model_name: str = "parallax-tpu",
+        stream_poll_s: float = 0.02,
+        refit_fn=None,
+    ):
+        self.tokenizer = tokenizer
+        self.submit_fn = submit_fn
+        self.route_fn = route_fn
+        self.status_fn = status_fn
+        self.refit_fn = refit_fn
+        self.model_name = model_name
+        self.stream_poll_s = stream_poll_s
+        self.app = web.Application(client_max_size=64 * 1024 * 1024)
+        self.app.add_routes([
+            web.post("/v1/chat/completions", self.chat_completions),
+            web.post("/v1/completions", self.completions),
+            web.get("/v1/models", self.models),
+            web.get("/health", self.health),
+            web.get("/cluster/status", self.cluster_status_stream),
+            web.get("/cluster/status_json", self.cluster_status_json),
+            web.post("/weight/refit", self.weight_refit),
+        ])
+
+    # -- endpoints ---------------------------------------------------------
+
+    async def health(self, _req):
+        return web.json_response({"status": "ok"})
+
+    async def models(self, _req):
+        return web.json_response({
+            "object": "list",
+            "data": [{
+                "id": self.model_name,
+                "object": "model",
+                "owned_by": "parallax-tpu",
+            }],
+        })
+
+    async def cluster_status_json(self, _req):
+        status = self.status_fn() if self.status_fn else {}
+        return web.json_response(status)
+
+    async def cluster_status_stream(self, request):
+        resp = web.StreamResponse(
+            headers={"Content-Type": "application/x-ndjson"}
+        )
+        await resp.prepare(request)
+        try:
+            while True:
+                status = self.status_fn() if self.status_fn else {}
+                await resp.write((json.dumps(status) + "\n").encode())
+                await asyncio.sleep(2.0)
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        return resp
+
+    async def weight_refit(self, request):
+        if self.refit_fn is None:
+            return web.json_response({"error": "refit unavailable"}, status=501)
+        body = await request.json()
+        version = self.refit_fn(body.get("index_map") or {})
+        return web.json_response({"version": version})
+
+    async def chat_completions(self, request):
+        try:
+            body = await request.json()
+        except Exception:
+            return self._error(400, "invalid JSON body")
+        messages = body.get("messages") or []
+        try:
+            prompt_text = self.tokenizer.apply_chat_template(messages)
+        except Exception:
+            prompt_text = "\n".join(m.get("content", "") for m in messages)
+        return await self._generate(request, body, prompt_text, chat=True)
+
+    async def completions(self, request):
+        try:
+            body = await request.json()
+        except Exception:
+            return self._error(400, "invalid JSON body")
+        return await self._generate(
+            request, body, body.get("prompt", ""), chat=False
+        )
+
+    # -- core generation ---------------------------------------------------
+
+    async def _generate(self, http_request, body: dict, prompt_text: str,
+                        chat: bool):
+        rid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
+        prompt_ids = self.tokenizer.encode(prompt_text)
+        if not prompt_ids:
+            return self._error(400, "empty prompt")
+
+        # Routing with retry ladder (reference request_handler.py:100-245:
+        # None path -> 503 after retries; engine full -> 429).
+        routing_table: list[str] = []
+        if self.route_fn is not None:
+            path = await asyncio.to_thread(self.route_fn, rid)
+            if path is None:
+                return self._error(503, "no serviceable pipeline")
+            routing_table = path
+
+        req = Request(
+            request_id=rid,
+            prompt_ids=list(prompt_ids),
+            sampling_params=_sampling_from_body(body),
+            routing_table=routing_table,
+            eos_token_ids=tuple(self.tokenizer.eos_token_ids),
+        )
+        t_start = time.monotonic()
+        try:
+            done = await asyncio.to_thread(self.submit_fn, req)
+        except ValueError as e:
+            return self._error(400, str(e))
+        except RuntimeError as e:
+            return self._error(429, str(e))
+
+        if body.get("stream"):
+            return await self._stream_response(
+                http_request, req, done, chat, t_start
+            )
+        ok = await asyncio.to_thread(done.wait, 600.0)
+        if not ok or req.status.value == "finished_abort":
+            return self._error(502, f"generation failed: {req.abort_reason}")
+        text = self.tokenizer.decode(req.output_ids)
+        return web.json_response(
+            self._completion_body(req, text, chat, t_start)
+        )
+
+    async def _stream_response(self, http_request, req, done, chat, t_start):
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        resp.enable_chunked_encoding()
+        await resp.prepare(http_request)
+        sent = 0
+        ttft_ms = None
+        deadline = time.monotonic() + 600.0
+        while True:
+            n = len(req.output_ids)
+            if n > sent:
+                if ttft_ms is None:
+                    ttft_ms = (time.monotonic() - t_start) * 1e3
+                delta = self.tokenizer.decode(req.output_ids[sent:n])
+                sent = n
+                await resp.write(self._sse_chunk(req, delta, chat))
+            if req.status.is_finished:
+                break
+            if time.monotonic() > deadline:
+                req.abort("stream deadline exceeded")
+                break
+            await asyncio.sleep(self.stream_poll_s)
+        usage = self._usage(req, t_start, ttft_ms)
+        await resp.write(self._sse_chunk(req, "", chat, finish=True, usage=usage))
+        await resp.write(b"data: [DONE]\n\n")
+        return resp
+
+    def _sse_chunk(self, req, delta_text, chat, finish=False, usage=None) -> bytes:
+        if chat:
+            delta = {} if finish else {"content": delta_text}
+            choice = {
+                "index": 0,
+                "delta": delta,
+                "finish_reason": self._finish_reason(req) if finish else None,
+            }
+            obj = "chat.completion.chunk"
+        else:
+            choice = {
+                "index": 0,
+                "text": delta_text,
+                "finish_reason": self._finish_reason(req) if finish else None,
+            }
+            obj = "text_completion"
+        payload = {
+            "id": req.request_id,
+            "object": obj,
+            "created": int(time.time()),
+            "model": self.model_name,
+            "choices": [choice],
+        }
+        if usage:
+            payload["usage"] = usage
+        return f"data: {json.dumps(payload)}\n\n".encode()
+
+    def _completion_body(self, req, text, chat, t_start):
+        if chat:
+            choice = {
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": self._finish_reason(req),
+            }
+            obj = "chat.completion"
+        else:
+            choice = {
+                "index": 0,
+                "text": text,
+                "finish_reason": self._finish_reason(req),
+            }
+            obj = "text_completion"
+        return {
+            "id": req.request_id,
+            "object": obj,
+            "created": int(time.time()),
+            "model": self.model_name,
+            "choices": [choice],
+            "usage": self._usage(req, t_start, None),
+        }
+
+    def _usage(self, req, t_start, ttft_ms):
+        elapsed = max(1e-6, time.monotonic() - t_start)
+        usage = {
+            "prompt_tokens": req.num_prompt_tokens,
+            "completion_tokens": req.num_output_tokens,
+            "total_tokens": req.total_len,
+            "tokens_per_second": round(req.num_output_tokens / elapsed, 2),
+        }
+        if ttft_ms is not None:
+            usage["ttft_ms"] = round(ttft_ms, 1)
+        return usage
+
+    @staticmethod
+    def _finish_reason(req) -> str:
+        return {
+            "finished_eos": "stop",
+            "finished_stop": "stop",
+            "finished_length": "length",
+            "finished_abort": "abort",
+        }.get(req.status.value, "stop")
+
+    @staticmethod
+    def _error(status: int, message: str):
+        return web.json_response(
+            {"error": {"message": message, "type": "invalid_request_error"}},
+            status=status,
+        )
+
+    # -- run ---------------------------------------------------------------
+
+    def run(self, host: str = "0.0.0.0", port: int = 8000) -> None:
+        web.run_app(self.app, host=host, port=port, print=None)

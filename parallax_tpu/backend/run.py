@@ -1,0 +1,145 @@
+"""Swarm serving: global scheduler + RPC service + OpenAI HTTP frontend.
+
+Capability parity: reference ``parallax run`` (``src/backend/main.py`` +
+``scheduler_manage.py``): the scheduler host serves the HTTP API, routes
+each request to a pipeline, hands it to the head node over RPC, and relays
+tokens back to the client.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from parallax_tpu.backend.http_server import OpenAIFrontend, load_tokenizer
+from parallax_tpu.backend.scheduler_service import SchedulerService
+from parallax_tpu.p2p.transport import TcpTransport, Transport
+from parallax_tpu.runtime.request import Request, RequestStatus
+from parallax_tpu.scheduling.scheduler import GlobalScheduler
+from parallax_tpu.utils import get_logger
+
+logger = get_logger(__name__)
+
+
+class SwarmClient:
+    """Submits requests to head nodes over the transport and mirrors token
+    progress back into the local Request (the HTTP frontend streams from
+    it). Capability parity: reference RequestHandler forwarding + SSE relay
+    (request_handler.py:100-245)."""
+
+    def __init__(self, transport: Transport, service: SchedulerService,
+                 poll_interval_s: float = 0.02):
+        self.transport = transport
+        self.service = service
+        self.poll_interval_s = poll_interval_s
+
+    def route(self, request_id: str) -> list[str] | None:
+        return self.service.route_request(request_id, timeout_s=10.0)
+
+    def submit(self, request: Request) -> threading.Event:
+        if not request.routing_table:
+            raise RuntimeError("request has no routing table")
+        head = request.routing_table[0]
+        try:
+            self.transport.call(head, "chat_submit", {
+                "rid": request.request_id,
+                "prompt_ids": request.prompt_ids,
+                "sampling_params": request.sampling_params.to_dict(),
+                "routing_table": request.routing_table,
+                "eos_token_ids": list(request.eos_token_ids),
+            }, timeout=30.0)
+        except Exception:
+            # The workers never saw this request; release the load the
+            # dispatcher charged for the path.
+            self.service.scheduler.complete_request(request.routing_table)
+            raise RuntimeError(f"head node {head} unreachable")
+        ev = threading.Event()
+        t = threading.Thread(
+            target=self._poll_loop, args=(request, head, ev), daemon=True
+        )
+        t.start()
+        return ev
+
+    def _poll_loop(self, request: Request, head: str, ev: threading.Event):
+        failures = 0
+        while True:
+            try:
+                r = self.transport.call(
+                    head, "chat_poll", {"rid": request.request_id}, timeout=10.0
+                )
+                failures = 0
+            except Exception as e:
+                failures += 1
+                if failures > 10:
+                    request.abort(f"head node unreachable: {e}")
+                    # The worker cannot report completion anymore; release
+                    # the path's load charge here.
+                    self.service.scheduler.complete_request(
+                        request.routing_table
+                    )
+                    ev.set()
+                    return
+                time.sleep(0.5)
+                continue
+            if "error" in r:
+                request.abort(r["error"])
+                ev.set()
+                return
+            ids = r["output_ids"]
+            if len(ids) > len(request.output_ids):
+                request.output_ids[:] = ids
+            if r["finished"]:
+                request.status = RequestStatus(r["status"])
+                ev.set()
+                return
+            time.sleep(self.poll_interval_s)
+
+
+def build_swarm_frontend(
+    scheduler: GlobalScheduler,
+    transport: TcpTransport,
+    tokenizer,
+    model_name: str,
+) -> tuple[OpenAIFrontend, SchedulerService, SwarmClient]:
+    service = SchedulerService(scheduler, transport)
+    client = SwarmClient(transport, service)
+    frontend = OpenAIFrontend(
+        tokenizer,
+        submit_fn=client.submit,
+        route_fn=client.route,
+        status_fn=scheduler.cluster_status,
+        refit_fn=scheduler.begin_refit,
+        model_name=model_name,
+    )
+    return frontend, service, client
+
+
+def run_main(args) -> int:
+    """``parallax-tpu run`` entry: scheduler + HTTP frontend."""
+    from parallax_tpu.models.presets import PRESETS, get_preset
+    from parallax_tpu.config import load_config
+    import os
+
+    if os.path.isdir(args.model_name):
+        model = load_config(args.model_name)
+        tokenizer = load_tokenizer(args.model_name)
+    elif args.model_name.lower() in PRESETS:
+        model = get_preset(args.model_name)
+        tokenizer = load_tokenizer(None)
+    else:
+        raise SystemExit(f"unknown model {args.model_name}")
+
+    scheduler = GlobalScheduler(
+        model, min_nodes_bootstrapping=args.min_nodes
+    )
+    transport = TcpTransport("scheduler", "0.0.0.0", args.port + 1)
+    frontend, service, _client = build_swarm_frontend(
+        scheduler, transport, tokenizer, args.model_name
+    )
+    service.start()
+    logger.info(
+        "scheduler RPC on :%d, HTTP on :%d (min_nodes=%d)",
+        args.port + 1, args.port, args.min_nodes,
+    )
+    frontend.run(host="0.0.0.0", port=args.port)
+    return 0

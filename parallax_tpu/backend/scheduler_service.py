@@ -115,5 +115,8 @@ class SchedulerService:
         scheduler_manage.get_routing_table, scheduler_manage.py:287-313)."""
         pr = self.scheduler.receive_request(request_id)
         if not pr.event.wait(timeout_s):
+            # Caller gives up: mark cancelled so a late dispatch does not
+            # charge node load for a path nobody will use.
+            pr.cancelled = True
             return None
         return pr.path_ids

@@ -1,0 +1,135 @@
+"""Single-host serving: engine pipeline + OpenAI HTTP frontend in one process.
+
+Capability parity: the reference single-node path (``launch.py`` + vllm-rs
+HTTP frontend + executor). Here the stage engines and the aiohttp frontend
+share the process; a runner thread steps the pipeline continuously.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from parallax_tpu.backend.http_server import OpenAIFrontend, load_tokenizer
+from parallax_tpu.runtime.engine import EngineConfig, StageEngine
+from parallax_tpu.runtime.pipeline import InProcessPipeline
+from parallax_tpu.runtime.request import Request
+from parallax_tpu.utils import get_logger
+
+logger = get_logger(__name__)
+
+
+class LocalRunner:
+    """Steps an in-process pipeline on a background thread and completes
+    per-request events."""
+
+    def __init__(self, pipeline: InProcessPipeline):
+        self.pipeline = pipeline
+        self._events: dict[str, threading.Event] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="pipeline-runner"
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=3.0)
+
+    def submit(self, request: Request) -> threading.Event:
+        ev = threading.Event()
+        with self._lock:
+            self._events[request.request_id] = ev
+            if not self.pipeline.submit(request):
+                self._events.pop(request.request_id, None)
+                raise RuntimeError("engine queue full")
+        return ev
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if not self.pipeline.has_work():
+                self._stop.wait(0.002)
+                continue
+            with self._lock:
+                finished = self.pipeline.step_round()
+                for req in finished:
+                    ev = self._events.pop(req.request_id, None)
+                    if ev is not None:
+                        ev.set()
+
+
+def build_local_frontend(
+    engines: list[StageEngine],
+    tokenizer,
+    model_name: str = "parallax-tpu",
+) -> tuple[OpenAIFrontend, LocalRunner]:
+    pipeline = InProcessPipeline(engines)
+    runner = LocalRunner(pipeline)
+    runner.start()
+
+    def status():
+        return {
+            "mode": "single-host",
+            "stages": [
+                {
+                    "layers": [e.model.start_layer, e.model.end_layer],
+                    "running": len(e.scheduler.running),
+                    "waiting": len(e.scheduler.wait_queue),
+                    "free_pages": e.cache.num_free_pages,
+                    "cached_pages": e.cache.prefix_cache.num_cached_pages,
+                }
+                for e in engines
+            ],
+        }
+
+    frontend = OpenAIFrontend(
+        tokenizer,
+        submit_fn=runner.submit,
+        status_fn=status,
+        model_name=model_name,
+    )
+    return frontend, runner
+
+
+def serve_main(args) -> int:
+    """``parallax-tpu serve`` entry."""
+    import jax
+    import jax.numpy as jnp
+
+    from parallax_tpu.config import load_config
+    from parallax_tpu.models.loader import load_stage_params
+    from parallax_tpu.models.registry import create_stage_model
+    from parallax_tpu.runtime.cache_manager import derive_num_pages
+    from parallax_tpu.utils.hw import device_free_memory_bytes
+
+    config = load_config(args.model_path)
+    start = args.start_layer or 0
+    end = args.end_layer or config.num_hidden_layers
+    model = create_stage_model(config, start, end)
+    params = load_stage_params(model, args.model_path)
+
+    page_size = args.page_size
+    num_pages = derive_num_pages(
+        device_free_memory_bytes(args.kv_utilization),
+        config, model.num_local_layers, page_size,
+    )
+    engine = StageEngine(
+        model,
+        params,
+        EngineConfig(
+            page_size=page_size,
+            num_pages=num_pages,
+            max_batch_size=args.max_batch_size,
+            max_model_len=args.max_model_len,
+        ),
+    )
+    tokenizer = load_tokenizer(args.model_path)
+    frontend, _runner = build_local_frontend(
+        [engine], tokenizer, model_name=args.model_path
+    )
+    logger.info("serving %s layers [%d, %d) on :%d",
+                args.model_path, start, end, args.port)
+    frontend.run(host=args.host, port=args.port)
+    return 0

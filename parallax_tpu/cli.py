@@ -42,6 +42,10 @@ def build_parser() -> argparse.ArgumentParser:
     join.add_argument("--scheduler-addr", required=True)
     join.add_argument("--model-path", default=None)
     join.add_argument("--port", type=int, default=0)
+    join.add_argument(
+        "--advertise-addr", default=None,
+        help="externally reachable host/IP peers dial for pp-forwards",
+    )
 
     bench = sub.add_parser("bench", help="offline throughput benchmark")
     bench.add_argument("--config", default="qwen2-7b")

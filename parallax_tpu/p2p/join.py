@@ -1,0 +1,78 @@
+"""``parallax-tpu join`` entry: run a worker node until interrupted.
+
+Capability parity: reference ``parallax join`` -> ``launch.py:89-331``
+(minus rank subprocesses — TP is the engine's mesh).
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+from parallax_tpu.p2p.transport import TcpTransport
+from parallax_tpu.utils import get_logger
+
+logger = get_logger(__name__)
+
+
+def _default_route_ip() -> str:
+    """Best-effort externally reachable IP (the UDP-connect trick)."""
+    import socket
+
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("10.255.255.255", 1))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except Exception:
+        return "127.0.0.1"
+
+
+def join_main(args) -> int:
+    import jax
+
+    from parallax_tpu.config import load_config
+    from parallax_tpu.models.loader import load_stage_params
+    from parallax_tpu.p2p.node import WorkerNode
+    from parallax_tpu.parallel import make_mesh
+    from parallax_tpu.runtime.engine import EngineConfig
+
+    # Scheduler RPC rides one port above its HTTP port by convention.
+    scheduler_peer = args.scheduler_addr
+    transport = TcpTransport("", "0.0.0.0", args.port)
+    transport.start()
+    # The node id doubles as the dial address peers use for pp-forwards: it
+    # must be externally reachable, never the 0.0.0.0 bind address.
+    advertise_host = getattr(args, "advertise_addr", None) or _default_route_ip()
+    transport.peer_id = f"{advertise_host}:{transport.port}"
+
+    model_config = None
+    load_params = None
+    if args.model_path:
+        model_config = load_config(args.model_path)
+        load_params = lambda model: load_stage_params(model, args.model_path)
+    else:
+        raise SystemExit("--model-path is required (checkpoint directory)")
+
+    n_devices = len(jax.local_devices())
+    mesh = make_mesh(tp_size=n_devices) if n_devices > 1 else None
+
+    node = WorkerNode(
+        transport=transport,
+        scheduler_peer=scheduler_peer,
+        model_config=model_config,
+        engine_config=EngineConfig(),
+        load_params=load_params,
+        mesh=mesh,
+        tp_size=n_devices if n_devices > 1 else 1,
+    )
+    node.start()
+    logger.info("worker %s joined %s", node.node_id, scheduler_peer)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    stop.wait()
+    node.stop()
+    return 0

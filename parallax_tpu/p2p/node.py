@@ -74,7 +74,13 @@ class WorkerNode:
         transport.register(proto.FORWARD, self._on_forward)
         transport.register(proto.ABORT, self._on_abort)
         transport.register(proto.RELEASE, self._on_release)
+        transport.register("chat_submit", self._on_chat_submit)
+        transport.register("chat_poll", self._on_chat_poll)
         transport.register("__ping__", lambda *_: "pong")
+        # Head-node chat requests by id (polled by the HTTP frontend;
+        # reference: TransformerConnectionHandler.chat_completion proxies to
+        # the local HTTP frontend, p2p/server.py:185-221).
+        self._chat_requests: dict[str, Request] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -120,6 +126,8 @@ class WorkerNode:
         return reply
 
     def _apply_allocation(self, alloc: dict) -> None:
+        if "start_layer" not in alloc:
+            return
         start, end = alloc["start_layer"], alloc["end_layer"]
         if (start, end) == (self.start_layer, self.end_layer):
             return
@@ -172,7 +180,9 @@ class WorkerNode:
                     # Scheduler lost us (restart or heartbeat eviction):
                     # auto-rejoin (reference rpc_connection_handler.py:71-113).
                     logger.warning("%s: scheduler asked for rejoin", self.node_id)
-                    self._inbox.put(("reload", self._join()))
+                    rejoin_alloc = self._join()
+                    if "start_layer" in rejoin_alloc:
+                        self._inbox.put(("reload", rejoin_alloc))
                 elif reply and reply.get("start_layer") is not None:
                     if (
                         reply["start_layer"],
@@ -201,6 +211,35 @@ class WorkerNode:
             self._inbox.put(("release", rid, payload.get("abort", False)))
         return "ok"
 
+    def _on_chat_submit(self, _peer: str, payload: dict):
+        from parallax_tpu.runtime.request import SamplingParams
+
+        req = Request(
+            request_id=payload["rid"],
+            prompt_ids=list(payload["prompt_ids"]),
+            sampling_params=SamplingParams.from_dict(
+                payload.get("sampling_params") or {}
+            ),
+            routing_table=list(payload.get("routing_table") or []),
+            eos_token_ids=tuple(payload.get("eos_token_ids") or ()),
+        )
+        self._chat_requests[req.request_id] = req
+        self.submit(req)
+        return "ok"
+
+    def _on_chat_poll(self, _peer: str, payload: dict):
+        req = self._chat_requests.get(payload["rid"])
+        if req is None:
+            return {"error": "unknown request"}
+        out = {
+            "output_ids": list(req.output_ids),
+            "status": req.status.value,
+            "finished": req.status.is_finished,
+        }
+        if req.status.is_finished:
+            self._chat_requests.pop(payload["rid"], None)
+        return out
+
     def submit(self, request: Request) -> threading.Event:
         """Head-node API: enqueue a user request; the returned event fires
         when it finishes."""
@@ -221,17 +260,23 @@ class WorkerNode:
 
     def _step_loop(self) -> None:
         while not self._stop.is_set():
-            worked = self._drain_inbox()
-            eng = self.engine
-            if eng is None:
-                time.sleep(0.01)
-                continue
-            if eng.has_work():
-                out = eng.step()
-                self._route_outputs(out)
-                worked = worked or out.num_tokens > 0
-            if not worked:
-                time.sleep(0.001)
+            try:
+                worked = self._drain_inbox()
+                eng = self.engine
+                if eng is None:
+                    time.sleep(0.01)
+                    continue
+                if eng.has_work():
+                    out = eng.step()
+                    self._route_outputs(out)
+                    worked = worked or out.num_tokens > 0
+                if not worked:
+                    time.sleep(0.001)
+            except Exception:
+                # The step thread must survive: a dead step loop with a live
+                # announcer would look healthy to the scheduler forever.
+                logger.exception("step loop error")
+                time.sleep(0.1)
 
     def _drain_inbox(self) -> bool:
         worked = False
@@ -257,6 +302,18 @@ class WorkerNode:
                     self._finish(req)
             elif kind == "release":
                 self.engine.release(item[1], abort=item[2])
+            elif kind == "abort_path":
+                # A next-hop peer is unreachable: abort everything routed
+                # through it; the normal finish flow then releases pages,
+                # fires client events and broadcasts to surviving peers.
+                peer = item[1]
+                sched = self.engine.scheduler
+                for req in (
+                    list(sched.running.values())
+                    + list(sched.wait_queue.values())
+                ):
+                    if peer in req.routing_table and not req.status.is_finished:
+                        req.abort(f"peer {peer} unreachable")
             elif kind == "reload":
                 self._apply_allocation(item[1])
 
@@ -307,10 +364,11 @@ class WorkerNode:
             except Exception:
                 pass
         try:
-            self.transport.call(
+            # Fire-and-forget: the step thread must not block on the
+            # scheduler's round trip.
+            self.transport.send(
                 self.scheduler_peer, "request_complete",
                 {"path": req.routing_table or [self.node_id]},
-                timeout=5.0,
             )
         except Exception:
             pass

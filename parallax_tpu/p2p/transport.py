@@ -105,6 +105,14 @@ class TcpTransport(Transport):
         super().__init__(peer_id)
         self.host = host
         self.port = port
+        # Dedicated handler pool: blocking handlers (node_join polls for an
+        # allocation for up to minutes) must not starve heartbeats or data
+        # frames, and asyncio.to_thread's default pool is small.
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._executor = ThreadPoolExecutor(
+            max_workers=128, thread_name_prefix=f"rpc-{peer_id or 'node'}"
+        )
         self._loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
         self._server: asyncio.AbstractServer | None = None
@@ -201,8 +209,9 @@ class TcpTransport(Transport):
 
     async def _handle_request(self, frame, peer_name, writer) -> None:
         try:
-            result = await asyncio.to_thread(
-                self._dispatch, frame["t"], peer_name, frame["p"]
+            result = await self._loop.run_in_executor(
+                self._executor, self._dispatch, frame["t"], peer_name,
+                frame["p"],
             )
         except Exception as e:  # reply with an error marker
             logger.exception("handler %s failed", frame["t"])

@@ -152,6 +152,12 @@ class StageEngine:
                 f"prompt length {request.num_prompt_tokens} exceeds "
                 f"max_model_len {self.cfg.max_model_len}"
             )
+        # Clamp generation to the context budget so oversized max_tokens
+        # finish at the length limit instead of dying on KV exhaustion.
+        cap = self.cfg.max_model_len - request.num_prompt_tokens
+        sp = request.sampling_params
+        if sp.max_new_tokens > cap:
+            sp.max_new_tokens = cap
         return self.scheduler.enqueue(request)
 
     def submit_intermediate(self, ireq: IntermediateRequest) -> None:

@@ -44,6 +44,9 @@ class PendingRequest:
     # Filled by the dispatcher.
     path_ids: list[str] | None = None
     event: threading.Event = dataclasses.field(default_factory=threading.Event)
+    # Set by a caller that gave up waiting; the dispatcher then drops the
+    # request instead of charging load for a path nobody will use.
+    cancelled: bool = False
 
 
 class GlobalScheduler:
@@ -230,6 +233,9 @@ class GlobalScheduler:
             try:
                 pr = self._requests.get(timeout=0.05)
             except queue.Empty:
+                continue
+            if pr.cancelled:
+                pr.event.set()
                 continue
             path = self.router.find_path()
             if path is not None:

@@ -1,0 +1,209 @@
+"""HTTP plane tests: OpenAI-compatible serving, single-host and swarm mode.
+
+Capability parity: the reference CI E2E (launch server, poll
+``/v1/chat/completions`` until it answers) + request-handler retry tests.
+"""
+
+import asyncio
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from parallax_tpu.backend.http_server import OpenAIFrontend, SimpleTokenizer
+from parallax_tpu.backend.serve import build_local_frontend
+from parallax_tpu.config import normalize_config
+from parallax_tpu.models.base import StageModel
+from parallax_tpu.runtime.engine import EngineConfig, StageEngine
+
+TINY = normalize_config(dict(
+    architectures=["Qwen2ForCausalLM"],
+    hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+    num_key_value_heads=2, intermediate_size=128, vocab_size=258 + 8,
+    max_position_embeddings=512,
+))
+
+
+def build_engines(bounds):
+    engines = []
+    for s, e in bounds:
+        m = StageModel(TINY, s, e, use_pallas=False)
+        engines.append(StageEngine(
+            m, m.init_params(jax.random.key(0), dtype=jnp.float32),
+            EngineConfig(page_size=8, num_pages=128, max_model_len=256,
+                         kv_dtype="float32"),
+        ))
+    return engines
+
+
+@pytest.fixture
+def frontend():
+    fe, runner = build_local_frontend(
+        build_engines([(0, 2)]), SimpleTokenizer(), model_name="tiny"
+    )
+    yield fe
+    runner.stop()
+
+
+def with_client(app, fn):
+    """Run all of a test's HTTP calls on one event loop (the app binds to
+    the first loop it sees)."""
+
+    async def go():
+        server = TestServer(app)
+        client = TestClient(server)
+        await client.start_server()
+        try:
+            return await fn(client)
+        finally:
+            await client.close()
+
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(go())
+    finally:
+        loop.close()
+
+
+async def _json(client, method, path, json_body=None):
+    resp = await client.request(method, path, json=json_body)
+    if resp.content_type == "application/json":
+        return resp.status, await resp.json()
+    return resp.status, await resp.text()
+
+
+def test_models_and_health(frontend):
+    async def fn(client):
+        status, body = await _json(client, "GET", "/v1/models")
+        assert status == 200 and body["data"][0]["id"] == "tiny"
+        status, _ = await _json(client, "GET", "/health")
+        assert status == 200
+
+    with_client(frontend.app, fn)
+
+
+def test_chat_completion_non_stream(frontend):
+    async def fn(client):
+        status, body = await _json(client, "POST", "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "hi"}],
+             "max_tokens": 6, "temperature": 0})
+        assert status == 200, body
+        assert body["object"] == "chat.completion"
+        assert body["usage"]["completion_tokens"] == 6
+        assert body["choices"][0]["message"]["role"] == "assistant"
+
+    with_client(frontend.app, fn)
+
+
+def test_completions_endpoint(frontend):
+    async def fn(client):
+        status, body = await _json(client, "POST", "/v1/completions",
+            {"prompt": "hello world", "max_tokens": 4, "temperature": 0})
+        assert status == 200, body
+        assert body["object"] == "text_completion"
+        assert body["usage"]["completion_tokens"] == 4
+
+    with_client(frontend.app, fn)
+
+
+def test_streaming_chat(frontend):
+    async def fn(client):
+        resp = await client.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "count"}],
+            "max_tokens": 5, "temperature": 0, "stream": True,
+        })
+        assert resp.status == 200
+        return await resp.text()
+
+    raw = with_client(frontend.app, fn)
+    chunks = [json.loads(line[6:]) for line in raw.splitlines()
+              if line.startswith("data: ") and line != "data: [DONE]"]
+    assert raw.strip().endswith("data: [DONE]")
+    assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+    assert "usage" in chunks[-1]
+
+
+def test_empty_prompt_400(frontend):
+    async def fn(client):
+        status, _ = await _json(client, "POST", "/v1/completions",
+                                {"prompt": "", "max_tokens": 4})
+        assert status == 400
+
+    with_client(frontend.app, fn)
+
+
+def test_cluster_status(frontend):
+    async def fn(client):
+        status, body = await _json(client, "GET", "/cluster/status_json")
+        assert status == 200
+        assert body["stages"][0]["layers"] == [0, 2]
+
+    with_client(frontend.app, fn)
+
+
+def test_swarm_http_end_to_end(monkeypatch):
+    """Scheduler HTTP frontend -> route -> head worker RPC -> pipeline ->
+    tokens streamed back. The full 'parallax run + join' path."""
+    from parallax_tpu.backend.run import build_swarm_frontend
+    from parallax_tpu.p2p.node import WorkerNode
+    from parallax_tpu.p2p.transport import TcpTransport
+    from parallax_tpu.scheduling import node as node_mod
+    from parallax_tpu.scheduling.scheduler import GlobalScheduler
+
+    monkeypatch.setattr(
+        node_mod.RooflinePerformanceModel, "max_layers_in_memory",
+        lambda self, kv_fraction=0.35: 1,
+    )
+    sched = GlobalScheduler(TINY, min_nodes_bootstrapping=2)
+    st = TcpTransport("scheduler", "127.0.0.1")
+    frontend, service, _client = build_swarm_frontend(
+        sched, st, SimpleTokenizer(), "tiny-swarm"
+    )
+    service.start()
+
+    workers = []
+    for _ in range(2):
+        t = TcpTransport("", "127.0.0.1")
+        t.start()
+        t.peer_id = t.address
+        w = WorkerNode(
+            transport=t, scheduler_peer=st.address, model_config=TINY,
+            engine_config=EngineConfig(page_size=8, num_pages=64,
+                                       max_model_len=256, kv_dtype="float32"),
+            heartbeat_interval_s=0.2,
+        )
+        workers.append(w)
+    threads = [threading.Thread(target=w.start) for w in workers]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(60)
+
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        s = sched.cluster_status()
+        if s["num_pipelines"] and all(
+            n["ready"] for p in s["pipelines"] for n in p["nodes"]
+        ):
+            break
+        time.sleep(0.05)
+
+    try:
+        async def fn(client):
+            status, body = await _json(client, "POST", "/v1/chat/completions",
+                {"messages": [{"role": "user", "content": "hello swarm"}],
+                 "max_tokens": 5, "temperature": 0})
+            assert status == 200, body
+            assert body["usage"]["completion_tokens"] == 5
+            assert body["choices"][0]["finish_reason"] in ("length", "stop")
+
+        with_client(frontend.app, fn)
+    finally:
+        for w in workers:
+            w.stop()
+        service.stop()
