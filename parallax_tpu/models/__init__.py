@@ -11,4 +11,7 @@ batches over paged KV; architectures register themselves by HF
 from parallax_tpu.models.base import BatchInputs, StageModel
 from parallax_tpu.models.registry import MODEL_REGISTRY, get_model_class
 
+# Import model modules for their registration side effects.
+from parallax_tpu.models import qwen3_moe  # noqa: F401  (registers MoE archs)
+
 __all__ = ["StageModel", "BatchInputs", "MODEL_REGISTRY", "get_model_class"]

@@ -111,6 +111,11 @@ class StageModel:
 
     # -- parameters -------------------------------------------------------
 
+    def finalize_params(self, tree: dict) -> dict:
+        """Loader hook: reshape/stack checkpoint weights into this model's
+        param layout (e.g. stacking MoE experts). Default: identity."""
+        return tree
+
     def init_params(self, rng: jax.Array, dtype=jnp.bfloat16) -> dict:
         """Random init (tests / benchmarks with synthetic weights)."""
         cfg = self.config

@@ -110,7 +110,7 @@ def load_stage_params(
         "loaded %d tensors for layers [%d, %d) from %s",
         n_loaded, model.start_layer, model.end_layer, model_path,
     )
-    return tree
+    return model.finalize_params(tree)
 
 
 def params_from_torch_state_dict(
@@ -133,4 +133,4 @@ def params_from_torch_state_dict(
         _assign(tree, local, jnp.asarray(arr).astype(dtype))
     layer_map = tree.get("layers", {})
     tree["layers"] = [layer_map[str(i)] for i in range(model.num_local_layers)]
-    return tree
+    return model.finalize_params(tree)

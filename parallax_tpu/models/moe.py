@@ -1,0 +1,148 @@
+"""Mixture-of-experts FFN: megablox grouped matmul on TPU, with expert
+parallelism over the ``tp`` mesh axis.
+
+Capability parity: reference MoE models run experts via mlx-lm SwitchGLU
+inside a stage (SURVEY.md section 2.7 marks cross-node EP absent; expert
+sharding over ICI is the TPU-native equivalent it prescribes). Params hold
+experts *stacked*: ``experts.gate_proj/up_proj: [E, I, H]``,
+``experts.down_proj: [E, H, I]`` — the loader stacks per-expert HF weights
+at load time, and EP shards the leading expert dim.
+
+Two compute paths with identical semantics:
+- ``megablox``: sort token-expert pairs by expert, one ``gmm`` per
+  projection (MXU-dense regardless of routing skew). TPU only.
+- fallback: static loop over (local) experts with masked matmuls — used on
+  CPU and for verification.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from parallax_tpu.config import MoEConfig
+from parallax_tpu.models.layers import linear
+
+
+def route_topk(
+    x: jax.Array, router_weight: jax.Array, moe: MoEConfig
+) -> tuple[jax.Array, jax.Array]:
+    """Router: returns (weights f32[T, K], expert_ids i32[T, K])."""
+    logits = jax.lax.dot_general(
+        x, router_weight,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if moe.scoring_func == "sigmoid":
+        scores = jax.nn.sigmoid(logits)
+    else:
+        scores = jax.nn.softmax(logits, axis=-1)
+    weights, ids = jax.lax.top_k(scores, moe.num_experts_per_tok)
+    if moe.norm_topk_prob:
+        weights = weights / jnp.maximum(
+            jnp.sum(weights, axis=-1, keepdims=True), 1e-20
+        )
+    weights = weights * moe.routed_scaling_factor
+    return weights.astype(jnp.float32), ids.astype(jnp.int32)
+
+
+def _expert_ffn(x, gate_w, up_w, down_w):
+    """SwiGLU for one expert's weight slices ([I,H],[I,H],[H,I])."""
+    g = jnp.einsum("th,ih->ti", x, gate_w, preferred_element_type=jnp.float32)
+    u = jnp.einsum("th,ih->ti", x, up_w, preferred_element_type=jnp.float32)
+    h = (jax.nn.silu(g) * u).astype(x.dtype)
+    return jnp.einsum("ti,hi->th", h, down_w, preferred_element_type=jnp.float32)
+
+
+def _moe_fallback(x, p, weights, ids, num_local, expert_offset):
+    """Masked per-expert loop; correct for any routing, O(E) matmuls."""
+    t = x.shape[0]
+    out = jnp.zeros((t, x.shape[1]), jnp.float32)
+    gate_w, up_w, down_w = (
+        p["experts"]["gate_proj"], p["experts"]["up_proj"],
+        p["experts"]["down_proj"],
+    )
+    for le in range(num_local):
+        ge = expert_offset + le
+        hit = ids == ge                           # [T, K]
+        w = jnp.sum(jnp.where(hit, weights, 0.0), axis=-1)  # [T]
+        y = _expert_ffn(x, gate_w[le], up_w[le], down_w[le])
+        out = out + y * w[:, None]
+    return out
+
+
+def _moe_megablox(x, p, weights, ids, num_local, expert_offset):
+    """Grouped-matmul path: sort token-expert pairs, gmm per projection."""
+    from jax.experimental.pallas.ops.tpu.megablox import gmm
+
+    t, h = x.shape
+    k = ids.shape[1]
+    flat_ids = ids.reshape(-1)                    # [T*K]
+    flat_w = weights.reshape(-1)
+    order = jnp.argsort(flat_ids)
+    sorted_ids = flat_ids[order]
+    token_of = order // k
+    xs = x[token_of]                              # [T*K, H] gathered rows
+
+    # Group sizes for the local expert slice. Rows routed to non-local
+    # experts are clipped into boundary groups; they ride the gmm for free
+    # and their contribution is masked out below.
+    local_ids = jnp.clip(sorted_ids - expert_offset, 0, num_local - 1)
+    group_sizes = jnp.bincount(local_ids, length=num_local).astype(jnp.int32)
+
+    gate_w = p["experts"]["gate_proj"]            # [El, I, H]
+    up_w = p["experts"]["up_proj"]
+    down_w = p["experts"]["down_proj"]            # [El, H, I]
+    g = gmm(xs, jnp.swapaxes(gate_w, 1, 2), group_sizes)
+    u = gmm(xs, jnp.swapaxes(up_w, 1, 2), group_sizes)
+    hme = (jax.nn.silu(g) * u).astype(x.dtype)
+    y = gmm(hme, jnp.swapaxes(down_w, 1, 2), group_sizes)  # [T*K, H]
+
+    # Zero out pairs routed to non-local experts, weight, scatter back.
+    local = (sorted_ids >= expert_offset) & (sorted_ids < expert_offset + num_local)
+    contrib = y * jnp.where(local, flat_w[order], 0.0)[:, None]
+    out = jnp.zeros((t, h), jnp.float32)
+    return out.at[token_of].add(contrib)
+
+
+def moe_ffn(
+    x: jax.Array,
+    p: dict,
+    moe: MoEConfig,
+    axis_name: str | None = None,
+    use_megablox: bool | None = None,
+) -> jax.Array:
+    """Full MoE block: route, expert-compute (+ optional shared experts),
+    psum over the expert-parallel axis."""
+    if use_megablox is None:
+        use_megablox = jax.default_backend() == "tpu"
+
+    weights, ids = route_topk(x, p["gate"]["weight"], moe)
+    num_local = p["experts"]["gate_proj"].shape[0]
+    if axis_name is not None:
+        expert_offset = jax.lax.axis_index(axis_name) * num_local
+    else:
+        expert_offset = 0
+
+    impl = _moe_megablox if use_megablox else _moe_fallback
+    out = impl(x, p, weights, ids, num_local, expert_offset)
+
+    if "shared_expert" in p:
+        # Shared expert uses the standard column/row TP sharding, so its
+        # partial output joins the routed experts' psum.
+        shared = _expert_ffn(
+            x,
+            p["shared_expert"]["gate_proj"]["weight"],
+            p["shared_expert"]["up_proj"]["weight"],
+            p["shared_expert"]["down_proj"]["weight"],
+        )
+        if "shared_expert_gate" in p:
+            sg = jax.nn.sigmoid(
+                linear(x, p["shared_expert_gate"]).astype(jnp.float32)
+            )
+            shared = shared * sg
+        out = out + shared
+
+    if axis_name is not None:
+        out = jax.lax.psum(out, axis_name)
+    return out.astype(x.dtype)

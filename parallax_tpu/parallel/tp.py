@@ -28,6 +28,10 @@ _ROW = {"o_proj", "down_proj"}
 def _spec_for(path: tuple[str, ...]) -> P:
     if len(path) >= 2:
         parent, leaf = path[-2], path[-1]
+        if parent == "experts":
+            # Stacked MoE experts [E, ...]: shard the expert dim (EP rides
+            # the tp axis).
+            return P("tp", None, None)
         if parent in _COLUMN and leaf == "weight":
             return P("tp", None)
         if parent in _COLUMN and leaf == "bias":
@@ -36,7 +40,7 @@ def _spec_for(path: tuple[str, ...]) -> P:
             return P(None, "tp")
     if path[-1] == "sinks":
         return P("tp")
-    return P()  # replicated (norms, embed, lm_head, biases of row layers)
+    return P()  # replicated (norms, embed, lm_head, router, row biases)
 
 
 def _tree_map_with_path(fn, tree, path=()):

@@ -1,0 +1,159 @@
+"""MoE tests: routing math, EP sharding invariance, HF generation parity.
+
+Capability parity: the reference's MoE model tests (qwen3_moe via
+SwitchGLU); here against HF transformers' Qwen3MoeForCausalLM.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parallax_tpu.config import normalize_config
+from parallax_tpu.models.moe import moe_ffn, route_topk
+from parallax_tpu.models.registry import create_stage_model
+from parallax_tpu.runtime.engine import EngineConfig, StageEngine
+from parallax_tpu.runtime.pipeline import InProcessPipeline
+from parallax_tpu.runtime.request import Request, SamplingParams
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+TINY_MOE = dict(
+    architectures=["Qwen3MoeForCausalLM"],
+    hidden_size=64,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    head_dim=16,
+    intermediate_size=128,
+    moe_intermediate_size=32,
+    num_experts=8,
+    num_experts_per_tok=2,
+    norm_topk_prob=True,
+    decoder_sparse_step=1,
+    mlp_only_layers=[],
+    vocab_size=199,
+    max_position_embeddings=512,
+    rms_norm_eps=1e-6,
+    rope_theta=10000.0,
+    tie_word_embeddings=False,
+)
+
+CONFIG = normalize_config(TINY_MOE)
+
+
+def test_route_topk_normalized():
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((5, 64)),
+                    dtype=jnp.float32)
+    w = jnp.asarray(np.random.default_rng(1).standard_normal((8, 64)),
+                    dtype=jnp.float32)
+    weights, ids = route_topk(x, w, CONFIG.moe)
+    assert weights.shape == (5, 2) and ids.shape == (5, 2)
+    np.testing.assert_allclose(np.asarray(weights.sum(-1)), 1.0, rtol=1e-5)
+    assert np.all(np.asarray(ids) < 8)
+
+
+def test_moe_ffn_fallback_matches_manual():
+    """The masked-loop path must equal an explicit per-token computation."""
+    rng = np.random.default_rng(2)
+    h, i, e = 16, 8, 4
+    moe_cfg = normalize_config(dict(TINY_MOE, hidden_size=h,
+                                    moe_intermediate_size=i,
+                                    num_experts=e)).moe
+    x = jnp.asarray(rng.standard_normal((6, h)).astype(np.float32))
+    p = {
+        "gate": {"weight": jnp.asarray(
+            rng.standard_normal((e, h)).astype(np.float32))},
+        "experts": {
+            "gate_proj": jnp.asarray(
+                rng.standard_normal((e, i, h)).astype(np.float32)),
+            "up_proj": jnp.asarray(
+                rng.standard_normal((e, i, h)).astype(np.float32)),
+            "down_proj": jnp.asarray(
+                rng.standard_normal((e, h, i)).astype(np.float32)),
+        },
+    }
+    out = np.asarray(moe_ffn(x, p, moe_cfg, use_megablox=False))
+
+    weights, ids = route_topk(x, p["gate"]["weight"], moe_cfg)
+    weights, ids = np.asarray(weights), np.asarray(ids)
+    expected = np.zeros((6, h), np.float32)
+    xn = np.asarray(x)
+    for t in range(6):
+        for j in range(2):
+            eidx = ids[t, j]
+            g = np.asarray(p["experts"]["gate_proj"][eidx]) @ xn[t]
+            u = np.asarray(p["experts"]["up_proj"][eidx]) @ xn[t]
+            silu = g / (1.0 + np.exp(-g)) * u
+            expected[t] += weights[t, j] * (
+                np.asarray(p["experts"]["down_proj"][eidx]) @ silu
+            )
+    np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-4)
+
+
+@pytest.fixture(scope="module")
+def hf_moe():
+    torch.manual_seed(0)
+    cfg = transformers.Qwen3MoeConfig(**{
+        k: v for k, v in TINY_MOE.items() if k != "architectures"
+    })
+    model = transformers.Qwen3MoeForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def moe_engines(hf_model, bounds, tp_size=1, mesh=None):
+    from parallax_tpu.models.loader import params_from_torch_state_dict
+
+    engines = []
+    for s, e in bounds:
+        model = create_stage_model(CONFIG, s, e, use_pallas=False,
+                                   tp_size=tp_size)
+        params = params_from_torch_state_dict(
+            model, hf_model.state_dict(), dtype=jnp.float32
+        )
+        engines.append(StageEngine(
+            model, params,
+            EngineConfig(page_size=8, num_pages=128, max_model_len=256,
+                         kv_dtype="float32"),
+            mesh=mesh,
+        ))
+    return engines
+
+
+def generate(engines, prompt, n=6):
+    pipe = InProcessPipeline(engines)
+    req = Request("r", prompt_ids=list(prompt),
+                  sampling_params=SamplingParams(temperature=0.0,
+                                                 max_new_tokens=n))
+    pipe.submit(req)
+    pipe.run_until_complete()
+    return req.output_ids
+
+
+def test_moe_generation_matches_hf(hf_moe):
+    from tests.test_engine_e2e import assert_greedy_matches
+
+    prompt = [3, 14, 15, 92, 65]
+    out = generate(moe_engines(hf_moe, [(0, 2)]), prompt)
+    assert_greedy_matches(hf_moe, prompt, out, 6)
+
+
+def test_moe_two_stage_matches_single(hf_moe):
+    prompt = [9, 8, 7, 6]
+    single = generate(moe_engines(hf_moe, [(0, 2)]), prompt)
+    staged = generate(moe_engines(hf_moe, [(0, 1), (1, 2)]), prompt)
+    assert single == staged
+
+
+def test_moe_expert_parallel_matches_single(hf_moe):
+    from parallax_tpu.parallel import make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("not enough devices")
+    prompt = [5, 6, 7, 8, 9]
+    single = generate(moe_engines(hf_moe, [(0, 2)]), prompt)
+    mesh = make_mesh(tp_size=2)
+    ep = generate(moe_engines(hf_moe, [(0, 2)], tp_size=2, mesh=mesh), prompt)
+    assert single == ep
