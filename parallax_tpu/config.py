@@ -43,6 +43,9 @@ class MoEConfig:
     n_group: int = 0
     topk_group: int = 0
     scoring_func: str = "softmax"   # or "sigmoid" (DeepSeek-V3)
+    # Group-selection method: "noaux_tc" (V3: sum of top-2 biased scores),
+    # "group_limited_greedy" (V2: max score per group), "greedy" (no groups).
+    topk_method: str = "greedy"
     # Explicit per-layer MoE mask, resolved at normalize time from the source
     # convention (DeepSeek first_k_dense_replace/moe_layer_freq vs Qwen
     # decoder_sparse_step/mlp_only_layers use different off-by-one rules).
@@ -278,6 +281,10 @@ def normalize_config(raw: dict, model_name: str = "") -> ModelConfig:
             n_group=int(_get(cfg, "n_group", default=0) or 0),
             topk_group=int(_get(cfg, "topk_group", default=0) or 0),
             scoring_func=str(_get(cfg, "scoring_func", default="softmax")),
+            topk_method=str(_get(
+                cfg, "topk_method",
+                default="noaux_tc" if _get(cfg, "n_group") else "greedy",
+            )),
         )
 
     mla = None
@@ -361,7 +368,8 @@ def normalize_config(raw: dict, model_name: str = "") -> ModelConfig:
         param_bytes_per_element=pbpe,
         partial_rotary_factor=float(_get(cfg, "partial_rotary_factor", default=1.0)),
         extra={k: v for k, v in cfg.items()
-               if k in ("moe_intermediate_size", "num_attention_groups", "rotary_dim")},
+               if k in ("moe_intermediate_size", "num_attention_groups",
+                        "rotary_dim", "rope_interleave")},
     )
 
 

@@ -13,5 +13,6 @@ from parallax_tpu.models.registry import MODEL_REGISTRY, get_model_class
 
 # Import model modules for their registration side effects.
 from parallax_tpu.models import qwen3_moe  # noqa: F401  (registers MoE archs)
+from parallax_tpu.models import deepseek_v3  # noqa: F401  (registers MLA archs)
 
 __all__ = ["StageModel", "BatchInputs", "MODEL_REGISTRY", "get_model_class"]

@@ -1,0 +1,262 @@
+"""DeepSeek-V2/V3 (and Kimi-K2) stage model: MLA + sigmoid-routed MoE.
+
+Capability parity: reference ``src/parallax/models/deepseek_v3.py`` (MLA
+compressed latent cache + mla_paged_attention). The TPU design runs decode
+AND prefill in the absorbed form over the latent cache (``ops/mla.py``):
+per-token HBM is kv_lora_rank + rope_dim, independent of head count.
+
+Weight names follow HF ``DeepseekV3ForCausalLM``:
+q_a_proj/q_a_layernorm/q_b_proj (or q_proj when q_lora_rank is null),
+kv_a_proj_with_mqa/kv_a_layernorm/kv_b_proj, o_proj; MoE:
+mlp.gate.{weight,e_score_correction_bias}, mlp.experts.{i}.*,
+mlp.shared_experts.* ; first_k_dense_replace leading dense layers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from parallax_tpu.models import layers as L
+from parallax_tpu.models.base import BatchInputs, StageModel
+from parallax_tpu.models.moe import moe_ffn
+from parallax_tpu.models.qwen3_moe import MoEStageModel
+from parallax_tpu.models.registry import register_model
+from parallax_tpu.ops.mla import (
+    mla_ragged_attention_xla,
+    mla_rope_permute,
+    new_mla_pages,
+    store_mla_cache,
+)
+from parallax_tpu.ops.rope import apply_rope
+
+
+@register_model(
+    "DeepseekV2ForCausalLM", "DeepseekV3ForCausalLM", "KimiK2ForCausalLM"
+)
+class DeepseekStageModel(MoEStageModel):
+    """MLA attention + (mostly) MoE FFN."""
+
+    def __init__(self, *args, **kwargs):
+        StageModel.__init__(self, *args, **kwargs)  # skip MoE __init__ checks
+        cfg = self.config
+        if cfg.mla is None:
+            raise ValueError("DeepSeek family requires MLA config")
+        # Rope covers only the rope head dims, not the full (nope+rope) head.
+        from parallax_tpu.ops.rope import (
+            rope_frequencies,
+            rope_table,
+            yarn_mscale,
+        )
+
+        inv = rope_frequencies(
+            cfg.mla.qk_rope_head_dim, cfg.rope_theta, cfg.rope_scaling
+        )
+        self.cos_table, self.sin_table = rope_table(
+            inv, cfg.max_position_embeddings
+        )
+        # YaRN magnitude correction folds into the softmax scale
+        # (HF DeepseekV3Attention: scaling *= mscale^2 when mscale_all_dim).
+        self.sm_scale = (
+            cfg.mla.qk_nope_head_dim + cfg.mla.qk_rope_head_dim
+        ) ** -0.5
+        rs = cfg.rope_scaling or {}
+        if rs.get("rope_type", rs.get("type")) == "yarn":
+            mscale_all = float(rs.get("mscale_all_dim", 0) or 0)
+            if mscale_all:
+                m_ = yarn_mscale(float(rs.get("factor", 1.0)), mscale_all)
+                self.sm_scale = self.sm_scale * m_ * m_
+        if cfg.moe is not None and self.tp_size > 1 and (
+            cfg.moe.num_experts % self.tp_size
+        ):
+            raise ValueError("num_experts not divisible by tp")
+        # MLA shards heads over tp like GQA would; latent cache is shared
+        # (replicated) across chips because it is head-independent.
+
+    # -- cache -------------------------------------------------------------
+
+    def new_kv_caches(self, num_pages, page_size, dtype=jnp.bfloat16):
+        m = self.config.mla
+        return [
+            new_mla_pages(num_pages, page_size, m.kv_lora_rank,
+                          m.qk_rope_head_dim, dtype)
+            for _ in range(self.num_local_layers)
+        ]
+
+    # -- layers ------------------------------------------------------------
+
+    def _decoder_layer(self, lp, x, kv, inputs: BatchInputs, window):
+        cfg = self.config
+        h = L.rms_norm(x, lp["input_layernorm"]["weight"], cfg.rms_norm_eps)
+        attn_out, kv = self._mla_attention(lp["self_attn"], h, kv, inputs)
+        x = x + attn_out
+        h = L.rms_norm(x, lp["post_attention_layernorm"]["weight"],
+                       cfg.rms_norm_eps)
+        x = x + self._mlp(lp, h)
+        return x, kv
+
+    def _mla_attention(self, p, x, cache, inputs: BatchInputs):
+        cfg = self.config
+        m = cfg.mla
+        t = x.shape[0]
+        dn, dr, dv, r = (
+            m.qk_nope_head_dim, m.qk_rope_head_dim, m.v_head_dim,
+            m.kv_lora_rank,
+        )
+
+        # Query path (optionally low-rank).
+        if "q_a_proj" in p:
+            q = L.linear(x, p["q_a_proj"])
+            q = L.rms_norm(q, p["q_a_layernorm"]["weight"], cfg.rms_norm_eps)
+            q = L.linear(q, p["q_b_proj"])
+        else:
+            q = L.linear(x, p["q_proj"])
+        hq = q.shape[-1] // (dn + dr)
+        q = q.reshape(t, hq, dn + dr)
+        q_nope, q_pe = q[..., :dn], q[..., dn:]
+
+        # KV path: compressed latent + shared rope key.
+        kv_a = L.linear(x, p["kv_a_proj_with_mqa"])
+        latent, k_pe = kv_a[..., :r], kv_a[..., r:]
+        latent = L.rms_norm(latent, p["kv_a_layernorm"]["weight"],
+                            cfg.rms_norm_eps)
+
+        # DeepSeek checkpoints use interleaved rope weights: permute the
+        # rope dims, then standard rotate-half (HF rope_interleave flag).
+        if cfg.extra.get("rope_interleave", True):
+            q_pe = mla_rope_permute(q_pe)
+            k_pe = mla_rope_permute(k_pe)
+        q_pe = apply_rope(q_pe, inputs.positions, self.cos_table, self.sin_table)
+        k_pe = apply_rope(k_pe, inputs.positions, self.cos_table, self.sin_table)
+
+        cache = store_mla_cache(cache, latent, k_pe, inputs.slot_mapping)
+
+        # Absorb W_UK into the query: kv_b_proj [Hq*(dn+dv), R].
+        w_kv_b = p["kv_b_proj"]["weight"].reshape(hq, dn + dv, r)
+        w_uk = w_kv_b[:, :dn, :]           # [Hq, dn, R]
+        w_uv = w_kv_b[:, dn:, :]           # [Hq, dv, R]
+        q_latent = jnp.einsum(
+            "thd,hdr->thr", q_nope, w_uk, preferred_element_type=jnp.float32
+        ).astype(x.dtype)
+
+        out_latent = mla_ragged_attention_xla(
+            q_latent,
+            q_pe,
+            cache,
+            inputs.kv_lens,
+            inputs.page_indices,
+            inputs.cu_q_lens,
+            inputs.num_seqs,
+            sm_scale=self.sm_scale,
+            kv_lora_rank=r,
+        )
+        out = jnp.einsum(
+            "thr,hdr->thd", out_latent, w_uv,
+            preferred_element_type=jnp.float32,
+        ).astype(x.dtype)
+        out = L.row_parallel_linear(
+            out.reshape(t, hq * dv), p["o_proj"], self.axis_name
+        )
+        return out, cache
+
+    def _mlp(self, lp: dict, h: jax.Array) -> jax.Array:
+        mlp = lp["mlp"]
+        if "experts" in mlp:
+            return moe_ffn(
+                h, mlp, self.config.moe,
+                axis_name=self.axis_name,
+                use_megablox=self.use_pallas,
+            )
+        return L.swiglu_mlp(h, mlp, axis_name=self.axis_name)
+
+    def finalize_params(self, tree: dict) -> dict:
+        tree = super().finalize_params(tree)
+        # HF names shared experts "shared_experts"; moe_ffn expects
+        # "shared_expert".
+        for layer in tree.get("layers", []):
+            mlp = layer.get("mlp")
+            if isinstance(mlp, dict) and "shared_experts" in mlp:
+                mlp["shared_expert"] = mlp.pop("shared_experts")
+        return tree
+
+    # -- init --------------------------------------------------------------
+
+    def init_params(self, rng, dtype=jnp.bfloat16) -> dict:
+        cfg = self.config
+        m = cfg.mla
+        params = StageModel.init_params(self, rng, dtype)
+
+        def dense(key, out_dim, in_dim):
+            return {"weight": (
+                jax.random.normal(key, (out_dim, in_dim), jnp.float32)
+                * (in_dim**-0.5)
+            ).astype(dtype)}
+
+        hq = cfg.num_attention_heads
+        dn, dr, dv, r = (
+            m.qk_nope_head_dim, m.qk_rope_head_dim, m.v_head_dim,
+            m.kv_lora_rank,
+        )
+        for li in range(self.num_local_layers):
+            gi = self.start_layer + li
+            key = jax.random.fold_in(rng, 9000 + gi)
+            k = jax.random.split(key, 6)
+            attn = {
+                "kv_a_proj_with_mqa": dense(k[0], r + dr, cfg.hidden_size),
+                "kv_a_layernorm": {"weight": jnp.ones((r,), dtype)},
+                "kv_b_proj": dense(k[1], hq * (dn + dv), r),
+                "o_proj": dense(k[2], cfg.hidden_size, hq * dv),
+            }
+            if m.q_lora_rank:
+                attn["q_a_proj"] = dense(k[3], m.q_lora_rank, cfg.hidden_size)
+                attn["q_a_layernorm"] = {
+                    "weight": jnp.ones((m.q_lora_rank,), dtype)
+                }
+                attn["q_b_proj"] = dense(k[4], hq * (dn + dr), m.q_lora_rank)
+            else:
+                attn["q_proj"] = dense(k[3], hq * (dn + dr), cfg.hidden_size)
+            params["layers"][li]["self_attn"] = attn
+
+            if cfg.moe is not None and cfg.is_moe_layer(gi):
+                e, h_, i = (cfg.moe.num_experts, cfg.hidden_size,
+                            cfg.moe.moe_intermediate_size)
+                km = jax.random.split(jax.random.fold_in(rng, 7000 + gi), 8)
+                mlp_params = {
+                    "gate": {
+                        "weight": (
+                            jax.random.normal(km[0], (e, h_), jnp.float32)
+                            * h_**-0.5
+                        ).astype(dtype),
+                        "e_score_correction_bias": jnp.zeros((e,), jnp.float32),
+                    },
+                    "experts": {
+                        "gate_proj": (
+                            jax.random.normal(km[1], (e, i, h_), jnp.float32)
+                            * h_**-0.5
+                        ).astype(dtype),
+                        "up_proj": (
+                            jax.random.normal(km[2], (e, i, h_), jnp.float32)
+                            * h_**-0.5
+                        ).astype(dtype),
+                        "down_proj": (
+                            jax.random.normal(km[3], (e, h_, i), jnp.float32)
+                            * i**-0.5
+                        ).astype(dtype),
+                    },
+                }
+                if cfg.moe.num_shared_experts > 0:
+                    si = (cfg.moe.shared_expert_intermediate_size
+                          or i) * cfg.moe.num_shared_experts
+                    mlp_params["shared_expert"] = {
+                        "gate_proj": {"weight": (
+                            jax.random.normal(km[4], (si, h_), jnp.float32)
+                            * h_**-0.5).astype(dtype)},
+                        "up_proj": {"weight": (
+                            jax.random.normal(km[5], (si, h_), jnp.float32)
+                            * h_**-0.5).astype(dtype)},
+                        "down_proj": {"weight": (
+                            jax.random.normal(km[6], (h_, si), jnp.float32)
+                            * si**-0.5).astype(dtype)},
+                    }
+                params["layers"][li]["mlp"] = mlp_params
+        return params

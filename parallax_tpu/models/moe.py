@@ -25,9 +25,18 @@ from parallax_tpu.models.layers import linear
 
 
 def route_topk(
-    x: jax.Array, router_weight: jax.Array, moe: MoEConfig
+    x: jax.Array,
+    router_weight: jax.Array,
+    moe: MoEConfig,
+    bias: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
-    """Router: returns (weights f32[T, K], expert_ids i32[T, K])."""
+    """Router: returns (weights f32[T, K], expert_ids i32[T, K]).
+
+    DeepSeek-V3 extras: ``bias`` (e_score_correction_bias) shifts the
+    *selection* scores only — gate weights come from the unbiased scores —
+    and ``n_group``/``topk_group`` restrict selection to the best expert
+    groups (group score = sum of each group's top-2 biased scores).
+    """
     logits = jax.lax.dot_general(
         x, router_weight,
         dimension_numbers=(((1,), (1,)), ((), ())),
@@ -37,7 +46,28 @@ def route_topk(
         scores = jax.nn.sigmoid(logits)
     else:
         scores = jax.nn.softmax(logits, axis=-1)
-    weights, ids = jax.lax.top_k(scores, moe.num_experts_per_tok)
+
+    selection = scores if bias is None else scores + bias.astype(jnp.float32)
+    if moe.n_group > 1 and moe.topk_group > 0:
+        t, e = selection.shape
+        per_group = selection.reshape(t, moe.n_group, e // moe.n_group)
+        if moe.topk_method == "group_limited_greedy":
+            # DeepSeek-V2: a group scores as its best expert.
+            group_score = jnp.max(per_group, axis=-1)
+        else:
+            # DeepSeek-V3 noaux_tc: sum of each group's top-2 biased scores.
+            group_score = jnp.sum(
+                jax.lax.top_k(per_group, min(2, e // moe.n_group))[0], axis=-1
+            )
+        _, top_groups = jax.lax.top_k(group_score, moe.topk_group)
+        group_mask = jnp.zeros((t, moe.n_group), bool).at[
+            jnp.arange(t)[:, None], top_groups
+        ].set(True)
+        mask = jnp.repeat(group_mask, e // moe.n_group, axis=-1)
+        selection = jnp.where(mask, selection, -jnp.inf)
+
+    _, ids = jax.lax.top_k(selection, moe.num_experts_per_tok)
+    weights = jnp.take_along_axis(scores, ids, axis=-1)
     if moe.norm_topk_prob:
         weights = weights / jnp.maximum(
             jnp.sum(weights, axis=-1, keepdims=True), 1e-20
@@ -117,7 +147,8 @@ def moe_ffn(
     if use_megablox is None:
         use_megablox = jax.default_backend() == "tpu"
 
-    weights, ids = route_topk(x, p["gate"]["weight"], moe)
+    bias = p["gate"].get("e_score_correction_bias")
+    weights, ids = route_topk(x, p["gate"]["weight"], moe, bias=bias)
     num_local = p["experts"]["gate_proj"].shape[0]
     if axis_name is not None:
         expert_offset = jax.lax.axis_index(axis_name) * num_local

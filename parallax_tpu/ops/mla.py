@@ -1,0 +1,117 @@
+"""Multi-head latent attention (MLA) over a compressed paged cache.
+
+Capability parity: reference MLA kernels
+(``src/parallax_extensions/kernels/mla``, facade ``ops.py:73-121``:
+softmax(q_latent . latent^T + q_pe . rope^T) . latent) and the DSA latent
+cache (``src/parallax/server/cache/dsa_cache.py``).
+
+The cache stores, per token, only the compressed latent (kv_lora_rank) and
+the shared rope key (qk_rope_head_dim) — the "absorbed" decode form: W_UK
+folds into the query, W_UV applies after attention, so HBM per token is
+~R+Dr instead of 2*H*D.
+
+Cache layout per MLA layer:  [num_pages, page_size, 1, R + Dr]
+(the singleton axis keeps the page-gather code shared with regular KV).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def new_mla_pages(
+    num_pages: int, page_size: int, kv_lora_rank: int, rope_dim: int,
+    dtype=jnp.bfloat16,
+) -> jax.Array:
+    return jnp.zeros((num_pages, page_size, 1, kv_lora_rank + rope_dim), dtype)
+
+
+def store_mla_cache(
+    cache: jax.Array,
+    latent: jax.Array,      # [T, R]
+    k_pe: jax.Array,        # [T, Dr]
+    slot_mapping: jax.Array,
+) -> jax.Array:
+    """Scatter latent+rope rows (reference reshape_and_cache DSA variant,
+    ops.py:370-413)."""
+    p, page, _, width = cache.shape
+    row = jnp.concatenate([latent, k_pe], axis=-1).astype(cache.dtype)
+    flat = cache.reshape(p * page, width)
+    slots = jnp.where(slot_mapping < 0, p * page, slot_mapping)
+    flat = flat.at[slots].set(row, mode="drop")
+    return flat.reshape(p, page, 1, width)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "kv_lora_rank"))
+def mla_ragged_attention_xla(
+    q_latent: jax.Array,     # [T, Hq, R]   (q_nope absorbed through W_UK)
+    q_pe: jax.Array,         # [T, Hq, Dr]
+    cache: jax.Array,        # [P, page, 1, R + Dr]
+    kv_lens: jax.Array,      # i32[S]
+    page_indices: jax.Array, # i32[S, pages_per_seq]
+    cu_q_lens: jax.Array,    # i32[S+1]
+    num_seqs: jax.Array,     # i32[1]
+    *,
+    sm_scale: float,
+    kv_lora_rank: int,
+) -> jax.Array:
+    """Returns attention output in latent space: [T, Hq, R].
+
+    The caller up-projects with W_UV. Jittable XLA fallback with the same
+    gather strategy as ``_ragged_paged_attention_xla``; a Pallas flash
+    variant is the optimization path on TPU.
+    """
+    t, hq, r = q_latent.shape
+    p, page_size, _, width = cache.shape
+    s, pages_per_seq = page_indices.shape
+    kv_cap = pages_per_seq * page_size
+
+    token_ids = jnp.arange(t, dtype=jnp.int32)
+    seq_of_tok = (
+        jnp.searchsorted(cu_q_lens[1:], token_ids, side="right")
+        .clip(0, s - 1).astype(jnp.int32)
+    )
+    q_len = cu_q_lens[seq_of_tok + 1] - cu_q_lens[seq_of_tok]
+    q_pos = kv_lens[seq_of_tok] - q_len + (token_ids - cu_q_lens[seq_of_tok])
+
+    rows = cache[page_indices.reshape(-1), :, 0, :].reshape(s, kv_cap, width)
+    latent_seq = rows[..., :kv_lora_rank]
+    rope_seq = rows[..., kv_lora_rank:]
+    latent_tok = latent_seq[seq_of_tok]   # [T, L, R]
+    rope_tok = rope_seq[seq_of_tok]       # [T, L, Dr]
+
+    scores = (
+        jnp.einsum("thr,tlr->thl", q_latent, latent_tok,
+                   preferred_element_type=jnp.float32)
+        + jnp.einsum("thd,tld->thl", q_pe, rope_tok,
+                     preferred_element_type=jnp.float32)
+    ) * sm_scale
+
+    kv_pos = jnp.arange(kv_cap, dtype=jnp.int32)
+    valid = (kv_pos[None, :] <= q_pos[:, None]) & (
+        kv_pos[None, :] < kv_lens[seq_of_tok][:, None]
+    )
+    scores = jnp.where(valid[:, None, :], scores, _MASK_VALUE)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    unnorm = jnp.exp(scores - m)
+    probs = unnorm / jnp.maximum(
+        jnp.sum(unnorm, axis=-1, keepdims=True), 1e-30
+    )
+    out = jnp.einsum("thl,tlr->thr", probs.astype(latent_tok.dtype),
+                     latent_tok, preferred_element_type=jnp.float32)
+    return out.astype(q_latent.dtype)
+
+
+def mla_rope_permute(x: jax.Array) -> jax.Array:
+    """DeepSeek's rope-dim interleave (HF modeling convention): view the
+    last dim as [d/2, 2], transpose, flatten — applied to q_pe/k_pe before
+    the standard rotate-half rope."""
+    *lead, d = x.shape
+    return (
+        x.reshape(*lead, d // 2, 2).swapaxes(-1, -2).reshape(*lead, d)
+    )

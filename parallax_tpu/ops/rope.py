@@ -51,10 +51,44 @@ def rope_frequencies(
             inv_freq,
             jnp.where(wavelen > orig / low, inv_freq / factor, mid),
         )
-    elif rtype in ("yarn", "dynamic"):
-        # Conservative fallback: plain interpolation by factor.
+    elif rtype == "yarn":
+        # NTK-by-parts: extrapolate fast-rotating dims, interpolate slow
+        # ones, linear ramp between the beta_fast/beta_slow boundaries.
+        orig = float(rope_scaling.get(
+            "original_max_position_embeddings", 4096
+        ))
+        beta_fast = float(rope_scaling.get("beta_fast", 32.0))
+        beta_slow = float(rope_scaling.get("beta_slow", 1.0))
+
+        def correction_dim(num_rotations: float) -> float:
+            return (
+                rot_dim
+                * math.log(orig / (num_rotations * 2.0 * math.pi))
+                / (2.0 * math.log(rope_theta))
+            )
+
+        low = max(math.floor(correction_dim(beta_fast)), 0)
+        high = min(math.ceil(correction_dim(beta_slow)), rot_dim // 2 - 1)
+        ramp = jnp.clip(
+            (jnp.arange(rot_dim // 2, dtype=jnp.float32) - low)
+            / max(high - low, 1e-3),
+            0.0, 1.0,
+        )
+        extrapolation_factor = 1.0 - ramp
+        inv_freq = (
+            inv_freq / factor * (1.0 - extrapolation_factor)
+            + inv_freq * extrapolation_factor
+        )
+    elif rtype == "dynamic":
         inv_freq = inv_freq / factor
     return inv_freq
+
+
+def yarn_mscale(scale: float, mscale: float = 1.0) -> float:
+    """YaRN attention magnitude correction (DeepSeek convention)."""
+    if scale <= 1.0 or mscale == 0:
+        return 1.0
+    return 0.1 * mscale * math.log(scale) + 1.0
 
 
 def rope_table(

@@ -21,7 +21,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 shard_map = jax.shard_map
 
 # param paths (last two key segments) -> PartitionSpec
-_COLUMN = {"q_proj", "k_proj", "v_proj", "gate_proj", "up_proj"}
+_COLUMN = {
+    "q_proj", "k_proj", "v_proj", "gate_proj", "up_proj",
+    # MLA head-sharded projections (DeepSeek): outputs are per-head.
+    "q_b_proj", "kv_b_proj",
+}
 _ROW = {"o_proj", "down_proj"}
 
 
@@ -60,6 +64,21 @@ def stage_param_specs(params: dict) -> dict:
 KV_SPEC = P(None, None, "tp", None)  # [pages, page, 2*Hkv, D]
 
 
+def kv_partition_specs(model) -> list:
+    """Per-layer KV cache specs: GQA pages shard on the combined-head axis;
+    MLA latent pages are head-independent and stay replicated."""
+    from parallax_tpu.config import LAYER_MLA
+
+    specs = []
+    for li in range(model.num_local_layers):
+        gi = model.start_layer + li
+        if model.config.layer_type(gi) == LAYER_MLA:
+            specs.append(P())
+        else:
+            specs.append(KV_SPEC)
+    return specs
+
+
 def shard_params(params: dict, mesh: Mesh) -> dict:
     """Place a (host/global) param tree onto the mesh with TP sharding."""
     specs = stage_param_specs(params)
@@ -85,12 +104,13 @@ def tp_stage_fn(model, params_template: dict, mesh: Mesh):
     def fn(params, kv_caches, inputs):
         return model(params, kv_caches, inputs)
 
+    kv_specs = kv_partition_specs(model)
     in_specs = (
         param_specs,
-        [KV_SPEC] * model.num_local_layers,
+        kv_specs,
         P(),   # BatchInputs: replicated on every chip
     )
-    out_specs = (P(), [KV_SPEC] * model.num_local_layers)
+    out_specs = (P(), kv_specs)
     if tp == 1:
         return fn
     return shard_map(

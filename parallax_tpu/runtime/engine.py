@@ -84,11 +84,11 @@ class StageEngine:
             # full unsharded cache at startup.
             from jax.sharding import NamedSharding
 
-            from parallax_tpu.parallel.tp import KV_SPEC
+            from parallax_tpu.parallel.tp import kv_partition_specs
 
             shardings = [
-                NamedSharding(mesh, KV_SPEC)
-            ] * model.num_local_layers
+                NamedSharding(mesh, s) for s in kv_partition_specs(model)
+            ]
             self.kv = jax.jit(
                 lambda: model.new_kv_caches(
                     self.cfg.num_pages, self.cfg.page_size, kv_dtype
