@@ -1,0 +1,1 @@
+"""Serving benchmark harness (client side)."""

@@ -1,0 +1,289 @@
+"""Online serving benchmark: load generation + latency metrics.
+
+Capability parity: reference ``src/backend/benchmark/benchmark_serving.py``
+(1,417 LoC, vLLM-derived): request-rate Poisson/gamma arrivals, concurrency
+caps, dataset samplers (random + file-based conversations), and the metric
+set — TTFT / TPOT / ITL / E2E (mean, median, std, p99), request and token
+throughput, goodput vs SLOs. Implemented fresh on asyncio + aiohttp against
+any OpenAI-compatible endpoint (ours or others').
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import dataclasses
+import json
+import random
+import time
+
+import numpy as np
+
+from parallax_tpu.utils import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class RequestSpec:
+    prompt: str
+    prompt_len: int
+    max_tokens: int
+
+
+@dataclasses.dataclass
+class RequestResult:
+    ok: bool
+    prompt_len: int = 0
+    output_len: int = 0
+    ttft_s: float = 0.0
+    latency_s: float = 0.0
+    itls: list[float] = dataclasses.field(default_factory=list)
+    error: str = ""
+
+
+# -- load model -------------------------------------------------------------
+
+
+def sample_random_requests(
+    num: int, input_len: int, output_len: int, seed: int = 0,
+    vocab_words: list[str] | None = None,
+) -> list[RequestSpec]:
+    """Random prompts (reference random dataset mode)."""
+    rng = random.Random(seed)
+    words = vocab_words or [
+        "alpha", "bravo", "charlie", "delta", "echo", "foxtrot", "golf",
+        "hotel", "india", "juliet", "kilo", "lima", "mike", "november",
+    ]
+    specs = []
+    for _ in range(num):
+        n = max(1, int(rng.gauss(input_len, input_len * 0.1)))
+        prompt = " ".join(rng.choice(words) for _ in range(n))
+        specs.append(RequestSpec(prompt, n, output_len))
+    return specs
+
+
+def sample_file_requests(
+    path: str, num: int, output_len: int, seed: int = 0
+) -> list[RequestSpec]:
+    """Conversation-file mode: JSON list of {"prompt": ...} or ShareGPT-style
+    {"conversations": [{"value": ...}, ...]} records."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    rng = random.Random(seed)
+    prompts = []
+    for rec in data:
+        if "prompt" in rec:
+            prompts.append(rec["prompt"])
+        elif rec.get("conversations"):
+            prompts.append(rec["conversations"][0].get("value", ""))
+    rng.shuffle(prompts)
+    return [
+        RequestSpec(p, len(p.split()), output_len)
+        for p in prompts[:num] if p
+    ]
+
+
+def arrival_times(
+    num: int, request_rate: float, burstiness: float = 1.0, seed: int = 0
+) -> list[float]:
+    """Poisson (burstiness=1) / gamma arrival offsets; inf rate => all at 0.
+    Reference: benchmark_serving.py request-rate model."""
+    if request_rate <= 0 or request_rate == float("inf"):
+        return [0.0] * num
+    rng = np.random.default_rng(seed)
+    shape = burstiness
+    scale = 1.0 / (request_rate * burstiness)
+    gaps = rng.gamma(shape, scale, size=num)
+    return np.cumsum(gaps).tolist()
+
+
+# -- client -----------------------------------------------------------------
+
+
+async def _one_request(
+    session, base_url: str, model: str, spec: RequestSpec
+) -> RequestResult:
+    payload = {
+        "model": model,
+        "messages": [{"role": "user", "content": spec.prompt}],
+        "max_tokens": spec.max_tokens,
+        "temperature": 0.0,
+        "stream": True,
+        "ignore_eos": True,
+    }
+    t0 = time.perf_counter()
+    ttft = None
+    last = t0
+    itls: list[float] = []
+    n_out = 0
+    try:
+        async with session.post(
+            f"{base_url}/v1/chat/completions", json=payload
+        ) as resp:
+            if resp.status != 200:
+                return RequestResult(
+                    ok=False, error=f"http {resp.status}: {await resp.text()}"
+                )
+            async for raw_line in resp.content:
+                line = raw_line.decode().strip()
+                if not line.startswith("data: ") or line == "data: [DONE]":
+                    continue
+                chunk = json.loads(line[6:])
+                delta = chunk["choices"][0].get("delta", {}).get("content") or \
+                    chunk["choices"][0].get("text", "")
+                now = time.perf_counter()
+                if delta:
+                    if ttft is None:
+                        ttft = now - t0
+                    else:
+                        itls.append(now - last)
+                    last = now
+                    n_out += 1
+                usage = chunk.get("usage")
+                if usage:
+                    n_out = usage.get("completion_tokens", n_out)
+    except Exception as e:
+        return RequestResult(ok=False, error=str(e))
+    return RequestResult(
+        ok=True,
+        prompt_len=spec.prompt_len,
+        output_len=n_out,
+        ttft_s=ttft or 0.0,
+        latency_s=time.perf_counter() - t0,
+        itls=itls,
+    )
+
+
+async def run_benchmark(
+    base_url: str,
+    specs: list[RequestSpec],
+    model: str = "parallax-tpu",
+    request_rate: float = float("inf"),
+    burstiness: float = 1.0,
+    max_concurrency: int | None = None,
+    seed: int = 0,
+) -> dict:
+    import aiohttp
+
+    offsets = arrival_times(len(specs), request_rate, burstiness, seed)
+    sem = asyncio.Semaphore(max_concurrency or len(specs))
+    t_start = time.perf_counter()
+
+    async with aiohttp.ClientSession(
+        timeout=aiohttp.ClientTimeout(total=1800)
+    ) as session:
+
+        async def worker(spec, offset):
+            delay = offset - (time.perf_counter() - t_start)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            async with sem:
+                return await _one_request(session, base_url, model, spec)
+
+        results = await asyncio.gather(
+            *[worker(s, o) for s, o in zip(specs, offsets)]
+        )
+    duration = time.perf_counter() - t_start
+    return compute_metrics(list(results), duration)
+
+
+# -- metrics ----------------------------------------------------------------
+
+
+def _stats(xs: list[float]) -> dict:
+    if not xs:
+        return {"mean": 0.0, "median": 0.0, "std": 0.0, "p99": 0.0}
+    a = np.asarray(xs)
+    return {
+        "mean": float(a.mean()),
+        "median": float(np.median(a)),
+        "std": float(a.std()),
+        "p99": float(np.percentile(a, 99)),
+    }
+
+
+def compute_metrics(
+    results: list[RequestResult],
+    duration_s: float,
+    goodput_slo: dict | None = None,
+) -> dict:
+    """TTFT/TPOT/ITL/E2E + throughput + goodput (reference
+    calculate_metrics, benchmark_serving.py:363-479)."""
+    ok = [r for r in results if r.ok]
+    tpots = [
+        (r.latency_s - r.ttft_s) / (r.output_len - 1)
+        for r in ok if r.output_len > 1
+    ]
+    itls = [x for r in ok for x in r.itls]
+    total_out = sum(r.output_len for r in ok)
+    total_tokens = total_out + sum(r.prompt_len for r in ok)
+
+    metrics = {
+        "completed": len(ok),
+        "failed": len(results) - len(ok),
+        "duration_s": round(duration_s, 3),
+        "request_throughput": round(len(ok) / duration_s, 3),
+        "output_token_throughput": round(total_out / duration_s, 2),
+        "total_token_throughput": round(total_tokens / duration_s, 2),
+        "ttft_s": _stats([r.ttft_s for r in ok]),
+        "tpot_s": _stats(tpots),
+        "itl_s": _stats(itls),
+        "e2e_s": _stats([r.latency_s for r in ok]),
+        "errors": [r.error for r in results if not r.ok][:5],
+    }
+    if goodput_slo:
+        good = sum(
+            1 for r in ok
+            if r.ttft_s <= goodput_slo.get("ttft_s", float("inf"))
+            and (
+                r.output_len <= 1
+                or (r.latency_s - r.ttft_s) / (r.output_len - 1)
+                <= goodput_slo.get("tpot_s", float("inf"))
+            )
+        )
+        metrics["goodput_requests_per_s"] = round(good / duration_s, 3)
+    return metrics
+
+
+# -- CLI --------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("parallax-tpu serving benchmark")
+    ap.add_argument("--base-url", default="http://127.0.0.1:8000")
+    ap.add_argument("--model", default="parallax-tpu")
+    ap.add_argument("--num-prompts", type=int, default=64)
+    ap.add_argument("--input-len", type=int, default=128)
+    ap.add_argument("--output-len", type=int, default=64)
+    ap.add_argument("--dataset", default=None, help="JSON conversations file")
+    ap.add_argument("--request-rate", type=float, default=float("inf"))
+    ap.add_argument("--burstiness", type=float, default=1.0)
+    ap.add_argument("--max-concurrency", type=int, default=None)
+    ap.add_argument("--goodput-ttft-s", type=float, default=None)
+    ap.add_argument("--goodput-tpot-s", type=float, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    if args.dataset:
+        specs = sample_file_requests(
+            args.dataset, args.num_prompts, args.output_len, args.seed
+        )
+    else:
+        specs = sample_random_requests(
+            args.num_prompts, args.input_len, args.output_len, args.seed
+        )
+    metrics = asyncio.run(run_benchmark(
+        args.base_url, specs,
+        model=args.model,
+        request_rate=args.request_rate,
+        burstiness=args.burstiness,
+        max_concurrency=args.max_concurrency,
+        seed=args.seed,
+    ))
+    print(json.dumps(metrics, indent=2))
+    return 0 if metrics["failed"] == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

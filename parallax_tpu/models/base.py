@@ -76,8 +76,15 @@ class StageModel:
             config.partial_rotary_factor,
         )
         scaling = 1.0
-        if config.rope_scaling and "attention_factor" in config.rope_scaling:
-            scaling = float(config.rope_scaling["attention_factor"])
+        if config.rope_scaling:
+            rs = config.rope_scaling
+            if "attention_factor" in rs:
+                scaling = float(rs["attention_factor"])
+            elif rs.get("rope_type", rs.get("type")) == "yarn":
+                # HF default YaRN magnitude correction on cos/sin.
+                from parallax_tpu.ops.rope import yarn_mscale
+
+                scaling = yarn_mscale(float(rs.get("factor", 1.0)))
         self.cos_table, self.sin_table = rope_table(
             inv, config.max_position_embeddings, scaling
         )

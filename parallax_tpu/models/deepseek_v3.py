@@ -19,7 +19,6 @@ import jax.numpy as jnp
 
 from parallax_tpu.models import layers as L
 from parallax_tpu.models.base import BatchInputs, StageModel
-from parallax_tpu.models.moe import moe_ffn
 from parallax_tpu.models.qwen3_moe import MoEStageModel
 from parallax_tpu.models.registry import register_model
 from parallax_tpu.ops.mla import (
@@ -38,7 +37,7 @@ class DeepseekStageModel(MoEStageModel):
     """MLA attention + (mostly) MoE FFN."""
 
     def __init__(self, *args, **kwargs):
-        StageModel.__init__(self, *args, **kwargs)  # skip MoE __init__ checks
+        super().__init__(*args, **kwargs)  # MoE + EP divisibility checks
         cfg = self.config
         if cfg.mla is None:
             raise ValueError("DeepSeek family requires MLA config")
@@ -66,10 +65,6 @@ class DeepseekStageModel(MoEStageModel):
             if mscale_all:
                 m_ = yarn_mscale(float(rs.get("factor", 1.0)), mscale_all)
                 self.sm_scale = self.sm_scale * m_ * m_
-        if cfg.moe is not None and self.tp_size > 1 and (
-            cfg.moe.num_experts % self.tp_size
-        ):
-            raise ValueError("num_experts not divisible by tp")
         # MLA shards heads over tp like GQA would; latent cache is shared
         # (replicated) across chips because it is head-independent.
 
@@ -158,16 +153,6 @@ class DeepseekStageModel(MoEStageModel):
             out.reshape(t, hq * dv), p["o_proj"], self.axis_name
         )
         return out, cache
-
-    def _mlp(self, lp: dict, h: jax.Array) -> jax.Array:
-        mlp = lp["mlp"]
-        if "experts" in mlp:
-            return moe_ffn(
-                h, mlp, self.config.moe,
-                axis_name=self.axis_name,
-                use_megablox=self.use_pallas,
-            )
-        return L.swiglu_mlp(h, mlp, axis_name=self.axis_name)
 
     def finalize_params(self, tree: dict) -> dict:
         tree = super().finalize_params(tree)

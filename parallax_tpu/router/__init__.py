@@ -1,0 +1,1 @@
+"""Standalone HTTP load balancer over multiple serving clusters."""

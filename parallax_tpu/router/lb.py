@@ -1,0 +1,338 @@
+"""Multi-cluster load balancer: endpoint registry, health, strategies.
+
+Capability parity: reference ``src/router/main.py:1-1056`` +
+``lb_strategy.py:16-171`` — endpoint registry with periodic health probes
+of ``/cluster/status_json``, EMA TTFT/TPOT and inflight/error accounting
+per endpoint, round_robin / random / performance strategies (scored EMA +
+penalties, top-k with an exploration ratio), SSE passthrough with metric
+finalization, runtime config APIs and a throughput time series.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+import time
+from collections import deque
+
+import aiohttp
+from aiohttp import web
+
+from parallax_tpu.utils import get_logger
+
+logger = get_logger(__name__)
+
+EMA_ALPHA = 0.2
+
+
+@dataclasses.dataclass
+class Endpoint:
+    url: str
+    healthy: bool = False
+    inflight: int = 0
+    error_count: int = 0
+    total_requests: int = 0
+    ema_ttft_s: float | None = None
+    ema_tpot_s: float | None = None
+    last_probe: float = 0.0
+    status: dict = dataclasses.field(default_factory=dict)
+
+    def observe(self, ttft_s: float | None, tpot_s: float | None) -> None:
+        if ttft_s is not None:
+            self.ema_ttft_s = (
+                ttft_s if self.ema_ttft_s is None
+                else (1 - EMA_ALPHA) * self.ema_ttft_s + EMA_ALPHA * ttft_s
+            )
+        if tpot_s is not None:
+            self.ema_tpot_s = (
+                tpot_s if self.ema_tpot_s is None
+                else (1 - EMA_ALPHA) * self.ema_tpot_s + EMA_ALPHA * tpot_s
+            )
+
+    def score(self, tpot_weight: float = 10.0) -> float:
+        """Lower is better (reference lb_strategy.py:25-60)."""
+        ttft = self.ema_ttft_s if self.ema_ttft_s is not None else 1.0
+        tpot = self.ema_tpot_s if self.ema_tpot_s is not None else 0.05
+        return (
+            ttft
+            + tpot * tpot_weight
+            + 0.05 * self.inflight
+            + 0.5 * min(self.error_count, 10)
+        )
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d.pop("status", None)
+        return d
+
+
+class Strategy:
+    def pick(self, endpoints: list[Endpoint]) -> Endpoint | None:
+        raise NotImplementedError
+
+
+class RoundRobin(Strategy):
+    def __init__(self):
+        self._i = 0
+
+    def pick(self, endpoints):
+        if not endpoints:
+            return None
+        self._i = (self._i + 1) % len(endpoints)
+        return endpoints[self._i]
+
+
+class Random(Strategy):
+    def pick(self, endpoints):
+        return random.choice(endpoints) if endpoints else None
+
+
+class Performance(Strategy):
+    """Best-scored with exploration (reference 'performance' strategy)."""
+
+    def __init__(self, top_k: int = 2, explore_ratio: float = 0.1):
+        self.top_k = top_k
+        self.explore_ratio = explore_ratio
+
+    def pick(self, endpoints):
+        if not endpoints:
+            return None
+        if random.random() < self.explore_ratio:
+            return random.choice(endpoints)
+        ranked = sorted(endpoints, key=lambda e: e.score())
+        return random.choice(ranked[: max(1, self.top_k)])
+
+
+STRATEGIES = {
+    "round_robin": RoundRobin,
+    "random": Random,
+    "performance": Performance,
+}
+
+
+class Router:
+    def __init__(self, endpoints: list[str], strategy: str = "performance",
+                 probe_interval_s: float = 10.0):
+        self.endpoints = [Endpoint(url=u.rstrip("/")) for u in endpoints]
+        self.strategy: Strategy = STRATEGIES[strategy]()
+        self.strategy_name = strategy
+        self.probe_interval_s = probe_interval_s
+        # (timestamp, output_tokens) events for the 1-hour throughput series.
+        self._token_events: deque[tuple[float, int]] = deque(maxlen=100_000)
+        self.app = web.Application()
+        self.app.add_routes([
+            web.post("/v1/chat/completions", self.proxy),
+            web.post("/v1/completions", self.proxy),
+            web.get("/v1/models", self.models),
+            web.get("/router/status", self.status),
+            web.post("/router/endpoints", self.add_endpoint),
+            web.delete("/router/endpoints", self.remove_endpoint),
+            web.post("/router/strategy", self.set_strategy),
+            web.get("/router/throughput", self.throughput_series),
+            web.get("/health", lambda r: web.json_response({"status": "ok"})),
+        ])
+        self.app.cleanup_ctx.append(self._background)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def _background(self, app):
+        import asyncio
+
+        session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=1800)
+        )
+        app["session"] = session
+        task = asyncio.create_task(self._probe_loop(session))
+        yield
+        task.cancel()
+        await session.close()
+
+    async def _probe_loop(self, session):
+        import asyncio
+
+        while True:
+            for ep in list(self.endpoints):
+                try:
+                    async with session.get(
+                        f"{ep.url}/cluster/status_json",
+                        timeout=aiohttp.ClientTimeout(total=5),
+                    ) as resp:
+                        ep.healthy = resp.status == 200
+                        if ep.healthy:
+                            ep.status = await resp.json()
+                            ep.error_count = max(0, ep.error_count - 1)
+                except Exception:
+                    ep.healthy = False
+                ep.last_probe = time.time()
+            await asyncio.sleep(self.probe_interval_s)
+
+    # -- proxy -------------------------------------------------------------
+
+    async def proxy(self, request: web.Request):
+        healthy = [e for e in self.endpoints if e.healthy]
+        ep = self.strategy.pick(healthy)
+        if ep is None:
+            return web.json_response(
+                {"error": {"message": "no healthy endpoints"}}, status=503
+            )
+        body = await request.read()
+        try:
+            payload = json.loads(body)
+        except Exception:
+            return web.json_response(
+                {"error": {"message": "invalid JSON"}}, status=400
+            )
+        ep.inflight += 1
+        ep.total_requests += 1
+        t0 = time.perf_counter()
+        session: aiohttp.ClientSession = request.app["session"]
+        try:
+            if payload.get("stream"):
+                return await self._proxy_stream(
+                    request, session, ep, body, t0
+                )
+            async with session.post(
+                f"{ep.url}{request.path}", data=body,
+                headers={"Content-Type": "application/json"},
+            ) as upstream:
+                data = await upstream.read()
+                if upstream.status == 200:
+                    self._finalize_json_metrics(ep, data, t0)
+                else:
+                    ep.error_count += 1
+                return web.Response(
+                    body=data, status=upstream.status,
+                    content_type="application/json",
+                )
+        except Exception as e:
+            ep.error_count += 1
+            return web.json_response(
+                {"error": {"message": f"upstream failed: {e}"}}, status=502
+            )
+        finally:
+            ep.inflight -= 1
+
+    async def _proxy_stream(self, request, session, ep, body, t0):
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+        })
+        await resp.prepare(request)
+        ttft = None
+        n_tokens = 0
+        usage = None
+        async with session.post(
+            f"{ep.url}{request.path}", data=body,
+            headers={"Content-Type": "application/json"},
+        ) as upstream:
+            async for chunk in upstream.content.iter_any():
+                if ttft is None and chunk.strip():
+                    ttft = time.perf_counter() - t0
+                # Inspect SSE lines for the final usage record.
+                for line in chunk.decode(errors="ignore").splitlines():
+                    if line.startswith("data: ") and '"usage"' in line:
+                        try:
+                            usage = json.loads(line[6:]).get("usage")
+                        except Exception:
+                            pass
+                await resp.write(chunk)
+        elapsed = time.perf_counter() - t0
+        if usage:
+            n_tokens = usage.get("completion_tokens", 0)
+        tpot = (
+            (elapsed - (ttft or 0.0)) / (n_tokens - 1) if n_tokens > 1 else None
+        )
+        ep.observe(ttft, tpot)
+        if n_tokens:
+            self._token_events.append((time.time(), n_tokens))
+        return resp
+
+    def _finalize_json_metrics(self, ep: Endpoint, data: bytes, t0) -> None:
+        """Non-stream responses carry usage with tokens/sec (reference
+        request_metrics.py: TPS/TTFT from the final usage chunk)."""
+        elapsed = time.perf_counter() - t0
+        try:
+            usage = json.loads(data).get("usage") or {}
+        except Exception:
+            return
+        n = usage.get("completion_tokens", 0)
+        ttft = usage.get("ttft_ms")
+        ep.observe(
+            ttft / 1e3 if ttft else None,
+            (elapsed / n) if n else None,
+        )
+        if n:
+            self._token_events.append((time.time(), n))
+
+    # -- control APIs ------------------------------------------------------
+
+    async def models(self, request):
+        session = request.app["session"]
+        for ep in self.endpoints:
+            if ep.healthy:
+                try:
+                    async with session.get(f"{ep.url}/v1/models") as r:
+                        return web.json_response(await r.json())
+                except Exception:
+                    continue
+        return web.json_response({"object": "list", "data": []})
+
+    async def status(self, _request):
+        return web.json_response({
+            "strategy": self.strategy_name,
+            "endpoints": [e.to_dict() for e in self.endpoints],
+        })
+
+    async def add_endpoint(self, request):
+        body = await request.json()
+        url = body["url"].rstrip("/")
+        if url not in [e.url for e in self.endpoints]:
+            self.endpoints.append(Endpoint(url=url))
+        return web.json_response({"endpoints": [e.url for e in self.endpoints]})
+
+    async def remove_endpoint(self, request):
+        body = await request.json()
+        url = body["url"].rstrip("/")
+        self.endpoints = [e for e in self.endpoints if e.url != url]
+        return web.json_response({"endpoints": [e.url for e in self.endpoints]})
+
+    async def set_strategy(self, request):
+        body = await request.json()
+        name = body["strategy"]
+        if name not in STRATEGIES:
+            return web.json_response(
+                {"error": {"message": f"unknown strategy {name}"}}, status=400
+            )
+        self.strategy = STRATEGIES[name]()
+        self.strategy_name = name
+        return web.json_response({"strategy": name})
+
+    async def throughput_series(self, _request):
+        """Tokens/min over the last hour (reference 1-hour series)."""
+        now = time.time()
+        buckets = [0] * 60
+        for ts, n in self._token_events:
+            age_min = int((now - ts) // 60)
+            if 0 <= age_min < 60:
+                buckets[59 - age_min] += n
+        return web.json_response({"tokens_per_minute": buckets})
+
+    def run(self, host="0.0.0.0", port=8080):
+        web.run_app(self.app, host=host, port=port, print=None)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser("parallax-tpu router")
+    ap.add_argument("--endpoints", nargs="+", required=True)
+    ap.add_argument("--strategy", default="performance",
+                    choices=sorted(STRATEGIES))
+    ap.add_argument("--port", type=int, default=8080)
+    args = ap.parse_args(argv)
+    Router(args.endpoints, args.strategy).run(port=args.port)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,200 @@
+"""Benchmark harness + router LB tests against a live local serving app.
+
+Capability parity: reference benchmark_serving metrics math + router
+endpoint registry/strategy tests.
+"""
+
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from parallax_tpu.backend.http_server import SimpleTokenizer
+from parallax_tpu.backend.serve import build_local_frontend
+from parallax_tpu.benchmark.serving import (
+    RequestResult,
+    arrival_times,
+    compute_metrics,
+    run_benchmark,
+    sample_random_requests,
+)
+from parallax_tpu.config import normalize_config
+from parallax_tpu.models.base import StageModel
+from parallax_tpu.router.lb import Endpoint, Performance, Router, RoundRobin
+from parallax_tpu.runtime.engine import EngineConfig, StageEngine
+
+TINY = normalize_config(dict(
+    architectures=["Qwen2ForCausalLM"],
+    hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+    num_key_value_heads=2, intermediate_size=128, vocab_size=266,
+))
+
+
+def tiny_frontend():
+    m = StageModel(TINY, 0, 2, use_pallas=False)
+    eng = StageEngine(
+        m, m.init_params(jax.random.key(0), dtype=jnp.float32),
+        EngineConfig(page_size=8, num_pages=256, max_model_len=512,
+                     kv_dtype="float32"),
+    )
+    return build_local_frontend([eng], SimpleTokenizer(), model_name="tiny")
+
+
+class TestMetricsMath:
+    def test_stats_and_throughput(self):
+        results = [
+            RequestResult(ok=True, prompt_len=10, output_len=5,
+                          ttft_s=0.1, latency_s=0.5, itls=[0.1] * 4),
+            RequestResult(ok=True, prompt_len=20, output_len=5,
+                          ttft_s=0.2, latency_s=0.6, itls=[0.1] * 4),
+            RequestResult(ok=False, error="boom"),
+        ]
+        m = compute_metrics(results, duration_s=2.0)
+        assert m["completed"] == 2 and m["failed"] == 1
+        assert m["output_token_throughput"] == 5.0
+        assert m["total_token_throughput"] == 20.0
+        np.testing.assert_allclose(m["ttft_s"]["mean"], 0.15)
+        np.testing.assert_allclose(m["tpot_s"]["mean"], 0.1)
+
+    def test_goodput_slo(self):
+        results = [
+            RequestResult(ok=True, output_len=5, ttft_s=0.1, latency_s=0.5),
+            RequestResult(ok=True, output_len=5, ttft_s=9.0, latency_s=9.4),
+        ]
+        m = compute_metrics(results, 1.0, goodput_slo={"ttft_s": 1.0})
+        assert m["goodput_requests_per_s"] == 1.0
+
+    def test_poisson_arrivals_monotonic(self):
+        times = arrival_times(100, request_rate=10.0, seed=1)
+        assert all(b >= a for a, b in zip(times, times[1:]))
+        # ~10 rps over 100 requests => ~10s span, loose bounds
+        assert 3.0 < times[-1] < 30.0
+
+    def test_inf_rate_all_at_zero(self):
+        assert arrival_times(5, float("inf")) == [0.0] * 5
+
+
+def test_benchmark_against_live_server():
+    fe, runner = tiny_frontend()
+
+    async def go():
+        server = TestServer(fe.app)
+        client = TestClient(server)
+        await client.start_server()
+        try:
+            base = f"http://{client.host}:{client.port}"
+            specs = sample_random_requests(6, input_len=8, output_len=5)
+            return await run_benchmark(
+                base, specs, request_rate=float("inf"), max_concurrency=3
+            )
+        finally:
+            await client.close()
+
+    loop = asyncio.new_event_loop()
+    try:
+        metrics = loop.run_until_complete(go())
+    finally:
+        loop.close()
+        runner.stop()
+    assert metrics["failed"] == 0, metrics["errors"]
+    assert metrics["completed"] == 6
+    assert metrics["output_token_throughput"] > 0
+    assert metrics["ttft_s"]["mean"] > 0
+
+
+class TestRouterStrategies:
+    def make_eps(self):
+        fast = Endpoint(url="http://fast", healthy=True)
+        fast.ema_ttft_s, fast.ema_tpot_s = 0.05, 0.01
+        slow = Endpoint(url="http://slow", healthy=True)
+        slow.ema_ttft_s, slow.ema_tpot_s = 2.0, 0.2
+        return [fast, slow]
+
+    def test_performance_prefers_fast(self):
+        eps = self.make_eps()
+        strat = Performance(top_k=1, explore_ratio=0.0)
+        picks = [strat.pick(eps).url for _ in range(10)]
+        assert all(p == "http://fast" for p in picks)
+
+    def test_error_penalty_flips_choice(self):
+        eps = self.make_eps()
+        eps[0].error_count = 10
+        strat = Performance(top_k=1, explore_ratio=0.0)
+        assert strat.pick(eps).url == "http://slow"
+
+    def test_round_robin_cycles(self):
+        eps = self.make_eps()
+        rr = RoundRobin()
+        assert {rr.pick(eps).url for _ in range(4)} == {
+            "http://fast", "http://slow"
+        }
+
+    def test_ema_update(self):
+        ep = Endpoint(url="x")
+        ep.observe(1.0, 0.1)
+        ep.observe(0.0, 0.0)
+        assert 0.0 < ep.ema_ttft_s < 1.0
+
+
+def test_router_proxies_to_live_backend():
+    fe, runner = tiny_frontend()
+
+    async def go():
+        backend_server = TestServer(fe.app)
+        backend = TestClient(backend_server)
+        await backend.start_server()
+        router = Router(
+            [f"http://{backend.host}:{backend.port}"],
+            strategy="round_robin", probe_interval_s=0.2,
+        )
+        router_client = TestClient(TestServer(router.app))
+        await router_client.start_server()
+        try:
+            await asyncio.sleep(0.5)  # allow a health probe
+            status = await (await router_client.get("/router/status")).json()
+            assert status["endpoints"][0]["healthy"], status
+
+            r = await router_client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4, "temperature": 0,
+            })
+            body = await r.json()
+            assert r.status == 200, body
+            assert body["usage"]["completion_tokens"] == 4
+
+            # streaming through the proxy
+            r2 = await router_client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "go"}],
+                "max_tokens": 3, "temperature": 0, "stream": True,
+            })
+            text = await r2.text()
+            assert text.strip().endswith("data: [DONE]")
+
+            status = await (await router_client.get("/router/status")).json()
+            ep = status["endpoints"][0]
+            assert ep["total_requests"] == 2
+            assert ep["ema_tpot_s"] is not None
+
+            # runtime config: switch strategy, add/remove endpoint
+            r3 = await router_client.post(
+                "/router/strategy", json={"strategy": "random"}
+            )
+            assert (await r3.json())["strategy"] == "random"
+            r4 = await router_client.post(
+                "/router/endpoints", json={"url": "http://nowhere:1"}
+            )
+            assert len((await r4.json())["endpoints"]) == 2
+        finally:
+            await router_client.close()
+            await backend.close()
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(go())
+    finally:
+        loop.close()
+        runner.stop()
