@@ -43,6 +43,10 @@ class BatchInputs:
 class StageModel:
     """A contiguous range ``[start_layer, end_layer)`` of decoder blocks."""
 
+    # NeoX-halves rope by default; models using the GPT-J interleaved
+    # convention (GLM4) override this class attribute.
+    rope_fn = staticmethod(L.apply_rope)
+
     def __init__(
         self,
         config: ModelConfig,
@@ -232,17 +236,10 @@ class StageModel:
         logits = L.lm_head_logits(x, head)
         return logits, new_kv
 
-    def _decoder_layer(
-        self,
-        lp: dict,
-        x: jax.Array,
-        kv: jax.Array,
-        inputs: BatchInputs,
-        window: int | None,
-    ) -> tuple[jax.Array, jax.Array]:
+    def _attention(self, lp: dict, h: jax.Array, kv: jax.Array,
+                   inputs: BatchInputs, window: int | None):
         cfg = self.config
-        h = L.rms_norm(x, lp["input_layernorm"]["weight"], cfg.rms_norm_eps)
-        attn_out, kv = L.paged_attention_block(
+        return L.paged_attention_block(
             h,
             lp["self_attn"],
             kv,
@@ -258,7 +255,20 @@ class StageModel:
             sliding_window=window,
             use_pallas=self.use_pallas,
             axis_name=self.axis_name,
+            rope_fn=self.rope_fn,
         )
+
+    def _decoder_layer(
+        self,
+        lp: dict,
+        x: jax.Array,
+        kv: jax.Array,
+        inputs: BatchInputs,
+        window: int | None,
+    ) -> tuple[jax.Array, jax.Array]:
+        cfg = self.config
+        h = L.rms_norm(x, lp["input_layernorm"]["weight"], cfg.rms_norm_eps)
+        attn_out, kv = self._attention(lp, h, kv, inputs, window)
         x = x + attn_out
         h = L.rms_norm(x, lp["post_attention_layernorm"]["weight"], cfg.rms_norm_eps)
         x = x + self._mlp(lp, h)

@@ -1,0 +1,69 @@
+"""GLM-4 dense family stage model.
+
+Capability parity: reference ``src/parallax/models/glm4_moe.py`` (partial
+RoPE + GLM block conventions). GLM-4 specifics vs the llama family:
+GPT-J-interleaved partial rotary, a fused ``gate_up_proj`` MLP, and
+sandwich norms (``post_self_attn_layernorm`` / ``post_mlp_layernorm``
+applied to the sublayer outputs before the residual add).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from parallax_tpu.models import layers as L
+from parallax_tpu.models.base import BatchInputs, StageModel
+from parallax_tpu.models.registry import register_model
+from parallax_tpu.ops.rope import apply_rope_interleaved
+
+
+@register_model("Glm4ForCausalLM", "GlmForCausalLM")
+class Glm4StageModel(StageModel):
+    rope_fn = staticmethod(apply_rope_interleaved)
+
+    def finalize_params(self, tree: dict) -> dict:
+        """Split HF's fused ``gate_up_proj [2I, H]`` into gate/up halves so
+        the standard swiglu path (and its column/row TP sharding) applies —
+        ``silu(gate) * up`` with gate = first half, up = second half."""
+        for layer in tree.get("layers", []):
+            mlp = layer.get("mlp")
+            if isinstance(mlp, dict) and "gate_up_proj" in mlp:
+                w = mlp.pop("gate_up_proj")["weight"]
+                half = w.shape[0] // 2
+                mlp["gate_proj"] = {"weight": w[:half]}
+                mlp["up_proj"] = {"weight": w[half:]}
+        return tree
+
+    def _decoder_layer(self, lp, x, kv, inputs: BatchInputs, window):
+        cfg = self.config
+        h = L.rms_norm(x, lp["input_layernorm"]["weight"], cfg.rms_norm_eps)
+        attn_out, kv = self._attention(lp, h, kv, inputs, window)
+        if "post_self_attn_layernorm" in lp:
+            attn_out = L.rms_norm(
+                attn_out, lp["post_self_attn_layernorm"]["weight"],
+                cfg.rms_norm_eps,
+            )
+        x = x + attn_out
+        h = L.rms_norm(x, lp["post_attention_layernorm"]["weight"],
+                       cfg.rms_norm_eps)
+        mlp_out = self._mlp(lp, h)
+        if "post_mlp_layernorm" in lp:
+            mlp_out = L.rms_norm(
+                mlp_out, lp["post_mlp_layernorm"]["weight"], cfg.rms_norm_eps
+            )
+        return x + mlp_out, kv
+
+    def init_params(self, rng: jax.Array, dtype=jnp.bfloat16) -> dict:
+        # Base init already produces split gate/up/down; GLM only adds the
+        # sandwich norms.
+        params = super().init_params(rng, dtype)
+        cfg = self.config
+        for layer in params["layers"]:
+            layer["post_self_attn_layernorm"] = {
+                "weight": jnp.ones((cfg.hidden_size,), dtype)
+            }
+            layer["post_mlp_layernorm"] = {
+                "weight": jnp.ones((cfg.hidden_size,), dtype)
+            }
+        return params

@@ -1,0 +1,113 @@
+"""gpt-oss stage model: attention sinks + sliding windows + clamped-GLU MoE.
+
+Capability parity: reference ``src/parallax/models/gpt_oss.py`` (sinks arg
+to paged_attention + sliding window). HF conventions: per-layer
+``self_attn.sinks [Hq]``; alternating sliding/full ``layer_types``; MoE with
+``mlp.router.{weight,bias}`` (top-k over raw logits, softmax over the top-k
+values) and fused expert tensors ``experts.gate_up_proj [E, H, 2I]`` (+bias)
+interleaving gate (even cols) / up (odd cols), activation
+``(up+1) * gate*sigmoid(alpha*gate)`` with clamping, ``experts.down_proj
+[E, I, H]`` (+bias).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from parallax_tpu.models import layers as L
+from parallax_tpu.models.base import StageModel
+from parallax_tpu.models.registry import register_model
+
+ALPHA = 1.702
+LIMIT = 7.0
+
+
+def gpt_oss_moe_ffn(
+    x: jax.Array, p: dict, num_experts_per_tok: int,
+    axis_name: str | None = None,
+) -> jax.Array:
+    t, h = x.shape
+    logits = L.linear(x, p["router"]).astype(jnp.float32)     # [T, E]
+    top_vals, top_ids = jax.lax.top_k(logits, num_experts_per_tok)
+    weights = jax.nn.softmax(top_vals, axis=-1)               # over top-k only
+
+    gate_up = p["experts"]["gate_up_proj"]                    # [E, H, 2I]
+    gate_up_b = p["experts"]["gate_up_proj_bias"]             # [E, 2I]
+    down = p["experts"]["down_proj"]                          # [E, I, H]
+    down_b = p["experts"]["down_proj_bias"]                   # [E, H]
+    num_local = gate_up.shape[0]
+    offset = (
+        jax.lax.axis_index(axis_name) * num_local
+        if axis_name is not None else 0
+    )
+
+    out = jnp.zeros((t, h), jnp.float32)
+    for le in range(num_local):
+        ge = offset + le
+        hit = top_ids == ge
+        w = jnp.sum(jnp.where(hit, weights, 0.0), axis=-1)    # [T]
+        gu = jnp.einsum("th,hi->ti", x, gate_up[le],
+                        preferred_element_type=jnp.float32) + gate_up_b[le]
+        gate = jnp.minimum(gu[..., 0::2], LIMIT)
+        up = jnp.clip(gu[..., 1::2], -LIMIT, LIMIT)
+        glu = gate * jax.nn.sigmoid(gate * ALPHA)
+        y = jnp.einsum("ti,ih->th", ((up + 1.0) * glu).astype(x.dtype),
+                       down[le], preferred_element_type=jnp.float32)
+        y = y + down_b[le]
+        out = out + y * w[:, None]
+
+    # Per-expert down bias is already inside the weighted sum; under EP the
+    # partial sums add correctly because each expert lives on one device.
+    if axis_name is not None:
+        out = jax.lax.psum(out, axis_name)
+    return out.astype(x.dtype)
+
+
+@register_model("GptOssForCausalLM")
+class GptOssStageModel(StageModel):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.config.moe is None:
+            raise ValueError("gpt-oss requires MoE config (num_local_experts)")
+
+    def _mlp(self, lp: dict, h: jax.Array) -> jax.Array:
+        return gpt_oss_moe_ffn(
+            h, lp["mlp"], self.config.moe.num_experts_per_tok,
+            axis_name=self.axis_name,
+        )
+
+    def init_params(self, rng: jax.Array, dtype=jnp.bfloat16) -> dict:
+        params = super().init_params(rng, dtype)
+        cfg = self.config
+        e = cfg.moe.num_experts
+        i = cfg.moe.moe_intermediate_size or cfg.intermediate_size
+        hdim = cfg.hidden_size
+        for li, layer in enumerate(params["layers"]):
+            key = jax.random.fold_in(rng, 4000 + li)
+            k = jax.random.split(key, 4)
+            layer["self_attn"]["sinks"] = jnp.zeros(
+                (cfg.num_attention_heads,), jnp.float32
+            )
+            layer["mlp"] = {
+                "router": {
+                    "weight": (
+                        jax.random.normal(k[0], (e, hdim), jnp.float32)
+                        * hdim**-0.5
+                    ).astype(dtype),
+                    "bias": jnp.zeros((e,), dtype),
+                },
+                "experts": {
+                    "gate_up_proj": (
+                        jax.random.normal(k[1], (e, hdim, 2 * i), jnp.float32)
+                        * hdim**-0.5
+                    ).astype(dtype),
+                    "gate_up_proj_bias": jnp.zeros((e, 2 * i), dtype),
+                    "down_proj": (
+                        jax.random.normal(k[2], (e, i, hdim), jnp.float32)
+                        * i**-0.5
+                    ).astype(dtype),
+                    "down_proj_bias": jnp.zeros((e, hdim), dtype),
+                },
+            }
+        return params

@@ -95,6 +95,7 @@ def paged_attention_block(
     sliding_window: int | None = None,
     use_pallas: bool | None = None,
     axis_name: str | None = None,
+    rope_fn=apply_rope,
 ) -> tuple[jax.Array, jax.Array]:
     """GQA attention over the paged cache: project, rope, scatter, attend.
 
@@ -119,8 +120,8 @@ def paged_attention_block(
         q = rms_norm(q, p["q_norm"]["weight"], config.rms_norm_eps)
         k = rms_norm(k, p["k_norm"]["weight"], config.rms_norm_eps)
 
-    q = apply_rope(q, positions, cos_table, sin_table)
-    k = apply_rope(k, positions, cos_table, sin_table)
+    q = rope_fn(q, positions, cos_table, sin_table)
+    k = rope_fn(k, positions, cos_table, sin_table)
 
     kv_pages = reshape_and_cache(kv_pages, k, v, slot_mapping)
     out = ragged_paged_attention(

@@ -100,6 +100,35 @@ def rope_table(
     return jnp.cos(freqs) * attention_scaling, jnp.sin(freqs) * attention_scaling
 
 
+def apply_rope_interleaved(
+    x: jax.Array,
+    positions: jax.Array,
+    cos_table: jax.Array,
+    sin_table: jax.Array,
+) -> jax.Array:
+    """GPT-J/GLM-style interleaved rotary: adjacent dim pairs rotate together
+    (vs the NeoX halves convention of :func:`apply_rope`)."""
+    squeeze = x.ndim == 2
+    if squeeze:
+        x = x[:, None, :]
+    t, h, d = x.shape
+    rot = cos_table.shape[-1] * 2
+    cos = cos_table[positions][:, None, :]  # [T, 1, rot/2]
+    sin = sin_table[positions][:, None, :]
+    x_rot, x_pass = x[..., :rot], x[..., rot:]
+    x1 = x_rot[..., 0::2].astype(jnp.float32)
+    x2 = x_rot[..., 1::2].astype(jnp.float32)
+    out_even = x1 * cos - x2 * sin
+    out_odd = x2 * cos + x1 * sin
+    out = jnp.stack([out_even, out_odd], axis=-1).reshape(t, h, rot)
+    out = out.astype(x.dtype)
+    if d > rot:
+        out = jnp.concatenate([out, x_pass], axis=-1)
+    if squeeze:
+        out = out[:, 0, :]
+    return out
+
+
 def apply_rope(
     x: jax.Array,
     positions: jax.Array,

@@ -67,6 +67,8 @@ class WorkerNode:
         self._reload = threading.Event()
         self._threads: list[threading.Thread] = []
         self._allocated = threading.Event()
+        self.refit_version = 0
+        self._refit_fetching = False
         # Head-node bookkeeping: finished requests awaiting pickup.
         self._finished: queue.Queue[Request] = queue.Queue()
         self._request_events: dict[str, threading.Event] = {}
@@ -173,6 +175,7 @@ class WorkerNode:
                         "layer_latency_ms": (
                             eng.layer_latency_ms_ewma if eng else None
                         ),
+                        "refit_version": self.refit_version,
                     },
                     timeout=10.0,
                 )
@@ -190,6 +193,15 @@ class WorkerNode:
                     ) != (self.start_layer, self.end_layer):
                         # Scheduler moved us: reload on the step thread.
                         self._inbox.put(("reload", reply))
+                    elif (
+                        reply.get("refit_index")
+                        and reply.get("refit_version", 0) > self.refit_version
+                    ):
+                        self._inbox.put((
+                            "refit",
+                            reply["refit_version"],
+                            reply["refit_index"],
+                        ))
             except Exception as e:
                 logger.warning("heartbeat failed: %s", e)
             self._stop.wait(self.heartbeat_interval_s)
@@ -316,6 +328,43 @@ class WorkerNode:
                         req.abort(f"peer {peer} unreachable")
             elif kind == "reload":
                 self._apply_allocation(item[1])
+            elif kind == "refit":
+                version, index = item[1], item[2]
+                if (
+                    version <= self.refit_version
+                    or self.engine is None
+                    or self._refit_fetching
+                ):
+                    continue
+                # Download + checksum off the step thread: decoding must not
+                # stall on network IO (reference downloads in the p2p
+                # daemon, p2p/server.py:224-339).
+                self._refit_fetching = True
+                threading.Thread(
+                    target=self._fetch_refit, args=(version, index),
+                    daemon=True, name="refit-fetch",
+                ).start()
+            elif kind == "refit_apply":
+                version, tensors = item[1], item[2]
+                from parallax_tpu.p2p.refit import apply_prefetched
+
+                try:
+                    if version > self.refit_version:
+                        apply_prefetched(self.engine, tensors, version)
+                        self.refit_version = version
+                except Exception:
+                    logger.exception("refit v%d apply failed", version)
+
+    def _fetch_refit(self, version: int, index: dict) -> None:
+        from parallax_tpu.p2p.refit import fetch_refit_tensors
+
+        try:
+            tensors = fetch_refit_tensors(self.engine, index)
+            self._inbox.put(("refit_apply", version, tensors))
+        except Exception:
+            logger.exception("refit v%d fetch failed", version)
+        finally:
+            self._refit_fetching = False
 
     def _route_outputs(self, out) -> None:
         """Group packets by next hop and fire rpc_pp_forward (reference

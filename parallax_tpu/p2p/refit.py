@@ -1,0 +1,194 @@
+"""Weight refit: hot-swap stage weights while serving (RL weight push).
+
+Capability parity: reference refit pipeline (SURVEY.md section 5):
+POST ``/weight/refit`` registers ``{version, index_map}`` with the global
+scheduler -> piggybacked on heartbeat replies -> each node fetches only its
+layer range, verifies checksums, and hot-reloads; routers skip pipelines
+whose ``refit_version`` lags (``request_routing.py:841-847``).
+
+The reference moves bytes over Lattica content blocks keyed by CID; here an
+index entry is ``{"uri": file-or-http safetensors, "sha256": hex?}`` —
+content addressing with explicit transport, fetched per node.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+
+import jax
+import jax.numpy as jnp
+
+from parallax_tpu.models.loader import shard_key_filter
+from parallax_tpu.utils import get_logger
+
+logger = get_logger(__name__)
+
+
+def fetch_uri(uri: str, timeout_s: float = 120.0) -> bytes:
+    if uri.startswith("file://"):
+        path = uri[len("file://"):]
+        with open(path, "rb") as f:
+            return f.read()
+    if uri.startswith(("http://", "https://")):
+        import urllib.request
+
+        with urllib.request.urlopen(uri, timeout=timeout_s) as resp:
+            return resp.read()
+    # bare path
+    with open(uri, "rb") as f:
+        return f.read()
+
+
+def verify_checksum(data: bytes, expected_sha256: str | None) -> None:
+    if not expected_sha256:
+        return
+    got = hashlib.sha256(data).hexdigest()
+    if got != expected_sha256:
+        raise ValueError(f"refit checksum mismatch: {got} != {expected_sha256}")
+
+
+def load_refit_tensors(
+    index_map: dict,
+    start_layer: int,
+    end_layer: int,
+    num_layers: int,
+    want_embed: bool,
+    fetch=fetch_uri,
+) -> dict[str, "jnp.ndarray"]:
+    """Fetch and decode the tensors this stage needs.
+
+    ``index_map``: weight name -> uri string or {"uri":…, "sha256":…}.
+    Entries may point at per-tensor safetensors blobs or shared files
+    (fetched once, cached by uri).
+    """
+    from safetensors import numpy as st_numpy
+
+    wanted: dict[str, str] = {}
+    blob_cache: dict[str, dict] = {}
+    out: dict[str, jnp.ndarray] = {}
+    for name, entry in index_map.items():
+        local = shard_key_filter(name, start_layer, end_layer, num_layers)
+        if local is None:
+            continue
+        if local.startswith("embed_tokens") and not want_embed:
+            continue
+        uri = entry["uri"] if isinstance(entry, dict) else entry
+        sha = entry.get("sha256") if isinstance(entry, dict) else None
+        if uri not in blob_cache:
+            data = fetch(uri)
+            verify_checksum(data, sha)
+            blob_cache[uri] = st_numpy.load(data)
+        tensors = blob_cache[uri]
+        if name not in tensors:
+            raise KeyError(f"{name} missing from {uri}")
+        out[local] = jnp.asarray(tensors[name])
+    return out
+
+
+def _locate(params: dict, local_path: str):
+    """Resolve a local weight path to (container, key, expert_index).
+
+    Handles per-expert checkpoint paths (``layers.N.mlp.experts.3.
+    gate_proj.weight``) landing in the *stacked* expert arrays that
+    ``finalize_params`` produced at load time: the new tensor replaces one
+    row of the stacked array.
+    """
+    parts = local_path.split(".")
+    node = params
+    i = 0
+    while i < len(parts) - 1:
+        part = parts[i]
+        child = node[int(part)] if isinstance(node, list) else node.get(part)
+        if (
+            part == "experts"
+            and isinstance(child, dict)
+            and i + 1 < len(parts)
+            and parts[i + 1].isdigit()
+            and parts[i + 1] not in child
+        ):
+            # Stacked experts: parts = [..., "experts", idx, proj, "weight"].
+            expert_idx = int(parts[i + 1])
+            proj = parts[i + 2]
+            return child, proj, expert_idx
+        node = child
+        i += 1
+    return node, parts[-1], None
+
+
+def fetch_refit_tensors(engine, index_map: dict, fetch=fetch_uri) -> dict:
+    """Download + verify this stage's tensors (no engine mutation — safe to
+    run off the step thread so decoding never stalls on network IO)."""
+    model = engine.model
+    cfg = model.config
+    want_embed = model.is_first or (model.is_last and cfg.tie_word_embeddings)
+    return load_refit_tensors(
+        index_map, model.start_layer, model.end_layer,
+        cfg.num_hidden_layers, want_embed, fetch,
+    )
+
+
+def apply_refit(engine, index_map: dict, version: int, fetch=fetch_uri) -> int:
+    """Fetch + hot-swap in one call (tests / synchronous callers)."""
+    tensors = fetch_refit_tensors(engine, index_map, fetch)
+    return apply_prefetched(engine, tensors, version)
+
+
+def apply_prefetched(engine, tensors: dict, version: int) -> int:
+    """Hot-swap pre-fetched tensors. Returns tensors replaced.
+
+    Two phases for atomicity: every tensor is located and shape-checked
+    first; only then are the leaves swapped — a bad entry leaves the
+    serving weights untouched instead of half-updated (the reference's
+    update_weight_from_disk semantics, shard_loader.py:560-653).
+    """
+    model = engine.model
+    if not tensors:
+        return 0
+
+    params = engine.params
+    staged = []
+    for local_path, arr in tensors.items():
+        container, key, expert_idx = _locate(params, local_path)
+        old = container[key]
+        expected = old.shape[1:] if expert_idx is not None else old.shape
+        if tuple(expected) != tuple(arr.shape):
+            raise ValueError(
+                f"refit shape mismatch for {local_path}: "
+                f"{tuple(expected)} vs {tuple(arr.shape)}"
+            )
+        staged.append((container, key, expert_idx, arr))
+
+    for container, key, expert_idx, arr in staged:
+        old = container[key]
+        if expert_idx is not None:
+            new = old.at[expert_idx].set(arr.astype(old.dtype))
+        else:
+            new = arr.astype(old.dtype)
+            if hasattr(old, "sharding"):
+                new = jax.device_put(new, old.sharding)
+        container[key] = new
+    engine.params = params
+    logger.info(
+        "refit v%d applied: %d tensors for layers [%d, %d)",
+        version, len(tensors), model.start_layer, model.end_layer,
+    )
+    return len(tensors)
+
+
+def build_index_map(
+    safetensors_path: str, base_uri: str | None = None
+) -> dict:
+    """Helper for refit initiators: index every tensor of a safetensors file
+    with its checksum (reference weight_refit_utils CID computation)."""
+    from safetensors import safe_open
+
+    uri = base_uri or f"file://{os.path.abspath(safetensors_path)}"
+    with open(safetensors_path, "rb") as f:
+        sha = hashlib.sha256(f.read()).hexdigest()
+    index = {}
+    with safe_open(safetensors_path, framework="numpy") as f:
+        for name in f.keys():
+            index[name] = {"uri": uri, "sha256": sha}
+    return index

@@ -29,13 +29,14 @@ _COLUMN = {
 _ROW = {"o_proj", "down_proj"}
 
 
-def _spec_for(path: tuple[str, ...]) -> P:
+def _spec_for(path: tuple[str, ...], leaf_value=None) -> P:
     if len(path) >= 2:
         parent, leaf = path[-2], path[-1]
         if parent == "experts":
-            # Stacked MoE experts [E, ...]: shard the expert dim (EP rides
-            # the tp axis).
-            return P("tp", None, None)
+            # Stacked MoE experts [E, ...] (weights rank 3, biases rank 2):
+            # shard the expert dim (EP rides the tp axis).
+            rank = getattr(leaf_value, "ndim", 3)
+            return P("tp", *([None] * (rank - 1)))
         if parent in _COLUMN and leaf == "weight":
             return P("tp", None)
         if parent in _COLUMN and leaf == "bias":
@@ -58,7 +59,9 @@ def _tree_map_with_path(fn, tree, path=()):
 
 def stage_param_specs(params: dict) -> dict:
     """PartitionSpec pytree matching a stage param tree."""
-    return _tree_map_with_path(lambda path, _: _spec_for(path), params)
+    return _tree_map_with_path(
+        lambda path, leaf: _spec_for(path, leaf), params
+    )
 
 
 KV_SPEC = P(None, None, "tp", None)  # [pages, page, 2*Hkv, D]

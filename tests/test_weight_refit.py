@@ -1,0 +1,245 @@
+"""Weight refit tests: hot-swap correctness + swarm propagation.
+
+Capability parity: reference refit pipeline (POST /weight/refit ->
+heartbeat piggyback -> per-layer-range download w/ checksum -> hot reload,
+router skipping stale pipelines).
+"""
+
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from safetensors import numpy as st_numpy
+
+from parallax_tpu.config import normalize_config
+from parallax_tpu.models.base import StageModel
+from parallax_tpu.p2p.refit import apply_refit, build_index_map, fetch_uri
+from parallax_tpu.runtime.engine import EngineConfig, StageEngine
+from parallax_tpu.runtime.pipeline import InProcessPipeline
+from parallax_tpu.runtime.request import Request, SamplingParams
+
+TINY = normalize_config(dict(
+    architectures=["Qwen2ForCausalLM"],
+    hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+    num_key_value_heads=2, intermediate_size=128, vocab_size=151,
+))
+
+ENGINE_CFG = EngineConfig(page_size=8, num_pages=64, max_model_len=128,
+                          kv_dtype="float32")
+
+
+def make_engine(seed=0):
+    m = StageModel(TINY, 0, 2, use_pallas=False)
+    return StageEngine(
+        m, m.init_params(jax.random.key(seed), dtype=jnp.float32), ENGINE_CFG
+    )
+
+
+def flatten_hf_names(params):
+    """Stage params -> HF global names (inverse of shard_key_filter)."""
+    out = {}
+    for li, layer in enumerate(params["layers"]):
+        def walk(node, prefix):
+            for k, v in node.items():
+                if isinstance(v, dict):
+                    walk(v, f"{prefix}.{k}")
+                else:
+                    out[f"{prefix}.{k}"] = np.asarray(v)
+        walk(layer, f"model.layers.{li}")
+    out["model.embed_tokens.weight"] = np.asarray(
+        params["embed_tokens"]["weight"]
+    )
+    out["model.norm.weight"] = np.asarray(params["norm"]["weight"])
+    out["lm_head.weight"] = np.asarray(params["lm_head"]["weight"])
+    return out
+
+
+def generate(engine, prompt=(1, 2, 3, 4)):
+    pipe = InProcessPipeline([engine])
+    r = Request(f"r{time.monotonic_ns()}", prompt_ids=list(prompt),
+                sampling_params=SamplingParams(temperature=0.0,
+                                               max_new_tokens=5))
+    pipe.submit(r)
+    pipe.run_until_complete()
+    return r.output_ids
+
+
+def test_apply_refit_swaps_weights(tmp_path):
+    engine = make_engine(seed=0)
+    before = generate(engine)
+
+    # New weights = a different random init, exported as one safetensors.
+    donor = make_engine(seed=99)
+    tensors = flatten_hf_names(donor.params)
+    path = str(tmp_path / "refit.safetensors")
+    st_numpy.save_file(tensors, path)
+    index = build_index_map(path)
+
+    n = apply_refit(engine, index, version=1)
+    assert n == len(tensors)
+    after = generate(engine)
+    assert after != before
+    assert after == generate(donor)  # engine now IS the donor model
+
+
+def test_refit_checksum_rejected(tmp_path):
+    engine = make_engine()
+    tensors = flatten_hf_names(engine.params)
+    path = str(tmp_path / "w.safetensors")
+    st_numpy.save_file(tensors, path)
+    index = build_index_map(path)
+    for entry in index.values():
+        entry["sha256"] = "0" * 64
+    with pytest.raises(ValueError, match="checksum"):
+        apply_refit(engine, index, version=1)
+
+
+def test_refit_shape_mismatch_rejected(tmp_path):
+    engine = make_engine()
+    bad = {"model.norm.weight": np.zeros((7,), np.float32)}
+    path = str(tmp_path / "bad.safetensors")
+    st_numpy.save_file(bad, path)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        apply_refit(engine, build_index_map(path), version=1)
+
+
+def test_refit_filters_layer_range(tmp_path):
+    """A stage only loads tensors inside its layer range."""
+    m = StageModel(TINY, 1, 2, use_pallas=False)
+    engine = StageEngine(
+        m, m.init_params(jax.random.key(0), dtype=jnp.float32), ENGINE_CFG
+    )
+    donor = make_engine(seed=5)
+    tensors = flatten_hf_names(donor.params)
+    path = str(tmp_path / "full.safetensors")
+    st_numpy.save_file(tensors, path)
+    n = apply_refit(engine, build_index_map(path), version=1)
+    # layer 1 (as local 0) + norm + lm_head (+ no embed: not first, untied)
+    expected = sum(1 for k in tensors if k.startswith("model.layers.1.")) + 2
+    assert n == expected
+
+
+def test_refit_atomic_on_partial_failure(tmp_path):
+    """A bad entry mid-index must leave ALL weights untouched."""
+    engine = make_engine()
+    before = np.asarray(engine.params["norm"]["weight"]).copy()
+    good = {"model.norm.weight": np.full((64,), 2.0, np.float32)}
+    bad = {"model.lm_head.weight": np.zeros((3, 3), np.float32)}
+    # one blob with a good tensor and a bad-shaped one
+    path = str(tmp_path / "mix.safetensors")
+    st_numpy.save_file({**good, "lm_head.weight": bad["model.lm_head.weight"]},
+                       path)
+    index = build_index_map(path)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        apply_refit(engine, index, version=1)
+    np.testing.assert_array_equal(
+        np.asarray(engine.params["norm"]["weight"]), before
+    )
+
+
+def test_refit_per_expert_paths_into_stacked(tmp_path):
+    """Per-expert HF names must update rows of the stacked expert arrays."""
+    from parallax_tpu.models.registry import create_stage_model
+
+    moe_cfg = normalize_config(dict(
+        architectures=["Qwen3MoeForCausalLM"],
+        hidden_size=32, num_hidden_layers=1, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=8, intermediate_size=64,
+        moe_intermediate_size=16, num_experts=4, num_experts_per_tok=2,
+        decoder_sparse_step=1, mlp_only_layers=[], vocab_size=64,
+    ))
+    m = create_stage_model(moe_cfg, 0, 1, use_pallas=False)
+    engine = StageEngine(
+        m, m.init_params(jax.random.key(0), dtype=jnp.float32), ENGINE_CFG
+    )
+    new_w = np.full((16, 32), 3.0, np.float32)
+    path = str(tmp_path / "expert.safetensors")
+    st_numpy.save_file(
+        {"model.layers.0.mlp.experts.2.gate_proj.weight": new_w}, path
+    )
+    n = apply_refit(engine, build_index_map(path), version=1)
+    assert n == 1
+    stacked = np.asarray(engine.params["layers"][0]["mlp"]["experts"]["gate_proj"])
+    np.testing.assert_array_equal(stacked[2], new_w)
+    assert not np.allclose(stacked[1], new_w)
+
+
+def test_swarm_refit_propagates(tmp_path, monkeypatch):
+    """POST-style begin_refit -> heartbeat piggyback -> workers hot-swap ->
+    router resumes routing at the new version."""
+    from parallax_tpu.backend.scheduler_service import SchedulerService
+    from parallax_tpu.p2p.node import WorkerNode
+    from parallax_tpu.p2p.transport import TcpTransport
+    from parallax_tpu.scheduling import node as node_mod
+    from parallax_tpu.scheduling.scheduler import GlobalScheduler
+
+    monkeypatch.setattr(
+        node_mod.RooflinePerformanceModel, "max_layers_in_memory",
+        lambda self, kv_fraction=0.35: 1,
+    )
+    sched = GlobalScheduler(TINY, min_nodes_bootstrapping=2)
+    st = TcpTransport("scheduler", "127.0.0.1")
+    service = SchedulerService(sched, st)
+    service.start()
+
+    def stage_params(model):
+        return model.init_params(
+            jax.random.key(model.start_layer), dtype=jnp.float32
+        )
+
+    workers = []
+    for _ in range(2):
+        t = TcpTransport("", "127.0.0.1")
+        t.start()
+        t.peer_id = t.address
+        w = WorkerNode(
+            transport=t, scheduler_peer=st.address, model_config=TINY,
+            engine_config=ENGINE_CFG, load_params=stage_params,
+            heartbeat_interval_s=0.15,
+        )
+        workers.append(w)
+    threads = [threading.Thread(target=w.start) for w in workers]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(60)
+
+    try:
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if all(w.engine is not None for w in workers):
+                break
+            time.sleep(0.05)
+
+        donor = make_engine(seed=123)
+        tensors = flatten_hf_names(donor.params)
+        path = str(tmp_path / "v2.safetensors")
+        st_numpy.save_file(tensors, path)
+        version = sched.begin_refit(build_index_map(path))
+        assert version == 1
+
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if all(w.refit_version == 1 for w in workers):
+                break
+            time.sleep(0.1)
+        assert all(w.refit_version == 1 for w in workers), [
+            w.refit_version for w in workers
+        ]
+        # Scheduler sees the new version via heartbeats -> routing resumes.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            nodes = sched.manager.nodes()
+            if all(n.refit_version == 1 for n in nodes):
+                break
+            time.sleep(0.1)
+        path_ids = service.route_request("post-refit", timeout_s=10.0)
+        assert path_ids is not None
+    finally:
+        for w in workers:
+            w.stop()
+        service.stop()
