@@ -1,0 +1,244 @@
+"""Native (C++) host-side cache structures with ctypes bindings.
+
+Exposes :class:`NativeRadixPageCache` and :class:`NativePageAllocator`,
+drop-in replacements for the pure-Python versions in
+``parallax_tpu/runtime``. The shared library builds on demand with g++.
+
+Status: behavior-verified (differential fuzz vs the Python oracle) but
+measured 0.4-1.0x the Python speed across prompt lengths 64-8192 — the
+per-call ctypes + ndarray marshalling outweighs the std::map tree gains
+while CPython dict lookups are already C speed. Opt in with
+``PARALLAX_TPU_NATIVE=1``; making this pay requires batched C ABI calls
+(match+lock+alloc in one crossing), tracked for a later round.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from parallax_tpu.utils import get_logger
+
+logger = get_logger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "radix_cache.cpp")
+_LIB_PATH = os.path.join(_HERE, "libradix.so")
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def _build() -> bool:
+    # Compile to a process-unique temp path, then atomically rename: two
+    # processes may build concurrently but never load a half-written .so.
+    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB_PATH)
+        return True
+    except Exception as e:
+        logger.warning("native build failed (%s); using Python fallback", e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def load_library():
+    """Load (building if needed) the native library, or None."""
+    global _lib, _build_failed
+    if os.environ.get("PARALLAX_TPU_NO_NATIVE"):
+        return None
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not os.path.exists(_LIB_PATH) or (
+            os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
+        ):
+            if not _build():
+                _build_failed = True
+                return None
+        lib = ctypes.CDLL(_LIB_PATH)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        sigs = {
+            "radix_new": ([ctypes.c_int32], ctypes.c_void_p),
+            "radix_free": ([ctypes.c_void_p], None),
+            "radix_num_pages": ([ctypes.c_void_p], ctypes.c_int64),
+            "radix_match": (
+                [ctypes.c_void_p, i32p, ctypes.c_int64, i32p, ctypes.c_int64],
+                ctypes.c_int64,
+            ),
+            "radix_lock": (
+                [ctypes.c_void_p, i32p, ctypes.c_int64, ctypes.c_int64,
+                 ctypes.c_int32],
+                None,
+            ),
+            "radix_insert": (
+                [ctypes.c_void_p, i32p, ctypes.c_int64, i32p, ctypes.c_int64,
+                 i32p, ctypes.c_int64],
+                ctypes.c_int64,
+            ),
+            "radix_evict": (
+                [ctypes.c_void_p, ctypes.c_int64, i32p], ctypes.c_int64
+            ),
+            "radix_reset": (
+                [ctypes.c_void_p, i32p, ctypes.c_int64], ctypes.c_int64
+            ),
+            "alloc_new": ([ctypes.c_int32, ctypes.c_int32], ctypes.c_void_p),
+            "alloc_free": ([ctypes.c_void_p], None),
+            "alloc_num_free": ([ctypes.c_void_p], ctypes.c_int64),
+            "alloc_take": (
+                [ctypes.c_void_p, ctypes.c_int64, i32p], ctypes.c_int64
+            ),
+            "alloc_release": (
+                [ctypes.c_void_p, i32p, ctypes.c_int64], None
+            ),
+        }
+        for name, (argtypes, restype) in sigs.items():
+            fn = getattr(lib, name)
+            fn.argtypes = argtypes
+            fn.restype = restype
+        _lib = lib
+        return _lib
+
+
+def _as_i32(xs) -> np.ndarray:
+    return np.ascontiguousarray(xs, dtype=np.int32)
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+class NativeRadixPageCache:
+    """ctypes facade matching ``runtime.radix_cache.RadixPageCache``.
+
+    Lock paths are tracked by (token prefix, page count) instead of node
+    objects; ``match_prefix`` returns that handle as its second element.
+    """
+
+    def __init__(self, page_size: int, on_evict=None):
+        self._lib = load_library()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self.page_size = page_size
+        self.on_evict = on_evict
+        self._h = self._lib.radix_new(page_size)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.radix_free(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    @property
+    def num_cached_pages(self) -> int:
+        return int(self._lib.radix_num_pages(self._h))
+
+    def match_prefix(self, token_ids):
+        tokens = _as_i32(token_ids)
+        cap = max(1, len(tokens) // self.page_size)
+        out = np.empty(cap, np.int32)
+        n = self._lib.radix_match(
+            self._h, _ptr(tokens), len(tokens), _ptr(out), cap
+        )
+        pages = out[:n].tolist()
+        return pages, (tokens[: n * self.page_size], n)
+
+    def slice_path(self, path, n: int):
+        tokens, _ = path
+        return (tokens[: n * self.page_size], n)
+
+    def lock(self, path) -> None:
+        if not path:
+            return
+        tokens, n = path
+        if n:
+            self._lib.radix_lock(self._h, _ptr(tokens), len(tokens), n, 1)
+
+    def unlock(self, path) -> None:
+        if not path:
+            return
+        tokens, n = path
+        if n:
+            self._lib.radix_lock(self._h, _ptr(tokens), len(tokens), n, -1)
+
+    def insert(self, token_ids, page_ids) -> list[int]:
+        tokens = _as_i32(token_ids)
+        pages = _as_i32(page_ids)
+        dups = np.empty(max(1, len(pages)), np.int32)
+        n = self._lib.radix_insert(
+            self._h, _ptr(tokens), len(tokens), _ptr(pages), len(pages),
+            _ptr(dups), len(dups),
+        )
+        return dups[:n].tolist()
+
+    def evict(self, num_pages: int) -> list[int]:
+        out = np.empty(max(1, num_pages), np.int32)
+        n = self._lib.radix_evict(self._h, num_pages, _ptr(out))
+        freed = out[:n].tolist()
+        if self.on_evict:
+            for p in freed:
+                self.on_evict(p)
+        return freed
+
+    def reset(self) -> list[int]:
+        cap = self.num_cached_pages or 1
+        out = np.empty(cap, np.int32)
+        n = self._lib.radix_reset(self._h, _ptr(out), cap)
+        return out[:n].tolist()
+
+
+class NativePageAllocator:
+    """ctypes facade matching ``runtime.allocator.PageAllocator``."""
+
+    def __init__(self, num_pages: int, reserve_null_page: bool = True):
+        self._lib = load_library()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self.num_pages = num_pages
+        self.null_page = 0 if reserve_null_page else -1
+        self._h = self._lib.alloc_new(num_pages, int(reserve_null_page))
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.alloc_free(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    @property
+    def num_free(self) -> int:
+        return int(self._lib.alloc_num_free(self._h))
+
+    def alloc(self, n: int) -> list[int]:
+        from parallax_tpu.runtime.allocator import OutOfPages
+
+        out = np.empty(max(1, n), np.int32)
+        got = self._lib.alloc_take(self._h, n, _ptr(out))
+        if got < 0:
+            raise OutOfPages(f"need {n} pages, {self.num_free} free")
+        return out[:n].tolist()
+
+    def free(self, pages) -> None:
+        if not len(pages):
+            return
+        arr = _as_i32(pages)
+        self._lib.alloc_release(self._h, _ptr(arr), len(arr))
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= self.num_free
+
+
+def native_available() -> bool:
+    return load_library() is not None

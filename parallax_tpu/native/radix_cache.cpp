@@ -1,0 +1,220 @@
+// Native host-side cache structures: page radix tree + free-list allocator.
+//
+// Capability parity: the reference keeps its runtime hot structures native
+// (C++/Metal extension + Rust engines); here the per-request host-side hot
+// path — prefix matching over token sequences and page alloc/free — is C++
+// behind a C ABI (ctypes), with the pure-Python implementation as fallback
+// and behavioral oracle (parallax_tpu/runtime/radix_cache.py).
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 radix_cache.cpp -o libradix.so
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <vector>
+
+namespace {
+
+using Key = std::vector<int32_t>;
+
+struct Node {
+    Key key;
+    int32_t page_id;
+    Node* parent;
+    std::map<Key, Node*> children;
+    int32_t lock_ref = 0;
+    uint64_t last_access = 0;
+
+    ~Node() {
+        for (auto& kv : children) delete kv.second;
+    }
+};
+
+struct RadixTree {
+    Node root;
+    int32_t page_size;
+    int64_t num_pages = 0;
+    uint64_t clock = 0;
+
+    explicit RadixTree(int32_t ps) : page_size(ps) {
+        root.page_id = -1;
+        root.parent = nullptr;
+    }
+};
+
+struct PageAlloc {
+    std::vector<int32_t> free_list;
+    int32_t num_pages;
+    int32_t null_page;
+};
+
+Key make_key(const int32_t* tokens, int64_t start, int32_t page) {
+    return Key(tokens + start, tokens + start + page);
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- radix tree -----------------------------------------------------------
+
+void* radix_new(int32_t page_size) { return new RadixTree(page_size); }
+
+void radix_free(void* handle) { delete static_cast<RadixTree*>(handle); }
+
+int64_t radix_num_pages(void* handle) {
+    return static_cast<RadixTree*>(handle)->num_pages;
+}
+
+// Longest full-page prefix match. Writes matched page ids into out_pages
+// (capacity max_out) and returns the match length in pages. Matched nodes
+// get their access clocks refreshed.
+int64_t radix_match(void* handle, const int32_t* tokens, int64_t n_tokens,
+                    int32_t* out_pages, int64_t max_out) {
+    auto* t = static_cast<RadixTree*>(handle);
+    Node* node = &t->root;
+    int64_t matched = 0;
+    t->clock++;
+    for (int64_t start = 0; start + t->page_size <= n_tokens;
+         start += t->page_size) {
+        if (matched >= max_out) break;
+        Key key = make_key(tokens, start, t->page_size);
+        auto it = node->children.find(key);
+        if (it == node->children.end()) break;
+        node = it->second;
+        node->last_access = t->clock;
+        out_pages[matched++] = node->page_id;
+    }
+    return matched;
+}
+
+// Adjust lock refs (+1 / -1) along the match path for the given prefix.
+void radix_lock(void* handle, const int32_t* tokens, int64_t n_tokens,
+                int64_t n_pages, int32_t delta) {
+    auto* t = static_cast<RadixTree*>(handle);
+    Node* node = &t->root;
+    for (int64_t i = 0; i < n_pages; i++) {
+        Key key = make_key(tokens, i * t->page_size, t->page_size);
+        auto it = node->children.find(key);
+        if (it == node->children.end()) return;
+        node = it->second;
+        node->lock_ref += delta;
+    }
+}
+
+// Insert full pages; returns the count of *duplicate* page ids written to
+// out_dups (pages the caller must free because the key already existed
+// with a different page).
+int64_t radix_insert(void* handle, const int32_t* tokens, int64_t n_tokens,
+                     const int32_t* page_ids, int64_t n_pages,
+                     int32_t* out_dups, int64_t max_dups) {
+    auto* t = static_cast<RadixTree*>(handle);
+    Node* node = &t->root;
+    int64_t n_dups = 0;
+    t->clock++;
+    int64_t n_full = n_tokens / t->page_size;
+    if (n_pages < n_full) n_full = n_pages;
+    for (int64_t i = 0; i < n_full; i++) {
+        Key key = make_key(tokens, i * t->page_size, t->page_size);
+        auto it = node->children.find(key);
+        Node* child;
+        if (it == node->children.end()) {
+            child = new Node();
+            child->key = key;
+            child->page_id = page_ids[i];
+            child->parent = node;
+            node->children.emplace(std::move(key), child);
+            t->num_pages++;
+        } else {
+            child = it->second;
+            if (child->page_id != page_ids[i] && n_dups < max_dups) {
+                out_dups[n_dups++] = page_ids[i];
+            }
+        }
+        child->last_access = t->clock;
+        node = child;
+    }
+    return n_dups;
+}
+
+// Evict up to n unpinned LRU leaves; returns freed page ids in out_pages.
+int64_t radix_evict(void* handle, int64_t n, int32_t* out_pages) {
+    auto* t = static_cast<RadixTree*>(handle);
+    int64_t freed = 0;
+    while (freed < n) {
+        Node* best = nullptr;
+        std::vector<Node*> stack;
+        for (auto& kv : t->root.children) stack.push_back(kv.second);
+        while (!stack.empty()) {
+            Node* cur = stack.back();
+            stack.pop_back();
+            if (!cur->children.empty()) {
+                for (auto& kv : cur->children) stack.push_back(kv.second);
+            } else if (cur->lock_ref <= 0) {
+                if (!best || cur->last_access < best->last_access) best = cur;
+            }
+        }
+        if (!best) break;
+        out_pages[freed++] = best->page_id;
+        best->parent->children.erase(best->key);
+        delete best;
+        t->num_pages--;
+    }
+    return freed;
+}
+
+// Drop the whole tree, returning every owned page id.
+int64_t radix_reset(void* handle, int32_t* out_pages, int64_t max_out) {
+    auto* t = static_cast<RadixTree*>(handle);
+    int64_t n = 0;
+    std::vector<Node*> stack;
+    for (auto& kv : t->root.children) stack.push_back(kv.second);
+    while (!stack.empty()) {
+        Node* cur = stack.back();
+        stack.pop_back();
+        if (n < max_out) out_pages[n++] = cur->page_id;
+        for (auto& kv : cur->children) stack.push_back(kv.second);
+    }
+    for (auto& kv : t->root.children) delete kv.second;
+    t->root.children.clear();
+    t->num_pages = 0;
+    return n;
+}
+
+// ---- page allocator -------------------------------------------------------
+
+void* alloc_new(int32_t num_pages, int32_t reserve_null) {
+    auto* a = new PageAlloc();
+    a->num_pages = num_pages;
+    a->null_page = reserve_null ? 0 : -1;
+    int32_t start = reserve_null ? 1 : 0;
+    if (num_pages > start) a->free_list.reserve(num_pages - start);
+    for (int32_t p = num_pages - 1; p >= start; p--) a->free_list.push_back(p);
+    return a;
+}
+
+void alloc_free(void* handle) { delete static_cast<PageAlloc*>(handle); }
+
+int64_t alloc_num_free(void* handle) {
+    return static_cast<PageAlloc*>(handle)->free_list.size();
+}
+
+// Pop n pages into out; returns n on success, -1 if insufficient.
+int64_t alloc_take(void* handle, int64_t n, int32_t* out) {
+    auto* a = static_cast<PageAlloc*>(handle);
+    if ((int64_t)a->free_list.size() < n) return -1;
+    for (int64_t i = 0; i < n; i++) {
+        out[i] = a->free_list.back();
+        a->free_list.pop_back();
+    }
+    return n;
+}
+
+void alloc_release(void* handle, const int32_t* pages, int64_t n) {
+    auto* a = static_cast<PageAlloc*>(handle);
+    for (int64_t i = 0; i < n; i++) {
+        if (pages[i] != a->null_page) a->free_list.push_back(pages[i]);
+    }
+}
+
+}  // extern "C"

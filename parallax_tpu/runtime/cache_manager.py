@@ -55,15 +55,38 @@ class CacheManager:
         num_pages: int,
         enable_prefix_cache: bool = True,
         max_model_len: int = 32768,
+        use_native: bool | None = None,
     ):
         self.page_size = page_size
         self.num_pages = num_pages
         self.max_model_len = max_model_len
-        self.allocator = PageAllocator(num_pages)
         self.enable_prefix_cache = enable_prefix_cache
-        self.prefix_cache = RadixPageCache(page_size)
+        self.allocator, self.prefix_cache = self._make_structures(use_native)
         # rid -> (locked node path, number of shared tree-owned pages)
-        self._locked: dict[str, tuple[list, int]] = {}
+        self._locked: dict[str, tuple] = {}
+
+    def _make_structures(self, use_native: bool | None):
+        """Cache structures. The C++ implementation (PARALLAX_TPU_NATIVE=1)
+        is measured SLOWER than the Python one for realistic prompt sizes
+        (0.4-1.0x: per-call ctypes+ndarray overhead beats std::map gains
+        while dict lookups are already C speed), so Python is the default;
+        the native path stays as a tested opt-in for future batched APIs."""
+        import os
+
+        if use_native is None:
+            use_native = bool(os.environ.get("PARALLAX_TPU_NATIVE"))
+        if use_native:
+            try:
+                from parallax_tpu import native
+
+                if native.native_available():
+                    return (
+                        native.NativePageAllocator(self.num_pages),
+                        native.NativeRadixPageCache(self.page_size),
+                    )
+            except Exception as e:  # pragma: no cover - env specific
+                logger.warning("native cache unavailable: %s", e)
+        return PageAllocator(self.num_pages), RadixPageCache(self.page_size)
 
     # -- capacity ---------------------------------------------------------
 
@@ -106,20 +129,25 @@ class CacheManager:
         """
         prompt_len = request.num_prompt_tokens
         shared_pages: list[int] = []
-        path: list = []
+        path = []  # empty match path (both impls accept [] for lock/unlock)
         if self.enable_prefix_cache and prompt_len > 1:
             pages, full_path = self.prefix_cache.match_prefix(request.prompt_ids)
             # Always leave >=1 prompt token to recompute so the stage emits a
             # hidden state for sampling.
             usable = min(len(pages), (prompt_len - 1) // self.page_size)
             shared_pages = pages[:usable]
-            path = full_path[:usable]
+            path = self.prefix_cache.slice_path(full_path, usable)
 
         total_pages = self.pages_needed(prompt_len)
         fresh_needed = total_pages - len(shared_pages)
-        if not self._reclaim(fresh_needed):
-            return False
+        # Pin the matched prefix BEFORE any eviction: reclaiming first could
+        # evict the matched nodes and hand their device pages back out as
+        # this very request's fresh pages (double-booked page = corrupted
+        # KV).
         self.prefix_cache.lock(path)
+        if not self._reclaim(fresh_needed):
+            self.prefix_cache.unlock(path)
+            return False
         try:
             fresh = self.allocator.alloc(fresh_needed)
         except OutOfPages:

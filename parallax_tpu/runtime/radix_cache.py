@@ -61,6 +61,11 @@ class RadixPageCache:
             node = child
         return pages, path
 
+    @staticmethod
+    def slice_path(path, n: int):
+        """First ``n`` pages of a match path (impl-specific handle)."""
+        return path[:n]
+
     def lock(self, path: list[_Node]) -> None:
         """Pin matched nodes so eviction cannot free their pages mid-request."""
         for n in path:
