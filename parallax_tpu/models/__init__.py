@@ -16,5 +16,6 @@ from parallax_tpu.models import qwen3_moe  # noqa: F401  (registers MoE archs)
 from parallax_tpu.models import deepseek_v3  # noqa: F401  (registers MLA archs)
 from parallax_tpu.models import glm4  # noqa: F401
 from parallax_tpu.models import gpt_oss  # noqa: F401
+from parallax_tpu.models import qwen3_next  # noqa: F401
 
 __all__ = ["StageModel", "BatchInputs", "MODEL_REGISTRY", "get_model_class"]

@@ -38,6 +38,13 @@ class BatchInputs:
     num_seqs: jax.Array              # i32[1]
     slot_mapping: jax.Array          # i32[T]
     logits_indices: jax.Array        # i32[S] last-token row per sequence
+    # Hybrid (linear-attention) models only; None otherwise.
+    state_slots: jax.Array | None = None  # i32[S] per-seq state slot
+    dense_map: jax.Array | None = None    # i32[S, maxq] row index per step
+    q_lens: jax.Array | None = None       # i32[S] valid steps per row
+    # 1 on a request's first chunk: its (possibly reused) slot must be
+    # zeroed before use.
+    reset_state: jax.Array | None = None  # i32[S]
 
 
 class StageModel:
@@ -46,6 +53,13 @@ class StageModel:
     # NeoX-halves rope by default; models using the GPT-J interleaved
     # convention (GLM4) override this class attribute.
     rope_fn = staticmethod(L.apply_rope)
+    # 0.0 = llama convention (ones-init weights); 1.0 = Gemma/Qwen3-Next
+    # zero-init ``x_hat * (1 + w)`` for all layer/final/qk norms.
+    norm_offset = 0.0
+
+    def _rms(self, x, weight):
+        return L.rms_norm(x, weight, self.config.rms_norm_eps,
+                          offset=self.norm_offset)
 
     def __init__(
         self,
@@ -230,7 +244,7 @@ class StageModel:
         if not self.is_last:
             return x, new_kv
 
-        x = L.rms_norm(x, params["norm"]["weight"], cfg.rms_norm_eps)
+        x = self._rms(x, params["norm"]["weight"])
         x = x[inputs.logits_indices]
         head = params.get("lm_head") or params["embed_tokens"]
         logits = L.lm_head_logits(x, head)
@@ -267,10 +281,10 @@ class StageModel:
         window: int | None,
     ) -> tuple[jax.Array, jax.Array]:
         cfg = self.config
-        h = L.rms_norm(x, lp["input_layernorm"]["weight"], cfg.rms_norm_eps)
+        h = self._rms(x, lp["input_layernorm"]["weight"])
         attn_out, kv = self._attention(lp, h, kv, inputs, window)
         x = x + attn_out
-        h = L.rms_norm(x, lp["post_attention_layernorm"]["weight"], cfg.rms_norm_eps)
+        h = self._rms(x, lp["post_attention_layernorm"]["weight"])
         x = x + self._mlp(lp, h)
         return x, kv
 

@@ -16,12 +16,16 @@ from parallax_tpu.config import ModelConfig
 from parallax_tpu.ops import apply_rope, ragged_paged_attention, reshape_and_cache
 
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+def rms_norm(
+    x: jax.Array, weight: jax.Array, eps: float, offset: float = 0.0
+) -> jax.Array:
+    """RMSNorm; ``offset=1.0`` gives the Gemma/Qwen3-Next zero-init
+    convention ``x_hat * (1 + w)``."""
     orig_dtype = x.dtype
     x = x.astype(jnp.float32)
     var = jnp.mean(x * x, axis=-1, keepdims=True)
     x = x * jax.lax.rsqrt(var + eps)
-    return (x * weight.astype(jnp.float32)).astype(orig_dtype)
+    return (x * (weight.astype(jnp.float32) + offset)).astype(orig_dtype)
 
 
 def linear(x: jax.Array, p: dict) -> jax.Array:

@@ -1,0 +1,270 @@
+"""Qwen3-Next hybrid stage model: GatedDeltaNet linear layers + gated
+full-attention layers + sparse MoE FFN.
+
+Capability parity: reference ``src/parallax/models/qwen3_next.py`` (linear
+layers use LinearCache conv/recurrent state slots + state_slot_mapping;
+full-attention layers paged). HF conventions followed exactly:
+``linear_attn.{in_proj_qkvz,in_proj_ba,conv1d,A_log,dt_bias,norm,out_proj}``,
+attention ``q_proj`` fused with a per-head output gate, Qwen2-MoE style
+sparse block with shared expert.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from parallax_tpu.config import LAYER_LINEAR, ModelConfig
+from parallax_tpu.models import layers as L
+from parallax_tpu.models.base import BatchInputs
+from parallax_tpu.models.qwen3_moe import MoEStageModel
+from parallax_tpu.models.registry import register_model
+from parallax_tpu.ops import ragged_paged_attention, reshape_and_cache
+from parallax_tpu.ops.linear_attn import (
+    causal_conv_update,
+    gated_delta_rule_scan,
+    l2norm,
+    new_linear_state,
+)
+
+
+def _densify(x: jax.Array, dense_map: jax.Array) -> jax.Array:
+    """[T, ...] ragged rows -> [S, maxq, ...] per-seq steps (OOB -> 0)."""
+    padded = jnp.concatenate([x, jnp.zeros_like(x[:1])], axis=0)
+    return padded[jnp.clip(dense_map, 0, x.shape[0])]
+
+
+def _scatter_ragged(
+    dense: jax.Array, dense_map: jax.Array, num_rows: int
+) -> jax.Array:
+    """[S, maxq, F] -> [T, F] at the mapped rows (OOB dropped)."""
+    s, maxq, f = dense.shape
+    out = jnp.zeros((num_rows, f), dense.dtype)
+    return out.at[dense_map.reshape(-1)].set(
+        dense.reshape(s * maxq, f), mode="drop"
+    )
+
+
+@register_model("Qwen3NextForCausalLM")
+class Qwen3NextStageModel(MoEStageModel):
+    # Qwen3-Next norms are zero-init Gemma-style (1 + w); the gated output
+    # norm inside GatedDeltaNet keeps plain ones-init weights.
+    norm_offset = 1.0
+
+    def __init__(self, config: ModelConfig, *args, **kwargs):
+        super().__init__(config, *args, **kwargs)
+        if config.linear_attn is None:
+            raise ValueError("Qwen3-Next requires linear_attn config")
+        if self.tp_size > 1:
+            raise NotImplementedError(
+                "hybrid linear-attention TP lands in a later round"
+            )
+        la = config.linear_attn
+        self.key_dim = la.num_k_heads * la.head_k_dim
+        self.value_dim = la.num_v_heads * la.head_v_dim
+        self.conv_dim = 2 * self.key_dim + self.value_dim
+
+    @property
+    def has_linear_layers(self) -> bool:
+        return any(
+            self.config.layer_type(i) == LAYER_LINEAR
+            for i in range(self.start_layer, self.end_layer)
+        )
+
+    # -- caches ------------------------------------------------------------
+
+    def new_kv_caches(self, num_pages, page_size, dtype=jnp.bfloat16,
+                      num_state_slots: int = 256):
+        la = self.config.linear_attn
+        caches = []
+        for i in range(self.start_layer, self.end_layer):
+            if self.config.layer_type(i) == LAYER_LINEAR:
+                # +1: slot 0 is the null slot padding rows write to.
+                caches.append(new_linear_state(
+                    num_state_slots + 1, self.conv_dim, la.conv_kernel_size,
+                    la.num_v_heads, la.head_k_dim, la.head_v_dim,
+                ))
+            else:
+                from parallax_tpu.ops import new_kv_pages
+
+                caches.append(new_kv_pages(
+                    num_pages, page_size, self.config.num_key_value_heads,
+                    self.config.head_dim, dtype,
+                ))
+        return caches
+
+    # -- layers ------------------------------------------------------------
+
+    def _decoder_layer(self, lp, x, kv, inputs: BatchInputs, window):
+        cfg = self.config
+        h = self._rms(x, lp["input_layernorm"]["weight"])
+        if "linear_attn" in lp:
+            attn_out, kv = self._gated_delta_net(lp["linear_attn"], h, kv, inputs)
+        else:
+            attn_out, kv = self._gated_attention(lp["self_attn"], h, kv, inputs)
+        x = x + attn_out
+        h = self._rms(x, lp["post_attention_layernorm"]["weight"])
+        return x + self._mlp(lp, h), kv
+
+    def _gated_attention(self, p, x, kv_pages, inputs: BatchInputs):
+        """Full attention with a per-head sigmoid output gate fused into
+        q_proj (HF Qwen3NextAttention)."""
+        cfg = self.config
+        t = x.shape[0]
+        d = cfg.head_dim
+        qg = L.linear(x, p["q_proj"]).reshape(t, -1, 2 * d)
+        q, gate = qg[..., :d], qg[..., d:]
+        k = L.linear(x, p["k_proj"]).reshape(t, -1, d)
+        v = L.linear(x, p["v_proj"]).reshape(t, -1, d)
+        q = self._rms(q, p["q_norm"]["weight"])
+        k = self._rms(k, p["k_norm"]["weight"])
+        q = self.rope_fn(q, inputs.positions, self.cos_table, self.sin_table)
+        k = self.rope_fn(k, inputs.positions, self.cos_table, self.sin_table)
+        kv_pages = reshape_and_cache(kv_pages, k, v, inputs.slot_mapping)
+        out = ragged_paged_attention(
+            q, kv_pages, inputs.kv_lens, inputs.page_indices,
+            inputs.cu_q_lens, inputs.num_seqs,
+            sm_scale=d**-0.5, use_pallas=self.use_pallas,
+        )
+        hq = q.shape[1]
+        out = out.reshape(t, hq * d) * jax.nn.sigmoid(
+            gate.reshape(t, hq * d).astype(jnp.float32)
+        ).astype(out.dtype)
+        return L.linear(out, p["o_proj"]), kv_pages
+
+    def _gated_delta_net(self, p, x, state, inputs: BatchInputs):
+        """GatedDeltaNet (HF Qwen3NextGatedDeltaNet semantics)."""
+        cfg = self.config
+        la = cfg.linear_attn
+        conv_state_all, rec_state_all = state
+        t = x.shape[0]
+        hk, hv = la.num_k_heads, la.num_v_heads
+        dk, dv = la.head_k_dim, la.head_v_dim
+        r = hv // hk
+
+        qkvz = L.linear(x, p["in_proj_qkvz"]).reshape(
+            t, hk, 2 * dk + 2 * r * dv
+        )
+        ba = L.linear(x, p["in_proj_ba"]).reshape(t, hk, 2 * r)
+        q = qkvz[..., :dk]
+        k = qkvz[..., dk : 2 * dk]
+        v = qkvz[..., 2 * dk : 2 * dk + r * dv].reshape(t, hv, dv)
+        z = qkvz[..., 2 * dk + r * dv :].reshape(t, hv, dv)
+        b = ba[..., :r].reshape(t, hv)
+        a = ba[..., r:].reshape(t, hv)
+
+        mixed = jnp.concatenate(
+            [q.reshape(t, -1), k.reshape(t, -1), v.reshape(t, -1)], axis=-1
+        )
+
+        # Densify to [S, maxq, ...] and run conv + recurrence over slots.
+        dm, slots, q_lens = inputs.dense_map, inputs.state_slots, inputs.q_lens
+        mixed_d = _densify(mixed, dm)
+        conv_state = conv_state_all[slots]
+        # A request's first chunk starts from zero state even when its slot
+        # was recycled from a finished request.
+        fresh = inputs.reset_state.astype(bool)
+        conv_state = jnp.where(fresh[:, None, None], 0.0, conv_state)
+        mixed_d, new_conv = causal_conv_update(
+            mixed_d, conv_state, p["conv1d"]["weight"], q_lens
+        )
+        s, maxq, _ = mixed_d.shape
+        qd = mixed_d[..., : self.key_dim].reshape(s, maxq, hk, dk)
+        kd = mixed_d[..., self.key_dim : 2 * self.key_dim].reshape(
+            s, maxq, hk, dk
+        )
+        vd = mixed_d[..., 2 * self.key_dim :].reshape(s, maxq, hv, dv)
+        if r > 1:
+            qd = jnp.repeat(qd, r, axis=2)
+            kd = jnp.repeat(kd, r, axis=2)
+        qd = l2norm(qd)
+        kd = l2norm(kd)
+
+        beta = jax.nn.sigmoid(_densify(b, dm).astype(jnp.float32))
+        g = -jnp.exp(p["A_log"].astype(jnp.float32)) * jax.nn.softplus(
+            _densify(a, dm).astype(jnp.float32) + p["dt_bias"]
+        )
+
+        rec_state = rec_state_all[slots]
+        rec_state = jnp.where(fresh[:, None, None, None], 0.0, rec_state)
+        out_d, new_rec = gated_delta_rule_scan(
+            qd, kd, vd, g, beta, rec_state, q_lens
+        )
+
+        conv_state_all = conv_state_all.at[slots].set(new_conv)
+        rec_state_all = rec_state_all.at[slots].set(new_rec)
+
+        out = _scatter_ragged(
+            out_d.reshape(s, maxq, hv * dv), dm, t
+        ).reshape(t, hv, dv)
+        # Gated RMSNorm (norm then * silu(z)), per value head dim.
+        zf = z.astype(jnp.float32)
+        normed = L.rms_norm(out.astype(x.dtype), p["norm"]["weight"],
+                            cfg.rms_norm_eps)
+        gated = normed.astype(jnp.float32) * jax.nn.silu(zf)
+        y = L.linear(gated.reshape(t, hv * dv).astype(x.dtype), p["out_proj"])
+        return y, (conv_state_all, rec_state_all)
+
+    # -- params ------------------------------------------------------------
+
+    def finalize_params(self, tree: dict) -> dict:
+        tree = super().finalize_params(tree)
+        for layer in tree.get("layers", []):
+            lin = layer.get("linear_attn")
+            if isinstance(lin, dict) and "conv1d" in lin:
+                w = lin["conv1d"]["weight"]
+                if w.ndim == 3:  # torch conv1d [out, 1, K] -> [out, K]
+                    lin["conv1d"]["weight"] = w[:, 0, :]
+        return tree
+
+    def init_params(self, rng: jax.Array, dtype=jnp.bfloat16) -> dict:
+        params = super().init_params(rng, dtype)
+        cfg = self.config
+        la = cfg.linear_attn
+        hk, hv, dk, dv = (la.num_k_heads, la.num_v_heads, la.head_k_dim,
+                          la.head_v_dim)
+        r = hv // hk
+        for li in range(self.num_local_layers):
+            gi = self.start_layer + li
+            key = jax.random.fold_in(rng, 3000 + gi)
+            ks = jax.random.split(key, 6)
+            layer = params["layers"][li]
+            if cfg.layer_type(gi) == LAYER_LINEAR:
+                layer.pop("self_attn", None)
+                h = cfg.hidden_size
+                layer["linear_attn"] = {
+                    "in_proj_qkvz": {"weight": (
+                        jax.random.normal(
+                            ks[0], (hk * (2 * dk + 2 * r * dv), h), jnp.float32
+                        ) * h**-0.5).astype(dtype)},
+                    "in_proj_ba": {"weight": (
+                        jax.random.normal(ks[1], (2 * hv, h), jnp.float32)
+                        * h**-0.5).astype(dtype)},
+                    "conv1d": {"weight": (
+                        jax.random.normal(
+                            ks[2], (self.conv_dim, la.conv_kernel_size),
+                            jnp.float32,
+                        ) * 0.2).astype(jnp.float32)},
+                    "A_log": jnp.zeros((hv,), jnp.float32),
+                    "dt_bias": jnp.ones((hv,), jnp.float32),
+                    "norm": {"weight": jnp.ones((dv,), dtype)},
+                    "out_proj": {"weight": (
+                        jax.random.normal(ks[3], (h, hv * dv), jnp.float32)
+                        * (hv * dv)**-0.5).astype(dtype)},
+                }
+            else:
+                # Fused q+gate projection replaces the standard q_proj.
+                h = cfg.hidden_size
+                d = cfg.head_dim
+                layer["self_attn"]["q_proj"] = {"weight": (
+                    jax.random.normal(
+                        ks[4], (cfg.num_attention_heads * 2 * d, h),
+                        jnp.float32,
+                    ) * h**-0.5).astype(dtype)}
+                layer["self_attn"].setdefault(
+                    "q_norm", {"weight": jnp.ones((d,), dtype)}
+                )
+                layer["self_attn"].setdefault(
+                    "k_norm", {"weight": jnp.ones((d,), dtype)}
+                )
+        return params

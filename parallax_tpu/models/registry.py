@@ -26,7 +26,6 @@ for _arch in (
     "MistralForCausalLM",
     "Qwen2ForCausalLM",
     "Qwen3ForCausalLM",
-    "Gemma2ForCausalLM",
 ):
     MODEL_REGISTRY[_arch] = StageModel
 

@@ -1,0 +1,118 @@
+"""Gated DeltaNet linear attention over per-request state slots.
+
+Capability parity: reference hybrid models (``src/parallax/models/
+qwen3_next.py``: GatedDeltaNet layers with LinearCache conv/recurrent state
+slots). State per request per linear layer:
+
+- conv state  f32[slots, conv_dim, K-1] — the last K-1 pre-activation
+  mixed-qkv columns (causal depthwise conv warmup window);
+- recurrent state f32[slots, Hv, Dk, Dv] — the delta-rule memory.
+
+The engine's ragged step batch is densified to ``[S, maxq]`` per-sequence
+rows (``BatchInputs.dense_map``); the recurrence runs a ``lax.scan`` over
+``maxq`` steps with all sequences advancing in lockstep (decode buckets
+compile with maxq=1, so the scan vanishes). Math mirrors HF's
+``torch_recurrent_gated_delta_rule`` exactly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def new_linear_state(
+    num_slots: int, conv_dim: int, kernel_size: int,
+    num_v_heads: int, head_k_dim: int, head_v_dim: int,
+) -> tuple[jax.Array, jax.Array]:
+    conv = jnp.zeros((num_slots, conv_dim, kernel_size - 1), jnp.float32)
+    rec = jnp.zeros((num_slots, num_v_heads, head_k_dim, head_v_dim),
+                    jnp.float32)
+    return conv, rec
+
+
+def l2norm(x: jax.Array, eps: float = 1e-6) -> jax.Array:
+    x = x.astype(jnp.float32)
+    return x * jax.lax.rsqrt(jnp.sum(x * x, axis=-1, keepdims=True) + eps)
+
+
+def causal_conv_update(
+    mixed_dense: jax.Array,     # [S, maxq, conv_dim] pre-activation
+    conv_state: jax.Array,      # [S, conv_dim, K-1] gathered per slot
+    conv_weight: jax.Array,     # [conv_dim, K] depthwise taps
+    seq_lens: jax.Array,        # i32[S] valid steps per row
+) -> tuple[jax.Array, jax.Array]:
+    """Depthwise causal conv with carried state; silu activation.
+
+    Returns (activated [S, maxq, conv_dim], new_conv_state [S, conv_dim, K-1]).
+    """
+    s, maxq, cdim = mixed_dense.shape
+    k = conv_weight.shape[-1]
+    x = jnp.swapaxes(mixed_dense, 1, 2)                 # [S, cdim, maxq]
+    # Zero out padding steps so they don't leak into the conv window.
+    step = jnp.arange(maxq, dtype=jnp.int32)
+    valid = step[None, :] < seq_lens[:, None]           # [S, maxq]
+    x = jnp.where(valid[:, None, :], x, 0.0)
+    full = jnp.concatenate([conv_state, x], axis=-1)    # [S, cdim, K-1+maxq]
+    # Causal depthwise conv: y[t] = sum_j w[j] * full[t + j].
+    windows = jnp.stack(
+        [full[:, :, j : j + maxq] for j in range(k)], axis=-1
+    )                                                    # [S, cdim, maxq, K]
+    y = jnp.einsum("sctk,ck->sct", windows, conv_weight)
+    y = jax.nn.silu(y)
+    y = jnp.where(valid[:, None, :], y, 0.0)
+
+    # New conv state: the K-1 inputs ending at each row's last valid step.
+    # full column index of the last input of row i is (K-1) + len_i - 1;
+    # the state window starts at len_i.
+    idx = seq_lens[:, None] + jnp.arange(k - 1)[None, :]  # [S, K-1]
+    new_state = jnp.take_along_axis(full, idx[:, None, :], axis=-1)
+    return jnp.swapaxes(y, 1, 2), new_state
+
+
+def gated_delta_rule_scan(
+    q: jax.Array,          # [S, maxq, Hv, Dk]  (post conv, post l2norm)
+    k: jax.Array,          # [S, maxq, Hv, Dk]
+    v: jax.Array,          # [S, maxq, Hv, Dv]
+    g: jax.Array,          # f32[S, maxq, Hv]   log decay
+    beta: jax.Array,       # f32[S, maxq, Hv]
+    state: jax.Array,      # f32[S, Hv, Dk, Dv]
+    seq_lens: jax.Array,   # i32[S]
+) -> tuple[jax.Array, jax.Array]:
+    """Recurrent delta rule (HF torch_recurrent_gated_delta_rule semantics):
+
+    state = state * exp(g_t); mem = k_t . state; delta = (v_t - mem) * b_t;
+    state += k_t (x) delta; out_t = q_t . state    (q pre-scaled by Dk^-0.5).
+
+    Padding steps (t >= seq_len) leave the state untouched.
+    """
+    s, maxq, hv, dk = q.shape
+    scale = dk**-0.5
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+
+    def step(carry, xs):
+        st = carry                                     # [S, Hv, Dk, Dv]
+        q_t, k_t, v_t, g_t, b_t, valid = xs
+        st_decayed = st * jnp.exp(g_t)[..., None, None]
+        mem = jnp.einsum("shkv,shk->shv", st_decayed, k_t)
+        delta = (v_t - mem) * b_t[..., None]
+        st_new = st_decayed + jnp.einsum("shk,shv->shkv", k_t, delta)
+        out_t = jnp.einsum("shkv,shk->shv", st_new, q_t)
+        st = jnp.where(valid[:, None, None, None], st_new, st)
+        out_t = jnp.where(valid[:, None, None], out_t, 0.0)
+        return st, out_t
+
+    step_idx = jnp.arange(maxq, dtype=jnp.int32)
+    valid = step_idx[None, :] < seq_lens[:, None]      # [S, maxq]
+    xs = (
+        jnp.swapaxes(qf, 0, 1),
+        jnp.swapaxes(kf, 0, 1),
+        jnp.swapaxes(vf, 0, 1),
+        jnp.swapaxes(g, 0, 1),
+        jnp.swapaxes(beta, 0, 1),
+        jnp.swapaxes(valid, 0, 1),
+    )
+    state, outs = jax.lax.scan(step, state, xs)
+    return jnp.swapaxes(outs, 0, 1), state             # [S, maxq, Hv, Dv]

@@ -60,6 +60,7 @@ def assemble(
     spec: BucketSpec,
     page_size: int,
     hidden_states: np.ndarray | None = None,
+    with_dense_map: bool = False,
 ) -> BatchInputs:
     """Build fixed-shape arrays from a ragged plan.
 
@@ -98,7 +99,35 @@ def assemble(
         row += n
     cu_q_lens[s_real + 1 :] = cu_q_lens[s_real]
 
+    state_slots = dense_map = q_lens_arr = None
+    if with_dense_map:
+        # Hybrid models: densify ragged rows to [S, maxq] per-seq steps; maxq
+        # is its own bucket dimension so decode batches compile with maxq=1
+        # (the recurrence scan vanishes).
+        maxq_real = max((seg.num_new_tokens for seg in seqs), default=1)
+        maxq = next_bucket(maxq_real, [1] + spec.token_buckets)
+        dense_map = np.full((s, maxq), t, np.int32)  # t = OOB padding row
+        q_lens_np = np.zeros((s,), np.int32)
+        slots = np.zeros((s,), np.int32)
+        reset = np.zeros((s,), np.int32)
+        for i, seg in enumerate(seqs):
+            n = seg.num_new_tokens
+            dense_map[i, :n] = np.arange(cu_q_lens[i], cu_q_lens[i] + n)
+            q_lens_np[i] = n
+            slots[i] = getattr(seg.request, "state_slot", 0)
+            # First chunk of the request: its reused slot holds a previous
+            # request's final state and must be zeroed.
+            reset[i] = int(seg.context_len - n == 0)
+        state_slots = jnp.asarray(slots)
+        q_lens_arr = jnp.asarray(q_lens_np)
+        dense_map = jnp.asarray(dense_map)
+        reset_arr = jnp.asarray(reset)
+
     return BatchInputs(
+        state_slots=state_slots,
+        dense_map=dense_map,
+        q_lens=q_lens_arr,
+        reset_state=None if not with_dense_map else reset_arr,
         token_ids=jnp.asarray(token_ids),
         hidden_states=(
             None if hidden_states is None

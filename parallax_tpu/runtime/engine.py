@@ -78,6 +78,12 @@ class StageEngine:
         self.cfg = config or EngineConfig()
         self.mesh = mesh
         kv_dtype = jnp.bfloat16 if self.cfg.kv_dtype == "bfloat16" else jnp.float32
+        # Hybrid (linear-attention) models carry per-request state slots.
+        self._needs_state = bool(getattr(model, "has_linear_layers", False))
+        if self._needs_state:
+            from parallax_tpu.runtime.allocator import SlotAllocator
+
+            self._slot_alloc = SlotAllocator(self.cfg.max_batch_size * 2)
         if mesh is not None and model.tp_size > 1:
             # Allocate the cache directly in its sharded layout — a
             # materialize-then-reshard would spike one chip's HBM with the
@@ -95,14 +101,26 @@ class StageEngine:
                 ),
                 out_shardings=shardings,
             )()
+        elif self._needs_state:
+            self.kv = model.new_kv_caches(
+                self.cfg.num_pages, self.cfg.page_size, kv_dtype,
+                num_state_slots=self.cfg.max_batch_size * 2,
+            )
         else:
             self.kv = model.new_kv_caches(
                 self.cfg.num_pages, self.cfg.page_size, kv_dtype
             )
+        # Linear-attention state is not prefix-restorable yet, so prefix
+        # caching is off for hybrid models — gated on the WHOLE model (any
+        # linear layer in the config), not this stage's slice: stages of one
+        # pipeline must agree or their token accounting desynchronizes.
+        hybrid_model = model.config.linear_attn is not None
         self.cache = CacheManager(
             self.cfg.page_size,
             self.cfg.num_pages,
-            enable_prefix_cache=self.cfg.enable_prefix_cache,
+            enable_prefix_cache=(
+                self.cfg.enable_prefix_cache and not hybrid_model
+            ),
             max_model_len=self.cfg.max_model_len,
         )
         self.scheduler = Scheduler(
@@ -213,6 +231,7 @@ class StageEngine:
                 else:
                     req.status = RequestStatus.FINISHED_EOS
             self.scheduler.release_request(req)
+            self._free_state_slot(req)
 
     # -- stepping ---------------------------------------------------------
 
@@ -234,7 +253,15 @@ class StageEngine:
                 ],
                 axis=0,
             )
-        inputs = assemble(plan, self.spec, self.cfg.page_size, hidden_states=hidden)
+        if self._needs_state:
+            for seg in plan.seqs:
+                if not hasattr(seg.request, "state_slot"):
+                    # slot 0 is the null slot; real slots start at 1.
+                    seg.request.state_slot = self._slot_alloc.alloc() + 1
+        inputs = assemble(
+            plan, self.spec, self.cfg.page_size, hidden_states=hidden,
+            with_dense_map=self._needs_state,
+        )
         out, self.kv = self._jit_step(self.params, self.kv, inputs)
 
         # Advance scheduler state first: a locally-committed sampled token
@@ -376,7 +403,13 @@ class StageEngine:
         for req in finished:
             self.scheduler.release_request(req)
             self._pending_hidden.pop(req.request_id, None)
+            self._free_state_slot(req)
         return finished
+
+    def _free_state_slot(self, req: Request) -> None:
+        if self._needs_state and hasattr(req, "state_slot"):
+            self._slot_alloc.free(req.state_slot - 1)
+            del req.state_slot
 
     def _record_latency(self, plan: BatchPlan, ms: float) -> None:
         if plan.has_prefill or plan.is_empty:
