@@ -49,6 +49,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     bench = sub.add_parser("bench", help="offline throughput benchmark")
     bench.add_argument("--config", default="qwen2-7b")
+
+    chat = sub.add_parser("chat", help="interactive chat against a server")
+    chat.add_argument("--base-url", default="http://127.0.0.1:8000")
+    chat.add_argument("--max-tokens", type=int, default=512)
+    chat.add_argument("--temperature", type=float, default=0.7)
     return p
 
 
@@ -74,7 +79,60 @@ def main(argv: list[str] | None = None) -> int:
 
         bench.main()
         return 0
+    if args.command == "chat":
+        return chat_main(args)
     return 1
+
+
+def chat_main(args) -> int:
+    """Interactive streaming chat REPL (reference ``parallax chat``)."""
+    import json
+    import urllib.request
+
+    history: list[dict] = []
+    print(f"chatting with {args.base_url} — /quit to exit, /clear to reset")
+    while True:
+        try:
+            user = input("you> ").strip()
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return 0
+        if not user:
+            continue
+        if user == "/quit":
+            return 0
+        if user == "/clear":
+            history.clear()
+            continue
+        history.append({"role": "user", "content": user})
+        payload = json.dumps({
+            "messages": history,
+            "max_tokens": args.max_tokens,
+            "temperature": args.temperature,
+            "stream": True,
+        }).encode()
+        req = urllib.request.Request(
+            f"{args.base_url}/v1/chat/completions", data=payload,
+            headers={"Content-Type": "application/json"},
+        )
+        reply = []
+        try:
+            with urllib.request.urlopen(req, timeout=600) as resp:
+                for raw in resp:
+                    line = raw.decode().strip()
+                    if not line.startswith("data: ") or line == "data: [DONE]":
+                        continue
+                    chunk = json.loads(line[6:])
+                    delta = chunk["choices"][0].get("delta", {}).get("content")
+                    if delta:
+                        reply.append(delta)
+                        print(delta, end="", flush=True)
+            print()
+        except Exception as e:
+            print(f"\n[error: {e}]")
+            history.pop()
+            continue
+        history.append({"role": "assistant", "content": "".join(reply)})
 
 
 if __name__ == "__main__":
