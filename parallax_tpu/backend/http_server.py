@@ -132,11 +132,15 @@ class OpenAIFrontend:
         self.model_name = model_name
         self.stream_poll_s = stream_poll_s
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
+        self._counters = {"requests": 0, "completion_tokens": 0,
+                          "prompt_tokens": 0, "started_at": time.time()}
         self.app.add_routes([
             web.post("/v1/chat/completions", self.chat_completions),
             web.post("/v1/completions", self.completions),
             web.get("/v1/models", self.models),
             web.get("/health", self.health),
+            web.get("/metrics", self.metrics),
+            web.get("/chat", self.chat_page),
             web.get("/cluster/status", self.cluster_status_stream),
             web.get("/cluster/status_json", self.cluster_status_json),
             web.post("/weight/refit", self.weight_refit),
@@ -146,6 +150,22 @@ class OpenAIFrontend:
 
     async def health(self, _req):
         return web.json_response({"status": "ok"})
+
+    async def metrics(self, _req):
+        """Prometheus-style plaintext counters."""
+        c = self._counters
+        lines = [
+            f"parallax_tpu_requests_total {c['requests']}",
+            f"parallax_tpu_completion_tokens_total {c['completion_tokens']}",
+            f"parallax_tpu_prompt_tokens_total {c['prompt_tokens']}",
+            f"parallax_tpu_uptime_seconds {time.time() - c['started_at']:.0f}",
+        ]
+        return web.Response(text="\n".join(lines) + "\n")
+
+    async def chat_page(self, _req):
+        """Minimal built-in chat UI (reference serves chat.html from the
+        node chat server, node_chat_http_server.py)."""
+        return web.Response(text=_CHAT_HTML, content_type="text/html")
 
     async def models(self, _req):
         return web.json_response({
@@ -228,6 +248,10 @@ class OpenAIFrontend:
             routing_table=routing_table,
             eos_token_ids=tuple(self.tokenizer.eos_token_ids),
         )
+        # Count at accept time, not in usage formatting: client disconnects
+        # mid-stream must still be visible in /metrics.
+        self._counters["requests"] += 1
+        self._counters["prompt_tokens"] += req.num_prompt_tokens
         t_start = time.monotonic()
         try:
             done = await asyncio.to_thread(self.submit_fn, req)
@@ -240,13 +264,16 @@ class OpenAIFrontend:
             return await self._stream_response(
                 http_request, req, done, chat, t_start
             )
-        ok = await asyncio.to_thread(done.wait, 600.0)
-        if not ok or req.status.value == "finished_abort":
-            return self._error(502, f"generation failed: {req.abort_reason}")
-        text = self.tokenizer.decode(req.output_ids)
-        return web.json_response(
-            self._completion_body(req, text, chat, t_start)
-        )
+        try:
+            ok = await asyncio.to_thread(done.wait, 600.0)
+            if not ok or req.status.value == "finished_abort":
+                return self._error(502, f"generation failed: {req.abort_reason}")
+            text = self.tokenizer.decode(req.output_ids)
+            return web.json_response(
+                self._completion_body(req, text, chat, t_start)
+            )
+        finally:
+            self._counters["completion_tokens"] += req.num_output_tokens
 
     async def _stream_response(self, http_request, req, done, chat, t_start):
         resp = web.StreamResponse(headers={
@@ -255,6 +282,12 @@ class OpenAIFrontend:
         })
         resp.enable_chunked_encoding()
         await resp.prepare(http_request)
+        try:
+            return await self._stream_body(resp, req, chat, t_start)
+        finally:
+            self._counters["completion_tokens"] += req.num_output_tokens
+
+    async def _stream_body(self, resp, req, chat, t_start):
         sent = 0
         ttft_ms = None
         deadline = time.monotonic() + 600.0
@@ -360,3 +393,53 @@ class OpenAIFrontend:
 
     def run(self, host: str = "0.0.0.0", port: int = 8000) -> None:
         web.run_app(self.app, host=host, port=port, print=None)
+
+
+_CHAT_HTML = """<!doctype html><html><head><meta charset="utf-8">
+<title>parallax-tpu chat</title><style>
+body{font-family:system-ui;margin:0;display:flex;flex-direction:column;
+height:100vh;background:#111;color:#eee}
+#log{flex:1;overflow-y:auto;padding:16px;max-width:760px;margin:0 auto;width:100%}
+.msg{margin:8px 0;padding:10px 14px;border-radius:10px;white-space:pre-wrap}
+.user{background:#2a4365}.bot{background:#222}
+#bar{display:flex;padding:12px;gap:8px;max-width:760px;margin:0 auto;width:100%}
+#inp{flex:1;padding:10px;border-radius:8px;border:1px solid #444;
+background:#1a1a1a;color:#eee}button{padding:10px 18px;border-radius:8px;
+border:none;background:#3182ce;color:#fff;cursor:pointer}
+</style></head><body><div id="log"></div><div id="bar">
+<input id="inp" placeholder="message..." autofocus><button id="go">send</button>
+</div><script>
+const log=document.getElementById('log'),inp=document.getElementById('inp');
+const btn=document.getElementById('go');
+const history=[];let busy=false;
+async function send(){
+ if(busy)return;
+ const text=inp.value.trim(); if(!text)return; inp.value='';
+ busy=true;btn.disabled=true;
+ history.push({role:'user',content:text});
+ add('user',text); const el=add('bot','');
+ try{
+  const r=await fetch('/v1/chat/completions',{method:'POST',
+   headers:{'Content-Type':'application/json'},
+   body:JSON.stringify({model:'parallax-tpu',messages:history,
+    stream:true,max_tokens:512})});
+  if(!r.ok){const err=await r.text();
+   el.textContent='[error '+r.status+': '+err.slice(0,200)+']';
+   history.pop();return;}
+  const rd=r.body.getReader(),dec=new TextDecoder();let acc='',buf='';
+  for(;;){const{done,value}=await rd.read();if(done)break;
+   buf+=dec.decode(value,{stream:true});
+   const lines=buf.split('\\n');buf=lines.pop();
+   for(const line of lines){if(!line.startsWith('data: '))continue;
+    const d=line.slice(6);if(d==='[DONE]')continue;
+    try{const c=JSON.parse(d).choices[0].delta?.content;
+     if(c){acc+=c;el.textContent=acc;log.scrollTop=log.scrollHeight}}catch(e){}}}
+  history.push({role:'assistant',content:acc});
+ }catch(e){el.textContent='[network error: '+e+']';history.pop();}
+ finally{busy=false;btn.disabled=false;inp.focus();}}
+function add(cls,text){const d=document.createElement('div');
+ d.className='msg '+cls;d.textContent=text;log.appendChild(d);
+ log.scrollTop=log.scrollHeight;return d}
+btn.onclick=send;
+inp.addEventListener('keydown',e=>{if(e.key==='Enter')send()});
+</script></body></html>"""

@@ -106,6 +106,7 @@ def chat_main(args) -> int:
             continue
         history.append({"role": "user", "content": user})
         payload = json.dumps({
+            "model": "parallax-tpu",
             "messages": history,
             "max_tokens": args.max_tokens,
             "temperature": args.temperature,
@@ -128,6 +129,11 @@ def chat_main(args) -> int:
                         reply.append(delta)
                         print(delta, end="", flush=True)
             print()
+        except KeyboardInterrupt:
+            # Cancel the turn, keep the REPL alive.
+            print("\n[interrupted]")
+            history.pop()
+            continue
         except Exception as e:
             print(f"\n[error: {e}]")
             history.pop()
