@@ -41,10 +41,44 @@ BASELINE_TOKENS_PER_SEC_PER_CHIP = 1360.0
 WATCHDOG_S = int(os.environ.get("BENCH_WATCHDOG_S", "2400"))
 
 
+PROBE_S = int(os.environ.get("BENCH_PROBE_S", "600"))
+
+
+def _tpu_reachable() -> bool:
+    """Cheap child probe: a wedged relay hangs backend init for ~35 min
+    before failing; don't spend the full watchdog discovering that."""
+    probe = (
+        "import jax, jax.numpy as jnp;"
+        "assert jax.default_backend() == 'tpu';"
+        "x = jnp.ones((8, 8));"
+        "(x @ x).block_until_ready();"
+        "print('TPU_OK')"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", probe],
+            capture_output=True, text=True, timeout=PROBE_S,
+        )
+        if "TPU_OK" in out.stdout:
+            return True
+        sys.stderr.write(f"TPU probe failed:\n{out.stderr[-2000:]}\n")
+        return False
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"TPU probe timed out ({PROBE_S}s)\n")
+        return False
+
+
 def main():
     if os.environ.get("BENCH_CHILD"):
         return _bench()
-    for attempt_env in (None, "1"):
+    if os.environ.get("BENCH_CPU"):
+        attempts = ["1"]
+    elif _tpu_reachable():
+        attempts = [None, "1"]
+    else:
+        sys.stderr.write("TPU unreachable; CPU smoke fallback\n")
+        attempts = ["1"]
+    for attempt_env in attempts:
         env = dict(os.environ, BENCH_CHILD="1")
         if attempt_env:
             env["BENCH_CPU"] = attempt_env
