@@ -1,0 +1,35 @@
+"""Driver-contract smoke tests: bench.py must always print exactly one
+JSON line with the required keys; __graft_entry__.entry() must be
+jit-lowerable."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_cpu_smoke_prints_one_json_line():
+    env = dict(os.environ, BENCH_CPU="1")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr[-1500:]
+    json_lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    assert len(json_lines) == 1, out.stdout
+    rec = json.loads(json_lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec, rec
+    assert rec["value"] > 0
+
+
+def test_graft_entry_lowers():
+    import jax
+
+    sys.path.insert(0, REPO)
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    jax.jit(fn, donate_argnums=(1,)).lower(*args)
