@@ -54,9 +54,21 @@ class SimpleTokenizer:
 def load_tokenizer(model_path: str | None):
     if model_path:
         try:
+            import os
+
+            if not any(
+                os.path.exists(os.path.join(model_path, f))
+                for f in ("tokenizer.json", "tokenizer_config.json",
+                          "tokenizer.model")
+            ):
+                raise FileNotFoundError("no tokenizer files in checkpoint")
             from transformers import AutoTokenizer
 
-            tok = AutoTokenizer.from_pretrained(model_path)
+            # local_files_only: never hit the hub (serving hosts may be
+            # air-gapped; a hub fetch can hang for minutes).
+            tok = AutoTokenizer.from_pretrained(
+                model_path, local_files_only=True
+            )
 
             class _HF:
                 vocab_size = tok.vocab_size
@@ -392,7 +404,15 @@ class OpenAIFrontend:
     # -- run ---------------------------------------------------------------
 
     def run(self, host: str = "0.0.0.0", port: int = 8000) -> None:
-        web.run_app(self.app, host=host, port=port, print=None)
+        import threading
+
+        kwargs = {}
+        if threading.current_thread() is not threading.main_thread():
+            # Signal handlers only install on the main thread.
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            kwargs = {"handle_signals": False, "loop": loop}
+        web.run_app(self.app, host=host, port=port, print=None, **kwargs)
 
 
 _CHAT_HTML = """<!doctype html><html><head><meta charset="utf-8">

@@ -107,13 +107,34 @@ def serve_main(args) -> int:
     config = load_config(args.model_path)
     start = args.start_layer or 0
     end = args.end_layer or config.num_hidden_layers
-    model = create_stage_model(config, start, end)
+
+    tp_size = getattr(args, "tp_size", 0)
+    mesh = None
+    if tp_size != 1:
+        import jax as _jax
+
+        n = len(_jax.local_devices())
+        tp_size = tp_size or n
+        if tp_size > 1:
+            from parallax_tpu.parallel import make_mesh
+
+            mesh = make_mesh(tp_size=tp_size)
+    model = create_stage_model(config, start, end, tp_size=max(1, tp_size))
     params = load_stage_params(model, args.model_path)
 
     page_size = args.page_size
-    num_pages = derive_num_pages(
-        device_free_memory_bytes(args.kv_utilization),
-        config, model.num_local_layers, page_size,
+    # HBM budget, capped by the most pages the configured batch can ever
+    # address (small models would otherwise derive absurd page counts).
+    addressable = (
+        ((args.max_model_len + page_size - 1) // page_size + 1)
+        * args.max_batch_size * 2
+    )
+    num_pages = min(
+        derive_num_pages(
+            device_free_memory_bytes(args.kv_utilization),
+            config, model.num_local_layers, page_size,
+        ),
+        addressable,
     )
     engine = StageEngine(
         model,
@@ -123,7 +144,14 @@ def serve_main(args) -> int:
             num_pages=num_pages,
             max_batch_size=args.max_batch_size,
             max_model_len=args.max_model_len,
+            max_num_tokens_per_batch=getattr(
+                args, "max_num_tokens_per_batch", 2048
+            ),
+            prefill_chunk_size=getattr(args, "prefill_chunk_size", 1024),
+            kv_dtype=getattr(args, "kv_dtype", "bfloat16"),
+            enable_prefix_cache=not getattr(args, "no_prefix_cache", False),
         ),
+        mesh=mesh,
     )
     tokenizer = load_tokenizer(args.model_path)
     frontend, _runner = build_local_frontend(

@@ -32,6 +32,13 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--max-batch-size", type=int, default=64)
     serve.add_argument("--max-model-len", type=int, default=8192)
     serve.add_argument("--kv-utilization", type=float, default=0.9)
+    serve.add_argument("--max-num-tokens-per-batch", type=int, default=2048)
+    serve.add_argument("--prefill-chunk-size", type=int, default=1024)
+    serve.add_argument("--kv-dtype", choices=["bfloat16", "float32"],
+                       default="bfloat16")
+    serve.add_argument("--no-prefix-cache", action="store_true")
+    serve.add_argument("--tp-size", type=int, default=0,
+                       help="0 = all local chips")
 
     run = sub.add_parser("run", help="launch the scheduler + web frontend")
     run.add_argument("--model-name", required=True)
