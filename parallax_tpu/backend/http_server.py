@@ -95,6 +95,9 @@ def load_tokenizer(model_path: str | None):
 
 
 def _sampling_from_body(body: dict, default_max: int = 512) -> SamplingParams:
+    seed = body.get("seed")
+    if seed is not None:
+        seed = int(seed)  # ValueError -> 400 in the caller
     return SamplingParams(
         temperature=float(body.get("temperature", 1.0)),
         top_p=float(body.get("top_p", 1.0)),
@@ -102,6 +105,7 @@ def _sampling_from_body(body: dict, default_max: int = 512) -> SamplingParams:
         min_p=float(body.get("min_p", 0.0)),
         presence_penalty=float(body.get("presence_penalty", 0.0)),
         frequency_penalty=float(body.get("frequency_penalty", 0.0)),
+        repetition_penalty=float(body.get("repetition_penalty", 1.0)),
         max_new_tokens=int(
             body.get("max_tokens")
             or body.get("max_completion_tokens")
@@ -112,8 +116,81 @@ def _sampling_from_body(body: dict, default_max: int = 512) -> SamplingParams:
             else body.get("stop") or ()
         ),
         ignore_eos=bool(body.get("ignore_eos", False)),
-        seed=body.get("seed"),
+        seed=seed,
     )
+
+
+class IncrementalDecoder:
+    """Streaming detokenizer with bounded per-update work.
+
+    BPE detokenization is context-dependent, so per-token-span decodes break
+    leading spaces and multi-byte UTF-8. Decoding the whole output every
+    poll is O(n^2) and stalls the event loop on long generations. This uses
+    the standard two-offset scheme: decode a short window
+    ``ids[prefix_offset:n]``, emit only once the window doesn't end in a
+    partial character (U+FFFD), then slide the window.
+    """
+
+    def __init__(self, tokenizer):
+        self.tok = tokenizer
+        self.prefix_offset = 0
+        self.read_offset = 0
+        self.text = ""  # decoded-and-stable text; grows append-only
+
+    def update(self, ids: list[int]) -> str:
+        """Feed the full token list; returns the stable decoded text."""
+        n = len(ids)
+        if n > self.read_offset:
+            prefix = self.tok.decode(ids[self.prefix_offset:self.read_offset])
+            window = self.tok.decode(ids[self.prefix_offset:n])
+            if len(window) > len(prefix) and not window.endswith("�"):
+                self.text += window[len(prefix):]
+                self.prefix_offset = self.read_offset
+                self.read_offset = n
+        return self.text
+
+    def finalize(self, ids: list[int]) -> str:
+        """Flush everything, including a trailing partial character."""
+        prefix = self.tok.decode(ids[self.prefix_offset:self.read_offset])
+        window = self.tok.decode(ids[self.prefix_offset:])
+        if len(window) > len(prefix):
+            self.text += window[len(prefix):]
+            self.prefix_offset = self.read_offset = len(ids)
+        return self.text
+
+
+def _stop_holdback(text: str, stops) -> int:
+    """Chars to hold back: the longest text suffix that is a proper prefix
+    of some stop string (it may complete into a match next poll)."""
+    hold = 0
+    for s in stops:
+        for n in range(min(len(s) - 1, len(text)), 0, -1):
+            if text.endswith(s[:n]):
+                hold = max(hold, n)
+                break
+    return hold
+
+
+class _StopScanner:
+    """Stop-string search that only rescans text appended since last call
+    (minus a max-stop-length overlap), keeping per-poll cost O(delta)."""
+
+    def __init__(self, stops):
+        self.stops = [s for s in stops if s]
+        self._overlap = max((len(s) for s in self.stops), default=1) - 1
+        self._pos = 0
+
+    def find(self, text: str) -> int | None:
+        if not self.stops:
+            return None
+        start = max(0, self._pos - self._overlap)
+        best = None
+        for s in self.stops:
+            i = text.find(s, start)
+            if i != -1 and (best is None or i < best):
+                best = i
+        self._pos = len(text)
+        return best
 
 
 class OpenAIFrontend:
@@ -123,7 +200,8 @@ class OpenAIFrontend:
     list[str] | None`` callables abstract over local pipelines and the
     networked swarm, so the same frontend runs on the scheduler host and in
     single-node mode (reference node_chat_http_server.py does the same via
-    RPC stubs).
+    RPC stubs). ``stop_fn(rid)`` asks the backend to gracefully finish a
+    request early (stop-string match).
     """
 
     def __init__(
@@ -135,12 +213,14 @@ class OpenAIFrontend:
         model_name: str = "parallax-tpu",
         stream_poll_s: float = 0.02,
         refit_fn=None,
+        stop_fn=None,
     ):
         self.tokenizer = tokenizer
         self.submit_fn = submit_fn
         self.route_fn = route_fn
         self.status_fn = status_fn
         self.refit_fn = refit_fn
+        self.stop_fn = stop_fn
         self.model_name = model_name
         self.stream_poll_s = stream_poll_s
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
@@ -243,6 +323,10 @@ class OpenAIFrontend:
         prompt_ids = self.tokenizer.encode(prompt_text)
         if not prompt_ids:
             return self._error(400, "empty prompt")
+        try:
+            sampling_params = _sampling_from_body(body)
+        except (TypeError, ValueError) as e:
+            return self._error(400, f"invalid sampling parameter: {e}")
 
         # Routing with retry ladder (reference request_handler.py:100-245:
         # None path -> 503 after retries; engine full -> 429).
@@ -256,7 +340,7 @@ class OpenAIFrontend:
         req = Request(
             request_id=rid,
             prompt_ids=list(prompt_ids),
-            sampling_params=_sampling_from_body(body),
+            sampling_params=sampling_params,
             routing_table=routing_table,
             eos_token_ids=tuple(self.tokenizer.eos_token_ids),
         )
@@ -277,12 +361,44 @@ class OpenAIFrontend:
                 http_request, req, done, chat, t_start
             )
         try:
-            ok = await asyncio.to_thread(done.wait, 600.0)
+            stops = req.sampling_params.stop_strings
+            stop_idx = None
+            dec = IncrementalDecoder(self.tokenizer)
+            scanner = _StopScanner(stops)
+            if stops:
+                # Poll so a stop-string match ends generation early instead
+                # of silently running to EOS/max_tokens.
+                deadline = time.monotonic() + 600.0
+                checked = 0
+                while not req.status.is_finished:
+                    if time.monotonic() > deadline:
+                        req.abort("deadline exceeded")
+                        break
+                    n = len(req.output_ids)
+                    if n > checked:
+                        checked = n
+                        text = dec.update(list(req.output_ids[:n]))
+                        stop_idx = scanner.find(text)
+                        if stop_idx is not None:
+                            await self._request_stop(req)
+                            break
+                    await asyncio.sleep(self.stream_poll_s)
+                ok = req.status.is_finished or stop_idx is not None
+            else:
+                ok = await asyncio.to_thread(done.wait, 600.0)
             if not ok or req.status.value == "finished_abort":
                 return self._error(502, f"generation failed: {req.abort_reason}")
-            text = self.tokenizer.decode(req.output_ids)
+            text = dec.finalize(list(req.output_ids))
+            if stop_idx is None and stops:
+                stop_idx = scanner.find(text)
+            stop_matched = stop_idx is not None
+            if stop_idx is not None:
+                text = text[:stop_idx]
             return web.json_response(
-                self._completion_body(req, text, chat, t_start)
+                self._completion_body(
+                    req, text, chat, t_start,
+                    finish_override="stop" if stop_matched else None,
+                )
             )
         finally:
             self._counters["completion_tokens"] += req.num_output_tokens
@@ -299,43 +415,95 @@ class OpenAIFrontend:
         finally:
             self._counters["completion_tokens"] += req.num_output_tokens
 
+    async def _request_stop(self, req) -> None:
+        """Ask the backend to finish ``req`` early (stop-string match)."""
+        if self.stop_fn is not None:
+            try:
+                await asyncio.to_thread(self.stop_fn, req.request_id)
+            except Exception as e:
+                logger.warning("stop_fn failed for %s: %s", req.request_id, e)
+
     async def _stream_body(self, resp, req, chat, t_start):
-        sent = 0
+        # BPE detokenization is context-dependent: per-token-span decodes
+        # break leading spaces and multi-token UTF-8 sequences, so deltas
+        # come from an incremental decoder (bounded per-poll work) and stop
+        # strings are scanned over appended text only.
+        stops = req.sampling_params.stop_strings
+        dec = IncrementalDecoder(self.tokenizer)
+        scanner = _StopScanner(stops)
+        seen_tokens = 0
+        emitted = ""
         ttft_ms = None
+        stop_matched = False
         deadline = time.monotonic() + 600.0
         while True:
             n = len(req.output_ids)
-            if n > sent:
+            if n > seen_tokens:
                 if ttft_ms is None:
                     ttft_ms = (time.monotonic() - t_start) * 1e3
-                delta = self.tokenizer.decode(req.output_ids[sent:n])
-                sent = n
-                await resp.write(self._sse_chunk(req, delta, chat))
+                seen_tokens = n
+                full = dec.update(list(req.output_ids[:n]))
+                idx = scanner.find(full) if stops else None
+                if idx is not None:
+                    final = full[:idx]
+                    if len(final) > len(emitted):
+                        await resp.write(
+                            self._sse_chunk(req, final[len(emitted):], chat)
+                        )
+                        emitted = final
+                    stop_matched = True
+                    await self._request_stop(req)
+                    break
+                # Hold back any suffix that could become a stop match.
+                safe = len(full) - (_stop_holdback(full, stops) if stops else 0)
+                if safe > len(emitted):
+                    await resp.write(
+                        self._sse_chunk(req, full[len(emitted):safe], chat)
+                    )
+                    emitted = full[:safe]
             if req.status.is_finished:
                 break
             if time.monotonic() > deadline:
                 req.abort("stream deadline exceeded")
                 break
             await asyncio.sleep(self.stream_poll_s)
+        if not stop_matched:
+            # Flush whatever was held back / arrived after the last poll.
+            full = dec.finalize(list(req.output_ids))
+            idx = scanner.find(full) if stops else None
+            if idx is not None:
+                full = full[:idx]
+                stop_matched = True
+            if len(full) > len(emitted):
+                await resp.write(
+                    self._sse_chunk(req, full[len(emitted):], chat)
+                )
         usage = self._usage(req, t_start, ttft_ms)
-        await resp.write(self._sse_chunk(req, "", chat, finish=True, usage=usage))
+        await resp.write(self._sse_chunk(
+            req, "", chat, finish=True, usage=usage,
+            finish_override="stop" if stop_matched else None,
+        ))
         await resp.write(b"data: [DONE]\n\n")
         return resp
 
-    def _sse_chunk(self, req, delta_text, chat, finish=False, usage=None) -> bytes:
+    def _sse_chunk(self, req, delta_text, chat, finish=False, usage=None,
+                   finish_override=None) -> bytes:
+        reason = (
+            (finish_override or self._finish_reason(req)) if finish else None
+        )
         if chat:
             delta = {} if finish else {"content": delta_text}
             choice = {
                 "index": 0,
                 "delta": delta,
-                "finish_reason": self._finish_reason(req) if finish else None,
+                "finish_reason": reason,
             }
             obj = "chat.completion.chunk"
         else:
             choice = {
                 "index": 0,
                 "text": delta_text,
-                "finish_reason": self._finish_reason(req) if finish else None,
+                "finish_reason": reason,
             }
             obj = "text_completion"
         payload = {
@@ -349,19 +517,20 @@ class OpenAIFrontend:
             payload["usage"] = usage
         return f"data: {json.dumps(payload)}\n\n".encode()
 
-    def _completion_body(self, req, text, chat, t_start):
+    def _completion_body(self, req, text, chat, t_start, finish_override=None):
+        reason = finish_override or self._finish_reason(req)
         if chat:
             choice = {
                 "index": 0,
                 "message": {"role": "assistant", "content": text},
-                "finish_reason": self._finish_reason(req),
+                "finish_reason": reason,
             }
             obj = "chat.completion"
         else:
             choice = {
                 "index": 0,
                 "text": text,
-                "finish_reason": self._finish_reason(req),
+                "finish_reason": reason,
             }
             obj = "text_completion"
         return {

@@ -32,6 +32,8 @@ class SwarmClient:
         self.transport = transport
         self.service = service
         self.poll_interval_s = poll_interval_s
+        # rid -> head node id, for stop-string early finish.
+        self._heads: dict[str, str] = {}
 
     def route(self, request_id: str) -> list[str] | None:
         return self.service.route_request(request_id, timeout_s=10.0)
@@ -54,13 +56,37 @@ class SwarmClient:
             self.service.scheduler.complete_request(request.routing_table)
             raise RuntimeError(f"head node {head} unreachable")
         ev = threading.Event()
+        self._heads[request.request_id] = head
         t = threading.Thread(
             target=self._poll_loop, args=(request, head, ev), daemon=True
         )
         t.start()
         return ev
 
+    def stop(self, request_id: str) -> None:
+        """Ask the head node to finish a request early (stop-string match).
+
+        Best-effort: the frontend already trimmed the visible text; this
+        just saves the swarm from generating the rest.
+        """
+        head = self._heads.get(request_id)
+        if head is None:
+            return
+        try:
+            self.transport.call(
+                head, "chat_stop", {"rid": request_id}, timeout=10.0
+            )
+        except Exception as e:
+            logger.warning("chat_stop failed for %s: %s", request_id, e)
+
     def _poll_loop(self, request: Request, head: str, ev: threading.Event):
+        try:
+            self._poll_until_done(request, head, ev)
+        finally:
+            self._heads.pop(request.request_id, None)
+
+    def _poll_until_done(self, request: Request, head: str,
+                         ev: threading.Event):
         failures = 0
         while True:
             try:
@@ -110,6 +136,7 @@ def build_swarm_frontend(
         status_fn=scheduler.cluster_status,
         refit_fn=scheduler.begin_refit,
         model_name=model_name,
+        stop_fn=client.stop,
     )
     return frontend, service, client
 

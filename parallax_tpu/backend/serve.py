@@ -47,6 +47,12 @@ class LocalRunner:
                 raise RuntimeError("engine queue full")
         return ev
 
+    def stop_request(self, request_id: str) -> None:
+        """Gracefully finish a request early (stop-string match): the next
+        step round collects and releases it."""
+        with self._lock:
+            self.pipeline.head.stop_request(request_id)
+
     def _loop(self) -> None:
         while not self._stop.is_set():
             if not self.pipeline.has_work():
@@ -89,6 +95,7 @@ def build_local_frontend(
         submit_fn=runner.submit,
         status_fn=status,
         model_name=model_name,
+        stop_fn=runner.stop_request,
     )
     return frontend, runner
 

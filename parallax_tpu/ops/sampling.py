@@ -26,8 +26,16 @@ def sample_tokens(
     top_k: jax.Array,             # i32[B]; <=0 disables
     top_p: jax.Array,             # f32[B] in (0, 1]; 1 disables
     min_p: jax.Array,             # f32[B] in [0, 1); 0 disables
+    seeds: jax.Array | None = None,      # i32[B]; <0 = unseeded row
+    out_steps: jax.Array | None = None,  # i32[B]; output index per row
 ) -> jax.Array:
-    """Sample one token per row. Returns i32[B]."""
+    """Sample one token per row. Returns i32[B].
+
+    Seeded rows (``seeds[i] >= 0``) draw from ``fold_in(key(seed), step)``
+    so the k-th output token of a seeded request is reproducible regardless
+    of batch composition or engine step count; unseeded rows use the
+    engine's per-step key folded with the row index.
+    """
     b, v = logits.shape
     logits = logits.astype(jnp.float32)
     greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -55,13 +63,60 @@ def sample_tokens(
 
     filtered = jnp.where(keep, sorted_logits, NEG_INF)
     # Gumbel-max over the filtered sorted logits.
-    gumbel = jax.random.gumbel(key, (b, v), dtype=jnp.float32)
+    if seeds is None:
+        gumbel = jax.random.gumbel(key, (b, v), dtype=jnp.float32)
+    else:
+        steps = out_steps if out_steps is not None else jnp.zeros(
+            (b,), jnp.int32
+        )
+
+        def _row_key(seed, step, i):
+            return jax.lax.cond(
+                seed >= 0,
+                lambda: jax.random.fold_in(jax.random.key(seed), step),
+                lambda: jax.random.fold_in(key, i),
+            )
+
+        row_keys = jax.vmap(_row_key)(
+            seeds, steps, jnp.arange(b, dtype=jnp.int32)
+        )
+        gumbel = jax.vmap(
+            lambda k: jax.random.gumbel(k, (v,), dtype=jnp.float32)
+        )(row_keys)
     choice_rank = jnp.argmax(filtered + gumbel, axis=-1)
     sampled_ids = jnp.take_along_axis(
         sorted_idx, choice_rank[:, None], axis=-1
     )[:, 0].astype(jnp.int32)
 
     return jnp.where(temperature <= 0.0, greedy_ids, sampled_ids)
+
+
+@jax.jit
+def penalize_logits(
+    logits: jax.Array,       # [B, V]
+    out_ids: jax.Array,      # i32[B, L] generated token ids, -1 padded
+    presence_penalty: jax.Array,
+    frequency_penalty: jax.Array,
+    repetition_penalty: jax.Array,
+) -> jax.Array:
+    """Build per-row output-token counts on device and apply penalties.
+
+    The host passes the (small) padded id lists instead of a dense [B, V]
+    count matrix — the scatter-add happens on device.
+    """
+    b, v = logits.shape
+    valid = out_ids >= 0
+    ids = jnp.where(valid, out_ids, 0)
+    rows = jnp.broadcast_to(
+        jnp.arange(b, dtype=jnp.int32)[:, None], out_ids.shape
+    )
+    counts = jnp.zeros((b, v), jnp.int32).at[rows, ids].add(
+        valid.astype(jnp.int32)
+    )
+    return apply_penalties(
+        logits, counts, presence_penalty, frequency_penalty,
+        repetition_penalty,
+    )
 
 
 def apply_penalties(

@@ -28,7 +28,11 @@ from parallax_tpu.models.registry import create_stage_model
 from parallax_tpu.p2p import proto
 from parallax_tpu.p2p.transport import Transport
 from parallax_tpu.runtime.engine import EngineConfig, StageEngine
-from parallax_tpu.runtime.request import IntermediateRequest, Request
+from parallax_tpu.runtime.request import (
+    IntermediateRequest,
+    Request,
+    RequestStatus,
+)
 from parallax_tpu.utils import get_logger
 from parallax_tpu.utils.hw import detect_hardware
 
@@ -78,6 +82,7 @@ class WorkerNode:
         transport.register(proto.RELEASE, self._on_release)
         transport.register("chat_submit", self._on_chat_submit)
         transport.register("chat_poll", self._on_chat_poll)
+        transport.register("chat_stop", self._on_chat_stop)
         transport.register("__ping__", lambda *_: "pong")
         # Head-node chat requests by id (polled by the HTTP frontend;
         # reference: TransformerConnectionHandler.chat_completion proxies to
@@ -239,6 +244,12 @@ class WorkerNode:
         self.submit(req)
         return "ok"
 
+    def _on_chat_stop(self, _peer: str, payload: dict):
+        """Stop-string early finish: gracefully end the request with
+        FINISHED_STOP (unlike abort, the generated text stands)."""
+        self._inbox.put(("stop", payload["rid"]))
+        return "ok"
+
     def _on_chat_poll(self, _peer: str, payload: dict):
         req = self._chat_requests.get(payload["rid"])
         if req is None:
@@ -314,6 +325,8 @@ class WorkerNode:
                     self._finish(req)
             elif kind == "release":
                 self.engine.release(item[1], abort=item[2])
+            elif kind == "stop":
+                self.engine.stop_request(item[1])
             elif kind == "abort_path":
                 # A next-hop peer is unreachable: abort everything routed
                 # through it; the normal finish flow then releases pages,

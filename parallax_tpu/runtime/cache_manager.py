@@ -189,8 +189,16 @@ class CacheManager:
             request.page_ids = []
             return
         if self.enable_prefix_cache and request.status.value != "finished_abort":
-            tokens = request.all_token_ids
-            n_full = len(tokens) // self.page_size
+            # Only donate pages fully covered by *computed* KV. The final
+            # sampled token never runs a forward step (the request finishes
+            # at commit), so its KV slot is stale — when the token count is
+            # page-aligned the naive len(all_token_ids) count would donate a
+            # page with one corrupt slot that future prefix hits silently
+            # read. (Reference insert_full_blocks_to_cache uses context_len,
+            # the computed KV length, for the same reason.)
+            computed = min(request.num_computed_tokens, len(request.all_token_ids))
+            n_full = computed // self.page_size
+            tokens = request.all_token_ids[: n_full * self.page_size]
             tail = owned[max(0, n_full - num_shared):]
             duplicates = self.prefix_cache.insert(tokens, request.page_ids[:n_full])
             self.allocator.free(duplicates + tail)

@@ -202,6 +202,12 @@ class StageEngine:
             req.is_mirror = True  # type: ignore[attr-defined]
             self.scheduler.enqueue(req)
         else:
+            if getattr(req, "last_chunk_flag", False):
+                # The prompt was complete before this packet, so these
+                # tokens are generated ones — track them for penalties.
+                req.mirror_gen_ids = (  # type: ignore[attr-defined]
+                    getattr(req, "mirror_gen_ids", []) + list(new_tokens)
+                )
             req.prompt_ids.extend(new_tokens)
             req.status = RequestStatus.PREFILLING
             req.ready_for_step = True
@@ -309,19 +315,70 @@ class StageEngine:
             self._pending_hidden.pop(rid)
         return take
 
+    @staticmethod
+    def _generated_ids(req: Request) -> list[int]:
+        """Tokens this request has generated so far, as visible to THIS
+        stage: the head tracks output_ids; a mirror accumulates decode-token
+        arrivals (``mirror_gen_ids``)."""
+        if getattr(req, "is_mirror", False):
+            return getattr(req, "mirror_gen_ids", [])
+        return req.output_ids
+
     def _sample(self, logits: jax.Array, inputs: BatchInputs, plan: BatchPlan):
         s = int(inputs.kv_lens.shape[0])
         temp = np.zeros((s,), np.float32)
         top_k = np.zeros((s,), np.int32)
         top_p = np.ones((s,), np.float32)
         min_p = np.zeros((s,), np.float32)
+        pres = np.zeros((s,), np.float32)
+        freq = np.zeros((s,), np.float32)
+        rep = np.ones((s,), np.float32)
+        seeds = np.full((s,), -1, np.int32)
+        steps = np.zeros((s,), np.int32)
+        any_pen = any_seed = False
+        gen_lists: list[list[int]] = []
         for i, seg in enumerate(plan.seqs):
             sp = seg.request.sampling_params
             temp[i] = sp.temperature
             top_k[i] = sp.top_k
             top_p[i] = sp.top_p
             min_p[i] = sp.min_p
+            gen = self._generated_ids(seg.request)
+            gen_lists.append(gen)
+            if sp.presence_penalty or sp.frequency_penalty or (
+                sp.repetition_penalty != 1.0
+            ):
+                any_pen = True
+                pres[i] = sp.presence_penalty
+                freq[i] = sp.frequency_penalty
+                rep[i] = sp.repetition_penalty
+            if sp.seed is not None:
+                any_seed = True
+                seeds[i] = sp.seed & 0x7FFFFFFF
+                steps[i] = len(gen)
+        if any_pen:
+            # Pad generated-id lists onto a power-of-2 lattice (bounded
+            # recompiles) and scatter the counts on device.
+            from parallax_tpu.ops.sampling import penalize_logits
+
+            max_len = max((len(g) for g in gen_lists), default=0)
+            bucket = 8
+            while bucket < max_len:
+                bucket *= 2
+            out_ids = np.full((s, bucket), -1, np.int32)
+            for i, gen in enumerate(gen_lists):
+                if gen:
+                    out_ids[i, : len(gen)] = gen
+            logits = penalize_logits(
+                logits, jnp.asarray(out_ids), jnp.asarray(pres),
+                jnp.asarray(freq), jnp.asarray(rep),
+            )
         key = jax.random.fold_in(self._base_key, self._step_count)
+        kwargs = {}
+        if any_seed:
+            kwargs = dict(
+                seeds=jnp.asarray(seeds), out_steps=jnp.asarray(steps)
+            )
         tokens = sample_tokens(
             logits,
             key,
@@ -329,6 +386,7 @@ class StageEngine:
             jnp.asarray(top_k),
             jnp.asarray(top_p),
             jnp.asarray(min_p),
+            **kwargs,
         )
         return np.asarray(tokens)
 
@@ -390,9 +448,21 @@ class StageEngine:
     def commit_token(self, request_id: str, token: int) -> None:
         """Head: the ring delivered a sampled token for ``request_id``."""
         req = self.scheduler.running.get(request_id)
-        if req is None:
+        if req is None or req.status.is_finished:
+            # Already finished (e.g. a stop-string early finish raced an
+            # in-flight ring token): committing would resurrect it.
             return
         self._commit(req, token)
+
+    def stop_request(self, request_id: str) -> None:
+        """Gracefully finish a request early (stop-string match). Unlike
+        abort, the generated text stands; the next step collects and
+        releases it through the normal finish flow."""
+        req = self.scheduler.running.get(request_id) or (
+            self.scheduler.wait_queue.get(request_id)
+        )
+        if req is not None and not req.status.is_finished:
+            req.status = RequestStatus.FINISHED_STOP
 
     def _commit(self, req: Request, token: int) -> None:
         req.commit_token(token)

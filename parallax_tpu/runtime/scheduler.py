@@ -181,6 +181,10 @@ class Scheduler:
                     req.status = RequestStatus.DECODING
                     req.ready_for_step = False
             elif req.status is RequestStatus.DECODING:
+                # The fed token's KV was written this step, so the computed
+                # count advances during decode too — release() relies on it
+                # to know which pages are fully backed by real KV.
+                req.num_computed_tokens += s.num_new_tokens
                 req.ready_for_step = False
 
     def on_token_committed(self, request: Request) -> None:

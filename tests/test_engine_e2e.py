@@ -139,6 +139,67 @@ def test_chunked_prefill_matches_hf(hf_model):
     assert_greedy_matches(hf_model, prompt, out["r0"], 6)
 
 
+def test_presence_penalty_prevents_repeats_single_stage(hf_model):
+    # A huge presence penalty excludes every generated token from being
+    # sampled again — outputs must be pairwise distinct (vocab >> max_new).
+    engines = build_engines(hf_model, [(0, 4)])
+    pipe = InProcessPipeline(engines)
+    req = Request(
+        request_id="pen", prompt_ids=[3, 14, 15, 92, 65],
+        sampling_params=SamplingParams(
+            temperature=0.0, max_new_tokens=8, presence_penalty=1e4,
+        ),
+    )
+    pipe.submit(req)
+    pipe.run_until_complete()
+    assert len(req.output_ids) == 8
+    assert len(set(req.output_ids)) == 8, req.output_ids
+
+
+def test_presence_penalty_on_mirror_last_stage(hf_model):
+    # Multi-stage: sampling happens on the LAST stage, which only sees the
+    # request as a mirror — generated-token tracking must work there too.
+    engines = build_engines(hf_model, [(0, 2), (2, 4)])
+    pipe = InProcessPipeline(engines)
+    req = Request(
+        request_id="pen2", prompt_ids=[7, 21, 180, 55],
+        sampling_params=SamplingParams(
+            temperature=0.0, max_new_tokens=8, presence_penalty=1e4,
+        ),
+    )
+    pipe.submit(req)
+    pipe.run_until_complete()
+    assert len(req.output_ids) == 8
+    assert len(set(req.output_ids)) == 8, req.output_ids
+
+
+def test_seeded_sampling_is_reproducible(hf_model):
+    # Same seed + same prompt => identical stochastic outputs, even though
+    # the engine's global step counter differs between the two runs.
+    engines = build_engines(hf_model, [(0, 4)])
+    pipe = InProcessPipeline(engines)
+    outs = []
+    for rid in ("s1", "s2"):
+        req = Request(
+            request_id=rid, prompt_ids=[5, 6, 7, 8],
+            sampling_params=SamplingParams(
+                temperature=1.0, max_new_tokens=6, seed=1234,
+            ),
+        )
+        pipe.submit(req)
+        pipe.run_until_complete()
+        outs.append(list(req.output_ids))
+    assert outs[0] == outs[1]
+    # An unseeded run at temperature 1.0 should (overwhelmingly) differ.
+    req = Request(
+        request_id="s3", prompt_ids=[5, 6, 7, 8],
+        sampling_params=SamplingParams(temperature=1.0, max_new_tokens=6),
+    )
+    pipe.submit(req)
+    pipe.run_until_complete()
+    assert len(req.output_ids) == 6
+
+
 def test_prefix_cache_reuse_matches_hf(hf_model):
     shared = [9, 8, 7, 6, 5, 4, 3, 2, 1, 10, 11, 12, 13, 14, 15, 16]
     p1 = shared + [20, 21]

@@ -207,3 +207,158 @@ def test_swarm_http_end_to_end(monkeypatch):
         for w in workers:
             w.stop()
         service.stop()
+
+
+class ScriptedBackend:
+    """Deterministic fake backend: emits a scripted token sequence over
+    time, honors stop_fn by finishing the request early."""
+
+    def __init__(self, tokens, interval_s=0.004):
+        self.tokens = tokens
+        self.interval_s = interval_s
+        self.stopped: list[str] = []
+        self.requests = {}
+
+    def submit(self, req):
+        ev = threading.Event()
+        self.requests[req.request_id] = req
+
+        def run():
+            from parallax_tpu.runtime.request import RequestStatus
+
+            for t in self.tokens:
+                if req.status.is_finished:
+                    break
+                req.output_ids.append(t)
+                time.sleep(self.interval_s)
+            if not req.status.is_finished:
+                req.status = RequestStatus.FINISHED_LENGTH
+            ev.set()
+
+        threading.Thread(target=run, daemon=True).start()
+        return ev
+
+    def stop(self, rid):
+        from parallax_tpu.runtime.request import RequestStatus
+
+        self.stopped.append(rid)
+        req = self.requests.get(rid)
+        if req is not None and not req.status.is_finished:
+            req.status = RequestStatus.FINISHED_STOP
+
+
+class JoinTokenizer:
+    """Context-dependent decode ('-'.joined ids): per-token-span decoding
+    would produce wrong separators, so these tests prove the frontend
+    decodes the full output and emits text deltas (the BPE-safe scheme)."""
+
+    vocab_size = 1000
+    eos_token_ids = ()
+
+    def encode(self, text):
+        return [1, 2, 3]
+
+    def decode(self, ids):
+        return "-".join(str(i) for i in ids)
+
+    def apply_chat_template(self, messages):
+        return "x"
+
+
+def _scripted_frontend(tokens, stop_backend=True):
+    backend = ScriptedBackend(tokens)
+    fe = OpenAIFrontend(
+        JoinTokenizer(),
+        submit_fn=backend.submit,
+        model_name="scripted",
+        stream_poll_s=0.002,
+        stop_fn=backend.stop if stop_backend else None,
+    )
+    return fe, backend
+
+
+def test_stop_string_nonstream_trims_and_stops_backend():
+    fe, backend = _scripted_frontend(list(range(10, 30)))
+    async def fn(client):
+        # decoded stream: "10-11-12-13-..."; stop at "13"
+        status, body = await _json(client, "POST", "/v1/completions",
+            {"prompt": "p", "max_tokens": 50, "stop": ["13"]})
+        assert status == 200, body
+        choice = body["choices"][0]
+        assert choice["text"] == "10-11-12-"
+        assert choice["finish_reason"] == "stop"
+
+    with_client(fe.app, fn)
+    assert backend.stopped  # backend was told to finish early
+
+
+def test_stop_string_streaming_trims_and_holds_back():
+    fe, backend = _scripted_frontend(list(range(10, 30)))
+    async def fn(client):
+        resp = await client.post("/v1/completions", json={
+            "prompt": "p", "max_tokens": 50, "stream": True,
+            "stop": ["15-16"]})
+        assert resp.status == 200
+        return await resp.text()
+
+    raw = with_client(fe.app, fn)
+    chunks = [json.loads(line[6:]) for line in raw.splitlines()
+              if line.startswith("data: ") and line != "data: [DONE]"]
+    text = "".join(c["choices"][0].get("text", "") for c in chunks)
+    assert text == "10-11-12-13-14-"
+    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+    assert backend.stopped
+
+
+def test_streaming_deltas_decode_full_context():
+    # No stop strings: concatenated SSE deltas must equal the full decode,
+    # which per-span decoding cannot produce with a context-dependent
+    # tokenizer.
+    fe, _ = _scripted_frontend([7, 8, 9, 10])
+    async def fn(client):
+        resp = await client.post("/v1/completions", json={
+            "prompt": "p", "max_tokens": 50, "stream": True})
+        assert resp.status == 200
+        return await resp.text()
+
+    raw = with_client(fe.app, fn)
+    chunks = [json.loads(line[6:]) for line in raw.splitlines()
+              if line.startswith("data: ") and line != "data: [DONE]"]
+    text = "".join(c["choices"][0].get("text", "") for c in chunks)
+    assert text == "7-8-9-10"
+
+
+def test_streaming_never_emits_partial_utf8():
+    # "é" = bytes C3 A9 split across two tokens: a poll landing between
+    # them must not emit U+FFFD; the final text must be the real character.
+    from parallax_tpu.backend.http_server import SimpleTokenizer
+
+    backend = ScriptedBackend([0xC3, 0xA9, 0x41], interval_s=0.02)
+    fe = OpenAIFrontend(
+        SimpleTokenizer(), submit_fn=backend.submit, model_name="bytes",
+        stream_poll_s=0.002, stop_fn=backend.stop,
+    )
+
+    async def fn(client):
+        resp = await client.post("/v1/completions", json={
+            "prompt": "p", "max_tokens": 50, "stream": True})
+        assert resp.status == 200
+        return await resp.text()
+
+    raw = with_client(fe.app, fn)
+    chunks = [json.loads(line[6:]) for line in raw.splitlines()
+              if line.startswith("data: ") and line != "data: [DONE]"]
+    deltas = [c["choices"][0].get("text", "") for c in chunks]
+    assert all("�" not in d for d in deltas), deltas
+    assert "".join(deltas) == "éA"
+
+
+def test_invalid_seed_returns_400():
+    fe, _ = _scripted_frontend([1, 2, 3])
+
+    async def fn(client):
+        status, body = await _json(client, "POST", "/v1/completions",
+            {"prompt": "p", "max_tokens": 4, "seed": "not-a-number"})
+        assert status == 400
+
+    with_client(fe.app, fn)

@@ -19,6 +19,14 @@ def make_request(rid, n_prompt, max_new=16):
     )
 
 
+def finish(req, status=RequestStatus.FINISHED_EOS):
+    """Mark a request finished the way the engine would: every token except
+    the final sampled one has computed KV."""
+    req.status = status
+    n = len(req.all_token_ids)
+    req.num_computed_tokens = n - 1 if req.output_ids else n
+
+
 class TestPageAllocator:
     def test_null_page_reserved(self):
         a = PageAllocator(8)
@@ -66,7 +74,7 @@ class TestCacheManager:
         req = make_request("a", 10)
         assert cm.allocate_for_prompt(req)
         assert len(req.page_ids) == 3
-        req.status = RequestStatus.FINISHED_EOS
+        finish(req)
         cm.release(req)
         # 2 full pages went to the prefix cache, tail page freed
         assert cm.prefix_cache.num_cached_pages == 2
@@ -76,7 +84,7 @@ class TestCacheManager:
         r1 = make_request("a", 8)
         cm.allocate_for_prompt(r1)
         pages1 = list(r1.page_ids)
-        r1.status = RequestStatus.FINISHED_EOS
+        finish(r1)
         cm.release(r1)
         r2 = Request("b", prompt_ids=list(range(8)) + [99])
         assert cm.allocate_for_prompt(r2)
@@ -87,7 +95,7 @@ class TestCacheManager:
         cm = CacheManager(page_size=4, num_pages=16)
         r1 = make_request("a", 8)
         cm.allocate_for_prompt(r1)
-        r1.status = RequestStatus.FINISHED_EOS
+        finish(r1)
         cm.release(r1)
         # identical prompt: must still recompute the last token
         r2 = make_request("b", 8)
@@ -98,11 +106,28 @@ class TestCacheManager:
         cm = CacheManager(page_size=4, num_pages=8)  # 7 usable
         r1 = make_request("a", 16)  # 4 pages
         cm.allocate_for_prompt(r1)
-        r1.status = RequestStatus.FINISHED_EOS
+        finish(r1)
         cm.release(r1)  # all 4 full pages cached
         r2 = Request("b", prompt_ids=[500 + i for i in range(24)])  # 6 pages
         assert cm.allocate_for_prompt(r2)  # forces eviction
         assert len(r2.page_ids) == 6
+
+    def test_stale_final_token_page_not_donated(self):
+        # Regression: prompt 7 + 1 sampled token = 8 tokens (page-aligned),
+        # but the sampled token's KV was never computed. The second page
+        # holds one stale slot and must NOT enter the prefix cache.
+        cm = CacheManager(page_size=4, num_pages=16)
+        req = make_request("a", 7)
+        assert cm.allocate_for_prompt(req)
+        req.num_computed_tokens = 7   # prefill done
+        req.commit_token(99)          # finishes; token 99 KV never written
+        req.status = RequestStatus.FINISHED_EOS
+        cm.release(req)
+        assert cm.prefix_cache.num_cached_pages == 1  # only the full page
+        assert cm.num_free_pages == 14  # 15 usable - 1 cached
+        # a future request with that 8-token prefix must not hit page 2
+        pages, _ = cm.prefix_cache.match_prefix(req.prompt_ids + [99])
+        assert len(pages) == 1
 
     def test_abort_frees_without_caching(self):
         cm = CacheManager(page_size=4, num_pages=16)
