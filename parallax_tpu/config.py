@@ -67,6 +67,79 @@ class MLAConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class DSAConfig:
+    """DeepSeek Sparse Attention (V3.2 / GLM-MoE-DSA) indexer dims.
+
+    The "lightning indexer" scores every cached token with
+    ``sum_h w_h * relu(q_h . k)`` and attention runs over the top-k
+    positions of the MLA latent cache. Reference:
+    ``src/parallax/models/deepseek_v32.py:27-58`` (derive_indexer_types),
+    ``src/parallax_extensions/ops.py:182-367``.
+    """
+
+    index_n_heads: int
+    index_head_dim: int
+    index_topk: int
+    index_key_heads: int = 1
+    # Per-layer indexer mode, length == num_hidden_layers: "full" layers run
+    # the indexer; "shared" layers reuse the previous full layer's top-k.
+    indexer_types: tuple[str, ...] = ()
+    # Rope convention inside the indexer head (True = interleaved/GPT-J,
+    # DeepSeek-V3.2 default; GLM-MoE-DSA uses half-rotation).
+    indexer_rope_traditional: bool = True
+    indexer_norm_eps: float = 1e-5
+
+
+def derive_indexer_types(
+    num_layers: int,
+    index_topk_freq: int = 1,
+    indexer_types=None,
+    first_k_dense_replace: int = 0,
+    index_skip_topk_offset: int | None = None,
+) -> tuple[str, ...]:
+    """Per-layer DSA indexer modes (reference deepseek_v32.py:27-58)."""
+    if indexer_types is not None:
+        return tuple(indexer_types)
+    if index_topk_freq <= 1:
+        return ("full",) * num_layers
+    if index_skip_topk_offset is None:
+        index_skip_topk_offset = index_topk_freq - 1
+    return tuple(
+        "full"
+        if (
+            i < first_k_dense_replace
+            or (i - first_k_dense_replace) % index_topk_freq
+            == index_skip_topk_offset
+        )
+        else "shared"
+        for i in range(num_layers)
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class MSAConfig:
+    """MiniMax-M3 block-sparse attention (MSA) dims.
+
+    A light indexer scores sparse blocks of the context (score = max over
+    index heads and block tokens of ``q_idx . k_idx * scale``); attention
+    then runs over the tokens of the top-k blocks, with the first
+    ``init_blocks`` and the ``local_blocks`` nearest blocks always kept.
+    Reference: ``src/parallax/models/minimax_m3.py:456-567``
+    (_build_sparse_mask) + ``src/parallax_extensions/ops.py:594-804``.
+    """
+
+    index_n_heads: int
+    index_head_dim: int
+    block_size: int
+    topk_blocks: int
+    init_blocks: int = 0
+    local_blocks: int = 1
+    index_key_heads: int = 1
+    # Per-layer sparse flag, length == num_hidden_layers.
+    sparse_layer_mask: tuple[bool, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
 class LinearAttnConfig:
     """State shapes for linear-attention / hybrid layers (Qwen3-Next style)."""
 
@@ -106,6 +179,8 @@ class ModelConfig:
     use_attention_sinks: bool = False
     moe: MoEConfig | None = None
     mla: MLAConfig | None = None
+    dsa: DSAConfig | None = None
+    msa: MSAConfig | None = None
     linear_attn: LinearAttnConfig | None = None
     dtype: str = "bfloat16"
     # Bytes per parameter after quantization (bf16 => 2.0).
@@ -140,8 +215,19 @@ class ModelConfig:
         elem = 2  # bf16 cache
         if self.mla is not None:
             # Compressed latent + rope key, shared across heads.
-            return elem * (self.mla.kv_lora_rank + self.mla.qk_rope_head_dim)
-        return 2 * elem * self.num_key_value_heads * self.head_dim
+            base = elem * (self.mla.kv_lora_rank + self.mla.qk_rope_head_dim)
+            if self.dsa is not None:
+                # DSA adds a paged index-key cache alongside the latent
+                # (counted on every layer even though shared-indexer layers
+                # skip it — conservative for page budgeting).
+                base += elem * self.dsa.index_key_heads * self.dsa.index_head_dim
+            return base
+        base = 2 * elem * self.num_key_value_heads * self.head_dim
+        if self.msa is not None:
+            # MSA index-key cache on sparse layers (conservatively counted
+            # on every layer for the page budget).
+            base += elem * self.msa.index_key_heads * self.msa.index_head_dim
+        return base
 
     def embedding_params(self) -> int:
         return self.vocab_size * self.hidden_size
@@ -235,6 +321,9 @@ def normalize_config(raw: dict, model_name: str = "") -> ModelConfig:
 
     archs = cfg.get("architectures") or ["UnknownForCausalLM"]
     architecture = archs[0]
+    is_glm_dsa = cfg.get("model_type") == "glm_moe_dsa"
+    if is_glm_dsa and architecture == "UnknownForCausalLM":
+        architecture = "GlmMoeDsaForCausalLM"
 
     hidden_size = int(_get(cfg, "hidden_size", "n_embd", "d_model"))
     num_layers = int(_get(cfg, "num_hidden_layers", "n_layer", "num_layers"))
@@ -253,7 +342,18 @@ def normalize_config(raw: dict, model_name: str = "") -> ModelConfig:
         # and idx % moe_layer_freq == 0.
         first_k = int(_get(cfg, "first_k_dense_replace", default=0) or 0)
         mlp_only = set(_get(cfg, "mlp_only_layers", default=[]) or [])
-        if "decoder_sparse_step" in cfg:
+        if isinstance(cfg.get("mlp_layer_types"), list):
+            # MiniMax-M3: explicit per-layer "sparse"/"dense" labels.
+            mask = tuple(
+                t == "sparse" for t in cfg["mlp_layer_types"]
+            )
+        elif isinstance(cfg.get("moe_layer_freq"), list):
+            freq_list = cfg["moe_layer_freq"]
+            mask = tuple(
+                bool(freq_list[i]) if i < len(freq_list) else True
+                for i in range(num_layers)
+            )
+        elif "decoder_sparse_step" in cfg:
             step = int(cfg["decoder_sparse_step"] or 1)
             mask = tuple(
                 (i + 1) % step == 0 and i not in mlp_only
@@ -272,20 +372,34 @@ def normalize_config(raw: dict, model_name: str = "") -> ModelConfig:
             num_shared_experts=int(_get(cfg, "n_shared_experts", "num_shared_experts", default=0) or 0),
             shared_expert_intermediate_size=int(
                 _get(cfg, "shared_expert_intermediate_size",
+                     "shared_intermediate_size",
                      default=_get(cfg, "moe_intermediate_size", default=inter))
             ),
             norm_topk_prob=bool(_get(cfg, "norm_topk_prob", default=True)),
             first_k_dense_replace=int(_get(cfg, "first_k_dense_replace", default=0) or 0),
-            moe_layer_freq=int(_get(cfg, "moe_layer_freq", "decoder_sparse_step", default=1) or 1),
+            moe_layer_freq=(
+                1 if isinstance(_get(cfg, "moe_layer_freq"), list)
+                else int(_get(cfg, "moe_layer_freq", "decoder_sparse_step",
+                              default=1) or 1)
+            ),
             routed_scaling_factor=float(_get(cfg, "routed_scaling_factor", default=1.0) or 1.0),
             n_group=int(_get(cfg, "n_group", default=0) or 0),
             topk_group=int(_get(cfg, "topk_group", default=0) or 0),
-            scoring_func=str(_get(cfg, "scoring_func", default="softmax")),
+            scoring_func=str(_get(
+                cfg, "scoring_func",
+                default="sigmoid" if is_glm_dsa else "softmax",
+            )),
             topk_method=str(_get(
                 cfg, "topk_method",
-                default="noaux_tc" if _get(cfg, "n_group") else "greedy",
+                default="noaux_tc" if (is_glm_dsa or _get(cfg, "n_group"))
+                else "greedy",
             )),
         )
+
+    # MiniMax-M3: experts use intermediate_size; DENSE layers use the larger
+    # dense_intermediate_size (reference ModelArgs.dense_intermediate_size).
+    if _get(cfg, "dense_intermediate_size") and moe is not None:
+        inter = int(cfg["dense_intermediate_size"])
 
     mla = None
     if _get(cfg, "kv_lora_rank"):
@@ -297,6 +411,86 @@ def normalize_config(raw: dict, model_name: str = "") -> ModelConfig:
             v_head_dim=int(_get(cfg, "v_head_dim", default=128)),
         )
         head_dim = mla.qk_nope_head_dim + mla.qk_rope_head_dim
+
+    # DSA indexer (DeepSeek-V3.2 config keys; GLM-MoE-DSA overrides the
+    # rope/norm conventions — reference GLM_MOE_DSA_DEFAULTS).
+    dsa = None
+    if mla is not None and _get(cfg, "index_n_heads") and _get(cfg, "index_head_dim"):
+        dsa = DSAConfig(
+            index_n_heads=int(cfg["index_n_heads"]),
+            index_head_dim=int(cfg["index_head_dim"]),
+            index_topk=int(_get(cfg, "index_topk", default=2048)),
+            index_key_heads=int(_get(cfg, "index_key_heads", default=1) or 1),
+            indexer_types=derive_indexer_types(
+                num_layers,
+                int(_get(cfg, "index_topk_freq", default=1) or 1),
+                cfg.get("indexer_types"),
+                int(_get(cfg, "first_k_dense_replace", default=0) or 0),
+                cfg.get("index_skip_topk_offset"),
+            ),
+            indexer_rope_traditional=bool(_get(
+                cfg, "indexer_rope_traditional",
+                default=not is_glm_dsa,
+            )),
+            indexer_norm_eps=float(_get(
+                cfg, "indexer_norm_eps",
+                default=1e-6 if is_glm_dsa else 1e-5,
+            )),
+        )
+
+    # MSA block-sparse attention (MiniMax-M3). Config surface mirrors the
+    # reference ModelArgs (minimax_m3.py:23-139): either a
+    # ``sparse_attention_config`` dict or flat ``index_*`` keys, with the
+    # per-layer sparse mask from layer_types / sparse_attention_freq.
+    msa = None
+    is_minimax_m3 = cfg.get("model_type") == "minimax_m3" or (
+        "MiniMaxM3" in architecture
+    )
+    sac = cfg.get("sparse_attention_config")
+    if is_minimax_m3 and (sac or _get(cfg, "index_n_heads")):
+        sac = dict(sac or {})
+        raw_lt = cfg.get("layer_types")
+        if raw_lt:
+            sparse_mask = tuple(
+                t == "minimax_m3_sparse" for t in raw_lt
+            )
+        elif isinstance(sac.get("sparse_attention_freq"), list):
+            freq = sac["sparse_attention_freq"]
+            sparse_mask = tuple(
+                bool(freq[i]) if i < len(freq) else False
+                for i in range(num_layers)
+            )
+        else:
+            dense_n = min(3, num_layers)
+            sparse_mask = (False,) * dense_n + (True,) * (
+                num_layers - dense_n
+            )
+        msa = MSAConfig(
+            index_n_heads=int(
+                sac.get("sparse_num_index_heads")
+                or _get(cfg, "index_n_heads", default=4)
+            ),
+            index_head_dim=int(
+                sac.get("sparse_index_dim")
+                or _get(cfg, "index_head_dim", default=128)
+            ),
+            block_size=int(
+                sac.get("sparse_block_size")
+                or _get(cfg, "index_block_size", default=128)
+            ),
+            topk_blocks=int(
+                sac.get("sparse_topk_blocks")
+                or _get(cfg, "index_topk_blocks", default=16)
+            ),
+            init_blocks=int(sac.get("sparse_init_block", 0) or 0),
+            local_blocks=int(
+                sac.get(
+                    "sparse_local_block",
+                    _get(cfg, "index_local_blocks", default=1),
+                ) or 0
+            ),
+            sparse_layer_mask=sparse_mask,
+        )
 
     linear_attn = None
     if _get(cfg, "linear_conv_kernel_dim", "conv_kernel"):
@@ -363,13 +557,18 @@ def normalize_config(raw: dict, model_name: str = "") -> ModelConfig:
         use_attention_sinks="GptOss" in architecture or bool(cfg.get("attention_sinks")),
         moe=moe,
         mla=mla,
+        dsa=dsa,
+        msa=msa,
         linear_attn=linear_attn,
         dtype=str(_get(cfg, "torch_dtype", "dtype", default="bfloat16")),
         param_bytes_per_element=pbpe,
         partial_rotary_factor=float(_get(cfg, "partial_rotary_factor", default=1.0)),
         extra={k: v for k, v in cfg.items()
                if k in ("moe_intermediate_size", "num_attention_groups",
-                        "rotary_dim", "rope_interleave")},
+                        "rotary_dim", "rope_interleave",
+                        "dense_intermediate_size", "swiglu_alpha",
+                        "swiglu_limit", "swiglu_beta", "use_gemma_norm",
+                        "use_routing_bias")},
     )
 
 

@@ -90,7 +90,10 @@ class DeepseekStageModel(MoEStageModel):
         x = x + self._mlp(lp, h)
         return x, kv
 
-    def _mla_attention(self, p, x, cache, inputs: BatchInputs):
+    def _mla_qkv(self, p, x, inputs: BatchInputs):
+        """Shared MLA projection pipeline: returns the absorbed query parts,
+        the new latent/rope rows to cache, the up-projection, and the
+        low-rank query activation (``qr`` — the DSA indexer reads it)."""
         cfg = self.config
         m = cfg.mla
         t = x.shape[0]
@@ -101,10 +104,11 @@ class DeepseekStageModel(MoEStageModel):
 
         # Query path (optionally low-rank).
         if "q_a_proj" in p:
-            q = L.linear(x, p["q_a_proj"])
-            q = L.rms_norm(q, p["q_a_layernorm"]["weight"], cfg.rms_norm_eps)
-            q = L.linear(q, p["q_b_proj"])
+            qr = L.linear(x, p["q_a_proj"])
+            qr = L.rms_norm(qr, p["q_a_layernorm"]["weight"], cfg.rms_norm_eps)
+            q = L.linear(qr, p["q_b_proj"])
         else:
+            qr = None
             q = L.linear(x, p["q_proj"])
         hq = q.shape[-1] // (dn + dr)
         q = q.reshape(t, hq, dn + dr)
@@ -124,8 +128,6 @@ class DeepseekStageModel(MoEStageModel):
         q_pe = apply_rope(q_pe, inputs.positions, self.cos_table, self.sin_table)
         k_pe = apply_rope(k_pe, inputs.positions, self.cos_table, self.sin_table)
 
-        cache = store_mla_cache(cache, latent, k_pe, inputs.slot_mapping)
-
         # Absorb W_UK into the query: kv_b_proj [Hq*(dn+dv), R].
         w_kv_b = p["kv_b_proj"]["weight"].reshape(hq, dn + dv, r)
         w_uk = w_kv_b[:, :dn, :]           # [Hq, dn, R]
@@ -133,7 +135,25 @@ class DeepseekStageModel(MoEStageModel):
         q_latent = jnp.einsum(
             "thd,hdr->thr", q_nope, w_uk, preferred_element_type=jnp.float32
         ).astype(x.dtype)
+        return q_latent, q_pe, latent, k_pe, w_uv, qr, hq
 
+    def _mla_out(self, p, out_latent, w_uv, hq):
+        """Up-project latent attention output and apply o_proj."""
+        t = out_latent.shape[0]
+        dv = w_uv.shape[1]
+        out = jnp.einsum(
+            "thr,hdr->thd", out_latent, w_uv,
+            preferred_element_type=jnp.float32,
+        ).astype(out_latent.dtype)
+        return L.row_parallel_linear(
+            out.reshape(t, hq * dv), p["o_proj"], self.axis_name
+        )
+
+    def _mla_attention(self, p, x, cache, inputs: BatchInputs):
+        q_latent, q_pe, latent, k_pe, w_uv, _qr, hq = self._mla_qkv(
+            p, x, inputs
+        )
+        cache = store_mla_cache(cache, latent, k_pe, inputs.slot_mapping)
         out_latent = mla_ragged_attention_xla(
             q_latent,
             q_pe,
@@ -143,16 +163,9 @@ class DeepseekStageModel(MoEStageModel):
             inputs.cu_q_lens,
             inputs.num_seqs,
             sm_scale=self.sm_scale,
-            kv_lora_rank=r,
+            kv_lora_rank=self.config.mla.kv_lora_rank,
         )
-        out = jnp.einsum(
-            "thr,hdr->thd", out_latent, w_uv,
-            preferred_element_type=jnp.float32,
-        ).astype(x.dtype)
-        out = L.row_parallel_linear(
-            out.reshape(t, hq * dv), p["o_proj"], self.axis_name
-        )
-        return out, cache
+        return self._mla_out(p, out_latent, w_uv, hq), cache
 
     def finalize_params(self, tree: dict) -> dict:
         tree = super().finalize_params(tree)

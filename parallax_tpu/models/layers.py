@@ -28,6 +28,20 @@ def rms_norm(
     return (x * (weight.astype(jnp.float32) + offset)).astype(orig_dtype)
 
 
+def layer_norm(x: jax.Array, p: dict, eps: float) -> jax.Array:
+    """Standard LayerNorm (mean-centered, with optional bias) — used by the
+    DSA indexer's k_norm; everything else in the zoo is RMSNorm."""
+    orig_dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    x = (x - mean) * jax.lax.rsqrt(var + eps)
+    out = x * p["weight"].astype(jnp.float32)
+    if "bias" in p:
+        out = out + p["bias"].astype(jnp.float32)
+    return out.astype(orig_dtype)
+
+
 def linear(x: jax.Array, p: dict) -> jax.Array:
     """x @ W^T + b with HF [out, in] weight layout kept as stored.
 
@@ -80,6 +94,16 @@ def swiglu_mlp(x: jax.Array, p: dict, axis_name: str | None = None) -> jax.Array
     gate = linear(x, p["gate_proj"])
     up = linear(x, p["up_proj"])
     return row_parallel_linear(jax.nn.silu(gate) * up, p["down_proj"], axis_name)
+
+
+def glu_mlp(x: jax.Array, p: dict, act_fn, axis_name: str | None = None) -> jax.Array:
+    """GLU FFN with a custom gating activation ``act_fn(gate, up)``
+    (MiniMax-M3's clamped swiglu-oai dense layers)."""
+    gate = linear(x, p["gate_proj"]).astype(jnp.float32)
+    up = linear(x, p["up_proj"]).astype(jnp.float32)
+    return row_parallel_linear(
+        act_fn(gate, up).astype(x.dtype), p["down_proj"], axis_name
+    )
 
 
 def paged_attention_block(

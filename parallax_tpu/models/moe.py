@@ -76,15 +76,21 @@ def route_topk(
     return weights.astype(jnp.float32), ids.astype(jnp.int32)
 
 
-def _expert_ffn(x, gate_w, up_w, down_w):
-    """SwiGLU for one expert's weight slices ([I,H],[I,H],[H,I])."""
+def _silu_glu(g, u):
+    return jax.nn.silu(g) * u
+
+
+def _expert_ffn(x, gate_w, up_w, down_w, act_fn=_silu_glu):
+    """GLU for one expert's weight slices ([I,H],[I,H],[H,I]); ``act_fn(g,
+    u)`` defaults to SwiGLU (MiniMax-M3 passes its clamped swiglu-oai)."""
     g = jnp.einsum("th,ih->ti", x, gate_w, preferred_element_type=jnp.float32)
     u = jnp.einsum("th,ih->ti", x, up_w, preferred_element_type=jnp.float32)
-    h = (jax.nn.silu(g) * u).astype(x.dtype)
+    h = act_fn(g, u).astype(x.dtype)
     return jnp.einsum("ti,hi->th", h, down_w, preferred_element_type=jnp.float32)
 
 
-def _moe_fallback(x, p, weights, ids, num_local, expert_offset):
+def _moe_fallback(x, p, weights, ids, num_local, expert_offset,
+                  act_fn=_silu_glu):
     """Masked per-expert loop; correct for any routing, O(E) matmuls."""
     t = x.shape[0]
     out = jnp.zeros((t, x.shape[1]), jnp.float32)
@@ -96,12 +102,13 @@ def _moe_fallback(x, p, weights, ids, num_local, expert_offset):
         ge = expert_offset + le
         hit = ids == ge                           # [T, K]
         w = jnp.sum(jnp.where(hit, weights, 0.0), axis=-1)  # [T]
-        y = _expert_ffn(x, gate_w[le], up_w[le], down_w[le])
+        y = _expert_ffn(x, gate_w[le], up_w[le], down_w[le], act_fn)
         out = out + y * w[:, None]
     return out
 
 
-def _moe_megablox(x, p, weights, ids, num_local, expert_offset):
+def _moe_megablox(x, p, weights, ids, num_local, expert_offset,
+                  act_fn=_silu_glu):
     """Grouped-matmul path: sort token-expert pairs, gmm per projection."""
     from jax.experimental.pallas.ops.tpu.megablox import gmm
 
@@ -125,7 +132,7 @@ def _moe_megablox(x, p, weights, ids, num_local, expert_offset):
     down_w = p["experts"]["down_proj"]            # [El, H, I]
     g = gmm(xs, jnp.swapaxes(gate_w, 1, 2), group_sizes)
     u = gmm(xs, jnp.swapaxes(up_w, 1, 2), group_sizes)
-    hme = (jax.nn.silu(g) * u).astype(x.dtype)
+    hme = act_fn(g, u).astype(x.dtype)
     y = gmm(hme, jnp.swapaxes(down_w, 1, 2), group_sizes)  # [T*K, H]
 
     # Zero out pairs routed to non-local experts, weight, scatter back.
@@ -141,6 +148,7 @@ def moe_ffn(
     moe: MoEConfig,
     axis_name: str | None = None,
     use_megablox: bool | None = None,
+    act_fn=_silu_glu,
 ) -> jax.Array:
     """Full MoE block: route, expert-compute (+ optional shared experts),
     psum over the expert-parallel axis."""
@@ -156,7 +164,7 @@ def moe_ffn(
         expert_offset = 0
 
     impl = _moe_megablox if use_megablox else _moe_fallback
-    out = impl(x, p, weights, ids, num_local, expert_offset)
+    out = impl(x, p, weights, ids, num_local, expert_offset, act_fn)
 
     if "shared_expert" in p:
         # Shared expert uses the standard column/row TP sharding, so its
@@ -166,6 +174,7 @@ def moe_ffn(
             p["shared_expert"]["gate_proj"]["weight"],
             p["shared_expert"]["up_proj"]["weight"],
             p["shared_expert"]["down_proj"]["weight"],
+            act_fn,
         )
         if "shared_expert_gate" in p:
             sg = jax.nn.sigmoid(

@@ -1,0 +1,184 @@
+"""DeepSeek Sparse Attention (DSA) ops: lightning indexer + top-k sparse
+MLA attention over the compressed latent cache.
+
+Capability parity: reference DSA kernel stack —
+``src/parallax_extensions/ops.py:182-367`` (dsa_paged_attention,
+dsa_indexer_scores_with_update, dsa_token_indexer_with_update),
+``src/parallax_extensions/kernels/dsa/dsa_indexer.metal`` (score formula
+``sum_h max(q_h . k, 0) * w_h``), and ``ops.py:124-179``
+(store_indexer_cache).
+
+TPU re-design: instead of the reference's dense-mask prefill path plus a
+separate sparse decode kernel, one gather-based attention op serves both —
+every query row attends to exactly ``index_topk`` gathered latent rows
+(sparse rows use their top-k indices, dense rows — where the context fits
+inside the top-k budget — use ``iota``), so shapes stay static under jit
+and HBM traffic is O(T * K) rather than O(T * context).
+
+Cache layout per DSA layer: the MLA latent cache (``ops/mla.py``) plus an
+index-key cache ``[num_pages, page_size, 1, index_head_dim]`` addressed by
+the SAME page table and slot mapping.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from parallax_tpu.ops.ragged import ragged_token_positions
+
+_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+_NEG_INF = float("-inf")
+
+
+def new_index_pages(
+    num_pages: int, page_size: int, index_head_dim: int, dtype=jnp.bfloat16
+) -> jax.Array:
+    """Paged index-key cache (reference DeepSeekSparseCache.indexer_key_cache,
+    dsa_cache.py:57-68; key heads == 1 for DeepSeek-V3.2/GLM)."""
+    return jnp.zeros((num_pages, page_size, 1, index_head_dim), dtype)
+
+
+def store_index_cache(
+    cache: jax.Array,       # [P, page, 1, D_idx]
+    k: jax.Array,           # [T, D_idx]
+    slot_mapping: jax.Array,
+) -> jax.Array:
+    """Scatter index keys (reference store_indexer_cache, ops.py:124-179)."""
+    p, page, _, d = cache.shape
+    flat = cache.reshape(p * page, d)
+    slots = jnp.where(slot_mapping < 0, p * page, slot_mapping)
+    flat = flat.at[slots].set(k.astype(cache.dtype), mode="drop")
+    return flat.reshape(p, page, 1, d)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def dsa_indexer_scores_xla(
+    q: jax.Array,            # [T, Hi, D_idx] rope-applied index queries
+    weights: jax.Array,      # f32[T, Hi] head weights (already scaled)
+    index_cache: jax.Array,  # [P, page, 1, D_idx]
+    kv_lens: jax.Array,      # i32[S]
+    page_indices: jax.Array, # i32[S, pages_per_seq]
+    cu_q_lens: jax.Array,    # i32[S+1]
+) -> jax.Array:
+    """Per-token indexer scores over the cached context: [T, kv_cap] f32.
+
+    score[t, s] = sum_h weights[t, h] * relu(q[t, h] . k[s]); -inf outside
+    the causal context (reference dsa_indexer.metal:100-115).
+    """
+    t, hi, d = q.shape
+    p, page_size, _, _ = index_cache.shape
+    s, pages_per_seq = page_indices.shape
+    kv_cap = pages_per_seq * page_size
+
+    seq_of_tok, q_pos = ragged_token_positions(kv_lens, cu_q_lens, t, s)
+
+    keys = index_cache[page_indices.reshape(-1), :, 0, :].reshape(
+        s, kv_cap, d
+    )
+    keys_tok = keys[seq_of_tok]                      # [T, L, D]
+    dots = jnp.einsum(
+        "thd,tld->thl", q, keys_tok, preferred_element_type=jnp.float32
+    )
+    scores = jnp.einsum(
+        "th,thl->tl", weights.astype(jnp.float32), jnp.maximum(dots, 0.0)
+    )
+    kv_pos = jnp.arange(kv_cap, dtype=jnp.int32)
+    valid = (kv_pos[None, :] <= q_pos[:, None]) & (
+        kv_pos[None, :] < kv_lens[seq_of_tok][:, None]
+    )
+    return jnp.where(valid, scores, _NEG_INF)
+
+
+@functools.partial(jax.jit, static_argnames=("index_topk",))
+def dsa_topk_indices(
+    scores: jax.Array,   # f32[T, kv_cap] (-inf outside context)
+    *,
+    index_topk: int,
+) -> jax.Array:
+    """Top-k token positions per query row: i32[T, K].
+
+    Rows whose valid-token count fits within the top-k budget are marked
+    dense with all -1 (reference dsa_token_indexer_with_update,
+    ops.py:345-367) — the attention op then covers positions 0..K-1, which
+    is the whole context for those rows.
+    """
+    t, kv_cap = scores.shape
+    k = min(index_topk, kv_cap)
+    _, idx = jax.lax.top_k(scores, k)
+    idx = idx.astype(jnp.int32)
+    if k < index_topk:
+        idx = jnp.concatenate(
+            [idx, jnp.full((t, index_topk - k), -1, jnp.int32)], axis=-1
+        )
+    valid_count = jnp.sum(scores > _NEG_INF, axis=-1)
+    dense = valid_count <= index_topk
+    return jnp.where(dense[:, None], jnp.int32(-1), idx)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "kv_lora_rank"))
+def mla_ragged_sparse_attention_xla(
+    q_latent: jax.Array,     # [T, Hq, R]
+    q_pe: jax.Array,         # [T, Hq, Dr]
+    cache: jax.Array,        # [P, page, 1, R + Dr] MLA latent cache
+    kv_lens: jax.Array,      # i32[S]
+    page_indices: jax.Array, # i32[S, pages_per_seq]
+    cu_q_lens: jax.Array,    # i32[S+1]
+    topk_indices: jax.Array, # i32[T, K] logical positions; row of -1 = dense
+    *,
+    sm_scale: float,
+    kv_lora_rank: int,
+) -> jax.Array:
+    """Sparse absorbed-MLA attention: each query row attends to its top-k
+    latent positions only. Returns [T, Hq, R].
+
+    Reference contract: dsa_paged_attention (ops.py:182-245,
+    kernels/dsa/dsa_paged_attention.metal) — softmax(scale * (q_latent .
+    latent^T + q_pe . rope^T)) . latent over ``topk_indices``; a -1-leading
+    row attends densely over range(context), which here is covered by
+    substituting iota for the indices (dense rows only occur when the
+    context fits in K).
+    """
+    t, hq, r = q_latent.shape
+    p, page_size, _, width = cache.shape
+    s, pages_per_seq = page_indices.shape
+    k = topk_indices.shape[1]
+
+    seq_of_tok, q_pos = ragged_token_positions(kv_lens, cu_q_lens, t, s)
+
+    dense_row = topk_indices[:, 0] < 0
+    iota = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32), (t, k))
+    pos = jnp.where(dense_row[:, None], iota, topk_indices)  # [T, K]
+
+    # Validity: inside this row's causal context and a real (>=0) index.
+    valid = (pos >= 0) & (pos <= q_pos[:, None]) & (
+        pos < kv_lens[seq_of_tok][:, None]
+    )
+    safe_pos = jnp.where(valid, pos, 0)
+
+    # Logical position -> physical slot via the per-sequence page table.
+    page_of = safe_pos // page_size                       # [T, K]
+    offset = safe_pos % page_size
+    phys_page = jnp.take_along_axis(
+        page_indices[seq_of_tok], page_of, axis=1
+    )                                                     # [T, K]
+    rows = cache[phys_page, offset, 0, :]                 # [T, K, R+Dr]
+    latent = rows[..., :kv_lora_rank]
+    rope = rows[..., kv_lora_rank:]
+
+    scores = (
+        jnp.einsum("thr,tkr->thk", q_latent, latent,
+                   preferred_element_type=jnp.float32)
+        + jnp.einsum("thd,tkd->thk", q_pe, rope,
+                     preferred_element_type=jnp.float32)
+    ) * sm_scale
+    scores = jnp.where(valid[:, None, :], scores, _MASK_VALUE)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    unnorm = jnp.exp(scores - m)
+    probs = unnorm / jnp.maximum(jnp.sum(unnorm, axis=-1, keepdims=True),
+                                 1e-30)
+    out = jnp.einsum("thk,tkr->thr", probs.astype(latent.dtype), latent,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q_latent.dtype)

@@ -21,6 +21,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from parallax_tpu.ops.ragged import ragged_token_positions
+
 _MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
@@ -71,13 +73,7 @@ def mla_ragged_attention_xla(
     s, pages_per_seq = page_indices.shape
     kv_cap = pages_per_seq * page_size
 
-    token_ids = jnp.arange(t, dtype=jnp.int32)
-    seq_of_tok = (
-        jnp.searchsorted(cu_q_lens[1:], token_ids, side="right")
-        .clip(0, s - 1).astype(jnp.int32)
-    )
-    q_len = cu_q_lens[seq_of_tok + 1] - cu_q_lens[seq_of_tok]
-    q_pos = kv_lens[seq_of_tok] - q_len + (token_ids - cu_q_lens[seq_of_tok])
+    seq_of_tok, q_pos = ragged_token_positions(kv_lens, cu_q_lens, t, s)
 
     rows = cache[page_indices.reshape(-1), :, 0, :].reshape(s, kv_cap, width)
     latent_seq = rows[..., :kv_lora_rank]

@@ -1,0 +1,180 @@
+"""MiniMax-M3 block-sparse attention (MSA) ops.
+
+Capability parity: reference MSA kernel stack —
+``src/parallax_extensions/ops.py:594-804`` (msa_paged_attention,
+msa_token_indexer_with_update) and the dense-mask construction in
+``src/parallax/models/minimax_m3.py:456-567`` (_build_sparse_mask):
+block score = max over index heads and block tokens of
+``q_idx . k_idx * scale``; the first ``init_blocks`` score 1e30 and the
+``local_blocks`` nearest blocks 1e29 so they always survive the top-k.
+
+TPU re-design: like ``ops/dsa.py``, one gather-based attention op serves
+prefill and decode — the indexer expands its selected blocks to
+``topk_blocks * block_size`` token positions per query row (-1 = invalid),
+and attention gathers exactly those rows from the packed paged KV cache.
+Selecting every causal block when the context fits inside the top-k budget
+makes the sparse path *exactly* equal to dense attention, so no separate
+dense branch is needed (the reference's ``L > block_size * topk`` prefill
+gate is subsumed).
+
+The index-key cache reuses the DSA layout ``[P, page, 1, D_idx]`` and the
+same slot mapping as the main KV cache.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from parallax_tpu.ops.ragged import ragged_token_positions
+
+from parallax_tpu.ops.dsa import new_index_pages, store_index_cache  # noqa: F401 (re-export)
+
+_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+_NEG_INF = float("-inf")
+_INIT_SCORE = 1e30
+_LOCAL_SCORE = 1e29
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "block_size", "topk_blocks", "init_blocks", "local_blocks",
+        "sm_scale",
+    ),
+)
+def msa_sparse_positions_xla(
+    idx_q: jax.Array,        # [T, Hi, D_idx] rope-applied index queries
+    index_cache: jax.Array,  # [P, page, 1, D_idx]
+    kv_lens: jax.Array,      # i32[S]
+    page_indices: jax.Array, # i32[S, pages_per_seq]
+    cu_q_lens: jax.Array,    # i32[S+1]
+    *,
+    block_size: int,
+    topk_blocks: int,
+    init_blocks: int,
+    local_blocks: int,
+    sm_scale: float,
+) -> jax.Array:
+    """Select sparse blocks per query row and expand to token positions.
+
+    Returns i32[T, topk_blocks * block_size]; -1 marks invalid slots
+    (reference msa_token_indexer contract, ops.py:666-719).
+    """
+    t, hi, d = idx_q.shape
+    p, page_size, _, _ = index_cache.shape
+    s, pages_per_seq = page_indices.shape
+    kv_cap = pages_per_seq * page_size
+    nb = (kv_cap + block_size - 1) // block_size
+
+    seq_of_tok, q_pos = ragged_token_positions(kv_lens, cu_q_lens, t, s)
+
+    keys = index_cache[page_indices.reshape(-1), :, 0, :].reshape(
+        s, kv_cap, d
+    )
+    keys_tok = keys[seq_of_tok]                  # [T, L, D]
+    scores = jnp.einsum(
+        "thd,tld->thl", idx_q, keys_tok, preferred_element_type=jnp.float32
+    ) * sm_scale
+
+    kv_pos = jnp.arange(kv_cap, dtype=jnp.int32)
+    valid = (kv_pos[None, :] <= q_pos[:, None]) & (
+        kv_pos[None, :] < kv_lens[seq_of_tok][:, None]
+    )
+    scores = jnp.where(valid[:, None, :], scores, _NEG_INF)
+
+    # Block score: max over index heads and block tokens.
+    pad = nb * block_size - kv_cap
+    if pad:
+        scores = jnp.pad(scores, ((0, 0), (0, 0), (0, pad)),
+                         constant_values=_NEG_INF)
+    block_scores = jnp.max(
+        scores.reshape(t, hi, nb, block_size), axis=(1, 3)
+    )                                            # [T, NB]
+
+    blocks = jnp.arange(nb, dtype=jnp.int32)
+    cur_block = q_pos // block_size
+    causal_block = blocks[None, :] <= cur_block[:, None]
+    selected = jnp.where(causal_block, block_scores, _NEG_INF)
+    if init_blocks > 0:
+        selected = jnp.where(
+            (blocks[None, :] < init_blocks) & causal_block,
+            _INIT_SCORE, selected,
+        )
+    if local_blocks > 0:
+        local_start = jnp.maximum(cur_block - local_blocks + 1, 0)
+        selected = jnp.where(
+            (blocks[None, :] >= local_start[:, None]) & causal_block,
+            _LOCAL_SCORE, selected,
+        )
+
+    kb = min(topk_blocks, nb)
+    top_vals, top_idx = jax.lax.top_k(selected, kb)      # [T, kb]
+    block_ok = top_vals > _NEG_INF
+    # Expand blocks to token positions: [T, kb, block_size].
+    pos = (
+        top_idx[:, :, None] * block_size
+        + jnp.arange(block_size, dtype=jnp.int32)[None, None, :]
+    )
+    pos = jnp.where(block_ok[:, :, None], pos, -1).reshape(t, kb * block_size)
+    if kb < topk_blocks:
+        pos = jnp.concatenate(
+            [pos, jnp.full((t, (topk_blocks - kb) * block_size), -1,
+                           jnp.int32)],
+            axis=-1,
+        )
+    return pos
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale",))
+def paged_sparse_gqa_attention_xla(
+    q: jax.Array,            # [T, Hq, D]
+    kv_pages: jax.Array,     # [P, page, 2*Hkv, D]
+    kv_lens: jax.Array,      # i32[S]
+    page_indices: jax.Array, # i32[S, pages_per_seq]
+    cu_q_lens: jax.Array,    # i32[S+1]
+    positions: jax.Array,    # i32[T, K] logical token positions; -1 invalid
+    *,
+    sm_scale: float,
+) -> jax.Array:
+    """GQA attention over explicitly listed token positions of the paged KV
+    cache (reference msa_paged_attention, ops.py:594-663 +
+    kernels/msa/msa_paged_attention.metal). Causality is re-enforced here,
+    so whole selected blocks may extend past the query position.
+    """
+    t, num_q_heads, head_dim = q.shape
+    p, page_size, combined, _ = kv_pages.shape
+    num_kv_heads = combined // 2
+    group = num_q_heads // num_kv_heads
+    s, pages_per_seq = page_indices.shape
+    k = positions.shape[1]
+
+    seq_of_tok, q_pos = ragged_token_positions(kv_lens, cu_q_lens, t, s)
+
+    valid = (positions >= 0) & (positions <= q_pos[:, None]) & (
+        positions < kv_lens[seq_of_tok][:, None]
+    )
+    safe_pos = jnp.where(valid, positions, 0)
+    page_of = safe_pos // page_size
+    offset = safe_pos % page_size
+    phys_page = jnp.take_along_axis(page_indices[seq_of_tok], page_of, axis=1)
+    rows = kv_pages[phys_page, offset]            # [T, K, 2*Hkv, D]
+    k_sel = rows[:, :, 0::2, :]                   # [T, K, Hkv, D]
+    v_sel = rows[:, :, 1::2, :]
+
+    qg = q.reshape(t, num_kv_heads, group, head_dim)
+    scores = jnp.einsum(
+        "thgd,tkhd->thgk", qg, k_sel, preferred_element_type=jnp.float32
+    ) * sm_scale
+    scores = jnp.where(valid[:, None, None, :], scores, _MASK_VALUE)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    unnorm = jnp.exp(scores - m)
+    probs = unnorm / jnp.maximum(jnp.sum(unnorm, axis=-1, keepdims=True),
+                                 1e-30)
+    out = jnp.einsum(
+        "thgk,tkhd->thgd", probs.astype(v_sel.dtype), v_sel,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(t, num_q_heads, head_dim).astype(q.dtype)

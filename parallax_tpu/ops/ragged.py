@@ -1,0 +1,34 @@
+"""Shared ragged-batch indexing for paged attention ops.
+
+Every attention/indexer op receives the same flattened ragged batch
+(``cu_q_lens`` row offsets + per-sequence ``kv_lens``); this helper maps
+each query token to its sequence and its absolute position in that
+sequence's context. One definition keeps the position convention (the
+``side='right'`` searchsorted and the clip bound) consistent across the
+dense, MLA, DSA and MSA ops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def ragged_token_positions(
+    kv_lens: jax.Array,    # i32[S]
+    cu_q_lens: jax.Array,  # i32[S+1]
+    num_tokens: int,
+    num_seqs: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Returns ``(seq_of_tok i32[T], q_pos i32[T])``: the owning sequence of
+    each query token and its absolute context position (the last new token
+    of sequence ``s`` sits at ``kv_lens[s] - 1``)."""
+    token_ids = jnp.arange(num_tokens, dtype=jnp.int32)
+    seq_of_tok = (
+        jnp.searchsorted(cu_q_lens[1:], token_ids, side="right")
+        .clip(0, num_seqs - 1)
+        .astype(jnp.int32)
+    )
+    q_len = cu_q_lens[seq_of_tok + 1] - cu_q_lens[seq_of_tok]
+    q_pos = kv_lens[seq_of_tok] - q_len + (token_ids - cu_q_lens[seq_of_tok])
+    return seq_of_tok, q_pos

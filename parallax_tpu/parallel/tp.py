@@ -68,15 +68,29 @@ KV_SPEC = P(None, None, "tp", None)  # [pages, page, 2*Hkv, D]
 
 
 def kv_partition_specs(model) -> list:
-    """Per-layer KV cache specs: GQA pages shard on the combined-head axis;
-    MLA latent pages are head-independent and stay replicated."""
+    """Per-layer KV cache specs, structure-matching the model's cache
+    pytree: GQA pages shard on the combined-head axis; MLA latent pages and
+    DSA/MSA index-key pages are head-independent and stay replicated.
+    Sparse layers carry ``(kv_pages, index_pages)`` tuples, so their spec is
+    a tuple too (a bare spec would be applied as a pytree prefix and try to
+    shard the index cache's singleton head axis)."""
     from parallax_tpu.config import LAYER_MLA
 
+    cfg = model.config
     specs = []
     for li in range(model.num_local_layers):
         gi = model.start_layer + li
-        if model.config.layer_type(gi) == LAYER_MLA:
-            specs.append(P())
+        if cfg.layer_type(gi) == LAYER_MLA:
+            if cfg.dsa is not None:
+                full = cfg.dsa.indexer_types[gi] == "full"
+                specs.append((P(), P()) if full else (P(), None))
+            else:
+                specs.append(P())
+        elif cfg.msa is not None and (
+            gi < len(cfg.msa.sparse_layer_mask)
+            and cfg.msa.sparse_layer_mask[gi]
+        ):
+            specs.append((KV_SPEC, P()))
         else:
             specs.append(KV_SPEC)
     return specs

@@ -27,8 +27,13 @@ logger = get_logger(__name__)
 def kv_bytes_per_page(
     config: ModelConfig, num_local_layers: int, page_size: int, dtype_bytes: int = 2
 ) -> int:
-    """Device bytes one page occupies across this shard's attention layers."""
-    per_token = 2 * config.num_key_value_heads * config.head_dim * dtype_bytes
+    """Device bytes one page occupies across this shard's attention layers.
+
+    Uses the config's per-token accounting, which covers MLA latent+rope
+    and the DSA index-key cache (reference DSA/MSA index-cache budgeting,
+    cache_manager.py:354-420).
+    """
+    per_token = config.kv_bytes_per_token_per_layer() * dtype_bytes // 2
     return per_token * page_size * num_local_layers
 
 

@@ -92,9 +92,13 @@ class StageEngine:
 
             from parallax_tpu.parallel.tp import kv_partition_specs
 
-            shardings = [
-                NamedSharding(mesh, s) for s in kv_partition_specs(model)
-            ]
+            from jax.sharding import PartitionSpec
+
+            shardings = jax.tree.map(
+                lambda s: NamedSharding(mesh, s),
+                kv_partition_specs(model),
+                is_leaf=lambda x: isinstance(x, PartitionSpec),
+            )
             self.kv = jax.jit(
                 lambda: model.new_kv_caches(
                     self.cfg.num_pages, self.cfg.page_size, kv_dtype
