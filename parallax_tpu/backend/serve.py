@@ -127,7 +127,10 @@ def serve_main(args) -> int:
 
             mesh = make_mesh(tp_size=tp_size)
     model = create_stage_model(config, start, end, tp_size=max(1, tp_size))
-    params = load_stage_params(model, args.model_path)
+    params = load_stage_params(
+        model, args.model_path,
+        quantize=getattr(args, "quantization", None),
+    )
 
     page_size = args.page_size
     # HBM budget, capped by the most pages the configured batch can ever

@@ -37,6 +37,9 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--kv-dtype", choices=["bfloat16", "float32"],
                        default="bfloat16")
     serve.add_argument("--no-prefix-cache", action="store_true")
+    serve.add_argument("--quantization", choices=["int8", "int4"],
+                       default=None,
+                       help="weight-only quantize an fp checkpoint on load")
     serve.add_argument("--tp-size", type=int, default=0,
                        help="0 = all local chips")
 

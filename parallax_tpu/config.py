@@ -416,6 +416,11 @@ def normalize_config(raw: dict, model_name: str = "") -> ModelConfig:
     # rope/norm conventions — reference GLM_MOE_DSA_DEFAULTS).
     dsa = None
     if mla is not None and _get(cfg, "index_n_heads") and _get(cfg, "index_head_dim"):
+        if int(_get(cfg, "index_key_heads", default=1) or 1) != 1:
+            # The DSA ops store/score a single shared index key per token
+            # (DeepSeek-V3.2/GLM convention); more key heads would be
+            # silently ignored, so reject loudly.
+            raise ValueError("DSA supports index_key_heads == 1 only")
         dsa = DSAConfig(
             index_n_heads=int(cfg["index_n_heads"]),
             index_head_dim=int(cfg["index_head_dim"]),

@@ -225,7 +225,7 @@ class StageModel:
         """
         cfg = self.config
         if self.is_first:
-            x = L.embed_lookup(params["embed_tokens"]["weight"], inputs.token_ids)
+            x = L.embed_lookup(params["embed_tokens"], inputs.token_ids)
         else:
             x = inputs.hidden_states
 

@@ -129,7 +129,7 @@ class DeepseekStageModel(MoEStageModel):
         k_pe = apply_rope(k_pe, inputs.positions, self.cos_table, self.sin_table)
 
         # Absorb W_UK into the query: kv_b_proj [Hq*(dn+dv), R].
-        w_kv_b = p["kv_b_proj"]["weight"].reshape(hq, dn + dv, r)
+        w_kv_b = L.get_weight(p["kv_b_proj"]).reshape(hq, dn + dv, r)
         w_uk = w_kv_b[:, :dn, :]           # [Hq, dn, R]
         w_uv = w_kv_b[:, dn:, :]           # [Hq, dv, R]
         q_latent = jnp.einsum(

@@ -42,6 +42,17 @@ def layer_norm(x: jax.Array, p: dict, eps: float) -> jax.Array:
     return out.astype(orig_dtype)
 
 
+def get_weight(p: dict) -> jax.Array:
+    """The float weight of a param dict, dequantizing on the fly for
+    weight-only quantized params (``ops/quant.py``)."""
+    w = p.get("weight")
+    if w is None and "qweight" in p:
+        from parallax_tpu.ops.quant import dequantize_weight
+
+        return dequantize_weight(p)
+    return w
+
+
 def linear(x: jax.Array, p: dict) -> jax.Array:
     """x @ W^T + b with HF [out, in] weight layout kept as stored.
 
@@ -49,7 +60,7 @@ def linear(x: jax.Array, p: dict) -> jax.Array:
     time; XLA folds the contraction orientation into the matmul tiling.
     """
     out = jax.lax.dot_general(
-        x, p["weight"],
+        x, get_weight(p),
         dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ).astype(x.dtype)
@@ -58,14 +69,27 @@ def linear(x: jax.Array, p: dict) -> jax.Array:
     return out
 
 
-def embed_lookup(embedding: jax.Array, token_ids: jax.Array) -> jax.Array:
-    return embedding[token_ids]
+def embed_lookup(embed, token_ids: jax.Array) -> jax.Array:
+    """Token embedding rows; for a quantized table only the gathered rows
+    are dequantized."""
+    if isinstance(embed, dict) and "qweight" in embed:
+        from parallax_tpu.ops.quant import dequantize_weight
+
+        rows = {
+            "qweight": embed["qweight"][token_ids],
+            "scales": embed["scales"][token_ids],
+        }
+        if "biases" in embed:
+            rows["biases"] = embed["biases"][token_ids]
+        return dequantize_weight(rows)
+    w = embed["weight"] if isinstance(embed, dict) else embed
+    return w[token_ids]
 
 
 def lm_head_logits(x: jax.Array, p: dict) -> jax.Array:
     """Final projection in fp32 for a numerically stable softmax/sampler."""
     return jax.lax.dot_general(
-        x, p["weight"],
+        x, get_weight(p),
         dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
@@ -77,7 +101,7 @@ def row_parallel_linear(
     """Row-sharded projection: psum the partial matmuls, add the (replicated)
     bias exactly once *after* the reduction."""
     out = jax.lax.dot_general(
-        x, p["weight"],
+        x, get_weight(p),
         dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ).astype(x.dtype)

@@ -77,16 +77,71 @@ def _assign(tree: dict, path: str, value) -> None:
     node[parts[-1]] = value
 
 
+def _quant_settings_for(
+    raw_cfg: dict, local_path: str, start_layer: int
+) -> tuple[int, int] | None:
+    """(bits, group_size) for a weight, honoring per-layer overrides.
+
+    Mirrors reference ``shard_loader.py:496-540`` (class_predicate): the
+    checkpoint's ``quantization`` dict holds global defaults plus optional
+    per-module override dicts keyed by the global (``model.``-prefixed or
+    bare) weight path.
+    """
+    qcfg = raw_cfg.get("quantization") or raw_cfg.get("quantization_config")
+    if not isinstance(qcfg, dict) or "bits" not in qcfg:
+        return None
+    module = local_path.rsplit(".", 1)[0]  # strip trailing .weight/.scales
+    candidates = [module, f"model.{module}"]
+    if module.startswith("layers."):
+        parts = module.split(".")
+        if len(parts) > 2 and parts[1].isdigit():
+            gi = int(parts[1]) + start_layer
+            candidates.append(
+                "model.layers." + str(gi) + "." + ".".join(parts[2:])
+            )
+    for key in candidates:
+        override = qcfg.get(key)
+        if override is False:
+            return None
+        if isinstance(override, dict):
+            return (
+                int(override.get("bits", qcfg["bits"])),
+                int(override.get("group_size", qcfg.get("group_size", 64))),
+            )
+    return int(qcfg["bits"]), int(qcfg.get("group_size", 64))
+
+
 def load_stage_params(
-    model: StageModel, model_path: str, dtype=jnp.bfloat16
+    model: StageModel, model_path: str, dtype=jnp.bfloat16,
+    quantize: str | None = None,
 ) -> dict:
-    """Load this stage's weights from a local HF checkpoint directory."""
+    """Load this stage's weights from a local HF checkpoint directory.
+
+    Quantized checkpoints (MLX affine format: packed-uint32 ``weight`` +
+    ``scales``/``biases`` siblings, config ``quantization`` dict with
+    per-layer overrides) load into on-the-fly-dequantized params
+    (``ops/quant.py``). ``quantize="int8"|"int4"`` quantizes a
+    full-precision checkpoint at load time instead (reference intent:
+    fitting DeepSeek-class MoE into a small-HBM stage).
+    """
     from safetensors import safe_open
 
     cfg = model.config
+    raw_cfg = {}
+    cfg_path = os.path.join(model_path, "config.json")
+    if os.path.exists(cfg_path):
+        with open(cfg_path, encoding="utf-8") as f:
+            raw_cfg = json.load(f)
+
     tree: dict = {}
     want_embed = model.is_first or (model.is_last and cfg.tie_word_embeddings)
     n_loaded = 0
+    n_quant = 0
+    # Full-precision tensors stream straight to device; only quantized
+    # triplets (packed uint32 weight + scales/biases siblings, already the
+    # compressed representation) are buffered until all parts arrive, so
+    # host peak memory stays far below the stage's fp footprint.
+    pending: dict[str, np.ndarray] = {}
     for path in _weight_files(model_path):
         with safe_open(path, framework="numpy") as f:
             for key in f.keys():
@@ -95,11 +150,48 @@ def load_stage_params(
                 )
                 if local is None:
                     continue
-                if local.startswith("embed_tokens") and not want_embed:
+                if local.split(".")[0] == "embed_tokens" and not want_embed:
                     continue
                 arr = f.get_tensor(key)
+                if local.endswith((".scales", ".biases")) or (
+                    local.endswith(".weight") and arr.dtype == np.uint32
+                ):
+                    pending[local] = arr
+                    continue
                 _assign(tree, local, jnp.asarray(arr).astype(dtype))
                 n_loaded += 1
+
+    from parallax_tpu.ops.quant import unpack_uint32
+
+    for local in list(pending):
+        if not local.endswith(".weight"):
+            continue
+        base = local[: -len(".weight")]
+        arr = pending.pop(local)
+        scales = pending.pop(base + ".scales", None)
+        if scales is None:
+            raise ValueError(
+                f"packed uint32 weight {base!r} has no .scales sibling"
+            )
+        qs = _quant_settings_for(raw_cfg, local, model.start_layer)
+        if qs is None:
+            raise ValueError(
+                f"quantized weight {base!r} but the checkpoint config has "
+                "no usable 'quantization' dict (bits/group_size unknown)"
+            )
+        _assign(tree, base + ".qweight",
+                jnp.asarray(unpack_uint32(arr, qs[0])))
+        _assign(tree, base + ".scales", jnp.asarray(scales).astype(dtype))
+        biases = pending.pop(base + ".biases", None)
+        if biases is not None:
+            _assign(tree, base + ".biases", jnp.asarray(biases).astype(dtype))
+        n_quant += 1
+        n_loaded += 1
+    if pending:
+        raise ValueError(
+            f"orphan quantization tensors without a weight: "
+            f"{sorted(pending)[:5]}"
+        )
 
     # layers dict {local_idx_str: {...}} -> ordered list
     layer_map = tree.get("layers", {})
@@ -107,10 +199,17 @@ def load_stage_params(
         layer_map[str(i)] for i in range(model.num_local_layers)
     ]
     logger.info(
-        "loaded %d tensors for layers [%d, %d) from %s",
-        n_loaded, model.start_layer, model.end_layer, model_path,
+        "loaded %d tensors (%d quantized) for layers [%d, %d) from %s",
+        n_loaded, n_quant, model.start_layer, model.end_layer, model_path,
     )
-    return model.finalize_params(tree)
+    tree = model.finalize_params(tree)
+    if quantize:
+        from parallax_tpu.ops.quant import quantize_tree
+
+        bits = {"int8": 8, "int4": 4}[quantize]
+        tree = quantize_tree(tree, bits=bits, group_size=64, dtype=dtype)
+        logger.info("quantized stage params on load (%s)", quantize)
+    return tree
 
 
 def params_from_torch_state_dict(

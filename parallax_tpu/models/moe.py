@@ -89,15 +89,26 @@ def _expert_ffn(x, gate_w, up_w, down_w, act_fn=_silu_glu):
     return jnp.einsum("ti,hi->th", h, down_w, preferred_element_type=jnp.float32)
 
 
+def _stacked_expert_weights(experts: dict):
+    """Stacked [E, I, H]/[E, H, I] expert tensors, dequantizing quantized
+    entries (dicts produced by ops/quant.py) on the fly."""
+    def get(name):
+        w = experts[name]
+        if isinstance(w, dict):
+            from parallax_tpu.ops.quant import dequantize_weight
+
+            return dequantize_weight(w)
+        return w
+
+    return get("gate_proj"), get("up_proj"), get("down_proj")
+
+
 def _moe_fallback(x, p, weights, ids, num_local, expert_offset,
                   act_fn=_silu_glu):
     """Masked per-expert loop; correct for any routing, O(E) matmuls."""
     t = x.shape[0]
     out = jnp.zeros((t, x.shape[1]), jnp.float32)
-    gate_w, up_w, down_w = (
-        p["experts"]["gate_proj"], p["experts"]["up_proj"],
-        p["experts"]["down_proj"],
-    )
+    gate_w, up_w, down_w = _stacked_expert_weights(p["experts"])
     for le in range(num_local):
         ge = expert_offset + le
         hit = ids == ge                           # [T, K]
@@ -127,9 +138,7 @@ def _moe_megablox(x, p, weights, ids, num_local, expert_offset,
     local_ids = jnp.clip(sorted_ids - expert_offset, 0, num_local - 1)
     group_sizes = jnp.bincount(local_ids, length=num_local).astype(jnp.int32)
 
-    gate_w = p["experts"]["gate_proj"]            # [El, I, H]
-    up_w = p["experts"]["up_proj"]
-    down_w = p["experts"]["down_proj"]            # [El, H, I]
+    gate_w, up_w, down_w = _stacked_expert_weights(p["experts"])
     g = gmm(xs, jnp.swapaxes(gate_w, 1, 2), group_sizes)
     u = gmm(xs, jnp.swapaxes(up_w, 1, 2), group_sizes)
     hme = act_fn(g, u).astype(x.dtype)
@@ -157,7 +166,8 @@ def moe_ffn(
 
     bias = p["gate"].get("e_score_correction_bias")
     weights, ids = route_topk(x, p["gate"]["weight"], moe, bias=bias)
-    num_local = p["experts"]["gate_proj"].shape[0]
+    gp = p["experts"]["gate_proj"]
+    num_local = (gp["qweight"] if isinstance(gp, dict) else gp).shape[0]
     if axis_name is not None:
         expert_offset = jax.lax.axis_index(axis_name) * num_local
     else:
@@ -169,11 +179,13 @@ def moe_ffn(
     if "shared_expert" in p:
         # Shared expert uses the standard column/row TP sharding, so its
         # partial output joins the routed experts' psum.
+        from parallax_tpu.models.layers import get_weight
+
         shared = _expert_ffn(
             x,
-            p["shared_expert"]["gate_proj"]["weight"],
-            p["shared_expert"]["up_proj"]["weight"],
-            p["shared_expert"]["down_proj"]["weight"],
+            get_weight(p["shared_expert"]["gate_proj"]),
+            get_weight(p["shared_expert"]["up_proj"]),
+            get_weight(p["shared_expert"]["down_proj"]),
             act_fn,
         )
         if "shared_expert_gate" in p:

@@ -73,7 +73,9 @@ class MoEStageModel(StageModel):
 
     def finalize_params(self, tree: dict) -> dict:
         """Stack per-expert HF weights: ``experts.{i}.gate_proj.weight`` ->
-        ``experts.gate_proj [E, I, H]`` (loader hook)."""
+        ``experts.gate_proj [E, I, H]`` (loader hook). Quantized experts
+        (``qweight``/``scales``/``biases`` from ops/quant.py) stack into a
+        quantized dict with a leading expert axis."""
         for layer in tree.get("layers", []):
             mlp = layer.get("mlp")
             if not isinstance(mlp, dict):
@@ -84,8 +86,17 @@ class MoEStageModel(StageModel):
             n = len(experts)
             stacked = {}
             for proj in ("gate_proj", "up_proj", "down_proj"):
-                stacked[proj] = jnp.stack(
-                    [experts[str(i)][proj]["weight"] for i in range(n)]
-                )
+                first = experts["0"][proj]
+                if "qweight" in first:
+                    stacked[proj] = {
+                        k: jnp.stack(
+                            [experts[str(i)][proj][k] for i in range(n)]
+                        )
+                        for k in first
+                    }
+                else:
+                    stacked[proj] = jnp.stack(
+                        [experts[str(i)][proj]["weight"] for i in range(n)]
+                    )
             mlp["experts"] = stacked
         return tree
