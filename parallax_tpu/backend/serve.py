@@ -116,6 +116,10 @@ def serve_main(args) -> int:
     end = args.end_layer or config.num_hidden_layers
 
     tp_size = getattr(args, "tp_size", 0)
+    if (getattr(args, "sp_size", 0) or 0) > 1 and not tp_size:
+        # SP claims the devices; TP defaults to off unless explicitly set
+        # (ring prefill does not compose with a TP-sharded stage yet).
+        tp_size = 1
     mesh = None
     if tp_size != 1:
         import jax as _jax
@@ -146,6 +150,14 @@ def serve_main(args) -> int:
         ),
         addressable,
     )
+    sp_size = getattr(args, "sp_size", 0) or 0
+    sp_mesh = None
+    sp_threshold = None
+    if sp_size > 1:
+        from parallax_tpu.parallel import make_mesh
+
+        sp_mesh = make_mesh(sp_size=sp_size, tp_size=1)
+        sp_threshold = getattr(args, "sp_threshold", 2048)
     engine = StageEngine(
         model,
         params,
@@ -160,8 +172,10 @@ def serve_main(args) -> int:
             prefill_chunk_size=getattr(args, "prefill_chunk_size", 1024),
             kv_dtype=getattr(args, "kv_dtype", "bfloat16"),
             enable_prefix_cache=not getattr(args, "no_prefix_cache", False),
+            sp_threshold=sp_threshold,
         ),
         mesh=mesh,
+        sp_mesh=sp_mesh,
     )
     tokenizer = load_tokenizer(args.model_path)
     frontend, _runner = build_local_frontend(

@@ -40,6 +40,11 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--quantization", choices=["int8", "int4"],
                        default=None,
                        help="weight-only quantize an fp checkpoint on load")
+    serve.add_argument("--sp-size", type=int, default=0,
+                       help="ring-attention sequence parallelism over this "
+                            "many devices for long-prompt prefill")
+    serve.add_argument("--sp-threshold", type=int, default=2048,
+                       help="prompts at least this long prefill via SP")
     serve.add_argument("--tp-size", type=int, default=0,
                        help="0 = all local chips")
 

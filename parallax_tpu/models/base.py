@@ -250,6 +250,12 @@ class StageModel:
         logits = L.lm_head_logits(x, head)
         return logits, new_kv
 
+    # Sequence-parallel mode: set by the engine's SP dispatch wrapper while
+    # tracing its long-prefill step function (ring attention over the
+    # ``sp`` mesh axis instead of the paged-cache read).
+    sp_mesh = None
+    _sp_active = False
+
     def _attention(self, lp: dict, h: jax.Array, kv: jax.Array,
                    inputs: BatchInputs, window: int | None):
         cfg = self.config
@@ -270,6 +276,7 @@ class StageModel:
             use_pallas=self.use_pallas,
             axis_name=self.axis_name,
             rope_fn=self.rope_fn,
+            sp_mesh=self.sp_mesh if self._sp_active else None,
         )
 
     def _decoder_layer(

@@ -148,6 +148,7 @@ def paged_attention_block(
     use_pallas: bool | None = None,
     axis_name: str | None = None,
     rope_fn=apply_rope,
+    sp_mesh=None,
 ) -> tuple[jax.Array, jax.Array]:
     """GQA attention over the paged cache: project, rope, scatter, attend.
 
@@ -160,6 +161,13 @@ def paged_attention_block(
     unsharded or inside shard_map with column-sharded projections (each chip
     sees its local heads + its slice of the KV pages); the row-parallel
     o_proj output is psummed over ``axis_name``.
+
+    ``sp_mesh`` switches long-context prefill to ring attention over the
+    mesh's ``sp`` axis (sequence parallelism): the quadratic attention is
+    computed with Q/K/V row-sharded over chips, K/V rotating on ICI, while
+    the cache write proceeds as usual. Valid only for a batch of
+    prefill-from-zero rows (no cached prefix) whose padding rows carry
+    position ``-1`` — the engine's SP dispatch guarantees both.
     """
     t = x.shape[0]
     d = config.head_dim
@@ -176,17 +184,24 @@ def paged_attention_block(
     k = rope_fn(k, positions, cos_table, sin_table)
 
     kv_pages = reshape_and_cache(kv_pages, k, v, slot_mapping)
-    out = ragged_paged_attention(
-        q,
-        kv_pages,
-        kv_lens,
-        page_indices,
-        cu_q_lens,
-        num_seqs,
-        sm_scale=d**-0.5,
-        sliding_window=sliding_window,
-        sinks=p.get("sinks"),
-        use_pallas=use_pallas,
-    )
+    if sp_mesh is not None:
+        from parallax_tpu.parallel.sp import ring_attention
+
+        out = ring_attention(
+            sp_mesh, q, k, v, positions, sm_scale=d**-0.5,
+        )
+    else:
+        out = ragged_paged_attention(
+            q,
+            kv_pages,
+            kv_lens,
+            page_indices,
+            cu_q_lens,
+            num_seqs,
+            sm_scale=d**-0.5,
+            sliding_window=sliding_window,
+            sinks=p.get("sinks"),
+            use_pallas=use_pallas,
+        )
     out = row_parallel_linear(out.reshape(t, hq * d), p["o_proj"], axis_name)
     return out, kv_pages

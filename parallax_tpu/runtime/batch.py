@@ -61,12 +61,14 @@ def assemble(
     page_size: int,
     hidden_states: np.ndarray | None = None,
     with_dense_map: bool = False,
+    pad_position: int = 0,
 ) -> BatchInputs:
     """Build fixed-shape arrays from a ragged plan.
 
     ``hidden_states`` replaces token ids on non-first stages; rows must be
     ordered exactly as the plan's segments (already padded to the token
-    bucket by the caller, or padded here).
+    bucket by the caller, or padded here). The SP path passes
+    ``pad_position=-1`` so ring attention masks padding rows as keys.
     """
     seqs = plan.seqs
     t_real = plan.total_new_tokens
@@ -75,7 +77,7 @@ def assemble(
     s = next_bucket(max(s_real, 1), spec.seq_buckets)
 
     token_ids = np.zeros((t,), np.int32)
-    positions = np.zeros((t,), np.int32)
+    positions = np.full((t,), pad_position, np.int32)
     slot_mapping = np.full((t,), -1, np.int32)
     kv_lens = np.zeros((s,), np.int32)
     page_indices = np.zeros((s, spec.pages_per_seq), np.int32)

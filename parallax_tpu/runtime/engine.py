@@ -21,7 +21,7 @@ import numpy as np
 from parallax_tpu.config import ModelConfig
 from parallax_tpu.models.base import BatchInputs, StageModel
 from parallax_tpu.ops.sampling import sample_tokens
-from parallax_tpu.runtime.batch import BucketSpec, assemble
+from parallax_tpu.runtime.batch import BucketSpec, assemble, default_buckets
 from parallax_tpu.runtime.cache_manager import CacheManager
 from parallax_tpu.runtime.request import (
     IntermediateRequest,
@@ -47,6 +47,10 @@ class EngineConfig:
     kv_dtype: str = "bfloat16"
     seed: int = 0
     request_timeout_s: float = 600.0
+    # Sequence parallelism: prompts of at least this many tokens prefill in
+    # ONE step with ring attention over the engine's sp mesh (requires
+    # ``sp_mesh`` at engine construction). None = off.
+    sp_threshold: int | None = None
 
 
 @dataclasses.dataclass
@@ -72,11 +76,13 @@ class StageEngine:
         params: dict,
         config: EngineConfig | None = None,
         mesh=None,
+        sp_mesh=None,
     ):
         self.model = model
         self.params = params
         self.cfg = config or EngineConfig()
         self.mesh = mesh
+        self.sp_mesh = sp_mesh
         kv_dtype = jnp.bfloat16 if self.cfg.kv_dtype == "bfloat16" else jnp.float32
         # Hybrid (linear-attention) models carry per-request state slots.
         self._needs_state = bool(getattr(model, "has_linear_layers", False))
@@ -150,6 +156,37 @@ class StageEngine:
             )
         else:
             self._jit_step = jax.jit(self._stage_fn, donate_argnums=(1,))
+        # Sequence-parallel long-prefill path: its own jit (traced with the
+        # model's SP flag up) and its own bucket lattice — token buckets are
+        # sp-multiples so the ring shards evenly, one sequence per step.
+        self._sp_enabled = (
+            sp_mesh is not None
+            and self.cfg.sp_threshold is not None
+            and self._model_supports_sp(model)
+        )
+        if self._sp_enabled:
+            sp = sp_mesh.shape["sp"]
+            model.sp_mesh = sp_mesh
+
+            def _sp_stage_fn(params, kv, inputs):
+                self.model._sp_active = True
+                try:
+                    return self.model(params, kv, inputs)
+                finally:
+                    self.model._sp_active = False
+
+            self._jit_sp_step = jax.jit(_sp_stage_fn, donate_argnums=(1,))
+            # Long prompts only: a floor of 256 keeps short prefills off the
+            # SP compile lattice; buckets are sp-multiples for even shards.
+            self._sp_spec = BucketSpec(
+                token_buckets=[
+                    ((b + sp - 1) // sp) * sp
+                    for b in default_buckets(self.cfg.max_model_len,
+                                             floor=256)
+                ],
+                seq_buckets=[1],
+                pages_per_seq=self.spec.pages_per_seq,
+            )
         self._base_key = jax.random.key(self.cfg.seed)
         self._step_count = 0
         # Non-head stages: hidden rows waiting per request id.
@@ -161,6 +198,26 @@ class StageEngine:
 
     def _stage_fn(self, params, kv, inputs: BatchInputs):
         return self.model(params, kv, inputs)
+
+    def _model_supports_sp(self, model: StageModel) -> bool:
+        """Ring-attention prefill covers only the plain full-causal GQA
+        path: models overriding ``_attention`` (MLA/DSA/MSA/hybrid), layers
+        with windows or sinks, and TP-sharded stages (whose psum axis would
+        escape the TP shard_map) would silently diverge — refuse them so
+        SP dispatch is never inert or wrong."""
+        from parallax_tpu.config import LAYER_ATTENTION
+
+        if self._needs_state or model.tp_size > 1:
+            return False
+        if type(model)._attention is not StageModel._attention:
+            return False
+        cfg = model.config
+        if cfg.use_attention_sinks:
+            return False
+        return all(
+            cfg.layer_type(gi) == LAYER_ATTENTION
+            for gi in range(model.start_layer, model.end_layer)
+        )
 
     # -- intake -----------------------------------------------------------
 
@@ -248,9 +305,24 @@ class StageEngine:
     def has_work(self) -> bool:
         return self.scheduler.num_requests() > 0
 
+    def _take_sp_plan(self) -> BatchPlan | None:
+        """A sequence-parallel long-prefill plan, if one is ready."""
+        if not self._sp_enabled:
+            return None
+        plan = self.scheduler.take_sp_prefill(self.cfg.sp_threshold)
+        if plan is None:
+            return None
+        if not self.model.is_first:
+            seg = plan.seqs[0]
+            avail = self._pending_hidden.get(seg.request.request_id)
+            if avail is None or avail.shape[0] < seg.num_new_tokens:
+                return None
+        return plan
+
     def step(self) -> StepOutputs:
         t0 = time.perf_counter()
-        plan = self._form_plan()
+        sp_plan = self._take_sp_plan()
+        plan = sp_plan if sp_plan is not None else self._form_plan()
         if plan.is_empty:
             return StepOutputs(forward=[], finished=self._collect_finished())
 
@@ -268,11 +340,18 @@ class StageEngine:
                 if not hasattr(seg.request, "state_slot"):
                     # slot 0 is the null slot; real slots start at 1.
                     seg.request.state_slot = self._slot_alloc.alloc() + 1
-        inputs = assemble(
-            plan, self.spec, self.cfg.page_size, hidden_states=hidden,
-            with_dense_map=self._needs_state,
-        )
-        out, self.kv = self._jit_step(self.params, self.kv, inputs)
+        if sp_plan is not None:
+            inputs = assemble(
+                plan, self._sp_spec, self.cfg.page_size,
+                hidden_states=hidden, pad_position=-1,
+            )
+            out, self.kv = self._jit_sp_step(self.params, self.kv, inputs)
+        else:
+            inputs = assemble(
+                plan, self.spec, self.cfg.page_size, hidden_states=hidden,
+                with_dense_map=self._needs_state,
+            )
+            out, self.kv = self._jit_step(self.params, self.kv, inputs)
 
         # Advance scheduler state first: a locally-committed sampled token
         # (single-stage ring closure) must not be clobbered by the

@@ -101,6 +101,37 @@ class Scheduler:
             req.status = RequestStatus.PREFILLING
             self.running[rid] = req
 
+    def take_sp_prefill(self, threshold: int) -> BatchPlan | None:
+        """Pick one whole long prompt for a sequence-parallel prefill step.
+
+        Eligible: a PREFILLING request with nothing computed yet (ring
+        attention covers new-token attention only, so no cached prefix and
+        no earlier chunks) and a prompt of at least ``threshold`` tokens.
+        The request is scheduled alone, unchunked. (No check_timeouts here:
+        the fall-through form_batch covers it, and the SP probe runs every
+        engine step — the O(requests) timeout scan must not run twice.)
+        """
+        self.admit_requests()
+        for req in self.running.values():
+            if req.status is not RequestStatus.PREFILLING:
+                continue
+            n = req.num_prompt_tokens
+            if req.num_computed_tokens != 0 or n < threshold:
+                continue
+            if not self.cache.ensure_capacity(req, n):
+                self._abort_on_oom(req)
+                continue
+            return BatchPlan([
+                ScheduledSeq(
+                    request=req,
+                    num_new_tokens=n,
+                    token_ids=list(req.prompt_ids),
+                    context_len=n,
+                    is_last_prefill_chunk=True,
+                )
+            ])
+        return None
+
     # -- batch formation (phase 2) ---------------------------------------
 
     def form_batch(self) -> BatchPlan:
