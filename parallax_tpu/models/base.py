@@ -45,6 +45,12 @@ class BatchInputs:
     # 1 on a request's first chunk: its (possibly reused) slot must be
     # zeroed before use.
     reset_state: jax.Array | None = None  # i32[S]
+    # STATIC: every segment is a single decode token (row i == sequence i).
+    # Part of the jit cache key — decode steps compile their own variant so
+    # decode-specialized kernels (Pallas MLA) can dispatch on it.
+    decode_only: bool = dataclasses.field(
+        default=False, metadata=dict(static=True)
+    )
 
 
 class StageModel:
@@ -277,6 +283,7 @@ class StageModel:
             axis_name=self.axis_name,
             rope_fn=self.rope_fn,
             sp_mesh=self.sp_mesh if self._sp_active else None,
+            decode_only=inputs.decode_only,
         )
 
     def _decoder_layer(

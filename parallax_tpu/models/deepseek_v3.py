@@ -22,7 +22,7 @@ from parallax_tpu.models.base import BatchInputs, StageModel
 from parallax_tpu.models.qwen3_moe import MoEStageModel
 from parallax_tpu.models.registry import register_model
 from parallax_tpu.ops.mla import (
-    mla_ragged_attention_xla,
+    mla_ragged_attention,
     mla_rope_permute,
     new_mla_pages,
     store_mla_cache,
@@ -154,7 +154,7 @@ class DeepseekStageModel(MoEStageModel):
             p, x, inputs
         )
         cache = store_mla_cache(cache, latent, k_pe, inputs.slot_mapping)
-        out_latent = mla_ragged_attention_xla(
+        out_latent = mla_ragged_attention(
             q_latent,
             q_pe,
             cache,
@@ -164,6 +164,8 @@ class DeepseekStageModel(MoEStageModel):
             inputs.num_seqs,
             sm_scale=self.sm_scale,
             kv_lora_rank=self.config.mla.kv_lora_rank,
+            decode_only=inputs.decode_only,
+            use_pallas=self.use_pallas,
         )
         return self._mla_out(p, out_latent, w_uv, hq), cache
 

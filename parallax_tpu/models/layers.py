@@ -149,6 +149,7 @@ def paged_attention_block(
     axis_name: str | None = None,
     rope_fn=apply_rope,
     sp_mesh=None,
+    decode_only: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """GQA attention over the paged cache: project, rope, scatter, attend.
 
@@ -202,6 +203,7 @@ def paged_attention_block(
             sliding_window=sliding_window,
             sinks=p.get("sinks"),
             use_pallas=use_pallas,
+            decode_only=decode_only,
         )
     out = row_parallel_linear(out.reshape(t, hq * d), p["o_proj"], axis_name)
     return out, kv_pages

@@ -48,6 +48,7 @@ def ragged_paged_attention(
     soft_cap: float | None = None,
     sinks: jax.Array | None = None,
     use_pallas: bool | None = None,
+    decode_only: bool = False,
 ) -> jax.Array:
     """Attention over the paged KV cache for a ragged batch of sequences.
 
@@ -77,14 +78,26 @@ def ragged_paged_attention(
     if use_pallas is None:
         use_pallas = _tpu_available()
     if use_pallas and sinks is not None:
-        # The bundled kernel has no sink support yet; fall back loudly — the
-        # XLA path materializes per-token KV copies and is not HBM-safe at
-        # scale (tracked for a custom Pallas kernel).
+        if decode_only and q.shape[0] == kv_lens.shape[0]:
+            # Custom flash decode kernel with sink + window support
+            # (the bundled kernel has neither sinks nor our sink-decode
+            # contract).
+            from parallax_tpu.ops.attention_pallas import (
+                gqa_decode_attention_pallas,
+            )
+
+            return gqa_decode_attention_pallas(
+                q, kv_pages, kv_lens, page_indices, sinks,
+                sm_scale=sm_scale, sliding_window=sliding_window,
+                use_sinks=True,
+            )
+        # Prefill with sinks: fall back loudly — the XLA path materializes
+        # per-token KV copies; chunked prefill bounds the blowup.
         import warnings
 
         warnings.warn(
-            "attention sinks requested on TPU: using the XLA fallback "
-            "attention path (memory-heavy); Pallas sink kernel pending",
+            "attention sinks in prefill on TPU: using the XLA fallback "
+            "attention path (memory-heavy); bounded by chunked prefill",
             stacklevel=2,
         )
     if use_pallas and sinks is None:

@@ -49,6 +49,45 @@ def store_mla_cache(
     return flat.reshape(p, page, 1, width)
 
 
+def mla_ragged_attention(
+    q_latent: jax.Array,
+    q_pe: jax.Array,
+    cache: jax.Array,
+    kv_lens: jax.Array,
+    page_indices: jax.Array,
+    cu_q_lens: jax.Array,
+    num_seqs: jax.Array,
+    *,
+    sm_scale: float,
+    kv_lora_rank: int,
+    decode_only: bool = False,
+    use_pallas: bool | None = None,
+) -> jax.Array:
+    """MLA attention dispatcher: the Pallas flash decode kernel on TPU for
+    decode-only batches (one query per sequence — reference kernel contract
+    ``kernels/mla/mla.cpp``), the XLA gather path otherwise (prefill /
+    CPU / oracle)."""
+    if use_pallas is None:
+        from parallax_tpu.ops.attention import _tpu_available
+
+        use_pallas = _tpu_available()
+    if (
+        decode_only
+        and use_pallas
+        and q_latent.shape[0] == kv_lens.shape[0]
+    ):
+        from parallax_tpu.ops.mla_pallas import mla_decode_attention_pallas
+
+        return mla_decode_attention_pallas(
+            q_latent, q_pe, cache, kv_lens, page_indices,
+            sm_scale=sm_scale, kv_lora_rank=kv_lora_rank,
+        )
+    return mla_ragged_attention_xla(
+        q_latent, q_pe, cache, kv_lens, page_indices, cu_q_lens, num_seqs,
+        sm_scale=sm_scale, kv_lora_rank=kv_lora_rank,
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("sm_scale", "kv_lora_rank"))
 def mla_ragged_attention_xla(
     q_latent: jax.Array,     # [T, Hq, R]   (q_nope absorbed through W_UK)

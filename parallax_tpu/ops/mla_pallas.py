@@ -1,0 +1,154 @@
+"""Pallas TPU kernel: flash-style MLA decode over the compressed latent
+cache.
+
+Capability parity: reference MLA decode kernel
+(``src/parallax_extensions/kernels/mla/mla.cpp:1-138``, facade
+``ops.py:73-121``): ``softmax(q_latent . latent^T + q_pe . rope^T) .
+latent`` per sequence, one query token each. The XLA gather path in
+``ops/mla.py`` stays as the oracle (tests compare bit-for-bit semantics)
+and the prefill path.
+
+Kernel shape: grid ``(num_seqs, pages_per_seq)``; each step streams one
+latent page from HBM into VMEM via the page table (scalar-prefetched so
+the DMA address is known before the body runs) and folds it into an
+online-softmax accumulator held in VMEM scratch. The two matmuls per page
+([Hq, R] x [R, page] and [Hq, page] x [page, R]) land on the MXU; per-page
+masking handles ragged context lengths, so padding sequences (kv_len 0)
+produce zeros.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30
+
+
+def _mla_decode_kernel(
+    # scalar prefetch
+    pages_ref,    # i32[S, pages_per_seq]
+    lens_ref,     # i32[S]
+    # blocks
+    q_lat_ref,    # [1, Hq, R]
+    q_pe_ref,     # [1, Hq, Dr]
+    cache_ref,    # [1, page, 1, R+Dr]
+    out_ref,      # [1, Hq, R]
+    # scratch
+    m_ref,        # f32[Hq, 1]
+    l_ref,        # f32[Hq, 1]
+    o_ref,        # f32[Hq, R]
+    *,
+    sm_scale: float,
+    kv_lora_rank: int,
+):
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    page_size = cache_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    kv_len = lens_ref[s]
+    base = j * page_size
+
+    @pl.when(base < kv_len)
+    def _accumulate():
+        rows = cache_ref[0, :, 0, :]                 # [page, R+Dr]
+        latent = rows[:, :kv_lora_rank]
+        rope = rows[:, kv_lora_rank:]
+        ql = q_lat_ref[0]                            # [Hq, R]
+        qp = q_pe_ref[0]                             # [Hq, Dr]
+        scores = (
+            jax.lax.dot_general(
+                ql, latent, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            + jax.lax.dot_general(
+                qp, rope, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        ) * sm_scale                                 # [Hq, page]
+        pos = base + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1
+        )
+        valid = pos < kv_len                         # decode: q at kv_len-1
+        scores = jnp.where(valid, scores, _NEG)
+
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new[:, None])
+        p = jnp.where(valid, p, 0.0)
+        l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
+        o_ref[:, :] = o_ref[:, :] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(latent.dtype), latent, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:, 0] = m_new
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _finish():
+        out_ref[0, :, :] = (
+            o_ref[:, :] / jnp.maximum(l_ref[:, 0], 1e-30)[:, None]
+        ).astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("sm_scale", "kv_lora_rank", "interpret"),
+)
+def mla_decode_attention_pallas(
+    q_latent: jax.Array,     # [S, Hq, R] — ONE query token per sequence
+    q_pe: jax.Array,         # [S, Hq, Dr]
+    cache: jax.Array,        # [P, page, 1, R+Dr]
+    kv_lens: jax.Array,      # i32[S]
+    page_indices: jax.Array, # i32[S, pages_per_seq]
+    *,
+    sm_scale: float,
+    kv_lora_rank: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash MLA decode: [S, Hq, R] attention output in latent space."""
+    s, hq, r = q_latent.shape
+    p, page_size, _, width = cache.shape
+    _, pages_per_seq = page_indices.shape
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec((1, hq, r), lambda i, j, pages, lens: (i, 0, 0)),
+            pl.BlockSpec(
+                (1, hq, width - r), lambda i, j, pages, lens: (i, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, page_size, 1, width),
+                lambda i, j, pages, lens: (pages[i, j], 0, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, hq, r), lambda i, j, pages, lens: (i, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((hq, 1), jnp.float32),
+            pltpu.VMEM((hq, 1), jnp.float32),
+            pltpu.VMEM((hq, r), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _mla_decode_kernel, sm_scale=sm_scale, kv_lora_rank=kv_lora_rank
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, hq, r), q_latent.dtype),
+        interpret=interpret,
+    )(page_indices, kv_lens, q_latent, q_pe, cache)

@@ -62,6 +62,7 @@ def assemble(
     hidden_states: np.ndarray | None = None,
     with_dense_map: bool = False,
     pad_position: int = 0,
+    decode_only: bool = False,
 ) -> BatchInputs:
     """Build fixed-shape arrays from a ragged plan.
 
@@ -73,8 +74,14 @@ def assemble(
     seqs = plan.seqs
     t_real = plan.total_new_tokens
     s_real = len(seqs)
-    t = next_bucket(max(t_real, 1), spec.token_buckets)
     s = next_bucket(max(s_real, 1), spec.seq_buckets)
+    if decode_only:
+        # One token per sequence: bucket tokens on the SEQ lattice so
+        # t == s always holds (the decode-kernel dispatch contract), even
+        # when the two lattices diverge (non-power-of-two max_batch_size).
+        t = s
+    else:
+        t = next_bucket(max(t_real, 1), spec.token_buckets)
 
     token_ids = np.zeros((t,), np.int32)
     positions = np.full((t,), pad_position, np.int32)
@@ -126,6 +133,7 @@ def assemble(
         reset_arr = jnp.asarray(reset)
 
     return BatchInputs(
+        decode_only=decode_only,
         state_slots=state_slots,
         dense_map=dense_map,
         q_lens=q_lens_arr,

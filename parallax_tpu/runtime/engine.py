@@ -187,6 +187,13 @@ class StageEngine:
                 seq_buckets=[1],
                 pages_per_seq=self.spec.pages_per_seq,
             )
+        # Models with a decode-specialized Pallas kernel: plain MLA
+        # (DeepSeek V2/V3 — V3.2's sparse path has its own ops) and
+        # sink-attention models (gpt-oss).
+        cfg_m = model.config
+        self._use_decode_flag = (
+            (cfg_m.is_mla and cfg_m.dsa is None) or cfg_m.use_attention_sinks
+        )
         self._base_key = jax.random.key(self.cfg.seed)
         self._step_count = 0
         # Non-head stages: hidden rows waiting per request id.
@@ -347,9 +354,17 @@ class StageEngine:
             )
             out, self.kv = self._jit_sp_step(self.params, self.kv, inputs)
         else:
+            # Decode-only batches compile their own variant (static flag)
+            # so decode-specialized Pallas kernels can dispatch. Only set
+            # for models that HAVE such a kernel (plain MLA, sink models) —
+            # for everyone else the extra variant would be pure compile
+            # waste.
+            decode_only = self._use_decode_flag and all(
+                s.num_new_tokens == 1 for s in plan.seqs
+            )
             inputs = assemble(
                 plan, self.spec, self.cfg.page_size, hidden_states=hidden,
-                with_dense_map=self._needs_state,
+                with_dense_map=self._needs_state, decode_only=decode_only,
             )
             out, self.kv = self._jit_step(self.params, self.kv, inputs)
 

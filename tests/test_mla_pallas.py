@@ -1,0 +1,159 @@
+"""Pallas MLA decode kernel vs the XLA oracle (interpret mode on CPU).
+
+Mirrors the reference kernel-test strategy
+(``tests/parallax_extensions_tests/test_paged_attention_v1.py``: exact
+comparison against a dense reference across shapes/lengths).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parallax_tpu.ops.mla import mla_ragged_attention_xla, new_mla_pages, store_mla_cache
+from parallax_tpu.ops.mla_pallas import mla_decode_attention_pallas
+
+
+def _setup(rng, s, hq, r, dr, page_size, pages_per_seq, lens):
+    num_pages = s * pages_per_seq + 1
+    cache = new_mla_pages(num_pages, page_size, r, dr, jnp.float32)
+    page_indices = np.zeros((s, pages_per_seq), np.int32)
+    next_page = 1
+    for i, ln in enumerate(lens):
+        need = (ln + page_size - 1) // page_size
+        for j in range(need):
+            page_indices[i, j] = next_page
+            next_page += 1
+        if ln:
+            latent = rng.standard_normal((ln, r)).astype(np.float32)
+            rope = rng.standard_normal((ln, dr)).astype(np.float32)
+            slots = np.array([
+                page_indices[i, t // page_size] * page_size + t % page_size
+                for t in range(ln)
+            ], np.int32)
+            cache = store_mla_cache(cache, jnp.asarray(latent),
+                                    jnp.asarray(rope), jnp.asarray(slots))
+    q_latent = rng.standard_normal((s, hq, r)).astype(np.float32)
+    q_pe = rng.standard_normal((s, hq, dr)).astype(np.float32)
+    return (jnp.asarray(q_latent), jnp.asarray(q_pe), cache,
+            jnp.asarray(lens, jnp.int32), jnp.asarray(page_indices))
+
+
+@pytest.mark.parametrize("lens", [
+    [7], [64], [1], [13, 64, 3], [100, 1, 57, 8],
+])
+@pytest.mark.parametrize("hq", [4, 16])
+def test_pallas_decode_matches_xla_oracle(lens, hq):
+    rng = np.random.default_rng(0)
+    s = len(lens)
+    r, dr, page_size = 32, 16, 16
+    pages_per_seq = 8
+    q_latent, q_pe, cache, kv_lens, page_indices = _setup(
+        rng, s, hq, r, dr, page_size, pages_per_seq, lens
+    )
+    cu = jnp.asarray(np.arange(s + 1, dtype=np.int32))
+    oracle = mla_ragged_attention_xla(
+        q_latent, q_pe, cache, kv_lens, page_indices, cu,
+        jnp.asarray([s], jnp.int32), sm_scale=0.25, kv_lora_rank=r,
+    )
+    out = mla_decode_attention_pallas(
+        q_latent, q_pe, cache, kv_lens, page_indices,
+        sm_scale=0.25, kv_lora_rank=r, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oracle),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pallas_decode_padding_sequences_zero():
+    # Sequences with kv_len 0 (batch padding) must come out all-zero.
+    rng = np.random.default_rng(1)
+    q_latent, q_pe, cache, kv_lens, page_indices = _setup(
+        rng, 3, 4, 32, 16, 16, 4, [20, 0, 0]
+    )
+    out = np.asarray(mla_decode_attention_pallas(
+        q_latent, q_pe, cache, kv_lens, page_indices,
+        sm_scale=0.25, kv_lora_rank=32, interpret=True,
+    ))
+    assert np.all(out[1:] == 0.0)
+    assert np.any(out[0] != 0.0)
+
+
+def test_decode_only_flag_routes_engine_batches():
+    """Engine decode steps set BatchInputs.decode_only (static), prefill
+    steps don't — checked via the assemble path."""
+    from parallax_tpu.runtime.batch import BucketSpec, assemble
+    from parallax_tpu.runtime.request import Request, SamplingParams
+    from parallax_tpu.runtime.scheduler import BatchPlan, ScheduledSeq
+
+    spec = BucketSpec.build(64, 8, 256, 8)
+    req = Request("r", prompt_ids=[1, 2, 3],
+                  sampling_params=SamplingParams())
+    req.page_ids = [1]
+    plan = BatchPlan([ScheduledSeq(request=req, num_new_tokens=1,
+                                   token_ids=[3], context_len=3)])
+    d = assemble(plan, spec, 8, decode_only=True)
+    p = assemble(plan, spec, 8)
+    assert d.decode_only and not p.decode_only
+    # static field: different jit cache keys
+    import jax.tree_util as jtu
+
+    assert jtu.tree_structure(d) != jtu.tree_structure(p)
+
+
+# ---------------------------------------------------------------------------
+# GQA decode kernel with sinks + sliding window (gpt-oss contract)
+# ---------------------------------------------------------------------------
+
+def _gqa_setup(rng, s, hq, hkv, d, page_size, pages_per_seq, lens):
+    from parallax_tpu.ops.kv_cache_ops import new_kv_pages, reshape_and_cache
+
+    num_pages = s * pages_per_seq + 1
+    kv = new_kv_pages(num_pages, page_size, hkv, d, jnp.float32)
+    page_indices = np.zeros((s, pages_per_seq), np.int32)
+    next_page = 1
+    for i, ln in enumerate(lens):
+        need = (ln + page_size - 1) // page_size
+        for j in range(need):
+            page_indices[i, j] = next_page
+            next_page += 1
+        if ln:
+            k = rng.standard_normal((ln, hkv, d)).astype(np.float32)
+            v = rng.standard_normal((ln, hkv, d)).astype(np.float32)
+            slots = np.array([
+                page_indices[i, t // page_size] * page_size + t % page_size
+                for t in range(ln)
+            ], np.int32)
+            kv = reshape_and_cache(kv, jnp.asarray(k), jnp.asarray(v),
+                                   jnp.asarray(slots))
+    q = rng.standard_normal((s, hq, d)).astype(np.float32)
+    return (jnp.asarray(q), kv, jnp.asarray(lens, jnp.int32),
+            jnp.asarray(page_indices))
+
+
+@pytest.mark.parametrize("window,use_sinks", [
+    (None, False), (None, True), (24, False), (24, True),
+])
+def test_gqa_decode_kernel_matches_xla_oracle(window, use_sinks):
+    from parallax_tpu.ops.attention import _ragged_paged_attention_xla
+    from parallax_tpu.ops.attention_pallas import gqa_decode_attention_pallas
+
+    rng = np.random.default_rng(2)
+    lens = [7, 40, 1, 64]
+    s, hq, hkv, d, page_size = len(lens), 8, 2, 16, 16
+    q, kv, kv_lens, page_indices = _gqa_setup(
+        rng, s, hq, hkv, d, page_size, 8, lens
+    )
+    sinks = (jnp.asarray(rng.standard_normal((hq,)).astype(np.float32))
+             if use_sinks else None)
+    cu = jnp.asarray(np.arange(s + 1, dtype=np.int32))
+    oracle = _ragged_paged_attention_xla(
+        q, kv, kv_lens, page_indices, cu, jnp.asarray([s], jnp.int32),
+        sm_scale=0.25, sliding_window=window, soft_cap=None, sinks=sinks,
+    )
+    out = gqa_decode_attention_pallas(
+        q, kv, kv_lens, page_indices, sinks,
+        sm_scale=0.25, sliding_window=window, use_sinks=use_sinks,
+        interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oracle),
+                               rtol=2e-4, atol=2e-4)
