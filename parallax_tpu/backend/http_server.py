@@ -214,6 +214,7 @@ class OpenAIFrontend:
         stream_poll_s: float = 0.02,
         refit_fn=None,
         stop_fn=None,
+        scheduler_init_fn=None,
     ):
         self.tokenizer = tokenizer
         self.submit_fn = submit_fn
@@ -221,6 +222,7 @@ class OpenAIFrontend:
         self.status_fn = status_fn
         self.refit_fn = refit_fn
         self.stop_fn = stop_fn
+        self.scheduler_init_fn = scheduler_init_fn
         self.model_name = model_name
         self.stream_poll_s = stream_poll_s
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
@@ -236,6 +238,7 @@ class OpenAIFrontend:
             web.get("/cluster/status", self.cluster_status_stream),
             web.get("/cluster/status_json", self.cluster_status_json),
             web.post("/weight/refit", self.weight_refit),
+            web.post("/scheduler/init", self.scheduler_init),
         ])
 
     # -- endpoints ---------------------------------------------------------
@@ -286,6 +289,50 @@ class OpenAIFrontend:
         except (ConnectionResetError, asyncio.CancelledError):
             pass
         return resp
+
+    async def scheduler_init(self, request):
+        """Live model switch (reference backend/main.py:99-155): stop the
+        current global scheduler and bootstrap a fresh one for the new
+        model; workers rejoin via heartbeat and reload their stage."""
+        if self.scheduler_init_fn is None:
+            return web.json_response(
+                {"type": "scheduler_init",
+                 "error": "model switch unavailable in this mode"},
+                status=501,
+            )
+        body = await request.json()
+        model_name = body.get("model_name")
+        init_nodes_num = body.get("init_nodes_num")
+        if model_name is None:
+            return web.json_response(
+                {"type": "scheduler_init", "error": "model_name is required"},
+                status=400,
+            )
+        if init_nodes_num is None:
+            return web.json_response(
+                {"type": "scheduler_init",
+                 "error": "init_nodes_num is required"},
+                status=400,
+            )
+        try:
+            info = await asyncio.to_thread(
+                self.scheduler_init_fn, model_name, int(init_nodes_num)
+            )
+        except ValueError as e:
+            return web.json_response(
+                {"type": "scheduler_init", "error": str(e)}, status=400
+            )
+        except Exception as e:
+            logger.exception("scheduler init failed")
+            return web.json_response(
+                {"type": "scheduler_init", "error": str(e)}, status=500
+            )
+        self.model_name = model_name
+        return web.json_response({
+            "type": "scheduler_init",
+            "data": {"model_name": model_name,
+                     "init_nodes_num": init_nodes_num, **(info or {})},
+        })
 
     async def weight_refit(self, request):
         if self.refit_fn is None:

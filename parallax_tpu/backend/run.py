@@ -126,19 +126,64 @@ def build_swarm_frontend(
     transport: TcpTransport,
     tokenizer,
     model_name: str,
+    resolve_model=None,
+    tokenizer_fn=None,
 ) -> tuple[OpenAIFrontend, SchedulerService, SwarmClient]:
     service = SchedulerService(scheduler, transport)
     client = SwarmClient(transport, service)
+    # Bind through the service so a live model switch (which swaps
+    # service.scheduler) redirects every control-plane call.
     frontend = OpenAIFrontend(
         tokenizer,
         submit_fn=client.submit,
         route_fn=client.route,
-        status_fn=scheduler.cluster_status,
-        refit_fn=scheduler.begin_refit,
+        status_fn=lambda: service.scheduler.cluster_status(),
+        refit_fn=lambda index: service.scheduler.begin_refit(index),
         model_name=model_name,
         stop_fn=client.stop,
     )
+    if resolve_model is not None:
+        frontend.scheduler_init_fn = make_scheduler_init_fn(
+            service, resolve_model, frontend=frontend,
+            tokenizer_fn=tokenizer_fn,
+        )
     return frontend, service, client
+
+
+def make_scheduler_init_fn(service: SchedulerService, resolve_model,
+                           frontend=None, tokenizer_fn=None):
+    """Model-switch hook for ``/scheduler/init``: swap a fresh
+    GlobalScheduler for the new model into the running service. Workers are
+    unknown to the new scheduler, so their next heartbeat gets a rejoin,
+    re-resolve the new model (join replies carry its name) and reload
+    their stage; the frontend's tokenizer follows via ``tokenizer_fn``
+    (reference scheduler_manage stop + run, backend/main.py:124-136)."""
+    lock = threading.Lock()
+
+    def init(model_name: str, init_nodes_num: int) -> dict:
+        try:
+            model = resolve_model(model_name)
+        except KeyError as e:   # -> 400 at the endpoint
+            raise ValueError(str(e))
+        new_tokenizer = tokenizer_fn(model_name) if tokenizer_fn else None
+        with lock:   # serialize concurrent switches: one stop per swap
+            new_sched = GlobalScheduler(
+                model, min_nodes_bootstrapping=init_nodes_num
+            )
+            old = service.scheduler
+            new_sched.start()
+            service.scheduler = new_sched
+            if frontend is not None and new_tokenizer is not None:
+                frontend.tokenizer = new_tokenizer
+            try:
+                old.stop()
+            except Exception:
+                logger.exception("stopping previous scheduler")
+        logger.info("scheduler switched to %s (min_nodes=%d)",
+                    model_name, init_nodes_num)
+        return {"num_layers": model.num_hidden_layers}
+
+    return init
 
 
 def run_main(args) -> int:
@@ -147,21 +192,32 @@ def run_main(args) -> int:
     from parallax_tpu.config import load_config
     import os
 
-    if os.path.isdir(args.model_name):
-        model = load_config(args.model_name)
-        tokenizer = load_tokenizer(args.model_name)
-    elif args.model_name.lower() in PRESETS:
-        model = get_preset(args.model_name)
-        tokenizer = load_tokenizer(None)
-    else:
-        raise SystemExit(f"unknown model {args.model_name}")
+    def resolve_model(name: str):
+        if os.path.isdir(name):
+            return load_config(name)
+        try:
+            return get_preset(name)   # presets + curated model DB
+        except KeyError:
+            raise ValueError(f"unknown model {name}")
+
+    try:
+        model = resolve_model(args.model_name)
+    except ValueError as e:
+        raise SystemExit(str(e))
+    tokenizer = load_tokenizer(
+        args.model_name if os.path.isdir(args.model_name) else None
+    )
 
     scheduler = GlobalScheduler(
         model, min_nodes_bootstrapping=args.min_nodes
     )
     transport = TcpTransport("scheduler", "0.0.0.0", args.port + 1)
     frontend, service, _client = build_swarm_frontend(
-        scheduler, transport, tokenizer, args.model_name
+        scheduler, transport, tokenizer, args.model_name,
+        resolve_model=resolve_model,
+        tokenizer_fn=lambda name: load_tokenizer(
+            name if os.path.isdir(name) else None
+        ),
     )
     service.start()
     logger.info(

@@ -61,17 +61,24 @@ class SchedulerService:
         while time.monotonic() < deadline:
             alloc = self.scheduler.get_node_allocation(node_id)
             if alloc is not None:
-                return alloc
+                return self._with_model(alloc)
             if self.scheduler.bootstrapped.is_set():
                 grace = time.monotonic() + 2.0
                 while time.monotonic() < grace:
                     alloc = self.scheduler.get_node_allocation(node_id)
                     if alloc is not None:
-                        return alloc
+                        return self._with_model(alloc)
                     time.sleep(0.05)
                 return {"standby": True}
             time.sleep(0.05)
         return {"error": "no allocation within timeout"}
+
+    def _with_model(self, alloc: dict) -> dict:
+        """Allocations carry the serving model's name so workers can detect
+        a live model switch and re-resolve their stage config."""
+        alloc = dict(alloc)
+        alloc["model_name"] = self.scheduler.model.model_name
+        return alloc
 
     def _on_update(self, _peer: str, payload: dict) -> dict:
         node_id = payload["node_id"]
@@ -91,7 +98,7 @@ class SchedulerService:
             is_ready=payload.get("is_ready"),
             refit_version=payload.get("refit_version"),
         )
-        alloc = self.scheduler.get_node_allocation(node_id) or {}
+        alloc = self._with_model(self.scheduler.get_node_allocation(node_id) or {})
         alloc["refit_version"] = self.scheduler.refit_version
         alloc["refit_index"] = (
             self.scheduler.refit_index

@@ -131,9 +131,12 @@ def serve_main(args) -> int:
 
             mesh = make_mesh(tp_size=tp_size)
     model = create_stage_model(config, start, end, tp_size=max(1, tp_size))
+    # LoRA merges into full-precision weights pre-finalize; on-load
+    # quantization runs after the merge inside the loader.
     params = load_stage_params(
         model, args.model_path,
         quantize=getattr(args, "quantization", None),
+        lora_path=getattr(args, "lora_path", None),
     )
 
     page_size = args.page_size

@@ -40,6 +40,8 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--quantization", choices=["int8", "int4"],
                        default=None,
                        help="weight-only quantize an fp checkpoint on load")
+    serve.add_argument("--lora-path", default=None,
+                       help="PEFT LoRA adapter directory to merge at load")
     serve.add_argument("--sp-size", type=int, default=0,
                        help="ring-attention sequence parallelism over this "
                             "many devices for long-prompt prefill")
@@ -57,6 +59,9 @@ def build_parser() -> argparse.ArgumentParser:
     join.add_argument("--scheduler-addr", required=True)
     join.add_argument("--model-path", default=None)
     join.add_argument("--port", type=int, default=0)
+    join.add_argument("--refit-cache-dir", default=None,
+                      help="persist fetched refit weight versions here "
+                           "(newest 3 kept; reloaded on restart)")
     join.add_argument(
         "--advertise-addr", default=None,
         help="externally reachable host/IP peers dial for pp-forwards",

@@ -328,13 +328,16 @@ def normalize_config(raw: dict, model_name: str = "") -> ModelConfig:
     hidden_size = int(_get(cfg, "hidden_size", "n_embd", "d_model"))
     num_layers = int(_get(cfg, "num_hidden_layers", "n_layer", "num_layers"))
     num_heads = int(_get(cfg, "num_attention_heads", "n_head"))
-    num_kv = int(_get(cfg, "num_key_value_heads", default=num_heads))
+    # Step-3.5 names its KV-head count "num_attention_groups".
+    num_kv = int(_get(cfg, "num_key_value_heads", "num_attention_groups",
+                      default=num_heads))
     head_dim = int(_get(cfg, "head_dim", default=hidden_size // num_heads))
     vocab = int(_get(cfg, "vocab_size", default=32000))
     inter = int(_get(cfg, "intermediate_size", "n_inner", default=4 * hidden_size))
 
     moe = None
-    n_experts = _get(cfg, "num_experts", "n_routed_experts", "num_local_experts")
+    n_experts = _get(cfg, "num_experts", "n_routed_experts",
+                     "num_local_experts", "moe_num_experts")
     if n_experts:
         # Resolve the per-layer MoE mask under the source convention:
         # Qwen: MoE iff (idx+1) % decoder_sparse_step == 0 and idx not in
@@ -367,7 +370,8 @@ def normalize_config(raw: dict, model_name: str = "") -> ModelConfig:
         moe = MoEConfig(
             layer_mask=mask,
             num_experts=int(n_experts),
-            num_experts_per_tok=int(_get(cfg, "num_experts_per_tok", "top_k", default=2)),
+            num_experts_per_tok=int(_get(cfg, "num_experts_per_tok", "top_k",
+                                         "moe_top_k", default=2)),
             moe_intermediate_size=int(_get(cfg, "moe_intermediate_size", default=inter)),
             num_shared_experts=int(_get(cfg, "n_shared_experts", "num_shared_experts", default=0) or 0),
             shared_expert_intermediate_size=int(

@@ -16,7 +16,9 @@ from parallax_tpu.models import qwen3_moe  # noqa: F401  (registers MoE archs)
 from parallax_tpu.models import deepseek_v3  # noqa: F401  (registers MLA archs)
 from parallax_tpu.models import deepseek_v32  # noqa: F401  (registers DSA archs)
 from parallax_tpu.models import glm4  # noqa: F401
+from parallax_tpu.models import minimax_m2  # noqa: F401
 from parallax_tpu.models import minimax_m3  # noqa: F401  (registers MSA archs)
+from parallax_tpu.models import step3p5  # noqa: F401
 from parallax_tpu.models import gpt_oss  # noqa: F401
 from parallax_tpu.models import qwen3_next  # noqa: F401
 

@@ -1,10 +1,13 @@
-"""GLM-4 dense family stage model.
+"""GLM-4 family stage models: dense and MoE (GLM-4.5/4.6 class).
 
 Capability parity: reference ``src/parallax/models/glm4_moe.py`` (partial
-RoPE + GLM block conventions). GLM-4 specifics vs the llama family:
-GPT-J-interleaved partial rotary, a fused ``gate_up_proj`` MLP, and
-sandwich norms (``post_self_attn_layernorm`` / ``post_mlp_layernorm``
-applied to the sublayer outputs before the residual add).
+RoPE + GLM block conventions + DeepSeek-style routed MoE). GLM-4 specifics
+vs the llama family: GPT-J-interleaved partial rotary, a fused
+``gate_up_proj`` MLP in the dense models, and sandwich norms
+(``post_self_attn_layernorm`` / ``post_mlp_layernorm`` applied to the
+sublayer outputs before the residual add). The MoE variant routes with
+sigmoid scores + e_score_correction_bias and group selection, which
+``models/moe.route_topk`` already implements for DeepSeek-V3.
 """
 
 from __future__ import annotations
@@ -14,12 +17,15 @@ import jax.numpy as jnp
 
 from parallax_tpu.models import layers as L
 from parallax_tpu.models.base import BatchInputs, StageModel
+from parallax_tpu.models.qwen3_moe import MoEStageModel
 from parallax_tpu.models.registry import register_model
 from parallax_tpu.ops.rope import apply_rope_interleaved
 
 
-@register_model("Glm4ForCausalLM", "GlmForCausalLM")
-class Glm4StageModel(StageModel):
+class _Glm4Conventions:
+    """Shared GLM-4 block behavior: interleaved partial rope, fused
+    gate_up split, optional sandwich norms."""
+
     rope_fn = staticmethod(apply_rope_interleaved)
 
     def finalize_params(self, tree: dict) -> dict:
@@ -33,7 +39,7 @@ class Glm4StageModel(StageModel):
                 half = w.shape[0] // 2
                 mlp["gate_proj"] = {"weight": w[:half]}
                 mlp["up_proj"] = {"weight": w[half:]}
-        return tree
+        return super().finalize_params(tree)
 
     def _decoder_layer(self, lp, x, kv, inputs: BatchInputs, window):
         cfg = self.config
@@ -54,6 +60,9 @@ class Glm4StageModel(StageModel):
             )
         return x + mlp_out, kv
 
+
+@register_model("Glm4ForCausalLM", "GlmForCausalLM")
+class Glm4StageModel(_Glm4Conventions, StageModel):
     def init_params(self, rng: jax.Array, dtype=jnp.bfloat16) -> dict:
         # Base init already produces split gate/up/down; GLM only adds the
         # sandwich norms.
@@ -66,4 +75,60 @@ class Glm4StageModel(StageModel):
             layer["post_mlp_layernorm"] = {
                 "weight": jnp.ones((cfg.hidden_size,), dtype)
             }
+        return params
+
+
+@register_model("Glm4MoeForCausalLM", "Glm4MoeLiteForCausalLM")
+class Glm4MoeStageModel(_Glm4Conventions, MoEStageModel):
+    """GLM-4 MoE (reference glm4_moe.py:1-176): GLM attention/rope
+    conventions with the DeepSeek-style routed-expert FFN; per-head qk
+    norms when ``use_qk_norm`` is set. Weight names follow HF
+    ``Glm4MoeForCausalLM`` (mlp.gate.{weight,e_score_correction_bias},
+    mlp.experts.N.*, mlp.shared_experts.*)."""
+
+    def finalize_params(self, tree: dict) -> dict:
+        for layer in tree.get("layers", []):
+            mlp = layer.get("mlp")
+            if isinstance(mlp, dict) and "shared_experts" in mlp:
+                mlp["shared_expert"] = mlp.pop("shared_experts")
+        return super().finalize_params(tree)
+
+    def init_params(self, rng: jax.Array, dtype=jnp.bfloat16) -> dict:
+        params = super().init_params(rng, dtype)
+        cfg = self.config
+        moe = cfg.moe
+        for li in range(self.num_local_layers):
+            gi = self.start_layer + li
+            layer = params["layers"][li]
+            if cfg.use_qk_norm:
+                layer["self_attn"]["q_norm"] = {
+                    "weight": jnp.ones((cfg.head_dim,), dtype)
+                }
+                layer["self_attn"]["k_norm"] = {
+                    "weight": jnp.ones((cfg.head_dim,), dtype)
+                }
+            if not cfg.is_moe_layer(gi):
+                continue
+            mlp = layer["mlp"]
+            mlp["gate"].setdefault(
+                "e_score_correction_bias",
+                jnp.zeros((moe.num_experts,), jnp.float32),
+            )
+            if moe.num_shared_experts and "shared_expert" not in mlp:
+                ks = jax.random.split(jax.random.fold_in(rng, 17000 + gi), 3)
+                si = (moe.shared_expert_intermediate_size
+                      or moe.moe_intermediate_size) * moe.num_shared_experts
+                h = cfg.hidden_size
+
+                def dense(key, out_dim, in_dim):
+                    return {"weight": (
+                        jax.random.normal(key, (out_dim, in_dim), jnp.float32)
+                        * (in_dim**-0.5)
+                    ).astype(dtype)}
+
+                mlp["shared_expert"] = {
+                    "gate_proj": dense(ks[0], si, h),
+                    "up_proj": dense(ks[1], si, h),
+                    "down_proj": dense(ks[2], h, si),
+                }
         return params

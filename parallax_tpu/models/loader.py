@@ -114,6 +114,7 @@ def _quant_settings_for(
 def load_stage_params(
     model: StageModel, model_path: str, dtype=jnp.bfloat16,
     quantize: str | None = None,
+    lora_path: str | None = None,
 ) -> dict:
     """Load this stage's weights from a local HF checkpoint directory.
 
@@ -202,6 +203,9 @@ def load_stage_params(
         "loaded %d tensors (%d quantized) for layers [%d, %d) from %s",
         n_loaded, n_quant, model.start_layer, model.end_layer, model_path,
     )
+    if lora_path:
+        # Pre-finalize: fused/per-expert HF module names still exist here.
+        apply_lora_adapter(model, tree, lora_path, dtype)
     tree = model.finalize_params(tree)
     if quantize:
         from parallax_tpu.ops.quant import quantize_tree
@@ -233,3 +237,111 @@ def params_from_torch_state_dict(
     layer_map = tree.get("layers", {})
     tree["layers"] = [layer_map[str(i)] for i in range(model.num_local_layers)]
     return model.finalize_params(tree)
+
+
+def apply_lora_adapter(
+    model: StageModel, params: dict, adapter_path: str, dtype=jnp.bfloat16
+) -> int:
+    """Merge a PEFT-format LoRA adapter into this stage's weights.
+
+    Reference: ``shard_loader.py:114-227`` (linear_to_lora_layers /
+    load_lora) keeps live adapter modules; for TPU inference the adapters
+    are merged at load — ``W' = W + (alpha / r) * B @ A`` — which is
+    mathematically identical for frozen adapters and keeps the jitted
+    stage function unchanged. Returns the number of merged modules.
+    DoRA adapters (per-column magnitude renormalization) are rejected —
+    merging them as plain LoRA would be silently wrong.
+
+    Call on the PRE-finalize tree (``load_stage_params(lora_path=...)``
+    does) so adapters targeting fused (``gate_up_proj``) or per-expert
+    modules still find their weights.
+
+    Adapter layout: ``adapter_config.json`` (r, lora_alpha, optional
+    use_rslora) + ``adapter_model.safetensors`` with keys
+    ``base_model.model.model.layers.N.<module>.lora_{A,B}.weight``.
+    """
+    from safetensors import safe_open
+
+    cfg_path = os.path.join(adapter_path, "adapter_config.json")
+    with open(cfg_path, encoding="utf-8") as f:
+        acfg = json.load(f)
+    r = int(acfg.get("r", 8))
+    alpha = float(acfg.get("lora_alpha", r))
+    if acfg.get("use_rslora"):
+        scale = alpha / (r ** 0.5)
+    else:
+        scale = alpha / r
+
+    weight_file = None
+    for name in ("adapter_model.safetensors", "adapter.safetensors"):
+        p = os.path.join(adapter_path, name)
+        if os.path.exists(p):
+            weight_file = p
+            break
+    if weight_file is None:
+        raise FileNotFoundError(f"no adapter safetensors under {adapter_path}")
+
+    cfg = model.config
+    pairs: dict[str, dict[str, np.ndarray]] = {}
+    with safe_open(weight_file, framework="numpy") as f:
+        for key in f.keys():
+            k = key
+            for prefix in ("base_model.model.", "base_model."):
+                if k.startswith(prefix):
+                    k = k[len(prefix):]
+                    break
+            if "lora_magnitude" in k:
+                raise ValueError(
+                    "DoRA adapters (lora_magnitude_vector) are not "
+                    "supported; merging without the magnitude "
+                    "renormalization would corrupt the weights"
+                )
+            if ".lora_A." in k:
+                mod, part = k.split(".lora_A."), "A"
+            elif ".lora_B." in k:
+                mod, part = k.split(".lora_B."), "B"
+            else:
+                continue
+            local = shard_key_filter(
+                mod[0] + ".weight", model.start_layer, model.end_layer,
+                cfg.num_hidden_layers,
+            )
+            if local is None:
+                continue
+            pairs.setdefault(local[: -len(".weight")], {})[part] = (
+                f.get_tensor(key)
+            )
+
+    merged = 0
+    for module, ab in pairs.items():
+        if "A" not in ab or "B" not in ab:
+            logger.warning("lora adapter incomplete for %s; skipped", module)
+            continue
+        node = params
+        parts = module.split(".")
+        try:
+            for part in parts:
+                node = node[int(part)] if part.isdigit() else node[part]
+        except (KeyError, IndexError, TypeError):
+            logger.warning("lora target %s not in stage params; skipped",
+                           module)
+            continue
+        if "weight" not in node:
+            raise ValueError(
+                f"cannot merge LoRA into quantized module {module}; load "
+                "the checkpoint in full precision (or quantize AFTER "
+                "merging with --quantization)"
+            )
+        a = np.asarray(ab["A"], np.float32)   # [r, in]
+        b = np.asarray(ab["B"], np.float32)   # [out, r]
+        delta = scale * (b @ a)
+        w = np.asarray(node["weight"], np.float32)
+        if w.shape != delta.shape:
+            raise ValueError(
+                f"LoRA shape mismatch for {module}: {w.shape} vs "
+                f"{delta.shape}"
+            )
+        node["weight"] = jnp.asarray(w + delta).astype(dtype)
+        merged += 1
+    logger.info("merged %d LoRA modules from %s", merged, adapter_path)
+    return merged

@@ -1,0 +1,83 @@
+"""MiniMax-M2 stage model: dense GQA attention + routed MoE.
+
+Capability parity: reference ``src/parallax/models/minimax.py`` (the M2
+wrapper over mlx-lm's minimax model). M2 quirks vs the llama family: the
+qk norms apply over the FULL projection output (all heads concatenated,
+reference minimax.py:55-58 — norm before the head reshape), partial
+rotary, sigmoid routing with a correction bias and routed scaling, and
+the MoE living under ``block_sparse_moe`` in checkpoints.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from parallax_tpu.models import layers as L
+from parallax_tpu.models.base import BatchInputs
+from parallax_tpu.models.qwen3_moe import MoEStageModel
+from parallax_tpu.models.registry import register_model
+from parallax_tpu.ops.attention import ragged_paged_attention
+from parallax_tpu.ops.kv_cache_ops import reshape_and_cache
+
+
+@register_model("MiniMaxM2ForCausalLM", "MiniMaxForCausalLM")
+class MiniMaxM2StageModel(MoEStageModel):
+    def _attention(self, lp, h, kv, inputs: BatchInputs, window):
+        cfg = self.config
+        p = lp["self_attn"]
+        t = h.shape[0]
+        d = cfg.head_dim
+
+        q = L.linear(h, p["q_proj"])
+        k = L.linear(h, p["k_proj"])
+        v = L.linear(h, p["v_proj"])
+        # M2: qk norm over the full concatenated projection, not per head.
+        if cfg.use_qk_norm and "q_norm" in p:
+            q = L.rms_norm(q, p["q_norm"]["weight"], cfg.rms_norm_eps)
+            k = L.rms_norm(k, p["k_norm"]["weight"], cfg.rms_norm_eps)
+        q = q.reshape(t, -1, d)
+        k = k.reshape(t, -1, d)
+        v = v.reshape(t, -1, d)
+        hq = q.shape[1]
+
+        q = self.rope_fn(q, inputs.positions, self.cos_table, self.sin_table)
+        k = self.rope_fn(k, inputs.positions, self.cos_table, self.sin_table)
+        kv = reshape_and_cache(kv, k, v, inputs.slot_mapping)
+        out = ragged_paged_attention(
+            q, kv, inputs.kv_lens, inputs.page_indices, inputs.cu_q_lens,
+            inputs.num_seqs, sm_scale=d**-0.5, sliding_window=window,
+            use_pallas=self.use_pallas, decode_only=inputs.decode_only,
+        )
+        return (
+            L.row_parallel_linear(out.reshape(t, hq * d), p["o_proj"],
+                                  self.axis_name),
+            kv,
+        )
+
+    def finalize_params(self, tree: dict) -> dict:
+        for layer in tree.get("layers", []):
+            moe = layer.pop("block_sparse_moe", None)
+            if moe is not None:
+                if "shared_experts" in moe:
+                    moe["shared_expert"] = moe.pop("shared_experts")
+                if "e_score_correction_bias" in moe and isinstance(
+                    moe.get("gate"), dict
+                ):
+                    moe["gate"]["e_score_correction_bias"] = moe.pop(
+                        "e_score_correction_bias"
+                    )
+                layer["mlp"] = moe
+        return super().finalize_params(tree)
+
+    def init_params(self, rng: jax.Array, dtype=jnp.bfloat16) -> dict:
+        params = super().init_params(rng, dtype)
+        cfg = self.config
+        if cfg.use_qk_norm:
+            for layer in params["layers"]:
+                attn = layer["self_attn"]
+                attn["q_norm"] = {"weight": jnp.ones(
+                    (cfg.num_attention_heads * cfg.head_dim,), dtype)}
+                attn["k_norm"] = {"weight": jnp.ones(
+                    (cfg.num_key_value_heads * cfg.head_dim,), dtype)}
+        return params

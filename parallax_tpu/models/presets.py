@@ -72,8 +72,165 @@ PRESETS: dict[str, dict] = {
 }
 
 
+# Curated model DB: HF repo name -> preset key + serving notes. The
+# scheduler uses this for ModelInfo/roofline estimates when a node joins by
+# model NAME rather than a local checkpoint directory (reference
+# ``src/backend/server/static_config.py:11-107`` maps ~90 GPU names to MLX
+# checkpoints; the TPU build maps to architecture presets — actual serving
+# always reads the checkpoint's own config.json).
+MODEL_DB: dict[str, dict] = {
+    # Qwen dense
+    "Qwen/Qwen2.5-0.5B-Instruct": dict(preset="qwen2.5-0.5b"),
+    "Qwen/Qwen2.5-7B-Instruct": dict(preset="qwen2.5-7b"),
+    "Qwen/Qwen3-0.6B": dict(
+        architectures=["Qwen3ForCausalLM"], hidden_size=1024,
+        num_hidden_layers=28, num_attention_heads=16, num_key_value_heads=8,
+        head_dim=128, intermediate_size=3072, vocab_size=151936,
+        max_position_embeddings=40960, rope_theta=1000000.0,
+        tie_word_embeddings=True,
+    ),
+    "Qwen/Qwen3-8B": dict(preset="qwen3-8b"),
+    "Qwen/Qwen3-32B": dict(
+        architectures=["Qwen3ForCausalLM"], hidden_size=5120,
+        num_hidden_layers=64, num_attention_heads=64, num_key_value_heads=8,
+        head_dim=128, intermediate_size=25600, vocab_size=151936,
+        max_position_embeddings=40960, rope_theta=1000000.0,
+    ),
+    # Qwen MoE
+    "Qwen/Qwen3-30B-A3B": dict(
+        architectures=["Qwen3MoeForCausalLM"], hidden_size=2048,
+        num_hidden_layers=48, num_attention_heads=32, num_key_value_heads=4,
+        head_dim=128, intermediate_size=6144, moe_intermediate_size=768,
+        num_experts=128, num_experts_per_tok=8, vocab_size=151936,
+        max_position_embeddings=40960, rope_theta=1000000.0,
+    ),
+    "Qwen/Qwen3-235B-A22B": dict(
+        architectures=["Qwen3MoeForCausalLM"], hidden_size=4096,
+        num_hidden_layers=94, num_attention_heads=64, num_key_value_heads=4,
+        head_dim=128, intermediate_size=12288, moe_intermediate_size=1536,
+        num_experts=128, num_experts_per_tok=8, vocab_size=151936,
+        max_position_embeddings=40960, rope_theta=1000000.0,
+    ),
+    "Qwen/Qwen3-Next-80B-A3B-Instruct": dict(
+        architectures=["Qwen3NextForCausalLM"], hidden_size=2048,
+        num_hidden_layers=48, num_attention_heads=16, num_key_value_heads=2,
+        head_dim=256, intermediate_size=5120, moe_intermediate_size=512,
+        num_experts=512, num_experts_per_tok=10, shared_expert_intermediate_size=512,
+        n_shared_experts=1, linear_conv_kernel_dim=4, linear_num_key_heads=16,
+        linear_num_value_heads=32, linear_key_head_dim=128,
+        linear_value_head_dim=128, vocab_size=151936,
+        max_position_embeddings=262144, rope_theta=10000000.0,
+    ),
+    # Llama
+    "meta-llama/Meta-Llama-3-8B-Instruct": dict(preset="llama-3-8b"),
+    "meta-llama/Llama-3.3-70B-Instruct": dict(
+        architectures=["LlamaForCausalLM"], hidden_size=8192,
+        num_hidden_layers=80, num_attention_heads=64, num_key_value_heads=8,
+        intermediate_size=28672, vocab_size=128256,
+        max_position_embeddings=131072, rope_theta=500000.0,
+    ),
+    # DeepSeek / Kimi (MLA)
+    "deepseek-ai/DeepSeek-V3": dict(
+        architectures=["DeepseekV3ForCausalLM"], hidden_size=7168,
+        num_hidden_layers=61, num_attention_heads=128,
+        num_key_value_heads=128, kv_lora_rank=512, q_lora_rank=1536,
+        qk_nope_head_dim=128, qk_rope_head_dim=64, v_head_dim=128,
+        intermediate_size=18432, moe_intermediate_size=2048,
+        n_routed_experts=256, num_experts_per_tok=8, n_shared_experts=1,
+        n_group=8, topk_group=4, scoring_func="sigmoid",
+        first_k_dense_replace=3, routed_scaling_factor=2.5,
+        vocab_size=129280, max_position_embeddings=163840,
+        rope_interleave=True,
+    ),
+    "deepseek-ai/DeepSeek-V3.2-Exp": dict(
+        architectures=["DeepseekV32ForCausalLM"], hidden_size=7168,
+        num_hidden_layers=61, num_attention_heads=128,
+        num_key_value_heads=128, kv_lora_rank=512, q_lora_rank=1536,
+        qk_nope_head_dim=128, qk_rope_head_dim=64, v_head_dim=128,
+        index_n_heads=64, index_head_dim=128, index_topk=2048,
+        intermediate_size=18432, moe_intermediate_size=2048,
+        n_routed_experts=256, num_experts_per_tok=8, n_shared_experts=1,
+        n_group=8, topk_group=4, scoring_func="sigmoid",
+        first_k_dense_replace=3, routed_scaling_factor=2.5,
+        vocab_size=129280, max_position_embeddings=163840,
+        rope_interleave=True,
+    ),
+    "moonshotai/Kimi-K2-Instruct": dict(
+        architectures=["DeepseekV3ForCausalLM"], hidden_size=7168,
+        num_hidden_layers=61, num_attention_heads=64,
+        num_key_value_heads=64, kv_lora_rank=512, q_lora_rank=1536,
+        qk_nope_head_dim=128, qk_rope_head_dim=64, v_head_dim=128,
+        intermediate_size=18432, moe_intermediate_size=2048,
+        n_routed_experts=384, num_experts_per_tok=8, n_shared_experts=1,
+        n_group=1, topk_group=1, scoring_func="sigmoid",
+        first_k_dense_replace=1, routed_scaling_factor=2.827,
+        vocab_size=163840, max_position_embeddings=131072,
+        rope_interleave=True,
+    ),
+    # gpt-oss (sinks + alternating windows)
+    "openai/gpt-oss-20b": dict(
+        architectures=["GptOssForCausalLM"], hidden_size=2880,
+        num_hidden_layers=24, num_attention_heads=64, num_key_value_heads=8,
+        head_dim=64, intermediate_size=2880, moe_intermediate_size=2880,
+        num_local_experts=32, num_experts_per_tok=4, sliding_window=128,
+        layer_types=["sliding_attention", "full_attention"] * 12,
+        vocab_size=201088, max_position_embeddings=131072,
+    ),
+    "openai/gpt-oss-120b": dict(
+        architectures=["GptOssForCausalLM"], hidden_size=2880,
+        num_hidden_layers=36, num_attention_heads=64, num_key_value_heads=8,
+        head_dim=64, intermediate_size=2880, moe_intermediate_size=2880,
+        num_local_experts=128, num_experts_per_tok=4, sliding_window=128,
+        layer_types=["sliding_attention", "full_attention"] * 18,
+        vocab_size=201088, max_position_embeddings=131072,
+    ),
+    # GLM
+    "zai-org/GLM-4-9B-0414": dict(
+        architectures=["Glm4ForCausalLM"], hidden_size=4096,
+        num_hidden_layers=40, num_attention_heads=32, num_key_value_heads=2,
+        intermediate_size=13696, partial_rotary_factor=0.5,
+        vocab_size=151552, max_position_embeddings=32768,
+        rope_theta=10000.0,
+    ),
+    "zai-org/GLM-4.5-Air": dict(
+        architectures=["Glm4MoeForCausalLM"], hidden_size=4096,
+        num_hidden_layers=46, num_attention_heads=96, num_key_value_heads=8,
+        head_dim=128, intermediate_size=10944, moe_intermediate_size=1408,
+        n_routed_experts=128, num_experts_per_tok=8, n_shared_experts=1,
+        n_group=1, topk_group=1, scoring_func="sigmoid", norm_topk_prob=True,
+        first_k_dense_replace=1, routed_scaling_factor=1.0,
+        partial_rotary_factor=0.5, use_qk_norm=True,
+        vocab_size=151552, max_position_embeddings=131072,
+    ),
+    # MiniMax
+    "MiniMaxAI/MiniMax-M2": dict(
+        architectures=["MiniMaxM2ForCausalLM"], hidden_size=3072,
+        num_hidden_layers=62, num_attention_heads=48, num_key_value_heads=8,
+        head_dim=128, intermediate_size=1536, num_local_experts=256,
+        num_experts_per_tok=8, scoring_func="sigmoid",
+        use_qk_norm=True, rotary_dim=64, partial_rotary_factor=0.5,
+        vocab_size=200064, max_position_embeddings=196608,
+    ),
+}
+
+
 def get_preset(name: str) -> ModelConfig:
     key = name.lower()
-    if key not in PRESETS:
-        raise KeyError(f"unknown preset {name!r}; have {sorted(PRESETS)}")
-    return normalize_config(dict(PRESETS[key]), model_name=key)
+    if key in PRESETS:
+        return normalize_config(dict(PRESETS[key]), model_name=key)
+    # HF repo names resolve through the curated DB (case-sensitive first,
+    # then case-insensitive).
+    entry = MODEL_DB.get(name)
+    if entry is None:
+        lowered = {k.lower(): v for k, v in MODEL_DB.items()}
+        entry = lowered.get(key)
+    if entry is not None:
+        entry = dict(entry)
+        alias = entry.pop("preset", None)
+        if alias:
+            return normalize_config(dict(PRESETS[alias]), model_name=name)
+        return normalize_config(entry, model_name=name)
+    raise KeyError(
+        f"unknown preset {name!r}; have {sorted(PRESETS)} + "
+        f"{len(MODEL_DB)} DB models"
+    )

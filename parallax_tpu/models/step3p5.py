@@ -1,0 +1,97 @@
+"""Step-3.5-Flash stage model.
+
+Capability parity: reference ``src/parallax/models/step3p5.py:1-208``.
+Step-3.5 quirks vs the llama family: KV heads come from
+``num_attention_groups`` (normalized into ``num_key_value_heads`` by
+``config.normalize_config``), per-head qk norms, alternating sliding
+windows (``is_sliding`` layers), an optional head-wise attention gate
+(``output * sigmoid(g_proj(x))`` per head, reference step3p5.py:133-135),
+and a MoE whose shared expert is named ``share_expert`` in checkpoints.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from parallax_tpu.models import layers as L
+from parallax_tpu.models.base import BatchInputs, StageModel
+from parallax_tpu.models.qwen3_moe import MoEStageModel
+from parallax_tpu.models.registry import register_model
+from parallax_tpu.ops.attention import ragged_paged_attention
+from parallax_tpu.ops.kv_cache_ops import reshape_and_cache
+
+
+@register_model("Step3p5ForCausalLM")
+class Step3p5StageModel(MoEStageModel):
+    def __init__(self, *args, **kwargs):
+        # Step-3.5 ships dense-only small variants too: tolerate no MoE.
+        try:
+            super().__init__(*args, **kwargs)
+        except ValueError:
+            StageModel.__init__(self, *args, **kwargs)
+
+    def _mlp(self, lp: dict, h: jax.Array) -> jax.Array:
+        if self.config.moe is None or "experts" not in lp["mlp"]:
+            return L.swiglu_mlp(h, lp["mlp"], axis_name=self.axis_name)
+        return super()._mlp(lp, h)
+
+    def _attention(self, lp, h, kv, inputs: BatchInputs, window):
+        cfg = self.config
+        p = lp["self_attn"]
+        t = h.shape[0]
+        d = cfg.head_dim
+
+        q = L.linear(h, p["q_proj"]).reshape(t, -1, d)
+        k = L.linear(h, p["k_proj"]).reshape(t, -1, d)
+        v = L.linear(h, p["v_proj"]).reshape(t, -1, d)
+        hq = q.shape[1]
+        if "q_norm" in p:
+            q = L.rms_norm(q, p["q_norm"]["weight"], cfg.rms_norm_eps)
+            k = L.rms_norm(k, p["k_norm"]["weight"], cfg.rms_norm_eps)
+        q = self.rope_fn(q, inputs.positions, self.cos_table, self.sin_table)
+        k = self.rope_fn(k, inputs.positions, self.cos_table, self.sin_table)
+        kv = reshape_and_cache(kv, k, v, inputs.slot_mapping)
+        out = ragged_paged_attention(
+            q, kv, inputs.kv_lens, inputs.page_indices, inputs.cu_q_lens,
+            inputs.num_seqs, sm_scale=d**-0.5, sliding_window=window,
+            use_pallas=self.use_pallas, decode_only=inputs.decode_only,
+        )
+        if "g_proj" in p:
+            # Head-wise attention gate (reference step3p5.py:133-135).
+            gate = jax.nn.sigmoid(
+                L.linear(h, p["g_proj"]).astype(jnp.float32)
+            )  # [T, Hq]
+            out = (out.astype(jnp.float32) * gate[:, :, None]).astype(
+                out.dtype
+            )
+        return (
+            L.row_parallel_linear(out.reshape(t, hq * d), p["o_proj"],
+                                  self.axis_name),
+            kv,
+        )
+
+    def finalize_params(self, tree: dict) -> dict:
+        for layer in tree.get("layers", []):
+            mlp = layer.get("mlp")
+            if isinstance(mlp, dict) and "share_expert" in mlp:
+                mlp["shared_expert"] = mlp.pop("share_expert")
+        return super().finalize_params(tree)
+
+    def init_params(self, rng: jax.Array, dtype=jnp.bfloat16) -> dict:
+        params = (super().init_params(rng, dtype)
+                  if self.config.moe is not None
+                  else StageModel.init_params(self, rng, dtype))
+        cfg = self.config
+        for li, layer in enumerate(params["layers"]):
+            attn = layer["self_attn"]
+            attn["q_norm"] = {"weight": jnp.ones((cfg.head_dim,), dtype)}
+            attn["k_norm"] = {"weight": jnp.ones((cfg.head_dim,), dtype)}
+            key = jax.random.fold_in(rng, 19000 + li)
+            attn["g_proj"] = {"weight": (
+                jax.random.normal(
+                    key, (cfg.num_attention_heads, cfg.hidden_size),
+                    jnp.float32,
+                ) * cfg.hidden_size**-0.5
+            ).astype(dtype)}
+        return params

@@ -55,6 +55,26 @@ def join_main(args) -> int:
     else:
         raise SystemExit("--model-path is required (checkpoint directory)")
 
+    def resolve_model(name: str):
+        """Live model switch (/scheduler/init): a directory this worker can
+        read loads real weights; a known preset serves random weights
+        (synthetic/benchmark swarms); anything else refuses the switch."""
+        import os
+
+        if os.path.isdir(name):
+            return load_config(name), (
+                lambda model: load_stage_params(model, name)
+            )
+        from parallax_tpu.models.presets import get_preset
+
+        try:
+            return get_preset(name), None
+        except KeyError:
+            raise RuntimeError(
+                f"model {name!r} is neither a local checkpoint nor a "
+                "known preset on this worker"
+            )
+
     n_devices = len(jax.local_devices())
     mesh = make_mesh(tp_size=n_devices) if n_devices > 1 else None
 
@@ -66,6 +86,8 @@ def join_main(args) -> int:
         load_params=load_params,
         mesh=mesh,
         tp_size=n_devices if n_devices > 1 else 1,
+        refit_cache_dir=getattr(args, "refit_cache_dir", None),
+        resolve_model=resolve_model,
     )
     node.start()
     logger.info("worker %s joined %s", node.node_id, scheduler_peer)

@@ -52,6 +52,8 @@ class WorkerNode:
         heartbeat_interval_s: float = 2.0,
         mesh=None,
         tp_size: int = 1,
+        refit_cache_dir: str | None = None,
+        resolve_model=None,  # callable (name) -> (ModelConfig, load_params|None)
     ):
         self.transport = transport
         self.scheduler_peer = scheduler_peer
@@ -61,6 +63,13 @@ class WorkerNode:
         self.heartbeat_interval_s = heartbeat_interval_s
         self.mesh = mesh
         self.tp_size = tp_size
+        self.resolve_model = resolve_model
+        self._served_model_name: str | None = None
+        self.refit_store = None
+        if refit_cache_dir:
+            from parallax_tpu.p2p.refit import RefitVersionStore
+
+            self.refit_store = RefitVersionStore(refit_cache_dir)
 
         self.node_id = transport.peer_id
         self.engine: StageEngine | None = None
@@ -135,8 +144,11 @@ class WorkerNode:
     def _apply_allocation(self, alloc: dict) -> None:
         if "start_layer" not in alloc:
             return
+        model_switched = self._maybe_switch_model(alloc.get("model_name"))
         start, end = alloc["start_layer"], alloc["end_layer"]
-        if (start, end) == (self.start_layer, self.end_layer):
+        if not model_switched and (start, end) == (
+            self.start_layer, self.end_layer
+        ):
             return
         logger.info(
             "%s: (re)loading layers [%d, %d)", self.node_id, start, end
@@ -149,7 +161,60 @@ class WorkerNode:
         self.engine = StageEngine(
             model, params, self.engine_config, mesh=self.mesh
         )
+        self._restore_refit_cache()
         self._allocated.set()
+
+    def _maybe_switch_model(self, model_name: str | None) -> bool:
+        """Live model switch (/scheduler/init): the allocation names a
+        different model than previous allocations — re-resolve config +
+        weights via ``resolve_model`` or refuse the allocation (the worker
+        cannot serve weights it does not have). The FIRST allocation's name
+        is recorded, not compared: scheduler and worker may spell the same
+        model differently (preset key vs checkpoint _name_or_path)."""
+        if not model_name:
+            return False
+        if self._served_model_name is None or (
+            model_name == self._served_model_name
+        ):
+            self._served_model_name = model_name
+            return False
+        self._served_model_name = model_name
+        if self.resolve_model is None:
+            raise RuntimeError(
+                f"scheduler switched to {model_name!r} but this worker has "
+                f"only {self.model_config.model_name!r} locally (no "
+                "resolver); restart the worker with the new --model-path"
+            )
+        config, load_params = self.resolve_model(model_name)
+        logger.warning("%s: switching model %s -> %s", self.node_id,
+                       self.model_config.model_name, model_name)
+        self.model_config = config
+        if load_params is not None:
+            self.load_params = load_params
+        else:
+            self.load_params = self._random_params
+        return True
+
+    def _restore_refit_cache(self) -> None:
+        """Reload the newest cached refit version after a (re)start so a
+        crashed worker resumes serving pushed weights (the reference keeps
+        3 disk versions for the same reason, p2p/server.py:434-446)."""
+        if self.refit_store is None or self.engine is None:
+            return
+        versions = self.refit_store.versions()
+        if not versions:
+            return
+        version = versions[-1]
+        if version <= self.refit_version:
+            return
+        try:
+            from parallax_tpu.p2p.refit import apply_prefetched
+
+            tensors = self.refit_store.load(version)
+            apply_prefetched(self.engine, tensors, version)
+            self.refit_version = version
+        except Exception:
+            logger.exception("refit cache restore v%d failed", version)
 
     def _random_params(self, model: StageModel):
         dtype = (
@@ -373,6 +438,13 @@ class WorkerNode:
 
         try:
             tensors = fetch_refit_tensors(self.engine, index)
+            if self.refit_store is not None:
+                # Persist + GC to the newest 3 versions (reference
+                # check_and_release_disk_weight, p2p/server.py:434-446).
+                try:
+                    self.refit_store.save(version, tensors)
+                except Exception:
+                    logger.exception("refit v%d disk cache failed", version)
             self._inbox.put(("refit_apply", version, tensors))
         except Exception:
             logger.exception("refit v%d fetch failed", version)

@@ -192,3 +192,61 @@ def build_index_map(
         for name in f.keys():
             index[name] = {"uri": uri, "sha256": sha}
     return index
+
+
+class RefitVersionStore:
+    """On-disk cache of fetched refit versions with bounded history.
+
+    Reference ``check_and_release_disk_weight`` (p2p/server.py:434-446)
+    keeps 3 weight versions on disk and garbage-collects older ones — the
+    cache lets a restarting worker reload the newest pushed weights without
+    refetching, without growing without bound.
+    """
+
+    def __init__(self, root: str, keep: int = 3):
+        self.root = root
+        self.keep = keep
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, version: int) -> str:
+        return os.path.join(self.root, f"v{version:08d}.safetensors")
+
+    def versions(self) -> list[int]:
+        out = []
+        for name in os.listdir(self.root):
+            if name.startswith("v") and name.endswith(".safetensors"):
+                try:
+                    out.append(int(name[1:-len(".safetensors")]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def save(self, version: int, tensors: dict) -> str:
+        """Persist one version's stage tensors, then GC old versions."""
+        import numpy as np
+        from safetensors.numpy import save_file
+
+        path = self._path(version)
+        save_file({k: np.asarray(v) for k, v in tensors.items()}, path)
+        self.gc()
+        return path
+
+    def load(self, version: int) -> dict:
+        from safetensors.numpy import load_file
+
+        return {k: jnp.asarray(v)
+                for k, v in load_file(self._path(version)).items()}
+
+    def gc(self) -> list[int]:
+        """Drop everything but the newest ``keep`` versions."""
+        versions = self.versions()
+        removed = []
+        for v in versions[:-self.keep] if self.keep else versions:
+            try:
+                os.remove(self._path(v))
+                removed.append(v)
+            except OSError:
+                logger.exception("refit GC failed for v%d", v)
+        if removed:
+            logger.info("refit GC removed versions %s", removed)
+        return removed

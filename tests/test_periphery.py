@@ -1,0 +1,233 @@
+"""Periphery parity: /scheduler/init model switch, refit version GC, LoRA
+adapter merging, model DB resolution.
+
+Reference anchors: backend/main.py:99-155 (scheduler init),
+p2p/server.py:434-446 (3-version refit GC), shard_loader.py:114-227
+(LoRA), static_config.py:11-107 (model DB).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parallax_tpu.config import normalize_config
+from parallax_tpu.models.base import StageModel
+from parallax_tpu.models.presets import MODEL_DB, get_preset
+from parallax_tpu.p2p.refit import RefitVersionStore
+
+
+# ---------------------------------------------------------------------------
+# model DB
+# ---------------------------------------------------------------------------
+
+def test_model_db_entries_normalize():
+    for name in MODEL_DB:
+        cfg = get_preset(name)
+        assert cfg.num_hidden_layers > 0, name
+        assert cfg.vocab_size > 0, name
+
+
+def test_model_db_covers_reference_families():
+    archs = {get_preset(n).architecture for n in MODEL_DB}
+    for required in (
+        "Qwen2ForCausalLM", "Qwen3ForCausalLM", "Qwen3MoeForCausalLM",
+        "Qwen3NextForCausalLM", "LlamaForCausalLM",
+        "DeepseekV3ForCausalLM", "DeepseekV32ForCausalLM",
+        "GptOssForCausalLM", "Glm4ForCausalLM", "Glm4MoeForCausalLM",
+        "MiniMaxM2ForCausalLM",
+    ):
+        assert required in archs, required
+
+
+def test_preset_db_case_insensitive():
+    a = get_preset("Qwen/Qwen3-8B")
+    b = get_preset("qwen/qwen3-8b")
+    assert a.hidden_size == b.hidden_size
+
+
+# ---------------------------------------------------------------------------
+# refit version store
+# ---------------------------------------------------------------------------
+
+def test_refit_store_keeps_three_versions(tmp_path):
+    store = RefitVersionStore(str(tmp_path / "refit"), keep=3)
+    for v in range(1, 6):
+        store.save(v, {"layers.0.mlp.gate_proj.weight":
+                       np.full((2, 2), float(v), np.float32)})
+    assert store.versions() == [3, 4, 5]
+    loaded = store.load(5)
+    np.testing.assert_array_equal(
+        np.asarray(loaded["layers.0.mlp.gate_proj.weight"]),
+        np.full((2, 2), 5.0, np.float32),
+    )
+    with pytest.raises(FileNotFoundError):
+        store.load(1)
+
+
+# ---------------------------------------------------------------------------
+# LoRA merge
+# ---------------------------------------------------------------------------
+
+TINY = dict(
+    architectures=["Qwen2ForCausalLM"], hidden_size=32,
+    num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+    intermediate_size=32, vocab_size=64, max_position_embeddings=128,
+    tie_word_embeddings=False,
+)
+
+
+def _write_adapter(path, r=4, alpha=8.0, layers=(0, 1), hidden=32,
+                   out_dim=32):
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(0)
+    tensors = {}
+    expected = {}
+    for li in layers:
+        pre = f"base_model.model.model.layers.{li}.self_attn.q_proj"
+        a = rng.standard_normal((r, hidden)).astype(np.float32) * 0.1
+        b = rng.standard_normal((out_dim, r)).astype(np.float32) * 0.1
+        tensors[f"{pre}.lora_A.weight"] = a
+        tensors[f"{pre}.lora_B.weight"] = b
+        expected[li] = (alpha / r) * (b @ a)
+    path.mkdir(parents=True, exist_ok=True)
+    save_file(tensors, str(path / "adapter_model.safetensors"))
+    (path / "adapter_config.json").write_text(json.dumps(
+        {"r": r, "lora_alpha": alpha}
+    ))
+    return expected
+
+
+def test_lora_merge_applies_delta(tmp_path):
+    from parallax_tpu.models.loader import apply_lora_adapter
+
+    cfg = normalize_config(TINY)
+    model = StageModel(cfg, 0, 2, use_pallas=False)
+    params = model.init_params(jax.random.key(0), dtype=jnp.float32)
+    before = [np.asarray(params["layers"][i]["self_attn"]["q_proj"]["weight"])
+              for i in range(2)]
+    expected = _write_adapter(tmp_path / "adapter")
+    n = apply_lora_adapter(model, params, str(tmp_path / "adapter"),
+                           dtype=jnp.float32)
+    assert n == 2
+    for i in range(2):
+        after = np.asarray(params["layers"][i]["self_attn"]["q_proj"]["weight"])
+        np.testing.assert_allclose(after, before[i] + expected[i],
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_lora_merge_respects_stage_range(tmp_path):
+    from parallax_tpu.models.loader import apply_lora_adapter
+
+    cfg = normalize_config(TINY)
+    model = StageModel(cfg, 1, 2, use_pallas=False)   # only layer 1
+    params = model.init_params(jax.random.key(0), dtype=jnp.float32)
+    _write_adapter(tmp_path / "adapter")
+    n = apply_lora_adapter(model, params, str(tmp_path / "adapter"),
+                           dtype=jnp.float32)
+    assert n == 1  # layer 0's adapter filtered out
+
+
+def test_lora_rejects_quantized_target(tmp_path):
+    from parallax_tpu.models.loader import apply_lora_adapter
+    from parallax_tpu.ops.quant import quantize_tree
+
+    cfg = normalize_config(TINY)
+    model = StageModel(cfg, 0, 2, use_pallas=False)
+    params = quantize_tree(
+        model.init_params(jax.random.key(0), dtype=jnp.float32),
+        bits=8, group_size=16, dtype=jnp.float32,
+    )
+    _write_adapter(tmp_path / "adapter")
+    with pytest.raises(ValueError, match="quantized"):
+        apply_lora_adapter(model, params, str(tmp_path / "adapter"),
+                           dtype=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# /scheduler/init
+# ---------------------------------------------------------------------------
+
+def test_scheduler_init_endpoint_switches_model():
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from parallax_tpu.backend.http_server import OpenAIFrontend, SimpleTokenizer
+
+    calls = []
+
+    def init_fn(model_name, n):
+        if model_name == "bogus":
+            raise ValueError("unknown model bogus")
+        calls.append((model_name, n))
+        return {"num_layers": 4}
+
+    fe = OpenAIFrontend(
+        SimpleTokenizer(), submit_fn=lambda r: None,
+        model_name="old-model", scheduler_init_fn=init_fn,
+    )
+
+    async def fn(client):
+        resp = await client.post("/scheduler/init", json={
+            "model_name": "qwen2.5-0.5b", "init_nodes_num": 2})
+        body = await resp.json()
+        assert resp.status == 200, body
+        assert body["data"]["num_layers"] == 4
+        # missing params -> 400
+        r2 = await client.post("/scheduler/init", json={})
+        assert r2.status == 400
+        # unknown model -> 400
+        r3 = await client.post("/scheduler/init", json={
+            "model_name": "bogus", "init_nodes_num": 1})
+        assert r3.status == 400
+        # the served model name follows the switch
+        r4 = await client.get("/v1/models")
+        models = await r4.json()
+        assert models["data"][0]["id"] == "qwen2.5-0.5b"
+
+    async def go():
+        server = TestServer(fe.app)
+        client = TestClient(server)
+        await client.start_server()
+        try:
+            await fn(client)
+        finally:
+            await client.close()
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(go())
+    finally:
+        loop.close()
+    assert calls == [("qwen2.5-0.5b", 2)]
+
+
+def test_swarm_scheduler_swap_rebootstraps():
+    """make_scheduler_init_fn swaps a fresh GlobalScheduler into the
+    running service; control-plane calls follow the swap."""
+    from parallax_tpu.backend.run import make_scheduler_init_fn
+    from parallax_tpu.backend.scheduler_service import SchedulerService
+    from parallax_tpu.p2p.transport import LoopbackTransport
+    from parallax_tpu.scheduling.scheduler import GlobalScheduler
+
+    old_model = get_preset("qwen2.5-0.5b")
+    sched = GlobalScheduler(old_model, min_nodes_bootstrapping=1)
+    transport = LoopbackTransport("sched", {})
+    service = SchedulerService(sched, transport)
+    sched.start()
+    try:
+        init = make_scheduler_init_fn(
+            service, lambda name: get_preset(name)
+        )
+        info = init("qwen3-8b", 1)
+        assert info["num_layers"] == 36
+        assert service.scheduler is not sched
+        assert service.scheduler.model.num_hidden_layers == 36
+        with pytest.raises(ValueError):
+            init("not-a-model", 1)
+    finally:
+        service.scheduler.stop()

@@ -1,0 +1,103 @@
+"""Model-zoo tails: GLM-4-MoE, MiniMax-M2, Step-3.5.
+
+Capability parity: reference glm4_moe.py / minimax.py / step3p5.py model
+files (generation smoke + architecture-specific mechanics).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from parallax_tpu.config import normalize_config
+from parallax_tpu.models.registry import create_stage_model, get_model_class
+from parallax_tpu.runtime.engine import EngineConfig, StageEngine
+from parallax_tpu.runtime.pipeline import InProcessPipeline
+from parallax_tpu.runtime.request import Request, SamplingParams
+
+GLM4_MOE = dict(
+    architectures=["Glm4MoeForCausalLM"],
+    hidden_size=64, num_hidden_layers=3, num_attention_heads=4,
+    num_key_value_heads=2, head_dim=16, intermediate_size=128,
+    moe_intermediate_size=32, n_routed_experts=8, num_experts_per_tok=2,
+    n_shared_experts=1, n_group=2, topk_group=1, scoring_func="sigmoid",
+    norm_topk_prob=True, routed_scaling_factor=1.0, first_k_dense_replace=1,
+    partial_rotary_factor=0.5, use_qk_norm=True, vocab_size=199,
+    max_position_embeddings=512, tie_word_embeddings=False,
+)
+
+MINIMAX_M2 = dict(
+    architectures=["MiniMaxM2ForCausalLM"],
+    hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+    num_key_value_heads=2, head_dim=16, intermediate_size=64,
+    num_local_experts=4, num_experts_per_tok=2, scoring_func="sigmoid",
+    routed_scaling_factor=1.0, partial_rotary_factor=0.5, use_qk_norm=True,
+    rotary_dim=8, vocab_size=199, max_position_embeddings=512,
+    tie_word_embeddings=False,
+)
+
+STEP3P5 = dict(
+    architectures=["Step3p5ForCausalLM"],
+    hidden_size=64, num_hidden_layers=4, num_attention_heads=4,
+    num_attention_groups=2,   # Step-3.5's name for KV heads
+    head_dim=16, intermediate_size=64, moe_num_experts=4, moe_top_k=2,
+    sliding_window=16,
+    layer_types=["full_attention", "sliding_attention",
+                 "full_attention", "sliding_attention"],
+    vocab_size=199, max_position_embeddings=512, tie_word_embeddings=False,
+)
+
+
+def _generate(cfg_dict, bounds, prompt, max_new=5):
+    cfg = normalize_config(cfg_dict)
+    engines = []
+    for s, e in bounds:
+        m = create_stage_model(cfg, s, e, use_pallas=False)
+        engines.append(StageEngine(
+            m, m.init_params(jax.random.key(0), dtype=jnp.float32),
+            EngineConfig(page_size=8, num_pages=128, max_model_len=256,
+                         kv_dtype="float32"),
+        ))
+    pipe = InProcessPipeline(engines)
+    req = Request("r", prompt_ids=list(prompt),
+                  sampling_params=SamplingParams(temperature=0.0,
+                                                 max_new_tokens=max_new))
+    pipe.submit(req)
+    pipe.run_until_complete()
+    return req.output_ids
+
+
+def test_glm4_moe_registered_and_generates():
+    cfg = normalize_config(GLM4_MOE)
+    assert cfg.moe is not None and cfg.moe.num_experts == 8
+    assert not cfg.is_moe_layer(0) and cfg.is_moe_layer(1)
+    cls = get_model_class("Glm4MoeForCausalLM")
+    assert cls.__name__ == "Glm4MoeStageModel"
+    out = _generate(GLM4_MOE, [(0, 3)], [3, 14, 15, 92])
+    assert len(out) == 5
+
+
+def test_glm4_moe_pipeline_smoke():
+    out = _generate(GLM4_MOE, [(0, 2), (2, 3)], [7, 21, 108])
+    assert len(out) == 5
+
+
+def test_minimax_m2_generates():
+    cfg = normalize_config(MINIMAX_M2)
+    assert cfg.moe is not None
+    out = _generate(MINIMAX_M2, [(0, 2)], [5, 6, 7, 8])
+    assert len(out) == 5
+
+
+def test_step3p5_config_quirks():
+    cfg = normalize_config(STEP3P5)
+    assert cfg.num_key_value_heads == 2       # from num_attention_groups
+    assert cfg.moe is not None and cfg.moe.num_experts == 4
+    assert cfg.moe.num_experts_per_tok == 2   # from moe_top_k
+    assert cfg.layer_types[1] == "sliding_attention"
+
+
+def test_step3p5_generates_with_windows_and_gate():
+    prompt = [int(x) for x in
+              np.random.default_rng(0).integers(1, 198, size=30)]
+    out = _generate(STEP3P5, [(0, 4)], prompt)
+    assert len(out) == 5
