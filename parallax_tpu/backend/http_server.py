@@ -229,6 +229,7 @@ class OpenAIFrontend:
         self._counters = {"requests": 0, "completion_tokens": 0,
                           "prompt_tokens": 0, "started_at": time.time()}
         self.app.add_routes([
+            web.get("/", self._root_redirect),
             web.post("/v1/chat/completions", self.chat_completions),
             web.post("/v1/completions", self.completions),
             web.get("/v1/models", self.models),
@@ -241,7 +242,23 @@ class OpenAIFrontend:
             web.post("/scheduler/init", self.scheduler_init),
         ])
 
+        # Built-in web UI (setup/join/cluster/chat — reference src/frontend).
+        from parallax_tpu.backend.webui import register_ui
+
+        try:
+            from parallax_tpu.models.presets import MODEL_DB, PRESETS
+
+            ui_models = [model_name] + sorted(
+                set(list(PRESETS) + list(MODEL_DB)) - {model_name}
+            )
+        except Exception:  # pragma: no cover
+            ui_models = [model_name]
+        register_ui(self.app, ui_models)
+
     # -- endpoints ---------------------------------------------------------
+
+    async def _root_redirect(self, _req):
+        raise web.HTTPFound("/ui")
 
     async def health(self, _req):
         return web.json_response({"status": "ok"})

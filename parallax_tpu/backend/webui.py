@@ -1,0 +1,183 @@
+"""Built-in web UI: setup / join / cluster / chat pages.
+
+Capability parity: reference ``src/frontend`` (7k LoC React+Vite+MUI with
+setup.tsx / join.tsx / chat.tsx served by the backend). The TPU build
+serves the same workflows from one dependency-free vanilla-JS page — no
+node toolchain in the serving image, nothing to build, same endpoints:
+
+- Setup: pick a model (from the curated DB + presets) and node count,
+  POST ``/scheduler/init``.
+- Join: copy-paste worker join commands for this scheduler.
+- Cluster: live pipeline/node topology from ``/cluster/status_json``.
+- Chat: streaming chat against ``/v1/chat/completions``.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+
+def register_ui(app: web.Application, model_names: list[str],
+                scheduler_addr_fn=None) -> None:
+    async def ui(_req):
+        return web.Response(text=PAGE, content_type="text/html")
+
+    async def models(_req):
+        addr = scheduler_addr_fn() if scheduler_addr_fn else ""
+        return web.json_response({"models": model_names,
+                                  "scheduler_addr": addr})
+
+    app.add_routes([
+        web.get("/ui", ui),
+        web.get("/ui/meta", models),
+    ])
+
+
+PAGE = """<!doctype html><html><head><meta charset="utf-8">
+<title>parallax-tpu</title><style>
+:root{--bg:#0f1115;--panel:#171a21;--line:#2a2f3a;--fg:#e6e6e6;--dim:#9aa4b2;
+--accent:#4f8ff7;--ok:#3fb950;--warn:#d29922}
+*{box-sizing:border-box}body{margin:0;font-family:system-ui;background:var(--bg);
+color:var(--fg);height:100vh;display:flex;flex-direction:column}
+header{display:flex;align-items:center;gap:24px;padding:12px 20px;
+border-bottom:1px solid var(--line);background:var(--panel)}
+header h1{font-size:16px;margin:0}
+nav button{background:none;border:none;color:var(--dim);font-size:14px;
+padding:8px 12px;cursor:pointer;border-radius:6px}
+nav button.active{color:var(--fg);background:#222838}
+main{flex:1;overflow:auto;padding:20px;max-width:900px;margin:0 auto;width:100%}
+.card{background:var(--panel);border:1px solid var(--line);border-radius:10px;
+padding:16px;margin-bottom:16px}
+.card h2{margin:0 0 12px;font-size:14px;color:var(--dim);
+text-transform:uppercase;letter-spacing:.06em}
+select,input{background:#10131a;color:var(--fg);border:1px solid var(--line);
+border-radius:6px;padding:8px 10px;font-size:14px}
+button.primary{background:var(--accent);color:#fff;border:none;
+border-radius:6px;padding:8px 16px;font-size:14px;cursor:pointer}
+code,pre{background:#10131a;border:1px solid var(--line);border-radius:6px;
+padding:2px 6px;font-size:13px}
+pre{padding:10px;overflow-x:auto}
+.node{display:inline-block;background:#10131a;border:1px solid var(--line);
+border-radius:8px;padding:8px 12px;margin:4px;font-size:13px}
+.node .id{color:var(--dim);font-size:11px}
+.ok{color:var(--ok)}.warn{color:var(--warn)}
+.pipeline{border-left:3px solid var(--accent);padding-left:10px;margin:10px 0}
+#log{display:flex;flex-direction:column;gap:8px}
+.msg{padding:10px 14px;border-radius:10px;white-space:pre-wrap;max-width:85%}
+.user{background:#23406b;align-self:flex-end}.bot{background:#1c2129}
+#chatbar{display:flex;gap:8px;margin-top:12px}
+#chatbar input{flex:1}
+.kv{display:grid;grid-template-columns:auto 1fr;gap:4px 16px;font-size:13px}
+.kv .k{color:var(--dim)}
+</style></head><body>
+<header><h1>parallax-tpu</h1><nav>
+<button data-tab="cluster" class="active">Cluster</button>
+<button data-tab="chat">Chat</button>
+<button data-tab="setup">Setup</button>
+<button data-tab="join">Join</button>
+</nav></header>
+<main>
+<section id="tab-cluster">
+ <div class="card"><h2>Swarm status</h2><div id="status">loading…</div></div>
+ <div class="card"><h2>Serving metrics</h2><pre id="metrics">…</pre></div>
+</section>
+<section id="tab-chat" hidden>
+ <div class="card"><div id="log"></div>
+ <div id="chatbar"><input id="inp" placeholder="message…">
+ <button class="primary" id="send">Send</button></div></div>
+</section>
+<section id="tab-setup" hidden>
+ <div class="card"><h2>Start / switch model</h2>
+ <p style="color:var(--dim);font-size:13px">Stops the current scheduler and
+ bootstraps a fresh one; workers rejoin and reload on their next heartbeat.
+ Workers must hold the model locally (checkpoint dir or preset).</p>
+ <div style="display:flex;gap:8px;flex-wrap:wrap">
+ <select id="model"></select>
+ <input id="nnodes" type="number" min="1" value="1" style="width:90px"
+  title="init nodes">
+ <button class="primary" id="init">Initialize</button></div>
+ <pre id="initout" hidden></pre></div>
+</section>
+<section id="tab-join" hidden>
+ <div class="card"><h2>Join this swarm</h2>
+ <p style="color:var(--dim);font-size:13px">Run on each worker host
+ (checkpoint directory must exist locally):</p>
+ <pre id="joincmd">…</pre></div>
+</section>
+</main><script>
+const $=s=>document.querySelector(s);
+document.querySelectorAll('nav button').forEach(b=>b.onclick=()=>{
+ document.querySelectorAll('nav button').forEach(x=>x.classList.remove('active'));
+ b.classList.add('active');
+ document.querySelectorAll('main section').forEach(s=>s.hidden=true);
+ $('#tab-'+b.dataset.tab).hidden=false;});
+async function meta(){
+ try{const m=await (await fetch('/ui/meta')).json();
+  $('#model').innerHTML=m.models.map(x=>`<option>${x}</option>`).join('');
+  const addr=m.scheduler_addr||location.hostname+':3002';
+  $('#joincmd').textContent=
+   'python -m parallax_tpu.cli join \\\\\\n  --scheduler-addr '+addr+
+   ' \\\\\\n  --model-path /path/to/checkpoint';
+ }catch(e){}}
+meta();
+async function refresh(){
+ try{
+  const st=await (await fetch('/cluster/status_json')).json();
+  let html='';
+  if(st.pipelines){
+   html+=`<div class="kv"><span class="k">bootstrapped</span><span>${st.bootstrapped?'<span class=ok>yes</span>':'<span class=warn>no</span>'}</span>`+
+    `<span class="k">nodes</span><span>${st.num_active??''} active / ${st.num_standby??0} standby</span></div>`;
+   for(const p of st.pipelines){
+    html+=`<div class="pipeline"><b>pipeline ${p.id}</b><br>`+
+     p.nodes.map(n=>`<span class="node">[${n.layers[0]}, ${n.layers[1]})`+
+      ` ${n.ready?'<span class=ok>ready</span>':'<span class=warn>loading</span>'}`+
+      ` load ${n.load}<br><span class="id">${n.node_id}</span></span>`).join('')+'</div>';}
+  } else if(st.stages){
+   html+='<div class="pipeline"><b>single host</b><br>'+st.stages.map(s=>
+    `<span class="node">[${s.layers[0]}, ${s.layers[1]}) running ${s.running}`+
+    ` waiting ${s.waiting}<br><span class="id">free pages ${s.free_pages}`+
+    ` · cached ${s.cached_pages}</span></span>`).join('')+'</div>';
+  } else html='<i>no status</i>';
+  $('#status').innerHTML=html;
+  $('#metrics').textContent=await (await fetch('/metrics')).text();
+ }catch(e){$('#status').innerHTML='<i>status unavailable: '+e+'</i>';}
+}
+refresh();setInterval(refresh,3000);
+const history=[];let busy=false;
+function add(cls,text){const d=document.createElement('div');
+ d.className='msg '+cls;d.textContent=text;$('#log').appendChild(d);
+ d.scrollIntoView();return d;}
+async function send(){
+ if(busy)return;const text=$('#inp').value.trim();if(!text)return;
+ $('#inp').value='';busy=true;
+ history.push({role:'user',content:text});add('user',text);
+ const el=add('bot','');
+ try{
+  const r=await fetch('/v1/chat/completions',{method:'POST',
+   headers:{'Content-Type':'application/json'},
+   body:JSON.stringify({model:'parallax-tpu',messages:history,stream:true,
+    max_tokens:512})});
+  if(!r.ok){el.textContent='[error '+r.status+']';history.pop();return;}
+  const rd=r.body.getReader(),dec=new TextDecoder();let acc='',buf='';
+  for(;;){const{done,value}=await rd.read();if(done)break;
+   buf+=dec.decode(value,{stream:true});
+   const lines=buf.split('\\n');buf=lines.pop();
+   for(const line of lines){if(!line.startsWith('data: '))continue;
+    const d=line.slice(6);if(d==='[DONE]')continue;
+    try{const c=JSON.parse(d).choices[0].delta?.content;
+     if(c){acc+=c;el.textContent=acc;el.scrollIntoView();}}catch(e){}}}
+  history.push({role:'assistant',content:acc});
+ }catch(e){el.textContent='[network error]';history.pop();}
+ finally{busy=false;$('#inp').focus();}}
+$('#send').onclick=send;
+$('#inp').addEventListener('keydown',e=>{if(e.key==='Enter')send()});
+$('#init').onclick=async()=>{
+ const out=$('#initout');out.hidden=false;out.textContent='initializing…';
+ try{
+  const r=await fetch('/scheduler/init',{method:'POST',
+   headers:{'Content-Type':'application/json'},
+   body:JSON.stringify({model_name:$('#model').value,
+    init_nodes_num:parseInt($('#nnodes').value)})});
+  out.textContent=JSON.stringify(await r.json(),null,2);
+ }catch(e){out.textContent='error: '+e;}};
+</script></body></html>"""

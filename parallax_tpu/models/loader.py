@@ -265,12 +265,20 @@ def apply_lora_adapter(
     cfg_path = os.path.join(adapter_path, "adapter_config.json")
     with open(cfg_path, encoding="utf-8") as f:
         acfg = json.load(f)
-    r = int(acfg.get("r", 8))
-    alpha = float(acfg.get("lora_alpha", r))
-    if acfg.get("use_rslora"):
-        scale = alpha / (r ** 0.5)
-    else:
-        scale = alpha / r
+    default_alpha = float(acfg.get("lora_alpha", acfg.get("r", 8)))
+    alpha_pattern = acfg.get("alpha_pattern") or {}
+    use_rslora = bool(acfg.get("use_rslora"))
+
+    def scale_for(module: str, rank: int) -> float:
+        # Per-module alpha overrides (PEFT alpha_pattern, matched on module
+        # suffix); the rank always comes from the actual lora_A tensor so
+        # rank_pattern adapters merge with the right scale.
+        alpha = default_alpha
+        for pat, a in alpha_pattern.items():
+            if module.endswith(pat) or pat in module:
+                alpha = float(a)
+                break
+        return alpha / (rank ** 0.5 if use_rslora else rank)
 
     weight_file = None
     for name in ("adapter_model.safetensors", "adapter.safetensors"):
@@ -334,7 +342,7 @@ def apply_lora_adapter(
             )
         a = np.asarray(ab["A"], np.float32)   # [r, in]
         b = np.asarray(ab["B"], np.float32)   # [out, r]
-        delta = scale * (b @ a)
+        delta = scale_for(module, a.shape[0]) * (b @ a)
         w = np.asarray(node["weight"], np.float32)
         if w.shape != delta.shape:
             raise ValueError(

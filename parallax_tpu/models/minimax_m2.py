@@ -21,8 +21,19 @@ from parallax_tpu.ops.attention import ragged_paged_attention
 from parallax_tpu.ops.kv_cache_ops import reshape_and_cache
 
 
-@register_model("MiniMaxM2ForCausalLM", "MiniMaxForCausalLM")
+@register_model("MiniMaxM2ForCausalLM")
 class MiniMaxM2StageModel(MoEStageModel):
+    # NOTE: no "MiniMaxForCausalLM" alias — that HF architecture is the
+    # MiniMax-Text-01 lightning-attention hybrid, a different model family.
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.tp_size > 1:
+            # The full-projection qk norms would need column-sharded norm
+            # weights, which the generic TP spec cannot express yet.
+            raise ValueError("MiniMax-M2 does not support tensor "
+                             "parallelism yet (full-projection qk norms)")
+
     def _attention(self, lp, h, kv, inputs: BatchInputs, window):
         cfg = self.config
         p = lp["self_attn"]

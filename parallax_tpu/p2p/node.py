@@ -178,7 +178,6 @@ class WorkerNode:
         ):
             self._served_model_name = model_name
             return False
-        self._served_model_name = model_name
         if self.resolve_model is None:
             raise RuntimeError(
                 f"scheduler switched to {model_name!r} but this worker has "
@@ -186,6 +185,10 @@ class WorkerNode:
                 "resolver); restart the worker with the new --model-path"
             )
         config, load_params = self.resolve_model(model_name)
+        # Record the new name only AFTER a successful resolve: a failed
+        # switch must keep retrying on later heartbeats, never silently
+        # serve the old model under the new name.
+        self._served_model_name = model_name
         logger.warning("%s: switching model %s -> %s", self.node_id,
                        self.model_config.model_name, model_name)
         self.model_config = config
@@ -201,20 +204,35 @@ class WorkerNode:
         3 disk versions for the same reason, p2p/server.py:434-446)."""
         if self.refit_store is None or self.engine is None:
             return
-        versions = self.refit_store.versions()
-        if not versions:
-            return
-        version = versions[-1]
-        if version <= self.refit_version:
-            return
-        try:
-            from parallax_tpu.p2p.refit import apply_prefetched
+        from parallax_tpu.p2p.refit import apply_prefetched
 
-            tensors = self.refit_store.load(version)
-            apply_prefetched(self.engine, tensors, version)
-            self.refit_version = version
-        except Exception:
-            logger.exception("refit cache restore v%d failed", version)
+        # Newest first, falling back through older intact versions (a crash
+        # mid-save could have left the newest unreadable). Versions cached
+        # for a different model or layer range must never be applied — the
+        # stage-local keys would shape-check but hold other layers' weights.
+        for version in reversed(self.refit_store.versions()):
+            if version <= self.refit_version:
+                return
+            meta = self.refit_store.load_meta(version)
+            if meta is None or (
+                meta.get("model_name") != self.model_config.model_name
+                or meta.get("start_layer") != self.start_layer
+                or meta.get("end_layer") != self.end_layer
+            ):
+                logger.info(
+                    "refit cache v%d skipped (cached for %s [%s, %s))",
+                    version, (meta or {}).get("model_name"),
+                    (meta or {}).get("start_layer"),
+                    (meta or {}).get("end_layer"),
+                )
+                continue
+            try:
+                tensors = self.refit_store.load(version)
+                apply_prefetched(self.engine, tensors, version)
+                self.refit_version = version
+                return
+            except Exception:
+                logger.exception("refit cache restore v%d failed", version)
 
     def _random_params(self, model: StageModel):
         dtype = (
@@ -442,7 +460,11 @@ class WorkerNode:
                 # Persist + GC to the newest 3 versions (reference
                 # check_and_release_disk_weight, p2p/server.py:434-446).
                 try:
-                    self.refit_store.save(version, tensors)
+                    self.refit_store.save(version, tensors, meta={
+                        "model_name": self.model_config.model_name,
+                        "start_layer": self.start_layer,
+                        "end_layer": self.end_layer,
+                    })
                 except Exception:
                     logger.exception("refit v%d disk cache failed", version)
             self._inbox.put(("refit_apply", version, tensors))

@@ -211,6 +211,9 @@ class RefitVersionStore:
     def _path(self, version: int) -> str:
         return os.path.join(self.root, f"v{version:08d}.safetensors")
 
+    def _meta_path(self, version: int) -> str:
+        return os.path.join(self.root, f"v{version:08d}.json")
+
     def versions(self) -> list[int]:
         out = []
         for name in os.listdir(self.root):
@@ -221,13 +224,26 @@ class RefitVersionStore:
                     continue
         return sorted(out)
 
-    def save(self, version: int, tensors: dict) -> str:
-        """Persist one version's stage tensors, then GC old versions."""
+    def save(self, version: int, tensors: dict,
+             meta: dict | None = None) -> str:
+        """Persist one version's stage tensors (atomically: temp + rename,
+        so a crash mid-write never leaves a truncated newest version), then
+        GC old versions. ``meta`` records which (model, layer range) the
+        stage-local keys belong to — restore validates it."""
+        import json as _json
+
         import numpy as np
         from safetensors.numpy import save_file
 
         path = self._path(version)
-        save_file({k: np.asarray(v) for k, v in tensors.items()}, path)
+        tmp = path + ".tmp"
+        save_file({k: np.asarray(v) for k, v in tensors.items()}, tmp)
+        os.replace(tmp, path)
+        if meta is not None:
+            mtmp = self._meta_path(version) + ".tmp"
+            with open(mtmp, "w", encoding="utf-8") as f:
+                _json.dump(meta, f)
+            os.replace(mtmp, self._meta_path(version))
         self.gc()
         return path
 
@@ -237,6 +253,15 @@ class RefitVersionStore:
         return {k: jnp.asarray(v)
                 for k, v in load_file(self._path(version)).items()}
 
+    def load_meta(self, version: int) -> dict | None:
+        import json as _json
+
+        try:
+            with open(self._meta_path(version), encoding="utf-8") as f:
+                return _json.load(f)
+        except (OSError, ValueError):
+            return None
+
     def gc(self) -> list[int]:
         """Drop everything but the newest ``keep`` versions."""
         versions = self.versions()
@@ -244,6 +269,8 @@ class RefitVersionStore:
         for v in versions[:-self.keep] if self.keep else versions:
             try:
                 os.remove(self._path(v))
+                if os.path.exists(self._meta_path(v)):
+                    os.remove(self._meta_path(v))
                 removed.append(v)
             except OSError:
                 logger.exception("refit GC failed for v%d", v)

@@ -25,6 +25,8 @@ _COLUMN = {
     "q_proj", "k_proj", "v_proj", "gate_proj", "up_proj",
     # MLA head-sharded projections (DeepSeek): outputs are per-head.
     "q_b_proj", "kv_b_proj",
+    # Step-3.5 head-wise attention gate: one output per (local) head.
+    "g_proj",
 }
 _ROW = {"o_proj", "down_proj"}
 
