@@ -52,68 +52,40 @@ Key make_key(const int32_t* tokens, int64_t start, int32_t page) {
     return Key(tokens + start, tokens + start + page);
 }
 
-}  // namespace
+// Shared walk primitives: the piecewise C ABI functions and the batched
+// cache-manager ops below must stay behaviorally identical, so both call
+// these.
 
-extern "C" {
-
-// ---- radix tree -----------------------------------------------------------
-
-void* radix_new(int32_t page_size) { return new RadixTree(page_size); }
-
-void radix_free(void* handle) { delete static_cast<RadixTree*>(handle); }
-
-int64_t radix_num_pages(void* handle) {
-    return static_cast<RadixTree*>(handle)->num_pages;
-}
-
-// Longest full-page prefix match. Writes matched page ids into out_pages
-// (capacity max_out) and returns the match length in pages. Matched nodes
-// get their access clocks refreshed.
-int64_t radix_match(void* handle, const int32_t* tokens, int64_t n_tokens,
-                    int32_t* out_pages, int64_t max_out) {
-    auto* t = static_cast<RadixTree*>(handle);
-    Node* node = &t->root;
-    int64_t matched = 0;
-    t->clock++;
-    for (int64_t start = 0; start + t->page_size <= n_tokens;
-         start += t->page_size) {
-        if (matched >= max_out) break;
-        Key key = make_key(tokens, start, t->page_size);
-        auto it = node->children.find(key);
-        if (it == node->children.end()) break;
-        node = it->second;
-        node->last_access = t->clock;
-        out_pages[matched++] = node->page_id;
+// LRU-evict one unpinned leaf; returns its page id or -1 when none.
+int32_t evict_one(RadixTree* t) {
+    Node* best = nullptr;
+    std::vector<Node*> stack;
+    for (auto& kv : t->root.children) stack.push_back(kv.second);
+    while (!stack.empty()) {
+        Node* cur = stack.back();
+        stack.pop_back();
+        if (!cur->children.empty()) {
+            for (auto& kv : cur->children) stack.push_back(kv.second);
+        } else if (cur->lock_ref <= 0) {
+            if (!best || cur->last_access < best->last_access) best = cur;
+        }
     }
-    return matched;
+    if (!best) return -1;
+    int32_t page = best->page_id;
+    best->parent->children.erase(best->key);
+    delete best;
+    t->num_pages--;
+    return page;
 }
 
-// Adjust lock refs (+1 / -1) along the match path for the given prefix.
-void radix_lock(void* handle, const int32_t* tokens, int64_t n_tokens,
-                int64_t n_pages, int32_t delta) {
-    auto* t = static_cast<RadixTree*>(handle);
-    Node* node = &t->root;
-    for (int64_t i = 0; i < n_pages; i++) {
-        Key key = make_key(tokens, i * t->page_size, t->page_size);
-        auto it = node->children.find(key);
-        if (it == node->children.end()) return;
-        node = it->second;
-        node->lock_ref += delta;
-    }
-}
-
-// Insert full pages; returns the count of *duplicate* page ids written to
-// out_dups (pages the caller must free because the key already existed
-// with a different page).
-int64_t radix_insert(void* handle, const int32_t* tokens, int64_t n_tokens,
-                     const int32_t* page_ids, int64_t n_pages,
-                     int32_t* out_dups, int64_t max_dups) {
-    auto* t = static_cast<RadixTree*>(handle);
+// Walk/extend the tree with full pages of tokens; existing keys with a
+// different page report the incoming page as a duplicate.
+int64_t insert_walk(RadixTree* t, const int32_t* tokens, int64_t n_full,
+                    const int32_t* page_ids, int32_t* out_dups,
+                    int64_t max_dups) {
     Node* node = &t->root;
     int64_t n_dups = 0;
     t->clock++;
-    int64_t n_full = n_tokens / t->page_size;
-    if (n_pages < n_full) n_full = n_pages;
     for (int64_t i = 0; i < n_full; i++) {
         Key key = make_key(tokens, i * t->page_size, t->page_size);
         auto it = node->children.find(key);
@@ -137,28 +109,85 @@ int64_t radix_insert(void* handle, const int32_t* tokens, int64_t n_tokens,
     return n_dups;
 }
 
+// Longest full-page prefix match (capped); refreshes access clocks and
+// optionally records the node path.
+int64_t match_walk(RadixTree* t, const int32_t* tokens, int64_t n_tokens,
+                   int64_t max_pages, int32_t* out_pages,
+                   std::vector<Node*>* out_path) {
+    Node* node = &t->root;
+    int64_t matched = 0;
+    t->clock++;
+    for (int64_t start = 0; matched < max_pages &&
+                            start + t->page_size <= n_tokens;
+         start += t->page_size) {
+        Key key = make_key(tokens, start, t->page_size);
+        auto it = node->children.find(key);
+        if (it == node->children.end()) break;
+        node = it->second;
+        node->last_access = t->clock;
+        out_pages[matched++] = node->page_id;
+        if (out_path) out_path->push_back(node);
+    }
+    return matched;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- radix tree -----------------------------------------------------------
+
+void* radix_new(int32_t page_size) { return new RadixTree(page_size); }
+
+void radix_free(void* handle) { delete static_cast<RadixTree*>(handle); }
+
+int64_t radix_num_pages(void* handle) {
+    return static_cast<RadixTree*>(handle)->num_pages;
+}
+
+// Longest full-page prefix match. Writes matched page ids into out_pages
+// (capacity max_out) and returns the match length in pages. Matched nodes
+// get their access clocks refreshed.
+int64_t radix_match(void* handle, const int32_t* tokens, int64_t n_tokens,
+                    int32_t* out_pages, int64_t max_out) {
+    auto* t = static_cast<RadixTree*>(handle);
+    return match_walk(t, tokens, n_tokens, max_out, out_pages, nullptr);
+}
+
+// Adjust lock refs (+1 / -1) along the match path for the given prefix.
+void radix_lock(void* handle, const int32_t* tokens, int64_t n_tokens,
+                int64_t n_pages, int32_t delta) {
+    auto* t = static_cast<RadixTree*>(handle);
+    Node* node = &t->root;
+    for (int64_t i = 0; i < n_pages; i++) {
+        Key key = make_key(tokens, i * t->page_size, t->page_size);
+        auto it = node->children.find(key);
+        if (it == node->children.end()) return;
+        node = it->second;
+        node->lock_ref += delta;
+    }
+}
+
+// Insert full pages; returns the count of *duplicate* page ids written to
+// out_dups (pages the caller must free because the key already existed
+// with a different page).
+int64_t radix_insert(void* handle, const int32_t* tokens, int64_t n_tokens,
+                     const int32_t* page_ids, int64_t n_pages,
+                     int32_t* out_dups, int64_t max_dups) {
+    auto* t = static_cast<RadixTree*>(handle);
+    int64_t n_full = n_tokens / t->page_size;
+    if (n_pages < n_full) n_full = n_pages;
+    return insert_walk(t, tokens, n_full, page_ids, out_dups, max_dups);
+}
+
 // Evict up to n unpinned LRU leaves; returns freed page ids in out_pages.
 int64_t radix_evict(void* handle, int64_t n, int32_t* out_pages) {
     auto* t = static_cast<RadixTree*>(handle);
     int64_t freed = 0;
     while (freed < n) {
-        Node* best = nullptr;
-        std::vector<Node*> stack;
-        for (auto& kv : t->root.children) stack.push_back(kv.second);
-        while (!stack.empty()) {
-            Node* cur = stack.back();
-            stack.pop_back();
-            if (!cur->children.empty()) {
-                for (auto& kv : cur->children) stack.push_back(kv.second);
-            } else if (cur->lock_ref <= 0) {
-                if (!best || cur->last_access < best->last_access) best = cur;
-            }
-        }
-        if (!best) break;
-        out_pages[freed++] = best->page_id;
-        best->parent->children.erase(best->key);
-        delete best;
-        t->num_pages--;
+        int32_t page = evict_one(t);
+        if (page < 0) break;
+        out_pages[freed++] = page;
     }
     return freed;
 }
@@ -179,6 +208,131 @@ int64_t radix_reset(void* handle, int32_t* out_pages, int64_t max_out) {
     t->root.children.clear();
     t->num_pages = 0;
     return n;
+}
+
+// ---- batched cache manager ops -------------------------------------------
+//
+// One ABI crossing per scheduler operation (the round-1 ctypes-per-call
+// variant measured 0.4-1.0x Python; the win requires match+lock+evict+
+// alloc fused on the native side).
+
+namespace {
+
+int64_t evict_into(RadixTree* t, PageAlloc* a, int64_t need) {
+    int64_t freed = 0;
+    while (freed < need) {
+        int32_t page = evict_one(t);
+        if (page < 0) break;
+        if (page != a->null_page) a->free_list.push_back(page);
+        freed++;
+    }
+    return freed;
+}
+
+}  // namespace
+
+// Admit a prompt in ONE crossing: prefix-match (capped so >=1 token is
+// recomputed), lock the matched path, evict-to-fit, allocate fresh pages.
+// Writes shared+fresh page ids to out_pages; *out_shared = matched pages.
+// Returns total pages, or -1 when memory is insufficient (fully rolled
+// back: locks released, nothing allocated).
+int64_t cache_admit(void* tree_h, void* alloc_h, const int32_t* tokens,
+                    int64_t n_tokens, int32_t enable_prefix,
+                    int32_t* out_pages, int64_t max_out,
+                    int64_t* out_shared) {
+    auto* t = static_cast<RadixTree*>(tree_h);
+    auto* a = static_cast<PageAlloc*>(alloc_h);
+    int64_t total = (n_tokens + t->page_size - 1) / t->page_size;
+    if (total > max_out) return -1;
+
+    // Match (capped at usable) collecting the node path for lock/unlock.
+    std::vector<Node*> path;
+    int64_t matched = 0;
+    if (enable_prefix && n_tokens > 1) {
+        int64_t usable = (n_tokens - 1) / t->page_size;
+        matched = match_walk(t, tokens, n_tokens, usable, out_pages, &path);
+    }
+    for (Node* n : path) n->lock_ref++;
+
+    int64_t fresh = total - matched;
+    if ((int64_t)a->free_list.size() < fresh) {
+        evict_into(t, a, fresh - (int64_t)a->free_list.size());
+    }
+    if ((int64_t)a->free_list.size() < fresh) {
+        for (Node* n : path) n->lock_ref--;
+        return -1;
+    }
+    for (int64_t i = 0; i < fresh; i++) {
+        out_pages[matched + i] = a->free_list.back();
+        a->free_list.pop_back();
+    }
+    *out_shared = matched;
+    return total;
+}
+
+// Grow a request's page list in ONE crossing: evict-to-fit + allocate.
+// Returns n on success, -1 if insufficient even after eviction.
+int64_t cache_grow(void* tree_h, void* alloc_h, int64_t n, int32_t* out) {
+    auto* t = static_cast<RadixTree*>(tree_h);
+    auto* a = static_cast<PageAlloc*>(alloc_h);
+    if ((int64_t)a->free_list.size() < n) {
+        evict_into(t, a, n - (int64_t)a->free_list.size());
+    }
+    if ((int64_t)a->free_list.size() < n) return -1;
+    for (int64_t i = 0; i < n; i++) {
+        out[i] = a->free_list.back();
+        a->free_list.pop_back();
+    }
+    return n;
+}
+
+// Release a finished request in ONE crossing: unlock the shared path,
+// donate fully-computed pages to the tree, free duplicates + the tail.
+// ``computed`` = tokens whose KV is real (the final sampled token's is
+// not). ``insert`` = 0 frees everything owned outright (abort path).
+void cache_release(void* tree_h, void* alloc_h, const int32_t* tokens,
+                   int64_t n_tokens, int64_t computed,
+                   const int32_t* pages, int64_t n_pages, int64_t n_shared,
+                   int32_t insert) {
+    auto* t = static_cast<RadixTree*>(tree_h);
+    auto* a = static_cast<PageAlloc*>(alloc_h);
+    // Unlock the shared prefix path.
+    {
+        Node* node = &t->root;
+        for (int64_t i = 0; i < n_shared; i++) {
+            Key key = make_key(tokens, i * t->page_size, t->page_size);
+            auto it = node->children.find(key);
+            if (it == node->children.end()) break;
+            node = it->second;
+            node->lock_ref--;
+        }
+    }
+    if (n_pages <= n_shared) return;
+    if (!insert) {
+        for (int64_t i = n_shared; i < n_pages; i++) {
+            if (pages[i] != a->null_page) a->free_list.push_back(pages[i]);
+        }
+        return;
+    }
+    if (computed > n_tokens) computed = n_tokens;
+    int64_t n_full = computed / t->page_size;
+    if (n_full > n_pages) n_full = n_pages;
+    // Insert the fully-computed prefix; duplicates go straight back to the
+    // allocator. (Shared-prefix pages are the tree's own ids, so they can
+    // never report as duplicates.)
+    {
+        std::vector<int32_t> dups(n_full > 0 ? n_full : 1);
+        int64_t n_dups = insert_walk(t, tokens, n_full, pages,
+                                     dups.data(), (int64_t)dups.size());
+        for (int64_t i = 0; i < n_dups; i++) {
+            if (dups[i] != a->null_page) a->free_list.push_back(dups[i]);
+        }
+    }
+    // Tail: owned pages past the donated prefix.
+    int64_t tail_start = n_full > n_shared ? n_full : n_shared;
+    for (int64_t i = tail_start; i < n_pages; i++) {
+        if (pages[i] != a->null_page) a->free_list.push_back(pages[i]);
+    }
 }
 
 // ---- page allocator -------------------------------------------------------

@@ -18,6 +18,19 @@ import jax.numpy as jnp
 NEG_INF = -1e10
 
 
+@jax.jit
+def greedy_tokens(logits: jax.Array) -> jax.Array:
+    """All-greedy fast path: a single argmax, no sort, no PRNG.
+
+    The reference sampler special-cases an all-greedy batch
+    (sampler.py:65-95); on TPU this matters more — the general path's
+    full-vocab descending sort is the single most expensive sampling op at
+    large vocabularies, and greedy decode (benchmarks, temperature-0
+    serving) never needs it.
+    """
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
 @functools.partial(jax.jit, donate_argnums=())
 def sample_tokens(
     logits: jax.Array,            # [B, V] float

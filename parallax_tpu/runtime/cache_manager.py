@@ -51,6 +51,42 @@ def derive_num_pages(
     return max(8, int(free_bytes * utilization) // per_page)
 
 
+def make_cache_manager(
+    page_size: int,
+    num_pages: int,
+    enable_prefix_cache: bool = True,
+    max_model_len: int = 32768,
+    use_native: bool | None = None,
+):
+    """CacheManager factory: the C++ manager (ONE ABI crossing per
+    admit/grow/release — ``native.NativeCacheManager``) by default when
+    the library builds; pure Python otherwise or with
+    ``PARALLAX_TPU_NO_NATIVE=1``. Native measures ~3-16x faster in the
+    production regime (full prefix cache under eviction pressure, growing
+    with prompt length); the Python manager remains the behavioral oracle
+    (differential fuzz in tests/test_native_cache.py)."""
+    import os
+
+    if use_native is None:
+        use_native = not os.environ.get("PARALLAX_TPU_NO_NATIVE")
+    if use_native:
+        try:
+            from parallax_tpu import native
+
+            if native.native_available():
+                return native.NativeCacheManager(
+                    page_size, num_pages,
+                    enable_prefix_cache=enable_prefix_cache,
+                    max_model_len=max_model_len,
+                )
+        except Exception as e:  # pragma: no cover - env specific
+            logger.warning("native cache unavailable: %s", e)
+    return CacheManager(
+        page_size, num_pages, enable_prefix_cache=enable_prefix_cache,
+        max_model_len=max_model_len,
+    )
+
+
 class CacheManager:
     """Host-side paged-KV bookkeeping for one pipeline stage."""
 
@@ -60,38 +96,15 @@ class CacheManager:
         num_pages: int,
         enable_prefix_cache: bool = True,
         max_model_len: int = 32768,
-        use_native: bool | None = None,
     ):
         self.page_size = page_size
         self.num_pages = num_pages
         self.max_model_len = max_model_len
         self.enable_prefix_cache = enable_prefix_cache
-        self.allocator, self.prefix_cache = self._make_structures(use_native)
+        self.allocator = PageAllocator(num_pages)
+        self.prefix_cache = RadixPageCache(page_size)
         # rid -> (locked node path, number of shared tree-owned pages)
         self._locked: dict[str, tuple] = {}
-
-    def _make_structures(self, use_native: bool | None):
-        """Cache structures. The C++ implementation (PARALLAX_TPU_NATIVE=1)
-        is measured SLOWER than the Python one for realistic prompt sizes
-        (0.4-1.0x: per-call ctypes+ndarray overhead beats std::map gains
-        while dict lookups are already C speed), so Python is the default;
-        the native path stays as a tested opt-in for future batched APIs."""
-        import os
-
-        if use_native is None:
-            use_native = bool(os.environ.get("PARALLAX_TPU_NATIVE"))
-        if use_native:
-            try:
-                from parallax_tpu import native
-
-                if native.native_available():
-                    return (
-                        native.NativePageAllocator(self.num_pages),
-                        native.NativeRadixPageCache(self.page_size),
-                    )
-            except Exception as e:  # pragma: no cover - env specific
-                logger.warning("native cache unavailable: %s", e)
-        return PageAllocator(self.num_pages), RadixPageCache(self.page_size)
 
     # -- capacity ---------------------------------------------------------
 
@@ -110,17 +123,6 @@ class CacheManager:
         freed = self.prefix_cache.evict(deficit)
         self.allocator.free(freed)
         return self.allocator.num_free >= need
-
-    def can_admit(self, request: Request) -> bool:
-        """Cheap admission check used by the scheduler's wait-queue scan."""
-        matched = 0
-        if self.enable_prefix_cache:
-            pages, _ = self.prefix_cache.match_prefix(request.prompt_ids)
-            matched = min(len(pages), max(0, (request.num_prompt_tokens - 1)) // self.page_size)
-        need = self.pages_needed(request.num_prompt_tokens) - matched
-        return (
-            self.allocator.num_free + self.prefix_cache.num_cached_pages >= need
-        )
 
     # -- request lifecycle ------------------------------------------------
 

@@ -125,7 +125,9 @@ class StageEngine:
         # linear layer in the config), not this stage's slice: stages of one
         # pipeline must agree or their token accounting desynchronizes.
         hybrid_model = model.config.linear_attn is not None
-        self.cache = CacheManager(
+        from parallax_tpu.runtime.cache_manager import make_cache_manager
+
+        self.cache = make_cache_manager(
             self.cfg.page_size,
             self.cfg.num_pages,
             enable_prefix_cache=(
@@ -471,6 +473,12 @@ class StageEngine:
                 logits, jnp.asarray(out_ids), jnp.asarray(pres),
                 jnp.asarray(freq), jnp.asarray(rep),
             )
+        if not np.any(temp > 0.0):
+            # All-greedy batch (padding rows default to temp 0): argmax
+            # only — skips the full-vocab sort and the PRNG entirely.
+            from parallax_tpu.ops.sampling import greedy_tokens
+
+            return np.asarray(greedy_tokens(logits))
         key = jax.random.fold_in(self._base_key, self._step_count)
         kwargs = {}
         if any_seed:
