@@ -176,6 +176,7 @@ def serve_main(args) -> int:
             kv_dtype=getattr(args, "kv_dtype", "bfloat16"),
             enable_prefix_cache=not getattr(args, "no_prefix_cache", False),
             sp_threshold=sp_threshold,
+            decode_lookahead=getattr(args, "decode_lookahead", 1) or 1,
         ),
         mesh=mesh,
         sp_mesh=sp_mesh,

@@ -42,6 +42,9 @@ def build_parser() -> argparse.ArgumentParser:
                        help="weight-only quantize an fp checkpoint on load")
     serve.add_argument("--lora-path", default=None,
                        help="PEFT LoRA adapter directory to merge at load")
+    serve.add_argument("--decode-lookahead", type=int, default=1,
+                       help="greedy decode tokens per jit dispatch "
+                            "(single-stage serving; 1 = off)")
     serve.add_argument("--sp-size", type=int, default=0,
                        help="ring-attention sequence parallelism over this "
                             "many devices for long-prompt prefill")

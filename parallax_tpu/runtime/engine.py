@@ -51,6 +51,11 @@ class EngineConfig:
     # ONE step with ring attention over the engine's sp mesh (requires
     # ``sp_mesh`` at engine construction). None = off.
     sp_threshold: int | None = None
+    # Multi-step decode: a single-stage all-greedy decode batch runs this
+    # many tokens per dispatch with sampling fused into the jit (lax.scan
+    # over forward+argmax) — the SURVEY's "k tokens per dispatch" lever
+    # against per-token host dispatch latency. 1 = off.
+    decode_lookahead: int = 1
 
 
 @dataclasses.dataclass
@@ -197,6 +202,7 @@ class StageEngine:
             (cfg_m.is_mla and cfg_m.dsa is None) or cfg_m.use_attention_sinks
         )
         self._base_key = jax.random.key(self.cfg.seed)
+        self._jit_multistep = None
         self._step_count = 0
         # Non-head stages: hidden rows waiting per request id.
         self._pending_hidden: dict[str, np.ndarray] = {}
@@ -314,6 +320,120 @@ class StageEngine:
     def has_work(self) -> bool:
         return self.scheduler.num_requests() > 0
 
+    # -- multi-step decode (k tokens per dispatch) ------------------------
+
+    def _build_multistep(self):
+        """Jit a k-step greedy decode loop: forward -> argmax -> feed back,
+        entirely on device. The page table is fixed across the window (the
+        host pre-ensures capacity), so each step only advances positions,
+        slot mapping and kv_lens."""
+        import dataclasses as _dc
+
+        model = self.model
+        k = self.cfg.decode_lookahead
+        page_size = self.cfg.page_size
+
+        def fn(params, kv, inputs: BatchInputs):
+            def body(carry, _):
+                kv, token_ids, ctx = carry
+                pos = ctx - 1                           # fed token's slot
+                page_of = jnp.maximum(pos, 0) // page_size
+                phys = jnp.take_along_axis(
+                    inputs.page_indices, page_of[:, None], axis=1
+                )[:, 0]
+                slots = jnp.where(
+                    ctx > 0, phys * page_size + jnp.maximum(pos, 0) % page_size,
+                    jnp.int32(-1),
+                )
+                step_inputs = _dc.replace(
+                    inputs,
+                    token_ids=token_ids,
+                    positions=pos,
+                    kv_lens=ctx,
+                    slot_mapping=slots,
+                )
+                logits, kv = model(params, kv, step_inputs)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (kv, nxt, ctx + 1), nxt
+
+            (kv, _, _), tokens = jax.lax.scan(
+                body, (kv, inputs.token_ids, inputs.kv_lens), None, length=k
+            )
+            return tokens, kv                           # tokens: [k, S]
+
+        return jax.jit(fn, donate_argnums=(1,))
+
+    def _try_multistep(self, plan: BatchPlan) -> int | None:
+        """Run a k-step decode window if the batch qualifies; commits
+        tokens and returns the commit count, or None for the normal path.
+
+        Qualification: single-stage engine (the ring is local), pure
+        all-greedy decode (no penalties/seeds — those need per-step host
+        state), and capacity for k more tokens per request. Requests may
+        finish mid-window (EOS/max_tokens); their surplus tokens are
+        discarded — the KV written past the finish point lies beyond the
+        committed context, so prefix-cache donation (keyed by computed
+        tokens) never exposes it.
+        """
+        k = self.cfg.decode_lookahead
+        if (
+            k <= 1
+            or not (self.model.is_first and self.model.is_last)
+            or self._needs_state
+            or self.mesh is not None
+        ):
+            return None
+        for seg in plan.seqs:
+            sp = seg.request.sampling_params
+            if (
+                seg.num_new_tokens != 1
+                or sp.temperature > 0.0
+                or sp.seed is not None
+                or sp.presence_penalty
+                or sp.frequency_penalty
+                or sp.repetition_penalty != 1.0
+            ):
+                return None
+        for seg in plan.seqs:
+            # Near the context limit the window would overrun max_model_len
+            # (and the per-seq page table): fall back to single-step.
+            if seg.request.total_len + k > self.cfg.max_model_len:
+                return None
+        for seg in plan.seqs:
+            if not self.cache.ensure_capacity(
+                seg.request, seg.request.total_len + k
+            ):
+                # Soft disqualifier only — the normal path probes +1 token
+                # itself and owns the abort decision (aborting here and
+                # then falling through would let commit_token resurrect
+                # the request).
+                return None
+
+        inputs = assemble(
+            plan, self.spec, self.cfg.page_size, decode_only=True
+        )
+        if self._jit_multistep is None:
+            self._jit_multistep = self._build_multistep()
+        tokens, self.kv = self._jit_multistep(self.params, self.kv, inputs)
+        tokens = np.asarray(tokens)                     # [k, S]
+
+        total = 0
+        for i, seg in enumerate(plan.seqs):
+            req = seg.request
+            committed = 0
+            for step in range(k):
+                if req.status.is_finished:
+                    break
+                req.commit_token(int(tokens[step, i]))
+                committed += 1
+            # Every committed token's predecessor was fed, so computed KV
+            # advances by the commit count (invariant: computed ==
+            # len(all_token_ids) - 1 while generating).
+            req.num_computed_tokens += committed
+            req.ready_for_step = not req.status.is_finished
+            total += committed
+        return total
+
     def _take_sp_plan(self) -> BatchPlan | None:
         """A sequence-parallel long-prefill plan, if one is ready."""
         if not self._sp_enabled:
@@ -334,6 +454,29 @@ class StageEngine:
         plan = sp_plan if sp_plan is not None else self._form_plan()
         if plan.is_empty:
             return StepOutputs(forward=[], finished=self._collect_finished())
+
+        if sp_plan is None:
+            committed = self._try_multistep(plan)
+            if committed is not None:
+                dt = (time.perf_counter() - t0) * 1000.0
+                # Per-layer decode EWMA still feeds scheduler telemetry:
+                # one window = k decode steps.
+                per_layer = (dt / self.cfg.decode_lookahead) / max(
+                    1, self.model.num_local_layers
+                )
+                if self.layer_latency_ms_ewma is None:
+                    self.layer_latency_ms_ewma = per_layer
+                else:
+                    self.layer_latency_ms_ewma = (
+                        0.8 * self.layer_latency_ms_ewma + 0.2 * per_layer
+                    )
+                self._step_count += 1
+                return StepOutputs(
+                    forward=[],
+                    finished=self._collect_finished(),
+                    num_tokens=committed,
+                    step_time_ms=dt,
+                )
 
         hidden = None
         if not self.model.is_first:

@@ -1,0 +1,161 @@
+"""Multi-step decode (k tokens per jit dispatch) — exact parity with the
+single-step engine (the SURVEY §7 "multi-step decode inside one jit" hard
+part)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from parallax_tpu.config import normalize_config
+from parallax_tpu.models.base import StageModel
+from parallax_tpu.runtime.engine import EngineConfig, StageEngine
+from parallax_tpu.runtime.pipeline import InProcessPipeline
+from parallax_tpu.runtime.request import Request, SamplingParams
+
+CFG = normalize_config(dict(
+    architectures=["Qwen2ForCausalLM"], hidden_size=64,
+    num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+    intermediate_size=128, vocab_size=199, max_position_embeddings=512,
+    tie_word_embeddings=False,
+))
+
+
+def _run(lookahead, prompts, max_new=11, eos=None, params=None,
+         page_size=8):
+    model = StageModel(CFG, 0, 2, use_pallas=False)
+    p = params if params is not None else model.init_params(
+        jax.random.key(0), dtype=jnp.float32
+    )
+    eng = StageEngine(model, p, EngineConfig(
+        page_size=page_size, num_pages=128, max_model_len=256,
+        kv_dtype="float32", decode_lookahead=lookahead,
+    ))
+    pipe = InProcessPipeline([eng])
+    reqs = []
+    for i, prompt in enumerate(prompts):
+        req = Request(
+            f"r{i}", prompt_ids=list(prompt),
+            sampling_params=SamplingParams(temperature=0.0,
+                                           max_new_tokens=max_new),
+        )
+        if eos is not None:
+            req.eos_token_ids = eos
+        reqs.append(req)
+        pipe.submit(req)
+    pipe.run_until_complete()
+    return reqs, eng
+
+
+def test_multistep_matches_single_step_exactly():
+    prompts = [[3, 14, 15, 92, 65], [7, 21, 108], [42] * 9]
+    base, _ = _run(1, prompts)
+    multi, eng = _run(4, prompts)
+    for b, m in zip(base, multi):
+        assert m.output_ids == b.output_ids, (b.output_ids, m.output_ids)
+        assert m.status == b.status
+    assert eng._jit_multistep is not None  # the path actually ran
+
+
+def test_multistep_respects_max_tokens_and_eos():
+    # max_new not a multiple of k: surplus window tokens must be discarded.
+    prompts = [[5, 6, 7, 8]]
+    base, _ = _run(1, prompts, max_new=7)
+    multi, _ = _run(4, prompts, max_new=7)
+    assert multi[0].output_ids == base[0].output_ids
+    assert len(multi[0].output_ids) == 7
+    # EOS mid-window: find what greedy produces, set its 3rd token as EOS.
+    probe, _ = _run(1, prompts, max_new=7)
+    eos = (probe[0].output_ids[2],)
+    base2, _ = _run(1, prompts, max_new=7, eos=eos)
+    multi2, _ = _run(4, prompts, max_new=7, eos=eos)
+    assert multi2[0].output_ids == base2[0].output_ids
+    assert multi2[0].status == base2[0].status
+
+
+def test_multistep_prefix_cache_donation_consistent():
+    """After a multistep run, the donated prefix pages must reflect only
+    computed KV (the invariant release() relies on)."""
+    prompts = [[9, 8, 7, 6, 5, 4, 3]]  # 7 tokens + outputs
+    reqs, eng = _run(4, prompts, max_new=9)
+    req = reqs[0]
+    # invariant held throughout: computed == len(all) - 1 at finish
+    assert req.num_computed_tokens == req.total_len - 1
+    # A second request sharing the donated page (prompt + first generated
+    # token completes the first full page) gets cache hits.
+    follow = Request(
+        "f",
+        prompt_ids=list(prompts[0]) + req.output_ids[:2] + [100],
+        sampling_params=SamplingParams(temperature=0.0, max_new_tokens=4),
+    )
+    pipe = InProcessPipeline([eng])
+    pipe.submit(follow)
+    pipe.run_until_complete()
+    assert follow.num_cached_tokens > 0
+    assert len(follow.output_ids) == 4
+
+
+def test_multistep_falls_back_for_sampled_requests():
+    model = StageModel(CFG, 0, 2, use_pallas=False)
+    p = model.init_params(jax.random.key(0), dtype=jnp.float32)
+    eng = StageEngine(model, p, EngineConfig(
+        page_size=8, num_pages=128, max_model_len=256,
+        kv_dtype="float32", decode_lookahead=4,
+    ))
+    pipe = InProcessPipeline([eng])
+    req = Request("s", prompt_ids=[1, 2, 3],
+                  sampling_params=SamplingParams(temperature=1.0,
+                                                 max_new_tokens=5, seed=3))
+    pipe.submit(req)
+    pipe.run_until_complete()
+    assert len(req.output_ids) == 5
+    assert eng._jit_multistep is None  # sampled batch never took the path
+
+
+def test_multistep_mixed_arrivals():
+    """A prefill arriving mid-stream forces normal steps, then decode
+    windows resume; outputs still match the single-step engine."""
+    model = StageModel(CFG, 0, 2, use_pallas=False)
+    params = model.init_params(jax.random.key(0), dtype=jnp.float32)
+
+    def run(lookahead):
+        eng = StageEngine(model, params, EngineConfig(
+            page_size=8, num_pages=128, max_model_len=256,
+            kv_dtype="float32", decode_lookahead=lookahead,
+        ))
+        pipe = InProcessPipeline([eng])
+        r1 = Request("a", prompt_ids=[3, 14, 15],
+                     sampling_params=SamplingParams(temperature=0.0,
+                                                    max_new_tokens=10))
+        pipe.submit(r1)
+        for _ in range(3):
+            pipe.step_round()
+        r2 = Request("b", prompt_ids=[99, 98, 97, 96],
+                     sampling_params=SamplingParams(temperature=0.0,
+                                                    max_new_tokens=6))
+        pipe.submit(r2)
+        pipe.run_until_complete()
+        return r1.output_ids, r2.output_ids
+
+    a1, b1 = run(1)
+    a4, b4 = run(4)
+    assert a4 == a1 and b4 == b1
+
+
+def test_multistep_near_context_limit_falls_back():
+    """total_len + k past max_model_len must fall back to single-step
+    (never overrun the per-seq page table) and still finish correctly."""
+    model = StageModel(CFG, 0, 2, use_pallas=False)
+    p = model.init_params(jax.random.key(0), dtype=jnp.float32)
+    eng = StageEngine(model, p, EngineConfig(
+        page_size=8, num_pages=64, max_model_len=32,
+        kv_dtype="float32", decode_lookahead=8,
+    ))
+    pipe = InProcessPipeline([eng])
+    req = Request("edge", prompt_ids=list(range(1, 25)),  # 24 tokens
+                  sampling_params=SamplingParams(temperature=0.0,
+                                                 max_new_tokens=100))
+    pipe.submit(req)
+    pipe.run_until_complete()
+    # clamped by the engine to the context budget, finished at length
+    assert req.status.value == "finished_length"
+    assert req.total_len <= 32
