@@ -23,9 +23,10 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from parallax_tpu.ops.ragged import ragged_token_positions
+from parallax_tpu.ops.ragged import page_chunks, ragged_token_positions
 
 _MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
 
 
 def _tpu_available() -> bool:
@@ -146,56 +147,71 @@ def _ragged_paged_attention_xla(
     soft_cap: float | None,
     sinks: jax.Array | None,
 ) -> jax.Array:
-    """Jittable pure-XLA fallback (gather KV per token, masked softmax)."""
+    """Jittable pure-XLA path: a ``lax.scan`` over KV page-chunks with
+    online-softmax accumulation, so the gather transient is O(T * chunk)
+    rather than O(T * context) (long-context safety for the sink/window
+    prefill paths that cannot take the bundled Pallas kernel). The sink
+    logit joins the softmax at the end — numerically identical to a
+    virtual key with no value payload."""
     t, num_q_heads, head_dim = q.shape
     _, page_size, combined, _ = kv_pages.shape
     num_kv_heads = combined // 2
     group = num_q_heads // num_kv_heads
     s, pages_per_seq = page_indices.shape
-    kv_cap = pages_per_seq * page_size
 
     # Which sequence does each query token belong to, at what position?
     seq_of_tok, q_pos = ragged_token_positions(kv_lens, cu_q_lens, t, s)
+    kv_len_tok = kv_lens[seq_of_tok]
 
-    # Gather each sequence's K/V: [S, kv_cap, Hkv, D].
-    pages = kv_pages[page_indices.reshape(-1)].reshape(
-        s, kv_cap, combined, head_dim
+    padded_pages, chunk_pages, lc, num_chunks = page_chunks(
+        page_indices, page_size
     )
-    k_seq = pages[:, :, 0::2, :]
-    v_seq = pages[:, :, 1::2, :]
-    # Per-token views: [T, kv_cap, Hkv, D].
-    k_tok = k_seq[seq_of_tok]
-    v_tok = v_seq[seq_of_tok]
-
     qg = q.reshape(t, num_kv_heads, group, head_dim)
-    scores = jnp.einsum(
-        "thgd,tlhd->thgl", qg, k_tok, preferred_element_type=jnp.float32
-    )
-    scores = scores * sm_scale
-    if soft_cap is not None:
-        scores = soft_cap * jnp.tanh(scores / soft_cap)
 
-    kv_pos = jnp.arange(kv_cap, dtype=jnp.int32)
-    valid = (kv_pos[None, :] <= q_pos[:, None]) & (
-        kv_pos[None, :] < kv_lens[seq_of_tok][:, None]
-    )
-    if sliding_window is not None:
-        valid &= kv_pos[None, :] > q_pos[:, None] - sliding_window
-    scores = jnp.where(valid[:, None, None, :], scores, _MASK_VALUE)
+    def body(carry, g):
+        m, l, o = carry
+        pages_g = jax.lax.dynamic_slice_in_dim(
+            padded_pages, g * chunk_pages, chunk_pages, axis=1
+        )
+        rows = kv_pages[pages_g.reshape(-1)].reshape(
+            s, lc, combined, head_dim
+        )
+        k_tok = rows[:, :, 0::2, :][seq_of_tok]      # [T, Lc, Hkv, D]
+        v_tok = rows[:, :, 1::2, :][seq_of_tok]
+        scores = jnp.einsum(
+            "thgd,tlhd->thgl", qg, k_tok,
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        if soft_cap is not None:
+            scores = soft_cap * jnp.tanh(scores / soft_cap)
+        kv_pos = g * lc + jnp.arange(lc, dtype=jnp.int32)
+        valid = (kv_pos[None, :] <= q_pos[:, None]) & (
+            kv_pos[None, :] < kv_len_tok[:, None]
+        )
+        if sliding_window is not None:
+            valid &= kv_pos[None, :] > q_pos[:, None] - sliding_window
+        scores = jnp.where(valid[:, None, None, :], scores, _MASK_VALUE)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        pz = jnp.exp(scores - m_new[..., None])
+        pz = jnp.where(valid[:, None, None, :], pz, 0.0)
+        l_new = l * alpha + jnp.sum(pz, axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "thgl,tlhd->thgd", pz.astype(v_tok.dtype), v_tok,
+            preferred_element_type=jnp.float32,
+        )
+        return (m_new, l_new, o_new), None
 
+    init = (
+        jnp.full((t, num_kv_heads, group), _MASK_VALUE, jnp.float32),
+        jnp.zeros((t, num_kv_heads, group), jnp.float32),
+        jnp.zeros((t, num_kv_heads, group, head_dim), jnp.float32),
+    )
+    (m, l, o), _ = jax.lax.scan(
+        body, init, jnp.arange(num_chunks, dtype=jnp.int32)
+    )
     if sinks is not None:
-        # One virtual key per head with logit `sinks[h]`, no value payload.
         sink = sinks.reshape(num_kv_heads, group).astype(jnp.float32)
-        sink = jnp.broadcast_to(sink[None, :, :, None], (t, num_kv_heads, group, 1))
-        scores = jnp.concatenate([scores, sink], axis=-1)
-
-    m = jnp.max(scores, axis=-1, keepdims=True)
-    unnorm = jnp.exp(scores - m)
-    denom = jnp.sum(unnorm, axis=-1, keepdims=True)
-    probs = (unnorm / jnp.maximum(denom, 1e-30))[..., :kv_cap]
-
-    out = jnp.einsum(
-        "thgl,tlhd->thgd", probs.astype(v_tok.dtype), v_tok,
-        preferred_element_type=jnp.float32,
-    )
+        l = l + jnp.exp(sink[None] - m)
+    out = o / jnp.maximum(l, 1e-30)[..., None]
     return out.reshape(t, num_q_heads, head_dim).astype(q.dtype)

@@ -27,10 +27,11 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from parallax_tpu.ops.ragged import ragged_token_positions
+from parallax_tpu.ops.ragged import page_chunks, ragged_token_positions
 
 _MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 _NEG_INF = float("-inf")
+
 
 
 def new_index_pages(
@@ -74,22 +75,37 @@ def dsa_indexer_scores_xla(
     kv_cap = pages_per_seq * page_size
 
     seq_of_tok, q_pos = ragged_token_positions(kv_lens, cu_q_lens, t, s)
+    kv_len_tok = kv_lens[seq_of_tok]
+    w = weights.astype(jnp.float32)
 
-    keys = index_cache[page_indices.reshape(-1), :, 0, :].reshape(
-        s, kv_cap, d
+    # Chunk the per-head [T, Hi, Lc] intermediate over page groups so the
+    # transient is O(T * Hi * chunk), never O(T * Hi * context); the full
+    # (much smaller) [T, context] score matrix is the output either way.
+    padded_pages, chunk_pages, lc, num_chunks = page_chunks(
+        page_indices, page_size
     )
-    keys_tok = keys[seq_of_tok]                      # [T, L, D]
-    dots = jnp.einsum(
-        "thd,tld->thl", q, keys_tok, preferred_element_type=jnp.float32
-    )
-    scores = jnp.einsum(
-        "th,thl->tl", weights.astype(jnp.float32), jnp.maximum(dots, 0.0)
-    )
-    kv_pos = jnp.arange(kv_cap, dtype=jnp.int32)
-    valid = (kv_pos[None, :] <= q_pos[:, None]) & (
-        kv_pos[None, :] < kv_lens[seq_of_tok][:, None]
-    )
-    return jnp.where(valid, scores, _NEG_INF)
+
+    def body(_, g):
+        pages_g = jax.lax.dynamic_slice_in_dim(
+            padded_pages, g * chunk_pages, chunk_pages, axis=1
+        )
+        keys = index_cache[pages_g.reshape(-1), :, 0, :].reshape(s, lc, d)
+        keys_tok = keys[seq_of_tok]                  # [T, Lc, D]
+        dots = jnp.einsum(
+            "thd,tld->thl", q, keys_tok, preferred_element_type=jnp.float32
+        )
+        sc = jnp.einsum("th,thl->tl", w, jnp.maximum(dots, 0.0))
+        kv_pos = g * lc + jnp.arange(lc, dtype=jnp.int32)
+        valid = (kv_pos[None, :] <= q_pos[:, None]) & (
+            kv_pos[None, :] < kv_len_tok[:, None]
+        )
+        return None, jnp.where(valid, sc, _NEG_INF)
+
+    _, chunks = jax.lax.scan(
+        body, None, jnp.arange(num_chunks, dtype=jnp.int32)
+    )                                                # [G, T, Lc]
+    scores = jnp.transpose(chunks, (1, 0, 2)).reshape(t, num_chunks * lc)
+    return scores[:, :kv_cap]
 
 
 @functools.partial(jax.jit, static_argnames=("index_topk",))

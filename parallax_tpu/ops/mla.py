@@ -21,9 +21,10 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from parallax_tpu.ops.ragged import ragged_token_positions
+from parallax_tpu.ops.ragged import page_chunks, ragged_token_positions
 
 _MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
 
 
 def new_mla_pages(
@@ -103,9 +104,12 @@ def mla_ragged_attention_xla(
 ) -> jax.Array:
     """Returns attention output in latent space: [T, Hq, R].
 
-    The caller up-projects with W_UV. Jittable XLA fallback with the same
-    gather strategy as ``_ragged_paged_attention_xla``; a Pallas flash
-    variant is the optimization path on TPU.
+    The caller up-projects with W_UV. Jittable XLA path; the Pallas flash
+    kernel (``ops/mla_pallas.py``) covers decode on TPU. Long contexts run
+    a ``lax.scan`` over KV page-chunks with online-softmax accumulation so
+    the transient footprint is O(T * chunk), never O(T * context) — the
+    HBM-safety requirement of the reference MLA kernel contract
+    (``kernels/mla/mla.cpp``).
     """
     t, hq, r = q_latent.shape
     p, page_size, _, width = cache.shape
@@ -113,32 +117,53 @@ def mla_ragged_attention_xla(
     kv_cap = pages_per_seq * page_size
 
     seq_of_tok, q_pos = ragged_token_positions(kv_lens, cu_q_lens, t, s)
+    kv_len_tok = kv_lens[seq_of_tok]
 
-    rows = cache[page_indices.reshape(-1), :, 0, :].reshape(s, kv_cap, width)
-    latent_seq = rows[..., :kv_lora_rank]
-    rope_seq = rows[..., kv_lora_rank:]
-    latent_tok = latent_seq[seq_of_tok]   # [T, L, R]
-    rope_tok = rope_seq[seq_of_tok]       # [T, L, Dr]
-
-    scores = (
-        jnp.einsum("thr,tlr->thl", q_latent, latent_tok,
-                   preferred_element_type=jnp.float32)
-        + jnp.einsum("thd,tld->thl", q_pe, rope_tok,
-                     preferred_element_type=jnp.float32)
-    ) * sm_scale
-
-    kv_pos = jnp.arange(kv_cap, dtype=jnp.int32)
-    valid = (kv_pos[None, :] <= q_pos[:, None]) & (
-        kv_pos[None, :] < kv_lens[seq_of_tok][:, None]
+    # Chunk over whole pages; fall back to a single pass for short caps.
+    padded_pages, chunk_pages, lc, num_chunks = page_chunks(
+        page_indices, page_size
     )
-    scores = jnp.where(valid[:, None, :], scores, _MASK_VALUE)
-    m = jnp.max(scores, axis=-1, keepdims=True)
-    unnorm = jnp.exp(scores - m)
-    probs = unnorm / jnp.maximum(
-        jnp.sum(unnorm, axis=-1, keepdims=True), 1e-30
+
+    def body(carry, g):
+        m, l, o = carry
+        pages_g = jax.lax.dynamic_slice_in_dim(
+            padded_pages, g * chunk_pages, chunk_pages, axis=1
+        )
+        rows = cache[pages_g.reshape(-1), :, 0, :].reshape(s, lc, width)
+        rows_tok = rows[seq_of_tok]                  # [T, Lc, width]
+        latent = rows_tok[..., :kv_lora_rank]
+        rope = rows_tok[..., kv_lora_rank:]
+        scores = (
+            jnp.einsum("thr,tlr->thl", q_latent, latent,
+                       preferred_element_type=jnp.float32)
+            + jnp.einsum("thd,tld->thl", q_pe, rope,
+                         preferred_element_type=jnp.float32)
+        ) * sm_scale
+        kv_pos = g * lc + jnp.arange(lc, dtype=jnp.int32)
+        valid = (kv_pos[None, :] <= q_pos[:, None]) & (
+            kv_pos[None, :] < kv_len_tok[:, None]
+        )
+        scores = jnp.where(valid[:, None, :], scores, _MASK_VALUE)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        pz = jnp.exp(scores - m_new[..., None])
+        pz = jnp.where(valid[:, None, :], pz, 0.0)
+        l_new = l * alpha + jnp.sum(pz, axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "thl,tlr->thr", pz.astype(latent.dtype), latent,
+            preferred_element_type=jnp.float32,
+        )
+        return (m_new, l_new, o_new), None
+
+    init = (
+        jnp.full((t, hq), _MASK_VALUE, jnp.float32),
+        jnp.zeros((t, hq), jnp.float32),
+        jnp.zeros((t, hq, r), jnp.float32),
     )
-    out = jnp.einsum("thl,tlr->thr", probs.astype(latent_tok.dtype),
-                     latent_tok, preferred_element_type=jnp.float32)
+    (m, l, o), _ = jax.lax.scan(
+        body, init, jnp.arange(num_chunks, dtype=jnp.int32)
+    )
+    out = o / jnp.maximum(l, 1e-30)[..., None]
     return out.astype(q_latent.dtype)
 
 

@@ -28,7 +28,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from parallax_tpu.ops.ragged import ragged_token_positions
+from parallax_tpu.ops.ragged import page_chunks, ragged_token_positions
 
 from parallax_tpu.ops.dsa import new_index_pages, store_index_cache  # noqa: F401 (re-export)
 
@@ -70,28 +70,47 @@ def msa_sparse_positions_xla(
     nb = (kv_cap + block_size - 1) // block_size
 
     seq_of_tok, q_pos = ragged_token_positions(kv_lens, cu_q_lens, t, s)
+    kv_len_tok = kv_lens[seq_of_tok]
 
-    keys = index_cache[page_indices.reshape(-1), :, 0, :].reshape(
-        s, kv_cap, d
+    # Chunk the per-head intermediate over page groups (O(T*Hi*chunk)
+    # transient); the block max decomposes as max-over-tokens of
+    # max-over-heads, so only the [T, context] per-token maxima
+    # materialize.
+    padded_pages, chunk_pages, lc, num_chunks = page_chunks(
+        page_indices, page_size
     )
-    keys_tok = keys[seq_of_tok]                  # [T, L, D]
-    scores = jnp.einsum(
-        "thd,tld->thl", idx_q, keys_tok, preferred_element_type=jnp.float32
-    ) * sm_scale
 
-    kv_pos = jnp.arange(kv_cap, dtype=jnp.int32)
-    valid = (kv_pos[None, :] <= q_pos[:, None]) & (
-        kv_pos[None, :] < kv_lens[seq_of_tok][:, None]
+    def body(_, g):
+        pages_g = jax.lax.dynamic_slice_in_dim(
+            padded_pages, g * chunk_pages, chunk_pages, axis=1
+        )
+        keys = index_cache[pages_g.reshape(-1), :, 0, :].reshape(s, lc, d)
+        keys_tok = keys[seq_of_tok]
+        sc = jnp.einsum(
+            "thd,tld->thl", idx_q, keys_tok,
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        sc = jnp.max(sc, axis=1)                 # max over index heads
+        kv_pos = g * lc + jnp.arange(lc, dtype=jnp.int32)
+        valid = (kv_pos[None, :] <= q_pos[:, None]) & (
+            kv_pos[None, :] < kv_len_tok[:, None]
+        )
+        return None, jnp.where(valid, sc, _NEG_INF)
+
+    _, chunks = jax.lax.scan(
+        body, None, jnp.arange(num_chunks, dtype=jnp.int32)
     )
-    scores = jnp.where(valid[:, None, :], scores, _NEG_INF)
+    token_scores = jnp.transpose(chunks, (1, 0, 2)).reshape(
+        t, num_chunks * lc
+    )[:, :kv_cap]
 
-    # Block score: max over index heads and block tokens.
+    # Block score: max over block tokens (heads already reduced).
     pad = nb * block_size - kv_cap
     if pad:
-        scores = jnp.pad(scores, ((0, 0), (0, 0), (0, pad)),
-                         constant_values=_NEG_INF)
+        token_scores = jnp.pad(token_scores, ((0, 0), (0, pad)),
+                               constant_values=_NEG_INF)
     block_scores = jnp.max(
-        scores.reshape(t, hi, nb, block_size), axis=(1, 3)
+        token_scores.reshape(t, nb, block_size), axis=2
     )                                            # [T, NB]
 
     blocks = jnp.arange(nb, dtype=jnp.int32)

@@ -32,3 +32,29 @@ def ragged_token_positions(
     q_len = cu_q_lens[seq_of_tok + 1] - cu_q_lens[seq_of_tok]
     q_pos = kv_lens[seq_of_tok] - q_len + (token_ids - cu_q_lens[seq_of_tok])
     return seq_of_tok, q_pos
+
+
+# KV rows per online-softmax / scoring chunk in the XLA paths: bounds each
+# op's gather transient at O(T * chunk) instead of O(T * context).
+KV_CHUNK_ROWS = 512
+
+
+def page_chunks(page_indices: jax.Array, page_size: int,
+                chunk_rows: int | None = None):
+    """Split a page table into page-group chunks for lax.scan.
+
+    Returns ``(padded_pages, chunk_pages, rows_per_chunk, num_chunks)``;
+    the table is zero-padded so every chunk is full (position masking in
+    the caller hides the padding — page 0 is the reserved null page).
+    """
+    import jax.numpy as jnp
+
+    s, pages_per_seq = page_indices.shape
+    rows = chunk_rows if chunk_rows is not None else KV_CHUNK_ROWS
+    chunk_pages = max(1, rows // page_size)
+    if chunk_pages >= pages_per_seq:
+        chunk_pages = pages_per_seq
+    num_chunks = (pages_per_seq + chunk_pages - 1) // chunk_pages
+    pad = num_chunks * chunk_pages - pages_per_seq
+    padded = jnp.pad(page_indices, ((0, 0), (0, pad))) if pad else page_indices
+    return padded, chunk_pages, chunk_pages * page_size, num_chunks

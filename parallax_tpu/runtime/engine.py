@@ -459,17 +459,8 @@ class StageEngine:
             committed = self._try_multistep(plan)
             if committed is not None:
                 dt = (time.perf_counter() - t0) * 1000.0
-                # Per-layer decode EWMA still feeds scheduler telemetry:
-                # one window = k decode steps.
-                per_layer = (dt / self.cfg.decode_lookahead) / max(
-                    1, self.model.num_local_layers
-                )
-                if self.layer_latency_ms_ewma is None:
-                    self.layer_latency_ms_ewma = per_layer
-                else:
-                    self.layer_latency_ms_ewma = (
-                        0.8 * self.layer_latency_ms_ewma + 0.2 * per_layer
-                    )
+                # One window = k decode steps for the latency EWMA.
+                self._update_latency_ewma(dt / self.cfg.decode_lookahead)
                 self._step_count += 1
                 return StepOutputs(
                     forward=[],
@@ -733,7 +724,12 @@ class StageEngine:
     def _record_latency(self, plan: BatchPlan, ms: float) -> None:
         if plan.has_prefill or plan.is_empty:
             return
-        per_layer = ms / max(1, self.model.num_local_layers)
+        self._update_latency_ewma(ms)
+
+    def _update_latency_ewma(self, step_ms: float) -> None:
+        """Per-layer decode latency EWMA published to the global scheduler
+        (reference base_executor.py:716-732)."""
+        per_layer = step_ms / max(1, self.model.num_local_layers)
         if self.layer_latency_ms_ewma is None:
             self.layer_latency_ms_ewma = per_layer
         else:

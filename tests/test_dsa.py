@@ -360,3 +360,27 @@ def test_v32_chunked_prefill_matches_unchunked():
     pipe.submit(req)
     pipe.run_until_complete()
     assert req.output_ids == full["r0"]
+
+
+def test_indexer_scores_chunked_scan_matches_single_pass(monkeypatch):
+    """Force multiple scoring chunks; the recombined [T, kv_cap] scores
+    must equal the single-pass result exactly."""
+    import parallax_tpu.ops.dsa as dsa_mod
+    import parallax_tpu.ops.ragged as ragged_mod
+
+    rng = np.random.default_rng(12)
+    page_size, num_pages = 4, 32
+    ctx, hi, d = 60, 3, 16
+    page_ids = list(range(1, 17))
+    keys = rng.standard_normal((ctx, d)).astype(np.float32)
+    cache = _fill_index_cache(keys, page_size, num_pages, page_ids, d)
+    q = rng.standard_normal((5, hi, d)).astype(np.float32)
+    w = rng.standard_normal((5, hi)).astype(np.float32)
+    args = (jnp.asarray(q), jnp.asarray(w), cache,
+            jnp.asarray([ctx], jnp.int32)[:1].repeat(1),
+            jnp.asarray([page_ids], jnp.int32),
+            jnp.asarray([0, 5], jnp.int32))
+    single = np.asarray(dsa_indexer_scores_xla(*args))
+    monkeypatch.setattr(ragged_mod, "KV_CHUNK_ROWS", 8)  # 8 chunks
+    chunked = np.asarray(dsa_indexer_scores_xla.__wrapped__(*args))
+    np.testing.assert_allclose(chunked, single, rtol=1e-6, atol=1e-6)

@@ -157,3 +157,28 @@ def test_gqa_decode_kernel_matches_xla_oracle(window, use_sinks):
     )
     np.testing.assert_allclose(np.asarray(out), np.asarray(oracle),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_mla_xla_chunked_scan_matches_single_pass(monkeypatch):
+    """Force multiple online-softmax chunks and require equality with the
+    single-pass computation (chunking must be numerically invisible)."""
+    import parallax_tpu.ops.mla as mla_mod
+    import parallax_tpu.ops.ragged as ragged_mod
+
+    rng = np.random.default_rng(9)
+    page_size, pages_per_seq = 8, 8      # kv_cap 64
+    lens = [50, 7, 64]
+    s, hq, r, dr = 3, 4, 32, 16
+    q_latent, q_pe, cache, kv_lens, page_indices = _setup(
+        rng, s, hq, r, dr, page_size, pages_per_seq, lens
+    )
+    cu = jnp.asarray(np.arange(s + 1, dtype=np.int32))
+    args = (q_latent, q_pe, cache, kv_lens, page_indices, cu,
+            jnp.asarray([s], jnp.int32))
+    kw = dict(sm_scale=0.25, kv_lora_rank=r)
+    single = np.asarray(mla_ragged_attention_xla(*args, **kw))
+    monkeypatch.setattr(ragged_mod, "KV_CHUNK_ROWS", 16)  # 4 chunks
+    chunked = np.asarray(
+        mla_mod.mla_ragged_attention_xla.__wrapped__(*args, **kw)
+    )
+    np.testing.assert_allclose(chunked, single, rtol=2e-5, atol=2e-5)

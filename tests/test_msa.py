@@ -310,3 +310,25 @@ def test_m3_pipeline_matches_single_stage():
     single = _generate(CONFIG, [(0, 3)], [prompt], params_src=sliced)
     multi = _generate(CONFIG, [(0, 2), (2, 3)], [prompt], params_src=sliced)
     assert single["r0"] == multi["r0"]
+
+
+def test_msa_positions_chunked_scan_matches_single_pass(monkeypatch):
+    import parallax_tpu.ops.msa as msa_mod
+    import parallax_tpu.ops.ragged as ragged_mod
+
+    rng = np.random.default_rng(13)
+    page_size, num_pages, bs = 4, 32, 4
+    ctx, hi, d = 60, 2, 8
+    page_ids = list(range(1, 17))
+    keys = rng.standard_normal((ctx, d)).astype(np.float32)
+    cache = _index_cache_with(keys, page_size, num_pages, page_ids)
+    q = rng.standard_normal((3, hi, d)).astype(np.float32)
+    args = (jnp.asarray(q), cache, jnp.asarray([ctx], jnp.int32),
+            jnp.asarray([page_ids], jnp.int32),
+            jnp.asarray([0, 3], jnp.int32))
+    kw = dict(block_size=bs, topk_blocks=4, init_blocks=1, local_blocks=1,
+              sm_scale=0.5)
+    single = np.asarray(msa_sparse_positions_xla(*args, **kw))
+    monkeypatch.setattr(ragged_mod, "KV_CHUNK_ROWS", 8)  # 8 chunks
+    chunked = np.asarray(msa_sparse_positions_xla.__wrapped__(*args, **kw))
+    np.testing.assert_array_equal(chunked, single)

@@ -221,3 +221,46 @@ def test_matches_bundled_ref_impl():
     np.testing.assert_allclose(
         np.asarray(ours), np.asarray(theirs), rtol=2e-4, atol=2e-4
     )
+
+
+def test_gqa_xla_chunked_scan_matches_single_pass(monkeypatch):
+    """Force multiple online-softmax chunks (window + sinks active) and
+    require equality with the single-chunk computation."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import parallax_tpu.ops.attention as att
+    import parallax_tpu.ops.ragged as ragged_mod
+    from parallax_tpu.ops.kv_cache_ops import new_kv_pages, reshape_and_cache
+
+    rng = np.random.default_rng(11)
+    page_size, pages_per_seq = 8, 8   # kv_cap 64
+    lens = [50, 7, 64]
+    s, hq, hkv, d = 3, 4, 2, 16
+    kv = new_kv_pages(s * pages_per_seq + 1, page_size, hkv, d, jnp.float32)
+    page_indices = np.zeros((s, pages_per_seq), np.int32)
+    nxt = 1
+    for i, ln in enumerate(lens):
+        need = (ln + page_size - 1) // page_size
+        page_indices[i, :need] = np.arange(nxt, nxt + need)
+        nxt += need
+        k = rng.standard_normal((ln, hkv, d)).astype(np.float32)
+        v = rng.standard_normal((ln, hkv, d)).astype(np.float32)
+        slots = np.array([
+            page_indices[i, t_ // page_size] * page_size + t_ % page_size
+            for t_ in range(ln)
+        ], np.int32)
+        kv = reshape_and_cache(kv, jnp.asarray(k), jnp.asarray(v),
+                               jnp.asarray(slots))
+    q = jnp.asarray(rng.standard_normal((s, hq, d)).astype(np.float32))
+    sinks = jnp.asarray(rng.standard_normal((hq,)).astype(np.float32))
+    args = (q, kv, jnp.asarray(lens, jnp.int32), jnp.asarray(page_indices),
+            jnp.asarray(np.arange(s + 1, dtype=np.int32)),
+            jnp.asarray([s], jnp.int32))
+    kw = dict(sm_scale=0.25, sliding_window=24, soft_cap=30.0, sinks=sinks)
+    single = np.asarray(att._ragged_paged_attention_xla(*args, **kw))
+    monkeypatch.setattr(ragged_mod, "KV_CHUNK_ROWS", 16)  # 4 chunks
+    chunked = np.asarray(
+        att._ragged_paged_attention_xla.__wrapped__(*args, **kw)
+    )
+    np.testing.assert_allclose(chunked, single, rtol=2e-5, atol=2e-5)
