@@ -254,6 +254,12 @@ class StageModel:
         x = x[inputs.logits_indices]
         head = params.get("lm_head") or params["embed_tokens"]
         logits = L.lm_head_logits(x, head)
+        if self.axis_name is not None and self._lm_head_sharded:
+            # Vocab-sharded head (tp.lm_head_vocab_sharded — set by
+            # tp_stage_fn): gather the [S, V/tp] slices on ICI.
+            logits = jax.lax.all_gather(
+                logits, self.axis_name, axis=1, tiled=True
+            )
         return logits, new_kv
 
     # Sequence-parallel mode: set by the engine's SP dispatch wrapper while
@@ -261,6 +267,8 @@ class StageModel:
     # ``sp`` mesh axis instead of the paged-cache read).
     sp_mesh = None
     _sp_active = False
+    # Set by tp.tp_stage_fn when the lm_head weight is vocab-sharded.
+    _lm_head_sharded = False
 
     def _attention(self, lp: dict, h: jax.Array, kv: jax.Array,
                    inputs: BatchInputs, window: int | None):

@@ -47,8 +47,6 @@ def page_chunks(page_indices: jax.Array, page_size: int,
     the table is zero-padded so every chunk is full (position masking in
     the caller hides the padding — page 0 is the reserved null page).
     """
-    import jax.numpy as jnp
-
     s, pages_per_seq = page_indices.shape
     rows = chunk_rows if chunk_rows is not None else KV_CHUNK_ROWS
     chunk_pages = max(1, rows // page_size)

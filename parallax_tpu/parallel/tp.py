@@ -31,7 +31,7 @@ _COLUMN = {
 _ROW = {"o_proj", "down_proj"}
 
 
-def _spec_for(path: tuple[str, ...], leaf_value=None) -> P:
+def _spec_for(path: tuple[str, ...], leaf_value=None, tp: int | None = None) -> P:
     if len(path) >= 2:
         parent, leaf = path[-2], path[-1]
         if parent == "experts":
@@ -47,7 +47,21 @@ def _spec_for(path: tuple[str, ...], leaf_value=None) -> P:
             return P(None, "tp")
     if path[-1] == "sinks":
         return P("tp")
-    return P()  # replicated (norms, embed, lm_head, router, row biases)
+    if (
+        len(path) >= 2 and path[-2] == "lm_head" and path[-1] == "weight"
+        and tp is not None
+        and getattr(leaf_value, "ndim", 0) == 2
+        and leaf_value.shape[0] % tp == 0
+    ):
+        # Vocab-sharded head: each chip computes a [S, V/tp] logits slice,
+        # all-gathered on ICI inside the stage fn (base.py __call__) — the
+        # full-vocab matmul FLOPs and the [V, H] weight split tp ways.
+        # Guarded: tied-embedding models have no "lm_head" entry, quantized
+        # heads have no "weight" leaf, and indivisible vocabs stay
+        # replicated — ``lm_head_vocab_sharded`` is the single predicate
+        # the model's all_gather must agree with.
+        return P("tp", None)
+    return P()  # replicated (norms, embed, router, row biases)
 
 
 def _tree_map_with_path(fn, tree, path=()):
@@ -59,10 +73,22 @@ def _tree_map_with_path(fn, tree, path=()):
     return fn(path, tree)
 
 
-def stage_param_specs(params: dict) -> dict:
+def stage_param_specs(params: dict, tp: int | None = None) -> dict:
     """PartitionSpec pytree matching a stage param tree."""
     return _tree_map_with_path(
-        lambda path, leaf: _spec_for(path, leaf), params
+        lambda path, leaf: _spec_for(path, leaf, tp), params
+    )
+
+
+def lm_head_vocab_sharded(params: dict, tp: int) -> bool:
+    """Whether ``stage_param_specs`` vocab-shards this tree's lm_head (the
+    model's logits all_gather must fire exactly when this holds)."""
+    head = params.get("lm_head")
+    return (
+        isinstance(head, dict)
+        and "weight" in head
+        and getattr(head["weight"], "ndim", 0) == 2
+        and head["weight"].shape[0] % tp == 0
     )
 
 
@@ -100,7 +126,7 @@ def kv_partition_specs(model) -> list:
 
 def shard_params(params: dict, mesh: Mesh) -> dict:
     """Place a (host/global) param tree onto the mesh with TP sharding."""
-    specs = stage_param_specs(params)
+    specs = stage_param_specs(params, tp=mesh.shape["tp"])
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
     )
@@ -117,8 +143,9 @@ def tp_stage_fn(model, params_template: dict, mesh: Mesh):
     for jit with KV donation. The model must have been constructed with
     ``tp_size = mesh.shape['tp']`` so its per-shard head counts match.
     """
-    param_specs = stage_param_specs(params_template)
     tp = mesh.shape["tp"]
+    param_specs = stage_param_specs(params_template, tp=tp)
+    model._lm_head_sharded = lm_head_vocab_sharded(params_template, tp)
 
     def fn(params, kv_caches, inputs):
         return model(params, kv_caches, inputs)
