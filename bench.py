@@ -89,12 +89,16 @@ def main():
             )
             lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
             if out.returncode == 0 and lines:
-                result = json.loads(lines[-1])
-                if attempt_env:  # CPU fallback: record what the TPU did
-                    result.setdefault("detail", {})[
-                        "tpu_relay"
-                    ] = _relay_evidence()
-                print(json.dumps(result))
+                try:
+                    result = json.loads(lines[-1])
+                    if attempt_env:  # CPU fallback: record the TPU story
+                        result.setdefault("detail", {})[
+                            "tpu_relay"
+                        ] = _relay_evidence()
+                    print(json.dumps(result))
+                except ValueError:
+                    # Never lose the driver's JSON line to a parse hiccup.
+                    print(lines[-1])
                 return
             sys.stderr.write(out.stderr[-2000:] + "\n")
         except subprocess.TimeoutExpired:
@@ -112,29 +116,30 @@ def _relay_evidence() -> dict:
     states loudly WHY there is no TPU number (wedged single-claim relay:
     backend init hangs, then 'UNAVAILABLE: TPU backend setup/compile
     error')."""
+    import re
+
     ev = {"status": "unknown"}
     log = "/tmp/tpu_retry.log"
     try:
         with open(log, encoding="utf-8", errors="replace") as f:
             text = f.read()
-        attempts = text.count("attempt ")
-        failures = text.count("failed")
-        unavailable = text.count("UNAVAILABLE")
+        failed_attempts = len(re.findall(r"attempt \d+ failed", text))
+        # Quote the actual last error line rather than assuming one.
+        err_lines = [
+            l.strip() for l in text.splitlines()
+            if "UNAVAILABLE" in l or "Unable to initialize backend" in l
+        ]
         ev = {
-            "status": "wedged" if failures and unavailable else "unclear",
-            "retry_attempts_this_session": failures,
-            "error": (
-                "RuntimeError: Unable to initialize backend 'axon': "
-                "UNAVAILABLE: TPU backend setup/compile error"
-                if unavailable else None
-            ),
+            "status": "wedged" if failed_attempts and err_lines
+            else "unclear",
+            "failed_retry_attempts_this_session": failed_attempts,
+            "last_error": err_lines[-1][-300:] if err_lines else None,
             "note": (
                 "single-claim axon relay never recovered during the "
-                "session; every attempt (spaced ~25 min) hung at backend "
-                "init then failed UNAVAILABLE"
-            ) if failures >= 2 else None,
+                "session: repeated bench attempts hung at backend init "
+                "then failed with the error above"
+            ) if failed_attempts >= 2 and err_lines else None,
         }
-        _ = attempts
     except OSError:
         pass
     return ev

@@ -45,6 +45,10 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--decode-lookahead", type=int, default=1,
                        help="greedy decode tokens per jit dispatch "
                             "(single-stage serving; 1 = off)")
+    serve.add_argument("--speculative-tokens", type=int, default=0,
+                       help="prompt-lookup speculative decoding: propose "
+                            "up to N continuation tokens from n-gram "
+                            "matches, verified in one forward (0 = off)")
     serve.add_argument("--sp-size", type=int, default=0,
                        help="ring-attention sequence parallelism over this "
                             "many devices for long-prompt prefill")

@@ -63,6 +63,7 @@ def assemble(
     with_dense_map: bool = False,
     pad_position: int = 0,
     decode_only: bool = False,
+    gather_all_logits: bool = False,
 ) -> BatchInputs:
     """Build fixed-shape arrays from a ragged plan.
 
@@ -107,6 +108,11 @@ def assemble(
         logits_indices[i] = row + n - 1
         row += n
     cu_q_lens[s_real + 1 :] = cu_q_lens[s_real]
+    if gather_all_logits:
+        # Speculative verification needs logits at EVERY fed position, not
+        # just each sequence's last token; its length defines the logits
+        # row count, which nothing ties to the seq bucket.
+        logits_indices = np.arange(t, dtype=np.int32)
 
     state_slots = dense_map = q_lens_arr = None
     if with_dense_map:

@@ -29,7 +29,7 @@ from parallax_tpu.runtime.request import (
     RequestStatus,
     SamplingParams,
 )
-from parallax_tpu.runtime.scheduler import BatchPlan, Scheduler
+from parallax_tpu.runtime.scheduler import BatchPlan, ScheduledSeq, Scheduler
 from parallax_tpu.utils import get_logger
 
 logger = get_logger(__name__)
@@ -56,6 +56,13 @@ class EngineConfig:
     # over forward+argmax) — the SURVEY's "k tokens per dispatch" lever
     # against per-token host dispatch latency. 1 = off.
     decode_lookahead: int = 1
+    # Speculative decoding (prompt-lookup / n-gram): propose up to this
+    # many continuation tokens from earlier context matches and verify
+    # them in ONE forward (greedy acceptance). 0 = off. Composes with the
+    # same eligibility rules as decode_lookahead; speculation wins when a
+    # proposal exists, lookahead otherwise.
+    speculative_tokens: int = 0
+    speculative_ngram: int = 3
 
 
 @dataclasses.dataclass
@@ -434,6 +441,130 @@ class StageEngine:
             total += committed
         return total
 
+    # -- speculative decoding (prompt-lookup) -----------------------------
+
+    # Host-side proposal scan is bounded to this many trailing tokens per
+    # sequence so the per-step cost stays O(batch * window), not
+    # O(batch * context).
+    _SPEC_LOOKBACK = 512
+
+    @classmethod
+    def _ngram_proposal(cls, tokens: list[int], n: int, k: int) -> list[int]:
+        """Propose up to ``k`` continuation tokens: find the most recent
+        earlier occurrence of the trailing ``n``-gram within the lookback
+        window and copy what followed it (prompt-lookup decoding — exact
+        for repetitive spans, free to verify)."""
+        if len(tokens) <= n:
+            return []
+        window = tokens[-cls._SPEC_LOOKBACK:]
+        tail = window[-n:]
+        for start in range(len(window) - n - 1, -1, -1):
+            if window[start:start + n] == tail:
+                follow = window[start + n : start + n + k]
+                if follow:
+                    return list(follow)
+        return []
+
+    def _try_speculative(self, plan: BatchPlan) -> int | None:
+        """Greedy speculative decode: extend each decode row with its
+        n-gram proposal, verify all positions in one forward, commit the
+        longest agreeing prefix plus the bonus token. Returns the commit
+        count, or None to use another path.
+
+        Exactness: position ``j``'s argmax depends only on tokens before
+        it, which match the true greedy stream up to the first proposal
+        mismatch — everything committed is exactly what single-step greedy
+        would have produced. KV written for rejected suffixes lies past
+        the committed context and is overwritten position-by-position by
+        later steps.
+        """
+        k = self.cfg.speculative_tokens
+        if (
+            k <= 0
+            or not (self.model.is_first and self.model.is_last)
+            or self._needs_state
+            or self.mesh is not None
+        ):
+            return None
+        for seg in plan.seqs:
+            sp = seg.request.sampling_params
+            if (
+                seg.num_new_tokens != 1
+                or sp.temperature > 0.0
+                or sp.seed is not None
+                or sp.presence_penalty
+                or sp.frequency_penalty
+                or sp.repetition_penalty != 1.0
+            ):
+                return None
+
+        proposals: list[list[int]] = []
+        any_proposal = False
+        # Each row feeds >= 1 token; proposals must also fit the batch
+        # token budget (and thus the largest assemble bucket).
+        spare = self.cfg.max_num_tokens_per_batch - len(plan.seqs)
+        for seg in plan.seqs:
+            req = seg.request
+            budget = min(
+                k, spare, self.cfg.max_model_len - req.total_len - 1
+            )
+            prop = (
+                self._ngram_proposal(
+                    req.all_token_ids, self.cfg.speculative_ngram, budget
+                )
+                if budget > 0 else []
+            )
+            spare -= len(prop)
+            proposals.append(prop)
+            any_proposal = any_proposal or bool(prop)
+        if not any_proposal:
+            return None
+        for seg, prop in zip(plan.seqs, proposals):
+            if not self.cache.ensure_capacity(
+                seg.request, seg.request.total_len + len(prop)
+            ):
+                return None   # soft fallback; normal path owns aborts
+
+        spec_segs = [
+            ScheduledSeq(
+                request=seg.request,
+                num_new_tokens=1 + len(prop),
+                token_ids=list(seg.token_ids) + prop,
+                context_len=seg.context_len + len(prop),
+            )
+            for seg, prop in zip(plan.seqs, proposals)
+        ]
+        spec_plan = BatchPlan(spec_segs)
+        inputs = assemble(
+            spec_plan, self.spec, self.cfg.page_size, gather_all_logits=True
+        )
+        logits, self.kv = self._jit_step(self.params, self.kv, inputs)
+        from parallax_tpu.ops.sampling import greedy_tokens
+
+        greedy = np.asarray(greedy_tokens(logits))      # [T_bucket]
+
+        total = 0
+        row = 0
+        for seg, prop in zip(spec_segs, proposals):
+            req = seg.request
+            n_fed = seg.num_new_tokens
+            g = greedy[row : row + n_fed]
+            row += n_fed
+            committed = 0
+            for j in range(n_fed):
+                if req.status.is_finished:
+                    break
+                req.commit_token(int(g[j]))
+                committed += 1
+                # Keep accepting while the next fed token agrees with what
+                # greedy just produced (the proposal position j).
+                if j < len(prop) and prop[j] != int(g[j]):
+                    break
+            req.num_computed_tokens += committed
+            req.ready_for_step = not req.status.is_finished
+            total += committed
+        return total
+
     def _take_sp_plan(self) -> BatchPlan | None:
         """A sequence-parallel long-prefill plan, if one is ready."""
         if not self._sp_enabled:
@@ -456,11 +587,14 @@ class StageEngine:
             return StepOutputs(forward=[], finished=self._collect_finished())
 
         if sp_plan is None:
-            committed = self._try_multistep(plan)
+            committed = self._try_speculative(plan)
+            ewma_steps = 1  # speculation = one forward's worth of latency
+            if committed is None:
+                committed = self._try_multistep(plan)
+                ewma_steps = self.cfg.decode_lookahead
             if committed is not None:
                 dt = (time.perf_counter() - t0) * 1000.0
-                # One window = k decode steps for the latency EWMA.
-                self._update_latency_ewma(dt / self.cfg.decode_lookahead)
+                self._update_latency_ewma(dt / ewma_steps)
                 self._step_count += 1
                 return StepOutputs(
                     forward=[],
