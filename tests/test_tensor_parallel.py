@@ -124,3 +124,63 @@ def test_tied_embeddings_split_pipeline():
     pipe.submit(req)
     pipe.run_until_complete()
     assert len(req.output_ids) == 4
+
+
+def test_dsa_model_engine_with_tp_mesh():
+    """DeepSeek-V3.2 under tp=2: tuple (latent, index) cache specs must
+    build and the engine must generate (index caches replicated, MLA heads
+    sharded)."""
+    import numpy as np
+
+    from parallax_tpu.config import normalize_config
+    from parallax_tpu.models.registry import create_stage_model
+    from parallax_tpu.parallel import make_mesh
+    from parallax_tpu.runtime.engine import EngineConfig, StageEngine
+    from parallax_tpu.runtime.pipeline import InProcessPipeline
+    from parallax_tpu.runtime.request import Request, SamplingParams
+
+    cfg = normalize_config(dict(
+        architectures=["DeepseekV32ForCausalLM"], hidden_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        kv_lora_rank=32, q_lora_rank=48, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16, index_n_heads=4,
+        index_head_dim=32, index_topk=16,
+        # GLM-style: layer 1 shares layer 0's top-k (exercises the
+        # (latent, None) tuple spec).
+        index_topk_freq=2, index_skip_topk_offset=0,
+        intermediate_size=128, moe_intermediate_size=32,
+        n_routed_experts=4, num_experts_per_tok=2, first_k_dense_replace=2,
+        vocab_size=199, rope_interleave=True,
+        max_position_embeddings=512, tie_word_embeddings=False,
+    ))
+    mesh = make_mesh(tp_size=2)
+    model = create_stage_model(cfg, 0, 2, use_pallas=False, tp_size=2)
+    eng = StageEngine(
+        model, model.init_params(jax.random.key(0), dtype=jnp.float32),
+        EngineConfig(page_size=8, num_pages=64, max_model_len=128,
+                     kv_dtype="float32"),
+        mesh=mesh,
+    )
+    pipe = InProcessPipeline([eng])
+    req = Request("tp-dsa", prompt_ids=[int(x) for x in
+                                        np.arange(1, 25)],
+                  sampling_params=SamplingParams(temperature=0.0,
+                                                 max_new_tokens=4))
+    pipe.submit(req)
+    pipe.run_until_complete()
+    assert len(req.output_ids) == 4
+
+    # TP output must match the unsharded engine exactly.
+    m1 = create_stage_model(cfg, 0, 2, use_pallas=False)
+    e1 = StageEngine(
+        m1, m1.init_params(jax.random.key(0), dtype=jnp.float32),
+        EngineConfig(page_size=8, num_pages=64, max_model_len=128,
+                     kv_dtype="float32"),
+    )
+    p1 = InProcessPipeline([e1])
+    r1 = Request("base", prompt_ids=[int(x) for x in np.arange(1, 25)],
+                 sampling_params=SamplingParams(temperature=0.0,
+                                                max_new_tokens=4))
+    p1.submit(r1)
+    p1.run_until_complete()
+    assert req.output_ids == r1.output_ids
