@@ -45,7 +45,14 @@ def _scatter_ragged(
     )
 
 
-@register_model("Qwen3NextForCausalLM")
+@register_model(
+    "Qwen3NextForCausalLM",
+    # Qwen3.5 reuses the Qwen3-Next hybrid block wholesale (reference
+    # qwen3_5.py imports ParallaxQwen3NextAttention and maps the MoE
+    # variant onto the same class, shard_loader.py:37-43).
+    "Qwen3_5ForConditionalGeneration",
+    "Qwen3_5MoeForConditionalGeneration",
+)
 class Qwen3NextStageModel(MoEStageModel):
     # Qwen3-Next norms are zero-init Gemma-style (1 + w); the gated output
     # norm inside GatedDeltaNet keeps plain ones-init weights.

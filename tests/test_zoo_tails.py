@@ -101,3 +101,72 @@ def test_step3p5_generates_with_windows_and_gate():
               np.random.default_rng(0).integers(1, 198, size=30)]
     out = _generate(STEP3P5, [(0, 4)], prompt)
     assert len(out) == 5
+
+
+# ---------------------------------------------------------------------------
+# GLM-4-MoE vs HF transformers (Glm4MoeForCausalLM is in transformers)
+# ---------------------------------------------------------------------------
+
+def _hf_glm4_moe():
+    import pytest
+    torch = pytest.importorskip("torch")
+    import transformers
+
+    cfg_kwargs = dict(
+        hidden_size=64, num_hidden_layers=3, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=16, intermediate_size=128,
+        moe_intermediate_size=32, n_routed_experts=8, num_experts_per_tok=2,
+        n_shared_experts=1, n_group=2, topk_group=1, norm_topk_prob=True,
+        routed_scaling_factor=1.0, first_k_dense_replace=1,
+        partial_rotary_factor=0.5, use_qk_norm=True, attention_bias=False,
+        vocab_size=199, max_position_embeddings=512, rms_norm_eps=1e-6,
+        rope_theta=10000.0, tie_word_embeddings=False,
+        torch_dtype="float32",
+    )
+    torch.manual_seed(0)
+    cfg = transformers.Glm4MoeConfig(**cfg_kwargs)
+    model = transformers.Glm4MoeForCausalLM(cfg)
+    model.eval()
+    return model, cfg_kwargs
+
+
+def test_glm4_moe_matches_hf():
+    import torch
+
+    from parallax_tpu.models.loader import params_from_torch_state_dict
+
+    hf, cfg_kwargs = _hf_glm4_moe()
+    cfg = normalize_config(dict(
+        architectures=["Glm4MoeForCausalLM"], **cfg_kwargs
+    ))
+    prompt = [3, 14, 15, 92, 65, 35, 89]
+    model = create_stage_model(cfg, 0, 3, use_pallas=False)
+    params = params_from_torch_state_dict(model, hf.state_dict(),
+                                          dtype=jnp.float32)
+    eng = StageEngine(model, params, EngineConfig(
+        page_size=8, num_pages=128, max_model_len=256, kv_dtype="float32"))
+    pipe = InProcessPipeline([eng])
+    req = Request("r", prompt_ids=list(prompt),
+                  sampling_params=SamplingParams(temperature=0.0,
+                                                 max_new_tokens=8))
+    pipe.submit(req)
+    pipe.run_until_complete()
+
+    # Tie-tolerant greedy replay (fp32 reduction order flips near-ties).
+    ctx = list(prompt)
+    for i, tok in enumerate(req.output_ids):
+        with torch.no_grad():
+            logits = hf(torch.tensor([ctx])).logits[0, -1]
+        best = int(torch.argmax(logits))
+        if tok != best:
+            gap = float(logits[best] - logits[tok])
+            assert gap < 5e-3, (
+                f"step {i}: got {tok}, HF argmax {best}, gap {gap}"
+            )
+        ctx.append(tok)
+
+
+def test_qwen3_5_aliases_resolve_to_hybrid():
+    cls = get_model_class("Qwen3_5ForConditionalGeneration")
+    assert cls.__name__ == "Qwen3NextStageModel"
+    assert get_model_class("Qwen3_5MoeForConditionalGeneration") is cls
