@@ -117,6 +117,7 @@ def _sampling_from_body(body: dict, default_max: int = 512) -> SamplingParams:
         ),
         ignore_eos=bool(body.get("ignore_eos", False)),
         seed=seed,
+        logprobs=bool(body.get("logprobs", False)),
     )
 
 
@@ -497,6 +498,7 @@ class OpenAIFrontend:
         scanner = _StopScanner(stops)
         seen_tokens = 0
         emitted = ""
+        lp_sent = 0
         ttft_ms = None
         stop_matched = False
         deadline = time.monotonic() + 600.0
@@ -508,22 +510,27 @@ class OpenAIFrontend:
                 seen_tokens = n
                 full = dec.update(list(req.output_ids[:n]))
                 idx = scanner.find(full) if stops else None
+                lp_entries, lp_sent = self._stream_logprob_entries(
+                    req, lp_sent
+                )
                 if idx is not None:
                     final = full[:idx]
-                    if len(final) > len(emitted):
-                        await resp.write(
-                            self._sse_chunk(req, final[len(emitted):], chat)
-                        )
+                    if len(final) > len(emitted) or lp_entries:
+                        await resp.write(self._sse_chunk(
+                            req, final[len(emitted):], chat,
+                            lp_entries=lp_entries,
+                        ))
                         emitted = final
                     stop_matched = True
                     await self._request_stop(req)
                     break
                 # Hold back any suffix that could become a stop match.
                 safe = len(full) - (_stop_holdback(full, stops) if stops else 0)
-                if safe > len(emitted):
-                    await resp.write(
-                        self._sse_chunk(req, full[len(emitted):safe], chat)
-                    )
+                if safe > len(emitted) or lp_entries:
+                    await resp.write(self._sse_chunk(
+                        req, full[len(emitted):safe], chat,
+                        lp_entries=lp_entries,
+                    ))
                     emitted = full[:safe]
             if req.status.is_finished:
                 break
@@ -538,10 +545,11 @@ class OpenAIFrontend:
             if idx is not None:
                 full = full[:idx]
                 stop_matched = True
-            if len(full) > len(emitted):
-                await resp.write(
-                    self._sse_chunk(req, full[len(emitted):], chat)
-                )
+            lp_entries, lp_sent = self._stream_logprob_entries(req, lp_sent)
+            if len(full) > len(emitted) or lp_entries:
+                await resp.write(self._sse_chunk(
+                    req, full[len(emitted):], chat, lp_entries=lp_entries,
+                ))
         usage = self._usage(req, t_start, ttft_ms)
         await resp.write(self._sse_chunk(
             req, "", chat, finish=True, usage=usage,
@@ -551,7 +559,7 @@ class OpenAIFrontend:
         return resp
 
     def _sse_chunk(self, req, delta_text, chat, finish=False, usage=None,
-                   finish_override=None) -> bytes:
+                   finish_override=None, lp_entries=None) -> bytes:
         reason = (
             (finish_override or self._finish_reason(req)) if finish else None
         )
@@ -563,6 +571,10 @@ class OpenAIFrontend:
                 "finish_reason": reason,
             }
             obj = "chat.completion.chunk"
+            if lp_entries:
+                choice["logprobs"] = {"content": [
+                    {"token": t, "logprob": lp} for t, lp in lp_entries
+                ]}
         else:
             choice = {
                 "index": 0,
@@ -570,6 +582,11 @@ class OpenAIFrontend:
                 "finish_reason": reason,
             }
             obj = "text_completion"
+            if lp_entries:
+                choice["logprobs"] = {
+                    "tokens": [t for t, _ in lp_entries],
+                    "token_logprobs": [lp for _, lp in lp_entries],
+                }
         payload = {
             "id": req.request_id,
             "object": obj,
@@ -581,8 +598,38 @@ class OpenAIFrontend:
             payload["usage"] = usage
         return f"data: {json.dumps(payload)}\n\n".encode()
 
+    def _stream_logprob_entries(self, req, lp_sent):
+        """New (token_text, logprob) pairs since the last chunk."""
+        if not req.sampling_params.logprobs:
+            return None, lp_sent
+        n = min(len(req.output_ids), len(req.output_logprobs))
+        if n <= lp_sent:
+            return None, lp_sent
+        entries = [
+            (self.tokenizer.decode([req.output_ids[i]]),
+             req.output_logprobs[i])
+            for i in range(lp_sent, n)
+        ]
+        return entries, n
+
+    def _logprobs_payload(self, req, chat):
+        """OpenAI-format logprobs for the committed tokens (chat: content
+        entries; completions: parallel token/logprob arrays)."""
+        if not req.sampling_params.logprobs or not req.output_logprobs:
+            return None
+        n = min(len(req.output_ids), len(req.output_logprobs))
+        toks = [self.tokenizer.decode([t]) for t in req.output_ids[:n]]
+        if chat:
+            return {"content": [
+                {"token": tok, "logprob": lp}
+                for tok, lp in zip(toks, req.output_logprobs[:n])
+            ]}
+        return {"tokens": toks,
+                "token_logprobs": list(req.output_logprobs[:n])}
+
     def _completion_body(self, req, text, chat, t_start, finish_override=None):
         reason = finish_override or self._finish_reason(req)
+        lp = self._logprobs_payload(req, chat)
         if chat:
             choice = {
                 "index": 0,
@@ -597,6 +644,8 @@ class OpenAIFrontend:
                 "finish_reason": reason,
             }
             obj = "text_completion"
+        if lp is not None:
+            choice["logprobs"] = lp
         return {
             "id": req.request_id,
             "object": obj,

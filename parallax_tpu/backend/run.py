@@ -114,6 +114,9 @@ class SwarmClient:
             ids = r["output_ids"]
             if len(ids) > len(request.output_ids):
                 request.output_ids[:] = ids
+                lps = r.get("output_logprobs")
+                if lps:
+                    request.output_logprobs[:] = lps
             if r["finished"]:
                 request.status = RequestStatus(r["status"])
                 ev.set()

@@ -391,7 +391,11 @@ def normalize_config(raw: dict, model_name: str = "") -> ModelConfig:
             topk_group=int(_get(cfg, "topk_group", default=0) or 0),
             scoring_func=str(_get(
                 cfg, "scoring_func",
-                default="sigmoid" if is_glm_dsa else "softmax",
+                # HF's Glm4MoeTopkRouter hardcodes sigmoid scoring (no
+                # scoring_func key in Glm4MoeConfig), as does GLM-MoE-DSA.
+                default="sigmoid"
+                if (is_glm_dsa or "Glm4Moe" in architecture)
+                else "softmax",
             )),
             topk_method=str(_get(
                 cfg, "topk_method",

@@ -155,3 +155,16 @@ def apply_penalties(
         jnp.float32
     )
     return logits
+
+
+@jax.jit
+def token_logprobs(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Log-probability of the chosen token per row: f32[B].
+
+    ``logprob = logits[token] - logsumexp(logits)`` — one reduction over
+    the vocab, no full log_softmax materialization.
+    """
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    chosen = jnp.take_along_axis(logits, tokens[:, None], axis=-1)[:, 0]
+    return chosen - lse

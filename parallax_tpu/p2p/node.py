@@ -339,6 +339,7 @@ class WorkerNode:
             return {"error": "unknown request"}
         out = {
             "output_ids": list(req.output_ids),
+            "output_logprobs": list(req.output_logprobs),
             "status": req.status.value,
             "finished": req.status.is_finished,
         }
@@ -396,7 +397,10 @@ class WorkerNode:
             if kind == "forward":
                 ireq: IntermediateRequest = item[1]
                 if ireq.next_token_id is not None:
-                    self.engine.commit_token(ireq.request_id, ireq.next_token_id)
+                    self.engine.commit_token(
+                        ireq.request_id, ireq.next_token_id,
+                        ireq.token_logprob,
+                    )
                 else:
                     self.engine.submit_intermediate(ireq)
             elif kind == "submit":

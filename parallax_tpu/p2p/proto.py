@@ -57,6 +57,7 @@ def ireq_to_wire(ireq: IntermediateRequest) -> dict:
         "token_ids": ireq.token_ids,
         "hidden_states": tensor_to_wire(ireq.hidden_states),
         "next_token_id": ireq.next_token_id,
+        "token_logprob": ireq.token_logprob,
         "sampling_params": ireq.sampling_params,
         "is_last_chunk": ireq.is_last_chunk,
         "abort": ireq.abort,
@@ -72,6 +73,7 @@ def ireq_from_wire(d: dict) -> IntermediateRequest:
         token_ids=d.get("token_ids"),
         hidden_states=tensor_from_wire(d.get("hidden_states")),
         next_token_id=d.get("next_token_id"),
+        token_logprob=d.get("token_logprob"),
         sampling_params=d.get("sampling_params"),
         is_last_chunk=d.get("is_last_chunk", True),
         abort=d.get("abort", False),

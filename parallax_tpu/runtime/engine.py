@@ -383,24 +383,8 @@ class StageEngine:
         tokens) never exposes it.
         """
         k = self.cfg.decode_lookahead
-        if (
-            k <= 1
-            or not (self.model.is_first and self.model.is_last)
-            or self._needs_state
-            or self.mesh is not None
-        ):
+        if k <= 1 or not self._greedy_fast_path_ok(plan):
             return None
-        for seg in plan.seqs:
-            sp = seg.request.sampling_params
-            if (
-                seg.num_new_tokens != 1
-                or sp.temperature > 0.0
-                or sp.seed is not None
-                or sp.presence_penalty
-                or sp.frequency_penalty
-                or sp.repetition_penalty != 1.0
-            ):
-                return None
         for seg in plan.seqs:
             # Near the context limit the window would overrun max_model_len
             # (and the per-seq page table): fall back to single-step.
@@ -443,6 +427,30 @@ class StageEngine:
 
     # -- speculative decoding (prompt-lookup) -----------------------------
 
+    def _greedy_fast_path_ok(self, plan: BatchPlan) -> bool:
+        """Shared disqualifier for the fused greedy paths (multistep,
+        speculative): single-stage engine, pure greedy decode, nothing
+        needing per-step host state (penalties/seeds/logprobs)."""
+        if (
+            not (self.model.is_first and self.model.is_last)
+            or self._needs_state
+            or self.mesh is not None
+        ):
+            return False
+        for seg in plan.seqs:
+            sp = seg.request.sampling_params
+            if (
+                seg.num_new_tokens != 1
+                or sp.temperature > 0.0
+                or sp.seed is not None
+                or sp.presence_penalty
+                or sp.frequency_penalty
+                or sp.repetition_penalty != 1.0
+                or sp.logprobs
+            ):
+                return False
+        return True
+
     # Host-side proposal scan is bounded to this many trailing tokens per
     # sequence so the per-step cost stays O(batch * window), not
     # O(batch * context).
@@ -479,24 +487,8 @@ class StageEngine:
         later steps.
         """
         k = self.cfg.speculative_tokens
-        if (
-            k <= 0
-            or not (self.model.is_first and self.model.is_last)
-            or self._needs_state
-            or self.mesh is not None
-        ):
+        if k <= 0 or not self._greedy_fast_path_ok(plan):
             return None
-        for seg in plan.seqs:
-            sp = seg.request.sampling_params
-            if (
-                seg.num_new_tokens != 1
-                or sp.temperature > 0.0
-                or sp.seed is not None
-                or sp.presence_penalty
-                or sp.frequency_penalty
-                or sp.repetition_penalty != 1.0
-            ):
-                return None
 
         proposals: list[list[int]] = []
         any_proposal = False
@@ -645,8 +637,8 @@ class StageEngine:
 
         forwards: list[IntermediateRequest] = []
         if self.model.is_last:
-            tokens = self._sample(out, inputs, plan)
-            forwards = self._emit_tokens(plan, tokens)
+            tokens, logprobs = self._sample(out, inputs, plan)
+            forwards = self._emit_tokens(plan, tokens, logprobs)
         else:
             forwards = self._emit_hidden(plan, np.asarray(out))
         dt = (time.perf_counter() - t0) * 1000.0
@@ -741,19 +733,23 @@ class StageEngine:
                 logits, jnp.asarray(out_ids), jnp.asarray(pres),
                 jnp.asarray(freq), jnp.asarray(rep),
             )
+        need_lp = [
+            bool(seg.request.sampling_params.logprobs) for seg in plan.seqs
+        ]
         if not np.any(temp > 0.0):
             # All-greedy batch (padding rows default to temp 0): argmax
             # only — skips the full-vocab sort and the PRNG entirely.
             from parallax_tpu.ops.sampling import greedy_tokens
 
-            return np.asarray(greedy_tokens(logits))
+            tokens = np.asarray(greedy_tokens(logits))
+            return tokens, self._logprobs_for(logits, tokens, need_lp)
         key = jax.random.fold_in(self._base_key, self._step_count)
         kwargs = {}
         if any_seed:
             kwargs = dict(
                 seeds=jnp.asarray(seeds), out_steps=jnp.asarray(steps)
             )
-        tokens = sample_tokens(
+        tokens = np.asarray(sample_tokens(
             logits,
             key,
             jnp.asarray(temp),
@@ -761,8 +757,19 @@ class StageEngine:
             jnp.asarray(top_p),
             jnp.asarray(min_p),
             **kwargs,
-        )
-        return np.asarray(tokens)
+        ))
+        return tokens, self._logprobs_for(logits, tokens, need_lp)
+
+    @staticmethod
+    def _logprobs_for(logits, tokens, need_lp) -> np.ndarray | None:
+        """Chosen-token logprobs when any request asked for them."""
+        if not any(need_lp):
+            return None
+        from parallax_tpu.ops.sampling import token_logprobs
+
+        return np.asarray(token_logprobs(
+            logits, jnp.asarray(tokens[: logits.shape[0]])
+        ))
 
     def _needs_token(self, seg) -> bool:
         """Does this segment's sequence produce a sampled token this step?"""
@@ -771,16 +778,22 @@ class StageEngine:
             return bool(getattr(req, "last_chunk_flag", True))
         return seg.is_last_prefill_chunk
 
-    def _emit_tokens(self, plan: BatchPlan, tokens: np.ndarray):
+    def _emit_tokens(self, plan: BatchPlan, tokens: np.ndarray,
+                     logprobs: np.ndarray | None = None):
         forwards = []
         for i, seg in enumerate(plan.seqs):
             if not self._needs_token(seg):
                 continue
             req = seg.request
             token = int(tokens[i])
+            lp = (
+                float(logprobs[i])
+                if logprobs is not None and req.sampling_params.logprobs
+                else None
+            )
             if self.model.is_first:
                 # Single-stage: commit locally, ring closed trivially.
-                self._commit(req, token)
+                self._commit(req, token, lp)
             else:
                 forwards.append(
                     IntermediateRequest(
@@ -789,6 +802,7 @@ class StageEngine:
                         context_len=seg.context_len + 1,
                         num_new_tokens=1,
                         next_token_id=token,
+                        token_logprob=lp,
                     )
                 )
         return forwards
@@ -819,14 +833,15 @@ class StageEngine:
             row += n
         return forwards
 
-    def commit_token(self, request_id: str, token: int) -> None:
+    def commit_token(self, request_id: str, token: int,
+                     logprob: float | None = None) -> None:
         """Head: the ring delivered a sampled token for ``request_id``."""
         req = self.scheduler.running.get(request_id)
         if req is None or req.status.is_finished:
             # Already finished (e.g. a stop-string early finish raced an
             # in-flight ring token): committing would resurrect it.
             return
-        self._commit(req, token)
+        self._commit(req, token, logprob)
 
     def stop_request(self, request_id: str) -> None:
         """Gracefully finish a request early (stop-string match). Unlike
@@ -838,8 +853,9 @@ class StageEngine:
         if req is not None and not req.status.is_finished:
             req.status = RequestStatus.FINISHED_STOP
 
-    def _commit(self, req: Request, token: int) -> None:
-        req.commit_token(token)
+    def _commit(self, req: Request, token: int,
+                logprob: float | None = None) -> None:
+        req.commit_token(token, logprob)
         self.scheduler.on_token_committed(req)
 
     def _collect_finished(self) -> list[Request]:

@@ -37,7 +37,10 @@ class InProcessPipeline:
             out = engine.step()
             for ireq in out.forward:
                 if ireq.next_token_id is not None:
-                    self.head.commit_token(ireq.request_id, ireq.next_token_id)
+                    self.head.commit_token(
+                        ireq.request_id, ireq.next_token_id,
+                        ireq.token_logprob,
+                    )
                 else:
                     self.engines[i + 1].submit_intermediate(ireq)
             for req in out.finished:

@@ -57,6 +57,9 @@ class SamplingParams:
     ignore_eos: bool = False
     seed: int | None = None
     json_schema: str | None = None
+    # Return per-token logprobs of the sampled tokens (reference wire
+    # fields token_prob/return_probs, forward.proto:39-40).
+    logprobs: bool = False
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -85,6 +88,9 @@ class Request:
     routing_table: list[str] = dataclasses.field(default_factory=list)
     status: RequestStatus = RequestStatus.PENDING
     output_ids: list[int] = dataclasses.field(default_factory=list)
+    # Log-probability of each sampled output token (filled when
+    # sampling_params.logprobs is set).
+    output_logprobs: list[float] = dataclasses.field(default_factory=list)
     # Prompt tokens whose KV is already computed (prefix-cache hit + finished
     # prefill chunks).
     num_computed_tokens: int = 0
@@ -121,12 +127,14 @@ class Request:
     def remaining_prompt_tokens(self) -> int:
         return max(0, self.num_prompt_tokens - self.num_computed_tokens)
 
-    def commit_token(self, token_id: int) -> None:
+    def commit_token(self, token_id: int, logprob: float | None = None) -> None:
         """Record one generated token and update status.
 
         Reference: ``InitialRequest.commit_new_token`` (request.py:230-249).
         """
         self.output_ids.append(token_id)
+        if logprob is not None:
+            self.output_logprobs.append(logprob)
         sp = self.sampling_params
         if self.num_output_tokens >= sp.min_new_tokens:
             if not sp.ignore_eos and (
@@ -166,6 +174,9 @@ class IntermediateRequest:
     hidden_states: np.ndarray | None = None
     # Sampled token (last stage -> head hop only).
     next_token_id: int | None = None
+    # Its logprob when the request asked for logprobs (reference
+    # token_prob, forward.proto:39).
+    token_logprob: float | None = None
     sampling_params: dict | None = None
     is_last_chunk: bool = True
     abort: bool = False

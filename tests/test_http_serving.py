@@ -362,3 +362,80 @@ def test_invalid_seed_returns_400():
         assert status == 400
 
     with_client(fe.app, fn)
+
+
+def test_logprobs_returned_single_and_multi_stage():
+    """logprobs=true returns one logprob per sampled token (computed on
+    the LAST stage and carried back over the ring for pipelines)."""
+    import math
+
+    for bounds in ([(0, 2)], [(0, 1), (1, 2)]):
+        engines = build_engines(bounds)
+        fe, runner = build_local_frontend(
+            engines, SimpleTokenizer(), model_name="tiny"
+        )
+
+        async def fn(client):
+            status, body = await _json(client, "POST", "/v1/completions",
+                {"prompt": "hello world", "max_tokens": 5,
+                 "temperature": 0, "logprobs": True, "ignore_eos": True})
+            assert status == 200, body
+            lp = body["choices"][0]["logprobs"]
+            assert len(lp["token_logprobs"]) == 5
+            assert all(isinstance(x, float) and x <= 0.0 and math.isfinite(x)
+                       for x in lp["token_logprobs"])
+            # chat format variant
+            status, body = await _json(client, "POST", "/v1/chat/completions",
+                {"messages": [{"role": "user", "content": "hi"}],
+                 "max_tokens": 3, "temperature": 0, "logprobs": True,
+                 "ignore_eos": True})
+            assert status == 200, body
+            content = body["choices"][0]["logprobs"]["content"]
+            assert len(content) == 3
+            assert all("logprob" in e and "token" in e for e in content)
+
+        with_client(fe.app, fn)
+        runner.stop()
+
+
+def test_no_logprobs_by_default():
+    engines = build_engines([(0, 2)])
+    fe, runner = build_local_frontend(
+        engines, SimpleTokenizer(), model_name="tiny"
+    )
+
+    async def fn(client):
+        status, body = await _json(client, "POST", "/v1/completions",
+            {"prompt": "hello", "max_tokens": 3, "temperature": 0,
+             "ignore_eos": True})
+        assert status == 200
+        assert "logprobs" not in body["choices"][0]
+
+    with_client(fe.app, fn)
+    runner.stop()
+
+
+def test_streaming_logprobs():
+    engines = build_engines([(0, 2)])
+    fe, runner = build_local_frontend(
+        engines, SimpleTokenizer(), model_name="tiny"
+    )
+
+    async def fn(client):
+        resp = await client.post("/v1/completions", json={
+            "prompt": "hello", "max_tokens": 5, "temperature": 0,
+            "stream": True, "logprobs": True, "ignore_eos": True})
+        assert resp.status == 200
+        return await resp.text()
+
+    raw = with_client(fe.app, fn)
+    runner.stop()
+    chunks = [json.loads(line[6:]) for line in raw.splitlines()
+              if line.startswith("data: ") and line != "data: [DONE]"]
+    lps = []
+    for c in chunks:
+        lp = c["choices"][0].get("logprobs")
+        if lp:
+            lps.extend(lp["token_logprobs"])
+    assert len(lps) == 5
+    assert all(x <= 0.0 for x in lps)
