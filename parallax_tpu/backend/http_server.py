@@ -241,7 +241,10 @@ class OpenAIFrontend:
             web.get("/cluster/status_json", self.cluster_status_json),
             web.post("/weight/refit", self.weight_refit),
             web.post("/scheduler/init", self.scheduler_init),
+            web.post("/profile/start", self.profile_start),
+            web.post("/profile/stop", self.profile_stop),
         ])
+        self._profiling = False
 
         # Built-in web UI (setup/join/cluster/chat — reference src/frontend).
         from parallax_tpu.backend.webui import register_ui
@@ -351,6 +354,38 @@ class OpenAIFrontend:
             "data": {"model_name": model_name,
                      "init_nodes_num": init_nodes_num, **(info or {})},
         })
+
+    async def profile_start(self, request):
+        """Start a JAX/XLA device trace (TensorBoard-viewable) while
+        serving — the TPU-native answer to per-step timing logs: captures
+        kernel timelines, HBM transfers and host gaps on live traffic.
+        Beyond reference parity (it ships no tracer)."""
+        import jax
+
+        if self._profiling:
+            return self._error(409, "profiler already running")
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        out_dir = body.get("dir") or "/tmp/parallax-profile"
+        try:
+            jax.profiler.start_trace(out_dir)
+        except Exception as e:
+            return self._error(500, f"profiler start failed: {e}")
+        self._profiling = True
+        return web.json_response({"profiling": True, "dir": out_dir})
+
+    async def profile_stop(self, _request):
+        import jax
+
+        if not self._profiling:
+            return self._error(409, "profiler not running")
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            self._profiling = False
+        return web.json_response({"profiling": False})
 
     async def weight_refit(self, request):
         if self.refit_fn is None:

@@ -439,3 +439,26 @@ def test_streaming_logprobs():
             lps.extend(lp["token_logprobs"])
     assert len(lps) == 5
     assert all(x <= 0.0 for x in lps)
+
+
+def test_profile_endpoints():
+    fe, backend = _scripted_frontend([1, 2, 3])
+
+    async def fn(client):
+        import os
+        import tempfile
+
+        d = tempfile.mkdtemp()
+        r = await client.post("/profile/start", json={"dir": d})
+        assert r.status == 200
+        # double-start conflicts
+        r2 = await client.post("/profile/start", json={"dir": d})
+        assert r2.status == 409
+        r3 = await client.post("/profile/stop")
+        assert r3.status == 200
+        # trace artifacts written
+        assert any(os.scandir(d))
+        r4 = await client.post("/profile/stop")
+        assert r4.status == 409
+
+    with_client(fe.app, fn)
