@@ -362,13 +362,14 @@ class OpenAIFrontend:
         Beyond reference parity (it ships no tracer)."""
         import jax
 
-        if self._profiling:
-            return self._error(409, "profiler already running")
         try:
             body = await request.json()
         except Exception:
             body = {}
         out_dir = body.get("dir") or "/tmp/parallax-profile"
+        # Check AFTER the awaits: no suspension between test and set.
+        if self._profiling:
+            return self._error(409, "profiler already running")
         try:
             jax.profiler.start_trace(out_dir)
         except Exception as e:

@@ -153,6 +153,11 @@ class WorkerNode:
         logger.info(
             "%s: (re)loading layers [%d, %d)", self.node_id, start, end
         )
+        # The old engine's in-flight requests can never finish on the new
+        # one (different layers/weights): abort them NOW so polling
+        # clients see finished_abort instead of hanging to their deadline,
+        # and peers holding mirrors release their pages.
+        self._abort_in_flight("node reallocated")
         self.start_layer, self.end_layer = start, end
         model = create_stage_model(
             self.model_config, start, end, tp_size=self.tp_size
@@ -163,6 +168,21 @@ class WorkerNode:
         )
         self._restore_refit_cache()
         self._allocated.set()
+
+    def _abort_in_flight(self, reason: str) -> None:
+        eng = self.engine
+        if eng is None:
+            return
+        sched = eng.scheduler
+        reqs = list(sched.running.values()) + list(sched.wait_queue.values())
+        for req in reqs:
+            if not req.status.is_finished:
+                req.abort(reason)
+            sched.release_request(req)
+            self._finish(req)
+        if reqs:
+            logger.warning("%s: aborted %d in-flight requests (%s)",
+                           self.node_id, len(reqs), reason)
 
     def _maybe_switch_model(self, model_name: str | None) -> bool:
         """Live model switch (/scheduler/init): the allocation names a
@@ -411,7 +431,25 @@ class WorkerNode:
                     req.abort(str(e))
                     self._finish(req)
             elif kind == "release":
-                self.engine.release(item[1], abort=item[2])
+                rid, aborted = item[1], item[2]
+                eng = self.engine
+                req = None
+                if eng is not None:
+                    req = eng.scheduler.running.get(rid) or (
+                        eng.scheduler.wait_queue.get(rid)
+                    )
+                    eng.release(rid, abort=aborted)
+                # A release broadcast can end a request this HEAD is still
+                # tracking for a client (e.g. a downstream stage
+                # reallocated and aborted its mirrors): complete it for
+                # the waiters instead of leaving them hanging. No re-
+                # broadcast / no request_complete here — the originating
+                # node already did both.
+                if req is not None:
+                    ev = self._request_events.pop(rid, None)
+                    if ev is not None:
+                        self._finished.put(req)
+                        ev.set()
             elif kind == "stop":
                 self.engine.stop_request(item[1])
             elif kind == "abort_path":

@@ -168,3 +168,67 @@ def test_swarm_handles_concurrent_requests(swarm):
     for ev, req in zip(events, reqs):
         assert ev.wait(30.0), f"{req.request_id} stuck: {req.status}"
         assert len(req.output_ids) == 4
+
+
+def test_reallocation_aborts_in_flight_requests(swarm):
+    """A worker forced to reload (engine replaced) must abort its
+    in-flight requests promptly — polling clients see finished_abort
+    instead of hanging to the HTTP deadline."""
+    service, workers = swarm
+    assert wait_ready(service, 2)
+    head = next(w for w in workers if w.engine and w.start_layer == 0)
+    status = service.scheduler.cluster_status()
+    path = [n["node_id"] for n in status["pipelines"][0]["nodes"]]
+    req = Request(
+        "inflight", prompt_ids=[1, 2, 3],
+        sampling_params=SamplingParams(temperature=0.0, max_new_tokens=10_000,
+                                       ignore_eos=True),
+        routing_table=path,
+    )
+    ev = head.submit(req)
+    deadline = time.monotonic() + 10
+    while not req.output_ids and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert req.output_ids, "generation never started"
+
+    # Force an engine reload on the head (as a rebalance would).
+    head._inbox.put(("reload", {"start_layer": head.start_layer,
+                                "end_layer": head.end_layer + 1
+                                if head.end_layer < TINY.num_hidden_layers
+                                else head.end_layer - 1}))
+    assert ev.wait(15.0), "in-flight request hung across reallocation"
+    assert req.status.value == "finished_abort"
+    assert req.abort_reason == "node reallocated"
+
+
+def test_midpath_reallocation_aborts_head_clients(swarm):
+    """A NON-head stage reloading must still unblock the head's waiting
+    clients (the release broadcast completes the head-side request)."""
+    service, workers = swarm
+    assert wait_ready(service, 2)
+    status = service.scheduler.cluster_status()
+    path = [n["node_id"] for n in status["pipelines"][0]["nodes"]]
+    if len(path) < 2:
+        import pytest
+        pytest.skip("allocator built a single-stage pipeline")
+    head = next(w for w in workers if w.node_id == path[0])
+    tail = next(w for w in workers if w.node_id == path[-1])
+    req = Request(
+        "inflight2", prompt_ids=[4, 5, 6],
+        sampling_params=SamplingParams(temperature=0.0, max_new_tokens=10_000,
+                                       ignore_eos=True),
+        routing_table=path,
+    )
+    ev = head.submit(req)
+    deadline = time.monotonic() + 10
+    while not req.output_ids and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert req.output_ids, "generation never started"
+
+    # Force the TAIL stage to reload mid-flight.
+    tail._inbox.put(("reload", {"start_layer": tail.start_layer - 1
+                                if tail.start_layer > 0
+                                else tail.start_layer + 1,
+                                "end_layer": tail.end_layer}))
+    assert ev.wait(15.0), "head client hung after mid-path reallocation"
+    assert req.status.is_finished
