@@ -344,3 +344,78 @@ def test_unknown_quantization_bits_errors(tmp_path):
     model = StageModel(cfg, 0, 1, use_pallas=False)
     with pytest.raises(ValueError, match="quantization"):
         load_stage_params(model, str(ckpt), dtype=jnp.float32)
+
+
+def test_quantized_dsa_model_generates():
+    """int8 on-load quantization composes with the DSA stack (indexer
+    projections wq_b/wk/weights_proj are quantized leaves)."""
+    from parallax_tpu.models.registry import create_stage_model
+
+    cfg = normalize_config(dict(
+        architectures=["DeepseekV32ForCausalLM"], hidden_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        kv_lora_rank=32, q_lora_rank=64, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16, index_n_heads=4,
+        index_head_dim=32, index_topk=8, intermediate_size=128,
+        moe_intermediate_size=32, n_routed_experts=4, num_experts_per_tok=2,
+        first_k_dense_replace=2, vocab_size=199, rope_interleave=True,
+        max_position_embeddings=512, tie_word_embeddings=False,
+    ))
+    model = create_stage_model(cfg, 0, 2, use_pallas=False)
+    fp = model.init_params(jax.random.key(0), dtype=jnp.float32)
+    q = quantize_tree(fp, bits=8, group_size=16, dtype=jnp.float32)
+    assert "qweight" in q["layers"][0]["self_attn"]["indexer"]["wq_b"]
+
+    def gen(params, prompt):
+        eng = StageEngine(model, params, EngineConfig(
+            page_size=8, num_pages=64, max_model_len=128,
+            kv_dtype="float32"))
+        pipe = InProcessPipeline([eng])
+        req = Request("r", prompt_ids=list(prompt),
+                      sampling_params=SamplingParams(temperature=0.0,
+                                                     max_new_tokens=4))
+        pipe.submit(req)
+        pipe.run_until_complete()
+        return req.output_ids
+
+    # Dense-budget regime (context <= index_topk): no discrete top-k
+    # selection, so int8/g16 greedy must track fp exactly.
+    short = [1, 2, 3, 4]
+    assert gen(q, short) == gen(fp, short)
+    # Sparse regime: quantization noise may legitimately flip which tokens
+    # win the top-k (a discrete decision) — require completion only.
+    assert len(gen(q, list(range(1, 21)))) == 4
+
+
+def test_quantized_msa_model_generates():
+    from parallax_tpu.models.registry import create_stage_model
+
+    cfg = normalize_config(dict(
+        architectures=["MiniMaxM3SparseForCausalLM"],
+        model_type="minimax_m3", hidden_size=64, intermediate_size=64,
+        dense_intermediate_size=128, shared_intermediate_size=64,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        num_hidden_layers=2, partial_rotary_factor=0.5, vocab_size=199,
+        max_position_embeddings=512, use_qk_norm=True, use_gemma_norm=True,
+        num_local_experts=4, num_experts_per_tok=2, n_shared_experts=1,
+        scoring_func="sigmoid", use_routing_bias=True,
+        routed_scaling_factor=2.0,
+        mlp_layer_types=["dense", "sparse"],
+        layer_types=["full_attention", "minimax_m3_sparse"],
+        index_n_heads=2, index_head_dim=16, index_block_size=4,
+        index_topk_blocks=2, index_local_blocks=1,
+        tie_word_embeddings=False,
+    ))
+    model = create_stage_model(cfg, 0, 2, use_pallas=False)
+    fp = model.init_params(jax.random.key(0), dtype=jnp.float32)
+    q = quantize_tree(fp, bits=8, group_size=16, dtype=jnp.float32)
+    assert "qweight" in q["layers"][1]["self_attn"]["index_q_proj"]
+    eng = StageEngine(model, q, EngineConfig(
+        page_size=8, num_pages=64, max_model_len=128, kv_dtype="float32"))
+    pipe = InProcessPipeline([eng])
+    req = Request("r", prompt_ids=list(range(1, 31)),
+                  sampling_params=SamplingParams(temperature=0.0,
+                                                 max_new_tokens=4))
+    pipe.submit(req)
+    pipe.run_until_complete()
+    assert len(req.output_ids) == 4
