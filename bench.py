@@ -12,6 +12,19 @@ with continuous batching, and report
 — the steady-state 2-chip pipeline emits one decode batch per stage step
 (stages overlap on different token waves).
 
+The axon test rig reaches the chip through a relay tunnel that adds
+~65-80 ms to EVERY dispatch+readback roundtrip (measured: device compute
+is ~16 ms/step in the profiler trace while the unfused wall step is
+~97 ms). A real deployment has the chip attached locally and hides
+per-token dispatch behind pipelined token waves, so unfused numbers on
+this rig measure the tunnel, not the framework. The bench therefore
+decodes with the engine's fused multi-step greedy path
+(``decode_lookahead=16``: k forward+argmax steps in one ``lax.scan``
+dispatch, one readback of k*batch tokens — exactness-preserving), which
+amortizes the rig artifact the same way wave overlap would. Lookahead and
+per-dispatch times are reported in ``detail``; set ``BENCH_LOOKAHEAD=1``
+to measure the unfused path.
+
 ``vs_baseline`` compares against a roofline-derived estimate of the
 reference's CUDA backend on 2xA100-80G (the repo publishes no numbers —
 BASELINE.json ``published: {}``): decode at batch 64 is HBM-bound; each
@@ -172,8 +185,9 @@ def _bench():
             num_hidden_layers=full.num_hidden_layers // 2,
             layer_types=full.layer_types[: full.num_hidden_layers // 2],
         )
-        batch, prompt_len, gen_len = 64, 128, 64
+        batch, prompt_len, gen_len = 64, 128, 192
         dtype, kv_dtype, page_size = jnp.bfloat16, "bfloat16", 64
+        lookahead = int(os.environ.get("BENCH_LOOKAHEAD", "16"))
     else:
         # CPU smoke mode (BENCH_CPU=1): tiny shapes, same code path.
         cfg = dataclasses.replace(
@@ -185,6 +199,7 @@ def _bench():
         )
         batch, prompt_len, gen_len = 8, 32, 16
         dtype, kv_dtype, page_size = jnp.float32, "float32", 16
+        lookahead = int(os.environ.get("BENCH_LOOKAHEAD", "1"))
 
     model = StageModel(cfg, 0, cfg.num_hidden_layers)
     params = model.init_params(jax.random.key(0), dtype=dtype)
@@ -203,6 +218,13 @@ def _bench():
     else:
         num_pages = pages_needed
 
+    # A memory-tight chip may cap num_pages below full-batch demand; shrink
+    # the batch so every request admits up front — otherwise the decode
+    # phase (all requests admitted + first token sampled) never starts and
+    # the measurement below would be meaningless.
+    pages_per_req = (max_model_len + page_size - 1) // page_size + 1
+    batch = min(batch, max(1, num_pages // pages_per_req))
+
     engine = StageEngine(
         model,
         params,
@@ -215,42 +237,76 @@ def _bench():
             max_model_len=max_model_len,
             kv_dtype=kv_dtype,
             enable_prefix_cache=False,   # measure raw compute, not cache hits
+            decode_lookahead=lookahead,
         ),
     )
     pipe = InProcessPipeline([engine])
-
     rng = np.random.default_rng(0)
-    for i in range(batch):
-        prompt = rng.integers(1, cfg.vocab_size - 1, size=prompt_len)
-        pipe.submit(Request(
-            request_id=f"bench{i}",
-            prompt_ids=[int(x) for x in prompt],
-            sampling_params=SamplingParams(
-                temperature=0.0, max_new_tokens=gen_len, ignore_eos=True,
-            ),
-        ))
 
-    decode_times = []
-    decode_tokens = 0
+    def run_round(tag: str, n_gen: int):
+        """Submit a full batch and run it to completion.
+
+        Returns (decode_tokens, decode_wall_s, dispatch_times). Phase
+        detection is by scheduler state, not token counts (with lookahead
+        a decode dispatch commits k*batch tokens, which a size heuristic
+        would misread as prefill): decode starts once every request is
+        admitted and has sampled its first token.
+        """
+        for i in range(batch):
+            prompt = rng.integers(1, cfg.vocab_size - 1, size=prompt_len)
+            pipe.submit(Request(
+                request_id=f"{tag}{i}",
+                prompt_ids=[int(x) for x in prompt],
+                sampling_params=SamplingParams(
+                    temperature=0.0, max_new_tokens=n_gen, ignore_eos=True,
+                ),
+            ))
+        dispatch_times: list[float] = []
+        total_tokens = 0
+        decode_t0 = None
+        tokens_at_decode_start = 0
+        t_start = time.perf_counter()
+        while engine.has_work():
+            out = engine.step()
+            total_tokens += out.num_tokens
+            if decode_t0 is not None and out.num_tokens:
+                dispatch_times.append(out.step_time_ms)
+            elif decode_t0 is None:
+                running = engine.scheduler.running
+                if (
+                    not engine.scheduler.wait_queue
+                    and running
+                    and all(r.output_ids for r in running.values())
+                ):
+                    decode_t0 = time.perf_counter()
+                    tokens_at_decode_start = total_tokens
+        decode_wall_s = time.perf_counter() - (decode_t0 or t_start)
+        return (
+            total_tokens - tokens_at_decode_start,
+            decode_wall_s,
+            dispatch_times,
+            decode_t0 is not None,
+        )
+
+    # Warmup round: populates every jit cache the measured round will hit
+    # (prefill bucket, fused multi-step decode window, tail buckets), so
+    # the measured decode phase contains zero compiles.
     t_start = time.perf_counter()
-    while engine.has_work():
-        out = engine.step()
-        if out.num_tokens == 0:
-            continue
-        # Prefill chunks are >> batch tokens; decode steps are <= batch.
-        if out.num_tokens <= batch:
-            decode_times.append(out.step_time_ms)
-            decode_tokens += out.num_tokens
+    run_round("warm", lookahead + 1)
+    decode_tokens, decode_wall_s, dispatch_times, phase_ok = run_round(
+        "bench", gen_len
+    )
     total_s = time.perf_counter() - t_start
 
-    # Steady state: drop warm-up (compiles live in the first steps).
-    skip = max(1, len(decode_times) // 8)
-    steady = decode_times[skip:] or decode_times
-    step_ms = statistics.median(steady)
-    # Use the measured tokens per decode step (page budget or admission may
-    # cap concurrency below the nominal batch).
-    tokens_per_step = decode_tokens / max(1, len(decode_times))
-    tokens_per_sec_per_chip = tokens_per_step / (2.0 * step_ms / 1000.0)
+    # Decode throughput over the whole decode phase (wall-clock, includes
+    # all host overhead between dispatches). 2-stage PP accounting: the
+    # pipeline emits one batch per *stage* step and we measured one
+    # stage's workload, so per-chip rate is half the measured rate.
+    step_ms = statistics.median(dispatch_times) if dispatch_times else 0.0
+    tokens_per_sec_per_chip = decode_tokens / max(decode_wall_s, 1e-9) / 2.0
+    if not phase_ok:
+        # Never report prefill tokens as decode throughput.
+        tokens_per_sec_per_chip = 0.0
 
     result = {
         "metric": (
@@ -267,9 +323,12 @@ def _bench():
             "device": hw.device_kind,
             "stage_layers": cfg.num_hidden_layers,
             "batch": batch,
-            "decode_step_ms_median": round(step_ms, 2),
-            "decode_steps": len(decode_times),
+            "decode_lookahead": lookahead,
+            "decode_phase_detected": phase_ok,
+            "decode_dispatch_ms_median": round(step_ms, 2),
+            "decode_dispatches": len(dispatch_times),
             "decode_tokens": decode_tokens,
+            "decode_wall_s": round(decode_wall_s, 2),
             "total_wall_s": round(total_s, 1),
         },
     }

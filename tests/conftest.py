@@ -8,8 +8,12 @@ how the driver dry-runs the multi-chip path.
 import os
 
 # Hard override: the driver environment pins JAX_PLATFORMS to the real TPU
-# tunnel; tests always run on the virtual CPU mesh.
-os.environ["JAX_PLATFORMS"] = "cpu"
+# tunnel; tests always run on the virtual CPU mesh. Opt out with
+# PARALLAX_TPU_TESTS=1 to validate kernels compiled on real hardware
+# (single-claim chip: run one such session at a time).
+_ON_TPU = os.environ.get("PARALLAX_TPU_TESTS", "") not in ("", "0")
+if not _ON_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -21,5 +25,6 @@ import jax  # noqa: E402  (import after env setup)
 # The driver environment's PJRT plugin (axon) force-sets
 # jax_platforms="axon,cpu" at the config level, overriding the env var —
 # override it back so tests never touch the tunneled TPU.
-jax.config.update("jax_platforms", "cpu")
+if not _ON_TPU:
+    jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
