@@ -27,4 +27,9 @@ import jax  # noqa: E402  (import after env setup)
 # override it back so tests never touch the tunneled TPU.
 if not _ON_TPU:
     jax.config.update("jax_platforms", "cpu")
+else:
+    # Exact-match oracles assume true f32 math; the TPU default lowers
+    # f32 matmuls to bf16 passes (~3e-3 relative error), which is fine in
+    # production (weights are bf16 anyway) but not for kernel tests.
+    jax.config.update("jax_default_matmul_precision", "highest")
 jax.config.update("jax_enable_x64", False)
