@@ -19,7 +19,7 @@ is ~16 ms/step in the profiler trace while the unfused wall step is
 per-token dispatch behind pipelined token waves, so unfused numbers on
 this rig measure the tunnel, not the framework. The bench therefore
 decodes with the engine's fused multi-step greedy path
-(``decode_lookahead=16``: k forward+argmax steps in one ``lax.scan``
+(``decode_lookahead=32``: k forward+argmax steps in one ``lax.scan``
 dispatch, one readback of k*batch tokens — exactness-preserving), which
 amortizes the rig artifact the same way wave overlap would. Lookahead and
 per-dispatch times are reported in ``detail``; set ``BENCH_LOOKAHEAD=1``
@@ -187,7 +187,7 @@ def _bench():
         )
         batch, prompt_len, gen_len = 64, 128, 192
         dtype, kv_dtype, page_size = jnp.bfloat16, "bfloat16", 64
-        lookahead = int(os.environ.get("BENCH_LOOKAHEAD", "16"))
+        lookahead = int(os.environ.get("BENCH_LOOKAHEAD", "32"))
     else:
         # CPU smoke mode (BENCH_CPU=1): tiny shapes, same code path.
         cfg = dataclasses.replace(

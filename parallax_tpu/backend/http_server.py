@@ -50,6 +50,11 @@ class SimpleTokenizer:
     def apply_chat_template(self, messages) -> str:
         return "\n".join(f"{m['role']}: {m['content']}" for m in messages) + "\nassistant:"
 
+    def vocab_bytes(self) -> list[bytes]:
+        """Exact token->bytes map for grammar-constrained decoding (the
+        generic decode() fallback would mangle non-ASCII lead bytes)."""
+        return [bytes([i]) for i in range(256)] + [b"", b""]
+
 
 def load_tokenizer(model_path: str | None):
     if model_path:
@@ -83,6 +88,16 @@ def load_tokenizer(model_path: str | None):
                 def eos_token_ids(self):
                     return (tok.eos_token_id,) if tok.eos_token_id else ()
 
+                def get_vocab(self):
+                    return tok.get_vocab()
+
+                @property
+                def all_special_ids(self):
+                    return getattr(tok, "all_special_ids", None) or ()
+
+                def get_added_vocab(self):
+                    return getattr(tok, "get_added_vocab", dict)() or {}
+
                 def apply_chat_template(self, messages):
                     return tok.apply_chat_template(
                         messages, tokenize=False, add_generation_prompt=True
@@ -94,11 +109,55 @@ def load_tokenizer(model_path: str | None):
     return SimpleTokenizer()
 
 
+def _schema_from_body(body: dict) -> str | None:
+    """OpenAI ``response_format`` -> schema string for constrained decoding.
+
+    ``{"type": "json_object"}`` -> "{}" (any JSON); ``{"type":
+    "json_schema", "json_schema": {"schema": {...}}}`` -> that schema.
+    Raises ValueError (mapped to 400 by the caller) on unknown types.
+    """
+    rf = body.get("response_format")
+    if not rf:
+        return None
+    import json as _json
+
+    kind = rf.get("type") if isinstance(rf, dict) else None
+    if kind in (None, "text"):
+        return None
+    if kind == "json_object":
+        schema = "{}"
+    elif kind == "json_schema":
+        spec = rf.get("json_schema") or {}
+        schema_keys = (
+            "type", "enum", "const", "anyOf", "oneOf", "properties",
+        )
+        if "schema" in spec:
+            inner = spec["schema"]
+        elif any(k in spec for k in schema_keys):
+            inner = spec          # schema passed inline, unwrapped
+        else:
+            raise ValueError(
+                "response_format.json_schema needs a 'schema' object"
+            )
+        schema = _json.dumps(inner)
+    else:
+        raise ValueError(f"unsupported response_format type: {kind!r}")
+    from parallax_tpu.constrained import validate_schema
+
+    # Compile-check so an unsupported schema 400s before any tokens run.
+    # lru-cached on the schema string; first compile of a big schema is
+    # pure-Python work, so the async handler runs this parse in a thread
+    # (see _parse_generation_request).
+    validate_schema(schema)
+    return schema
+
+
 def _sampling_from_body(body: dict, default_max: int = 512) -> SamplingParams:
     seed = body.get("seed")
     if seed is not None:
         seed = int(seed)  # ValueError -> 400 in the caller
     return SamplingParams(
+        json_schema=_schema_from_body(body),
         temperature=float(body.get("temperature", 1.0)),
         top_p=float(body.get("top_p", 1.0)),
         top_k=int(body.get("top_k", -1)),
@@ -425,7 +484,12 @@ class OpenAIFrontend:
         if not prompt_ids:
             return self._error(400, "empty prompt")
         try:
-            sampling_params = _sampling_from_body(body)
+            # In a thread: schema validation compiles a DFA (pure-Python,
+            # potentially hundreds of ms for big schemas) and must not
+            # stall the event loop for in-flight streams.
+            sampling_params = await asyncio.to_thread(
+                _sampling_from_body, body
+            )
         except (TypeError, ValueError) as e:
             return self._error(400, f"invalid sampling parameter: {e}")
 

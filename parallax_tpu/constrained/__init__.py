@@ -1,0 +1,67 @@
+"""Grammar-constrained decoding (JSON mode / json_schema), TPU-native.
+
+Pipeline: JSON schema -> byte NFA (``json_schema.py``) -> dense byte DFA
+(``automaton.py``) -> per-state vocab masks (``vocab.py``) -> logit mask
+applied in the last stage's sampler (``runtime/engine.py``).
+
+The reference carries ``json_schema`` in SamplingParams and delegates
+enforcement to its CUDA backends' grammar engines; this package is the
+framework-native equivalent.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from parallax_tpu.constrained.automaton import Dfa, compile_dfa
+from parallax_tpu.constrained.json_schema import SchemaError, compile_schema
+from parallax_tpu.constrained.vocab import TokenTable, vocab_bytes_from_tokenizer
+
+__all__ = [
+    "Dfa",
+    "GrammarCompiler",
+    "SchemaError",
+    "TokenTable",
+    "compile_dfa",
+    "compile_schema",
+    "validate_schema",
+    "vocab_bytes_from_tokenizer",
+]
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=64)
+def validate_schema(schema_json: str) -> None:
+    """Frontend-side admission check: compile (and discard) the DFA so an
+    unsupported schema 400s before any tokens are spent. Successes are
+    cached; the engine's GrammarCompiler re-uses its own cache for the
+    vocab-bound table."""
+    compile_schema(schema_json)
+
+
+class GrammarCompiler:
+    """Schema-string -> TokenTable with caching, bound to one vocabulary."""
+
+    def __init__(self, vocab: list[bytes], eos_token_id: int,
+                 max_cached: int = 32):
+        self._vocab = vocab
+        self._eos = int(eos_token_id)
+        self._max = max_cached
+        self._cache: dict[str, TokenTable] = {}
+        self._lock = threading.Lock()
+
+    def compile(self, schema_json: str) -> TokenTable:
+        key = schema_json.strip() or "{}"
+        with self._lock:
+            hit = self._cache.get(key)
+        if hit is not None:
+            return hit
+        dfa = compile_schema(key)          # raises SchemaError on bad input
+        table = TokenTable(dfa, self._vocab, self._eos)
+        with self._lock:
+            if len(self._cache) >= self._max:
+                self._cache.pop(next(iter(self._cache)))
+            self._cache[key] = table
+        return table

@@ -19,6 +19,7 @@ sinks.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -26,6 +27,27 @@ import jax.numpy as jnp
 from parallax_tpu.ops.ragged import page_chunks, ragged_token_positions
 
 _MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _rpa_block_overrides() -> dict:
+    """Optional Pallas grid tuning for the bundled kernel, e.g.
+    ``PARALLAX_RPA_BLOCKS=4,32`` -> num_kv_pages_per_block=4,
+    num_queries_per_block=32. Default: kernel heuristics."""
+    spec = os.environ.get("PARALLAX_RPA_BLOCKS", "")
+    if not spec:
+        return {}
+    try:
+        nkv, nq = (int(x) for x in spec.split(","))
+        return {"num_kv_pages_per_block": nkv, "num_queries_per_block": nq}
+    except ValueError:
+        import warnings
+
+        warnings.warn(
+            f"PARALLAX_RPA_BLOCKS={spec!r} is malformed (want 'NKV,NQ'); "
+            "using kernel default heuristics",
+            stacklevel=2,
+        )
+        return {}
 
 
 
@@ -116,6 +138,7 @@ def ragged_paged_attention(
             sm_scale=sm_scale,
             sliding_window=sliding_window,
             soft_cap=soft_cap,
+            **_rpa_block_overrides(),
         )
     return _ragged_paged_attention_xla(
         q,

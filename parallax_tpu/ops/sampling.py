@@ -158,6 +158,18 @@ def apply_penalties(
 
 
 @jax.jit
+def apply_grammar_mask(
+    logits: jax.Array, rows: jax.Array, allowed: jax.Array
+) -> jax.Array:
+    """Constrained decoding: force disallowed tokens to -inf on the given
+    rows. ``rows`` i32[G] row indices (-1 = padding, dropped), ``allowed``
+    bool[G, V] per-row allow masks. Non-listed rows pass through."""
+    full = jnp.ones(logits.shape, bool)
+    full = full.at[rows].set(allowed, mode="drop")
+    return jnp.where(full, logits, NEG_INF)
+
+
+@jax.jit
 def token_logprobs(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     """Log-probability of the chosen token per row: f32[B].
 

@@ -214,9 +214,44 @@ class StageEngine:
         # Non-head stages: hidden rows waiting per request id.
         self._pending_hidden: dict[str, np.ndarray] = {}
         self._sampling_cache: dict[str, SamplingParams] = {}
+        # Grammar-constrained decoding (json_schema): set by the serving
+        # layer on the LAST stage via set_grammar_vocab(); per-request DFA
+        # states live here keyed by request id.
+        self.grammar = None
+        self._grammar_states: dict[str, tuple] = {}
         # EWMA per-layer decode latency published to the global scheduler
         # (reference base_executor.py:716-732).
         self.layer_latency_ms_ewma: float | None = None
+
+    def set_grammar_vocab(self, vocab: list[bytes], eos_token_id: int) -> None:
+        """Enable grammar-constrained decoding (json_schema) on this
+        stage. Call on the last stage with the tokenizer's raw token byte
+        strings; without it, constrained requests are aborted."""
+        from parallax_tpu.constrained import GrammarCompiler
+
+        self.grammar = GrammarCompiler(vocab, eos_token_id)
+
+    def _grammar_entry(self, req) -> tuple | None:
+        """(TokenTable, state) for a constrained request, creating it on
+        first sight; None for unconstrained. Aborts the request if the
+        grammar stack is unavailable or the schema does not compile."""
+        sp = req.sampling_params
+        if not sp.json_schema:
+            return None
+        ent = self._grammar_states.get(req.request_id)
+        if ent is None:
+            if self.grammar is None:
+                req.abort("json_schema requires a tokenizer-wired last "
+                          "stage (set_grammar_vocab)")
+                return None
+            try:
+                table = self.grammar.compile(sp.json_schema)
+            except ValueError as e:
+                req.abort(f"json_schema rejected: {e}")
+                return None
+            ent = (table, 0)
+            self._grammar_states[req.request_id] = ent
+        return ent
 
     def _stage_fn(self, params, kv, inputs: BatchInputs):
         return self.model(params, kv, inputs)
@@ -313,6 +348,7 @@ class StageEngine:
             request_id
         )
         self._pending_hidden.pop(request_id, None)
+        self._grammar_states.pop(request_id, None)
         if req is not None:
             if not req.status.is_finished:
                 if abort:
@@ -447,6 +483,7 @@ class StageEngine:
                 or sp.frequency_penalty
                 or sp.repetition_penalty != 1.0
                 or sp.logprobs
+                or sp.json_schema       # grammar mask needs per-step host state
             ):
                 return False
         return True
@@ -733,6 +770,30 @@ class StageEngine:
                 logits, jnp.asarray(out_ids), jnp.asarray(pres),
                 jnp.asarray(freq), jnp.asarray(rep),
             )
+        g_rows, g_masks = [], []
+        for i, seg in enumerate(plan.seqs):
+            if not self._needs_token(seg):
+                continue
+            ent = self._grammar_entry(seg.request)
+            if ent is not None and not seg.request.status.is_finished:
+                table, state = ent
+                g_rows.append(i)
+                g_masks.append(table.allowed_mask(state))
+        if g_rows:
+            from parallax_tpu.ops.sampling import apply_grammar_mask
+
+            bucket = 1
+            while bucket < len(g_rows):
+                bucket *= 2
+            rows = np.full((bucket,), -1, np.int32)
+            rows[: len(g_rows)] = g_rows
+            allowed = np.ones((bucket, logits.shape[-1]), bool)
+            for j, m in enumerate(g_masks):
+                allowed[j, : m.shape[0]] = m
+                allowed[j, m.shape[0]:] = False
+            logits = apply_grammar_mask(
+                logits, jnp.asarray(rows), jnp.asarray(allowed)
+            )
         need_lp = [
             bool(seg.request.sampling_params.logprobs) for seg in plan.seqs
         ]
@@ -785,7 +846,18 @@ class StageEngine:
             if not self._needs_token(seg):
                 continue
             req = seg.request
+            if req.status.is_finished:
+                # Aborted mid-step (e.g. grammar setup failure in _sample):
+                # never commit a token into a finished request — commit
+                # would clobber the abort status.
+                continue
             token = int(tokens[i])
+            ent = self._grammar_states.get(req.request_id)
+            if ent is not None:
+                table, state = ent
+                self._grammar_states[req.request_id] = (
+                    table, table.advance(state, token)
+                )
             lp = (
                 float(logprobs[i])
                 if logprobs is not None and req.sampling_params.logprobs
@@ -863,6 +935,7 @@ class StageEngine:
         for req in finished:
             self.scheduler.release_request(req)
             self._pending_hidden.pop(req.request_id, None)
+            self._grammar_states.pop(req.request_id, None)
             self._free_state_slot(req)
         return finished
 

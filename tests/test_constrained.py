@@ -1,0 +1,424 @@
+"""Grammar-constrained decoding: automaton, schema compiler, vocab masks,
+engine enforcement, HTTP response_format plumbing.
+
+Mirrors the reference's sampling-params surface (``json_schema`` in
+``src/parallax/server/sampling/sampling_params.py``), which the reference
+enforces only via its CUDA backends' grammar engines; here enforcement is
+framework-native, so it is tested end-to-end."""
+
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parallax_tpu.config import normalize_config
+from parallax_tpu.constrained import (
+    GrammarCompiler,
+    SchemaError,
+    TokenTable,
+    compile_schema,
+    validate_schema,
+)
+from parallax_tpu.constrained.automaton import Builder, compile_dfa
+from parallax_tpu.models.base import StageModel
+from parallax_tpu.runtime.engine import EngineConfig, StageEngine
+from parallax_tpu.runtime.pipeline import InProcessPipeline
+from parallax_tpu.runtime.request import Request, SamplingParams
+
+
+# -- automaton units ------------------------------------------------------
+
+def test_automaton_basics():
+    b = Builder()
+    frag = b.seq(b.lit(b"ab"), b.star(b.lit(b"c")), b.opt(b.lit(b"d")))
+    dfa = compile_dfa(b, frag)
+    assert dfa.matches(b"ab")
+    assert dfa.matches(b"abccc")
+    assert dfa.matches(b"abcd")
+    assert not dfa.matches(b"abd c")
+    assert not dfa.matches(b"a")
+
+
+def test_automaton_sep_list_single_item_copy():
+    b = Builder()
+    item = b.lit(b"x")
+    frag = b.sep_list(item, b.lit(b","))
+    dfa = compile_dfa(b, frag)
+    assert dfa.matches(b"x")
+    assert dfa.matches(b"x,x,x")
+    assert not dfa.matches(b"")
+    assert not dfa.matches(b"x,")
+    assert not dfa.matches(b",x")
+
+
+def test_automaton_alt_ranges():
+    b = Builder()
+    frag = b.plus(b.byte_class([(0x30, 0x34), (0x37, 0x39)]))
+    dfa = compile_dfa(b, frag)
+    assert dfa.matches(b"012347789")
+    assert not dfa.matches(b"5")
+    assert not dfa.matches(b"")
+
+
+# -- schema compiler ------------------------------------------------------
+
+def test_schema_required_object():
+    dfa = compile_schema(json.dumps({
+        "type": "object",
+        "properties": {"name": {"type": "string"},
+                       "age": {"type": "integer"}},
+        "required": ["name", "age"],
+    }))
+    assert dfa.matches(b'{"name": "bob", "age": 42}')
+    assert dfa.matches(b'{"name":"","age":0}')
+    assert not dfa.matches(b'{"age": 42}')          # missing required
+    assert not dfa.matches(b'{"name": "b", "age": 1.5}')  # float for int
+    assert not dfa.matches(b'{"name": "b", "age": 1,}')   # trailing comma
+
+
+def test_schema_optional_properties():
+    dfa = compile_schema(json.dumps({
+        "type": "object",
+        "properties": {"a": {"type": "boolean"}, "b": {"type": "null"}},
+    }))
+    assert dfa.matches(b"{}")
+    assert dfa.matches(b'{"a": true}')
+    assert dfa.matches(b'{"b": null}')
+    assert dfa.matches(b'{"a": false, "b": null}')
+    assert not dfa.matches(b'{"b": null, "a": true}')  # order fixed
+
+
+def test_schema_enum_const_anyof():
+    dfa = compile_schema(json.dumps({
+        "anyOf": [{"enum": ["red", "green"]}, {"const": 7}],
+    }))
+    assert dfa.matches(b'"red"')
+    assert dfa.matches(b"7")
+    assert not dfa.matches(b'"blue"')
+    assert not dfa.matches(b"8")
+
+
+def test_schema_arrays():
+    dfa = compile_schema(json.dumps({
+        "type": "array", "items": {"type": "integer"},
+        "minItems": 1, "maxItems": 3,
+    }))
+    assert dfa.matches(b"[1]")
+    assert dfa.matches(b"[1, 2, 3]")
+    assert not dfa.matches(b"[]")
+    assert not dfa.matches(b"[1, 2, 3, 4]")
+    unbounded = compile_schema(json.dumps({
+        "type": "array", "items": {"type": "boolean"},
+    }))
+    assert unbounded.matches(b"[" + b", ".join([b"true"] * 40) + b"]")
+
+
+def test_schema_string_bounds_and_numbers():
+    dfa = compile_schema(json.dumps({"type": "string", "maxLength": 2}))
+    assert dfa.matches(b'"ab"')
+    assert not dfa.matches(b'"abc"')
+    num = compile_schema(json.dumps({"type": "number"}))
+    for ok in (b"0", b"-1.5", b"2e10", b"3.25E-2"):
+        assert num.matches(ok), ok
+    for bad in (b"01", b"+1", b".5", b"1."):
+        assert not num.matches(bad), bad
+
+
+def test_schema_any_json_mode():
+    dfa = compile_schema("{}")
+    for ok in (b'{"a": [1, {"b": null}]}', b"[true]", b'"s"', b"-2.5"):
+        assert dfa.matches(ok), ok
+    for bad in (b"{", b'{"a": 1]', b"[1,]", b"tru"):
+        assert not dfa.matches(bad), bad
+
+
+def test_schema_unsupported_rejected():
+    with pytest.raises(SchemaError):
+        compile_schema(json.dumps({"type": "object", "required": ["ghost"]}))
+    with pytest.raises(ValueError):
+        compile_schema(json.dumps({"type": "frobnicate"}))
+    with pytest.raises(ValueError):
+        validate_schema(json.dumps({"enum": []}))
+    validate_schema("{}")   # cached success path
+
+
+# -- vocab masks ----------------------------------------------------------
+
+BYTE_VOCAB = [bytes([i]) for i in range(256)] + [b"", b""]
+EOS = 257
+
+
+def _mask_generate(table: TokenTable, pick, max_steps=200) -> bytes:
+    state, out = 0, b""
+    for _ in range(max_steps):
+        mask = table.allowed_mask(state)
+        tok = pick(mask, state)
+        if tok == EOS:
+            assert table.is_accepting(state)
+            break
+        out += BYTE_VOCAB[tok]
+        state = table.advance(state, tok)
+        assert state >= 0
+    return out
+
+
+def test_mask_walk_produces_valid_json():
+    dfa = compile_schema(json.dumps({
+        "type": "object",
+        "properties": {"ok": {"type": "boolean"},
+                       "tag": {"enum": ["a", "b"]}},
+        "required": ["ok", "tag"],
+    }))
+    table = TokenTable(dfa, BYTE_VOCAB, EOS)
+    rng = np.random.default_rng(0)
+
+    def pick(mask, state):
+        choices = np.flatnonzero(mask)
+        return int(rng.choice(choices))
+
+    for _ in range(20):
+        out = _mask_generate(table, pick)
+        obj = json.loads(out)
+        assert isinstance(obj["ok"], bool)
+        assert obj["tag"] in ("a", "b")
+
+
+def test_mask_zero_length_tokens_never_allowed():
+    dfa = compile_schema('{"type": "boolean"}')
+    table = TokenTable(dfa, BYTE_VOCAB, EOS)
+    mask = table.allowed_mask(0)
+    assert not mask[256]            # zero-length token (bos slot)
+    assert not mask[EOS]            # start state is not accepting
+    # after "true", EOS allowed
+    state = 0
+    for byt in b"true":
+        state = table.advance(state, byt)
+    assert table.allowed_mask(state)[EOS]
+
+
+def test_vocab_bytes_sentencepiece_dialect():
+    """SP vocabs ('▁' word marker, '<0xNN>' byte tokens) must not be
+    misread as byte-level BPE (plain ASCII exists in both dialects)."""
+    from parallax_tpu.constrained.vocab import vocab_bytes_from_tokenizer
+
+    class SP:
+        vocab_size = 6
+
+        def get_vocab(self):
+            return {"<unk>": 0, "the": 1, "▁the": 2, "<0x20>": 3,
+                    "▁a": 4, "</s>": 5}
+
+    v = vocab_bytes_from_tokenizer(SP())
+    assert v[1] == b"the"
+    assert v[2] == b" the"
+    assert v[3] == b" "
+    assert v[4] == b" a"
+    assert v[0].startswith(b"\x00\xff")     # special -> dead sentinel
+    assert v[5].startswith(b"\x00\xff")
+
+
+def test_vocab_bytes_byte_level_dialect():
+    from parallax_tpu.constrained.vocab import vocab_bytes_from_tokenizer
+
+    class BL:
+        vocab_size = 4
+
+        def get_vocab(self):
+            return {"the": 0, "Ġthe": 1, "Ċ": 2, "<|im_end|>": 3}
+
+    v = vocab_bytes_from_tokenizer(BL())
+    assert v[0] == b"the"
+    assert v[1] == b" the"
+    assert v[2] == b"\n"
+    assert v[3].startswith(b"\x00\xff")
+
+
+def test_grammar_compiler_cache():
+    gc = GrammarCompiler(BYTE_VOCAB, EOS)
+    t1 = gc.compile('{"type": "boolean"}')
+    t2 = gc.compile('{"type": "boolean"}')
+    assert t1 is t2
+    with pytest.raises(ValueError):
+        gc.compile('{"type": "nope"}')
+
+
+# -- engine enforcement ---------------------------------------------------
+
+TINY = normalize_config(dict(
+    architectures=["Qwen2ForCausalLM"],
+    hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+    num_key_value_heads=2, intermediate_size=128, vocab_size=258,
+    max_position_embeddings=512,
+))
+
+SCHEMA = json.dumps({
+    "type": "object",
+    "properties": {"v": {"enum": ["x", "y"]}},
+    "required": ["v"],
+})
+
+
+def _engine():
+    m = StageModel(TINY, 0, 2, use_pallas=False)
+    eng = StageEngine(
+        m, m.init_params(jax.random.key(0), dtype=jnp.float32),
+        EngineConfig(page_size=8, num_pages=64, max_model_len=128,
+                     kv_dtype="float32"),
+    )
+    eng.set_grammar_vocab(BYTE_VOCAB, EOS)
+    return eng
+
+
+@pytest.mark.parametrize("temperature", [0.0, 0.9])
+def test_engine_constrained_output_is_valid(temperature):
+    eng = _engine()
+    pipe = InProcessPipeline([eng])
+    reqs = []
+    for i in range(3):
+        r = Request(
+            request_id=f"g{i}",
+            prompt_ids=[1, 2, 3 + i],
+            sampling_params=SamplingParams(
+                temperature=temperature, max_new_tokens=40,
+                json_schema=SCHEMA, seed=i if temperature else None,
+            ),
+        )
+        reqs.append(r)
+        pipe.submit(r)
+    pipe.run_until_complete()
+    for r in reqs:
+        out = bytes(t for t in r.output_ids if t < 256)
+        obj = json.loads(out)
+        assert obj["v"] in ("x", "y"), out
+
+
+def test_engine_mixed_constrained_and_free():
+    """Constrained and unconstrained requests in one batch: masks apply
+    only to their rows."""
+    eng = _engine()
+    pipe = InProcessPipeline([eng])
+    g = Request("g", prompt_ids=[5, 6], sampling_params=SamplingParams(
+        temperature=0.0, max_new_tokens=30, json_schema=SCHEMA))
+    f = Request("f", prompt_ids=[5, 6], sampling_params=SamplingParams(
+        temperature=0.0, max_new_tokens=8, ignore_eos=True))
+    pipe.submit(g)
+    pipe.submit(f)
+    pipe.run_until_complete()
+    json.loads(bytes(t for t in g.output_ids if t < 256))
+    assert len(f.output_ids) == 8     # free request unaffected
+
+
+def test_engine_without_vocab_aborts_constrained():
+    m = StageModel(TINY, 0, 2, use_pallas=False)
+    eng = StageEngine(
+        m, m.init_params(jax.random.key(0), dtype=jnp.float32),
+        EngineConfig(page_size=8, num_pages=64, max_model_len=128,
+                     kv_dtype="float32"),
+    )
+    pipe = InProcessPipeline([eng])
+    r = Request("g", prompt_ids=[1], sampling_params=SamplingParams(
+        temperature=0.0, max_new_tokens=8, json_schema=SCHEMA))
+    pipe.submit(r)
+    pipe.run_until_complete()
+    assert r.status.name.startswith("FINISHED_ABORT")
+
+
+def test_grammar_state_cleared_on_release():
+    eng = _engine()
+    pipe = InProcessPipeline([eng])
+    r = Request("g", prompt_ids=[1], sampling_params=SamplingParams(
+        temperature=0.0, max_new_tokens=30, json_schema=SCHEMA))
+    pipe.submit(r)
+    pipe.run_until_complete()
+    assert "g" not in eng._grammar_states
+
+
+# -- HTTP plumbing --------------------------------------------------------
+
+def test_response_format_parsing_and_400():
+    from parallax_tpu.backend.http_server import _schema_from_body
+
+    assert _schema_from_body({}) is None
+    assert _schema_from_body({"response_format": {"type": "text"}}) is None
+    assert _schema_from_body(
+        {"response_format": {"type": "json_object"}}) == "{}"
+    s = _schema_from_body({"response_format": {
+        "type": "json_schema",
+        "json_schema": {"name": "t", "schema": {"type": "boolean"}},
+    }})
+    assert json.loads(s) == {"type": "boolean"}
+    inline = _schema_from_body({"response_format": {
+        "type": "json_schema",
+        "json_schema": {"type": "boolean"},   # inline, no 'schema' wrapper
+    }})
+    assert json.loads(inline) == {"type": "boolean"}
+    with pytest.raises(ValueError):
+        # Spec with the schema accidentally omitted must 400, not silently
+        # downgrade to any-JSON mode.
+        _schema_from_body({"response_format": {
+            "type": "json_schema",
+            "json_schema": {"name": "x", "strict": True},
+        }})
+    with pytest.raises(ValueError):
+        _schema_from_body({"response_format": {"type": "grammar"}})
+    with pytest.raises(ValueError):
+        _schema_from_body({"response_format": {
+            "type": "json_schema",
+            "json_schema": {"schema": {"type": "frobnicate"}},
+        }})
+
+
+def test_http_json_object_end_to_end():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from parallax_tpu.backend.http_server import SimpleTokenizer
+    from parallax_tpu.backend.serve import build_local_frontend
+
+    m = StageModel(TINY, 0, 2, use_pallas=False)
+    eng = StageEngine(
+        m, m.init_params(jax.random.key(0), dtype=jnp.float32),
+        EngineConfig(page_size=8, num_pages=64, max_model_len=128,
+                     kv_dtype="float32"),
+    )
+    fe, runner = build_local_frontend([eng], SimpleTokenizer(),
+                                      model_name="tiny")
+    try:
+        async def go():
+            server = TestServer(fe.app)
+            client = TestClient(server)
+            await client.start_server()
+            try:
+                resp = await client.post("/v1/chat/completions", json={
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 40,
+                    "temperature": 0,
+                    "response_format": {"type": "json_schema",
+                                        "json_schema": {"schema":
+                                                        json.loads(SCHEMA)}},
+                })
+                assert resp.status == 200, await resp.text()
+                body = await resp.json()
+                content = body["choices"][0]["message"]["content"]
+                assert json.loads(content)["v"] in ("x", "y")
+                bad = await client.post("/v1/chat/completions", json={
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "response_format": {"type": "json_schema",
+                                        "json_schema": {"schema":
+                                                        {"type": "wat"}}},
+                })
+                assert bad.status == 400
+            finally:
+                await client.close()
+
+        loop = asyncio.new_event_loop()
+        try:
+            loop.run_until_complete(go())
+        finally:
+            loop.close()
+    finally:
+        runner.stop()
