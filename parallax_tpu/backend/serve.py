@@ -79,24 +79,14 @@ def build_local_frontend(
     # happens); wire the tokenizer's raw byte vocabulary into it.
     last = engines[-1]
     if last.model.is_last:
-        eos_ids = tuple(getattr(tokenizer, "eos_token_ids", ()) or ())
-        if not eos_ids:
-            # Never fabricate an EOS id: the grammar mask would allow a
-            # real token at accepting states without ever finishing.
-            logger.warning("tokenizer has no EOS id; json_schema requests "
-                           "will be rejected")
-        else:
-            try:
-                from parallax_tpu.constrained import (
-                    vocab_bytes_from_tokenizer,
-                )
+        try:
+            from parallax_tpu.constrained import grammar_vocab_from_tokenizer
 
-                last.set_grammar_vocab(
-                    vocab_bytes_from_tokenizer(tokenizer), eos_ids[0]
-                )
-            except Exception as e:  # tokenizer without a recoverable vocab
-                logger.warning("grammar vocab unavailable (%s); "
-                               "json_schema requests will be rejected", e)
+            vocab, eos = grammar_vocab_from_tokenizer(tokenizer)
+            last.set_grammar_vocab(vocab, eos)
+        except Exception as e:  # no EOS id / no recoverable vocab
+            logger.warning("grammar vocab unavailable (%s); "
+                           "json_schema requests will be rejected", e)
 
     def status():
         return {

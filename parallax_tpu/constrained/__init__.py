@@ -24,12 +24,28 @@ __all__ = [
     "TokenTable",
     "compile_dfa",
     "compile_schema",
+    "grammar_vocab_from_tokenizer",
     "validate_schema",
     "vocab_bytes_from_tokenizer",
 ]
 
 
 import functools
+
+
+def grammar_vocab_from_tokenizer(tok) -> tuple[list[bytes], int] | None:
+    """Shared tokenizer -> (vocab bytes, eos id) derivation for grammar
+    wiring; None (with the reason logged by the caller via ValueError)
+    when enforcement cannot be sound.
+
+    Refuses tokenizers without an EOS id: the mask layer would otherwise
+    have to fabricate one, letting a real token pass at accepting states
+    without ever finishing the request.
+    """
+    eos = tuple(getattr(tok, "eos_token_ids", ()) or ())
+    if not eos:
+        raise ValueError("tokenizer has no EOS id")
+    return vocab_bytes_from_tokenizer(tok), eos[0]
 
 
 @functools.lru_cache(maxsize=64)

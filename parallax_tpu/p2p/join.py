@@ -88,6 +88,7 @@ def join_main(args) -> int:
         tp_size=n_devices if n_devices > 1 else 1,
         refit_cache_dir=getattr(args, "refit_cache_dir", None),
         resolve_model=resolve_model,
+        tokenizer_path=args.model_path,
     )
     node.start()
     logger.info("worker %s joined %s", node.node_id, scheduler_peer)

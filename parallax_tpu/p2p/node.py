@@ -15,6 +15,7 @@ is unnecessary on DCN.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -54,6 +55,7 @@ class WorkerNode:
         tp_size: int = 1,
         refit_cache_dir: str | None = None,
         resolve_model=None,  # callable (name) -> (ModelConfig, load_params|None)
+        tokenizer_path: str | None = None,
     ):
         self.transport = transport
         self.scheduler_peer = scheduler_peer
@@ -64,6 +66,8 @@ class WorkerNode:
         self.mesh = mesh
         self.tp_size = tp_size
         self.resolve_model = resolve_model
+        self.tokenizer_path = tokenizer_path
+        self._grammar_vocab: tuple | None = None
         self._served_model_name: str | None = None
         self.refit_store = None
         if refit_cache_dir:
@@ -166,8 +170,42 @@ class WorkerNode:
         self.engine = StageEngine(
             model, params, self.engine_config, mesh=self.mesh
         )
+        if model.is_last:
+            self._wire_grammar()
         self._restore_refit_cache()
         self._allocated.set()
+
+    def _wire_grammar(self) -> None:
+        """Enable json_schema enforcement on a last-stage worker: build the
+        tokenizer byte vocabulary once and hand it to the engine. Without a
+        real tokenizer on disk, constrained requests abort with a clear
+        reason instead of being silently unenforced."""
+        if self._grammar_vocab is None:
+            if not self.tokenizer_path:
+                return
+            try:
+                from parallax_tpu.backend.http_server import (
+                    SimpleTokenizer,
+                    load_tokenizer,
+                )
+                from parallax_tpu.constrained import (
+                    grammar_vocab_from_tokenizer,
+                )
+
+                tok = load_tokenizer(self.tokenizer_path)
+                if isinstance(tok, SimpleTokenizer):
+                    # The byte fallback's ids won't match a real model's
+                    # vocabulary — masks built from it would be garbage.
+                    raise ValueError(
+                        f"no tokenizer files at {self.tokenizer_path}"
+                    )
+                self._grammar_vocab = grammar_vocab_from_tokenizer(tok)
+            except Exception as e:
+                logger.warning("%s: grammar vocab unavailable (%s); "
+                               "json_schema requests will be rejected",
+                               self.node_id, e)
+                return
+        self.engine.set_grammar_vocab(*self._grammar_vocab)
 
     def _abort_in_flight(self, reason: str) -> None:
         eng = self.engine
@@ -216,6 +254,10 @@ class WorkerNode:
             self.load_params = load_params
         else:
             self.load_params = self._random_params
+        # The new model's tokenizer differs: rebuild the grammar vocab
+        # lazily from the new checkpoint (presets have no tokenizer).
+        self._grammar_vocab = None
+        self.tokenizer_path = model_name if os.path.isdir(model_name) else None
         return True
 
     def _restore_refit_cache(self) -> None:

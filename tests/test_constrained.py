@@ -336,6 +336,92 @@ def test_grammar_state_cleared_on_release():
     assert "g" not in eng._grammar_states
 
 
+# -- swarm (multi-stage over TCP): mask applies on the last stage ---------
+
+def test_swarm_constrained_over_tcp(monkeypatch):
+    import dataclasses
+    import time
+
+    from parallax_tpu.backend.scheduler_service import SchedulerService
+    from parallax_tpu.p2p.node import WorkerNode
+    from parallax_tpu.p2p.transport import TcpTransport
+    from parallax_tpu.scheduling import node as node_mod
+    from parallax_tpu.scheduling.scheduler import GlobalScheduler
+
+    cfg = dataclasses.replace(TINY, num_hidden_layers=4,
+                              layer_types=("attention",) * 4)
+    vocab151 = [bytes([i]) for i in range(149)] + [b"", b""]
+    eos151 = 150
+
+    monkeypatch.setattr(
+        node_mod.RooflinePerformanceModel, "max_layers_in_memory",
+        lambda self, kv_fraction=0.35: 2,
+    )
+    sched = GlobalScheduler(cfg, min_nodes_bootstrapping=2)
+    st = TcpTransport("scheduler", "127.0.0.1")
+    service = SchedulerService(sched, st, join_timeout_s=30.0)
+    service.start()
+
+    workers = []
+    try:
+        import threading
+
+        for _ in range(2):
+            t = TcpTransport("", "127.0.0.1")
+            t.start()
+            t.peer_id = t.address
+            w = WorkerNode(
+                transport=t, scheduler_peer=st.address, model_config=cfg,
+                engine_config=EngineConfig(
+                    page_size=8, num_pages=64, max_model_len=128,
+                    kv_dtype="float32", max_batch_size=8,
+                    max_num_tokens_per_batch=128,
+                ),
+                load_params=lambda m: m.init_params(
+                    jax.random.key(m.start_layer), dtype=jnp.float32),
+                heartbeat_interval_s=0.2,
+            )
+            # Pre-seed the grammar vocab cache (no tokenizer files in this
+            # synthetic swarm); _wire_grammar applies it on the last stage.
+            w._grammar_vocab = (vocab151, eos151)
+            workers.append(w)
+        starters = [threading.Thread(target=w.start) for w in workers]
+        for s in starters:
+            s.start()
+        for s in starters:
+            s.join(timeout=60.0)
+
+        end = time.monotonic() + 15.0
+        while time.monotonic() < end:
+            status = service.scheduler.cluster_status()
+            if status["num_pipelines"] >= 1 and all(
+                n["ready"] for p in status["pipelines"] for n in p["nodes"]
+            ):
+                break
+            time.sleep(0.05)
+
+        path = service.route_request("req-g", timeout_s=10.0)
+        assert path is not None and len(path) == 2
+        head = next(w for w in workers if w.node_id == path[0])
+        last = next(w for w in workers if w.node_id == path[-1])
+        assert last.engine.grammar is not None
+
+        req = Request(
+            request_id="req-g", prompt_ids=[1, 2, 3],
+            sampling_params=SamplingParams(
+                temperature=0.0, max_new_tokens=40, json_schema=SCHEMA),
+            routing_table=list(path),
+        )
+        done = head.submit(req)
+        assert done.wait(60.0), f"request did not finish: {req.status}"
+        out = bytes(t for t in req.output_ids if t < 149)
+        assert json.loads(out)["v"] in ("x", "y"), out
+    finally:
+        for w in workers:
+            w.stop()
+        service.stop()
+
+
 # -- HTTP plumbing --------------------------------------------------------
 
 def test_response_format_parsing_and_400():
