@@ -203,6 +203,11 @@ def _bench():
 
     model = StageModel(cfg, 0, cfg.num_hidden_layers)
     params = model.init_params(jax.random.key(0), dtype=dtype)
+    quant = os.environ.get("BENCH_QUANT", "")   # "int8" / "int4" opt-in
+    if quant:
+        from parallax_tpu.ops.quant import quantize_tree
+
+        params = quantize_tree(params, bits=int(quant.removeprefix("int")))
     params = jax.tree.map(lambda x: x.block_until_ready(), params)
 
     max_model_len = prompt_len + gen_len + page_size
@@ -325,6 +330,7 @@ def _bench():
             "batch": batch,
             "decode_lookahead": lookahead,
             "decode_phase_detected": phase_ok,
+            **({"quantization": quant} if quant else {}),
             "decode_dispatch_ms_median": round(step_ms, 2),
             "decode_dispatches": len(dispatch_times),
             "decode_tokens": decode_tokens,

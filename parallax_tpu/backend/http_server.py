@@ -28,85 +28,9 @@ from parallax_tpu.utils import get_logger
 logger = get_logger(__name__)
 
 
-class SimpleTokenizer:
-    """Byte-level fallback tokenizer for checkpoints without tokenizer files."""
-
-    vocab_size = 256 + 2
-    bos_id = 256
-    eos_id = 257
-
-    def encode(self, text: str) -> list[int]:
-        if not text:
-            return []
-        return [self.bos_id] + list(text.encode("utf-8"))
-
-    def decode(self, ids) -> str:
-        return bytes(i for i in ids if i < 256).decode("utf-8", errors="replace")
-
-    @property
-    def eos_token_ids(self):
-        return (self.eos_id,)
-
-    def apply_chat_template(self, messages) -> str:
-        return "\n".join(f"{m['role']}: {m['content']}" for m in messages) + "\nassistant:"
-
-    def vocab_bytes(self) -> list[bytes]:
-        """Exact token->bytes map for grammar-constrained decoding (the
-        generic decode() fallback would mangle non-ASCII lead bytes)."""
-        return [bytes([i]) for i in range(256)] + [b"", b""]
-
-
-def load_tokenizer(model_path: str | None):
-    if model_path:
-        try:
-            import os
-
-            if not any(
-                os.path.exists(os.path.join(model_path, f))
-                for f in ("tokenizer.json", "tokenizer_config.json",
-                          "tokenizer.model")
-            ):
-                raise FileNotFoundError("no tokenizer files in checkpoint")
-            from transformers import AutoTokenizer
-
-            # local_files_only: never hit the hub (serving hosts may be
-            # air-gapped; a hub fetch can hang for minutes).
-            tok = AutoTokenizer.from_pretrained(
-                model_path, local_files_only=True
-            )
-
-            class _HF:
-                vocab_size = tok.vocab_size
-
-                def encode(self, text):
-                    return tok.encode(text)
-
-                def decode(self, ids):
-                    return tok.decode(ids, skip_special_tokens=True)
-
-                @property
-                def eos_token_ids(self):
-                    return (tok.eos_token_id,) if tok.eos_token_id else ()
-
-                def get_vocab(self):
-                    return tok.get_vocab()
-
-                @property
-                def all_special_ids(self):
-                    return getattr(tok, "all_special_ids", None) or ()
-
-                def get_added_vocab(self):
-                    return getattr(tok, "get_added_vocab", dict)() or {}
-
-                def apply_chat_template(self, messages):
-                    return tok.apply_chat_template(
-                        messages, tokenize=False, add_generation_prompt=True
-                    )
-
-            return _HF()
-        except Exception as e:
-            logger.warning("tokenizer load failed (%s); using byte fallback", e)
-    return SimpleTokenizer()
+# SimpleTokenizer / load_tokenizer live in utils.tokenizer (shared with
+# frontend-less swarm workers); re-exported here for compatibility.
+from parallax_tpu.utils.tokenizer import SimpleTokenizer, load_tokenizer  # noqa: E402,F401
 
 
 def _schema_from_body(body: dict) -> str | None:
@@ -156,8 +80,15 @@ def _sampling_from_body(body: dict, default_max: int = 512) -> SamplingParams:
     seed = body.get("seed")
     if seed is not None:
         seed = int(seed)  # ValueError -> 400 in the caller
+    logit_bias = body.get("logit_bias") or None
+    if logit_bias is not None:
+        if not isinstance(logit_bias, dict):
+            raise ValueError("logit_bias must be an object of "
+                             "token_id -> bias")
+        logit_bias = {int(k): float(v) for k, v in logit_bias.items()}
     return SamplingParams(
         json_schema=_schema_from_body(body),
+        logit_bias=logit_bias,
         temperature=float(body.get("temperature", 1.0)),
         top_p=float(body.get("top_p", 1.0)),
         top_k=int(body.get("top_k", -1)),
@@ -229,6 +160,10 @@ def _stop_holdback(text: str, stops) -> int:
                 hold = max(hold, n)
                 break
     return hold
+
+
+class _GenFailed(Exception):
+    """A request aborted or timed out before completing."""
 
 
 class _StopScanner:
@@ -493,6 +428,16 @@ class OpenAIFrontend:
         except (TypeError, ValueError) as e:
             return self._error(400, f"invalid sampling parameter: {e}")
 
+        try:
+            raw_n = body.get("n")
+            n_choices = 1 if raw_n is None else int(raw_n)
+        except (TypeError, ValueError):
+            return self._error(400, "n must be an integer")
+        if not 1 <= n_choices <= 8:
+            return self._error(400, "n must be between 1 and 8")
+        if n_choices > 1 and body.get("stream"):
+            return self._error(400, "streaming with n > 1 is not supported")
+
         # Routing with retry ladder (reference request_handler.py:100-245:
         # None path -> 503 after retries; engine full -> 429).
         routing_table: list[str] = []
@@ -501,6 +446,12 @@ class OpenAIFrontend:
             if path is None:
                 return self._error(503, "no serviceable pipeline")
             routing_table = path
+
+        if n_choices > 1:
+            return await self._generate_n(
+                rid, body, prompt_ids, sampling_params, routing_table,
+                chat, n_choices,
+            )
 
         req = Request(
             request_id=rid,
@@ -526,39 +477,12 @@ class OpenAIFrontend:
                 http_request, req, done, chat, t_start
             )
         try:
-            stops = req.sampling_params.stop_strings
-            stop_idx = None
-            dec = IncrementalDecoder(self.tokenizer)
-            scanner = _StopScanner(stops)
-            if stops:
-                # Poll so a stop-string match ends generation early instead
-                # of silently running to EOS/max_tokens.
-                deadline = time.monotonic() + 600.0
-                checked = 0
-                while not req.status.is_finished:
-                    if time.monotonic() > deadline:
-                        req.abort("deadline exceeded")
-                        break
-                    n = len(req.output_ids)
-                    if n > checked:
-                        checked = n
-                        text = dec.update(list(req.output_ids[:n]))
-                        stop_idx = scanner.find(text)
-                        if stop_idx is not None:
-                            await self._request_stop(req)
-                            break
-                    await asyncio.sleep(self.stream_poll_s)
-                ok = req.status.is_finished or stop_idx is not None
-            else:
-                ok = await asyncio.to_thread(done.wait, 600.0)
-            if not ok or req.status.value == "finished_abort":
-                return self._error(502, f"generation failed: {req.abort_reason}")
-            text = dec.finalize(list(req.output_ids))
-            if stop_idx is None and stops:
-                stop_idx = scanner.find(text)
-            stop_matched = stop_idx is not None
-            if stop_idx is not None:
-                text = text[:stop_idx]
+            # finally (not except): client disconnects cancel this handler
+            # mid-wait, and generated tokens must still reach /metrics.
+            try:
+                text, stop_matched = await self._await_completion(req, done)
+            except _GenFailed as e:
+                return self._error(502, f"generation failed: {e}")
             return web.json_response(
                 self._completion_body(
                     req, text, chat, t_start,
@@ -567,6 +491,120 @@ class OpenAIFrontend:
             )
         finally:
             self._counters["completion_tokens"] += req.num_output_tokens
+
+    async def _generate_n(self, rid, body, prompt_ids, sampling_params,
+                          routing_table, chat, n_choices):
+        """OpenAI ``n`` > 1: n independent generations on one pipeline path,
+        merged into one choices array. (The reference's engine protocol has
+        no multi-choice support; the vllm-rs frontend expands client-side
+        the same way.) Seeded requests get seed+i per choice so the
+        choices differ; greedy requests will legitimately all match."""
+        import dataclasses as _dc
+
+        reqs, dones = [], []
+        for i in range(n_choices):
+            sp = sampling_params
+            if sp.seed is not None:
+                sp = _dc.replace(sp, seed=sp.seed + i)
+            req = Request(
+                request_id=f"{rid}-{i}",
+                prompt_ids=list(prompt_ids),
+                sampling_params=sp,
+                routing_table=list(routing_table),
+                eos_token_ids=tuple(self.tokenizer.eos_token_ids),
+            )
+            self._counters["requests"] += 1
+            self._counters["prompt_tokens"] += req.num_prompt_tokens
+            try:
+                done = await asyncio.to_thread(self.submit_fn, req)
+            except ValueError as e:
+                for r in reqs:
+                    await self._request_stop(r)
+                return self._error(400, str(e))
+            except RuntimeError as e:
+                for r in reqs:
+                    await self._request_stop(r)
+                return self._error(429, str(e))
+            reqs.append(req)
+            dones.append(done)
+        t_start = time.monotonic()
+
+        try:
+            results = await asyncio.gather(
+                *(self._await_completion(r, d) for r, d in zip(reqs, dones)),
+                return_exceptions=True,
+            )
+        finally:
+            # Cancellation-safe: tokens generated before a client
+            # disconnect must still reach /metrics.
+            for req in reqs:
+                self._counters["completion_tokens"] += req.num_output_tokens
+        failures = [r for r in results if isinstance(r, BaseException)]
+        if failures:
+            return self._error(502, f"generation failed: {failures[0]}")
+
+        choices = []
+        for i, (req, (text, stop_matched)) in enumerate(zip(reqs, results)):
+            c = self._completion_body(
+                req, text, chat, t_start,
+                finish_override="stop" if stop_matched else None,
+            )["choices"][0]
+            c["index"] = i
+            choices.append(c)
+        completion = sum(r.num_output_tokens for r in reqs)
+        prompt = reqs[0].num_prompt_tokens
+        elapsed = max(1e-6, time.monotonic() - t_start)
+        return web.json_response({
+            "id": rid,
+            "object": "chat.completion" if chat else "text_completion",
+            "created": int(time.time()),
+            "model": self.model_name,
+            "choices": choices,
+            "usage": {
+                "prompt_tokens": prompt,
+                "completion_tokens": completion,
+                "total_tokens": prompt + completion,
+                "tokens_per_second": round(completion / elapsed, 2),
+            },
+        })
+
+    async def _await_completion(self, req, done) -> tuple[str, bool]:
+        """Wait for one request's generation; returns (text, stop_matched).
+        Raises _GenFailed on abort/timeout. Stop strings end generation
+        early via the poll loop instead of silently running to
+        EOS/max_tokens."""
+        stops = req.sampling_params.stop_strings
+        stop_idx = None
+        dec = IncrementalDecoder(self.tokenizer)
+        scanner = _StopScanner(stops)
+        if stops:
+            deadline = time.monotonic() + 600.0
+            checked = 0
+            while not req.status.is_finished:
+                if time.monotonic() > deadline:
+                    req.abort("deadline exceeded")
+                    break
+                n = len(req.output_ids)
+                if n > checked:
+                    checked = n
+                    text = dec.update(list(req.output_ids[:n]))
+                    stop_idx = scanner.find(text)
+                    if stop_idx is not None:
+                        await self._request_stop(req)
+                        break
+                await asyncio.sleep(self.stream_poll_s)
+            ok = req.status.is_finished or stop_idx is not None
+        else:
+            ok = await asyncio.to_thread(done.wait, 600.0)
+        if not ok or req.status.value == "finished_abort":
+            raise _GenFailed(req.abort_reason or "timeout")
+        text = dec.finalize(list(req.output_ids))
+        if stop_idx is None and stops:
+            stop_idx = scanner.find(text)
+        stop_matched = stop_idx is not None
+        if stop_idx is not None:
+            text = text[:stop_idx]
+        return text, stop_matched
 
     async def _stream_response(self, http_request, req, done, chat, t_start):
         resp = web.StreamResponse(headers={

@@ -33,14 +33,14 @@ __all__ = [
 import functools
 
 
-def grammar_vocab_from_tokenizer(tok) -> tuple[list[bytes], int] | None:
+def grammar_vocab_from_tokenizer(tok) -> tuple[list[bytes], int]:
     """Shared tokenizer -> (vocab bytes, eos id) derivation for grammar
-    wiring; None (with the reason logged by the caller via ValueError)
-    when enforcement cannot be sound.
+    wiring.
 
-    Refuses tokenizers without an EOS id: the mask layer would otherwise
-    have to fabricate one, letting a real token pass at accepting states
-    without ever finishing the request.
+    Raises ValueError when enforcement cannot be sound — in particular for
+    tokenizers without an EOS id: the mask layer would otherwise have to
+    fabricate one, letting a real token pass at accepting states without
+    ever finishing the request.
     """
     eos = tuple(getattr(tok, "eos_token_ids", ()) or ())
     if not eos:

@@ -158,6 +158,15 @@ def apply_penalties(
 
 
 @jax.jit
+def bias_logits(
+    logits: jax.Array, rows: jax.Array, bias_rows: jax.Array
+) -> jax.Array:
+    """OpenAI logit_bias: add per-row bias vectors to the given rows.
+    ``rows`` i32[G] (-1 = padding, dropped), ``bias_rows`` f32[G, V]."""
+    return logits.at[rows].add(bias_rows, mode="drop")
+
+
+@jax.jit
 def apply_grammar_mask(
     logits: jax.Array, rows: jax.Array, allowed: jax.Array
 ) -> jax.Array:

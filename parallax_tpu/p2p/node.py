@@ -182,14 +182,19 @@ class WorkerNode:
         reason instead of being silently unenforced."""
         if self._grammar_vocab is None:
             if not self.tokenizer_path:
+                logger.warning(
+                    "%s: no tokenizer path (e.g. after switching to a "
+                    "preset model); json_schema requests will be rejected",
+                    self.node_id,
+                )
                 return
             try:
-                from parallax_tpu.backend.http_server import (
-                    SimpleTokenizer,
-                    load_tokenizer,
-                )
                 from parallax_tpu.constrained import (
                     grammar_vocab_from_tokenizer,
+                )
+                from parallax_tpu.utils.tokenizer import (
+                    SimpleTokenizer,
+                    load_tokenizer,
                 )
 
                 tok = load_tokenizer(self.tokenizer_path)

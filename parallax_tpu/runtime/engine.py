@@ -219,6 +219,8 @@ class StageEngine:
         # states live here keyed by request id.
         self.grammar = None
         self._grammar_states: dict[str, tuple] = {}
+        # Per-request dense logit_bias vectors (built once per request).
+        self._bias_cache: dict[str, np.ndarray] = {}
         # EWMA per-layer decode latency published to the global scheduler
         # (reference base_executor.py:716-732).
         self.layer_latency_ms_ewma: float | None = None
@@ -349,6 +351,7 @@ class StageEngine:
         )
         self._pending_hidden.pop(request_id, None)
         self._grammar_states.pop(request_id, None)
+        self._bias_cache.pop(request_id, None)
         if req is not None:
             if not req.status.is_finished:
                 if abort:
@@ -484,6 +487,7 @@ class StageEngine:
                 or sp.repetition_penalty != 1.0
                 or sp.logprobs
                 or sp.json_schema       # grammar mask needs per-step host state
+                or sp.logit_bias        # bias applied at the sampler
             ):
                 return False
         return True
@@ -770,6 +774,36 @@ class StageEngine:
                 logits, jnp.asarray(out_ids), jnp.asarray(pres),
                 jnp.asarray(freq), jnp.asarray(rep),
             )
+        b_rows, b_vecs = [], []
+        for i, seg in enumerate(plan.seqs):
+            lb = seg.request.sampling_params.logit_bias
+            if lb and self._needs_token(seg):
+                rid = seg.request.request_id
+                vec = self._bias_cache.get(rid)
+                if vec is None or vec.shape[0] != logits.shape[-1]:
+                    # Pure function of the immutable SamplingParams: build
+                    # once per request, not once per decode step.
+                    vec = np.zeros((logits.shape[-1],), np.float32)
+                    for tid, bias in lb.items():
+                        tid = int(tid)
+                        if 0 <= tid < vec.shape[0]:
+                            vec[tid] = float(bias)
+                    self._bias_cache[rid] = vec
+                b_rows.append(i)
+                b_vecs.append(vec)
+        if b_rows:
+            # Bias BEFORE the grammar mask so masked tokens stay -inf.
+            from parallax_tpu.ops.sampling import bias_logits
+
+            bucket = 1
+            while bucket < len(b_rows):
+                bucket *= 2
+            rows = np.full((bucket,), -1, np.int32)
+            rows[: len(b_rows)] = b_rows
+            vecs = np.zeros((bucket, logits.shape[-1]), np.float32)
+            for j, v in enumerate(b_vecs):
+                vecs[j] = v
+            logits = bias_logits(logits, jnp.asarray(rows), jnp.asarray(vecs))
         g_rows, g_masks = [], []
         for i, seg in enumerate(plan.seqs):
             if not self._needs_token(seg):
@@ -936,6 +970,7 @@ class StageEngine:
             self.scheduler.release_request(req)
             self._pending_hidden.pop(req.request_id, None)
             self._grammar_states.pop(req.request_id, None)
+            self._bias_cache.pop(req.request_id, None)
             self._free_state_slot(req)
         return finished
 

@@ -57,6 +57,9 @@ class SamplingParams:
     ignore_eos: bool = False
     seed: int | None = None
     json_schema: str | None = None
+    # OpenAI logit_bias: token id -> additive bias (reference REJECTS this
+    # field, engine_core_protocol.py:196; we support it natively).
+    logit_bias: dict | None = None
     # Return per-token logprobs of the sampled tokens (reference wire
     # fields token_prob/return_probs, forward.proto:39-40).
     logprobs: bool = False
@@ -72,6 +75,12 @@ class SamplingParams:
         d = dict(d)
         d["stop_token_ids"] = tuple(d.get("stop_token_ids", ()))
         d["stop_strings"] = tuple(d.get("stop_strings", ()))
+        if d.get("logit_bias"):
+            # JSON object keys arrive as strings (OpenAI sends them that
+            # way too); canonicalize to int -> float.
+            d["logit_bias"] = {
+                int(k): float(v) for k, v in d["logit_bias"].items()
+            }
         return cls(**{k: v for k, v in d.items()
                       if k in {f.name for f in dataclasses.fields(cls)}})
 

@@ -111,6 +111,48 @@ def test_completions_endpoint(frontend):
     with_client(frontend.app, fn)
 
 
+def test_n_choices(frontend):
+    async def fn(client):
+        status, body = await _json(client, "POST", "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "hi"}],
+             "max_tokens": 5, "temperature": 0.9, "seed": 7, "n": 3})
+        assert status == 200, body
+        assert [c["index"] for c in body["choices"]] == [0, 1, 2]
+        assert body["usage"]["completion_tokens"] == 15
+        # n>1 + stream and out-of-range n are rejected up front.
+        status, _ = await _json(client, "POST", "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "x"}],
+             "n": 2, "stream": True})
+        assert status == 400
+        status, _ = await _json(client, "POST", "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "x"}], "n": 0})
+        assert status == 400
+
+    with_client(frontend.app, fn)
+
+
+def test_logit_bias_forces_and_bans_tokens(frontend):
+    async def fn(client):
+        base = {"messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4, "temperature": 0}
+        # +1e4 bias on byte 'Z' (id 90) dominates every raw logit: the
+        # whole generation becomes 'Z's (reference REJECTS logit_bias —
+        # engine_core_protocol.py:196 — so this is beyond-parity surface).
+        status, body = await _json(client, "POST", "/v1/chat/completions",
+                                   {**base, "logit_bias": {"90": 10000.0}})
+        assert status == 200
+        assert body["choices"][0]["message"]["content"] == "ZZZZ"
+        # Relative bias: a slightly larger bias on 'Y' (89) outbids 'Z',
+        # i.e. biases compose per token, not winner-takes-all.
+        status, body = await _json(client, "POST", "/v1/chat/completions",
+                                   {**base, "logit_bias": {"90": 10000.0,
+                                                           "89": 10001.0}})
+        assert status == 200
+        assert body["choices"][0]["message"]["content"] == "YYYY"
+
+    with_client(frontend.app, fn)
+
+
 def test_streaming_chat(frontend):
     async def fn(client):
         resp = await client.post("/v1/chat/completions", json={
