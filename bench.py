@@ -20,10 +20,12 @@ per-token dispatch behind pipelined token waves, so unfused numbers on
 this rig measure the tunnel, not the framework. The bench therefore
 decodes with the engine's fused multi-step greedy path
 (``decode_lookahead=32``: k forward+argmax steps in one ``lax.scan``
-dispatch, one readback of k*batch tokens — exactness-preserving), which
-amortizes the rig artifact the same way wave overlap would. Lookahead and
-per-dispatch times are reported in ``detail``; set ``BENCH_LOOKAHEAD=1``
-to measure the unfused path.
+dispatch — exactness-preserving) chained through the pipelined decode
+(``decode_pipeline=7``: each window is dispatched from the previous
+window's device-resident carry before its tokens are read back), so the
+roundtrip is paid once per ~224 tokens and the chip never idles. Knobs:
+``BENCH_LOOKAHEAD`` / ``BENCH_PIPELINE`` / ``BENCH_BATCH``
+(``BENCH_LOOKAHEAD=1`` measures the unfused path).
 
 ``vs_baseline`` compares against a roofline-derived estimate of the
 reference's CUDA backend on 2xA100-80G (the repo publishes no numbers —
@@ -185,9 +187,17 @@ def _bench():
             num_hidden_layers=full.num_hidden_layers // 2,
             layer_types=full.layer_types[: full.num_hidden_layers // 2],
         )
-        batch, prompt_len, gen_len = 64, 128, 192
+        batch = int(os.environ.get("BENCH_BATCH", "128"))
+        prompt_len = 128
         dtype, kv_dtype, page_size = jnp.bfloat16, "bfloat16", 64
         lookahead = int(os.environ.get("BENCH_LOOKAHEAD", "32"))
+        pipeline = int(os.environ.get("BENCH_PIPELINE", "7"))
+        # Generation ends exactly on a chain boundary (1 prefill token +
+        # pipeline*k chained decode tokens) so no window compute is
+        # discarded by mid-chain finishes. Floor of 193 keeps the unfused
+        # measurement (BENCH_LOOKAHEAD=1) at ~192 decode samples instead
+        # of collapsing to pipeline*1 tokens.
+        gen_len = max(193, 1 + max(1, pipeline) * max(1, lookahead))
     else:
         # CPU smoke mode (BENCH_CPU=1): tiny shapes, same code path.
         cfg = dataclasses.replace(
@@ -200,6 +210,7 @@ def _bench():
         batch, prompt_len, gen_len = 8, 32, 16
         dtype, kv_dtype, page_size = jnp.float32, "float32", 16
         lookahead = int(os.environ.get("BENCH_LOOKAHEAD", "1"))
+        pipeline = int(os.environ.get("BENCH_PIPELINE", "1"))
 
     model = StageModel(cfg, 0, cfg.num_hidden_layers)
     params = model.init_params(jax.random.key(0), dtype=dtype)
@@ -243,6 +254,7 @@ def _bench():
             kv_dtype=kv_dtype,
             enable_prefix_cache=False,   # measure raw compute, not cache hits
             decode_lookahead=lookahead,
+            decode_pipeline=pipeline,
         ),
     )
     pipe = InProcessPipeline([engine])
@@ -329,6 +341,7 @@ def _bench():
             "stage_layers": cfg.num_hidden_layers,
             "batch": batch,
             "decode_lookahead": lookahead,
+            "decode_pipeline": pipeline,
             "decode_phase_detected": phase_ok,
             **({"quantization": quant} if quant else {}),
             "decode_dispatch_ms_median": round(step_ms, 2),

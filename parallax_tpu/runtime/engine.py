@@ -56,6 +56,15 @@ class EngineConfig:
     # over forward+argmax) — the SURVEY's "k tokens per dispatch" lever
     # against per-token host dispatch latency. 1 = off.
     decode_lookahead: int = 1
+    # Pipelined multi-step decode: chain this many k-token windows per
+    # host round. Window j+1 is dispatched from window j's device-resident
+    # carry (last token + context length) BEFORE window j's tokens are
+    # read back, so the host<->device roundtrip is paid once per
+    # ``decode_pipeline * decode_lookahead`` tokens and the chip never
+    # idles between windows (async dispatch; same exactness invariants as
+    # a single window — surplus tokens past a mid-chain finish are
+    # discarded). 1 = off.
+    decode_pipeline: int = 1
     # Speculative decoding (prompt-lookup / n-gram): propose up to this
     # many continuation tokens from earlier context matches and verify
     # them in ONE forward (greedy acceptance). 0 = off. Composes with the
@@ -164,7 +173,11 @@ class StageEngine:
         if mesh is not None and model.tp_size > 1:
             from parallax_tpu.parallel import tp as _tp
 
-            self.params = _tp.shard_params(params, mesh)
+            self.params = _tp.shard_params(
+                params, mesh,
+                col_vecs=getattr(model, "tp_column_vector_params",
+                                 frozenset()),
+            )
             self._jit_step = jax.jit(
                 _tp.tp_stage_fn(model, params, mesh), donate_argnums=(1,)
             )
@@ -402,10 +415,13 @@ class StageEngine:
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 return (kv, nxt, ctx + 1), nxt
 
-            (kv, _, _), tokens = jax.lax.scan(
+            (kv, feed, ctx), tokens = jax.lax.scan(
                 body, (kv, inputs.token_ids, inputs.kv_lens), None, length=k
             )
-            return tokens, kv                           # tokens: [k, S]
+            # tokens: [k, S]; (feed, ctx) is the device-resident carry the
+            # NEXT window starts from — returning it lets the host chain
+            # windows without reading tokens back in between.
+            return tokens, kv, feed, ctx
 
         return jax.jit(fn, donate_argnums=(1,))
 
@@ -429,39 +445,92 @@ class StageEngine:
             # (and the per-seq page table): fall back to single-step.
             if seg.request.total_len + k > self.cfg.max_model_len:
                 return None
+        # Pipelined windows: chain as many full k-token windows as every
+        # request's context budget allows, capped by config.
+        m = max(1, self.cfg.decode_pipeline)
         for seg in plan.seqs:
-            if not self.cache.ensure_capacity(
-                seg.request, seg.request.total_len + k
-            ):
-                # Soft disqualifier only — the normal path probes +1 token
-                # itself and owns the abort decision (aborting here and
-                # then falling through would let commit_token resurrect
-                # the request).
-                return None
+            room = (self.cfg.max_model_len - seg.request.total_len) // k
+            m = min(m, room)
+        # Windows past every request's generation budget are pure waste:
+        # cap the chain at the largest remaining max_new_tokens.
+        want = max(
+            seg.request.sampling_params.max_new_tokens
+            - len(seg.request.output_ids)
+            for seg in plan.seqs
+        )
+        m = min(m, max(1, -(-want // k)))
+        if m > 1:
+            # Size the chain by pages that are free RIGHT NOW (no prefix
+            # eviction): a failed multi-window probe must not leave
+            # speculative allocations or evictions behind. ensure_capacity
+            # below then cannot fail for the chosen m.
+            def _extra_pages(mm: int) -> int:
+                return sum(
+                    max(
+                        0,
+                        self.cache.pages_needed(
+                            seg.request.total_len + mm * k
+                        ) - len(seg.request.page_ids),
+                    )
+                    for seg in plan.seqs
+                )
+
+            while m > 1 and _extra_pages(m) > self.cache.num_free_pages:
+                m -= 1
+        if not all(
+            self.cache.ensure_capacity(
+                seg.request, seg.request.total_len + m * k
+            )
+            for seg in plan.seqs
+        ):
+            # Soft disqualifier only — the normal path probes +1 token
+            # itself and owns the abort decision (aborting here and
+            # then falling through would let commit_token resurrect
+            # the request).
+            return None
 
         inputs = assemble(
             plan, self.spec, self.cfg.page_size, decode_only=True
         )
         if self._jit_multistep is None:
             self._jit_multistep = self._build_multistep()
-        tokens, self.kv = self._jit_multistep(self.params, self.kv, inputs)
-        tokens = np.asarray(tokens)                     # [k, S]
+        # Dispatch all m windows back-to-back: window j+1 consumes window
+        # j's on-device carry, so no host sync happens inside the chain
+        # (jax async dispatch keeps the device busy while earlier windows'
+        # tokens stream back below).
+        windows = []
+        feed, ctx = inputs.token_ids, inputs.kv_lens
+        for _ in range(m):
+            step_inputs = dataclasses.replace(
+                inputs, token_ids=feed, kv_lens=ctx
+            )
+            tokens, self.kv, feed, ctx = self._jit_multistep(
+                self.params, self.kv, step_inputs
+            )
+            windows.append(tokens)
+        self._last_fused_steps = m * k
 
         total = 0
-        for i, seg in enumerate(plan.seqs):
-            req = seg.request
-            committed = 0
-            for step in range(k):
-                if req.status.is_finished:
-                    break
-                req.commit_token(int(tokens[step, i]))
-                committed += 1
-            # Every committed token's predecessor was fed, so computed KV
-            # advances by the commit count (invariant: computed ==
-            # len(all_token_ids) - 1 while generating).
-            req.num_computed_tokens += committed
-            req.ready_for_step = not req.status.is_finished
-            total += committed
+        done = [False] * len(plan.seqs)
+        for tokens in windows:
+            tokens = np.asarray(tokens)                 # [k, S]
+            for i, seg in enumerate(plan.seqs):
+                req = seg.request
+                if done[i]:
+                    continue
+                committed = 0
+                for step in range(k):
+                    if req.status.is_finished:
+                        done[i] = True
+                        break
+                    req.commit_token(int(tokens[step, i]))
+                    committed += 1
+                # Every committed token's predecessor was fed, so computed
+                # KV advances by the commit count (invariant: computed ==
+                # len(all_token_ids) - 1 while generating).
+                req.num_computed_tokens += committed
+                req.ready_for_step = not req.status.is_finished
+                total += committed
         return total
 
     # -- speculative decoding (prompt-lookup) -----------------------------
@@ -624,7 +693,9 @@ class StageEngine:
             ewma_steps = 1  # speculation = one forward's worth of latency
             if committed is None:
                 committed = self._try_multistep(plan)
-                ewma_steps = self.cfg.decode_lookahead
+                ewma_steps = getattr(
+                    self, "_last_fused_steps", self.cfg.decode_lookahead
+                )
             if committed is not None:
                 dt = (time.perf_counter() - t0) * 1000.0
                 self._update_latency_ewma(dt / ewma_steps)

@@ -21,7 +21,7 @@ CFG = normalize_config(dict(
 
 
 def _run(lookahead, prompts, max_new=11, eos=None, params=None,
-         page_size=8):
+         page_size=8, pipeline=1):
     model = StageModel(CFG, 0, 2, use_pallas=False)
     p = params if params is not None else model.init_params(
         jax.random.key(0), dtype=jnp.float32
@@ -29,6 +29,7 @@ def _run(lookahead, prompts, max_new=11, eos=None, params=None,
     eng = StageEngine(model, p, EngineConfig(
         page_size=page_size, num_pages=128, max_model_len=256,
         kv_dtype="float32", decode_lookahead=lookahead,
+        decode_pipeline=pipeline,
     ))
     pipe = InProcessPipeline([eng])
     reqs = []
@@ -139,6 +140,58 @@ def test_multistep_mixed_arrivals():
     a1, b1 = run(1)
     a4, b4 = run(4)
     assert a4 == a1 and b4 == b1
+
+
+def test_pipelined_windows_match_single_step_exactly():
+    """decode_pipeline chains windows off the device-resident carry; the
+    token stream must be bit-identical to the unfused engine."""
+    prompts = [[3, 14, 15, 92, 65], [7, 21, 108], [42] * 9]
+    base, _ = _run(1, prompts, max_new=25)
+    piped, eng = _run(4, prompts, max_new=25, pipeline=3)
+    for b, m in zip(base, piped):
+        assert m.output_ids == b.output_ids, (b.output_ids, m.output_ids)
+        assert m.status == b.status
+    assert eng._jit_multistep is not None
+    assert eng._last_fused_steps == 12  # 3 windows x k=4 actually chained
+
+
+def test_pipelined_windows_mid_chain_finishes():
+    """max_new_tokens ending mid-window and mid-chain: surplus tokens from
+    the remaining chained windows must be discarded, not committed."""
+    prompts = [[5, 6, 7, 8], [9, 10, 11]]
+    base, _ = _run(1, prompts, max_new=6)       # ends mid-window (6 = 4+2)
+    piped, _ = _run(4, prompts, max_new=6, pipeline=4)
+    for b, m in zip(base, piped):
+        assert m.output_ids == b.output_ids
+        assert len(m.output_ids) == 6
+    # EOS inside the FIRST window of a chain: later windows' tokens for
+    # that row are discarded while other rows keep decoding.
+    probe, _ = _run(1, prompts, max_new=12)
+    eos = (probe[0].output_ids[1],)
+    base2, _ = _run(1, prompts, max_new=12, eos=eos)
+    piped2, _ = _run(4, prompts, max_new=12, eos=eos, pipeline=3)
+    for b, m in zip(base2, piped2):
+        assert m.output_ids == b.output_ids
+        assert m.status == b.status
+
+
+def test_pipelined_windows_clamp_to_context_room():
+    """Near max_model_len the chain shortens to the windows that fit; the
+    request still finishes correctly via the fallback paths."""
+    model = StageModel(CFG, 0, 2, use_pallas=False)
+    p = model.init_params(jax.random.key(0), dtype=jnp.float32)
+    eng = StageEngine(model, p, EngineConfig(
+        page_size=8, num_pages=64, max_model_len=64,
+        kv_dtype="float32", decode_lookahead=4, decode_pipeline=8,
+    ))
+    pipe = InProcessPipeline([eng])
+    req = Request("clamp", prompt_ids=list(range(1, 41)),  # 40 tokens
+                  sampling_params=SamplingParams(temperature=0.0,
+                                                 max_new_tokens=100))
+    pipe.submit(req)
+    pipe.run_until_complete()
+    assert req.status.value == "finished_length"
+    assert req.total_len <= 64
 
 
 def test_multistep_near_context_limit_falls_back():
