@@ -28,6 +28,35 @@ def rms_norm(
     return (x * (weight.astype(jnp.float32) + offset)).astype(orig_dtype)
 
 
+def full_proj_rms_norm(
+    x: jax.Array,
+    weight: jax.Array,
+    eps: float,
+    axis_name: str | None = None,
+    full_dim: int | None = None,
+) -> jax.Array:
+    """RMSNorm over a FULL projection output whose feature dim may be
+    column-sharded over ``axis_name`` (MiniMax-M2 qk norms: the statistic
+    spans all heads concatenated, so under TP the sum of squares is
+    psummed and every shard normalizes by the global mean while scaling
+    with its local slice of the norm weight)."""
+    orig_dtype = x.dtype
+    x = x.astype(jnp.float32)
+    ss = jnp.sum(x * x, axis=-1, keepdims=True)
+    dim = x.shape[-1]
+    if axis_name is not None:
+        ss = jax.lax.psum(ss, axis_name)
+        # The statistic is now global; the divisor must be too. Derive it
+        # from the mesh when the caller didn't pass full_dim (local dim
+        # alone would mis-scale by sqrt(num_shards)).
+        dim = (
+            full_dim if full_dim is not None
+            else x.shape[-1] * jax.lax.psum(1, axis_name)
+        )
+    x = x * jax.lax.rsqrt(ss / dim + eps)
+    return (x * weight.astype(jnp.float32)).astype(orig_dtype)
+
+
 def layer_norm(x: jax.Array, p: dict, eps: float) -> jax.Array:
     """Standard LayerNorm (mean-centered, with optional bias) — used by the
     DSA indexer's k_norm; everything else in the zoo is RMSNorm."""

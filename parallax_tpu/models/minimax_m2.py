@@ -26,13 +26,10 @@ class MiniMaxM2StageModel(MoEStageModel):
     # NOTE: no "MiniMaxForCausalLM" alias — that HF architecture is the
     # MiniMax-Text-01 lightning-attention hybrid, a different model family.
 
-    def __init__(self, *args, **kwargs):
-        super().__init__(*args, **kwargs)
-        if self.tp_size > 1:
-            # The full-projection qk norms would need column-sharded norm
-            # weights, which the generic TP spec cannot express yet.
-            raise ValueError("MiniMax-M2 does not support tensor "
-                             "parallelism yet (full-projection qk norms)")
+    # The full-projection qk norm weights are column-sharded alongside
+    # their projections under TP (each shard scales its own heads' slice;
+    # the norm statistic is psummed — see L.full_proj_rms_norm).
+    tp_column_vector_params = frozenset({"q_norm", "k_norm"})
 
     def _attention(self, lp, h, kv, inputs: BatchInputs, window):
         cfg = self.config
@@ -44,9 +41,19 @@ class MiniMaxM2StageModel(MoEStageModel):
         k = L.linear(h, p["k_proj"])
         v = L.linear(h, p["v_proj"])
         # M2: qk norm over the full concatenated projection, not per head.
+        # Under TP the feature dim here is this shard's heads only; the
+        # norm spans all heads, so the statistic crosses shards.
         if cfg.use_qk_norm and "q_norm" in p:
-            q = L.rms_norm(q, p["q_norm"]["weight"], cfg.rms_norm_eps)
-            k = L.rms_norm(k, p["k_norm"]["weight"], cfg.rms_norm_eps)
+            q = L.full_proj_rms_norm(
+                q, p["q_norm"]["weight"], cfg.rms_norm_eps,
+                axis_name=self.axis_name,
+                full_dim=cfg.num_attention_heads * d,
+            )
+            k = L.full_proj_rms_norm(
+                k, p["k_norm"]["weight"], cfg.rms_norm_eps,
+                axis_name=self.axis_name,
+                full_dim=cfg.num_key_value_heads * d,
+            )
         q = q.reshape(t, -1, d)
         k = k.reshape(t, -1, d)
         v = v.reshape(t, -1, d)

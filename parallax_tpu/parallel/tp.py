@@ -31,9 +31,19 @@ _COLUMN = {
 _ROW = {"o_proj", "down_proj"}
 
 
-def _spec_for(path: tuple[str, ...], leaf_value=None, tp: int | None = None) -> P:
+def _spec_for(
+    path: tuple[str, ...],
+    leaf_value=None,
+    tp: int | None = None,
+    col_vecs: frozenset = frozenset(),
+) -> P:
     if len(path) >= 2:
         parent, leaf = path[-2], path[-1]
+        if parent in col_vecs and leaf == "weight":
+            # Model-declared column-sharded 1-D params (e.g. MiniMax-M2's
+            # full-projection qk norm weights, which follow their
+            # projection's head sharding).
+            return P("tp")
         if parent == "experts":
             # Stacked MoE experts [E, ...] (weights rank 3, biases rank 2):
             # shard the expert dim (EP rides the tp axis).
@@ -73,10 +83,12 @@ def _tree_map_with_path(fn, tree, path=()):
     return fn(path, tree)
 
 
-def stage_param_specs(params: dict, tp: int | None = None) -> dict:
+def stage_param_specs(
+    params: dict, tp: int | None = None, col_vecs: frozenset = frozenset()
+) -> dict:
     """PartitionSpec pytree matching a stage param tree."""
     return _tree_map_with_path(
-        lambda path, leaf: _spec_for(path, leaf, tp), params
+        lambda path, leaf: _spec_for(path, leaf, tp, col_vecs), params
     )
 
 
@@ -124,9 +136,11 @@ def kv_partition_specs(model) -> list:
     return specs
 
 
-def shard_params(params: dict, mesh: Mesh) -> dict:
+def shard_params(
+    params: dict, mesh: Mesh, col_vecs: frozenset = frozenset()
+) -> dict:
     """Place a (host/global) param tree onto the mesh with TP sharding."""
-    specs = stage_param_specs(params, tp=mesh.shape["tp"])
+    specs = stage_param_specs(params, tp=mesh.shape["tp"], col_vecs=col_vecs)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
     )
@@ -144,7 +158,10 @@ def tp_stage_fn(model, params_template: dict, mesh: Mesh):
     ``tp_size = mesh.shape['tp']`` so its per-shard head counts match.
     """
     tp = mesh.shape["tp"]
-    param_specs = stage_param_specs(params_template, tp=tp)
+    param_specs = stage_param_specs(
+        params_template, tp=tp,
+        col_vecs=getattr(model, "tp_column_vector_params", frozenset()),
+    )
     model._lm_head_sharded = lm_head_vocab_sharded(params_template, tp)
 
     def fn(params, kv_caches, inputs):

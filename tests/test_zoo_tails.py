@@ -88,6 +88,49 @@ def test_minimax_m2_generates():
     assert len(out) == 5
 
 
+def test_minimax_m2_tensor_parallel_matches():
+    """M2 under TP: the full-projection qk norm statistic crosses shards
+    (psummed) and the norm weights shard with their projections — outputs
+    must match the unsharded engine token-for-token."""
+    if len(jax.devices()) < 2:
+        import pytest
+
+        pytest.skip("not enough virtual devices")
+    from parallax_tpu.parallel import make_mesh
+
+    cfg = normalize_config(MINIMAX_M2)
+    prompts = [[5, 6, 7, 8], [9, 10, 11]]
+
+    def run(tp_size):
+        m = create_stage_model(cfg, 0, 2, use_pallas=False, tp_size=tp_size)
+        params = m.init_params(jax.random.key(0), dtype=jnp.float32)
+        # Non-uniform norm weights so a mis-sliced shard actually diverges.
+        for li, lp in enumerate(params["layers"]):
+            attn = lp["self_attn"]
+            for name in ("q_norm", "k_norm"):
+                n = attn[name]["weight"].shape[0]
+                attn[name]["weight"] = (
+                    0.5 + jnp.arange(n, dtype=jnp.float32) / n + 0.1 * li
+                )
+        eng = StageEngine(
+            m, params,
+            EngineConfig(page_size=8, num_pages=128, max_model_len=256,
+                         kv_dtype="float32"),
+            mesh=make_mesh(tp_size=tp_size) if tp_size > 1 else None,
+        )
+        pipe = InProcessPipeline([eng])
+        for i, p in enumerate(prompts):
+            pipe.submit(Request(
+                f"r{i}", prompt_ids=list(p),
+                sampling_params=SamplingParams(temperature=0.0,
+                                               max_new_tokens=6),
+            ))
+        pipe.run_until_complete()
+        return {r.request_id: r.output_ids for r in pipe.finished}
+
+    assert run(2) == run(1)
+
+
 def test_step3p5_config_quirks():
     cfg = normalize_config(STEP3P5)
     assert cfg.num_key_value_heads == 2       # from num_attention_groups
