@@ -471,6 +471,11 @@ class OpenAIFrontend:
             return self._error(400, str(e))
         except RuntimeError as e:
             return self._error(429, str(e))
+        except asyncio.CancelledError:
+            # Disconnect while the submit thread was in flight: the
+            # submission may still have landed — stop it best-effort.
+            await self._request_stop(req)
+            raise
 
         if body.get("stream"):
             return await self._stream_response(
@@ -483,6 +488,12 @@ class OpenAIFrontend:
                 text, stop_matched = await self._await_completion(req, done)
             except _GenFailed as e:
                 return self._error(502, f"generation failed: {e}")
+            except asyncio.CancelledError:
+                # Client disconnected: stop the engine work (also unblocks
+                # the done.wait waiter thread) instead of generating to
+                # max_tokens unobserved.
+                await self._request_stop(req)
+                raise
             return web.json_response(
                 self._completion_body(
                     req, text, chat, t_start,
@@ -501,6 +512,14 @@ class OpenAIFrontend:
         choices differ; greedy requests will legitimately all match."""
         import dataclasses as _dc
 
+        async def abandon(started: list) -> None:
+            # Stop every already-running sibling and account its tokens —
+            # stopping finishes the request, so the parked done.wait
+            # threads (if any) unblock too.
+            for r in started:
+                await self._request_stop(r)
+                self._counters["completion_tokens"] += r.num_output_tokens
+
         reqs, dones = [], []
         for i in range(n_choices):
             sp = sampling_params
@@ -513,18 +532,25 @@ class OpenAIFrontend:
                 routing_table=list(routing_table),
                 eos_token_ids=tuple(self.tokenizer.eos_token_ids),
             )
-            self._counters["requests"] += 1
-            self._counters["prompt_tokens"] += req.num_prompt_tokens
             try:
                 done = await asyncio.to_thread(self.submit_fn, req)
             except ValueError as e:
-                for r in reqs:
-                    await self._request_stop(r)
+                await abandon(reqs)
                 return self._error(400, str(e))
             except RuntimeError as e:
-                for r in reqs:
-                    await self._request_stop(r)
+                await abandon(reqs)
                 return self._error(429, str(e))
+            except asyncio.CancelledError:
+                # Disconnect while still submitting: earlier choices are
+                # already running, and the in-flight submission may still
+                # have landed in the worker thread — stop and account all
+                # of them.
+                await abandon(reqs + [req])
+                raise
+            # Count only actually-submitted choices (at accept time, so a
+            # later disconnect is still visible in /metrics).
+            self._counters["requests"] += 1
+            self._counters["prompt_tokens"] += req.num_prompt_tokens
             reqs.append(req)
             dones.append(done)
         t_start = time.monotonic()
@@ -534,39 +560,48 @@ class OpenAIFrontend:
                 *(self._await_completion(r, d) for r, d in zip(reqs, dones)),
                 return_exceptions=True,
             )
-        finally:
-            # Cancellation-safe: tokens generated before a client
-            # disconnect must still reach /metrics.
-            for req in reqs:
-                self._counters["completion_tokens"] += req.num_output_tokens
+        except asyncio.CancelledError:
+            # Client disconnected: stop the engine work (which also
+            # unblocks the waiter threads) instead of letting n choices
+            # generate to max_tokens unobserved. abandon() records the
+            # tokens generated so far.
+            await abandon(reqs)
+            raise
+        # Tokens generated before a failure must still reach /metrics.
+        for req in reqs:
+            self._counters["completion_tokens"] += req.num_output_tokens
         failures = [r for r in results if isinstance(r, BaseException)]
         if failures:
+            for req in reqs:
+                await self._request_stop(req)
             return self._error(502, f"generation failed: {failures[0]}")
 
         choices = []
+        bodies = []
         for i, (req, (text, stop_matched)) in enumerate(zip(reqs, results)):
-            c = self._completion_body(
+            body_i = self._completion_body(
                 req, text, chat, t_start,
                 finish_override="stop" if stop_matched else None,
-            )["choices"][0]
+            )
+            bodies.append(body_i)
+            c = body_i["choices"][0]
             c["index"] = i
             choices.append(c)
-        completion = sum(r.num_output_tokens for r in reqs)
-        prompt = reqs[0].num_prompt_tokens
-        elapsed = max(1e-6, time.monotonic() - t_start)
-        return web.json_response({
-            "id": rid,
-            "object": "chat.completion" if chat else "text_completion",
-            "created": int(time.time()),
-            "model": self.model_name,
-            "choices": choices,
-            "usage": {
-                "prompt_tokens": prompt,
-                "completion_tokens": completion,
-                "total_tokens": prompt + completion,
-                "tokens_per_second": round(completion / elapsed, 2),
-            },
-        })
+        # Compose the merged envelope from the per-choice bodies (one
+        # source of truth for the envelope/usage schema) and sum the
+        # usage numbers.
+        merged = dict(bodies[0], id=rid, choices=choices)
+        usage = dict(bodies[0]["usage"])
+        for b in bodies[1:]:
+            # Prompt tokens count once (OpenAI semantics: one prompt, n
+            # choices); completions and throughput sum across choices.
+            for key in ("completion_tokens", "tokens_per_second"):
+                usage[key] = round(usage[key] + b["usage"][key], 2)
+        usage["total_tokens"] = (
+            usage["prompt_tokens"] + usage["completion_tokens"]
+        )
+        merged["usage"] = usage
+        return web.json_response(merged)
 
     async def _await_completion(self, req, done) -> tuple[str, bool]:
         """Wait for one request's generation; returns (text, stop_matched).
@@ -615,6 +650,12 @@ class OpenAIFrontend:
         await resp.prepare(http_request)
         try:
             return await self._stream_body(resp, req, chat, t_start)
+        except asyncio.CancelledError:
+            # Client went away mid-stream (handler_cancellation=True):
+            # stop the engine work instead of generating to max_tokens
+            # with nobody reading.
+            await self._request_stop(req)
+            raise
         finally:
             self._counters["completion_tokens"] += req.num_output_tokens
 
@@ -832,7 +873,12 @@ class OpenAIFrontend:
             loop = asyncio.new_event_loop()
             asyncio.set_event_loop(loop)
             kwargs = {"handle_signals": False, "loop": loop}
-        web.run_app(self.app, host=host, port=port, print=None, **kwargs)
+        # Cancel handlers when the client goes away (off by default since
+        # aiohttp 3.9) so a disconnect stops the engine work via the
+        # CancelledError cleanup paths instead of generating to
+        # max_tokens unobserved.
+        web.run_app(self.app, host=host, port=port, print=None,
+                    handler_cancellation=True, **kwargs)
 
 
 _CHAT_HTML = """<!doctype html><html><head><meta charset="utf-8">

@@ -190,6 +190,7 @@ def serve_main(args) -> int:
             enable_prefix_cache=not getattr(args, "no_prefix_cache", False),
             sp_threshold=sp_threshold,
             decode_lookahead=getattr(args, "decode_lookahead", 1) or 1,
+            decode_pipeline=getattr(args, "decode_pipeline", 1) or 1,
             speculative_tokens=getattr(args, "speculative_tokens", 0) or 0,
         ),
         mesh=mesh,

@@ -45,6 +45,9 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--decode-lookahead", type=int, default=1,
                        help="greedy decode tokens per jit dispatch "
                             "(single-stage serving; 1 = off)")
+    serve.add_argument("--decode-pipeline", type=int, default=1,
+                       help="chained k-token decode windows per host "
+                            "round (hides dispatch latency; 1 = off)")
     serve.add_argument("--speculative-tokens", type=int, default=0,
                        help="prompt-lookup speculative decoding: propose "
                             "up to N continuation tokens from n-gram "
