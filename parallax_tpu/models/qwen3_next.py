@@ -58,18 +58,26 @@ class Qwen3NextStageModel(MoEStageModel):
     # norm inside GatedDeltaNet keeps plain ones-init weights.
     norm_offset = 1.0
 
+    # in_proj_qkvz/in_proj_ba are k-head-grouped rows (column-shard);
+    # out_proj is the residual projection (row-shard + psum).
     def __init__(self, config: ModelConfig, *args, **kwargs):
         super().__init__(config, *args, **kwargs)
         if config.linear_attn is None:
             raise ValueError("Qwen3-Next requires linear_attn config")
-        if self.tp_size > 1:
-            raise NotImplementedError(
-                "hybrid linear-attention TP lands in a later round"
-            )
         la = config.linear_attn
+        if self.tp_size > 1 and la.num_k_heads % self.tp_size:
+            raise ValueError(
+                f"linear_attn num_k_heads={la.num_k_heads} not divisible "
+                f"by tp={self.tp_size}"
+            )
+        # Global dims (init/checkpoint shapes). Inside shard_map each
+        # shard sees its own contiguous block of k-head groups; the
+        # *_local dims describe that per-shard view.
         self.key_dim = la.num_k_heads * la.head_k_dim
         self.value_dim = la.num_v_heads * la.head_v_dim
         self.conv_dim = 2 * self.key_dim + self.value_dim
+        self.key_dim_local = self.key_dim // self.tp_size
+        self.value_dim_local = self.value_dim // self.tp_size
 
     @property
     def has_linear_layers(self) -> bool:
@@ -137,17 +145,31 @@ class Qwen3NextStageModel(MoEStageModel):
         out = out.reshape(t, hq * d) * jax.nn.sigmoid(
             gate.reshape(t, hq * d).astype(jnp.float32)
         ).astype(out.dtype)
-        return L.linear(out, p["o_proj"]), kv_pages
+        return (
+            L.row_parallel_linear(out, p["o_proj"], self.axis_name),
+            kv_pages,
+        )
 
     def _gated_delta_net(self, p, x, state, inputs: BatchInputs):
-        """GatedDeltaNet (HF Qwen3NextGatedDeltaNet semantics)."""
+        """GatedDeltaNet (HF Qwen3NextGatedDeltaNet semantics).
+
+        Under TP each shard owns a contiguous block of k-head groups (and
+        their r v-heads each): the in_proj outputs are column-sharded, the
+        per-channel conv weight and per-v-head A_log/dt_bias stay
+        replicated and are sliced locally (the conv channel layout
+        [q_all | k_all | v_all] does not shard contiguously, so slicing
+        by axis index beats permuting checkpoints), and out_proj is
+        row-parallel.
+        """
         cfg = self.config
         la = cfg.linear_attn
         conv_state_all, rec_state_all = state
         t = x.shape[0]
-        hk, hv = la.num_k_heads, la.num_v_heads
+        tp = self.tp_size
+        hk, hv = la.num_k_heads // tp, la.num_v_heads // tp  # per shard
         dk, dv = la.head_k_dim, la.head_v_dim
-        r = hv // hk
+        r = la.num_v_heads // la.num_k_heads
+        key_dim, value_dim = self.key_dim_local, self.value_dim_local
 
         qkvz = L.linear(x, p["in_proj_qkvz"]).reshape(
             t, hk, 2 * dk + 2 * r * dv
@@ -159,6 +181,25 @@ class Qwen3NextStageModel(MoEStageModel):
         z = qkvz[..., 2 * dk + r * dv :].reshape(t, hv, dv)
         b = ba[..., :r].reshape(t, hv)
         a = ba[..., r:].reshape(t, hv)
+
+        conv_w = p["conv1d"]["weight"]
+        a_log = p["A_log"]
+        dt_bias = p["dt_bias"]
+        if self.axis_name is not None:
+            # This shard's slice of the replicated per-channel params.
+            idx = jax.lax.axis_index(self.axis_name)
+            conv_w = jnp.concatenate([
+                jax.lax.dynamic_slice_in_dim(
+                    conv_w, idx * key_dim, key_dim, 0),
+                jax.lax.dynamic_slice_in_dim(
+                    conv_w, self.key_dim + idx * key_dim, key_dim, 0),
+                jax.lax.dynamic_slice_in_dim(
+                    conv_w, 2 * self.key_dim + idx * value_dim,
+                    value_dim, 0),
+            ], axis=0)
+            a_log = jax.lax.dynamic_slice_in_dim(a_log, idx * hv, hv, 0)
+            dt_bias = jax.lax.dynamic_slice_in_dim(
+                dt_bias, idx * hv, hv, 0)
 
         mixed = jnp.concatenate(
             [q.reshape(t, -1), k.reshape(t, -1), v.reshape(t, -1)], axis=-1
@@ -173,14 +214,14 @@ class Qwen3NextStageModel(MoEStageModel):
         fresh = inputs.reset_state.astype(bool)
         conv_state = jnp.where(fresh[:, None, None], 0.0, conv_state)
         mixed_d, new_conv = causal_conv_update(
-            mixed_d, conv_state, p["conv1d"]["weight"], q_lens
+            mixed_d, conv_state, conv_w, q_lens
         )
         s, maxq, _ = mixed_d.shape
-        qd = mixed_d[..., : self.key_dim].reshape(s, maxq, hk, dk)
-        kd = mixed_d[..., self.key_dim : 2 * self.key_dim].reshape(
+        qd = mixed_d[..., :key_dim].reshape(s, maxq, hk, dk)
+        kd = mixed_d[..., key_dim : 2 * key_dim].reshape(
             s, maxq, hk, dk
         )
-        vd = mixed_d[..., 2 * self.key_dim :].reshape(s, maxq, hv, dv)
+        vd = mixed_d[..., 2 * key_dim :].reshape(s, maxq, hv, dv)
         if r > 1:
             qd = jnp.repeat(qd, r, axis=2)
             kd = jnp.repeat(kd, r, axis=2)
@@ -188,8 +229,8 @@ class Qwen3NextStageModel(MoEStageModel):
         kd = l2norm(kd)
 
         beta = jax.nn.sigmoid(_densify(b, dm).astype(jnp.float32))
-        g = -jnp.exp(p["A_log"].astype(jnp.float32)) * jax.nn.softplus(
-            _densify(a, dm).astype(jnp.float32) + p["dt_bias"]
+        g = -jnp.exp(a_log.astype(jnp.float32)) * jax.nn.softplus(
+            _densify(a, dm).astype(jnp.float32) + dt_bias
         )
 
         rec_state = rec_state_all[slots]
@@ -209,7 +250,10 @@ class Qwen3NextStageModel(MoEStageModel):
         normed = L.rms_norm(out.astype(x.dtype), p["norm"]["weight"],
                             cfg.rms_norm_eps)
         gated = normed.astype(jnp.float32) * jax.nn.silu(zf)
-        y = L.linear(gated.reshape(t, hv * dv).astype(x.dtype), p["out_proj"])
+        y = L.row_parallel_linear(
+            gated.reshape(t, hv * dv).astype(x.dtype), p["out_proj"],
+            self.axis_name,
+        )
         return y, (conv_state_all, rec_state_all)
 
     # -- params ------------------------------------------------------------

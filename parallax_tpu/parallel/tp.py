@@ -27,8 +27,10 @@ _COLUMN = {
     "q_b_proj", "kv_b_proj",
     # Step-3.5 head-wise attention gate: one output per (local) head.
     "g_proj",
+    # Qwen3-Next GatedDeltaNet: rows are k-head-grouped blocks.
+    "in_proj_qkvz", "in_proj_ba",
 }
-_ROW = {"o_proj", "down_proj"}
+_ROW = {"o_proj", "down_proj", "out_proj"}
 
 
 def _spec_for(
@@ -114,13 +116,18 @@ def kv_partition_specs(model) -> list:
     Sparse layers carry ``(kv_pages, index_pages)`` tuples, so their spec is
     a tuple too (a bare spec would be applied as a pytree prefix and try to
     shard the index cache's singleton head axis)."""
-    from parallax_tpu.config import LAYER_MLA
+    from parallax_tpu.config import LAYER_LINEAR, LAYER_MLA
 
     cfg = model.config
     specs = []
     for li in range(model.num_local_layers):
         gi = model.start_layer + li
-        if cfg.layer_type(gi) == LAYER_MLA:
+        if cfg.layer_type(gi) == LAYER_LINEAR:
+            # (conv_state [slots, conv_dim, K], rec_state [slots, Hv, Dk,
+            # Dv]): both shard on their channel/head axis — each shard's
+            # slice matches its local [q|k|v] mixed layout and v-heads.
+            specs.append((P(None, "tp", None), P(None, "tp", None, None)))
+        elif cfg.layer_type(gi) == LAYER_MLA:
             if cfg.dsa is not None:
                 full = cfg.dsa.indexer_types[gi] == "full"
                 specs.append((P(), P()) if full else (P(), None))
