@@ -115,7 +115,15 @@ def build_local_frontend(
 
 def serve_main(args) -> int:
     """``parallax-tpu serve`` entry."""
+    import os
+
     import jax
+
+    # Honor JAX_PLATFORMS even when a PJRT plugin (axon) force-sets the
+    # platform list at config level — the env var alone is silently
+    # overridden, which turns a CPU dev run into a surprise TPU claim.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import jax.numpy as jnp
 
     from parallax_tpu.config import load_config
@@ -153,8 +161,58 @@ def serve_main(args) -> int:
     )
 
     page_size = args.page_size
+    sp_size = getattr(args, "sp_size", 0) or 0
+    sp_mesh = None
+    sp_threshold = None
+    if sp_size > 1:
+        from parallax_tpu.parallel import make_mesh
+
+        sp_mesh = make_mesh(sp_size=sp_size, tp_size=1)
+        sp_threshold = getattr(args, "sp_threshold", 2048)
+    draft = None
+    draft_path = getattr(args, "draft_model_path", None)
+    if draft_path:
+        from parallax_tpu.runtime.engine import DraftProposer
+
+        # Speculation runs only on the single-stage unsharded greedy fast
+        # path; loading a draft model in a configuration where it can
+        # never fire would silently waste HBM.
+        if tp_size and tp_size > 1:
+            raise ValueError("--draft-model-path requires tp-size 1 "
+                             "(speculation runs unsharded)")
+        if start != 0 or end != config.num_hidden_layers:
+            raise ValueError("--draft-model-path requires a full "
+                             "single-stage model (no layer split)")
+        if config.linear_attn is not None:
+            raise ValueError("--draft-model-path does not support hybrid "
+                             "linear-attention main models")
+        draft_cfg = load_config(draft_path)
+        draft_model = create_stage_model(
+            draft_cfg, 0, draft_cfg.num_hidden_layers
+        )
+        draft_engine = StageEngine(
+            draft_model,
+            load_stage_params(draft_model, draft_path),
+            EngineConfig(
+                page_size=16,   # small pages -> small prefix-recompute tail
+                num_pages=max(
+                    512,
+                    args.max_batch_size
+                    * ((args.max_model_len + 15) // 16 + 1),
+                ),
+                max_batch_size=args.max_batch_size,
+                max_model_len=args.max_model_len,
+                kv_dtype=getattr(args, "kv_dtype", "bfloat16"),
+                decode_lookahead=max(
+                    1, getattr(args, "speculative_tokens", 0) or 4
+                ),
+            ),
+        )
+        draft = DraftProposer(draft_engine)
     # HBM budget, capped by the most pages the configured batch can ever
     # address (small models would otherwise derive absurd page counts).
+    # Derived AFTER the draft engine exists so its params + KV are already
+    # subtracted from free memory.
     addressable = (
         ((args.max_model_len + page_size - 1) // page_size + 1)
         * args.max_batch_size * 2
@@ -166,14 +224,6 @@ def serve_main(args) -> int:
         ),
         addressable,
     )
-    sp_size = getattr(args, "sp_size", 0) or 0
-    sp_mesh = None
-    sp_threshold = None
-    if sp_size > 1:
-        from parallax_tpu.parallel import make_mesh
-
-        sp_mesh = make_mesh(sp_size=sp_size, tp_size=1)
-        sp_threshold = getattr(args, "sp_threshold", 2048)
     engine = StageEngine(
         model,
         params,
@@ -191,10 +241,15 @@ def serve_main(args) -> int:
             sp_threshold=sp_threshold,
             decode_lookahead=getattr(args, "decode_lookahead", 1) or 1,
             decode_pipeline=getattr(args, "decode_pipeline", 1) or 1,
-            speculative_tokens=getattr(args, "speculative_tokens", 0) or 0,
+            # A configured draft model implies speculation (default k=4).
+            speculative_tokens=(
+                (getattr(args, "speculative_tokens", 0) or 0)
+                or (4 if draft is not None else 0)
+            ),
         ),
         mesh=mesh,
         sp_mesh=sp_mesh,
+        draft=draft,
     )
     tokenizer = load_tokenizer(args.model_path)
     frontend, _runner = build_local_frontend(

@@ -65,11 +65,14 @@ class EngineConfig:
     # a single window — surplus tokens past a mid-chain finish are
     # discarded). 1 = off.
     decode_pipeline: int = 1
-    # Speculative decoding (prompt-lookup / n-gram): propose up to this
-    # many continuation tokens from earlier context matches and verify
-    # them in ONE forward (greedy acceptance). 0 = off. Composes with the
-    # same eligibility rules as decode_lookahead; speculation wins when a
-    # proposal exists, lookahead otherwise.
+    # Speculative decoding: propose up to this many continuation tokens
+    # and verify them in ONE forward (greedy acceptance). 0 = off.
+    # Proposals come from prompt-lookup n-gram matches, or from a draft
+    # model when the engine was built with ``draft=`` (reference parity:
+    # the reference delegates speculation to its backends; here both
+    # proposers are native). Composes with the same eligibility rules as
+    # decode_lookahead; speculation wins when a proposal exists,
+    # lookahead otherwise.
     speculative_tokens: int = 0
     speculative_ngram: int = 3
 
@@ -88,6 +91,54 @@ class StepOutputs:
     step_time_ms: float = 0.0
 
 
+class DraftProposer:
+    """Draft-model proposal source for speculative decoding.
+
+    Wraps a small single-stage engine (prefix cache ON) serving the same
+    vocabulary: each proposal submits the request's current context and
+    decodes ``k`` greedy draft tokens. The draft engine's prefix cache
+    makes consecutive proposals incremental — only the page-granularity
+    tail of the context is recomputed per step — and batching proposals
+    for a whole decode batch is one draft-engine run, not one per row.
+    The main engine verifies every proposal in one forward (greedy
+    acceptance), so draft quality affects speed only, never outputs.
+    """
+
+    def __init__(self, engine: "StageEngine"):
+        if not (engine.model.is_first and engine.model.is_last):
+            raise ValueError("draft engine must be a full single stage")
+        self.engine = engine
+        self._counter = 0
+
+    def propose_batch(
+        self, contexts: list[list[int]], budgets: list[int]
+    ) -> list[list[int]]:
+        reqs: list[Request | None] = []
+        for ctx, budget in zip(contexts, budgets):
+            k = min(budget, self.engine.cfg.max_model_len - len(ctx) - 1)
+            if k <= 0 or len(ctx) >= self.engine.cfg.max_model_len:
+                reqs.append(None)
+                continue
+            req = Request(
+                f"__draft{self._counter}",
+                prompt_ids=list(ctx),
+                sampling_params=SamplingParams(
+                    temperature=0.0, max_new_tokens=k, ignore_eos=True
+                ),
+            )
+            self._counter += 1
+            if not self.engine.submit(req):
+                reqs.append(None)
+                continue
+            reqs.append(req)
+        if any(r is not None for r in reqs):
+            guard = 0
+            while self.engine.has_work() and guard < 10_000:
+                self.engine.step()
+                guard += 1
+        return [list(r.output_ids) if r is not None else [] for r in reqs]
+
+
 class StageEngine:
     """Continuous-batching engine for one pipeline stage."""
 
@@ -98,12 +149,14 @@ class StageEngine:
         config: EngineConfig | None = None,
         mesh=None,
         sp_mesh=None,
+        draft: "DraftProposer | None" = None,
     ):
         self.model = model
         self.params = params
         self.cfg = config or EngineConfig()
         self.mesh = mesh
         self.sp_mesh = sp_mesh
+        self.draft = draft
         kv_dtype = jnp.bfloat16 if self.cfg.kv_dtype == "bfloat16" else jnp.float32
         # Hybrid (linear-attention) models carry per-request state slots.
         self._needs_state = bool(getattr(model, "has_linear_layers", False))
@@ -126,9 +179,14 @@ class StageEngine:
                 kv_partition_specs(model),
                 is_leaf=lambda x: isinstance(x, PartitionSpec),
             )
+            state_kw = (
+                {"num_state_slots": self.cfg.max_batch_size * 2}
+                if self._needs_state else {}
+            )
             self.kv = jax.jit(
                 lambda: model.new_kv_caches(
-                    self.cfg.num_pages, self.cfg.page_size, kv_dtype
+                    self.cfg.num_pages, self.cfg.page_size, kv_dtype,
+                    **state_kw,
                 ),
                 out_shardings=shardings,
             )()
@@ -600,26 +658,38 @@ class StageEngine:
         if k <= 0 or not self._greedy_fast_path_ok(plan):
             return None
 
-        proposals: list[list[int]] = []
-        any_proposal = False
         # Each row feeds >= 1 token; proposals must also fit the batch
         # token budget (and thus the largest assemble bucket).
         spare = self.cfg.max_num_tokens_per_batch - len(plan.seqs)
+        budgets = []
         for seg in plan.seqs:
             req = seg.request
-            budget = min(
-                k, spare, self.cfg.max_model_len - req.total_len - 1
+            budgets.append(min(
+                k, max(0, spare), self.cfg.max_model_len - req.total_len - 1
+            ))
+        if self.draft is not None:
+            proposals = self.draft.propose_batch(
+                [seg.request.all_token_ids for seg in plan.seqs], budgets
             )
-            prop = (
-                self._ngram_proposal(
-                    req.all_token_ids, self.cfg.speculative_ngram, budget
+            # Clamp to the shared token budget in row order.
+            for i, prop in enumerate(proposals):
+                take = min(len(prop), max(0, spare))
+                proposals[i] = prop[:take]
+                spare -= take
+        else:
+            proposals = []
+            for seg, budget in zip(plan.seqs, budgets):
+                budget = min(budget, max(0, spare))
+                prop = (
+                    self._ngram_proposal(
+                        seg.request.all_token_ids,
+                        self.cfg.speculative_ngram, budget,
+                    )
+                    if budget > 0 else []
                 )
-                if budget > 0 else []
-            )
-            spare -= len(prop)
-            proposals.append(prop)
-            any_proposal = any_proposal or bool(prop)
-        if not any_proposal:
+                spare -= len(prop)
+                proposals.append(prop)
+        if not any(proposals):
             return None
         for seg, prop in zip(plan.seqs, proposals):
             if not self.cache.ensure_capacity(

@@ -111,3 +111,70 @@ def test_speculative_respects_max_tokens_and_finish():
     assert len(spec[0].output_ids) == 5
     assert spec[0].status == base[0].status
     assert spec[0].num_computed_tokens == spec[0].total_len - 1
+
+
+def _draft_engine(params=None, key=0):
+    from parallax_tpu.runtime.engine import DraftProposer
+
+    model = StageModel(CFG, 0, 2, use_pallas=False)
+    p = params if params is not None else model.init_params(
+        jax.random.key(key), dtype=jnp.float32
+    )
+    eng = StageEngine(model, p, EngineConfig(
+        page_size=8, num_pages=256, max_model_len=256,
+        kv_dtype="float32", decode_lookahead=4,
+    ))
+    return DraftProposer(eng), p
+
+
+def _run_draft(prompts, draft, max_new=12, params=None, spec=4):
+    model = StageModel(CFG, 0, 2, use_pallas=False)
+    p = params if params is not None else model.init_params(
+        jax.random.key(0), dtype=jnp.float32
+    )
+    eng = StageEngine(model, p, EngineConfig(
+        page_size=8, num_pages=128, max_model_len=256,
+        kv_dtype="float32", speculative_tokens=spec,
+    ), draft=draft)
+    pipe = InProcessPipeline([eng])
+    reqs = []
+    for i, prompt in enumerate(prompts):
+        req = Request(f"r{i}", prompt_ids=list(prompt),
+                      sampling_params=SamplingParams(temperature=0.0,
+                                                     max_new_tokens=max_new))
+        reqs.append(req)
+        pipe.submit(req)
+    pipe.run_until_complete()
+    return reqs
+
+
+def test_draft_model_same_weights_accepts_everything():
+    """Draft == main: every proposal verifies, outputs match single-step
+    greedy exactly, and decoding takes far fewer main-engine steps."""
+    prompts = [[3, 14, 15, 92, 65], [7, 21, 108]]
+    base = _run(0, prompts, max_new=12)
+    main_model = StageModel(CFG, 0, 2, use_pallas=False)
+    shared = main_model.init_params(jax.random.key(0), dtype=jnp.float32)
+    draft, _ = _draft_engine(params=shared)
+    got = _run_draft(prompts, draft, max_new=12, params=shared)
+    for b, g in zip(base, got):
+        assert g.output_ids == b.output_ids
+        assert g.status == b.status
+
+
+def test_draft_model_different_weights_is_still_exact():
+    """A bad draft must never change outputs — only acceptance rate."""
+    prompts = [[5, 6, 7, 8], [42] * 6]
+    base = _run(0, prompts, max_new=10)
+    draft, _ = _draft_engine(key=99)    # different random weights
+    got = _run_draft(prompts, draft, max_new=10)
+    for b, g in zip(base, got):
+        assert g.output_ids == b.output_ids
+        assert g.status == b.status
+
+
+def test_draft_proposer_context_overflow_returns_empty():
+    draft, _ = _draft_engine()
+    props = draft.propose_batch([[1] * 300, [1, 2, 3]], [4, 4])
+    assert props[0] == []
+    assert len(props[1]) <= 4
