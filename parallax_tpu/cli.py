@@ -52,6 +52,10 @@ def build_parser() -> argparse.ArgumentParser:
                        help="prompt-lookup speculative decoding: propose "
                             "up to N continuation tokens from n-gram "
                             "matches, verified in one forward (0 = off)")
+    serve.add_argument("--draft-model-path", default=None,
+                       help="small draft checkpoint for speculative "
+                            "decoding (proposals verified by the main "
+                            "model; implies --speculative-tokens 4)")
     serve.add_argument("--sp-size", type=int, default=0,
                        help="ring-attention sequence parallelism over this "
                             "many devices for long-prompt prefill")
@@ -84,6 +88,15 @@ def build_parser() -> argparse.ArgumentParser:
     chat.add_argument("--base-url", default="http://127.0.0.1:8000")
     chat.add_argument("--max-tokens", type=int, default=512)
     chat.add_argument("--temperature", type=float, default=0.7)
+
+    merge = sub.add_parser(
+        "lora-merge",
+        help="fuse a PEFT LoRA adapter into a checkpoint "
+             "(reference prepare_adapter)",
+    )
+    merge.add_argument("--model-path", required=True)
+    merge.add_argument("--adapter-path", required=True)
+    merge.add_argument("--out-dir", required=True)
     return p
 
 
@@ -94,12 +107,26 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     if args.command == "serve":
         from parallax_tpu.backend.serve import serve_main
+        from parallax_tpu.utils.banner import print_banner
+        from parallax_tpu.utils.version_check import check_latest_release
 
+        print_banner()
+        hint = check_latest_release()
+        if hint:
+            print(hint)
         return serve_main(args)
     if args.command == "run":
         from parallax_tpu.backend.run import run_main
+        from parallax_tpu.utils.banner import print_banner
 
+        print_banner()
         return run_main(args)
+    if args.command == "lora-merge":
+        from parallax_tpu.utils.adapter import merge_adapter
+
+        n = merge_adapter(args.model_path, args.adapter_path, args.out_dir)
+        print(f"merged {n} adapter modules -> {args.out_dir}")
+        return 0
     if args.command == "join":
         from parallax_tpu.p2p.join import join_main
 
@@ -148,17 +175,35 @@ def chat_main(args) -> int:
         )
         reply = []
         try:
+            import time as _time
+
+            from parallax_tpu.utils.request_metrics import request_metrics
+
+            t0 = _time.monotonic()
+            t_first = t_last = None
+            final_chunk = None
             with urllib.request.urlopen(req, timeout=600) as resp:
                 for raw in resp:
                     line = raw.decode().strip()
                     if not line.startswith("data: ") or line == "data: [DONE]":
                         continue
                     chunk = json.loads(line[6:])
+                    if chunk.get("usage"):
+                        final_chunk = chunk
                     delta = chunk["choices"][0].get("delta", {}).get("content")
                     if delta:
+                        t_last = _time.monotonic()
+                        if t_first is None:
+                            t_first = t_last
                         reply.append(delta)
                         print(delta, end="", flush=True)
             print()
+            tps, ttft_ms, _, out_toks = request_metrics(
+                final_chunk, t0, t_first, t_last
+            )
+            if out_toks is not None:
+                rate = f" · {tps:.1f} tok/s" if tps is not None else ""
+                print(f"[{out_toks} tokens{rate} · ttft {ttft_ms} ms]")
         except KeyboardInterrupt:
             # Cancel the turn, keep the REPL alive.
             print("\n[interrupted]")

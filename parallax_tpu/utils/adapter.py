@@ -1,0 +1,166 @@
+"""Offline LoRA adapter fusion: checkpoint + PEFT adapter -> merged
+checkpoint directory.
+
+Capability parity: reference ``src/parallax_utils/prepare_adapter.py``
+(download adapter + base, fuse, save a servable checkpoint). TPU
+re-design: processes the checkpoint shard-by-shard (host memory stays at
+one shard + the adapter, and the multi-file layout is preserved), merges
+``W' = W + (alpha/r) * B @ A`` in float32, and copies the config,
+index, and tokenizer files the serving loader needs. Serving can also
+merge at load time (``--lora-path``); this tool is for producing a
+standalone merged checkpoint once and serving it many times.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import numpy as np
+
+from parallax_tpu.utils import get_logger
+
+logger = get_logger(__name__)
+
+_SIDE_FILES = (
+    "config.json", "generation_config.json", "tokenizer.json",
+    "tokenizer_config.json", "special_tokens_map.json", "vocab.json",
+    "merges.txt", "tokenizer.model", "model.safetensors.index.json",
+)
+
+
+def _load_adapter(adapter_path: str) -> tuple[dict, dict]:
+    """(module -> {A, B}, scales keyed by module)."""
+    from safetensors import safe_open
+
+    with open(os.path.join(adapter_path, "adapter_config.json"),
+              encoding="utf-8") as f:
+        acfg = json.load(f)
+    default_alpha = float(acfg.get("lora_alpha", acfg.get("r", 8)))
+    alpha_pattern = acfg.get("alpha_pattern") or {}
+    use_rslora = bool(acfg.get("use_rslora"))
+
+    weight_file = None
+    for name in ("adapter_model.safetensors", "adapter.safetensors"):
+        p = os.path.join(adapter_path, name)
+        if os.path.exists(p):
+            weight_file = p
+            break
+    if weight_file is None:
+        raise FileNotFoundError(f"no adapter safetensors in {adapter_path}")
+
+    pairs: dict[str, dict[str, np.ndarray]] = {}
+    with safe_open(weight_file, framework="numpy") as f:
+        for key in f.keys():
+            k = key
+            for prefix in ("base_model.model.", "base_model."):
+                if k.startswith(prefix):
+                    k = k[len(prefix):]
+                    break
+            if "lora_magnitude" in k:
+                raise ValueError("DoRA adapters are not supported")
+            if ".lora_A." in k:
+                mod, part = k.split(".lora_A.")[0], "A"
+            elif ".lora_B." in k:
+                mod, part = k.split(".lora_B.")[0], "B"
+            else:
+                continue
+            pairs.setdefault(mod, {})[part] = f.get_tensor(key)
+
+    scales = {}
+    for mod, ab in pairs.items():
+        if "A" not in ab or "B" not in ab:
+            raise ValueError(f"adapter incomplete for {mod}")
+        rank = ab["A"].shape[0]
+        alpha = default_alpha
+        for pat, a in alpha_pattern.items():
+            if mod.endswith(pat) or pat in mod:
+                alpha = float(a)
+                break
+        scales[mod] = alpha / (rank ** 0.5 if use_rslora else rank)
+    return pairs, scales
+
+
+def merge_adapter(model_path: str, adapter_path: str, out_dir: str) -> int:
+    """Write ``out_dir`` = ``model_path`` with the LoRA deltas merged.
+
+    Returns the number of merged modules; raises if any adapter module
+    has no matching base weight (a silent partial merge would serve a
+    wrong model).
+    """
+    from safetensors import safe_open
+    from safetensors.numpy import save_file
+
+    pairs, scales = _load_adapter(adapter_path)
+    os.makedirs(out_dir, exist_ok=True)
+    unmatched = set(pairs)
+
+    files = sorted(
+        f for f in os.listdir(model_path) if f.endswith(".safetensors")
+    )
+    if not files:
+        raise FileNotFoundError(f"no safetensors under {model_path}")
+    # Validate every adapter module has a base weight BEFORE writing any
+    # output (keys only — no tensor loads) so a bad adapter cannot leave
+    # a half-written checkpoint behind.
+    base_mods = set()
+    for name in files:
+        with safe_open(os.path.join(model_path, name),
+                       framework="numpy") as f:
+            for key in f.keys():
+                if key.endswith(".weight"):
+                    mod = key[: -len(".weight")]
+                    base_mods.update((mod, f"model.{mod}",
+                                      mod.removeprefix("model.")))
+    missing = unmatched - base_mods
+    if missing:
+        raise ValueError(
+            f"adapter modules with no base weight: {sorted(missing)[:5]}"
+        )
+    # Shard-by-shard: one input file's tensors in memory at a time, each
+    # written to the same-named output file (the index json, copied as a
+    # side file, keeps pointing at the right shards).
+    for name in files:
+        shard: dict[str, np.ndarray] = {}
+        with safe_open(os.path.join(model_path, name),
+                       framework="numpy") as f:
+            for key in f.keys():
+                arr = f.get_tensor(key)
+                mod = key[: -len(".weight")] if key.endswith(".weight") else None
+                # Checkpoints may or may not carry the "model." prefix the
+                # PEFT keys use; match either.
+                cand = None
+                if mod is not None:
+                    for m in (mod, f"model.{mod}", mod.removeprefix("model.")):
+                        if m in pairs:
+                            cand = m
+                            break
+                if cand is not None:
+                    ab = pairs[cand]
+                    delta = (
+                        ab["B"].astype(np.float32)
+                        @ ab["A"].astype(np.float32)
+                    ) * scales[cand]
+                    if delta.shape != arr.shape:
+                        raise ValueError(
+                            f"{cand}: adapter delta {delta.shape} does not "
+                            f"match base weight {arr.shape}"
+                        )
+                    arr = (arr.astype(np.float32) + delta).astype(arr.dtype)
+                    unmatched.discard(cand)
+                shard[key] = arr
+        save_file(shard, os.path.join(out_dir, name))
+    if unmatched:
+        raise ValueError(
+            f"adapter modules with no base weight: {sorted(unmatched)[:5]}"
+        )
+    for name in _SIDE_FILES:
+        src = os.path.join(model_path, name)
+        if os.path.exists(src):
+            shutil.copy2(src, os.path.join(out_dir, name))
+    logger.info(
+        "merged %d adapter modules from %s into %s",
+        len(pairs), adapter_path, out_dir,
+    )
+    return len(pairs)

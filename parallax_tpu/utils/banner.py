@@ -1,0 +1,38 @@
+"""Terminal boot banner.
+
+Capability parity: reference ``src/parallax_utils/ascii_anime.py`` (a
+terminal boot animation shown by the CLI). TPU re-design: a static,
+pipe-safe banner — animations corrupt logs under process supervisors, so
+the banner prints once with version + device line and degrades to plain
+text when stdout is not a TTY.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_ART = r"""
+                           _ _              _
+ _ __   __ _ _ __ __ _ ___| | | __ ___  __ | |_ _ __  _   _
+| '_ \ / _` | '__/ _` (_-< | |/ _` \ \/ / | __| '_ \| | | |
+| |_) | (_| | | | (_| /__/ | | (_| |>  <  | |_| |_) | |_| |
+| .__/ \__,_|_|  \__,_|___|_|_|\__,_/_/\_\  \__| .__/ \__,_|
+|_|        pipeline-parallel LLM serving on TPU|_|
+"""
+
+
+def banner(device_line: str | None = None) -> str:
+    from parallax_tpu.utils.version_check import get_current_version
+
+    lines = [_ART.rstrip("\n"), f"  v{get_current_version()}"]
+    if device_line:
+        lines.append(f"  {device_line}")
+    text = "\n".join(lines) + "\n"
+    if sys.stdout.isatty() and os.environ.get("NO_COLOR") is None:
+        return f"\x1b[36m{text}\x1b[0m"
+    return text
+
+
+def print_banner(device_line: str | None = None) -> None:
+    sys.stdout.write(banner(device_line))

@@ -1,0 +1,57 @@
+"""Client-side request metrics from a streamed OpenAI response.
+
+Capability parity: reference ``src/parallax_utils/request_metrics.py:4-19``
+(``get_request_metrics``: TPS/TTFT/token counts parsed from the final SSE
+usage chunk). Used by the chat CLI and the benchmark client to report
+per-request numbers without trusting server-side aggregation.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+
+def parse_usage_chunk(chunk: bytes | str | dict) -> dict | None:
+    """The ``usage`` object of an SSE data chunk, or None."""
+    try:
+        if isinstance(chunk, bytes):
+            chunk = chunk.decode("utf-8", errors="replace")
+        if isinstance(chunk, str):
+            chunk = chunk.strip()
+            if chunk.startswith("data:"):
+                chunk = chunk[len("data:"):].strip()
+            if not chunk or chunk == "[DONE]":
+                return None
+            chunk = json.loads(chunk)
+        usage = chunk.get("usage")
+        return usage if isinstance(usage, dict) else None
+    except Exception:
+        return None
+
+
+def request_metrics(
+    final_chunk: Any,
+    start_time: float,
+    first_token_time: float | None,
+    last_token_time: float | None,
+) -> tuple[float | None, int | None, int | None, int | None]:
+    """(tokens_per_second, ttft_ms, prompt_tokens, completion_tokens).
+
+    All-None on any malformed input — metrics never break the request
+    path (reference contract).
+    """
+    usage = parse_usage_chunk(final_chunk)
+    if usage is None or first_token_time is None:
+        return None, None, None, None
+    try:
+        out_tokens = int(usage["completion_tokens"])
+        in_tokens = int(usage["prompt_tokens"])
+        span = (last_token_time or first_token_time) - first_token_time
+        # One-token replies have no measurable span; a rate would be
+        # fabricated, so tps stays None while the counts remain usable.
+        tps = out_tokens / span if span > 0 else None
+        ttft_ms = int((first_token_time - start_time) * 1000)
+        return tps, ttft_ms, in_tokens, out_tokens
+    except Exception:
+        return None, None, None, None

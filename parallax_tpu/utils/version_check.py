@@ -1,0 +1,62 @@
+"""Release-freshness check.
+
+Capability parity: reference ``src/parallax_utils/version_check.py``
+(``get_current_version`` via importlib metadata, latest-release probe with
+a short timeout, non-fatal on any failure). TPU re-design: the package
+version is the source of truth, the remote probe endpoint is
+configurable, and in an egress-less deployment the probe degrades to a
+silent no-op instead of stalling startup.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.request
+
+RELEASES_URL = os.environ.get(
+    "PARALLAX_TPU_RELEASES_URL",
+    "https://api.github.com/repos/parallax-tpu/parallax-tpu/releases/latest",
+)
+
+
+def get_current_version() -> str:
+    try:
+        import importlib.metadata
+
+        return importlib.metadata.version("parallax-tpu")
+    except Exception:
+        try:
+            from parallax_tpu.version import __version__
+
+            return __version__
+        except Exception:
+            return "unknown"
+
+
+def get_latest_version(timeout: float = 3.0) -> str | None:
+    """Latest published release tag, or None when unreachable (offline,
+    rate-limited, air-gapped — all non-fatal by design)."""
+    try:
+        with urllib.request.urlopen(RELEASES_URL, timeout=timeout) as resp:
+            data = json.loads(resp.read())
+        tag = data.get("tag_name") or data.get("name")
+        return str(tag).lstrip("v") if tag else None
+    except Exception:
+        return None
+
+
+def check_latest_release(log=None) -> str | None:
+    """Compare current vs latest; returns an update hint string (also
+    logged when a logger is passed) or None when up to date / unknown."""
+    current = get_current_version()
+    latest = get_latest_version()
+    if latest is None or current in ("unknown", latest):
+        return None
+    msg = (
+        f"parallax-tpu {current} is behind the latest release {latest}; "
+        f"consider upgrading"
+    )
+    if log is not None:
+        log.info("%s", msg)
+    return msg

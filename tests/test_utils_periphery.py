@@ -1,0 +1,164 @@
+"""parallax_utils parity: request metrics, version check, banner, and
+offline LoRA adapter fusion (reference request_metrics.py /
+version_check.py / ascii_anime.py / prepare_adapter.py)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from parallax_tpu.utils.request_metrics import parse_usage_chunk, request_metrics
+
+
+def test_request_metrics_from_sse_chunk():
+    chunk = (
+        'data: {"choices": [{"delta": {}}], "usage": {"prompt_tokens": 10, '
+        '"completion_tokens": 20, "total_tokens": 30}}'
+    )
+    assert parse_usage_chunk(chunk) == {
+        "prompt_tokens": 10, "completion_tokens": 20, "total_tokens": 30,
+    }
+    tps, ttft, in_t, out_t = request_metrics(chunk, 1.0, 1.5, 3.5)
+    assert (in_t, out_t) == (10, 20)
+    assert ttft == 500
+    assert abs(tps - 10.0) < 1e-9
+
+
+def test_request_metrics_malformed_is_all_none():
+    for bad in (None, "", "data: [DONE]", b"\xff\xfe", '{"no": "usage"}'):
+        assert request_metrics(bad, 0.0, 1.0, 2.0) == (
+            None, None, None, None
+        )
+    # Missing first token (no output): also all-None, never a crash.
+    ok = 'data: {"usage": {"prompt_tokens": 1, "completion_tokens": 0}}'
+    assert request_metrics(ok, 0.0, None, None) == (None, None, None, None)
+
+
+def test_version_check_offline_graceful(monkeypatch):
+    from parallax_tpu.utils import version_check as vc
+
+    assert vc.get_current_version() != ""
+    monkeypatch.setattr(vc, "RELEASES_URL", "http://127.0.0.1:1/none")
+    assert vc.get_latest_version(timeout=0.2) is None
+    assert vc.check_latest_release() is None  # unknown latest -> quiet
+
+
+def test_banner_contains_version():
+    from parallax_tpu.utils.banner import banner
+    from parallax_tpu.utils.version_check import get_current_version
+
+    text = banner(device_line="v5e x1")
+    assert get_current_version() in text
+    assert "v5e x1" in text
+
+
+def _write_tiny_checkpoint(path, cfg_dict, params):
+    """Flatten a stage param tree into an HF-keyed safetensors file."""
+    from safetensors.numpy import save_file
+
+    tensors = {}
+
+    def walk(prefix, node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}.{k}" if prefix else k, v)
+        elif isinstance(node, list):
+            for i, v in enumerate(node):
+                walk(f"{prefix}.{i}", v)
+        else:
+            tensors[f"model.{prefix}"] = np.asarray(node)
+
+    walk("", params)
+    # lm_head lives outside the "model." prefix in HF checkpoints.
+    for k in list(tensors):
+        if k.startswith("model.lm_head."):
+            tensors[k[len("model."):]] = tensors.pop(k)
+    os.makedirs(path, exist_ok=True)
+    save_file(tensors, os.path.join(path, "model.safetensors"))
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(cfg_dict, f)
+
+
+def test_lora_merge_produces_servable_equal_checkpoint(tmp_path):
+    """cli lora-merge output == serving base + --lora-path, weight for
+    weight."""
+    from safetensors.numpy import save_file
+
+    from parallax_tpu.config import normalize_config
+    from parallax_tpu.models.base import StageModel
+    from parallax_tpu.models.loader import load_stage_params
+    from parallax_tpu.utils.adapter import merge_adapter
+
+    cfg_dict = dict(
+        architectures=["Qwen2ForCausalLM"], hidden_size=32,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=64, vocab_size=97, max_position_embeddings=128,
+        tie_word_embeddings=False,
+    )
+    cfg = normalize_config(cfg_dict)
+    model = StageModel(cfg, 0, 2, use_pallas=False)
+    params = model.init_params(jax.random.key(0), dtype=jnp.float32)
+    base_dir = str(tmp_path / "base")
+    _write_tiny_checkpoint(base_dir, cfg_dict, params)
+
+    # A rank-2 adapter on layer 0's q_proj and layer 1's down_proj.
+    rng = np.random.default_rng(0)
+    h = cfg.hidden_size
+    qdim = cfg.num_attention_heads * cfg.head_dim
+    adapter_dir = str(tmp_path / "adapter")
+    os.makedirs(adapter_dir)
+    adapter = {
+        "base_model.model.model.layers.0.self_attn.q_proj.lora_A.weight":
+            rng.normal(size=(2, h)).astype(np.float32),
+        "base_model.model.model.layers.0.self_attn.q_proj.lora_B.weight":
+            rng.normal(size=(qdim, 2)).astype(np.float32),
+        "base_model.model.model.layers.1.mlp.down_proj.lora_A.weight":
+            rng.normal(size=(2, cfg.intermediate_size)).astype(np.float32),
+        "base_model.model.model.layers.1.mlp.down_proj.lora_B.weight":
+            rng.normal(size=(h, 2)).astype(np.float32),
+    }
+    save_file(adapter, os.path.join(adapter_dir, "adapter_model.safetensors"))
+    with open(os.path.join(adapter_dir, "adapter_config.json"), "w") as f:
+        json.dump({"r": 2, "lora_alpha": 4}, f)
+
+    merged_dir = str(tmp_path / "merged")
+    n = merge_adapter(base_dir, adapter_dir, merged_dir)
+    assert n == 2
+    assert os.path.exists(os.path.join(merged_dir, "config.json"))
+
+    via_tool = load_stage_params(model, merged_dir, dtype=jnp.float32)
+    via_load = load_stage_params(
+        model, base_dir, dtype=jnp.float32, lora_path=adapter_dir
+    )
+    flat_a = jax.tree.leaves(via_tool)
+    flat_b = jax.tree.leaves(via_load)
+    assert len(flat_a) == len(flat_b)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-6, atol=2e-6
+        )
+    # And the delta actually changed the targeted weight.
+    base = load_stage_params(model, base_dir, dtype=jnp.float32)
+    q0 = np.asarray(base["layers"][0]["self_attn"]["q_proj"]["weight"])
+    q0m = np.asarray(via_tool["layers"][0]["self_attn"]["q_proj"]["weight"])
+    assert np.abs(q0m - q0).max() > 1e-3
+
+
+def test_cli_lora_merge_subcommand(tmp_path, capsys):
+    import pytest
+
+    from parallax_tpu.cli import build_parser
+
+    args = build_parser().parse_args([
+        "lora-merge", "--model-path", "x", "--adapter-path", "y",
+        "--out-dir", "z",
+    ])
+    assert args.command == "lora-merge"
+    from parallax_tpu.cli import main
+
+    with pytest.raises(FileNotFoundError):
+        main(["lora-merge", "--model-path", str(tmp_path),
+              "--adapter-path", str(tmp_path), "--out-dir",
+              str(tmp_path / "o")])
