@@ -80,6 +80,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--advertise-addr", default=None,
         help="externally reachable host/IP peers dial for pp-forwards",
     )
+    join.add_argument(
+        "--relay", action="store_true",
+        help="NAT'd worker: no inbound dials — keep a reverse connection "
+             "at the scheduler and receive pp-forwards relayed through it",
+    )
 
     bench = sub.add_parser("bench", help="offline throughput benchmark")
     bench.add_argument("--config", default="qwen2-7b")

@@ -30,7 +30,14 @@ def _default_route_ip() -> str:
 
 
 def join_main(args) -> int:
+    import os
+
     import jax
+
+    # Honor JAX_PLATFORMS even when a PJRT plugin (axon) force-sets the
+    # platform list at config level (same rationale as serve_main).
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     from parallax_tpu.config import load_config
     from parallax_tpu.models.loader import load_stage_params
@@ -42,10 +49,24 @@ def join_main(args) -> int:
     scheduler_peer = args.scheduler_addr
     transport = TcpTransport("", "0.0.0.0", args.port)
     transport.start()
-    # The node id doubles as the dial address peers use for pp-forwards: it
-    # must be externally reachable, never the 0.0.0.0 bind address.
-    advertise_host = getattr(args, "advertise_addr", None) or _default_route_ip()
-    transport.peer_id = f"{advertise_host}:{transport.port}"
+    if getattr(args, "relay", False):
+        # NAT'd worker: no inbound dials — keep a reverse connection at
+        # the scheduler's transport and advertise a relay address
+        # (reference: libp2p relay + DCUtR, p2p/server.py build_lattica).
+        import uuid
+
+        transport.peer_id = (
+            f"relay:{uuid.uuid4().hex[:12]}@{scheduler_peer}"
+        )
+        transport.register_at_relay(scheduler_peer)
+    else:
+        # The node id doubles as the dial address peers use for
+        # pp-forwards: it must be externally reachable, never the
+        # 0.0.0.0 bind address.
+        advertise_host = (
+            getattr(args, "advertise_addr", None) or _default_route_ip()
+        )
+        transport.peer_id = f"{advertise_host}:{transport.port}"
 
     model_config = None
     load_params = None

@@ -319,6 +319,15 @@ class WorkerNode:
         while not self._stop.is_set():
             try:
                 logger.debug("%s: heartbeat", self.node_id)
+                if self.node_id.startswith("relay:") and hasattr(
+                    self.transport, "register_at_relay"
+                ):
+                    # Refresh the reverse route every beat: idempotent,
+                    # and it re-establishes the route after a dropped
+                    # relay connection without any extra liveness logic.
+                    self.transport.register_at_relay(
+                        self.node_id.rsplit("@", 1)[1]
+                    )
                 eng = self.engine
                 reply = self.transport.call(
                     self.scheduler_peer,

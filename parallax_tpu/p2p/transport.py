@@ -12,6 +12,17 @@ Capability parity: reference Lattica RPC framework (libp2p DHT/relay,
 Both expose the same synchronous facade (the engine loop is a thread):
 ``call(peer, method, payload)`` for request/response RPCs and
 ``send(peer, method, payload)`` for fire-and-forget data-plane frames.
+
+NAT traversal (reference: libp2p relay + DCUtR hole punching) is the
+**relay mode**: a worker that cannot accept inbound dials keeps one
+outbound connection to a relay (normally the scheduler's transport),
+registers its identity over it (``register_at_relay``), and advertises
+the address ``relay:<id>@<relay_host:port>``. Peers dialing such an
+address wrap their frames in a ``__relay__`` envelope to the relay,
+which forwards them down the worker's registered reverse connection as
+``__relayed__``; replies ride the same path back. Frames stay
+end-to-end — the relay never decodes the inner payload, it only routes
+envelopes.
 """
 
 from __future__ import annotations
@@ -120,6 +131,9 @@ class TcpTransport(Transport):
         self._pending: dict[int, "asyncio.Future"] = {}
         self._msg_id = 0
         self._started = threading.Event()
+        # Relay role: relay-registered worker id -> reverse-connection writer.
+        self._relay_routes: dict[str, asyncio.StreamWriter] = {}
+        self._local_ips: set[str] | None = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -197,6 +211,8 @@ class TcpTransport(Transport):
             if frame["t"] == "__hello__":
                 peer_name = frame["p"]
                 continue
+            if await self._handle_relay_frame(frame, peer_name, writer):
+                continue
             if frame.get("re") is not None:
                 fut = self._pending.pop(frame["re"], None)
                 if fut is not None and not fut.done():
@@ -205,7 +221,99 @@ class TcpTransport(Transport):
             asyncio.ensure_future(
                 self._handle_request(frame, peer_name, writer)
             )
+        # Dead reverse routes must not linger: until the worker's next
+        # re-register they would black-hole relayed frames, and churning
+        # workers (fresh uuid ids per rejoin) would grow the map forever.
+        for rid, w in list(self._relay_routes.items()):
+            if w is writer:
+                self._relay_routes.pop(rid, None)
         writer.close()
+
+    # -- relay protocol ----------------------------------------------------
+
+    async def _handle_relay_frame(self, frame, peer_name, writer) -> bool:
+        """Transport-level relay frames; True when consumed."""
+        t = frame["t"]
+        if t == "__relay_register__":
+            fresh = frame["p"] not in self._relay_routes
+            self._relay_routes[frame["p"]] = writer
+            # Heartbeat refreshes are routine; only NEW routes are news.
+            logger.log(
+                20 if fresh else 10,
+                "relay: registered reverse route for %s", frame["p"],
+            )
+            return True
+        if t == "__relay__":
+            env = frame["p"]  # {"to", "from", "data"}
+            # Off the read loop: routing can block on the target's
+            # backpressure (or a dial-out), and head-of-line blocking
+            # here would stall the sender's own heartbeats.
+            asyncio.ensure_future(self._route_envelope(env))
+            return True
+        if t == "__relayed__":
+            env = frame["p"]
+            asyncio.ensure_future(
+                self._deliver_relayed(env["from"], env["data"], writer)
+            )
+            return True
+        return False
+
+    async def _route_envelope(self, env: dict) -> None:
+        to = env["to"]
+        if to == self.peer_id:
+            # Terminal hop: we are the addressee (e.g. the scheduler
+            # relaying for itself).
+            await self._deliver_relayed(env["from"], env["data"], None)
+            return
+        route = self._relay_routes.get(to)
+        if route is not None and not route.is_closing():
+            self._write_frame(route, encode_frame("__relayed__", env))
+            try:
+                await route.drain()
+            except ConnectionError:
+                self._relay_routes.pop(to, None)
+            return
+        if ":" in to and not to.startswith("relay:"):
+            # Plain dialable peer (a non-NAT worker replying through us).
+            try:
+                await self._send_async(to, encode_frame("__relayed__", env))
+                return
+            except OSError as e:
+                logger.warning("relay: dial-out to %s failed: %s", to, e)
+        logger.warning("relay: no route to %s", to)
+
+    async def _deliver_relayed(
+        self, from_peer: str, data: bytes, reply_writer
+    ) -> None:
+        """A relayed end-to-end frame reached its addressee."""
+        inner = decode_frame(data)
+        if inner.get("re") is not None:
+            fut = self._pending.pop(inner["re"], None)
+            if fut is not None and not fut.done():
+                fut.set_result(inner["p"])
+            return
+        try:
+            result = await self._loop.run_in_executor(
+                self._executor, self._dispatch, inner["t"], from_peer,
+                inner["p"],
+            )
+        except Exception as e:
+            logger.exception("relayed handler %s failed", inner["t"])
+            result = {"__error__": str(e)}
+        if inner["id"]:
+            reply = encode_frame("__reply__", result, reply_to=inner["id"])
+            env = {"to": from_peer, "from": self.peer_id, "data": reply}
+            if reply_writer is not None and not reply_writer.is_closing():
+                # Back out the same path the request came in on.
+                self._write_frame(
+                    reply_writer, encode_frame("__relay__", env, msg_id=0)
+                )
+                try:
+                    await reply_writer.drain()
+                except ConnectionError:
+                    pass
+            else:
+                await self._route_envelope(env)
 
     async def _handle_request(self, frame, peer_name, writer) -> None:
         try:
@@ -248,6 +356,12 @@ class TcpTransport(Transport):
             if frame is None:
                 self._conns.pop(peer, None)
                 return
+            conn = self._conns.get(peer)
+            writer = conn[1] if conn else None
+            if writer is not None and await self._handle_relay_frame(
+                frame, peer, writer
+            ):
+                continue
             if frame.get("re") is not None:
                 fut = self._pending.pop(frame["re"], None)
                 if fut is not None and not fut.done():
@@ -258,7 +372,47 @@ class TcpTransport(Transport):
                     self._handle_request(frame, peer, self._conns[peer][1])
                 )
 
+    @staticmethod
+    def _parse_relay_addr(peer: str) -> tuple[str, str] | None:
+        """("relay:<id>@<host:port>") -> (full_target_id, relay_addr)."""
+        if not peer.startswith("relay:") or "@" not in peer:
+            return None
+        return peer, peer.rsplit("@", 1)[1]
+
+    def _is_self_addr(self, addr: str) -> bool:
+        """Is ``addr`` one of this transport's own reachable addresses?
+        (The bind address is usually 0.0.0.0, never what peers dialed.)"""
+        host, _, port_s = addr.rpartition(":")
+        try:
+            if int(port_s) != self.port:
+                return False
+        except ValueError:
+            return False
+        if self.host not in ("0.0.0.0", "::"):
+            return host == self.host
+        if host in ("127.0.0.1", "localhost", "0.0.0.0"):
+            return True
+        if self._local_ips is None:
+            try:
+                self._local_ips = set(
+                    socket.gethostbyname_ex(socket.gethostname())[2]
+                )
+            except OSError:
+                self._local_ips = set()
+        return host in self._local_ips
+
     async def _send_async(self, peer: str, data: bytes) -> None:
+        relayed = self._parse_relay_addr(peer)
+        if relayed is not None:
+            target, relay_addr = relayed
+            env = {"to": target, "from": self.peer_id, "data": data}
+            if target in self._relay_routes or self._is_self_addr(relay_addr):
+                # We ARE the relay (scheduler calling a NAT'd worker) —
+                # route directly instead of dialing our own server.
+                await self._route_envelope(env)
+                return
+            data = encode_frame("__relay__", env, msg_id=0)
+            peer = relay_addr
         reader, writer, lock = await self._get_conn(peer)
         async with lock:
             self._write_frame(writer, data)
@@ -274,6 +428,26 @@ class TcpTransport(Transport):
             return await asyncio.wait_for(fut, timeout)
         finally:
             self._pending.pop(mid, None)
+
+    # -- relay client ------------------------------------------------------
+
+    def register_at_relay(self, relay_addr: str) -> None:
+        """NAT'd worker: open/refresh the reverse route at ``relay_addr``.
+
+        Idempotent — call again (e.g. on every heartbeat) to re-register
+        after a dropped connection; the relay replaces the route writer.
+        """
+
+        async def _register():
+            _, writer, lock = await self._get_conn(relay_addr)
+            async with lock:
+                self._write_frame(
+                    writer,
+                    encode_frame("__relay_register__", self.peer_id, msg_id=0),
+                )
+                await writer.drain()
+
+        asyncio.run_coroutine_threadsafe(_register(), self._loop).result(10.0)
 
     # -- public sync facade --------------------------------------------------
 

@@ -1,0 +1,189 @@
+"""Relay transport: NAT'd workers served through the scheduler's relay.
+
+Capability parity: the reference's libp2p relay + DCUtR NAT story
+(``p2p/server.py build_lattica``) — here a reverse-connection relay on
+the scheduler transport (``transport.py`` relay protocol): workers with
+no inbound reachability register a reverse route and are addressed as
+``relay:<id>@<relay_host:port>``.
+"""
+
+import dataclasses
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from parallax_tpu.p2p.transport import TcpTransport, make_ping_handler
+
+
+@pytest.fixture
+def trio():
+    relay = TcpTransport("relay-node", "127.0.0.1")
+    relay.start()
+    worker = TcpTransport("", "127.0.0.1")
+    worker.start()
+    worker.peer_id = f"relay:natted-1@{relay.address}"
+    client = TcpTransport("", "127.0.0.1")
+    client.start()
+    client.peer_id = client.address
+    yield relay, worker, client
+    for t in (relay, worker, client):
+        t.stop()
+
+
+def test_relayed_call_round_trip(trio):
+    relay, worker, client = trio
+    worker.register(
+        "echo", lambda frm, payload: {"got": payload, "frm": frm}
+    )
+    worker.register_at_relay(relay.address)
+
+    out = client.call(worker.peer_id, "echo", {"x": 42}, timeout=10.0)
+    assert out["got"] == {"x": 42}
+    # The worker saw the ORIGINATOR's identity, not the relay hop.
+    assert out["frm"] == client.peer_id
+
+
+def test_relay_delivers_to_its_own_registered_worker(trio):
+    """The relay itself calling a NAT'd worker (scheduler -> worker RPC)."""
+    relay, worker, _ = trio
+    worker.register("double", lambda _f, p: p * 2)
+    worker.register_at_relay(relay.address)
+    assert relay.call(worker.peer_id, "double", 21, timeout=10.0) == 42
+
+
+def test_relayed_send_fire_and_forget(trio):
+    relay, worker, client = trio
+    got = []
+    done = threading.Event()
+
+    def on_data(_frm, payload):
+        got.append(payload)
+        done.set()
+
+    worker.register("data", on_data)
+    worker.register_at_relay(relay.address)
+    client.send(worker.peer_id, "data", b"\x01\x02\x03")
+    assert done.wait(10.0)
+    assert got == [b"\x01\x02\x03"]
+
+
+def test_relay_reregister_replaces_route(trio):
+    relay, worker, client = trio
+    worker.register("ping2", make_ping_handler())
+    worker.register_at_relay(relay.address)
+    # Re-registration (every heartbeat in production) must keep working.
+    worker.register_at_relay(relay.address)
+    assert client.call(worker.peer_id, "ping2", None, timeout=10.0) == "pong"
+
+
+def test_relay_errors_propagate_end_to_end(trio):
+    relay, worker, client = trio
+
+    def boom(_f, _p):
+        raise RuntimeError("kaboom")
+
+    worker.register("boom", boom)
+    worker.register_at_relay(relay.address)
+    from parallax_tpu.p2p.transport import TransportError
+
+    with pytest.raises(TransportError, match="kaboom"):
+        client.call(worker.peer_id, "boom", None, timeout=10.0)
+
+
+def test_swarm_serves_through_a_relay_worker(monkeypatch):
+    """Full swarm: one plain worker + one NAT'd relay worker behind the
+    scheduler's transport serve a 2-stage pipeline end to end."""
+    from parallax_tpu.backend.scheduler_service import SchedulerService
+    from parallax_tpu.config import normalize_config
+    from parallax_tpu.p2p.node import WorkerNode
+    from parallax_tpu.runtime.engine import EngineConfig
+    from parallax_tpu.runtime.request import Request, SamplingParams
+    from parallax_tpu.scheduling import node as node_mod
+    from parallax_tpu.scheduling.scheduler import GlobalScheduler
+
+    TINY = normalize_config(dict(
+        architectures=["Qwen2ForCausalLM"],
+        hidden_size=64, num_hidden_layers=4, num_attention_heads=4,
+        num_key_value_heads=2, intermediate_size=128, vocab_size=151,
+        max_position_embeddings=256,
+    ))
+    ENGINE_CFG = EngineConfig(
+        page_size=8, num_pages=64, max_model_len=128, kv_dtype="float32",
+        max_num_tokens_per_batch=128, max_batch_size=8,
+    )
+    monkeypatch.setattr(
+        node_mod.RooflinePerformanceModel, "max_layers_in_memory",
+        lambda self, kv_fraction=0.35: 2,
+    )
+
+    def stage_params(model):
+        return model.init_params(
+            jax.random.key(model.start_layer * 1000 + model.end_layer),
+            dtype=jnp.float32,
+        )
+
+    sched = GlobalScheduler(TINY, min_nodes_bootstrapping=2)
+    sched_transport = TcpTransport("scheduler", "127.0.0.1")
+    service = SchedulerService(sched, sched_transport, join_timeout_s=30.0)
+    service.start()
+    sched_addr = sched_transport.address
+
+    workers = []
+    for i in range(2):
+        t = TcpTransport("", "127.0.0.1")
+        t.start()
+        if i == 1:
+            t.peer_id = f"relay:natted-w{i}@{sched_addr}"
+            t.register_at_relay(sched_addr)
+        else:
+            t.peer_id = t.address
+        workers.append(WorkerNode(
+            transport=t,
+            scheduler_peer=sched_addr,
+            model_config=TINY,
+            engine_config=ENGINE_CFG,
+            load_params=stage_params,
+            heartbeat_interval_s=0.2,
+        ))
+    try:
+        starters = [threading.Thread(target=w.start) for w in workers]
+        for s in starters:
+            s.start()
+        for s in starters:
+            s.join(timeout=60.0)
+
+        end = time.monotonic() + 15.0
+        ready = False
+        while time.monotonic() < end:
+            status = service.scheduler.cluster_status()
+            if status["num_pipelines"] >= 1 and all(
+                node["ready"]
+                for p in status["pipelines"] for node in p["nodes"]
+            ):
+                ready = True
+                break
+            time.sleep(0.05)
+        assert ready, service.scheduler.cluster_status()
+
+        path = service.route_request("rr-1", timeout_s=10.0)
+        assert path is not None and len(path) == 2
+        # The relay worker really is one of the hops.
+        assert any(p.startswith("relay:") for p in path), path
+
+        head = next(w for w in workers if w.node_id == path[0])
+        req = Request(
+            request_id="rr-1",
+            prompt_ids=[1, 2, 3, 4, 5, 6, 7],
+            sampling_params=SamplingParams(temperature=0.0, max_new_tokens=6),
+            routing_table=list(path),
+        )
+        done = head.submit(req)
+        assert done.wait(30.0), f"request did not finish: {req.status}"
+        assert len(req.output_ids) == 6
+    finally:
+        for w in workers:
+            w.stop()
+        service.stop()
