@@ -132,6 +132,14 @@ def serve_main(args) -> int:
     from parallax_tpu.runtime.cache_manager import derive_num_pages
     from parallax_tpu.utils.hw import device_free_memory_bytes
 
+    if not os.path.isdir(args.model_path) and "/" in args.model_path:
+        # HF repo id: fetch just this stage's shard files (reference
+        # selective_model_download; requires network reachability).
+        from parallax_tpu.utils.model_download import selective_download
+
+        args.model_path = selective_download(
+            args.model_path, args.start_layer or 0, args.end_layer
+        )
     config = load_config(args.model_path)
     start = args.start_layer or 0
     end = args.end_layer or config.num_hidden_layers

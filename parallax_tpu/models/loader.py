@@ -32,12 +32,41 @@ _DTYPE_MAP = {
 }
 
 
-def _weight_files(model_path: str) -> list[str]:
+def _weight_files(model_path: str, key_needed=None) -> list[str]:
+    """Weight files to read. A selectively-downloaded stage dir
+    legitimately lacks other stages' shard files, so missing indexed
+    files are tolerated ONLY when (per the index's weight_map) they hold
+    no key ``key_needed`` accepts — an incomplete copy of a needed shard
+    still fails fast with the file names."""
     index = os.path.join(model_path, "model.safetensors.index.json")
     if os.path.exists(index):
         with open(index, encoding="utf-8") as f:
-            files = sorted(set(json.load(f)["weight_map"].values()))
-        return [os.path.join(model_path, f) for f in files]
+            weight_map = json.load(f)["weight_map"]
+        files = sorted(set(weight_map.values()))
+        present = [f for f in files
+                   if os.path.exists(os.path.join(model_path, f))]
+        missing = set(files) - set(present)
+        if missing and key_needed is not None:
+            needed_missing = sorted({
+                fname for key, fname in weight_map.items()
+                if fname in missing and key_needed(key)
+            })
+            if needed_missing:
+                raise FileNotFoundError(
+                    f"{model_path}: shard files holding this stage's "
+                    f"weights are missing: {needed_missing}"
+                )
+        if not present:
+            raise FileNotFoundError(
+                f"index lists {len(files)} shard files but none exist "
+                f"under {model_path}"
+            )
+        if missing:
+            logger.info(
+                "%s: %d/%d indexed shard files present (selective "
+                "download)", model_path, len(present), len(files),
+            )
+        return [os.path.join(model_path, f) for f in present]
     single = os.path.join(model_path, "model.safetensors")
     if os.path.exists(single):
         return [single]
@@ -143,7 +172,15 @@ def load_stage_params(
     # compressed representation) are buffered until all parts arrive, so
     # host peak memory stays far below the stage's fp footprint.
     pending: dict[str, np.ndarray] = {}
-    for path in _weight_files(model_path):
+    weight_files = _weight_files(
+        model_path,
+        key_needed=lambda key: shard_key_filter(
+            key, model.start_layer, model.end_layer, cfg.num_hidden_layers
+        ) is not None and not (
+            key.startswith("model.embed_tokens.") and not want_embed
+        ),
+    )
+    for path in weight_files:
         with safe_open(path, framework="numpy") as f:
             for key in f.keys():
                 local = shard_key_filter(

@@ -1,0 +1,134 @@
+"""Selective checkpoint download: fetch only the shard files a stage needs.
+
+Capability parity: reference ``src/parallax/utils/model_download.py``
+(``selective_model_download``: read the safetensors index, download only
+the files containing keys for layers ``[start, end)`` plus the
+config/tokenizer side files). TPU re-design: the key->need decision is
+the loader's own ``shard_key_filter`` (one source of truth for what a
+stage loads), the fetch backend is ``huggingface_hub`` when available,
+and everything degrades to a clear error — never a hang — in an
+egress-less deployment.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from parallax_tpu.utils import get_logger
+
+logger = get_logger(__name__)
+
+SIDE_FILES = (
+    "config.json", "generation_config.json", "tokenizer.json",
+    "tokenizer_config.json", "special_tokens_map.json", "vocab.json",
+    "merges.txt", "tokenizer.model", "model.safetensors.index.json",
+)
+
+INDEX_FILE = "model.safetensors.index.json"
+
+
+def shard_files_for_layers(
+    weight_map: dict[str, str], start: int, end: int, num_layers: int,
+    tie_word_embeddings: bool = True,
+) -> list[str]:
+    """Which safetensors files hold keys a ``[start, end)`` stage loads.
+
+    ``weight_map`` is the index's key->filename dict. The key->need
+    decision is the loader's ``shard_key_filter`` plus its want-embed
+    rule (embeddings ride the first stage, and the last when tied).
+    """
+    from parallax_tpu.models.loader import shard_key_filter
+
+    want_embed = start == 0 or (end == num_layers and tie_word_embeddings)
+    files = set()
+    for key, fname in weight_map.items():
+        if key.startswith("model.embed_tokens.") and not want_embed:
+            continue
+        if shard_key_filter(key, start, end, num_layers) is not None:
+            files.add(fname)
+    return sorted(files)
+
+
+def selective_download(
+    repo_id: str,
+    start_layer: int = 0,
+    end_layer: int | None = None,
+    local_dir: str | None = None,
+    revision: str | None = None,
+    fetch=None,
+) -> str:
+    """Download a stage's slice of ``repo_id``; returns the local dir.
+
+    ``end_layer=None`` means "to the last layer" (the count comes from
+    the index). ``fetch(repo_id, filename) -> local_path`` may be
+    injected (tests, mirrors); the default uses huggingface_hub.
+    Single-file checkpoints (no index) download whole — there is nothing
+    to skip.
+    """
+    if fetch is None:
+        try:
+            from huggingface_hub import hf_hub_download
+        except ImportError as e:  # pragma: no cover - env without hub
+            raise RuntimeError(
+                "huggingface_hub is unavailable; pass fetch= or use a "
+                "local checkpoint directory"
+            ) from e
+
+        def fetch(rid: str, filename: str) -> str:
+            return hf_hub_download(
+                rid, filename, revision=revision, local_dir=local_dir
+            )
+
+    got_dir = None
+    for name in SIDE_FILES:
+        try:
+            got_dir = os.path.dirname(fetch(repo_id, name))
+        except Exception as e:
+            if name == "config.json":
+                raise  # a checkpoint without config.json is unusable
+            # Absent side files are normal (not every repo ships every
+            # tokenizer format) but must not vanish silently — a failed
+            # INDEX fetch in particular changes how the repo is treated.
+            logger.debug("%s: side file %s not fetched: %s",
+                         repo_id, name, e)
+            if name == INDEX_FILE:
+                logger.warning(
+                    "%s: no %s (%s) — treating as a single-file "
+                    "checkpoint", repo_id, INDEX_FILE, e,
+                )
+    index_path = (
+        os.path.join(got_dir, INDEX_FILE) if got_dir is not None else None
+    )
+    if index_path is None or not os.path.exists(index_path):
+        # Single-file checkpoint.
+        path = fetch(repo_id, "model.safetensors")
+        logger.info("downloaded single-file checkpoint %s", repo_id)
+        return os.path.dirname(path)
+
+    with open(index_path, encoding="utf-8") as f:
+        weight_map = json.load(f)["weight_map"]
+    num_layers = 1 + max(
+        (int(k.split(".")[2]) for k in weight_map
+         if k.startswith("model.layers.")),
+        default=0,
+    )
+    if end_layer is None:
+        end_layer = num_layers
+    tied = True
+    cfg_path = os.path.join(got_dir, "config.json")
+    if os.path.exists(cfg_path):
+        with open(cfg_path, encoding="utf-8") as f:
+            tied = bool(json.load(f).get("tie_word_embeddings", True))
+    needed = shard_files_for_layers(
+        weight_map, start_layer, end_layer, num_layers,
+        tie_word_embeddings=tied,
+    )
+    total = sorted(set(weight_map.values()))
+    for fname in needed:
+        fetch(repo_id, fname)
+    logger.info(
+        "selective download %s layers [%d, %d): %d/%d shard files",
+        repo_id, start_layer, end_layer, len(needed), len(total),
+    )
+    return got_dir

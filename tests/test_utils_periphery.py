@@ -162,3 +162,180 @@ def test_cli_lora_merge_subcommand(tmp_path, capsys):
         main(["lora-merge", "--model-path", str(tmp_path),
               "--adapter-path", str(tmp_path), "--out-dir",
               str(tmp_path / "o")])
+
+
+def test_shard_files_for_layers_selects_minimal_set():
+    from parallax_tpu.utils.model_download import shard_files_for_layers
+
+    wm = {
+        "model.embed_tokens.weight": "s0.safetensors",
+        "model.layers.0.self_attn.q_proj.weight": "s0.safetensors",
+        "model.layers.1.mlp.down_proj.weight": "s1.safetensors",
+        "model.layers.2.self_attn.q_proj.weight": "s1.safetensors",
+        "model.layers.3.mlp.down_proj.weight": "s2.safetensors",
+        "model.norm.weight": "s3.safetensors",
+        "lm_head.weight": "s3.safetensors",
+    }
+    # First stage: embed + layers 0-1.
+    assert shard_files_for_layers(wm, 0, 2, 4) == [
+        "s0.safetensors", "s1.safetensors",
+    ]
+    # Last stage (untied): layers 2-3 + norm/lm_head, no embed file pull
+    # beyond what its layers already need.
+    assert shard_files_for_layers(wm, 2, 4, 4, tie_word_embeddings=False) == [
+        "s1.safetensors", "s2.safetensors", "s3.safetensors",
+    ]
+    # Middle stage of a tied model: layer 1 only.
+    assert shard_files_for_layers(wm, 1, 2, 4) == ["s1.safetensors"]
+    # Tied last stage needs the embed file (it IS the lm_head).
+    assert "s0.safetensors" in shard_files_for_layers(
+        wm, 2, 4, 4, tie_word_embeddings=True
+    )
+
+
+def test_selective_download_with_injected_fetcher(tmp_path):
+    """End-to-end against a local 'hub': only the needed shard files are
+    fetched, and the result dir serves load_stage_params."""
+    import shutil
+
+    from safetensors.numpy import save_file
+
+    from parallax_tpu.config import normalize_config
+    from parallax_tpu.models.base import StageModel
+    from parallax_tpu.models.loader import load_stage_params
+    from parallax_tpu.utils.model_download import selective_download
+
+    cfg_dict = dict(
+        architectures=["Qwen2ForCausalLM"], hidden_size=32,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=64, vocab_size=97, max_position_embeddings=128,
+        tie_word_embeddings=False,
+    )
+    cfg = normalize_config(cfg_dict)
+    model = StageModel(cfg, 0, 2, use_pallas=False)
+    params = model.init_params(jax.random.key(0), dtype=jnp.float32)
+    # Build a sharded "remote" repo: one file per layer + one for ends.
+    remote = tmp_path / "remote"
+    remote.mkdir()
+    flat: dict[str, np.ndarray] = {}
+
+    def walk(prefix, node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}.{k}" if prefix else k, v)
+        elif isinstance(node, list):
+            for i, v in enumerate(node):
+                walk(f"{prefix}.{i}", v)
+        else:
+            flat[f"model.{prefix}"] = np.asarray(node)
+
+    walk("", params)
+    for k in list(flat):
+        if k.startswith("model.lm_head."):
+            flat[k[len("model."):]] = flat.pop(k)
+    shards = {"a.safetensors": {}, "b.safetensors": {}, "c.safetensors": {}}
+    wmap = {}
+    for k, v in flat.items():
+        if ".layers.0." in k:
+            fname = "a.safetensors"
+        elif ".layers.1." in k:
+            fname = "b.safetensors"
+        else:
+            fname = "c.safetensors"
+        shards[fname][k] = v
+        wmap[k] = fname
+    for fname, tensors in shards.items():
+        save_file(tensors, str(remote / fname))
+    json.dump({"weight_map": wmap}, open(remote / "model.safetensors.index.json", "w"))
+    json.dump(cfg_dict, open(remote / "config.json", "w"))
+
+    local = tmp_path / "local"
+    local.mkdir()
+    fetched = []
+
+    def fetch(repo_id, filename):
+        src = remote / filename
+        if not src.exists():
+            raise FileNotFoundError(filename)
+        fetched.append(filename)
+        dst = local / filename
+        shutil.copy2(src, dst)
+        return str(dst)
+
+    out = selective_download("fake/repo", 1, 2, fetch=fetch)
+    assert out == str(local)
+    # Layer-0 shard was never fetched for a [1, 2) stage.
+    assert "a.safetensors" not in fetched
+    assert "b.safetensors" in fetched
+
+    stage = StageModel(cfg, 1, 2, use_pallas=False)
+    loaded = load_stage_params(stage, out, dtype=jnp.float32)
+    ref = np.asarray(params["layers"][1]["self_attn"]["q_proj"]["weight"])
+    np.testing.assert_allclose(
+        np.asarray(loaded["layers"][0]["self_attn"]["q_proj"]["weight"]),
+        ref,
+    )
+
+
+def test_loader_fails_fast_on_missing_needed_shard(tmp_path):
+    """An incomplete copy (missing a shard this stage NEEDS) must raise
+    with the file names, not a cryptic downstream KeyError; missing
+    shards of OTHER stages stay tolerated."""
+    import shutil
+
+    import pytest
+
+    from parallax_tpu.config import normalize_config
+    from parallax_tpu.models.base import StageModel
+    from parallax_tpu.models.loader import load_stage_params
+    from safetensors.numpy import save_file
+
+    cfg_dict = dict(
+        architectures=["Qwen2ForCausalLM"], hidden_size=32,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=64, vocab_size=97, max_position_embeddings=128,
+        tie_word_embeddings=False,
+    )
+    cfg = normalize_config(cfg_dict)
+    model = StageModel(cfg, 0, 2, use_pallas=False)
+    params = model.init_params(jax.random.key(0), dtype=jnp.float32)
+    flat = {}
+
+    def walk(prefix, node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}.{k}" if prefix else k, v)
+        elif isinstance(node, list):
+            for i, v in enumerate(node):
+                walk(f"{prefix}.{i}", v)
+        else:
+            flat[f"model.{prefix}"] = np.asarray(node)
+
+    walk("", params)
+    for k in list(flat):
+        if k.startswith("model.lm_head."):
+            flat[k[len("model."):]] = flat.pop(k)
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    shards = {"l0.safetensors": {}, "l1.safetensors": {}, "ends.safetensors": {}}
+    wmap = {}
+    for k, v in flat.items():
+        fname = ("l0.safetensors" if ".layers.0." in k
+                 else "l1.safetensors" if ".layers.1." in k
+                 else "ends.safetensors")
+        shards[fname][k] = v
+        wmap[k] = fname
+    for fname, tensors in shards.items():
+        save_file(tensors, str(ckpt / fname))
+    json.dump({"weight_map": wmap},
+              open(ckpt / "model.safetensors.index.json", "w"))
+    json.dump(cfg_dict, open(ckpt / "config.json", "w"))
+
+    # Missing shard needed by a [0, 2) stage -> clear FileNotFoundError.
+    os.remove(ckpt / "l1.safetensors")
+    with pytest.raises(FileNotFoundError, match="l1.safetensors"):
+        load_stage_params(model, str(ckpt), dtype=jnp.float32)
+    # But a [0, 1) stage doesn't need it and loads fine.
+    s0 = StageModel(cfg, 0, 1, use_pallas=False)
+    loaded = load_stage_params(s0, str(ckpt), dtype=jnp.float32)
+    assert len(loaded["layers"]) == 1
