@@ -134,6 +134,14 @@ def dsa_topk_indices(
     return jnp.where(dense[:, None], jnp.int32(-1), idx)
 
 
+# Above this many top-k positions the single-pass gather's [T, K, R+Dr]
+# transient dominates HBM; the chunked online-softmax path bounds it to
+# [T, chunk, R+Dr] at identical math (DeepSeek-V3.2 ships index_topk=2048:
+# at T=64 that is ~1.2 GB single-pass vs ~75 MB chunked).
+_SPARSE_CHUNK_THRESHOLD = 512
+_SPARSE_CHUNK = 256
+
+
 @functools.partial(jax.jit, static_argnames=("sm_scale", "kv_lora_rank"))
 def mla_ragged_sparse_attention_xla(
     q_latent: jax.Array,     # [T, Hq, R]
@@ -155,7 +163,8 @@ def mla_ragged_sparse_attention_xla(
     latent^T + q_pe . rope^T)) . latent over ``topk_indices``; a -1-leading
     row attends densely over range(context), which here is covered by
     substituting iota for the indices (dense rows only occur when the
-    context fits in K).
+    context fits in K). Large K runs the chunked online-softmax variant
+    (O(T * chunk) transients); small K a single pass.
     """
     t, hq, r = q_latent.shape
     p, page_size, _, width = cache.shape
@@ -180,21 +189,64 @@ def mla_ragged_sparse_attention_xla(
     phys_page = jnp.take_along_axis(
         page_indices[seq_of_tok], page_of, axis=1
     )                                                     # [T, K]
-    rows = cache[phys_page, offset, 0, :]                 # [T, K, R+Dr]
-    latent = rows[..., :kv_lora_rank]
-    rope = rows[..., kv_lora_rank:]
+    flat_rows = phys_page * page_size + offset            # [T, K]
+    flat_cache = cache.reshape(p * page_size, width)
 
-    scores = (
-        jnp.einsum("thr,tkr->thk", q_latent, latent,
-                   preferred_element_type=jnp.float32)
-        + jnp.einsum("thd,tkd->thk", q_pe, rope,
-                     preferred_element_type=jnp.float32)
-    ) * sm_scale
-    scores = jnp.where(valid[:, None, :], scores, _MASK_VALUE)
-    m = jnp.max(scores, axis=-1, keepdims=True)
-    unnorm = jnp.exp(scores - m)
-    probs = unnorm / jnp.maximum(jnp.sum(unnorm, axis=-1, keepdims=True),
-                                 1e-30)
-    out = jnp.einsum("thk,tkr->thr", probs.astype(latent.dtype), latent,
-                     preferred_element_type=jnp.float32)
+    def score_block(rows_blk, valid_blk):
+        """[T, Kc, R+Dr] gathered block -> masked f32 scores [T, Hq, Kc]."""
+        latent = rows_blk[..., :kv_lora_rank]
+        rope = rows_blk[..., kv_lora_rank:]
+        sc = (
+            jnp.einsum("thr,tkr->thk", q_latent, latent,
+                       preferred_element_type=jnp.float32)
+            + jnp.einsum("thd,tkd->thk", q_pe, rope,
+                         preferred_element_type=jnp.float32)
+        ) * sm_scale
+        return jnp.where(valid_blk[:, None, :], sc, _MASK_VALUE), latent
+
+    if k <= _SPARSE_CHUNK_THRESHOLD:
+        rows = flat_cache[flat_rows]                      # [T, K, R+Dr]
+        scores, latent = score_block(rows, valid)
+        m = jnp.max(scores, axis=-1, keepdims=True)
+        unnorm = jnp.exp(scores - m)
+        probs = unnorm / jnp.maximum(
+            jnp.sum(unnorm, axis=-1, keepdims=True), 1e-30
+        )
+        out = jnp.einsum("thk,tkr->thr", probs.astype(latent.dtype), latent,
+                         preferred_element_type=jnp.float32)
+        return out.astype(q_latent.dtype)
+
+    # Chunked online softmax over K (flash-style accumulation).
+    chunk = _SPARSE_CHUNK
+    num_chunks = -(-k // chunk)
+    pad = num_chunks * chunk - k
+    if pad:
+        flat_rows = jnp.pad(flat_rows, ((0, 0), (0, pad)))
+        valid = jnp.pad(valid, ((0, 0), (0, pad)))
+
+    def body(carry, c):
+        m_run, l_run, acc = carry
+        rows_c = jax.lax.dynamic_slice_in_dim(flat_rows, c * chunk, chunk, 1)
+        valid_c = jax.lax.dynamic_slice_in_dim(valid, c * chunk, chunk, 1)
+        blk = flat_cache[rows_c]                          # [T, Kc, R+Dr]
+        sc, latent = score_block(blk, valid_c)            # [T, Hq, Kc]
+        m_new = jnp.maximum(m_run, jnp.max(sc, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_run - m_new)
+        p_blk = jnp.exp(sc - m_new)
+        l_new = l_run * alpha + jnp.sum(p_blk, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum(
+            "thk,tkr->thr", p_blk.astype(latent.dtype), latent,
+            preferred_element_type=jnp.float32,
+        )
+        return (m_new, l_new, acc), None
+
+    init = (
+        jnp.full((t, hq, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((t, hq, 1), jnp.float32),
+        jnp.zeros((t, hq, kv_lora_rank), jnp.float32),
+    )
+    (m_run, l_run, acc), _ = jax.lax.scan(
+        body, init, jnp.arange(num_chunks, dtype=jnp.int32)
+    )
+    out = acc / jnp.maximum(l_run, 1e-30)
     return out.astype(q_latent.dtype)

@@ -34,6 +34,9 @@ from parallax_tpu.ops.dsa import new_index_pages, store_index_cache  # noqa: F40
 
 _MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 _NEG_INF = float("-inf")
+# Same transient-bounding thresholds as ops/dsa.py: above this many
+# selected positions the gather+softmax runs chunked (online softmax).
+from parallax_tpu.ops.dsa import _SPARSE_CHUNK, _SPARSE_CHUNK_THRESHOLD  # noqa: E402
 _INIT_SCORE = 1e30
 _LOCAL_SCORE = 1e29
 
@@ -179,21 +182,70 @@ def paged_sparse_gqa_attention_xla(
     page_of = safe_pos // page_size
     offset = safe_pos % page_size
     phys_page = jnp.take_along_axis(page_indices[seq_of_tok], page_of, axis=1)
-    rows = kv_pages[phys_page, offset]            # [T, K, 2*Hkv, D]
-    k_sel = rows[:, :, 0::2, :]                   # [T, K, Hkv, D]
-    v_sel = rows[:, :, 1::2, :]
-
+    flat_rows = phys_page * page_size + offset    # [T, K]
+    flat_kv = kv_pages.reshape(p * page_size, combined, head_dim)
     qg = q.reshape(t, num_kv_heads, group, head_dim)
-    scores = jnp.einsum(
-        "thgd,tkhd->thgk", qg, k_sel, preferred_element_type=jnp.float32
-    ) * sm_scale
-    scores = jnp.where(valid[:, None, None, :], scores, _MASK_VALUE)
-    m = jnp.max(scores, axis=-1, keepdims=True)
-    unnorm = jnp.exp(scores - m)
-    probs = unnorm / jnp.maximum(jnp.sum(unnorm, axis=-1, keepdims=True),
-                                 1e-30)
-    out = jnp.einsum(
-        "thgk,tkhd->thgd", probs.astype(v_sel.dtype), v_sel,
-        preferred_element_type=jnp.float32,
+
+    def score_block(rows_blk, valid_blk):
+        """[T, Kc, 2Hkv, D] -> (masked f32 scores [T, Hkv, G, Kc], v)."""
+        k_sel = rows_blk[:, :, 0::2, :]
+        v_sel = rows_blk[:, :, 1::2, :]
+        sc = jnp.einsum(
+            "thgd,tkhd->thgk", qg, k_sel, preferred_element_type=jnp.float32
+        ) * sm_scale
+        return jnp.where(valid_blk[:, None, None, :], sc, _MASK_VALUE), v_sel
+
+    if k <= _SPARSE_CHUNK_THRESHOLD:
+        rows = flat_kv[flat_rows]                 # [T, K, 2*Hkv, D]
+        scores, v_sel = score_block(rows, valid)
+        m = jnp.max(scores, axis=-1, keepdims=True)
+        unnorm = jnp.exp(scores - m)
+        probs = unnorm / jnp.maximum(
+            jnp.sum(unnorm, axis=-1, keepdims=True), 1e-30
+        )
+        out = jnp.einsum(
+            "thgk,tkhd->thgd", probs.astype(v_sel.dtype), v_sel,
+            preferred_element_type=jnp.float32,
+        )
+        return out.reshape(t, num_q_heads, head_dim).astype(q.dtype)
+
+    # Chunked online softmax over K: the gather transient is bounded to
+    # [T, chunk, 2Hkv, D] instead of the full selected set (MiniMax-M3's
+    # topk_blocks * block_size can reach thousands of positions). The
+    # first chunk always holds valid positions (top-k sorts real blocks
+    # ahead of the -1 padding), so the running max is real before any
+    # fully-masked chunk can contribute exp(0) terms.
+    chunk = _SPARSE_CHUNK
+    num_chunks = -(-k // chunk)
+    pad = num_chunks * chunk - k
+    if pad:
+        flat_rows = jnp.pad(flat_rows, ((0, 0), (0, pad)))
+        valid = jnp.pad(valid, ((0, 0), (0, pad)))
+
+    def body(carry, c):
+        m_run, l_run, acc = carry
+        rows_c = jax.lax.dynamic_slice_in_dim(flat_rows, c * chunk, chunk, 1)
+        valid_c = jax.lax.dynamic_slice_in_dim(valid, c * chunk, chunk, 1)
+        blk = flat_kv[rows_c]                     # [T, Kc, 2Hkv, D]
+        sc, v_sel = score_block(blk, valid_c)
+        m_new = jnp.maximum(m_run, jnp.max(sc, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_run - m_new)
+        p_blk = jnp.exp(sc - m_new)
+        l_new = l_run * alpha + jnp.sum(p_blk, axis=-1, keepdims=True)
+        # alpha's trailing singleton broadcasts over D.
+        acc = acc * alpha + jnp.einsum(
+            "thgk,tkhd->thgd", p_blk.astype(v_sel.dtype), v_sel,
+            preferred_element_type=jnp.float32,
+        )
+        return (m_new, l_new, acc), None
+
+    init = (
+        jnp.full((t, num_kv_heads, group, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((t, num_kv_heads, group, 1), jnp.float32),
+        jnp.zeros((t, num_kv_heads, group, head_dim), jnp.float32),
     )
+    (m_run, l_run, acc), _ = jax.lax.scan(
+        body, init, jnp.arange(num_chunks, dtype=jnp.int32)
+    )
+    out = acc / jnp.maximum(l_run, 1e-30)
     return out.reshape(t, num_q_heads, head_dim).astype(q.dtype)

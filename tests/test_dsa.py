@@ -238,6 +238,53 @@ def test_sparse_attention_matches_numpy_restriction():
     np.testing.assert_allclose(out[0], ref, rtol=1e-4, atol=1e-4)
 
 
+def test_sparse_attention_chunked_matches_single_pass():
+    """K above the chunk threshold switches to the online-softmax scan;
+    the result must match the single-pass gather bit-for-near-bit."""
+    from parallax_tpu.ops import dsa as dsa_mod
+
+    rng = np.random.default_rng(7)
+    page_size, num_pages = 8, 128
+    ctx, hq, r, dr = 700, 2, 16, 8
+    k = dsa_mod._SPARSE_CHUNK_THRESHOLD + 90   # force the chunked path
+    pages_needed = -(-ctx // page_size)
+    page_ids = list(range(1, 1 + pages_needed))
+    latent = rng.standard_normal((ctx, r)).astype(np.float32)
+    rope = rng.standard_normal((ctx, dr)).astype(np.float32)
+    cache = new_mla_pages(num_pages, page_size, r, dr, jnp.float32)
+    slots = np.array([page_ids[i // page_size] * page_size + i % page_size
+                      for i in range(ctx)], np.int32)
+    cache = store_mla_cache(cache, jnp.asarray(latent), jnp.asarray(rope),
+                            jnp.asarray(slots))
+    t = 3
+    q_latent = rng.standard_normal((t, hq, r)).astype(np.float32)
+    q_pe = rng.standard_normal((t, hq, dr)).astype(np.float32)
+    # Random sparse picks inside the context + some -1 padding tails.
+    picks = np.stack([
+        np.sort(rng.choice(ctx, size=k, replace=False)) for _ in range(t)
+    ]).astype(np.int32)
+    picks[0, -17:] = -1
+    args = (
+        jnp.asarray(q_latent), jnp.asarray(q_pe), cache,
+        jnp.asarray([ctx], jnp.int32), jnp.asarray([page_ids], jnp.int32),
+        jnp.asarray([0, t], jnp.int32),
+    )
+    chunked = np.asarray(mla_ragged_sparse_attention_xla(
+        *args, jnp.asarray(picks), sm_scale=0.3, kv_lora_rank=r,
+    ))
+    # Single-pass oracle: same function with the threshold raised past K
+    # (fresh trace: clear the jit cache so the patched constant applies).
+    import unittest.mock as mock
+
+    with mock.patch.object(dsa_mod, "_SPARSE_CHUNK_THRESHOLD", 10_000):
+        jax.clear_caches()
+        single = np.asarray(mla_ragged_sparse_attention_xla(
+            *args, jnp.asarray(picks), sm_scale=0.3, kv_lora_rank=r,
+        ))
+    jax.clear_caches()
+    np.testing.assert_allclose(chunked, single, rtol=2e-5, atol=2e-5)
+
+
 # ---------------------------------------------------------------------------
 # model level
 # ---------------------------------------------------------------------------

@@ -332,3 +332,47 @@ def test_msa_positions_chunked_scan_matches_single_pass(monkeypatch):
     monkeypatch.setattr(ragged_mod, "KV_CHUNK_ROWS", 8)  # 8 chunks
     chunked = np.asarray(msa_sparse_positions_xla.__wrapped__(*args, **kw))
     np.testing.assert_array_equal(chunked, single)
+
+
+def test_sparse_gqa_chunked_matches_single_pass():
+    """K above the chunk threshold switches to the online-softmax scan;
+    results must match the single-pass gather."""
+    from parallax_tpu.ops import dsa as dsa_mod
+
+    rng = np.random.default_rng(11)
+    page_size, num_pages = 8, 128
+    ctx, hq, hkv, d = 800, 4, 2, 16
+    kk = dsa_mod._SPARSE_CHUNK_THRESHOLD + 70
+    pages_needed = -(-ctx // page_size)
+    page_ids = list(range(1, 1 + pages_needed))
+    kv = new_kv_pages(num_pages, page_size, hkv, d, jnp.float32)
+    k = rng.standard_normal((ctx, hkv, d)).astype(np.float32)
+    v = rng.standard_normal((ctx, hkv, d)).astype(np.float32)
+    slots = np.array([page_ids[i // page_size] * page_size + i % page_size
+                      for i in range(ctx)], np.int32)
+    kv = reshape_and_cache(kv, jnp.asarray(k), jnp.asarray(v),
+                           jnp.asarray(slots))
+    t = 2
+    q = rng.standard_normal((t, hq, d)).astype(np.float32)
+    pos = np.stack([
+        np.sort(rng.choice(ctx, size=kk, replace=False)) for _ in range(t)
+    ]).astype(np.int32)
+    pos[1, -25:] = -1
+    args = (
+        jnp.asarray(q), kv, jnp.asarray([ctx], jnp.int32),
+        jnp.asarray([page_ids], jnp.int32), jnp.asarray([0, t], jnp.int32),
+    )
+    chunked = np.asarray(paged_sparse_gqa_attention_xla(
+        *args, jnp.asarray(pos), sm_scale=0.3,
+    ))
+    import unittest.mock as mock
+
+    from parallax_tpu.ops import msa as msa_mod
+
+    with mock.patch.object(msa_mod, "_SPARSE_CHUNK_THRESHOLD", 10_000):
+        jax.clear_caches()
+        single = np.asarray(paged_sparse_gqa_attention_xla(
+            *args, jnp.asarray(pos), sm_scale=0.3,
+        ))
+    jax.clear_caches()
+    np.testing.assert_allclose(chunked, single, rtol=2e-5, atol=2e-5)
