@@ -99,6 +99,7 @@ class BaseLayerAllocator:
         counts = water_fill_layers(group, self.num_layers)
         if counts is None:
             return None
+        counts = trim_pipeline_boundaries(group, counts)
         assign_ranges(group, counts)
         return Pipeline(nodes=group)
 
@@ -109,13 +110,7 @@ class BaseLayerAllocator:
         layer_allocation.py:226-276)."""
         if not active:
             return False
-        power = [0.0] * self.num_layers
-        for n in active:
-            if not n.has_allocation:
-                continue
-            p = 1.0 / max(1e-9, n.layer_latency_ms())
-            for layer in range(n.start_layer, min(n.end_layer, self.num_layers)):
-                power[layer] += p
+        power = layer_hosting_power(active, self.num_layers)
         if any(p == 0.0 for p in power):
             return True  # uncovered layer: must rebalance
         mean = statistics.fmean(power)
@@ -223,3 +218,85 @@ def try_dynamic_join(
     (reference dynamic_join + extend, layer_allocation.py:193-214,
     request_routing RR extend)."""
     return allocator.allocate(standby)
+
+
+def layer_hosting_power(active: list[Node], num_layers: int) -> list[float]:
+    """Per-layer hosting power (sum of 1/latency over nodes serving each
+    layer) — the reference's LayerLoad heap, as a plain array."""
+    power = [0.0] * num_layers
+    for n in active:
+        if not n.has_allocation:
+            continue
+        p = 1.0 / max(1e-9, n.layer_latency_ms())
+        for layer in range(n.start_layer, min(n.end_layer, num_layers)):
+            power[layer] += p
+    return power
+
+
+def assign_to_lightest_layers(
+    node: Node, active: list[Node], num_layers: int
+) -> bool:
+    """Dynamic join for a node that cannot complete a new pipeline:
+    replicate the lightest EXISTING stage range it can hold (reference
+    ``BaseLayerAllocator.dynamic_join`` joining the lightest layer,
+    layer_allocation.py:193-214). Dynamic routers walk exact stage
+    boundaries, so a free-sliding window would be unreachable — the
+    replica must adopt a range some path already uses. Returns False when
+    no active stage fits the node's capacity.
+    """
+    cap = node.layer_capacity()
+    power = layer_hosting_power(active, num_layers)
+    best: tuple[int, int] | None = None
+    best_avg = float("inf")
+    for other in active:
+        if not other.has_allocation:
+            continue
+        s, e = other.start_layer, min(other.end_layer, num_layers)
+        if e - s < 1 or e - s > cap:
+            continue
+        avg = sum(power[s:e]) / (e - s)
+        if avg < best_avg:
+            best_avg, best = avg, (s, e)
+    if best is None:
+        return False
+    node.set_layers(*best)
+    return True
+
+
+def trim_pipeline_boundaries(
+    group: list[Node], counts: list[int], max_iter: int = 64
+) -> list[int]:
+    """Local search on stage boundaries after water-filling: repeatedly move
+    one layer from the latency-bottleneck stage to its cheaper neighbor
+    while that lowers the pipeline's max stage latency (the reference's
+    turning-point trimming, layer_allocation.py:461-555 — water-filling is
+    proportional in the continuous relaxation; integer rounding leaves
+    boundary slack this pass reclaims).
+    """
+    counts = list(counts)
+    lat = [n.layer_latency_ms() for n in group]
+    caps = [n.layer_capacity() for n in group]
+
+    def stage_ms(i: int) -> float:
+        return counts[i] * lat[i]
+
+    for _ in range(max_iter):
+        worst = max(range(len(group)), key=stage_ms)
+        if counts[worst] <= 1:
+            break
+        best_gain, best_nb = 0.0, None
+        for nb in (worst - 1, worst + 1):
+            if not 0 <= nb < len(group) or counts[nb] >= caps[nb]:
+                continue
+            old_max = max(stage_ms(worst), stage_ms(nb))
+            new_max = max(
+                (counts[worst] - 1) * lat[worst],
+                (counts[nb] + 1) * lat[nb],
+            )
+            if old_max - new_max > best_gain:
+                best_gain, best_nb = old_max - new_max, nb
+        if best_nb is None:
+            break
+        counts[worst] -= 1
+        counts[best_nb] += 1
+    return counts

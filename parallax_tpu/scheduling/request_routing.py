@@ -13,6 +13,11 @@ from parallax_tpu.scheduling.node_management import NodeManager, Pipeline
 
 
 class RoutingStrategy:
+    # Whether this router can use partial-range replica nodes that are
+    # not members of a registered pipeline (the scheduler's dynamic-join
+    # gate reads this instead of matching router names).
+    supports_partial_replicas = False
+
     def __init__(self, manager: NodeManager):
         self.manager = manager
 
@@ -63,6 +68,8 @@ class DPRouting(RoutingStrategy):
     request_routing.py:286-426): dp over layer boundaries, cost = stage
     latency + inter-hop RTT + load compensation."""
 
+    supports_partial_replicas = True
+
     def find_path(self) -> list[Node] | None:
         nodes = [n for n in self.manager.nodes() if n.has_allocation and n.is_ready]
         if not nodes:
@@ -98,9 +105,76 @@ class DPRouting(RoutingStrategy):
         return path if cost < INF else None
 
 
+class RandomizedRouting(RoutingStrategy):
+    """Randomized choice over ALL complete dynamic pipelines (reference
+    ``RandomizedOverDynamicPipelinesRouting``, request_routing.py:443-500):
+    DFS-enumerate every complete path over the announced layer ranges,
+    drop overloaded ones, and pick randomly weighted by inverse estimated
+    latency — spreading load across replicas that shortest-path DP would
+    starve."""
+
+    supports_partial_replicas = True
+
+    # DFS ceiling: enumeration is exponential in replica fan-out; beyond
+    # this many complete paths the sample is already diverse.
+    MAX_PATHS = 128
+
+    def __init__(self, manager: NodeManager, seed: int | None = None):
+        super().__init__(manager)
+        import random
+
+        self._rng = random.Random(seed)
+
+    def _discover(self) -> list[list[Node]]:
+        nodes = [
+            n for n in self.manager.nodes()
+            if n.has_allocation and n.is_ready
+        ]
+        num_layers = self.manager.num_layers
+        by_start: dict[int, list[Node]] = {}
+        for n in nodes:
+            by_start.setdefault(n.start_layer, []).append(n)
+        # Shuffle each candidate list per call: the MAX_PATHS cutoff then
+        # truncates a DIFFERENT suffix every request instead of starving
+        # the same trailing replicas forever.
+        for cands in by_start.values():
+            self._rng.shuffle(cands)
+        paths: list[list[Node]] = []
+
+        def dfs(boundary: int, acc: list[Node]) -> None:
+            if len(paths) >= self.MAX_PATHS:
+                return
+            if boundary == num_layers:
+                paths.append(list(acc))
+                return
+            for cand in by_start.get(boundary, []):
+                if cand.load >= cand.max_concurrent_requests():
+                    continue
+                acc.append(cand)
+                dfs(cand.end_layer, acc)
+                acc.pop()
+
+        dfs(0, [])
+        return paths
+
+    def find_path(self) -> list[Node] | None:
+        paths = self._discover()
+        if not paths:
+            return None
+        weights = []
+        for p in paths:
+            ms = sum(n.stage_latency_ms() for n in p)
+            for prev, nxt in zip(p, p[1:]):
+                ms += prev.rtt_to(nxt.node_id) * 1e3
+            weights.append(1.0 / max(ms, 1e-6))
+        return self._rng.choices(paths, weights=weights, k=1)[0]
+
+
 def make_router(name: str, manager: NodeManager) -> RoutingStrategy:
     if name in ("rr", "round_robin"):
         return RoundRobinRouting(manager)
     if name in ("dp", "dynamic"):
         return DPRouting(manager)
+    if name in ("random", "randomized"):
+        return RandomizedRouting(manager)
     raise ValueError(f"unknown routing strategy {name!r}")

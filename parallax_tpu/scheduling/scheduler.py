@@ -196,6 +196,25 @@ class GlobalScheduler:
             if pipelines:
                 self.manager.register_pipelines(pipelines)
                 self._log_allocation("extend")
+        # Leftover standby nodes that cannot complete a pipeline still
+        # help under dynamic routing: replicate an existing stage range
+        # (reference dynamic_join, layer_allocation.py:193-214). Runs on
+        # the bootstrap branch too — a global rebalance standbys every
+        # node, and stranded replicas must re-join without waiting for an
+        # unrelated membership event.
+        if self.router.supports_partial_replicas and self.bootstrapped.is_set():
+            from parallax_tpu.scheduling.layer_allocation import (
+                assign_to_lightest_layers,
+            )
+
+            active = self.manager.nodes(NodeState.ACTIVE)
+            for node in self.manager.nodes(NodeState.STANDBY):
+                if active and assign_to_lightest_layers(
+                    node, active, self.model.num_hidden_layers
+                ):
+                    self.manager.set_active(node.node_id)
+                    active.append(node)
+                    self._log_allocation("dynamic-join")
 
     def _handle_leave(self, node_id: str) -> None:
         displaced = self.manager.remove(node_id)

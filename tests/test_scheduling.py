@@ -243,3 +243,139 @@ class TestGlobalScheduler:
             )
         finally:
             sched.stop()
+
+
+class TestDynamicJoinAndTrimming:
+    def test_assign_to_lightest_layers_replicates_weakest_stage(self):
+        from parallax_tpu.scheduling.layer_allocation import (
+            assign_to_lightest_layers,
+        )
+
+        # Two stages [0, 14) fast and [14, 28) slow: the joiner must adopt
+        # the SLOW stage's exact range (dynamic routers walk existing
+        # boundaries, so only stage-aligned replicas are reachable).
+        a = make_node("a", V5P_HOST)
+        a.set_layers(0, 14)
+        b = make_node("b", V5E_HOST)
+        b.set_layers(14, 28)
+        joiner = make_node("j", V5E_HOST)
+        assert assign_to_lightest_layers(joiner, [a, b], 28)
+        assert (joiner.start_layer, joiner.end_layer) == (14, 28)
+        # A node too small for every stage is refused outright.
+        tiny = make_node("t", V5E_SMALL)
+        if tiny.layer_capacity() < 14:
+            assert not assign_to_lightest_layers(tiny, [a, b], 28)
+
+    def test_dynamic_join_replicates_under_dp_routing(self, monkeypatch):
+        """A standby node that cannot complete a new pipeline still joins
+        a dp-routed cluster as a replica of an EXISTING stage range —
+        and is actually routable (a free-sliding window would not be)."""
+        from parallax_tpu.scheduling import node as node_mod
+
+        monkeypatch.setattr(
+            node_mod.RooflinePerformanceModel, "max_layers_in_memory",
+            lambda self, kv_fraction=0.35: 14,
+        )
+        sched = GlobalScheduler(MODEL, min_nodes_bootstrapping=2,
+                                routing="dp")
+        sched.start()
+        try:
+            sched.enqueue_join("a", V5E_HOST)
+            sched.enqueue_join("b", V5E_HOST)
+            deadline = time.monotonic() + 5.0
+            while not sched.bootstrapped.is_set():
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            for nid in ("a", "b"):
+                sched.enqueue_update(nid, is_ready=True)
+            # Third node: cannot form a pipeline alone -> replica join.
+            sched.enqueue_join("c", V5E_HOST)
+            deadline = time.monotonic() + 5.0
+            small = None
+            while time.monotonic() < deadline:
+                small = sched.manager.get("c")
+                if small is not None and small.has_allocation:
+                    break
+                time.sleep(0.01)
+            assert small is not None and small.has_allocation
+            assert sched.manager.state_of("c") is NodeState.ACTIVE
+            # The replica adopted an EXISTING stage range...
+            ranges = {
+                (sched.manager.get(n).start_layer,
+                 sched.manager.get(n).end_layer) for n in ("a", "b")
+            }
+            assert (small.start_layer, small.end_layer) in ranges
+            # ...and is genuinely routable: load out the original holder
+            # of that range and the DP router must route via the replica.
+            small.is_ready = True
+            holder = next(
+                n for n in ("a", "b")
+                if (sched.manager.get(n).start_layer,
+                    sched.manager.get(n).end_layer)
+                == (small.start_layer, small.end_layer)
+            )
+            sched.manager.get(holder).load = (
+                sched.manager.get(holder).max_concurrent_requests()
+            )
+            path = sched.router.find_path()
+            assert path is not None
+            assert any(n.node_id == "c" for n in path), [
+                n.node_id for n in path
+            ]
+        finally:
+            sched.stop()
+
+    def test_trim_boundaries_reduces_bottleneck(self):
+        from parallax_tpu.scheduling.layer_allocation import (
+            trim_pipeline_boundaries,
+        )
+
+        fast = make_node("f", V5P_HOST)
+        slow = make_node("s", V5E_HOST)
+        # Deliberately bad split: slow node overloaded.
+        counts = trim_pipeline_boundaries([slow, fast], [20, 8])
+        assert sum(counts) == 28
+        # Bottleneck must not be worse than the input split's.
+        before = max(20 * slow.layer_latency_ms(),
+                     8 * fast.layer_latency_ms())
+        after = max(counts[0] * slow.layer_latency_ms(),
+                    counts[1] * fast.layer_latency_ms())
+        assert after <= before
+        assert counts[0] < 20  # layers actually moved off the slow node
+
+
+class TestRandomizedRouting:
+    def test_randomized_spreads_over_replicas(self):
+        from parallax_tpu.scheduling.request_routing import RandomizedRouting
+
+        mgr = NodeManager(MODEL.num_hidden_layers)
+        picks = []
+        nodes = []
+        for nid in ("p0a", "p0b"):
+            n = make_node(nid)
+            n.set_layers(0, 14)
+            mgr.add(n)
+            nodes.append(n)
+        tail = make_node("tail", V5P_HOST)
+        tail.set_layers(14, 28)
+        mgr.add(tail)
+        router = RandomizedRouting(mgr, seed=7)
+        for _ in range(40):
+            path = router.find_path()
+            assert path is not None
+            assert [n.start_layer for n in path] == [0, 14]
+            picks.append(path[0].node_id)
+        # Both head replicas get traffic (the DP router would always pick
+        # the single cheapest).
+        assert set(picks) == {"p0a", "p0b"}
+
+    def test_randomized_respects_load_caps(self):
+        from parallax_tpu.scheduling.request_routing import RandomizedRouting
+
+        mgr = NodeManager(MODEL.num_hidden_layers)
+        full = make_node("full")
+        full.set_layers(0, 28)
+        full.load = full.max_concurrent_requests()
+        mgr.add(full)
+        router = RandomizedRouting(mgr, seed=1)
+        assert router.find_path() is None
