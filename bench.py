@@ -1,16 +1,19 @@
 """Offline serving benchmark: output tokens/sec/chip on the north-star config.
 
-North-star (BASELINE.md): output tokens/sec/chip, Qwen2.5-7B, 2-stage
-pipeline parallel. One real chip is available, so we run one chip's
-workload of the 2-stage setup — half the model's decoder layers, plus
-embed + lm_head + sampling (a real stage carries one of the two ends; we
-carry both, which over-counts slightly and is therefore conservative) —
-with continuous batching, and report
+North-star (BASELINE.md): output tokens/sec/chip + p50 TTFT, Qwen2.5-7B,
+2-stage pipeline parallel. One real chip is available, so we run one
+chip's workload of the 2-stage setup — half the model's decoder layers,
+plus embed + lm_head + sampling (a real stage carries one of the two
+ends; we carry both, which over-counts slightly and is therefore
+conservative) — with continuous batching, and report
 
     tokens/sec/chip = decode_batch / (2 * stage_decode_step_time)
 
 — the steady-state 2-chip pipeline emits one decode batch per stage step
-(stages overlap on different token waves).
+(stages overlap on different token waves). ``ttft_p50_ms`` is the median
+time from request submission to its first sampled token across the full
+measured batch (all requests submitted at t=0; the number includes queue
++ chunked prefill, the honest offline-batch definition).
 
 The axon test rig reaches the chip through a relay tunnel that adds
 ~65-80 ms to EVERY dispatch+readback roundtrip (measured: device compute
@@ -18,22 +21,37 @@ is ~16 ms/step in the profiler trace while the unfused wall step is
 ~97 ms). A real deployment has the chip attached locally and hides
 per-token dispatch behind pipelined token waves, so unfused numbers on
 this rig measure the tunnel, not the framework. The bench therefore
-decodes with the engine's fused multi-step greedy path
-(``decode_lookahead=32``: k forward+argmax steps in one ``lax.scan``
-dispatch — exactness-preserving) chained through the pipelined decode
-(``decode_pipeline=7``: each window is dispatched from the previous
-window's device-resident carry before its tokens are read back), so the
-roundtrip is paid once per ~224 tokens and the chip never idles. Knobs:
-``BENCH_LOOKAHEAD`` / ``BENCH_PIPELINE`` / ``BENCH_BATCH``
-(``BENCH_LOOKAHEAD=1`` measures the unfused path).
+decodes with the engine's fused multi-step path (``decode_lookahead=32``:
+k forward+sample steps in one ``lax.scan`` dispatch) chained through the
+pipelined decode (``decode_pipeline=7``: each window is dispatched from
+the previous window's device-resident carry before its tokens are read
+back), so the roundtrip is paid once per ~224 tokens and the chip never
+idles. Knobs: ``BENCH_LOOKAHEAD`` / ``BENCH_PIPELINE`` / ``BENCH_BATCH``
+(``BENCH_LOOKAHEAD=1`` measures the unfused path) / ``BENCH_TEMP``
+(sampled decode; the fused path now covers temperature>0 too).
 
-``vs_baseline`` compares against a roofline-derived estimate of the
-reference's CUDA backend on 2xA100-80G (the repo publishes no numbers —
-BASELINE.json ``published: {}``): decode at batch 64 is HBM-bound; each
-stage streams ~7.6 GB of bf16 params per step => 2039 GB/s / 7.6 GB ~= 268
-steps/s theoretical, ~40% achieved for SGLang-class engines => ~107
-steps/s => 64 tokens / (2 chips * step) ~= 3400 theoretical, ~1360
-achieved tok/s/chip. We use 1360.
+The relay is known to wedge for long stretches (rounds 1 and 2 both lost
+their TPU number to a single 600 s probe), so the driver entry retries
+the reachability probe across the bench window (``BENCH_PROBE_ATTEMPTS``
+x ``BENCH_PROBE_S``, sleeping ``BENCH_PROBE_SLEEP_S`` between failures)
+and every child runs with a persistent JAX compilation cache under the
+repo (``.jax_cache``) so each graph's compile cost is paid once per
+round, not once per process.
+
+``BENCH_MODEL=dsa`` switches to the sparse-attention benchmark:
+DeepSeek-V3.2 attention geometry (MLA latent cache + lightning indexer,
+``index_topk=2048``) at ``BENCH_CTX`` context (default 8192), reduced to
+a 4-layer dense-FFN stage so one chip holds it. Its ``vs_baseline``
+compares achieved HBM bandwidth against the 40%-of-roofline efficiency
+the main number's baseline assumes (1.0 == SGLang-class efficiency).
+
+``vs_baseline`` (default mode) compares against a roofline-derived
+estimate of the reference's CUDA backend on 2xA100-80G (the repo
+publishes no numbers — BASELINE.json ``published: {}``): decode at batch
+64 is HBM-bound; each stage streams ~7.6 GB of bf16 params per step =>
+2039 GB/s / 7.6 GB ~= 268 steps/s theoretical, ~40% achieved for
+SGLang-class engines => ~107 steps/s => 64 tokens / (2 chips * step)
+~= 3400 theoretical, ~1360 achieved tok/s/chip. We use 1360.
 
 Prints ONE JSON line.
 """
@@ -55,13 +73,31 @@ BASELINE_TOKENS_PER_SEC_PER_CHIP = 1360.0
 # to the CPU smoke path so the driver always gets its JSON line.
 WATCHDOG_S = int(os.environ.get("BENCH_WATCHDOG_S", "2400"))
 
+# Per-probe timeout. A healthy chip answers in seconds; a wedged relay
+# hangs until the timeout.
+PROBE_S = int(os.environ.get("BENCH_PROBE_S", "300"))
+PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "10"))
+PROBE_SLEEP_S = int(os.environ.get("BENCH_PROBE_SLEEP_S", "60"))
 
-PROBE_S = int(os.environ.get("BENCH_PROBE_S", "600"))
+# Overall wall budget for the whole bench entry (probes + TPU attempt +
+# int8 attempt + CPU fallback). The driver can shrink/grow it.
+TOTAL_BUDGET_S = int(os.environ.get("BENCH_TOTAL_BUDGET_S", "9000"))
+# Always keep enough budget to produce SOME JSON line via CPU smoke.
+CPU_RESERVE_S = 420
+
+RETRY_LOG = "/tmp/tpu_retry.log"
 
 
-def _tpu_reachable() -> bool:
-    """Cheap child probe: a wedged relay hangs backend init for ~35 min
-    before failing; don't spend the full watchdog discovering that."""
+def _log_probe(msg: str) -> None:
+    sys.stderr.write(msg + "\n")
+    try:
+        with open(RETRY_LOG, "a", encoding="utf-8") as f:
+            f.write(f"{time.strftime('%H:%M:%S')} {msg}\n")
+    except OSError:
+        pass
+
+
+def _probe_once(timeout_s: float) -> bool:
     probe = (
         "import jax, jax.numpy as jnp;"
         "assert jax.default_backend() == 'tpu';"
@@ -72,58 +108,151 @@ def _tpu_reachable() -> bool:
     try:
         out = subprocess.run(
             [sys.executable, "-c", probe],
-            capture_output=True, text=True, timeout=PROBE_S,
+            capture_output=True, text=True, timeout=timeout_s,
         )
         if "TPU_OK" in out.stdout:
             return True
-        sys.stderr.write(f"TPU probe failed:\n{out.stderr[-2000:]}\n")
+        _log_probe(f"bench: probe attempt failed:\n{out.stderr[-1500:]}")
         return False
     except subprocess.TimeoutExpired:
-        sys.stderr.write(f"TPU probe timed out ({PROBE_S}s)\n")
+        _log_probe(f"bench: probe attempt timed out ({int(timeout_s)}s)")
         return False
+
+
+def _tpu_reachable(deadline: float) -> tuple[bool, int]:
+    """Probe the chip repeatedly across the bench window (the relay wedges
+    and un-wedges on its own schedule; one probe has lost the round's TPU
+    number twice). Returns (reachable, attempts_used)."""
+    for i in range(PROBE_ATTEMPTS):
+        left = deadline - time.time() - CPU_RESERVE_S
+        if left < 30:
+            return False, i
+        if _probe_once(min(PROBE_S, left)):
+            _log_probe(f"bench: probe attempt {i + 1} succeeded")
+            return True, i + 1
+        left = deadline - time.time() - CPU_RESERVE_S
+        if i + 1 < PROBE_ATTEMPTS and left > PROBE_SLEEP_S + 30:
+            time.sleep(PROBE_SLEEP_S)
+    return False, PROBE_ATTEMPTS
+
+
+def _run_child(env: dict, timeout_s: float) -> dict | str | None:
+    """Run one bench child; returns its JSON record, the raw JSON-looking
+    line if it would not parse (never lose the driver's line to a parse
+    hiccup), or None on failure."""
+    if timeout_s < 60:
+        return None
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"bench attempt timed out ({int(timeout_s)}s)\n")
+        return None
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    if out.returncode == 0 and lines:
+        try:
+            return json.loads(lines[-1])
+        except ValueError:
+            sys.stderr.write("bench child emitted unparseable JSON\n")
+            return lines[-1]
+    sys.stderr.write(out.stderr[-2000:] + "\n")
+    return None
 
 
 def main():
     if os.environ.get("BENCH_CHILD"):
         return _bench()
+    deadline = time.time() + TOTAL_BUDGET_S
+    try:
+        # Relay evidence must describe THIS invocation, not prior rounds
+        # that wrote the same log.
+        open(RETRY_LOG, "w", encoding="utf-8").close()
+    except OSError:
+        pass
+
+    # Persistent compilation cache: the fused decode window costs ~17 s to
+    # compile and quantized graphs much more; pay it once per round.
+    repo = os.path.dirname(os.path.abspath(__file__))
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+        repo, ".jax_cache"
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        cache_dir = ""
+
+    def child_env(**extra) -> dict:
+        env = dict(os.environ, BENCH_CHILD="1", **extra)
+        if cache_dir:
+            env["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+        return env
+
+    probes = 0
     if os.environ.get("BENCH_CPU"):
-        attempts = ["1"]
-    elif _tpu_reachable():
-        attempts = [None, "1"]
+        tpu_ok = False
     else:
-        sys.stderr.write("TPU unreachable; CPU smoke fallback\n")
-        attempts = ["1"]
-    for attempt_env in attempts:
-        env = dict(os.environ, BENCH_CHILD="1")
-        if attempt_env:
-            env["BENCH_CPU"] = attempt_env
-        try:
-            out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env, capture_output=True, text=True, timeout=WATCHDOG_S,
+        tpu_ok, probes = _tpu_reachable(deadline)
+        if not tpu_ok:
+            sys.stderr.write(
+                f"TPU unreachable after {probes} probes; CPU smoke fallback\n"
             )
-            lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
-            if out.returncode == 0 and lines:
-                try:
-                    result = json.loads(lines[-1])
-                    if attempt_env:  # CPU fallback: record the TPU story
-                        result.setdefault("detail", {})[
-                            "tpu_relay"
-                        ] = _relay_evidence()
-                    print(json.dumps(result))
-                except ValueError:
-                    # Never lose the driver's JSON line to a parse hiccup.
-                    print(lines[-1])
-                return
-            sys.stderr.write(out.stderr[-2000:] + "\n")
-        except subprocess.TimeoutExpired:
-            sys.stderr.write(f"bench attempt timed out ({WATCHDOG_S}s)\n")
-    print(json.dumps({
-        "metric": "output tokens/sec/chip", "value": 0.0,
-        "unit": "tokens/s/chip", "vs_baseline": 0.0,
-        "detail": {"error": "all bench attempts failed",
-                   "tpu_relay": _relay_evidence()},
-    }))
+
+    result = None
+    if tpu_ok:
+        left = deadline - time.time() - CPU_RESERVE_S
+        result = _run_child(child_env(), min(WATCHDOG_S, left))
+        if isinstance(result, str):
+            print(result)
+            return
+        if (
+            result is not None
+            and os.environ.get("BENCH_INT8", "1") != "0"
+            and not os.environ.get("BENCH_QUANT")
+            and not os.environ.get("BENCH_MODEL")
+        ):
+            # Quantized serving line (int8 weight-only): decode is
+            # bandwidth-bound, so halved weight bytes should beat bf16.
+            left = deadline - time.time() - CPU_RESERVE_S
+            int8 = (
+                _run_child(child_env(BENCH_QUANT="int8"),
+                           min(WATCHDOG_S, left))
+                if left > 600 else None
+            )
+            if isinstance(int8, str):
+                int8 = None
+            d = result.setdefault("detail", {})
+            if int8 is not None:
+                d["int8"] = {
+                    "value": int8.get("value"),
+                    **{
+                        k: int8.get("detail", {}).get(k)
+                        for k in ("decode_dispatch_ms_median", "params_gb",
+                                  "ttft_p50_ms")
+                    },
+                }
+            else:
+                d["int8"] = {"error": "int8 attempt failed or out of budget"}
+
+    if result is None:
+        result = _run_child(child_env(BENCH_CPU="1"),
+                            max(60, deadline - time.time()))
+        if isinstance(result, str):
+            print(result)
+            return
+        if result is not None:
+            result.setdefault("detail", {})["tpu_relay"] = _relay_evidence()
+
+    if result is None:
+        result = {
+            "metric": "output tokens/sec/chip", "value": 0.0,
+            "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "detail": {"error": "all bench attempts failed",
+                       "tpu_relay": _relay_evidence()},
+        }
+    result.setdefault("detail", {})["tpu_probe_attempts"] = probes
+    print(json.dumps(result))
 
 
 def _relay_evidence() -> dict:
@@ -134,15 +263,17 @@ def _relay_evidence() -> dict:
     import re
 
     ev = {"status": "unknown"}
-    log = "/tmp/tpu_retry.log"
     try:
-        with open(log, encoding="utf-8", errors="replace") as f:
+        with open(RETRY_LOG, encoding="utf-8", errors="replace") as f:
             text = f.read()
-        failed_attempts = len(re.findall(r"attempt \d+ failed", text))
+        failed_attempts = len(re.findall(
+            r"attempt( \d+)? (failed|timed out)", text
+        ))
         # Quote the actual last error line rather than assuming one.
         err_lines = [
             l.strip() for l in text.splitlines()
             if "UNAVAILABLE" in l or "Unable to initialize backend" in l
+            or "timed out" in l
         ]
         ev = {
             "status": "wedged" if failed_attempts and err_lines
@@ -150,10 +281,10 @@ def _relay_evidence() -> dict:
             "failed_retry_attempts_this_session": failed_attempts,
             "last_error": err_lines[-1][-300:] if err_lines else None,
             "note": (
-                "single-claim axon relay never recovered during the "
-                "session: repeated bench attempts hung at backend init "
-                "then failed with the error above"
-            ) if failed_attempts >= 2 and err_lines else None,
+                "axon relay never recovered during the session: repeated "
+                "probes across the bench window hung or failed with the "
+                "error above"
+            ) if failed_attempts >= 2 else None,
         }
     except OSError:
         pass
@@ -165,12 +296,20 @@ def _bench():
 
     if os.environ.get("BENCH_CPU"):
         jax.config.update("jax_platforms", "cpu")
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if cache_dir:
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception:  # older jax: env var alone still applies
+            pass
 
     import jax.numpy as jnp
     import numpy as np
 
-    from parallax_tpu.models.base import StageModel
+    from parallax_tpu.config import normalize_config
     from parallax_tpu.models.presets import get_preset
+    from parallax_tpu.models.registry import create_stage_model
     from parallax_tpu.runtime.engine import EngineConfig, StageEngine
     from parallax_tpu.runtime.pipeline import InProcessPipeline
     from parallax_tpu.runtime.request import Request, SamplingParams
@@ -178,8 +317,59 @@ def _bench():
 
     on_tpu = jax.default_backend() == "tpu"
     hw = detect_hardware()
+    mode = os.environ.get("BENCH_MODEL", "").lower()
+    temp = float(os.environ.get("BENCH_TEMP", "0"))
 
-    if on_tpu:
+    if mode == "dsa":
+        # Sparse-attention benchmark: DeepSeek-V3.2 attention geometry
+        # (index_topk=2048 over the MLA latent cache) with the FFN kept
+        # dense and the depth cut to 4 layers so one 16 GB chip holds
+        # params + caches. Decode cost per token is dominated by the
+        # indexer's full-context score pass + the top-k latent gather —
+        # exactly the per-layer work a 61-layer production stage repeats.
+        if on_tpu:
+            raw = dict(
+                architectures=["DeepseekV32ForCausalLM"], hidden_size=7168,
+                num_hidden_layers=4, num_attention_heads=128,
+                num_key_value_heads=128, kv_lora_rank=512, q_lora_rank=1536,
+                qk_nope_head_dim=128, qk_rope_head_dim=64, v_head_dim=128,
+                index_n_heads=64, index_head_dim=128, index_topk=2048,
+                intermediate_size=18432, first_k_dense_replace=4,
+                # MoE config is structurally required by the V32 model
+                # class but no layer < first_k_dense_replace uses it.
+                moe_intermediate_size=2048, n_routed_experts=8,
+                num_experts_per_tok=2, n_shared_experts=1, n_group=2,
+                topk_group=1, scoring_func="sigmoid",
+                vocab_size=129280, max_position_embeddings=163840,
+                rope_interleave=True, tie_word_embeddings=False,
+            )
+            cfg = normalize_config(raw, model_name="dsa-bench")
+            batch = int(os.environ.get("BENCH_BATCH", "32"))
+            prompt_len = int(os.environ.get("BENCH_CTX", "8192"))
+            dtype, kv_dtype, page_size = jnp.bfloat16, "bfloat16", 64
+            lookahead = int(os.environ.get("BENCH_LOOKAHEAD", "8"))
+            pipeline = int(os.environ.get("BENCH_PIPELINE", "2"))
+            gen_len = max(65, 1 + max(1, pipeline) * max(1, lookahead))
+        else:
+            raw = dict(
+                architectures=["DeepseekV32ForCausalLM"], hidden_size=64,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=4, kv_lora_rank=32, q_lora_rank=48,
+                qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+                index_n_heads=4, index_head_dim=32, index_topk=64,
+                intermediate_size=128, first_k_dense_replace=2,
+                moe_intermediate_size=32, n_routed_experts=4,
+                num_experts_per_tok=2, n_shared_experts=1, n_group=2,
+                topk_group=1, scoring_func="sigmoid",
+                vocab_size=512, max_position_embeddings=2048,
+                rope_interleave=True, tie_word_embeddings=False,
+            )
+            cfg = normalize_config(raw, model_name="dsa-bench")
+            batch, prompt_len, gen_len = 4, 128, 8
+            dtype, kv_dtype, page_size = jnp.float32, "float32", 16
+            lookahead = int(os.environ.get("BENCH_LOOKAHEAD", "1"))
+            pipeline = int(os.environ.get("BENCH_PIPELINE", "1"))
+    elif on_tpu:
         full = get_preset("qwen2.5-7b")
         # One chip's workload of 2-stage PP: half the layers (+ both ends).
         cfg = dataclasses.replace(
@@ -212,7 +402,7 @@ def _bench():
         lookahead = int(os.environ.get("BENCH_LOOKAHEAD", "1"))
         pipeline = int(os.environ.get("BENCH_PIPELINE", "1"))
 
-    model = StageModel(cfg, 0, cfg.num_hidden_layers)
+    model = create_stage_model(cfg, 0, cfg.num_hidden_layers)
     params = model.init_params(jax.random.key(0), dtype=dtype)
     quant = os.environ.get("BENCH_QUANT", "")   # "int8" / "int4" opt-in
     if quant:
@@ -220,6 +410,9 @@ def _bench():
 
         params = quantize_tree(params, bits=int(quant.removeprefix("int")))
     params = jax.tree.map(lambda x: x.block_until_ready(), params)
+    params_bytes = sum(
+        x.nbytes for x in jax.tree.leaves(params) if hasattr(x, "nbytes")
+    )
 
     max_model_len = prompt_len + gen_len + page_size
     pages_needed = ((max_model_len + page_size - 1) // page_size + 1) * batch
@@ -248,8 +441,8 @@ def _bench():
             page_size=page_size,
             num_pages=num_pages,
             max_batch_size=batch,
-            max_num_tokens_per_batch=2048,
-            prefill_chunk_size=1024,
+            max_num_tokens_per_batch=max(2048, prompt_len),
+            prefill_chunk_size=max(1024, min(prompt_len, 8192)),
             max_model_len=max_model_len,
             kv_dtype=kv_dtype,
             enable_prefix_cache=False,   # measure raw compute, not cache hits
@@ -263,29 +456,39 @@ def _bench():
     def run_round(tag: str, n_gen: int):
         """Submit a full batch and run it to completion.
 
-        Returns (decode_tokens, decode_wall_s, dispatch_times). Phase
-        detection is by scheduler state, not token counts (with lookahead
-        a decode dispatch commits k*batch tokens, which a size heuristic
-        would misread as prefill): decode starts once every request is
-        admitted and has sampled its first token.
+        Returns (decode_tokens, decode_wall_s, dispatch_times, phase_ok,
+        ttft_ms). Phase detection is by scheduler state, not token counts
+        (with lookahead a decode dispatch commits k*batch tokens, which a
+        size heuristic would misread as prefill): decode starts once every
+        request is admitted and has sampled its first token. TTFT per
+        request = first sampled token's wall time minus the round start
+        (all requests submitted up front).
         """
+        submitted: list[Request] = []
         for i in range(batch):
             prompt = rng.integers(1, cfg.vocab_size - 1, size=prompt_len)
-            pipe.submit(Request(
+            req = Request(
                 request_id=f"{tag}{i}",
                 prompt_ids=[int(x) for x in prompt],
                 sampling_params=SamplingParams(
-                    temperature=0.0, max_new_tokens=n_gen, ignore_eos=True,
+                    temperature=temp, max_new_tokens=n_gen, ignore_eos=True,
                 ),
-            ))
+            )
+            submitted.append(req)
+            pipe.submit(req)
         dispatch_times: list[float] = []
+        ttft_ms: dict[str, float] = {}
         total_tokens = 0
         decode_t0 = None
         tokens_at_decode_start = 0
         t_start = time.perf_counter()
         while engine.has_work():
             out = engine.step()
+            now = time.perf_counter()
             total_tokens += out.num_tokens
+            for req in submitted:
+                if req.request_id not in ttft_ms and req.output_ids:
+                    ttft_ms[req.request_id] = (now - t_start) * 1000.0
             if decode_t0 is not None and out.num_tokens:
                 dispatch_times.append(out.step_time_ms)
             elif decode_t0 is None:
@@ -303,6 +506,7 @@ def _bench():
             decode_wall_s,
             dispatch_times,
             decode_t0 is not None,
+            sorted(ttft_ms.values()),
         )
 
     # Warmup round: populates every jit cache the measured round will hit
@@ -310,8 +514,8 @@ def _bench():
     # the measured decode phase contains zero compiles.
     t_start = time.perf_counter()
     run_round("warm", lookahead + 1)
-    decode_tokens, decode_wall_s, dispatch_times, phase_ok = run_round(
-        "bench", gen_len
+    decode_tokens, decode_wall_s, dispatch_times, phase_ok, ttfts = (
+        run_round("bench", gen_len)
     )
     total_s = time.perf_counter() - t_start
 
@@ -320,30 +524,66 @@ def _bench():
     # pipeline emits one batch per *stage* step and we measured one
     # stage's workload, so per-chip rate is half the measured rate.
     step_ms = statistics.median(dispatch_times) if dispatch_times else 0.0
-    tokens_per_sec_per_chip = decode_tokens / max(decode_wall_s, 1e-9) / 2.0
+    pp_div = 1.0 if mode == "dsa" else 2.0
+    tokens_per_sec_per_chip = decode_tokens / max(decode_wall_s, 1e-9) / pp_div
     if not phase_ok:
         # Never report prefill tokens as decode throughput.
         tokens_per_sec_per_chip = 0.0
+    ttft_p50 = statistics.median(ttfts) if ttfts else 0.0
 
-    result = {
-        "metric": (
+    if mode == "dsa":
+        # vs_baseline for the sparse bench: achieved HBM bandwidth over
+        # the 40%-of-roofline efficiency the main baseline assumes.
+        # Decode-step bytes ~= params + per-layer sparse traffic: the
+        # indexer's full-context score pass reads the paged index keys
+        # [ctx, idx_dim] and the sparse attention gathers [topk,
+        # latent+rope] per request per layer (bf16 = 2 B).
+        elem = 2 if on_tpu else 4
+        d = cfg.dsa
+        sparse_bytes = (
+            batch * cfg.num_hidden_layers * (
+                prompt_len * (d.index_head_dim if d else 0) * elem
+                + (d.index_topk if d else 0)
+                * (cfg.mla.kv_lora_rank + cfg.mla.qk_rope_head_dim) * elem
+            )
+        )
+        step_bytes = params_bytes + sparse_bytes
+        bw = hw.hbm_gbps * 1e9 if on_tpu else 50e9
+        roofline_steps = bw / max(step_bytes, 1)
+        roofline_tps = roofline_steps * batch
+        vs_baseline = tokens_per_sec_per_chip / max(0.4 * roofline_tps, 1e-9)
+        metric = (
+            f"output tokens/sec/chip (DSA sparse decode, V3.2 geometry, "
+            f"ctx={prompt_len}, topk={d.index_topk if d else 0})"
+        )
+    else:
+        vs_baseline = (
+            tokens_per_sec_per_chip / BASELINE_TOKENS_PER_SEC_PER_CHIP
+        )
+        metric = (
             "output tokens/sec/chip (Qwen2.5-7B, 2-stage PP accounting)"
             if on_tpu
             else "output tokens/sec/chip (CPU smoke, tiny model)"
-        ),
+        )
+
+    result = {
+        "metric": metric,
         "value": round(tokens_per_sec_per_chip, 1),
         "unit": "tokens/s/chip",
-        "vs_baseline": round(
-            tokens_per_sec_per_chip / BASELINE_TOKENS_PER_SEC_PER_CHIP, 3
-        ),
+        "vs_baseline": round(vs_baseline, 3),
         "detail": {
             "device": hw.device_kind,
             "stage_layers": cfg.num_hidden_layers,
             "batch": batch,
+            "prompt_len": prompt_len,
+            "temperature": temp,
             "decode_lookahead": lookahead,
             "decode_pipeline": pipeline,
             "decode_phase_detected": phase_ok,
             **({"quantization": quant} if quant else {}),
+            **({"bench_model": mode} if mode else {}),
+            "params_gb": round(params_bytes / 1e9, 2),
+            "ttft_p50_ms": round(ttft_p50, 1),
             "decode_dispatch_ms_median": round(step_ms, 2),
             "decode_dispatches": len(dispatch_times),
             "decode_tokens": decode_tokens,

@@ -25,6 +25,21 @@ def test_bench_cpu_smoke_prints_one_json_line():
     assert rec["value"] > 0
 
 
+def test_bench_dsa_mode_cpu_smoke():
+    env = dict(os.environ, BENCH_CPU="1", BENCH_MODEL="dsa")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr[-1500:]
+    json_lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    assert len(json_lines) == 1, out.stdout
+    rec = json.loads(json_lines[0])
+    assert rec["value"] > 0
+    assert rec["detail"]["bench_model"] == "dsa"
+    assert "ttft_p50_ms" in rec["detail"]
+
+
 def test_graft_entry_lowers():
     import jax
 
