@@ -51,10 +51,13 @@ class EngineConfig:
     # ONE step with ring attention over the engine's sp mesh (requires
     # ``sp_mesh`` at engine construction). None = off.
     sp_threshold: int | None = None
-    # Multi-step decode: a single-stage all-greedy decode batch runs this
-    # many tokens per dispatch with sampling fused into the jit (lax.scan
-    # over forward+argmax) — the SURVEY's "k tokens per dispatch" lever
-    # against per-token host dispatch latency. 1 = off.
+    # Multi-step decode: a single-stage decode batch runs this many tokens
+    # per dispatch with sampling fused into the jit (lax.scan over
+    # forward+sample) — the SURVEY's "k tokens per dispatch" lever against
+    # per-token host dispatch latency. Covers greedy AND sampled rows
+    # (temperature/top-k/top-p/min-p, seeded or not); rows needing
+    # per-step host state (penalties, logprobs, grammar, logit_bias)
+    # fall back to single-step. 1 = off.
     decode_lookahead: int = 1
     # Pipelined multi-step decode: chain this many k-token windows per
     # host round. Window j+1 is dispatched from window j's device-resident
@@ -281,6 +284,7 @@ class StageEngine:
         )
         self._base_key = jax.random.key(self.cfg.seed)
         self._jit_multistep = None
+        self._jit_multistep_sampled = None
         self._step_count = 0
         # Non-head stages: hidden rows waiting per request id.
         self._pending_hidden: dict[str, np.ndarray] = {}
@@ -439,46 +443,89 @@ class StageEngine:
 
     # -- multi-step decode (k tokens per dispatch) ------------------------
 
-    def _build_multistep(self):
-        """Jit a k-step greedy decode loop: forward -> argmax -> feed back,
+    def _build_multistep(self, sampled: bool):
+        """Jit a k-step decode loop: forward -> sample -> feed back,
         entirely on device. The page table is fixed across the window (the
         host pre-ensures capacity), so each step only advances positions,
-        slot mapping and kv_lens."""
+        slot mapping and kv_lens.
+
+        ``sampled=False`` compiles the pure-argmax variant (no sort, no
+        PRNG). ``sampled=True`` fuses the full filtered categorical
+        sampler into the scan body: per-row temperature/top-k/top-p/min-p
+        arrays ride in a side pytree, and randomness follows the same
+        per-row key discipline as the per-step path — seeded rows draw
+        from ``fold_in(key(seed), output_step)``, so a seeded stream is
+        reproducible regardless of batch composition, and matches the
+        per-step path wherever the two compiled programs produce the
+        same logits (bitwise on CPU; on TPU a near-tied categorical can
+        flip on ulp-level fusion differences). Unseeded rows draw from
+        the window key folded with the scan step and row index.
+        """
         import dataclasses as _dc
 
         model = self.model
         k = self.cfg.decode_lookahead
         page_size = self.cfg.page_size
 
-        def fn(params, kv, inputs: BatchInputs):
-            def body(carry, _):
+        def step_inputs_at(inputs, token_ids, ctx):
+            pos = ctx - 1                           # fed token's slot
+            page_of = jnp.maximum(pos, 0) // page_size
+            phys = jnp.take_along_axis(
+                inputs.page_indices, page_of[:, None], axis=1
+            )[:, 0]
+            slots = jnp.where(
+                ctx > 0, phys * page_size + jnp.maximum(pos, 0) % page_size,
+                jnp.int32(-1),
+            )
+            return _dc.replace(
+                inputs,
+                token_ids=token_ids,
+                positions=pos,
+                kv_lens=ctx,
+                slot_mapping=slots,
+            )
+
+        if not sampled:
+            def fn(params, kv, inputs: BatchInputs):
+                def body(carry, _):
+                    kv, token_ids, ctx = carry
+                    logits, kv = model(
+                        params, kv, step_inputs_at(inputs, token_ids, ctx)
+                    )
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    return (kv, nxt, ctx + 1), nxt
+
+                (kv, feed, ctx), tokens = jax.lax.scan(
+                    body, (kv, inputs.token_ids, inputs.kv_lens), None,
+                    length=k,
+                )
+                # tokens: [k, S]; (feed, ctx) is the device-resident carry
+                # the NEXT window starts from — returning it lets the host
+                # chain windows without reading tokens back in between.
+                return tokens, kv, feed, ctx
+
+            return jax.jit(fn, donate_argnums=(1,))
+
+        def fn(params, kv, inputs: BatchInputs, samp: dict):
+            def body(carry, step_i):
                 kv, token_ids, ctx = carry
-                pos = ctx - 1                           # fed token's slot
-                page_of = jnp.maximum(pos, 0) // page_size
-                phys = jnp.take_along_axis(
-                    inputs.page_indices, page_of[:, None], axis=1
-                )[:, 0]
-                slots = jnp.where(
-                    ctx > 0, phys * page_size + jnp.maximum(pos, 0) % page_size,
-                    jnp.int32(-1),
+                logits, kv = model(
+                    params, kv, step_inputs_at(inputs, token_ids, ctx)
                 )
-                step_inputs = _dc.replace(
-                    inputs,
-                    token_ids=token_ids,
-                    positions=pos,
-                    kv_lens=ctx,
-                    slot_mapping=slots,
+                nxt = sample_tokens(
+                    logits,
+                    jax.random.fold_in(samp["key"], step_i),
+                    samp["temp"], samp["top_k"], samp["top_p"],
+                    samp["min_p"],
+                    seeds=samp["seeds"],
+                    out_steps=samp["steps"] + step_i,
                 )
-                logits, kv = model(params, kv, step_inputs)
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 return (kv, nxt, ctx + 1), nxt
 
             (kv, feed, ctx), tokens = jax.lax.scan(
-                body, (kv, inputs.token_ids, inputs.kv_lens), None, length=k
+                body, (kv, inputs.token_ids, inputs.kv_lens),
+                jnp.arange(k, dtype=jnp.int32),
             )
-            # tokens: [k, S]; (feed, ctx) is the device-resident carry the
-            # NEXT window starts from — returning it lets the host chain
-            # windows without reading tokens back in between.
             return tokens, kv, feed, ctx
 
         return jax.jit(fn, donate_argnums=(1,))
@@ -487,17 +534,25 @@ class StageEngine:
         """Run a k-step decode window if the batch qualifies; commits
         tokens and returns the commit count, or None for the normal path.
 
-        Qualification: single-stage engine (the ring is local), pure
-        all-greedy decode (no penalties/seeds — those need per-step host
-        state), and capacity for k more tokens per request. Requests may
-        finish mid-window (EOS/max_tokens); their surplus tokens are
-        discarded — the KV written past the finish point lies beyond the
-        committed context, so prefix-cache donation (keyed by computed
-        tokens) never exposes it.
+        Qualification: single-stage engine (the ring is local), decode
+        rows with no per-step host state (penalties, logprobs, grammar,
+        logit_bias fall back), and capacity for k more tokens per
+        request. Greedy AND sampled rows qualify — an all-greedy batch
+        compiles the cheap argmax variant, a mixed/sampled batch the
+        fused-sampler variant. Requests may finish mid-window
+        (EOS/max_tokens); their surplus tokens are discarded — the KV
+        written past the finish point lies beyond the committed context,
+        so prefix-cache donation (keyed by computed tokens) never
+        exposes it.
         """
         k = self.cfg.decode_lookahead
-        if k <= 1 or not self._greedy_fast_path_ok(plan):
+        if k <= 1 or not self._fused_common_ok(plan):
             return None
+        sampled = any(
+            seg.request.sampling_params.temperature > 0.0
+            or seg.request.sampling_params.seed is not None
+            for seg in plan.seqs
+        )
         for seg in plan.seqs:
             # Near the context limit the window would overrun max_model_len
             # (and the per-seq page table): fall back to single-step.
@@ -550,21 +605,45 @@ class StageEngine:
         inputs = assemble(
             plan, self.spec, self.cfg.page_size, decode_only=True
         )
-        if self._jit_multistep is None:
-            self._jit_multistep = self._build_multistep()
+        samp = None
+        if sampled:
+            s = int(inputs.kv_lens.shape[0])
+            temp, top_k, top_p, min_p, seeds, steps, _ = (
+                self._pack_base_sampling(plan, s)
+            )
+            samp = dict(
+                temp=jnp.asarray(temp), top_k=jnp.asarray(top_k),
+                top_p=jnp.asarray(top_p), min_p=jnp.asarray(min_p),
+                seeds=jnp.asarray(seeds),
+            )
+        if sampled and self._jit_multistep_sampled is None:
+            self._jit_multistep_sampled = self._build_multistep(True)
+        if not sampled and self._jit_multistep is None:
+            self._jit_multistep = self._build_multistep(False)
         # Dispatch all m windows back-to-back: window j+1 consumes window
         # j's on-device carry, so no host sync happens inside the chain
         # (jax async dispatch keeps the device busy while earlier windows'
         # tokens stream back below).
         windows = []
         feed, ctx = inputs.token_ids, inputs.kv_lens
-        for _ in range(m):
+        window_key = jax.random.fold_in(self._base_key, self._step_count)
+        for w in range(m):
             step_inputs = dataclasses.replace(
                 inputs, token_ids=feed, kv_lens=ctx
             )
-            tokens, self.kv, feed, ctx = self._jit_multistep(
-                self.params, self.kv, step_inputs
-            )
+            if sampled:
+                samp_w = dict(
+                    samp,
+                    key=jax.random.fold_in(window_key, w),
+                    steps=jnp.asarray(steps + w * k),
+                )
+                tokens, self.kv, feed, ctx = self._jit_multistep_sampled(
+                    self.params, self.kv, step_inputs, samp_w
+                )
+            else:
+                tokens, self.kv, feed, ctx = self._jit_multistep(
+                    self.params, self.kv, step_inputs
+                )
             windows.append(tokens)
         self._last_fused_steps = m * k
 
@@ -593,10 +672,10 @@ class StageEngine:
 
     # -- speculative decoding (prompt-lookup) -----------------------------
 
-    def _greedy_fast_path_ok(self, plan: BatchPlan) -> bool:
-        """Shared disqualifier for the fused greedy paths (multistep,
-        speculative): single-stage engine, pure greedy decode, nothing
-        needing per-step host state (penalties/seeds/logprobs)."""
+    def _fused_common_ok(self, plan: BatchPlan) -> bool:
+        """Shared disqualifier for the fused decode paths (multistep,
+        speculative): single-stage engine, decode-only rows, nothing
+        needing per-step host state (penalties/logprobs/grammar/bias)."""
         if (
             not (self.model.is_first and self.model.is_last)
             or self._needs_state
@@ -607,8 +686,6 @@ class StageEngine:
             sp = seg.request.sampling_params
             if (
                 seg.num_new_tokens != 1
-                or sp.temperature > 0.0
-                or sp.seed is not None
                 or sp.presence_penalty
                 or sp.frequency_penalty
                 or sp.repetition_penalty != 1.0
@@ -616,6 +693,18 @@ class StageEngine:
                 or sp.json_schema       # grammar mask needs per-step host state
                 or sp.logit_bias        # bias applied at the sampler
             ):
+                return False
+        return True
+
+    def _greedy_fast_path_ok(self, plan: BatchPlan) -> bool:
+        """The speculative paths additionally require pure greedy decode
+        (acceptance compares argmaxes; a sampled row has no single right
+        answer to verify against)."""
+        if not self._fused_common_ok(plan):
+            return False
+        for seg in plan.seqs:
+            sp = seg.request.sampling_params
+            if sp.temperature > 0.0 or sp.seed is not None:
                 return False
         return True
 
@@ -857,6 +946,32 @@ class StageEngine:
             self._pending_hidden.pop(rid)
         return take
 
+    def _pack_base_sampling(self, plan: BatchPlan, s: int):
+        """Per-row base sampling vectors shared by the fused decode window
+        and the per-step sampler. ONE packing convention (incl. the seed
+        mask and the seeded-row output-step origin) — the two paths must
+        never desynchronize or the cross-path seeded-exactness guarantee
+        breaks. Returns (temp, top_k, top_p, min_p, seeds, steps,
+        any_seed); ``steps`` is meaningful only for seeded rows."""
+        temp = np.zeros((s,), np.float32)
+        top_k = np.zeros((s,), np.int32)
+        top_p = np.ones((s,), np.float32)
+        min_p = np.zeros((s,), np.float32)
+        seeds = np.full((s,), -1, np.int32)
+        steps = np.zeros((s,), np.int32)
+        any_seed = False
+        for i, seg in enumerate(plan.seqs):
+            sp = seg.request.sampling_params
+            temp[i] = sp.temperature
+            top_k[i] = sp.top_k
+            top_p[i] = sp.top_p
+            min_p[i] = sp.min_p
+            if sp.seed is not None:
+                any_seed = True
+                seeds[i] = sp.seed & 0x7FFFFFFF
+                steps[i] = len(self._generated_ids(seg.request))
+        return temp, top_k, top_p, min_p, seeds, steps, any_seed
+
     @staticmethod
     def _generated_ids(req: Request) -> list[int]:
         """Tokens this request has generated so far, as visible to THIS
@@ -868,23 +983,16 @@ class StageEngine:
 
     def _sample(self, logits: jax.Array, inputs: BatchInputs, plan: BatchPlan):
         s = int(inputs.kv_lens.shape[0])
-        temp = np.zeros((s,), np.float32)
-        top_k = np.zeros((s,), np.int32)
-        top_p = np.ones((s,), np.float32)
-        min_p = np.zeros((s,), np.float32)
+        temp, top_k, top_p, min_p, seeds, steps, any_seed = (
+            self._pack_base_sampling(plan, s)
+        )
         pres = np.zeros((s,), np.float32)
         freq = np.zeros((s,), np.float32)
         rep = np.ones((s,), np.float32)
-        seeds = np.full((s,), -1, np.int32)
-        steps = np.zeros((s,), np.int32)
-        any_pen = any_seed = False
+        any_pen = False
         gen_lists: list[list[int]] = []
         for i, seg in enumerate(plan.seqs):
             sp = seg.request.sampling_params
-            temp[i] = sp.temperature
-            top_k[i] = sp.top_k
-            top_p[i] = sp.top_p
-            min_p[i] = sp.min_p
             gen = self._generated_ids(seg.request)
             gen_lists.append(gen)
             if sp.presence_penalty or sp.frequency_penalty or (
@@ -894,10 +1002,6 @@ class StageEngine:
                 pres[i] = sp.presence_penalty
                 freq[i] = sp.frequency_penalty
                 rep[i] = sp.repetition_penalty
-            if sp.seed is not None:
-                any_seed = True
-                seeds[i] = sp.seed & 0x7FFFFFFF
-                steps[i] = len(gen)
         if any_pen:
             # Pad generated-id lists onto a power-of-2 lattice (bounded
             # recompiles) and scatter the counts on device.

@@ -95,7 +95,65 @@ def test_multistep_prefix_cache_donation_consistent():
     assert len(follow.output_ids) == 4
 
 
-def test_multistep_falls_back_for_sampled_requests():
+def _run_sampled(lookahead, specs, max_new=9, pipeline=1):
+    """specs: list of (prompt, temperature, seed)."""
+    model = StageModel(CFG, 0, 2, use_pallas=False)
+    p = model.init_params(jax.random.key(0), dtype=jnp.float32)
+    eng = StageEngine(model, p, EngineConfig(
+        page_size=8, num_pages=128, max_model_len=256,
+        kv_dtype="float32", decode_lookahead=lookahead,
+        decode_pipeline=pipeline,
+    ))
+    pipe = InProcessPipeline([eng])
+    reqs = []
+    for i, (prompt, temp, seed) in enumerate(specs):
+        req = Request(
+            f"r{i}", prompt_ids=list(prompt),
+            sampling_params=SamplingParams(
+                temperature=temp, max_new_tokens=max_new, seed=seed,
+                ignore_eos=True,
+            ),
+        )
+        reqs.append(req)
+        pipe.submit(req)
+    pipe.run_until_complete()
+    return reqs, eng
+
+
+def test_multistep_sampled_seeded_matches_single_step_exactly():
+    """Seeded sampled rows draw from fold_in(key(seed), output_step) on
+    BOTH paths, so the fused window must reproduce per-step sampling
+    token-for-token (VERDICT r2 #2)."""
+    specs = [([3, 14, 15, 92], 0.9, 7), ([7, 21, 108], 1.3, 11)]
+    base, beng = _run_sampled(1, specs)
+    multi, meng = _run_sampled(4, specs)
+    assert meng._jit_multistep_sampled is not None  # fused path ran
+    assert beng._jit_multistep_sampled is None
+    for b, m in zip(base, multi):
+        assert m.output_ids == b.output_ids, (b.output_ids, m.output_ids)
+
+
+def test_multistep_sampled_mixed_greedy_rows_stay_greedy():
+    """A mixed batch (greedy + sampled rows) takes the fused-sampler
+    variant; the greedy rows' outputs must equal the pure-greedy run."""
+    specs = [([5, 6, 7, 8], 0.0, None), ([9, 10, 11], 1.0, 3)]
+    mixed, meng = _run_sampled(4, specs)
+    assert meng._jit_multistep_sampled is not None
+    greedy_only, _ = _run_sampled(1, [([5, 6, 7, 8], 0.0, None)])
+    assert mixed[0].output_ids == greedy_only[0].output_ids
+    # seeded row reproducible vs its single-step stream too
+    seeded_only, _ = _run_sampled(1, [([9, 10, 11], 1.0, 3)])
+    assert mixed[1].output_ids == seeded_only[0].output_ids
+
+
+def test_multistep_sampled_pipelined_windows_match():
+    specs = [([42, 43, 44, 45], 1.1, 123)]
+    base, _ = _run_sampled(1, specs, max_new=13)
+    multi, _ = _run_sampled(3, specs, max_new=13, pipeline=3)
+    assert multi[0].output_ids == base[0].output_ids
+
+
+def test_multistep_falls_back_for_penalized_requests():
     model = StageModel(CFG, 0, 2, use_pallas=False)
     p = model.init_params(jax.random.key(0), dtype=jnp.float32)
     eng = StageEngine(model, p, EngineConfig(
@@ -104,12 +162,15 @@ def test_multistep_falls_back_for_sampled_requests():
     ))
     pipe = InProcessPipeline([eng])
     req = Request("s", prompt_ids=[1, 2, 3],
-                  sampling_params=SamplingParams(temperature=1.0,
-                                                 max_new_tokens=5, seed=3))
+                  sampling_params=SamplingParams(
+                      temperature=1.0, max_new_tokens=5, seed=3,
+                      repetition_penalty=1.3))
     pipe.submit(req)
     pipe.run_until_complete()
     assert len(req.output_ids) == 5
-    assert eng._jit_multistep is None  # sampled batch never took the path
+    # penalties need per-step host state: neither fused variant may run
+    assert eng._jit_multistep is None
+    assert eng._jit_multistep_sampled is None
 
 
 def test_multistep_mixed_arrivals():
