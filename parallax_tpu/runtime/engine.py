@@ -504,7 +504,8 @@ class StageEngine:
                 # chain windows without reading tokens back in between.
                 return tokens, kv, feed, ctx
 
-            return jax.jit(fn, donate_argnums=(1,))
+            return jax.jit(self._tp_wrap_multistep(fn, 0),
+                           donate_argnums=(1,))
 
         def fn(params, kv, inputs: BatchInputs, samp: dict):
             def body(carry, step_i):
@@ -528,7 +529,36 @@ class StageEngine:
             )
             return tokens, kv, feed, ctx
 
-        return jax.jit(fn, donate_argnums=(1,))
+        return jax.jit(self._tp_wrap_multistep(fn, 1), donate_argnums=(1,))
+
+    def _tp_wrap_multistep(self, fn, n_extra: int):
+        """SPMD-wrap a multistep fn for a TP-sharded stage: the whole
+        k-step scan runs inside ONE shard_map over the tp axis (params and
+        KV pages stay in their shard layout; the per-layer psums and the
+        vocab-sharded lm_head all_gather happen inside the body exactly as
+        in the per-step TP path), and the sampled tokens — identical on
+        every shard after the gather — come back replicated. ``n_extra``
+        counts trailing replicated args (the sampled variant's side
+        pytree). No-op for unsharded engines."""
+        if self.mesh is None or self.model.tp_size <= 1:
+            return fn
+        from jax.sharding import PartitionSpec as P
+
+        from parallax_tpu.parallel import tp as _tp
+
+        param_specs = _tp.stage_param_specs(
+            self.params, tp=self.mesh.shape["tp"],
+            col_vecs=getattr(self.model, "tp_column_vector_params",
+                             frozenset()),
+        )
+        kv_specs = _tp.kv_partition_specs(self.model)
+        return jax.shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=(param_specs, kv_specs, P(), *([P()] * n_extra)),
+            out_specs=(P(), kv_specs, P(), P()),
+            check_vma=False,
+        )
 
     def _try_multistep(self, plan: BatchPlan) -> int | None:
         """Run a k-step decode window if the batch qualifies; commits
@@ -626,7 +656,8 @@ class StageEngine:
         # tokens stream back below).
         windows = []
         feed, ctx = inputs.token_ids, inputs.kv_lens
-        window_key = jax.random.fold_in(self._base_key, self._step_count)
+        if sampled:
+            window_key = jax.random.fold_in(self._base_key, self._step_count)
         for w in range(m):
             step_inputs = dataclasses.replace(
                 inputs, token_ids=feed, kv_lens=ctx
@@ -679,7 +710,6 @@ class StageEngine:
         if (
             not (self.model.is_first and self.model.is_last)
             or self._needs_state
-            or self.mesh is not None
         ):
             return False
         for seg in plan.seqs:

@@ -62,6 +62,86 @@ def test_tp_matches_single_device(tp_size):
     assert got == expected
 
 
+def run_engine_fused(tp_size, specs, n_new=10, lookahead=1, pipeline=1):
+    """specs: (prompt, temperature, seed). Returns (outputs, engine)."""
+    config = normalize_config(TINY)
+    mesh = make_mesh(tp_size=tp_size) if tp_size > 1 else None
+    model = StageModel(config, 0, 2, use_pallas=False, tp_size=tp_size)
+    params = model.init_params(jax.random.key(7), dtype=jnp.float32)
+    eng = StageEngine(
+        model, params,
+        EngineConfig(page_size=8, num_pages=64, max_model_len=128,
+                     kv_dtype="float32", max_num_tokens_per_batch=128,
+                     decode_lookahead=lookahead, decode_pipeline=pipeline),
+        mesh=mesh,
+    )
+    pipe = InProcessPipeline([eng])
+    for i, (p, temp, seed) in enumerate(specs):
+        pipe.submit(Request(
+            request_id=f"r{i}", prompt_ids=list(p),
+            sampling_params=SamplingParams(
+                temperature=temp, max_new_tokens=n_new, seed=seed,
+                ignore_eos=True),
+        ))
+    pipe.run_until_complete()
+    return {r.request_id: r.output_ids for r in pipe.finished}, eng
+
+
+def test_tp_fused_multistep_matches_single_step():
+    """VERDICT r2 #3: the k-token decode window must cover TP-sharded
+    stages — the whole scan runs inside one shard_map dispatch."""
+    if len(jax.devices()) < 2:
+        pytest.skip("not enough virtual devices")
+    specs = [([1, 2, 3, 4, 5], 0.0, None), ([100, 90, 80], 0.0, None)]
+    base, _ = run_engine_fused(2, specs, lookahead=1)
+    fused, eng = run_engine_fused(2, specs, lookahead=4, pipeline=2)
+    assert eng._jit_multistep is not None   # fused path ran under TP
+    assert fused == base
+
+
+def test_tp_fused_sampled_seeded_matches_single_step():
+    if len(jax.devices()) < 2:
+        pytest.skip("not enough virtual devices")
+    specs = [([5, 6, 7], 0.9, 17), ([8, 9, 10, 11], 0.0, None)]
+    base, _ = run_engine_fused(2, specs, lookahead=1)
+    fused, eng = run_engine_fused(2, specs, lookahead=3)
+    assert eng._jit_multistep_sampled is not None
+    assert fused == base
+
+
+def test_tp_speculative_matches_plain_greedy():
+    """Prompt-lookup speculation is TP-eligible now the mesh bar is
+    lifted; verification logits come from the same shard_mapped stage fn
+    so acceptance must reproduce plain greedy exactly."""
+    if len(jax.devices()) < 2:
+        pytest.skip("not enough virtual devices")
+    config = normalize_config(TINY)
+    rep = [7, 8, 9, 10] * 5    # repetitive: n-gram proposals fire
+
+    def run(spec_tokens):
+        mesh = make_mesh(tp_size=2)
+        model = StageModel(config, 0, 2, use_pallas=False, tp_size=2)
+        params = model.init_params(jax.random.key(7), dtype=jnp.float32)
+        eng = StageEngine(
+            model, params,
+            EngineConfig(page_size=8, num_pages=64, max_model_len=128,
+                         kv_dtype="float32", max_num_tokens_per_batch=128,
+                         speculative_tokens=spec_tokens),
+            mesh=mesh,
+        )
+        pipe = InProcessPipeline([eng])
+        pipe.submit(Request(
+            "r", prompt_ids=list(rep),
+            sampling_params=SamplingParams(temperature=0.0,
+                                           max_new_tokens=12,
+                                           ignore_eos=True),
+        ))
+        pipe.run_until_complete()
+        return pipe.finished[0].output_ids
+
+    assert run(4) == run(0)
+
+
 def test_tp_requires_divisible_heads():
     config = normalize_config(dict(TINY, num_key_value_heads=3))
     with pytest.raises(ValueError, match="not divisible"):
