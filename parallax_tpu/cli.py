@@ -12,7 +12,9 @@ Capability parity target: reference ``src/parallax/cli.py:26-473``
 from __future__ import annotations
 
 import argparse
+import os
 import sys
+import threading
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -116,9 +118,15 @@ def main(argv: list[str] | None = None) -> int:
         from parallax_tpu.utils.version_check import check_latest_release
 
         print_banner()
-        hint = check_latest_release()
-        if hint:
-            print(hint)
+        # Purely informational network probe: never let it delay boot
+        # (air-gapped deployments), and allow opting out entirely.
+        if not os.environ.get("PARALLAX_TPU_NO_VERSION_CHECK"):
+            def _version_hint():
+                hint = check_latest_release()
+                if hint:
+                    print(hint)
+
+            threading.Thread(target=_version_hint, daemon=True).start()
         return serve_main(args)
     if args.command == "run":
         from parallax_tpu.backend.run import run_main

@@ -276,6 +276,23 @@ def params_from_torch_state_dict(
     return model.finalize_params(tree)
 
 
+def _apply_dora_magnitude(module: str, v: "np.ndarray", ab: dict):
+    """DoRA closing step: renormalize the updated weight's rows to the
+    learned magnitudes. ``v = W + scale * B @ A`` ([out, in]); plain LoRA
+    modules (no magnitude) pass through unchanged. Reference semantics:
+    ``shard_loader.py:188-225`` (load_lora DoRA branch)."""
+    if "M" not in ab:
+        return v
+    m = np.asarray(ab["M"], np.float32).reshape(-1)   # [out]
+    if m.shape[0] != v.shape[0]:
+        raise ValueError(
+            f"DoRA magnitude length {m.shape[0]} does not match output "
+            f"dim {v.shape[0]} for {module}"
+        )
+    norm = np.linalg.norm(v, axis=1)                  # per output row
+    return (m / np.maximum(norm, 1e-12))[:, None] * v
+
+
 def apply_lora_adapter(
     model: StageModel, params: dict, adapter_path: str, dtype=jnp.bfloat16
 ) -> int:
@@ -286,8 +303,11 @@ def apply_lora_adapter(
     are merged at load — ``W' = W + (alpha / r) * B @ A`` — which is
     mathematically identical for frozen adapters and keeps the jitted
     stage function unchanged. Returns the number of merged modules.
-    DoRA adapters (per-column magnitude renormalization) are rejected —
-    merging them as plain LoRA would be silently wrong.
+    DoRA adapters (reference ``shard_loader.py:188-225``) merge too:
+    ``W' = m * V / ||V||_row`` with ``V = W + (alpha/r) * B @ A`` and
+    ``m`` the learned per-output-row ``lora_magnitude_vector`` — the
+    weight-decomposed form collapses to a plain matrix for frozen
+    adapters just like LoRA does.
 
     Call on the PRE-finalize tree (``load_stage_params(lora_path=...)``
     does) so adapters targeting fused (``gate_up_proj``) or per-expert
@@ -335,12 +355,19 @@ def apply_lora_adapter(
                 if k.startswith(prefix):
                     k = k[len(prefix):]
                     break
-            if "lora_magnitude" in k:
-                raise ValueError(
-                    "DoRA adapters (lora_magnitude_vector) are not "
-                    "supported; merging without the magnitude "
-                    "renormalization would corrupt the weights"
+            if ".lora_magnitude_vector" in k:
+                # DoRA: per-output-row magnitude, applied after the
+                # directional update.
+                mod = k.split(".lora_magnitude_vector")[0]
+                local = shard_key_filter(
+                    mod + ".weight", model.start_layer, model.end_layer,
+                    cfg.num_hidden_layers,
                 )
+                if local is not None:
+                    pairs.setdefault(local[: -len(".weight")], {})["M"] = (
+                        f.get_tensor(key)
+                    )
+                continue
             if ".lora_A." in k:
                 mod, part = k.split(".lora_A."), "A"
             elif ".lora_B." in k:
@@ -386,7 +413,8 @@ def apply_lora_adapter(
                 f"LoRA shape mismatch for {module}: {w.shape} vs "
                 f"{delta.shape}"
             )
-        node["weight"] = jnp.asarray(w + delta).astype(dtype)
+        new_w = _apply_dora_magnitude(module, w + delta, ab)
+        node["weight"] = jnp.asarray(new_w).astype(dtype)
         merged += 1
     logger.info("merged %d LoRA modules from %s", merged, adapter_path)
     return merged

@@ -27,7 +27,12 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from parallax_tpu.ops.ragged import page_chunks, ragged_token_positions
+from parallax_tpu.ops.ragged import (
+    SPARSE_CHUNK,
+    SPARSE_CHUNK_THRESHOLD,
+    page_chunks,
+    ragged_token_positions,
+)
 
 _MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 _NEG_INF = float("-inf")
@@ -134,12 +139,6 @@ def dsa_topk_indices(
     return jnp.where(dense[:, None], jnp.int32(-1), idx)
 
 
-# Above this many top-k positions the single-pass gather's [T, K, R+Dr]
-# transient dominates HBM; the chunked online-softmax path bounds it to
-# [T, chunk, R+Dr] at identical math (DeepSeek-V3.2 ships index_topk=2048:
-# at T=64 that is ~1.2 GB single-pass vs ~75 MB chunked).
-_SPARSE_CHUNK_THRESHOLD = 512
-_SPARSE_CHUNK = 256
 
 
 @functools.partial(jax.jit, static_argnames=("sm_scale", "kv_lora_rank"))
@@ -204,7 +203,7 @@ def mla_ragged_sparse_attention_xla(
         ) * sm_scale
         return jnp.where(valid_blk[:, None, :], sc, _MASK_VALUE), latent
 
-    if k <= _SPARSE_CHUNK_THRESHOLD:
+    if k <= SPARSE_CHUNK_THRESHOLD:
         rows = flat_cache[flat_rows]                      # [T, K, R+Dr]
         scores, latent = score_block(rows, valid)
         m = jnp.max(scores, axis=-1, keepdims=True)
@@ -217,7 +216,7 @@ def mla_ragged_sparse_attention_xla(
         return out.astype(q_latent.dtype)
 
     # Chunked online softmax over K (flash-style accumulation).
-    chunk = _SPARSE_CHUNK
+    chunk = SPARSE_CHUNK
     num_chunks = -(-k // chunk)
     pad = num_chunks * chunk - k
     if pad:

@@ -36,7 +36,7 @@ _MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 _NEG_INF = float("-inf")
 # Same transient-bounding thresholds as ops/dsa.py: above this many
 # selected positions the gather+softmax runs chunked (online softmax).
-from parallax_tpu.ops.dsa import _SPARSE_CHUNK, _SPARSE_CHUNK_THRESHOLD  # noqa: E402
+from parallax_tpu.ops.dsa import SPARSE_CHUNK, SPARSE_CHUNK_THRESHOLD  # noqa: E402
 _INIT_SCORE = 1e30
 _LOCAL_SCORE = 1e29
 
@@ -195,7 +195,7 @@ def paged_sparse_gqa_attention_xla(
         ) * sm_scale
         return jnp.where(valid_blk[:, None, None, :], sc, _MASK_VALUE), v_sel
 
-    if k <= _SPARSE_CHUNK_THRESHOLD:
+    if k <= SPARSE_CHUNK_THRESHOLD:
         rows = flat_kv[flat_rows]                 # [T, K, 2*Hkv, D]
         scores, v_sel = score_block(rows, valid)
         m = jnp.max(scores, axis=-1, keepdims=True)
@@ -215,7 +215,7 @@ def paged_sparse_gqa_attention_xla(
     # first chunk always holds valid positions (top-k sorts real blocks
     # ahead of the -1 padding), so the running max is real before any
     # fully-masked chunk can contribute exp(0) terms.
-    chunk = _SPARSE_CHUNK
+    chunk = SPARSE_CHUNK
     num_chunks = -(-k // chunk)
     pad = num_chunks * chunk - k
     if pad:

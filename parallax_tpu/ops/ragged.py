@@ -13,6 +13,15 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+# Shared sparse-attention transient bounds (DSA and MSA gather paths):
+# above SPARSE_CHUNK_THRESHOLD selected positions the single-pass
+# gather's [T, K, dim] transient dominates HBM, so the op switches to a
+# chunked online-softmax over SPARSE_CHUNK-position slices at identical
+# math (DeepSeek-V3.2 ships index_topk=2048: at T=64 that is ~1.2 GB
+# single-pass vs ~75 MB chunked).
+SPARSE_CHUNK_THRESHOLD = 512
+SPARSE_CHUNK = 256
+
 
 def ragged_token_positions(
     kv_lens: jax.Array,    # i32[S]

@@ -477,6 +477,10 @@ class WorkerNode:
                         ireq.request_id, ireq.next_token_id,
                         ireq.token_logprob,
                     )
+                elif ireq.spec_accepted is not None:
+                    self.engine.commit_spec_result(
+                        ireq.request_id, ireq.spec_accepted
+                    )
                 else:
                     self.engine.submit_intermediate(ireq)
             elif kind == "submit":
@@ -577,7 +581,7 @@ class WorkerNode:
         by_peer: dict[str, list] = {}
         for ireq in out.forward:
             table = ireq.routing_table
-            if ireq.next_token_id is not None:
+            if ireq.next_token_id is not None or ireq.spec_accepted is not None:
                 target = table[0] if table else self.node_id
             else:
                 try:

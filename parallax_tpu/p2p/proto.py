@@ -61,6 +61,9 @@ def ireq_to_wire(ireq: IntermediateRequest) -> dict:
         "sampling_params": ireq.sampling_params,
         "is_last_chunk": ireq.is_last_chunk,
         "abort": ireq.abort,
+        "spec_len": ireq.spec_len,
+        "spec_accepted": ireq.spec_accepted,
+        "cached_prefix_ids": ireq.cached_prefix_ids,
     }
 
 
@@ -77,6 +80,9 @@ def ireq_from_wire(d: dict) -> IntermediateRequest:
         sampling_params=d.get("sampling_params"),
         is_last_chunk=d.get("is_last_chunk", True),
         abort=d.get("abort", False),
+        spec_len=d.get("spec_len", 0),
+        spec_accepted=d.get("spec_accepted"),
+        cached_prefix_ids=d.get("cached_prefix_ids"),
     )
 
 

@@ -235,11 +235,21 @@ class TcpTransport(Transport):
         """Transport-level relay frames; True when consumed."""
         t = frame["t"]
         if t == "__relay_register__":
-            fresh = frame["p"] not in self._relay_routes
+            prev = self._relay_routes.get(frame["p"])
+            if prev is not None and prev is not writer and not prev.is_closing():
+                # A LIVE route replaced by a different connection is either
+                # a worker reconnect the old socket hasn't noticed yet or a
+                # registration hijack (the relay endpoint is unauthenticated
+                # inside the swarm's trust boundary) — say so loudly either
+                # way so operators can correlate.
+                logger.warning(
+                    "relay: reverse route for %s replaced by a different "
+                    "live connection (reconnect or hijack)", frame["p"],
+                )
             self._relay_routes[frame["p"]] = writer
             # Heartbeat refreshes are routine; only NEW routes are news.
             logger.log(
-                20 if fresh else 10,
+                20 if prev is None else 10,
                 "relay: registered reverse route for %s", frame["p"],
             )
             return True

@@ -105,12 +105,21 @@ class DraftProposer:
     for a whole decode batch is one draft-engine run, not one per row.
     The main engine verifies every proposal in one forward (greedy
     acceptance), so draft quality affects speed only, never outputs.
+
+    Proposal wall time is bounded (``max_propose_ms``): speculation is an
+    accelerator, so a slow draft model must never stall the batch it is
+    supposed to speed up — on deadline the run stops and whatever tokens
+    each draft produced so far become the (possibly shorter) proposals.
+    Unfinished drafts are aborted and released, never left queued (a
+    leaked draft would be re-stepped by every later proposal round and
+    its pages/state slots would compound).
     """
 
-    def __init__(self, engine: "StageEngine"):
+    def __init__(self, engine: "StageEngine", max_propose_ms: float = 250.0):
         if not (engine.model.is_first and engine.model.is_last):
             raise ValueError("draft engine must be a full single stage")
         self.engine = engine
+        self.max_propose_ms = max_propose_ms
         self._counter = 0
 
     def propose_batch(
@@ -135,10 +144,16 @@ class DraftProposer:
                 continue
             reqs.append(req)
         if any(r is not None for r in reqs):
+            deadline = time.perf_counter() + self.max_propose_ms / 1000.0
             guard = 0
             while self.engine.has_work() and guard < 10_000:
                 self.engine.step()
                 guard += 1
+                if time.perf_counter() >= deadline:
+                    break
+            for req in reqs:
+                if req is not None and not req.status.is_finished:
+                    self.engine.release(req.request_id, abort=True)
         return [list(r.output_ids) if r is not None else [] for r in reqs]
 
 
@@ -299,6 +314,10 @@ class StageEngine:
         # EWMA per-layer decode latency published to the global scheduler
         # (reference base_executor.py:716-732).
         self.layer_latency_ms_ewma: float | None = None
+        # Pipeline-speculative telemetry (last stage): verification rounds
+        # and tokens accepted per ring packet.
+        self.pp_spec_rounds = 0
+        self.pp_spec_tokens = 0
 
     def set_grammar_vocab(self, vocab: list[bytes], eos_token_id: int) -> None:
         """Enable grammar-constrained decoding (json_schema) on this
@@ -388,15 +407,39 @@ class StageEngine:
             return
         new_tokens = ireq.token_ids or [0] * ireq.num_new_tokens
         if req is None:
+            # Head-side prefix-cache skip: prepend the skipped token ids so
+            # this stage's own prefix match aligns to the same absolute
+            # positions (the hidden rows start at len(cached_prefix_ids)).
+            prefix = list(ireq.cached_prefix_ids or [])
             req = Request(
                 request_id=rid,
-                prompt_ids=list(new_tokens),
+                prompt_ids=prefix + list(new_tokens),
                 sampling_params=SamplingParams.from_dict(ireq.sampling_params or {}),
                 routing_table=list(ireq.routing_table),
             )
             req.is_mirror = True  # type: ignore[attr-defined]
+            if prefix:
+                # This stage MUST start computing at exactly this offset —
+                # rows before it never arrive, rows after it do.
+                req.mirror_head_cached = len(prefix)  # type: ignore[attr-defined]
+                req.mirror_prefix_ids = prefix  # type: ignore[attr-defined]
             self.scheduler.enqueue(req)
         else:
+            # Pipeline-speculative self-healing: the packet's
+            # ``context_len - num_new_tokens`` is the head's authoritative
+            # context before these tokens. A longer mirror state can only
+            # mean rejected speculative tokens from the previous round —
+            # truncate them (their KV lies past the live context and is
+            # overwritten position-by-position, exactly as in the
+            # single-stage speculative path).
+            prior = ireq.context_len - ireq.num_new_tokens
+            if 0 <= prior < len(req.prompt_ids):
+                excess = len(req.prompt_ids) - prior
+                del req.prompt_ids[prior:]
+                gen = getattr(req, "mirror_gen_ids", None)
+                if gen:
+                    del gen[max(0, len(gen) - excess):]
+                req.num_computed_tokens = min(req.num_computed_tokens, prior)
             if getattr(req, "last_chunk_flag", False):
                 # The prompt was complete before this packet, so these
                 # tokens are generated ones — track them for penalties.
@@ -406,6 +449,12 @@ class StageEngine:
             req.prompt_ids.extend(new_tokens)
             req.status = RequestStatus.PREFILLING
             req.ready_for_step = True
+        if ireq.spec_len > 0:
+            # Last ``spec_len`` tokens are unverified proposals; the last
+            # stage verifies them against its own greedy logits.
+            req.pp_spec_fed = list(new_tokens)  # type: ignore[attr-defined]
+        elif hasattr(req, "pp_spec_fed"):
+            del req.pp_spec_fed
         req.last_chunk_flag = ireq.is_last_chunk  # type: ignore[attr-defined]
         if ireq.hidden_states is not None:
             prev = self._pending_hidden.get(rid)
@@ -856,6 +905,103 @@ class StageEngine:
             total += committed
         return total
 
+    def _extend_plan_pp_spec(self, plan: BatchPlan) -> None:
+        """Multi-stage head: extend eligible decode rows with speculative
+        proposals so every stage processes 1+k tokens per dispatch (the
+        only causally-valid way to move >1 token per stage dispatch in a
+        pipeline — the next true token is unknown until the ring returns,
+        but a proposal can be verified in one forward; reference per-token
+        contract: base_executor.py:634-769, which we beat, not match).
+
+        Rows keep their plan slot; only num_new_tokens/token_ids/
+        context_len grow. Eligibility mirrors the single-stage speculative
+        path: greedy rows with no per-step host state. The last stage
+        verifies (``pp_spec_fed``), the ring returns ``spec_accepted``,
+        and ``commit_spec_result`` rewinds the rejects.
+        """
+        k = self.cfg.speculative_tokens
+        spare = self.cfg.max_num_tokens_per_batch - plan.total_new_tokens
+        contexts, budgets, rows = [], [], []
+        for idx, seg in enumerate(plan.seqs):
+            req = seg.request
+            sp = req.sampling_params
+            if (
+                seg.num_new_tokens != 1
+                or req.status is not RequestStatus.DECODING
+                or getattr(req, "pp_spec_k", 0)
+                or sp.temperature > 0.0
+                or sp.seed is not None
+                or sp.presence_penalty
+                or sp.frequency_penalty
+                or sp.repetition_penalty != 1.0
+                or sp.logprobs
+                or sp.json_schema
+                or sp.logit_bias
+            ):
+                continue
+            budget = min(
+                k, max(0, spare),
+                self.cfg.max_model_len - req.total_len - 1,
+            )
+            if budget <= 0:
+                continue
+            contexts.append(req.all_token_ids)
+            budgets.append(budget)
+            rows.append(idx)
+        if not rows:
+            return
+        if self.draft is not None:
+            proposals = self.draft.propose_batch(contexts, budgets)
+        else:
+            proposals = [
+                self._ngram_proposal(ctx, self.cfg.speculative_ngram, b)
+                for ctx, b in zip(contexts, budgets)
+            ]
+        for idx, prop in zip(rows, proposals):
+            seg = plan.seqs[idx]
+            req = seg.request
+            prop = prop[: max(0, spare)]
+            if not prop:
+                continue
+            if not self.cache.ensure_capacity(
+                req, req.total_len + len(prop)
+            ):
+                continue
+            spare -= len(prop)
+            plan.seqs[idx] = ScheduledSeq(
+                request=req,
+                num_new_tokens=1 + len(prop),
+                token_ids=list(seg.token_ids) + list(prop),
+                context_len=seg.context_len + len(prop),
+            )
+            req.pp_spec_k = len(prop)  # type: ignore[attr-defined]
+
+    def commit_spec_result(self, request_id: str,
+                           accepted: list[int]) -> None:
+        """Head: the ring delivered a verified token run for a
+        pipeline-speculative round. Commits every accepted token and
+        rewinds ``num_computed_tokens`` for the rejected suffix (whose KV
+        lies past the live context on every stage)."""
+        req = self.scheduler.running.get(request_id)
+        if req is None:
+            return
+        k = getattr(req, "pp_spec_k", 0)
+        if hasattr(req, "pp_spec_k"):
+            del req.pp_spec_k
+        if req.status.is_finished:
+            return
+        # on_batch_computed advanced computed by the full 1+k fed rows;
+        # only the rows whose fed token matches the committed stream hold
+        # valid KV.
+        req.num_computed_tokens -= 1 + k
+        committed = 0
+        for tok in accepted:
+            if req.status.is_finished:
+                break
+            self._commit(req, int(tok))
+            committed += 1
+        req.num_computed_tokens += committed
+
     def _take_sp_plan(self) -> BatchPlan | None:
         """A sequence-parallel long-prefill plan, if one is ready."""
         if not self._sp_enabled:
@@ -895,6 +1041,12 @@ class StageEngine:
                     num_tokens=committed,
                     step_time_ms=dt,
                 )
+            if (
+                self.cfg.speculative_tokens > 0
+                and self.model.is_first
+                and not self.model.is_last
+            ):
+                self._extend_plan_pp_spec(plan)
 
         hidden = None
         if not self.model.is_first:
@@ -910,6 +1062,16 @@ class StageEngine:
                 if not hasattr(seg.request, "state_slot"):
                     # slot 0 is the null slot; real slots start at 1.
                     seg.request.state_slot = self._slot_alloc.alloc() + 1
+        # Last stage of a multi-stage pipeline: rows carrying unverified
+        # speculative tokens are greedy-verified against logits at EVERY
+        # fed position (one forward verifies the whole proposal).
+        spec_rows: dict[int, list[int]] = {}
+        if sp_plan is None and self.model.is_last and not self.model.is_first:
+            for i, seg in enumerate(plan.seqs):
+                fed = getattr(seg.request, "pp_spec_fed", None)
+                if fed is not None and seg.num_new_tokens == len(fed):
+                    spec_rows[i] = fed
+
         if sp_plan is not None:
             inputs = assemble(
                 plan, self._sp_spec, self.cfg.page_size,
@@ -928,6 +1090,7 @@ class StageEngine:
             inputs = assemble(
                 plan, self.spec, self.cfg.page_size, hidden_states=hidden,
                 with_dense_map=self._needs_state, decode_only=decode_only,
+                gather_all_logits=bool(spec_rows),
             )
             out, self.kv = self._jit_step(self.params, self.kv, inputs)
 
@@ -937,7 +1100,9 @@ class StageEngine:
         self.scheduler.on_batch_computed(plan)
 
         forwards: list[IntermediateRequest] = []
-        if self.model.is_last:
+        if self.model.is_last and spec_rows:
+            forwards = self._verify_and_emit(plan, inputs, out, spec_rows)
+        elif self.model.is_last:
             tokens, logprobs = self._sample(out, inputs, plan)
             forwards = self._emit_tokens(plan, tokens, logprobs)
         else:
@@ -954,6 +1119,62 @@ class StageEngine:
 
     # -- internals --------------------------------------------------------
 
+    def _verify_and_emit(
+        self, plan: BatchPlan, inputs: BatchInputs, out: jax.Array,
+        spec_rows: dict[int, list[int]],
+    ) -> list[IntermediateRequest]:
+        """Last stage, speculative rows present: ``out`` holds logits at
+        every fed position (gather_all_logits). Greedy-verify each spec
+        row's proposals — commit the longest agreeing prefix plus the
+        bonus token (identical acceptance rule to the single-stage
+        ``_try_speculative``) — and ring the accepted run back in ONE
+        packet. Non-spec rows sample normally off their last-position
+        logits."""
+        from parallax_tpu.ops.sampling import greedy_tokens
+
+        greedy_all = np.asarray(greedy_tokens(out))     # [T_bucket]
+        offs = np.concatenate([
+            [0], np.cumsum([s.num_new_tokens for s in plan.seqs]),
+        ]).astype(np.int64)
+        forwards: list[IntermediateRequest] = []
+        rest_segs: list[ScheduledSeq] = []
+        rest_rows: list[int] = []
+        for i, seg in enumerate(plan.seqs):
+            if i not in spec_rows:
+                rest_segs.append(seg)
+                rest_rows.append(int(offs[i + 1] - 1))
+                continue
+            fed = spec_rows[i]
+            req = seg.request
+            if hasattr(req, "pp_spec_fed"):
+                del req.pp_spec_fed
+            g = greedy_all[offs[i] : offs[i + 1]]
+            accepted: list[int] = []
+            for j in range(len(fed)):
+                accepted.append(int(g[j]))
+                if j + 1 < len(fed) and fed[j + 1] != int(g[j]):
+                    break
+            self.pp_spec_rounds += 1
+            self.pp_spec_tokens += len(accepted)
+            forwards.append(
+                IntermediateRequest(
+                    request_id=req.request_id,
+                    routing_table=req.routing_table,
+                    context_len=seg.context_len - len(fed) + len(accepted),
+                    num_new_tokens=len(accepted),
+                    spec_accepted=accepted,
+                )
+            )
+        if rest_segs:
+            s_bucket = int(inputs.kv_lens.shape[0])
+            rows = np.zeros((s_bucket,), np.int32)
+            rows[: len(rest_rows)] = rest_rows
+            logits_rest = out[jnp.asarray(rows)]
+            rest_plan = BatchPlan(rest_segs)
+            tokens, logprobs = self._sample(logits_rest, inputs, rest_plan)
+            forwards.extend(self._emit_tokens(rest_plan, tokens, logprobs))
+        return forwards
+
     def _form_plan(self) -> BatchPlan:
         plan = self.scheduler.form_batch()
         if self.model.is_first:
@@ -963,8 +1184,16 @@ class StageEngine:
         for s in plan.seqs:
             avail = self._pending_hidden.get(s.request.request_id)
             n_avail = 0 if avail is None else avail.shape[0]
-            if s.num_new_tokens <= n_avail:
-                usable.append(s)
+            if s.num_new_tokens > n_avail:
+                continue
+            fed = getattr(s.request, "pp_spec_fed", None)
+            if fed is not None and s.num_new_tokens != len(fed):
+                # A speculative row must be processed whole (verification
+                # needs every fed position; a forwarded partial window
+                # would desync spec_len downstream). The clamp can only be
+                # the step token budget — defer to the next step.
+                continue
+            usable.append(s)
         return BatchPlan(usable)
 
     def _take_hidden(self, rid: str, n: int) -> np.ndarray:
@@ -1194,6 +1423,27 @@ class StageEngine:
         for seg in plan.seqs:
             n = seg.num_new_tokens
             req = seg.request
+            # Pipeline-speculative rows advertise their proposal suffix so
+            # every downstream stage forwards the whole window and the
+            # last stage verifies instead of sampling. Head rows carry
+            # pp_spec_k; middle-stage mirrors relay their pp_spec_fed.
+            if self.model.is_first:
+                spec_len = getattr(req, "pp_spec_k", 0) if n > 1 else 0
+            else:
+                fed = getattr(req, "pp_spec_fed", None)
+                spec_len = n - 1 if fed is not None and n == len(fed) else 0
+            # First chunk after a prefix-cache skip: ship the skipped ids
+            # so downstream stages align their own match (see
+            # submit_intermediate).
+            prefix_ids = None
+            start = seg.context_len - n
+            if self.model.is_first:
+                if req.num_cached_tokens and start == req.num_cached_tokens:
+                    prefix_ids = req.prompt_ids[: req.num_cached_tokens]
+            else:
+                mp = getattr(req, "mirror_prefix_ids", None)
+                if mp is not None and start == len(mp):
+                    prefix_ids = mp
             forwards.append(
                 IntermediateRequest(
                     request_id=req.request_id,
@@ -1209,6 +1459,8 @@ class StageEngine:
                         else seg.is_last_prefill_chunk
                         or seg.request.status is RequestStatus.DECODING
                     ),
+                    spec_len=spec_len,
+                    cached_prefix_ids=prefix_ids,
                 )
             )
             row += n

@@ -41,6 +41,10 @@ class InProcessPipeline:
                         ireq.request_id, ireq.next_token_id,
                         ireq.token_logprob,
                     )
+                elif ireq.spec_accepted is not None:
+                    self.head.commit_spec_result(
+                        ireq.request_id, ireq.spec_accepted
+                    )
                 else:
                     self.engines[i + 1].submit_intermediate(ireq)
             for req in out.finished:

@@ -189,6 +189,18 @@ class IntermediateRequest:
     sampling_params: dict | None = None
     is_last_chunk: bool = True
     abort: bool = False
+    # Pipeline speculative decode: on a head->downstream decode packet,
+    # the last ``spec_len`` of ``token_ids`` are unverified proposals (the
+    # packet carries 1 + spec_len tokens). On the last->head ring hop,
+    # ``spec_accepted`` is the greedy-verified token list (the head
+    # commits them all and rewinds its computed count for the rejects).
+    spec_len: int = 0
+    spec_accepted: list[int] | None = None
+    # First prefill chunk of a request whose head stage prefix-cache hit
+    # skipped tokens: the skipped token ids, so every downstream stage can
+    # align its own prefix match to the same absolute positions (the
+    # packet's hidden rows start at position len(cached_prefix_ids)).
+    cached_prefix_ids: list[int] | None = None
 
     @property
     def is_prefill(self) -> bool:

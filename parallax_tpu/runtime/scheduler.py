@@ -98,6 +98,26 @@ class Scheduler:
             if not self.cache.allocate_for_prompt(req):
                 break
             del self.wait_queue[rid]
+            head_cached = getattr(req, "mirror_head_cached", None)
+            if head_cached is not None:
+                # Mirror of a head-side prefix hit: the head only forwards
+                # hidden rows from ``head_cached`` on. A SHORTER local
+                # match means this stage would need rows that never arrive
+                # — abort loudly rather than stall or serve garbage
+                # (asymmetric eviction between stages; rare). A LONGER
+                # local match is clamped down: the overlap rows recompute
+                # into the shared pages deterministically (same inputs,
+                # same values).
+                if req.num_computed_tokens < head_cached:
+                    logger.warning(
+                        "%s: downstream prefix-cache miss (head skipped "
+                        "%d, local match %d) — aborting", rid,
+                        head_cached, req.num_computed_tokens,
+                    )
+                    req.abort("downstream_prefix_cache_miss")
+                    self.running[rid] = req   # collected + released next step
+                    continue
+                req.num_computed_tokens = head_cached
             req.status = RequestStatus.PREFILLING
             self.running[rid] = req
 

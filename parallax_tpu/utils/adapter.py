@@ -5,8 +5,9 @@ Capability parity: reference ``src/parallax_utils/prepare_adapter.py``
 (download adapter + base, fuse, save a servable checkpoint). TPU
 re-design: processes the checkpoint shard-by-shard (host memory stays at
 one shard + the adapter, and the multi-file layout is preserved), merges
-``W' = W + (alpha/r) * B @ A`` in float32, and copies the config,
-index, and tokenizer files the serving loader needs. Serving can also
+``W' = W + (alpha/r) * B @ A`` in float32 (DoRA adapters additionally
+renormalize rows to the learned ``lora_magnitude_vector``), and copies
+the config, index, and tokenizer files the serving loader needs. Serving can also
 merge at load time (``--lora-path``); this tool is for producing a
 standalone merged checkpoint once and serving it many times.
 """
@@ -58,9 +59,11 @@ def _load_adapter(adapter_path: str) -> tuple[dict, dict]:
                 if k.startswith(prefix):
                     k = k[len(prefix):]
                     break
-            if "lora_magnitude" in k:
-                raise ValueError("DoRA adapters are not supported")
-            if ".lora_A." in k:
+            if ".lora_magnitude_vector" in k:
+                # DoRA per-output-row magnitude (applied after the
+                # directional update in the merge step).
+                mod, part = k.split(".lora_magnitude_vector")[0], "M"
+            elif ".lora_A." in k:
                 mod, part = k.split(".lora_A.")[0], "A"
             elif ".lora_B." in k:
                 mod, part = k.split(".lora_B.")[0], "B"
@@ -147,7 +150,14 @@ def merge_adapter(model_path: str, adapter_path: str, out_dir: str) -> int:
                             f"{cand}: adapter delta {delta.shape} does not "
                             f"match base weight {arr.shape}"
                         )
-                    arr = (arr.astype(np.float32) + delta).astype(arr.dtype)
+                    from parallax_tpu.models.loader import (
+                        _apply_dora_magnitude,
+                    )
+
+                    merged_w = _apply_dora_magnitude(
+                        cand, arr.astype(np.float32) + delta, ab
+                    )
+                    arr = merged_w.astype(arr.dtype)
                     unmatched.discard(cand)
                 shard[key] = arr
         save_file(shard, os.path.join(out_dir, name))

@@ -131,6 +131,50 @@ def test_lora_merge_respects_stage_range(tmp_path):
     assert n == 1  # layer 0's adapter filtered out
 
 
+def _write_dora_adapter(path, r=4, alpha=8.0, hidden=32, out_dim=32):
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(3)
+    pre = "base_model.model.model.layers.0.self_attn.q_proj"
+    a = rng.standard_normal((r, hidden)).astype(np.float32) * 0.1
+    b = rng.standard_normal((out_dim, r)).astype(np.float32) * 0.1
+    m = (rng.standard_normal(out_dim).astype(np.float32) * 0.2 + 1.0)
+    tensors = {
+        f"{pre}.lora_A.weight": a,
+        f"{pre}.lora_B.weight": b,
+        f"{pre}.lora_magnitude_vector.weight": m,
+    }
+    path.mkdir(parents=True, exist_ok=True)
+    save_file(tensors, str(path / "adapter_model.safetensors"))
+    (path / "adapter_config.json").write_text(json.dumps(
+        {"r": r, "lora_alpha": alpha, "use_dora": True}
+    ))
+    return a, b, m, alpha / r
+
+
+def test_dora_merge_renormalizes_rows(tmp_path):
+    """DoRA (VERDICT r2 #10): W' = m * V / ||V||_row with V = W +
+    scale*B@A (reference shard_loader.py:188-225 load_lora DoRA
+    branch)."""
+    from parallax_tpu.models.loader import apply_lora_adapter
+
+    cfg = normalize_config(TINY)
+    model = StageModel(cfg, 0, 2, use_pallas=False)
+    params = model.init_params(jax.random.key(0), dtype=jnp.float32)
+    w = np.asarray(params["layers"][0]["self_attn"]["q_proj"]["weight"])
+    a, b, m, scale = _write_dora_adapter(tmp_path / "adapter")
+    n = apply_lora_adapter(model, params, str(tmp_path / "adapter"),
+                           dtype=jnp.float32)
+    assert n == 1
+    v = w + scale * (b @ a)
+    expect = (m / np.linalg.norm(v, axis=1))[:, None] * v
+    after = np.asarray(params["layers"][0]["self_attn"]["q_proj"]["weight"])
+    np.testing.assert_allclose(after, expect, rtol=1e-5, atol=1e-5)
+    # learned magnitudes are now the row norms of the merged weight
+    np.testing.assert_allclose(np.linalg.norm(after, axis=1), m,
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_lora_rejects_quantized_target(tmp_path):
     from parallax_tpu.models.loader import apply_lora_adapter
     from parallax_tpu.ops.quant import quantize_tree

@@ -1,0 +1,179 @@
+"""Pipeline speculative decoding: >1 token per stage dispatch in a
+multi-stage pipeline (VERDICT r2 #3).
+
+The head extends eligible greedy decode rows with n-gram proposals, every
+stage forwards the whole 1+k window in one dispatch, the LAST stage
+greedy-verifies all positions in one forward and rings the accepted run
+back in one packet; mirrors self-heal rejected tokens by truncating to
+the next packet's authoritative context. Exactness: committed streams
+must equal the per-token pipeline's, token for token (same acceptance
+rule as single-stage speculation; reference per-token stage contract
+``base_executor.py:634-769`` is the baseline we beat).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from parallax_tpu.config import normalize_config
+from parallax_tpu.models.base import StageModel
+from parallax_tpu.runtime.engine import EngineConfig, StageEngine
+from parallax_tpu.runtime.pipeline import InProcessPipeline
+from parallax_tpu.runtime.request import Request, SamplingParams
+
+CFG = normalize_config(dict(
+    architectures=["Qwen2ForCausalLM"], hidden_size=64,
+    num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+    intermediate_size=128, vocab_size=199, max_position_embeddings=512,
+    tie_word_embeddings=False,
+))
+
+
+def _build(stages, spec_tokens, params_key=0):
+    bounds = {
+        2: [(0, 2), (2, 4)],
+        3: [(0, 2), (2, 3), (3, 4)],
+    }[stages]
+    engines = []
+    for s, e in bounds:
+        m = StageModel(CFG, s, e, use_pallas=False)
+        engines.append(StageEngine(
+            m, m.init_params(jax.random.key(params_key), dtype=jnp.float32),
+            EngineConfig(page_size=8, num_pages=128, max_model_len=256,
+                         kv_dtype="float32",
+                         speculative_tokens=spec_tokens),
+        ))
+    return InProcessPipeline(engines)
+
+
+def _serve(pipe, specs, max_new=14, ignore_eos=True, eos=None):
+    reqs = []
+    for i, (prompt, temp, seed, extra) in enumerate(specs):
+        req = Request(
+            f"r{i}", prompt_ids=list(prompt),
+            sampling_params=SamplingParams(
+                temperature=temp, seed=seed, max_new_tokens=max_new,
+                ignore_eos=ignore_eos, **extra,
+            ),
+        )
+        if eos is not None:
+            req.eos_token_ids = eos
+        reqs.append(req)
+        pipe.submit(req)
+    pipe.run_until_complete()
+    return reqs
+
+
+REP = [7, 8, 9, 10] * 6   # repetitive: n-gram proposals always fire
+
+
+def test_pp_spec_two_stage_exact_and_multitoken():
+    base = _serve(_build(2, 0), [(REP, 0.0, None, {})])
+    pipe = _build(2, 4)
+    got = _serve(pipe, [(REP, 0.0, None, {})])
+    assert got[0].output_ids == base[0].output_ids
+    assert got[0].status == base[0].status
+    # the last stage actually verified multi-token windows
+    assert pipe.engines[-1].pp_spec_rounds > 0
+
+
+def test_pp_spec_three_stage_middle_relays():
+    base = _serve(_build(3, 0), [(REP, 0.0, None, {}), ([3, 1, 4, 1, 5, 9, 2, 6], 0.0, None, {})])
+    pipe = _build(3, 3)
+    got = _serve(pipe, [(REP, 0.0, None, {}), ([3, 1, 4, 1, 5, 9, 2, 6], 0.0, None, {})])
+    for b, g in zip(base, got):
+        assert g.output_ids == b.output_ids
+    assert pipe.engines[-1].pp_spec_rounds > 0
+
+
+def test_pp_spec_eos_and_max_tokens():
+    probe = _serve(_build(2, 0), [(REP, 0.0, None, {})], max_new=10)
+    eos = (probe[0].output_ids[4],)
+    base = _serve(_build(2, 0), [(REP, 0.0, None, {})], max_new=10,
+                  ignore_eos=False, eos=eos)
+    got = _serve(_build(2, 4), [(REP, 0.0, None, {})], max_new=10,
+                 ignore_eos=False, eos=eos)
+    assert got[0].output_ids == base[0].output_ids
+    assert got[0].status == base[0].status
+    # max_new not a multiple of the window
+    base7 = _serve(_build(2, 0), [(REP, 0.0, None, {})], max_new=7)
+    got7 = _serve(_build(2, 3), [(REP, 0.0, None, {})], max_new=7)
+    assert got7[0].output_ids == base7[0].output_ids
+    assert len(got7[0].output_ids) == 7
+
+
+def test_pp_spec_mixed_batch_ineligible_rows_untouched():
+    """Sampled/penalized rows keep the per-token path while greedy rows
+    speculate in the same batch; every stream matches the no-spec run."""
+    specs = [
+        (REP, 0.0, None, {}),
+        ([11, 12, 13], 0.7, 21, {}),                      # seeded sampled
+        ([14, 15, 16, 17], 0.0, None,
+         {"repetition_penalty": 1.25}),                   # penalized greedy
+    ]
+    base = _serve(_build(2, 0), list(specs))
+    pipe = _build(2, 4)
+    got = _serve(pipe, list(specs))
+    for b, g in zip(base, got):
+        assert g.output_ids == b.output_ids, (b.request_id, b.output_ids,
+                                              g.output_ids)
+    assert pipe.engines[-1].pp_spec_rounds > 0
+
+
+def test_pp_spec_prefix_donation_consistent():
+    """After rejected windows, computed-token accounting must still let
+    prefix donation serve a follow-up request correctly."""
+    pipe = _build(2, 4)
+    first = _serve(pipe, [(REP, 0.0, None, {})], max_new=9)
+    req = first[0]
+    assert req.num_computed_tokens == req.total_len - 1
+    follow = Request(
+        "follow", prompt_ids=list(REP) + req.output_ids[:2] + [100],
+        sampling_params=SamplingParams(temperature=0.0, max_new_tokens=4,
+                                       ignore_eos=True),
+    )
+    pipe.submit(follow)
+    pipe.run_until_complete()
+    assert len(follow.output_ids) == 4
+    # same continuation as a fresh pipeline serving the same prompt
+    fresh = _serve(_build(2, 0), [(follow.prompt_ids, 0.0, None, {})],
+                   max_new=4)
+    assert follow.output_ids == fresh[0].output_ids
+
+
+def test_cross_stage_prefix_hit_aligns_mirrors():
+    """Regression (round-3 find): a head prefix-cache hit used to forward
+    only the uncached suffix, leaving mirror stages misaligned (wrong
+    absolute positions -> wrong logits). The first chunk now carries the
+    skipped ids so every stage aligns its own match."""
+    pipe = _build(2, 0)
+    first = _serve(pipe, [(REP, 0.0, None, {})], max_new=9)
+    follow_prompt = list(REP) + first[0].output_ids[:2] + [100]
+    follow = Request(
+        "follow", prompt_ids=follow_prompt,
+        sampling_params=SamplingParams(temperature=0.0, max_new_tokens=4,
+                                       ignore_eos=True),
+    )
+    pipe.submit(follow)
+    pipe.run_until_complete()
+    assert follow.num_cached_tokens > 0      # the head actually hit
+    fresh = _serve(_build(2, 0), [(follow_prompt, 0.0, None, {})], max_new=4)
+    assert follow.output_ids == fresh[0].output_ids
+
+
+def test_spec_wire_fields_roundtrip():
+    from parallax_tpu.p2p import proto
+    from parallax_tpu.runtime.request import IntermediateRequest
+
+    ireq = IntermediateRequest(
+        request_id="x", routing_table=["a", "b"], context_len=30,
+        num_new_tokens=5, token_ids=[1, 2, 3, 4, 5], spec_len=4,
+    )
+    back = proto.ireq_from_wire(proto.ireq_to_wire(ireq))
+    assert back.spec_len == 4 and back.spec_accepted is None
+    ring = IntermediateRequest(
+        request_id="x", routing_table=["a", "b"], context_len=28,
+        num_new_tokens=3, spec_accepted=[9, 8, 7],
+    )
+    back = proto.ireq_from_wire(proto.ireq_to_wire(ring))
+    assert back.spec_accepted == [9, 8, 7] and back.spec_len == 0

@@ -48,6 +48,18 @@ def stage_params(model: StageModel):
 @pytest.fixture
 def swarm(monkeypatch):
     """Scheduler service + 2 workers over TCP localhost."""
+    yield from _make_swarm(monkeypatch, ENGINE_CFG)
+
+
+@pytest.fixture
+def swarm_spec(monkeypatch):
+    """Same swarm with pipeline-speculative decoding enabled."""
+    yield from _make_swarm(
+        monkeypatch, dataclasses.replace(ENGINE_CFG, speculative_tokens=4)
+    )
+
+
+def _make_swarm(monkeypatch, engine_cfg):
     # Each worker must look like a 1-chip host that can hold ~half the
     # (tiny) model, so the allocator builds one 2-stage pipeline. Capacity
     # for the tiny model is huge on any hardware; force a 2-way split by
@@ -75,7 +87,7 @@ def swarm(monkeypatch):
             transport=t,
             scheduler_peer=sched_addr,
             model_config=TINY,
-            engine_config=ENGINE_CFG,
+            engine_config=engine_cfg,
             load_params=stage_params,
             heartbeat_interval_s=0.2,
         )
@@ -146,6 +158,50 @@ def test_swarm_serves_request_over_tcp(swarm):
     # Release broadcast freed every stage's pages back to steady state.
     for w in workers:
         assert w.engine.scheduler.num_requests() == 0
+
+
+def test_swarm_pp_speculative_multitoken_over_tcp(swarm_spec):
+    """VERDICT r2 #3: decode moves >1 token per stage dispatch over the
+    REAL TCP path — the head extends decode rows with n-gram proposals,
+    the last stage verifies and rings back the accepted run in one
+    packet. Output must equal the per-token in-process reference."""
+    service, workers = swarm_spec
+    assert wait_ready(service, 2), service.scheduler.cluster_status()
+
+    path = service.route_request("req-spec", timeout_s=10.0)
+    assert path is not None and len(path) == 2
+    head = next(w for w in workers if w.node_id == path[0])
+    rep = [7, 8, 9, 10] * 6
+    req = Request(
+        request_id="req-spec",
+        prompt_ids=list(rep),
+        sampling_params=SamplingParams(temperature=0.0, max_new_tokens=10,
+                                       ignore_eos=True),
+        routing_table=list(path),
+    )
+    done = head.submit(req)
+    assert done.wait(30.0), f"request did not finish: {req.status}"
+    assert len(req.output_ids) == 10
+
+    last = next(w for w in workers if w.node_id == path[-1])
+    assert last.engine.pp_spec_rounds > 0   # >1 token/stage dispatch ran
+
+    bounds = sorted(
+        (w.start_layer, w.end_layer) for w in workers if w.node_id in path
+    )
+    engines = []
+    for s, e in bounds:
+        m = StageModel(TINY, s, e, use_pallas=False)
+        engines.append(StageEngine(m, stage_params(m), ENGINE_CFG))
+    pipe = InProcessPipeline(engines)
+    ref = Request(
+        request_id="ref", prompt_ids=list(rep),
+        sampling_params=SamplingParams(temperature=0.0, max_new_tokens=10,
+                                       ignore_eos=True),
+    )
+    pipe.submit(ref)
+    pipe.run_until_complete()
+    assert req.output_ids == ref.output_ids
 
 
 def test_swarm_handles_concurrent_requests(swarm):

@@ -146,6 +146,57 @@ def test_lora_merge_produces_servable_equal_checkpoint(tmp_path):
     assert np.abs(q0m - q0).max() > 1e-3
 
 
+def test_dora_merge_offline_equals_load_time(tmp_path):
+    """DoRA offline fusion == load-time merge, and merged row norms equal
+    the learned magnitudes."""
+    from safetensors.numpy import save_file
+
+    from parallax_tpu.config import normalize_config
+    from parallax_tpu.models.base import StageModel
+    from parallax_tpu.models.loader import load_stage_params
+    from parallax_tpu.utils.adapter import merge_adapter
+
+    cfg_dict = dict(
+        architectures=["Qwen2ForCausalLM"], hidden_size=32,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=64, vocab_size=97, max_position_embeddings=128,
+        tie_word_embeddings=False,
+    )
+    cfg = normalize_config(cfg_dict)
+    model = StageModel(cfg, 0, 2, use_pallas=False)
+    params = model.init_params(jax.random.key(1), dtype=jnp.float32)
+    base_dir = str(tmp_path / "base")
+    _write_tiny_checkpoint(base_dir, cfg_dict, params)
+
+    rng = np.random.default_rng(7)
+    h = cfg.hidden_size
+    qdim = cfg.num_attention_heads * cfg.head_dim
+    mag = (rng.normal(size=qdim).astype(np.float32) * 0.1 + 1.0)
+    adapter_dir = str(tmp_path / "adapter")
+    os.makedirs(adapter_dir)
+    pre = "base_model.model.model.layers.0.self_attn.q_proj"
+    save_file({
+        f"{pre}.lora_A.weight": rng.normal(size=(2, h)).astype(np.float32),
+        f"{pre}.lora_B.weight": rng.normal(size=(qdim, 2)).astype(np.float32),
+        f"{pre}.lora_magnitude_vector.weight": mag,
+    }, os.path.join(adapter_dir, "adapter_model.safetensors"))
+    with open(os.path.join(adapter_dir, "adapter_config.json"), "w") as f:
+        json.dump({"r": 2, "lora_alpha": 4, "use_dora": True}, f)
+
+    merged_dir = str(tmp_path / "merged")
+    assert merge_adapter(base_dir, adapter_dir, merged_dir) == 1
+
+    via_tool = load_stage_params(model, merged_dir, dtype=jnp.float32)
+    via_load = load_stage_params(
+        model, base_dir, dtype=jnp.float32, lora_path=adapter_dir
+    )
+    qt = np.asarray(via_tool["layers"][0]["self_attn"]["q_proj"]["weight"])
+    ql = np.asarray(via_load["layers"][0]["self_attn"]["q_proj"]["weight"])
+    np.testing.assert_allclose(qt, ql, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.linalg.norm(qt, axis=1), mag,
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_cli_lora_merge_subcommand(tmp_path, capsys):
     import pytest
 
