@@ -246,7 +246,7 @@ def test_sparse_attention_chunked_matches_single_pass():
     rng = np.random.default_rng(7)
     page_size, num_pages = 8, 128
     ctx, hq, r, dr = 700, 2, 16, 8
-    k = dsa_mod._SPARSE_CHUNK_THRESHOLD + 90   # force the chunked path
+    k = dsa_mod.SPARSE_CHUNK_THRESHOLD + 90   # force the chunked path
     pages_needed = -(-ctx // page_size)
     page_ids = list(range(1, 1 + pages_needed))
     latent = rng.standard_normal((ctx, r)).astype(np.float32)
@@ -276,7 +276,7 @@ def test_sparse_attention_chunked_matches_single_pass():
     # (fresh trace: clear the jit cache so the patched constant applies).
     import unittest.mock as mock
 
-    with mock.patch.object(dsa_mod, "_SPARSE_CHUNK_THRESHOLD", 10_000):
+    with mock.patch.object(dsa_mod, "SPARSE_CHUNK_THRESHOLD", 10_000):
         jax.clear_caches()
         single = np.asarray(mla_ragged_sparse_attention_xla(
             *args, jnp.asarray(picks), sm_scale=0.3, kv_lora_rank=r,

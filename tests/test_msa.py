@@ -342,7 +342,7 @@ def test_sparse_gqa_chunked_matches_single_pass():
     rng = np.random.default_rng(11)
     page_size, num_pages = 8, 128
     ctx, hq, hkv, d = 800, 4, 2, 16
-    kk = dsa_mod._SPARSE_CHUNK_THRESHOLD + 70
+    kk = dsa_mod.SPARSE_CHUNK_THRESHOLD + 70
     pages_needed = -(-ctx // page_size)
     page_ids = list(range(1, 1 + pages_needed))
     kv = new_kv_pages(num_pages, page_size, hkv, d, jnp.float32)
@@ -369,7 +369,7 @@ def test_sparse_gqa_chunked_matches_single_pass():
 
     from parallax_tpu.ops import msa as msa_mod
 
-    with mock.patch.object(msa_mod, "_SPARSE_CHUNK_THRESHOLD", 10_000):
+    with mock.patch.object(msa_mod, "SPARSE_CHUNK_THRESHOLD", 10_000):
         jax.clear_caches()
         single = np.asarray(paged_sparse_gqa_attention_xla(
             *args, jnp.asarray(pos), sm_scale=0.3,
