@@ -232,6 +232,10 @@ def main():
         if tpu_ok:
             left = deadline - time.time() - EXIT_MARGIN_S
             result = _run_child(child_env(), min(WATCHDOG_S, left))
+            if isinstance(result, str) and cpu is not None:
+                # Unparseable child line: never let it replace a valid
+                # already-printed line as the driver-visible LAST line.
+                result = None
             if result is not None:
                 if isinstance(result, dict):
                     result.setdefault("detail", {})[

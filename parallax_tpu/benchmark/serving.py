@@ -31,6 +31,14 @@ class RequestSpec:
     max_tokens: int
 
 
+def _count_tokens(text: str, tokenizer) -> int:
+    """Token length under the given tokenizer; whitespace-word fallback
+    keeps every loader usable offline with no model files."""
+    if tokenizer is None:
+        return len(text.split())
+    return len(tokenizer.encode(text))
+
+
 @dataclasses.dataclass
 class RequestResult:
     ok: bool
@@ -82,6 +90,122 @@ def sample_file_requests(
         RequestSpec(p, len(p.split()), output_len)
         for p in prompts[:num] if p
     ]
+
+
+def _build_specs(
+    pairs,
+    num: int,
+    tokenizer,
+    fixed_output_len: int | None,
+) -> list[RequestSpec]:
+    """Turn (prompt, completion) pairs into pruned RequestSpecs — the
+    shared core of every conversation-dataset sampler (reference
+    ``benchmark_serving.py:147-287`` semantics): output budget is the
+    completion's token length unless ``fixed_output_len``; prompts
+    outside [4, 1024] tokens are always pruned; completion-derived
+    prunes (reply < 4 tokens, prompt+output > 2048) apply only when the
+    output length is data-derived."""
+    specs: list[RequestSpec] = []
+    for prompt, completion in pairs:
+        if len(specs) == num:
+            break
+        prompt_len = _count_tokens(prompt, tokenizer)
+        if prompt_len < 4 or prompt_len > 1024:
+            continue
+        if fixed_output_len is not None:
+            output_len = fixed_output_len
+        else:
+            output_len = _count_tokens(completion, tokenizer)
+            if output_len < 4 or prompt_len + output_len > 2048:
+                continue
+        specs.append(RequestSpec(prompt, prompt_len, output_len))
+    return specs
+
+
+def sample_sharegpt_requests(
+    dataset_path: str,
+    num: int,
+    tokenizer=None,
+    fixed_output_len: int | None = None,
+    seed: int = 0,
+) -> list[RequestSpec]:
+    """ShareGPT local-JSON sampler — the north-star workload's dataset
+    (reference ``benchmark_serving.py:147-187``): conversations with
+    >= 2 turns, turn 0 as the prompt, turn 1 as the completion,
+    shuffled then pruned by ``_build_specs``."""
+    with open(dataset_path, encoding="utf-8") as f:
+        dataset = json.load(f)
+    pairs = [
+        (d["conversations"][0]["value"], d["conversations"][1]["value"])
+        for d in dataset
+        if len(d.get("conversations") or []) >= 2
+    ]
+    random.Random(seed).shuffle(pairs)
+    return _build_specs(pairs, num, tokenizer, fixed_output_len)
+
+
+def _load_hf_dataset(path: str, subset: str | None, split: str,
+                     streaming: bool = False):
+    """Indirection over ``datasets.load_dataset`` so tests can inject a
+    local fixture and offline installs fail with a clear message."""
+    try:
+        from datasets import load_dataset
+    except ImportError as e:  # pragma: no cover - baked into this image
+        raise RuntimeError(
+            "HuggingFace `datasets` is required for wildchat/hf dataset "
+            "modes; use --dataset-name sharegpt or random instead"
+        ) from e
+    return load_dataset(path, name=subset, split=split, streaming=streaming)
+
+
+def sample_wildchat_requests(
+    dataset_path: str,
+    num: int,
+    tokenizer=None,
+    seed: int = 0,
+    fixed_output_len: int | None = None,
+) -> list[RequestSpec]:
+    """WildChat sampler (reference ``benchmark_serving.py:189-224``):
+    HF dataset rows with a ``conversation`` column of role/content
+    dicts; prompt = first turn, completion = second turn."""
+    dataset = _load_hf_dataset(dataset_path, None, "train", streaming=True)
+    dataset = dataset.shuffle(seed=seed).filter(
+        lambda x: len(x["conversation"]) >= 2
+    )
+    pairs = (
+        (d["conversation"][0]["content"], d["conversation"][1]["content"])
+        for d in dataset
+    )
+    return _build_specs(pairs, num, tokenizer, fixed_output_len)
+
+
+def sample_hf_requests(
+    dataset_path: str,
+    dataset_subset: str | None,
+    dataset_split: str,
+    num: int,
+    tokenizer=None,
+    seed: int = 0,
+    fixed_output_len: int | None = None,
+) -> list[RequestSpec]:
+    """Generic HF-hub sampler (reference ``benchmark_serving.py:226-287``,
+    minus the vision/multimodal leg — this framework serves text): the
+    dataset must expose a ShareGPT-shaped ``conversations`` column."""
+    dataset = _load_hf_dataset(
+        dataset_path, dataset_subset, dataset_split, streaming=True
+    )
+    # Streaming datasets may have unresolved (None) features; defer the
+    # column check to row shape in that case.
+    if dataset.features is not None and "conversations" not in dataset.features:
+        raise ValueError("HF dataset must have a 'conversations' column")
+    dataset = dataset.shuffle(seed=seed).filter(
+        lambda x: len(x["conversations"]) >= 2
+    )
+    pairs = (
+        (d["conversations"][0]["value"], d["conversations"][1]["value"])
+        for d in dataset
+    )
+    return _build_specs(pairs, num, tokenizer, fixed_output_len)
 
 
 def arrival_times(
@@ -163,6 +287,7 @@ async def run_benchmark(
     burstiness: float = 1.0,
     max_concurrency: int | None = None,
     seed: int = 0,
+    goodput_slo: dict | None = None,
 ) -> dict:
     import aiohttp
 
@@ -185,7 +310,7 @@ async def run_benchmark(
             *[worker(s, o) for s, o in zip(specs, offsets)]
         )
     duration = time.perf_counter() - t_start
-    return compute_metrics(list(results), duration)
+    return compute_metrics(list(results), duration, goodput_slo)
 
 
 # -- metrics ----------------------------------------------------------------
@@ -255,8 +380,27 @@ def main(argv=None) -> int:
     ap.add_argument("--model", default="parallax-tpu")
     ap.add_argument("--num-prompts", type=int, default=64)
     ap.add_argument("--input-len", type=int, default=128)
-    ap.add_argument("--output-len", type=int, default=64)
-    ap.add_argument("--dataset", default=None, help="JSON conversations file")
+    ap.add_argument(
+        "--output-len", type=int, default=None,
+        help="output budget per request; for sharegpt/wildchat/hf modes "
+        "the default derives it from each conversation's reply length",
+    )
+    ap.add_argument(
+        "--dataset-name", default=None,
+        choices=["random", "file", "sharegpt", "wildchat", "hf"],
+        help="load model (default: random, or file when --dataset-path "
+        "is a plain conversations JSON)",
+    )
+    ap.add_argument("--dataset-path", default=None,
+                    help="local JSON path (sharegpt/file) or HF dataset id")
+    ap.add_argument("--dataset", default=None,
+                    help="deprecated alias for --dataset-path with "
+                    "--dataset-name file")
+    ap.add_argument("--hf-subset", default=None)
+    ap.add_argument("--hf-split", default="train")
+    ap.add_argument("--tokenizer", default=None,
+                    help="model path whose tokenizer measures prompt/output "
+                    "token lengths (default: whitespace words)")
     ap.add_argument("--request-rate", type=float, default=float("inf"))
     ap.add_argument("--burstiness", type=float, default=1.0)
     ap.add_argument("--max-concurrency", type=int, default=None)
@@ -265,14 +409,50 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
-    if args.dataset:
+    tokenizer = None
+    if args.tokenizer:
+        from parallax_tpu.utils.tokenizer import load_tokenizer
+
+        tokenizer = load_tokenizer(args.tokenizer)
+
+    name = args.dataset_name
+    path = args.dataset_path or args.dataset
+    if name is None:
+        name = "file" if path else "random"
+    if name != "random" and not path:
+        ap.error(f"--dataset-path is required for --dataset-name {name}")
+    if name == "sharegpt":
+        specs = sample_sharegpt_requests(
+            path, args.num_prompts, tokenizer, args.output_len, args.seed
+        )
+    elif name == "wildchat":
+        specs = sample_wildchat_requests(
+            path, args.num_prompts, tokenizer, args.seed, args.output_len
+        )
+    elif name == "hf":
+        specs = sample_hf_requests(
+            path, args.hf_subset, args.hf_split, args.num_prompts,
+            tokenizer, args.seed, args.output_len,
+        )
+    elif name == "file":
         specs = sample_file_requests(
-            args.dataset, args.num_prompts, args.output_len, args.seed
+            path, args.num_prompts, args.output_len or 64, args.seed
         )
     else:
         specs = sample_random_requests(
-            args.num_prompts, args.input_len, args.output_len, args.seed
+            args.num_prompts, args.input_len, args.output_len or 64,
+            args.seed,
         )
+    if not specs:
+        logger.error("dataset produced no usable prompts")
+        return 2
+    goodput_slo = None
+    if args.goodput_ttft_s is not None or args.goodput_tpot_s is not None:
+        goodput_slo = {}
+        if args.goodput_ttft_s is not None:
+            goodput_slo["ttft_s"] = args.goodput_ttft_s
+        if args.goodput_tpot_s is not None:
+            goodput_slo["tpot_s"] = args.goodput_tpot_s
     metrics = asyncio.run(run_benchmark(
         args.base_url, specs,
         model=args.model,
@@ -280,6 +460,7 @@ def main(argv=None) -> int:
         burstiness=args.burstiness,
         max_concurrency=args.max_concurrency,
         seed=args.seed,
+        goodput_slo=goodput_slo,
     ))
     print(json.dumps(metrics, indent=2))
     return 0 if metrics["failed"] == 0 else 1

@@ -1,5 +1,6 @@
-"""Driver-contract smoke tests: bench.py must always print exactly one
-JSON line with the required keys; __graft_entry__.entry() must be
+"""Driver-contract smoke tests: bench.py prints one or more JSON lines
+(each an upgrade of the previous; the driver takes the LAST) with the
+required keys and exits 0; __graft_entry__.entry() must be
 jit-lowerable."""
 
 import json
@@ -18,11 +19,13 @@ def test_bench_cpu_smoke_prints_one_json_line():
     )
     assert out.returncode == 0, out.stderr[-1500:]
     json_lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
-    assert len(json_lines) == 1, out.stdout
-    rec = json.loads(json_lines[0])
+    assert json_lines, out.stdout
+    rec = json.loads(json_lines[-1])
     for key in ("metric", "value", "unit", "vs_baseline"):
         assert key in rec, rec
     assert rec["value"] > 0
+    # The final (driver-visible) line records why there is no TPU number.
+    assert "tpu_probe_attempts" in rec["detail"]
 
 
 def test_bench_dsa_mode_cpu_smoke():
@@ -33,8 +36,8 @@ def test_bench_dsa_mode_cpu_smoke():
     )
     assert out.returncode == 0, out.stderr[-1500:]
     json_lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
-    assert len(json_lines) == 1, out.stdout
-    rec = json.loads(json_lines[0])
+    assert json_lines, out.stdout
+    rec = json.loads(json_lines[-1])
     assert rec["value"] > 0
     assert rec["detail"]["bench_model"] == "dsa"
     assert "ttft_p50_ms" in rec["detail"]

@@ -20,7 +20,10 @@ from parallax_tpu.benchmark.serving import (
     arrival_times,
     compute_metrics,
     run_benchmark,
+    sample_hf_requests,
     sample_random_requests,
+    sample_sharegpt_requests,
+    sample_wildchat_requests,
 )
 from parallax_tpu.config import normalize_config
 from parallax_tpu.models.base import StageModel
@@ -76,6 +79,112 @@ class TestMetricsMath:
 
     def test_inf_rate_all_at_zero(self):
         assert arrival_times(5, float("inf")) == [0.0] * 5
+
+
+class TestDatasetLoaders:
+    """ShareGPT / WildChat / HF samplers (reference
+    benchmark_serving.py:147-287 semantics)."""
+
+    @staticmethod
+    def _sharegpt_records():
+        long_prompt = " ".join(["word"] * 40)
+        reply = " ".join(["out"] * 12)
+        return [
+            # usable: 40-word prompt, 12-word reply
+            {"conversations": [{"value": long_prompt}, {"value": reply}]},
+            # pruned: prompt too short (<4 tokens)
+            {"conversations": [{"value": "hi"}, {"value": reply}]},
+            # pruned: reply too short when output length is data-derived
+            {"conversations": [{"value": long_prompt}, {"value": "ok"}]},
+            # pruned: single turn
+            {"conversations": [{"value": long_prompt}]},
+            # pruned: prompt over 1024 tokens
+            {"conversations": [{"value": " ".join(["w"] * 1100)},
+                               {"value": reply}]},
+        ]
+
+    def test_sharegpt_filters_and_lengths(self, tmp_path):
+        path = tmp_path / "sharegpt.json"
+        path.write_text(json.dumps(self._sharegpt_records()))
+        specs = sample_sharegpt_requests(str(path), num=10)
+        assert len(specs) == 1
+        assert specs[0].prompt_len == 40
+        assert specs[0].max_tokens == 12   # derived from the reply
+
+    def test_sharegpt_fixed_output_len_keeps_short_replies(self, tmp_path):
+        path = tmp_path / "sharegpt.json"
+        path.write_text(json.dumps(self._sharegpt_records()))
+        specs = sample_sharegpt_requests(str(path), num=10,
+                                         fixed_output_len=7)
+        # fixed output budget: the short-reply record survives too
+        assert len(specs) == 2
+        assert all(s.max_tokens == 7 for s in specs)
+
+    def test_sharegpt_respects_num_cap(self, tmp_path):
+        long_prompt = " ".join(["word"] * 20)
+        recs = [
+            {"conversations": [{"value": f"{i} {long_prompt}"},
+                               {"value": long_prompt}]}
+            for i in range(30)
+        ]
+        path = tmp_path / "sharegpt.json"
+        path.write_text(json.dumps(recs))
+        assert len(sample_sharegpt_requests(str(path), num=5)) == 5
+
+    def test_wildchat_from_local_fixture(self, monkeypatch):
+        import datasets as hf_datasets
+
+        import parallax_tpu.benchmark.serving as serving
+
+        rows = [
+            {"conversation": [
+                {"role": "user", "content": " ".join(["q"] * 16)},
+                {"role": "assistant", "content": " ".join(["a"] * 9)},
+            ]},
+            {"conversation": [
+                {"role": "user", "content": "too short"},
+            ]},
+        ]
+        fixture = hf_datasets.Dataset.from_list(rows)
+        monkeypatch.setattr(
+            serving, "_load_hf_dataset",
+            lambda path, subset, split, streaming=False: fixture,
+        )
+        specs = sample_wildchat_requests("any", num=5)
+        assert len(specs) == 1
+        assert specs[0].prompt_len == 16 and specs[0].max_tokens == 9
+
+    def test_hf_requires_conversations_column(self, monkeypatch):
+        import datasets as hf_datasets
+
+        import parallax_tpu.benchmark.serving as serving
+
+        fixture = hf_datasets.Dataset.from_list([{"text": "nope"}])
+        monkeypatch.setattr(
+            serving, "_load_hf_dataset",
+            lambda *a, **k: fixture,
+        )
+        with pytest.raises(ValueError, match="conversations"):
+            sample_hf_requests("any", None, "train", num=5)
+
+    def test_hf_sharegpt_shaped_rows(self, monkeypatch):
+        import datasets as hf_datasets
+
+        import parallax_tpu.benchmark.serving as serving
+
+        rows = [
+            {"conversations": [{"value": " ".join(["q"] * 10)},
+                               {"value": " ".join(["a"] * 6)}]},
+            {"conversations": [{"value": "solo"}]},
+        ]
+        fixture = hf_datasets.Dataset.from_list(rows)
+        monkeypatch.setattr(
+            serving, "_load_hf_dataset",
+            lambda *a, **k: fixture,
+        )
+        specs = sample_hf_requests("any", None, "train", num=5)
+        assert len(specs) == 1
+        assert specs[0].prompt_len == 10 and specs[0].max_tokens == 6
 
 
 def test_benchmark_against_live_server():
