@@ -459,6 +459,9 @@ class OpenAIFrontend:
             sampling_params=sampling_params,
             routing_table=routing_table,
             eos_token_ids=tuple(self.tokenizer.eos_token_ids),
+            # Per-request adapter (reference Req.lora_path): "lora" in the
+            # body selects an adapter registered at every stage.
+            lora_id=body.get("lora"),
         )
         # Count at accept time, not in usage formatting: client disconnects
         # mid-stream must still be visible in /metrics.
@@ -531,6 +534,7 @@ class OpenAIFrontend:
                 sampling_params=sp,
                 routing_table=list(routing_table),
                 eos_token_ids=tuple(self.tokenizer.eos_token_ids),
+                lora_id=body.get("lora"),
             )
             try:
                 done = await asyncio.to_thread(self.submit_fn, req)

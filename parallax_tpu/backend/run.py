@@ -49,6 +49,7 @@ class SwarmClient:
                 "sampling_params": request.sampling_params.to_dict(),
                 "routing_table": request.routing_table,
                 "eos_token_ids": list(request.eos_token_ids),
+                "lora_id": request.lora_id,
             }, timeout=30.0)
         except Exception:
             # The workers never saw this request; release the load the
@@ -214,7 +215,10 @@ def run_main(args) -> int:
     scheduler = GlobalScheduler(
         model, min_nodes_bootstrapping=args.min_nodes
     )
-    transport = TcpTransport("scheduler", "0.0.0.0", args.port + 1)
+    transport = TcpTransport(
+        "scheduler", "0.0.0.0", args.port + 1,
+        relay_token=getattr(args, "relay_token", None),
+    )
     frontend, service, _client = build_swarm_frontend(
         scheduler, transport, tokenizer, args.model_name,
         resolve_model=resolve_model,

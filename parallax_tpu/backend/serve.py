@@ -259,6 +259,12 @@ def serve_main(args) -> int:
         sp_mesh=sp_mesh,
         draft=draft,
     )
+    from parallax_tpu.ops.lora import parse_adapter_spec
+
+    for name, path in parse_adapter_spec(
+        getattr(args, "lora_adapters", None)
+    ).items():
+        engine.load_adapter(name, path)
     tokenizer = load_tokenizer(args.model_path)
     frontend, _runner = build_local_frontend(
         [engine], tokenizer, model_name=args.model_path

@@ -44,6 +44,10 @@ def build_parser() -> argparse.ArgumentParser:
                        help="weight-only quantize an fp checkpoint on load")
     serve.add_argument("--lora-path", default=None,
                        help="PEFT LoRA adapter directory to merge at load")
+    serve.add_argument("--lora-adapters", default=None,
+                       help="per-request adapters: name=peft_dir[,name=dir] "
+                            "— requests select one via the 'lora' body "
+                            "field (unmerged; batch-grouped at serving)")
     serve.add_argument("--decode-lookahead", type=int, default=1,
                        help="greedy decode tokens per jit dispatch "
                             "(single-stage serving; 1 = off)")
@@ -70,6 +74,11 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--model-name", required=True)
     run.add_argument("--min-nodes", type=int, default=1)
     run.add_argument("--port", type=int, default=3001)
+    run.add_argument(
+        "--relay-token", default=None,
+        help="shared secret NAT'd workers must present to register a "
+             "relay route (default: registration is identity-bound only)",
+    )
 
     join = sub.add_parser("join", help="join a swarm as a worker")
     join.add_argument("--scheduler-addr", required=True)
@@ -86,6 +95,16 @@ def build_parser() -> argparse.ArgumentParser:
         "--relay", action="store_true",
         help="NAT'd worker: no inbound dials — keep a reverse connection "
              "at the scheduler and receive pp-forwards relayed through it",
+    )
+    join.add_argument(
+        "--relay-token", default=None,
+        help="shared secret presented when registering the relay route "
+             "(must match the scheduler's --relay-token)",
+    )
+    join.add_argument(
+        "--lora-adapters", default=None,
+        help="per-request adapters this worker serves: "
+             "name=peft_dir[,name=dir]",
     )
 
     bench = sub.add_parser("bench", help="offline throughput benchmark")

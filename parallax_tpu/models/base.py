@@ -45,6 +45,10 @@ class BatchInputs:
     # 1 on a request's first chunk: its (possibly reused) slot must be
     # zeroed before use.
     reset_state: jax.Array | None = None  # i32[S]
+    # Per-request LoRA: {"slot": i32[], "layers": stacked adapter pytree}
+    # for a batch the scheduler grouped under one adapter; None for base
+    # traffic (which keeps its adapter-free graph). See ops/lora.py.
+    lora: dict | None = None
     # STATIC: every segment is a single decode token (row i == sequence i).
     # Part of the jit cache key — decode steps compile their own variant so
     # decode-specialized kernels (Pallas MLA) can dispatch on it.
@@ -235,9 +239,19 @@ class StageModel:
         else:
             x = inputs.hidden_states
 
+        lora_sel = None
+        if inputs.lora is not None:
+            from parallax_tpu.ops.lora import select_slot
+
+            lora_sel = select_slot(inputs.lora)
+
         new_kv: list[jax.Array] = []
         for li in range(self.num_local_layers):
             lp = params["layers"][li]
+            if lora_sel is not None and str(li) in lora_sel:
+                from parallax_tpu.ops.lora import merge_layer_lora
+
+                lp = merge_layer_lora(lp, lora_sel[str(li)])
             gi = self.start_layer + li
             window = (
                 cfg.sliding_window

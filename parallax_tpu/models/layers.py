@@ -82,6 +82,21 @@ def get_weight(p: dict) -> jax.Array:
     return w
 
 
+def _lora_delta(x: jax.Array, ab: dict) -> jax.Array:
+    """Per-request LoRA correction ``(x @ A^T) @ B^T * scale`` in fp32
+    (``A [r, in]``, ``B [out, r]`` — two thin MXU matmuls)."""
+    a = jax.lax.dot_general(
+        x, ab["A"],
+        dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return jax.lax.dot_general(
+        a, ab["B"],
+        dimension_numbers=(((a.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * ab["s"]
+
+
 def linear(x: jax.Array, p: dict) -> jax.Array:
     """x @ W^T + b with HF [out, in] weight layout kept as stored.
 
@@ -93,6 +108,8 @@ def linear(x: jax.Array, p: dict) -> jax.Array:
         dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ).astype(x.dtype)
+    if "lora" in p:
+        out = out + _lora_delta(x, p["lora"]).astype(out.dtype)
     if "bias" in p:
         out = out + p["bias"].astype(out.dtype)
     return out
@@ -134,6 +151,10 @@ def row_parallel_linear(
         dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ).astype(x.dtype)
+    if "lora" in p:
+        # Unsharded only (the engine refuses per-request adapters on TP
+        # stages): applied before the no-op psum for symmetry with linear.
+        out = out + _lora_delta(x, p["lora"]).astype(out.dtype)
     if axis_name is not None:
         out = jax.lax.psum(out, axis_name)
     if "bias" in p:

@@ -1,0 +1,218 @@
+"""Per-request LoRA adapters for multi-tenant serving.
+
+Capability parity: reference per-request ``lora_path`` on the wire
+(``src/parallax/p2p/proto/forward.proto`` ``Req.lora_path``) and the
+adapter suite in ``src/parallax/server/shard_loader.py:114-227``.
+
+TPU re-design: adapters are never merged into the base weights at
+serving time. All registered adapters' ``A``/``B`` matrices are stacked
+into fixed-shape device arrays ``[num_slots, ...]`` (ranks zero-padded
+to the set's max), the local scheduler groups every dispatched batch by
+adapter, and the batch's slot index rides into the jitted step as a
+traced scalar: the model selects its adapter weights with
+``lax.dynamic_index_in_dim`` inside the graph and applies the delta as
+two thin matmuls per projection (``(x @ A^T) @ B^T * scale``). One
+compiled program therefore serves every adapter, base traffic keeps its
+adapter-free graph, and no weight copies ever cross the host.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import OrderedDict
+
+import numpy as np
+
+from parallax_tpu.utils import get_logger
+
+logger = get_logger(__name__)
+
+# Projections a per-request adapter may target, as ``group.proj`` paths
+# inside one decoder layer's param dict.
+SUPPORTED_PROJS = (
+    "self_attn.q_proj", "self_attn.k_proj", "self_attn.v_proj",
+    "self_attn.o_proj",
+    "mlp.gate_proj", "mlp.up_proj", "mlp.down_proj",
+)
+
+_LAYER_RE = re.compile(r"(?:^|\.)layers\.(\d+)\.(.+)$")
+
+
+def adapter_tree_from_peft(
+    adapter_path: str, start_layer: int, end_layer: int
+) -> dict:
+    """Load a PEFT adapter directory into this stage's adapter tree:
+    ``{local_layer_idx: {"group.proj": (A [r,in], B [out,r], scale)}}``.
+
+    Modules outside ``[start_layer, end_layer)`` are ignored (they belong
+    to other pipeline stages); unsupported target modules raise."""
+    from parallax_tpu.utils.adapter import _load_adapter
+
+    pairs, scales = _load_adapter(adapter_path)
+    tree: dict[int, dict[str, tuple]] = {}
+    for mod, ab in pairs.items():
+        m = _LAYER_RE.search(mod)
+        if m is None:
+            raise ValueError(
+                f"unsupported adapter target {mod!r} (per-request adapters "
+                "cover decoder-layer projections only)"
+            )
+        gi, path = int(m.group(1)), m.group(2)
+        if path not in SUPPORTED_PROJS:
+            raise ValueError(f"unsupported adapter target {mod!r}")
+        if not (start_layer <= gi < end_layer):
+            continue
+        if "M" in ab:
+            raise ValueError(
+                "DoRA adapters cannot be applied per-request; merge "
+                "offline with `cli lora-merge`"
+            )
+        tree.setdefault(gi - start_layer, {})[path] = (
+            np.asarray(ab["A"], np.float32),
+            np.asarray(ab["B"], np.float32),
+            float(scales[mod]),
+        )
+    if not tree:
+        # Legitimate for a mid-pipeline stage when the adapter targets
+        # only other stages' layers; its delta is a no-op here.
+        logger.warning(
+            "adapter at %s has no modules in layers [%d, %d)",
+            adapter_path, start_layer, end_layer,
+        )
+    return tree
+
+
+def parse_adapter_spec(spec: str | None) -> dict[str, str]:
+    """CLI ``name=peft_dir[,name=dir]`` -> {name: dir}."""
+    out: dict[str, str] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"bad --lora-adapters entry {part!r} (want name=path)"
+            )
+        name, path = part.split("=", 1)
+        out[name.strip()] = path.strip()
+    return out
+
+
+class AdapterSet:
+    """Registered adapters of one stage, stacked for in-graph selection.
+
+    Registration is rare (admin-plane); every (re)build stacks all
+    adapters into ``[num_slots, ...]`` device arrays, which changes the
+    lora pytree's shapes and thus retraces the step on the next lora
+    batch — steady-state serving pays nothing.
+    """
+
+    def __init__(self):
+        self._adapters: "OrderedDict[str, dict]" = OrderedDict()
+        self._stacked = None   # {"layers": {...}} device pytree
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._adapters
+
+    @property
+    def names(self) -> list[str]:
+        return list(self._adapters)
+
+    def register(self, name: str, tree: dict) -> None:
+        """``tree``: {local_layer: {"group.proj": (A, B, scale)}}."""
+        for layer_tree in tree.values():
+            for path in layer_tree:
+                if path not in SUPPORTED_PROJS:
+                    raise ValueError(f"unsupported adapter path {path!r}")
+        self._adapters[name] = tree
+        self._stacked = None
+        logger.info("registered LoRA adapter %r (%d total)", name,
+                    len(self._adapters))
+
+    def slot_of(self, name: str) -> int:
+        return list(self._adapters).index(name)
+
+    def batch_field(self, name: str) -> dict:
+        """The ``BatchInputs.lora`` value for a batch using ``name``:
+        ``{"slot": i32[], "layers": {li: {path: {"A","B","s"}}}}``."""
+        import jax.numpy as jnp
+
+        if self._stacked is None:
+            self._stacked = self._stack()
+        return {
+            "slot": jnp.asarray(self.slot_of(name), jnp.int32),
+            "layers": self._stacked,
+        }
+
+    def _stack(self) -> dict:
+        import jax.numpy as jnp
+
+        n = len(self._adapters)
+        # Union of (layer, path) across adapters; missing entries are
+        # zero-filled so their delta vanishes.
+        sites: dict[tuple[int, str], tuple[int, int, int]] = {}
+        for tree in self._adapters.values():
+            for li, layer_tree in tree.items():
+                for path, (a, b, _s) in layer_tree.items():
+                    r, in_dim = a.shape
+                    out_dim = b.shape[0]
+                    prev = sites.get((li, path))
+                    if prev is not None:
+                        if (prev[1], prev[2]) != (in_dim, out_dim):
+                            raise ValueError(
+                                f"adapter shape mismatch at layer {li} "
+                                f"{path}: {prev[1:]} vs "
+                                f"{(in_dim, out_dim)}"
+                            )
+                        r = max(r, prev[0])
+                    sites[(li, path)] = (r, in_dim, out_dim)
+
+        stacked: dict[str, dict[str, dict]] = {}
+        for (li, path), (r, in_dim, out_dim) in sites.items():
+            a_stack = np.zeros((n, r, in_dim), np.float32)
+            b_stack = np.zeros((n, out_dim, r), np.float32)
+            s_stack = np.zeros((n,), np.float32)
+            for slot, tree in enumerate(self._adapters.values()):
+                ent = tree.get(li, {}).get(path)
+                if ent is None:
+                    continue
+                a, b, s = ent
+                a_stack[slot, : a.shape[0]] = a
+                b_stack[slot, :, : b.shape[1]] = b
+                s_stack[slot] = s
+            stacked.setdefault(str(li), {})[path] = {
+                "A": jnp.asarray(a_stack),
+                "B": jnp.asarray(b_stack),
+                "s": jnp.asarray(s_stack),
+            }
+        return stacked
+
+
+def select_slot(lora: dict):
+    """Inside-jit: slice every stacked array down to the batch's slot."""
+    import jax
+    from jax import lax
+
+    return jax.tree.map(
+        lambda a: lax.dynamic_index_in_dim(a, lora["slot"], 0,
+                                           keepdims=False),
+        lora["layers"],
+    )
+
+
+def merge_layer_lora(lp: dict, layer_sel: dict | None) -> dict:
+    """Shallow-copy a layer's param dict with ``{"lora": {A,B,s}}``
+    attached to each adapted projection (consumed by ``layers.linear``).
+    Paths absent from this layer's params are skipped (a subclass with a
+    different block structure simply never sees the delta)."""
+    if not layer_sel:
+        return lp
+    lp = dict(lp)
+    for path, ab in layer_sel.items():
+        grp, proj = path.split(".")
+        if grp not in lp or proj not in lp[grp]:
+            continue
+        lp[grp] = dict(lp[grp])
+        lp[grp][proj] = dict(lp[grp][proj])
+        lp[grp][proj]["lora"] = ab
+    return lp

@@ -47,7 +47,10 @@ def join_main(args) -> int:
 
     # Scheduler RPC rides one port above its HTTP port by convention.
     scheduler_peer = args.scheduler_addr
-    transport = TcpTransport("", "0.0.0.0", args.port)
+    transport = TcpTransport(
+        "", "0.0.0.0", args.port,
+        relay_token=getattr(args, "relay_token", None),
+    )
     transport.start()
     if getattr(args, "relay", False):
         # NAT'd worker: no inbound dials — keep a reverse connection at
@@ -99,6 +102,8 @@ def join_main(args) -> int:
     n_devices = len(jax.local_devices())
     mesh = make_mesh(tp_size=n_devices) if n_devices > 1 else None
 
+    from parallax_tpu.ops.lora import parse_adapter_spec
+
     node = WorkerNode(
         transport=transport,
         scheduler_peer=scheduler_peer,
@@ -110,6 +115,9 @@ def join_main(args) -> int:
         refit_cache_dir=getattr(args, "refit_cache_dir", None),
         resolve_model=resolve_model,
         tokenizer_path=args.model_path,
+        lora_adapters=parse_adapter_spec(
+            getattr(args, "lora_adapters", None)
+        ),
     )
     node.start()
     logger.info("worker %s joined %s", node.node_id, scheduler_peer)

@@ -56,6 +56,7 @@ class WorkerNode:
         refit_cache_dir: str | None = None,
         resolve_model=None,  # callable (name) -> (ModelConfig, load_params|None)
         tokenizer_path: str | None = None,
+        lora_adapters: dict | None = None,  # name -> PEFT dir or tree
     ):
         self.transport = transport
         self.scheduler_peer = scheduler_peer
@@ -67,6 +68,7 @@ class WorkerNode:
         self.tp_size = tp_size
         self.resolve_model = resolve_model
         self.tokenizer_path = tokenizer_path
+        self.lora_adapters = dict(lora_adapters or {})
         self._grammar_vocab: tuple | None = None
         self._served_model_name: str | None = None
         self.refit_store = None
@@ -170,6 +172,13 @@ class WorkerNode:
         self.engine = StageEngine(
             model, params, self.engine_config, mesh=self.mesh
         )
+        for name, source in self.lora_adapters.items():
+            # Each (re)allocation re-registers every adapter against the
+            # stage's new layer range.
+            try:
+                self.engine.load_adapter(name, source)
+            except (ValueError, OSError) as e:
+                logger.warning("adapter %r failed to load: %s", name, e)
         if model.is_last:
             self._wire_grammar()
         self._restore_refit_cache()
@@ -398,6 +407,7 @@ class WorkerNode:
             ),
             routing_table=list(payload.get("routing_table") or []),
             eos_token_ids=tuple(payload.get("eos_token_ids") or ()),
+            lora_id=payload.get("lora_id"),
         )
         self._chat_requests[req.request_id] = req
         self.submit(req)

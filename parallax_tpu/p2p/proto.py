@@ -64,6 +64,7 @@ def ireq_to_wire(ireq: IntermediateRequest) -> dict:
         "spec_len": ireq.spec_len,
         "spec_accepted": ireq.spec_accepted,
         "cached_prefix_ids": ireq.cached_prefix_ids,
+        "lora_id": ireq.lora_id,
     }
 
 
@@ -83,6 +84,7 @@ def ireq_from_wire(d: dict) -> IntermediateRequest:
         spec_len=d.get("spec_len", 0),
         spec_accepted=d.get("spec_accepted"),
         cached_prefix_ids=d.get("cached_prefix_ids"),
+        lora_id=d.get("lora_id"),
     )
 
 

@@ -112,10 +112,16 @@ class TcpTransport(Transport):
     it in ``re``.
     """
 
-    def __init__(self, peer_id: str, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, peer_id: str, host: str = "127.0.0.1", port: int = 0,
+                 relay_token: str | None = None):
         super().__init__(peer_id)
         self.host = host
         self.port = port
+        # Shared swarm secret for relay registration. As the relay: any
+        # registration must present it. As a NAT'd worker: presented in
+        # register_at_relay. None disables the token check (identity
+        # binding below still applies).
+        self.relay_token = relay_token
         # Dedicated handler pool: blocking handlers (node_join polls for an
         # allocation for up to minutes) must not starve heartbeats or data
         # frames, and asyncio.to_thread's default pool is small.
@@ -235,22 +241,44 @@ class TcpTransport(Transport):
         """Transport-level relay frames; True when consumed."""
         t = frame["t"]
         if t == "__relay_register__":
-            prev = self._relay_routes.get(frame["p"])
+            p = frame["p"]
+            if isinstance(p, dict):
+                rid, token = p.get("id"), p.get("token")
+            else:   # legacy bare-id registration
+                rid, token = p, None
+            # Identity binding: a registration may only claim the id the
+            # connection introduced itself with (__hello__). Stops one
+            # worker's frames from being silently rerouted to whichever
+            # connection registered last under a stolen id.
+            if rid != peer_name:
+                logger.warning(
+                    "relay: REJECTED registration for %s from connection "
+                    "hello'd as %s (identity mismatch)", rid, peer_name,
+                )
+                return True
+            # Token check: with a swarm secret configured, hello identity
+            # alone (which a hostile peer can fake) is not enough.
+            if self.relay_token is not None and token != self.relay_token:
+                logger.warning(
+                    "relay: REJECTED registration for %s (bad or missing "
+                    "relay token)", rid,
+                )
+                return True
+            prev = self._relay_routes.get(rid)
             if prev is not None and prev is not writer and not prev.is_closing():
-                # A LIVE route replaced by a different connection is either
-                # a worker reconnect the old socket hasn't noticed yet or a
-                # registration hijack (the relay endpoint is unauthenticated
-                # inside the swarm's trust boundary) — say so loudly either
-                # way so operators can correlate.
+                # A LIVE route replaced by a different connection is a
+                # worker reconnect the old socket hasn't noticed yet (or,
+                # without a token, a hijack by an id-faking peer) — say so
+                # loudly so operators can correlate.
                 logger.warning(
                     "relay: reverse route for %s replaced by a different "
-                    "live connection (reconnect or hijack)", frame["p"],
+                    "live connection (reconnect or hijack)", rid,
                 )
-            self._relay_routes[frame["p"]] = writer
+            self._relay_routes[rid] = writer
             # Heartbeat refreshes are routine; only NEW routes are news.
             logger.log(
                 20 if prev is None else 10,
-                "relay: registered reverse route for %s", frame["p"],
+                "relay: registered reverse route for %s", rid,
             )
             return True
         if t == "__relay__":
@@ -453,7 +481,11 @@ class TcpTransport(Transport):
             async with lock:
                 self._write_frame(
                     writer,
-                    encode_frame("__relay_register__", self.peer_id, msg_id=0),
+                    encode_frame(
+                        "__relay_register__",
+                        {"id": self.peer_id, "token": self.relay_token},
+                        msg_id=0,
+                    ),
                 )
                 await writer.drain()
 

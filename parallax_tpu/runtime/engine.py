@@ -300,6 +300,9 @@ class StageEngine:
         self._base_key = jax.random.key(self.cfg.seed)
         self._jit_multistep = None
         self._jit_multistep_sampled = None
+        # Per-request LoRA adapters (ops/lora.py); None until the first
+        # load_adapter so base-only serving never touches the machinery.
+        self._adapters = None
         self._step_count = 0
         # Non-head stages: hidden rows waiting per request id.
         self._pending_hidden: dict[str, np.ndarray] = {}
@@ -351,6 +354,42 @@ class StageEngine:
 
     def _stage_fn(self, params, kv, inputs: BatchInputs):
         return self.model(params, kv, inputs)
+
+    # -- per-request LoRA --------------------------------------------------
+
+    def load_adapter(self, name: str, source) -> None:
+        """Register a LoRA adapter for per-request serving.
+
+        ``source``: a PEFT adapter directory (this stage slices out its
+        own layers) or a prebuilt tree ``{local_layer: {"group.proj":
+        (A, B, scale)}}``. Requests carrying ``lora_id=name`` are then
+        batch-grouped by the scheduler and served with the adapter's
+        delta applied in-graph (reference per-request ``lora_path``,
+        forward.proto + shard_loader.py:114-227).
+        """
+        if self.model.tp_size > 1:
+            raise ValueError(
+                "per-request LoRA is not supported on TP-sharded stages; "
+                "merge offline with `cli lora-merge`"
+            )
+        from parallax_tpu.ops.lora import AdapterSet, adapter_tree_from_peft
+
+        if self._adapters is None:
+            self._adapters = AdapterSet()
+        tree = source
+        if isinstance(source, str):
+            tree = adapter_tree_from_peft(
+                source, self.model.start_layer, self.model.end_layer
+            )
+        self._adapters.register(name, tree)
+
+    def has_adapter(self, name: str) -> bool:
+        return self._adapters is not None and name in self._adapters
+
+    def _lora_field(self, plan: BatchPlan):
+        if plan.lora_id is None or self._adapters is None:
+            return None
+        return self._adapters.batch_field(plan.lora_id)
 
     def _model_supports_sp(self, model: StageModel) -> bool:
         """Ring-attention prefill covers only the plain full-causal GQA
@@ -416,6 +455,7 @@ class StageEngine:
                 prompt_ids=prefix + list(new_tokens),
                 sampling_params=SamplingParams.from_dict(ireq.sampling_params or {}),
                 routing_table=list(ireq.routing_table),
+                lora_id=ireq.lora_id,
             )
             req.is_mirror = True  # type: ignore[attr-defined]
             if prefix:
@@ -684,6 +724,9 @@ class StageEngine:
         inputs = assemble(
             plan, self.spec, self.cfg.page_size, decode_only=True
         )
+        lora = self._lora_field(plan)
+        if lora is not None:
+            inputs = dataclasses.replace(inputs, lora=lora)
         samp = None
         if sampled:
             s = int(inputs.kv_lens.shape[0])
@@ -874,10 +917,13 @@ class StageEngine:
             )
             for seg, prop in zip(plan.seqs, proposals)
         ]
-        spec_plan = BatchPlan(spec_segs)
+        spec_plan = BatchPlan(spec_segs, lora_id=plan.lora_id)
         inputs = assemble(
             spec_plan, self.spec, self.cfg.page_size, gather_all_logits=True
         )
+        lora = self._lora_field(spec_plan)
+        if lora is not None:
+            inputs = dataclasses.replace(inputs, lora=lora)
         logits, self.kv = self._jit_step(self.params, self.kv, inputs)
         from parallax_tpu.ops.sampling import greedy_tokens
 
@@ -1022,6 +1068,14 @@ class StageEngine:
         plan = sp_plan if sp_plan is not None else self._form_plan()
         if plan.is_empty:
             return StepOutputs(forward=[], finished=self._collect_finished())
+        if plan.lora_id is not None and not self.has_adapter(plan.lora_id):
+            # Unknown adapter: fail the whole (single-adapter) batch with
+            # a clear reason instead of silently serving base weights.
+            for seg in plan.seqs:
+                seg.request.abort(
+                    f"unknown lora adapter {plan.lora_id!r}"
+                )
+            return StepOutputs(forward=[], finished=self._collect_finished())
 
         if sp_plan is None:
             committed = self._try_speculative(plan)
@@ -1092,6 +1146,9 @@ class StageEngine:
                 with_dense_map=self._needs_state, decode_only=decode_only,
                 gather_all_logits=bool(spec_rows),
             )
+            lora = self._lora_field(plan)
+            if lora is not None:
+                inputs = dataclasses.replace(inputs, lora=lora)
             out, self.kv = self._jit_step(self.params, self.kv, inputs)
 
         # Advance scheduler state first: a locally-committed sampled token
@@ -1194,7 +1251,9 @@ class StageEngine:
                 # the step token budget — defer to the next step.
                 continue
             usable.append(s)
-        return BatchPlan(usable)
+        # form_batch grouped by adapter; the availability filter must not
+        # drop the group's lora_id (downstream stages apply deltas too).
+        return BatchPlan(usable, lora_id=plan.lora_id)
 
     def _take_hidden(self, rid: str, n: int) -> np.ndarray:
         buf = self._pending_hidden[rid]
@@ -1461,6 +1520,7 @@ class StageEngine:
                     ),
                     spec_len=spec_len,
                     cached_prefix_ids=prefix_ids,
+                    lora_id=req.lora_id,
                 )
             )
             row += n

@@ -112,6 +112,11 @@ class Request:
     # Filled when decoding starts; used by the decode-ready gating.
     ready_for_step: bool = True
     abort_reason: str | None = None
+    # Per-request LoRA adapter name (reference ``Req.lora_path``,
+    # forward.proto). None = base model. The local scheduler groups each
+    # dispatched batch by this id; every stage must have the adapter
+    # registered (StageEngine.load_adapter).
+    lora_id: str | None = None
 
     @property
     def num_prompt_tokens(self) -> int:
@@ -201,6 +206,9 @@ class IntermediateRequest:
     # align its own prefix match to the same absolute positions (the
     # packet's hidden rows start at position len(cached_prefix_ids)).
     cached_prefix_ids: list[int] | None = None
+    # Per-request LoRA adapter (reference ``Req.lora_path``,
+    # forward.proto:1-57): downstream stages apply their layers' deltas.
+    lora_id: str | None = None
 
     @property
     def is_prefill(self) -> bool:

@@ -36,6 +36,9 @@ class BatchPlan:
     """Everything the executor needs to build device inputs for one step."""
 
     seqs: list[ScheduledSeq]
+    # The single LoRA adapter every seq in this batch uses (None = base):
+    # one adapter per dispatch keeps the in-graph slot selection scalar.
+    lora_id: str | None = None
 
     @property
     def total_new_tokens(self) -> int:
@@ -135,6 +138,10 @@ class Scheduler:
         for req in self.running.values():
             if req.status is not RequestStatus.PREFILLING:
                 continue
+            if req.lora_id is not None:
+                # The ring-attention SP step does not carry adapter
+                # weights; LoRA prompts take the chunked-prefill path.
+                continue
             n = req.num_prompt_tokens
             if req.num_computed_tokens != 0 or n < threshold:
                 continue
@@ -166,11 +173,20 @@ class Scheduler:
         seqs: list[ScheduledSeq] = []
         token_budget = self.max_num_tokens_per_batch
 
+        # One LoRA adapter per batch (in-graph slot selection is scalar):
+        # the batch takes the adapter of the first schedulable request,
+        # and other-adapter requests wait for a later step. _UNSET (not
+        # None) so base traffic groups too.
+        _UNSET = object()
+        batch_lora = _UNSET
+
         # Prefill chunks first (including re-chunked long prompts).
         for req in self.running.values():
             if len(seqs) >= self.max_batch_size or token_budget <= 0:
                 break
             if req.status is not RequestStatus.PREFILLING:
+                continue
+            if batch_lora is not _UNSET and req.lora_id != batch_lora:
                 continue
             remaining = req.remaining_prompt_tokens()
             if remaining <= 0:
@@ -194,12 +210,15 @@ class Scheduler:
                 )
             )
             token_budget -= n
+            batch_lora = req.lora_id
 
         # Then ready decodes.
         for req in self.running.values():
             if len(seqs) >= self.max_batch_size or token_budget <= 0:
                 break
             if req.status is not RequestStatus.DECODING or not req.ready_for_step:
+                continue
+            if batch_lora is not _UNSET and req.lora_id != batch_lora:
                 continue
             if not self.cache.ensure_capacity(req, req.total_len):
                 self._abort_on_oom(req)
@@ -214,7 +233,10 @@ class Scheduler:
                 )
             )
             token_budget -= 1
-        return BatchPlan(seqs)
+            batch_lora = req.lora_id
+        return BatchPlan(
+            seqs, lora_id=None if batch_lora is _UNSET else batch_lora
+        )
 
     # -- step feedback ----------------------------------------------------
 

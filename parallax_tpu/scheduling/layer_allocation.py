@@ -162,52 +162,170 @@ class GreedyLayerAllocator(BaseLayerAllocator):
 
 
 class DPLayerAllocator(BaseLayerAllocator):
-    """Exact DP maximizing the number of full pipelines.
+    """Exact DP over the pipeline-count objective.
 
-    State: (node index, residual layers needed to close the open pipeline);
-    value: pipelines closed (tie-break: total spare capacity). The reference
-    solves a richer variant (layer_allocation.py:758-1015); this captures
-    the same objective for the fixed-pipeline serving mode.
+    For each feasible pipeline count ``k`` compute ``s*(k)``, the minimum
+    total number of stages realizing ``k`` full pipelines (DP state:
+    node index, sorted residuals of the open pipelines, pipelines
+    closed — the interleaved construction is what lets capacities like
+    (40, 40, 20, 20, 10, 10) over 70 layers close (40, 20, 10) twice
+    instead of one (40, 30) pipeline), then score
+
+        Z(k) = k**alpha / (compute_ms + (s*(k) / k) * hop_ms)
+
+    — throughput grows with k, per-request latency with stages per
+    pipeline — and keep the best k. Same objective family as the
+    reference DP (``layer_allocation.py:758-1015``), re-derived.
     """
 
-    def allocate(self, standby: list[Node]) -> list[Pipeline]:
-        nodes = sorted(standby, key=lambda n: n.layer_capacity(), reverse=True)
-        n = len(nodes)
-        L = self.num_layers
-        # dp[residual] = (pipelines_closed, assignment list) best at this point
-        # residual==0 means no open pipeline.
+    # The open-residuals DP state is exponential in node heterogeneity;
+    # past this pool size fall back to greedy (which is O(n log n) and
+    # what the reference does implicitly via its pruning cutoffs).
+    MAX_DP_NODES = 12
+
+    def __init__(self, num_layers: int, alpha: float = 2.0,
+                 hop_ms: float = 30.0):
+        super().__init__(num_layers)
+        self.alpha = alpha
+        self.hop_ms = hop_ms
+
+    def _min_stages(self, caps: list[int], k: int):
+        """(s*(k), plan) or (None, None); plan = list of (node_idx,
+        pipeline_slot) in assignment order."""
         from functools import lru_cache
 
-        caps = [min(x.layer_capacity(), L) for x in nodes]
+        n = len(caps)
+        L = self.num_layers
+        suffix = [0] * (n + 1)
+        for i in range(n - 1, -1, -1):
+            suffix[i] = suffix[i + 1] + caps[i]
+        INF = float("inf")
 
         @lru_cache(maxsize=None)
-        def best(i: int, residual: int) -> tuple[int, tuple]:
+        def dp(i: int, open_res: tuple, closed: int):
+            if closed == k and not open_res:
+                return 0
             if i == n:
-                return (0, ())
-            # Option 1: skip node i.
-            score_skip, plan_skip = best(i + 1, residual)
-            # Option 2: add node i to the open pipeline (or open one).
-            r = residual if residual > 0 else L
-            r2 = max(0, r - caps[i])
-            closed = 1 if r2 == 0 else 0
-            s, plan = best(i + 1, r2)
-            score_add = s + closed
-            if score_add > score_skip:
-                return (score_add, ((i, r2 == 0),) + plan)
-            return (score_skip, plan_skip)
+                return INF
+            # Prune: remaining capacity cannot cover what is still open
+            # plus the pipelines not yet started.
+            need = sum(open_res) + (k - closed - len(open_res)) * L
+            if suffix[i] < need:
+                return INF
+            best = dp(i + 1, open_res, closed)            # skip node i
+            for j, r in enumerate(set(open_res)):         # extend open j
+                r2 = r - caps[i]
+                rest = list(open_res)
+                rest.remove(r)
+                if r2 <= 0:
+                    cand = dp(i + 1, tuple(sorted(rest)), closed + 1)
+                else:
+                    cand = dp(i + 1, tuple(sorted(rest + [r2])), closed)
+                if 1 + cand < best:
+                    best = 1 + cand
+            if closed + len(open_res) < k:                # open new
+                r = L - caps[i]
+                if r <= 0:
+                    cand = dp(i + 1, open_res, closed + 1)
+                else:
+                    cand = dp(i + 1, tuple(sorted(open_res + (r,))),
+                              closed)
+                if 1 + cand < best:
+                    best = 1 + cand
+            return best
 
-        _, plan = best(0, 0)
-        best.cache_clear()
+        total = dp(0, (), 0)
+        if total == INF:
+            dp.cache_clear()
+            return None, None
 
+        # Greedy backtrack against the memo: replay the same transitions,
+        # taking any choice whose cost matches the optimum.
+        plan: list[tuple[int, int]] = []   # (node idx, open-slot id)
+        open_res: list[int] = []           # residual per open slot id
+        slot_ids: list[int] = []           # stable slot id per open entry
+        next_slot = 0
+        i, closed = 0, 0
+        remaining = total
+        while not (closed == k and not open_res):
+            key = tuple(sorted(open_res))
+            if dp(i + 1, key, closed) == remaining:
+                i += 1
+                continue
+            advanced = False
+            for j in range(len(open_res)):
+                r2 = open_res[j] - caps[i]
+                rest = open_res[:j] + open_res[j + 1:]
+                if r2 <= 0:
+                    cand = dp(i + 1, tuple(sorted(rest)), closed + 1)
+                else:
+                    cand = dp(i + 1, tuple(sorted(rest + [r2])), closed)
+                if 1 + cand == remaining:
+                    plan.append((i, slot_ids[j]))
+                    if r2 <= 0:
+                        del open_res[j], slot_ids[j]
+                        closed += 1
+                    else:
+                        open_res[j] = r2
+                    i += 1
+                    remaining -= 1
+                    advanced = True
+                    break
+            if advanced:
+                continue
+            r = self.num_layers - caps[i]
+            plan.append((i, next_slot))
+            if r <= 0:
+                closed += 1
+            else:
+                open_res.append(r)
+                slot_ids.append(next_slot)
+            next_slot += 1
+            i += 1
+            remaining -= 1
+        dp.cache_clear()
+        return total, plan
+
+    def allocate(self, standby: list[Node]) -> list[Pipeline]:
+        if len(standby) > self.MAX_DP_NODES:
+            return GreedyLayerAllocator(self.num_layers).allocate(standby)
+        nodes = sorted(standby, key=lambda n: n.layer_capacity(),
+                       reverse=True)
+        L = self.num_layers
+        caps = [min(x.layer_capacity(), L) for x in nodes]
+        total_cap = sum(caps)
+        if not nodes or total_cap < L:
+            return []
+        mean_layer_ms = sum(
+            n.layer_latency_ms() for n in nodes
+        ) / len(nodes)
+        compute_ms = max(L * mean_layer_ms, 1e-6)
+
+        best_score, best_plan, best_k = float("-inf"), None, 0
+        for k in range(1, min(len(nodes), total_cap // L) + 1):
+            s_star, plan = self._min_stages(caps, k)
+            if s_star is None:
+                continue
+            score = k ** self.alpha / (
+                compute_ms + (s_star / k) * self.hop_ms
+            )
+            if score > best_score:
+                best_score, best_plan, best_k = score, plan, k
+
+        if best_plan is None:
+            return []
+        groups: dict[int, list[Node]] = {}
+        order: list[int] = []
+        for idx, slot in best_plan:
+            if slot not in groups:
+                groups[slot] = []
+                order.append(slot)
+            groups[slot].append(nodes[idx])
         pipelines: list[Pipeline] = []
-        group: list[Node] = []
-        for idx, closes in plan:
-            group.append(nodes[idx])
-            if closes:
-                pipe = self._build_pipeline(group)
-                if pipe is not None:
-                    pipelines.append(pipe)
-                group = []
+        for slot in order:
+            pipe = self._build_pipeline(groups[slot])
+            if pipe is not None:
+                pipelines.append(pipe)
         return pipelines
 
 

@@ -170,6 +170,82 @@ class RandomizedRouting(RoutingStrategy):
         return self._rng.choices(paths, weights=weights, k=1)[0]
 
 
+def find_turning_points(
+    nodes: list[Node], num_layers: int
+) -> list[tuple[str, int, str]]:
+    """Layer-level DP over overlapping shards: where should the optimal
+    route switch nodes, and which hosted layers does that strand?
+
+    Capability parity: reference ``request_routing.py:86-177``. State is
+    (layer, hosting node); node cost is the per-layer latency proxy, edge
+    cost the RTT between distinct nodes. Backtracking the cheapest path
+    yields truncation advice for the allocator:
+
+    - ``(node, l, "tail")`` — the route leaves ``node`` at layer ``l``
+      even though it still hosts ``l``: the shard suffix ``[l, end)`` is
+      dead weight there.
+    - ``(node, l, "head")`` — the route first uses ``node`` at layer
+      ``l`` past its hosted start: the prefix ``[start, l)`` is dead.
+
+    Returns [] when some layer has no host (no complete route exists).
+    """
+    if num_layers <= 0 or not nodes:
+        return []
+    hosts: list[list[int]] = []
+    for layer in range(num_layers):
+        h = [
+            i for i, n in enumerate(nodes)
+            if n.has_allocation and n.start_layer <= layer < n.end_layer
+        ]
+        if not h:
+            return []
+        hosts.append(h)
+
+    INF = float("inf")
+    cost = {i: nodes[i].layer_latency_ms() for i in hosts[0]}
+    back: list[dict[int, int | None]] = [{i: None for i in hosts[0]}]
+    for layer in range(1, num_layers):
+        nxt: dict[int, float] = {}
+        bk: dict[int, int | None] = {}
+        for i in hosts[layer]:
+            lat = nodes[i].layer_latency_ms()
+            best, best_j = INF, None
+            for j, c in cost.items():
+                hop = 0.0 if j == i else (
+                    nodes[j].rtt_to(nodes[i].node_id) * 1e3
+                )
+                if c + hop + lat < best:
+                    best, best_j = c + hop + lat, j
+            nxt[i] = best
+            bk[i] = best_j
+        back.append(bk)
+        cost = nxt
+
+    end_i = min(cost, key=lambda k: cost[k])
+    path = [end_i]
+    for layer in range(num_layers - 1, 0, -1):
+        prev = back[layer][path[-1]]
+        if prev is None:
+            break
+        path.append(prev)
+    path.reverse()
+
+    turning: list[tuple[str, int, str]] = []
+    for layer in range(1, len(path)):
+        prev_i, cur_i = path[layer - 1], path[layer]
+        if prev_i == cur_i:
+            continue
+        if nodes[prev_i].end_layer > layer:
+            turning.append((nodes[prev_i].node_id, layer, "tail"))
+    first_used: dict[int, int] = {}
+    for layer, idx in enumerate(path):
+        first_used.setdefault(idx, layer)
+    for idx, l0 in first_used.items():
+        if l0 > nodes[idx].start_layer:
+            turning.append((nodes[idx].node_id, l0, "head"))
+    return turning
+
+
 def make_router(name: str, manager: NodeManager) -> RoutingStrategy:
     if name in ("rr", "round_robin"):
         return RoundRobinRouting(manager)

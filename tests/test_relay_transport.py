@@ -93,6 +93,75 @@ def test_relay_errors_propagate_end_to_end(trio):
         client.call(worker.peer_id, "boom", None, timeout=10.0)
 
 
+def test_relay_rejects_identity_mismatched_registration(trio):
+    """A second connection cannot steal a registered worker id: the
+    registration's claimed id must match the connection's hello identity."""
+    relay, worker, client = trio
+    worker.register("whoami", lambda _f, _p: "victim")
+    worker.register_at_relay(relay.address)
+    assert client.call(worker.peer_id, "whoami", None, timeout=10.0) == "victim"
+
+    # Attacker hello's as itself but registers the victim's id.
+    attacker = TcpTransport("", "127.0.0.1")
+    attacker.start()
+    attacker.peer_id = f"relay:attacker@{relay.address}"
+    try:
+        victim_id = worker.peer_id
+        import asyncio
+
+        async def _register_stolen():
+            _, w, lock = await attacker._get_conn(relay.address)
+            from parallax_tpu.p2p.proto import encode_frame
+
+            async with lock:
+                attacker._write_frame(w, encode_frame(
+                    "__relay_register__",
+                    {"id": victim_id, "token": None}, msg_id=0,
+                ))
+                await w.drain()
+
+        route_before = relay._relay_routes[victim_id]
+        asyncio.run_coroutine_threadsafe(
+            _register_stolen(), attacker._loop
+        ).result(10.0)
+        time.sleep(0.3)
+        # The relay's reverse route still points at the victim's own
+        # connection — the stolen registration was rejected.
+        assert relay._relay_routes[victim_id] is route_before
+        assert client.call(
+            worker.peer_id, "whoami", None, timeout=10.0
+        ) == "victim"
+    finally:
+        attacker.stop()
+
+
+def test_relay_token_required_when_configured():
+    """With a swarm secret on the relay, identity alone is not enough."""
+    relay = TcpTransport("relay-node", "127.0.0.1", relay_token="s3cret")
+    relay.start()
+    legit = TcpTransport("", "127.0.0.1", relay_token="s3cret")
+    legit.start()
+    legit.peer_id = f"relay:legit@{relay.address}"
+    intruder = TcpTransport("", "127.0.0.1", relay_token="wrong")
+    intruder.start()
+    intruder.peer_id = f"relay:intruder@{relay.address}"
+    client = TcpTransport("", "127.0.0.1")
+    client.start()
+    client.peer_id = client.address
+    try:
+        legit.register("ping3", make_ping_handler())
+        legit.register_at_relay(relay.address)
+        assert client.call(legit.peer_id, "ping3", None, timeout=10.0) == "pong"
+
+        intruder.register_at_relay(relay.address)
+        time.sleep(0.3)
+        assert intruder.peer_id not in relay._relay_routes
+        assert legit.peer_id in relay._relay_routes
+    finally:
+        for t in (relay, legit, intruder, client):
+            t.stop()
+
+
 def test_swarm_serves_through_a_relay_worker(monkeypatch):
     """Full swarm: one plain worker + one NAT'd relay worker behind the
     scheduler's transport serve a 2-stage pipeline end to end."""

@@ -14,7 +14,11 @@ from parallax_tpu.scheduling.layer_allocation import (
     water_fill_layers,
 )
 from parallax_tpu.scheduling.node import Node
-from parallax_tpu.scheduling.request_routing import DPRouting, RoundRobinRouting
+from parallax_tpu.scheduling.request_routing import (
+    DPRouting,
+    RoundRobinRouting,
+    find_turning_points,
+)
 from parallax_tpu.utils.hw import HardwareInfo
 
 MODEL = normalize_config(dict(
@@ -87,6 +91,82 @@ class TestAllocators:
         n1 = make_node("a")
         n1.set_layers(0, 14)  # layers 14..28 uncovered
         assert alloc.should_global_rebalance([n1])
+
+    @staticmethod
+    def _capped(nid, cap, lat=1.0):
+        n = make_node(nid)
+        n.layer_capacity = lambda c=cap: c     # type: ignore[method-assign]
+        n.measured_layer_latency_ms = lat
+        return n
+
+    def test_dp_interleaves_where_greedy_builds_one_pipeline(self):
+        """Reference DP's motivating case (layer_allocation.py:765-768):
+        capacities (40,40,20,20,10,10) over 70 layers — interleaved
+        construction closes (40,20,10) twice; greedy largest-first burns
+        both 40s on one pipeline and strands the rest."""
+        caps = [40, 40, 20, 20, 10, 10]
+        nodes = [self._capped(f"c{i}", c) for i, c in enumerate(caps)]
+        g = GreedyLayerAllocator(70).allocate([*nodes])
+        for n in nodes:
+            n.clear_layers()
+        d = DPLayerAllocator(70).allocate([*nodes])
+        assert len(g) == 1
+        assert len(d) == 2
+        for p in d:
+            p.validate(70)
+
+    def test_min_stages_prefers_single_big_node(self):
+        """s*(k=1) over capacities (70, 40, 30) is 1 stage — the DP must
+        pick the single 70-layer node, not chain 40+30."""
+        alloc = DPLayerAllocator(70)
+        s_star, plan = alloc._min_stages([70, 40, 30], 1)
+        assert s_star == 1
+        assert plan == [(0, 0)]
+
+    def test_objective_trades_stage_count_for_concurrency(self):
+        """(70, 35, 35): both k=1 (one 1-stage pipeline) and k=2 (1-stage
+        + 2-stage) are feasible; Z(k)=k^2/(...) should take k=2 and use
+        every node."""
+        nodes = [self._capped("big", 70),
+                 self._capped("m1", 35), self._capped("m2", 35)]
+        d = DPLayerAllocator(70).allocate(nodes)
+        assert len(d) == 2
+        sizes = sorted(len(p.nodes) for p in d)
+        assert sizes == [1, 2]
+
+
+class TestTurningPoints:
+    @staticmethod
+    def _hosting(nid, start, end, lat):
+        n = make_node(nid)
+        n.set_layers(start, end)
+        n.measured_layer_latency_ms = lat
+        return n
+
+    def test_tail_truncation_on_faster_overlap(self):
+        # A hosts [0,4) slowly; B hosts [2,6) fast: the optimal route
+        # leaves A at layer 2, stranding A's [2,4).
+        a = self._hosting("A", 0, 4, lat=5.0)
+        b = self._hosting("B", 2, 6, lat=0.1)
+        tp = find_turning_points([a, b], 6)
+        assert ("A", 2, "tail") in tp
+        assert not any(kind == "head" for _, _, kind in tp)
+
+    def test_head_truncation_on_late_entry(self):
+        # A hosts [0,3) fast; B hosts [1,6): the route enters B at layer
+        # 3 past its hosted start 1, stranding B's [1,3).
+        a = self._hosting("A", 0, 3, lat=0.1)
+        b = self._hosting("B", 1, 6, lat=1.0)
+        tp = find_turning_points([a, b], 6)
+        assert ("B", 3, "head") in tp
+
+    def test_uncovered_layer_returns_empty(self):
+        a = self._hosting("A", 0, 3, lat=1.0)
+        assert find_turning_points([a], 6) == []
+
+    def test_single_full_host_no_turning_points(self):
+        a = self._hosting("A", 0, 6, lat=1.0)
+        assert find_turning_points([a], 6) == []
 
 
 def build_registered_manager(num_pipes=2):
