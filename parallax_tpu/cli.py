@@ -81,7 +81,17 @@ def build_parser() -> argparse.ArgumentParser:
     )
 
     join = sub.add_parser("join", help="join a swarm as a worker")
-    join.add_argument("--scheduler-addr", required=True)
+    join.add_argument("--scheduler-addr", default=None,
+                      help="scheduler RPC address; omit for scheduler-less "
+                           "mode (requires --peers + --start-layer/"
+                           "--end-layer)")
+    join.add_argument("--peers", default=None,
+                      help="scheduler-less mode: comma-separated worker "
+                           "addresses to gossip block announcements with")
+    join.add_argument("--start-layer", type=int, default=None,
+                      help="scheduler-less mode: this worker's first layer")
+    join.add_argument("--end-layer", type=int, default=None,
+                      help="scheduler-less mode: one past the last layer")
     join.add_argument("--model-path", default=None)
     join.add_argument("--port", type=int, default=0)
     join.add_argument("--refit-cache-dir", default=None,

@@ -26,7 +26,7 @@ from parallax_tpu.models.base import BatchInputs
 from parallax_tpu.models.deepseek_v3 import DeepseekStageModel
 from parallax_tpu.models.registry import register_model
 from parallax_tpu.ops.dsa import (
-    dsa_indexer_scores_xla,
+    dsa_indexer_scores,
     dsa_topk_indices,
     mla_ragged_sparse_attention_xla,
     new_index_pages,
@@ -136,9 +136,11 @@ class DeepseekV32StageModel(DeepseekStageModel):
         weights = L.linear(x, p["weights_proj"]).astype(jnp.float32) * (
             d.index_n_heads ** -0.5 * self._idx_softmax_scale
         )
-        scores = dsa_indexer_scores_xla(
+        scores = dsa_indexer_scores(
             q, weights, index_cache,
             inputs.kv_lens, inputs.page_indices, inputs.cu_q_lens,
+            decode_only=inputs.decode_only,
+            use_pallas=self.use_pallas,
         )
         return dsa_topk_indices(scores, index_topk=d.index_topk), index_cache
 

@@ -60,6 +60,37 @@ def store_index_cache(
     return flat.reshape(p, page, 1, d)
 
 
+def dsa_indexer_scores(
+    q: jax.Array,
+    weights: jax.Array,
+    index_cache: jax.Array,
+    kv_lens: jax.Array,
+    page_indices: jax.Array,
+    cu_q_lens: jax.Array,
+    *,
+    decode_only: bool = False,
+    use_pallas: bool | None = None,
+) -> jax.Array:
+    """Indexer-score dispatcher: the Pallas page-streaming kernel on TPU
+    for decode-only batches (one query per sequence), the chunked XLA
+    path otherwise (prefill / CPU / oracle)."""
+    if use_pallas is None:
+        from parallax_tpu.ops.attention import _tpu_available
+
+        use_pallas = _tpu_available()
+    if decode_only and use_pallas and q.shape[0] == kv_lens.shape[0]:
+        from parallax_tpu.ops.dsa_pallas import (
+            dsa_indexer_scores_decode_pallas,
+        )
+
+        return dsa_indexer_scores_decode_pallas(
+            q, weights, index_cache, kv_lens, page_indices
+        )
+    return dsa_indexer_scores_xla(
+        q, weights, index_cache, kv_lens, page_indices, cu_q_lens
+    )
+
+
 @functools.partial(jax.jit, static_argnames=())
 def dsa_indexer_scores_xla(
     q: jax.Array,            # [T, Hi, D_idx] rope-applied index queries

@@ -47,6 +47,18 @@ def join_main(args) -> int:
 
     # Scheduler RPC rides one port above its HTTP port by convention.
     scheduler_peer = args.scheduler_addr
+    standalone = scheduler_peer is None
+    if standalone:
+        if getattr(args, "relay", False):
+            raise SystemExit("--relay requires a scheduler as the relay")
+        if (
+            getattr(args, "start_layer", None) is None
+            or getattr(args, "end_layer", None) is None
+        ):
+            raise SystemExit(
+                "scheduler-less mode needs --start-layer/--end-layer "
+                "(and --peers unless one host serves every layer)"
+            )
     transport = TcpTransport(
         "", "0.0.0.0", args.port,
         relay_token=getattr(args, "relay_token", None),
@@ -117,6 +129,13 @@ def join_main(args) -> int:
         tokenizer_path=args.model_path,
         lora_adapters=parse_adapter_spec(
             getattr(args, "lora_adapters", None)
+        ),
+        static_peers=[
+            p.strip() for p in (getattr(args, "peers", None) or "").split(",")
+            if p.strip()
+        ],
+        layers=(
+            (args.start_layer, args.end_layer) if standalone else None
         ),
     )
     node.start()

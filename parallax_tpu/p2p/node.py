@@ -57,7 +57,15 @@ class WorkerNode:
         resolve_model=None,  # callable (name) -> (ModelConfig, load_params|None)
         tokenizer_path: str | None = None,
         lora_adapters: dict | None = None,  # name -> PEFT dir or tree
+        static_peers: list[str] | None = None,
+        layers: tuple[int, int] | None = None,
     ):
+        """``scheduler_peer=None`` enters SCHEDULER-LESS mode (reference:
+        DHT announce + dijkstra routing, ``p2p/server.py:569-626``): the
+        worker self-assigns ``layers``, gossips its block over
+        ``static_peers``, and — when it hosts layer 0 — computes its own
+        fewest-hops routing table from the announcements, so a swarm
+        keeps serving with no scheduler as rendezvous."""
         self.transport = transport
         self.scheduler_peer = scheduler_peer
         self.model_config = model_config
@@ -69,6 +77,17 @@ class WorkerNode:
         self.resolve_model = resolve_model
         self.tokenizer_path = tokenizer_path
         self.lora_adapters = dict(lora_adapters or {})
+        self.static_peers = list(static_peers or [])
+        self.standalone = scheduler_peer is None
+        if self.standalone and layers is None:
+            raise ValueError(
+                "scheduler-less mode requires explicit layers=(start, end)"
+            )
+        self._self_layers = layers
+        # Gossip registry (scheduler-less): node_id -> block announcement.
+        self._peer_blocks: dict[str, dict] = {}
+        self._peer_lock = threading.Lock()
+        self.peer_ttl_s = max(10.0, 5 * heartbeat_interval_s)
         self._grammar_vocab: tuple | None = None
         self._served_model_name: str | None = None
         self.refit_store = None
@@ -95,6 +114,7 @@ class WorkerNode:
         transport.register(proto.FORWARD, self._on_forward)
         transport.register(proto.ABORT, self._on_abort)
         transport.register(proto.RELEASE, self._on_release)
+        transport.register("__announce__", self._on_announce)
         transport.register("chat_submit", self._on_chat_submit)
         transport.register("chat_poll", self._on_chat_poll)
         transport.register("chat_stop", self._on_chat_stop)
@@ -112,7 +132,15 @@ class WorkerNode:
         from the first moment — the reference loads its executor in separate
         processes for the same reason (launch.py:250-309)."""
         self.transport.start()
-        alloc = self._join()
+        if self.standalone:
+            s, e = self._self_layers
+            alloc = {"start_layer": s, "end_layer": e}
+            logger.info(
+                "%s: scheduler-less, self-assigned layers [%d, %d)",
+                self.node_id, s, e,
+            )
+        else:
+            alloc = self._join()
         for fn in (self._announcer_loop, self._step_loop):
             t = threading.Thread(target=fn, daemon=True, name=fn.__name__)
             t.start()
@@ -126,11 +154,12 @@ class WorkerNode:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=3.0)
-        try:
-            self.transport.call(self.scheduler_peer, proto.NODE_LEAVE,
-                                {"node_id": self.node_id}, timeout=5.0)
-        except Exception:
-            pass
+        if not self.standalone:
+            try:
+                self.transport.call(self.scheduler_peer, proto.NODE_LEAVE,
+                                    {"node_id": self.node_id}, timeout=5.0)
+            except Exception:
+                pass
         self.transport.stop()
 
     # -- join + elastic reload ----------------------------------------------
@@ -325,6 +354,14 @@ class WorkerNode:
     # -- announcer (heartbeat) ----------------------------------------------
 
     def _announcer_loop(self) -> None:
+        if self.standalone:
+            while not self._stop.is_set():
+                try:
+                    self._gossip_beat()
+                except Exception as e:
+                    logger.warning("gossip beat failed: %s", e)
+                self._stop.wait(self.heartbeat_interval_s)
+            return
         while not self._stop.is_set():
             try:
                 logger.debug("%s: heartbeat", self.node_id)
@@ -378,6 +415,116 @@ class WorkerNode:
             except Exception as e:
                 logger.warning("heartbeat failed: %s", e)
             self._stop.wait(self.heartbeat_interval_s)
+
+    # -- scheduler-less gossip (reference DHT announce + dijkstra routing,
+    # p2p/server.py:569-626) -------------------------------------------------
+
+    def _known_blocks(self) -> list[dict]:
+        """Fresh announcements incl. our own, with ages so receivers can
+        order third-party info correctly."""
+        now = time.monotonic()
+        out = []
+        if self.start_layer >= 0:
+            out.append({
+                "node_id": self.node_id, "start": self.start_layer,
+                "end": self.end_layer, "ready": self.engine is not None,
+                "age_s": 0.0,
+            })
+        with self._peer_lock:
+            for nid, b in self._peer_blocks.items():
+                age = now - b["t"]
+                if age <= self.peer_ttl_s:
+                    out.append({
+                        "node_id": nid, "start": b["start"], "end": b["end"],
+                        "ready": b["ready"], "age_s": age,
+                    })
+        return out
+
+    def _merge_blocks(self, blocks: list[dict]) -> None:
+        now = time.monotonic()
+        with self._peer_lock:
+            for b in blocks or []:
+                nid = b.get("node_id")
+                if not nid or nid == self.node_id:
+                    continue
+                t = now - float(b.get("age_s", 0.0))
+                prev = self._peer_blocks.get(nid)
+                if prev is None or t > prev["t"]:
+                    self._peer_blocks[nid] = {
+                        "start": int(b["start"]), "end": int(b["end"]),
+                        "ready": bool(b.get("ready")), "t": t,
+                    }
+
+    def _gossip_beat(self) -> None:
+        """Announce our block to every static peer and every FRESH known
+        peer; merge what they know back (transitive discovery). Expired
+        entries are pruned — dead peers must not be re-dialed forever
+        (each dial burns a connect timeout, which would starve live
+        announcements past the TTL and flap routes)."""
+        blocks = self._known_blocks()
+        now = time.monotonic()
+        with self._peer_lock:
+            for nid, b in list(self._peer_blocks.items()):
+                if now - b["t"] > 3 * self.peer_ttl_s:
+                    del self._peer_blocks[nid]
+            known = {
+                nid for nid, b in self._peer_blocks.items()
+                if now - b["t"] <= self.peer_ttl_s
+            }
+        for peer in set(self.static_peers) | known:
+            if peer == self.node_id:
+                continue
+            try:
+                reply = self.transport.call(
+                    peer, "__announce__", {"blocks": blocks}, timeout=5.0
+                )
+            except Exception as e:
+                logger.debug("announce to %s failed: %s", peer, e)
+                continue
+            if isinstance(reply, dict):
+                self._merge_blocks(reply.get("blocks"))
+
+    def _on_announce(self, _peer: str, payload: dict):
+        self._merge_blocks((payload or {}).get("blocks"))
+        return {"blocks": self._known_blocks()}
+
+    def local_route(self) -> list[str] | None:
+        """Head-side routing table with no scheduler: fewest-hops chain of
+        announced READY blocks from our end layer to num_layers (the
+        reference's dijkstra over layer boundaries with unit edge cost)."""
+        if self.start_layer != 0 or self.engine is None:
+            return None
+        num_layers = self.model_config.num_hidden_layers
+        now = time.monotonic()
+        by_start: dict[int, list[tuple[str, int]]] = {}
+        with self._peer_lock:
+            for nid, b in self._peer_blocks.items():
+                if not b["ready"] or now - b["t"] > self.peer_ttl_s:
+                    continue
+                by_start.setdefault(b["start"], []).append((nid, b["end"]))
+
+        best: dict[int, list[str] | None] = {num_layers: []}
+
+        def chain(boundary: int) -> list[str] | None:
+            if boundary in best:
+                return best[boundary]
+            best[boundary] = None          # cycle guard
+            result = None
+            for nid, end in by_start.get(boundary, []):
+                if end <= boundary:
+                    continue
+                tail = chain(end)
+                if tail is not None and (
+                    result is None or 1 + len(tail) < len(result)
+                ):
+                    result = [nid] + tail
+            best[boundary] = result
+            return result
+
+        tail = chain(self.end_layer)
+        if tail is None:
+            return None
+        return [self.node_id] + tail
 
     # -- transport handlers (any thread) -------------------------------------
 
@@ -495,7 +642,16 @@ class WorkerNode:
                     self.engine.submit_intermediate(ireq)
             elif kind == "submit":
                 try:
-                    self.engine.submit(item[1])
+                    req = item[1]
+                    if self.standalone and not req.routing_table:
+                        route = self.local_route()
+                        if route is None:
+                            raise RuntimeError(
+                                "no route to the last layer from gossip "
+                                "announcements"
+                            )
+                        req.routing_table = route
+                    self.engine.submit(req)
                 except Exception as e:
                     req: Request = item[1]
                     req.abort(str(e))
@@ -631,15 +787,16 @@ class WorkerNode:
                 )
             except Exception:
                 pass
-        try:
-            # Fire-and-forget: the step thread must not block on the
-            # scheduler's round trip.
-            self.transport.send(
-                self.scheduler_peer, "request_complete",
-                {"path": req.routing_table or [self.node_id]},
-            )
-        except Exception:
-            pass
+        if not self.standalone:
+            try:
+                # Fire-and-forget: the step thread must not block on the
+                # scheduler's round trip.
+                self.transport.send(
+                    self.scheduler_peer, "request_complete",
+                    {"path": req.routing_table or [self.node_id]},
+                )
+            except Exception:
+                pass
         self._finished.put(req)
         ev = self._request_events.pop(req.request_id, None)
         if ev is not None:

@@ -291,11 +291,11 @@ class StageEngine:
                 pages_per_seq=self.spec.pages_per_seq,
             )
         # Models with a decode-specialized Pallas kernel: plain MLA
-        # (DeepSeek V2/V3 — V3.2's sparse path has its own ops) and
-        # sink-attention models (gpt-oss).
+        # (DeepSeek V2/V3), DSA models (the lightning-indexer decode
+        # kernel, ops/dsa_pallas.py), and sink-attention models (gpt-oss).
         cfg_m = model.config
         self._use_decode_flag = (
-            (cfg_m.is_mla and cfg_m.dsa is None) or cfg_m.use_attention_sinks
+            cfg_m.is_mla or cfg_m.use_attention_sinks
         )
         self._base_key = jax.random.key(self.cfg.seed)
         self._jit_multistep = None

@@ -145,6 +145,51 @@ def test_indexer_scores_match_numpy():
     assert np.all(np.isneginf(scores[0, ctx:]))
 
 
+def test_indexer_pallas_decode_matches_xla():
+    """The Pallas decode indexer kernel (interpret mode off-TPU) must
+    reproduce the XLA oracle bit-for-near-bit: multi-sequence decode
+    batch with ragged context lengths and a padding row."""
+    from parallax_tpu.ops.dsa_pallas import dsa_indexer_scores_decode_pallas
+
+    rng = np.random.default_rng(4)
+    page_size, num_pages = 8, 32
+    hi, d = 4, 16
+    ctxs = [19, 7, 0]             # third row = padding sequence
+    pages_per_seq = 4
+    page_tables = [[1, 2, 3, 0], [4, 5, 0, 0], [0, 0, 0, 0]]
+    cache = new_index_pages(num_pages, page_size, d, jnp.float32)
+    for ctx, table in zip(ctxs, page_tables):
+        if ctx == 0:
+            continue
+        keys = rng.standard_normal((ctx, d)).astype(np.float32)
+        slots = np.array(
+            [table[i // page_size] * page_size + i % page_size
+             for i in range(ctx)], np.int32,
+        )
+        cache = store_index_cache(cache, jnp.asarray(keys),
+                                  jnp.asarray(slots))
+
+    s = len(ctxs)
+    q = rng.standard_normal((s, hi, d)).astype(np.float32)
+    w = rng.standard_normal((s, hi)).astype(np.float32)
+    kv_lens = jnp.asarray(ctxs, jnp.int32)
+    page_indices = jnp.asarray(page_tables, jnp.int32)
+    cu = jnp.asarray(np.arange(s + 1), jnp.int32)
+
+    want = np.asarray(dsa_indexer_scores_xla(
+        jnp.asarray(q), jnp.asarray(w), cache, kv_lens, page_indices, cu,
+    ))
+    got = np.asarray(dsa_indexer_scores_decode_pallas(
+        jnp.asarray(q), jnp.asarray(w), cache, kv_lens, page_indices,
+        interpret=True,
+    ))
+    assert got.shape == (s, pages_per_seq * page_size)
+    valid = np.asarray(kv_lens)[:, None] > np.arange(got.shape[1])[None, :]
+    np.testing.assert_allclose(got[valid], want[valid], rtol=1e-5,
+                               atol=1e-5)
+    assert np.all(np.isneginf(got[~valid]))
+
+
 def test_indexer_scores_causal_in_prefill():
     rng = np.random.default_rng(1)
     page_size, num_pages = 4, 8

@@ -120,6 +120,92 @@ def wait_ready(service, n, timeout=10.0):
     return False
 
 
+def test_schedulerless_swarm_serves_via_gossip():
+    """Scheduler-less fallback (reference DHT announce + dijkstra,
+    p2p/server.py:569-626): two workers with self-assigned layers gossip
+    block announcements over static peers; the head computes its own
+    routing table and serves a request with no scheduler anywhere."""
+    workers = []
+    try:
+        transports = []
+        for _ in range(2):
+            t = TcpTransport("", "127.0.0.1")
+            t.start()
+            t.peer_id = t.address
+            transports.append(t)
+        addrs = [t.address for t in transports]
+        bounds = [(0, 2), (2, 4)]
+        for t, (s, e) in zip(transports, bounds):
+            w = WorkerNode(
+                transport=t, scheduler_peer=None,
+                model_config=TINY, engine_config=ENGINE_CFG,
+                load_params=stage_params, heartbeat_interval_s=0.2,
+                static_peers=[a for a in addrs if a != t.address],
+                layers=(s, e),
+            )
+            workers.append(w)
+        import threading
+
+        starters = [threading.Thread(target=w.start) for w in workers]
+        for st in starters:
+            st.start()
+        for st in starters:
+            st.join(timeout=60.0)
+
+        # Gossip converges: the head learns the tail's block and routes.
+        head = workers[0]
+        deadline = time.monotonic() + 15.0
+        route = None
+        while time.monotonic() < deadline:
+            route = head.local_route()
+            if route is not None:
+                break
+            time.sleep(0.1)
+        assert route == [workers[0].node_id, workers[1].node_id], route
+
+        req = Request(
+            request_id="nosched-1",
+            prompt_ids=[1, 2, 3, 4, 5, 6, 7],
+            sampling_params=SamplingParams(temperature=0.0,
+                                           max_new_tokens=6),
+        )
+        done = head.submit(req)
+        assert done.wait(30.0), f"request did not finish: {req.status}"
+        assert len(req.output_ids) == 6
+
+        # Oracle: same stages chained in-process.
+        engines = []
+        for s, e in bounds:
+            m = StageModel(TINY, s, e, use_pallas=False)
+            engines.append(StageEngine(m, stage_params(m), ENGINE_CFG))
+        pipe = InProcessPipeline(engines)
+        ref = Request(
+            request_id="ref", prompt_ids=[1, 2, 3, 4, 5, 6, 7],
+            sampling_params=SamplingParams(temperature=0.0,
+                                           max_new_tokens=6),
+        )
+        pipe.submit(ref)
+        pipe.run_until_complete()
+        assert req.output_ids == ref.output_ids
+
+        # Resilience: the tail dying makes the route disappear once its
+        # announcement expires (no silent routing into a dead node).
+        head.peer_ttl_s = 0.5
+        workers[1].stop()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if head.local_route() is None:
+                break
+            time.sleep(0.1)
+        assert head.local_route() is None
+    finally:
+        for w in workers:
+            try:
+                w.stop()
+            except Exception:
+                pass
+
+
 def test_swarm_serves_request_over_tcp(swarm):
     service, workers = swarm
     assert wait_ready(service, 2), service.scheduler.cluster_status()
