@@ -27,21 +27,43 @@ class SwarmClient:
     it). Capability parity: reference RequestHandler forwarding + SSE relay
     (request_handler.py:100-245)."""
 
-    def __init__(self, transport: Transport, service: SchedulerService,
-                 poll_interval_s: float = 0.02):
+    def __init__(self, transport: Transport,
+                 service: SchedulerService | None,
+                 poll_interval_s: float = 0.02,
+                 default_head: str | None = None):
         self.transport = transport
+        # None = no scheduler anywhere (standalone chat host fronting a
+        # scheduler-less swarm): requests go to ``default_head`` with an
+        # empty routing table and the head computes its own route.
         self.service = service
         self.poll_interval_s = poll_interval_s
+        self.default_head = default_head
         # rid -> head node id, for stop-string early finish.
         self._heads: dict[str, str] = {}
 
     def route(self, request_id: str) -> list[str] | None:
+        if self.service is None:
+            # Chat-host mode: probe the head's readiness so a still-loading
+            # or route-less swarm maps to the frontend's retryable 503
+            # instead of a post-submit hard failure.
+            if self.default_head is None:
+                return None
+            try:
+                r = self.transport.call(
+                    self.default_head, "chat_ready", None, timeout=5.0
+                )
+            except Exception:
+                return None
+            return [] if isinstance(r, dict) and r.get("ready") else None
         return self.service.route_request(request_id, timeout_s=10.0)
 
     def submit(self, request: Request) -> threading.Event:
-        if not request.routing_table:
+        if request.routing_table:
+            head = request.routing_table[0]
+        elif self.default_head is not None:
+            head = self.default_head
+        else:
             raise RuntimeError("request has no routing table")
-        head = request.routing_table[0]
         try:
             self.transport.call(head, "chat_submit", {
                 "rid": request.request_id,
@@ -54,7 +76,10 @@ class SwarmClient:
         except Exception:
             # The workers never saw this request; release the load the
             # dispatcher charged for the path.
-            self.service.scheduler.complete_request(request.routing_table)
+            if self.service is not None:
+                self.service.scheduler.complete_request(
+                    request.routing_table
+                )
             raise RuntimeError(f"head node {head} unreachable")
         ev = threading.Event()
         self._heads[request.request_id] = head
@@ -101,9 +126,10 @@ class SwarmClient:
                     request.abort(f"head node unreachable: {e}")
                     # The worker cannot report completion anymore; release
                     # the path's load charge here.
-                    self.service.scheduler.complete_request(
-                        request.routing_table
-                    )
+                    if self.service is not None:
+                        self.service.scheduler.complete_request(
+                            request.routing_table
+                        )
                     ev.set()
                     return
                 time.sleep(0.5)
@@ -152,6 +178,49 @@ def build_swarm_frontend(
             tokenizer_fn=tokenizer_fn,
         )
     return frontend, service, client
+
+
+def build_chat_host_frontend(
+    head_addr: str,
+    tokenizer,
+    model_name: str,
+    transport: TcpTransport | None = None,
+) -> tuple[OpenAIFrontend, SwarmClient]:
+    """Standalone chat host on a NON-scheduler machine (capability parity:
+    reference ``node_chat_http_server.py`` + ``launch_chat.py`` — a chat
+    UI host proxying ``/v1/chat/completions`` to the swarm over RPC).
+
+    Points at one head worker: a scheduler-less head
+    (``WorkerNode(scheduler_peer=None)``) fills in its own gossip routing
+    table for the empty table this host submits; a single-stage worker
+    needs no table at all.
+    """
+    if transport is None:
+        transport = TcpTransport("", "127.0.0.1")
+        transport.start()
+        transport.peer_id = transport.address
+    client = SwarmClient(transport, service=None, default_head=head_addr)
+    frontend = OpenAIFrontend(
+        tokenizer,
+        submit_fn=client.submit,
+        route_fn=client.route,
+        model_name=model_name,
+        stop_fn=client.stop,
+    )
+    return frontend, client
+
+
+def chat_host_main(args) -> int:
+    """CLI ``chat-host``: serve the chat UI + OpenAI API, proxying to a
+    swarm head worker."""
+    tokenizer = load_tokenizer(getattr(args, "model_path", None))
+    frontend, _client = build_chat_host_frontend(
+        args.head, tokenizer,
+        getattr(args, "model_name", None) or "parallax-tpu",
+    )
+    logger.info("chat host on :%d -> head %s", args.port, args.head)
+    frontend.run(host="0.0.0.0", port=args.port)
+    return 0
 
 
 def make_scheduler_init_fn(service: SchedulerService, resolve_model,

@@ -125,6 +125,18 @@ def build_parser() -> argparse.ArgumentParser:
     chat.add_argument("--max-tokens", type=int, default=512)
     chat.add_argument("--temperature", type=float, default=0.7)
 
+    chost = sub.add_parser(
+        "chat-host",
+        help="standalone chat UI + OpenAI API host on a non-scheduler "
+             "machine, proxying to a swarm head worker over RPC",
+    )
+    chost.add_argument("--head", required=True,
+                       help="head worker transport address (host:port)")
+    chost.add_argument("--port", type=int, default=8000)
+    chost.add_argument("--model-path", default=None,
+                       help="checkpoint dir for the tokenizer")
+    chost.add_argument("--model-name", default=None)
+
     merge = sub.add_parser(
         "lora-merge",
         help="fuse a PEFT LoRA adapter into a checkpoint "
@@ -180,6 +192,10 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     if args.command == "chat":
         return chat_main(args)
+    if args.command == "chat-host":
+        from parallax_tpu.backend.run import chat_host_main
+
+        return chat_host_main(args)
     return 1
 
 

@@ -115,6 +115,7 @@ class WorkerNode:
         transport.register(proto.ABORT, self._on_abort)
         transport.register(proto.RELEASE, self._on_release)
         transport.register("__announce__", self._on_announce)
+        transport.register("chat_ready", self._on_chat_ready)
         transport.register("chat_submit", self._on_chat_submit)
         transport.register("chat_poll", self._on_chat_poll)
         transport.register("chat_stop", self._on_chat_stop)
@@ -471,22 +472,45 @@ class WorkerNode:
                 nid for nid, b in self._peer_blocks.items()
                 if now - b["t"] <= self.peer_ttl_s
             }
-        for peer in set(self.static_peers) | known:
-            if peer == self.node_id:
-                continue
+        timeout = min(5.0, max(1.0, self.heartbeat_interval_s))
+
+        def announce(peer: str) -> None:
             try:
                 reply = self.transport.call(
-                    peer, "__announce__", {"blocks": blocks}, timeout=5.0
+                    peer, "__announce__", {"blocks": blocks},
+                    timeout=timeout,
                 )
             except Exception as e:
                 logger.debug("announce to %s failed: %s", peer, e)
-                continue
+                return
             if isinstance(reply, dict):
                 self._merge_blocks(reply.get("blocks"))
+
+        # Concurrent dials: dead STATIC peers (never pruned — they are
+        # the operator-given bootstrap list) must not serialize connect
+        # timeouts past the TTL and flap live routes.
+        beats = [
+            threading.Thread(target=announce, args=(p,), daemon=True)
+            for p in set(self.static_peers) | known if p != self.node_id
+        ]
+        for b in beats:
+            b.start()
+        deadline = time.monotonic() + timeout + 1.0
+        for b in beats:
+            b.join(timeout=max(0.0, deadline - time.monotonic()))
 
     def _on_announce(self, _peer: str, payload: dict):
         self._merge_blocks((payload or {}).get("blocks"))
         return {"blocks": self._known_blocks()}
+
+    def _on_chat_ready(self, _peer: str, _payload):
+        """Readiness probe for standalone chat hosts: can this head accept
+        and route a request RIGHT NOW? (Maps not-ready to the frontend's
+        retryable 503 instead of a post-submit 502.)"""
+        ready = self.engine is not None and (
+            not self.standalone or self.local_route() is not None
+        )
+        return {"ready": bool(ready)}
 
     def local_route(self) -> list[str] | None:
         """Head-side routing table with no scheduler: fewest-hops chain of

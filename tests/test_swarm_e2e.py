@@ -206,6 +206,87 @@ def test_schedulerless_swarm_serves_via_gossip():
                 pass
 
 
+def test_chat_host_fronts_schedulerless_swarm():
+    """Standalone chat host (reference node_chat_http_server.py): an
+    OpenAI frontend on a non-scheduler machine proxies chat completions
+    to a scheduler-less head worker over RPC, which routes via gossip."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from parallax_tpu.backend.http_server import SimpleTokenizer
+    from parallax_tpu.backend.run import build_chat_host_frontend
+
+    workers = []
+    host_transport = None
+    try:
+        transports = []
+        for _ in range(2):
+            t = TcpTransport("", "127.0.0.1")
+            t.start()
+            t.peer_id = t.address
+            transports.append(t)
+        addrs = [t.address for t in transports]
+        for t, (s, e) in zip(transports, [(0, 2), (2, 4)]):
+            w = WorkerNode(
+                transport=t, scheduler_peer=None,
+                model_config=TINY, engine_config=ENGINE_CFG,
+                load_params=stage_params, heartbeat_interval_s=0.2,
+                static_peers=[a for a in addrs if a != t.address],
+                layers=(s, e),
+            )
+            workers.append(w)
+        import threading
+
+        starters = [threading.Thread(target=w.start) for w in workers]
+        for st in starters:
+            st.start()
+        for st in starters:
+            st.join(timeout=60.0)
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if workers[0].local_route() is not None:
+                break
+            time.sleep(0.1)
+        assert workers[0].local_route() is not None
+
+        host_transport = TcpTransport("", "127.0.0.1")
+        host_transport.start()
+        host_transport.peer_id = host_transport.address
+        frontend, _client = build_chat_host_frontend(
+            workers[0].node_id, SimpleTokenizer(), "tiny",
+            transport=host_transport,
+        )
+
+        async def drive():
+            client = TestClient(TestServer(frontend.app))
+            await client.start_server()
+            r = await client.post("/v1/chat/completions", json={
+                "model": "tiny",
+                "messages": [{"role": "user", "content": "hi there"}],
+                "max_tokens": 6, "temperature": 0.0, "ignore_eos": True,
+            })
+            body = await r.json()
+            page = await client.get("/chat")
+            page_ok = page.status == 200
+            await client.close()
+            return r.status, body, page_ok
+
+        status, body, page_ok = asyncio.run(drive())
+        assert status == 200, body
+        assert body["choices"][0]["message"]["content"]
+        assert body["usage"]["completion_tokens"] == 6
+        assert page_ok
+    finally:
+        for w in workers:
+            try:
+                w.stop()
+            except Exception:
+                pass
+        if host_transport is not None:
+            host_transport.stop()
+
+
 def test_swarm_serves_request_over_tcp(swarm):
     service, workers = swarm
     assert wait_ready(service, 2), service.scheduler.cluster_status()
