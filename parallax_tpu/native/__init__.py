@@ -280,6 +280,19 @@ class NativeCacheManager:
         self.allocator = NativePageAllocator(num_pages)
         # rid -> number of tree-shared pages (for release's unlock walk).
         self._shared: dict[str, int] = {}
+        # Per-adapter prefix-cache namespaces (cache_manager.ns_salt).
+        self._ns_salts: dict[str, int] = {}
+
+    def _ns_i32(self, token_ids, lora_id) -> np.ndarray:
+        """int32 tokens, XOR-salted at numpy speed for adapter requests
+        (the scheduler hot path must stay free of per-token Python)."""
+        from parallax_tpu.runtime.cache_manager import ns_salt
+
+        tokens = _as_i32(token_ids)
+        salt = ns_salt(self._ns_salts, lora_id)
+        if salt is not None:
+            tokens = tokens ^ np.int32(salt)
+        return tokens
 
     # -- capacity ---------------------------------------------------------
 
@@ -293,7 +306,9 @@ class NativeCacheManager:
     # -- request lifecycle ------------------------------------------------
 
     def allocate_for_prompt(self, request) -> bool:
-        tokens = _as_i32(request.prompt_ids)
+        tokens = self._ns_i32(
+            request.prompt_ids, getattr(request, "lora_id", None)
+        )
         cap = self.pages_needed(len(tokens)) + 1
         out = np.empty(cap, np.int32)
         shared = ctypes.c_int64(0)
@@ -329,7 +344,9 @@ class NativeCacheManager:
         if not len(pages):
             request.page_ids = []
             return
-        tokens = _as_i32(request.all_token_ids)
+        tokens = self._ns_i32(
+            request.all_token_ids, getattr(request, "lora_id", None)
+        )
         computed = min(request.num_computed_tokens, len(tokens))
         insert = int(
             self.enable_prefix_cache

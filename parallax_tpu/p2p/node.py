@@ -87,6 +87,7 @@ class WorkerNode:
         # Gossip registry (scheduler-less): node_id -> block announcement.
         self._peer_blocks: dict[str, dict] = {}
         self._peer_lock = threading.Lock()
+        self._gossip_pool = None
         self.peer_ttl_s = max(10.0, 5 * heartbeat_interval_s)
         self._grammar_vocab: tuple | None = None
         self._served_model_name: str | None = None
@@ -155,6 +156,8 @@ class WorkerNode:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=3.0)
+        if self._gossip_pool is not None:
+            self._gossip_pool.shutdown(wait=False, cancel_futures=True)
         if not self.standalone:
             try:
                 self.transport.call(self.scheduler_peer, proto.NODE_LEAVE,
@@ -486,31 +489,48 @@ class WorkerNode:
             if isinstance(reply, dict):
                 self._merge_blocks(reply.get("blocks"))
 
-        # Concurrent dials: dead STATIC peers (never pruned — they are
-        # the operator-given bootstrap list) must not serialize connect
-        # timeouts past the TTL and flap live routes.
-        beats = [
-            threading.Thread(target=announce, args=(p,), daemon=True)
+        # Concurrent dials off a persistent pool: dead STATIC peers
+        # (never pruned — they are the operator-given bootstrap list)
+        # must not serialize connect timeouts past the TTL and flap live
+        # routes, and a fixed peer set must not churn a thread per peer
+        # per beat. The bounded pool also caps in-flight dials when a
+        # blackholed peer's call overruns the beat.
+        if self._gossip_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._gossip_pool = ThreadPoolExecutor(
+                max_workers=min(16, 4 + len(self.static_peers)),
+                thread_name_prefix="gossip",
+            )
+        futures = [
+            self._gossip_pool.submit(announce, p)
             for p in set(self.static_peers) | known if p != self.node_id
         ]
-        for b in beats:
-            b.start()
-        deadline = time.monotonic() + timeout + 1.0
-        for b in beats:
-            b.join(timeout=max(0.0, deadline - time.monotonic()))
+        from concurrent.futures import wait as _fwait
+
+        _fwait(futures, timeout=timeout + 1.0)
 
     def _on_announce(self, _peer: str, payload: dict):
         self._merge_blocks((payload or {}).get("blocks"))
         return {"blocks": self._known_blocks()}
 
     def _on_chat_ready(self, _peer: str, _payload):
-        """Readiness probe for standalone chat hosts: can this head accept
-        and route a request RIGHT NOW? (Maps not-ready to the frontend's
-        retryable 503 instead of a post-submit 502.)"""
-        ready = self.engine is not None and (
-            not self.standalone or self.local_route() is not None
+        """Readiness probe for standalone chat hosts: can this head serve
+        a request submitted with an EMPTY routing table right now? A
+        standalone head routes via gossip; a scheduler-managed worker can
+        only if it hosts the whole model (partial shards need the
+        scheduler's routing, which the chat host bypasses). Maps
+        not-ready to the frontend's retryable 503 instead of a
+        post-submit 502."""
+        if self.engine is None:
+            return {"ready": False}
+        if self.standalone:
+            return {"ready": self.local_route() is not None}
+        full = (
+            self.start_layer == 0
+            and self.end_layer == self.model_config.num_hidden_layers
         )
-        return {"ready": bool(ready)}
+        return {"ready": full}
 
     def local_route(self) -> list[str] | None:
         """Head-side routing table with no scheduler: fewest-hops chain of

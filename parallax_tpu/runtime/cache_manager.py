@@ -87,6 +87,34 @@ def make_cache_manager(
     )
 
 
+def ns_salt(salts: dict[str, int], lora_id: str | None) -> int | None:
+    """Process-random 31-bit prefix-cache namespace salt per adapter.
+
+    KV contents depend on the LoRA adapter, so tenants must never
+    prefix-hit each other's pages. XOR-salting the token stream keeps
+    its length (page alignment intact), fits the native backend's int32
+    tokens, and is identical for both radix implementations.
+    Cross-tenant collisions require an entire page of positionwise-
+    colliding tokens against an unguessable salt."""
+    if lora_id is None:
+        return None
+    salt = salts.get(lora_id)
+    if salt is None:
+        import random
+
+        salt = salts[lora_id] = random.getrandbits(31)
+    return salt
+
+
+def ns_tokens(salts: dict[str, int], token_ids: list[int],
+              lora_id: str | None) -> list[int]:
+    """Namespace a token stream per LoRA adapter (see ``ns_salt``)."""
+    salt = ns_salt(salts, lora_id)
+    if salt is None:
+        return token_ids
+    return [t ^ salt for t in token_ids]
+
+
 class CacheManager:
     """Host-side paged-KV bookkeeping for one pipeline stage."""
 
@@ -105,6 +133,13 @@ class CacheManager:
         self.prefix_cache = RadixPageCache(page_size)
         # rid -> (locked node path, number of shared tree-owned pages)
         self._locked: dict[str, tuple] = {}
+        # Per-adapter radix namespaces: KV depends on the LoRA adapter, so
+        # tenants must never prefix-hit each other's pages (see
+        # ``ns_tokens``).
+        self._ns_salts: dict[str, int] = {}
+
+    def _ns_tokens(self, token_ids: list[int], lora_id: str | None):
+        return ns_tokens(self._ns_salts, token_ids, lora_id)
 
     # -- capacity ---------------------------------------------------------
 
@@ -138,7 +173,9 @@ class CacheManager:
         shared_pages: list[int] = []
         path = []  # empty match path (both impls accept [] for lock/unlock)
         if self.enable_prefix_cache and prompt_len > 1:
-            pages, full_path = self.prefix_cache.match_prefix(request.prompt_ids)
+            pages, full_path = self.prefix_cache.match_prefix(
+                self._ns_tokens(request.prompt_ids, request.lora_id)
+            )
             # Always leave >=1 prompt token to recompute so the stage emits a
             # hidden state for sampling.
             usable = min(len(pages), (prompt_len - 1) // self.page_size)
@@ -205,7 +242,10 @@ class CacheManager:
             # the computed KV length, for the same reason.)
             computed = min(request.num_computed_tokens, len(request.all_token_ids))
             n_full = computed // self.page_size
-            tokens = request.all_token_ids[: n_full * self.page_size]
+            tokens = self._ns_tokens(
+                request.all_token_ids[: n_full * self.page_size],
+                request.lora_id,
+            )
             tail = owned[max(0, n_full - num_shared):]
             duplicates = self.prefix_cache.insert(tokens, request.page_ids[:n_full])
             self.allocator.free(duplicates + tail)

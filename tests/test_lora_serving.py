@@ -185,6 +185,29 @@ class TestAdapterMath:
                        n=6)
         assert got.output_ids == want.output_ids
 
+    def test_prefix_cache_isolates_tenants(self):
+        """KV depends on the adapter: a tenant must never prefix-hit
+        another tenant's (or the base model's) donated pages, while
+        same-tenant reuse still works."""
+        tree = make_adapter(9, layers=[0, 1])
+        eng, _ = base_engine({"ad1": tree, "ad2": make_adapter(10, [0])})
+        prompt = list(range(1, 40))   # 4+ full pages at page_size 8
+
+        def one(rid, lora_id):
+            req = run_one(eng, prompt, n=2, lora_id=lora_id, rid=rid)
+            return req.num_cached_tokens
+
+        assert one("base1", None) == 0
+        # Base donated its pages; an adapter request with the SAME prompt
+        # must not reuse them.
+        assert one("t1a", "ad1") == 0
+        # Same tenant again: reuse kicks in.
+        assert one("t1b", "ad1") > 0
+        # A different tenant still gets nothing.
+        assert one("t2a", "ad2") == 0
+        # And base still hits its own namespace.
+        assert one("base2", None) > 0
+
     def test_multistep_fused_decode_applies_adapter(self):
         tree = make_adapter(3, layers=[0, 1, 2, 3])
         model = StageModel(TINY, 0, TINY.num_hidden_layers, use_pallas=False)
