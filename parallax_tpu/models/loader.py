@@ -140,6 +140,41 @@ def _quant_settings_for(
     return int(qcfg["bits"]), int(qcfg.get("group_size", 64))
 
 
+def _iter_safetensors(path: str, fp8_mode: bool, resolve):
+    """Yield ``(local_path, numpy_array, is_fp8)`` for one safetensors
+    file, fetching only keys ``resolve`` maps to this stage (partial
+    stages must not pay IO for other stages' tensors).
+
+    Plain checkpoints stream through the numpy framework. FP8 checkpoints
+    need the torch framework (numpy has no float8 dtype); float8 tensors
+    are upcast to float32 on the way out, block scaling applied by the
+    caller."""
+    from safetensors import safe_open
+
+    if not fp8_mode:
+        with safe_open(path, framework="numpy") as f:
+            for key in f.keys():
+                local = resolve(key)
+                if local is not None:
+                    yield local, f.get_tensor(key), False
+        return
+    import torch
+
+    fp8_dtypes = {torch.float8_e4m3fn, torch.float8_e5m2}
+    with safe_open(path, framework="pt") as f:
+        for key in f.keys():
+            local = resolve(key)
+            if local is None:
+                continue
+            t = f.get_tensor(key)
+            if t.dtype in fp8_dtypes:
+                yield local, t.to(torch.float32).numpy(), True
+            elif t.dtype in (torch.bfloat16, torch.float16):
+                yield local, t.to(torch.float32).numpy(), False
+            else:
+                yield local, t.numpy(), False
+
+
 def load_stage_params(
     model: StageModel, model_path: str, dtype=jnp.bfloat16,
     quantize: str | None = None,
@@ -150,18 +185,23 @@ def load_stage_params(
     Quantized checkpoints (MLX affine format: packed-uint32 ``weight`` +
     ``scales``/``biases`` siblings, config ``quantization`` dict with
     per-layer overrides) load into on-the-fly-dequantized params
-    (``ops/quant.py``). ``quantize="int8"|"int4"`` quantizes a
-    full-precision checkpoint at load time instead (reference intent:
-    fitting DeepSeek-class MoE into a small-HBM stage).
+    (``ops/quant.py``). HF FP8 block-quantized checkpoints
+    (``quantization_config.quant_method == "fp8"``: float8_e4m3 weights +
+    ``weight_scale_inv`` block scales — the DeepSeek/Qwen "-FP8"
+    releases) dequantize to ``dtype`` on load. ``quantize="int8"|"int4"``
+    quantizes a full-precision checkpoint at load time instead
+    (reference intent: fitting DeepSeek-class MoE into a small-HBM
+    stage; reference byte accounting: ``static_config.py:110-131``).
     """
-    from safetensors import safe_open
-
     cfg = model.config
     raw_cfg = {}
     cfg_path = os.path.join(model_path, "config.json")
     if os.path.exists(cfg_path):
         with open(cfg_path, encoding="utf-8") as f:
             raw_cfg = json.load(f)
+    qc = raw_cfg.get("quantization_config") or {}
+    fp8_mode = qc.get("quant_method") == "fp8"
+    fp8_block = tuple(qc.get("weight_block_size") or (128, 128))
 
     tree: dict = {}
     want_embed = model.is_first or (model.is_last and cfg.tie_word_embeddings)
@@ -172,32 +212,71 @@ def load_stage_params(
     # compressed representation) are buffered until all parts arrive, so
     # host peak memory stays far below the stage's fp footprint.
     pending: dict[str, np.ndarray] = {}
-    weight_files = _weight_files(
-        model_path,
-        key_needed=lambda key: shard_key_filter(
+    def _resolve(key: str) -> str | None:
+        """THE stage-ownership filter (shared by file selection and the
+        tensor loop): global checkpoint key -> local param path, or None
+        when another stage owns it."""
+        local = shard_key_filter(
             key, model.start_layer, model.end_layer, cfg.num_hidden_layers
-        ) is not None and not (
-            key.startswith("model.embed_tokens.") and not want_embed
-        ),
+        )
+        if local is None or (
+            local.split(".")[0] == "embed_tokens" and not want_embed
+        ):
+            return None
+        return local
+
+    weight_files = _weight_files(
+        model_path, key_needed=lambda key: _resolve(key) is not None
     )
+
+    def _dequant_fp8(local: str, w: np.ndarray, scale) -> None:
+        from parallax_tpu.ops.quant import dequant_fp8_block
+
+        _assign(tree, local,
+                jnp.asarray(dequant_fp8_block(w, scale, fp8_block)).astype(
+                    dtype))
+
+    # FP8 weight/scale pairs live in the same shard file; dequantize as
+    # soon as both halves are seen so host RAM holds at most one file's
+    # stragglers, never the whole stage upcast to fp32.
+    fp8_weights: dict[str, np.ndarray] = {}
+    fp8_scales: dict[str, np.ndarray] = {}
     for path in weight_files:
-        with safe_open(path, framework="numpy") as f:
-            for key in f.keys():
-                local = shard_key_filter(
-                    key, model.start_layer, model.end_layer, cfg.num_hidden_layers
-                )
-                if local is None:
-                    continue
-                if local.split(".")[0] == "embed_tokens" and not want_embed:
-                    continue
-                arr = f.get_tensor(key)
-                if local.endswith((".scales", ".biases")) or (
-                    local.endswith(".weight") and arr.dtype == np.uint32
-                ):
-                    pending[local] = arr
-                    continue
-                _assign(tree, local, jnp.asarray(arr).astype(dtype))
-                n_loaded += 1
+        for local, arr, is_fp8 in _iter_safetensors(path, fp8_mode, _resolve):
+            if local.endswith(".weight_scale_inv"):
+                base = local[: -len("_scale_inv")]
+                w = fp8_weights.pop(base, None)
+                if w is not None:
+                    _dequant_fp8(base, w, arr)
+                    n_loaded += 1
+                else:
+                    fp8_scales[base] = arr
+                continue
+            if is_fp8:
+                scale = fp8_scales.pop(local, None)
+                if scale is not None:
+                    _dequant_fp8(local, arr, scale)
+                    n_loaded += 1
+                else:
+                    fp8_weights[local] = arr
+                continue
+            if local.endswith((".scales", ".biases")) or (
+                local.endswith(".weight") and arr.dtype == np.uint32
+            ):
+                pending[local] = arr
+                continue
+            _assign(tree, local, jnp.asarray(arr).astype(dtype))
+            n_loaded += 1
+
+    if fp8_weights:
+        raise ValueError(
+            f"fp8 weights with no .weight_scale_inv sibling: "
+            f"{sorted(fp8_weights)[:5]}"
+        )
+    if fp8_scales:
+        raise ValueError(
+            f"orphan fp8 scales without weights: {sorted(fp8_scales)[:5]}"
+        )
 
     from parallax_tpu.ops.quant import unpack_uint32
 

@@ -51,6 +51,28 @@ def pack_uint32(values: np.ndarray, bits: int) -> np.ndarray:
     return out
 
 
+def dequant_fp8_block(w: np.ndarray, scale_inv: np.ndarray,
+                      block: tuple[int, int] = (128, 128)) -> np.ndarray:
+    """Dequantize an HF FP8 block-quantized weight (DeepSeek/Qwen -FP8
+    checkpoints: ``weight`` float8_e4m3 + ``weight_scale_inv``
+    [ceil(out/b0), ceil(in/b1)]): multiply each (b0, b1) block by its
+    scale. ``w`` arrives already upcast to float32."""
+    b0, b1 = block
+    out_dim, in_dim = w.shape
+    scale_inv = np.asarray(scale_inv, np.float32)
+    want = (-(-out_dim // b0), -(-in_dim // b1))
+    if scale_inv.shape != want:
+        # A mismatched grid would be silently truncated by the slices
+        # below, scaling every block wrongly — fail loudly instead.
+        raise ValueError(
+            f"fp8 scale grid {scale_inv.shape} != {want} for weight "
+            f"{w.shape} at block size {block}"
+        )
+    s = np.repeat(scale_inv, b0, axis=0)[:out_dim]
+    s = np.repeat(s, b1, axis=1)[:, :in_dim]
+    return w * s
+
+
 def quantize_array(
     w: np.ndarray, bits: int = 8, group_size: int = 64
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:

@@ -419,3 +419,110 @@ def test_quantized_msa_model_generates():
     pipe.submit(req)
     pipe.run_until_complete()
     assert len(req.output_ids) == 4
+
+
+def test_fp8_block_checkpoint_loads(tmp_path):
+    """HF FP8 block-quantized checkpoint (float8_e4m3 weights +
+    weight_scale_inv block scales, quantization_config.quant_method fp8 —
+    the DeepSeek/Qwen "-FP8" release format): the loader must dequantize
+    to the target dtype and match a manual block dequant."""
+    import torch
+    from safetensors.torch import save_file as save_pt
+
+    from parallax_tpu.models.loader import load_stage_params
+    from parallax_tpu.ops.quant import dequant_fp8_block
+
+    rng = np.random.default_rng(11)
+    cfg_dict = dict(
+        architectures=["Qwen2ForCausalLM"], hidden_size=32,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        intermediate_size=64, vocab_size=64, max_position_embeddings=128,
+        tie_word_embeddings=False,
+        quantization_config={"quant_method": "fp8",
+                             "weight_block_size": [16, 16]},
+    )
+    cfg = normalize_config(cfg_dict)
+    h, kvh, d = 32, 2, 16
+    tensors = {}
+    originals = {}
+
+    def add_fp8(name, out_dim, in_dim):
+        w = rng.standard_normal((out_dim, in_dim)).astype(np.float32)
+        scale = (rng.uniform(0.5, 2.0, (
+            -(-out_dim // 16), -(-in_dim // 16)
+        ))).astype(np.float32)
+        w8 = torch.from_numpy(w).to(torch.float8_e4m3fn)
+        tensors[f"{name}.weight"] = w8
+        tensors[f"{name}.weight_scale_inv"] = torch.from_numpy(scale)
+        originals[name] = dequant_fp8_block(
+            w8.to(torch.float32).numpy(), scale, (16, 16)
+        )
+
+    pre = "model.layers.0"
+    for name, o, i in [
+        (f"{pre}.self_attn.q_proj", 2 * d, h),
+        (f"{pre}.self_attn.k_proj", kvh * d, h),
+        (f"{pre}.self_attn.v_proj", kvh * d, h),
+        (f"{pre}.self_attn.o_proj", h, 2 * d),
+        (f"{pre}.mlp.gate_proj", 64, h),
+        (f"{pre}.mlp.up_proj", 64, h),
+        (f"{pre}.mlp.down_proj", h, 64),
+    ]:
+        add_fp8(name, o, i)
+    # Unquantized side tensors stay bf16 in real fp8 checkpoints.
+    tensors["model.embed_tokens.weight"] = torch.from_numpy(
+        rng.standard_normal((64, h)).astype(np.float32)).to(torch.bfloat16)
+    tensors["model.norm.weight"] = torch.ones((h,), dtype=torch.bfloat16)
+    tensors[f"{pre}.input_layernorm.weight"] = torch.ones(
+        (h,), dtype=torch.bfloat16)
+    tensors[f"{pre}.post_attention_layernorm.weight"] = torch.ones(
+        (h,), dtype=torch.bfloat16)
+    tensors["lm_head.weight"] = torch.from_numpy(
+        rng.standard_normal((64, h)).astype(np.float32)).to(torch.bfloat16)
+
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    save_pt(tensors, str(ckpt / "model.safetensors"))
+    (ckpt / "config.json").write_text(json.dumps(cfg_dict))
+
+    model = StageModel(cfg, 0, 1, use_pallas=False)
+    params = load_stage_params(model, str(ckpt), dtype=jnp.float32)
+    attn = params["layers"][0]["self_attn"]
+    np.testing.assert_allclose(
+        np.asarray(attn["q_proj"]["weight"]),
+        originals[f"{pre}.self_attn.q_proj"], rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(params["layers"][0]["mlp"]["down_proj"]["weight"]),
+        originals[f"{pre}.mlp.down_proj"], rtol=1e-6,
+    )
+    # Side tensors came through the bf16 upcast path.
+    assert params["norm"]["weight"].dtype == jnp.float32
+
+
+def test_fp8_weight_without_scales_fails_loudly(tmp_path):
+    import torch
+    from safetensors.torch import save_file as save_pt
+
+    from parallax_tpu.models.loader import load_stage_params
+
+    cfg_dict = dict(
+        architectures=["Qwen2ForCausalLM"], hidden_size=8,
+        num_hidden_layers=1, num_attention_heads=1, num_key_value_heads=1,
+        intermediate_size=8, vocab_size=16, max_position_embeddings=32,
+        tie_word_embeddings=True,
+        quantization_config={"quant_method": "fp8"},
+    )
+    cfg = normalize_config(cfg_dict)
+    tensors = {
+        "model.embed_tokens.weight": torch.zeros((16, 8)),
+        "model.layers.0.self_attn.q_proj.weight":
+            torch.zeros((8, 8)).to(torch.float8_e4m3fn),
+    }
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    save_pt(tensors, str(ckpt / "model.safetensors"))
+    (ckpt / "config.json").write_text(json.dumps(cfg_dict))
+    model = StageModel(cfg, 0, 1, use_pallas=False)
+    with pytest.raises(ValueError, match="weight_scale_inv"):
+        load_stage_params(model, str(ckpt), dtype=jnp.float32)
