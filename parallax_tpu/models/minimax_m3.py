@@ -26,7 +26,7 @@ from parallax_tpu.models.registry import register_model
 from parallax_tpu.ops.kv_cache_ops import new_kv_pages, reshape_and_cache
 from parallax_tpu.ops.attention import ragged_paged_attention
 from parallax_tpu.ops.msa import (
-    msa_sparse_positions_xla,
+    msa_sparse_positions,
     new_index_pages,
     paged_sparse_gqa_attention_xla,
     store_index_cache,
@@ -140,7 +140,7 @@ class MiniMaxM3StageModel(MoEStageModel):
                                  self.sin_table)
             index_pages = store_index_cache(index_pages, idx_k,
                                             inputs.slot_mapping)
-            positions = msa_sparse_positions_xla(
+            positions = msa_sparse_positions(
                 idx_q, index_pages,
                 inputs.kv_lens, inputs.page_indices, inputs.cu_q_lens,
                 block_size=msa.block_size,
@@ -148,6 +148,8 @@ class MiniMaxM3StageModel(MoEStageModel):
                 init_blocks=msa.init_blocks,
                 local_blocks=msa.local_blocks,
                 sm_scale=d ** -0.5,
+                decode_only=inputs.decode_only,
+                use_pallas=self.use_pallas,
             )
             out = paged_sparse_gqa_attention_xla(
                 q, kv_pages,

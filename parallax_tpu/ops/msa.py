@@ -70,7 +70,6 @@ def msa_sparse_positions_xla(
     p, page_size, _, _ = index_cache.shape
     s, pages_per_seq = page_indices.shape
     kv_cap = pages_per_seq * page_size
-    nb = (kv_cap + block_size - 1) // block_size
 
     seq_of_tok, q_pos = ragged_token_positions(kv_lens, cu_q_lens, t, s)
     kv_len_tok = kv_lens[seq_of_tok]
@@ -106,6 +105,26 @@ def msa_sparse_positions_xla(
     token_scores = jnp.transpose(chunks, (1, 0, 2)).reshape(
         t, num_chunks * lc
     )[:, :kv_cap]
+    return topk_block_positions(
+        token_scores, q_pos,
+        block_size=block_size, topk_blocks=topk_blocks,
+        init_blocks=init_blocks, local_blocks=local_blocks,
+    )
+
+
+def topk_block_positions(
+    token_scores: jax.Array,  # f32[T, kv_cap] (-inf outside context)
+    q_pos: jax.Array,         # i32[T]
+    *,
+    block_size: int,
+    topk_blocks: int,
+    init_blocks: int,
+    local_blocks: int,
+) -> jax.Array:
+    """Token scores -> selected block top-k expanded to token positions
+    (shared tail of the XLA and Pallas indexer paths)."""
+    t, kv_cap = token_scores.shape
+    nb = (kv_cap + block_size - 1) // block_size
 
     # Block score: max over block tokens (heads already reduced).
     pad = nb * block_size - kv_cap
@@ -148,6 +167,49 @@ def msa_sparse_positions_xla(
             axis=-1,
         )
     return pos
+
+
+def msa_sparse_positions(
+    idx_q: jax.Array,
+    index_cache: jax.Array,
+    kv_lens: jax.Array,
+    page_indices: jax.Array,
+    cu_q_lens: jax.Array,
+    *,
+    block_size: int,
+    topk_blocks: int,
+    init_blocks: int,
+    local_blocks: int,
+    sm_scale: float,
+    decode_only: bool = False,
+    use_pallas: bool | None = None,
+) -> jax.Array:
+    """Indexer dispatcher: the Pallas page-streaming token-score kernel on
+    TPU for decode-only batches (one query per sequence), the chunked XLA
+    path otherwise (prefill / CPU / oracle)."""
+    if use_pallas is None:
+        from parallax_tpu.ops.attention import _tpu_available
+
+        use_pallas = _tpu_available()
+    if decode_only and use_pallas and idx_q.shape[0] == kv_lens.shape[0]:
+        from parallax_tpu.ops.msa_pallas import msa_token_scores_decode_pallas
+
+        scores = msa_token_scores_decode_pallas(
+            idx_q, index_cache, kv_lens, page_indices, sm_scale=sm_scale
+        )
+        # Decode q_pos = kv_len - 1; padding rows (kv_len 0) get -1 so
+        # the causal block mask rejects every block (all -1 out).
+        return topk_block_positions(
+            scores, kv_lens - 1,
+            block_size=block_size, topk_blocks=topk_blocks,
+            init_blocks=init_blocks, local_blocks=local_blocks,
+        )
+    return msa_sparse_positions_xla(
+        idx_q, index_cache, kv_lens, page_indices, cu_q_lens,
+        block_size=block_size, topk_blocks=topk_blocks,
+        init_blocks=init_blocks, local_blocks=local_blocks,
+        sm_scale=sm_scale,
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("sm_scale",))

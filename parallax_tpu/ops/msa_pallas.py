@@ -1,0 +1,95 @@
+"""Pallas TPU kernel: MSA block-indexer token scoring for decode batches.
+
+Capability parity: reference MSA indexer
+(``src/parallax_extensions/ops.py:666-719`` msa_token_indexer +
+``kernels/msa/msa_paged_attention.metal``): per-token score = max over
+index heads of ``q_idx . k_idx * scale`` over the cached context; the
+block-max / init-local forcing / top-k tail is shared plain-XLA code
+(``ops/msa.py topk_block_positions``).
+
+Same design as the DSA indexer kernel (``ops/dsa_pallas.py``): the
+indexer must read the ENTIRE index-key cache every decode step, so the
+kernel streams each physical page HBM->VMEM exactly once via the
+scalar-prefetched page table, computes the [Hi, page] dot block on the
+MXU, reduces over heads with max, masks beyond-context positions to
+``-inf``, and writes one page-wide slice of the [S, kv_cap] score
+matrix.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float("-inf")
+
+
+def _msa_decode_kernel(
+    # scalar prefetch
+    pages_ref,    # i32[S, pages_per_seq]
+    lens_ref,     # i32[S]
+    # blocks
+    q_ref,        # [1, Hi, D]
+    cache_ref,    # [1, page, 1, D]
+    out_ref,      # f32[1, page]
+    *,
+    sm_scale: float,
+):
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    page_size = cache_ref.shape[1]
+    kv_len = lens_ref[s]
+    base = j * page_size
+
+    keys = cache_ref[0, :, 0, :]                     # [page, D]
+    dots = jax.lax.dot_general(
+        q_ref[0], keys, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                # [Hi, page]
+    sc = jnp.max(dots, axis=0) * sm_scale            # [page]
+    pos = base + jax.lax.broadcasted_iota(jnp.int32, (page_size,), 0)
+    # Decode: the query sits at position kv_len-1 => causal == pos < kv_len.
+    out_ref[0, :] = jnp.where(pos < kv_len, sc, _NEG_INF)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+def msa_token_scores_decode_pallas(
+    idx_q: jax.Array,        # [S, Hi, D] — ONE query token per sequence
+    index_cache: jax.Array,  # [P, page, 1, D]
+    kv_lens: jax.Array,      # i32[S]
+    page_indices: jax.Array, # i32[S, pages_per_seq]
+    *,
+    sm_scale: float,
+    interpret: bool = False,
+) -> jax.Array:
+    """Decode-mode indexer token scores: f32[S, pages_per_seq * page]."""
+    s, hi, d = idx_q.shape
+    _, page_size, _, _ = index_cache.shape
+    _, pages_per_seq = page_indices.shape
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec((1, hi, d), lambda i, j, pages, lens: (i, 0, 0)),
+            pl.BlockSpec(
+                (1, page_size, 1, d),
+                lambda i, j, pages, lens: (pages[i, j], 0, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, page_size), lambda i, j, pages, lens: (i, j)
+        ),
+    )
+    return pl.pallas_call(
+        functools.partial(_msa_decode_kernel, sm_scale=sm_scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (s, pages_per_seq * page_size), jnp.float32
+        ),
+        interpret=interpret,
+    )(page_indices, kv_lens, idx_q, index_cache)

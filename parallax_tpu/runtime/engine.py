@@ -292,10 +292,13 @@ class StageEngine:
             )
         # Models with a decode-specialized Pallas kernel: plain MLA
         # (DeepSeek V2/V3), DSA models (the lightning-indexer decode
-        # kernel, ops/dsa_pallas.py), and sink-attention models (gpt-oss).
+        # kernel, ops/dsa_pallas.py), MSA models (the block-indexer
+        # decode kernel, ops/msa_pallas.py), and sink-attention models
+        # (gpt-oss).
         cfg_m = model.config
         self._use_decode_flag = (
-            cfg_m.is_mla or cfg_m.use_attention_sinks
+            cfg_m.is_mla or cfg_m.msa is not None
+            or cfg_m.use_attention_sinks
         )
         self._base_key = jax.random.key(self.cfg.seed)
         self._jit_multistep = None

@@ -76,6 +76,8 @@ class Scheduler:
         self.is_first_stage = is_first_stage
         self.wait_queue: OrderedDict[str, Request] = OrderedDict()
         self.running: OrderedDict[str, Request] = OrderedDict()
+        # Round-robin cursor over adapter groups (see form_batch).
+        self._lora_cursor = 0
 
     # -- intake -----------------------------------------------------------
 
@@ -173,12 +175,27 @@ class Scheduler:
         seqs: list[ScheduledSeq] = []
         token_budget = self.max_num_tokens_per_batch
 
-        # One LoRA adapter per batch (in-graph slot selection is scalar):
-        # the batch takes the adapter of the first schedulable request,
-        # and other-adapter requests wait for a later step. _UNSET (not
-        # None) so base traffic groups too.
-        _UNSET = object()
-        batch_lora = _UNSET
+        # One LoRA adapter per batch (in-graph slot selection is scalar).
+        # The batch's adapter rotates round-robin over the DISTINCT
+        # adapters with schedulable work — without rotation the first
+        # running request's tenant head-of-line-blocks every other tenant
+        # until it finishes. When nothing is schedulable the value is
+        # irrelevant (the loops below append no seqs).
+        groups: list = []
+        for req in self.running.values():
+            schedulable = (
+                req.status is RequestStatus.PREFILLING
+                and req.remaining_prompt_tokens() > 0
+            ) or (
+                req.status is RequestStatus.DECODING and req.ready_for_step
+            )
+            if schedulable and req.lora_id not in groups:
+                groups.append(req.lora_id)
+        if len(groups) > 1:
+            batch_lora = groups[self._lora_cursor % len(groups)]
+            self._lora_cursor += 1
+        else:
+            batch_lora = groups[0] if groups else None
 
         # Prefill chunks first (including re-chunked long prompts).
         for req in self.running.values():
@@ -186,7 +203,7 @@ class Scheduler:
                 break
             if req.status is not RequestStatus.PREFILLING:
                 continue
-            if batch_lora is not _UNSET and req.lora_id != batch_lora:
+            if req.lora_id != batch_lora:
                 continue
             remaining = req.remaining_prompt_tokens()
             if remaining <= 0:
@@ -210,7 +227,6 @@ class Scheduler:
                 )
             )
             token_budget -= n
-            batch_lora = req.lora_id
 
         # Then ready decodes.
         for req in self.running.values():
@@ -218,7 +234,7 @@ class Scheduler:
                 break
             if req.status is not RequestStatus.DECODING or not req.ready_for_step:
                 continue
-            if batch_lora is not _UNSET and req.lora_id != batch_lora:
+            if req.lora_id != batch_lora:
                 continue
             if not self.cache.ensure_capacity(req, req.total_len):
                 self._abort_on_oom(req)
@@ -233,10 +249,7 @@ class Scheduler:
                 )
             )
             token_budget -= 1
-            batch_lora = req.lora_id
-        return BatchPlan(
-            seqs, lora_id=None if batch_lora is _UNSET else batch_lora
-        )
+        return BatchPlan(seqs, lora_id=batch_lora if seqs else None)
 
     # -- step feedback ----------------------------------------------------
 

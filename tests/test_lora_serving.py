@@ -254,6 +254,9 @@ class TestGroupingAndWire:
         eng.scheduler.form_batch = spy
         pipe.run_until_complete()
         assert {None, "ad1", "ad2"} <= set(seen)
+        # Round-robin fairness: every tenant is served within the first
+        # few batches instead of head-of-line blocking behind the first.
+        assert {None, "ad1", "ad2"} <= set(seen[:4]), seen[:8]
 
     def test_lora_id_round_trips_on_the_wire(self):
         from parallax_tpu.p2p.proto import ireq_from_wire, ireq_to_wire

@@ -376,3 +376,49 @@ def test_sparse_gqa_chunked_matches_single_pass():
         ))
     jax.clear_caches()
     np.testing.assert_allclose(chunked, single, rtol=2e-5, atol=2e-5)
+
+
+def test_msa_pallas_decode_positions_match_xla():
+    """The Pallas token-score decode kernel (interpret mode off-TPU)
+    composed with the shared block top-k must reproduce the XLA indexer
+    exactly: multi-sequence decode batch, ragged contexts, padding row."""
+    from parallax_tpu.ops.msa import topk_block_positions
+    from parallax_tpu.ops.msa_pallas import msa_token_scores_decode_pallas
+
+    rng = np.random.default_rng(6)
+    page_size, num_pages = 8, 32
+    hi, d = 3, 16
+    ctxs = [21, 9, 0]
+    page_tables = [[1, 2, 3, 0], [4, 5, 0, 0], [0, 0, 0, 0]]
+    cache = new_index_pages(num_pages, page_size, d, jnp.float32)
+    for ctx, table in zip(ctxs, page_tables):
+        if ctx == 0:
+            continue
+        keys = rng.standard_normal((ctx, d)).astype(np.float32)
+        slots = np.array(
+            [table[i // page_size] * page_size + i % page_size
+             for i in range(ctx)], np.int32,
+        )
+        cache = store_index_cache(cache, jnp.asarray(keys),
+                                  jnp.asarray(slots))
+
+    s = len(ctxs)
+    q = rng.standard_normal((s, hi, d)).astype(np.float32)
+    kv_lens = jnp.asarray(ctxs, jnp.int32)
+    page_indices = jnp.asarray(page_tables, jnp.int32)
+    cu = jnp.asarray(np.arange(s + 1), jnp.int32)
+    kw = dict(block_size=4, topk_blocks=3, init_blocks=1, local_blocks=1,
+              sm_scale=0.5)
+
+    want = np.asarray(msa_sparse_positions_xla(
+        jnp.asarray(q), cache, kv_lens, page_indices, cu, **kw,
+    ))
+    scores = msa_token_scores_decode_pallas(
+        jnp.asarray(q), cache, kv_lens, page_indices,
+        sm_scale=0.5, interpret=True,
+    )
+    got = np.asarray(topk_block_positions(
+        scores, kv_lens - 1,
+        block_size=4, topk_blocks=3, init_blocks=1, local_blocks=1,
+    ))
+    np.testing.assert_array_equal(got, want)
