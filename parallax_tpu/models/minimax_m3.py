@@ -163,6 +163,7 @@ class MiniMaxM3StageModel(MoEStageModel):
                 inputs.kv_lens, inputs.page_indices, inputs.cu_q_lens,
                 inputs.num_seqs, sm_scale=d ** -0.5,
                 sliding_window=None, use_pallas=self.use_pallas,
+                decode_only=inputs.decode_only,
             )
             new_kv = kv_pages
         out = L.row_parallel_linear(

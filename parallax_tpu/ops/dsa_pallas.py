@@ -1,10 +1,11 @@
-"""Pallas TPU kernel: DSA lightning-indexer scoring for decode batches.
+"""Pallas TPU kernels: sparse-attention indexer scoring for decode
+batches (DSA and, via ``ops/msa_pallas.py``, MSA).
 
-Capability parity: reference indexer kernel
-(``src/parallax_extensions/kernels/dsa/dsa_indexer.metal:100-115``, facade
-``ops.py:248-343``): ``score[s] = sum_h w_h * relu(q_h . k_s)`` over the
-cached context. The XLA chunked path in ``ops/dsa.py`` stays as the
-oracle and the prefill path.
+Capability parity: reference indexer kernels
+(``src/parallax_extensions/kernels/dsa/dsa_indexer.metal:100-115``,
+facade ``ops.py:248-343``): ``score[s] = sum_h w_h * relu(q_h . k_s)``
+over the cached context. The XLA chunked paths in ``ops/dsa.py`` /
+``ops/msa.py`` stay as the oracle and the prefill path.
 
 Why a kernel: the indexer reads the ENTIRE index-key cache every decode
 step (that is its job — scoring all positions to pick top-k), so decode
@@ -14,11 +15,12 @@ kernel streams each physical page HBM->VMEM exactly once via the
 scalar-prefetched page table and keeps the [Hi, page] score block in
 VMEM, so the layer runs at key-streaming bandwidth.
 
-Kernel shape: grid ``(num_seqs, pages_per_seq)``; block ``j`` DMAs one
-index page, computes ``relu(q . k^T)`` on the MXU, reduces over heads
-with the per-token head weights, masks beyond-context positions to
-``-inf`` (the top-k facade's dense-row detection relies on exact -inf),
-and writes one page-wide slice of the [S, kv_cap] score matrix.
+Kernel shape (shared by both indexers — they differ only in the head
+reduction): grid ``(num_seqs, pages_per_seq)``; block ``j`` DMAs one
+index page, computes ``q . k^T`` on the MXU, reduces over heads, masks
+beyond-context positions to ``-inf`` (the top-k facades' dense-row /
+causal-block detection relies on exact -inf), and writes one page-wide
+slice of the [S, kv_cap] score matrix.
 """
 
 from __future__ import annotations
@@ -33,34 +35,82 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = float("-inf")
 
 
-def _indexer_decode_kernel(
-    # scalar prefetch
-    pages_ref,    # i32[S, pages_per_seq]
-    lens_ref,     # i32[S]
-    # blocks
-    q_ref,        # [1, Hi, D]
-    w_ref,        # f32[1, Hi]
-    cache_ref,    # [1, page, 1, D]
-    out_ref,      # f32[1, page]
-):
-    s = pl.program_id(0)
-    j = pl.program_id(1)
-    page_size = cache_ref.shape[1]
-    kv_len = lens_ref[s]
-    base = j * page_size
+def paged_token_scores_decode(
+    q: jax.Array,            # [S, Hi, D] — ONE query token per sequence
+    weights,                 # f32[S, Hi] or None (reduction-dependent)
+    index_cache: jax.Array,  # [P, page, 1, D]
+    kv_lens: jax.Array,      # i32[S]
+    page_indices: jax.Array, # i32[S, pages_per_seq]
+    *,
+    reduce_heads,            # (dots f32[Hi, page], w f32[Hi]|None) -> [page]
+    interpret: bool = False,
+) -> jax.Array:
+    """Shared page-streaming scorer: f32[S, pages_per_seq * page_size].
 
-    keys = cache_ref[0, :, 0, :]                     # [page, D]
-    dots = jax.lax.dot_general(
-        q_ref[0], keys, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )                                                # [Hi, page]
-    sc = jnp.sum(
-        w_ref[0][:, None] * jnp.maximum(dots, 0.0), axis=0
-    )                                                # [page]
-    pos = base + jax.lax.broadcasted_iota(jnp.int32, (page_size,), 0)
-    # Decode: the query sits at position kv_len-1, so causal validity is
-    # simply pos < kv_len (covers padding sequences with kv_len 0 too).
-    out_ref[0, :] = jnp.where(pos < kv_len, sc, _NEG_INF)
+    ``reduce_heads`` folds the per-head dot block into per-token scores
+    (DSA: relu-weighted sum; MSA: scaled max)."""
+    s, hi, d = q.shape
+    _, page_size, _, _ = index_cache.shape
+    _, pages_per_seq = page_indices.shape
+    with_w = weights is not None
+
+    def kernel(pages_ref, lens_ref, q_ref, *rest):
+        if with_w:
+            w_ref, cache_ref, out_ref = rest
+        else:
+            cache_ref, out_ref = rest
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+        kv_len = lens_ref[i]
+        keys = cache_ref[0, :, 0, :]                 # [page, D]
+        dots = jax.lax.dot_general(
+            q_ref[0], keys, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                            # [Hi, page]
+        sc = reduce_heads(dots, w_ref[0] if with_w else None)
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (page_size,), 0
+        )
+        # Decode: the query sits at position kv_len-1, so causal validity
+        # is pos < kv_len (covers padding sequences with kv_len 0 too).
+        out_ref[0, :] = jnp.where(pos < kv_len, sc, _NEG_INF)
+
+    in_specs = [
+        pl.BlockSpec((1, hi, d), lambda i, j, pages, lens: (i, 0, 0)),
+    ]
+    operands = [q]
+    if with_w:
+        in_specs.append(
+            pl.BlockSpec((1, hi), lambda i, j, pages, lens: (i, 0))
+        )
+        operands.append(weights.astype(jnp.float32))
+    in_specs.append(pl.BlockSpec(
+        (1, page_size, 1, d),
+        lambda i, j, pages, lens: (pages[i, j], 0, 0, 0),
+    ))
+    operands.append(index_cache)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s, pages_per_seq),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, page_size), lambda i, j, pages, lens: (i, j)
+        ),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (s, pages_per_seq * page_size), jnp.float32
+        ),
+        interpret=interpret,
+    )(page_indices, kv_lens, *operands)
+
+
+def _dsa_reduce(dots, w):
+    """DSA lightning indexer: ``sum_h w_h * relu(q_h . k)``."""
+    return jnp.sum(w[:, None] * jnp.maximum(dots, 0.0), axis=0)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -73,31 +123,8 @@ def dsa_indexer_scores_decode_pallas(
     *,
     interpret: bool = False,
 ) -> jax.Array:
-    """Decode-mode indexer scores: f32[S, pages_per_seq * page_size]."""
-    s, hi, d = q.shape
-    _, page_size, _, _ = index_cache.shape
-    _, pages_per_seq = page_indices.shape
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(s, pages_per_seq),
-        in_specs=[
-            pl.BlockSpec((1, hi, d), lambda i, j, pages, lens: (i, 0, 0)),
-            pl.BlockSpec((1, hi), lambda i, j, pages, lens: (i, 0)),
-            pl.BlockSpec(
-                (1, page_size, 1, d),
-                lambda i, j, pages, lens: (pages[i, j], 0, 0, 0),
-            ),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, page_size), lambda i, j, pages, lens: (i, j)
-        ),
+    """Decode-mode DSA indexer scores: f32[S, pages_per_seq * page]."""
+    return paged_token_scores_decode(
+        q, weights, index_cache, kv_lens, page_indices,
+        reduce_heads=_dsa_reduce, interpret=interpret,
     )
-    return pl.pallas_call(
-        _indexer_decode_kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(
-            (s, pages_per_seq * page_size), jnp.float32
-        ),
-        interpret=interpret,
-    )(page_indices, kv_lens, q, weights.astype(jnp.float32), index_cache)

@@ -7,13 +7,10 @@ index heads of ``q_idx . k_idx * scale`` over the cached context; the
 block-max / init-local forcing / top-k tail is shared plain-XLA code
 (``ops/msa.py topk_block_positions``).
 
-Same design as the DSA indexer kernel (``ops/dsa_pallas.py``): the
-indexer must read the ENTIRE index-key cache every decode step, so the
-kernel streams each physical page HBM->VMEM exactly once via the
-scalar-prefetched page table, computes the [Hi, page] dot block on the
-MXU, reduces over heads with max, masks beyond-context positions to
-``-inf``, and writes one page-wide slice of the [S, kv_cap] score
-matrix.
+The page-streaming scaffold (scalar-prefetched page table, causal
+masking, grid layout) is shared with the DSA indexer — see
+``ops/dsa_pallas.py paged_token_scores_decode``; only the head
+reduction differs.
 """
 
 from __future__ import annotations
@@ -22,38 +19,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-_NEG_INF = float("-inf")
-
-
-def _msa_decode_kernel(
-    # scalar prefetch
-    pages_ref,    # i32[S, pages_per_seq]
-    lens_ref,     # i32[S]
-    # blocks
-    q_ref,        # [1, Hi, D]
-    cache_ref,    # [1, page, 1, D]
-    out_ref,      # f32[1, page]
-    *,
-    sm_scale: float,
-):
-    s = pl.program_id(0)
-    j = pl.program_id(1)
-    page_size = cache_ref.shape[1]
-    kv_len = lens_ref[s]
-    base = j * page_size
-
-    keys = cache_ref[0, :, 0, :]                     # [page, D]
-    dots = jax.lax.dot_general(
-        q_ref[0], keys, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )                                                # [Hi, page]
-    sc = jnp.max(dots, axis=0) * sm_scale            # [page]
-    pos = base + jax.lax.broadcasted_iota(jnp.int32, (page_size,), 0)
-    # Decode: the query sits at position kv_len-1 => causal == pos < kv_len.
-    out_ref[0, :] = jnp.where(pos < kv_len, sc, _NEG_INF)
+from parallax_tpu.ops.dsa_pallas import paged_token_scores_decode
 
 
 @functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
@@ -67,29 +34,12 @@ def msa_token_scores_decode_pallas(
     interpret: bool = False,
 ) -> jax.Array:
     """Decode-mode indexer token scores: f32[S, pages_per_seq * page]."""
-    s, hi, d = idx_q.shape
-    _, page_size, _, _ = index_cache.shape
-    _, pages_per_seq = page_indices.shape
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(s, pages_per_seq),
-        in_specs=[
-            pl.BlockSpec((1, hi, d), lambda i, j, pages, lens: (i, 0, 0)),
-            pl.BlockSpec(
-                (1, page_size, 1, d),
-                lambda i, j, pages, lens: (pages[i, j], 0, 0, 0),
-            ),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, page_size), lambda i, j, pages, lens: (i, j)
-        ),
+    def reduce_heads(dots, _w):
+        # Max over index heads; the (positive) scale commutes past max.
+        return jnp.max(dots, axis=0) * sm_scale
+
+    return paged_token_scores_decode(
+        idx_q, None, index_cache, kv_lens, page_indices,
+        reduce_heads=reduce_heads, interpret=interpret,
     )
-    return pl.pallas_call(
-        functools.partial(_msa_decode_kernel, sm_scale=sm_scale),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(
-            (s, pages_per_seq * page_size), jnp.float32
-        ),
-        interpret=interpret,
-    )(page_indices, kv_lens, idx_q, index_cache)

@@ -172,15 +172,14 @@ class Scheduler:
         """
         self.check_timeouts()
         self.admit_requests()
-        seqs: list[ScheduledSeq] = []
-        token_budget = self.max_num_tokens_per_batch
 
         # One LoRA adapter per batch (in-graph slot selection is scalar).
         # The batch's adapter rotates round-robin over the DISTINCT
         # adapters with schedulable work — without rotation the first
         # running request's tenant head-of-line-blocks every other tenant
-        # until it finishes. When nothing is schedulable the value is
-        # irrelevant (the loops below append no seqs).
+        # until it finishes. A chosen group can still schedule nothing
+        # (e.g. its only request OOM-aborts at capacity check), so fall
+        # through to the next group rather than idling the step.
         groups: list = []
         for req in self.running.values():
             schedulable = (
@@ -191,11 +190,22 @@ class Scheduler:
             )
             if schedulable and req.lora_id not in groups:
                 groups.append(req.lora_id)
+        if not groups:
+            return BatchPlan([])
+        start = self._lora_cursor % len(groups)
         if len(groups) > 1:
-            batch_lora = groups[self._lora_cursor % len(groups)]
             self._lora_cursor += 1
-        else:
-            batch_lora = groups[0] if groups else None
+        for off in range(len(groups)):
+            batch_lora = groups[(start + off) % len(groups)]
+            seqs = self._fill_batch(batch_lora)
+            if seqs:
+                return BatchPlan(seqs, lora_id=batch_lora)
+        return BatchPlan([])
+
+    def _fill_batch(self, batch_lora: str | None) -> list[ScheduledSeq]:
+        """The prefill-first loops for one adapter group."""
+        seqs: list[ScheduledSeq] = []
+        token_budget = self.max_num_tokens_per_batch
 
         # Prefill chunks first (including re-chunked long prompts).
         for req in self.running.values():
@@ -249,7 +259,7 @@ class Scheduler:
                 )
             )
             token_budget -= 1
-        return BatchPlan(seqs, lora_id=batch_lora if seqs else None)
+        return seqs
 
     # -- step feedback ----------------------------------------------------
 

@@ -198,6 +198,41 @@ def test_schedulerless_swarm_serves_via_gossip():
                 break
             time.sleep(0.1)
         assert head.local_route() is None
+
+        # Elastic recovery: a REPLACEMENT tail (fresh address) announces
+        # to the head and the route comes back through the new node.
+        t_new = TcpTransport("", "127.0.0.1")
+        t_new.start()
+        t_new.peer_id = t_new.address
+        replacement = WorkerNode(
+            transport=t_new, scheduler_peer=None,
+            model_config=TINY, engine_config=ENGINE_CFG,
+            load_params=stage_params, heartbeat_interval_s=0.2,
+            static_peers=[head.node_id], layers=(2, 4),
+        )
+        workers.append(replacement)
+        import threading as _threading
+
+        st = _threading.Thread(target=replacement.start)
+        st.start()
+        st.join(timeout=60.0)
+        deadline = time.monotonic() + 20.0
+        route = None
+        while time.monotonic() < deadline:
+            route = head.local_route()
+            if route is not None:
+                break
+            time.sleep(0.1)
+        assert route == [head.node_id, replacement.node_id], route
+        req2 = Request(
+            request_id="nosched-2",
+            prompt_ids=[1, 2, 3, 4, 5, 6, 7],
+            sampling_params=SamplingParams(temperature=0.0,
+                                           max_new_tokens=4),
+        )
+        done2 = head.submit(req2)
+        assert done2.wait(30.0), f"recovered swarm failed: {req2.status}"
+        assert len(req2.output_ids) == 4
     finally:
         for w in workers:
             try:
