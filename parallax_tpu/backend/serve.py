@@ -113,6 +113,27 @@ def build_local_frontend(
     return frontend, runner
 
 
+def _sp_eligible(config) -> bool:
+    """Config-level mirror of StageEngine._model_supports_sp: can this
+    model take the ring-attention prefill path at all? (The engine also
+    checks class-level _attention overrides; every architecture that
+    overrides it is config-detectable below.)"""
+    from parallax_tpu.config import LAYER_ATTENTION
+
+    if config.is_mla or config.use_attention_sinks:
+        return False
+    if (
+        config.linear_attn is not None
+        or config.dsa is not None
+        or config.msa is not None
+    ):
+        return False
+    return all(
+        config.layer_type(i) == LAYER_ATTENTION
+        for i in range(config.num_hidden_layers)
+    )
+
+
 def serve_main(args) -> int:
     """``parallax-tpu serve`` entry."""
     import os
@@ -145,20 +166,34 @@ def serve_main(args) -> int:
     end = args.end_layer or config.num_hidden_layers
 
     tp_size = getattr(args, "tp_size", 0)
-    if (getattr(args, "sp_size", 0) or 0) > 1 and not tp_size:
-        # SP claims the devices; TP defaults to off unless explicitly set
-        # (ring prefill does not compose with a TP-sharded stage yet).
+    sp_for_mesh = getattr(args, "sp_size", 0) or 0
+    if sp_for_mesh > 1 and not tp_size:
+        # SP claims the devices; TP defaults to off unless explicitly set.
         tp_size = 1
     mesh = None
     if tp_size != 1:
         import jax as _jax
 
         n = len(_jax.local_devices())
-        tp_size = tp_size or n
+        if not tp_size:
+            tp_size = n
         if tp_size > 1:
             from parallax_tpu.parallel import make_mesh
 
-            mesh = make_mesh(tp_size=tp_size)
+            # SP x TP: one combined mesh; the engine detects the sp axis
+            # and runs the ring body inside the TP shard_map. Models the
+            # engine refuses SP for must not claim (and waste) sp x
+            # devices, so pre-check eligibility here.
+            sp_axis = max(1, sp_for_mesh)
+            if sp_axis > 1 and not _sp_eligible(config):
+                logger.warning(
+                    "--sp-size %d ignored: %s does not support "
+                    "ring-attention prefill (MLA/sparse/hybrid/window/"
+                    "sink attention)", sp_for_mesh, config.architecture,
+                )
+                sp_axis = 1
+                sp_for_mesh = 0
+            mesh = make_mesh(tp_size=tp_size, sp_size=sp_axis)
     model = create_stage_model(config, start, end, tp_size=max(1, tp_size))
     # LoRA merges into full-precision weights pre-finalize; on-load
     # quantization runs after the merge inside the loader.
@@ -173,10 +208,12 @@ def serve_main(args) -> int:
     sp_mesh = None
     sp_threshold = None
     if sp_size > 1:
-        from parallax_tpu.parallel import make_mesh
-
-        sp_mesh = make_mesh(sp_size=sp_size, tp_size=1)
         sp_threshold = getattr(args, "sp_threshold", 2048)
+        if tp_size <= 1:
+            from parallax_tpu.parallel import make_mesh
+
+            sp_mesh = make_mesh(sp_size=sp_size, tp_size=1)
+        # tp > 1: the combined mesh above carries the sp axis instead.
     draft = None
     draft_path = getattr(args, "draft_model_path", None)
     if draft_path:

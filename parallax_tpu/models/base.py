@@ -280,6 +280,11 @@ class StageModel:
     # tracing its long-prefill step function (ring attention over the
     # ``sp`` mesh axis instead of the paged-cache read).
     sp_mesh = None
+    # SP x TP composition: when > 1, the stage is traced INSIDE a TP
+    # shard_map over a combined ("sp", "tp") mesh and the attention block
+    # slices its sp rank's token block for the ring body in place of
+    # opening its own shard_map.
+    sp_in_mesh = 0
     _sp_active = False
     # Set by tp.tp_stage_fn when the lm_head weight is vocab-sharded.
     _lm_head_sharded = False
@@ -305,6 +310,7 @@ class StageModel:
             axis_name=self.axis_name,
             rope_fn=self.rope_fn,
             sp_mesh=self.sp_mesh if self._sp_active else None,
+            sp_in_mesh=self.sp_in_mesh if self._sp_active else 0,
             decode_only=inputs.decode_only,
         )
 

@@ -199,6 +199,7 @@ def paged_attention_block(
     axis_name: str | None = None,
     rope_fn=apply_rope,
     sp_mesh=None,
+    sp_in_mesh: int = 0,
     decode_only: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """GQA attention over the paged cache: project, rope, scatter, attend.
@@ -235,7 +236,29 @@ def paged_attention_block(
     k = rope_fn(k, positions, cos_table, sin_table)
 
     kv_pages = reshape_and_cache(kv_pages, k, v, slot_mapping)
-    if sp_mesh is not None:
+    if sp_in_mesh > 1:
+        # SP x TP composition: we are ALREADY inside the TP stage's
+        # shard_map (mesh axes ("sp", "tp"); everything here replicated
+        # over sp, heads sharded over tp). The cache scatter above ran on
+        # the full token batch — identical on every sp rank, keeping the
+        # (sp-replicated) cache consistent — and only the quadratic
+        # attention shards: each rank slices its token block and runs the
+        # ring body directly with "sp" collectives.
+        from parallax_tpu.parallel.sp import ring_attention_local
+
+        rank = jax.lax.axis_index("sp")
+        tshard = t // sp_in_mesh   # engine lattice pads T to sp multiples
+        kv_positions = jnp.where(positions < 0, jnp.int32(2**30), positions)
+
+        def _sl(a):
+            return jax.lax.dynamic_slice_in_dim(a, rank * tshard, tshard, 0)
+
+        out_l = ring_attention_local(
+            _sl(q), _sl(k), _sl(v), _sl(positions), _sl(kv_positions),
+            axis_name="sp", sm_scale=d**-0.5, sp=sp_in_mesh,
+        )
+        out = jax.lax.all_gather(out_l, "sp", axis=0, tiled=True)
+    elif sp_mesh is not None:
         from parallax_tpu.parallel.sp import ring_attention
 
         out = ring_attention(

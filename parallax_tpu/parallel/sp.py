@@ -74,6 +74,12 @@ def _ring_attention_local(q, k, v, q_pos, kv_pos, *, axis_name, sm_scale, sp):
     return out.reshape(tq, hq, d).astype(q.dtype)
 
 
+# Public alias: the per-device ring body, for callers ALREADY inside a
+# shard_map whose mesh carries the "sp" axis (SP x TP composition —
+# layers.paged_attention_block).
+ring_attention_local = _ring_attention_local
+
+
 def ring_attention(
     mesh: Mesh,
     q: jax.Array,           # [T, Hq, D] global (padded to sp multiple)

@@ -246,6 +246,7 @@ class StageEngine:
             self.cfg.max_model_len,
             self.cfg.page_size,
         )
+        stage_fn = self._stage_fn
         if mesh is not None and model.tp_size > 1:
             from parallax_tpu.parallel import tp as _tp
 
@@ -254,27 +255,34 @@ class StageEngine:
                 col_vecs=getattr(model, "tp_column_vector_params",
                                  frozenset()),
             )
-            self._jit_step = jax.jit(
-                _tp.tp_stage_fn(model, params, mesh), donate_argnums=(1,)
-            )
-        else:
-            self._jit_step = jax.jit(self._stage_fn, donate_argnums=(1,))
+            stage_fn = _tp.tp_stage_fn(model, params, mesh)
+        self._jit_step = jax.jit(stage_fn, donate_argnums=(1,))
         # Sequence-parallel long-prefill path: its own jit (traced with the
         # model's SP flag up) and its own bucket lattice — token buckets are
         # sp-multiples so the ring shards evenly, one sequence per step.
+        # Two forms: a dedicated sp_mesh (unsharded stage, the ring opens
+        # its own shard_map) or SP x TP composition (the engine's combined
+        # mesh carries an sp axis > 1 and the ring body runs inside the TP
+        # shard_map).
+        mesh_sp = int(mesh.shape.get("sp", 1)) if mesh is not None else 1
+        sp_in_mesh = mesh_sp if model.tp_size > 1 else 1
         self._sp_enabled = (
-            sp_mesh is not None
+            (sp_mesh is not None or sp_in_mesh > 1)
             and self.cfg.sp_threshold is not None
-            and self._model_supports_sp(model)
+            and self._model_supports_sp(model, in_mesh=sp_in_mesh > 1)
         )
         if self._sp_enabled:
-            sp = sp_mesh.shape["sp"]
-            model.sp_mesh = sp_mesh
+            if sp_in_mesh > 1:
+                sp = sp_in_mesh
+                model.sp_in_mesh = sp
+            else:
+                sp = sp_mesh.shape["sp"]
+                model.sp_mesh = sp_mesh
 
             def _sp_stage_fn(params, kv, inputs):
                 self.model._sp_active = True
                 try:
-                    return self.model(params, kv, inputs)
+                    return stage_fn(params, kv, inputs)
                 finally:
                     self.model._sp_active = False
 
@@ -394,15 +402,18 @@ class StageEngine:
             return None
         return self._adapters.batch_field(plan.lora_id)
 
-    def _model_supports_sp(self, model: StageModel) -> bool:
+    def _model_supports_sp(self, model: StageModel,
+                           in_mesh: bool = False) -> bool:
         """Ring-attention prefill covers only the plain full-causal GQA
-        path: models overriding ``_attention`` (MLA/DSA/MSA/hybrid), layers
-        with windows or sinks, and TP-sharded stages (whose psum axis would
-        escape the TP shard_map) would silently diverge — refuse them so
-        SP dispatch is never inert or wrong."""
+        path: models overriding ``_attention`` (MLA/DSA/MSA/hybrid) and
+        layers with windows or sinks would silently diverge — refuse them
+        so SP dispatch is never inert or wrong. TP-sharded stages compose
+        only through the in-mesh form (the ring body running inside the
+        TP shard_map over a combined ("sp", "tp") mesh); the standalone
+        sp_mesh form would let the psum axis escape the TP shard_map."""
         from parallax_tpu.config import LAYER_ATTENTION
 
-        if self._needs_state or model.tp_size > 1:
+        if self._needs_state or (model.tp_size > 1 and not in_mesh):
             return False
         if type(model)._attention is not StageModel._attention:
             return False

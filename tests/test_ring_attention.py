@@ -129,6 +129,59 @@ def test_engine_sp_prefill_matches_dense_engine():
     assert sp_out == dense_out, (sp_out, dense_out)
 
 
+def test_engine_sp_tp_composed_matches_dense_engine():
+    """SP x TP composition: a 2x4 ("sp", "tp") mesh engine — ring body
+    inside the TP shard_map — must match the unsharded engine
+    token-for-token, and decode afterwards must read the same KV cache
+    the SP prefill wrote."""
+    import numpy as np
+
+    from parallax_tpu.config import normalize_config
+    from parallax_tpu.models.base import StageModel
+    from parallax_tpu.runtime.engine import EngineConfig, StageEngine
+    from parallax_tpu.runtime.pipeline import InProcessPipeline
+    from parallax_tpu.runtime.request import Request, SamplingParams
+
+    cfg = normalize_config(dict(
+        architectures=["Qwen2ForCausalLM"], hidden_size=64,
+        num_hidden_layers=2, num_attention_heads=8, num_key_value_heads=4,
+        intermediate_size=128, vocab_size=199, max_position_embeddings=2048,
+        tie_word_embeddings=False,
+    ))
+    model_a = StageModel(cfg, 0, 2, use_pallas=False)
+    params = model_a.init_params(jax.random.key(0), dtype=jnp.float32)
+    prompt = [int(x) for x in
+              np.random.default_rng(1).integers(1, 198, size=300)]
+
+    def gen(engine):
+        pipe = InProcessPipeline([engine])
+        req = Request("r", prompt_ids=list(prompt),
+                      sampling_params=SamplingParams(temperature=0.0,
+                                                     max_new_tokens=5))
+        pipe.submit(req)
+        pipe.run_until_complete()
+        return req.output_ids, req
+
+    base = dict(page_size=8, num_pages=128, max_model_len=512,
+                max_num_tokens_per_batch=512, kv_dtype="float32",
+                enable_prefix_cache=False)
+    dense_eng = StageEngine(model_a, params, EngineConfig(**base))
+    dense_out, _ = gen(dense_eng)
+
+    model_b = StageModel(cfg, 0, 2, use_pallas=False, tp_size=4)
+    mesh = make_mesh(tp_size=4, sp_size=2)
+    sp_eng = StageEngine(
+        model_b, params, EngineConfig(**base, sp_threshold=256),
+        mesh=mesh,
+    )
+    assert sp_eng._sp_enabled
+    sp_out, sp_req = gen(sp_eng)
+    # The whole prompt prefilled in ONE ring step, then decode (5 tokens)
+    # ran on the normal TP path against the SP-written cache.
+    assert sp_req.num_computed_tokens >= len(prompt)
+    assert sp_out == dense_out, (sp_out, dense_out)
+
+
 def test_engine_sp_below_threshold_uses_normal_path():
     import numpy as np
 
