@@ -114,11 +114,13 @@ def build_local_frontend(
 
 
 def _sp_eligible(config) -> bool:
-    """Config-level mirror of StageEngine._model_supports_sp: can this
-    model take the ring-attention prefill path at all? (The engine also
-    checks class-level _attention overrides; every architecture that
-    overrides it is config-detectable below.)"""
+    """Mirror of StageEngine._model_supports_sp at config level: can this
+    model take the ring-attention prefill path at all? Includes the
+    class-level ``_attention`` override check (e.g. MiniMax-M2 overrides
+    it despite a plain-attention config)."""
     from parallax_tpu.config import LAYER_ATTENTION
+    from parallax_tpu.models.base import StageModel
+    from parallax_tpu.models.registry import get_model_class
 
     if config.is_mla or config.use_attention_sinks:
         return False
@@ -126,6 +128,10 @@ def _sp_eligible(config) -> bool:
         config.linear_attn is not None
         or config.dsa is not None
         or config.msa is not None
+    ):
+        return False
+    if get_model_class(config.architecture)._attention is not (
+        StageModel._attention
     ):
         return False
     return all(
@@ -166,8 +172,17 @@ def serve_main(args) -> int:
     end = args.end_layer or config.num_hidden_layers
 
     tp_size = getattr(args, "tp_size", 0)
-    sp_for_mesh = getattr(args, "sp_size", 0) or 0
-    if sp_for_mesh > 1 and not tp_size:
+    sp_size = getattr(args, "sp_size", 0) or 0
+    if sp_size > 1 and not _sp_eligible(config):
+        # Models the engine refuses SP for must not claim (and waste)
+        # sp x devices on a silently inert ring path.
+        logger.warning(
+            "--sp-size %d ignored: %s does not support ring-attention "
+            "prefill (MLA/sparse/hybrid/window/sink attention)",
+            sp_size, config.architecture,
+        )
+        sp_size = 0
+    if sp_size > 1 and not tp_size:
         # SP claims the devices; TP defaults to off unless explicitly set.
         tp_size = 1
     mesh = None
@@ -181,19 +196,8 @@ def serve_main(args) -> int:
             from parallax_tpu.parallel import make_mesh
 
             # SP x TP: one combined mesh; the engine detects the sp axis
-            # and runs the ring body inside the TP shard_map. Models the
-            # engine refuses SP for must not claim (and waste) sp x
-            # devices, so pre-check eligibility here.
-            sp_axis = max(1, sp_for_mesh)
-            if sp_axis > 1 and not _sp_eligible(config):
-                logger.warning(
-                    "--sp-size %d ignored: %s does not support "
-                    "ring-attention prefill (MLA/sparse/hybrid/window/"
-                    "sink attention)", sp_for_mesh, config.architecture,
-                )
-                sp_axis = 1
-                sp_for_mesh = 0
-            mesh = make_mesh(tp_size=tp_size, sp_size=sp_axis)
+            # and runs the ring body inside the TP shard_map.
+            mesh = make_mesh(tp_size=tp_size, sp_size=max(1, sp_size))
     model = create_stage_model(config, start, end, tp_size=max(1, tp_size))
     # LoRA merges into full-precision weights pre-finalize; on-load
     # quantization runs after the merge inside the loader.
@@ -204,7 +208,6 @@ def serve_main(args) -> int:
     )
 
     page_size = args.page_size
-    sp_size = getattr(args, "sp_size", 0) or 0
     sp_mesh = None
     sp_threshold = None
     if sp_size > 1:

@@ -242,9 +242,10 @@ def paged_attention_block(
         # over sp, heads sharded over tp). The cache scatter above ran on
         # the full token batch — identical on every sp rank, keeping the
         # (sp-replicated) cache consistent — and only the quadratic
-        # attention shards: each rank slices its token block and runs the
-        # ring body directly with "sp" collectives.
-        from parallax_tpu.parallel.sp import ring_attention_local
+        # attention shards: each rank slices its query block and flashes
+        # it against the full K/V it already holds (no ring rotation —
+        # ppermuting replicated blocks would be pure ICI overhead).
+        from parallax_tpu.parallel.sp import context_blocks_attention_local
 
         rank = jax.lax.axis_index("sp")
         tshard = t // sp_in_mesh   # engine lattice pads T to sp multiples
@@ -253,9 +254,9 @@ def paged_attention_block(
         def _sl(a):
             return jax.lax.dynamic_slice_in_dim(a, rank * tshard, tshard, 0)
 
-        out_l = ring_attention_local(
-            _sl(q), _sl(k), _sl(v), _sl(positions), _sl(kv_positions),
-            axis_name="sp", sm_scale=d**-0.5, sp=sp_in_mesh,
+        out_l = context_blocks_attention_local(
+            _sl(q), k, v, _sl(positions), kv_positions,
+            sm_scale=d**-0.5, sp=sp_in_mesh,
         )
         out = jax.lax.all_gather(out_l, "sp", axis=0, tiled=True)
     elif sp_mesh is not None:

@@ -75,9 +75,37 @@ def _ring_attention_local(q, k, v, q_pos, kv_pos, *, axis_name, sm_scale, sp):
 
 
 # Public alias: the per-device ring body, for callers ALREADY inside a
-# shard_map whose mesh carries the "sp" axis (SP x TP composition —
-# layers.paged_attention_block).
+# shard_map whose mesh carries the "sp" axis.
 ring_attention_local = _ring_attention_local
+
+
+def context_blocks_attention_local(
+    q_l, k_full, v_full, q_pos_l, kv_pos_full, *, sm_scale, sp
+):
+    """Per-device flash attention of a LOCAL query block against FULL
+    K/V, iterated over ``sp`` static chunks (SP x TP composition —
+    layers.paged_attention_block). Inside the TP stage's shard_map every
+    rank already holds the full (sp-replicated) K/V, so rotating blocks
+    over ICI like the ring does would be pure communication overhead;
+    the same online-softmax accumulation runs over local slices
+    instead. Score memory stays O(T/sp * chunk) per rank."""
+    tq, hq, d = q_l.shape
+    hkv = k_full.shape[1]
+    g = hq // hkv
+    qg = q_l.reshape(tq, hkv, g, d)
+    chunk = k_full.shape[0] // sp
+
+    m = jnp.full((tq, hkv, g), NEG_INF, jnp.float32)
+    l = jnp.zeros((tq, hkv, g), jnp.float32)
+    o = jnp.zeros((tq, hkv, g, d), jnp.float32)
+    for step in range(sp):
+        sl = slice(step * chunk, (step + 1) * chunk)
+        m, l, o = _block_attn(
+            qg, k_full[sl], v_full[sl], q_pos_l, kv_pos_full[sl],
+            sm_scale, m, l, o,
+        )
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.reshape(tq, hq, d).astype(q_l.dtype)
 
 
 def ring_attention(

@@ -230,16 +230,19 @@ def find_turning_points(
         path.append(prev)
     path.reverse()
 
+    # Tail advice anchors at each node's LAST use, not each departure: a
+    # route may leave a node and re-enter it later (cheap ends, fast
+    # middle replica), and trimming at the first departure would delete
+    # shards the route itself depends on.
     turning: list[tuple[str, int, str]] = []
-    for layer in range(1, len(path)):
-        prev_i, cur_i = path[layer - 1], path[layer]
-        if prev_i == cur_i:
-            continue
-        if nodes[prev_i].end_layer > layer:
-            turning.append((nodes[prev_i].node_id, layer, "tail"))
     first_used: dict[int, int] = {}
+    last_used: dict[int, int] = {}
     for layer, idx in enumerate(path):
         first_used.setdefault(idx, layer)
+        last_used[idx] = layer
+    for idx, ll in last_used.items():
+        if nodes[idx].end_layer > ll + 1:
+            turning.append((nodes[idx].node_id, ll + 1, "tail"))
     for idx, l0 in first_used.items():
         if l0 > nodes[idx].start_layer:
             turning.append((nodes[idx].node_id, l0, "head"))

@@ -215,6 +215,44 @@ class GlobalScheduler:
                     self.manager.set_active(node.node_id)
                     active.append(node)
                     self._log_allocation("dynamic-join")
+            self._apply_turning_point_trims()
+
+    def _apply_turning_point_trims(self) -> None:
+        """Trim replica shard segments the optimal route never uses
+        (reference find_turning_points warm-up trimming,
+        request_routing.py:86-177): layer-level DP over the active
+        nodes' (possibly drift-overlapped) ranges yields head/tail
+        truncation advice; applying it to PARTIAL REPLICAS frees their
+        HBM for KV. Registered pipeline members are never trimmed —
+        their contiguity contract is what RR routing validates."""
+        from parallax_tpu.scheduling.request_routing import (
+            find_turning_points,
+        )
+
+        active = self.manager.nodes(NodeState.ACTIVE)
+        members = {
+            n.node_id for p in self.manager.pipelines for n in p.nodes
+        }
+        for node_id, layer, kind in find_turning_points(
+            active, self.model.num_hidden_layers
+        ):
+            node = self.manager.get(node_id)
+            if node is None or node_id in members:
+                continue
+            if kind == "tail" and node.start_layer < layer < node.end_layer:
+                logger.info(
+                    "turning-point trim: %s tail [%d, %d) -> [%d, %d)",
+                    node_id, node.start_layer, node.end_layer,
+                    node.start_layer, layer,
+                )
+                node.set_layers(node.start_layer, layer)
+            elif kind == "head" and node.start_layer < layer < node.end_layer:
+                logger.info(
+                    "turning-point trim: %s head [%d, %d) -> [%d, %d)",
+                    node_id, node.start_layer, node.end_layer,
+                    layer, node.end_layer,
+                )
+                node.set_layers(layer, node.end_layer)
 
     def _handle_leave(self, node_id: str) -> None:
         displaced = self.manager.remove(node_id)

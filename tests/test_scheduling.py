@@ -135,6 +135,45 @@ class TestAllocators:
         assert sizes == [1, 2]
 
 
+def test_scheduler_trims_drifted_replica_shards():
+    """Wiring of turning-point advice into the scheduler: drifted
+    replica segments the optimal route never uses get trimmed; pipeline
+    members are never touched. MODEL has 28 layers."""
+    from parallax_tpu.scheduling.scheduler import GlobalScheduler
+
+    sched = GlobalScheduler(MODEL, min_nodes_bootstrapping=1, routing="dp")
+    mgr = sched.manager
+
+    def add(nid, start, end, lat):
+        n = make_node(nid)
+        n.set_layers(start, end)
+        n.measured_layer_latency_ms = lat
+        mgr.add(n)
+        mgr.set_active(nid)
+        return n
+
+    # Registered pipeline: a [0, 15) + e [15, 28), both cheap.
+    a = add("a", 0, 15, lat=0.01)
+    e = add("e", 15, 28, lat=0.01)
+    mgr.register_pipelines([Pipeline(nodes=[a, e])])
+    # Drifted replicas: c hosts [10, 20), d hosts [12, 28).
+    c = add("c", 10, 20, lat=0.005)
+    d = add("d", 12, 28, lat=0.001)
+    # Negligible hop costs so per-layer latency alone picks the route:
+    # a [0, 10) -> c [10, 12) -> d [12, 28).
+    for n in (a, e, c, d):
+        n.rtt_s = {x: 1e-6 for x in ("a", "e", "c", "d")}
+
+    sched._apply_turning_point_trims()
+    # Members keep their ranges even where the route skips them.
+    assert (a.start_layer, a.end_layer) == (0, 15)
+    assert (e.start_layer, e.end_layer) == (15, 28)
+    # c's tail [12, 20) is never used by the optimal route -> trimmed.
+    assert (c.start_layer, c.end_layer) == (10, 12)
+    # d is entered at its own start -> untouched.
+    assert (d.start_layer, d.end_layer) == (12, 28)
+
+
 class TestTurningPoints:
     @staticmethod
     def _hosting(nid, start, end, lat):
