@@ -202,6 +202,12 @@ def load_stage_params(
     qc = raw_cfg.get("quantization_config") or {}
     fp8_mode = qc.get("quant_method") == "fp8"
     fp8_block = tuple(qc.get("weight_block_size") or (128, 128))
+    gptq_mode = qc.get("quant_method") == "gptq"
+    gptq_bits = int(qc.get("bits") or 4)
+    # v1 storage biases zeros by +1; gptq_v2 (GPTQModel) does not.
+    gptq_zero_offset = (
+        0 if qc.get("checkpoint_format") == "gptq_v2" else 1
+    )
 
     tree: dict = {}
     want_embed = model.is_first or (model.is_last and cfg.tie_word_embeddings)
@@ -241,8 +247,16 @@ def load_stage_params(
     # stragglers, never the whole stage upcast to fp32.
     fp8_weights: dict[str, np.ndarray] = {}
     fp8_scales: dict[str, np.ndarray] = {}
+    # GPTQ quartets (qweight/qzeros/scales/g_idx per projection) buffer
+    # until complete; they are already the compressed representation.
+    gptq_parts: dict[str, dict[str, np.ndarray]] = {}
+    _GPTQ_SUFFIXES = (".qweight", ".qzeros", ".scales", ".g_idx")
     for path in weight_files:
         for local, arr, is_fp8 in _iter_safetensors(path, fp8_mode, _resolve):
+            if gptq_mode and local.endswith(_GPTQ_SUFFIXES):
+                base, _, part = local.rpartition(".")
+                gptq_parts.setdefault(base, {})[part] = arr
+                continue
             if local.endswith(".weight_scale_inv"):
                 base = local[: -len("_scale_inv")]
                 w = fp8_weights.pop(base, None)
@@ -277,6 +291,35 @@ def load_stage_params(
         raise ValueError(
             f"orphan fp8 scales without weights: {sorted(fp8_scales)[:5]}"
         )
+
+    if gptq_parts:
+        from parallax_tpu.ops.quant import convert_gptq_weight
+
+        for base, parts in gptq_parts.items():
+            missing = {"qweight", "qzeros", "scales"} - set(parts)
+            if missing:
+                raise ValueError(
+                    f"incomplete GPTQ tensors for {base!r}: missing "
+                    f"{sorted(missing)}"
+                )
+            out = convert_gptq_weight(
+                parts["qweight"], parts["qzeros"], parts["scales"],
+                parts.get("g_idx"), gptq_bits,
+                zero_offset=gptq_zero_offset,
+            )
+            if "weight" in out:
+                # Activation-ordered (desc_act) groups: stored float.
+                _assign(tree, base + ".weight",
+                        jnp.asarray(out["weight"]).astype(dtype))
+            else:
+                _assign(tree, base + ".qweight",
+                        jnp.asarray(out["qweight"]))
+                _assign(tree, base + ".scales",
+                        jnp.asarray(out["scales"]).astype(dtype))
+                _assign(tree, base + ".biases",
+                        jnp.asarray(out["biases"]).astype(dtype))
+                n_quant += 1
+            n_loaded += 1
 
     from parallax_tpu.ops.quant import unpack_uint32
 

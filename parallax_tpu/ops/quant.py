@@ -73,6 +73,59 @@ def dequant_fp8_block(w: np.ndarray, scale_inv: np.ndarray,
     return w * s
 
 
+def convert_gptq_weight(
+    qweight: np.ndarray,   # i32[in/(32/bits), out] packed along IN
+    qzeros: np.ndarray,    # i32[in/group, out/(32/bits)] packed zeros
+    scales: np.ndarray,    # [in/group, out]
+    g_idx: np.ndarray | None,
+    bits: int,
+    zero_offset: int = 1,
+) -> dict:
+    """GPTQ checkpoint tensors -> this runtime's affine param dict.
+
+    GPTQ dequant is ``w[i, o] = s[g, o] * (q[i, o] - (z[g, o] + off))``
+    grouped along the INPUT dim, where ``off`` is 1 for classic AutoGPTQ
+    v1 storage and 0 for ``checkpoint_format == "gptq_v2"``. Transposed
+    to the HF [out, in] layout this is exactly our affine form ``w = q *
+    scale + bias`` with ``bias = -scale * (z + off)`` — a lossless
+    re-labelling, so GPTQ weights stay quantized at rest with the
+    dequant fused into the consuming matmul.
+
+    Activation-ordered checkpoints (``desc_act``: a non-trivial
+    ``g_idx`` permutes group membership per input channel) have no
+    contiguous group structure; those dequantize to float here and the
+    caller stores them full-precision.
+    """
+    if bits not in (2, 4, 8):
+        # 3-bit GPTQ packs across word boundaries; the simple in-word
+        # unpacking below would silently mis-shape it.
+        raise ValueError(f"unsupported GPTQ bit width {bits} (want 2/4/8)")
+    pack = 32 // bits
+    in_dim = qweight.shape[0] * pack
+    groups, out_dim = scales.shape
+    group_size = in_dim // groups
+
+    # qweight packs along the IN dim, qzeros along the OUT dim; both are
+    # the little-endian in-word layout unpack_uint32 inverts.
+    q = unpack_uint32(qweight.T, bits).T            # [in, out]
+    z = unpack_uint32(qzeros, bits)                 # [groups, out]
+    zp = (z.astype(np.float32) + zero_offset)       # [groups, out]
+    scales = np.asarray(scales, np.float32)
+
+    trivial = g_idx is None or np.array_equal(
+        np.asarray(g_idx), np.arange(in_dim) // group_size
+    )
+    if not trivial:
+        g = np.asarray(g_idx)
+        w = scales[g] * (q.astype(np.float32) - zp[g])   # [in, out]
+        return {"weight": w.T}                            # float fallback
+    return {
+        "qweight": q.T.astype(np.uint8),                     # [out, in]
+        "scales": scales.T,                                  # [out, groups]
+        "biases": (-scales * zp).T,                          # [out, groups]
+    }
+
+
 def quantize_array(
     w: np.ndarray, bits: int = 8, group_size: int = 64
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:

@@ -526,3 +526,145 @@ def test_fp8_weight_without_scales_fails_loudly(tmp_path):
     model = StageModel(cfg, 0, 1, use_pallas=False)
     with pytest.raises(ValueError, match="weight_scale_inv"):
         load_stage_params(model, str(ckpt), dtype=jnp.float32)
+
+
+def _pack_gptq(values: np.ndarray, bits: int, axis: int) -> np.ndarray:
+    """Pack small ints into int32 LSB-first along ``axis``."""
+    pack = 32 // bits
+    v = np.moveaxis(values.astype(np.uint32), axis, 0)
+    v = v.reshape(v.shape[0] // pack, pack, *v.shape[1:])
+    shifts = (np.arange(pack, dtype=np.uint32) * bits).reshape(
+        1, pack, *([1] * (v.ndim - 2)))
+    packed = np.bitwise_or.reduce(v << shifts, axis=1).astype(np.int32)
+    return np.moveaxis(packed, 0, axis)
+
+
+def test_gptq_checkpoint_loads(tmp_path):
+    """Synthetic GPTQ-int4 checkpoint (qweight packed along IN, qzeros
+    packed along OUT, s*(q-(z+1)) dequant): the loader must produce our
+    affine runtime form whose dequant matches the GPTQ math exactly."""
+    from parallax_tpu.models.loader import load_stage_params
+    from parallax_tpu.ops.quant import dequantize_weight
+
+    rng = np.random.default_rng(21)
+    bits, group = 4, 16
+    cfg_dict = dict(
+        architectures=["Qwen2ForCausalLM"], hidden_size=32,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        intermediate_size=64, vocab_size=64, max_position_embeddings=128,
+        tie_word_embeddings=False,
+        quantization_config={"quant_method": "gptq", "bits": bits,
+                             "group_size": group},
+    )
+    cfg = normalize_config(cfg_dict)
+    h, kvh, d = 32, 2, 16
+    tensors = {}
+    originals = {}
+
+    def add_gptq(name, out_dim, in_dim):
+        groups = in_dim // group
+        q = rng.integers(0, 16, (in_dim, out_dim)).astype(np.uint8)
+        z = rng.integers(0, 15, (groups, out_dim)).astype(np.uint8)
+        s = rng.uniform(0.01, 0.1, (groups, out_dim)).astype(np.float32)
+        tensors[f"{name}.qweight"] = _pack_gptq(q, bits, axis=0)
+        tensors[f"{name}.qzeros"] = _pack_gptq(z, bits, axis=1)
+        tensors[f"{name}.scales"] = s
+        tensors[f"{name}.g_idx"] = (
+            np.arange(in_dim, dtype=np.int32) // group
+        )
+        gi = np.arange(in_dim) // group
+        originals[name] = (
+            s[gi] * (q.astype(np.float32) - (z[gi].astype(np.float32) + 1))
+        ).T                                           # [out, in]
+
+    pre = "model.layers.0"
+    for name, o, i in [
+        (f"{pre}.self_attn.q_proj", 2 * d, h),
+        (f"{pre}.self_attn.k_proj", kvh * d, h),
+        (f"{pre}.self_attn.v_proj", kvh * d, h),
+        (f"{pre}.self_attn.o_proj", h, 2 * d),
+        (f"{pre}.mlp.gate_proj", 64, h),
+        (f"{pre}.mlp.up_proj", 64, h),
+        (f"{pre}.mlp.down_proj", h, 64),
+    ]:
+        add_gptq(name, o, i)
+    tensors["model.embed_tokens.weight"] = rng.standard_normal(
+        (64, h)).astype(np.float32)
+    tensors["model.norm.weight"] = np.ones((h,), np.float32)
+    tensors[f"{pre}.input_layernorm.weight"] = np.ones((h,), np.float32)
+    tensors[f"{pre}.post_attention_layernorm.weight"] = np.ones(
+        (h,), np.float32)
+    tensors["lm_head.weight"] = rng.standard_normal((64, h)).astype(
+        np.float32)
+
+    from safetensors.numpy import save_file
+
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    save_file(tensors, str(ckpt / "model.safetensors"))
+    (ckpt / "config.json").write_text(json.dumps(cfg_dict))
+
+    model = StageModel(cfg, 0, 1, use_pallas=False)
+    params = load_stage_params(model, str(ckpt), dtype=jnp.float32)
+    attn = params["layers"][0]["self_attn"]
+    # Quantized at rest (affine triplet), dequant matches GPTQ math.
+    assert "qweight" in attn["q_proj"] and "weight" not in attn["q_proj"]
+    np.testing.assert_allclose(
+        np.asarray(dequantize_weight(attn["q_proj"])),
+        originals[f"{pre}.self_attn.q_proj"], rtol=1e-5, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(dequantize_weight(
+            params["layers"][0]["mlp"]["down_proj"])),
+        originals[f"{pre}.mlp.down_proj"], rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_gptq_desc_act_falls_back_to_float(tmp_path):
+    """Activation-ordered g_idx (non-contiguous groups) cannot stay
+    quantized in our group-block form; the loader stores float weights
+    with the same dequant values."""
+    from parallax_tpu.ops.quant import convert_gptq_weight
+
+    rng = np.random.default_rng(3)
+    bits, group, in_dim, out_dim = 4, 8, 32, 16
+    groups = in_dim // group
+    q = rng.integers(0, 16, (in_dim, out_dim)).astype(np.uint8)
+    z = rng.integers(0, 15, (groups, out_dim)).astype(np.uint8)
+    s = rng.uniform(0.01, 0.1, (groups, out_dim)).astype(np.float32)
+    g_idx = rng.permutation(np.arange(in_dim) // group).astype(np.int32)
+    out = convert_gptq_weight(
+        _pack_gptq(q, bits, 0), _pack_gptq(z, bits, 1), s, g_idx, bits,
+    )
+    assert set(out) == {"weight"}
+    want = (s[g_idx] * (q.astype(np.float32)
+                        - (z[g_idx].astype(np.float32) + 1))).T
+    np.testing.assert_allclose(out["weight"], want, rtol=1e-6)
+
+
+def test_gptq_v2_zero_offset():
+    """gptq_v2 stores zeros without the v1 +1 bias; conversion honors
+    zero_offset=0 and rejects unsupported bit widths loudly."""
+    from parallax_tpu.ops.quant import convert_gptq_weight, dequantize_weight
+
+    rng = np.random.default_rng(9)
+    bits, group, in_dim, out_dim = 4, 8, 16, 8
+    groups = in_dim // group
+    q = rng.integers(0, 16, (in_dim, out_dim)).astype(np.uint8)
+    z = rng.integers(0, 16, (groups, out_dim)).astype(np.uint8)
+    s = rng.uniform(0.01, 0.1, (groups, out_dim)).astype(np.float32)
+    gi = np.arange(in_dim) // group
+    out = convert_gptq_weight(
+        _pack_gptq(q, bits, 0), _pack_gptq(z, bits, 1), s, None, bits,
+        zero_offset=0,
+    )
+    want = (s[gi] * (q.astype(np.float32) - z[gi].astype(np.float32))).T
+    np.testing.assert_allclose(
+        np.asarray(dequantize_weight(
+            {k: jnp.asarray(v) for k, v in out.items()})),
+        want, rtol=1e-5, atol=1e-5,
+    )
+    with pytest.raises(ValueError, match="bit width"):
+        convert_gptq_weight(
+            _pack_gptq(q, bits, 0), _pack_gptq(z, bits, 1), s, None, 3,
+        )
