@@ -204,6 +204,7 @@ def load_stage_params(
     fp8_block = tuple(qc.get("weight_block_size") or (128, 128))
     gptq_mode = qc.get("quant_method") == "gptq"
     gptq_bits = int(qc.get("bits") or 4)
+    mxfp4_mode = qc.get("quant_method") == "mxfp4"
     # v1 storage biases zeros by +1; gptq_v2 (GPTQModel) does not.
     gptq_zero_offset = (
         0 if qc.get("checkpoint_format") == "gptq_v2" else 1
@@ -251,11 +252,41 @@ def load_stage_params(
     # until complete; they are already the compressed representation.
     gptq_parts: dict[str, dict[str, np.ndarray]] = {}
     _GPTQ_SUFFIXES = (".qweight", ".qzeros", ".scales", ".g_idx")
+    # MXFP4 halves (gpt-oss expert tensors: ``<proj>_blocks`` packed fp4
+    # + ``<proj>_scales`` e8m0) pair within one shard file.
+    mx_blocks: dict[str, np.ndarray] = {}
+    mx_scales: dict[str, np.ndarray] = {}
+
+    def _mx_emit(base: str, blocks: np.ndarray, scales: np.ndarray):
+        from parallax_tpu.ops.quant import dequant_mxfp4
+
+        w = dequant_mxfp4(blocks, scales)
+        if w.ndim == 3:
+            # Expert tensors dequantize to [E, out, in]; the serving
+            # layout (and the bf16 checkpoints) use [E, in, out].
+            w = np.swapaxes(w, 1, 2)
+        _assign(tree, base, jnp.asarray(w).astype(dtype))
+
     for path in weight_files:
         for local, arr, is_fp8 in _iter_safetensors(path, fp8_mode, _resolve):
             if gptq_mode and local.endswith(_GPTQ_SUFFIXES):
                 base, _, part = local.rpartition(".")
                 gptq_parts.setdefault(base, {})[part] = arr
+                continue
+            if mxfp4_mode and local.endswith(("_blocks", "_scales")):
+                is_blocks = local.endswith("_blocks")
+                base = local[: -len("_blocks")]
+                other = (mx_scales if is_blocks else mx_blocks).pop(
+                    base, None
+                )
+                if other is not None:
+                    blocks, scales = (arr, other) if is_blocks else (
+                        other, arr
+                    )
+                    _mx_emit(base, blocks, scales)
+                    n_loaded += 1
+                else:
+                    (mx_blocks if is_blocks else mx_scales)[base] = arr
                 continue
             if local.endswith(".weight_scale_inv"):
                 base = local[: -len("_scale_inv")]
@@ -290,6 +321,12 @@ def load_stage_params(
     if fp8_scales:
         raise ValueError(
             f"orphan fp8 scales without weights: {sorted(fp8_scales)[:5]}"
+        )
+
+    if mx_blocks or mx_scales:
+        raise ValueError(
+            f"unpaired mxfp4 tensors: "
+            f"{sorted([*mx_blocks, *mx_scales])[:5]}"
         )
 
     if gptq_parts:

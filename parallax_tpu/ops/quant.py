@@ -126,6 +126,35 @@ def convert_gptq_weight(
     }
 
 
+# FP4 e2m1 value table (OCP MX spec; nibble index -> value). Matches the
+# HF gpt-oss dequant reference (transformers/integrations/mxfp4.py).
+_FP4_VALUES = np.array(
+    [0.0, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0,
+     -0.0, -0.5, -1.0, -1.5, -2.0, -3.0, -4.0, -6.0],
+    np.float32,
+)
+
+
+def dequant_mxfp4(blocks: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    """OCP MXFP4 -> float32: ``blocks`` u8[..., G, B] holds two e2m1
+    nibbles per byte (low nibble first), ``scales`` u8[..., G] the shared
+    e8m0 block exponent (value - 127). Returns [..., G * B * 2]."""
+    *lead, g, b = blocks.shape
+    if scales.shape != (*lead, g):
+        raise ValueError(
+            f"mxfp4 scales shape {scales.shape} != {(*lead, g)}"
+        )
+    # One output-sized buffer only (gpt-oss-120b expert tensors are GBs;
+    # a lo/hi/ldexp chain of temporaries would quadruple peak host RAM —
+    # the HF reference chunks for the same reason).
+    vals = np.empty((*lead, g, b * 2), np.float32)
+    np.take(_FP4_VALUES, blocks & 0x0F, out=vals[..., 0::2])
+    np.take(_FP4_VALUES, blocks >> 4, out=vals[..., 1::2])
+    exp = scales.astype(np.int32) - 127
+    np.ldexp(vals, exp[..., None], out=vals)
+    return vals.reshape(*lead, g * b * 2)
+
+
 def quantize_array(
     w: np.ndarray, bits: int = 8, group_size: int = 64
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:

@@ -668,3 +668,110 @@ def test_gptq_v2_zero_offset():
         convert_gptq_weight(
             _pack_gptq(q, bits, 0), _pack_gptq(z, bits, 1), s, None, 3,
         )
+
+
+def test_mxfp4_dequant_matches_transformers_reference():
+    """Our numpy MXFP4 dequant must match the canonical HF gpt-oss
+    implementation (transformers.integrations.mxfp4) bit for bit."""
+    import torch
+    from transformers.integrations.mxfp4 import convert_moe_packed_tensors
+
+    from parallax_tpu.ops.quant import dequant_mxfp4
+
+    rng = np.random.default_rng(0)
+    e, out, g, b = 2, 6, 4, 16
+    blocks = rng.integers(0, 256, (e, out, g, b)).astype(np.uint8)
+    scales = rng.integers(110, 140, (e, out, g)).astype(np.uint8)
+    ref = convert_moe_packed_tensors(
+        torch.from_numpy(blocks), torch.from_numpy(scales),
+        dtype=torch.float32, rows_per_chunk=4096,
+    ).numpy()                                       # [E, in, out]
+    ours = np.swapaxes(dequant_mxfp4(blocks, scales), 1, 2)
+    np.testing.assert_array_equal(ours, ref)
+
+
+def test_mxfp4_gptoss_checkpoint_loads(tmp_path):
+    """A gpt-oss-style MXFP4 checkpoint (expert *_blocks/*_scales pairs,
+    everything else bf16-ish) loads into the serving layout and the
+    engine generates from it."""
+    from parallax_tpu.models.loader import load_stage_params
+    from parallax_tpu.models.registry import create_stage_model
+    from parallax_tpu.ops.quant import dequant_mxfp4
+    from parallax_tpu.runtime.engine import EngineConfig, StageEngine
+    from parallax_tpu.runtime.pipeline import InProcessPipeline
+    from parallax_tpu.runtime.request import Request, SamplingParams
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(7)
+    h, inter, e, d, kvh = 64, 32, 4, 16, 2
+    cfg_dict = dict(
+        architectures=["GptOssForCausalLM"],
+        hidden_size=h, num_hidden_layers=1, num_attention_heads=4,
+        num_key_value_heads=kvh, head_dim=d, intermediate_size=inter,
+        num_local_experts=e, num_experts_per_tok=2,
+        sliding_window=8, layer_types=["full_attention"],
+        vocab_size=199, max_position_embeddings=512,
+        tie_word_embeddings=False, attention_bias=True,
+        quantization_config={"quant_method": "mxfp4"},
+    )
+    cfg = normalize_config(cfg_dict)
+    tensors = {}
+
+    def dense(name, o, i, bias=True):
+        tensors[f"{name}.weight"] = (
+            rng.standard_normal((o, i)) * 0.05).astype(np.float32)
+        if bias:
+            tensors[f"{name}.bias"] = np.zeros((o,), np.float32)
+
+    def mx(name, out_dim, in_dim):
+        g, b = in_dim // 32, 16
+        blocks = rng.integers(0, 256, (e, out_dim, g, b)).astype(np.uint8)
+        scales = np.full((e, out_dim, g), 121, np.uint8)  # small weights
+        tensors[f"{name}_blocks"] = blocks
+        tensors[f"{name}_scales"] = scales
+        return np.swapaxes(dequant_mxfp4(blocks, scales), 1, 2)
+
+    pre = "model.layers.0"
+    dense(f"{pre}.self_attn.q_proj", 4 * d, h)
+    dense(f"{pre}.self_attn.k_proj", kvh * d, h)
+    dense(f"{pre}.self_attn.v_proj", kvh * d, h)
+    dense(f"{pre}.self_attn.o_proj", h, 4 * d)
+    tensors[f"{pre}.self_attn.sinks"] = np.zeros((4,), np.float32)
+    tensors[f"{pre}.mlp.router.weight"] = (
+        rng.standard_normal((e, h)) * 0.05).astype(np.float32)
+    tensors[f"{pre}.mlp.router.bias"] = np.zeros((e,), np.float32)
+    want_gu = mx(f"{pre}.mlp.experts.gate_up_proj", 2 * inter, h)
+    mx(f"{pre}.mlp.experts.down_proj", h, inter)
+    tensors[f"{pre}.mlp.experts.gate_up_proj_bias"] = np.zeros(
+        (e, 2 * inter), np.float32)
+    tensors[f"{pre}.mlp.experts.down_proj_bias"] = np.zeros(
+        (e, h), np.float32)
+    tensors[f"{pre}.input_layernorm.weight"] = np.ones((h,), np.float32)
+    tensors[f"{pre}.post_attention_layernorm.weight"] = np.ones(
+        (h,), np.float32)
+    tensors["model.embed_tokens.weight"] = (
+        rng.standard_normal((199, h)) * 0.05).astype(np.float32)
+    tensors["model.norm.weight"] = np.ones((h,), np.float32)
+    tensors["lm_head.weight"] = (
+        rng.standard_normal((199, h)) * 0.05).astype(np.float32)
+
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    save_file(tensors, str(ckpt / "model.safetensors"))
+    (ckpt / "config.json").write_text(json.dumps(cfg_dict))
+
+    model = create_stage_model(cfg, 0, 1, use_pallas=False)
+    params = load_stage_params(model, str(ckpt), dtype=jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(params["layers"][0]["mlp"]["experts"]["gate_up_proj"]),
+        want_gu, rtol=1e-6,
+    )
+    eng = StageEngine(model, params, EngineConfig(
+        page_size=8, num_pages=64, max_model_len=64, kv_dtype="float32"))
+    pipe = InProcessPipeline([eng])
+    req = Request("r", prompt_ids=[1, 2, 3, 4, 5],
+                  sampling_params=SamplingParams(
+                      temperature=0.0, max_new_tokens=4, ignore_eos=True))
+    pipe.submit(req)
+    pipe.run_until_complete()
+    assert len(req.output_ids) == 4
