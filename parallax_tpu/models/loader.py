@@ -200,11 +200,22 @@ def load_stage_params(
         with open(cfg_path, encoding="utf-8") as f:
             raw_cfg = json.load(f)
     qc = raw_cfg.get("quantization_config") or {}
-    fp8_mode = qc.get("quant_method") == "fp8"
+    quant_method = qc.get("quant_method")
+    if quant_method not in (None, "fp8", "gptq", "mxfp4"):
+        # An unknown packed format (awq, compressed-tensors, ...) would
+        # stream raw int tensors into float param slots and serve
+        # garbage; refuse loudly instead.
+        raise ValueError(
+            f"quantization_config.quant_method {quant_method!r} is not "
+            "supported (have: fp8, gptq, mxfp4, MLX-format, or on-load "
+            "--quantization int8/int4); dequantize the checkpoint "
+            "offline to serve it"
+        )
+    fp8_mode = quant_method == "fp8"
     fp8_block = tuple(qc.get("weight_block_size") or (128, 128))
-    gptq_mode = qc.get("quant_method") == "gptq"
+    gptq_mode = quant_method == "gptq"
     gptq_bits = int(qc.get("bits") or 4)
-    mxfp4_mode = qc.get("quant_method") == "mxfp4"
+    mxfp4_mode = quant_method == "mxfp4"
     # v1 storage biases zeros by +1; gptq_v2 (GPTQModel) does not.
     gptq_zero_offset = (
         0 if qc.get("checkpoint_format") == "gptq_v2" else 1

@@ -775,3 +775,24 @@ def test_mxfp4_gptoss_checkpoint_loads(tmp_path):
     pipe.submit(req)
     pipe.run_until_complete()
     assert len(req.output_ids) == 4
+
+
+def test_unknown_quant_method_fails_loudly(tmp_path):
+    from parallax_tpu.models.loader import load_stage_params
+    from safetensors.numpy import save_file
+
+    cfg_dict = dict(
+        architectures=["Qwen2ForCausalLM"], hidden_size=8,
+        num_hidden_layers=1, num_attention_heads=1, num_key_value_heads=1,
+        intermediate_size=8, vocab_size=16, max_position_embeddings=32,
+        tie_word_embeddings=True,
+        quantization_config={"quant_method": "awq", "bits": 4},
+    )
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    save_file({"model.embed_tokens.weight": np.zeros((16, 8), np.float32)},
+              str(ckpt / "model.safetensors"))
+    (ckpt / "config.json").write_text(json.dumps(cfg_dict))
+    model = StageModel(normalize_config(cfg_dict), 0, 1, use_pallas=False)
+    with pytest.raises(ValueError, match="awq"):
+        load_stage_params(model, str(ckpt), dtype=jnp.float32)

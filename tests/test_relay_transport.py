@@ -18,6 +18,17 @@ import pytest
 from parallax_tpu.p2p.transport import TcpTransport, make_ping_handler
 
 
+def wait_route(relay, worker_id, timeout=5.0):
+    """Registration is fire-and-forget (a heartbeat refresh in
+    production); tests must not race the relay's read loop."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if worker_id in relay._relay_routes:
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"route for {worker_id} never registered")
+
+
 @pytest.fixture
 def trio():
     relay = TcpTransport("relay-node", "127.0.0.1")
@@ -39,6 +50,7 @@ def test_relayed_call_round_trip(trio):
         "echo", lambda frm, payload: {"got": payload, "frm": frm}
     )
     worker.register_at_relay(relay.address)
+    wait_route(relay, worker.peer_id)
 
     out = client.call(worker.peer_id, "echo", {"x": 42}, timeout=10.0)
     assert out["got"] == {"x": 42}
@@ -51,6 +63,7 @@ def test_relay_delivers_to_its_own_registered_worker(trio):
     relay, worker, _ = trio
     worker.register("double", lambda _f, p: p * 2)
     worker.register_at_relay(relay.address)
+    wait_route(relay, worker.peer_id)
     assert relay.call(worker.peer_id, "double", 21, timeout=10.0) == 42
 
 
@@ -65,6 +78,7 @@ def test_relayed_send_fire_and_forget(trio):
 
     worker.register("data", on_data)
     worker.register_at_relay(relay.address)
+    wait_route(relay, worker.peer_id)
     client.send(worker.peer_id, "data", b"\x01\x02\x03")
     assert done.wait(10.0)
     assert got == [b"\x01\x02\x03"]
@@ -74,7 +88,10 @@ def test_relay_reregister_replaces_route(trio):
     relay, worker, client = trio
     worker.register("ping2", make_ping_handler())
     worker.register_at_relay(relay.address)
-    # Re-registration (every heartbeat in production) must keep working.
+    wait_route(relay, worker.peer_id)
+    # Re-registration (every heartbeat in production) must keep working;
+    # it rides the same cached connection, so the existing route stays
+    # valid throughout — no extra synchronization point exists to wait on.
     worker.register_at_relay(relay.address)
     assert client.call(worker.peer_id, "ping2", None, timeout=10.0) == "pong"
 
@@ -87,6 +104,7 @@ def test_relay_errors_propagate_end_to_end(trio):
 
     worker.register("boom", boom)
     worker.register_at_relay(relay.address)
+    wait_route(relay, worker.peer_id)
     from parallax_tpu.p2p.transport import TransportError
 
     with pytest.raises(TransportError, match="kaboom"):
@@ -99,6 +117,7 @@ def test_relay_rejects_identity_mismatched_registration(trio):
     relay, worker, client = trio
     worker.register("whoami", lambda _f, _p: "victim")
     worker.register_at_relay(relay.address)
+    wait_route(relay, worker.peer_id)
     assert client.call(worker.peer_id, "whoami", None, timeout=10.0) == "victim"
 
     # Attacker hello's as itself but registers the victim's id.
@@ -151,6 +170,7 @@ def test_relay_token_required_when_configured():
     try:
         legit.register("ping3", make_ping_handler())
         legit.register_at_relay(relay.address)
+        wait_route(relay, legit.peer_id)
         assert client.call(legit.peer_id, "ping3", None, timeout=10.0) == "pong"
 
         intruder.register_at_relay(relay.address)
