@@ -208,6 +208,49 @@ class TestAdapterMath:
         # And base still hits its own namespace.
         assert one("base2", None) > 0
 
+    def test_pipeline_prefix_cache_with_adapters(self):
+        """2-stage pipeline, prefix cache ON: a same-adapter repeat hits
+        the namespaced cache on the head (mirror alignment included) and
+        reproduces the same tokens; a different tenant's identical
+        prompt gets no reuse and different tokens."""
+        tree1, tree2 = make_adapter(4, [0, 1, 2, 3]), make_adapter(8, [0, 2])
+        cache_cfg = dataclasses.replace(ECFG, enable_prefix_cache=True)
+        engines = []
+        for s, e in [(0, 2), (2, 4)]:
+            m = StageModel(TINY, s, e, use_pallas=False)
+            p = m.init_params(jax.random.key(s + 11), dtype=jnp.float32)
+            eng = StageEngine(m, p, cache_cfg)
+            eng.load_adapter("ad1", {gi - s: lt for gi, lt in tree1.items()
+                                     if s <= gi < e})
+            eng.load_adapter("ad2", {gi - s: lt for gi, lt in tree2.items()
+                                     if s <= gi < e})
+            engines.append(eng)
+        pipe = InProcessPipeline(engines)
+        prompt = list(range(1, 30))   # 3 full pages at page_size 8
+
+        def one(rid, lid):
+            req = Request(
+                rid, prompt_ids=list(prompt),
+                sampling_params=SamplingParams(
+                    temperature=0.0, max_new_tokens=4, ignore_eos=True),
+                lora_id=lid,
+            )
+            pipe.submit(req)
+            pipe.run_until_complete()
+            assert req.status.is_finished
+            return req
+
+        first = one("p1", "ad1")
+        assert first.num_cached_tokens == 0
+        again = one("p2", "ad1")
+        assert again.num_cached_tokens > 0          # namespaced hit
+        assert again.output_ids == first.output_ids  # cache-exactness
+        other = one("p3", "ad2")
+        assert other.num_cached_tokens == 0          # tenant isolation
+        assert other.output_ids != first.output_ids
+        base = one("p4", None)
+        assert base.num_cached_tokens == 0
+
     def test_multistep_fused_decode_applies_adapter(self):
         tree = make_adapter(3, layers=[0, 1, 2, 3])
         model = StageModel(TINY, 0, TINY.num_hidden_layers, use_pallas=False)
