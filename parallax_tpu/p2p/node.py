@@ -423,6 +423,16 @@ class WorkerNode:
     # -- scheduler-less gossip (reference DHT announce + dijkstra routing,
     # p2p/server.py:569-626) -------------------------------------------------
 
+    def _fresh_peer_ids(self, now: float) -> set[str]:
+        """Peers whose announcements are within the TTL (THE liveness
+        definition — route computation, gossip fan-out and the standalone
+        sweep must all agree on it)."""
+        with self._peer_lock:
+            return {
+                nid for nid, b in self._peer_blocks.items()
+                if now - b["t"] <= self.peer_ttl_s
+            }
+
     def _known_blocks(self) -> list[dict]:
         """Fresh announcements incl. our own, with ages so receivers can
         order third-party info correctly."""
@@ -471,10 +481,7 @@ class WorkerNode:
             for nid, b in list(self._peer_blocks.items()):
                 if now - b["t"] > 3 * self.peer_ttl_s:
                     del self._peer_blocks[nid]
-            known = {
-                nid for nid, b in self._peer_blocks.items()
-                if now - b["t"] <= self.peer_ttl_s
-            }
+        known = self._fresh_peer_ids(now)
         timeout = min(5.0, max(1.0, self.heartbeat_interval_s))
 
         def announce(peer: str) -> None:
@@ -510,6 +517,20 @@ class WorkerNode:
 
         _fwait(futures, timeout=timeout + 1.0)
 
+        # The gossip TTL doubles as the standalone liveness sweep: an
+        # in-flight request routed through an expired peer would
+        # otherwise hang to its request timeout when the peer died
+        # BETWEEN packets (nothing in flight -> no send failure to
+        # trigger abort_path). Scheduler mode gets this from the
+        # heartbeat sweep; here the announcements are the heartbeats.
+        # The request scan itself runs on the step thread (the scheduler
+        # dicts are single-threaded state); this beat only ships the
+        # freshness snapshot over.
+        if self.engine is not None:
+            fresh = self._fresh_peer_ids(time.monotonic())
+            fresh.add(self.node_id)
+            self._inbox.put(("liveness", fresh))
+
     def _on_announce(self, _peer: str, payload: dict):
         self._merge_blocks((payload or {}).get("blocks"))
         return {"blocks": self._known_blocks()}
@@ -539,11 +560,11 @@ class WorkerNode:
         if self.start_layer != 0 or self.engine is None:
             return None
         num_layers = self.model_config.num_hidden_layers
-        now = time.monotonic()
+        fresh = self._fresh_peer_ids(time.monotonic())
         by_start: dict[int, list[tuple[str, int]]] = {}
         with self._peer_lock:
             for nid, b in self._peer_blocks.items():
-                if not b["ready"] or now - b["t"] > self.peer_ttl_s:
+                if nid not in fresh or not b["ready"]:
                     continue
                 by_start.setdefault(b["start"], []).append((nid, b["end"]))
 
@@ -734,6 +755,19 @@ class WorkerNode:
                 ):
                     if peer in req.routing_table and not req.status.is_finished:
                         req.abort(f"peer {peer} unreachable")
+            elif kind == "liveness":
+                # Standalone gossip sweep (freshness snapshot from the
+                # announcer thread): abort requests routed through peers
+                # whose announcements expired — one scan per beat.
+                fresh = item[1]
+                sched = self.engine.scheduler
+                for req in (
+                    list(sched.running.values())
+                    + list(sched.wait_queue.values())
+                ):
+                    dead = [p for p in req.routing_table if p not in fresh]
+                    if dead and not req.status.is_finished:
+                        req.abort(f"peer {dead[0]} unreachable")
             elif kind == "reload":
                 self._apply_allocation(item[1])
             elif kind == "refit":

@@ -241,6 +241,67 @@ def test_schedulerless_swarm_serves_via_gossip():
                 pass
 
 
+def test_schedulerless_midserve_tail_death_aborts_quickly():
+    """A tail dying mid-request must abort the head's in-flight work via
+    the gossip liveness sweep (or the send-failure path) well under the
+    600 s request timeout — never hang the client."""
+    import threading
+
+    workers = []
+    try:
+        transports = []
+        for _ in range(2):
+            t = TcpTransport("", "127.0.0.1")
+            t.start()
+            t.peer_id = t.address
+            transports.append(t)
+        addrs = [t.address for t in transports]
+        # A long generation budget so the request provably outlives the
+        # kill (the engine clamps max_new_tokens to the context budget).
+        long_cfg = dataclasses.replace(
+            ENGINE_CFG, max_model_len=4096, num_pages=520,
+        )
+        for t, (s, e) in zip(transports, [(0, 2), (2, 4)]):
+            workers.append(WorkerNode(
+                transport=t, scheduler_peer=None,
+                model_config=TINY, engine_config=long_cfg,
+                load_params=stage_params, heartbeat_interval_s=0.2,
+                static_peers=[a for a in addrs if a != t.address],
+                layers=(s, e),
+            ))
+        starters = [threading.Thread(target=w.start) for w in workers]
+        for st in starters:
+            st.start()
+        for st in starters:
+            st.join(timeout=60.0)
+        head = workers[0]
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and head.local_route() is None:
+            time.sleep(0.1)
+        assert head.local_route() is not None
+
+        head.peer_ttl_s = 1.0
+        req = Request(
+            request_id="midserve",
+            prompt_ids=[1, 2, 3, 4, 5],
+            sampling_params=SamplingParams(temperature=0.0,
+                                           max_new_tokens=4000,
+                                           ignore_eos=True),
+        )
+        ev = head.submit(req)
+        # Let it get into flight, then kill the tail.
+        time.sleep(1.0)
+        workers[1].stop()
+        assert ev.wait(30.0), f"request hung after tail death: {req.status}"
+        assert req.status.value == "finished_abort"
+    finally:
+        for w in workers:
+            try:
+                w.stop()
+            except Exception:
+                pass
+
+
 def test_chat_host_fronts_schedulerless_swarm():
     """Standalone chat host (reference node_chat_http_server.py): an
     OpenAI frontend on a non-scheduler machine proxies chat completions
