@@ -120,6 +120,25 @@ def build_parser() -> argparse.ArgumentParser:
     bench = sub.add_parser("bench", help="offline throughput benchmark")
     bench.add_argument("--config", default="qwen2-7b")
 
+    gen = sub.add_parser(
+        "generate",
+        help="offline one-shot generation, no server (reference "
+             "scripts/generate.py)",
+    )
+    gen.add_argument("--model-path", required=True)
+    gen.add_argument("--prompt", default="Hi")
+    gen.add_argument("--max-tokens", type=int, default=256)
+    gen.add_argument("--temperature", type=float, default=0.0)
+    gen.add_argument("--top-k", type=int, default=-1)
+    gen.add_argument("--top-p", type=float, default=1.0)
+    gen.add_argument("--tp-size", type=int, default=0)
+    gen.add_argument("--kv-dtype", choices=["bfloat16", "float32"],
+                     default="bfloat16")
+    gen.add_argument("--decode-lookahead", type=int, default=1)
+    gen.add_argument("--quantization", choices=["int8", "int4"],
+                     default=None)
+    gen.add_argument("--lora-path", default=None)
+
     chat = sub.add_parser("chat", help="interactive chat against a server")
     chat.add_argument("--base-url", default="http://127.0.0.1:8000")
     chat.add_argument("--max-tokens", type=int, default=512)
@@ -196,6 +215,10 @@ def main(argv: list[str] | None = None) -> int:
         from parallax_tpu.backend.run import chat_host_main
 
         return chat_host_main(args)
+    if args.command == "generate":
+        from parallax_tpu.backend.generate import generate_main
+
+        return generate_main(args)
     return 1
 
 

@@ -275,3 +275,56 @@ def test_swarm_scheduler_swap_rebootstraps():
             init("not-a-model", 1)
     finally:
         service.scheduler.stop()
+
+
+def test_cli_generate_offline(tmp_path):
+    """`cli generate` (reference scripts/generate.py): offline one-shot
+    generation from a checkpoint dir, streaming to stdout, no server."""
+    import os
+    import subprocess
+    import sys
+
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(0)
+    h, kvh, d = 64, 2, 16
+    cfg_dict = dict(
+        architectures=["Qwen2ForCausalLM"], hidden_size=h,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=kvh,
+        intermediate_size=128, vocab_size=256, max_position_embeddings=512,
+        tie_word_embeddings=False,
+    )
+    t = {}
+    for li in range(2):
+        pre = f"model.layers.{li}"
+        for n, o, i in [
+            ("self_attn.q_proj", 4 * d, h), ("self_attn.k_proj", kvh * d, h),
+            ("self_attn.v_proj", kvh * d, h), ("self_attn.o_proj", h, 4 * d),
+            ("mlp.gate_proj", 128, h), ("mlp.up_proj", 128, h),
+            ("mlp.down_proj", h, 128),
+        ]:
+            t[f"{pre}.{n}.weight"] = (
+                rng.standard_normal((o, i)) * 0.05).astype(np.float32)
+        t[f"{pre}.input_layernorm.weight"] = np.ones((h,), np.float32)
+        t[f"{pre}.post_attention_layernorm.weight"] = np.ones(
+            (h,), np.float32)
+    t["model.embed_tokens.weight"] = (
+        rng.standard_normal((256, h)) * 0.1).astype(np.float32)
+    t["model.norm.weight"] = np.ones((h,), np.float32)
+    t["lm_head.weight"] = (
+        rng.standard_normal((256, h)) * 0.1).astype(np.float32)
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    save_file(t, str(ckpt / "model.safetensors"))
+    (ckpt / "config.json").write_text(json.dumps(cfg_dict))
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "parallax_tpu.cli", "generate",
+         "--model-path", str(ckpt), "--prompt", "hello",
+         "--max-tokens", "8", "--kv-dtype", "float32", "--tp-size", "1"],
+        env=env, capture_output=True, text=True, timeout=240,
+    )
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert out.stdout.endswith("\n") and len(out.stdout) > 1
+    assert "generated tokens" in out.stderr
