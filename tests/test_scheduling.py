@@ -199,6 +199,21 @@ class TestTurningPoints:
         tp = find_turning_points([a, b], 6)
         assert ("B", 3, "head") in tp
 
+    def test_no_tail_trim_when_route_reenters_node(self):
+        """A route can leave a node for a faster middle replica and
+        re-enter it later; tail advice must anchor at the LAST use, not
+        the first departure — otherwise the trim would delete shards
+        the optimal route itself depends on."""
+        a = self._hosting("A", 0, 28, lat=0.01)
+        f = self._hosting("F", 10, 12, lat=0.0001)
+        a.rtt_s = {"F": 1e-6}
+        f.rtt_s = {"A": 1e-6}
+        tp = find_turning_points([a, f], 28)
+        # Route: A [0,10) -> F [10,12) -> A [12,28). A is used to the
+        # model's end, so no tail advice for A; F is fully used.
+        assert not any(n == "A" and kind == "tail" for n, _, kind in tp)
+        assert tp == [] or all(n == "F" for n, _, _ in tp)
+
     def test_uncovered_layer_returns_empty(self):
         a = self._hosting("A", 0, 3, lat=1.0)
         assert find_turning_points([a], 6) == []
