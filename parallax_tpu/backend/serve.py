@@ -113,33 +113,6 @@ def build_local_frontend(
     return frontend, runner
 
 
-def _sp_eligible(config) -> bool:
-    """Mirror of StageEngine._model_supports_sp at config level: can this
-    model take the ring-attention prefill path at all? Includes the
-    class-level ``_attention`` override check (e.g. MiniMax-M2 overrides
-    it despite a plain-attention config)."""
-    from parallax_tpu.config import LAYER_ATTENTION
-    from parallax_tpu.models.base import StageModel
-    from parallax_tpu.models.registry import get_model_class
-
-    if config.is_mla or config.use_attention_sinks:
-        return False
-    if (
-        config.linear_attn is not None
-        or config.dsa is not None
-        or config.msa is not None
-    ):
-        return False
-    if get_model_class(config.architecture)._attention is not (
-        StageModel._attention
-    ):
-        return False
-    return all(
-        config.layer_type(i) == LAYER_ATTENTION
-        for i in range(config.num_hidden_layers)
-    )
-
-
 def serve_main(args) -> int:
     """``parallax-tpu serve`` entry."""
     import os
@@ -173,7 +146,9 @@ def serve_main(args) -> int:
 
     tp_size = getattr(args, "tp_size", 0)
     sp_size = getattr(args, "sp_size", 0) or 0
-    if sp_size > 1 and not _sp_eligible(config):
+    from parallax_tpu.parallel.sp import sp_eligible
+
+    if sp_size > 1 and not sp_eligible(config):
         # Models the engine refuses SP for must not claim (and waste)
         # sp x devices on a silently inert ring path.
         logger.warning(

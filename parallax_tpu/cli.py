@@ -116,6 +116,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="per-request adapters this worker serves: "
              "name=peft_dir[,name=dir]",
     )
+    join.add_argument(
+        "--sp-size", type=int, default=0,
+        help="ring-attention sp mesh axis for long-prompt prefill: the "
+             "host's chips form an (sp, tp) mesh with tp = chips / "
+             "sp-size (must divide evenly)",
+    )
+    join.add_argument("--sp-threshold", type=int, default=2048,
+                      help="prompts at least this long prefill via SP")
 
     bench = sub.add_parser("bench", help="offline throughput benchmark")
     bench.add_argument("--config", default="qwen2-7b")

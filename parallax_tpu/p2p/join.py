@@ -112,7 +112,37 @@ def join_main(args) -> int:
             )
 
     n_devices = len(jax.local_devices())
-    mesh = make_mesh(tp_size=n_devices) if n_devices > 1 else None
+    # --sp-size N: the mesh becomes ("sp"=N, "tp"=n/N) — every chip sits
+    # on both axes. Long prompts ring-prefill over sp (inside the TP
+    # shard_map when tp > 1, over a dedicated sp mesh when tp == 1).
+    # Eligibility is pre-checked on the INITIAL model; a later
+    # /scheduler/init switch to an ineligible model falls back to the
+    # engine's own refusal (warning + replicated sp chips).
+    sp_size = max(1, getattr(args, "sp_size", 0) or 0)
+    if sp_size > 1:
+        from parallax_tpu.parallel.sp import sp_eligible
+
+        if n_devices % sp_size:
+            raise SystemExit(
+                f"--sp-size {sp_size} does not divide {n_devices} "
+                "local chips"
+            )
+        if model_config is not None and not sp_eligible(model_config):
+            logger.warning(
+                "--sp-size %d ignored: %s does not support ring-attention "
+                "prefill (MLA/sparse/hybrid/window/sink attention)",
+                sp_size, model_config.architecture,
+            )
+            sp_size = 1
+    tp_size = n_devices // sp_size
+    mesh = None
+    sp_mesh = None
+    if tp_size > 1:
+        mesh = make_mesh(tp_size=tp_size, sp_size=sp_size)
+    elif sp_size > 1:
+        # SP-only worker (sp spans every chip): the ring opens its own
+        # shard_map over a dedicated sp mesh.
+        sp_mesh = make_mesh(sp_size=sp_size, tp_size=1)
 
     from parallax_tpu.ops.lora import parse_adapter_spec
 
@@ -120,10 +150,16 @@ def join_main(args) -> int:
         transport=transport,
         scheduler_peer=scheduler_peer,
         model_config=model_config,
-        engine_config=EngineConfig(),
+        engine_config=EngineConfig(
+            sp_threshold=(
+                getattr(args, "sp_threshold", 2048)
+                if sp_size > 1 else None
+            ),
+        ),
         load_params=load_params,
         mesh=mesh,
-        tp_size=n_devices if n_devices > 1 else 1,
+        sp_mesh=sp_mesh,
+        tp_size=tp_size if n_devices > 1 else 1,
         refit_cache_dir=getattr(args, "refit_cache_dir", None),
         resolve_model=resolve_model,
         tokenizer_path=args.model_path,

@@ -52,6 +52,7 @@ class WorkerNode:
         load_params=None,          # callable (StageModel) -> params
         heartbeat_interval_s: float = 2.0,
         mesh=None,
+        sp_mesh=None,
         tp_size: int = 1,
         refit_cache_dir: str | None = None,
         resolve_model=None,  # callable (name) -> (ModelConfig, load_params|None)
@@ -73,6 +74,7 @@ class WorkerNode:
         self.load_params = load_params or self._random_params
         self.heartbeat_interval_s = heartbeat_interval_s
         self.mesh = mesh
+        self.sp_mesh = sp_mesh
         self.tp_size = tp_size
         self.resolve_model = resolve_model
         self.tokenizer_path = tokenizer_path
@@ -203,7 +205,8 @@ class WorkerNode:
         )
         params = self.load_params(model)
         self.engine = StageEngine(
-            model, params, self.engine_config, mesh=self.mesh
+            model, params, self.engine_config, mesh=self.mesh,
+            sp_mesh=self.sp_mesh,
         )
         for name, source in self.lora_adapters.items():
             # Each (re)allocation re-registers every adapter against the

@@ -149,6 +149,34 @@ def ring_attention(
     return fn(q, k, v, positions, kv_positions)
 
 
+def sp_eligible(config) -> bool:
+    """Can this model take the ring-attention prefill path at all?
+    Mirrors ``StageEngine._model_supports_sp`` at config level, including
+    the class-level ``_attention`` override check (e.g. MiniMax-M2
+    overrides it despite a plain-attention config). Launchers use this to
+    avoid carving an sp mesh axis a model can never use."""
+    from parallax_tpu.config import LAYER_ATTENTION
+    from parallax_tpu.models.base import StageModel
+    from parallax_tpu.models.registry import get_model_class
+
+    if config.is_mla or config.use_attention_sinks:
+        return False
+    if (
+        config.linear_attn is not None
+        or config.dsa is not None
+        or config.msa is not None
+    ):
+        return False
+    if get_model_class(config.architecture)._attention is not (
+        StageModel._attention
+    ):
+        return False
+    return all(
+        config.layer_type(i) == LAYER_ATTENTION
+        for i in range(config.num_hidden_layers)
+    )
+
+
 def dense_causal_reference(q, k, v, positions, sm_scale):
     """Unsharded reference with identical semantics (tests)."""
     t, hq, d = q.shape

@@ -271,6 +271,13 @@ class StageEngine:
             and self.cfg.sp_threshold is not None
             and self._model_supports_sp(model, in_mesh=sp_in_mesh > 1)
         )
+        if mesh_sp > 1 and not self._sp_enabled:
+            # Engine-level refusal (model class / config / threshold):
+            # the sp chips then run fully replicated — loud, not silent.
+            logger.warning(
+                "mesh carries sp=%d but SP prefill is disabled for this "
+                "model/config; those chips run replicated work", mesh_sp,
+            )
         if self._sp_enabled:
             if sp_in_mesh > 1:
                 sp = sp_in_mesh
