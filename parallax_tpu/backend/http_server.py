@@ -210,6 +210,7 @@ class OpenAIFrontend:
         refit_fn=None,
         stop_fn=None,
         scheduler_init_fn=None,
+        adapters_fn=None,
     ):
         self.tokenizer = tokenizer
         self.submit_fn = submit_fn
@@ -217,6 +218,7 @@ class OpenAIFrontend:
         self.status_fn = status_fn
         self.refit_fn = refit_fn
         self.stop_fn = stop_fn
+        self.adapters_fn = adapters_fn
         self.scheduler_init_fn = scheduler_init_fn
         self.model_name = model_name
         self.stream_poll_s = stream_poll_s
@@ -278,14 +280,34 @@ class OpenAIFrontend:
         return web.Response(text=_CHAT_HTML, content_type="text/html")
 
     async def models(self, _req):
+        """Base model plus one ``<model>:<adapter>`` variant per
+        registered LoRA adapter (the multi-LoRA serving convention, so
+        stock OpenAI clients can select a tenant via the model field)."""
+        names = [self.model_name]
+        if self.adapters_fn is not None:
+            names += [
+                f"{self.model_name}:{a}" for a in self.adapters_fn()
+            ]
         return web.json_response({
             "object": "list",
             "data": [{
-                "id": self.model_name,
+                "id": name,
                 "object": "model",
                 "owned_by": "parallax-tpu",
-            }],
+            } for name in names],
         })
+
+    def _request_lora(self, body: dict) -> str | None:
+        """Adapter selection: explicit ``"lora"`` field, or the
+        ``<model>:<adapter>`` model-name convention."""
+        lora = body.get("lora")
+        if lora:
+            return lora
+        m = body.get("model") or ""
+        prefix = f"{self.model_name}:"
+        if m.startswith(prefix):
+            return m[len(prefix):] or None
+        return None
 
     async def cluster_status_json(self, _req):
         status = self.status_fn() if self.status_fn else {}
@@ -459,9 +481,9 @@ class OpenAIFrontend:
             sampling_params=sampling_params,
             routing_table=routing_table,
             eos_token_ids=tuple(self.tokenizer.eos_token_ids),
-            # Per-request adapter (reference Req.lora_path): "lora" in the
-            # body selects an adapter registered at every stage.
-            lora_id=body.get("lora"),
+            # Per-request adapter (reference Req.lora_path): "lora" in
+            # the body or the <model>:<adapter> model-name convention.
+            lora_id=self._request_lora(body),
         )
         # Count at accept time, not in usage formatting: client disconnects
         # mid-stream must still be visible in /metrics.
@@ -534,7 +556,7 @@ class OpenAIFrontend:
                 sampling_params=sp,
                 routing_table=list(routing_table),
                 eos_token_ids=tuple(self.tokenizer.eos_token_ids),
-                lora_id=body.get("lora"),
+                lora_id=self._request_lora(body),
             )
             try:
                 done = await asyncio.to_thread(self.submit_fn, req)

@@ -103,12 +103,21 @@ def build_local_frontend(
             ],
         }
 
+    def adapters():
+        # Advertise only adapters EVERY stage can serve — a name missing
+        # on one stage would 502 mid-pipeline after being listed.
+        names = set(engines[0].adapter_names())
+        for e in engines[1:]:
+            names &= set(e.adapter_names())
+        return sorted(names)
+
     frontend = OpenAIFrontend(
         tokenizer,
         submit_fn=runner.submit,
         status_fn=status,
         model_name=model_name,
         stop_fn=runner.stop_request,
+        adapters_fn=adapters,
     )
     return frontend, runner
 

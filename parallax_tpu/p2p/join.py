@@ -159,7 +159,7 @@ def join_main(args) -> int:
         load_params=load_params,
         mesh=mesh,
         sp_mesh=sp_mesh,
-        tp_size=tp_size if n_devices > 1 else 1,
+        tp_size=tp_size,
         refit_cache_dir=getattr(args, "refit_cache_dir", None),
         resolve_model=resolve_model,
         tokenizer_path=args.model_path,

@@ -271,12 +271,14 @@ class StageEngine:
             and self.cfg.sp_threshold is not None
             and self._model_supports_sp(model, in_mesh=sp_in_mesh > 1)
         )
-        if mesh_sp > 1 and not self._sp_enabled:
+        if (mesh_sp > 1 or sp_mesh is not None) and not self._sp_enabled:
             # Engine-level refusal (model class / config / threshold):
             # the sp chips then run fully replicated — loud, not silent.
+            # Covers both mesh forms (combined sp axis AND dedicated
+            # sp_mesh), incl. a live model switch to an ineligible model.
             logger.warning(
-                "mesh carries sp=%d but SP prefill is disabled for this "
-                "model/config; those chips run replicated work", mesh_sp,
+                "an sp mesh is configured but SP prefill is disabled for "
+                "this model/config; those chips run replicated work",
             )
         if self._sp_enabled:
             if sp_in_mesh > 1:
@@ -403,6 +405,10 @@ class StageEngine:
 
     def has_adapter(self, name: str) -> bool:
         return self._adapters is not None and name in self._adapters
+
+    def adapter_names(self) -> list[str]:
+        """Registered per-request adapters (frontend advertising)."""
+        return self._adapters.names if self._adapters is not None else []
 
     def _lora_field(self, plan: BatchPlan):
         if plan.lora_id is None or self._adapters is None:

@@ -504,3 +504,50 @@ def test_profile_endpoints():
         assert r4.status == 409
 
     with_client(fe.app, fn)
+
+
+def test_adapter_model_variants():
+    """Registered LoRA adapters appear as <model>:<adapter> entries in
+    /v1/models, and selecting that model name routes the request to the
+    adapter (the multi-LoRA OpenAI convention)."""
+    import numpy as np
+
+    engines = build_engines([(0, 2)])
+    rng = np.random.default_rng(2)
+    engines[0].load_adapter("tenant-x", {0: {"self_attn.q_proj": (
+        rng.standard_normal((4, 64)).astype(np.float32),
+        rng.standard_normal((64, 4)).astype(np.float32), 0.9,
+    )}})
+    fe, runner = build_local_frontend(
+        engines, SimpleTokenizer(), model_name="tiny"
+    )
+    try:
+        async def go(client):
+            models = await (await client.get("/v1/models")).json()
+            ids = [m["id"] for m in models["data"]]
+            assert ids == ["tiny", "tiny:tenant-x"]
+            base_body = {
+                "messages": [{"role": "user", "content": "hello"}],
+                "max_tokens": 6, "temperature": 0.0, "ignore_eos": True,
+            }
+            r1 = await client.post("/v1/chat/completions",
+                                   json={**base_body, "model": "tiny"})
+            r2 = await client.post(
+                "/v1/chat/completions",
+                json={**base_body, "model": "tiny:tenant-x"},
+            )
+            t1 = (await r1.json())["choices"][0]["message"]["content"]
+            t2 = (await r2.json())["choices"][0]["message"]["content"]
+            assert r1.status == r2.status == 200
+            assert t1 != t2          # the adapter changed the stream
+            # Unknown adapter via model suffix fails loudly, not as base.
+            r3 = await client.post(
+                "/v1/chat/completions",
+                json={**base_body, "model": "tiny:nope"},
+            )
+            assert r3.status == 502
+            return True
+
+        assert with_client(fe.app, go)
+    finally:
+        runner.stop()
