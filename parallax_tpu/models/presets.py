@@ -209,6 +209,15 @@ MODEL_DB: dict[str, dict] = {
         linear_value_head_dim=128, vocab_size=151936,
         max_position_embeddings=262144, rope_theta=10000000.0,
     ),
+    # Qwen3.5 / 3.6 (reference static_config.py lists them; public
+    # configs are not yet released, so these resolve to the nearest
+    # released family for the scheduler's capacity estimates only —
+    # actually serving one reads the checkpoint's own config.json, and
+    # an architecture this build does not implement fails loudly there).
+    "Qwen/Qwen3.5-0.8B": dict(alias="Qwen/Qwen3-0.6B"),
+    "Qwen/Qwen3.5-35B-A3B": dict(alias="Qwen/Qwen3-30B-A3B"),
+    "Qwen/Qwen3.6-35B-A3B": dict(alias="Qwen/Qwen3-30B-A3B"),
+    "Qwen/Qwen3.6-27B": dict(alias="Qwen/Qwen3-32B"),
     "Qwen/Qwen3-Next-80B-A3B-Instruct-FP8": dict(
         alias="Qwen/Qwen3-Next-80B-A3B-Instruct",
     ),
