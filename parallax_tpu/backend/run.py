@@ -163,6 +163,15 @@ def build_swarm_frontend(
     client = SwarmClient(transport, service)
     # Bind through the service so a live model switch (which swaps
     # service.scheduler) redirects every control-plane call.
+    def adapters():
+        from parallax_tpu.ops.lora import intersect_adapter_names
+
+        return intersect_adapter_names(
+            n.lora_adapters
+            for n in service.scheduler.manager.nodes()
+            if n.has_allocation and n.is_ready
+        )
+
     frontend = OpenAIFrontend(
         tokenizer,
         submit_fn=client.submit,
@@ -171,6 +180,7 @@ def build_swarm_frontend(
         refit_fn=lambda index: service.scheduler.begin_refit(index),
         model_name=model_name,
         stop_fn=client.stop,
+        adapters_fn=adapters,
     )
     if resolve_model is not None:
         frontend.scheduler_init_fn = make_scheduler_init_fn(

@@ -97,6 +97,11 @@ class SchedulerService:
             rtt_s=payload.get("rtt_s"),
             is_ready=payload.get("is_ready"),
             refit_version=payload.get("refit_version"),
+            lora_adapters=(
+                [str(a) for a in payload["lora_adapters"]]
+                if isinstance(payload.get("lora_adapters"), (list, tuple))
+                else None
+            ),
         )
         alloc = self._with_model(self.scheduler.get_node_allocation(node_id) or {})
         alloc["refit_version"] = self.scheduler.refit_version

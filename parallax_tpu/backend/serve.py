@@ -104,12 +104,11 @@ def build_local_frontend(
         }
 
     def adapters():
-        # Advertise only adapters EVERY stage can serve — a name missing
-        # on one stage would 502 mid-pipeline after being listed.
-        names = set(engines[0].adapter_names())
-        for e in engines[1:]:
-            names &= set(e.adapter_names())
-        return sorted(names)
+        from parallax_tpu.ops.lora import intersect_adapter_names
+
+        return intersect_adapter_names(
+            e.adapter_names() for e in engines
+        )
 
     frontend = OpenAIFrontend(
         tokenizer,

@@ -82,6 +82,20 @@ def adapter_tree_from_peft(
     return tree
 
 
+def intersect_adapter_names(name_lists) -> list[str]:
+    """Adapters EVERY participant can serve (frontend advertising): a
+    name missing on one stage/node would 502 mid-pipeline after being
+    listed. Empty input -> nothing advertised."""
+    it = iter(name_lists)
+    try:
+        names = set(next(it))
+    except StopIteration:
+        return []
+    for other in it:
+        names &= set(other)
+    return sorted(names)
+
+
 def parse_adapter_spec(spec: str | None) -> dict[str, str]:
     """CLI ``name=peft_dir[,name=dir]`` -> {name: dir}."""
     out: dict[str, str] = {}

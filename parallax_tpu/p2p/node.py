@@ -204,17 +204,21 @@ class WorkerNode:
             self.model_config, start, end, tp_size=self.tp_size
         )
         params = self.load_params(model)
-        self.engine = StageEngine(
+        engine = StageEngine(
             model, params, self.engine_config, mesh=self.mesh,
             sp_mesh=self.sp_mesh,
         )
         for name, source in self.lora_adapters.items():
             # Each (re)allocation re-registers every adapter against the
-            # stage's new layer range.
+            # stage's new layer range — BEFORE the engine is published:
+            # a heartbeat firing mid-registration would otherwise report
+            # is_ready with an empty adapter list and transiently drop
+            # every advertised adapter variant cluster-wide.
             try:
-                self.engine.load_adapter(name, source)
+                engine.load_adapter(name, source)
             except (ValueError, OSError) as e:
                 logger.warning("adapter %r failed to load: %s", name, e)
+        self.engine = engine
         if model.is_last:
             self._wire_grammar()
         self._restore_refit_cache()
@@ -393,6 +397,9 @@ class WorkerNode:
                             eng.layer_latency_ms_ewma if eng else None
                         ),
                         "refit_version": self.refit_version,
+                        "lora_adapters": (
+                            eng.adapter_names() if eng else []
+                        ),
                     },
                     timeout=10.0,
                 )

@@ -72,6 +72,9 @@ class Node:
     # Measured per-layer decode latency EWMA from heartbeats (overrides
     # roofline when present; reference node.py:378-387).
     measured_layer_latency_ms: float | None = None
+    # Per-request LoRA adapters this node can serve (heartbeat-reported;
+    # the swarm frontend advertises the cross-stage intersection).
+    lora_adapters: tuple = ()
     # RTT cache to peers, node_id -> seconds.
     rtt_s: dict[str, float] = dataclasses.field(default_factory=dict)
     last_heartbeat: float = dataclasses.field(default_factory=time.monotonic)

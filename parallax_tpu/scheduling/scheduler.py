@@ -98,10 +98,11 @@ class GlobalScheduler:
         rtt_s: dict | None = None,
         is_ready: bool | None = None,
         refit_version: int | None = None,
+        lora_adapters: list | None = None,
     ) -> None:
         self._events.put(
             ("update", node_id, layer_latency_ms, load, rtt_s, is_ready,
-             refit_version)
+             refit_version, lora_adapters)
         )
 
     def receive_request(self, request_id: str) -> PendingRequest:
@@ -144,7 +145,13 @@ class GlobalScheduler:
             except queue.Empty:
                 ev = None
             if ev is not None:
-                self._handle_event(ev)
+                try:
+                    self._handle_event(ev)
+                except Exception:
+                    # The topology thread must survive malformed
+                    # network-fed payloads (update fields arrive from
+                    # workers' heartbeats verbatim).
+                    logger.exception("event %r failed", ev[0])
             now = time.monotonic()
             if now - last_sweep > 1.0:
                 self._sweep_heartbeats()
@@ -162,7 +169,7 @@ class GlobalScheduler:
         elif kind == "leave":
             self._handle_leave(ev[1])
         elif kind == "update":
-            _, node_id, lat, load, rtt, ready, refit = ev
+            _, node_id, lat, load, rtt, ready, refit, adapters = ev
             node = self.manager.get(node_id)
             if node is None:
                 return
@@ -177,6 +184,8 @@ class GlobalScheduler:
                 node.is_ready = ready
             if refit is not None:
                 node.refit_version = refit
+            if adapters is not None:
+                node.lora_adapters = tuple(adapters)
 
     def _try_bootstrap_or_extend(self) -> None:
         standby = self.manager.nodes(NodeState.STANDBY)

@@ -456,6 +456,90 @@ def test_swarm_two_tenants_adapter_correct(monkeypatch, tmp_path):
         service.stop()
 
 
+def test_swarm_heartbeats_advertise_adapters(monkeypatch, tmp_path):
+    """Workers report their adapters over heartbeats; the swarm
+    frontend's /v1/models lists the cross-node intersection."""
+    import json
+    import threading
+    import time
+
+    from safetensors.numpy import save_file
+
+    from parallax_tpu.backend.run import build_swarm_frontend
+    from parallax_tpu.backend.scheduler_service import SchedulerService
+    from parallax_tpu.p2p.node import WorkerNode
+    from parallax_tpu.p2p.transport import TcpTransport
+    from parallax_tpu.scheduling import node as node_mod
+    from parallax_tpu.scheduling.scheduler import GlobalScheduler
+    from parallax_tpu.utils.tokenizer import SimpleTokenizer
+
+    def write_peft(sub: str, seed: int) -> str:
+        d = tmp_path / sub
+        d.mkdir()
+        rng = np.random.default_rng(seed)
+        h = TINY.hidden_size
+        weights = {}
+        for gi in range(TINY.num_hidden_layers):
+            base = f"base_model.model.model.layers.{gi}.self_attn.q_proj"
+            weights[f"{base}.lora_A.weight"] = (
+                rng.standard_normal((4, h)).astype(np.float32) * 0.1)
+            weights[f"{base}.lora_B.weight"] = (
+                rng.standard_normal((h, 4)).astype(np.float32) * 0.1)
+        (d / "adapter_config.json").write_text(
+            json.dumps({"lora_alpha": 8, "r": 4}))
+        save_file(weights, str(d / "adapter_model.safetensors"))
+        return str(d)
+
+    shared, extra = write_peft("shared", 1), write_peft("extra", 2)
+    monkeypatch.setattr(
+        node_mod.RooflinePerformanceModel, "max_layers_in_memory",
+        lambda self, kv_fraction=0.35: 2,
+    )
+
+    def stage_params(model):
+        return model.init_params(jax.random.key(1), dtype=jnp.float32)
+
+    sched = GlobalScheduler(TINY, min_nodes_bootstrapping=2)
+    transport = TcpTransport("scheduler", "127.0.0.1")
+    frontend, service, _client = build_swarm_frontend(
+        sched, transport, SimpleTokenizer(), "tiny"
+    )
+    service.start()
+    workers = []
+    try:
+        # Worker 1 serves both adapters; worker 2 only the shared one.
+        for ads in ({"common": shared, "only1": extra},
+                    {"common": shared}):
+            t = TcpTransport("", "127.0.0.1")
+            t.start()
+            t.peer_id = t.address
+            workers.append(WorkerNode(
+                transport=t, scheduler_peer=transport.address,
+                model_config=TINY, engine_config=ECFG,
+                load_params=stage_params, heartbeat_interval_s=0.2,
+                lora_adapters=ads,
+            ))
+        starters = [threading.Thread(target=w.start) for w in workers]
+        for s in starters:
+            s.start()
+        for s in starters:
+            s.join(timeout=60.0)
+        deadline = time.monotonic() + 20.0
+        names = []
+        while time.monotonic() < deadline:
+            nodes = [n for n in sched.manager.nodes()
+                     if n.has_allocation and n.is_ready]
+            if len(nodes) == 2 and all(n.lora_adapters for n in nodes):
+                names = frontend.adapters_fn()
+                break
+            time.sleep(0.1)
+        assert names == ["common"], names
+    finally:
+        for w in workers:
+            w.stop()
+        service.stop()
+
+
 class TestPeftLoading:
     def _write_peft_dir(self, tmp_path, rank=4, alpha=8):
         import json
