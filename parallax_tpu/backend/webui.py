@@ -82,7 +82,9 @@ border-radius:8px;padding:8px 12px;margin:4px;font-size:13px}
  <div class="card"><h2>Serving metrics</h2><pre id="metrics">…</pre></div>
 </section>
 <section id="tab-chat" hidden>
- <div class="card"><div id="log"></div>
+ <div class="card">
+ <div style="margin-bottom:8px"><select id="chatmodel"></select></div>
+ <div id="log"></div>
  <div id="chatbar"><input id="inp" placeholder="message…">
  <button class="primary" id="send">Send</button></div></div>
 </section>
@@ -110,7 +112,8 @@ document.querySelectorAll('nav button').forEach(b=>b.onclick=()=>{
  document.querySelectorAll('nav button').forEach(x=>x.classList.remove('active'));
  b.classList.add('active');
  document.querySelectorAll('main section').forEach(s=>s.hidden=true);
- $('#tab-'+b.dataset.tab).hidden=false;});
+ $('#tab-'+b.dataset.tab).hidden=false;
+ if(b.dataset.tab==='chat')loadChatModels();});
 async function meta(){
  try{const m=await (await fetch('/ui/meta')).json();
   $('#model').innerHTML=m.models.map(x=>`<option>${x}</option>`).join('');
@@ -147,6 +150,14 @@ const history=[];let busy=false;
 function add(cls,text){const d=document.createElement('div');
  d.className='msg '+cls;d.textContent=text;$('#log').appendChild(d);
  d.scrollIntoView();return d;}
+async function loadChatModels(){
+ try{const r=await fetch('/v1/models');const j=await r.json();
+  const sel=$('#chatmodel');const cur=sel.value;sel.innerHTML='';
+  for(const m of j.data){const o=document.createElement('option');
+   o.value=m.id;o.textContent=m.id;sel.appendChild(o);}
+  if(cur)sel.value=cur;}catch(e){}}
+loadChatModels();
+
 async function send(){
  if(busy)return;const text=$('#inp').value.trim();if(!text)return;
  $('#inp').value='';busy=true;
@@ -155,8 +166,8 @@ async function send(){
  try{
   const r=await fetch('/v1/chat/completions',{method:'POST',
    headers:{'Content-Type':'application/json'},
-   body:JSON.stringify({model:'parallax-tpu',messages:history,stream:true,
-    max_tokens:512})});
+   body:JSON.stringify({model:$('#chatmodel').value||'parallax-tpu',
+    messages:history,stream:true,max_tokens:512})});
   if(!r.ok){el.textContent='[error '+r.status+']';history.pop();return;}
   const rd=r.body.getReader(),dec=new TextDecoder();let acc='',buf='';
   for(;;){const{done,value}=await rd.read();if(done)break;
