@@ -137,8 +137,13 @@ class TcpTransport(Transport):
         self._pending: dict[int, "asyncio.Future"] = {}
         self._msg_id = 0
         self._started = threading.Event()
+        self._stopped = False
+        self._stop_lock = threading.Lock()
         # Relay role: relay-registered worker id -> reverse-connection writer.
         self._relay_routes: dict[str, asyncio.StreamWriter] = {}
+        # Writers of inbound connections, so stop() can close them and let
+        # their read loops exit instead of being destroyed mid-await.
+        self._server_writers: set[asyncio.StreamWriter] = set()
         self._local_ips: set[str] | None = None
 
     # -- lifecycle ---------------------------------------------------------
@@ -156,8 +161,13 @@ class TcpTransport(Transport):
     def _run_loop(self) -> None:
         self._loop = asyncio.new_event_loop()
         asyncio.set_event_loop(self._loop)
-        self._loop.run_until_complete(self._serve())
-        self._loop.run_forever()
+        try:
+            self._loop.run_until_complete(self._serve())
+            self._loop.run_forever()
+        except Exception:
+            logger.exception("transport %s failed to serve", self.peer_id)
+        finally:
+            self._loop.close()
 
     async def _serve(self) -> None:
         self._server = await asyncio.start_server(
@@ -171,19 +181,57 @@ class TcpTransport(Transport):
         return f"{self.host}:{self.port}"
 
     def stop(self) -> None:
-        if self._loop is None:
-            return
+        with self._stop_lock:
+            if self._loop is None or self._stopped:
+                return
+            self._stopped = True
 
-        def _shutdown():
+        async def _shutdown():
             if self._server is not None:
+                # close() only stops accepting; wait_closed() must come
+                # AFTER the handler tasks are cancelled — on 3.12+ it
+                # waits for every connection handler to finish, so
+                # awaiting it first deadlocks against our own cancel.
                 self._server.close()
-            for task in asyncio.all_tasks(self._loop):
-                task.cancel()
-            self._loop.stop()
+            # Close every connection so read loops see EOF, then cancel
+            # whatever is still running and WAIT for the cancellations to
+            # land — stopping the loop first is what used to spray
+            # "Task was destroyed but it is pending!" on every teardown.
+            for _reader, writer, _lock in list(self._conns.values()):
+                writer.close()
+            self._conns.clear()
+            for writer in list(self._server_writers):
+                writer.close()
+            self._server_writers.clear()
+            self._relay_routes.clear()
+            current = asyncio.current_task()
+            tasks = [t for t in asyncio.all_tasks() if t is not current]
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            if self._server is not None:
+                await self._server.wait_closed()
 
-        self._loop.call_soon_threadsafe(_shutdown)
+        try:
+            # A loop that never reached run_forever (failed start) would
+            # park _shutdown forever; skip straight to stopping it.
+            if self._loop.is_running():
+                asyncio.run_coroutine_threadsafe(
+                    _shutdown(), self._loop
+                ).result(5.0)
+        except Exception as e:  # loop already closed / a task outlived the wait
+            logger.warning("transport %s teardown incomplete: %r",
+                           self.peer_id, e)
+        finally:
+            # The loop must stop even when _shutdown timed out — a live
+            # loop thread with _stopped=True could never be stopped again.
+            try:
+                self._loop.call_soon_threadsafe(self._loop.stop)
+            except RuntimeError:  # loop already closed
+                pass
         if self._thread:
             self._thread.join(timeout=2.0)
+        self._executor.shutdown(wait=False, cancel_futures=True)
 
     # -- framing -----------------------------------------------------------
 
@@ -210,30 +258,35 @@ class TcpTransport(Transport):
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         peer_name = "?"
-        while True:
-            frame = await self._read_frame(reader)
-            if frame is None:
-                break
-            if frame["t"] == "__hello__":
-                peer_name = frame["p"]
-                continue
-            if await self._handle_relay_frame(frame, peer_name, writer):
-                continue
-            if frame.get("re") is not None:
-                fut = self._pending.pop(frame["re"], None)
-                if fut is not None and not fut.done():
-                    fut.set_result(frame["p"])
-                continue
-            asyncio.ensure_future(
-                self._handle_request(frame, peer_name, writer)
-            )
-        # Dead reverse routes must not linger: until the worker's next
-        # re-register they would black-hole relayed frames, and churning
-        # workers (fresh uuid ids per rejoin) would grow the map forever.
-        for rid, w in list(self._relay_routes.items()):
-            if w is writer:
-                self._relay_routes.pop(rid, None)
-        writer.close()
+        self._server_writers.add(writer)
+        try:
+            while True:
+                frame = await self._read_frame(reader)
+                if frame is None:
+                    break
+                if frame["t"] == "__hello__":
+                    peer_name = frame["p"]
+                    continue
+                if await self._handle_relay_frame(frame, peer_name, writer):
+                    continue
+                if frame.get("re") is not None:
+                    fut = self._pending.pop(frame["re"], None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(frame["p"])
+                    continue
+                asyncio.ensure_future(
+                    self._handle_request(frame, peer_name, writer)
+                )
+        finally:
+            # Runs even when a malformed frame kills the read loop: dead
+            # reverse routes must not linger (they black-hole relayed
+            # frames until the worker's next re-register), and churning
+            # workers would grow the maps forever.
+            for rid, w in list(self._relay_routes.items()):
+                if w is writer:
+                    self._relay_routes.pop(rid, None)
+            self._server_writers.discard(writer)
+            writer.close()
 
     # -- relay protocol ----------------------------------------------------
 
@@ -266,14 +319,24 @@ class TcpTransport(Transport):
                 return True
             prev = self._relay_routes.get(rid)
             if prev is not None and prev is not writer and not prev.is_closing():
-                # A LIVE route replaced by a different connection is a
-                # worker reconnect the old socket hasn't noticed yet (or,
-                # without a token, a hijack by an id-faking peer) — say so
-                # loudly so operators can correlate.
+                # A LIVE route replaced by a new connection is either a
+                # worker reconnect whose old socket died half-open (NAT
+                # rebind — the relay never saw a FIN) or, without a token,
+                # an id-faking hijack. The two are indistinguishable here:
+                # any tokenless recovery path the real worker could use, an
+                # attacker can replay, so rejecting/quarantining only slows
+                # the victim down without stopping theft. Replace the route
+                # (availability first), close the stale socket, and say so
+                # loudly; actual hijack protection requires --relay-token
+                # on non-loopback swarms.
                 logger.warning(
                     "relay: reverse route for %s replaced by a different "
-                    "live connection (reconnect or hijack)", rid,
+                    "live connection (%s)", rid,
+                    "authenticated reconnect" if self.relay_token is not None
+                    else "reconnect or HIJACK — set --relay-token to "
+                         "authenticate registrations",
                 )
+                prev.close()
             self._relay_routes[rid] = writer
             # Heartbeat refreshes are routine; only NEW routes are news.
             logger.log(
@@ -473,7 +536,10 @@ class TcpTransport(Transport):
         """NAT'd worker: open/refresh the reverse route at ``relay_addr``.
 
         Idempotent — call again (e.g. on every heartbeat) to re-register
-        after a dropped connection; the relay replaces the route writer.
+        after a dropped connection; the relay replaces the route writer
+        and closes the stale socket. Without a relay token any peer can
+        claim any id, so tokenless relay mode is for trusted networks
+        only — configure ``--relay-token`` on non-loopback swarms.
         """
 
         async def _register():

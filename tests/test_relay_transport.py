@@ -154,6 +154,35 @@ def test_relay_rejects_identity_mismatched_registration(trio):
         attacker.stop()
 
 
+def test_relay_stale_route_recovery_without_token(trio):
+    """A worker whose old relay connection died half-open (NAT rebind —
+    the relay never saw a FIN) recovers on its first re-registration from
+    a new connection: the route is replaced and the stale socket closed."""
+    relay, worker, client = trio
+    worker.register_at_relay(relay.address)
+    wait_route(relay, worker.peer_id)
+    stale_writer = relay._relay_routes[worker.peer_id]
+
+    reborn = TcpTransport("", "127.0.0.1")
+    reborn.start()
+    reborn.peer_id = worker.peer_id
+    reborn.register("alive", lambda _f, _p: "reborn")
+    try:
+        reborn.register_at_relay(relay.address)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if relay._relay_routes.get(worker.peer_id) is not stale_writer:
+                break
+            time.sleep(0.01)
+        assert relay._relay_routes.get(worker.peer_id) is not stale_writer
+        assert stale_writer.is_closing()  # relay reclaimed the old socket
+        assert client.call(
+            worker.peer_id, "alive", None, timeout=10.0
+        ) == "reborn"
+    finally:
+        reborn.stop()
+
+
 def test_relay_token_required_when_configured():
     """With a swarm secret on the relay, identity alone is not enough."""
     relay = TcpTransport("relay-node", "127.0.0.1", relay_token="s3cret")
