@@ -89,9 +89,16 @@ def build_parser() -> argparse.ArgumentParser:
                       help="scheduler-less mode: comma-separated worker "
                            "addresses to gossip block announcements with")
     join.add_argument("--start-layer", type=int, default=None,
-                      help="scheduler-less mode: this worker's first layer")
+                      help="scheduler-less mode: this worker's first layer. "
+                           "Blocks chain only at EXACT boundaries (a stage "
+                           "is jit-compiled for its whole slice, so a "
+                           "route cannot enter a block mid-way): every "
+                           "worker's end layer must equal the next "
+                           "worker's start layer")
     join.add_argument("--end-layer", type=int, default=None,
-                      help="scheduler-less mode: one past the last layer")
+                      help="scheduler-less mode: one past the last layer "
+                           "(must match the next block's --start-layer; "
+                           "see --start-layer)")
     join.add_argument("--model-path", default=None)
     join.add_argument("--port", type=int, default=0)
     join.add_argument("--refit-cache-dir", default=None,

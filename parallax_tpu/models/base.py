@@ -243,7 +243,9 @@ class StageModel:
         if inputs.lora is not None:
             from parallax_tpu.ops.lora import select_slot
 
-            lora_sel = select_slot(inputs.lora)
+            lora_sel = select_slot(
+                inputs.lora, axis_name=self.axis_name, tp=self.tp_size
+            )
 
         new_kv: list[jax.Array] = []
         for li in range(self.num_local_layers):

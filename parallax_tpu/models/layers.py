@@ -152,8 +152,9 @@ def row_parallel_linear(
         preferred_element_type=jnp.float32,
     ).astype(x.dtype)
     if "lora" in p:
-        # Unsharded only (the engine refuses per-request adapters on TP
-        # stages): applied before the no-op psum for symmetry with linear.
+        # Under TP the delta's A is sliced to this shard's in-dim block
+        # (ops/lora.select_slot), so like the base matmul it is a partial
+        # sum — applying it BEFORE the psum completes both at once.
         out = out + _lora_delta(x, p["lora"]).astype(out.dtype)
     if axis_name is not None:
         out = jax.lax.psum(out, axis_name)

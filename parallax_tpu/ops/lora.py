@@ -202,16 +202,78 @@ class AdapterSet:
         return stacked
 
 
-def select_slot(lora: dict):
-    """Inside-jit: slice every stacked array down to the batch's slot."""
+# Projection classes under tensor parallelism: column-sharded projections
+# slice the delta's B on its out dim, row-sharded ones slice A on its in
+# dim (the partial delta then rides the layer's existing psum).
+_COL_PROJS = frozenset({"q_proj", "k_proj", "v_proj", "gate_proj", "up_proj"})
+_ROW_PROJS = frozenset({"o_proj", "down_proj"})
+
+
+def select_slot(lora: dict, axis_name: str | None = None, tp: int = 1):
+    """Inside-jit: slice every stacked array down to the batch's slot.
+
+    Under TP (called inside the stage's shard_map) the adapter arrays
+    arrive replicated; each shard slices its own partition so the delta
+    matmuls match the base projection's local shapes:
+
+    - column-parallel (q/k/v/gate/up): ``B -> B[idx*out_loc:(idx+1)*out_loc]``
+      (A replicated) — the delta directly produces the local out slice.
+    - row-parallel (o/down): ``A -> A[:, idx*in_loc:(idx+1)*in_loc]``
+      (B replicated) — ``(x_loc @ A_loc^T) @ B^T`` is a partial sum over
+      the sharded in dim, summed by the projection's psum alongside the
+      base matmul (layers.row_parallel_linear applies deltas pre-psum).
+
+    Reference capability: per-request LoRA on TP stages via SGLang
+    (shard_loader.py:114-227 + sglang_executor.py:249-334).
+    """
     import jax
     from jax import lax
 
-    return jax.tree.map(
+    sel = jax.tree.map(
         lambda a: lax.dynamic_index_in_dim(a, lora["slot"], 0,
                                            keepdims=False),
         lora["layers"],
     )
+    if axis_name is None or tp <= 1:
+        return sel
+    idx = lax.axis_index(axis_name)
+    out: dict[str, dict] = {}
+    for li, layer in sel.items():
+        out[li] = {}
+        for path, ab in layer.items():
+            proj = path.rsplit(".", 1)[-1]
+            ab = dict(ab)
+            if proj in _COL_PROJS:
+                b = ab["B"]
+                n_loc = b.shape[0] // tp
+                ab["B"] = lax.dynamic_slice_in_dim(b, idx * n_loc, n_loc, 0)
+            elif proj in _ROW_PROJS:
+                a = ab["A"]
+                n_loc = a.shape[1] // tp
+                ab["A"] = lax.dynamic_slice_in_dim(a, idx * n_loc, n_loc, 1)
+            out[li][path] = ab
+    return out
+
+
+def validate_tp_shardable(tree: dict, tp: int) -> None:
+    """Reject adapters whose targeted projections cannot shard ``tp``
+    ways (indivisible out dim on a column projection / in dim on a row
+    projection) — at registration, not mid-forward."""
+    if tp <= 1:
+        return
+    for li, layer_tree in tree.items():
+        for path, (a, b, _s) in layer_tree.items():
+            proj = path.rsplit(".", 1)[-1]
+            if proj in _COL_PROJS and b.shape[0] % tp:
+                raise ValueError(
+                    f"adapter layer {li} {path}: out dim {b.shape[0]} "
+                    f"not divisible by tp={tp}"
+                )
+            if proj in _ROW_PROJS and a.shape[1] % tp:
+                raise ValueError(
+                    f"adapter layer {li} {path}: in dim {a.shape[1]} "
+                    f"not divisible by tp={tp}"
+                )
 
 
 def merge_layer_lora(lp: dict, layer_sel: dict | None) -> dict:

@@ -598,6 +598,20 @@ class WorkerNode:
 
         tail = chain(self.end_layer)
         if tail is None:
+            # Diagnose the common operator error: layers are all hosted but
+            # block boundaries don't meet exactly (e.g. [0,14) + [10,28)).
+            # Stages are jit-compiled for their whole slice, so a route
+            # cannot enter a block mid-way — boundaries must match.
+            covered = set(range(self.start_layer, self.end_layer))
+            for start, blocks in by_start.items():
+                for _nid, end in blocks:
+                    covered.update(range(start, end))
+            if covered >= set(range(num_layers)):
+                logger.warning(
+                    "no route: every layer is hosted but block boundaries "
+                    "do not meet exactly (blocks chain only when one "
+                    "worker's --end-layer equals the next's --start-layer)"
+                )
             return None
         return [self.node_id] + tail
 

@@ -57,6 +57,8 @@ def make_cache_manager(
     enable_prefix_cache: bool = True,
     max_model_len: int = 32768,
     use_native: bool | None = None,
+    linear_state: bool = False,
+    on_slot_free=None,
 ):
     """CacheManager factory: the C++ manager (ONE ABI crossing per
     admit/grow/release — ``native.NativeCacheManager``) by default when
@@ -69,6 +71,17 @@ def make_cache_manager(
 
     if use_native is None:
         use_native = not os.environ.get("PARALLAX_TPU_NO_NATIVE")
+    if linear_state and enable_prefix_cache:
+        # Hybrid models need the linear-slot-aware radix walk (match
+        # truncation + snapshot attach); the C++ manager doesn't speak it,
+        # and the Python walk is not the bottleneck for these models.
+        # With prefix caching off the walk never runs, so such engines
+        # keep the native manager below.
+        return CacheManager(
+            page_size, num_pages, enable_prefix_cache=enable_prefix_cache,
+            max_model_len=max_model_len, linear_state=True,
+            on_slot_free=on_slot_free,
+        )
     if use_native:
         try:
             from parallax_tpu import native
@@ -124,13 +137,24 @@ class CacheManager:
         num_pages: int,
         enable_prefix_cache: bool = True,
         max_model_len: int = 32768,
+        linear_state: bool = False,
+        on_slot_free=None,
     ):
         self.page_size = page_size
         self.num_pages = num_pages
         self.max_model_len = max_model_len
         self.enable_prefix_cache = enable_prefix_cache
+        # Hybrid models: prefix hits additionally need a linear-state
+        # snapshot at the skip boundary (reference linear prefix slots,
+        # cache_manager.py:96-103,422-447); matches truncate to the deepest
+        # slot-carrying node and the snapshot's slot id is surfaced on the
+        # request as ``restore_state_from``.
+        self.linear_state = linear_state
+        self.on_slot_free = on_slot_free
         self.allocator = PageAllocator(num_pages)
-        self.prefix_cache = RadixPageCache(page_size)
+        self.prefix_cache = RadixPageCache(
+            page_size, on_evict_slot=on_slot_free
+        )
         # rid -> (locked node path, number of shared tree-owned pages)
         self._locked: dict[str, tuple] = {}
         # Per-adapter radix namespaces: KV depends on the LoRA adapter, so
@@ -172,6 +196,8 @@ class CacheManager:
         prompt_len = request.num_prompt_tokens
         shared_pages: list[int] = []
         path = []  # empty match path (both impls accept [] for lock/unlock)
+        if self.linear_state and hasattr(request, "restore_state_from"):
+            del request.restore_state_from  # stale from a failed admit
         if self.enable_prefix_cache and prompt_len > 1:
             pages, full_path = self.prefix_cache.match_prefix(
                 self._ns_tokens(request.prompt_ids, request.lora_id)
@@ -179,6 +205,21 @@ class CacheManager:
             # Always leave >=1 prompt token to recompute so the stage emits a
             # hidden state for sampling.
             usable = min(len(pages), (prompt_len - 1) // self.page_size)
+            if self.linear_state:
+                # Mirror stages must skip EXACTLY what the head skipped
+                # (rows before that never arrive); cap the walk there so a
+                # longer local match cannot put the recurrence state ahead
+                # of the rows about to be replayed.
+                head_cached = getattr(request, "mirror_head_cached", None)
+                if head_cached is not None:
+                    usable = min(usable, head_cached // self.page_size)
+                usable = self.prefix_cache.deepest_linear_slot(
+                    full_path, usable
+                )
+                if usable:
+                    request.restore_state_from = (  # type: ignore[attr-defined]
+                        full_path[usable - 1].linear_slot
+                    )
             shared_pages = pages[:usable]
             path = self.prefix_cache.slice_path(full_path, usable)
 
@@ -228,8 +269,19 @@ class CacheManager:
         """
         path, num_shared = self._locked.pop(request.request_id, ([], 0))
         self.prefix_cache.unlock(path)
+        # Hybrid models: the engine snapshotted conv/recurrent state into a
+        # dedicated slot at a page-aligned prefill boundary; attach it to
+        # the radix node at exactly that boundary so future prefix hits can
+        # resume the recurrence there. Unattachable (aborted request, node
+        # missing, boundary already covered) -> the slot goes back to the
+        # engine's pool via on_slot_free.
+        snapshot = getattr(request, "state_snapshot", None)
+        if snapshot is not None:
+            del request.state_snapshot
         owned = request.page_ids[num_shared:]
         if not owned:
+            if snapshot is not None and self.on_slot_free:
+                self.on_slot_free(snapshot[1])
             request.page_ids = []
             return
         if self.enable_prefix_cache and request.status.value != "finished_abort":
@@ -249,7 +301,22 @@ class CacheManager:
             tail = owned[max(0, n_full - num_shared):]
             duplicates = self.prefix_cache.insert(tokens, request.page_ids[:n_full])
             self.allocator.free(duplicates + tail)
+            if snapshot is not None:
+                length, slot = snapshot
+                attached = (
+                    length <= n_full * self.page_size
+                    and self.prefix_cache.attach_linear_slot(
+                        self._ns_tokens(
+                            request.all_token_ids[:length], request.lora_id
+                        ),
+                        slot,
+                    )
+                )
+                if not attached and self.on_slot_free:
+                    self.on_slot_free(slot)
         else:
+            if snapshot is not None and self.on_slot_free:
+                self.on_slot_free(snapshot[1])
             self.allocator.free(owned)
         request.page_ids = []
 

@@ -44,6 +44,13 @@ class EngineConfig:
     prefill_chunk_size: int = 1024
     max_model_len: int = 8192
     enable_prefix_cache: bool = True
+    # Hybrid (linear-attention) models: device slots reserved for
+    # conv/recurrent state snapshots attached to prefix-cache nodes
+    # (reference linear prefix slots, cache_manager.py:96-103). Each
+    # finished prefill snapshots its state at the last page-aligned
+    # prompt boundary so later requests sharing the prefix can resume
+    # the recurrence there. 0 disables prefix caching for hybrids.
+    linear_prefix_slots: int = 32
     kv_dtype: str = "bfloat16"
     seed: int = 0
     request_timeout_s: float = 600.0
@@ -178,10 +185,19 @@ class StageEngine:
         kv_dtype = jnp.bfloat16 if self.cfg.kv_dtype == "bfloat16" else jnp.float32
         # Hybrid (linear-attention) models carry per-request state slots.
         self._needs_state = bool(getattr(model, "has_linear_layers", False))
+        # Prefix caching for hybrids rides on snapshot slots appended after
+        # the active slots: null(0) | active [1, 2B] | prefix (2B, 2B+P].
+        n_prefix_slots = (
+            self.cfg.linear_prefix_slots
+            if self._needs_state and self.cfg.enable_prefix_cache else 0
+        )
+        num_state_slots = self.cfg.max_batch_size * 2 + n_prefix_slots
         if self._needs_state:
             from parallax_tpu.runtime.allocator import SlotAllocator
 
             self._slot_alloc = SlotAllocator(self.cfg.max_batch_size * 2)
+            self._prefix_slot_base = self.cfg.max_batch_size * 2 + 1
+            self._prefix_slot_alloc = SlotAllocator(n_prefix_slots)
         if mesh is not None and model.tp_size > 1:
             # Allocate the cache directly in its sharded layout — a
             # materialize-then-reshard would spike one chip's HBM with the
@@ -198,7 +214,7 @@ class StageEngine:
                 is_leaf=lambda x: isinstance(x, PartitionSpec),
             )
             state_kw = (
-                {"num_state_slots": self.cfg.max_batch_size * 2}
+                {"num_state_slots": num_state_slots}
                 if self._needs_state else {}
             )
             self.kv = jax.jit(
@@ -211,26 +227,43 @@ class StageEngine:
         elif self._needs_state:
             self.kv = model.new_kv_caches(
                 self.cfg.num_pages, self.cfg.page_size, kv_dtype,
-                num_state_slots=self.cfg.max_batch_size * 2,
+                num_state_slots=num_state_slots,
             )
         else:
             self.kv = model.new_kv_caches(
                 self.cfg.num_pages, self.cfg.page_size, kv_dtype
             )
-        # Linear-attention state is not prefix-restorable yet, so prefix
-        # caching is off for hybrid models — gated on the WHOLE model (any
-        # linear layer in the config), not this stage's slice: stages of one
-        # pipeline must agree or their token accounting desynchronizes.
-        hybrid_model = model.config.linear_attn is not None
+        # Stages with local linear layers prefix-cache through linear-state
+        # snapshots: the cache manager's radix walk truncates matches to
+        # slot-carrying nodes and the engine restores/copies state on
+        # device (reference linear prefix slots, cache_manager.py:96-103).
+        # Attention-only NON-HEAD stages of a hybrid model match on pages
+        # alone; the mirror clamp in admit_requests keeps every stage's
+        # skip equal to the head's, so mixed-slice pipelines stay aligned.
+        # An attention-only HEAD of a hybrid model must not skip at all:
+        # it would pick pages-only boundaries the downstream linear
+        # stages can never resume from (no snapshot there), turning every
+        # repeat prompt into a deterministic downstream abort.
         from parallax_tpu.runtime.cache_manager import make_cache_manager
 
+        hybrid_attention_only_head = (
+            model.config.linear_attn is not None
+            and model.is_first and not self._needs_state
+            and not model.is_last
+        )
         self.cache = make_cache_manager(
             self.cfg.page_size,
             self.cfg.num_pages,
             enable_prefix_cache=(
-                self.cfg.enable_prefix_cache and not hybrid_model
+                self.cfg.enable_prefix_cache
+                and (not self._needs_state or n_prefix_slots > 0)
+                and not hybrid_attention_only_head
             ),
             max_model_len=self.cfg.max_model_len,
+            linear_state=self._needs_state,
+            on_slot_free=(
+                self._on_prefix_slot_free if self._needs_state else None
+            ),
         )
         self.scheduler = Scheduler(
             self.cache,
@@ -239,6 +272,11 @@ class StageEngine:
             prefill_chunk_size=self.cfg.prefill_chunk_size,
             request_timeout_s=self.cfg.request_timeout_s,
             is_first_stage=model.is_first,
+            snapshot_page_align=(
+                self.cfg.page_size
+                if self._needs_state and self.cache.enable_prefix_cache
+                else None
+            ),
         )
         self.spec = BucketSpec.build(
             self.cfg.max_num_tokens_per_batch,
@@ -257,6 +295,31 @@ class StageEngine:
             )
             stage_fn = _tp.tp_stage_fn(model, params, mesh)
         self._jit_step = jax.jit(stage_fn, donate_argnums=(1,))
+        if self._needs_state:
+            from parallax_tpu.config import LAYER_LINEAR
+
+            is_lin = [
+                model.config.layer_type(i) == LAYER_LINEAR
+                for i in range(model.start_layer, model.end_layer)
+            ]
+
+            def _copy_state_fn(kv, src, dst):
+                # Copy one request's conv/recurrent state between slots
+                # (snapshot at a prefill boundary / restore on a prefix
+                # hit). One compile serves every (src, dst) pair; paged KV
+                # passes through untouched under donation.
+                out = []
+                for lin, cache in zip(is_lin, kv):
+                    if lin:
+                        conv, rec = cache
+                        cache = (conv.at[dst].set(conv[src]),
+                                 rec.at[dst].set(rec[src]))
+                    out.append(cache)
+                return out
+
+            self._jit_copy_state = jax.jit(
+                _copy_state_fn, donate_argnums=(0,)
+            )
         # Sequence-parallel long-prefill path: its own jit (traced with the
         # model's SP flag up) and its own bucket lattice — token buckets are
         # sp-multiples so the ring shards evenly, one sequence per step.
@@ -387,12 +450,11 @@ class StageEngine:
         delta applied in-graph (reference per-request ``lora_path``,
         forward.proto + shard_loader.py:114-227).
         """
-        if self.model.tp_size > 1:
-            raise ValueError(
-                "per-request LoRA is not supported on TP-sharded stages; "
-                "merge offline with `cli lora-merge`"
-            )
-        from parallax_tpu.ops.lora import AdapterSet, adapter_tree_from_peft
+        from parallax_tpu.ops.lora import (
+            AdapterSet,
+            adapter_tree_from_peft,
+            validate_tp_shardable,
+        )
 
         if self._adapters is None:
             self._adapters = AdapterSet()
@@ -401,6 +463,10 @@ class StageEngine:
             tree = adapter_tree_from_peft(
                 source, self.model.start_layer, self.model.end_layer
             )
+        # TP stages shard the delta inside the shard_map (select_slot);
+        # refuse adapters whose dims cannot split rather than failing at
+        # trace time mid-request.
+        validate_tp_shardable(tree, self.model.tp_size)
         self._adapters.register(name, tree)
 
     def has_adapter(self, name: str) -> bool:
@@ -485,10 +551,13 @@ class StageEngine:
                 lora_id=ireq.lora_id,
             )
             req.is_mirror = True  # type: ignore[attr-defined]
+            # This stage MUST start computing at exactly this offset — rows
+            # before it never arrive, rows after it do. Set even when the
+            # head skipped nothing: a LOCAL prefix hit the head didn't have
+            # (asymmetric eviction) would otherwise silently misalign the
+            # hidden-row stream against this stage's chunk starts.
+            req.mirror_head_cached = len(prefix)  # type: ignore[attr-defined]
             if prefix:
-                # This stage MUST start computing at exactly this offset —
-                # rows before it never arrive, rows after it do.
-                req.mirror_head_cached = len(prefix)  # type: ignore[attr-defined]
                 req.mirror_prefix_ids = prefix  # type: ignore[attr-defined]
             self.scheduler.enqueue(req)
         else:
@@ -846,9 +915,10 @@ class StageEngine:
         return True
 
     def _greedy_fast_path_ok(self, plan: BatchPlan) -> bool:
-        """The speculative paths additionally require pure greedy decode
-        (acceptance compares argmaxes; a sampled row has no single right
-        answer to verify against)."""
+        """Pure greedy decode: acceptance can compare argmaxes (used by
+        the pipeline-speculative path, whose last-stage verifier is
+        greedy). The single-stage speculative path no longer needs this —
+        sampled rows verify in lockstep (see _try_speculative)."""
         if not self._fused_common_ok(plan):
             return False
         for seg in plan.seqs:
@@ -880,20 +950,32 @@ class StageEngine:
         return []
 
     def _try_speculative(self, plan: BatchPlan) -> int | None:
-        """Greedy speculative decode: extend each decode row with its
-        n-gram proposal, verify all positions in one forward, commit the
-        longest agreeing prefix plus the bonus token. Returns the commit
-        count, or None to use another path.
+        """Speculative decode: extend each decode row with its proposal,
+        verify all positions in one forward, commit the longest agreeing
+        prefix plus the bonus token. Returns the commit count, or None to
+        use another path.
 
-        Exactness: position ``j``'s argmax depends only on tokens before
-        it, which match the true greedy stream up to the first proposal
-        mismatch — everything committed is exactly what single-step greedy
-        would have produced. KV written for rejected suffixes lies past
-        the committed context and is overwritten position-by-position by
-        later steps.
+        Exactness (greedy rows): position ``j``'s argmax depends only on
+        tokens before it, which match the true greedy stream up to the
+        first proposal mismatch — everything committed is exactly what
+        single-step greedy would have produced.
+
+        Exactness (sampled rows): verification samples each position from
+        the TARGET distribution under the engine's deterministic key
+        discipline (seeded rows: ``fold_in(key(seed), output_step)`` —
+        the same stream the per-step and fused-multistep paths draw), and
+        accepts while the proposal agrees with the *sampled* token. The
+        committed tokens are therefore bitwise the tokens sequential
+        sampling would have produced: speculation changes wall-clock,
+        never the distribution (and for seeded rows, not even the draw).
+        The reference has no sampled speculation; its executor is
+        per-token (base_executor.py:634-769).
+
+        KV written for rejected suffixes lies past the committed context
+        and is overwritten position-by-position by later steps.
         """
         k = self.cfg.speculative_tokens
-        if k <= 0 or not self._greedy_fast_path_ok(plan):
+        if k <= 0 or not self._fused_common_ok(plan):
             return None
 
         # Each row feeds >= 1 token; proposals must also fit the batch
@@ -952,16 +1034,56 @@ class StageEngine:
         if lora is not None:
             inputs = dataclasses.replace(inputs, lora=lora)
         logits, self.kv = self._jit_step(self.params, self.kv, inputs)
-        from parallax_tpu.ops.sampling import greedy_tokens
+        from parallax_tpu.ops.sampling import greedy_tokens, sample_tokens
 
-        greedy = np.asarray(greedy_tokens(logits))      # [T_bucket]
+        all_greedy = all(
+            seg.request.sampling_params.temperature <= 0.0
+            and seg.request.sampling_params.seed is None
+            for seg in plan.seqs
+        )
+        if all_greedy:
+            verified = np.asarray(greedy_tokens(logits))    # [T_bucket]
+        else:
+            # Lockstep sampled verification: every fed position draws from
+            # the TARGET distribution with the row's params and the SAME
+            # per-output-index key a sequential decode would use. Padded
+            # positions keep temp=0 (argmax, discarded).
+            t_bucket = int(logits.shape[0])
+            temp = np.zeros((t_bucket,), np.float32)
+            top_k = np.zeros((t_bucket,), np.int32)
+            top_p = np.ones((t_bucket,), np.float32)
+            min_p = np.zeros((t_bucket,), np.float32)
+            seeds = np.full((t_bucket,), -1, np.int32)
+            steps = np.zeros((t_bucket,), np.int32)
+            row = 0
+            for seg in spec_segs:
+                n_fed = seg.num_new_tokens
+                (t_i, k_i, p_i, m_i, seed_i, origin) = (
+                    self._row_sampling_fields(seg.request)
+                )
+                temp[row : row + n_fed] = t_i
+                top_k[row : row + n_fed] = k_i
+                top_p[row : row + n_fed] = p_i
+                min_p[row : row + n_fed] = m_i
+                if seed_i >= 0:
+                    seeds[row : row + n_fed] = seed_i
+                    # Position j emits output index ``origin + j`` — the
+                    # same fold_in origin as every other sampler path.
+                    steps[row : row + n_fed] = origin + np.arange(n_fed)
+                row += n_fed
+            key = jax.random.fold_in(self._base_key, self._step_count)
+            verified = np.asarray(sample_tokens(
+                logits, key, jnp.asarray(temp), jnp.asarray(top_k),
+                jnp.asarray(top_p), jnp.asarray(min_p),
+                seeds=jnp.asarray(seeds), out_steps=jnp.asarray(steps),
+            ))
 
         total = 0
         row = 0
         for seg, prop in zip(spec_segs, proposals):
             req = seg.request
             n_fed = seg.num_new_tokens
-            g = greedy[row : row + n_fed]
+            g = verified[row : row + n_fed]
             row += n_fed
             committed = 0
             for j in range(n_fed):
@@ -970,7 +1092,7 @@ class StageEngine:
                 req.commit_token(int(g[j]))
                 committed += 1
                 # Keep accepting while the next fed token agrees with what
-                # greedy just produced (the proposal position j).
+                # verification just produced at this position.
                 if j < len(prop) and prop[j] != int(g[j]):
                     break
             req.num_computed_tokens += committed
@@ -1143,6 +1265,17 @@ class StageEngine:
                 if not hasattr(seg.request, "state_slot"):
                     # slot 0 is the null slot; real slots start at 1.
                     seg.request.state_slot = self._slot_alloc.alloc() + 1
+                    # Prefix hit: resume the recurrence from the tree's
+                    # snapshot instead of zero state (the row's first
+                    # chunk starts at num_cached_tokens, so assemble's
+                    # reset flag stays 0 and the copied state stands).
+                    src = getattr(seg.request, "restore_state_from", None)
+                    if src is not None:
+                        self.kv = self._jit_copy_state(
+                            self.kv, jnp.int32(src),
+                            jnp.int32(seg.request.state_slot),
+                        )
+                        del seg.request.restore_state_from
         # Last stage of a multi-stage pipeline: rows carrying unverified
         # speculative tokens are greedy-verified against logits at EVERY
         # fed position (one forward verifies the whole proposal).
@@ -1182,6 +1315,8 @@ class StageEngine:
         # (single-stage ring closure) must not be clobbered by the
         # prefill-progress bookkeeping.
         self.scheduler.on_batch_computed(plan)
+        if self._needs_state and self.cache.enable_prefix_cache:
+            self._maybe_snapshot_state(plan)
 
         forwards: list[IntermediateRequest] = []
         if self.model.is_last and spec_rows:
@@ -1291,13 +1426,24 @@ class StageEngine:
             self._pending_hidden.pop(rid)
         return take
 
+    @classmethod
+    def _row_sampling_fields(cls, req: Request):
+        """THE single packing convention for one row's sampler fields
+        (incl. the 31-bit seed mask and the output-step origin). Every
+        sampler-feeding path — per-step, fused multistep, speculative
+        verification — must go through this, or the cross-path
+        seeded-exactness guarantee silently breaks.
+        Returns (temp, top_k, top_p, min_p, seed_or_-1, step_origin)."""
+        sp = req.sampling_params
+        seed = sp.seed & 0x7FFFFFFF if sp.seed is not None else -1
+        return (sp.temperature, sp.top_k, sp.top_p, sp.min_p, seed,
+                len(cls._generated_ids(req)))
+
     def _pack_base_sampling(self, plan: BatchPlan, s: int):
         """Per-row base sampling vectors shared by the fused decode window
-        and the per-step sampler. ONE packing convention (incl. the seed
-        mask and the seeded-row output-step origin) — the two paths must
-        never desynchronize or the cross-path seeded-exactness guarantee
-        breaks. Returns (temp, top_k, top_p, min_p, seeds, steps,
-        any_seed); ``steps`` is meaningful only for seeded rows."""
+        and the per-step sampler (one _row_sampling_fields call per row).
+        Returns (temp, top_k, top_p, min_p, seeds, steps, any_seed);
+        ``steps`` is meaningful only for seeded rows."""
         temp = np.zeros((s,), np.float32)
         top_k = np.zeros((s,), np.int32)
         top_p = np.ones((s,), np.float32)
@@ -1306,15 +1452,11 @@ class StageEngine:
         steps = np.zeros((s,), np.int32)
         any_seed = False
         for i, seg in enumerate(plan.seqs):
-            sp = seg.request.sampling_params
-            temp[i] = sp.temperature
-            top_k[i] = sp.top_k
-            top_p[i] = sp.top_p
-            min_p[i] = sp.min_p
-            if sp.seed is not None:
+            (temp[i], top_k[i], top_p[i], min_p[i], seeds[i],
+             origin) = self._row_sampling_fields(seg.request)
+            if seeds[i] >= 0:
                 any_seed = True
-                seeds[i] = sp.seed & 0x7FFFFFFF
-                steps[i] = len(self._generated_ids(seg.request))
+                steps[i] = origin
         return temp, top_k, top_p, min_p, seeds, steps, any_seed
 
     @staticmethod
@@ -1592,6 +1734,60 @@ class StageEngine:
         if self._needs_state and hasattr(req, "state_slot"):
             self._slot_alloc.free(req.state_slot - 1)
             del req.state_slot
+
+    def _on_prefix_slot_free(self, slot: int) -> None:
+        """The radix cache evicted (or could not attach) a snapshot slot."""
+        self._prefix_slot_alloc.free(slot - self._prefix_slot_base)
+
+    def _maybe_snapshot_state(self, plan: BatchPlan) -> None:
+        """Snapshot conv/recurrent state at page-aligned prefill boundaries.
+
+        Runs right after a forward: any prefilling row whose computed
+        length just landed on a page boundary copies its state into a
+        dedicated snapshot slot (overwriting its own earlier, shallower
+        snapshot — one slot per in-flight request). The deepest snapshot is
+        attached to the radix node at that exact boundary on release, so a
+        later request sharing the prefix resumes the recurrence there.
+        The scheduler splits the final prefill chunk at the last aligned
+        boundary (snapshot_page_align), so nearly the whole prompt is
+        reusable. Reference: linear prefix slots attached after prefill,
+        cache_manager.py:704-791 + mlx_executor.py:497.
+        """
+        from parallax_tpu.runtime.allocator import OutOfPages
+
+        page = self.cfg.page_size
+        for seg in plan.seqs:
+            req = seg.request
+            c = req.num_computed_tokens
+            # The deepest boundary a future match can use: a hit always
+            # leaves >= 1 prompt token to recompute, so for page-aligned
+            # prompts the last page is never matchable (also excludes
+            # decode rows: c past this limit snapshots nothing).
+            usable = ((req.num_prompt_tokens - 1) // page) * page
+            if (
+                c % page
+                or c <= req.num_cached_tokens   # tree already covers this
+                or c > usable
+                or not hasattr(req, "state_slot")
+            ):
+                continue
+            snap = getattr(req, "state_snapshot", None)
+            if snap is None:
+                try:
+                    slot = self._prefix_slot_base + self._prefix_slot_alloc.alloc()
+                except OutOfPages:
+                    # Steal the LRU snapshot already in the tree; if none
+                    # is reclaimable every slot belongs to an in-flight
+                    # request — skip, the request simply won't donate one.
+                    slot = self.cache.prefix_cache.detach_lru_linear_slot()
+                    if slot is None:
+                        continue
+            else:
+                slot = snap[1]
+            self.kv = self._jit_copy_state(
+                self.kv, jnp.int32(req.state_slot), jnp.int32(slot)
+            )
+            req.state_snapshot = (c, slot)  # type: ignore[attr-defined]
 
     def _record_latency(self, plan: BatchPlan, ms: float) -> None:
         if plan.has_prefill or plan.is_empty:

@@ -5,6 +5,13 @@ Capability parity: reference ``src/parallax/server/block_radix_cache.py:14-333``
 ids; matching walks full-page keys, insertion reuses existing device pages,
 and eviction walks LRU leaves with a pin refcount protecting in-flight
 requests. Device KV never moves: the cache only shares page ids.
+
+Hybrid (linear-attention) models additionally attach a *linear state slot*
+to a node: a device snapshot of the conv/recurrent state taken at exactly
+that node's token boundary (reference linear-aware BlockRadixCache:
+``has_linear_cache`` + per-node ``linear_slot``). A hybrid prefix match is
+only usable up to the deepest slot-carrying node — the recurrence cannot
+resume from pages alone.
 """
 
 from __future__ import annotations
@@ -14,7 +21,8 @@ from typing import Callable
 
 
 class _Node:
-    __slots__ = ("key", "page_id", "children", "parent", "lock_ref", "last_access")
+    __slots__ = ("key", "page_id", "children", "parent", "lock_ref",
+                 "last_access", "linear_slot")
 
     def __init__(self, key: tuple[int, ...], page_id: int, parent: "_Node | None"):
         self.key = key                      # the page's token ids
@@ -23,14 +31,19 @@ class _Node:
         self.parent = parent
         self.lock_ref = 0
         self.last_access = time.monotonic()
+        # Linear-state snapshot at this node's token boundary (hybrid
+        # models only; None = pages-only node).
+        self.linear_slot: int | None = None
 
 
 class RadixPageCache:
     """Prefix cache over full KV pages."""
 
-    def __init__(self, page_size: int, on_evict: Callable[[int], None] | None = None):
+    def __init__(self, page_size: int, on_evict: Callable[[int], None] | None = None,
+                 on_evict_slot: Callable[[int], None] | None = None):
         self.page_size = page_size
         self.on_evict = on_evict
+        self.on_evict_slot = on_evict_slot
         self._root = _Node((), -1, None)
         self._num_pages = 0
 
@@ -65,6 +78,54 @@ class RadixPageCache:
     def slice_path(path, n: int):
         """First ``n`` pages of a match path (impl-specific handle)."""
         return path[:n]
+
+    @staticmethod
+    def deepest_linear_slot(path: list[_Node], max_pages: int) -> int:
+        """Pages usable by a hybrid match: depth of the deepest node within
+        ``path[:max_pages]`` carrying a linear-state snapshot (0 = none).
+        The recurrence must resume from a snapshot taken at exactly the
+        skip boundary, so slotless tail nodes contribute nothing."""
+        for i in range(min(len(path), max_pages) - 1, -1, -1):
+            if path[i].linear_slot is not None:
+                return i + 1
+        return 0
+
+    # -- linear-state snapshots -------------------------------------------
+
+    def attach_linear_slot(self, token_ids: list[int], slot: int) -> bool:
+        """Attach state snapshot ``slot`` to the node covering exactly
+        ``token_ids`` (a whole number of pages). Returns False — caller
+        keeps ownership of the slot — when the node does not exist or
+        already carries a snapshot."""
+        if not token_ids or len(token_ids) % self.page_size:
+            return False
+        node = self._root
+        for start in range(0, len(token_ids), self.page_size):
+            node = node.children.get(
+                tuple(token_ids[start : start + self.page_size])
+            )
+            if node is None:
+                return False
+        if node.linear_slot is not None:
+            return False
+        node.linear_slot = slot
+        return True
+
+    def detach_lru_linear_slot(self) -> int | None:
+        """Reclaim the least-recently-used unpinned snapshot slot (the node
+        keeps its pages). Returns the freed slot id, or None."""
+        best: _Node | None = None
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            if n.linear_slot is not None and n.lock_ref <= 0:
+                if best is None or n.last_access < best.last_access:
+                    best = n
+        if best is None:
+            return None
+        slot, best.linear_slot = best.linear_slot, None
+        return slot
 
     def lock(self, path: list[_Node]) -> None:
         """Pin matched nodes so eviction cannot free their pages mid-request."""
@@ -120,6 +181,8 @@ class RadixPageCache:
             freed.append(leaf.page_id)
             if self.on_evict:
                 self.on_evict(leaf.page_id)
+            if leaf.linear_slot is not None and self.on_evict_slot:
+                self.on_evict_slot(leaf.linear_slot)
         return freed
 
     def _lru_unpinned_leaf(self) -> _Node | None:
@@ -141,6 +204,8 @@ class RadixPageCache:
         while stack:
             n = stack.pop()
             pages.append(n.page_id)
+            if n.linear_slot is not None and self.on_evict_slot:
+                self.on_evict_slot(n.linear_slot)
             stack.extend(n.children.values())
         self._root = _Node((), -1, None)
         self._num_pages = 0

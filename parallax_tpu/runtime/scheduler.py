@@ -66,6 +66,7 @@ class Scheduler:
         max_queue_size: int = 1024,
         request_timeout_s: float = 600.0,
         is_first_stage: bool = True,
+        snapshot_page_align: int | None = None,
     ):
         self.cache = cache_manager
         self.max_batch_size = max_batch_size
@@ -74,6 +75,10 @@ class Scheduler:
         self.max_queue_size = max_queue_size
         self.request_timeout_s = request_timeout_s
         self.is_first_stage = is_first_stage
+        # Hybrid prefix snapshots: split the final prefill chunk at the
+        # last boundary aligned to this many tokens, so the engine can
+        # snapshot linear state covering (almost) the whole prompt.
+        self.snapshot_page_align = snapshot_page_align
         self.wait_queue: OrderedDict[str, Request] = OrderedDict()
         self.running: OrderedDict[str, Request] = OrderedDict()
         # Round-robin cursor over adapter groups (see form_batch).
@@ -222,6 +227,17 @@ class Scheduler:
             if n < remaining and n < self.cache.page_size:
                 break  # not worth a degenerate chunk; wait for budget
             start = req.num_computed_tokens
+            if self.snapshot_page_align and start + n >= req.num_prompt_tokens:
+                # End the penultimate chunk exactly at the last USABLE
+                # aligned prompt boundary (the linear-state snapshot
+                # point); the ragged remainder becomes one more small
+                # chunk. "(prompt_len - 1)": a prefix hit always leaves
+                # >= 1 token to recompute, so a snapshot at the full
+                # (aligned) prompt length could never be matched.
+                a = ((req.num_prompt_tokens - 1) // self.snapshot_page_align
+                     ) * self.snapshot_page_align
+                if start < a < start + n:
+                    n = a - start
             # Mirror requests grow their prompt incrementally (chunks arrive
             # over the wire), so page capacity may lag the prompt length.
             if not self.cache.ensure_capacity(req, start + n):

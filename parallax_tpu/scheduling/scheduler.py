@@ -248,6 +248,13 @@ class GlobalScheduler:
             node = self.manager.get(node_id)
             if node is None or node_id in members:
                 continue
+            # Trimming changes the allocation, which the next heartbeat
+            # turns into an engine reload aborting that replica's in-flight
+            # requests — only act on evidence, never on roofline defaults:
+            # the node must have reported a measured layer latency and be
+            # idle right now.
+            if node.measured_layer_latency_ms is None or node.load > 0:
+                continue
             if kind == "tail" and node.start_layer < layer < node.end_layer:
                 logger.info(
                     "turning-point trim: %s tail [%d, %d) -> [%d, %d)",

@@ -1,0 +1,291 @@
+"""Linear-state prefix reuse for hybrid (linear-attention) models.
+
+Capability parity: reference linear prefix slots — dedicated snapshot
+slots budgeted next to the active state slots, attached to radix nodes,
+copied into a request's slot on a prefix hit
+(``src/parallax/server/cache_manager.py:96-103,422-447``, tested by
+``tests/test_mlx_linear_prefix_cache.py``). TPU re-design: snapshots are
+taken at page-aligned prefill chunk boundaries (the scheduler splits the
+final chunk at the last aligned prompt boundary), the copy is one jitted
+scatter over the donated state arrays, and the radix walk truncates hybrid
+matches to the deepest slot-carrying node.
+"""
+
+import jax
+import pytest
+
+from parallax_tpu.config import normalize_config
+from parallax_tpu.models.registry import create_stage_model
+from parallax_tpu.runtime.cache_manager import CacheManager
+from parallax_tpu.runtime.engine import EngineConfig, StageEngine
+from parallax_tpu.runtime.pipeline import InProcessPipeline
+from parallax_tpu.runtime.radix_cache import RadixPageCache
+from parallax_tpu.runtime.request import Request, SamplingParams
+
+TINY = dict(
+    architectures=["Qwen3NextForCausalLM"],
+    hidden_size=64,
+    num_hidden_layers=4,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    head_dim=16,
+    intermediate_size=96,
+    moe_intermediate_size=32,
+    num_experts=4,
+    num_experts_per_tok=2,
+    shared_expert_intermediate_size=32,
+    decoder_sparse_step=1,
+    mlp_only_layers=[],
+    norm_topk_prob=True,
+    layer_types=["linear_attention", "full_attention",
+                 "linear_attention", "full_attention"],
+    linear_conv_kernel_dim=4,
+    linear_num_key_heads=2,
+    linear_num_value_heads=4,
+    linear_key_head_dim=16,
+    linear_value_head_dim=16,
+    partial_rotary_factor=0.25,
+    vocab_size=199,
+    max_position_embeddings=512,
+    rms_norm_eps=1e-6,
+    rope_theta=10000.0,
+    tie_word_embeddings=False,
+    attention_bias=False,
+)
+CONFIG = normalize_config(TINY)
+PAGE = 8
+
+
+# -- radix-level slot semantics ---------------------------------------------
+
+
+def test_radix_attach_and_match_truncation():
+    cache = RadixPageCache(page_size=2)
+    cache.insert([1, 2, 3, 4, 5, 6], [10, 11, 12])
+
+    pages, path = cache.match_prefix([1, 2, 3, 4, 5, 6, 7])
+    assert pages == [10, 11, 12]
+    # No snapshots anywhere: a hybrid match is unusable at any depth.
+    assert cache.deepest_linear_slot(path, 3) == 0
+
+    assert cache.attach_linear_slot([1, 2, 3, 4], slot=77)
+    assert cache.deepest_linear_slot(path, 3) == 2      # ends at the slot
+    assert path[1].linear_slot == 77
+    # max_pages caps the walk below the slot depth.
+    assert cache.deepest_linear_slot(path, 1) == 0
+
+
+def test_radix_attach_rejects_missing_or_taken_node():
+    cache = RadixPageCache(page_size=2)
+    cache.insert([1, 2, 3, 4], [10, 11])
+    assert not cache.attach_linear_slot([9, 9], slot=5)      # no such node
+    assert not cache.attach_linear_slot([1, 2, 3], slot=5)   # ragged length
+    assert cache.attach_linear_slot([1, 2], slot=5)
+    assert not cache.attach_linear_slot([1, 2], slot=6)      # already taken
+
+
+def test_radix_eviction_frees_attached_slot():
+    freed = []
+    cache = RadixPageCache(page_size=2, on_evict_slot=freed.append)
+    cache.insert([1, 2, 3, 4], [10, 11])
+    cache.attach_linear_slot([1, 2, 3, 4], slot=9)
+    cache.evict(1)   # LRU leaf = the slot-carrying node
+    assert freed == [9]
+    cache.reset()
+    assert freed == [9]  # no double free
+
+    cache.insert([5, 6], [20])
+    cache.attach_linear_slot([5, 6], slot=4)
+    cache.reset()
+    assert freed == [9, 4]
+
+
+def test_radix_detach_lru_skips_pinned():
+    cache = RadixPageCache(page_size=2)
+    cache.insert([1, 2], [10])
+    cache.insert([3, 4], [11])
+    cache.attach_linear_slot([1, 2], slot=7)
+    cache.attach_linear_slot([3, 4], slot=8)
+    _, path = cache.match_prefix([1, 2])
+    cache.lock(path)
+    assert cache.detach_lru_linear_slot() == 8   # 7 is pinned
+    assert cache.detach_lru_linear_slot() is None
+    cache.unlock(path)
+    assert cache.detach_lru_linear_slot() == 7
+
+
+# -- cache-manager-level matching -------------------------------------------
+
+
+def test_hybrid_match_requires_snapshot_and_restores_slot():
+    cm = CacheManager(page_size=2, num_pages=16, linear_state=True)
+    donor = Request("d", prompt_ids=[1, 2, 3, 4, 5],
+                    sampling_params=SamplingParams(max_new_tokens=1))
+    assert cm.allocate_for_prompt(donor)
+    donor.num_computed_tokens = 5
+    donor.state_snapshot = (4, 99)
+    from parallax_tpu.runtime.request import RequestStatus
+
+    donor.status = RequestStatus.FINISHED_LENGTH
+    cm.release(donor)
+
+    hit = Request("h", prompt_ids=[1, 2, 3, 4, 5, 6],
+                  sampling_params=SamplingParams(max_new_tokens=1))
+    assert cm.allocate_for_prompt(hit)
+    assert hit.num_cached_tokens == 4
+    assert hit.restore_state_from == 99
+
+    # Without a snapshot in the tree the same match yields nothing.
+    cm2 = CacheManager(page_size=2, num_pages=16, linear_state=True)
+    d2 = Request("d2", prompt_ids=[1, 2, 3, 4, 5],
+                 sampling_params=SamplingParams(max_new_tokens=1))
+    assert cm2.allocate_for_prompt(d2)
+    d2.num_computed_tokens = 5
+    d2.status = RequestStatus.FINISHED_LENGTH
+    cm2.release(d2)
+    h2 = Request("h2", prompt_ids=[1, 2, 3, 4, 5, 6],
+                 sampling_params=SamplingParams(max_new_tokens=1))
+    assert cm2.allocate_for_prompt(h2)
+    assert h2.num_cached_tokens == 0
+    assert not hasattr(h2, "restore_state_from")
+
+
+def test_unattachable_snapshot_slot_returns_to_pool():
+    freed = []
+    cm = CacheManager(page_size=2, num_pages=16, linear_state=True,
+                      on_slot_free=freed.append)
+    from parallax_tpu.runtime.request import RequestStatus
+
+    req = Request("a", prompt_ids=[1, 2, 3],
+                  sampling_params=SamplingParams(max_new_tokens=1))
+    assert cm.allocate_for_prompt(req)
+    req.num_computed_tokens = 3
+    req.state_snapshot = (2, 42)
+    req.abort("test")    # aborted requests never donate
+    cm.release(req)
+    assert freed == [42]
+
+
+# -- end-to-end: identical tokens with and without reuse ---------------------
+
+
+def _engine(prefix: bool, stages=None, **cfg_kw) -> list[StageEngine]:
+    engines = []
+    for s, e in (stages or [(0, 4)]):
+        m = create_stage_model(CONFIG, s, e, use_pallas=False)
+        engines.append(StageEngine(
+            m, m.init_params(jax.random.key(0), dtype=jax.numpy.float32),
+            EngineConfig(page_size=PAGE, num_pages=64, max_model_len=256,
+                         kv_dtype="float32", enable_prefix_cache=prefix,
+                         prefill_chunk_size=16, **cfg_kw),
+        ))
+    return engines
+
+
+def _run(engines, rid, ids, n=6):
+    r = Request(rid, prompt_ids=list(ids),
+                sampling_params=SamplingParams(temperature=0.0,
+                                               max_new_tokens=n))
+    p = InProcessPipeline(engines)
+    p.submit(r)
+    p.run_until_complete()
+    return r
+
+
+BASE = list(range(1, 42))           # 41 tokens; aligned floor = 40
+SUFFIX = [50, 51, 52, 53, 54, 55, 56]
+
+
+def test_hybrid_prefix_reuse_exact_match_single_stage():
+    oracle = _engine(prefix=False)
+    o1 = _run(oracle, "o1", BASE)
+    o2 = _run(oracle, "o2", BASE + SUFFIX)
+
+    eng = _engine(prefix=True)
+    r1 = _run(eng, "r1", BASE)
+    assert r1.output_ids == o1.output_ids
+    assert eng[0].cache.prefix_cache.num_cached_pages > 0
+
+    r2 = _run(eng, "r2", BASE + SUFFIX)
+    assert r2.num_cached_tokens == 40    # the snapshot boundary
+    assert r2.output_ids == o2.output_ids
+
+
+def test_hybrid_prefix_reuse_divergent_prompt_is_safe():
+    eng = _engine(prefix=True)
+    oracle = _engine(prefix=False)
+    _run(eng, "r1", BASE)
+    divergent = BASE[:20] + [90, 91, 92] + BASE[23:] + SUFFIX
+    r = _run(eng, "r2", divergent)
+    o = _run(oracle, "o", divergent)
+    assert r.num_cached_tokens <= 16     # only up to the divergence page
+    assert r.output_ids == o.output_ids
+
+
+def test_hybrid_prefix_reuse_two_stage_pipeline():
+    oracle = _engine(prefix=False, stages=[(0, 2), (2, 4)])
+    o2 = _run(oracle, "o2", BASE + SUFFIX)
+
+    eng = _engine(prefix=True, stages=[(0, 2), (2, 4)])
+    _run(eng, "r1", BASE)
+    r2 = _run(eng, "r2", BASE + SUFFIX)
+    assert r2.num_cached_tokens == 40
+    assert r2.output_ids == o2.output_ids
+    # Every stage served the hit, not just the head.
+    for e in eng:
+        assert e.cache.prefix_cache.num_cached_pages > 0
+
+
+def test_hybrid_snapshot_slot_exhaustion_recycles_lru():
+    # One snapshot slot: the second conversation steals it from the first;
+    # correctness never depends on a hit, only page/slot accounting does.
+    oracle = _engine(prefix=False)
+    eng = _engine(prefix=True, linear_prefix_slots=1)
+    conv_a = list(range(1, 42))
+    conv_b = list(range(100, 141))
+    _run(eng, "a1", conv_a)
+    _run(eng, "b1", conv_b)             # steals the sole snapshot slot
+    rb = _run(eng, "b2", conv_b + SUFFIX)
+    ob = _run(oracle, "ob", conv_b + SUFFIX)
+    assert rb.num_cached_tokens == 40       # b's snapshot survived
+    assert rb.output_ids == ob.output_ids
+    ra = _run(eng, "a2", conv_a + SUFFIX)   # steals the slot back in turn
+    oa = _run(oracle, "oa", conv_a + SUFFIX)
+    assert ra.num_cached_tokens == 0        # pages match, snapshot gone
+    assert ra.output_ids == oa.output_ids
+
+
+def test_hybrid_chained_turns_compound_reuse():
+    """Turn 3 reuses turn 2's snapshot (which itself reused turn 1's)."""
+    oracle = _engine(prefix=False)
+    eng = _engine(prefix=True)
+    t1 = BASE
+    t2 = BASE + SUFFIX + [60, 61, 62]          # 51 tokens, floor 48
+    t3 = t2 + [70, 71, 72, 73, 74]
+    _run(eng, "r1", t1)
+    r2 = _run(eng, "r2", t2)
+    assert r2.num_cached_tokens == 40
+    r3 = _run(eng, "r3", t3)
+    assert r3.num_cached_tokens == 48          # t2's deeper snapshot
+    o3 = _run(oracle, "o3", t3)
+    assert r3.output_ids == o3.output_ids
+
+
+def test_hybrid_prefix_reuse_page_aligned_prompt():
+    """A prompt whose length is an exact page multiple must still produce
+    a USABLE snapshot: the boundary is capped at (len-1)//page pages
+    because a hit always leaves >= 1 token to recompute."""
+    aligned = list(range(1, 49))             # 48 tokens = 6 full pages
+    oracle = _engine(prefix=False)
+    o2 = _run(oracle, "o2", aligned + SUFFIX)
+    eng = _engine(prefix=True)
+    _run(eng, "r1", aligned)
+    r2 = _run(eng, "r2", aligned + SUFFIX)
+    assert r2.num_cached_tokens == 40        # (48-1)//8*8, not 48
+    assert r2.output_ids == o2.output_ids
+
+    # Exact repeat of the aligned prompt also hits (cap leaves one page).
+    r3 = _run(eng, "r3", aligned)
+    o3 = _run(oracle, "o3", aligned)
+    assert r3.num_cached_tokens == 40
+    assert r3.output_ids == o3.output_ids

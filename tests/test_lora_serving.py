@@ -319,17 +319,49 @@ class TestGroupingAndWire:
         with pytest.raises(ValueError):
             parse_adapter_spec("oops")
 
-    def test_tp_stage_refuses_per_request_lora(self):
+    def test_tp_stage_serves_per_request_lora(self):
+        """TP=2 stage with in-graph adapters matches the unsharded
+        engine exactly, for adapter AND base traffic (reference TP LoRA
+        via SGLang, sglang_executor.py:249-334; here the delta shards
+        inside the shard_map — ops/lora.select_slot)."""
+        tree = make_adapter(1, layers=[0, 2])
+        ref_eng, _ = base_engine({"ad1": tree})
+        want_ad = run_one(ref_eng, [1, 2, 3, 4, 5], lora_id="ad1")
+        want_base = run_one(ref_eng, [1, 2, 3, 4, 5], rid="b")
+
+        from parallax_tpu.parallel import make_mesh
+
         model = StageModel(TINY, 0, TINY.num_hidden_layers,
                            use_pallas=False, tp_size=2)
         params = model.init_params(jax.random.key(0), dtype=jnp.float32)
-        from parallax_tpu.parallel import make_mesh
-
         eng = StageEngine(model, params, ECFG,
                           mesh=make_mesh(tp_size=2,
                                          devices=jax.devices()[:2]))
-        with pytest.raises(ValueError, match="TP"):
-            eng.load_adapter("ad1", make_adapter(1, [0]))
+        eng.load_adapter("ad1", tree)
+        got_ad = run_one(eng, [1, 2, 3, 4, 5], lora_id="ad1")
+        got_base = run_one(eng, [1, 2, 3, 4, 5], rid="b")
+        assert got_ad.output_ids == want_ad.output_ids
+        assert got_base.output_ids == want_base.output_ids
+        assert got_ad.output_ids != got_base.output_ids
+
+    def test_tp_rejects_indivisible_adapter_dims(self):
+        from parallax_tpu.ops.lora import validate_tp_shardable
+
+        rank = 4
+        tree = {0: {"self_attn.q_proj": (
+            np.zeros((rank, 64), np.float32),
+            np.zeros((63, rank), np.float32),   # 63 % 2 != 0
+            1.0,
+        )}}
+        with pytest.raises(ValueError, match="not divisible"):
+            validate_tp_shardable(tree, 2)
+        tree_row = {0: {"mlp.down_proj": (
+            np.zeros((rank, 63), np.float32),   # in dim indivisible
+            np.zeros((64, rank), np.float32),
+            1.0,
+        )}}
+        with pytest.raises(ValueError, match="not divisible"):
+            validate_tp_shardable(tree_row, 2)
 
 
 def test_swarm_two_tenants_adapter_correct(monkeypatch, tmp_path):

@@ -513,3 +513,44 @@ class TestRandomizedRouting:
         mgr.add(full)
         router = RandomizedRouting(mgr, seed=1)
         assert router.find_path() is None
+
+
+def test_trims_require_measurements_and_idle_replica():
+    """ADVICE r4: a trim reloads the replica's engine (aborting its
+    in-flight requests), so advice computed from roofline DEFAULTS or
+    aimed at a BUSY replica must not be applied."""
+    from parallax_tpu.scheduling.scheduler import GlobalScheduler
+
+    sched = GlobalScheduler(MODEL, min_nodes_bootstrapping=1, routing="dp")
+    mgr = sched.manager
+
+    def add(nid, start, end, lat=None, load=0):
+        n = make_node(nid)
+        n.set_layers(start, end)
+        n.measured_layer_latency_ms = lat
+        n.load = load
+        mgr.add(n)
+        mgr.set_active(nid)
+        return n
+
+    a = add("a", 0, 15, lat=0.01)
+    e = add("e", 15, 28, lat=0.01)
+    mgr.register_pipelines([Pipeline(nodes=[a, e])])
+    # Same drift geometry as the trimming test, but c has no measured
+    # latency the first time and is busy the second time.
+    c = add("c", 10, 20, lat=None)
+    d = add("d", 12, 28, lat=0.001)
+    for n in (a, e, c, d):
+        n.rtt_s = {x: 1e-6 for x in ("a", "e", "c", "d")}
+
+    sched._apply_turning_point_trims()
+    assert (c.start_layer, c.end_layer) == (10, 20)   # no measurement
+
+    c.measured_layer_latency_ms = 0.005
+    c.load = 3
+    sched._apply_turning_point_trims()
+    assert (c.start_layer, c.end_layer) == (10, 20)   # busy
+
+    c.load = 0
+    sched._apply_turning_point_trims()
+    assert (c.start_layer, c.end_layer) == (10, 12)   # evidence + idle
