@@ -328,3 +328,23 @@ def test_cli_generate_offline(tmp_path):
     assert out.returncode == 0, out.stderr[-1500:]
     assert out.stdout.endswith("\n") and len(out.stdout) > 1
     assert "generated tokens" in out.stderr
+
+
+def test_webui_model_catalog_estimates():
+    """The /ui/models catalog derives HBM estimates from config shapes
+    (reference setup.tsx model browser columns)."""
+    from parallax_tpu.backend.webui import _model_catalog
+
+    cat = _model_catalog()
+    assert len(cat) >= 60
+    by_name = {m["name"]: m for m in cat}
+    q7 = by_name["Qwen/Qwen2.5-7B-Instruct"]
+    assert 7.0 <= q7["params_b"] <= 8.0          # public param count
+    assert 13.0 <= q7["weight_gib"] <= 16.0      # bf16 weights
+    assert q7["min_chips_16g"] >= 2
+    nxt = by_name["Qwen/Qwen3-Next-80B-A3B-Instruct"]
+    assert nxt["hybrid"] and nxt["moe"]
+    assert 75.0 <= nxt["params_b"] <= 85.0
+    for m in cat:
+        assert m["params_b"] > 0 and m["weight_gib"] > 0
+        assert m["min_chips_16g"] >= 1

@@ -1,0 +1,19 @@
+#!/bin/bash
+# Probe the TPU relay every 5 minutes; log results. When a probe succeeds,
+# write /root/repo/TPU_UP and stop so the session can run the real bench.
+LOG=/root/repo/tpu_watch.log
+echo "watch start $(date -u +%FT%TZ)" >> "$LOG"
+while true; do
+  START=$(date +%s)
+  OUT=$(cd /root/repo && timeout 150 python -c "import jax; d=jax.devices(); print('DEVLIST', d)" 2>&1)
+  RC=$?
+  DUR=$(( $(date +%s) - START ))
+  LINE=$(echo "$OUT" | grep "DEVLIST" | head -1)
+  echo "$(date -u +%FT%TZ) rc=$RC dur=${DUR}s ${LINE:0:140}" >> "$LOG"
+  if [ $RC -eq 0 ] && echo "$LINE" | grep -qi "tpu"; then
+    echo "$(date -u +%FT%TZ) TPU REACHABLE" >> "$LOG"
+    touch /root/repo/TPU_UP
+    exit 0
+  fi
+  sleep 300
+done
