@@ -23,12 +23,12 @@ import functools
 
 from aiohttp import web
 
-# The UI's ~min-chips estimate must use the scheduler's own capacity
-# accounting or the column drifts from what the allocator actually does
-# (scheduling/node.py max_layers_in_memory: 92% usable HBM, 35% reserved
-# for KV).
-HBM_UTILIZATION = 0.92
-KV_RESERVE_FRACTION = 0.35
+# The UI's ~min-chips estimate uses the scheduler's own capacity
+# constants so the column can never drift from what the allocator does.
+from parallax_tpu.scheduling.node import (  # noqa: E402
+    HBM_UTILIZATION,
+    KV_RESERVE_FRACTION,
+)
 
 
 @functools.lru_cache(maxsize=1)
@@ -235,11 +235,15 @@ async function meta(){
 meta();
 const BS=' \\\n  ';   // backslash + newline + indent for shell commands
 function renderJoin(){
- const model=$('#model').value||'/path/to/checkpoint';
- $('#joincmd').textContent='python -m parallax_tpu.cli join'+BS+
-  '--scheduler-addr '+schedAddr+BS+'--model-path '+model+BS+'--port 0';
- $('#joinrelay').textContent='python -m parallax_tpu.cli join'+BS+
-  '--scheduler-addr '+schedAddr+BS+'--model-path '+model+BS+
+ // --model-path must be a LOCAL checkpoint directory on the worker
+ // (cli join loads it at startup; names resolve only on live switches).
+ const model=$('#model').value;
+ const path='/path/to/checkpoint';
+ const hint=model?'# checkpoint for: '+model+'\n':'';
+ $('#joincmd').textContent=hint+'python -m parallax_tpu.cli join'+BS+
+  '--scheduler-addr '+schedAddr+BS+'--model-path '+path+BS+'--port 0';
+ $('#joinrelay').textContent=hint+'python -m parallax_tpu.cli join'+BS+
+  '--scheduler-addr '+schedAddr+BS+'--model-path '+path+BS+
   '--relay --relay-token <swarm-secret>';
  let stages=null;
  if(lastStatus&&lastStatus.pipelines&&lastStatus.pipelines.length)
@@ -247,10 +251,10 @@ function renderJoin(){
  if(!stages)stages=[[0,'L/2'],['L/2','L']];
  const peers=location.hostname+':<worker1-port>,'+location.hostname+
   ':<worker2-port>';
- $('#joingossip').textContent=stages.map((se,i)=>
+ $('#joingossip').textContent=hint+stages.map((se,i)=>
   '# stage '+i+' (layers ['+se[0]+', '+se[1]+'))\n'+
   'python -m parallax_tpu.cli join'+BS+'--peers '+peers+BS+
-  '--model-path '+model+BS+'--start-layer '+se[0]+
+  '--model-path '+path+BS+'--start-layer '+se[0]+
   ' --end-layer '+se[1]).join('\n\n');
 }
 document.querySelectorAll('button.ghost[data-copy]').forEach(b=>

@@ -617,12 +617,26 @@ class WorkerNode:
 
     # -- transport handlers (any thread) -------------------------------------
 
-    def _on_forward(self, _peer: str, payload: dict):
+    def _on_forward(self, _peer: str, payload):
+        if isinstance(payload, (bytes, bytearray)):
+            # Reference-protocol peer: a raw protobuf ForwardRequest
+            # (heterogeneous-swarm interop, p2p/interop.py).
+            from parallax_tpu.p2p import interop
+
+            for ireq in interop.forward_bytes_to_ireqs(payload):
+                self._inbox.put(("forward", ireq))
+            return "ok"
         for wire_req in payload["reqs"]:
             self._inbox.put(("forward", proto.ireq_from_wire(wire_req)))
         return "ok"
 
-    def _on_abort(self, _peer: str, payload: dict):
+    def _on_abort(self, _peer: str, payload):
+        if isinstance(payload, (bytes, bytearray)):
+            from parallax_tpu.p2p import interop
+
+            for rid in interop.abort_bytes_to_rids(payload):
+                self._inbox.put(("release", rid, True))
+            return "ok"
         for rid in payload["rids"]:
             self._inbox.put(("release", rid, True))
         return "ok"

@@ -14,6 +14,12 @@ import time
 from parallax_tpu.config import ModelConfig
 from parallax_tpu.utils.hw import HardwareInfo
 
+# Capacity-model constants shared with every surface that estimates
+# "will it fit" (the web UI's ~min-chips column imports these): fraction
+# of HBM treated as usable, and the slice of that reserved for KV.
+HBM_UTILIZATION = 0.92
+KV_RESERVE_FRACTION = 0.35
+
 
 @dataclasses.dataclass
 class RooflinePerformanceModel:
@@ -48,9 +54,14 @@ class RooflinePerformanceModel:
             bytes_ / (self.hardware.hbm_gbps * self.hardware.num_chips * 1e9),
         ) * 1e3
 
-    def max_layers_in_memory(self, kv_fraction: float = 0.35) -> int:
+    def max_layers_in_memory(
+        self, kv_fraction: float = KV_RESERVE_FRACTION
+    ) -> int:
         """How many decoder layers fit in HBM, reserving a KV budget."""
-        usable = self.hardware.total_hbm_bytes * 0.92 * (1 - kv_fraction)
+        usable = (
+            self.hardware.total_hbm_bytes * HBM_UTILIZATION
+            * (1 - kv_fraction)
+        )
         per_layer = (
             self.model.decoder_layer_params(0)
             * self.model.param_bytes_per_element
@@ -120,7 +131,10 @@ class Node:
     def max_concurrent_requests(self, avg_context: int = 2048) -> int:
         """KV-budget-derived admission cap (reference node.py:212-246)."""
         layers = self.num_layers or 1
-        kv_budget = self.hardware.total_hbm_bytes * 0.92 * 0.35
+        kv_budget = (
+            self.hardware.total_hbm_bytes * HBM_UTILIZATION
+            * KV_RESERVE_FRACTION
+        )
         per_req = (
             self.model.kv_bytes_per_token_per_layer() * avg_context * layers
         )
