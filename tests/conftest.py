@@ -21,6 +21,28 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 import jax  # noqa: E402  (import after env setup)
+import pytest  # noqa: E402
+
+# Jit-heavy / e2e suites (each >1 min on CPU). The fast core —
+# scheduling, cache bookkeeping, transport, interop, constrained,
+# periphery — gives signal in well under a minute with
+# ``pytest -m "not slow"``; CI and the driver run everything.
+SLOW_MODULES = {
+    "test_deepseek_mla", "test_dsa", "test_engine_e2e",
+    "test_glm4_gptoss", "test_http_serving", "test_linear_prefix_cache",
+    "test_lora_serving", "test_mla_pallas", "test_moe", "test_msa",
+    "test_multistep_decode", "test_ops_attention", "test_pp_speculative",
+    "test_quantization", "test_qwen3_next", "test_ring_attention",
+    "test_speculative", "test_swarm_e2e", "test_tensor_parallel",
+    "test_weight_refit", "test_zoo_tails",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        mod = item.module.__name__.rsplit(".", 1)[-1]
+        if mod in SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
 
 # The driver environment's PJRT plugin (axon) force-sets
 # jax_platforms="axon,cpu" at the config level, overriding the env var —
