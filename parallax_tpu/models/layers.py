@@ -83,8 +83,34 @@ def get_weight(p: dict) -> jax.Array:
 
 
 def _lora_delta(x: jax.Array, ab: dict) -> jax.Array:
-    """Per-request LoRA correction ``(x @ A^T) @ B^T * scale`` in fp32
-    (``A [r, in]``, ``B [out, r]`` — two thin MXU matmuls)."""
+    """Per-request LoRA correction ``(x @ A^T) @ B^T * scale`` in fp32.
+
+    Two forms:
+    - batch-uniform (``A [r, in]``, ``B [out, r]``, scalar ``s``): two
+      thin MXU matmuls — the whole batch shares one adapter.
+    - per-row mixed (``"slots"`` present: ``A [n, r, in]``,
+      ``B [n, out, r]``, ``s [n]``, ``slots i32[T]``): compute the thin
+      first matmul against EVERY adapter (``[T, n, r]`` — r is tiny, so
+      this costs ~n*r/out of the base matmul) and contract the second
+      matmul jointly over (n, r) with a scale-folded one-hot selecting
+      each row's adapter. A row whose slot is out of range (the null
+      slot for base traffic) gets an all-zero one-hot and thus a zero
+      delta — masking for free. No ``[T, n, out]`` intermediate ever
+      materializes.
+    """
+    if "slots" in ab:
+        a_all = jnp.einsum(
+            "ti,nri->tnr", x, ab["A"],
+            preferred_element_type=jnp.float32,
+        )
+        n = ab["A"].shape[0]
+        onehot = jax.nn.one_hot(
+            ab["slots"], n, dtype=jnp.float32
+        ) * ab["s"][None, :]
+        return jnp.einsum(
+            "tnr,tn,nor->to", a_all, onehot, ab["B"],
+            preferred_element_type=jnp.float32,
+        )
     a = jax.lax.dot_general(
         x, ab["A"],
         dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),

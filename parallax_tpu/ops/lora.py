@@ -158,6 +158,25 @@ class AdapterSet:
             "layers": self._stacked,
         }
 
+    def mixed_batch_field(self, token_slots) -> dict:
+        """The ``BatchInputs.lora`` value for a MIXED-adapter batch:
+        ``{"slots": i32[T], "layers": stacked}`` — every row selects its
+        own adapter in-graph (base rows use slot == num_adapters, whose
+        one-hot is all-zero, so their delta vanishes)."""
+        import jax.numpy as jnp
+
+        if self._stacked is None:
+            self._stacked = self._stack()
+        return {
+            "slots": jnp.asarray(token_slots, jnp.int32),
+            "layers": self._stacked,
+        }
+
+    def token_slot(self, name: str | None) -> int:
+        """Row slot for mixed batches; base rows (None) get the null slot
+        one past the last adapter — its one-hot is all-zero."""
+        return self.slot_of(name) if name is not None else len(self._adapters)
+
     def _stack(self) -> dict:
         import jax.numpy as jnp
 
@@ -229,14 +248,27 @@ def select_slot(lora: dict, axis_name: str | None = None, tp: int = 1):
     import jax
     from jax import lax
 
-    sel = jax.tree.map(
-        lambda a: lax.dynamic_index_in_dim(a, lora["slot"], 0,
-                                           keepdims=False),
-        lora["layers"],
-    )
+    mixed = "slots" in lora
+    if mixed:
+        # Per-row selection happens inside _lora_delta; keep the stacked
+        # arrays and thread the slot vector into every site.
+        sel = {
+            li: {path: dict(ab, slots=lora["slots"])
+                 for path, ab in layer.items()}
+            for li, layer in lora["layers"].items()
+        }
+    else:
+        sel = jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, lora["slot"], 0,
+                                               keepdims=False),
+            lora["layers"],
+        )
     if axis_name is None or tp <= 1:
         return sel
     idx = lax.axis_index(axis_name)
+    # Stacked (mixed) arrays carry a leading adapter axis; the sharded
+    # dim shifts by one.
+    b_axis, a_axis = (1, 2) if mixed else (0, 1)
     out: dict[str, dict] = {}
     for li, layer in sel.items():
         out[li] = {}
@@ -245,12 +277,16 @@ def select_slot(lora: dict, axis_name: str | None = None, tp: int = 1):
             ab = dict(ab)
             if proj in _COL_PROJS:
                 b = ab["B"]
-                n_loc = b.shape[0] // tp
-                ab["B"] = lax.dynamic_slice_in_dim(b, idx * n_loc, n_loc, 0)
+                n_loc = b.shape[b_axis] // tp
+                ab["B"] = lax.dynamic_slice_in_dim(
+                    b, idx * n_loc, n_loc, b_axis
+                )
             elif proj in _ROW_PROJS:
                 a = ab["A"]
-                n_loc = a.shape[1] // tp
-                ab["A"] = lax.dynamic_slice_in_dim(a, idx * n_loc, n_loc, 1)
+                n_loc = a.shape[a_axis] // tp
+                ab["A"] = lax.dynamic_slice_in_dim(
+                    a, idx * n_loc, n_loc, a_axis
+                )
             out[li][path] = ab
     return out
 

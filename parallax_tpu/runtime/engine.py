@@ -476,8 +476,25 @@ class StageEngine:
         """Registered per-request adapters (frontend advertising)."""
         return self._adapters.names if self._adapters is not None else []
 
-    def _lora_field(self, plan: BatchPlan):
-        if plan.lora_id is None or self._adapters is None:
+    def _lora_field(self, plan: BatchPlan, inputs: BatchInputs):
+        if self._adapters is None:
+            return None
+        if plan.mixed_lora:
+            # Per-token slot vector sized to the assembled bucket; padded
+            # rows keep the null slot (zero delta — they're never read,
+            # but garbage slots would still burn the one-hot's clarity).
+            t = int(inputs.token_ids.shape[0])
+            null = self._adapters.token_slot(None)
+            slots = np.full((t,), null, np.int32)
+            row = 0
+            for seg in plan.seqs:
+                n = seg.num_new_tokens
+                slots[row : row + n] = self._adapters.token_slot(
+                    seg.request.lora_id
+                )
+                row += n
+            return self._adapters.mixed_batch_field(slots)
+        if plan.lora_id is None:
             return None
         return self._adapters.batch_field(plan.lora_id)
 
@@ -820,7 +837,7 @@ class StageEngine:
         inputs = assemble(
             plan, self.spec, self.cfg.page_size, decode_only=True
         )
-        lora = self._lora_field(plan)
+        lora = self._lora_field(plan, inputs)
         if lora is not None:
             inputs = dataclasses.replace(inputs, lora=lora)
         samp = None
@@ -1026,11 +1043,12 @@ class StageEngine:
             )
             for seg, prop in zip(plan.seqs, proposals)
         ]
-        spec_plan = BatchPlan(spec_segs, lora_id=plan.lora_id)
+        spec_plan = BatchPlan(spec_segs, lora_id=plan.lora_id,
+                              mixed_lora=plan.mixed_lora)
         inputs = assemble(
             spec_plan, self.spec, self.cfg.page_size, gather_all_logits=True
         )
-        lora = self._lora_field(spec_plan)
+        lora = self._lora_field(spec_plan, inputs)
         if lora is not None:
             inputs = dataclasses.replace(inputs, lora=lora)
         logits, self.kv = self._jit_step(self.params, self.kv, inputs)
@@ -1217,7 +1235,26 @@ class StageEngine:
         plan = sp_plan if sp_plan is not None else self._form_plan()
         if plan.is_empty:
             return StepOutputs(forward=[], finished=self._collect_finished())
-        if plan.lora_id is not None and not self.has_adapter(plan.lora_id):
+        if plan.mixed_lora:
+            # Mixed-adapter batch: abort only the rows whose adapter this
+            # stage does not serve; the rest proceed.
+            bad = [
+                seg for seg in plan.seqs
+                if seg.request.lora_id is not None
+                and not self.has_adapter(seg.request.lora_id)
+            ]
+            if bad:
+                for seg in bad:
+                    seg.request.abort(
+                        f"unknown lora adapter {seg.request.lora_id!r}"
+                    )
+                keep = [s for s in plan.seqs if s not in bad]
+                if not keep:
+                    return StepOutputs(
+                        forward=[], finished=self._collect_finished()
+                    )
+                plan = BatchPlan(keep, mixed_lora=True)
+        elif plan.lora_id is not None and not self.has_adapter(plan.lora_id):
             # Unknown adapter: fail the whole (single-adapter) batch with
             # a clear reason instead of silently serving base weights.
             for seg in plan.seqs:
@@ -1306,7 +1343,7 @@ class StageEngine:
                 with_dense_map=self._needs_state, decode_only=decode_only,
                 gather_all_logits=bool(spec_rows),
             )
-            lora = self._lora_field(plan)
+            lora = self._lora_field(plan, inputs)
             if lora is not None:
                 inputs = dataclasses.replace(inputs, lora=lora)
             out, self.kv = self._jit_step(self.params, self.kv, inputs)
@@ -1415,7 +1452,8 @@ class StageEngine:
             usable.append(s)
         # form_batch grouped by adapter; the availability filter must not
         # drop the group's lora_id (downstream stages apply deltas too).
-        return BatchPlan(usable, lora_id=plan.lora_id)
+        return BatchPlan(usable, lora_id=plan.lora_id,
+                         mixed_lora=plan.mixed_lora)
 
     def _take_hidden(self, rid: str, n: int) -> np.ndarray:
         buf = self._pending_hidden[rid]

@@ -36,9 +36,15 @@ class BatchPlan:
     """Everything the executor needs to build device inputs for one step."""
 
     seqs: list[ScheduledSeq]
-    # The single LoRA adapter every seq in this batch uses (None = base):
+    # The single LoRA adapter every seq in this batch uses (None = base);
     # one adapter per dispatch keeps the in-graph slot selection scalar.
     lora_id: str | None = None
+    # Mixed-adapter DECODE batch: every row selects its own adapter via a
+    # per-token slot vector (ops/lora.py mixed form). Lifts the
+    # one-adapter-per-step ITL cost under many concurrent tenants — with
+    # N active adapters each tenant would otherwise decode on ~1/N of
+    # steps.
+    mixed_lora: bool = False
 
     @property
     def total_new_tokens(self) -> int:
@@ -83,6 +89,8 @@ class Scheduler:
         self.running: OrderedDict[str, Request] = OrderedDict()
         # Round-robin cursor over adapter groups (see form_batch).
         self._lora_cursor = 0
+        # Rotation cursor for budget-capped mixed decode batches.
+        self._decode_cursor = 0
 
     # -- intake -----------------------------------------------------------
 
@@ -197,6 +205,23 @@ class Scheduler:
                 groups.append(req.lora_id)
         if not groups:
             return BatchPlan([])
+        if len(groups) > 1 and not any(
+            req.status is RequestStatus.PREFILLING
+            and req.remaining_prompt_tokens() > 0
+            for req in self.running.values()
+        ):
+            # Pure decode with several tenants active: serve EVERY tenant
+            # this step with a mixed-adapter batch (per-row slot vectors)
+            # instead of rotating — per-tenant ITL stops scaling with the
+            # number of active adapters. Prefill keeps adapter grouping
+            # (chunk compute dominates; rotation is fine there).
+            seqs = self._fill_decode(batch_lora=None, any_adapter=True)
+            if seqs:
+                lids = {s.request.lora_id for s in seqs}
+                if len(lids) > 1:
+                    return BatchPlan(seqs, mixed_lora=True)
+                # Capacity aborts collapsed it to one tenant after all.
+                return BatchPlan(seqs, lora_id=next(iter(lids)))
         start = self._lora_cursor % len(groups)
         if len(groups) > 1:
             self._lora_cursor += 1
@@ -255,13 +280,42 @@ class Scheduler:
             token_budget -= n
 
         # Then ready decodes.
-        for req in self.running.values():
-            if len(seqs) >= self.max_batch_size or token_budget <= 0:
+        seqs.extend(self._fill_decode(
+            batch_lora,
+            max_seqs=self.max_batch_size - len(seqs),
+            token_budget=token_budget,
+        ))
+        return seqs
+
+    def _fill_decode(
+        self,
+        batch_lora: str | None,
+        any_adapter: bool = False,
+        max_seqs: int | None = None,
+        token_budget: int | None = None,
+    ) -> list[ScheduledSeq]:
+        """Ready decode rows — one adapter group, or every tenant at once
+        (``any_adapter``, mixed-adapter batches)."""
+        if max_seqs is None:
+            max_seqs = self.max_batch_size
+        if token_budget is None:
+            token_budget = self.max_num_tokens_per_batch
+        candidates = [
+            req for req in self.running.values()
+            if req.status is RequestStatus.DECODING and req.ready_for_step
+            and (any_adapter or req.lora_id == batch_lora)
+        ]
+        if any_adapter and candidates:
+            # The mixed path returns before form_batch's group rotation,
+            # so fairness must live here: when the budget caps the batch,
+            # a fixed iteration order would serve the same head-of-line
+            # rows every step and starve the rest. Rotate the start.
+            start = self._decode_cursor % len(candidates)
+            candidates = candidates[start:] + candidates[:start]
+        seqs: list[ScheduledSeq] = []
+        for req in candidates:
+            if len(seqs) >= max_seqs or token_budget <= 0:
                 break
-            if req.status is not RequestStatus.DECODING or not req.ready_for_step:
-                continue
-            if req.lora_id != batch_lora:
-                continue
             if not self.cache.ensure_capacity(req, req.total_len):
                 self._abort_on_oom(req)
                 continue
@@ -275,6 +329,8 @@ class Scheduler:
                 )
             )
             token_budget -= 1
+        if any_adapter:
+            self._decode_cursor += len(seqs)
         return seqs
 
     # -- step feedback ----------------------------------------------------

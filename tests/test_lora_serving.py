@@ -271,7 +271,10 @@ class TestAdapterMath:
 
 
 class TestGroupingAndWire:
-    def test_batches_never_mix_adapters(self):
+    def test_batch_adapter_grouping_contract(self):
+        """Prefill batches carry exactly one adapter (scalar in-graph
+        slot); pure-decode batches may MIX adapters via per-row slots —
+        and when they do, the plan says so and every tenant is served."""
         eng, _ = base_engine({"ad1": make_adapter(1, [0]),
                               "ad2": make_adapter(2, [0])})
         pipe = InProcessPipeline([eng])
@@ -282,24 +285,31 @@ class TestGroupingAndWire:
                     temperature=0.0, max_new_tokens=4, ignore_eos=True),
                 lora_id=lid,
             ))
-        seen = []
+        seen, mixed_seen = [], []
         orig = eng.scheduler.form_batch
 
         def spy():
             plan = orig()
             if not plan.is_empty:
                 ids = {s.request.lora_id for s in plan.seqs}
-                assert len(ids) == 1, f"mixed-adapter batch: {ids}"
-                assert plan.lora_id in ids
-                seen.append(plan.lora_id)
+                if plan.mixed_lora:
+                    assert len(ids) > 1
+                    assert all(s.num_new_tokens == 1 for s in plan.seqs)
+                    mixed_seen.append(ids)
+                else:
+                    assert len(ids) == 1, f"unmarked mixed batch: {ids}"
+                    assert plan.lora_id in ids
+                seen.extend(ids)
             return plan
 
         eng.scheduler.form_batch = spy
         pipe.run_until_complete()
         assert {None, "ad1", "ad2"} <= set(seen)
-        # Round-robin fairness: every tenant is served within the first
-        # few batches instead of head-of-line blocking behind the first.
-        assert {None, "ad1", "ad2"} <= set(seen[:4]), seen[:8]
+        # Pure-decode steps actually mixed (all three tenants at once).
+        assert any(len(ids) == 3 for ids in mixed_seen), mixed_seen
+        # Every tenant is served within the first few batches instead of
+        # head-of-line blocking behind the first.
+        assert {None, "ad1", "ad2"} <= set(seen[:6]), seen[:8]
 
     def test_lora_id_round_trips_on_the_wire(self):
         from parallax_tpu.p2p.proto import ireq_from_wire, ireq_to_wire
@@ -621,3 +631,136 @@ class TestPeftLoading:
         assert A.shape == (2, 4, 64)
         # The rank-2 adapter's padded rows are zero.
         np.testing.assert_array_equal(np.asarray(A[0][2:]), 0.0)
+
+
+class TestMixedAdapterBatches:
+    """ADVICE r4: one adapter group per step multiplied per-tenant ITL by
+    the number of active tenants. Pure-decode steps now form MIXED
+    batches (per-row slot vectors, ops/lora.py mixed form)."""
+
+    def _three_tenant_engine(self):
+        eng, params = base_engine({
+            "ad1": make_adapter(1, layers=[0, 2]),
+            "ad2": make_adapter(2, layers=[1, 3]),
+        })
+        return eng, params
+
+    def _run_many(self, eng, specs, n=8):
+        pipe = InProcessPipeline([eng])
+        reqs = []
+        for rid, lora in specs:
+            r = Request(rid, prompt_ids=[1, 2, 3, 4, 5],
+                        sampling_params=SamplingParams(
+                            temperature=0.0, max_new_tokens=n,
+                            ignore_eos=True),
+                        lora_id=lora)
+            reqs.append(r)
+            pipe.submit(r)
+        pipe.run_until_complete()
+        return reqs
+
+    def test_mixed_decode_exactly_matches_solo_runs(self):
+        # Solo oracles: each tenant alone.
+        solo = {}
+        for lora in (None, "ad1", "ad2"):
+            eng, _ = self._three_tenant_engine()
+            (r,) = self._run_many(eng, [("s", lora)])
+            solo[lora] = r.output_ids
+
+        eng, _ = self._three_tenant_engine()
+        mixed_plans = []
+        orig = eng.scheduler.form_batch
+
+        def spy():
+            plan = orig()
+            if plan.mixed_lora:
+                mixed_plans.append(len(plan.seqs))
+            return plan
+
+        eng.scheduler.form_batch = spy
+        reqs = self._run_many(
+            eng, [("a", "ad1"), ("b", "ad2"), ("c", None)]
+        )
+        assert mixed_plans, "no mixed-adapter batch ever formed"
+        assert max(mixed_plans) == 3      # every tenant served per step
+        for r, lora in zip(reqs, ("ad1", "ad2", None)):
+            assert r.output_ids == solo[lora], (r.request_id, lora)
+
+    def test_mixed_decode_with_multistep_window(self):
+        solo = {}
+        for lora in ("ad1", "ad2"):
+            eng, _ = self._three_tenant_engine()
+            (r,) = self._run_many(eng, [("s", lora)], n=10)
+            solo[lora] = r.output_ids
+        eng, params = base_engine({
+            "ad1": make_adapter(1, layers=[0, 2]),
+            "ad2": make_adapter(2, layers=[1, 3]),
+        })
+        eng.cfg.decode_lookahead = 4
+        reqs = self._run_many(eng, [("a", "ad1"), ("b", "ad2")], n=10)
+        for r, lora in zip(reqs, ("ad1", "ad2")):
+            assert r.output_ids == solo[lora]
+
+    def test_mixed_decode_on_tp_stage(self):
+        ref_eng, _ = self._three_tenant_engine()
+        want = self._run_many(ref_eng, [("a", "ad1"), ("b", "ad2"),
+                                        ("c", None)])
+        from parallax_tpu.parallel import make_mesh
+
+        model = StageModel(TINY, 0, TINY.num_hidden_layers,
+                           use_pallas=False, tp_size=2)
+        params = model.init_params(jax.random.key(0), dtype=jnp.float32)
+        eng = StageEngine(model, params, ECFG,
+                          mesh=make_mesh(tp_size=2,
+                                         devices=jax.devices()[:2]))
+        eng.load_adapter("ad1", make_adapter(1, layers=[0, 2]))
+        eng.load_adapter("ad2", make_adapter(2, layers=[1, 3]))
+        got = self._run_many(eng, [("a", "ad1"), ("b", "ad2"), ("c", None)])
+        for g, w in zip(got, want):
+            assert g.output_ids == w.output_ids
+
+    def test_prefill_still_groups_by_adapter(self):
+        """Chunked prefill keeps one adapter per batch (mixing only pays
+        off in decode; the scalar-slot prefill graph stays)."""
+        eng, _ = self._three_tenant_engine()
+        plans = []
+        orig = eng.scheduler.form_batch
+
+        def spy():
+            plan = orig()
+            if not plan.is_empty and any(
+                s.num_new_tokens > 1 for s in plan.seqs
+            ):
+                plans.append(plan)
+            return plan
+
+        eng.scheduler.form_batch = spy
+        self._run_many(eng, [("a", "ad1"), ("b", "ad2")], n=2)
+        assert plans
+        for plan in plans:
+            assert not plan.mixed_lora
+            lids = {s.request.lora_id for s in plan.seqs}
+            assert len(lids) == 1
+
+    def test_budget_capped_mixed_decode_rotates_fairly(self):
+        """When the batch budget cannot fit every decode-ready row, the
+        mixed path must rotate its starting row — a fixed order would
+        serve the same head-of-line tenants every step and starve the
+        rest."""
+        eng, _ = base_engine({"ad1": make_adapter(1, [0]),
+                              "ad2": make_adapter(2, [0])})
+        eng.scheduler.max_batch_size = 2     # cap below the 4 rows below
+        pipe = InProcessPipeline([eng])
+        reqs = []
+        for i, lid in enumerate(["ad1", "ad1", "ad2", None]):
+            r = Request(f"f{i}", prompt_ids=[1, 2, 3],
+                        sampling_params=SamplingParams(
+                            temperature=0.0, max_new_tokens=6,
+                            ignore_eos=True),
+                        lora_id=lid)
+            reqs.append(r)
+            pipe.submit(r)
+        pipe.run_until_complete()
+        # Everyone finished — no tenant starved behind the cap.
+        for r in reqs:
+            assert len(r.output_ids) == 6, r.request_id
