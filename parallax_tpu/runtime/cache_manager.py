@@ -269,19 +269,21 @@ class CacheManager:
         """
         path, num_shared = self._locked.pop(request.request_id, ([], 0))
         self.prefix_cache.unlock(path)
-        # Hybrid models: the engine snapshotted conv/recurrent state into a
-        # dedicated slot at a page-aligned prefill boundary; attach it to
-        # the radix node at exactly that boundary so future prefix hits can
+        # Hybrid models: the engine snapshotted conv/recurrent state into
+        # dedicated slots at page-aligned boundaries (deepest prompt
+        # boundary + deepest conversation boundary); attach each to the
+        # radix node at exactly its boundary so future prefix hits can
         # resume the recurrence there. Unattachable (aborted request, node
         # missing, boundary already covered) -> the slot goes back to the
         # engine's pool via on_slot_free.
-        snapshot = getattr(request, "state_snapshot", None)
-        if snapshot is not None:
-            del request.state_snapshot
+        snapshots = list(getattr(request, "state_snapshots", {}).values())
+        if hasattr(request, "state_snapshots"):
+            del request.state_snapshots
         owned = request.page_ids[num_shared:]
         if not owned:
-            if snapshot is not None and self.on_slot_free:
-                self.on_slot_free(snapshot[1])
+            if self.on_slot_free:
+                for _length, slot in snapshots:
+                    self.on_slot_free(slot)
             request.page_ids = []
             return
         if self.enable_prefix_cache and request.status.value != "finished_abort":
@@ -301,8 +303,7 @@ class CacheManager:
             tail = owned[max(0, n_full - num_shared):]
             duplicates = self.prefix_cache.insert(tokens, request.page_ids[:n_full])
             self.allocator.free(duplicates + tail)
-            if snapshot is not None:
-                length, slot = snapshot
+            for length, slot in snapshots:
                 attached = (
                     length <= n_full * self.page_size
                     and self.prefix_cache.attach_linear_slot(
@@ -315,8 +316,9 @@ class CacheManager:
                 if not attached and self.on_slot_free:
                     self.on_slot_free(slot)
         else:
-            if snapshot is not None and self.on_slot_free:
-                self.on_slot_free(snapshot[1])
+            if self.on_slot_free:
+                for _length, slot in snapshots:
+                    self.on_slot_free(slot)
             self.allocator.free(owned)
         request.page_ids = []
 

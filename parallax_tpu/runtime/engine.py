@@ -47,10 +47,17 @@ class EngineConfig:
     # Hybrid (linear-attention) models: device slots reserved for
     # conv/recurrent state snapshots attached to prefix-cache nodes
     # (reference linear prefix slots, cache_manager.py:96-103). Each
-    # finished prefill snapshots its state at the last page-aligned
-    # prompt boundary so later requests sharing the prefix can resume
-    # the recurrence there. 0 disables prefix caching for hybrids.
+    # request pins up to TWO in-flight snapshots (deepest prompt boundary
+    # + deepest conversation boundary), so size this at roughly 2x the
+    # expected concurrent hybrid requests plus tree headroom. 0 disables
+    # prefix caching for hybrids.
     linear_prefix_slots: int = 32
+    # Decode-time snapshots fire every this-many pages of generated
+    # tokens (each is one small jitted state copy); reuse for follow-up
+    # turns then resumes within stride*page_size tokens of the
+    # conversation end. 0 disables decode snapshots (prefill-only, the
+    # reference's behavior).
+    linear_decode_snapshot_stride: int = 4
     kv_dtype: str = "bfloat16"
     seed: int = 0
     request_timeout_s: float = 600.0
@@ -1797,26 +1804,57 @@ class StageEngine:
         for seg in plan.seqs:
             req = seg.request
             c = req.num_computed_tokens
-            # The deepest boundary a future match can use: a hit always
-            # leaves >= 1 prompt token to recompute, so for page-aligned
-            # prompts the last page is never matchable (also excludes
-            # decode rows: c past this limit snapshots nothing).
-            usable = ((req.num_prompt_tokens - 1) // page) * page
-            if (
-                c % page
-                or c <= req.num_cached_tokens   # tree already covers this
-                or c > usable
-                or not hasattr(req, "state_slot")
-            ):
+            if c % page or not hasattr(req, "state_slot"):
                 continue
-            snap = getattr(req, "state_snapshot", None)
+            # Two pending snapshots per request, each overwriting its own
+            # slot, both attached on release:
+            # - "prefill": the deepest boundary inside the PROMPT (capped
+            #   at (prompt-1) so an exact repeat can still match) — the
+            #   divergence point when the next request asks a different
+            #   follow-up after the same prompt.
+            # - "decode": the deepest boundary in the whole conversation —
+            #   a follow-up whose prompt is the full previous conversation
+            #   (prompt + generated) resumes there. Beyond the reference,
+            #   which attaches after prefill only.
+            decoding = (
+                req.status is RequestStatus.DECODING
+                or c > req.num_prompt_tokens
+            )
+            kind = "decode" if decoding else "prefill"
+            snaps = getattr(req, "state_snapshots", None)
+            if snaps is None:
+                snaps = req.state_snapshots = {}  # type: ignore[attr-defined]
+            if decoding:
+                stride = self.cfg.linear_decode_snapshot_stride
+                if not stride:
+                    continue
+                # Amortize the per-boundary copy: after the first decode
+                # snapshot, re-copy only once per ``stride`` pages (the
+                # deepest snapshot is the one that matters; intermediate
+                # copies into the same slot are overwritten anyway).
+                prev = snaps.get("decode")
+                if prev is not None and c - prev[0] < stride * page:
+                    continue
+            elif c > ((req.num_prompt_tokens - 1) // page) * page:
+                continue
+            if c <= req.num_cached_tokens or c <= max(
+                (length for length, _ in snaps.values()), default=0
+            ):
+                continue   # tree or an existing snapshot already covers it
+            snap = snaps.get(kind)
             if snap is None:
                 try:
                     slot = self._prefix_slot_base + self._prefix_slot_alloc.alloc()
                 except OutOfPages:
-                    # Steal the LRU snapshot already in the tree; if none
-                    # is reclaimable every slot belongs to an in-flight
-                    # request — skip, the request simply won't donate one.
+                    if decoding:
+                        # A decode snapshot is a bonus — never strip a
+                        # snapshot already ATTACHED to the tree for one
+                        # (stealing degrades existing prefix hits under
+                        # exactly the load this feature targets).
+                        continue
+                    # Prefill snapshots are the primary reuse mechanism:
+                    # steal the LRU tree snapshot; if none is reclaimable
+                    # every slot belongs to an in-flight request — skip.
                     slot = self.cache.prefix_cache.detach_lru_linear_slot()
                     if slot is None:
                         continue
@@ -1825,7 +1863,7 @@ class StageEngine:
             self.kv = self._jit_copy_state(
                 self.kv, jnp.int32(req.state_slot), jnp.int32(slot)
             )
-            req.state_snapshot = (c, slot)  # type: ignore[attr-defined]
+            snaps[kind] = (c, slot)
 
     def _record_latency(self, plan: BatchPlan, ms: float) -> None:
         if plan.has_prefill or plan.is_empty:

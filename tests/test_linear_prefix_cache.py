@@ -123,7 +123,7 @@ def test_hybrid_match_requires_snapshot_and_restores_slot():
                     sampling_params=SamplingParams(max_new_tokens=1))
     assert cm.allocate_for_prompt(donor)
     donor.num_computed_tokens = 5
-    donor.state_snapshot = (4, 99)
+    donor.state_snapshots = {"prefill": (4, 99)}
     from parallax_tpu.runtime.request import RequestStatus
 
     donor.status = RequestStatus.FINISHED_LENGTH
@@ -160,7 +160,7 @@ def test_unattachable_snapshot_slot_returns_to_pool():
                   sampling_params=SamplingParams(max_new_tokens=1))
     assert cm.allocate_for_prompt(req)
     req.num_computed_tokens = 3
-    req.state_snapshot = (2, 42)
+    req.state_snapshots = {"prefill": (2, 42)}
     req.abort("test")    # aborted requests never donate
     cm.release(req)
     assert freed == [42]
@@ -173,6 +173,7 @@ def _engine(prefix: bool, stages=None, **cfg_kw) -> list[StageEngine]:
     engines = []
     for s, e in (stages or [(0, 4)]):
         m = create_stage_model(CONFIG, s, e, use_pallas=False)
+        cfg_kw.setdefault("linear_decode_snapshot_stride", 1)
         engines.append(StageEngine(
             m, m.init_params(jax.random.key(0), dtype=jax.numpy.float32),
             EngineConfig(page_size=PAGE, num_pages=64, max_model_len=256,
@@ -281,7 +282,9 @@ def test_hybrid_prefix_reuse_page_aligned_prompt():
     eng = _engine(prefix=True)
     _run(eng, "r1", aligned)
     r2 = _run(eng, "r2", aligned + SUFFIX)
-    assert r2.num_cached_tokens == 40        # (48-1)//8*8, not 48
+    # The decode-boundary snapshot covers the full aligned prompt (48);
+    # the prompt-floor snapshot (40) also exists for exact repeats.
+    assert r2.num_cached_tokens == 48
     assert r2.output_ids == o2.output_ids
 
     # Exact repeat of the aligned prompt also hits (cap leaves one page).
@@ -289,3 +292,26 @@ def test_hybrid_prefix_reuse_page_aligned_prompt():
     o3 = _run(oracle, "o3", aligned)
     assert r3.num_cached_tokens == 40
     assert r3.output_ids == o3.output_ids
+
+
+def test_hybrid_decode_snapshots_extend_reuse_past_prompt():
+    """Follow-up turns whose prompt is the WHOLE previous conversation
+    (prompt + generated) skip past the generated span too: decode rows
+    snapshot at every aligned boundary, so the deepest snapshot covers
+    generated tokens (beyond the reference's prefill-only attach)."""
+    oracle = _engine(prefix=False)
+    eng = _engine(prefix=True)
+    # 37-token prompt + 15 generated = 52 tokens; deepest aligned
+    # boundary inside the conversation = 48 > 32 (the prompt floor).
+    t1 = list(range(1, 38))
+    r1 = _run(eng, "r1", t1, n=15)
+    o1 = _run(oracle, "o1", t1, n=15)
+    assert r1.output_ids == o1.output_ids
+    convo = t1 + r1.output_ids
+    assert len(convo) == 52
+
+    t2 = convo + [90, 91, 92]
+    r2 = _run(eng, "r2", t2)
+    o2 = _run(oracle, "o2", t2)
+    assert r2.num_cached_tokens == 48    # past the 37-token prompt
+    assert r2.output_ids == o2.output_ids
