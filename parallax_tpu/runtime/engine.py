@@ -785,7 +785,7 @@ class StageEngine:
         exposes it.
         """
         k = self.cfg.decode_lookahead
-        if k <= 1 or not self._fused_common_ok(plan):
+        if k <= 1 or not self._fused_common_ok(plan, allow_state=True):
             return None
         sampled = any(
             seg.request.sampling_params.temperature > 0.0
@@ -841,8 +841,24 @@ class StageEngine:
             # the request).
             return None
 
+        if self._needs_state:
+            # Hybrid rows must have their state slots assigned before the
+            # window (the normal path does this per step; here the whole
+            # window runs device-side) — and a prefix-restored request's
+            # first batch must restore BEFORE its state is read.
+            for seg in plan.seqs:
+                if not hasattr(seg.request, "state_slot"):
+                    seg.request.state_slot = self._slot_alloc.alloc() + 1
+                    src = getattr(seg.request, "restore_state_from", None)
+                    if src is not None:
+                        self.kv = self._jit_copy_state(
+                            self.kv, jnp.int32(src),
+                            jnp.int32(seg.request.state_slot),
+                        )
+                        del seg.request.restore_state_from
         inputs = assemble(
-            plan, self.spec, self.cfg.page_size, decode_only=True
+            plan, self.spec, self.cfg.page_size, decode_only=True,
+            with_dense_map=self._needs_state,
         )
         lora = self._lora_field(plan, inputs)
         if lora is not None:
@@ -911,23 +927,47 @@ class StageEngine:
                 req.num_computed_tokens += committed
                 req.ready_for_step = not req.status.is_finished
                 total += committed
+        if self._needs_state and self.cache.enable_prefix_cache:
+            # Opportunistic decode snapshots: the on-device state is at
+            # the window end, so a snapshot fires only when that lands on
+            # an aligned boundary (per-step decode hits every boundary;
+            # fused windows hit them when (context + j*k) % page == 0).
+            # Rows that FINISHED mid-window are excluded: the device ran
+            # their state past the committed context (surplus scan
+            # steps), so a snapshot would resume a future request from an
+            # over-advanced recurrence.
+            live = [s for s in plan.seqs if not s.request.status.is_finished]
+            if live:
+                self._maybe_snapshot_state(BatchPlan(live))
         return total
 
     # -- speculative decoding (prompt-lookup) -----------------------------
 
-    def _fused_common_ok(self, plan: BatchPlan) -> bool:
+    def _fused_common_ok(self, plan: BatchPlan,
+                         allow_state: bool = False) -> bool:
         """Shared disqualifier for the fused decode paths (multistep,
         speculative): single-stage engine, decode-only rows, nothing
-        needing per-step host state (penalties/logprobs/grammar/bias)."""
-        if (
-            not (self.model.is_first and self.model.is_last)
-            or self._needs_state
-        ):
+        needing per-step host state (penalties/logprobs/grammar/bias).
+
+        Hybrid (linear-state) models fuse fine in the MULTISTEP scan —
+        per-row state slots, dense map and q_lens are constant across a
+        decode window, so the recurrence advances on device exactly as
+        per-step would. Speculation stays excluded for them: rejected
+        proposal tokens would leave the recurrent state advanced past the
+        committed context with no way to rewind it."""
+        if not (self.model.is_first and self.model.is_last):
+            return False
+        if self._needs_state and not allow_state:
             return False
         for seg in plan.seqs:
             sp = seg.request.sampling_params
             if (
                 seg.num_new_tokens != 1
+                # A 1-token PROMPT's first forward also has num_new == 1;
+                # it must stay on the normal path (its reset_state flag
+                # would re-zero hybrid state at every scan step, and
+                # prefill bookkeeping differs).
+                or seg.request.status is not RequestStatus.DECODING
                 or sp.presence_penalty
                 or sp.frequency_penalty
                 or sp.repetition_penalty != 1.0

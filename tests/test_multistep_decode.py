@@ -273,3 +273,115 @@ def test_multistep_near_context_limit_falls_back():
     # clamped by the engine to the context budget, finished at length
     assert req.status.value == "finished_length"
     assert req.total_len <= 32
+
+
+# -- hybrid (linear-state) models in the fused window ------------------------
+
+
+def _hybrid_run(lookahead, prompts, max_new=10, pipeline=1, seed=None,
+                temperature=0.0):
+    from tests.test_linear_prefix_cache import CONFIG as HYBRID_CFG
+    from parallax_tpu.models.registry import create_stage_model
+
+    m = create_stage_model(HYBRID_CFG, 0, 4, use_pallas=False)
+    eng = StageEngine(
+        m, m.init_params(jax.random.key(0), dtype=jnp.float32),
+        EngineConfig(page_size=8, num_pages=128, max_model_len=256,
+                     kv_dtype="float32", decode_lookahead=lookahead,
+                     decode_pipeline=pipeline),
+    )
+    windows = []
+    orig = eng._try_multistep
+    eng._try_multistep = lambda plan: windows.append(1) or orig(plan)
+    pipe = InProcessPipeline([eng])
+    reqs = []
+    for i, p in enumerate(prompts):
+        r = Request(f"h{i}", prompt_ids=list(p),
+                    sampling_params=SamplingParams(
+                        temperature=temperature, max_new_tokens=max_new,
+                        ignore_eos=True, seed=seed))
+        reqs.append(r)
+        pipe.submit(r)
+    pipe.run_until_complete()
+    return reqs, orig
+
+
+def test_hybrid_multistep_matches_single_step_exactly():
+    """Linear-state models now fuse the decode window: the recurrence
+    advances inside the scan (constant slots/dense map per window) and
+    must match per-step decode token-for-token."""
+    prompts = [[1, 2, 3, 4, 5, 6, 7], [9, 8, 7, 6, 5]]
+    base, _ = _hybrid_run(1, prompts)
+    fused, orig = _hybrid_run(4, prompts)
+    for b, f in zip(base, fused):
+        assert f.output_ids == b.output_ids
+        assert len(f.output_ids) == 10
+
+
+def test_hybrid_multistep_sampled_seeded_matches():
+    prompts = [[1, 2, 3, 4, 5, 6, 7]]
+    base, _ = _hybrid_run(1, prompts, seed=42, temperature=0.8)
+    fused, _ = _hybrid_run(4, prompts, seed=42, temperature=0.8)
+    assert fused[0].output_ids == base[0].output_ids
+
+
+def test_hybrid_pipelined_windows_match():
+    prompts = [[3, 1, 4, 1, 5, 9, 2, 6]]
+    base, _ = _hybrid_run(1, prompts, max_new=16)
+    fused, _ = _hybrid_run(4, prompts, max_new=16, pipeline=3)
+    assert fused[0].output_ids == base[0].output_ids
+
+
+def test_one_token_prompt_stays_on_normal_path():
+    """A 1-token prompt's first forward has num_new == 1 but is a
+    PREFILL; it must not enter the fused window (hybrids would re-zero
+    their state every scan step; prefill bookkeeping differs)."""
+    base, _ = _hybrid_run(1, [[7]], max_new=8)
+    fused, _ = _hybrid_run(4, [[7]], max_new=8)
+    assert fused[0].output_ids == base[0].output_ids
+    # Dense model too.
+    (b,), _ = _run(1, [[7]], max_new=8)
+    (f,), _ = _run(4, [[7]], max_new=8)
+    assert f.output_ids == b.output_ids
+
+
+def test_hybrid_mid_window_finish_never_snapshots_overrun_state():
+    """A row finishing mid-window has device state PAST its committed
+    context; that state must never be donated as a prefix snapshot. A
+    follow-up sharing the conversation must emit oracle tokens (resuming
+    from a shallower, valid snapshot instead)."""
+    from tests.test_linear_prefix_cache import CONFIG as HYBRID_CFG
+    from parallax_tpu.models.registry import create_stage_model
+
+    def build(lookahead, prefix):
+        m = create_stage_model(HYBRID_CFG, 0, 4, use_pallas=False)
+        return StageEngine(
+            m, m.init_params(jax.random.key(0), dtype=jnp.float32),
+            EngineConfig(page_size=8, num_pages=128, max_model_len=256,
+                         kv_dtype="float32", decode_lookahead=lookahead,
+                         enable_prefix_cache=prefix,
+                         linear_decode_snapshot_stride=1),
+        )
+
+    def run(eng, rid, ids, n):
+        r = Request(rid, prompt_ids=list(ids),
+                    sampling_params=SamplingParams(
+                        temperature=0.0, max_new_tokens=n, ignore_eos=True))
+        p = InProcessPipeline([eng])
+        p.submit(r)
+        p.run_until_complete()
+        return r
+
+    # prompt 11 + 5 generated = 16 = page-aligned finish, mid-window for
+    # k=4 (window 2 stops after 1 commit; device ran 4 more scan steps).
+    prompt = list(range(1, 12))
+    oracle = build(1, prefix=False)
+    o1 = run(oracle, "o1", prompt, 5)
+    convo = prompt + o1.output_ids
+    o2 = run(oracle, "o2", convo + [40, 41], 6)
+
+    eng = build(4, prefix=True)
+    r1 = run(eng, "r1", prompt, 5)
+    assert r1.output_ids == o1.output_ids
+    r2 = run(eng, "r2", convo + [40, 41], 6)
+    assert r2.output_ids == o2.output_ids   # over-advanced state never used
