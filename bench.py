@@ -51,6 +51,12 @@ a 4-layer dense-FFN stage so one chip holds it. Its ``vs_baseline``
 compares achieved HBM bandwidth against the 40%-of-roofline efficiency
 the main number's baseline assumes (1.0 == SGLang-class efficiency).
 
+``BENCH_MODEL=hybrid`` benchmarks the hybrid linear-attention path:
+Qwen3-Next per-layer geometry (GatedDeltaNet 3:1 + gated full attention,
+MoE FFN) on a reduced-depth stage, decoding through the FUSED multistep
+window (the recurrence advances inside the scan). Same
+bandwidth-efficiency ``vs_baseline`` convention as the DSA mode.
+
 ``vs_baseline`` (default mode) compares against a roofline-derived
 estimate of the reference's CUDA backend on 2xA100-80G (the repo
 publishes no numbers — BASELINE.json ``published: {}``): decode at batch
@@ -412,6 +418,61 @@ def _bench():
             dtype, kv_dtype, page_size = jnp.float32, "float32", 16
             lookahead = int(os.environ.get("BENCH_LOOKAHEAD", "1"))
             pipeline = int(os.environ.get("BENCH_PIPELINE", "1"))
+    elif mode == "hybrid":
+        # Hybrid (linear-attention) benchmark: Qwen3-Next per-layer
+        # geometry (GatedDeltaNet 3:1 with gated full attention, dense
+        # FFN) cut to a reduced-depth stage one chip holds. Decode runs
+        # the FUSED multistep window — the recurrence advances inside the
+        # scan — so the number reflects the production hybrid path.
+        if on_tpu:
+            raw = dict(
+                architectures=["Qwen3NextForCausalLM"], hidden_size=2048,
+                num_hidden_layers=8, num_attention_heads=16,
+                num_key_value_heads=2, head_dim=256,
+                intermediate_size=5120,
+                moe_intermediate_size=1024, num_experts=8,
+                num_experts_per_tok=2,
+                shared_expert_intermediate_size=1024,
+                decoder_sparse_step=1, mlp_only_layers=[],
+                norm_topk_prob=True,
+                layer_types=["linear_attention", "linear_attention",
+                             "linear_attention", "full_attention"] * 2,
+                linear_conv_kernel_dim=4, linear_num_key_heads=16,
+                linear_num_value_heads=32, linear_key_head_dim=128,
+                linear_value_head_dim=128, partial_rotary_factor=0.25,
+                vocab_size=151936, max_position_embeddings=32768,
+                rope_theta=10000000.0, tie_word_embeddings=False,
+                attention_bias=False,
+            )
+            cfg = normalize_config(raw, model_name="hybrid-bench")
+            batch = int(os.environ.get("BENCH_BATCH", "64"))
+            prompt_len = int(os.environ.get("BENCH_CTX", "512"))
+            dtype, kv_dtype, page_size = jnp.bfloat16, "bfloat16", 64
+            lookahead = int(os.environ.get("BENCH_LOOKAHEAD", "16"))
+            pipeline = int(os.environ.get("BENCH_PIPELINE", "4"))
+            gen_len = max(129, 1 + max(1, pipeline) * max(1, lookahead))
+        else:
+            raw = dict(
+                architectures=["Qwen3NextForCausalLM"], hidden_size=64,
+                num_hidden_layers=4, num_attention_heads=4,
+                num_key_value_heads=2, head_dim=16, intermediate_size=128,
+                moe_intermediate_size=32, num_experts=4,
+                num_experts_per_tok=2, shared_expert_intermediate_size=32,
+                decoder_sparse_step=1, mlp_only_layers=[],
+                norm_topk_prob=True,
+                layer_types=["linear_attention", "full_attention"] * 2,
+                linear_conv_kernel_dim=4, linear_num_key_heads=2,
+                linear_num_value_heads=4, linear_key_head_dim=16,
+                linear_value_head_dim=16, partial_rotary_factor=0.25,
+                vocab_size=512, max_position_embeddings=2048,
+                rope_theta=10000.0, tie_word_embeddings=False,
+                attention_bias=False,
+            )
+            cfg = normalize_config(raw, model_name="hybrid-bench")
+            batch, prompt_len, gen_len = 4, 64, 16
+            dtype, kv_dtype, page_size = jnp.float32, "float32", 16
+            lookahead = int(os.environ.get("BENCH_LOOKAHEAD", "4"))
+            pipeline = int(os.environ.get("BENCH_PIPELINE", "1"))
     elif on_tpu:
         full = get_preset("qwen2.5-7b")
         # One chip's workload of 2-stage PP: half the layers (+ both ends).
@@ -567,7 +628,7 @@ def _bench():
     # pipeline emits one batch per *stage* step and we measured one
     # stage's workload, so per-chip rate is half the measured rate.
     step_ms = statistics.median(dispatch_times) if dispatch_times else 0.0
-    pp_div = 1.0 if mode == "dsa" else 2.0
+    pp_div = 1.0 if mode in ("dsa", "hybrid") else 2.0
     tokens_per_sec_per_chip = decode_tokens / max(decode_wall_s, 1e-9) / pp_div
     if not phase_ok:
         # Never report prefill tokens as decode throughput.
@@ -598,6 +659,37 @@ def _bench():
         metric = (
             f"output tokens/sec/chip (DSA sparse decode, V3.2 geometry, "
             f"ctx={prompt_len}, topk={d.index_topk if d else 0})"
+        )
+    elif mode == "hybrid":
+        # vs_baseline: achieved HBM bandwidth over the same
+        # 40%-of-roofline efficiency bar. Decode-step bytes ~= params +
+        # per-request linear-state traffic (conv + recurrent rows read
+        # AND written per linear layer) + the full-attention layers'
+        # context KV reads.
+        elem = 2 if on_tpu else 4
+        la = cfg.linear_attn
+        n_linear = sum(
+            1 for i in range(cfg.num_hidden_layers)
+            if cfg.layer_type(i) == "linear_attention"
+        )
+        n_full = cfg.num_hidden_layers - n_linear
+        conv_dim = (2 * la.num_k_heads * la.head_k_dim
+                    + la.num_v_heads * la.head_v_dim)
+        state_bytes = 2 * batch * n_linear * (
+            conv_dim * (la.conv_kernel_size - 1)
+            + la.num_v_heads * la.head_k_dim * la.head_v_dim
+        ) * 4   # state arrays are f32
+        kv_bytes = (
+            batch * n_full * prompt_len
+            * 2 * cfg.num_key_value_heads * cfg.head_dim * elem
+        )
+        step_bytes = params_bytes + state_bytes + kv_bytes
+        bw = hw.hbm_gbps * 1e9 if on_tpu else 50e9
+        roofline_tps = bw / max(step_bytes, 1) * batch
+        vs_baseline = tokens_per_sec_per_chip / max(0.4 * roofline_tps, 1e-9)
+        metric = (
+            f"output tokens/sec/chip (hybrid GatedDeltaNet decode, "
+            f"Qwen3-Next geometry, fused window, ctx={prompt_len})"
         )
     else:
         vs_baseline = (

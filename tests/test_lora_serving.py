@@ -764,3 +764,21 @@ class TestMixedAdapterBatches:
         # Everyone finished — no tenant starved behind the cap.
         for r in reqs:
             assert len(r.output_ids) == 6, r.request_id
+
+    def test_mixed_decode_with_speculation(self):
+        """Speculation engages on mixed-adapter batches too (the spec
+        plan inherits mixed_lora and repeats each row's slot across its
+        fed positions); outputs stay exact per tenant."""
+        solo = {}
+        for lora in ("ad1", "ad2"):
+            eng, _ = self._three_tenant_engine()
+            (r,) = self._run_many(eng, [("s", lora)], n=10)
+            solo[lora] = r.output_ids
+        eng, _ = base_engine({
+            "ad1": make_adapter(1, layers=[0, 2]),
+            "ad2": make_adapter(2, layers=[1, 3]),
+        })
+        eng.cfg.speculative_tokens = 3
+        reqs = self._run_many(eng, [("a", "ad1"), ("b", "ad2")], n=10)
+        for r, lora in zip(reqs, ("ad1", "ad2")):
+            assert r.output_ids == solo[lora]
