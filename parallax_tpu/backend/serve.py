@@ -269,6 +269,7 @@ def serve_main(args) -> int:
             prefill_chunk_size=getattr(args, "prefill_chunk_size", 1024),
             kv_dtype=getattr(args, "kv_dtype", "bfloat16"),
             enable_prefix_cache=not getattr(args, "no_prefix_cache", False),
+            linear_prefix_slots=getattr(args, "linear_prefix_slots", 32),
             sp_threshold=sp_threshold,
             decode_lookahead=getattr(args, "decode_lookahead", 1) or 1,
             decode_pipeline=getattr(args, "decode_pipeline", 1) or 1,

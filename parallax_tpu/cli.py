@@ -39,6 +39,12 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--kv-dtype", choices=["bfloat16", "float32"],
                        default="bfloat16")
     serve.add_argument("--no-prefix-cache", action="store_true")
+    serve.add_argument(
+        "--linear-prefix-slots", type=int, default=32,
+        help="hybrid models: device slots for linear-state prefix "
+             "snapshots (~2x expected concurrent requests; 0 disables "
+             "hybrid prefix caching)",
+    )
     serve.add_argument("--quantization", choices=["int8", "int4"],
                        default=None,
                        help="weight-only quantize an fp checkpoint on load")
