@@ -107,8 +107,9 @@ def load_library():
             ),
             "cache_admit": (
                 [ctypes.c_void_p, ctypes.c_void_p, i32p, ctypes.c_int64,
-                 ctypes.c_int32, i32p, ctypes.c_int64,
-                 ctypes.POINTER(ctypes.c_int64)],
+                 ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+                 i32p, ctypes.c_int64,
+                 ctypes.POINTER(ctypes.c_int64), i32p],
                 ctypes.c_int64,
             ),
             "cache_grow": (
@@ -118,8 +119,17 @@ def load_library():
             "cache_release": (
                 [ctypes.c_void_p, ctypes.c_void_p, i32p, ctypes.c_int64,
                  ctypes.c_int64, i32p, ctypes.c_int64, ctypes.c_int64,
-                 ctypes.c_int32],
-                None,
+                 ctypes.c_int32, ctypes.POINTER(ctypes.c_int64), i32p,
+                 ctypes.c_int64, i32p],
+                ctypes.c_int64,
+            ),
+            "radix_attach_slot": (
+                [ctypes.c_void_p, i32p, ctypes.c_int64, ctypes.c_int32],
+                ctypes.c_int32,
+            ),
+            "radix_detach_lru_slot": ([ctypes.c_void_p], ctypes.c_int32),
+            "radix_take_freed_slots": (
+                [ctypes.c_void_p, i32p, ctypes.c_int64], ctypes.c_int64
             ),
         }
         for name, (argtypes, restype) in sigs.items():
@@ -145,13 +155,42 @@ class NativeRadixPageCache:
     objects; ``match_prefix`` returns that handle as its second element.
     """
 
-    def __init__(self, page_size: int, on_evict=None):
+    def __init__(self, page_size: int, on_evict=None, on_evict_slot=None):
         self._lib = load_library()
         if self._lib is None:
             raise RuntimeError("native library unavailable")
         self.page_size = page_size
         self.on_evict = on_evict
+        self.on_evict_slot = on_evict_slot
         self._h = self._lib.radix_new(page_size)
+
+    def _drain_slots(self) -> None:
+        """Return snapshot slots orphaned by eviction/reset to the
+        engine's pool (mirrors the Python radix's on_evict_slot).
+        No-op without a slot consumer — slots only exist for hybrid
+        managers, and the drain must not cost the non-hybrid hot path
+        an ABI crossing."""
+        if self.on_evict_slot is None:
+            return
+        if not hasattr(self, "_slot_buf"):
+            self._slot_buf = np.empty(64, np.int32)
+        out = self._slot_buf
+        while True:
+            n = self._lib.radix_take_freed_slots(self._h, _ptr(out), 64)
+            for s in out[:n].tolist():
+                self.on_evict_slot(int(s))
+            if n < 64:
+                return
+
+    def attach_linear_slot(self, token_ids, slot: int) -> bool:
+        tokens = _as_i32(token_ids)
+        return bool(self._lib.radix_attach_slot(
+            self._h, _ptr(tokens), len(tokens), slot
+        ))
+
+    def detach_lru_linear_slot(self):
+        slot = int(self._lib.radix_detach_lru_slot(self._h))
+        return None if slot < 0 else slot
 
     def __del__(self):
         try:
@@ -210,12 +249,14 @@ class NativeRadixPageCache:
         if self.on_evict:
             for p in freed:
                 self.on_evict(p)
+        self._drain_slots()
         return freed
 
     def reset(self) -> list[int]:
         cap = self.num_cached_pages or 1
         out = np.empty(cap, np.int32)
         n = self._lib.radix_reset(self._h, _ptr(out), cap)
+        self._drain_slots()
         return out[:n].tolist()
 
 
@@ -268,7 +309,9 @@ class NativeCacheManager:
 
     def __init__(self, page_size: int, num_pages: int,
                  enable_prefix_cache: bool = True,
-                 max_model_len: int = 32768):
+                 max_model_len: int = 32768,
+                 linear_state: bool = False,
+                 on_slot_free=None):
         self._lib = load_library()
         if self._lib is None:
             raise RuntimeError("native library unavailable")
@@ -276,7 +319,14 @@ class NativeCacheManager:
         self.num_pages = num_pages
         self.max_model_len = max_model_len
         self.enable_prefix_cache = enable_prefix_cache
-        self.prefix_cache = NativeRadixPageCache(page_size)
+        # Hybrid models: matches truncate to snapshot-carrying nodes and
+        # release attaches per-request snapshots (see the Python
+        # CacheManager for the semantics; differential-fuzzed).
+        self.linear_state = linear_state
+        self.on_slot_free = on_slot_free
+        self.prefix_cache = NativeRadixPageCache(
+            page_size, on_evict_slot=on_slot_free
+        )
         self.allocator = NativePageAllocator(num_pages)
         # rid -> number of tree-shared pages (for release's unlock walk).
         self._shared: dict[str, int] = {}
@@ -306,22 +356,34 @@ class NativeCacheManager:
     # -- request lifecycle ------------------------------------------------
 
     def allocate_for_prompt(self, request) -> bool:
+        if self.linear_state and hasattr(request, "restore_state_from"):
+            del request.restore_state_from  # stale from a failed admit
         tokens = self._ns_i32(
             request.prompt_ids, getattr(request, "lora_id", None)
         )
         cap = self.pages_needed(len(tokens)) + 1
         out = np.empty(cap, np.int32)
         shared = ctypes.c_int64(0)
+        restore = np.full(1, -1, np.int32)
+        head_cached = getattr(request, "mirror_head_cached", None)
+        pages_cap = (
+            head_cached // self.page_size
+            if self.linear_state and head_cached is not None else -1
+        )
         total = self._lib.cache_admit(
             self.prefix_cache._h, self.allocator._h,
             _ptr(tokens), len(tokens), int(self.enable_prefix_cache),
-            _ptr(out), cap, ctypes.byref(shared),
+            int(self.linear_state), pages_cap,
+            _ptr(out), cap, ctypes.byref(shared), _ptr(restore),
         )
+        self.prefix_cache._drain_slots()   # admit may have evicted
         if total < 0:
             return False
         request.page_ids = out[:total].tolist()
         request.num_cached_tokens = int(shared.value) * self.page_size
         request.num_computed_tokens = request.num_cached_tokens
+        if int(restore[0]) >= 0:
+            request.restore_state_from = int(restore[0])
         self._shared[request.request_id] = int(shared.value)
         return True
 
@@ -333,6 +395,7 @@ class NativeCacheManager:
         got = self._lib.cache_grow(
             self.prefix_cache._h, self.allocator._h, need, _ptr(out)
         )
+        self.prefix_cache._drain_slots()   # grow may have evicted
         if got < 0:
             return False
         request.page_ids.extend(out[:need].tolist())
@@ -340,8 +403,14 @@ class NativeCacheManager:
 
     def release(self, request) -> None:
         n_shared = self._shared.pop(request.request_id, 0)
+        snapshots = list(getattr(request, "state_snapshots", {}).values())
+        if hasattr(request, "state_snapshots"):
+            del request.state_snapshots
         pages = _as_i32(request.page_ids)
         if not len(pages):
+            if self.on_slot_free:
+                for _length, slot in snapshots:
+                    self.on_slot_free(slot)
             request.page_ids = []
             return
         tokens = self._ns_i32(
@@ -352,11 +421,30 @@ class NativeCacheManager:
             self.enable_prefix_cache
             and request.status.value != "finished_abort"
         )
-        self._lib.cache_release(
-            self.prefix_cache._h, self.allocator._h,
-            _ptr(tokens), len(tokens), computed,
-            _ptr(pages), len(pages), n_shared, insert,
-        )
+        if snapshots:
+            snap_lens = np.ascontiguousarray(
+                [length for length, _ in snapshots], dtype=np.int64
+            )
+            snap_slots = _as_i32([slot for _, slot in snapshots])
+            unattached = np.empty(len(snapshots), np.int32)
+            n_un = self._lib.cache_release(
+                self.prefix_cache._h, self.allocator._h,
+                _ptr(tokens), len(tokens), computed,
+                _ptr(pages), len(pages), n_shared, insert,
+                snap_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                _ptr(snap_slots), len(snapshots), _ptr(unattached),
+            )
+            if self.on_slot_free:
+                for slot in unattached[:n_un].tolist():
+                    self.on_slot_free(int(slot))
+        else:
+            # Non-hybrid fast path: zero extra allocations per release.
+            self._lib.cache_release(
+                self.prefix_cache._h, self.allocator._h,
+                _ptr(tokens), len(tokens), computed,
+                _ptr(pages), len(pages), n_shared, insert,
+                None, None, 0, None,
+            )
         request.page_ids = []
 
     def reset_prefix_cache(self) -> None:

@@ -24,6 +24,9 @@ struct Node {
     std::map<Key, Node*> children;
     int32_t lock_ref = 0;
     uint64_t last_access = 0;
+    // Linear-state snapshot slot at this node's token boundary (hybrid
+    // models; -1 = none). Mirrors runtime/radix_cache.py.
+    int32_t linear_slot = -1;
 
     ~Node() {
         for (auto& kv : children) delete kv.second;
@@ -35,6 +38,9 @@ struct RadixTree {
     int32_t page_size;
     int64_t num_pages = 0;
     uint64_t clock = 0;
+    // Snapshot slots orphaned by eviction/reset, drained by the Python
+    // side (radix_take_freed_slots) back to the engine's slot pool.
+    std::vector<int32_t> freed_slots;
 
     explicit RadixTree(int32_t ps) : page_size(ps) {
         root.page_id = -1;
@@ -72,10 +78,24 @@ int32_t evict_one(RadixTree* t) {
     }
     if (!best) return -1;
     int32_t page = best->page_id;
+    if (best->linear_slot >= 0) t->freed_slots.push_back(best->linear_slot);
     best->parent->children.erase(best->key);
     delete best;
     t->num_pages--;
     return page;
+}
+
+// Walk to the node covering exactly n_pages full pages of tokens;
+// nullptr when the path does not exist.
+Node* walk_to(RadixTree* t, const int32_t* tokens, int64_t n_pages) {
+    Node* node = &t->root;
+    for (int64_t i = 0; i < n_pages; i++) {
+        Key key = make_key(tokens, i * t->page_size, t->page_size);
+        auto it = node->children.find(key);
+        if (it == node->children.end()) return nullptr;
+        node = it->second;
+    }
+    return node;
 }
 
 // Walk/extend the tree with full pages of tokens; existing keys with a
@@ -202,11 +222,57 @@ int64_t radix_reset(void* handle, int32_t* out_pages, int64_t max_out) {
         Node* cur = stack.back();
         stack.pop_back();
         if (n < max_out) out_pages[n++] = cur->page_id;
+        if (cur->linear_slot >= 0) t->freed_slots.push_back(cur->linear_slot);
         for (auto& kv : cur->children) stack.push_back(kv.second);
     }
     for (auto& kv : t->root.children) delete kv.second;
     t->root.children.clear();
     t->num_pages = 0;
+    return n;
+}
+
+// Attach a snapshot slot at the node covering exactly n_tokens (a whole
+// number of pages); 1 on success, 0 when the node is missing, the length
+// is ragged, or a slot is already attached (caller keeps ownership).
+int32_t radix_attach_slot(void* handle, const int32_t* tokens,
+                          int64_t n_tokens, int32_t slot) {
+    auto* t = static_cast<RadixTree*>(handle);
+    if (n_tokens <= 0 || n_tokens % t->page_size) return 0;
+    Node* node = walk_to(t, tokens, n_tokens / t->page_size);
+    if (!node || node->linear_slot >= 0) return 0;
+    node->linear_slot = slot;
+    return 1;
+}
+
+// Reclaim the LRU unpinned snapshot slot (the node keeps its pages);
+// returns the slot id or -1.
+int32_t radix_detach_lru_slot(void* handle) {
+    auto* t = static_cast<RadixTree*>(handle);
+    Node* best = nullptr;
+    std::vector<Node*> stack;
+    for (auto& kv : t->root.children) stack.push_back(kv.second);
+    while (!stack.empty()) {
+        Node* cur = stack.back();
+        stack.pop_back();
+        for (auto& kv : cur->children) stack.push_back(kv.second);
+        if (cur->linear_slot >= 0 && cur->lock_ref <= 0) {
+            if (!best || cur->last_access < best->last_access) best = cur;
+        }
+    }
+    if (!best) return -1;
+    int32_t slot = best->linear_slot;
+    best->linear_slot = -1;
+    return slot;
+}
+
+// Drain snapshot slots orphaned by eviction/reset since the last drain.
+int64_t radix_take_freed_slots(void* handle, int32_t* out, int64_t max_out) {
+    auto* t = static_cast<RadixTree*>(handle);
+    int64_t n = 0;
+    while (n < max_out && !t->freed_slots.empty()) {
+        out[n++] = t->freed_slots.back();
+        t->freed_slots.pop_back();
+    }
     return n;
 }
 
@@ -236,21 +302,41 @@ int64_t evict_into(RadixTree* t, PageAlloc* a, int64_t need) {
 // Writes shared+fresh page ids to out_pages; *out_shared = matched pages.
 // Returns total pages, or -1 when memory is insufficient (fully rolled
 // back: locks released, nothing allocated).
+//
+// Hybrid models (linear_state != 0): the match additionally truncates to
+// the deepest node carrying a linear-state snapshot (the recurrence
+// cannot resume from pages alone); that slot id lands in
+// *out_restore_slot (-1 = no hit). max_pages_cap (-1 = none) caps the
+// walk for mirror stages that must skip exactly the head's count.
 int64_t cache_admit(void* tree_h, void* alloc_h, const int32_t* tokens,
                     int64_t n_tokens, int32_t enable_prefix,
+                    int32_t linear_state, int64_t max_pages_cap,
                     int32_t* out_pages, int64_t max_out,
-                    int64_t* out_shared) {
+                    int64_t* out_shared, int32_t* out_restore_slot) {
     auto* t = static_cast<RadixTree*>(tree_h);
     auto* a = static_cast<PageAlloc*>(alloc_h);
     int64_t total = (n_tokens + t->page_size - 1) / t->page_size;
     if (total > max_out) return -1;
+    *out_restore_slot = -1;
 
     // Match (capped at usable) collecting the node path for lock/unlock.
     std::vector<Node*> path;
     int64_t matched = 0;
     if (enable_prefix && n_tokens > 1) {
         int64_t usable = (n_tokens - 1) / t->page_size;
+        if (max_pages_cap >= 0 && max_pages_cap < usable) {
+            usable = max_pages_cap;
+        }
         matched = match_walk(t, tokens, n_tokens, usable, out_pages, &path);
+        if (linear_state) {
+            while (matched > 0 && path[matched - 1]->linear_slot < 0) {
+                matched--;
+            }
+            path.resize(matched);
+            if (matched > 0) {
+                *out_restore_slot = path[matched - 1]->linear_slot;
+            }
+        }
     }
     for (Node* n : path) n->lock_ref++;
 
@@ -287,13 +373,19 @@ int64_t cache_grow(void* tree_h, void* alloc_h, int64_t n, int32_t* out) {
 }
 
 // Release a finished request in ONE crossing: unlock the shared path,
-// donate fully-computed pages to the tree, free duplicates + the tail.
+// donate fully-computed pages to the tree, free duplicates + the tail,
+// and attach linear-state snapshots (snap_lens[i] tokens -> snap_slots[i])
+// to their radix nodes. Unattachable snapshots are reported in
+// out_unattached (capacity n_snaps); return value = their count — the
+// caller returns those slots to the engine's pool.
 // ``computed`` = tokens whose KV is real (the final sampled token's is
 // not). ``insert`` = 0 frees everything owned outright (abort path).
-void cache_release(void* tree_h, void* alloc_h, const int32_t* tokens,
-                   int64_t n_tokens, int64_t computed,
-                   const int32_t* pages, int64_t n_pages, int64_t n_shared,
-                   int32_t insert) {
+int64_t cache_release(void* tree_h, void* alloc_h, const int32_t* tokens,
+                      int64_t n_tokens, int64_t computed,
+                      const int32_t* pages, int64_t n_pages, int64_t n_shared,
+                      int32_t insert,
+                      const int64_t* snap_lens, const int32_t* snap_slots,
+                      int64_t n_snaps, int32_t* out_unattached) {
     auto* t = static_cast<RadixTree*>(tree_h);
     auto* a = static_cast<PageAlloc*>(alloc_h);
     // Unlock the shared prefix path.
@@ -307,12 +399,17 @@ void cache_release(void* tree_h, void* alloc_h, const int32_t* tokens,
             node->lock_ref--;
         }
     }
-    if (n_pages <= n_shared) return;
-    if (!insert) {
+    int64_t n_unattached = 0;
+    if (n_pages <= n_shared || !insert) {
+        // Nothing donated: every snapshot slot goes back to the pool,
+        // and an abort's owned pages are freed outright.
+        for (int64_t i = 0; i < n_snaps; i++) {
+            out_unattached[n_unattached++] = snap_slots[i];
+        }
         for (int64_t i = n_shared; i < n_pages; i++) {
             if (pages[i] != a->null_page) a->free_list.push_back(pages[i]);
         }
-        return;
+        return n_unattached;
     }
     if (computed > n_tokens) computed = n_tokens;
     int64_t n_full = computed / t->page_size;
@@ -333,6 +430,19 @@ void cache_release(void* tree_h, void* alloc_h, const int32_t* tokens,
     for (int64_t i = tail_start; i < n_pages; i++) {
         if (pages[i] != a->null_page) a->free_list.push_back(pages[i]);
     }
+    // Attach snapshots at their exact boundaries within the donated span.
+    for (int64_t i = 0; i < n_snaps; i++) {
+        int64_t len = snap_lens[i];
+        bool ok = len > 0 && len % t->page_size == 0
+                  && len <= n_full * t->page_size;
+        if (ok) {
+            Node* node = walk_to(t, tokens, len / t->page_size);
+            ok = node && node->linear_slot < 0;
+            if (ok) node->linear_slot = snap_slots[i];
+        }
+        if (!ok) out_unattached[n_unattached++] = snap_slots[i];
+    }
+    return n_unattached;
 }
 
 // ---- page allocator -------------------------------------------------------

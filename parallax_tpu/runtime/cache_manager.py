@@ -71,17 +71,6 @@ def make_cache_manager(
 
     if use_native is None:
         use_native = not os.environ.get("PARALLAX_TPU_NO_NATIVE")
-    if linear_state and enable_prefix_cache:
-        # Hybrid models need the linear-slot-aware radix walk (match
-        # truncation + snapshot attach); the C++ manager doesn't speak it,
-        # and the Python walk is not the bottleneck for these models.
-        # With prefix caching off the walk never runs, so such engines
-        # keep the native manager below.
-        return CacheManager(
-            page_size, num_pages, enable_prefix_cache=enable_prefix_cache,
-            max_model_len=max_model_len, linear_state=True,
-            on_slot_free=on_slot_free,
-        )
     if use_native:
         try:
             from parallax_tpu import native
@@ -91,12 +80,15 @@ def make_cache_manager(
                     page_size, num_pages,
                     enable_prefix_cache=enable_prefix_cache,
                     max_model_len=max_model_len,
+                    linear_state=linear_state,
+                    on_slot_free=on_slot_free,
                 )
         except Exception as e:  # pragma: no cover - env specific
             logger.warning("native cache unavailable: %s", e)
     return CacheManager(
         page_size, num_pages, enable_prefix_cache=enable_prefix_cache,
-        max_model_len=max_model_len,
+        max_model_len=max_model_len, linear_state=linear_state,
+        on_slot_free=on_slot_free,
     )
 
 

@@ -249,3 +249,74 @@ def test_native_manager_faster_than_python():
     print(f"python {t_py*1e3:.1f} ms vs native {t_nat*1e3:.1f} ms "
           f"({t_py/t_nat:.2f}x)")
     assert t_nat < t_py, (t_py, t_nat)
+
+
+def test_linear_state_cache_manager_differential():
+    """Hybrid differential: the linear-slot semantics (match truncation
+    to snapshot-carrying nodes, restore-slot surfacing, snapshot attach
+    on release, orphaned-slot draining on eviction) must be identical
+    between the Python CacheManager and the native one."""
+    from parallax_tpu.runtime.cache_manager import CacheManager
+    from parallax_tpu.runtime.request import RequestStatus
+
+    rng = np.random.default_rng(7)
+    freed_py, freed_nat = [], []
+    py = CacheManager(page_size=4, num_pages=48, linear_state=True,
+                      on_slot_free=freed_py.append)
+    nat = native.NativeCacheManager(page_size=4, num_pages=48,
+                                    linear_state=True,
+                                    on_slot_free=freed_nat.append)
+    next_slot = [1]
+    live: list[tuple] = []
+
+    for step in range(400):
+        op = rng.random()
+        if op < 0.5 or not live:
+            n = int(rng.integers(2, 32))
+            prompt = [int(x) for x in rng.integers(0, 3, size=n)]
+            r1 = _mk_req(f"p{step}", prompt)
+            r2 = _mk_req(f"p{step}", prompt)
+            ok1 = py.allocate_for_prompt(r1)
+            ok2 = nat.allocate_for_prompt(r2)
+            assert ok1 == ok2, step
+            if ok1:
+                assert r1.num_cached_tokens == r2.num_cached_tokens, step
+                assert (getattr(r1, "restore_state_from", None)
+                        == getattr(r2, "restore_state_from", None)), step
+                r1.num_computed_tokens = r2.num_computed_tokens = n
+                live.append((r1, r2))
+        else:
+            idx = int(rng.integers(len(live)))
+            r1, r2 = live.pop(idx)
+            # Half the finishes carry snapshots at aligned boundaries.
+            if rng.random() < 0.6:
+                snaps = {}
+                aligned = (r1.num_computed_tokens // 4) * 4
+                if aligned >= 4:
+                    slot = next_slot[0]
+                    next_slot[0] += 1
+                    snaps["prefill"] = (aligned, slot)
+                    if aligned >= 8 and rng.random() < 0.5:
+                        slot2 = next_slot[0]
+                        next_slot[0] += 1
+                        snaps = {"prefill": (aligned - 4, slot),
+                                 "decode": (aligned, slot2)}
+                if snaps:
+                    r1.state_snapshots = dict(snaps)
+                    r2.state_snapshots = dict(snaps)
+            status = (RequestStatus.FINISHED_ABORT if rng.random() < 0.2
+                      else RequestStatus.FINISHED_EOS)
+            r1.status = r2.status = status
+            py.release(r1)
+            nat.release(r2)
+        assert py.num_free_pages == nat.num_free_pages, step
+        assert (py.prefix_cache.num_cached_pages
+                == nat.prefix_cache.num_cached_pages), step
+        assert sorted(freed_py) == sorted(freed_nat), step
+    # Exercised both hit and slot-recycling paths.
+    assert freed_py, "fuzz never freed a snapshot slot"
+
+    # LRU slot detach agrees too (engine slot-steal path).
+    d1 = py.prefix_cache.detach_lru_linear_slot()
+    d2 = nat.prefix_cache.detach_lru_linear_slot()
+    assert (d1 is None) == (d2 is None)
