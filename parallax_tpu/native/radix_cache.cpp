@@ -319,7 +319,11 @@ int64_t cache_admit(void* tree_h, void* alloc_h, const int32_t* tokens,
     if (total > max_out) return -1;
     *out_restore_slot = -1;
 
-    // Match (capped at usable) collecting the node path for lock/unlock.
+    // Match collecting the node path for lock/unlock. The walk itself is
+    // UNCAPPED (bounded by the prompt) and the cap applies afterwards:
+    // the Python oracle refreshes every matched node's access clock
+    // before capping, and LRU eviction order must agree between the two
+    // implementations.
     std::vector<Node*> path;
     int64_t matched = 0;
     if (enable_prefix && n_tokens > 1) {
@@ -327,7 +331,11 @@ int64_t cache_admit(void* tree_h, void* alloc_h, const int32_t* tokens,
         if (max_pages_cap >= 0 && max_pages_cap < usable) {
             usable = max_pages_cap;
         }
-        matched = match_walk(t, tokens, n_tokens, usable, out_pages, &path);
+        matched = match_walk(t, tokens, n_tokens, total, out_pages, &path);
+        if (matched > usable) {
+            matched = usable;
+            path.resize(matched);
+        }
         if (linear_state) {
             while (matched > 0 && path[matched - 1]->linear_slot < 0) {
                 matched--;

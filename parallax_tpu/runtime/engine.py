@@ -1113,34 +1113,20 @@ class StageEngine:
             # the TARGET distribution with the row's params and the SAME
             # per-output-index key a sequential decode would use. Padded
             # positions keep temp=0 (argmax, discarded).
-            t_bucket = int(logits.shape[0])
-            temp = np.zeros((t_bucket,), np.float32)
-            top_k = np.zeros((t_bucket,), np.int32)
-            top_p = np.ones((t_bucket,), np.float32)
-            min_p = np.zeros((t_bucket,), np.float32)
-            seeds = np.full((t_bucket,), -1, np.int32)
-            steps = np.zeros((t_bucket,), np.int32)
+            entries = []
             row = 0
             for seg in spec_segs:
                 n_fed = seg.num_new_tokens
-                (t_i, k_i, p_i, m_i, seed_i, origin) = (
-                    self._row_sampling_fields(seg.request)
-                )
-                temp[row : row + n_fed] = t_i
-                top_k[row : row + n_fed] = k_i
-                top_p[row : row + n_fed] = p_i
-                min_p[row : row + n_fed] = m_i
-                if seed_i >= 0:
-                    seeds[row : row + n_fed] = seed_i
-                    # Position j emits output index ``origin + j`` — the
-                    # same fold_in origin as every other sampler path.
-                    steps[row : row + n_fed] = origin + np.arange(n_fed)
+                origin = self._row_sampling_fields(seg.request)[-1]
+                entries.append((seg.request, row, row + n_fed, origin))
                 row += n_fed
+            temp, top_k, top_p, min_p, seeds, steps = (
+                self._pack_lockstep_vectors(int(logits.shape[0]), entries)
+            )
             key = jax.random.fold_in(self._base_key, self._step_count)
             verified = np.asarray(sample_tokens(
-                logits, key, jnp.asarray(temp), jnp.asarray(top_k),
-                jnp.asarray(top_p), jnp.asarray(min_p),
-                seeds=jnp.asarray(seeds), out_steps=jnp.asarray(steps),
+                logits, key, temp, top_k, top_p, min_p,
+                seeds=seeds, out_steps=steps,
             ))
 
         total = 0
@@ -1189,8 +1175,10 @@ class StageEngine:
                 seg.num_new_tokens != 1
                 or req.status is not RequestStatus.DECODING
                 or getattr(req, "pp_spec_k", 0)
-                or sp.temperature > 0.0
-                or sp.seed is not None
+                # Sampled rows ARE eligible: the last stage verifies them
+                # in lockstep (sampling each fed position under the
+                # deterministic key discipline — see _verify_and_emit).
+                # Per-step host state still falls back:
                 or sp.presence_penalty
                 or sp.frequency_penalty
                 or sp.repetition_penalty != 1.0
@@ -1427,18 +1415,56 @@ class StageEngine:
         spec_rows: dict[int, list[int]],
     ) -> list[IntermediateRequest]:
         """Last stage, speculative rows present: ``out`` holds logits at
-        every fed position (gather_all_logits). Greedy-verify each spec
-        row's proposals — commit the longest agreeing prefix plus the
-        bonus token (identical acceptance rule to the single-stage
-        ``_try_speculative``) — and ring the accepted run back in ONE
-        packet. Non-spec rows sample normally off their last-position
-        logits."""
-        from parallax_tpu.ops.sampling import greedy_tokens
+        every fed position (gather_all_logits). Verify each spec row's
+        proposals — greedy rows by argmax, sampled rows in LOCKSTEP
+        (each position drawn from the target distribution under the
+        deterministic key discipline, so a seeded stream is identical
+        with and without speculation) — commit the longest agreeing
+        prefix plus the bonus token, and ring the accepted run back in
+        ONE packet. Non-spec rows sample normally off their
+        last-position logits.
 
-        greedy_all = np.asarray(greedy_tokens(out))     # [T_bucket]
+        Output-step origin for sampled verification: the mirror's
+        generated-id list already contains this packet's fed tokens
+        (including the unverified proposals), so position ``j`` of a
+        spec row emits output index ``len(gen) - (len(fed) - 1) + j``.
+        """
+        from parallax_tpu.ops.sampling import greedy_tokens, sample_tokens
+
         offs = np.concatenate([
             [0], np.cumsum([s.num_new_tokens for s in plan.seqs]),
         ]).astype(np.int64)
+        all_greedy = all(
+            plan.seqs[i].request.sampling_params.temperature <= 0.0
+            and plan.seqs[i].request.sampling_params.seed is None
+            for i in spec_rows
+        )
+        if all_greedy:
+            verified_all = np.asarray(greedy_tokens(out))   # [T_bucket]
+        else:
+            entries = []
+            for i, fed in spec_rows.items():
+                seg = plan.seqs[i]
+                origin = self._row_sampling_fields(seg.request)[-1]
+                entries.append((
+                    seg.request, int(offs[i]), int(offs[i + 1]),
+                    origin - (len(fed) - 1),
+                ))
+            temp, top_k, top_p, min_p, seeds, steps = (
+                self._pack_lockstep_vectors(int(out.shape[0]), entries)
+            )
+            # Salted: _sample runs in the SAME step for non-spec rows
+            # with the bare step key; sharing it would hand unseeded
+            # spec and rest rows at equal bucket indices identical
+            # gumbel noise (correlated streams across requests).
+            key = jax.random.fold_in(
+                jax.random.fold_in(self._base_key, self._step_count),
+                0x5BEC,
+            )
+            verified_all = np.asarray(sample_tokens(
+                out, key, temp, top_k, top_p, min_p,
+                seeds=seeds, out_steps=steps,
+            ))
         forwards: list[IntermediateRequest] = []
         rest_segs: list[ScheduledSeq] = []
         rest_rows: list[int] = []
@@ -1451,7 +1477,7 @@ class StageEngine:
             req = seg.request
             if hasattr(req, "pp_spec_fed"):
                 del req.pp_spec_fed
-            g = greedy_all[offs[i] : offs[i + 1]]
+            g = verified_all[offs[i] : offs[i + 1]]
             accepted: list[int] = []
             for j in range(len(fed)):
                 accepted.append(int(g[j]))
@@ -1510,6 +1536,36 @@ class StageEngine:
         else:
             self._pending_hidden.pop(rid)
         return take
+
+    def _pack_lockstep_vectors(self, t_bucket: int, entries):
+        """Per-POSITION sampler vectors for lockstep speculative
+        verification (single-stage and pipeline last-stage): every fed
+        position gets its row's params and the deterministic
+        ``fold_in(key(seed), output_step)`` origin. ONE implementation —
+        the _row_sampling_fields contract — so the two verify paths can
+        never drift. ``entries`` = (request, lo, hi, origin) spans.
+        Returns the sample_tokens argument tuple (minus logits/key)."""
+        temp = np.zeros((t_bucket,), np.float32)
+        top_k = np.zeros((t_bucket,), np.int32)
+        top_p = np.ones((t_bucket,), np.float32)
+        min_p = np.zeros((t_bucket,), np.float32)
+        seeds = np.full((t_bucket,), -1, np.int32)
+        steps = np.zeros((t_bucket,), np.int32)
+        for req, lo, hi, origin in entries:
+            (t_i, k_i, p_i, m_i, seed_i, _default_origin) = (
+                self._row_sampling_fields(req)
+            )
+            temp[lo:hi] = t_i
+            top_k[lo:hi] = k_i
+            top_p[lo:hi] = p_i
+            min_p[lo:hi] = m_i
+            if seed_i >= 0:
+                seeds[lo:hi] = seed_i
+                steps[lo:hi] = origin + np.arange(hi - lo)
+        return (
+            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+            jnp.asarray(min_p), jnp.asarray(seeds), jnp.asarray(steps),
+        )
 
     @classmethod
     def _row_sampling_fields(cls, req: Request):

@@ -177,3 +177,45 @@ def test_spec_wire_fields_roundtrip():
     )
     back = proto.ireq_from_wire(proto.ireq_to_wire(ring))
     assert back.spec_accepted == [9, 8, 7] and back.spec_len == 0
+
+
+def test_pp_spec_sampled_seeded_exact():
+    """VERDICT r4 #6 extended to pipelines: seeded sampled rows now
+    speculate across stages — the last stage verifies in lockstep, so
+    the stream is identical with and without pipeline speculation."""
+    specs = [
+        ([7, 8, 9, 10, 7, 8, 9, 10, 7, 8], 0.7, 123, {}),
+        ([5, 6, 5, 6, 5, 6, 5], 0.4, 9, {}),
+    ]
+    base = _serve(_build(2, 0), specs)
+    pipe = _build(2, 4)
+    # Force engagement even when sampled text never repeats: adversarial
+    # fallback proposals must cost acceptance only, never tokens.
+    head = pipe.engines[0]
+    orig_prop = head._ngram_proposal
+    head._ngram_proposal = (
+        lambda toks, n, k: orig_prop(toks, n, k) or [1, 2, 3][:k]
+    )
+    got = _serve(pipe, specs)
+    assert pipe.engines[-1].pp_spec_rounds > 0
+    for b, g in zip(base, got):
+        assert g.output_ids == b.output_ids
+        assert g.status == b.status
+
+
+def test_pp_spec_mixed_greedy_and_sampled_batch():
+    specs = [
+        ([7, 8, 9, 10, 7, 8, 9, 10, 7, 8], 0.0, None, {}),
+        ([3, 14, 15, 3, 14, 15, 3, 14], 0.6, 42, {}),
+    ]
+    base = _serve(_build(2, 0), specs)
+    pipe = _build(2, 4)
+    head = pipe.engines[0]
+    orig_prop = head._ngram_proposal
+    head._ngram_proposal = (
+        lambda toks, n, k: orig_prop(toks, n, k) or [4, 4][:k]
+    )
+    got = _serve(pipe, specs)
+    assert pipe.engines[-1].pp_spec_rounds > 0
+    for b, g in zip(base, got):
+        assert g.output_ids == b.output_ids
