@@ -48,6 +48,8 @@ class RequestResult:
     latency_s: float = 0.0
     itls: list[float] = dataclasses.field(default_factory=list)
     error: str = ""
+    text: str = ""          # assistant text (multi-turn history building)
+    turn: int = 0           # 0-based turn index within a conversation
 
 
 # -- load model -------------------------------------------------------------
@@ -226,11 +228,13 @@ def arrival_times(
 
 
 async def _one_request(
-    session, base_url: str, model: str, spec: RequestSpec
+    session, base_url: str, model: str, spec: RequestSpec,
+    messages: list | None = None,
 ) -> RequestResult:
     payload = {
         "model": model,
-        "messages": [{"role": "user", "content": spec.prompt}],
+        "messages": messages
+        or [{"role": "user", "content": spec.prompt}],
         "max_tokens": spec.max_tokens,
         "temperature": 0.0,
         "stream": True,
@@ -241,6 +245,7 @@ async def _one_request(
     last = t0
     itls: list[float] = []
     n_out = 0
+    text_parts: list[str] = []
     try:
         async with session.post(
             f"{base_url}/v1/chat/completions", json=payload
@@ -264,6 +269,7 @@ async def _one_request(
                         itls.append(now - last)
                     last = now
                     n_out += 1
+                    text_parts.append(delta)
                 usage = chunk.get("usage")
                 if usage:
                     n_out = usage.get("completion_tokens", n_out)
@@ -276,6 +282,7 @@ async def _one_request(
         ttft_s=ttft or 0.0,
         latency_s=time.perf_counter() - t0,
         itls=itls,
+        text="".join(text_parts),
     )
 
 
@@ -288,7 +295,14 @@ async def run_benchmark(
     max_concurrency: int | None = None,
     seed: int = 0,
     goodput_slo: dict | None = None,
+    turns: int = 1,
 ) -> dict:
+    """Drive the workload. ``turns > 1`` turns every spec into a
+    CONVERSATION: each follow-up turn resends the whole history (the
+    real assistant responses included) plus a short new user message —
+    the multi-turn serving pattern prefix caching exists for. Per-turn
+    TTFT means land in the metrics (``ttft_s_by_turn``): with a working
+    prefix cache turn-2+ TTFT stays flat as history grows."""
     import aiohttp
 
     offsets = arrival_times(len(specs), request_rate, burstiness, seed)
@@ -299,18 +313,38 @@ async def run_benchmark(
         timeout=aiohttp.ClientTimeout(total=1800)
     ) as session:
 
-        async def worker(spec, offset):
+        async def worker(spec, offset, conv_idx):
             delay = offset - (time.perf_counter() - t_start)
             if delay > 0:
                 await asyncio.sleep(delay)
+            out: list[RequestResult] = []
+            messages = [{"role": "user", "content": spec.prompt}]
             async with sem:
-                return await _one_request(session, base_url, model, spec)
+                for t in range(max(1, turns)):
+                    r = await _one_request(
+                        session, base_url, model, spec, list(messages)
+                    )
+                    r.turn = t
+                    out.append(r)
+                    if not r.ok:
+                        break
+                    messages.append(
+                        {"role": "assistant", "content": r.text or "..."}
+                    )
+                    messages.append({
+                        "role": "user",
+                        "content": f"Follow-up {t + 1} for case "
+                                   f"{conv_idx}: continue.",
+                    })
+            return out
 
-        results = await asyncio.gather(
-            *[worker(s, o) for s, o in zip(specs, offsets)]
+        nested = await asyncio.gather(
+            *[worker(s, o, i)
+              for i, (s, o) in enumerate(zip(specs, offsets))]
         )
+    results = [r for conv in nested for r in conv]
     duration = time.perf_counter() - t_start
-    return compute_metrics(list(results), duration, goodput_slo)
+    return compute_metrics(results, duration, goodput_slo)
 
 
 # -- metrics ----------------------------------------------------------------
@@ -357,6 +391,16 @@ def compute_metrics(
         "e2e_s": _stats([r.latency_s for r in ok]),
         "errors": [r.error for r in results if not r.ok][:5],
     }
+    max_turn = max((r.turn for r in ok), default=0)
+    if max_turn > 0:
+        # Multi-turn: per-turn TTFT means. With a working prefix cache
+        # (hybrids included) turn-2+ stays flat as history grows.
+        metrics["ttft_s_by_turn"] = [
+            round(float(np.mean(
+                [r.ttft_s for r in ok if r.turn == t] or [0.0]
+            )), 4)
+            for t in range(max_turn + 1)
+        ]
     if goodput_slo:
         good = sum(
             1 for r in ok
@@ -406,6 +450,12 @@ def main(argv=None) -> int:
     ap.add_argument("--max-concurrency", type=int, default=None)
     ap.add_argument("--goodput-ttft-s", type=float, default=None)
     ap.add_argument("--goodput-tpot-s", type=float, default=None)
+    ap.add_argument(
+        "--turns", type=int, default=1,
+        help="turns per conversation: each follow-up resends the whole "
+             "history (real responses included) — per-turn TTFT in the "
+             "report shows prefix-cache effectiveness",
+    )
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
@@ -461,6 +511,7 @@ def main(argv=None) -> int:
         max_concurrency=args.max_concurrency,
         seed=args.seed,
         goodput_slo=goodput_slo,
+        turns=args.turns,
     ))
     print(json.dumps(metrics, indent=2))
     return 0 if metrics["failed"] == 0 else 1
