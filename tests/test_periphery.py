@@ -348,3 +348,63 @@ def test_webui_model_catalog_estimates():
     for m in cat:
         assert m["params_b"] > 0 and m["weight_gib"] > 0
         assert m["min_chips_16g"] >= 1
+
+
+def test_webui_inline_script_is_lexically_valid():
+    """The /ui page ships a single inline script from a Python string;
+    a cooked escape (raw newline inside a JS string literal) kills the
+    whole dashboard at parse time. Guard the string-literal and bracket
+    structure (no JS engine in the image, so a small lexer stands in)."""
+    import re
+
+    from parallax_tpu.backend.webui import PAGE
+
+    script = re.search(r"<script>(.*)</script>", PAGE, re.S).group(1)
+    state = None          # inside ' / " / ` literal
+    esc = False
+    depth = {"(": 0, "[": 0, "{": 0}
+    close = {")": "(", "]": "[", "}": "{"}
+    in_comment = None
+    prev = ""
+    errors = []
+    line = 1
+    for ch in script:
+        if ch == "\n":
+            line += 1
+        if in_comment == "//":
+            if ch == "\n":
+                in_comment = None
+            prev = ch
+            continue
+        if in_comment == "/*":
+            if prev == "*" and ch == "/":
+                in_comment = None
+            prev = ch
+            continue
+        if state is None:
+            if ch == "/" and prev == "/":
+                in_comment = "//"
+            elif ch == "*" and prev == "/":
+                in_comment = "/*"
+            elif ch in "'\"`":
+                state = ch
+            elif ch in depth:
+                depth[ch] += 1
+            elif ch in close:
+                depth[close[ch]] -= 1
+        else:
+            if esc:
+                esc = False
+            elif ch == "\\":
+                esc = True
+            elif ch == state:
+                state = None
+            elif ch == "\n" and state in "'\"":
+                errors.append(f"line {line}: raw newline in {state} string")
+                state = None
+        prev = ch
+    assert state is None, "unterminated string literal"
+    assert not errors, errors
+    # Bracket balance outside string literals (text like "[a, b)" lives
+    # inside quotes and is excluded by the lexer).
+    assert depth == {"(": 0, "[": 0, "{": 0}, depth
