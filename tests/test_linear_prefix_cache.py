@@ -315,3 +315,36 @@ def test_hybrid_decode_snapshots_extend_reuse_past_prompt():
     o2 = _run(oracle, "o2", t2)
     assert r2.num_cached_tokens == 48    # past the 37-token prompt
     assert r2.output_ids == o2.output_ids
+
+
+def test_hybrid_prefix_reuse_on_tp_stage():
+    """Prefix restore on a TP-sharded hybrid stage: the snapshot/restore
+    slot copies run on SHARDED conv/recurrent arrays inside jit. Outputs
+    must match the unsharded engine exactly, with a real prefix hit."""
+    from parallax_tpu.parallel import make_mesh
+
+    def build(tp):
+        m = create_stage_model(CONFIG, 0, 4, use_pallas=False, tp_size=tp)
+        mesh = (
+            make_mesh(tp_size=tp, devices=jax.devices()[:tp])
+            if tp > 1 else None
+        )
+        return [StageEngine(
+            m, m.init_params(jax.random.key(0), dtype=jax.numpy.float32),
+            EngineConfig(page_size=PAGE, num_pages=64, max_model_len=256,
+                         kv_dtype="float32", prefill_chunk_size=16,
+                         linear_decode_snapshot_stride=1),
+            mesh=mesh,
+        )]
+
+    ref = build(1)
+    r1 = _run(ref, "r1", BASE)
+    r2 = _run(ref, "r2", BASE + SUFFIX)
+    assert r2.num_cached_tokens > 0
+
+    tp = build(2)
+    t1 = _run(tp, "t1", BASE)
+    assert t1.output_ids == r1.output_ids
+    t2 = _run(tp, "t2", BASE + SUFFIX)
+    assert t2.num_cached_tokens == r2.num_cached_tokens
+    assert t2.output_ids == r2.output_ids
