@@ -90,6 +90,15 @@ WATCHDOG_S = int(os.environ.get("BENCH_WATCHDOG_S", "1000"))
 PROBE_S = int(os.environ.get("BENCH_PROBE_S", "120"))
 PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "2"))
 PROBE_SLEEP_S = int(os.environ.get("BENCH_PROBE_SLEEP_S", "30"))
+# Hard wall-clock budget for the WHOLE probe phase (r05 lesson: two
+# back-to-back 120 s hangs burned the window before the bench even
+# started). One knob caps attempts x timeout x sleeps together; probes
+# that don't fit are SKIPPED and recorded in the JSON detail instead of
+# retried blind.
+PROBE_BUDGET_S = int(os.environ.get(
+    "BENCH_TPU_PROBE_BUDGET_S",
+    str(PROBE_ATTEMPTS * PROBE_S + (PROBE_ATTEMPTS - 1) * PROBE_SLEEP_S),
+))
 
 # Self-imposed wall budget for the whole entry. The driver killed r03 at
 # roughly ~30 min (rc=124); stay safely inside that so we exit 0 on our
@@ -132,22 +141,41 @@ def _probe_once(timeout_s: float) -> bool:
         return False
 
 
-def _tpu_reachable(deadline: float) -> tuple[bool, int]:
-    """Probe the chip a bounded number of times. The relay wedges and
-    un-wedges on its own schedule, but r03 proved that chasing it eats
-    the driver's whole window: 2 x 120 s is the cap, not 10 x 300 s.
-    Returns (reachable, attempts_used)."""
+def _tpu_reachable(deadline: float) -> tuple[bool, dict]:
+    """Probe the chip under a single wall-clock budget
+    (``BENCH_TPU_PROBE_BUDGET_S``). The relay wedges and un-wedges on its
+    own schedule, but r03/r05 proved that chasing it eats the driver's
+    whole window — the budget caps attempts, timeouts and sleeps
+    together, and attempts that don't fit are skipped, not retried
+    blind. Returns (reachable, probe_record)."""
+    budget_deadline = min(deadline, time.time() + PROBE_BUDGET_S)
+    attempts = 0
+    skipped = PROBE_ATTEMPTS
     for i in range(PROBE_ATTEMPTS):
-        left = deadline - time.time()
+        left = budget_deadline - time.time()
         if left < 30:
-            return False, i
+            _log_probe(
+                f"bench: probe budget exhausted ({PROBE_BUDGET_S}s), "
+                f"skipping {PROBE_ATTEMPTS - i} attempt(s)"
+            )
+            break
+        attempts = i + 1
+        skipped = PROBE_ATTEMPTS - attempts
         if _probe_once(min(PROBE_S, left)):
             _log_probe(f"bench: probe attempt {i + 1} succeeded")
-            return True, i + 1
-        left = deadline - time.time()
+            # "skipped" counts budget-driven skips only; attempts that a
+            # SUCCESS made unnecessary were never wanted.
+            return True, {
+                "attempts": attempts, "skipped": 0,
+                "budget_s": PROBE_BUDGET_S,
+            }
+        left = budget_deadline - time.time()
         if i + 1 < PROBE_ATTEMPTS and left > PROBE_SLEEP_S + 30:
             time.sleep(PROBE_SLEEP_S)
-    return False, PROBE_ATTEMPTS
+    return False, {
+        "attempts": attempts, "skipped": skipped,
+        "budget_s": PROBE_BUDGET_S,
+    }
 
 
 def _run_child(env: dict, timeout_s: float) -> dict | str | None:
@@ -223,14 +251,16 @@ def main():
     # exit code: an unhandled exception here would make the driver
     # distrust the already-printed line (rc != 0).
     try:
-        # Step 2 — bounded reachability probe.
-        probes = 0
+        # Step 2 — reachability probe under one wall-clock budget.
+        probe_rec = {"attempts": 0, "skipped": PROBE_ATTEMPTS,
+                     "budget_s": PROBE_BUDGET_S}
         tpu_ok = False
         if not os.environ.get("BENCH_CPU"):
-            tpu_ok, probes = _tpu_reachable(deadline - EXIT_MARGIN_S)
+            tpu_ok, probe_rec = _tpu_reachable(deadline - EXIT_MARGIN_S)
             if not tpu_ok:
                 sys.stderr.write(
-                    f"TPU unreachable after {probes} probes; "
+                    f"TPU unreachable after {probe_rec['attempts']} probes "
+                    f"({probe_rec['skipped']} skipped on budget); "
                     "keeping CPU line\n"
                 )
 
@@ -244,8 +274,7 @@ def main():
                 result = None
             if result is not None:
                 if isinstance(result, dict):
-                    result.setdefault("detail", {})[
-                        "tpu_probe_attempts"] = probes
+                    result.setdefault("detail", {})["tpu_probe"] = probe_rec
                 emit(result)
             if (
                 isinstance(result, dict)
@@ -321,7 +350,7 @@ def main():
             }
         d = cpu.setdefault("detail", {})
         d["tpu_relay"] = _relay_evidence()
-        d["tpu_probe_attempts"] = probes
+        d["tpu_probe"] = probe_rec
         emit(cpu)
     except BaseException as exc:  # noqa: BLE001 — exit 0 is the contract
         sys.stderr.write(f"bench entry: suppressed {exc!r}\n")
@@ -383,7 +412,11 @@ def _bench():
     from parallax_tpu.config import normalize_config
     from parallax_tpu.models.presets import get_preset
     from parallax_tpu.models.registry import create_stage_model
-    from parallax_tpu.runtime.engine import EngineConfig, StageEngine
+    from parallax_tpu.runtime.engine import (
+        EngineConfig,
+        StageEngine,
+        drive_step,
+    )
     from parallax_tpu.runtime.pipeline import InProcessPipeline
     from parallax_tpu.runtime.request import Request, SamplingParams
     from parallax_tpu.utils.hw import detect_hardware, device_free_memory_bytes
@@ -518,14 +551,18 @@ def _bench():
         gen_len = max(193, 1 + max(1, pipeline) * max(1, lookahead))
     else:
         # CPU smoke mode (BENCH_CPU=1): tiny shapes, same code path.
+        # Sized HOST-bound (per-step host work > device exec) so the
+        # overlapped decode loop's recovered idle time is visible in the
+        # sync-vs-overlap comparison — the regime the TPU hot path lives
+        # in (r05: decode_dispatch 3.51 ms, mostly host).
         cfg = dataclasses.replace(
             get_preset("qwen2.5-0.5b"),
-            hidden_size=256, num_hidden_layers=4, num_attention_heads=4,
-            num_key_value_heads=2, head_dim=64, intermediate_size=512,
-            vocab_size=1024, layer_types=("attention",) * 4,
+            hidden_size=128, num_hidden_layers=4, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=32, intermediate_size=256,
+            vocab_size=512, layer_types=("attention",) * 4,
             tie_word_embeddings=False, attention_bias=False,
         )
-        batch, prompt_len, gen_len = 8, 32, 16
+        batch, prompt_len, gen_len = 16, 32, 16
         dtype, kv_dtype, page_size = jnp.float32, "float32", 16
         lookahead = int(os.environ.get("BENCH_LOOKAHEAD", "1"))
         pipeline = int(os.environ.get("BENCH_PIPELINE", "1"))
@@ -581,17 +618,22 @@ def _bench():
     pipe = InProcessPipeline([engine])
     rng = np.random.default_rng(0)
 
-    def run_round(tag: str, n_gen: int):
-        """Submit a full batch and run it to completion.
+    def run_round(tag: str, n_gen: int, overlap: bool):
+        """Submit a full batch and run it to completion through the
+        two-phase dispatch/resolve loop (one step in flight when
+        ``overlap``; fully synchronous otherwise).
 
-        Returns (decode_tokens, decode_wall_s, dispatch_times, phase_ok,
-        ttft_ms). Phase detection is by scheduler state, not token counts
-        (with lookahead a decode dispatch commits k*batch tokens, which a
-        size heuristic would misread as prefill): decode starts once every
-        request is admitted and has sampled its first token. TTFT per
-        request = first sampled token's wall time minus the round start
-        (all requests submitted up front).
+        Returns a dict of decode-phase measurements. Phase detection is
+        by scheduler state, not token counts (with lookahead a decode
+        dispatch commits k*batch tokens, which a size heuristic would
+        misread as prefill): decode starts once every request is admitted
+        and has sampled its first token. TTFT per request = first sampled
+        token's wall time minus the round start (all requests submitted
+        up front). ``dispatch_times`` is the HOST-BLOCKING ms per decode
+        step (StepOutputs.host_ms) — in sync mode that is the whole step
+        wall, in overlap mode the portion the device could not hide.
         """
+        engine.cfg.overlap_steps = overlap
         submitted: list[Request] = []
         for i in range(batch):
             prompt = rng.integers(1, cfg.vocab_size - 1, size=prompt_len)
@@ -605,46 +647,68 @@ def _bench():
             submitted.append(req)
             pipe.submit(req)
         dispatch_times: list[float] = []
+        device_times: list[float] = []
+        wall_times: list[float] = []
+        overlapped_steps = 0
         ttft_ms: dict[str, float] = {}
         total_tokens = 0
         decode_t0 = None
         tokens_at_decode_start = 0
         t_start = time.perf_counter()
-        while engine.has_work():
-            out = engine.step()
+        pending = None
+        while engine.has_work() or pending is not None:
+            outs, pending = drive_step(engine, pending)
             now = time.perf_counter()
-            total_tokens += out.num_tokens
-            for req in submitted:
-                if req.request_id not in ttft_ms and req.output_ids:
-                    ttft_ms[req.request_id] = (now - t_start) * 1000.0
-            if decode_t0 is not None and out.num_tokens:
-                dispatch_times.append(out.step_time_ms)
-            elif decode_t0 is None:
-                running = engine.scheduler.running
-                if (
-                    not engine.scheduler.wait_queue
-                    and running
-                    and all(r.output_ids for r in running.values())
-                ):
-                    decode_t0 = time.perf_counter()
-                    tokens_at_decode_start = total_tokens
+            for out in outs:
+                total_tokens += out.num_tokens
+                for req in submitted:
+                    if req.request_id not in ttft_ms and req.output_ids:
+                        ttft_ms[req.request_id] = (now - t_start) * 1000.0
+                if decode_t0 is not None and out.num_tokens:
+                    dispatch_times.append(out.host_ms)
+                    device_times.append(out.device_ms)
+                    wall_times.append(out.step_time_ms)
+                    overlapped_steps += int(out.overlapped)
+                elif decode_t0 is None:
+                    running = engine.scheduler.running
+                    if (
+                        not engine.scheduler.wait_queue
+                        and running
+                        and all(r.output_ids for r in running.values())
+                    ):
+                        decode_t0 = time.perf_counter()
+                        tokens_at_decode_start = total_tokens
         decode_wall_s = time.perf_counter() - (decode_t0 or t_start)
-        return (
-            total_tokens - tokens_at_decode_start,
-            decode_wall_s,
-            dispatch_times,
-            decode_t0 is not None,
-            sorted(ttft_ms.values()),
+        return dict(
+            decode_tokens=total_tokens - tokens_at_decode_start,
+            decode_wall_s=decode_wall_s,
+            dispatch_times=dispatch_times,
+            device_times=device_times,
+            wall_times=wall_times,
+            overlapped_steps=overlapped_steps,
+            phase_ok=decode_t0 is not None,
+            ttfts=sorted(ttft_ms.values()),
         )
 
+    overlap_on = os.environ.get("BENCH_OVERLAP", "1") != "0"
     # Warmup round: populates every jit cache the measured round will hit
-    # (prefill bucket, fused multi-step decode window, tail buckets), so
-    # the measured decode phase contains zero compiles.
+    # (prefill bucket, fused multi-step decode window, tail buckets, the
+    # deferred sampler), so the measured decode phase contains zero
+    # compiles.
     t_start = time.perf_counter()
-    run_round("warm", lookahead + 1)
+    run_round("warm", lookahead + 1, overlap_on)
+    r = run_round("bench", gen_len, overlap_on)
     decode_tokens, decode_wall_s, dispatch_times, phase_ok, ttfts = (
-        run_round("bench", gen_len)
+        r["decode_tokens"], r["decode_wall_s"], r["dispatch_times"],
+        r["phase_ok"], r["ttfts"],
     )
+    # Same-invocation sync comparison: how much host-blocking time the
+    # overlapped loop recovers. Cheap on CPU (the smoke's contract);
+    # opt-in on TPU where the fused window already owns the budget.
+    sync_r = None
+    if overlap_on and (not on_tpu or os.environ.get("BENCH_SYNC_COMPARE")):
+        sync_r = run_round("sync", gen_len, False)
+        engine.cfg.overlap_steps = overlap_on
     total_s = time.perf_counter() - t_start
 
     # Decode throughput over the whole decode phase (wall-clock, includes
@@ -748,6 +812,35 @@ def _bench():
             "decode_tokens": decode_tokens,
             "decode_wall_s": round(decode_wall_s, 2),
             "total_wall_s": round(total_s, 1),
+            # Two-phase step telemetry (overlapped decode loop).
+            # decode_dispatch_ms_median now measures the HOST-BLOCKING
+            # portion per decode step (host_ms_median is its explicit
+            # name); the old full-wall meaning (r05 baseline 3.51 ms)
+            # lives on as decode_step_wall_ms_median. Note an overlapped
+            # ticket's wall spans its interleaved next dispatch too.
+            "overlap_steps": overlap_on,
+            "host_ms_median": round(step_ms, 2),
+            "decode_step_wall_ms_median": round(
+                statistics.median(r["wall_times"])
+                if r["wall_times"] else 0.0, 2,
+            ),
+            "device_ms_median": round(
+                statistics.median(r["device_times"])
+                if r["device_times"] else 0.0, 3,
+            ),
+            "overlapped_steps": r["overlapped_steps"],
+            **(
+                {
+                    "sync_decode_dispatch_ms_median": round(
+                        statistics.median(sync_r["dispatch_times"])
+                        if sync_r["dispatch_times"] else 0.0, 2,
+                    ),
+                    "sync_decode_wall_s": round(
+                        sync_r["decode_wall_s"], 2
+                    ),
+                }
+                if sync_r is not None else {}
+            ),
         },
     }
     print(json.dumps(result))

@@ -30,7 +30,11 @@ def generate_main(args) -> int:
     from parallax_tpu.config import load_config
     from parallax_tpu.models.loader import load_stage_params
     from parallax_tpu.models.registry import create_stage_model
-    from parallax_tpu.runtime.engine import EngineConfig, StageEngine
+    from parallax_tpu.runtime.engine import (
+        EngineConfig,
+        StageEngine,
+        drive_step,
+    )
     from parallax_tpu.runtime.request import Request, SamplingParams
     from parallax_tpu.utils.tokenizer import load_tokenizer
 
@@ -97,8 +101,13 @@ def generate_main(args) -> int:
     t0 = time.perf_counter()
     ttft = None
     sent = 0
-    while engine.has_work():
-        engine.step()
+    # Overlapped two-phase loop, one step in flight: the host assembles
+    # step N+1 while the device computes step N (EngineConfig
+    # .overlap_steps); detokenization runs one step behind off the
+    # committed ids.
+    pending = None
+    while engine.has_work() or pending is not None:
+        _, pending = drive_step(engine, pending)
         if req.output_ids and ttft is None:
             ttft = time.perf_counter() - t0
         stable = decoder.update(req.output_ids)   # cumulative stable text

@@ -102,6 +102,13 @@ class SchedulerService:
                 if isinstance(payload.get("lora_adapters"), (list, tuple))
                 else None
             ),
+            # Two-phase decode telemetry (host_ms/device_ms/overlap
+            # EWMAs) — surfaced per node in /cluster/status.
+            step_timing=(
+                payload["step_timing"]
+                if isinstance(payload.get("step_timing"), dict)
+                else None
+            ),
         )
         alloc = self._with_model(self.scheduler.get_node_allocation(node_id) or {})
         alloc["refit_version"] = self.scheduler.refit_version

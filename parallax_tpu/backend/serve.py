@@ -98,6 +98,9 @@ def build_local_frontend(
                     "waiting": len(e.scheduler.wait_queue),
                     "free_pages": e.cache.num_free_pages,
                     "cached_pages": e.cache.prefix_cache.num_cached_pages,
+                    # Two-phase decode telemetry (host_ms/device_ms
+                    # EWMAs + overlap fraction).
+                    "step_timing": e.step_timing.summary(),
                 }
                 for e in engines
             ],

@@ -104,6 +104,9 @@ class WorkerNode:
         self.start_layer = -1
         self.end_layer = -1
         self._inbox: queue.Queue = queue.Queue()
+        # Set by every _post(): the step thread parks on it when idle
+        # instead of polling, and wakes the instant work arrives.
+        self._wake = threading.Event()
         self._stop = threading.Event()
         self._reload = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -150,7 +153,7 @@ class WorkerNode:
             t.start()
             self._threads.append(t)
         if "start_layer" in alloc:
-            self._inbox.put(("reload", alloc))
+            self._post(("reload", alloc))
         else:
             logger.info("%s: joined as standby", self.node_id)
 
@@ -396,6 +399,9 @@ class WorkerNode:
                         "layer_latency_ms": (
                             eng.layer_latency_ms_ewma if eng else None
                         ),
+                        "step_timing": (
+                            eng.step_timing.summary() if eng else None
+                        ),
                         "refit_version": self.refit_version,
                         "lora_adapters": (
                             eng.adapter_names() if eng else []
@@ -409,19 +415,19 @@ class WorkerNode:
                     logger.warning("%s: scheduler asked for rejoin", self.node_id)
                     rejoin_alloc = self._join()
                     if "start_layer" in rejoin_alloc:
-                        self._inbox.put(("reload", rejoin_alloc))
+                        self._post(("reload", rejoin_alloc))
                 elif reply and reply.get("start_layer") is not None:
                     if (
                         reply["start_layer"],
                         reply["end_layer"],
                     ) != (self.start_layer, self.end_layer):
                         # Scheduler moved us: reload on the step thread.
-                        self._inbox.put(("reload", reply))
+                        self._post(("reload", reply))
                     elif (
                         reply.get("refit_index")
                         and reply.get("refit_version", 0) > self.refit_version
                     ):
-                        self._inbox.put((
+                        self._post((
                             "refit",
                             reply["refit_version"],
                             reply["refit_index"],
@@ -539,7 +545,7 @@ class WorkerNode:
         if self.engine is not None:
             fresh = self._fresh_peer_ids(time.monotonic())
             fresh.add(self.node_id)
-            self._inbox.put(("liveness", fresh))
+            self._post(("liveness", fresh))
 
     def _on_announce(self, _peer: str, payload: dict):
         self._merge_blocks((payload or {}).get("blocks"))
@@ -624,10 +630,10 @@ class WorkerNode:
             from parallax_tpu.p2p import interop
 
             for ireq in interop.forward_bytes_to_ireqs(payload):
-                self._inbox.put(("forward", ireq))
+                self._post(("forward", ireq))
             return "ok"
         for wire_req in payload["reqs"]:
-            self._inbox.put(("forward", proto.ireq_from_wire(wire_req)))
+            self._post(("forward", proto.ireq_from_wire(wire_req)))
         return "ok"
 
     def _on_abort(self, _peer: str, payload):
@@ -635,15 +641,15 @@ class WorkerNode:
             from parallax_tpu.p2p import interop
 
             for rid in interop.abort_bytes_to_rids(payload):
-                self._inbox.put(("release", rid, True))
+                self._post(("release", rid, True))
             return "ok"
         for rid in payload["rids"]:
-            self._inbox.put(("release", rid, True))
+            self._post(("release", rid, True))
         return "ok"
 
     def _on_release(self, _peer: str, payload: dict):
         for rid in payload["rids"]:
-            self._inbox.put(("release", rid, payload.get("abort", False)))
+            self._post(("release", rid, payload.get("abort", False)))
         return "ok"
 
     def _on_chat_submit(self, _peer: str, payload: dict):
@@ -666,7 +672,7 @@ class WorkerNode:
     def _on_chat_stop(self, _peer: str, payload: dict):
         """Stop-string early finish: gracefully end the request with
         FINISHED_STOP (unlike abort, the generated text stands)."""
-        self._inbox.put(("stop", payload["rid"]))
+        self._post(("stop", payload["rid"]))
         return "ok"
 
     def _on_chat_poll(self, _peer: str, payload: dict):
@@ -688,7 +694,7 @@ class WorkerNode:
         when it finishes."""
         ev = threading.Event()
         self._request_events[request.request_id] = ev
-        self._inbox.put(("submit", request))
+        self._post(("submit", request))
         return ev
 
     def pop_finished(self) -> list[Request]:
@@ -701,24 +707,66 @@ class WorkerNode:
 
     # -- step loop (owns the engine) -----------------------------------------
 
+    def _post(self, item: tuple) -> None:
+        """Enqueue work for the step thread and wake it (the idle path
+        parks on ``_wake`` instead of busy-polling)."""
+        self._inbox.put(item)
+        self._wake.set()
+
     def _step_loop(self) -> None:
+        from parallax_tpu.runtime.engine import drive_step
+
+        # The overlapped two-phase loop keeps exactly ONE step in flight:
+        # drive_step dispatches step N+1 (host-side plan forming and
+        # batch assembly) BEFORE resolving step N, so the host schedules
+        # the next batch while the device computes the current one.
+        pending = None
+        pending_engine = None
         while not self._stop.is_set():
             try:
                 worked = self._drain_inbox()
                 eng = self.engine
+                if pending is not None and pending_engine is not eng:
+                    # Elastic reload swapped the engine mid-flight: the
+                    # old engine's requests were already aborted; its
+                    # ticket resolves against dead state — drop it.
+                    pending = None
                 if eng is None:
-                    time.sleep(0.01)
+                    self._wake.wait(0.01)
+                    self._wake.clear()
                     continue
-                if eng.has_work():
-                    out = eng.step()
+                outs, pending = drive_step(eng, pending)
+                pending_engine = eng
+                for out in outs:
                     self._route_outputs(out)
                     worked = worked or out.num_tokens > 0
-                if not worked:
-                    time.sleep(0.001)
+                if not worked and pending is None:
+                    # Event-driven idle wait: submits/forwards/releases
+                    # all land through _post and set the wake event, so
+                    # an idle node parks instead of burning a core on a
+                    # 1 ms poll; the timeout only bounds housekeeping
+                    # (request-timeout sweeps), not wake latency.
+                    if self._inbox.empty():
+                        self._wake.wait(0.05)
+                    self._wake.clear()
             except Exception:
                 # The step thread must survive: a dead step loop with a live
                 # announcer would look healthy to the scheduler forever.
                 logger.exception("step loop error")
+                if pending is not None:
+                    # Only retry a ticket that is genuinely still
+                    # unresolved (the failure was elsewhere, e.g. in
+                    # dispatch or routing); a ticket whose own resolve
+                    # failed was already abandoned by the engine and
+                    # re-running its emit path would double-commit.
+                    try:
+                        if pending_engine.is_inflight(pending):
+                            self._route_outputs(
+                                pending_engine.resolve(pending)
+                            )
+                    except Exception:
+                        logger.exception("in-flight step resolution failed")
+                    pending = None
                 time.sleep(0.1)
 
     def _drain_inbox(self) -> bool:
@@ -851,7 +899,7 @@ class WorkerNode:
                     })
                 except Exception:
                     logger.exception("refit v%d disk cache failed", version)
-            self._inbox.put(("refit_apply", version, tensors))
+            self._post(("refit_apply", version, tensors))
         except Exception:
             logger.exception("refit v%d fetch failed", version)
         finally:
@@ -876,7 +924,7 @@ class WorkerNode:
                     )
                     continue
             if target == self.node_id:
-                self._inbox.put(("forward", ireq))
+                self._post(("forward", ireq))
             else:
                 by_peer.setdefault(target, []).append(proto.ireq_to_wire(ireq))
         for peer, reqs in by_peer.items():
@@ -884,7 +932,7 @@ class WorkerNode:
                 self.transport.send(peer, proto.FORWARD, {"reqs": reqs})
             except Exception as e:
                 logger.error("forward to %s failed: %s", peer, e)
-                self._inbox.put(("abort_path", peer))
+                self._post(("abort_path", peer))
 
         for req in out.finished:
             self._finish(req)

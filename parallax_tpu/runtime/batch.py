@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -164,3 +165,26 @@ def _pad_rows(x: np.ndarray, t: int) -> np.ndarray:
         return x
     pad = np.zeros((t - x.shape[0], x.shape[1]), x.dtype)
     return np.concatenate([x, pad], axis=0)
+
+
+@jax.jit
+def _gather_feed(token_ids, last_tokens, slots):
+    fed = last_tokens[jnp.clip(slots, 0, last_tokens.shape[0] - 1)]
+    return jnp.where(slots >= 0, fed, token_ids)
+
+
+def substitute_device_tokens(
+    inputs: BatchInputs, last_tokens, feed_slots
+) -> BatchInputs:
+    """Overlapped decode's on-device token feedback: replace the
+    placeholder token ids of device-fed rows with a gather from the
+    engine's device-resident last-token array.
+
+    ``feed_slots`` is i32[T] with the row's token slot at its first token
+    position and -1 everywhere else (host rows keep their assembled ids).
+    The gather is a tiny jitted op enqueued between the sampler that
+    produced ``last_tokens`` and the forward that consumes the result, so
+    the sampled token never round-trips through the host.
+    """
+    token_ids = _gather_feed(inputs.token_ids, last_tokens, feed_slots)
+    return dataclasses.replace(inputs, token_ids=token_ids)

@@ -92,6 +92,19 @@ class EngineConfig:
     # lookahead otherwise.
     speculative_tokens: int = 0
     speculative_ngram: int = 3
+    # Overlapped decode: step() splits into dispatch() (form plan,
+    # assemble inputs, ENQUEUE the jit call — returns an in-flight
+    # ticket) and resolve(ticket) (block on outputs, sample/emit, advance
+    # bookkeeping), and the step loops keep exactly ONE step in flight so
+    # the host builds step N+1 while the device computes step N. Sampled
+    # tokens stay resident on device between steps (a slot-indexed
+    # last-token array) so decode feeds next-token ids without a host
+    # round trip; rows needing host-synchronous state (penalties,
+    # logprobs, grammar masks, logit_bias, speculative verify, SP plans)
+    # force a sync resolve for that step, keeping token streams
+    # bit-identical to the synchronous engine for greedy and seeded rows.
+    # False = the pre-split fully synchronous behavior.
+    overlap_steps: bool = True
 
 
 @dataclasses.dataclass
@@ -106,6 +119,82 @@ class StepOutputs:
     # Diagnostics.
     num_tokens: int = 0
     step_time_ms: float = 0.0
+    # Two-phase step telemetry: ms the host spent blocked on this step
+    # (plan forming + assembly + sample/emit bookkeeping + any residual
+    # device wait), the device-readback portion of that wait, and whether
+    # the step's resolve overlapped a later dispatch.
+    host_ms: float = 0.0
+    device_ms: float = 0.0
+    overlapped: bool = False
+
+
+@dataclasses.dataclass(eq=False)
+class StepTicket:
+    """An in-flight engine step: the plan plus the device futures its
+    dispatch enqueued; ``resolve(ticket)`` completes it. Identity
+    equality only (``eq=False``): field comparison would try to bool()
+    device arrays.
+
+    ``outputs`` is pre-filled for steps that resolved synchronously
+    inside dispatch (empty plans, fused multistep/speculative windows);
+    ``sync_only`` marks tickets whose rows need host-synchronous logits
+    processing — the driver loop must resolve them before dispatching
+    again."""
+
+    plan: BatchPlan
+    step_idx: int
+    t0: float
+    host_ms: float = 0.0
+    sync_only: bool = False
+    inputs: BatchInputs | None = None
+    out: jax.Array | None = None
+    spec_rows: dict | None = None
+    # Pre-sampled tokens (deferred fetch): the sampler was enqueued at
+    # dispatch so only the readback remains at resolve.
+    tokens_dev: jax.Array | None = None
+    outputs: "StepOutputs | None" = None
+
+
+def drive_step(
+    engine: "StageEngine", pending: "StepTicket | None"
+) -> tuple[list[StepOutputs], "StepTicket | None"]:
+    """One iteration of the overlapped step loop (the one-in-flight
+    pattern every driver uses): dispatch step N+1 FIRST — its host work
+    runs while the device still computes step N — then resolve step N.
+    Tickets that resolved inside dispatch or that carry host-synchronous
+    rows resolve immediately; with ``overlap_steps`` off every ticket
+    resolves immediately (the pre-split synchronous behavior).
+
+    Returns (resolved StepOutputs in completion order, the new in-flight
+    ticket or None)."""
+    outs: list[StepOutputs] = []
+    ticket = engine.dispatch() if engine.has_work() else None
+    if pending is not None:
+        try:
+            outs.append(engine.resolve(pending))
+        except Exception:
+            # The just-dispatched ticket would otherwise be orphaned in
+            # the engine's in-flight list, wedging every later dispatch
+            # on the one-in-flight invariant.
+            if ticket is not None:
+                engine.discard(ticket)
+            raise
+    if ticket is not None:
+        if (
+            ticket.outputs is not None
+            or ticket.sync_only
+            or not engine.cfg.overlap_steps
+        ):
+            outs.append(engine.resolve(ticket))
+            ticket = None
+    return outs, ticket
+
+
+@jax.jit
+def _scatter_last_tokens(last, slots, tokens):
+    """Park this step's sampled tokens in the slot-indexed last-token
+    array (on device; OOB sentinel slots are dropped)."""
+    return last.at[slots].set(tokens[: slots.shape[0]], mode="drop")
 
 
 class DraftProposer:
@@ -133,6 +222,10 @@ class DraftProposer:
         if not (engine.model.is_first and engine.model.is_last):
             raise ValueError("draft engine must be a full single stage")
         self.engine = engine
+        # The proposal loop drives step() synchronously, so the deferred
+        # sampler + device token feedback would be pure per-step overhead
+        # inside the propose budget — run the draft engine sync.
+        engine.cfg.overlap_steps = False
         self.max_propose_ms = max_propose_ms
         self._counter = 0
 
@@ -301,7 +394,13 @@ class StageEngine:
                                  frozenset()),
             )
             stage_fn = _tp.tp_stage_fn(model, params, mesh)
-        self._jit_step = jax.jit(stage_fn, donate_argnums=(1,))
+        # KV donation halves peak HBM on accelerators. On the CPU backend
+        # donation is a no-op (PJRT CPU cannot alias) AND it forces the
+        # jit call to execute synchronously inline — which would defeat
+        # the overlapped dispatch/resolve split entirely — so skip it
+        # there. Execution semantics are identical either way.
+        self._donate_kv = (1,) if jax.default_backend() != "cpu" else ()
+        self._jit_step = jax.jit(stage_fn, donate_argnums=self._donate_kv)
         if self._needs_state:
             from parallax_tpu.config import LAYER_LINEAR
 
@@ -365,7 +464,9 @@ class StageEngine:
                 finally:
                     self.model._sp_active = False
 
-            self._jit_sp_step = jax.jit(_sp_stage_fn, donate_argnums=(1,))
+            self._jit_sp_step = jax.jit(
+                _sp_stage_fn, donate_argnums=self._donate_kv
+            )
             # Long prompts only: a floor of 256 keeps short prefills off the
             # SP compile lattice; buckets are sp-multiples for even shards.
             self._sp_spec = BucketSpec(
@@ -394,6 +495,21 @@ class StageEngine:
         # load_adapter so base-only serving never touches the machinery.
         self._adapters = None
         self._step_count = 0
+        # Overlapped two-phase stepping: at most ONE unresolved ticket may
+        # be outstanding when dispatch() is entered (the one-in-flight
+        # invariant); the device-resident last-token array feeds decode
+        # rows whose sampled token has not reached the host yet.
+        self._inflight: list[StepTicket] = []
+        self._last_token_dev = jnp.zeros(
+            (self.cfg.max_batch_size,), jnp.int32
+        )
+        self._token_slots: dict[str, int] = {}
+        self._free_token_slots = list(range(self.cfg.max_batch_size))
+        # host_ms/device_ms/overlap EWMA published via heartbeats and
+        # /cluster/status (utils/request_metrics.py).
+        from parallax_tpu.utils.request_metrics import StepTimingAggregator
+
+        self.step_timing = StepTimingAggregator()
         # Non-head stages: hidden rows waiting per request id.
         self._pending_hidden: dict[str, np.ndarray] = {}
         self._sampling_cache: dict[str, SamplingParams] = {}
@@ -636,7 +752,9 @@ class StageEngine:
         self._pending_hidden.pop(request_id, None)
         self._grammar_states.pop(request_id, None)
         self._bias_cache.pop(request_id, None)
+        self._free_token_slot(request_id)
         if req is not None:
+            req.device_feed_ready = False
             if not req.status.is_finished:
                 if abort:
                     req.abort("released")
@@ -714,7 +832,7 @@ class StageEngine:
                 return tokens, kv, feed, ctx
 
             return jax.jit(self._tp_wrap_multistep(fn, 0),
-                           donate_argnums=(1,))
+                           donate_argnums=self._donate_kv)
 
         def fn(params, kv, inputs: BatchInputs, samp: dict):
             def body(carry, step_i):
@@ -738,7 +856,8 @@ class StageEngine:
             )
             return tokens, kv, feed, ctx
 
-        return jax.jit(self._tp_wrap_multistep(fn, 1), donate_argnums=(1,))
+        return jax.jit(self._tp_wrap_multistep(fn, 1),
+                       donate_argnums=self._donate_kv)
 
     def _tp_wrap_multistep(self, fn, n_extra: int):
         """SPMD-wrap a multistep fn for a TP-sharded stage: the whole
@@ -1265,11 +1384,37 @@ class StageEngine:
         return plan
 
     def step(self) -> StepOutputs:
+        """One fully synchronous engine step (dispatch + resolve)."""
+        return self.resolve(self.dispatch())
+
+    def dispatch(self) -> StepTicket:
+        """Phase 1: form the plan, assemble device inputs and ENQUEUE the
+        jit call(s); returns without blocking on device results. A driver
+        overlaps host work with device execution by dispatching step N+1
+        before resolving step N (see ``drive_step``); at most one
+        unresolved ticket may be outstanding when dispatch is entered.
+
+        A failure anywhere in here leaves the scheduler consistent: no
+        bookkeeping advances until the forward is enqueued, so the same
+        rows are re-schedulable on the next call."""
+        if len(self._inflight) > 1:
+            raise RuntimeError(
+                "dispatch() with two steps already in flight — resolve() "
+                "the oldest ticket first (one-in-flight invariant)"
+            )
         t0 = time.perf_counter()
+
+        def _done(outputs: StepOutputs) -> StepTicket:
+            return StepTicket(
+                plan=plan, step_idx=self._step_count, t0=t0, outputs=outputs
+            )
+
         sp_plan = self._take_sp_plan()
         plan = sp_plan if sp_plan is not None else self._form_plan()
         if plan.is_empty:
-            return StepOutputs(forward=[], finished=self._collect_finished())
+            return _done(
+                StepOutputs(forward=[], finished=self._collect_finished())
+            )
         if plan.mixed_lora:
             # Mixed-adapter batch: abort only the rows whose adapter this
             # stage does not serve; the rest proceed.
@@ -1285,9 +1430,9 @@ class StageEngine:
                     )
                 keep = [s for s in plan.seqs if s not in bad]
                 if not keep:
-                    return StepOutputs(
+                    return _done(StepOutputs(
                         forward=[], finished=self._collect_finished()
-                    )
+                    ))
                 plan = BatchPlan(keep, mixed_lora=True)
         elif plan.lora_id is not None and not self.has_adapter(plan.lora_id):
             # Unknown adapter: fail the whole (single-adapter) batch with
@@ -1296,9 +1441,15 @@ class StageEngine:
                 seg.request.abort(
                     f"unknown lora adapter {plan.lora_id!r}"
                 )
-            return StepOutputs(forward=[], finished=self._collect_finished())
+            return _done(
+                StepOutputs(forward=[], finished=self._collect_finished())
+            )
 
-        if sp_plan is None:
+        # Rows fed from the device-resident last-token array: their token
+        # value is unknown to the host, so the fused paths (which read
+        # host token ids) must not run this step.
+        fed_rows = any(seg.device_token for seg in plan.seqs)
+        if sp_plan is None and not fed_rows:
             committed = self._try_speculative(plan)
             ewma_steps = 1  # speculation = one forward's worth of latency
             if committed is None:
@@ -1310,12 +1461,13 @@ class StageEngine:
                 dt = (time.perf_counter() - t0) * 1000.0
                 self._update_latency_ewma(dt / ewma_steps)
                 self._step_count += 1
-                return StepOutputs(
+                return _done(StepOutputs(
                     forward=[],
                     finished=self._collect_finished(),
                     num_tokens=committed,
                     step_time_ms=dt,
-                )
+                    host_ms=dt,
+                ))
             if (
                 self.cfg.speculative_tokens > 0
                 and self.model.is_first
@@ -1381,6 +1533,8 @@ class StageEngine:
             lora = self._lora_field(plan, inputs)
             if lora is not None:
                 inputs = dataclasses.replace(inputs, lora=lora)
+            if fed_rows:
+                inputs = self._substitute_feed(plan, inputs)
             out, self.kv = self._jit_step(self.params, self.kv, inputs)
 
         # Advance scheduler state first: a locally-committed sampled token
@@ -1390,29 +1544,241 @@ class StageEngine:
         if self._needs_state and self.cache.enable_prefix_cache:
             self._maybe_snapshot_state(plan)
 
-        forwards: list[IntermediateRequest] = []
-        if self.model.is_last and spec_rows:
-            forwards = self._verify_and_emit(plan, inputs, out, spec_rows)
-        elif self.model.is_last:
-            tokens, logprobs = self._sample(out, inputs, plan)
-            forwards = self._emit_tokens(plan, tokens, logprobs)
-        else:
-            forwards = self._emit_hidden(plan, np.asarray(out))
-        dt = (time.perf_counter() - t0) * 1000.0
-        self._record_latency(plan, dt)
+        step_idx = self._step_count
         self._step_count += 1
+        ticket = StepTicket(
+            plan=plan, step_idx=step_idx, t0=t0, inputs=inputs, out=out,
+            spec_rows=spec_rows or None,
+            sync_only=sp_plan is not None or bool(spec_rows),
+        )
+        if (
+            self.model.is_last
+            and not ticket.sync_only
+            and self.cfg.overlap_steps
+            and self._overlap_sample_ok(plan)
+        ):
+            # Deferred sampling: enqueue the sampler NOW so resolve only
+            # has the readback left — and park the sampled tokens in the
+            # device-resident last-token array so the next dispatch can
+            # feed eligible rows without waiting for the host commit.
+            ticket.tokens_dev = self._enqueue_sample(plan, inputs, out,
+                                                     step_idx)
+            if self.model.is_first:
+                self._mark_device_feed(plan, ticket.tokens_dev)
+        elif self.model.is_last:
+            # Host-synchronous logits processing (penalties, logprobs,
+            # grammar, logit_bias): the driver must resolve before the
+            # next dispatch so the histories these rows need are complete.
+            ticket.sync_only = True
+        ticket.host_ms = (time.perf_counter() - t0) * 1000.0
+        self._inflight.append(ticket)
+        return ticket
+
+    def resolve(self, ticket: StepTicket) -> StepOutputs:
+        """Phase 2: block on the ticket's device outputs, sample/verify,
+        emit tokens or hidden states, and advance finish bookkeeping.
+        Tickets must resolve in dispatch order."""
+        if ticket in self._inflight:
+            self._inflight.remove(ticket)
+        if ticket.outputs is not None:
+            o = ticket.outputs
+            if o.num_tokens:
+                self.step_timing.update(o.host_ms, o.device_ms, o.overlapped)
+            return o
+        plan = ticket.plan
+        t_r0 = time.perf_counter()
+        device_ms = 0.0
+        try:
+            if not self.model.is_last:
+                tb = time.perf_counter()
+                hidden_out = np.asarray(ticket.out)
+                device_ms = (time.perf_counter() - tb) * 1000.0
+                forwards = self._emit_hidden(plan, hidden_out)
+            elif ticket.spec_rows:
+                forwards = self._verify_and_emit(
+                    plan, ticket.inputs, ticket.out, ticket.spec_rows,
+                    ticket.step_idx,
+                )
+            elif ticket.tokens_dev is not None:
+                tb = time.perf_counter()
+                tokens = np.asarray(ticket.tokens_dev)
+                device_ms = (time.perf_counter() - tb) * 1000.0
+                forwards = self._emit_tokens(plan, tokens, None)
+            else:
+                tokens, logprobs = self._sample(
+                    ticket.out, ticket.inputs, plan, ticket.step_idx
+                )
+                forwards = self._emit_tokens(plan, tokens, logprobs)
+        except Exception:
+            self._abandon(plan)
+            raise
+        now = time.perf_counter()
+        dt = (now - ticket.t0) * 1000.0
+        host_ms = ticket.host_ms + (now - t_r0) * 1000.0
+        overlapped = self._step_count != ticket.step_idx + 1
+        # Latency EWMA: an overlapped ticket's t0->resolve span covers
+        # the interleaved next dispatch too; the per-iteration cost the
+        # scheduler should see is the host-blocking time (which already
+        # includes any residual device wait as its device_ms portion).
+        # Sync tickets' host_ms equals their full wall, so the EWMA is
+        # unchanged there.
+        self._record_latency(plan, host_ms)
+        self.step_timing.update(host_ms, device_ms, overlapped)
         return StepOutputs(
             forward=forwards,
             finished=self._collect_finished(),
             num_tokens=plan.total_new_tokens,
             step_time_ms=dt,
+            host_ms=host_ms,
+            device_ms=device_ms,
+            overlapped=overlapped,
         )
 
     # -- internals --------------------------------------------------------
 
+    def _overlap_sample_ok(self, plan: BatchPlan) -> bool:
+        """Can this batch's sampling be enqueued at dispatch time? Only
+        when no row needs host-synchronous logits processing — penalties
+        (generated-id histories), logprobs, grammar masks, logit_bias all
+        force a sync resolve."""
+        for seg in plan.seqs:
+            sp = seg.request.sampling_params
+            if (
+                sp.presence_penalty
+                or sp.frequency_penalty
+                or sp.repetition_penalty != 1.0
+                or sp.logprobs
+                or sp.json_schema
+                or sp.logit_bias
+            ):
+                return False
+        return True
+
+    def _enqueue_sample(
+        self, plan: BatchPlan, inputs: BatchInputs, logits: jax.Array,
+        step_idx: int,
+    ) -> jax.Array:
+        """The deferred twin of _sample's tail for host-simple batches:
+        identical packing, key discipline and compiled graphs (so token
+        streams match the sync path bitwise), but the result stays on
+        device."""
+        s = int(inputs.kv_lens.shape[0])
+        temp, top_k, top_p, min_p, seeds, steps, any_seed = (
+            self._pack_base_sampling(plan, s)
+        )
+        if not np.any(temp > 0.0):
+            from parallax_tpu.ops.sampling import greedy_tokens
+
+            return greedy_tokens(logits)
+        key = jax.random.fold_in(self._base_key, step_idx)
+        kwargs = {}
+        if any_seed:
+            kwargs = dict(
+                seeds=jnp.asarray(seeds), out_steps=jnp.asarray(steps)
+            )
+        return sample_tokens(
+            logits,
+            key,
+            jnp.asarray(temp),
+            jnp.asarray(top_k),
+            jnp.asarray(top_p),
+            jnp.asarray(min_p),
+            **kwargs,
+        )
+
+    def _mark_device_feed(
+        self, plan: BatchPlan, tokens_dev: jax.Array
+    ) -> None:
+        """Single-stage overlap: scatter this step's sampled tokens into
+        the slot-indexed last-token array and mark the rows device-feed
+        ready, so the NEXT dispatch can schedule them before these tokens
+        ever reach the host."""
+        s = int(tokens_dev.shape[0])
+        # OOB sentinel = dropped by the scatter.
+        slots = np.full((s,), self.cfg.max_batch_size, np.int32)
+        marked = False
+        for i, seg in enumerate(plan.seqs):
+            req = seg.request
+            if not self._needs_token(seg) or req.status.is_finished:
+                continue
+            # A row whose NEXT commit ends it (max_new reached) never
+            # needs the device round trip; skipping it also bounds every
+            # device-fed position strictly inside max_model_len.
+            pending = 1 if seg.device_token else 0
+            if (
+                len(req.output_ids) + pending + 1
+                >= req.sampling_params.max_new_tokens
+            ):
+                continue
+            slot = self._token_slots.get(req.request_id)
+            if slot is None:
+                if not self._free_token_slots:
+                    continue
+                slot = self._free_token_slots.pop()
+                self._token_slots[req.request_id] = slot
+            slots[i] = slot
+            req.device_feed_ready = True
+            marked = True
+        if marked:
+            self._last_token_dev = _scatter_last_tokens(
+                self._last_token_dev, jnp.asarray(slots), tokens_dev
+            )
+
+    def _substitute_feed(
+        self, plan: BatchPlan, inputs: BatchInputs
+    ) -> BatchInputs:
+        """Swap device-fed rows' placeholder token ids for a gather from
+        the last-token array (enqueued between the previous step's
+        sampler and this step's forward — no host round trip)."""
+        from parallax_tpu.runtime.batch import substitute_device_tokens
+
+        feed_slots = np.full(
+            (int(inputs.token_ids.shape[0]),), -1, np.int32
+        )
+        row = 0
+        for seg in plan.seqs:
+            if seg.device_token:
+                feed_slots[row] = self._token_slots[seg.request.request_id]
+            row += seg.num_new_tokens
+        return substitute_device_tokens(
+            inputs, self._last_token_dev, jnp.asarray(feed_slots)
+        )
+
+    def is_inflight(self, ticket: StepTicket) -> bool:
+        """True while the ticket has been dispatched but not resolved
+        (nor discarded). A failed resolve() removes the ticket, so error
+        handlers can use this to tell whether a retry is meaningful."""
+        return ticket in self._inflight
+
+    def discard(self, ticket: StepTicket) -> None:
+        """Drop an in-flight ticket that can no longer be resolved
+        (e.g. an earlier ticket's resolve failed mid-loop): its rows'
+        pending tokens are lost, so abort them to keep the scheduler
+        consistent."""
+        if ticket in self._inflight:
+            self._inflight.remove(ticket)
+        if ticket.outputs is None:
+            self._abandon(ticket.plan)
+
+    def _abandon(self, plan: BatchPlan) -> None:
+        """A resolve failed mid-step: the sampled tokens (and any pending
+        device-feed state) for these rows are lost — abort them so the
+        scheduler never re-schedules rows whose token stream has a
+        hole."""
+        for seg in plan.seqs:
+            req = seg.request
+            if not req.status.is_finished:
+                req.abort("step_resolve_failed")
+            req.device_feed_ready = False
+
+    def _free_token_slot(self, request_id: str) -> None:
+        slot = self._token_slots.pop(request_id, None)
+        if slot is not None:
+            self._free_token_slots.append(slot)
+
     def _verify_and_emit(
         self, plan: BatchPlan, inputs: BatchInputs, out: jax.Array,
-        spec_rows: dict[int, list[int]],
+        spec_rows: dict[int, list[int]], step_idx: int,
     ) -> list[IntermediateRequest]:
         """Last stage, speculative rows present: ``out`` holds logits at
         every fed position (gather_all_logits). Verify each spec row's
@@ -1458,7 +1824,7 @@ class StageEngine:
             # spec and rest rows at equal bucket indices identical
             # gumbel noise (correlated streams across requests).
             key = jax.random.fold_in(
-                jax.random.fold_in(self._base_key, self._step_count),
+                jax.random.fold_in(self._base_key, step_idx),
                 0x5BEC,
             )
             verified_all = np.asarray(sample_tokens(
@@ -1500,7 +1866,9 @@ class StageEngine:
             rows[: len(rest_rows)] = rest_rows
             logits_rest = out[jnp.asarray(rows)]
             rest_plan = BatchPlan(rest_segs)
-            tokens, logprobs = self._sample(logits_rest, inputs, rest_plan)
+            tokens, logprobs = self._sample(
+                logits_rest, inputs, rest_plan, step_idx
+            )
             forwards.extend(self._emit_tokens(rest_plan, tokens, logprobs))
         return forwards
 
@@ -1597,7 +1965,19 @@ class StageEngine:
              origin) = self._row_sampling_fields(seg.request)
             if seeds[i] >= 0:
                 any_seed = True
-                steps[i] = origin
+                # A device-fed row's fed token may still be uncommitted
+                # (dispatch-time packing): the host-visible generated
+                # count then runs one behind the true output index this
+                # step samples. When a host-synchronous batch defers the
+                # packing to RESOLVE time, the driver has already
+                # resolved the previous ticket and committed that token
+                # (total_len == context_len), so origin already counts
+                # it — adding 1 there would shift the seeded key stream.
+                pending_fed = (
+                    seg.device_token
+                    and seg.request.total_len < seg.context_len
+                )
+                steps[i] = origin + (1 if pending_fed else 0)
         return temp, top_k, top_p, min_p, seeds, steps, any_seed
 
     @staticmethod
@@ -1609,7 +1989,8 @@ class StageEngine:
             return getattr(req, "mirror_gen_ids", [])
         return req.output_ids
 
-    def _sample(self, logits: jax.Array, inputs: BatchInputs, plan: BatchPlan):
+    def _sample(self, logits: jax.Array, inputs: BatchInputs,
+                plan: BatchPlan, step_idx: int):
         s = int(inputs.kv_lens.shape[0])
         temp, top_k, top_p, min_p, seeds, steps, any_seed = (
             self._pack_base_sampling(plan, s)
@@ -1617,30 +1998,36 @@ class StageEngine:
         pres = np.zeros((s,), np.float32)
         freq = np.zeros((s,), np.float32)
         rep = np.ones((s,), np.float32)
-        any_pen = False
-        gen_lists: list[list[int]] = []
+        pen_rows: list[int] = []
         for i, seg in enumerate(plan.seqs):
             sp = seg.request.sampling_params
-            gen = self._generated_ids(seg.request)
-            gen_lists.append(gen)
             if sp.presence_penalty or sp.frequency_penalty or (
                 sp.repetition_penalty != 1.0
             ):
-                any_pen = True
+                pen_rows.append(i)
                 pres[i] = sp.presence_penalty
                 freq[i] = sp.frequency_penalty
                 rep[i] = sp.repetition_penalty
-        if any_pen:
+        if pen_rows:
             # Pad generated-id lists onto a power-of-2 lattice (bounded
-            # recompiles) and scatter the counts on device.
+            # recompiles) and scatter the counts on device. Only the
+            # PENALIZED rows' histories are walked — non-penalized rows
+            # contributed ids the penalty math ignored anyway (pres/freq
+            # 0, rep 1), and walking every request's full history every
+            # step was pure per-step waste for the common penalty-free
+            # batch.
             from parallax_tpu.ops.sampling import penalize_logits
 
-            max_len = max((len(g) for g in gen_lists), default=0)
+            gen_lists = {
+                i: self._generated_ids(plan.seqs[i].request)
+                for i in pen_rows
+            }
+            max_len = max(len(g) for g in gen_lists.values())
             bucket = 8
             while bucket < max_len:
                 bucket *= 2
             out_ids = np.full((s, bucket), -1, np.int32)
-            for i, gen in enumerate(gen_lists):
+            for i, gen in gen_lists.items():
                 if gen:
                     out_ids[i, : len(gen)] = gen
             logits = penalize_logits(
@@ -1711,7 +2098,7 @@ class StageEngine:
 
             tokens = np.asarray(greedy_tokens(logits))
             return tokens, self._logprobs_for(logits, tokens, need_lp)
-        key = jax.random.fold_in(self._base_key, self._step_count)
+        key = jax.random.fold_in(self._base_key, step_idx)
         kwargs = {}
         if any_seed:
             kwargs = dict(
@@ -1869,6 +2256,8 @@ class StageEngine:
             self._grammar_states.pop(req.request_id, None)
             self._bias_cache.pop(req.request_id, None)
             self._free_state_slot(req)
+            self._free_token_slot(req.request_id)
+            req.device_feed_ready = False
         return finished
 
     def _free_state_slot(self, req: Request) -> None:

@@ -111,6 +111,13 @@ class Request:
     eos_token_ids: tuple[int, ...] = ()
     # Filled when decoding starts; used by the decode-ready gating.
     ready_for_step: bool = True
+    # Overlapped decode: the row's next token was sampled by an in-flight
+    # engine step and lives only in the device-resident last-token array —
+    # the scheduler may feed it without a host round trip (the step loop
+    # keeps one step in flight; see StageEngine.dispatch). Cleared when
+    # the row is scheduled device-fed or when the token reaches the host
+    # before being fed (sync tail).
+    device_feed_ready: bool = False
     abort_reason: str | None = None
     # Per-request LoRA adapter name (reference ``Req.lora_path``,
     # forward.proto). None = base model. The local scheduler groups each

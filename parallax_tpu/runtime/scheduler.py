@@ -29,6 +29,11 @@ class ScheduledSeq:
     token_ids: list[int]         # the new tokens (head node fills these)
     context_len: int             # total KV length after this step
     is_last_prefill_chunk: bool = True
+    # Overlapped decode: this row's fed token is the one an in-flight
+    # step sampled — it lives only in the engine's device-resident
+    # last-token array; ``token_ids`` holds a placeholder the engine
+    # replaces with an on-device gather (batch.substitute_device_tokens).
+    device_token: bool = False
 
 
 @dataclasses.dataclass
@@ -199,7 +204,8 @@ class Scheduler:
                 req.status is RequestStatus.PREFILLING
                 and req.remaining_prompt_tokens() > 0
             ) or (
-                req.status is RequestStatus.DECODING and req.ready_for_step
+                req.status is RequestStatus.DECODING
+                and (req.ready_for_step or req.device_feed_ready)
             )
             if schedulable and req.lora_id not in groups:
                 groups.append(req.lora_id)
@@ -302,7 +308,8 @@ class Scheduler:
             token_budget = self.max_num_tokens_per_batch
         candidates = [
             req for req in self.running.values()
-            if req.status is RequestStatus.DECODING and req.ready_for_step
+            if req.status is RequestStatus.DECODING
+            and (req.ready_for_step or req.device_feed_ready)
             and (any_adapter or req.lora_id == batch_lora)
         ]
         if any_adapter and candidates:
@@ -316,18 +323,25 @@ class Scheduler:
         for req in candidates:
             if len(seqs) >= max_seqs or token_budget <= 0:
                 break
-            if not self.cache.ensure_capacity(req, req.total_len):
+            # A device-fed row's next token was sampled by the in-flight
+            # step and lives only on device: it occupies one more context
+            # slot than the host-committed total.
+            fed = req.device_feed_ready and not req.ready_for_step
+            ctx = req.total_len + 1 if fed else req.total_len
+            if not self.cache.ensure_capacity(req, ctx):
                 self._abort_on_oom(req)
                 continue
-            last = req.all_token_ids[-1]
             seqs.append(
                 ScheduledSeq(
                     request=req,
                     num_new_tokens=1,
-                    token_ids=[last],
-                    context_len=req.total_len,
+                    token_ids=[0] if fed else [req.all_token_ids[-1]],
+                    context_len=ctx,
+                    device_token=fed,
                 )
             )
+            if fed:
+                req.device_feed_ready = False
             token_budget -= 1
         if any_adapter:
             self._decode_cursor += len(seqs)
@@ -356,11 +370,19 @@ class Scheduler:
                 req.ready_for_step = False
 
     def on_token_committed(self, request: Request) -> None:
-        """The ring delivered a sampled token for this request."""
-        request.ready_for_step = True
-        if request.status is RequestStatus.DECODING:
-            # KV for the new token is written next step alongside its compute.
-            pass
+        """The ring (or the local resolve) delivered a sampled token.
+
+        A token that was already fed from the device-resident array (the
+        overlapped step loop ran one dispatch ahead) must NOT re-arm
+        ``ready_for_step`` — feeding it again would recompute its
+        position and resample its logits, duplicating a token.
+        """
+        fed_ahead = request.num_computed_tokens >= request.total_len
+        request.ready_for_step = not fed_ahead
+        if not fed_ahead:
+            # The committed token is host-known and unfed: the normal
+            # host-fed path takes over (sync tail / overlap off).
+            request.device_feed_ready = False
 
     # -- completion -------------------------------------------------------
 

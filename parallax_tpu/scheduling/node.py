@@ -93,6 +93,9 @@ class Node:
     refit_version: int = 0
     # True once the node reports its executor is serving.
     is_ready: bool = False
+    # Two-phase decode telemetry from heartbeats (host_ms/device_ms
+    # EWMAs, overlap fraction); surfaced in /cluster/status.
+    step_timing: dict | None = None
 
     def __post_init__(self):
         self.perf = RooflinePerformanceModel(self.hardware, self.model)

@@ -99,10 +99,11 @@ class GlobalScheduler:
         is_ready: bool | None = None,
         refit_version: int | None = None,
         lora_adapters: list | None = None,
+        step_timing: dict | None = None,
     ) -> None:
         self._events.put(
             ("update", node_id, layer_latency_ms, load, rtt_s, is_ready,
-             refit_version, lora_adapters)
+             refit_version, lora_adapters, step_timing)
         )
 
     def receive_request(self, request_id: str) -> PendingRequest:
@@ -169,7 +170,7 @@ class GlobalScheduler:
         elif kind == "leave":
             self._handle_leave(ev[1])
         elif kind == "update":
-            _, node_id, lat, load, rtt, ready, refit, adapters = ev
+            _, node_id, lat, load, rtt, ready, refit, adapters, timing = ev
             node = self.manager.get(node_id)
             if node is None:
                 return
@@ -186,6 +187,8 @@ class GlobalScheduler:
                 node.refit_version = refit
             if adapters is not None:
                 node.lora_adapters = tuple(adapters)
+            if timing is not None:
+                node.step_timing = timing
 
     def _try_bootstrap_or_extend(self) -> None:
         standby = self.manager.nodes(NodeState.STANDBY)
@@ -350,6 +353,9 @@ class GlobalScheduler:
                         "layers": [n.start_layer, n.end_layer],
                         "load": n.load,
                         "ready": n.is_ready,
+                        # Overlapped decode loop telemetry (host_ms /
+                        # device_ms EWMAs + overlap fraction).
+                        "step_timing": n.step_timing,
                     }
                     for n in p.nodes
                 ],

@@ -1,15 +1,62 @@
-"""Client-side request metrics from a streamed OpenAI response.
+"""Request/step metrics helpers.
 
-Capability parity: reference ``src/parallax_utils/request_metrics.py:4-19``
-(``get_request_metrics``: TPS/TTFT/token counts parsed from the final SSE
-usage chunk). Used by the chat CLI and the benchmark client to report
-per-request numbers without trusting server-side aggregation.
+Client side — capability parity: reference
+``src/parallax_utils/request_metrics.py:4-19`` (``get_request_metrics``:
+TPS/TTFT/token counts parsed from the final SSE usage chunk). Used by the
+chat CLI and the benchmark client to report per-request numbers without
+trusting server-side aggregation.
+
+Server side — :class:`StepTimingAggregator` folds the two-phase engine
+step's ``host_ms``/``device_ms``/``overlapped`` telemetry (StepOutputs)
+into EWMAs published via worker heartbeats and ``/cluster/status``, so
+operators can see how much host scheduling time the overlapped decode
+loop actually hides behind device compute.
 """
 
 from __future__ import annotations
 
 import json
 from typing import Any
+
+
+class StepTimingAggregator:
+    """EWMA over per-step timing from the two-phase decode loop."""
+
+    def __init__(self, alpha: float = 0.2):
+        self.alpha = alpha
+        self.host_ms_ewma: float | None = None
+        self.device_ms_ewma: float | None = None
+        self.steps = 0
+        self.overlapped_steps = 0
+
+    def update(self, host_ms: float, device_ms: float,
+               overlapped: bool) -> None:
+        a = self.alpha
+        self.host_ms_ewma = (
+            host_ms if self.host_ms_ewma is None
+            else (1 - a) * self.host_ms_ewma + a * host_ms
+        )
+        self.device_ms_ewma = (
+            device_ms if self.device_ms_ewma is None
+            else (1 - a) * self.device_ms_ewma + a * device_ms
+        )
+        self.steps += 1
+        if overlapped:
+            self.overlapped_steps += 1
+
+    def summary(self) -> dict | None:
+        """Heartbeat/status payload; None before the first step."""
+        if not self.steps:
+            return None
+        return {
+            "host_ms_ewma": round(self.host_ms_ewma, 3),
+            "device_ms_ewma": round(self.device_ms_ewma, 3),
+            "steps": self.steps,
+            "overlapped_steps": self.overlapped_steps,
+            "overlap_fraction": round(
+                self.overlapped_steps / self.steps, 3
+            ),
+        }
 
 
 def parse_usage_chunk(chunk: bytes | str | dict) -> dict | None:

@@ -24,8 +24,17 @@ def test_bench_cpu_smoke_prints_one_json_line():
     for key in ("metric", "value", "unit", "vs_baseline"):
         assert key in rec, rec
     assert rec["value"] > 0
-    # The final (driver-visible) line records why there is no TPU number.
-    assert "tpu_probe_attempts" in rec["detail"]
+    # The final (driver-visible) line records why there is no TPU number:
+    # the probe record carries attempts run, attempts skipped when the
+    # wall-clock budget (BENCH_TPU_PROBE_BUDGET_S) ran out, and the
+    # budget itself.
+    probe = rec["detail"]["tpu_probe"]
+    for key in ("attempts", "skipped", "budget_s"):
+        assert key in probe, probe
+    # Two-phase decode-loop telemetry is part of the bench contract.
+    for key in ("host_ms_median", "device_ms_median", "overlapped_steps",
+                "sync_decode_dispatch_ms_median"):
+        assert key in rec["detail"], rec["detail"]
 
 
 def test_bench_dsa_mode_cpu_smoke():
