@@ -109,6 +109,13 @@ class SchedulerService:
                 if isinstance(payload.get("step_timing"), dict)
                 else None
             ),
+            # Prefix-cache / memory-tier counters (hit rates, occupancy,
+            # demotion/swap-in/preemption) — surfaced in /cluster/status.
+            cache_stats=(
+                payload["cache_stats"]
+                if isinstance(payload.get("cache_stats"), dict)
+                else None
+            ),
         )
         alloc = self._with_model(self.scheduler.get_node_allocation(node_id) or {})
         alloc["refit_version"] = self.scheduler.refit_version

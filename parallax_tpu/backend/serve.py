@@ -101,6 +101,10 @@ def build_local_frontend(
                     # Two-phase decode telemetry (host_ms/device_ms
                     # EWMAs + overlap fraction).
                     "step_timing": e.step_timing.summary(),
+                    # Prefix-cache / memory-tier counters (hit rates
+                    # split device/host, occupancy, demotions,
+                    # swap-ins, preemptions).
+                    "cache_stats": e.cache_stats(),
                 }
                 for e in engines
             ],
@@ -141,7 +145,10 @@ def serve_main(args) -> int:
     from parallax_tpu.models.loader import load_stage_params
     from parallax_tpu.models.registry import create_stage_model
     from parallax_tpu.runtime.cache_manager import derive_num_pages
-    from parallax_tpu.utils.hw import device_free_memory_bytes
+    from parallax_tpu.utils.hw import (
+        default_host_cache_bytes,
+        device_free_memory_bytes,
+    )
 
     if not os.path.isdir(args.model_path) and "/" in args.model_path:
         # HF repo id: fetch just this stage's shard files (reference
@@ -272,6 +279,12 @@ def serve_main(args) -> int:
             prefill_chunk_size=getattr(args, "prefill_chunk_size", 1024),
             kv_dtype=getattr(args, "kv_dtype", "bfloat16"),
             enable_prefix_cache=not getattr(args, "no_prefix_cache", False),
+            # Host-DRAM KV tier: sized from host RAM unless pinned by
+            # flag (CPU backends default off — see
+            # utils.hw.default_host_cache_bytes).
+            host_cache_bytes=default_host_cache_bytes(
+                override=getattr(args, "host_cache_bytes", None)
+            ),
             linear_prefix_slots=getattr(args, "linear_prefix_slots", 32),
             sp_threshold=sp_threshold,
             decode_lookahead=getattr(args, "decode_lookahead", 1) or 1,

@@ -40,6 +40,13 @@ def build_parser() -> argparse.ArgumentParser:
                        default="bfloat16")
     serve.add_argument("--no-prefix-cache", action="store_true")
     serve.add_argument(
+        "--host-cache-bytes", type=int, default=None,
+        help="host-DRAM KV tier budget: radix eviction demotes pages "
+             "here and decode OOM preempts requests here instead of "
+             "aborting (default: half of available DRAM on TPU, off on "
+             "CPU; 0 disables)",
+    )
+    serve.add_argument(
         "--linear-prefix-slots", type=int, default=32,
         help="hybrid models: device slots for linear-state prefix "
              "snapshots (~2x expected concurrent requests; 0 disables "
@@ -134,6 +141,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="ring-attention sp mesh axis for long-prompt prefill: the "
              "host's chips form an (sp, tp) mesh with tp = chips / "
              "sp-size (must divide evenly)",
+    )
+    join.add_argument(
+        "--host-cache-bytes", type=int, default=None,
+        help="host-DRAM KV tier budget for this worker (default: half "
+             "of available DRAM on TPU, off on CPU; 0 disables)",
     )
     join.add_argument("--sp-threshold", type=int, default=2048,
                       help="prompts at least this long prefill via SP")

@@ -332,6 +332,12 @@ class NativeCacheManager:
         self._shared: dict[str, int] = {}
         # Per-adapter prefix-cache namespaces (cache_manager.ns_salt).
         self._ns_salts: dict[str, int] = {}
+        # Observability counters (utils.request_metrics.cache_stats_summary
+        # reads these; the native tier has no host cache, so host/preempt
+        # fields stay zero).
+        from parallax_tpu.utils.request_metrics import CacheStats
+
+        self.stats = CacheStats()
 
     def _ns_i32(self, token_ids, lora_id) -> np.ndarray:
         """int32 tokens, XOR-salted at numpy speed for adapter requests
@@ -385,6 +391,8 @@ class NativeCacheManager:
         if int(restore[0]) >= 0:
             request.restore_state_from = int(restore[0])
         self._shared[request.request_id] = int(shared.value)
+        self.stats.tokens_admitted += len(tokens)
+        self.stats.tokens_hit_device += request.num_cached_tokens
         return True
 
     def ensure_capacity(self, request, new_total_tokens: int) -> bool:

@@ -38,6 +38,36 @@ def interleave_kv(k: jax.Array, v: jax.Array) -> jax.Array:
     return jnp.stack([k, v], axis=2).reshape(t, 2 * h, d)
 
 
+def gather_pages(kv_pages: jax.Array, page_ids: jax.Array) -> jax.Array:
+    """Gather whole pages into a staging buffer for D2H demotion.
+
+    Args:
+      kv_pages: [P, page, ...] one layer's paged cache.
+      page_ids: i32[n] device page ids (in-range; callers own validity).
+
+    Returns:
+      [n, page, ...] contiguous staging copy, safe to copy to host while
+      later steps keep mutating ``kv_pages``.
+    """
+    return jnp.take(kv_pages, page_ids, axis=0)
+
+
+def scatter_pages(
+    kv_pages: jax.Array, page_ids: jax.Array, data: jax.Array
+) -> jax.Array:
+    """Write host-promoted pages back into the paged cache (H2D swap-in).
+
+    Args:
+      kv_pages: [P, page, ...] cache (donate for in-place update).
+      page_ids: i32[n] destination device page ids.
+      data: [n, page, ...] page payloads (any castable dtype).
+
+    Returns:
+      Updated kv_pages.
+    """
+    return kv_pages.at[page_ids].set(data.astype(kv_pages.dtype), mode="drop")
+
+
 def reshape_and_cache(
     kv_pages: jax.Array,
     k: jax.Array,

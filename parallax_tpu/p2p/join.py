@@ -44,6 +44,9 @@ def join_main(args) -> int:
     from parallax_tpu.p2p.node import WorkerNode
     from parallax_tpu.parallel import make_mesh
     from parallax_tpu.runtime.engine import EngineConfig
+    from parallax_tpu.utils.hw import (
+        default_host_cache_bytes as _default_host_cache_bytes,
+    )
 
     # Scheduler RPC rides one port above its HTTP port by convention.
     scheduler_peer = args.scheduler_addr
@@ -154,6 +157,11 @@ def join_main(args) -> int:
             sp_threshold=(
                 getattr(args, "sp_threshold", 2048)
                 if sp_size > 1 else None
+            ),
+            # Host-DRAM KV tier, sized from worker RAM on accelerators
+            # (off on CPU); see docs/memory.md.
+            host_cache_bytes=_default_host_cache_bytes(
+                override=getattr(args, "host_cache_bytes", None)
             ),
         ),
         load_params=load_params,

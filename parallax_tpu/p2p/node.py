@@ -402,6 +402,9 @@ class WorkerNode:
                         "step_timing": (
                             eng.step_timing.summary() if eng else None
                         ),
+                        "cache_stats": (
+                            eng.cache_stats() if eng else None
+                        ),
                         "refit_version": self.refit_version,
                         "lora_adapters": (
                             eng.adapter_names() if eng else []

@@ -59,6 +59,7 @@ def make_cache_manager(
     use_native: bool | None = None,
     linear_state: bool = False,
     on_slot_free=None,
+    host_tier=None,
 ):
     """CacheManager factory: the C++ manager (ONE ABI crossing per
     admit/grow/release — ``native.NativeCacheManager``) by default when
@@ -66,12 +67,29 @@ def make_cache_manager(
     ``PARALLAX_TPU_NO_NATIVE=1``. Native measures ~3-16x faster in the
     production regime (full prefix cache under eviction pressure, growing
     with prompt length); the Python manager remains the behavioral oracle
-    (differential fuzz in tests/test_native_cache.py)."""
+    (differential fuzz in tests/test_native_cache.py).
+
+    A ``host_tier`` (:class:`runtime.host_cache.HostKVTier`) forces the
+    Python manager: tier residency lives on radix nodes and in the
+    preemption bookkeeping, which the native structures do not model."""
     import os
 
     if use_native is None:
-        use_native = not os.environ.get("PARALLAX_TPU_NO_NATIVE")
-    if use_native:
+        use_native = (
+            not os.environ.get("PARALLAX_TPU_NO_NATIVE")
+            and host_tier is None
+        )
+    if host_tier is not None and not os.environ.get(
+        "PARALLAX_TPU_NO_NATIVE"
+    ):
+        # Operators should see the tradeoff they opted into: the tier
+        # buys OOM-free degradation at the cost of the native manager's
+        # faster admit/grow/release bookkeeping.
+        logger.info(
+            "host KV tier enabled: using the Python cache manager "
+            "(the native manager does not model tier residency)"
+        )
+    if use_native and host_tier is None:
         try:
             from parallax_tpu import native
 
@@ -88,7 +106,7 @@ def make_cache_manager(
     return CacheManager(
         page_size, num_pages, enable_prefix_cache=enable_prefix_cache,
         max_model_len=max_model_len, linear_state=linear_state,
-        on_slot_free=on_slot_free,
+        on_slot_free=on_slot_free, host_tier=host_tier,
     )
 
 
@@ -131,6 +149,7 @@ class CacheManager:
         max_model_len: int = 32768,
         linear_state: bool = False,
         on_slot_free=None,
+        host_tier=None,
     ):
         self.page_size = page_size
         self.num_pages = num_pages
@@ -143,10 +162,24 @@ class CacheManager:
         # request as ``restore_state_from``.
         self.linear_state = linear_state
         self.on_slot_free = on_slot_free
+        # Host-DRAM second tier (runtime/host_cache.py): radix eviction
+        # demotes pages into it, matches can hit host-resident nodes
+        # (swap-in before admission), and decode OOM preempts whole
+        # requests into it instead of aborting.
+        self.host_tier = host_tier
         self.allocator = PageAllocator(num_pages)
         self.prefix_cache = RadixPageCache(
-            page_size, on_evict_slot=on_slot_free
+            page_size, on_evict_slot=on_slot_free,
+            host_free=(
+                (lambda h: host_tier.pool.free(h))
+                if host_tier is not None else None
+            ),
         )
+        if host_tier is not None:
+            host_tier.set_evict_cb(self.prefix_cache.drop_host_page)
+        from parallax_tpu.utils.request_metrics import CacheStats
+
+        self.stats = CacheStats()
         # rid -> (locked node path, number of shared tree-owned pages)
         self._locked: dict[str, tuple] = {}
         # Per-adapter radix namespaces: KV depends on the LoRA adapter, so
@@ -167,12 +200,23 @@ class CacheManager:
         return math.ceil(num_tokens / self.page_size)
 
     def _reclaim(self, need: int) -> bool:
-        """Free pages from the prefix cache until ``need`` are available."""
+        """Free pages from the prefix cache until ``need`` are available.
+
+        With a host tier attached, evicted pages demote into it (batched
+        D2H) instead of losing their KV; prefix reuse then extends past
+        HBM capacity."""
         if self.allocator.num_free >= need:
             return True
         deficit = need - self.allocator.num_free
-        freed = self.prefix_cache.evict(deficit)
+        demoter = None
+        if self.host_tier is not None:
+            def demoter(ids, _tier=self.host_tier):
+                # Partial mode: evict() orders victims coldest-first, so
+                # the kept suffix is the warmest, ancestor-closed subset.
+                return _tier.demote(ids, partial=True)
+        freed = self.prefix_cache.evict(deficit, demoter=demoter)
         self.allocator.free(freed)
+        self.stats.pages_evicted += len(freed)
         return self.allocator.num_free >= need
 
     # -- request lifecycle ------------------------------------------------
@@ -217,23 +261,44 @@ class CacheManager:
 
         total_pages = self.pages_needed(prompt_len)
         fresh_needed = total_pages - len(shared_pages)
+        # Host-resident nodes in the matched path need a device page each
+        # (swap-in) on top of the fresh tail.
+        host_nodes = [n for n in path if not n.on_device]
         # Pin the matched prefix BEFORE any eviction: reclaiming first could
         # evict the matched nodes and hand their device pages back out as
         # this very request's fresh pages (double-booked page = corrupted
-        # KV).
+        # KV). The pin also shields host-resident nodes from the host
+        # pool's own watermark eviction while the reclaim below runs.
         self.prefix_cache.lock(path)
-        if not self._reclaim(fresh_needed):
+        if not self._reclaim(fresh_needed + len(host_nodes)):
             self.prefix_cache.unlock(path)
             return False
         try:
-            fresh = self.allocator.alloc(fresh_needed)
+            fresh = self.allocator.alloc(fresh_needed + len(host_nodes))
         except OutOfPages:
             self.prefix_cache.unlock(path)
             return False
+        if host_nodes:
+            # H2D scatter of the host-tier hits, then the nodes are
+            # ordinary device-resident tree pages shared with this
+            # request.
+            swap_pages = fresh[:len(host_nodes)]
+            fresh = fresh[len(host_nodes):]
+            handles = [
+                self.prefix_cache.promote_node(n, p)
+                for n, p in zip(host_nodes, swap_pages)
+            ]
+            self.host_tier.promote(handles, swap_pages)
+            shared_pages = [n.page_id for n in path]
         request.page_ids = shared_pages + fresh
         request.num_cached_tokens = len(shared_pages) * self.page_size
         request.num_computed_tokens = request.num_cached_tokens
         self._locked[request.request_id] = (path, len(shared_pages))
+        self.stats.tokens_admitted += prompt_len
+        self.stats.tokens_hit_host += len(host_nodes) * self.page_size
+        self.stats.tokens_hit_device += (
+            request.num_cached_tokens - len(host_nodes) * self.page_size
+        )
         return True
 
     def ensure_capacity(self, request: Request, new_total_tokens: int) -> bool:
@@ -252,6 +317,52 @@ class CacheManager:
             return False
         return True
 
+    # -- preemption (decode OOM -> host tier, not abort) ------------------
+
+    def preempt_to_host(self, request: Request) -> bool:
+        """Park a running request's KV in the host tier (pinned — losing
+        it would corrupt the resumed stream) and free its device pages.
+
+        The shared prefix stays tree-owned and LOCKED on device (the
+        ``_locked`` entry survives preemption), so only the request's own
+        pages move. False (no side effects) when the tier is absent or
+        cannot hold the image — the caller then falls back to abort.
+        """
+        if self.host_tier is None:
+            return False
+        _path, num_shared = self._locked.get(
+            request.request_id, ([], 0)
+        )
+        owned = request.page_ids[num_shared:]
+        if not owned:
+            return False   # nothing to reclaim; preemption is pointless
+        handles = self.host_tier.demote(owned, pinned=True)
+        if handles is None:
+            return False
+        request.host_page_handles = handles  # type: ignore[attr-defined]
+        self.allocator.free(owned)
+        del request.page_ids[num_shared:]
+        self.stats.preemptions += 1
+        return True
+
+    def resume_from_host(self, request: Request) -> bool:
+        """Swap a preempted request's KV image back into fresh device
+        pages. False (request stays parked) when pages are still short."""
+        handles = getattr(request, "host_page_handles", None)
+        if handles is None:
+            return True
+        if not self._reclaim(len(handles)):
+            return False
+        try:
+            fresh = self.allocator.alloc(len(handles))
+        except OutOfPages:
+            return False
+        self.host_tier.promote(handles, fresh)
+        request.page_ids.extend(fresh)
+        del request.host_page_handles
+        self.stats.resumes += 1
+        return True
+
     def release(self, request: Request) -> None:
         """Return a finished/aborted request's pages.
 
@@ -259,6 +370,12 @@ class CacheManager:
         duplicates and the ragged tail are freed.
         Reference: ``insert_full_blocks_to_cache`` (cache_manager.py:704-791).
         """
+        handles = getattr(request, "host_page_handles", None)
+        if handles is not None:
+            # Released while preempted (timeout/abort): the parked host
+            # image dies with the request.
+            self.host_tier.free(handles)
+            del request.host_page_handles
         path, num_shared = self._locked.pop(request.request_id, ([], 0))
         self.prefix_cache.unlock(path)
         # Hybrid models: the engine snapshotted conv/recurrent state into

@@ -59,6 +59,15 @@ class EngineConfig:
     # reference's behavior).
     linear_decode_snapshot_stride: int = 4
     kv_dtype: str = "bfloat16"
+    # Host-DRAM KV tier budget in bytes (runtime/host_cache.py): radix
+    # eviction demotes prefix pages into it (prefix reuse extends past
+    # HBM capacity) and decode-time OOM preempts the lowest-priority
+    # running request into it instead of aborting with ``kv_oom``. 0 =
+    # off (today's behavior, bit-identical streams). Serving sizes it
+    # from host RAM on accelerators (utils.hw.default_host_cache_bytes);
+    # unsupported layouts (hybrid linear state, sharded KV) gate it off
+    # with a warning.
+    host_cache_bytes: int = 0
     seed: int = 0
     request_timeout_s: float = 600.0
     # Sequence parallelism: prompts of at least this many tokens prefill in
@@ -351,6 +360,38 @@ class StageEngine:
             and model.is_first and not self._needs_state
             and not model.is_last
         )
+        # Host-DRAM KV tier: demotion target for radix eviction and
+        # preemption; transfers read self.kv LIVE (the step loop donates
+        # and replaces the arrays every dispatch).
+        self.host_tier = None
+        if self.cfg.host_cache_bytes > 0:
+            if self._needs_state:
+                logger.warning(
+                    "host KV tier disabled: hybrid linear-state KV "
+                    "cannot be paged to host (recurrent state has no "
+                    "page-granularity image)",
+                )
+            elif mesh is not None and model.tp_size > 1:
+                logger.warning(
+                    "host KV tier disabled: TP-sharded KV transfers "
+                    "are not supported yet",
+                )
+            else:
+                from parallax_tpu.runtime.host_cache import (
+                    tier_from_paged_kv,
+                )
+
+                self.host_tier = tier_from_paged_kv(
+                    self.cfg.host_cache_bytes,
+                    lambda: self.kv,
+                    lambda kv: setattr(self, "kv", kv),
+                    self.cfg.num_pages,
+                )
+                if self.host_tier is None:
+                    logger.warning(
+                        "host KV tier disabled: unsupported KV layout "
+                        "or budget below one page",
+                    )
         self.cache = make_cache_manager(
             self.cfg.page_size,
             self.cfg.num_pages,
@@ -364,6 +405,7 @@ class StageEngine:
             on_slot_free=(
                 self._on_prefix_slot_free if self._needs_state else None
             ),
+            host_tier=self.host_tier,
         )
         self.scheduler = Scheduler(
             self.cache,
@@ -767,6 +809,14 @@ class StageEngine:
 
     def has_work(self) -> bool:
         return self.scheduler.num_requests() > 0
+
+    def cache_stats(self) -> dict | None:
+        """Prefix-cache / memory-tier observability payload (hit rates,
+        occupancy, demotion/swap-in/preemption counters) for heartbeats,
+        ``/cluster/status`` and bench JSON."""
+        from parallax_tpu.utils.request_metrics import cache_stats_summary
+
+        return cache_stats_summary(self.cache)
 
     # -- multi-step decode (k tokens per dispatch) ------------------------
 

@@ -1,0 +1,319 @@
+"""Host-DRAM KV tier: the second level of the memory hierarchy.
+
+HBM holds the working set (PagedAttention pool + radix prefix cache);
+this module adds a host-side page pool behind it so memory pressure
+degrades to latency instead of failures:
+
+- Radix eviction *demotes* cold prefix pages to host DRAM (batched
+  gather-to-staging D2H) instead of discarding their KV; a later prefix
+  match on a host-resident node swaps the page back in (H2D scatter)
+  before admission.
+- Decode-time OOM *preempts* the lowest-priority running request to the
+  host tier (its whole KV image parks here, pinned) rather than
+  aborting it with ``kv_oom``; it resumes via swap-in when pages free
+  up.
+
+The pool is itself LRU with a low watermark: once full it sheds cold
+unpinned pages in a batch down to the watermark, so steady-state
+demotion never pays a per-page eviction walk or repeated single-slot
+reclaims. Pinned pages (preempted
+requests' KV) are never shed — preemption data loss would be silent
+output corruption, so the only way out of the pool for those is
+``free()`` on resume/release.
+
+Device transfers are injected (``gather_fn``/``scatter_fn``) so the
+bookkeeping is testable without an accelerator; the engine wires jitted
+implementations built on ``ops/kv_cache_ops.py`` (gather_pages /
+scatter_pages) whose D2H copies start asynchronously and overlap the
+in-flight step.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Sequence
+
+import numpy as np
+
+from parallax_tpu.utils import get_logger
+
+logger = get_logger(__name__)
+
+
+class HostPagePool:
+    """LRU pool of host-resident KV pages under a byte budget.
+
+    Entries are opaque per-page payloads of a fixed size
+    (``page_nbytes``); the budget is expressed in bytes and enforced as
+    a page-count capacity. Eviction consults ``evict_cb(handle)`` — the
+    owner (radix tree) drops its reference and returns True, or refuses
+    (pinned node) and the walk skips it.
+    """
+
+    def __init__(
+        self,
+        budget_bytes: int,
+        page_nbytes: int,
+        low_watermark: float = 0.85,
+    ):
+        self.page_nbytes = max(1, int(page_nbytes))
+        self.capacity = max(0, int(budget_bytes) // self.page_nbytes)
+        self.low_target = int(self.capacity * low_watermark)
+        # handle -> payload, insertion/access-ordered (oldest first).
+        self._pages: "OrderedDict[int, object]" = OrderedDict()
+        self._pinned: set[int] = set()
+        self._next_handle = 0
+        self.evict_cb: Callable[[int], bool] | None = None
+        self.evictions = 0
+
+    # -- capacity ---------------------------------------------------------
+
+    @property
+    def num_pages(self) -> int:
+        return len(self._pages)
+
+    @property
+    def num_free(self) -> int:
+        return self.capacity - len(self._pages)
+
+    def ensure_room(self, n: int) -> bool:
+        """Make room for ``n`` new pages, shedding cold unpinned entries
+        down to the low watermark in one batch. False when the budget
+        cannot hold them even after eviction (everything pinned, or the
+        pool is simply too small)."""
+        if n > self.capacity:
+            return False
+        if self.num_free >= n:
+            return True
+        target = min(self.low_target, self.capacity - n)
+        # Snapshot: evict_cb may reentrantly free() descendants of the
+        # handle being dropped (host subtrees), mutating the dict.
+        for h in list(self._pages.keys()):
+            if len(self._pages) <= target:
+                break
+            if h in self._pinned or h not in self._pages:
+                continue
+            if self.evict_cb is None or self.evict_cb(h):
+                self._pages.pop(h, None)
+                self._pinned.discard(h)
+                self.evictions += 1
+        return self.num_free >= n
+
+    # -- entries ----------------------------------------------------------
+
+    def store(self, data, pinned: bool = False) -> int | None:
+        """Insert one page; None when no room can be made."""
+        if not self.ensure_room(1):
+            return None
+        h = self._next_handle
+        self._next_handle += 1
+        self._pages[h] = data
+        if pinned:
+            self._pinned.add(h)
+        return h
+
+    def load(self, handle: int):
+        """Read a page's payload (touches LRU recency)."""
+        data = self._pages[handle]
+        self._pages.move_to_end(handle)
+        return data
+
+    def free(self, handle: int) -> None:
+        self._pages.pop(handle, None)
+        self._pinned.discard(handle)
+
+    def unpin(self, handle: int) -> None:
+        """Make a pinned page evictable again (pinning itself happens at
+        ``store(pinned=True)`` — a page is pinned for its whole parked
+        life or not at all)."""
+        self._pinned.discard(handle)
+
+
+class HostKVTier:
+    """Device<->host page movement over a :class:`HostPagePool`.
+
+    ``gather_fn(page_ids) -> [per-layer np.ndarray with leading dim n]``
+    reads device pages to host (the engine's implementation batches the
+    gather into one staging buffer per layer and starts the D2H copy
+    asynchronously); ``scatter_fn(page_ids, layers)`` writes host pages
+    back into device pages. One handle = one page's KV across every
+    local attention layer.
+    """
+
+    def __init__(
+        self,
+        budget_bytes: int,
+        page_nbytes: int,
+        gather_fn: Callable[[list[int]], list[np.ndarray]],
+        scatter_fn: Callable[[list[int], list[np.ndarray]], None],
+        low_watermark: float = 0.85,
+    ):
+        self.pool = HostPagePool(budget_bytes, page_nbytes, low_watermark)
+        self._gather = gather_fn
+        self._scatter = scatter_fn
+        self.pages_demoted = 0
+        self.pages_swapped_in = 0
+
+    def set_evict_cb(self, cb: Callable[[int], bool] | None) -> None:
+        self.pool.evict_cb = cb
+
+    @property
+    def num_host_pages(self) -> int:
+        return self.pool.num_pages
+
+    @property
+    def capacity_pages(self) -> int:
+        return self.pool.capacity
+
+    @property
+    def host_evictions(self) -> int:
+        return self.pool.evictions
+
+    def demote(
+        self,
+        page_ids: Sequence[int],
+        pinned: bool = False,
+        partial: bool = False,
+    ) -> list[int] | None:
+        """Copy device pages to host; returns their handles.
+
+        All-or-nothing by default: None (no side effects beyond pool
+        eviction) when the tier cannot hold every page — a preempted
+        request's KV image is useless in halves. With ``partial``, as
+        many pages as fit are taken from the END of the list (None
+        entries for the rest): radix eviction passes victims coldest
+        first, so the suffix keeps the warmest pages AND is
+        ancestor-closed (children precede parents in the victim order,
+        so a kept child's kept parent is never dropped under it)."""
+        n = len(page_ids)
+        if n == 0:
+            return []
+        want = min(n, self.pool.capacity) if partial else n
+        if not self.pool.ensure_room(want) and not partial:
+            return None
+        # Non-partial: ensure_room(n) succeeded, so fit == n here.
+        fit = min(want, self.pool.num_free)
+        if fit <= 0:
+            return [None] * n if partial else None
+        kept = list(page_ids[n - fit:])
+        layers = self._gather(kept)
+        handles: list[int | None] = [None] * (n - fit)
+        for j in range(fit):
+            handles.append(self.pool.store(
+                tuple(layer[j] for layer in layers), pinned=pinned
+            ))
+        self.pages_demoted += fit
+        return handles
+
+    def promote(
+        self, handles: Sequence[int], device_page_ids: Sequence[int]
+    ) -> None:
+        """Swap host pages back into freshly allocated device pages and
+        release their host copies."""
+        if not handles:
+            return
+        datas = [self.pool.load(h) for h in handles]
+        layers = [
+            np.stack([d[i] for d in datas])
+            for i in range(len(datas[0]))
+        ]
+        self._scatter(list(device_page_ids), layers)
+        for h in handles:
+            self.pool.free(h)
+        self.pages_swapped_in += len(handles)
+
+    def free(self, handles: Sequence[int]) -> None:
+        for h in handles:
+            self.pool.free(h)
+
+
+def tier_from_paged_kv(
+    budget_bytes: int,
+    get_kv: Callable[[], list],
+    set_kv: Callable[[list], None],
+    num_pages: int,
+    low_watermark: float = 0.85,
+) -> HostKVTier | None:
+    """Build a tier whose transfers operate on the engine's live list of
+    paged per-layer device arrays (leading dim ``num_pages``).
+
+    The KV list is re-read through ``get_kv`` on every transfer — the
+    engine's step donates and replaces its arrays each dispatch, so a
+    captured reference would go stale after one step — and swap-ins
+    write the updated list back through ``set_kv``. Returns None when
+    the KV layout is unsupported (hybrid linear-state tuples, sharded
+    leaves without ``nbytes``) or the budget is below one page.
+
+    The gather enqueues ONE jitted slice per layer (``gather_pages``)
+    and starts the D2H copies asynchronously before materializing.
+    Note the gather reads the live KV list, which after a dispatch is
+    the in-flight step's *output* buffers — so a demotion triggered
+    while a step is in flight waits for that step before the copies can
+    start (device-ordered correctness; the async start only overlaps
+    the per-layer copies with each other). The swap-in is a jitted
+    donated scatter (``scatter_pages``).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from parallax_tpu.ops.kv_cache_ops import gather_pages, scatter_pages
+
+    kv_arrays = get_kv()
+    if not kv_arrays or any(
+        not hasattr(a, "shape")
+        or not hasattr(a, "nbytes")
+        or a.shape[0] != num_pages
+        for a in kv_arrays
+    ):
+        return None
+    page_nbytes = sum(int(a.nbytes) // num_pages for a in kv_arrays)
+    if budget_bytes < page_nbytes:
+        return None
+
+    _jit_gather = jax.jit(
+        lambda kv, ids: [gather_pages(layer, ids) for layer in kv]
+    )
+    _jit_scatter = jax.jit(
+        lambda kv, ids, datas: [
+            scatter_pages(layer, ids, data)
+            for layer, data in zip(kv, datas)
+        ],
+        donate_argnums=(0,),
+    )
+
+    def _bucket_ids(page_ids: list[int]) -> np.ndarray:
+        # Power-of-two id buckets bound transfer recompiles; padding
+        # repeats the first id — harmless for gather (extra rows sliced
+        # off host-side) and for scatter (the same payload rewritten).
+        b = 1
+        while b < len(page_ids):
+            b *= 2
+        ids = np.full((b,), page_ids[0], np.int32)
+        ids[: len(page_ids)] = page_ids
+        return ids
+
+    def gather_fn(page_ids: list[int]) -> list[np.ndarray]:
+        ids = _bucket_ids(page_ids)
+        staged = _jit_gather(get_kv(), jnp.asarray(ids))
+        for s in staged:
+            # Start every layer's D2H before materializing any of them,
+            # so the per-layer copies overlap each other (they still
+            # order after the in-flight step that produced these
+            # buffers).
+            s.copy_to_host_async()
+        return [np.asarray(s)[: len(page_ids)] for s in staged]
+
+    def scatter_fn(page_ids: list[int], layers: list[np.ndarray]) -> None:
+        n = len(page_ids)
+        ids = _bucket_ids(page_ids)
+        padded = []
+        for data in layers:
+            if ids.shape[0] != n:
+                pad = np.repeat(data[:1], ids.shape[0] - n, axis=0)
+                data = np.concatenate([data, pad], axis=0)
+            padded.append(data)
+        set_kv(_jit_scatter(get_kv(), jnp.asarray(ids), padded))
+
+    return HostKVTier(
+        budget_bytes, page_nbytes, gather_fn, scatter_fn, low_watermark
+    )

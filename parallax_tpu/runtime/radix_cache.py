@@ -19,10 +19,14 @@ from __future__ import annotations
 import time
 from typing import Callable
 
+from parallax_tpu.utils import get_logger
+
+logger = get_logger(__name__)
+
 
 class _Node:
     __slots__ = ("key", "page_id", "children", "parent", "lock_ref",
-                 "last_access", "linear_slot")
+                 "last_access", "linear_slot", "host_handle")
 
     def __init__(self, key: tuple[int, ...], page_id: int, parent: "_Node | None"):
         self.key = key                      # the page's token ids
@@ -34,22 +38,44 @@ class _Node:
         # Linear-state snapshot at this node's token boundary (hybrid
         # models only; None = pages-only node).
         self.linear_slot: int | None = None
+        # Host-tier residency: a demoted node keeps its key in the tree
+        # but its KV lives in the host pool under this handle
+        # (page_id == -1 while set). Invariant: host-resident nodes only
+        # ever sit BELOW device-resident ones — eviction demotes the
+        # device fringe bottom-up — so a match walk sees device pages,
+        # then host pages, never interleaved.
+        self.host_handle: int | None = None
+
+    @property
+    def on_device(self) -> bool:
+        return self.host_handle is None
 
 
 class RadixPageCache:
     """Prefix cache over full KV pages."""
 
     def __init__(self, page_size: int, on_evict: Callable[[int], None] | None = None,
-                 on_evict_slot: Callable[[int], None] | None = None):
+                 on_evict_slot: Callable[[int], None] | None = None,
+                 host_free: Callable[[int], None] | None = None):
         self.page_size = page_size
         self.on_evict = on_evict
         self.on_evict_slot = on_evict_slot
+        # Called with the host handle when a host-resident node is
+        # dropped from the tree (its pool page is no longer reachable).
+        self.host_free = host_free
         self._root = _Node((), -1, None)
         self._num_pages = 0
+        self._num_host_pages = 0
+        # handle -> node, for the host pool's eviction callback.
+        self._host_nodes: dict[int, _Node] = {}
 
     @property
     def num_cached_pages(self) -> int:
         return self._num_pages
+
+    @property
+    def num_host_pages(self) -> int:
+        return self._num_host_pages
 
     # -- matching ---------------------------------------------------------
 
@@ -157,6 +183,13 @@ class RadixPageCache:
                 child = _Node(key, page_ids[i], node)
                 node.children[key] = child
                 self._num_pages += 1
+            elif not child.on_device:
+                # Host-resident twin: adopt the caller's freshly computed
+                # device copy (identical KV) and drop the stale host page
+                # — promotion by recomputation.
+                self._release_host(child)
+                child.page_id = page_ids[i]
+                self._num_pages += 1
             elif child.page_id != page_ids[i]:
                 duplicates.append(page_ids[i])
             child.last_access = now
@@ -165,48 +198,170 @@ class RadixPageCache:
 
     # -- eviction ---------------------------------------------------------
 
-    def evict(self, num_pages: int) -> list[int]:
-        """Evict up to ``num_pages`` unpinned LRU leaf pages.
+    def evict(self, num_pages: int, demoter=None) -> list[int]:
+        """Evict up to ``num_pages`` unpinned LRU device-leaf pages.
 
         Returns freed device page ids (also passed to ``on_evict``).
-        Reference: ``evict_lru_blocks`` (block_radix_cache.py:252-291).
+        With a ``demoter`` — ``demoter(page_ids) -> [handle | None] |
+        None`` — victims' KV moves to the host tier in one batched
+        gather instead of vanishing: the node stays in the tree tagged
+        host-resident and a later ``match_prefix`` can still hit it.
+        Victims whose demotion fails (host tier full) are dropped
+        outright, together with any host-resident descendants.
+        Reference: ``evict_lru_blocks`` (block_radix_cache.py:252-291);
+        demotion follows SGLang HiCache's HBM->host hierarchy.
         """
-        freed: list[int] = []
-        while len(freed) < num_pages:
+        # Victim selection keeps the reference's iterative LRU-leaf
+        # discipline EXACTLY (the native impl is differentially fuzzed
+        # against it): pick the LRU unpinned device-leaf, detach it —
+        # exposing its parent as the next candidate — and repeat. Only
+        # the KV transfer is batched: one demoter call covers the whole
+        # victim set (single staging gather + async D2H).
+        victims: list[_Node] = []
+        while len(victims) < num_pages:
             leaf = self._lru_unpinned_leaf()
             if leaf is None:
                 break
             del leaf.parent.children[leaf.key]
-            self._num_pages -= 1
+            victims.append(leaf)
+        if not victims:
+            return []
+        # Victims run coldest-first with children before parents, so a
+        # partial demoter keeping only a suffix (HostKVTier.demote
+        # partial mode) never re-attaches a kept child under a dropped
+        # parent.
+        handles = None
+        if demoter is not None:
+            try:
+                handles = demoter([n.page_id for n in victims])
+            except Exception:  # noqa: BLE001 - any transfer failure
+                # A failed transfer (e.g. host allocation under the very
+                # memory pressure this tier targets) must not leak the
+                # already-detached victims' device pages: degrade to
+                # plain eviction.
+                logger.warning(
+                    "host-tier demotion failed; evicting %d pages "
+                    "without offload", len(victims), exc_info=True,
+                )
+        freed: list[int] = []
+        for i, leaf in enumerate(victims):
             freed.append(leaf.page_id)
             if self.on_evict:
                 self.on_evict(leaf.page_id)
             if leaf.linear_slot is not None and self.on_evict_slot:
+                # The device-side state snapshot does not follow the
+                # page to host; the slot returns to the engine pool
+                # either way.
                 self.on_evict_slot(leaf.linear_slot)
+                leaf.linear_slot = None
+            self._num_pages -= 1
+            h = handles[i] if handles else None
+            if h is not None:
+                # Re-attach tier-tagged: the node's KV now lives in the
+                # host pool and future matches can still walk it.
+                leaf.parent.children[leaf.key] = leaf
+                leaf.page_id = -1
+                leaf.host_handle = h
+                self._host_nodes[h] = leaf
+                self._num_host_pages += 1
+            else:
+                self._drop_host_subtree(leaf)
         return freed
 
+    def _drop_host_subtree(self, node: _Node) -> None:
+        """Release the (all host-resident) descendants of a dropped
+        device node; their pages return to the pool via ``host_free``."""
+        stack = list(node.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            self._release_host(n)
+            if n.linear_slot is not None and self.on_evict_slot:
+                self.on_evict_slot(n.linear_slot)
+
+    def _release_host(self, node: _Node) -> None:
+        """Drop a node's host residency (freeing the pool page)."""
+        if node.host_handle is None:
+            return
+        self._host_nodes.pop(node.host_handle, None)
+        if self.host_free:
+            self.host_free(node.host_handle)
+        node.host_handle = None
+        self._num_host_pages -= 1
+
+    # -- host tier --------------------------------------------------------
+
+    def promote_node(self, node: _Node, page_id: int) -> int:
+        """A host-resident node regains a device page (the caller has
+        swapped its KV in). Returns the host handle the caller must
+        release from the pool."""
+        handle = node.host_handle
+        self._host_nodes.pop(handle, None)
+        node.host_handle = None
+        node.page_id = page_id
+        self._num_host_pages -= 1
+        self._num_pages += 1
+        node.last_access = time.monotonic()
+        return handle
+
+    def drop_host_page(self, handle: int) -> bool:
+        """Host-pool eviction callback: drop the node holding ``handle``
+        (and its host-resident subtree — children are unreachable
+        without their ancestor's pages). Refuses pinned nodes: a locked
+        path is mid-swap-in for an admitting request."""
+        node = self._host_nodes.get(handle)
+        if node is None:
+            return True    # already gone; the pool may reclaim the slot
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if n.lock_ref > 0:
+                return False
+            stack.extend(n.children.values())
+        del node.parent.children[node.key]
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            self._release_host(n)
+            if n.linear_slot is not None and self.on_evict_slot:
+                self.on_evict_slot(n.linear_slot)
+        return True
+
     def _lru_unpinned_leaf(self) -> _Node | None:
+        """LRU unpinned device-resident node with no device-resident
+        children (host-resident subtrees hang below the device fringe
+        and do not shield their ancestors from eviction)."""
         best: _Node | None = None
         stack = list(self._root.children.values())
         while stack:
             n = stack.pop()
-            if n.children:
-                stack.extend(n.children.values())
-            elif n.lock_ref <= 0:
+            if not n.on_device:
+                continue   # host subtrees never contain device pages
+            stack.extend(n.children.values())
+            if n.lock_ref <= 0 and not any(
+                c.on_device for c in n.children.values()
+            ):
                 if best is None or n.last_access < best.last_access:
                     best = n
         return best
 
     def reset(self) -> list[int]:
-        """Drop the whole tree, returning every owned page id."""
+        """Drop the whole tree, returning every owned device page id
+        (host-resident pages are released through ``host_free``)."""
         pages: list[int] = []
         stack = list(self._root.children.values())
         while stack:
             n = stack.pop()
-            pages.append(n.page_id)
+            if n.on_device:
+                pages.append(n.page_id)
+            else:
+                self._release_host(n)
             if n.linear_slot is not None and self.on_evict_slot:
                 self.on_evict_slot(n.linear_slot)
             stack.extend(n.children.values())
         self._root = _Node((), -1, None)
         self._num_pages = 0
+        self._num_host_pages = 0
+        self._host_nodes.clear()
         return pages

@@ -29,6 +29,9 @@ class RequestStatus(enum.Enum):
     PENDING = "pending"          # waiting for admission (KV not allocated)
     PREFILLING = "prefilling"    # admitted, prompt chunks in flight
     DECODING = "decoding"        # generating, one token per pipeline round
+    # Swapped out to the host KV tier under memory pressure (decode OOM);
+    # parked in the wait queue, resumes via swap-in when pages free up.
+    PREEMPTED = "preempted"
     FINISHED_EOS = "finished_eos"
     FINISHED_LENGTH = "finished_length"
     FINISHED_STOP = "finished_stop"
@@ -170,7 +173,11 @@ class Request:
         if self.num_output_tokens >= sp.max_new_tokens:
             self.status = RequestStatus.FINISHED_LENGTH
             return
-        self.status = RequestStatus.DECODING
+        if self.status is not RequestStatus.PREEMPTED:
+            # A preempted request can still receive the commit of a step
+            # that was in flight when it was swapped out; the token is
+            # recorded but the request stays parked until swap-in.
+            self.status = RequestStatus.DECODING
 
     def abort(self, reason: str = "") -> None:
         self.status = RequestStatus.FINISHED_ABORT

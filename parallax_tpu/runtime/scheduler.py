@@ -118,6 +118,25 @@ class Scheduler:
         """
         while self.wait_queue and len(self.running) < self.max_batch_size:
             rid, req = next(iter(self.wait_queue.items()))
+            if req.status.is_finished:
+                # Aborted while parked (timeout / client cancel): route it
+                # through the running set so the normal finish collection
+                # releases its state.
+                del self.wait_queue[rid]
+                self.running[rid] = req
+                continue
+            if req.status is RequestStatus.PREEMPTED:
+                # Preempted-to-host: swap the KV image back in instead of
+                # re-allocating a prompt. FCFS discipline is unchanged —
+                # a resume that does not fit blocks admission like any
+                # other head-of-queue request.
+                resume = getattr(self.cache, "resume_from_host", None)
+                if resume is None or not resume(req):
+                    break
+                del self.wait_queue[rid]
+                req.status = RequestStatus.DECODING
+                self.running[rid] = req
+                continue
             if not self.cache.allocate_for_prompt(req):
                 break
             del self.wait_queue[rid]
@@ -155,7 +174,7 @@ class Scheduler:
         engine step — the O(requests) timeout scan must not run twice.)
         """
         self.admit_requests()
-        for req in self.running.values():
+        for req in list(self.running.values()):
             if req.status is not RequestStatus.PREFILLING:
                 continue
             if req.lora_id is not None:
@@ -165,8 +184,7 @@ class Scheduler:
             n = req.num_prompt_tokens
             if req.num_computed_tokens != 0 or n < threshold:
                 continue
-            if not self.cache.ensure_capacity(req, n):
-                self._abort_on_oom(req)
+            if not self._ensure_capacity_or_preempt(req, n):
                 continue
             return BatchPlan([
                 ScheduledSeq(
@@ -244,7 +262,9 @@ class Scheduler:
         token_budget = self.max_num_tokens_per_batch
 
         # Prefill chunks first (including re-chunked long prompts).
-        for req in self.running.values():
+        # Snapshot: preemption-to-host can move a running request to the
+        # wait queue mid-iteration.
+        for req in list(self.running.values()):
             if len(seqs) >= self.max_batch_size or token_budget <= 0:
                 break
             if req.status is not RequestStatus.PREFILLING:
@@ -271,8 +291,7 @@ class Scheduler:
                     n = a - start
             # Mirror requests grow their prompt incrementally (chunks arrive
             # over the wire), so page capacity may lag the prompt length.
-            if not self.cache.ensure_capacity(req, start + n):
-                self._abort_on_oom(req)
+            if not self._ensure_capacity_or_preempt(req, start + n):
                 continue
             seqs.append(
                 ScheduledSeq(
@@ -320,17 +339,22 @@ class Scheduler:
             start = self._decode_cursor % len(candidates)
             candidates = candidates[start:] + candidates[:start]
         seqs: list[ScheduledSeq] = []
+        scheduled: set[str] = set()
         for req in candidates:
             if len(seqs) >= max_seqs or token_budget <= 0:
                 break
+            if req.status is not RequestStatus.DECODING:
+                continue   # preempted by an earlier row in this pass
             # A device-fed row's next token was sampled by the in-flight
             # step and lives only on device: it occupies one more context
             # slot than the host-committed total.
             fed = req.device_feed_ready and not req.ready_for_step
             ctx = req.total_len + 1 if fed else req.total_len
-            if not self.cache.ensure_capacity(req, ctx):
-                self._abort_on_oom(req)
+            if not self._ensure_capacity_or_preempt(
+                req, ctx, allow_self=True, exclude_scheduled=scheduled,
+            ):
                 continue
+            scheduled.add(req.request_id)
             seqs.append(
                 ScheduledSeq(
                     request=req,
@@ -397,6 +421,103 @@ class Scheduler:
     def _abort_on_oom(self, req: Request) -> None:
         logger.warning("decode OOM: aborting %s", req.request_id)
         req.abort("kv_oom")
+        stats = getattr(self.cache, "stats", None)
+        if stats is not None:
+            stats.kv_oom_aborts += 1
+
+    # -- preemption to host -----------------------------------------------
+
+    def _ensure_capacity_or_preempt(
+        self,
+        req: Request,
+        new_total_tokens: int,
+        allow_self: bool = False,
+        exclude_scheduled: set[str] | None = None,
+    ) -> bool:
+        """``ensure_capacity`` with preemption-to-host behind it.
+
+        Under memory pressure, swap out the lowest-priority running
+        decode (latest arrival first) until ``req`` fits. When nothing
+        is left to preempt: park ``req`` itself if eligible
+        (``allow_self``, decode path), else abort it — ``kv_oom`` is the
+        last resort once the host tier is also exhausted, not the first
+        response to pressure. Returns True when ``req`` may be
+        scheduled this step.
+        """
+        if self.cache.ensure_capacity(req, new_total_tokens):
+            return True
+        preempt = getattr(self.cache, "preempt_to_host", None)
+        if preempt is not None:
+            skip: set[str] = set(exclude_scheduled or ())
+            while True:
+                victim = self._preemption_victim(req, skip)
+                if victim is None:
+                    break
+                if not preempt(victim):
+                    # This victim's KV image does not fit the host tier;
+                    # a smaller (slightly older) victim still might —
+                    # keep walking before declaring the tier full.
+                    skip.add(victim.request_id)
+                    continue
+                self._park(victim)
+                if self.cache.ensure_capacity(req, new_total_tokens):
+                    return True
+            if (
+                allow_self
+                and req.status is RequestStatus.DECODING
+                and (req.ready_for_step or req.device_feed_ready)
+                and preempt(req)
+            ):
+                # req is itself the lowest priority: park it rather than
+                # abort — its pages unblock older requests immediately.
+                self._park(req)
+                return False
+        self._abort_on_oom(req)
+        return False
+
+    def _preemption_victim(
+        self, exclude: Request, exclude_ids: set[str] | None = None
+    ) -> Request | None:
+        """Latest-arrival running decode that is safe to swap out.
+
+        Safe: a committed row awaiting scheduling (``ready_for_step``),
+        or a row whose next token sits in the device last-token array
+        (``device_feed_ready``) — an in-flight step's writes to its
+        pages are ordered BEFORE the demotion gather on the device
+        stream, and its pending commit lands on the parked request
+        object directly. Unsafe: a row awaiting a ring/host token with
+        nothing device-resident (the late commit would look up the
+        running set and drop the token), rows already placed in the
+        plan being formed (their segment would reference freed pages),
+        mirrors, and hybrid state-slot holders (their swap-out would
+        need cross-stage/state coordination this tier does not model).
+        """
+        best: Request | None = None
+        for r in self.running.values():
+            if (
+                r is exclude
+                or r.status is not RequestStatus.DECODING
+                or not (r.ready_for_step or r.device_feed_ready)
+                or (exclude_ids and r.request_id in exclude_ids)
+                or getattr(r, "is_mirror", False)
+                or getattr(r, "state_slot", None) is not None
+            ):
+                continue
+            if best is None or r.arrival_time > best.arrival_time:
+                best = r
+        return best
+
+    def _park(self, req: Request) -> None:
+        """Move a preempted (always DECODING) request to the wait-queue
+        FRONT: preempted requests carry the oldest arrivals among waiting
+        work, so FCFS resume order falls out of front insertion.
+        ``ready_for_step`` is preserved: a parked row with a commit still
+        in flight is re-armed by ``on_token_committed`` when it lands."""
+        self.running.pop(req.request_id, None)
+        req.status = RequestStatus.PREEMPTED
+        req.device_feed_ready = False
+        self.wait_queue[req.request_id] = req
+        self.wait_queue.move_to_end(req.request_id, last=False)
 
     def check_timeouts(self) -> list[Request]:
         """Abort requests exceeding the wall-clock budget

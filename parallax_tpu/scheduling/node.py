@@ -96,6 +96,10 @@ class Node:
     # Two-phase decode telemetry from heartbeats (host_ms/device_ms
     # EWMAs, overlap fraction); surfaced in /cluster/status.
     step_timing: dict | None = None
+    # Prefix-cache / memory-tier counters from heartbeats (hit rates
+    # split device/host tier, occupancy, demotion/swap-in/preemption
+    # counts); surfaced in /cluster/status.
+    cache_stats: dict | None = None
 
     def __post_init__(self):
         self.perf = RooflinePerformanceModel(self.hardware, self.model)

@@ -100,10 +100,11 @@ class GlobalScheduler:
         refit_version: int | None = None,
         lora_adapters: list | None = None,
         step_timing: dict | None = None,
+        cache_stats: dict | None = None,
     ) -> None:
         self._events.put(
             ("update", node_id, layer_latency_ms, load, rtt_s, is_ready,
-             refit_version, lora_adapters, step_timing)
+             refit_version, lora_adapters, step_timing, cache_stats)
         )
 
     def receive_request(self, request_id: str) -> PendingRequest:
@@ -170,7 +171,8 @@ class GlobalScheduler:
         elif kind == "leave":
             self._handle_leave(ev[1])
         elif kind == "update":
-            _, node_id, lat, load, rtt, ready, refit, adapters, timing = ev
+            (_, node_id, lat, load, rtt, ready, refit, adapters, timing,
+             cache_stats) = ev
             node = self.manager.get(node_id)
             if node is None:
                 return
@@ -189,6 +191,8 @@ class GlobalScheduler:
                 node.lora_adapters = tuple(adapters)
             if timing is not None:
                 node.step_timing = timing
+            if cache_stats is not None:
+                node.cache_stats = cache_stats
 
     def _try_bootstrap_or_extend(self) -> None:
         standby = self.manager.nodes(NodeState.STANDBY)
@@ -356,6 +360,10 @@ class GlobalScheduler:
                         # Overlapped decode loop telemetry (host_ms /
                         # device_ms EWMAs + overlap fraction).
                         "step_timing": n.step_timing,
+                        # Prefix-cache / memory-tier counters (hit
+                        # rates, occupancy, demotions, swap-ins,
+                        # preemptions) from heartbeats.
+                        "cache_stats": n.cache_stats,
                     }
                     for n in p.nodes
                 ],

@@ -89,6 +89,40 @@ def detect_hardware() -> HardwareInfo:
     )
 
 
+def host_available_memory_bytes() -> int:
+    """Host DRAM available for the KV offload tier (0 when unknown).
+
+    Linux ``MemAvailable`` (kernel's reclaimable estimate) rather than
+    MemFree: page cache the kernel would drop under pressure should
+    count toward the tier budget.
+    """
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 0
+
+
+def default_host_cache_bytes(
+    fraction: float = 0.5, override: int | None = None
+) -> int:
+    """Host-KV-tier budget: the operator's explicit value when given,
+    otherwise half of available DRAM on accelerator backends (so the
+    tier never drives the host into swap). 0 (tier off) on CPU or when
+    availability cannot be read — CPU test runs configure the budget
+    explicitly. The single policy point for serve and swarm workers."""
+    if override is not None:
+        return override
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return 0
+    return int(host_available_memory_bytes() * fraction)
+
+
 def device_free_memory_bytes(fraction: float = 0.9) -> int:
     """Usable HBM bytes on device 0 for KV-cache budgeting.
 

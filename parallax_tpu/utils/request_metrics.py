@@ -59,6 +59,74 @@ class StepTimingAggregator:
         }
 
 
+class CacheStats:
+    """Prefix-cache and memory-tier counters for one engine stage.
+
+    Owned by the stage's CacheManager (Python or native) and incremented
+    on the admission/eviction/preemption paths; summarized per heartbeat
+    for ``/cluster/status`` and per run for bench JSON via
+    :func:`cache_stats_summary`.
+    """
+
+    __slots__ = ("tokens_admitted", "tokens_hit_device", "tokens_hit_host",
+                 "pages_evicted", "preemptions", "resumes",
+                 "kv_oom_aborts")
+
+    def __init__(self):
+        self.tokens_admitted = 0     # prompt tokens of admitted requests
+        self.tokens_hit_device = 0   # skipped via HBM-resident prefixes
+        self.tokens_hit_host = 0     # skipped via host-tier swap-ins
+        self.pages_evicted = 0       # device pages reclaimed from the tree
+        self.preemptions = 0         # decode-OOM swap-outs to host
+        self.resumes = 0             # preempted requests swapped back in
+        self.kv_oom_aborts = 0       # last-resort aborts (host tier full)
+
+
+def cache_stats_summary(cache) -> dict | None:
+    """Heartbeat/status/bench payload for a CacheManager-like object;
+    None when it carries no stats (metrics never break serving)."""
+    stats = getattr(cache, "stats", None)
+    if stats is None:
+        return None
+    try:
+        admitted = stats.tokens_admitted
+        hit = stats.tokens_hit_device + stats.tokens_hit_host
+        num_pages = getattr(cache, "num_pages", 0)
+        free = getattr(cache, "num_free_pages", 0)
+        d = {
+            "tokens_admitted": admitted,
+            "tokens_hit_device": stats.tokens_hit_device,
+            "tokens_hit_host": stats.tokens_hit_host,
+            "prefix_hit_rate": round(hit / admitted, 4) if admitted else 0.0,
+            "host_hit_rate": (
+                round(stats.tokens_hit_host / admitted, 4) if admitted
+                else 0.0
+            ),
+            "pages_evicted": stats.pages_evicted,
+            "preemptions": stats.preemptions,
+            "resumes": stats.resumes,
+            "kv_oom_aborts": stats.kv_oom_aborts,
+            "page_occupancy": (
+                round(1.0 - free / num_pages, 4) if num_pages else 0.0
+            ),
+            "cached_pages": getattr(
+                getattr(cache, "prefix_cache", None), "num_cached_pages", 0
+            ),
+        }
+        tier = getattr(cache, "host_tier", None)
+        if tier is not None:
+            d.update(
+                host_pages=tier.num_host_pages,
+                host_capacity_pages=tier.capacity_pages,
+                pages_demoted=tier.pages_demoted,
+                pages_swapped_in=tier.pages_swapped_in,
+                host_evictions=tier.host_evictions,
+            )
+        return d
+    except Exception:  # pragma: no cover - defensive; see docstring
+        return None
+
+
 def parse_usage_chunk(chunk: bytes | str | dict) -> dict | None:
     """The ``usage`` object of an SSE data chunk, or None."""
     try:

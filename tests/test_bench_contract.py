@@ -35,6 +35,20 @@ def test_bench_cpu_smoke_prints_one_json_line():
     for key in ("host_ms_median", "device_ms_median", "overlapped_steps",
                 "sync_decode_dispatch_ms_median"):
         assert key in rec["detail"], rec["detail"]
+    # Cache observability + the host-KV-tier pressure probe: the tier-on
+    # run must finish everything without kv_oom while the tier-off run
+    # aborts — the new-subsystem acceptance contract.
+    assert "cache_stats" in rec["detail"], rec["detail"]
+    hc = rec["detail"]["host_cache"]
+    for run in ("enabled", "disabled"):
+        for key in ("prefix_hit_rate", "tokens_hit_host", "kv_oom_aborts",
+                    "preemptions", "completed", "requests"):
+            assert key in hc[run], hc
+    assert hc["enabled"]["kv_oom_aborts"] == 0, hc
+    assert hc["enabled"]["completed"] == hc["enabled"]["requests"], hc
+    assert hc["disabled"]["kv_oom_aborts"] > 0, hc
+    assert (hc["enabled"]["prefix_hit_rate"]
+            > hc["disabled"]["prefix_hit_rate"]), hc
 
 
 def test_bench_dsa_mode_cpu_smoke():
