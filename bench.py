@@ -393,6 +393,128 @@ def _relay_evidence() -> dict:
     return ev
 
 
+def _transport_probe(cfg, stage_params_fn, kv_dtype, page_size):
+    """Two-stage loopback swarm, clean vs slow-peer links (see the call
+    site). Returns the probe record for ``detail.transport``."""
+    import statistics
+    import time as _time
+
+    import numpy as np
+
+    from parallax_tpu.p2p.node import WorkerNode
+    from parallax_tpu.p2p.transport import LoopbackTransport
+    from parallax_tpu.runtime.engine import EngineConfig
+    from parallax_tpu.runtime.request import Request, SamplingParams
+
+    delay_s = float(os.environ.get("BENCH_TRANSPORT_DELAY_S", "0.05"))
+    n_req, prompt_len, gen_len = 4, 16, 16
+    split = max(1, cfg.num_hidden_layers // 2)
+    max_model_len = prompt_len + gen_len + 2 * page_size
+
+    def run(delay: float) -> dict:
+        registry: dict = {}
+        transports = [
+            LoopbackTransport("tw0", registry),
+            LoopbackTransport("tw1", registry),
+        ]
+        if delay:
+            # Slow peer: every data-plane send pays the delay (gossip
+            # rides call(), which stays fast — only the activation path
+            # is stalled, exactly what a congested WAN link does).
+            for t in transports:
+                real = t.send
+
+                def slow(peer, method, payload, _real=real):
+                    _time.sleep(delay)
+                    _real(peer, method, payload)
+
+                t.send = slow
+        ecfg = EngineConfig(
+            page_size=page_size,
+            num_pages=n_req * (max_model_len // page_size + 2) + 8,
+            max_batch_size=n_req, max_model_len=max_model_len,
+            kv_dtype=kv_dtype, enable_prefix_cache=False,
+        )
+        workers = [
+            WorkerNode(
+                transport=transports[i],
+                scheduler_peer=None,
+                model_config=cfg,
+                engine_config=ecfg,
+                load_params=stage_params_fn,
+                heartbeat_interval_s=0.1,
+                static_peers=[transports[1 - i].peer_id],
+                layers=(
+                    (0, split) if i == 0
+                    else (split, cfg.num_hidden_layers)
+                ),
+            )
+            for i in range(2)
+        ]
+        try:
+            for w in workers:
+                w.start()
+            head = workers[0]
+            deadline = _time.time() + 120
+            while _time.time() < deadline:
+                if head.engine is not None and head.local_route():
+                    break
+                _time.sleep(0.02)
+            # Record the head's per-step HOST-BLOCKING ms (the dispatch
+            # cadence the sender pipeline must protect).
+            host_ms: list[float] = []
+            agg = head.engine.step_timing
+            orig_update = agg.update
+
+            def record(h, d, o):
+                host_ms.append(h)
+                orig_update(h, d, o)
+
+            agg.update = record
+            rng = np.random.default_rng(3)
+            reqs, events = [], []
+            t0 = time.perf_counter()
+            for i in range(n_req):
+                req = Request(
+                    request_id=f"tp{i}",
+                    prompt_ids=[int(x) for x in rng.integers(
+                        1, cfg.vocab_size - 1, size=prompt_len
+                    )],
+                    sampling_params=SamplingParams(
+                        temperature=0.0, max_new_tokens=gen_len,
+                        ignore_eos=True,
+                    ),
+                )
+                reqs.append(req)
+                events.append(head.submit(req))
+            ok = all(ev.wait(120.0) for ev in events)
+            wall = time.perf_counter() - t0
+            return {
+                "requests": n_req,
+                "completed": sum(
+                    1 for r in reqs
+                    if r.status.is_finished
+                    and r.status.value != "finished_abort"
+                ),
+                "finished_in_time": ok,
+                "decode_dispatch_ms_median": round(
+                    statistics.median(host_ms), 3
+                ) if host_ms else 0.0,
+                "steps": len(host_ms),
+                "wall_s": round(wall, 2),
+                "links": head.transport_stats() or {},
+            }
+        finally:
+            for w in workers:
+                w.stop()
+
+    return {
+        "slow_peer_delay_ms": round(delay_s * 1000, 1),
+        "baseline": run(0.0),
+        "delayed": run(delay_s),
+    }
+
+
 def _bench():
     import jax
 
@@ -797,6 +919,25 @@ def _bench():
             "enabled": pressure_round(1 << 28),
             "disabled": pressure_round(0),
         }
+
+    # Activation-transport probe: a two-stage LOOPBACK swarm (real
+    # WorkerNodes, real wire serialization, in-process transport) run
+    # twice — clean links vs an injected slow peer (every inter-stage
+    # send sleeps ``delay``). The async sender pipeline moves serialize +
+    # send off the step thread, so the head's decode DISPATCH cadence
+    # (host-blocking ms per step) must stay at the no-delay level while
+    # the per-peer queue absorbs the stall; a synchronous sender would
+    # push it past the injected delay. Cheap on CPU (part of the smoke
+    # contract); opt-in on TPU.
+    transport_probe = None
+    if not on_tpu or os.environ.get("BENCH_TRANSPORT"):
+        transport_probe = _transport_probe(
+            cfg, stage_params_fn=lambda m: m.init_params(
+                jax.random.key(m.start_layer * 1000 + m.end_layer),
+                dtype=dtype,
+            ),
+            kv_dtype=kv_dtype, page_size=page_size,
+        )
     total_s = time.perf_counter() - t_start
 
     # Decode throughput over the whole decode phase (wall-clock, includes
@@ -926,6 +1067,14 @@ def _bench():
             **(
                 {"host_cache": host_cache_probe}
                 if host_cache_probe is not None else {}
+            ),
+            # Activation-transport probe (two-stage loopback swarm,
+            # clean vs injected-slow-peer links): dispatch cadence must
+            # hold while the sender queue absorbs the stall; links carry
+            # per-peer bytes/serialize/send/queue/compression telemetry.
+            **(
+                {"transport": transport_probe}
+                if transport_probe is not None else {}
             ),
             **(
                 {

@@ -56,7 +56,14 @@ class SchedulerService:
         heartbeat channel alive during long waits)."""
         node_id = payload["node_id"]
         hw = HardwareInfo.from_dict(payload["hardware"])
-        self.scheduler.enqueue_join(node_id, hw)
+        self.scheduler.enqueue_join(
+            node_id, hw,
+            wire_formats=(
+                [str(f) for f in payload["wire_formats"]]
+                if isinstance(payload.get("wire_formats"), (list, tuple))
+                else None
+            ),
+        )
         deadline = time.monotonic() + self.join_timeout_s
         while time.monotonic() < deadline:
             alloc = self.scheduler.get_node_allocation(node_id)
@@ -114,6 +121,14 @@ class SchedulerService:
             cache_stats=(
                 payload["cache_stats"]
                 if isinstance(payload.get("cache_stats"), dict)
+                else None
+            ),
+            # Per-link activation-transport telemetry (bytes each way,
+            # serialize/send ms, queue depth, compression ratio) —
+            # surfaced per node in /cluster/status.
+            transport=(
+                payload["transport"]
+                if isinstance(payload.get("transport"), dict)
                 else None
             ),
         )

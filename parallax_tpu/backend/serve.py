@@ -294,6 +294,10 @@ def serve_main(args) -> int:
                 (getattr(args, "speculative_tokens", 0) or 0)
                 or (4 if draft is not None else 0)
             ),
+            # Single-host serving has no network hop; carried so a
+            # worker spawned from this config inherits the operator's
+            # wire choice (docs/networking.md).
+            wire_dtype=getattr(args, "wire_dtype", None),
         ),
         mesh=mesh,
         sp_mesh=sp_mesh,

@@ -82,6 +82,13 @@ def build_parser() -> argparse.ArgumentParser:
                        help="prompts at least this long prefill via SP")
     serve.add_argument("--tp-size", type=int, default=0,
                        help="0 = all local chips")
+    serve.add_argument(
+        "--wire-dtype", default=None,
+        choices=["bfloat16", "bf16", "fp8", "float8_e4m3fn"],
+        help="inter-stage activation wire format (default: the model's "
+             "native precision — bit-identical streams); fp8 compresses "
+             "hidden frames with per-token scales, negotiated per link",
+    )
 
     run = sub.add_parser("run", help="launch the scheduler + web frontend")
     run.add_argument("--model-name", required=True)
@@ -149,6 +156,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     join.add_argument("--sp-threshold", type=int, default=2048,
                       help="prompts at least this long prefill via SP")
+    join.add_argument(
+        "--wire-dtype", default=None,
+        choices=["bfloat16", "bf16", "fp8", "float8_e4m3fn"],
+        help="inter-stage activation wire format for this worker's "
+             "outbound links (default: native precision — bit-identical "
+             "streams); negotiated per link via wire_caps",
+    )
 
     bench = sub.add_parser("bench", help="offline throughput benchmark")
     bench.add_argument("--config", default="qwen2-7b")

@@ -305,6 +305,44 @@ def _get(cfg: dict, *names: str, default: Any = None) -> Any:
     return default
 
 
+# Wire dtype for inter-stage activation frames (p2p/proto.py): the
+# spellings operators use, keyed to the canonical names the wire format
+# understands. "fp8" compresses hidden states with per-token scales.
+_WIRE_DTYPE_ALIASES = {
+    "bf16": "bfloat16",
+    "bfloat16": "bfloat16",
+    "fp8": "float8_e4m3fn",
+    "float8": "float8_e4m3fn",
+    "float8_e4m3fn": "float8_e4m3fn",
+    "e4m3": "float8_e4m3fn",
+    "f32": "float32",
+    "fp32": "float32",
+    "float32": "float32",
+}
+
+
+def resolve_wire_dtype(
+    wire_dtype: str | None, model_dtype: str | None = None
+) -> str | None:
+    """Canonical wire dtype for inter-stage activation frames, or None
+    when activations should ship at their native precision (the default —
+    bit-identical multi-stage streams). A wire dtype equal to the model's
+    own dtype is also None: framing it "natively" is the same bytes, and
+    None keeps the exactness guarantee explicit."""
+    if wire_dtype in (None, "", "model", "native"):
+        return None
+    key = str(wire_dtype).lower()
+    if key not in _WIRE_DTYPE_ALIASES:
+        raise ValueError(
+            f"unknown wire dtype {wire_dtype!r} (want one of "
+            f"{sorted(set(_WIRE_DTYPE_ALIASES))})"
+        )
+    canon = _WIRE_DTYPE_ALIASES[key]
+    if model_dtype is not None and canon == str(model_dtype):
+        return None
+    return canon
+
+
 def normalize_config(raw: dict, model_name: str = "") -> ModelConfig:
     """Build a :class:`ModelConfig` from a HF ``config.json`` dict.
 

@@ -126,6 +126,43 @@ def convert_gptq_weight(
     }
 
 
+# Largest finite float8_e4m3fn value (OCP FP8 spec): per-token wire
+# scales normalize each row's absmax to this so the full e4m3 range is
+# used without overflow to NaN (e4m3fn has no inf).
+FP8_E4M3_MAX = 448.0
+
+
+def quantize_fp8_per_token(
+    arr: np.ndarray,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Per-token fp8 compression for activation frames on the wire.
+
+    Each row of ``arr`` [..., hidden] (one token's hidden state) is
+    scaled by its own absmax into float8_e4m3fn range:
+    ``arr ~= q * scales[..., None]``. Returns ``(q float8_e4m3fn,
+    scales float32[...])``. Per-token (not per-tensor) scales keep one
+    outlier token from crushing every other row's resolution — the
+    standard fp8 activation recipe.
+    """
+    from ml_dtypes import float8_e4m3fn
+
+    a = np.asarray(arr, np.float32)
+    amax = np.max(np.abs(a), axis=-1) if a.size else np.zeros(a.shape[:-1])
+    scales = np.maximum(amax / FP8_E4M3_MAX, 1e-12).astype(np.float32)
+    q = (a / scales[..., None]).astype(float8_e4m3fn)
+    return q, scales
+
+
+def dequantize_fp8_per_token(
+    q: np.ndarray, scales: np.ndarray, dtype=np.float32
+) -> np.ndarray:
+    """Inverse of :func:`quantize_fp8_per_token`."""
+    a = np.asarray(q, np.float32) * np.asarray(
+        scales, np.float32
+    )[..., None]
+    return a.astype(dtype)
+
+
 # FP4 e2m1 value table (OCP MX spec; nibble index -> value). Matches the
 # HF gpt-oss dequant reference (transformers/integrations/mxfp4.py).
 _FP4_VALUES = np.array(

@@ -163,6 +163,10 @@ def join_main(args) -> int:
             host_cache_bytes=_default_host_cache_bytes(
                 override=getattr(args, "host_cache_bytes", None)
             ),
+            # Inter-stage activation wire format; per-link negotiation
+            # and alias resolution happen in the worker's sender
+            # pipeline (docs/networking.md).
+            wire_dtype=getattr(args, "wire_dtype", None),
         ),
         load_params=load_params,
         mesh=mesh,

@@ -23,11 +23,11 @@ import time
 import jax
 import jax.numpy as jnp
 
-from parallax_tpu.config import ModelConfig
+from parallax_tpu.config import ModelConfig, resolve_wire_dtype
 from parallax_tpu.models.base import StageModel
 from parallax_tpu.models.registry import create_stage_model
 from parallax_tpu.p2p import proto
-from parallax_tpu.p2p.transport import Transport
+from parallax_tpu.p2p.transport import AsyncSender, Transport
 from parallax_tpu.runtime.engine import EngineConfig, StageEngine
 from parallax_tpu.runtime.request import (
     IntermediateRequest,
@@ -116,6 +116,22 @@ class WorkerNode:
         # Head-node bookkeeping: finished requests awaiting pickup.
         self._finished: queue.Queue[Request] = queue.Queue()
         self._request_events: dict[str, threading.Event] = {}
+        # Async sender pipeline: serialization + socket latency leave
+        # the step thread entirely (per-peer bounded in-order queues);
+        # overflow or send failure feeds the abort_path flow.
+        self.sender = AsyncSender(
+            transport, on_failure=self._on_send_failure
+        )
+        # Fail fast on a bad wire dtype: deferred to the sender workers
+        # it would masquerade as per-frame link failures and abort
+        # traffic with a misleading "peer unreachable" reason.
+        resolve_wire_dtype(
+            self.engine_config.wire_dtype, model_config.dtype
+        )
+        # Negotiated wire dtype per link (None = native precision) and
+        # per-source receive counters for the transport telemetry.
+        self._wire_dtypes: dict[str, str | None] = {}
+        self._rx_stats: dict[str, dict] = {}
 
         transport.register(proto.FORWARD, self._on_forward)
         transport.register(proto.ABORT, self._on_abort)
@@ -125,6 +141,7 @@ class WorkerNode:
         transport.register("chat_submit", self._on_chat_submit)
         transport.register("chat_poll", self._on_chat_poll)
         transport.register("chat_stop", self._on_chat_stop)
+        transport.register(proto.WIRE_CAPS, self._on_wire_caps)
         transport.register("__ping__", lambda *_: "pong")
         # Head-node chat requests by id (polled by the HTTP frontend;
         # reference: TransformerConnectionHandler.chat_completion proxies to
@@ -161,6 +178,7 @@ class WorkerNode:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=3.0)
+        self.sender.close()
         if self._gossip_pool is not None:
             self._gossip_pool.shutdown(wait=False, cancel_futures=True)
         if not self.standalone:
@@ -178,7 +196,14 @@ class WorkerNode:
         reply = self.transport.call(
             self.scheduler_peer,
             proto.NODE_JOIN,
-            {"node_id": self.node_id, "hardware": hw.to_dict()},
+            {
+                "node_id": self.node_id,
+                "hardware": hw.to_dict(),
+                # Wire-format capability advertisement: the dtype names
+                # this build can decode on activation frames (per-link
+                # senders re-confirm via wire_caps before compressing).
+                "wire_formats": list(proto.WIRE_DTYPES),
+            },
             timeout=300.0,
         )
         if not reply or ("start_layer" not in reply and "standby" not in reply):
@@ -405,6 +430,11 @@ class WorkerNode:
                         "cache_stats": (
                             eng.cache_stats() if eng else None
                         ),
+                        # Per-link activation-transport telemetry
+                        # (bytes/frames each way, serialize/send ms,
+                        # queue depth, compression ratio) — surfaced in
+                        # /cluster/status.
+                        "transport": self.transport_stats(),
                         "refit_version": self.refit_version,
                         "lora_adapters": (
                             eng.adapter_names() if eng else []
@@ -624,9 +654,79 @@ class WorkerNode:
             return None
         return [self.node_id] + tail
 
+    # -- wire-format negotiation + transport telemetry -----------------------
+
+    def _on_wire_caps(self, _peer: str, _payload):
+        """Per-link capability answer: the tensor dtypes this build can
+        decode. A sender only compresses a link after the receiving peer
+        lists the requested wire dtype here."""
+        return {"formats": list(proto.WIRE_DTYPES)}
+
+    def _wire_dtype_for(self, peer: str) -> str | None:
+        """Negotiated wire dtype for one link (cached). Runs on the
+        sender worker, never the step thread — the first frame to a peer
+        pays one capability RPC. Peers that cannot answer (older build,
+        interop) get native-precision frames."""
+        want = resolve_wire_dtype(
+            self.engine_config.wire_dtype, self.model_config.dtype
+        )
+        if want is None:
+            return None
+        if peer in self._wire_dtypes:
+            return self._wire_dtypes[peer]
+        try:
+            caps = self.transport.call(
+                peer, proto.WIRE_CAPS, None, timeout=10.0
+            )
+        except Exception as e:
+            # Transient probe failure (peer still booting, blip): this
+            # frame ships native, but the answer is NOT cached — the
+            # next frame re-probes, so one startup race never disables
+            # compression for the link's lifetime.
+            logger.warning(
+                "%s: wire_caps probe to %s failed (%s); sending native "
+                "frames until it answers", self.node_id, peer, e,
+            )
+            return None
+        got = None
+        formats = set((caps or {}).get("formats") or ())
+        if want in formats:
+            got = want
+        else:
+            logger.warning(
+                "%s: peer %s cannot decode wire dtype %s; sending "
+                "native frames on this link", self.node_id, peer, want,
+            )
+        self._wire_dtypes[peer] = got
+        return got
+
+    def _on_send_failure(self, peer: str, reason: str) -> None:
+        """Sender pipeline failure (queue overflow or dead peer): route
+        into the abort_path flow on the step thread — exactly what a
+        synchronous send failure used to trigger inline."""
+        logger.error("%s: async send to %s failed: %s",
+                     self.node_id, peer, reason)
+        self._post(("abort_path", peer))
+
+    def _count_rx(self, peer: str, wire_req: dict) -> None:
+        rx = self._rx_stats.setdefault(
+            peer or "?", {"frames_in": 0, "bytes_in": 0}
+        )
+        rx["frames_in"] += 1
+        rx["bytes_in"] += proto.tensor_nbytes(wire_req.get("hidden_states"))
+
+    def transport_stats(self) -> dict | None:
+        """Per-link telemetry for heartbeats / status surfaces: the
+        sender pipeline's outbound counters merged with inbound
+        frame/byte counts per source peer."""
+        links = self.sender.stats()
+        for peer, rx in list(self._rx_stats.items()):
+            links.setdefault(peer, {}).update(rx)
+        return links or None
+
     # -- transport handlers (any thread) -------------------------------------
 
-    def _on_forward(self, _peer: str, payload):
+    def _on_forward(self, peer: str, payload):
         if isinstance(payload, (bytes, bytearray)):
             # Reference-protocol peer: a raw protobuf ForwardRequest
             # (heterogeneous-swarm interop, p2p/interop.py).
@@ -636,6 +736,7 @@ class WorkerNode:
                 self._post(("forward", ireq))
             return "ok"
         for wire_req in payload["reqs"]:
+            self._count_rx(peer, wire_req)
             self._post(("forward", proto.ireq_from_wire(wire_req)))
         return "ok"
 
@@ -836,6 +937,10 @@ class WorkerNode:
                 # A next-hop peer is unreachable: abort everything routed
                 # through it; the normal finish flow then releases pages,
                 # fires client events and broadcasts to surviving peers.
+                # (Posted by the sender workers too, which can outlive an
+                # engine teardown — nothing to abort then.)
+                if self.engine is None:
+                    continue
                 peer = item[1]
                 sched = self.engine.scheduler
                 for req in (
@@ -909,8 +1014,11 @@ class WorkerNode:
             self._refit_fetching = False
 
     def _route_outputs(self, out) -> None:
-        """Group packets by next hop and fire rpc_pp_forward (reference
-        start_node_sender, p2p/server.py:628-755)."""
+        """Group packets by next hop and hand them to the sender
+        pipeline (reference start_node_sender, p2p/server.py:628-755).
+        Serialization and socket latency run on the per-peer sender
+        workers — the step thread only enqueues; a dead or backed-up
+        link surfaces as abort_path via the sender's failure callback."""
         by_peer: dict[str, list] = {}
         for ireq in out.forward:
             table = ireq.routing_table
@@ -929,41 +1037,60 @@ class WorkerNode:
             if target == self.node_id:
                 self._post(("forward", ireq))
             else:
-                by_peer.setdefault(target, []).append(proto.ireq_to_wire(ireq))
-        for peer, reqs in by_peer.items():
-            try:
-                self.transport.send(peer, proto.FORWARD, {"reqs": reqs})
-            except Exception as e:
-                logger.error("forward to %s failed: %s", peer, e)
-                self._post(("abort_path", peer))
+                by_peer.setdefault(target, []).append(ireq)
+        for peer, ireqs in by_peer.items():
+            self.sender.send(
+                peer, proto.FORWARD, self._forward_payload(peer, ireqs)
+            )
 
         for req in out.finished:
             self._finish(req)
 
+    def _forward_payload(self, peer: str, ireqs: list):
+        """Lazy FORWARD serialization for the sender worker: negotiate
+        the link's wire dtype (first use only), pack the tensors, and
+        report raw vs wire bytes for the compression telemetry."""
+
+        def build():
+            wd = self._wire_dtype_for(peer)
+            raw = sum(
+                i.hidden_states.nbytes
+                for i in ireqs if i.hidden_states is not None
+            )
+            reqs = [proto.ireq_to_wire(i, wire_dtype=wd) for i in ireqs]
+            wire = sum(
+                proto.tensor_nbytes(r.get("hidden_states")) for r in reqs
+            )
+            return {"reqs": reqs}, raw, wire
+
+        return build
+
     def _finish(self, req: Request) -> None:
         # Broadcast release to the rest of the path (reference abort
-        # broadcast, p2p/server.py:713-749).
+        # broadcast, p2p/server.py:713-749) — through the async sender:
+        # these ride the same per-peer FIFO as the data frames, so a
+        # RELEASE never overtakes the request's final FORWARD, and the
+        # step thread never blocks on a slow peer's socket.
         aborted = req.status.value == "finished_abort"
         for peer in req.routing_table:
             if peer == self.node_id:
                 continue
-            try:
-                self.transport.send(
-                    peer, proto.RELEASE,
-                    {"rids": [req.request_id], "abort": aborted},
-                )
-            except Exception:
-                pass
+            # best_effort: a lost RELEASE leaks a mirror until its
+            # timeout — same contract as the old swallowed-exception
+            # path; it must never escalate to aborting live requests.
+            self.sender.send(
+                peer, proto.RELEASE,
+                {"rids": [req.request_id], "abort": aborted},
+                best_effort=True,
+            )
         if not self.standalone:
-            try:
-                # Fire-and-forget: the step thread must not block on the
-                # scheduler's round trip.
-                self.transport.send(
-                    self.scheduler_peer, "request_complete",
-                    {"path": req.routing_table or [self.node_id]},
-                )
-            except Exception:
-                pass
+            # Fire-and-forget: the scheduler's round trip happens on its
+            # link's sender worker.
+            self.sender.send(
+                self.scheduler_peer, "request_complete",
+                {"path": req.routing_table or [self.node_id]},
+                best_effort=True,
+            )
         self._finished.put(req)
         ev = self._request_events.pop(req.request_id, None)
         if ev is not None:

@@ -5,9 +5,14 @@ Field semantics mirror the reference's ``forward.proto``
 repeated Req{rid, routing_table, input_ids, hidden_states, next_token_id,
 sampling_params, ...}}, AbortRequest) — re-encoded as msgpack for a
 dependency-light, schema-evolvable wire. Tensors are serialized as
-``{dtype, shape, data: raw bytes}`` (the reference uses safetensors bytes;
-raw+header avoids a container parse per hop and maps straight into
-``np.frombuffer`` -> ``jax.device_put``).
+``{dtype: name, shape, data: raw bytes}`` (the reference uses safetensors
+bytes; raw+header avoids a container parse per hop and maps straight into
+``np.frombuffer`` -> ``jax.device_put``). Dtypes travel by NAME, never by
+numpy type code — extension types (bfloat16, fp8) have no reconstructible
+code. Optional wire compression (negotiated per link, ``wire_caps``):
+bf16 frames ship natively at 2 B/element, and the opt-in fp8 link mode
+adds per-token ``scales`` + the original dtype so the receiver restores
+working precision. See docs/networking.md.
 """
 
 from __future__ import annotations
@@ -27,14 +32,95 @@ CHAT_COMPLETION = "chat_completion"
 NODE_JOIN = "node_join"
 NODE_UPDATE = "node_update"
 NODE_LEAVE = "node_leave"
+# Per-link wire-format negotiation (sender asks, receiver answers with
+# the dtype names it can decode; see docs/networking.md).
+WIRE_CAPS = "wire_caps"
 
 
-def tensor_to_wire(arr: np.ndarray | None) -> dict | None:
+def _build_dtype_registry() -> dict[str, np.dtype]:
+    """Explicit dtype-NAME registry for tensor frames.
+
+    ``arr.dtype.str`` does not survive the round trip for ml_dtypes
+    extension types: ``np.dtype(bfloat16).str`` is the opaque void code
+    ``'<V2'``, and ``np.dtype('<V2')`` reconstructs raw void bytes, not
+    bfloat16 — a bf16 activation hop would deliver garbage. Names are
+    the wire contract; numpy's own codes are still accepted on decode
+    for frames from older peers (standard dtypes only).
+    """
+    reg: dict[str, np.dtype] = {}
+    for name in (
+        "float16", "float32", "float64",
+        "int8", "int16", "int32", "int64",
+        "uint8", "uint16", "uint32", "uint64", "bool",
+    ):
+        reg[name] = np.dtype(name)
+    try:
+        import ml_dtypes
+
+        for t in ("bfloat16", "float8_e4m3fn", "float8_e5m2"):
+            reg[t] = np.dtype(getattr(ml_dtypes, t))
+    except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+        pass
+    return reg
+
+
+_NAME_TO_DTYPE = _build_dtype_registry()
+
+# Dtype names this build can decode — the capability list advertised in
+# node_join payloads and wire_caps replies. Compressed links are only
+# negotiated when the receiving peer lists the sender's wire dtype here.
+WIRE_DTYPES = tuple(sorted(_NAME_TO_DTYPE))
+
+# Dtypes eligible for lossy wire conversion (activations); integer and
+# bool tensors always ship verbatim.
+_FLOAT_NAMES = frozenset(
+    ("float16", "float32", "float64", "bfloat16")
+)
+
+
+def dtype_name(dtype) -> str:
+    """Canonical wire name of a numpy dtype (``np.dtype.name`` — stable
+    for both standard and ml_dtypes extension types)."""
+    return np.dtype(dtype).name
+
+
+def resolve_dtype(name: str) -> np.dtype:
+    dt = _NAME_TO_DTYPE.get(name)
+    if dt is not None:
+        return dt
+    # Legacy frames carry numpy type codes ('<f4'); extension types never
+    # round-trip through codes, so plain np.dtype is correct here.
+    return np.dtype(name)
+
+
+def tensor_to_wire(
+    arr: np.ndarray | None, wire_dtype: str | None = None
+) -> dict | None:
+    """Serialize one tensor, optionally converting float payloads to a
+    cheaper wire dtype. ``wire_dtype=None`` ships the bytes verbatim
+    (bit-identical streams); ``"bfloat16"`` downcasts on the wire;
+    ``"float8_e4m3fn"`` compresses with per-token scales (frame carries
+    ``scales`` + the original dtype to restore on receive)."""
     if arr is None:
         return None
     arr = np.ascontiguousarray(arr)
+    name = dtype_name(arr.dtype)
+    if wire_dtype and wire_dtype != name and name in _FLOAT_NAMES:
+        if wire_dtype == "float8_e4m3fn":
+            from parallax_tpu.ops.quant import quantize_fp8_per_token
+
+            q, scales = quantize_fp8_per_token(arr)
+            return {
+                "dtype": "float8_e4m3fn",
+                "shape": list(arr.shape),
+                "data": q.tobytes(),
+                "scales": scales.tobytes(),
+                "odtype": name,
+            }
+        arr = arr.astype(resolve_dtype(wire_dtype))
+        name = wire_dtype
     return {
-        "dtype": arr.dtype.str,
+        "dtype": name,
         "shape": list(arr.shape),
         "data": arr.tobytes(),
     }
@@ -43,19 +129,38 @@ def tensor_to_wire(arr: np.ndarray | None) -> dict | None:
 def tensor_from_wire(obj: dict | None) -> np.ndarray | None:
     if obj is None:
         return None
-    return np.frombuffer(obj["data"], dtype=np.dtype(obj["dtype"])).reshape(
-        obj["shape"]
-    )
+    arr = np.frombuffer(
+        obj["data"], dtype=resolve_dtype(obj["dtype"])
+    ).reshape(obj["shape"])
+    if obj.get("scales") is not None:
+        from parallax_tpu.ops.quant import dequantize_fp8_per_token
+
+        scales = np.frombuffer(obj["scales"], np.float32).reshape(
+            obj["shape"][:-1]
+        )
+        arr = dequantize_fp8_per_token(
+            arr, scales, resolve_dtype(obj.get("odtype") or "float32")
+        )
+    return arr
 
 
-def ireq_to_wire(ireq: IntermediateRequest) -> dict:
+def tensor_nbytes(obj: dict | None) -> int:
+    """Payload bytes of one wire tensor frame (data + scales)."""
+    if obj is None:
+        return 0
+    return len(obj["data"]) + len(obj.get("scales") or b"")
+
+
+def ireq_to_wire(
+    ireq: IntermediateRequest, wire_dtype: str | None = None
+) -> dict:
     return {
         "rid": ireq.request_id,
         "routing_table": list(ireq.routing_table),
         "context_len": ireq.context_len,
         "num_new_tokens": ireq.num_new_tokens,
         "token_ids": ireq.token_ids,
-        "hidden_states": tensor_to_wire(ireq.hidden_states),
+        "hidden_states": tensor_to_wire(ireq.hidden_states, wire_dtype),
         "next_token_id": ireq.next_token_id,
         "token_logprob": ireq.token_logprob,
         "sampling_params": ireq.sampling_params,

@@ -589,3 +589,230 @@ class TcpTransport(Transport):
 
 def make_ping_handler() -> Handler:
     return lambda _peer, _payload: "pong"
+
+
+# ---------------------------------------------------------------------------
+
+
+class AsyncSender:
+    """Per-peer sender pipeline over any :class:`Transport`.
+
+    The engine's step thread must never block on serialization or socket
+    I/O — a slow or distant peer would stall the dispatch cadence the
+    overlapped decode loop exists to protect. ``send()`` therefore only
+    enqueues: each peer gets a bounded FIFO queue drained by its own
+    worker thread, which (lazily) serializes the payload and runs the
+    blocking ``transport.send``. One worker per peer preserves per-peer
+    in-order delivery; independent peers drain concurrently, so one slow
+    link never backs up another.
+
+    Backpressure is a hard failure, not buffering: a full queue or a
+    failed send drops the frame, drains whatever else is queued for that
+    peer (those frames are for requests the failure callback is about to
+    abort) and fires ``on_failure(peer, reason)`` once per incident —
+    the caller routes that into its abort-path flow. Memory is bounded
+    by ``max_queue`` frames per peer, never by the peer's latency.
+    Frames sent with ``best_effort=True`` (release broadcasts, courtesy
+    notifications) never fire the failure callback — their loss must not
+    abort live traffic — but still count in the error telemetry.
+
+    ``payload`` may be a zero-arg callable for lazy serialization (the
+    expensive ``ireq_to_wire`` tensor copy runs on the worker, not the
+    step thread); it returns either the payload or a ``(payload,
+    raw_bytes, wire_bytes)`` tuple feeding the per-link telemetry.
+
+    Links idle for ``idle_reap_s`` retire themselves (worker exits, the
+    entry leaves the stats map) so elastic swarms with churn never
+    accumulate threads or telemetry for departed peers; the next send
+    to that peer transparently recreates the link.
+    """
+
+    _CLOSE = object()
+
+    def __init__(
+        self,
+        transport: Transport,
+        max_queue: int = 256,
+        on_failure: Callable[[str, str], None] | None = None,
+        idle_reap_s: float = 300.0,
+    ):
+        self.transport = transport
+        self.max_queue = max_queue
+        self.on_failure = on_failure
+        self.idle_reap_s = idle_reap_s
+        self._links: dict[str, "_PeerLink"] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def send(
+        self, peer: str, method: str, payload: Any,
+        best_effort: bool = False,
+    ) -> None:
+        """Enqueue one frame for ``peer``; never blocks, never raises."""
+        overflow = False
+        with self._lock:
+            if self._closed:
+                return
+            link = self._links.get(peer)
+            if link is None:
+                link = _PeerLink(peer, self)
+                self._links[peer] = link
+            # Enqueue under the lock: the idle-reap check (queue empty ->
+            # retire) runs under the same lock, so a frame can never land
+            # in a queue whose worker just decided to exit.
+            try:
+                link.queue.put_nowait((method, payload, best_effort))
+            except Exception:  # queue.Full
+                # One incident, not one failure per frame: everything
+                # queued is stale the moment the abort-path fires, so
+                # drain it all (bounded memory, no deliveries to a peer
+                # that cannot keep up) and report once.
+                link.stats["drops"] += 1 + link.drain()
+                overflow = True
+            depth = link.queue.qsize()
+            if depth > link.stats["queue_peak"]:
+                link.stats["queue_peak"] = depth
+        if overflow and not best_effort:
+            self._fail(
+                peer,
+                f"send queue overflow (> {self.max_queue} frames queued)",
+            )
+
+    def _fail(self, peer: str, reason: str) -> None:
+        logger.error("sender: link to %s failed: %s", peer, reason)
+        if self.on_failure is not None:
+            try:
+                self.on_failure(peer, reason)
+            except Exception:
+                logger.exception("sender failure callback raised")
+
+    def stats(self) -> dict[str, dict]:
+        """Per-link telemetry: bytes/frames out, serialize/send ms,
+        queue depth + peak, drops/errors, achieved compression ratio."""
+        out = {}
+        with self._lock:
+            links = list(self._links.items())
+        for peer, link in links:
+            s = dict(link.stats)
+            s["queue_depth"] = link.queue.qsize()
+            raw, wire = s.pop("raw_bytes"), s["bytes_out"]
+            s["compression_ratio"] = (
+                round(raw / wire, 3) if raw and wire else 1.0
+            )
+            s["serialize_ms"] = round(s["serialize_ms"], 3)
+            s["send_ms"] = round(s["send_ms"], 3)
+            out[peer] = s
+        return out
+
+    def close(self, timeout: float = 2.0) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            links = list(self._links.values())
+        for link in links:
+            try:
+                link.queue.put_nowait((None, self._CLOSE, True))
+            except Exception:
+                pass
+        for link in links:
+            link.thread.join(timeout=timeout)
+
+
+class _PeerLink:
+    """One peer's bounded in-order queue + drain thread."""
+
+    def __init__(self, peer: str, sender: AsyncSender):
+        import queue as _queue
+
+        self.peer = peer
+        self.sender = sender
+        self.queue: "_queue.Queue" = _queue.Queue(maxsize=sender.max_queue)
+        self.stats = {
+            "frames_out": 0,
+            "bytes_out": 0,
+            "raw_bytes": 0,
+            "serialize_ms": 0.0,
+            "send_ms": 0.0,
+            "queue_peak": 0,
+            "drops": 0,
+            "errors": 0,
+        }
+        self.thread = threading.Thread(
+            target=self._drain, daemon=True, name=f"sender-{peer}"
+        )
+        self.thread.start()
+
+    def drain(self) -> int:
+        """Drop everything queued (stale after a link incident); returns
+        the count. A close sentinel pulled mid-drain is re-queued so the
+        worker still exits."""
+        import queue as _queue
+
+        drained = 0
+        while True:
+            try:
+                item = self.queue.get_nowait()
+            except _queue.Empty:
+                return drained
+            if item[1] is AsyncSender._CLOSE:
+                self.queue.put_nowait(item)
+                return drained
+            drained += 1
+
+    def _retire_if_idle(self) -> bool:
+        """Idle reap: retire this link (thread exits, stats entry leaves
+        the map) unless a frame raced in — the empty-check runs under
+        the sender lock that ``send()`` enqueues under, so no frame can
+        land in a retired queue."""
+        with self.sender._lock:
+            if not self.queue.empty():
+                return False
+            if self.sender._links.get(self.peer) is self:
+                del self.sender._links[self.peer]
+            return True
+
+    def _drain(self) -> None:
+        import queue as _queue
+
+        while True:
+            try:
+                item = self.queue.get(timeout=self.sender.idle_reap_s)
+            except _queue.Empty:
+                if self._retire_if_idle():
+                    return
+                continue
+            method, payload, best_effort = item
+            if payload is AsyncSender._CLOSE:
+                return
+            try:
+                t0 = time.perf_counter()
+                raw_b = wire_b = 0
+                if callable(payload):
+                    payload = payload()
+                    if (
+                        isinstance(payload, tuple) and len(payload) == 3
+                    ):
+                        payload, raw_b, wire_b = payload
+                t1 = time.perf_counter()
+                self.sender.transport.send(self.peer, method, payload)
+                t2 = time.perf_counter()
+                s = self.stats
+                s["frames_out"] += 1
+                s["bytes_out"] += wire_b
+                s["raw_bytes"] += raw_b
+                s["serialize_ms"] += (t1 - t0) * 1000.0
+                s["send_ms"] += (t2 - t1) * 1000.0
+            except Exception as e:
+                self.stats["errors"] += 1
+                if best_effort:
+                    # Courtesy frames (release broadcasts, completion
+                    # notifications) were best-effort before the async
+                    # sender too: their loss must never abort live
+                    # traffic routed through the peer.
+                    continue
+                # Everything still queued belongs to requests the
+                # failure callback is about to abort — drop it now so a
+                # dead peer's queue cannot hold memory to its timeout.
+                self.stats["drops"] += self.drain()
+                self.sender._fail(self.peer, repr(e))

@@ -114,6 +114,15 @@ class EngineConfig:
     # bit-identical to the synchronous engine for greedy and seeded rows.
     # False = the pre-split fully synchronous behavior.
     overlap_steps: bool = True
+    # Inter-stage wire dtype for hidden-state frames (multi-stage P2P
+    # transport, p2p/proto.py). None ships activations at their native
+    # precision — multi-stage streams stay bit-identical to a local run.
+    # "bfloat16" frames bf16 on the wire (lossy only when the model
+    # computes wider); "fp8"/"float8_e4m3fn" compresses with per-token
+    # scales (opt-in, bounded divergence). Each link negotiates the
+    # format via wire_caps at first use; peers that cannot decode the
+    # requested dtype receive native frames. See docs/networking.md.
+    wire_dtype: str | None = None
 
 
 @dataclasses.dataclass
@@ -1601,6 +1610,17 @@ class StageEngine:
             spec_rows=spec_rows or None,
             sync_only=sp_plan is not None or bool(spec_rows),
         )
+        if not self.model.is_last:
+            # Start the hidden-state device->host copy NOW (the same
+            # device-ordering trick as the host tier's per-layer D2H in
+            # runtime/host_cache.py): the copy is ordered after this
+            # step's compute but overlaps the driver's next dispatch, so
+            # resolve()'s np.asarray readback finds the bytes already
+            # staged instead of blocking the step thread on a full D2H.
+            try:
+                out.copy_to_host_async()
+            except AttributeError:  # stubbed jit call in tests
+                pass
         if (
             self.model.is_last
             and not ticket.sync_only
@@ -1615,6 +1635,12 @@ class StageEngine:
                                                      step_idx)
             if self.model.is_first:
                 self._mark_device_feed(plan, ticket.tokens_dev)
+            try:
+                # Same dispatch-time D2H start for the sampled tokens:
+                # resolve only finds the (tiny) readback pre-staged.
+                ticket.tokens_dev.copy_to_host_async()
+            except AttributeError:
+                pass
         elif self.model.is_last:
             # Host-synchronous logits processing (penalties, logprobs,
             # grammar, logit_bias): the driver must resolve before the

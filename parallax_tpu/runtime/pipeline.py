@@ -4,6 +4,12 @@ This is the loopback-transport execution mode — the same engine code that
 runs under the networked P2P daemon, wired stage-to-stage in one process.
 Used by tests (the reference tests multi-stage the same way,
 ``tests/test_executor.py``) and by single-host multi-stage debugging.
+
+``wire=True`` routes every inter-stage packet through the real wire
+format (msgpack frame encode/decode + tensor serialization from
+``p2p/proto.py``, optionally at a compressed ``wire_dtype``) — the
+in-process twin of the networked hop, used by the exactness tests that
+pin multi-stage streams bit-identical to the direct-call path.
 """
 
 from __future__ import annotations
@@ -15,9 +21,16 @@ from parallax_tpu.runtime.request import Request
 class InProcessPipeline:
     """Ring of engines: stage0 (head) -> ... -> stageN-1 -> head."""
 
-    def __init__(self, engines: list[StageEngine]):
+    def __init__(
+        self,
+        engines: list[StageEngine],
+        wire: bool = False,
+        wire_dtype: str | None = None,
+    ):
         assert engines and engines[0].model.is_first and engines[-1].model.is_last
         self.engines = engines
+        self.wire = wire or wire_dtype is not None
+        self.wire_dtype = wire_dtype
         self.finished: list[Request] = []
 
     @property
@@ -30,12 +43,27 @@ class InProcessPipeline:
     def has_work(self) -> bool:
         return any(e.has_work() for e in self.engines)
 
+    def _wire_roundtrip(self, ireq):
+        """One packet through the full wire path: serialize (with the
+        configured wire dtype), msgpack-frame, decode, deserialize."""
+        from parallax_tpu.p2p import proto
+
+        frame = proto.encode_frame(
+            proto.FORWARD,
+            {"reqs": [proto.ireq_to_wire(ireq, wire_dtype=self.wire_dtype)]},
+        )
+        return proto.ireq_from_wire(
+            proto.decode_frame(frame)["p"]["reqs"][0]
+        )
+
     def step_round(self) -> list[Request]:
         """One step of every stage, routing packets around the ring."""
         newly_finished: list[Request] = []
         for i, engine in enumerate(self.engines):
             out = engine.step()
             for ireq in out.forward:
+                if self.wire:
+                    ireq = self._wire_roundtrip(ireq)
                 if ireq.next_token_id is not None:
                     self.head.commit_token(
                         ireq.request_id, ireq.next_token_id,

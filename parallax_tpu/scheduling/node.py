@@ -100,6 +100,13 @@ class Node:
     # split device/host tier, occupancy, demotion/swap-in/preemption
     # counts); surfaced in /cluster/status.
     cache_stats: dict | None = None
+    # Per-link activation-transport telemetry from heartbeats (bytes in/
+    # out, serialize/send ms, queue depth, compression ratio per peer);
+    # surfaced in /cluster/status.
+    transport: dict | None = None
+    # Wire-format capability list from node_join (dtype names this
+    # node's build can decode on activation frames).
+    wire_formats: tuple = ()
 
     def __post_init__(self):
         self.perf = RooflinePerformanceModel(self.hardware, self.model)

@@ -84,8 +84,11 @@ class GlobalScheduler:
 
     # -- public API (thread-safe enqueues) --------------------------------
 
-    def enqueue_join(self, node_id: str, hardware: HardwareInfo) -> None:
-        self._events.put(("join", node_id, hardware))
+    def enqueue_join(
+        self, node_id: str, hardware: HardwareInfo,
+        wire_formats: list | None = None,
+    ) -> None:
+        self._events.put(("join", node_id, hardware, wire_formats))
 
     def enqueue_leave(self, node_id: str) -> None:
         self._events.put(("leave", node_id))
@@ -101,10 +104,12 @@ class GlobalScheduler:
         lora_adapters: list | None = None,
         step_timing: dict | None = None,
         cache_stats: dict | None = None,
+        transport: dict | None = None,
     ) -> None:
         self._events.put(
             ("update", node_id, layer_latency_ms, load, rtt_s, is_ready,
-             refit_version, lora_adapters, step_timing, cache_stats)
+             refit_version, lora_adapters, step_timing, cache_stats,
+             transport)
         )
 
     def receive_request(self, request_id: str) -> PendingRequest:
@@ -162,8 +167,10 @@ class GlobalScheduler:
     def _handle_event(self, ev: tuple) -> None:
         kind = ev[0]
         if kind == "join":
-            _, node_id, hardware = ev
+            _, node_id, hardware, *rest = ev
             node = Node(node_id=node_id, hardware=hardware, model=self.model)
+            if rest and rest[0]:
+                node.wire_formats = tuple(rest[0])
             self.manager.add(node)
             logger.info("node %s joined (%s x%d)", node_id,
                         hardware.device_kind, hardware.num_chips)
@@ -172,7 +179,8 @@ class GlobalScheduler:
             self._handle_leave(ev[1])
         elif kind == "update":
             (_, node_id, lat, load, rtt, ready, refit, adapters, timing,
-             cache_stats) = ev
+             cache_stats, *rest) = ev
+            transport = rest[0] if rest else None
             node = self.manager.get(node_id)
             if node is None:
                 return
@@ -193,6 +201,8 @@ class GlobalScheduler:
                 node.step_timing = timing
             if cache_stats is not None:
                 node.cache_stats = cache_stats
+            if transport is not None:
+                node.transport = transport
 
     def _try_bootstrap_or_extend(self) -> None:
         standby = self.manager.nodes(NodeState.STANDBY)
@@ -364,6 +374,10 @@ class GlobalScheduler:
                         # rates, occupancy, demotions, swap-ins,
                         # preemptions) from heartbeats.
                         "cache_stats": n.cache_stats,
+                        # Per-link activation-transport telemetry
+                        # (bytes each way, serialize/send ms, queue
+                        # depth, compression ratio) from heartbeats.
+                        "transport": n.transport,
                     }
                     for n in p.nodes
                 ],
