@@ -27,7 +27,11 @@ from parallax_tpu.config import ModelConfig, resolve_wire_dtype
 from parallax_tpu.models.base import StageModel
 from parallax_tpu.models.registry import create_stage_model
 from parallax_tpu.p2p import proto
-from parallax_tpu.p2p.transport import AsyncSender, Transport
+from parallax_tpu.p2p.transport import (
+    NO_HANDLER_MARK,
+    AsyncSender,
+    Transport,
+)
 from parallax_tpu.runtime.engine import EngineConfig, StageEngine
 from parallax_tpu.runtime.request import (
     IntermediateRequest,
@@ -86,6 +90,13 @@ class WorkerNode:
                 "scheduler-less mode requires explicit layers=(start, end)"
             )
         self._self_layers = layers
+        # Boot epoch: travels in gossip announcements so peers can tell
+        # a restarted process (possibly a different build — different
+        # wire caps) from a continuing one even when the restart is
+        # faster than the announcement TTL.
+        import uuid as _uuid
+
+        self._epoch = _uuid.uuid4().hex[:12]
         # Gossip registry (scheduler-less): node_id -> block announcement.
         self._peer_blocks: dict[str, dict] = {}
         self._peer_lock = threading.Lock()
@@ -128,10 +139,33 @@ class WorkerNode:
         resolve_wire_dtype(
             self.engine_config.wire_dtype, model_config.dtype
         )
-        # Negotiated wire dtype per link (None = native precision) and
-        # per-source receive counters for the transport telemetry.
-        self._wire_dtypes: dict[str, str | None] = {}
+        # Negotiated wire dtype per link: peer -> (dtype | None,
+        # expires_at); None means "ship native frames". Entries are
+        # written by sender workers (and probe threads) and popped by
+        # gossip/heartbeat threads; writes that follow a slow read or
+        # RPC go through _wire_lock plus the forget generation counter
+        # so a freshly invalidated decision can never be resurrected by
+        # an in-flight probe. (The hot-path fresh-hit read stays
+        # lock-free — a single atomic get of an immutable tuple.)
+        self._wire_dtypes: dict[str, tuple[str | None, float]] = {}
+        self._wire_lock = threading.Lock()
+        # Per-peer forget counts (never reset — a reset would make an
+        # in-flight probe's stale snapshot match again). Ints only,
+        # grown per ever-invalidated peer; per-peer so churn on one
+        # link never discards another link's probe result.
+        self._wire_forget_gen: dict[str, int] = {}
+        # Links we already warned about falling back to native frames
+        # ("warn once, cached" — steady-state re-confirmations log at
+        # debug); cleared when a link negotiates compression so a later
+        # degrade warns again.
+        self._wire_warned_native: set[str] = set()
+        # Per-source receive counters for the transport telemetry,
+        # bumped from concurrent transport-dispatch threads and reaped
+        # from the heartbeat thread — += is not atomic, so all three
+        # paths take the lock (same contract as the sender's per-link
+        # stats_lock).
         self._rx_stats: dict[str, dict] = {}
+        self._rx_lock = threading.Lock()
 
         transport.register(proto.FORWARD, self._on_forward)
         transport.register(proto.ABORT, self._on_abort)
@@ -397,12 +431,14 @@ class WorkerNode:
             while not self._stop.is_set():
                 try:
                     self._gossip_beat()
+                    self._reap_rx_stats()
                 except Exception as e:
                     logger.warning("gossip beat failed: %s", e)
                 self._stop.wait(self.heartbeat_interval_s)
             return
         while not self._stop.is_set():
             try:
+                self._reap_rx_stats()
                 logger.debug("%s: heartbeat", self.node_id)
                 if self.node_id.startswith("relay:") and hasattr(
                     self.transport, "register_at_relay"
@@ -491,7 +527,7 @@ class WorkerNode:
             out.append({
                 "node_id": self.node_id, "start": self.start_layer,
                 "end": self.end_layer, "ready": self.engine is not None,
-                "age_s": 0.0,
+                "age_s": 0.0, "epoch": self._epoch,
             })
         with self._peer_lock:
             for nid, b in self._peer_blocks.items():
@@ -500,10 +536,13 @@ class WorkerNode:
                     out.append({
                         "node_id": nid, "start": b["start"], "end": b["end"],
                         "ready": b["ready"], "age_s": age,
+                        "epoch": b.get("epoch"),
                     })
         return out
 
-    def _merge_blocks(self, blocks: list[dict]) -> None:
+    def _merge_blocks(
+        self, blocks: list[dict], from_peer: str | None = None
+    ) -> None:
         now = time.monotonic()
         with self._peer_lock:
             for b in blocks or []:
@@ -513,9 +552,36 @@ class WorkerNode:
                 t = now - float(b.get("age_s", 0.0))
                 prev = self._peer_blocks.get(nid)
                 if prev is None or t > prev["t"]:
+                    new_ep = b.get("epoch")
+                    prev_ep = prev.get("epoch") if prev else None
+                    # A peer's OWN announcement is authoritative for its
+                    # boot epoch — including an absent one (it restarted
+                    # as an epoch-less older build). Third-party blocks
+                    # are not: an epoch-less intermediary strips the
+                    # field on relay, so there a missing epoch keeps the
+                    # known one — otherwise direct/relayed alternation
+                    # would thrash the cache.
+                    direct = nid == from_peer
+                    epoch = new_ep if direct else (new_ep or prev_ep)
+                    # A changed boot epoch means the peer restarted —
+                    # possibly as a different build — faster than the
+                    # TTL could notice. Its negotiated wire dtype is
+                    # stale (the new process may not decode it, and a
+                    # one-way FORWARD would fail silently on the
+                    # receiver), so the next frame must re-probe. An
+                    # epoch appearing where none was known (old build
+                    # restarting as a current one) or disappearing from
+                    # a direct announcement (downgrade) counts too.
+                    changed = (
+                        epoch != prev_ep if direct
+                        else bool(new_ep) and new_ep != prev_ep
+                    )
+                    if prev is not None and changed:
+                        self._forget_wire_dtype(nid)
                     self._peer_blocks[nid] = {
                         "start": int(b["start"]), "end": int(b["end"]),
                         "ready": bool(b.get("ready")), "t": t,
+                        "epoch": epoch,
                     }
 
     def _gossip_beat(self) -> None:
@@ -530,6 +596,12 @@ class WorkerNode:
             for nid, b in list(self._peer_blocks.items()):
                 if now - b["t"] > 3 * self.peer_ttl_s:
                     del self._peer_blocks[nid]
+                    # Forget the negotiated wire dtype with the peer: if
+                    # it rejoins it may be a different build, and the
+                    # first frame to it must re-run the caps probe.
+                    # (The inbound counters are reaped separately by
+                    # _reap_rx_stats, under the rx lock.)
+                    self._forget_wire_dtype(nid)
         known = self._fresh_peer_ids(now)
         timeout = min(5.0, max(1.0, self.heartbeat_interval_s))
 
@@ -543,7 +615,7 @@ class WorkerNode:
                 logger.debug("announce to %s failed: %s", peer, e)
                 return
             if isinstance(reply, dict):
-                self._merge_blocks(reply.get("blocks"))
+                self._merge_blocks(reply.get("blocks"), from_peer=peer)
 
         # Concurrent dials off a persistent pool: dead STATIC peers
         # (never pruned — they are the operator-given bootstrap list)
@@ -580,8 +652,8 @@ class WorkerNode:
             fresh.add(self.node_id)
             self._post(("liveness", fresh))
 
-    def _on_announce(self, _peer: str, payload: dict):
-        self._merge_blocks((payload or {}).get("blocks"))
+    def _on_announce(self, peer: str, payload: dict):
+        self._merge_blocks((payload or {}).get("blocks"), from_peer=peer)
         return {"blocks": self._known_blocks()}
 
     def _on_chat_ready(self, _peer: str, _payload):
@@ -662,65 +734,226 @@ class WorkerNode:
         lists the requested wire dtype here."""
         return {"formats": list(proto.WIRE_DTYPES)}
 
+    # Cached wire-dtype decisions re-probe after this long. Gossip mode
+    # catches a restarted peer through its boot epoch; scheduler mode
+    # has no such signal when the restart leaves the topology unchanged
+    # (same address, same layers -> no reload, and a quiescent link sees
+    # no send failure), so the cache itself must age out. One capability
+    # RPC per link per interval, on the sender worker.
+    WIRE_DTYPE_REFRESH_S = 300.0
+    # Retry horizon after a TRANSIENT probe failure: frames ship native
+    # meanwhile. Without this negative cache, every frame on a link
+    # whose call path is degraded (but whose one-way sends succeed)
+    # would block the sender worker a full probe timeout — throttling
+    # the queue into overflow and aborting a deliverable path.
+    WIRE_PROBE_RETRY_S = 30.0
+
     def _wire_dtype_for(self, peer: str) -> str | None:
         """Negotiated wire dtype for one link (cached). Runs on the
         sender worker, never the step thread — the first frame to a peer
-        pays one capability RPC. Peers that cannot answer (older build,
-        interop) get native-precision frames."""
+        pays one short capability RPC. Peers that cannot answer (older
+        build, interop) get native-precision frames."""
         want = resolve_wire_dtype(
             self.engine_config.wire_dtype, self.model_config.dtype
         )
         if want is None:
             return None
-        if peer in self._wire_dtypes:
-            return self._wire_dtypes[peer]
+        now = time.monotonic()
+        # Lock-free fresh-hit read: the entry can be popped concurrently
+        # (epoch change, TTL prune, send failure) and a check-then-index
+        # pair would KeyError into the worker's failure path, aborting a
+        # healthy link.
+        entry = self._wire_dtypes.get(peer)
+        if entry is not None and now < entry[1]:
+            return entry[0]
+        if entry is not None:
+            # Expired mid-life: serve the stale decision and revalidate
+            # OFF this worker. A blocking probe here stalls every frame
+            # queued behind it, and a mid-life queue can be deep — a
+            # slow answer at decode cadence would overflow it and
+            # hard-abort a healthy link. The placeholder horizon also
+            # stops a probe stampede while the answer is in flight. The
+            # placeholder is written under the lock AFTER re-reading:
+            # if a forget raced in, the stale decision must not come
+            # back (the peer may be a different build now).
+            with self._wire_lock:
+                entry = self._wire_dtypes.get(peer)
+                if entry is None:
+                    stale = None     # forgotten: ship native, re-probe
+                else:
+                    stale = entry[0]
+                    self._wire_dtypes[peer] = (
+                        stale, now + self.WIRE_PROBE_RETRY_S
+                    )
+            if entry is not None:
+                threading.Thread(
+                    target=self._negotiate_wire_dtype,
+                    args=(peer, want, 10.0),
+                    daemon=True, name=f"wirecaps-{peer}",
+                ).start()
+                return stale
+        # No entry: first contact, or a forget raced in. A SHORT
+        # blocking probe is only safe against a near-empty queue (first
+        # contact, where it keeps the first hop's frames compressed);
+        # measure rather than assume — after an epoch-change forget on
+        # a busy link the queue can be deep, and blocking 1 s in front
+        # of it could overflow it into a hard abort.
+        if self.sender.queue_depth(peer) <= 8:
+            self._negotiate_wire_dtype(peer, want, timeout=1.0)
+            entry = self._wire_dtypes.get(peer)
+            return entry[0] if entry is not None else None
+        now = time.monotonic()
+        with self._wire_lock:
+            if self._wire_dtypes.get(peer) is None:
+                self._wire_dtypes[peer] = (
+                    None, now + self.WIRE_PROBE_RETRY_S
+                )
+        threading.Thread(
+            target=self._negotiate_wire_dtype, args=(peer, want, 10.0),
+            daemon=True, name=f"wirecaps-{peer}",
+        ).start()
+        return None
+
+    def _negotiate_wire_dtype(
+        self, peer: str, want: str, timeout: float
+    ) -> None:
+        """Blocking capability probe + cache update. Called inline for a
+        brand-new link, from a one-shot background thread on refresh.
+        The result is discarded if THIS peer was invalidated while the
+        RPC was in flight (per-peer generation count): a forget during
+        the probe means the answer may describe a process that no
+        longer exists, and re-caching it for the full horizon would
+        resurrect exactly the decision the forget killed. Forgets are
+        rare; a discarded answer just re-probes on the next frame."""
+        gen = self._wire_forget_gen.get(peer, 0)
+        # "Warn once, cached": the first native fallback on a link is
+        # news for the operator; the periodic refresh re-confirming it
+        # is steady state and logs at debug. A link that upgrades to
+        # compression re-arms the warning for a later degrade.
+        def log_native(msg, *args):
+            if peer not in self._wire_warned_native:
+                self._wire_warned_native.add(peer)
+                logger.warning(msg, *args)
+            else:
+                logger.debug(msg, *args)
         try:
             caps = self.transport.call(
-                peer, proto.WIRE_CAPS, None, timeout=10.0
+                peer, proto.WIRE_CAPS, None, timeout=timeout
             )
         except Exception as e:
-            # Transient probe failure (peer still booting, blip): this
-            # frame ships native, but the answer is NOT cached — the
-            # next frame re-probes, so one startup race never disables
-            # compression for the link's lifetime.
-            logger.warning(
+            if NO_HANDLER_MARK in str(e):
+                # Definitive answer: an older/interop build with no
+                # WIRE_CAPS handler will not grow one mid-life, so
+                # cache the native decision for the full horizon —
+                # re-probing (and warning) per frame would stall the
+                # sender at decode cadence. A restart that adds
+                # support invalidates this like any other rebuild
+                # (epoch change / link failure / TTL expiry).
+                log_native(
+                    "%s: peer %s has no wire_caps handler (older "
+                    "build?); sending native frames on this link",
+                    self.node_id, peer,
+                )
+                self._cache_wire_dtype(
+                    peer, None, self.WIRE_DTYPE_REFRESH_S, gen
+                )
+                return
+            # Transient probe failure (peer still booting, blip):
+            # frames ship native under a SHORT negative cache, so a
+            # startup race never disables compression for the link's
+            # lifetime, and a degraded call path never stalls the
+            # sender worker once per frame.
+            log_native(
                 "%s: wire_caps probe to %s failed (%s); sending native "
-                "frames until it answers", self.node_id, peer, e,
+                "frames, retrying in %ds",
+                self.node_id, peer, e, int(self.WIRE_PROBE_RETRY_S),
             )
-            return None
+            self._cache_wire_dtype(
+                peer, None, self.WIRE_PROBE_RETRY_S, gen
+            )
+            return
         got = None
         formats = set((caps or {}).get("formats") or ())
         if want in formats:
             got = want
+            self._wire_warned_native.discard(peer)
         else:
-            logger.warning(
+            log_native(
                 "%s: peer %s cannot decode wire dtype %s; sending "
                 "native frames on this link", self.node_id, peer, want,
             )
-        self._wire_dtypes[peer] = got
-        return got
+        self._cache_wire_dtype(peer, got, self.WIRE_DTYPE_REFRESH_S, gen)
+
+    def _cache_wire_dtype(
+        self, peer: str, dtype: str | None, ttl: float, gen: int
+    ) -> None:
+        with self._wire_lock:
+            if self._wire_forget_gen.get(peer, 0) == gen:
+                self._wire_dtypes[peer] = (dtype, time.monotonic() + ttl)
+
+    def _forget_wire_dtype(self, peer: str) -> None:
+        """Drop a link's negotiated wire dtype — the peer failed,
+        restarted or departed, and may come back as a different build;
+        the next frame re-probes. Bumps the peer's generation count so
+        a probe already in flight to it discards its (possibly
+        pre-restart) answer instead of resurrecting it."""
+        with self._wire_lock:
+            self._wire_forget_gen[peer] = (
+                self._wire_forget_gen.get(peer, 0) + 1
+            )
+            self._wire_dtypes.pop(peer, None)
 
     def _on_send_failure(self, peer: str, reason: str) -> None:
         """Sender pipeline failure (queue overflow or dead peer): route
         into the abort_path flow on the step thread — exactly what a
-        synchronous send failure used to trigger inline."""
+        synchronous send failure used to trigger inline. The negotiated
+        wire dtype is dropped with the link: a failed peer may come back
+        as a different build (e.g. without fp8 decode), so the next
+        frame re-probes instead of shipping frames it cannot parse."""
         logger.error("%s: async send to %s failed: %s",
                      self.node_id, peer, reason)
+        self._forget_wire_dtype(peer)
         self._post(("abort_path", peer))
 
     def _count_rx(self, peer: str, wire_req: dict) -> None:
-        rx = self._rx_stats.setdefault(
-            peer or "?", {"frames_in": 0, "bytes_in": 0}
+        self._count_rx_bytes(
+            peer, proto.tensor_nbytes(wire_req.get("hidden_states"))
         )
-        rx["frames_in"] += 1
-        rx["bytes_in"] += proto.tensor_nbytes(wire_req.get("hidden_states"))
+
+    def _count_rx_bytes(self, peer: str, nbytes: int) -> None:
+        with self._rx_lock:
+            rx = self._rx_stats.setdefault(
+                peer or "?", {"frames_in": 0, "bytes_in": 0}
+            )
+            rx["frames_in"] += 1
+            rx["bytes_in"] += nbytes
+            rx["t"] = time.monotonic()
+
+    def _reap_rx_stats(self, idle_s: float | None = None) -> None:
+        """Drop inbound counters for peers that stopped sending (same
+        idle horizon as the sender's link reap, so tx and rx telemetry
+        rows retire together). Runs from the announcer in BOTH modes —
+        scheduler-managed swarms churn too, and a departed peer must
+        not grow every heartbeat forever."""
+        if idle_s is None:
+            idle_s = self.sender.idle_reap_s
+        now = time.monotonic()
+        with self._rx_lock:
+            for peer in [
+                p for p, rx in self._rx_stats.items()
+                if now - rx.get("t", now) > idle_s
+            ]:
+                del self._rx_stats[peer]
 
     def transport_stats(self) -> dict | None:
         """Per-link telemetry for heartbeats / status surfaces: the
         sender pipeline's outbound counters merged with inbound
         frame/byte counts per source peer."""
         links = self.sender.stats()
-        for peer, rx in list(self._rx_stats.items()):
+        with self._rx_lock:
+            rx_snapshot = {p: dict(rx) for p, rx in self._rx_stats.items()}
+        for peer, rx in rx_snapshot.items():
+            rx.pop("t", None)
             links.setdefault(peer, {}).update(rx)
         return links or None
 
@@ -729,9 +962,12 @@ class WorkerNode:
     def _on_forward(self, peer: str, payload):
         if isinstance(payload, (bytes, bytearray)):
             # Reference-protocol peer: a raw protobuf ForwardRequest
-            # (heterogeneous-swarm interop, p2p/interop.py).
+            # (heterogeneous-swarm interop, p2p/interop.py). Counted
+            # whole-frame — cross-build links are exactly where an
+            # operator reads the inbound telemetry.
             from parallax_tpu.p2p import interop
 
+            self._count_rx_bytes(peer, len(payload))
             for ireq in interop.forward_bytes_to_ireqs(payload):
                 self._post(("forward", ireq))
             return "ok"
@@ -942,6 +1178,11 @@ class WorkerNode:
                 if self.engine is None:
                     continue
                 peer = item[1]
+                # Whatever declared the path dead (send failure posts
+                # this, but so can future callers), the link's
+                # negotiated wire dtype dies with it: a peer that comes
+                # back may be a different build.
+                self._forget_wire_dtype(peer)
                 sched = self.engine.scheduler
                 for req in (
                     list(sched.running.values())
@@ -1037,6 +1278,20 @@ class WorkerNode:
             if target == self.node_id:
                 self._post(("forward", ireq))
             else:
+                # Detach from the step's batch array before queueing:
+                # _emit_hidden hands out VIEWS into the full hidden_out,
+                # and a queued frame holding one pins the whole batch
+                # (every queued frame, every peer) until the worker
+                # drains it — on a backed-up link that is max_queue
+                # full-batch arrays, not max_queue frames. The copy is
+                # one memcpy of the forwarded rows on the step thread
+                # (serialization stays on the sender worker), skipped
+                # when the view already spans its whole base (single
+                # request: holding the view pins nothing extra).
+                h = ireq.hidden_states
+                base = getattr(h, "base", None)
+                if base is not None and h.nbytes < base.nbytes:
+                    ireq.hidden_states = h.copy()
                 by_peer.setdefault(target, []).append(ireq)
         for peer, ireqs in by_peer.items():
             self.sender.send(

@@ -117,8 +117,17 @@ def tensor_to_wire(
                 "scales": scales.tobytes(),
                 "odtype": name,
             }
-        arr = arr.astype(resolve_dtype(wire_dtype))
-        name = wire_dtype
+        # Like the fp8 path, carry the original dtype: the receiver
+        # restores it so the downstream stage's jit sees ONE input
+        # dtype whether a frame shipped compressed or (after a probe
+        # blip) native — mixed dtypes would mean recompile churn and
+        # silent promotion in chunk concatenation.
+        return {
+            "dtype": wire_dtype,
+            "shape": list(arr.shape),
+            "data": arr.astype(resolve_dtype(wire_dtype)).tobytes(),
+            "odtype": name,
+        }
     return {
         "dtype": name,
         "shape": list(arr.shape),
@@ -141,6 +150,11 @@ def tensor_from_wire(obj: dict | None) -> np.ndarray | None:
         arr = dequantize_fp8_per_token(
             arr, scales, resolve_dtype(obj.get("odtype") or "float32")
         )
+    elif obj.get("odtype") and obj["odtype"] != obj["dtype"]:
+        # Plain downcast frame (bf16/fp16 link): restore the sender's
+        # working precision so compressed and native frames feed the
+        # receiving stage the same input dtype.
+        arr = arr.astype(resolve_dtype(obj["odtype"]))
     return arr
 
 

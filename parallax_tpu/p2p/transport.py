@@ -46,6 +46,12 @@ class TransportError(Exception):
     pass
 
 
+# Marker for "this build has no handler registered for that method" —
+# callers (wire-caps negotiation) classify it as a definitive answer
+# rather than a transient failure, so the wording is a contract.
+NO_HANDLER_MARK = "no handler for"
+
+
 class Transport:
     """RPC surface shared by all backends."""
 
@@ -59,7 +65,9 @@ class Transport:
     def _dispatch(self, method: str, from_peer: str, payload: Any) -> Any:
         handler = self._handlers.get(method)
         if handler is None:
-            raise TransportError(f"{self.peer_id}: no handler for {method}")
+            raise TransportError(
+                f"{self.peer_id}: {NO_HANDLER_MARK} {method}"
+            )
         return handler(from_peer, payload)
 
     # -- backend API -------------------------------------------------------
@@ -614,7 +622,10 @@ class AsyncSender:
     by ``max_queue`` frames per peer, never by the peer's latency.
     Frames sent with ``best_effort=True`` (release broadcasts, courtesy
     notifications) never fire the failure callback — their loss must not
-    abort live traffic — but still count in the error telemetry.
+    abort live traffic — but still count in the error telemetry. For the
+    same reason a best-effort frame that overflows the queue is dropped
+    alone: the queued frames it collides with are live traffic, and
+    draining them without an abort would strand their requests.
 
     ``payload`` may be a zero-arg callable for lazy serialization (the
     expensive ``ireq_to_wire`` tensor copy runs on the worker, not the
@@ -663,16 +674,31 @@ class AsyncSender:
             try:
                 link.queue.put_nowait((method, payload, best_effort))
             except Exception:  # queue.Full
-                # One incident, not one failure per frame: everything
-                # queued is stale the moment the abort-path fires, so
-                # drain it all (bounded memory, no deliveries to a peer
-                # that cannot keep up) and report once.
-                link.stats["drops"] += 1 + link.drain()
-                overflow = True
+                if best_effort:
+                    # A courtesy frame that does not fit is dropped
+                    # ALONE: what is queued is live traffic (FORWARD
+                    # frames share the link with RELEASE broadcasts),
+                    # and a best-effort overflow suppresses the failure
+                    # callback — draining here would silently discard
+                    # activations with no abort-path to clean up after
+                    # them.
+                    with link.stats_lock:
+                        link.stats["drops"] += 1
+                else:
+                    # One incident, not one failure per frame:
+                    # everything queued is stale the moment the
+                    # abort-path fires, so drain it all (bounded
+                    # memory, no deliveries to a peer that cannot keep
+                    # up) and report once.
+                    dropped = 1 + link.drain()
+                    with link.stats_lock:
+                        link.stats["drops"] += dropped
+                    overflow = True
             depth = link.queue.qsize()
-            if depth > link.stats["queue_peak"]:
-                link.stats["queue_peak"] = depth
-        if overflow and not best_effort:
+            with link.stats_lock:
+                if depth > link.stats["queue_peak"]:
+                    link.stats["queue_peak"] = depth
+        if overflow:
             self._fail(
                 peer,
                 f"send queue overflow (> {self.max_queue} frames queued)",
@@ -686,6 +712,12 @@ class AsyncSender:
             except Exception:
                 logger.exception("sender failure callback raised")
 
+    def queue_depth(self, peer: str) -> int:
+        """Frames currently queued for one peer (0 if no live link)."""
+        with self._lock:
+            link = self._links.get(peer)
+        return link.queue.qsize() if link is not None else 0
+
     def stats(self) -> dict[str, dict]:
         """Per-link telemetry: bytes/frames out, serialize/send ms,
         queue depth + peak, drops/errors, achieved compression ratio."""
@@ -693,7 +725,8 @@ class AsyncSender:
         with self._lock:
             links = list(self._links.items())
         for peer, link in links:
-            s = dict(link.stats)
+            with link.stats_lock:
+                s = dict(link.stats)
             s["queue_depth"] = link.queue.qsize()
             raw, wire = s.pop("raw_bytes"), s["bytes_out"]
             s["compression_ratio"] = (
@@ -713,10 +746,21 @@ class AsyncSender:
         for link in links:
             try:
                 link.queue.put_nowait((None, self._CLOSE, True))
-            except Exception:
-                pass
+            except Exception:  # queue.Full
+                # Queued frames are abandoned on close anyway — drain
+                # so the sentinel fits and the worker exits instead of
+                # lingering as a daemon blocked behind a stalled peer.
+                link.drain()
+                try:
+                    link.queue.put_nowait((None, self._CLOSE, True))
+                except Exception:
+                    pass
+        # Shared deadline across ALL workers (sentinels are already
+        # queued): shutdown cost stays ~``timeout`` total, not
+        # ``timeout`` per stuck peer.
+        deadline = time.monotonic() + timeout
         for link in links:
-            link.thread.join(timeout=timeout)
+            link.thread.join(timeout=max(0.0, deadline - time.monotonic()))
 
 
 class _PeerLink:
@@ -728,6 +772,12 @@ class _PeerLink:
         self.peer = peer
         self.sender = sender
         self.queue: "_queue.Queue" = _queue.Queue(maxsize=sender.max_queue)
+        # Counters are bumped from both the caller (send(): drops,
+        # queue_peak) and the worker (frames/bytes/errors); += is not
+        # atomic, so every stats mutation/snapshot takes this lock.
+        # send() acquires it while holding the sender lock; the worker
+        # takes it alone — one ordering, no deadlock.
+        self.stats_lock = threading.Lock()
         self.stats = {
             "frames_out": 0,
             "bytes_out": 0,
@@ -797,14 +847,16 @@ class _PeerLink:
                 t1 = time.perf_counter()
                 self.sender.transport.send(self.peer, method, payload)
                 t2 = time.perf_counter()
-                s = self.stats
-                s["frames_out"] += 1
-                s["bytes_out"] += wire_b
-                s["raw_bytes"] += raw_b
-                s["serialize_ms"] += (t1 - t0) * 1000.0
-                s["send_ms"] += (t2 - t1) * 1000.0
+                with self.stats_lock:
+                    s = self.stats
+                    s["frames_out"] += 1
+                    s["bytes_out"] += wire_b
+                    s["raw_bytes"] += raw_b
+                    s["serialize_ms"] += (t1 - t0) * 1000.0
+                    s["send_ms"] += (t2 - t1) * 1000.0
             except Exception as e:
-                self.stats["errors"] += 1
+                with self.stats_lock:
+                    self.stats["errors"] += 1
                 if best_effort:
                     # Courtesy frames (release broadcasts, completion
                     # notifications) were best-effort before the async
@@ -814,5 +866,7 @@ class _PeerLink:
                 # Everything still queued belongs to requests the
                 # failure callback is about to abort — drop it now so a
                 # dead peer's queue cannot hold memory to its timeout.
-                self.stats["drops"] += self.drain()
+                dropped = self.drain()
+                with self.stats_lock:
+                    self.stats["drops"] += dropped
                 self.sender._fail(self.peer, repr(e))

@@ -378,6 +378,10 @@ class GlobalScheduler:
                         # (bytes each way, serialize/send ms, queue
                         # depth, compression ratio) from heartbeats.
                         "transport": n.transport,
+                        # Wire dtypes this node's build can decode
+                        # (node_join capability) — which links can
+                        # negotiate bf16/fp8 compression.
+                        "wire_formats": list(n.wire_formats),
                     }
                     for n in p.nodes
                 ],

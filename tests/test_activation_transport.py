@@ -99,9 +99,14 @@ def test_bf16_wire_downcast_and_integer_passthrough():
     h = np.linspace(-2, 2, 32, dtype=np.float32).reshape(4, 8)
     w = proto.tensor_to_wire(h, wire_dtype="bfloat16")
     assert w["dtype"] == "bfloat16"
+    assert w["odtype"] == "float32"
     assert len(w["data"]) == h.size * 2
     back = proto.tensor_from_wire(w)
-    assert np.allclose(np.asarray(back, np.float32), h, atol=0.02)
+    # Original dtype restored on receive (like the fp8 path): the
+    # receiving stage's jit must see ONE input dtype whether a frame
+    # shipped compressed or native.
+    assert back.dtype == np.float32
+    assert np.allclose(back, h, atol=0.02)
     # Integer tensors never convert, whatever the link negotiated.
     ids = np.arange(10, dtype=np.int32)
     assert proto.tensor_to_wire(ids, wire_dtype="bfloat16")["dtype"] == (
@@ -287,6 +292,53 @@ def test_sender_overflow_drains_queue_in_one_incident():
     sender.close()
 
 
+def test_sender_best_effort_overflow_drops_only_itself():
+    """A best-effort frame (RELEASE broadcast) hitting a full queue must
+    not drain the live FORWARD frames queued behind it: its overflow
+    suppresses the failure callback, so a drain here would silently
+    discard activations with no abort-path to clean up the requests."""
+    release = threading.Event()
+
+    class _GatedTransport(_RecordingTransport):
+        def send(self, peer, method, payload):
+            release.wait(10.0)
+            super().send(peer, method, payload)
+
+    t = _GatedTransport()
+    failures = []
+    sender = AsyncSender(
+        t, max_queue=4, on_failure=lambda p, r: failures.append((p, r))
+    )
+    # Frame 0 blocks the worker inside transport.send; wait for the
+    # dequeue so the next four frames fill the queue exactly.
+    sender.send("p", "fwd", {"i": 0})
+    deadline = time.monotonic() + 5
+    while (
+        time.monotonic() < deadline
+        and sender.stats()["p"]["queue_depth"] > 0
+    ):
+        time.sleep(0.01)
+    for i in range(1, 5):
+        sender.send("p", "fwd", {"i": i})
+    assert sender.stats()["p"]["queue_depth"] == 4
+
+    sender.send("p", "rpc_release", {"rids": ["r"]}, best_effort=True)
+    stats = sender.stats()["p"]
+    # Only the courtesy frame dropped; the data frames are untouched
+    # and no abort-path fired.
+    assert stats["drops"] == 1
+    assert stats["queue_depth"] == 4
+    assert not failures
+
+    release.set()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and len(t.sent) < 5:
+        time.sleep(0.01)
+    assert [p["i"] for _pr, _m, p in t.sent] == list(range(5))
+    assert not failures
+    sender.close()
+
+
 def test_sender_idle_link_retires_and_recreates():
     t = _RecordingTransport()
     sender = AsyncSender(t, idle_reap_s=0.1)
@@ -314,6 +366,167 @@ def test_invalid_wire_dtype_fails_fast_at_node_construction():
             engine_config=EngineConfig(wire_dtype="int3"),
             layers=(0, 2),
         )
+
+
+def test_wire_dtype_cache_invalidated_on_peer_epoch_change():
+    """A peer that restarts — possibly as a different build without the
+    negotiated wire dtype — faster than the gossip TTL announces a new
+    boot epoch; the cached negotiation must be forgotten so the next
+    frame re-probes instead of shipping frames the new build cannot
+    decode (FORWARD is one-way: the receiver's failure is silent)."""
+    from parallax_tpu.p2p.node import WorkerNode
+
+    node = WorkerNode(
+        transport=LoopbackTransport("w0", {}),
+        scheduler_peer=None,
+        model_config=CFG,
+        engine_config=EngineConfig(),
+        layers=(0, 2),
+    )
+    block = {"node_id": "p1", "start": 2, "end": 4, "ready": True,
+             "age_s": 0.0}
+    far = time.monotonic() + 600.0
+    node._merge_blocks([dict(block, epoch="boot-1")])
+    node._wire_dtypes["p1"] = ("float8_e4m3fn", far)
+    # Same epoch re-announcing (the steady-state heartbeat): cache kept.
+    node._merge_blocks([dict(block, epoch="boot-1")])
+    assert node._wire_dtypes["p1"][0] == "float8_e4m3fn"
+    # New epoch = restarted process: negotiation forgotten.
+    node._merge_blocks([dict(block, epoch="boot-2")])
+    assert "p1" not in node._wire_dtypes
+    # Epoch-less announcements (relayed via an older build that strips
+    # the field) never thrash the cache — the known epoch is preserved.
+    node._wire_dtypes["p1"] = ("bfloat16", far)
+    node._merge_blocks([dict(block)])
+    assert node._wire_dtypes["p1"][0] == "bfloat16"
+    # ...and the preserved epoch still detects the next real restart.
+    node._merge_blocks([dict(block, epoch="boot-3")])
+    assert "p1" not in node._wire_dtypes
+    # Old build (never announced an epoch) restarting as a current one:
+    # the first epoch sighting invalidates, so a no-handler native
+    # cache cannot outlive the upgrade.
+    block2 = {"node_id": "p2", "start": 2, "end": 4, "ready": True,
+              "age_s": 0.0}
+    node._merge_blocks([dict(block2)])
+    node._wire_dtypes["p2"] = (None, far)
+    node._merge_blocks([dict(block2, epoch="boot-1")])
+    assert "p2" not in node._wire_dtypes
+    # A peer's OWN announcement is authoritative for its epoch: losing
+    # it means the peer downgraded to an epoch-less build, so the
+    # negotiation is forgotten (a relayed epoch-less block above kept
+    # it — an old-build intermediary strips the field).
+    node._wire_dtypes["p2"] = ("float8_e4m3fn", far)
+    node._merge_blocks([dict(block2)], from_peer="p2")
+    assert "p2" not in node._wire_dtypes
+
+
+def test_rx_stats_reaped_for_idle_peers():
+    """Inbound telemetry must not grow forever under swarm churn: peers
+    that stopped sending reap on the sender-link idle horizon, and the
+    internal last-rx stamp never leaks into heartbeat payloads."""
+    from parallax_tpu.p2p.node import WorkerNode
+
+    node = WorkerNode(
+        transport=LoopbackTransport("w0", {}),
+        scheduler_peer=None,
+        model_config=CFG,
+        engine_config=EngineConfig(),
+        layers=(0, 2),
+    )
+    node._count_rx("gone-peer", {"hidden_states": None})
+    stats = node.transport_stats()["gone-peer"]
+    assert stats["frames_in"] == 1 and "t" not in stats
+    node._reap_rx_stats(idle_s=300.0)
+    assert "gone-peer" in node._rx_stats     # fresh: kept
+    node._reap_rx_stats(idle_s=0.0)
+    assert "gone-peer" not in node._rx_stats  # idle past horizon: gone
+
+
+def test_wire_caps_no_handler_cached_long_transient_cached_short():
+    """An older/interop peer with no WIRE_CAPS handler is a definitive
+    answer — cache native for the full refresh horizon; a transient
+    probe failure (peer booting, degraded call path) gets a SHORT
+    negative cache: frames ship native without re-paying a blocking
+    probe each, and the link can still upgrade once the peer answers."""
+    from parallax_tpu.p2p.node import WorkerNode
+
+    node = WorkerNode(
+        transport=LoopbackTransport("w0", {}),
+        scheduler_peer=None,
+        model_config=CFG,
+        engine_config=EngineConfig(wire_dtype="fp8"),
+        layers=(0, 2),
+    )
+    calls = []
+
+    def no_handler(peer, method, payload, timeout=30.0):
+        calls.append(method)
+        raise TransportError(f"{peer}: no handler for {method}")
+
+    node.transport.call = no_handler
+    assert node._wire_dtype_for("old-build") is None
+    assert node._wire_dtypes["old-build"][0] is None
+    assert node._wire_dtype_for("old-build") is None
+    assert len(calls) == 1   # second frame hit the cache, no re-probe
+
+    def refused(peer, method, payload, timeout=30.0):
+        calls.append(method)
+        raise TransportError("connection refused")
+
+    node.transport.call = refused
+    assert node._wire_dtype_for("booting") is None
+    assert node._wire_dtype_for("booting") is None
+    assert len(calls) == 2   # negative-cached: one probe, not per frame
+    # ...but only until the short retry horizon; the expired entry is
+    # then revalidated off the calling thread (a blocking re-probe
+    # would stall queued frames), still serving native meanwhile.
+    node._wire_dtypes["booting"] = (None, time.monotonic() - 1)
+    assert node._wire_dtype_for("booting") is None
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and len(calls) < 3:
+        time.sleep(0.01)
+    assert len(calls) == 3
+
+
+def test_wire_dtype_cache_ages_out_and_reprobes():
+    """Scheduler-managed swarms get no restart signal when a peer comes
+    back into an unchanged topology, so the negotiated decision must age
+    out and re-probe instead of living forever."""
+    from parallax_tpu.p2p.node import WorkerNode
+
+    node = WorkerNode(
+        transport=LoopbackTransport("w0", {}),
+        scheduler_peer=None,
+        model_config=CFG,
+        engine_config=EngineConfig(wire_dtype="fp8"),
+        layers=(0, 2),
+    )
+    probes = []
+
+    def caps_ok(peer, method, payload, timeout=30.0):
+        probes.append(method)
+        return {"formats": list(proto.WIRE_DTYPES)}
+
+    node.transport.call = caps_ok
+    assert node._wire_dtype_for("p") == "float8_e4m3fn"
+    assert node._wire_dtype_for("p") == "float8_e4m3fn"
+    assert len(probes) == 1                       # fresh: cached
+    dt, _exp = node._wire_dtypes["p"]
+    node._wire_dtypes["p"] = (dt, time.monotonic() - 1)
+    # Stale entries keep serving (never block queued frames on the
+    # probe) while a background revalidation refreshes the horizon.
+    assert node._wire_dtype_for("p") == "float8_e4m3fn"
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and len(probes) < 2:
+        time.sleep(0.01)
+    assert len(probes) == 2                       # stale: re-probed
+    deadline = time.monotonic() + 5
+    while (
+        time.monotonic() < deadline
+        and node._wire_dtypes["p"][1] < time.monotonic() + 200
+    ):
+        time.sleep(0.01)
+    assert node._wire_dtypes["p"][1] > time.monotonic() + 200
 
 
 def test_sender_close_is_idempotent_and_stops_workers():
@@ -510,7 +723,7 @@ def test_swarm_fp8_link_negotiated_and_completes():
             assert len(r.output_ids) == 8
         # The link really negotiated fp8 and the telemetry shows the
         # compression (hidden frames shrink ~4x vs float32).
-        assert head._wire_dtypes.get("w1") == "float8_e4m3fn"
+        assert head._wire_dtypes.get("w1", (None, 0))[0] == "float8_e4m3fn"
         stats = head.transport_stats()
         assert stats["w1"]["compression_ratio"] > 2.0, stats
     finally:
