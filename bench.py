@@ -515,6 +515,20 @@ def _transport_probe(cfg, stage_params_fn, kv_dtype, page_size):
     }
 
 
+def _obs_metrics() -> dict:
+    """p50/p95/p99 summary of the process metrics registry (the series
+    the engine's TTFT/TPOT/step histograms accumulated this run)."""
+    try:
+        from parallax_tpu.obs.registry import (
+            get_registry,
+            summarize_snapshots,
+        )
+
+        return summarize_snapshots(get_registry().histogram_snapshots())
+    except Exception:  # pragma: no cover - metrics never break the bench
+        return {}
+
+
 def _bench():
     import jax
 
@@ -1064,6 +1078,11 @@ def _bench():
             # under a page budget the working set exceeds: kv_oom_aborts,
             # preemptions, prefix_hit_rate per run).
             "cache_stats": engine.cache_stats(),
+            # Observability registry percentiles (p50/p95/p99 per
+            # histogram: TTFT/TPOT/e2e + step host/device ms + batch
+            # tokens) — the same series /metrics exposes, proving the
+            # bench run populated the unified registry.
+            "metrics": _obs_metrics(),
             **(
                 {"host_cache": host_cache_probe}
                 if host_cache_probe is not None else {}

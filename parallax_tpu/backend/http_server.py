@@ -225,6 +225,42 @@ class OpenAIFrontend:
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
         self._counters = {"requests": 0, "completion_tokens": 0,
                           "prompt_tokens": 0, "started_at": time.time()}
+        # Unified metrics registry (obs/registry.py): the HTTP counters
+        # are registry series now — /metrics renders the whole process
+        # surface (engine histograms, cache counters, transport links)
+        # with proper HELP/TYPE lines. The legacy ``_counters`` dict is
+        # kept in lockstep for callers that read it directly.
+        from parallax_tpu.obs.registry import get_registry
+
+        reg = get_registry()
+        self._m_requests = reg.counter(
+            "parallax_tpu_requests_total",
+            "Generation requests accepted by the HTTP frontend",
+        )
+        self._m_prompt_tokens = reg.counter(
+            "parallax_tpu_prompt_tokens_total",
+            "Prompt tokens across accepted requests",
+        )
+        self._m_completion_tokens = reg.counter(
+            "parallax_tpu_completion_tokens_total",
+            "Completion tokens generated (counted at request end)",
+        )
+        self._m_uptime = reg.gauge(
+            "parallax_tpu_uptime_seconds", "Frontend process uptime",
+        )
+        self._m_http_ttft = reg.histogram(
+            "parallax_http_ttft_ms",
+            "Client-observed time to first streamed token, milliseconds",
+        )
+        self._m_http_e2e = reg.histogram(
+            "parallax_http_e2e_ms",
+            "Client-observed request latency, milliseconds",
+        )
+        # Strong ref on self: the registry holds only a weakref.
+        self._obs_collector = lambda: self._m_uptime.set(
+            time.time() - self._counters["started_at"]
+        )
+        reg.register_collector(self._obs_collector)
         self.app.add_routes([
             web.get("/", self._root_redirect),
             web.post("/v1/chat/completions", self.chat_completions),
@@ -235,12 +271,15 @@ class OpenAIFrontend:
             web.get("/chat", self.chat_page),
             web.get("/cluster/status", self.cluster_status_stream),
             web.get("/cluster/status_json", self.cluster_status_json),
+            web.get("/debug/trace/{request_id}", self.debug_trace),
+            web.get("/debug/flight", self.debug_flight),
             web.post("/weight/refit", self.weight_refit),
             web.post("/scheduler/init", self.scheduler_init),
             web.post("/profile/start", self.profile_start),
             web.post("/profile/stop", self.profile_stop),
         ])
         self._profiling = False
+        self._profile_deadline_handle = None
 
         # Built-in web UI (setup/join/cluster/chat — reference src/frontend).
         from parallax_tpu.backend.webui import register_ui
@@ -264,15 +303,61 @@ class OpenAIFrontend:
         return web.json_response({"status": "ok"})
 
     async def metrics(self, _req):
-        """Prometheus-style plaintext counters."""
-        c = self._counters
-        lines = [
-            f"parallax_tpu_requests_total {c['requests']}",
-            f"parallax_tpu_completion_tokens_total {c['completion_tokens']}",
-            f"parallax_tpu_prompt_tokens_total {c['prompt_tokens']}",
-            f"parallax_tpu_uptime_seconds {time.time() - c['started_at']:.0f}",
-        ]
-        return web.Response(text="\n".join(lines) + "\n")
+        """Prometheus text exposition of the process-wide registry:
+        frontend counters plus every engine/cache/transport series, with
+        ``# HELP``/``# TYPE`` lines and the version=0.0.4 content type
+        scrapers require."""
+        from parallax_tpu.obs.registry import (
+            EXPOSITION_CONTENT_TYPE,
+            get_registry,
+        )
+
+        text = get_registry().render()
+        return web.Response(
+            body=text.encode("utf-8"),
+            headers={"Content-Type": EXPOSITION_CONTENT_TYPE},
+        )
+
+    async def debug_trace(self, request):
+        """Chrome trace-event JSON for one sampled request
+        (``EngineConfig.trace_sample_rate``); load in chrome://tracing
+        or Perfetto. 404 for unknown/unsampled ids."""
+        from parallax_tpu.obs.trace import get_trace_store
+
+        rid = request.match_info["request_id"]
+        data = get_trace_store().export_chrome(rid)
+        if data is None:
+            return self._error(
+                404,
+                f"no trace recorded for {rid!r} (tracing is sampled: "
+                "set trace_sample_rate > 0)",
+            )
+        return web.json_response(data)
+
+    async def debug_flight(self, _req):
+        """Flight recorder dump: recent request timelines, the slow ring,
+        and notable engine events (preempt/kv_oom/abort_path/wire-dtype
+        renegotiation/queue overflow)."""
+        from parallax_tpu.obs.flight import get_flight
+
+        return web.json_response(get_flight().snapshot())
+
+    def _count_accept(self, req) -> None:
+        """Count a request at accept time (client disconnects mid-stream
+        must still be visible in /metrics)."""
+        self._counters["requests"] += 1
+        self._counters["prompt_tokens"] += req.num_prompt_tokens
+        self._m_requests.inc()
+        self._m_prompt_tokens.inc(req.num_prompt_tokens)
+
+    def _count_completion(self, req, t_start=None) -> None:
+        """Count generated tokens (and, when the request ran to an end the
+        caller timed, its e2e latency). TTFT is observed where it is
+        measured — the streaming loop's first-delta branch."""
+        self._counters["completion_tokens"] += req.num_output_tokens
+        self._m_completion_tokens.inc(req.num_output_tokens)
+        if t_start is not None:
+            self._m_http_e2e.observe((time.monotonic() - t_start) * 1e3)
 
     async def chat_page(self, _req):
         """Minimal built-in chat UI (reference serves chat.html from the
@@ -314,15 +399,37 @@ class OpenAIFrontend:
         return web.json_response(status)
 
     async def cluster_status_stream(self, request):
+        """NDJSON status stream. ``?interval=<seconds>`` sets the poll
+        cadence (floored at 0.25 s so a hostile query cannot spin the
+        event loop); a raising ``status_fn`` emits an ``{"error": ...}``
+        record and keeps streaming instead of killing the connection
+        mid-scrape."""
+        try:
+            interval = float(
+                request.query.get("interval")
+                or request.query.get("interval_s") or 2.0
+            )
+        except (TypeError, ValueError):
+            interval = 2.0
+        interval = max(0.25, interval)
         resp = web.StreamResponse(
             headers={"Content-Type": "application/x-ndjson"}
         )
         await resp.prepare(request)
         try:
             while True:
-                status = self.status_fn() if self.status_fn else {}
-                await resp.write((json.dumps(status) + "\n").encode())
-                await asyncio.sleep(2.0)
+                try:
+                    status = self.status_fn() if self.status_fn else {}
+                except Exception as e:
+                    logger.exception("status_fn failed")
+                    status = {"error": str(e)}
+                try:
+                    payload = json.dumps(status)
+                except (TypeError, ValueError) as e:
+                    status = {"error": f"unserializable status: {e}"}
+                    payload = json.dumps(status)
+                await resp.write((payload + "\n").encode())
+                await asyncio.sleep(interval)
         except (ConnectionResetError, asyncio.CancelledError):
             pass
         return resp
@@ -375,7 +482,12 @@ class OpenAIFrontend:
         """Start a JAX/XLA device trace (TensorBoard-viewable) while
         serving — the TPU-native answer to per-step timing logs: captures
         kernel timelines, HBM transfers and host gaps on live traffic.
-        Beyond reference parity (it ships no tracer)."""
+        Beyond reference parity (it ships no tracer).
+
+        ``max_seconds`` (body, default 120) is an auto-stop deadline: a
+        forgotten ``start_trace`` buffers device events without bound, so
+        an unattended profile now ends itself; an explicit
+        ``/profile/stop`` before the deadline cancels the timer."""
         import jax
 
         try:
@@ -383,6 +495,12 @@ class OpenAIFrontend:
         except Exception:
             body = {}
         out_dir = body.get("dir") or "/tmp/parallax-profile"
+        try:
+            max_seconds = float(body.get("max_seconds", 120.0))
+        except (TypeError, ValueError):
+            return self._error(400, "max_seconds must be a number")
+        if max_seconds <= 0:
+            return self._error(400, "max_seconds must be > 0")
         # Check AFTER the awaits: no suspension between test and set.
         if self._profiling:
             return self._error(409, "profiler already running")
@@ -391,13 +509,38 @@ class OpenAIFrontend:
         except Exception as e:
             return self._error(500, f"profiler start failed: {e}")
         self._profiling = True
-        return web.json_response({"profiling": True, "dir": out_dir})
+        self._profile_deadline_handle = asyncio.get_running_loop().call_later(
+            max_seconds, self._profile_deadline
+        )
+        return web.json_response({
+            "profiling": True, "dir": out_dir, "max_seconds": max_seconds,
+        })
+
+    def _profile_deadline(self) -> None:
+        """Auto-stop timer fired: end the trace (event-loop thread, same
+        thread every profile handler runs on — no race with an explicit
+        stop)."""
+        self._profile_deadline_handle = None
+        if not self._profiling:
+            return
+        import jax
+
+        logger.warning("profiler auto-stop: max_seconds deadline reached")
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            logger.exception("profiler auto-stop failed")
+        finally:
+            self._profiling = False
 
     async def profile_stop(self, _request):
         import jax
 
         if not self._profiling:
             return self._error(409, "profiler not running")
+        if self._profile_deadline_handle is not None:
+            self._profile_deadline_handle.cancel()
+            self._profile_deadline_handle = None
         try:
             jax.profiler.stop_trace()
         finally:
@@ -487,8 +630,7 @@ class OpenAIFrontend:
         )
         # Count at accept time, not in usage formatting: client disconnects
         # mid-stream must still be visible in /metrics.
-        self._counters["requests"] += 1
-        self._counters["prompt_tokens"] += req.num_prompt_tokens
+        self._count_accept(req)
         t_start = time.monotonic()
         try:
             done = await asyncio.to_thread(self.submit_fn, req)
@@ -526,7 +668,7 @@ class OpenAIFrontend:
                 )
             )
         finally:
-            self._counters["completion_tokens"] += req.num_output_tokens
+            self._count_completion(req, t_start)
 
     async def _generate_n(self, rid, body, prompt_ids, sampling_params,
                           routing_table, chat, n_choices):
@@ -543,7 +685,7 @@ class OpenAIFrontend:
             # threads (if any) unblock too.
             for r in started:
                 await self._request_stop(r)
-                self._counters["completion_tokens"] += r.num_output_tokens
+                self._count_completion(r)
 
         reqs, dones = [], []
         for i in range(n_choices):
@@ -575,8 +717,7 @@ class OpenAIFrontend:
                 raise
             # Count only actually-submitted choices (at accept time, so a
             # later disconnect is still visible in /metrics).
-            self._counters["requests"] += 1
-            self._counters["prompt_tokens"] += req.num_prompt_tokens
+            self._count_accept(req)
             reqs.append(req)
             dones.append(done)
         t_start = time.monotonic()
@@ -595,7 +736,7 @@ class OpenAIFrontend:
             raise
         # Tokens generated before a failure must still reach /metrics.
         for req in reqs:
-            self._counters["completion_tokens"] += req.num_output_tokens
+            self._count_completion(req, t_start)
         failures = [r for r in results if isinstance(r, BaseException)]
         if failures:
             for req in reqs:
@@ -683,7 +824,7 @@ class OpenAIFrontend:
             await self._request_stop(req)
             raise
         finally:
-            self._counters["completion_tokens"] += req.num_output_tokens
+            self._count_completion(req, t_start)
 
     async def _request_stop(self, req) -> None:
         """Ask the backend to finish ``req`` early (stop-string match)."""
@@ -712,6 +853,7 @@ class OpenAIFrontend:
             if n > seen_tokens:
                 if ttft_ms is None:
                     ttft_ms = (time.monotonic() - t_start) * 1e3
+                    self._m_http_ttft.observe(ttft_ms)
                 seen_tokens = n
                 full = dec.update(list(req.output_ids[:n]))
                 idx = scanner.find(full) if stops else None

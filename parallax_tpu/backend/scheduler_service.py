@@ -131,6 +131,13 @@ class SchedulerService:
                 if isinstance(payload.get("transport"), dict)
                 else None
             ),
+            # Histogram snapshots (obs/registry.py) — merged across
+            # nodes into cluster-wide percentiles in /cluster/status.
+            metrics=(
+                payload["metrics"]
+                if isinstance(payload.get("metrics"), dict)
+                else None
+            ),
         )
         alloc = self._with_model(self.scheduler.get_node_allocation(node_id) or {})
         alloc["refit_version"] = self.scheduler.refit_version

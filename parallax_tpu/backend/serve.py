@@ -70,8 +70,12 @@ def build_local_frontend(
     engines: list[StageEngine],
     tokenizer,
     model_name: str = "parallax-tpu",
+    wire: bool = False,
 ) -> tuple[OpenAIFrontend, LocalRunner]:
-    pipeline = InProcessPipeline(engines)
+    """``wire=True`` routes inter-stage packets through the real wire
+    format (the in-process twin of the networked hop) — exercised by the
+    observability tests so stitched traces cover the transport leg."""
+    pipeline = InProcessPipeline(engines, wire=wire)
     runner = LocalRunner(pipeline)
     runner.start()
 
@@ -89,8 +93,19 @@ def build_local_frontend(
                            "json_schema requests will be rejected", e)
 
     def status():
+        from parallax_tpu.obs.registry import (
+            get_registry,
+            summarize_snapshots,
+        )
+
         return {
             "mode": "single-host",
+            # Latency percentiles (TTFT/TPOT/e2e/step timing) from the
+            # process registry — the single-host twin of the swarm's
+            # cluster-wide heartbeat merge.
+            "metrics": summarize_snapshots(
+                get_registry().histogram_snapshots()
+            ),
             "stages": [
                 {
                     "layers": [e.model.start_layer, e.model.end_layer],
@@ -298,6 +313,10 @@ def serve_main(args) -> int:
             # worker spawned from this config inherits the operator's
             # wire choice (docs/networking.md).
             wire_dtype=getattr(args, "wire_dtype", None),
+            # Observability: lifecycle-trace sampling + slow-request
+            # flight threshold (docs/observability.md).
+            trace_sample_rate=getattr(args, "trace_sample_rate", 0.0) or 0.0,
+            slow_request_ms=getattr(args, "slow_request_ms", 30_000.0),
         ),
         mesh=mesh,
         sp_mesh=sp_mesh,

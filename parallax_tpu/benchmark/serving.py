@@ -352,12 +352,17 @@ async def run_benchmark(
 
 def _stats(xs: list[float]) -> dict:
     if not xs:
-        return {"mean": 0.0, "median": 0.0, "std": 0.0, "p99": 0.0}
+        return {"mean": 0.0, "median": 0.0, "std": 0.0,
+                "p50": 0.0, "p95": 0.0, "p99": 0.0}
     a = np.asarray(xs)
     return {
         "mean": float(a.mean()),
         "median": float(np.median(a)),
         "std": float(a.std()),
+        # Full percentile spread (p50 == median, kept under both names —
+        # dashboards grab pXX keys, older readers use median).
+        "p50": float(np.median(a)),
+        "p95": float(np.percentile(a, 95)),
         "p99": float(np.percentile(a, 99)),
     }
 

@@ -89,6 +89,18 @@ def build_parser() -> argparse.ArgumentParser:
              "native precision — bit-identical streams); fp8 compresses "
              "hidden frames with per-token scales, negotiated per link",
     )
+    serve.add_argument(
+        "--trace-sample-rate", type=float, default=0.0,
+        help="fraction of requests sampled for lifecycle tracing "
+             "(GET /debug/trace/<rid>, Chrome trace JSON); 0 disables "
+             "with zero per-step overhead",
+    )
+    serve.add_argument(
+        "--slow-request-ms", type=float, default=30000.0,
+        help="flight-recorder slow threshold: requests slower end-to-end "
+             "than this are captured with their span breakdown "
+             "(GET /debug/flight); <= 0 disables slow capture",
+    )
 
     run = sub.add_parser("run", help="launch the scheduler + web frontend")
     run.add_argument("--model-name", required=True)
@@ -162,6 +174,16 @@ def build_parser() -> argparse.ArgumentParser:
         help="inter-stage activation wire format for this worker's "
              "outbound links (default: native precision — bit-identical "
              "streams); negotiated per link via wire_caps",
+    )
+    join.add_argument(
+        "--trace-sample-rate", type=float, default=0.0,
+        help="head-stage lifecycle-trace sampling rate; the sampled flag "
+             "rides FORWARD frames so downstream stages join the trace",
+    )
+    join.add_argument(
+        "--slow-request-ms", type=float, default=30000.0,
+        help="flight-recorder slow threshold for this worker's head "
+             "stage (<= 0 disables slow capture)",
     )
 
     bench = sub.add_parser("bench", help="offline throughput benchmark")

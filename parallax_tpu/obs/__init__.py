@@ -1,0 +1,41 @@
+"""Unified observability layer: metrics registry, request tracing,
+flight recorder.
+
+Three pillars (docs/observability.md):
+
+- :mod:`parallax_tpu.obs.registry` — thread-safe Counter/Gauge/Histogram
+  primitives with Prometheus text exposition; every engine/transport/HTTP
+  series lives in one process-wide registry so ``/metrics`` exposes the
+  full serving surface, and histogram snapshots ride worker heartbeats
+  into cluster-wide percentiles.
+- :mod:`parallax_tpu.obs.trace` — request-lifecycle span recorder whose
+  trace context rides the FORWARD wire frames, so spans emitted on
+  different pipeline stages stitch into one Chrome-trace-viewable trace
+  (``GET /debug/trace/<request_id>``).
+- :mod:`parallax_tpu.obs.flight` — bounded ring of recent request
+  timelines plus engine events (preemption, abort_path, wire-dtype
+  renegotiation, queue overflow), surfaced at ``GET /debug/flight`` and
+  auto-logging slow requests with their span breakdown.
+"""
+
+from parallax_tpu.obs.flight import FlightRecorder, get_flight
+from parallax_tpu.obs.registry import (
+    EXPOSITION_CONTENT_TYPE,
+    MetricsRegistry,
+    get_registry,
+    merge_histogram_snapshots,
+    summarize_snapshots,
+)
+from parallax_tpu.obs.trace import TraceStore, get_trace_store
+
+__all__ = [
+    "EXPOSITION_CONTENT_TYPE",
+    "FlightRecorder",
+    "MetricsRegistry",
+    "TraceStore",
+    "get_flight",
+    "get_registry",
+    "get_trace_store",
+    "merge_histogram_snapshots",
+    "summarize_snapshots",
+]

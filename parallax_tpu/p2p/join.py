@@ -167,6 +167,10 @@ def join_main(args) -> int:
             # and alias resolution happen in the worker's sender
             # pipeline (docs/networking.md).
             wire_dtype=getattr(args, "wire_dtype", None),
+            # Observability: trace sampling + slow-request threshold
+            # (docs/observability.md).
+            trace_sample_rate=getattr(args, "trace_sample_rate", 0.0) or 0.0,
+            slow_request_ms=getattr(args, "slow_request_ms", 30_000.0),
         ),
         load_params=load_params,
         mesh=mesh,

@@ -471,6 +471,11 @@ class WorkerNode:
                         # queue depth, compression ratio) — surfaced in
                         # /cluster/status.
                         "transport": self.transport_stats(),
+                        # Histogram snapshots (TTFT/TPOT/step timing/
+                        # batch size) from the local metrics registry —
+                        # the scheduler merges them into cluster-wide
+                        # percentiles in /cluster/status.
+                        "metrics": self._metrics_snapshot(),
                         "refit_version": self.refit_version,
                         "lora_adapters": (
                             eng.adapter_names() if eng else []
@@ -882,6 +887,12 @@ class WorkerNode:
                 "%s: peer %s cannot decode wire dtype %s; sending "
                 "native frames on this link", self.node_id, peer, want,
             )
+        from parallax_tpu.obs.flight import get_flight
+
+        get_flight().event(
+            "wire_dtype", node=self.node_id, peer=peer, want=want,
+            negotiated=got,
+        )
         self._cache_wire_dtype(peer, got, self.WIRE_DTYPE_REFRESH_S, gen)
 
     def _cache_wire_dtype(
@@ -912,6 +923,11 @@ class WorkerNode:
         frame re-probes instead of shipping frames it cannot parse."""
         logger.error("%s: async send to %s failed: %s",
                      self.node_id, peer, reason)
+        from parallax_tpu.obs.flight import get_flight
+
+        get_flight().event(
+            "abort_path", node=self.node_id, peer=peer, reason=reason,
+        )
         self._forget_wire_dtype(peer)
         self._post(("abort_path", peer))
 
@@ -948,14 +964,73 @@ class WorkerNode:
     def transport_stats(self) -> dict | None:
         """Per-link telemetry for heartbeats / status surfaces: the
         sender pipeline's outbound counters merged with inbound
-        frame/byte counts per source peer."""
+        frame/byte counts per source peer. Also republishes the totals
+        into the metrics registry so a worker's ``/metrics`` (and the
+        single-process swarm probes) expose transport series."""
         links = self.sender.stats()
         with self._rx_lock:
             rx_snapshot = {p: dict(rx) for p, rx in self._rx_stats.items()}
         for peer, rx in rx_snapshot.items():
             rx.pop("t", None)
             links.setdefault(peer, {}).update(rx)
+        try:
+            self._publish_transport_metrics(links)
+        except Exception:  # pragma: no cover - metrics never break serving
+            pass
         return links or None
+
+    def _publish_transport_metrics(self, links: dict) -> None:
+        from parallax_tpu.obs.registry import get_registry
+
+        reg = get_registry()
+        peers = ("peer",)
+        c_bytes_out = reg.counter(
+            "parallax_transport_bytes_out_total",
+            "Wire bytes sent per link", labelnames=peers,
+        )
+        c_bytes_in = reg.counter(
+            "parallax_transport_bytes_in_total",
+            "Wire bytes received per link", labelnames=peers,
+        )
+        c_frames_out = reg.counter(
+            "parallax_transport_frames_out_total",
+            "Frames sent per link", labelnames=peers,
+        )
+        c_drops = reg.counter(
+            "parallax_transport_drops_total",
+            "Frames dropped per link (overflow / dead peer)",
+            labelnames=peers,
+        )
+        g_depth = reg.gauge(
+            "parallax_transport_queue_depth",
+            "Sender frames currently queued per link", labelnames=peers,
+        )
+        for peer, s in links.items():
+            c_bytes_out.labels(peer=peer).set_total(s.get("bytes_out", 0))
+            c_bytes_in.labels(peer=peer).set_total(s.get("bytes_in", 0))
+            c_frames_out.labels(peer=peer).set_total(s.get("frames_out", 0))
+            c_drops.labels(peer=peer).set_total(s.get("drops", 0))
+            g_depth.labels(peer=peer).set(s.get("queue_depth", 0))
+
+    def _metrics_snapshot(self) -> dict | None:
+        """Histogram snapshots for the heartbeat payload (scheduler-side
+        merge into cluster percentiles); None when nothing observed yet."""
+        try:
+            from parallax_tpu.obs.registry import get_registry
+
+            snaps = get_registry().histogram_snapshots()
+            # Strip empty children: idle engines would otherwise ship a
+            # full lattice of zeros every beat.
+            out = {}
+            for name, children in snaps.items():
+                kept = {
+                    lbl: c for lbl, c in children.items() if c.get("count")
+                }
+                if kept:
+                    out[name] = kept
+            return out or None
+        except Exception:  # pragma: no cover - metrics never break serving
+            return None
 
     # -- transport handlers (any thread) -------------------------------------
 
@@ -1307,6 +1382,7 @@ class WorkerNode:
         report raw vs wire bytes for the compression telemetry."""
 
         def build():
+            t0 = time.perf_counter()
             wd = self._wire_dtype_for(peer)
             raw = sum(
                 i.hidden_states.nbytes
@@ -1316,6 +1392,18 @@ class WorkerNode:
             wire = sum(
                 proto.tensor_nbytes(r.get("hidden_states")) for r in reqs
             )
+            traced = [i for i in ireqs if i.trace]
+            if traced:
+                from parallax_tpu.obs.trace import get_trace_store
+
+                store = get_trace_store()
+                dur = time.perf_counter() - t0
+                for i in traced:
+                    store.add(
+                        i.request_id, self.node_id, "transport_send",
+                        t0=t0, dur=dur, args={"peer": peer, "bytes": wire},
+                        merge=True,
+                    )
             return {"reqs": reqs}, raw, wire
 
         return build

@@ -184,6 +184,9 @@ def ireq_to_wire(
         "spec_accepted": ireq.spec_accepted,
         "cached_prefix_ids": ireq.cached_prefix_ids,
         "lora_id": ireq.lora_id,
+        # Trace context (obs/trace.py): sampled requests carry the flag
+        # across stage hops so spans stitch into one trace.
+        "trace": ireq.trace,
     }
 
 
@@ -204,6 +207,7 @@ def ireq_from_wire(d: dict) -> IntermediateRequest:
         spec_accepted=d.get("spec_accepted"),
         cached_prefix_ids=d.get("cached_prefix_ids"),
         lora_id=d.get("lora_id"),
+        trace=bool(d.get("trace", False)),
     )
 
 

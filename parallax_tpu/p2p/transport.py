@@ -674,6 +674,8 @@ class AsyncSender:
             try:
                 link.queue.put_nowait((method, payload, best_effort))
             except Exception:  # queue.Full
+                from parallax_tpu.obs.flight import get_flight
+
                 if best_effort:
                     # A courtesy frame that does not fit is dropped
                     # ALONE: what is queued is live traffic (FORWARD
@@ -684,6 +686,10 @@ class AsyncSender:
                     # them.
                     with link.stats_lock:
                         link.stats["drops"] += 1
+                    get_flight().event(
+                        "queue_overflow", peer=peer, dropped=1,
+                        best_effort=True, method=method,
+                    )
                 else:
                     # One incident, not one failure per frame:
                     # everything queued is stale the moment the
@@ -693,6 +699,10 @@ class AsyncSender:
                     dropped = 1 + link.drain()
                     with link.stats_lock:
                         link.stats["drops"] += dropped
+                    get_flight().event(
+                        "queue_overflow", peer=peer, dropped=dropped,
+                        best_effort=False, method=method,
+                    )
                     overflow = True
             depth = link.queue.qsize()
             with link.stats_lock:

@@ -14,6 +14,7 @@ via lock refs for the request's lifetime.
 from __future__ import annotations
 
 import math
+import time
 
 from parallax_tpu.config import LAYER_ATTENTION, LAYER_SLIDING, ModelConfig
 from parallax_tpu.runtime.allocator import OutOfPages, PageAllocator
@@ -282,6 +283,7 @@ class CacheManager:
             # H2D scatter of the host-tier hits, then the nodes are
             # ordinary device-resident tree pages shared with this
             # request.
+            t_swap = time.perf_counter()
             swap_pages = fresh[:len(host_nodes)]
             fresh = fresh[len(host_nodes):]
             handles = [
@@ -290,6 +292,22 @@ class CacheManager:
             ]
             self.host_tier.promote(handles, swap_pages)
             shared_pages = [n.page_id for n in path]
+            # Observability: admission-time host-tier swap-in is one of
+            # the places a slow request can hide — record it for traced
+            # requests and the flight-recorder event ring.
+            dur = time.perf_counter() - t_swap
+            from parallax_tpu.obs.flight import get_flight
+            from parallax_tpu.obs.trace import get_trace_store
+
+            get_flight().event(
+                "swap_in", request_id=request.request_id,
+                pages=len(host_nodes), ms=round(dur * 1e3, 3),
+            )
+            if request.traced:
+                get_trace_store().add(
+                    request.request_id, "cache", "swap_in",
+                    t0=t_swap, dur=dur, args={"pages": len(host_nodes)},
+                )
         request.page_ids = shared_pages + fresh
         request.num_cached_tokens = len(shared_pages) * self.page_size
         request.num_computed_tokens = request.num_cached_tokens

@@ -12,6 +12,7 @@ O(tokens) numpy; sampling is a second fused jit call.
 from __future__ import annotations
 
 import dataclasses
+import random
 import time
 
 import jax
@@ -123,6 +124,17 @@ class EngineConfig:
     # format via wire_caps at first use; peers that cannot decode the
     # requested dtype receive native frames. See docs/networking.md.
     wire_dtype: str | None = None
+    # Request-lifecycle tracing (obs/trace.py): fraction of head-stage
+    # requests sampled for span recording (enqueue -> admit -> prefill ->
+    # decode epochs -> swap-in/preempt -> transport -> finish; Chrome
+    # trace JSON at GET /debug/trace/<rid>). 0 = off (the default) — the
+    # overlapped decode dispatch path then runs with zero tracing work.
+    trace_sample_rate: float = 0.0
+    # Flight recorder (obs/flight.py): any head request whose end-to-end
+    # latency exceeds this is captured in the slow ring with its span
+    # breakdown and logged. <= 0 disables slow capture (the timeline ring
+    # still records).
+    slow_request_ms: float = 30_000.0
 
 
 @dataclasses.dataclass
@@ -300,6 +312,10 @@ class StageEngine:
         self.mesh = mesh
         self.sp_mesh = sp_mesh
         self.draft = draft
+        # The stage label every observability surface carries (metric
+        # labels, trace-span lanes, flight events — one source of truth,
+        # shared with the scheduler's preempt/swap-in hooks).
+        self._obs_stage = f"{model.start_layer}-{model.end_layer}"
         kv_dtype = jnp.bfloat16 if self.cfg.kv_dtype == "bfloat16" else jnp.float32
         # Hybrid (linear-attention) models carry per-request state slots.
         self._needs_state = bool(getattr(model, "has_linear_layers", False))
@@ -428,6 +444,7 @@ class StageEngine:
                 if self._needs_state and self.cache.enable_prefix_cache
                 else None
             ),
+            stage_name=self._obs_stage,
         )
         self.spec = BucketSpec.build(
             self.cfg.max_num_tokens_per_batch,
@@ -557,10 +574,15 @@ class StageEngine:
         self._token_slots: dict[str, int] = {}
         self._free_token_slots = list(range(self.cfg.max_batch_size))
         # host_ms/device_ms/overlap EWMA published via heartbeats and
-        # /cluster/status (utils/request_metrics.py).
+        # /cluster/status (utils/request_metrics.py), with the same
+        # samples feeding registry histograms for /metrics and
+        # cluster-wide percentile merges.
         from parallax_tpu.utils.request_metrics import StepTimingAggregator
 
-        self.step_timing = StepTimingAggregator()
+        self._init_obs()
+        self.step_timing = StepTimingAggregator(
+            host_hist=self._h_step_host, device_hist=self._h_step_device
+        )
         # Non-head stages: hidden rows waiting per request id.
         self._pending_hidden: dict[str, np.ndarray] = {}
         self._sampling_cache: dict[str, SamplingParams] = {}
@@ -713,6 +735,12 @@ class StageEngine:
         sp = request.sampling_params
         if sp.max_new_tokens > cap:
             sp.max_new_tokens = cap
+        # Lifecycle-trace sampling (head decides; the flag rides the
+        # FORWARD frames so downstream stages join the same trace).
+        if request.traced or (
+            self._trace_rate > 0.0 and random.random() < self._trace_rate
+        ):
+            self._trace_begin(request)
         return self.scheduler.enqueue(request)
 
     def submit_intermediate(self, ireq: IntermediateRequest) -> None:
@@ -776,6 +804,11 @@ class StageEngine:
             req.prompt_ids.extend(new_tokens)
             req.status = RequestStatus.PREFILLING
             req.ready_for_step = True
+        if ireq.trace and req.request_id not in self._traced:
+            # An upstream stage sampled this request for tracing: record
+            # this stage's spans under the same trace id (begin() is
+            # idempotent, so in-process pipelines share one span list).
+            self._trace_begin(req)
         if ireq.spec_len > 0:
             # Last ``spec_len`` tokens are unverified proposals; the last
             # stage verifies them against its own greedy logits.
@@ -804,6 +837,7 @@ class StageEngine:
         self._grammar_states.pop(request_id, None)
         self._bias_cache.pop(request_id, None)
         self._free_token_slot(request_id)
+        self._traced.discard(request_id)
         if req is not None:
             req.device_feed_ready = False
             if not req.status.is_finished:
@@ -826,6 +860,213 @@ class StageEngine:
         from parallax_tpu.utils.request_metrics import cache_stats_summary
 
         return cache_stats_summary(self.cache)
+
+    # -- observability (obs/: registry series, tracing, flight) -----------
+
+    def _init_obs(self) -> None:
+        """Register this stage's metric series and trace state.
+
+        Hot-path contract: with ``trace_sample_rate=0`` (default) the
+        ``self._traced`` set stays empty and every per-step tracing hook
+        is behind an O(1) emptiness check; gauges and monotonic cache
+        counters are pulled lazily by a registry collector at
+        render/snapshot time, never per step.
+        """
+        from parallax_tpu.obs.registry import (
+            DEFAULT_COUNT_BUCKETS,
+            get_registry,
+        )
+
+        self._trace_rate = min(
+            1.0, max(0.0, float(self.cfg.trace_sample_rate or 0.0))
+        )
+        self._traced: set[str] = set()
+        model = self.model
+        reg = get_registry()
+        st = ("stage",)
+        lbl = {"stage": self._obs_stage}
+        self._h_step_host = reg.histogram(
+            "parallax_step_host_ms",
+            "Host-blocking milliseconds per engine step",
+            labelnames=st,
+        ).labels(**lbl)
+        self._h_step_device = reg.histogram(
+            "parallax_step_device_ms",
+            "Device-readback milliseconds per engine step",
+            labelnames=st,
+        ).labels(**lbl)
+        self._h_batch_tokens = reg.histogram(
+            "parallax_step_batch_tokens",
+            "New tokens per dispatched engine step",
+            buckets=DEFAULT_COUNT_BUCKETS, labelnames=st,
+        ).labels(**lbl)
+        self._g_queue = reg.gauge(
+            "parallax_queue_depth",
+            "Requests parked in the stage wait queue", labelnames=st,
+        ).labels(**lbl)
+        self._g_running = reg.gauge(
+            "parallax_running_requests",
+            "Requests admitted into the running set", labelnames=st,
+        ).labels(**lbl)
+        self._g_occupancy = reg.gauge(
+            "parallax_kv_page_occupancy",
+            "Fraction of KV pages in use (0..1)", labelnames=st,
+        ).labels(**lbl)
+        self._c_preempt = reg.counter(
+            "parallax_kv_preemptions_total",
+            "Decode-OOM preemptions to the host KV tier", labelnames=st,
+        ).labels(**lbl)
+        self._c_resumes = reg.counter(
+            "parallax_kv_resumes_total",
+            "Preempted requests swapped back in", labelnames=st,
+        ).labels(**lbl)
+        self._c_kv_oom = reg.counter(
+            "parallax_kv_oom_total",
+            "Last-resort kv_oom aborts", labelnames=st,
+        ).labels(**lbl)
+        self._c_evicted = reg.counter(
+            "parallax_kv_pages_evicted_total",
+            "Device pages reclaimed from the prefix tree", labelnames=st,
+        ).labels(**lbl)
+        if model.is_first:
+            self._h_ttft = reg.histogram(
+                "parallax_ttft_ms",
+                "Time to first token, milliseconds", labelnames=st,
+            ).labels(**lbl)
+            self._h_tpot = reg.histogram(
+                "parallax_tpot_ms",
+                "Time per output token after the first, milliseconds",
+                labelnames=st,
+            ).labels(**lbl)
+            self._h_e2e = reg.histogram(
+                "parallax_e2e_ms",
+                "End-to-end request latency, milliseconds", labelnames=st,
+            ).labels(**lbl)
+        # The registry holds only a weakref to this bound method; the
+        # engine's own reference keeps collection alive exactly as long
+        # as the engine.
+        reg.register_collector(self._collect_obs)
+
+    def _collect_obs(self) -> None:
+        """Pull-style series, refreshed at render/snapshot time."""
+        sched = self.scheduler
+        self._g_queue.set(len(sched.wait_queue))
+        self._g_running.set(len(sched.running))
+        num_pages = getattr(self.cache, "num_pages", 0)
+        free = getattr(self.cache, "num_free_pages", 0)
+        self._g_occupancy.set(
+            round(1.0 - free / num_pages, 4) if num_pages else 0.0
+        )
+        stats = getattr(self.cache, "stats", None)
+        if stats is not None:
+            self._c_preempt.set_total(stats.preemptions)
+            self._c_resumes.set_total(stats.resumes)
+            self._c_kv_oom.set_total(stats.kv_oom_aborts)
+            self._c_evicted.set_total(stats.pages_evicted)
+
+    def _trace_begin(self, req: Request) -> None:
+        from parallax_tpu.obs.trace import get_trace_store
+
+        req.traced = True
+        self._traced.add(req.request_id)
+        get_trace_store().begin(req.request_id)
+
+    def _trace_queue_wait(self, plan: BatchPlan) -> None:
+        """First time a traced request is scheduled: close its
+        enqueue->admit span (wait-queue time)."""
+        from parallax_tpu.obs.trace import get_trace_store
+
+        store = get_trace_store()
+        now_pc = time.perf_counter()
+        now_mono = time.monotonic()
+        for seg in plan.seqs:
+            req = seg.request
+            if req.traced and not getattr(req, "_trace_scheduled", False):
+                req._trace_scheduled = True  # type: ignore[attr-defined]
+                wait = max(0.0, now_mono - req.arrival_time)
+                store.add(
+                    req.request_id, self._obs_stage, "queue_wait",
+                    t0=now_pc - wait, dur=wait,
+                    args={"prompt_tokens": req.num_prompt_tokens},
+                )
+
+    def _trace_plan(self, plan: BatchPlan, t0: float, t1: float) -> None:
+        """Per-step spans for traced rows; decode steps coalesce into
+        epochs (obs/trace.py merge) so long generations stay bounded."""
+        from parallax_tpu.obs.trace import get_trace_store
+
+        store = get_trace_store()
+        for seg in plan.seqs:
+            req = seg.request
+            if not req.traced:
+                continue
+            if getattr(req, "is_mirror", False):
+                decode = seg.num_new_tokens == 1 and getattr(
+                    req, "last_chunk_flag", False
+                )
+            else:
+                decode = (
+                    seg.num_new_tokens == 1
+                    and seg.context_len > req.num_prompt_tokens
+                )
+            store.add(
+                req.request_id, self._obs_stage,
+                "decode" if decode else "prefill",
+                t0=t0, dur=t1 - t0,
+                args={"tokens": seg.num_new_tokens}, merge=decode,
+            )
+
+    def _obs_finish(self, req: Request) -> None:
+        """Finish bookkeeping: TTFT/TPOT/e2e histograms + the flight
+        recorder's timeline ring (head stage), finish span + traced-set
+        cleanup (every stage). Internal requests (draft proposer) skip."""
+        rid = req.request_id
+        traced = rid in self._traced
+        store = None
+        if traced:
+            from parallax_tpu.obs.trace import get_trace_store
+
+            self._traced.discard(rid)
+            store = get_trace_store()
+            store.add(
+                rid, self._obs_stage, "finish",
+                t0=time.perf_counter(), dur=0.0,
+                args={"status": req.status.value},
+            )
+        if not self.model.is_first or rid.startswith("__"):
+            return
+        from parallax_tpu.obs.flight import get_flight
+
+        now = time.monotonic()
+        e2e_ms = (now - req.arrival_time) * 1e3
+        ttft_ms = None
+        if req.first_token_time is not None:
+            ttft_ms = (req.first_token_time - req.arrival_time) * 1e3
+            self._h_ttft.observe(ttft_ms)
+            n = req.num_output_tokens
+            if n > 1:
+                self._h_tpot.observe(
+                    (now - req.first_token_time) * 1e3 / (n - 1)
+                )
+        self._h_e2e.observe(e2e_ms)
+        breakdown = store.breakdown(rid) if store is not None else None
+        if breakdown is None and ttft_ms is not None:
+            breakdown = {
+                "ttft_ms": round(ttft_ms, 3),
+                "decode_ms": round(e2e_ms - ttft_ms, 3),
+            }
+        get_flight().record_request(
+            rid,
+            status=req.status.value,
+            e2e_ms=e2e_ms,
+            ttft_ms=ttft_ms,
+            prompt_tokens=req.num_prompt_tokens,
+            output_tokens=req.num_output_tokens,
+            abort_reason=req.abort_reason,
+            stage=self._obs_stage,
+            breakdown=breakdown,
+            slow_threshold_ms=self.cfg.slow_request_ms,
+        )
 
     # -- multi-step decode (k tokens per dispatch) ------------------------
 
@@ -1504,6 +1745,10 @@ class StageEngine:
                 StepOutputs(forward=[], finished=self._collect_finished())
             )
 
+        if self._traced:
+            # Tracing-off fast path: the set is empty unless sampling is
+            # on, so the default config pays one falsy check here.
+            self._trace_queue_wait(plan)
         # Rows fed from the device-resident last-token array: their token
         # value is unknown to the host, so the fused paths (which read
         # host token ids) must not run this step.
@@ -1660,6 +1905,11 @@ class StageEngine:
             o = ticket.outputs
             if o.num_tokens:
                 self.step_timing.update(o.host_ms, o.device_ms, o.overlapped)
+                self._h_batch_tokens.observe(o.num_tokens)
+                if self._traced:
+                    self._trace_plan(
+                        ticket.plan, ticket.t0, time.perf_counter()
+                    )
             return o
         plan = ticket.plan
         t_r0 = time.perf_counter()
@@ -1700,6 +1950,10 @@ class StageEngine:
         # unchanged there.
         self._record_latency(plan, host_ms)
         self.step_timing.update(host_ms, device_ms, overlapped)
+        if plan.total_new_tokens:
+            self._h_batch_tokens.observe(plan.total_new_tokens)
+        if self._traced:
+            self._trace_plan(plan, ticket.t0, now)
         return StepOutputs(
             forward=forwards,
             finished=self._collect_finished(),
@@ -2245,6 +2499,7 @@ class StageEngine:
                         num_new_tokens=1,
                         next_token_id=token,
                         token_logprob=lp,
+                        trace=req.traced,
                     )
                 )
         return forwards
@@ -2294,6 +2549,7 @@ class StageEngine:
                     spec_len=spec_len,
                     cached_prefix_ids=prefix_ids,
                     lora_id=req.lora_id,
+                    trace=req.traced,
                 )
             )
             row += n
@@ -2334,6 +2590,8 @@ class StageEngine:
             self._free_state_slot(req)
             self._free_token_slot(req.request_id)
             req.device_feed_ready = False
+            if self.model.is_first or req.request_id in self._traced:
+                self._obs_finish(req)
         return finished
 
     def _free_state_slot(self, req: Request) -> None:

@@ -45,16 +45,30 @@ class InProcessPipeline:
 
     def _wire_roundtrip(self, ireq):
         """One packet through the full wire path: serialize (with the
-        configured wire dtype), msgpack-frame, decode, deserialize."""
+        configured wire dtype), msgpack-frame, decode, deserialize.
+        Traced packets record the hop as a ``transport`` span — the
+        in-process twin of the networked send/recv pair."""
+        import time
+
         from parallax_tpu.p2p import proto
 
+        t0 = time.perf_counter()
         frame = proto.encode_frame(
             proto.FORWARD,
             {"reqs": [proto.ireq_to_wire(ireq, wire_dtype=self.wire_dtype)]},
         )
-        return proto.ireq_from_wire(
+        out = proto.ireq_from_wire(
             proto.decode_frame(frame)["p"]["reqs"][0]
         )
+        if ireq.trace:
+            from parallax_tpu.obs.trace import get_trace_store
+
+            get_trace_store().add(
+                ireq.request_id, "wire", "transport",
+                t0=t0, dur=time.perf_counter() - t0,
+                args={"bytes": len(frame)}, merge=True,
+            )
+        return out
 
     def step_round(self) -> list[Request]:
         """One step of every stage, routing packets around the ring."""

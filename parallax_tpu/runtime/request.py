@@ -127,6 +127,14 @@ class Request:
     # dispatched batch by this id; every stage must have the adapter
     # registered (StageEngine.load_adapter).
     lora_id: str | None = None
+    # Observability: this request was sampled for lifecycle tracing
+    # (obs/trace.py). The flag travels on inter-stage packets so every
+    # pipeline stage records spans under the same trace id.
+    traced: bool = False
+    # Monotonic timestamp of the first committed output token — TTFT for
+    # the metrics registry and flight recorder. Set in commit_token (the
+    # single choke point every sampling path funnels through).
+    first_token_time: float | None = None
 
     @property
     def num_prompt_tokens(self) -> int:
@@ -156,6 +164,8 @@ class Request:
 
         Reference: ``InitialRequest.commit_new_token`` (request.py:230-249).
         """
+        if self.first_token_time is None:
+            self.first_token_time = time.monotonic()
         self.output_ids.append(token_id)
         if logprob is not None:
             self.output_logprobs.append(logprob)
@@ -223,6 +233,10 @@ class IntermediateRequest:
     # Per-request LoRA adapter (reference ``Req.lora_path``,
     # forward.proto:1-57): downstream stages apply their layers' deltas.
     lora_id: str | None = None
+    # Trace context (obs/trace.py): the request was sampled for lifecycle
+    # tracing — receiving stages record their spans under the request id
+    # so multi-stage traces stitch.
+    trace: bool = False
 
     @property
     def is_prefill(self) -> bool:

@@ -78,7 +78,11 @@ class Scheduler:
         request_timeout_s: float = 600.0,
         is_first_stage: bool = True,
         snapshot_page_align: int | None = None,
+        stage_name: str = "stage",
     ):
+        # Observability: the stage label this scheduler's flight-recorder
+        # events and trace spans carry (preempt / swap-in / kv_oom).
+        self.stage_name = stage_name
         self.cache = cache_manager
         self.max_batch_size = max_batch_size
         self.max_num_tokens_per_batch = max_num_tokens_per_batch
@@ -131,11 +135,13 @@ class Scheduler:
                 # a resume that does not fit blocks admission like any
                 # other head-of-queue request.
                 resume = getattr(self.cache, "resume_from_host", None)
+                t0 = time.perf_counter()
                 if resume is None or not resume(req):
                     break
                 del self.wait_queue[rid]
                 req.status = RequestStatus.DECODING
                 self.running[rid] = req
+                self._obs_event("swap_in", req, dur=time.perf_counter() - t0)
                 continue
             if not self.cache.allocate_for_prompt(req):
                 break
@@ -424,6 +430,26 @@ class Scheduler:
         stats = getattr(self.cache, "stats", None)
         if stats is not None:
             stats.kv_oom_aborts += 1
+        self._obs_event("kv_oom", req)
+
+    def _obs_event(self, kind: str, req: Request, dur: float = 0.0) -> None:
+        """Flight-recorder event + (for traced requests) a trace span for
+        the memory-pressure lifecycle transitions — the "which of the
+        five places" answer when a slow request hit swap traffic."""
+        from parallax_tpu.obs.flight import get_flight
+
+        get_flight().event(
+            kind, request_id=req.request_id, stage=self.stage_name,
+            context_tokens=req.total_len,
+        )
+        if req.traced:
+            from parallax_tpu.obs.trace import get_trace_store
+
+            get_trace_store().add(
+                req.request_id, self.stage_name, kind,
+                t0=time.perf_counter() - dur, dur=dur,
+                args={"context_tokens": req.total_len},
+            )
 
     # -- preemption to host -----------------------------------------------
 
@@ -518,6 +544,7 @@ class Scheduler:
         req.device_feed_ready = False
         self.wait_queue[req.request_id] = req
         self.wait_queue.move_to_end(req.request_id, last=False)
+        self._obs_event("preempt", req)
 
     def check_timeouts(self) -> list[Request]:
         """Abort requests exceeding the wall-clock budget

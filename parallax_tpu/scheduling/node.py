@@ -107,6 +107,10 @@ class Node:
     # Wire-format capability list from node_join (dtype names this
     # node's build can decode on activation frames).
     wire_formats: tuple = ()
+    # Histogram snapshots from heartbeats (obs/registry.py payload:
+    # {metric: {labels: {bounds, counts, sum, count}}}) — merged across
+    # nodes into cluster-wide percentiles in /cluster/status.
+    metrics: dict | None = None
 
     def __post_init__(self):
         self.perf = RooflinePerformanceModel(self.hardware, self.model)

@@ -105,11 +105,12 @@ class GlobalScheduler:
         step_timing: dict | None = None,
         cache_stats: dict | None = None,
         transport: dict | None = None,
+        metrics: dict | None = None,
     ) -> None:
         self._events.put(
             ("update", node_id, layer_latency_ms, load, rtt_s, is_ready,
              refit_version, lora_adapters, step_timing, cache_stats,
-             transport)
+             transport, metrics)
         )
 
     def receive_request(self, request_id: str) -> PendingRequest:
@@ -181,6 +182,7 @@ class GlobalScheduler:
             (_, node_id, lat, load, rtt, ready, refit, adapters, timing,
              cache_stats, *rest) = ev
             transport = rest[0] if rest else None
+            metrics = rest[1] if len(rest) > 1 else None
             node = self.manager.get(node_id)
             if node is None:
                 return
@@ -203,6 +205,8 @@ class GlobalScheduler:
                 node.cache_stats = cache_stats
             if transport is not None:
                 node.transport = transport
+            if metrics is not None:
+                node.metrics = metrics
 
     def _try_bootstrap_or_extend(self) -> None:
         standby = self.manager.nodes(NodeState.STANDBY)
@@ -358,6 +362,23 @@ class GlobalScheduler:
     def cluster_status(self) -> dict:
         report = self.manager.capacity_report()
         report["bootstrapped"] = self.bootstrapped.is_set()
+        # Cluster-wide latency percentiles: merge every node's heartbeat
+        # histogram snapshots (same bucket lattice by convention) into
+        # one p50/p95/p99 summary per metric — TTFT/TPOT across the
+        # whole swarm, not per worker.
+        from parallax_tpu.obs.registry import (
+            merge_histogram_snapshots,
+            summarize_snapshots,
+        )
+
+        node_snaps = [
+            n.metrics for p in self.manager.pipelines for n in p.nodes
+            if n.metrics
+        ]
+        if node_snaps:
+            report["metrics"] = summarize_snapshots(
+                merge_histogram_snapshots(node_snaps)
+            )
         report["pipelines"] = [
             {
                 "id": p.pipeline_id,

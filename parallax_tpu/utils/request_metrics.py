@@ -20,14 +20,22 @@ from typing import Any
 
 
 class StepTimingAggregator:
-    """EWMA over per-step timing from the two-phase decode loop."""
+    """EWMA over per-step timing from the two-phase decode loop.
 
-    def __init__(self, alpha: float = 0.2):
+    Optionally feeds the same samples into metrics-registry histograms
+    (``obs/registry.py``) so ``/metrics`` and cluster-wide heartbeat
+    merges see full distributions, not just EWMAs — one choke point for
+    every resolve path (sync, deferred-sampler, fused multistep).
+    """
+
+    def __init__(self, alpha: float = 0.2, host_hist=None, device_hist=None):
         self.alpha = alpha
         self.host_ms_ewma: float | None = None
         self.device_ms_ewma: float | None = None
         self.steps = 0
         self.overlapped_steps = 0
+        self.host_hist = host_hist
+        self.device_hist = device_hist
 
     def update(self, host_ms: float, device_ms: float,
                overlapped: bool) -> None:
@@ -43,6 +51,10 @@ class StepTimingAggregator:
         self.steps += 1
         if overlapped:
             self.overlapped_steps += 1
+        if self.host_hist is not None:
+            self.host_hist.observe(host_ms)
+        if self.device_hist is not None:
+            self.device_hist.observe(device_ms)
 
     def summary(self) -> dict | None:
         """Heartbeat/status payload; None before the first step."""
