@@ -119,7 +119,10 @@ def _log_probe(msg: str) -> None:
         pass
 
 
-def _probe_once(timeout_s: float) -> bool:
+def _probe_once(timeout_s: float) -> str:
+    """One reachability attempt: "ok", "wedged" (the relay failure mode —
+    backend init hung to the timeout, or died with the relay's signature
+    UNAVAILABLE/init error) or "failed" (anything else)."""
     probe = (
         "import jax, jax.numpy as jnp;"
         "assert jax.default_backend() == 'tpu';"
@@ -133,12 +136,17 @@ def _probe_once(timeout_s: float) -> bool:
             capture_output=True, text=True, timeout=timeout_s,
         )
         if "TPU_OK" in out.stdout:
-            return True
+            return "ok"
         _log_probe(f"bench: probe attempt failed:\n{out.stderr[-1500:]}")
-        return False
+        if (
+            "UNAVAILABLE" in out.stderr
+            or "Unable to initialize backend" in out.stderr
+        ):
+            return "wedged"
+        return "failed"
     except subprocess.TimeoutExpired:
         _log_probe(f"bench: probe attempt timed out ({int(timeout_s)}s)")
-        return False
+        return "wedged"
 
 
 def _tpu_reachable(deadline: float) -> tuple[bool, dict]:
@@ -151,6 +159,7 @@ def _tpu_reachable(deadline: float) -> tuple[bool, dict]:
     budget_deadline = min(deadline, time.time() + PROBE_BUDGET_S)
     attempts = 0
     skipped = PROBE_ATTEMPTS
+    status = "unreachable"
     for i in range(PROBE_ATTEMPTS):
         left = budget_deadline - time.time()
         if left < 30:
@@ -161,7 +170,8 @@ def _tpu_reachable(deadline: float) -> tuple[bool, dict]:
             break
         attempts = i + 1
         skipped = PROBE_ATTEMPTS - attempts
-        if _probe_once(min(PROBE_S, left)):
+        status = _probe_once(min(PROBE_S, left))
+        if status == "ok":
             _log_probe(f"bench: probe attempt {i + 1} succeeded")
             # "skipped" counts budget-driven skips only; attempts that a
             # SUCCESS made unnecessary were never wanted.
@@ -169,12 +179,24 @@ def _tpu_reachable(deadline: float) -> tuple[bool, dict]:
                 "attempts": attempts, "skipped": 0,
                 "budget_s": PROBE_BUDGET_S,
             }
+        if status == "wedged":
+            # The relay's failure mode is bimodal: a wedged relay stays
+            # wedged for the whole bench window (r05 burned 2 x 120 s
+            # proving it). Record the verdict NOW and keep the CPU line
+            # — the retry would spend the budget learning nothing.
+            _log_probe(
+                "bench: relay wedged on attempt "
+                f"{i + 1}; skipping {PROBE_ATTEMPTS - attempts} "
+                "remaining attempt(s)"
+            )
+            skipped = PROBE_ATTEMPTS - attempts
+            break
         left = budget_deadline - time.time()
         if i + 1 < PROBE_ATTEMPTS and left > PROBE_SLEEP_S + 30:
             time.sleep(PROBE_SLEEP_S)
     return False, {
         "attempts": attempts, "skipped": skipped,
-        "budget_s": PROBE_BUDGET_S,
+        "budget_s": PROBE_BUDGET_S, "status": status,
     }
 
 
@@ -512,6 +534,215 @@ def _transport_probe(cfg, stage_params_fn, kv_dtype, page_size):
         "slow_peer_delay_ms": round(delay_s * 1000, 1),
         "baseline": run(0.0),
         "delayed": run(delay_s),
+    }
+
+
+def _routing_probe(cfg, stage_params_fn, kv_dtype, page_size):
+    """Two-replica loopback swarm under a shared-prefix (multi-turn chat)
+    workload, once per routing strategy: round-robin routes blind, so a
+    follow-up turn usually lands on the replica that has NEVER seen the
+    conversation and pays full prefill; cache-aware routing hashes the
+    prompt's block chain against the heartbeat-published radix digests
+    and sends it back to the warm replica. Returns ``detail.routing``:
+    per-strategy prefix hit rate + TTFT p50 over the follow-up turns,
+    plus the cache-aware decision counters and predicted-vs-actual hit
+    telemetry."""
+    import statistics
+    import time as _time
+
+    import numpy as np
+
+    from parallax_tpu.backend.scheduler_service import SchedulerService
+    from parallax_tpu.p2p.node import WorkerNode
+    from parallax_tpu.p2p.transport import LoopbackTransport
+    from parallax_tpu.runtime.engine import EngineConfig
+    from parallax_tpu.runtime.request import Request, SamplingParams
+    from parallax_tpu.scheduling.scheduler import GlobalScheduler
+
+    n_sessions, n_turns = 3, 3
+    base_pages, turn_pages = 3, 1
+    gen_len = max(4, page_size // 2)
+    # Worst-case context: base + per-turn extension + generations.
+    max_model_len = (
+        (base_pages + n_turns * (turn_pages + 1)) * page_size
+        + (n_turns + 1) * gen_len
+    )
+
+    rng = np.random.default_rng(11)
+    bases = [
+        [int(x) for x in rng.integers(
+            1, cfg.vocab_size - 1, size=base_pages * page_size
+        )]
+        for _ in range(n_sessions)
+    ]
+    chunks = [
+        [
+            [int(x) for x in rng.integers(
+                1, cfg.vocab_size - 1, size=turn_pages * page_size
+            )]
+            for _ in range(n_turns)
+        ]
+        for _ in range(n_sessions)
+    ]
+
+    def run(routing: str) -> dict:
+        registry: dict = {}
+        sched = GlobalScheduler(cfg, min_nodes_bootstrapping=2,
+                                routing=routing)
+        service = SchedulerService(
+            sched, LoopbackTransport("sched", registry), join_timeout_s=60.0
+        )
+        service.start()
+        ecfg = EngineConfig(
+            page_size=page_size,
+            num_pages=n_sessions * (max_model_len // page_size + 2) + 16,
+            max_batch_size=n_sessions,
+            max_model_len=max_model_len,
+            kv_dtype=kv_dtype,
+            enable_prefix_cache=True,
+        )
+        workers = [
+            WorkerNode(
+                transport=LoopbackTransport(f"rt{i}", registry),
+                scheduler_peer="sched",
+                model_config=cfg,
+                engine_config=ecfg,
+                load_params=stage_params_fn,
+                heartbeat_interval_s=0.1,
+            )
+            for i in range(2)
+        ]
+        try:
+            import threading
+
+            starters = [threading.Thread(target=w.start) for w in workers]
+            for s in starters:
+                s.start()
+            for s in starters:
+                s.join(timeout=120.0)
+            by_id = {w.node_id: w for w in workers}
+            deadline = _time.time() + 120
+            while _time.time() < deadline:
+                st = sched.cluster_status()
+                if st["num_pipelines"] >= 2 and all(
+                    n["ready"] for p in st["pipelines"] for n in p["nodes"]
+                ):
+                    break
+                _time.sleep(0.02)
+
+            def digests_synced() -> bool:
+                # Scheduler mirrors caught up with every live tree.
+                for w in workers:
+                    eng, node = w.engine, sched.manager.get(w.node_id)
+                    if eng is None or node is None:
+                        return False
+                    tree = getattr(eng.cache, "prefix_cache", None)
+                    n = getattr(tree, "num_cached_pages", 0) + getattr(
+                        tree, "num_host_pages", 0
+                    )
+                    if len(node.cache_index) != n:
+                        return False
+                return True
+
+            contexts: list[list[int]] = [list(b) for b in bases]
+            ttfts: list[float] = []
+            cached = prompt_total = 0
+            completed = requests = 0
+            for turn in range(n_turns):
+                for s in range(n_sessions):
+                    prompt = (
+                        contexts[s] if turn == 0
+                        else contexts[s] + chunks[s][turn]
+                    )
+                    rid = f"{routing}-s{s}-t{turn}"
+                    path = service.route_request(
+                        rid, timeout_s=30.0, prompt_ids=list(prompt)
+                    )
+                    if path is None:
+                        continue
+                    requests += 1
+                    req = Request(
+                        request_id=rid,
+                        prompt_ids=list(prompt),
+                        sampling_params=SamplingParams(
+                            temperature=0.0, max_new_tokens=gen_len,
+                            ignore_eos=True,
+                        ),
+                        routing_table=list(path),
+                    )
+                    head = by_id[path[0]]
+                    t0 = _time.perf_counter()
+                    ev = head.submit(req)
+                    first_deadline = t0 + 60.0
+                    while (
+                        not req.output_ids
+                        and not req.status.is_finished
+                        and _time.perf_counter() < first_deadline
+                    ):
+                        _time.sleep(0.0005)
+                    ttft_ms = (_time.perf_counter() - t0) * 1e3
+                    ok = ev.wait(60.0)
+                    if (
+                        ok and req.status.is_finished
+                        and req.status.value != "finished_abort"
+                    ):
+                        completed += 1
+                    contexts[s] = list(req.all_token_ids)
+                    if turn > 0:
+                        ttfts.append(ttft_ms)
+                        cached += req.num_cached_tokens
+                        prompt_total += len(prompt)
+                # Follow-up turns route against the digests the finished
+                # turn donated: wait for the heartbeat mirrors to catch
+                # up (cache-aware only; RR reads nothing).
+                if routing == "cache_aware":
+                    sync_deadline = _time.time() + 10.0
+                    while (
+                        not digests_synced()
+                        and _time.time() < sync_deadline
+                    ):
+                        _time.sleep(0.02)
+                else:
+                    _time.sleep(0.25)
+            # request_complete actuals ride the async sender: give the
+            # predicted-vs-actual aggregate a moment to drain.
+            acc_deadline = _time.time() + 3.0
+            while (
+                sched.routing_accuracy["requests"] < requests
+                and _time.time() < acc_deadline
+            ):
+                _time.sleep(0.02)
+            rec = {
+                "requests": requests,
+                "completed": completed,
+                "prefix_hit_rate": round(
+                    cached / prompt_total, 4
+                ) if prompt_total else 0.0,
+                "ttft_p50_ms": round(
+                    statistics.median(ttfts), 2
+                ) if ttfts else 0.0,
+                "pipeline_dispatches": {
+                    str(k): v
+                    for k, v in sched.router.pipeline_dispatches.items()
+                },
+            }
+            if sched.router.decision_counters:
+                rec["decisions"] = dict(sched.router.decision_counters)
+            if sched.routing_accuracy["requests"]:
+                rec["predicted_vs_actual"] = dict(sched.routing_accuracy)
+            return rec
+        finally:
+            for w in workers:
+                w.stop()
+            service.stop()
+
+    return {
+        "workload": {
+            "sessions": n_sessions, "turns": n_turns,
+            "base_pages": base_pages, "page_size": page_size,
+        },
+        "round_robin": run("rr"),
+        "cache_aware": run("cache_aware"),
     }
 
 
@@ -952,6 +1183,22 @@ def _bench():
             ),
             kv_dtype=kv_dtype, page_size=page_size,
         )
+
+    # Prefix-cache-aware routing probe: a two-replica loopback swarm
+    # serving a shared-prefix multi-turn workload, once with blind
+    # round-robin and once with cache-aware routing. The cache-aware run
+    # must win on BOTH prefix hit rate and follow-up-turn TTFT (the CI
+    # routing smoke asserts the hit-rate half of that contract). Cheap on
+    # CPU (part of the smoke contract); opt-in on TPU.
+    routing_probe = None
+    if not on_tpu or os.environ.get("BENCH_ROUTING"):
+        routing_probe = _routing_probe(
+            cfg, stage_params_fn=lambda m: m.init_params(
+                jax.random.key(m.start_layer * 1000 + m.end_layer),
+                dtype=dtype,
+            ),
+            kv_dtype=kv_dtype, page_size=page_size,
+        )
     total_s = time.perf_counter() - t_start
 
     # Decode throughput over the whole decode phase (wall-clock, includes
@@ -1094,6 +1341,14 @@ def _bench():
             **(
                 {"transport": transport_probe}
                 if transport_probe is not None else {}
+            ),
+            # Prefix-cache-aware routing probe (two-replica loopback
+            # swarm, shared-prefix multi-turn workload): per-strategy
+            # prefix hit rate + follow-up TTFT p50, cache-aware decision
+            # counters and predicted-vs-actual hit accuracy.
+            **(
+                {"routing": routing_probe}
+                if routing_probe is not None else {}
             ),
             **(
                 {

@@ -215,6 +215,21 @@ class OpenAIFrontend:
         self.tokenizer = tokenizer
         self.submit_fn = submit_fn
         self.route_fn = route_fn
+        # Cache-aware routing: newer route callables accept the tokenized
+        # prompt (``prompt_ids``/``lora_id``) so the dispatcher can hash
+        # the prompt's block chain once and score pipelines against the
+        # workers' published prefix digests. Older single-arg callables
+        # (tests, custom frontends) keep working.
+        self._route_takes_meta = False
+        if route_fn is not None:
+            try:
+                import inspect
+
+                self._route_takes_meta = (
+                    "prompt_ids" in inspect.signature(route_fn).parameters
+                )
+            except (TypeError, ValueError):  # builtins / C callables
+                pass
         self.status_fn = status_fn
         self.refit_fn = refit_fn
         self.stop_fn = stop_fn
@@ -605,9 +620,16 @@ class OpenAIFrontend:
 
         # Routing with retry ladder (reference request_handler.py:100-245:
         # None path -> 503 after retries; engine full -> 429).
+        lora_id = self._request_lora(body)
         routing_table: list[str] = []
         if self.route_fn is not None:
-            path = await asyncio.to_thread(self.route_fn, rid)
+            if self._route_takes_meta:
+                path = await asyncio.to_thread(
+                    self.route_fn, rid,
+                    prompt_ids=list(prompt_ids), lora_id=lora_id,
+                )
+            else:
+                path = await asyncio.to_thread(self.route_fn, rid)
             if path is None:
                 return self._error(503, "no serviceable pipeline")
             routing_table = path
@@ -626,7 +648,7 @@ class OpenAIFrontend:
             eos_token_ids=tuple(self.tokenizer.eos_token_ids),
             # Per-request adapter (reference Req.lora_path): "lora" in
             # the body or the <model>:<adapter> model-name convention.
-            lora_id=self._request_lora(body),
+            lora_id=lora_id,
         )
         # Count at accept time, not in usage formatting: client disconnects
         # mid-stream must still be visible in /metrics.

@@ -41,7 +41,9 @@ class SwarmClient:
         # rid -> head node id, for stop-string early finish.
         self._heads: dict[str, str] = {}
 
-    def route(self, request_id: str) -> list[str] | None:
+    def route(self, request_id: str,
+              prompt_ids: list[int] | None = None,
+              lora_id: str | None = None) -> list[str] | None:
         if self.service is None:
             # Chat-host mode: probe the head's readiness so a still-loading
             # or route-less swarm maps to the frontend's retryable 503
@@ -55,7 +57,10 @@ class SwarmClient:
             except Exception:
                 return None
             return [] if isinstance(r, dict) and r.get("ready") else None
-        return self.service.route_request(request_id, timeout_s=10.0)
+        return self.service.route_request(
+            request_id, timeout_s=10.0,
+            prompt_ids=prompt_ids, lora_id=lora_id,
+        )
 
     def submit(self, request: Request) -> threading.Event:
         if request.routing_table:
@@ -251,7 +256,11 @@ def make_scheduler_init_fn(service: SchedulerService, resolve_model,
         new_tokenizer = tokenizer_fn(model_name) if tokenizer_fn else None
         with lock:   # serialize concurrent switches: one stop per swap
             new_sched = GlobalScheduler(
-                model, min_nodes_bootstrapping=init_nodes_num
+                model, min_nodes_bootstrapping=init_nodes_num,
+                # The operator's routing choice AND tuning (--routing-alpha
+                # etc.) survive a model switch.
+                routing=service.scheduler.routing_name,
+                routing_kwargs=service.scheduler.routing_kwargs,
             )
             old = service.scheduler
             new_sched.start()
@@ -291,8 +300,19 @@ def run_main(args) -> int:
         args.model_name if os.path.isdir(args.model_name) else None
     )
 
+    routing_kwargs = None
+    if getattr(args, "routing", "rr") in ("cache_aware", "cache-aware"):
+        routing_kwargs = {
+            "alpha": getattr(args, "routing_alpha", 1.0),
+            "beta": getattr(args, "routing_beta", 256.0),
+            "imbalance_threshold": getattr(
+                args, "routing_imbalance", 8
+            ),
+        }
     scheduler = GlobalScheduler(
-        model, min_nodes_bootstrapping=args.min_nodes
+        model, min_nodes_bootstrapping=args.min_nodes,
+        routing=getattr(args, "routing", "rr"),
+        routing_kwargs=routing_kwargs,
     )
     transport = TcpTransport(
         "scheduler", "0.0.0.0", args.port + 1,

@@ -138,6 +138,13 @@ class SchedulerService:
                 if isinstance(payload.get("metrics"), dict)
                 else None
             ),
+            # Prefix-digest delta/snapshot (cache-aware routing): folded
+            # into the node's scheduler-side CacheIndex.
+            cache_digests=(
+                payload["cache_digests"]
+                if isinstance(payload.get("cache_digests"), dict)
+                else None
+            ),
         )
         alloc = self._with_model(self.scheduler.get_node_allocation(node_id) or {})
         alloc["refit_version"] = self.scheduler.refit_version
@@ -146,6 +153,10 @@ class SchedulerService:
             if payload.get("refit_version", 0) < self.scheduler.refit_version
             else None
         )
+        if self.scheduler.digests_resync_requested(node_id):
+            # A delta arrived out of sequence: the worker's next beat
+            # must carry a full digest snapshot.
+            alloc["digests_resync"] = True
         return alloc
 
     def _on_leave(self, _peer: str, payload: dict) -> str:
@@ -153,15 +164,31 @@ class SchedulerService:
         return "ok"
 
     def _on_request_complete(self, _peer: str, payload: dict) -> str:
-        self.scheduler.complete_request(payload.get("path") or [])
+        self.scheduler.complete_request(
+            payload.get("path") or [],
+            request_id=payload.get("rid"),
+            cached_tokens=payload.get("cached_tokens"),
+        )
         return "ok"
 
     # -- routing for the HTTP plane -----------------------------------------
 
-    def route_request(self, request_id: str, timeout_s: float = 5.0) -> list[str] | None:
+    def route_request(self, request_id: str, timeout_s: float = 5.0,
+                      prompt_ids: list[int] | None = None,
+                      lora_id: str | None = None) -> list[str] | None:
         """Block until the dispatcher assigns a node path (reference
-        scheduler_manage.get_routing_table, scheduler_manage.py:287-313)."""
-        pr = self.scheduler.receive_request(request_id)
+        scheduler_manage.get_routing_table, scheduler_manage.py:287-313).
+
+        ``prompt_ids`` (already tokenized by the HTTP frontend) feed the
+        cache-aware router: the dispatcher hashes the prompt's block
+        chain once and scores pipelines against each head's digest index.
+        """
+        from parallax_tpu.scheduling.request_routing import RequestMeta
+
+        meta = RequestMeta(
+            request_id, prompt_ids=prompt_ids, lora_id=lora_id,
+        ) if prompt_ids else None
+        pr = self.scheduler.receive_request(request_id, meta=meta)
         if not pr.event.wait(timeout_s):
             # Caller gives up: mark cancelled so a late dispatch does not
             # charge node load for a path nobody will use.

@@ -107,6 +107,32 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--min-nodes", type=int, default=1)
     run.add_argument("--port", type=int, default=3001)
     run.add_argument(
+        "--routing", default="rr",
+        choices=["rr", "dp", "random", "cache_aware"],
+        help="request routing strategy: rr round-robins registered "
+             "pipelines; dp shortest-latency over announced layer "
+             "ranges; random latency-weighted; cache_aware scores "
+             "pipelines by predicted prefix-cache hit (workers publish "
+             "radix-tree digests through heartbeats) plus load "
+             "(see docs/scheduling.md)",
+    )
+    run.add_argument(
+        "--routing-alpha", type=float, default=1.0,
+        help="cache_aware: cost per predicted UNCACHED prompt token",
+    )
+    run.add_argument(
+        "--routing-beta", type=float, default=256.0,
+        help="cache_aware: cost per in-flight request on the head "
+             "(default prices one queued request like 256 uncached "
+             "tokens)",
+    )
+    run.add_argument(
+        "--routing-imbalance", type=int, default=8,
+        help="cache_aware: when the in-flight spread across eligible "
+             "pipelines exceeds this, fall back to least-loaded so a "
+             "hot prefix cannot starve a replica",
+    )
+    run.add_argument(
         "--relay-token", default=None,
         help="shared secret NAT'd workers must present to register a "
              "relay route (default: registration is identity-bound only)",

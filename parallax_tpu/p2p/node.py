@@ -74,7 +74,13 @@ class WorkerNode:
         self.transport = transport
         self.scheduler_peer = scheduler_peer
         self.model_config = model_config
-        self.engine_config = engine_config or EngineConfig()
+        # Own copy: allocation replies mutate it (cache_digests rides
+        # want_digests), and callers legitimately share one EngineConfig
+        # across workers — a shared flip would make a sibling's
+        # digests_switched check see "already on" and skip its rebuild.
+        import dataclasses as _dc
+
+        self.engine_config = _dc.replace(engine_config or EngineConfig())
         self.load_params = load_params or self._random_params
         self.heartbeat_interval_s = heartbeat_interval_s
         self.mesh = mesh
@@ -114,6 +120,13 @@ class WorkerNode:
         self.engine: StageEngine | None = None
         self.start_layer = -1
         self.end_layer = -1
+        # Prefix-digest publishing (cache-aware routing): monotonically
+        # increasing per-payload sequence number + full-snapshot flag.
+        # Ordering is self-healing: a lost heartbeat leaves a seq gap the
+        # scheduler answers with digests_resync, and the next beat ships
+        # a full snapshot.
+        self._digests_seq = 0
+        self._digests_full_next = True
         self._inbox: queue.Queue = queue.Queue()
         # Set by every _post(): the step thread parks on it when idle
         # instead of polling, and wakes the instant work arrives.
@@ -248,8 +261,18 @@ class WorkerNode:
         if "start_layer" not in alloc:
             return
         model_switched = self._maybe_switch_model(alloc.get("model_name"))
+        # Cache-aware routing: the scheduler's join/reload replies carry
+        # want_digests, and the engine must be built with digest tracking
+        # to honor it (the Python cache manager owns the delta log). A
+        # flip without a layer change — strategy switch via scheduler
+        # restart — still forces a rebuild; in-flight requests abort,
+        # exactly like a reallocation.
+        want_digests = bool(alloc.get("want_digests"))
+        digests_switched = want_digests != self.engine_config.cache_digests
+        if digests_switched:
+            self.engine_config.cache_digests = want_digests
         start, end = alloc["start_layer"], alloc["end_layer"]
-        if not model_switched and (start, end) == (
+        if not model_switched and not digests_switched and (start, end) == (
             self.start_layer, self.end_layer
         ):
             return
@@ -281,6 +304,9 @@ class WorkerNode:
             except (ValueError, OSError) as e:
                 logger.warning("adapter %r failed to load: %s", name, e)
         self.engine = engine
+        # Fresh engine = empty radix tree: the scheduler's digest mirror
+        # for this node is stale; the next heartbeat ships a snapshot.
+        self._digests_full_next = True
         if model.is_last:
             self._wire_grammar()
         self._restore_refit_cache()
@@ -455,6 +481,10 @@ class WorkerNode:
                     proto.NODE_UPDATE,
                     {
                         "node_id": self.node_id,
+                        # Prefix-digest delta for the scheduler's routing
+                        # index (None unless cache-aware routing enabled
+                        # digest tracking via the allocation).
+                        "cache_digests": self._digest_heartbeat(eng),
                         "is_ready": eng is not None,
                         "load": eng.scheduler.num_requests() if eng else 0,
                         "layer_latency_ms": (
@@ -483,6 +513,10 @@ class WorkerNode:
                     },
                     timeout=10.0,
                 )
+                if reply and reply.get("digests_resync"):
+                    # The scheduler saw a sequence gap (its restart, a
+                    # dropped beat): ship a full snapshot next beat.
+                    self._digests_full_next = True
                 if reply and reply.get("rejoin"):
                     # Scheduler lost us (restart or heartbeat eviction):
                     # auto-rejoin (reference rpc_connection_handler.py:71-113).
@@ -509,6 +543,26 @@ class WorkerNode:
             except Exception as e:
                 logger.warning("heartbeat failed: %s", e)
             self._stop.wait(self.heartbeat_interval_s)
+
+    def _digest_heartbeat(self, eng) -> dict | None:
+        """Prefix-digest payload for one heartbeat: a delta normally, a
+        full snapshot after (re)build or a scheduler resync request.
+        Sequence-numbered per payload; a beat lost in transit leaves a
+        gap the scheduler answers with ``digests_resync``. None (zero
+        bytes, zero work) unless the allocation asked for digests."""
+        if eng is None or not self.engine_config.cache_digests:
+            return None
+        try:
+            payload = eng.cache_digest_payload(full=self._digests_full_next)
+        except Exception:  # pragma: no cover - telemetry never kills beats
+            logger.exception("digest payload failed")
+            return None
+        if payload is None:
+            return None
+        self._digests_full_next = False
+        self._digests_seq += 1
+        payload["seq"] = self._digests_seq
+        return payload
 
     # -- scheduler-less gossip (reference DHT announce + dijkstra routing,
     # p2p/server.py:569-626) -------------------------------------------------
@@ -1431,7 +1485,13 @@ class WorkerNode:
             # link's sender worker.
             self.sender.send(
                 self.scheduler_peer, "request_complete",
-                {"path": req.routing_table or [self.node_id]},
+                {
+                    "path": req.routing_table or [self.node_id],
+                    # Predicted-vs-actual routing telemetry: this head's
+                    # admission-time prefix-cache hit for the request.
+                    "rid": req.request_id,
+                    "cached_tokens": req.num_cached_tokens,
+                },
                 best_effort=True,
             )
         self._finished.put(req)

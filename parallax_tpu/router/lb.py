@@ -5,7 +5,11 @@ Capability parity: reference ``src/router/main.py:1-1056`` +
 of ``/cluster/status_json``, EMA TTFT/TPOT and inflight/error accounting
 per endpoint, round_robin / random / performance strategies (scored EMA +
 penalties, top-k with an exploration ratio), SSE passthrough with metric
-finalization, runtime config APIs and a throughput time series.
+finalization, runtime config APIs and a throughput time series. Beyond
+parity: a ``session_affinity`` strategy (rendezvous hashing on a stable
+session/user key, else the leading prompt bytes) keeps multi-turn chats
+on the swarm whose prefix cache already holds them, falling back to
+``performance`` scoring when the pinned endpoint is unhealthy.
 """
 
 from __future__ import annotations
@@ -68,7 +72,8 @@ class Endpoint:
 
 
 class Strategy:
-    def pick(self, endpoints: list[Endpoint]) -> Endpoint | None:
+    def pick(self, endpoints: list[Endpoint],
+             key: str | None = None) -> Endpoint | None:
         raise NotImplementedError
 
 
@@ -76,7 +81,7 @@ class RoundRobin(Strategy):
     def __init__(self):
         self._i = 0
 
-    def pick(self, endpoints):
+    def pick(self, endpoints, key=None):
         if not endpoints:
             return None
         self._i = (self._i + 1) % len(endpoints)
@@ -84,7 +89,7 @@ class RoundRobin(Strategy):
 
 
 class Random(Strategy):
-    def pick(self, endpoints):
+    def pick(self, endpoints, key=None):
         return random.choice(endpoints) if endpoints else None
 
 
@@ -95,7 +100,7 @@ class Performance(Strategy):
         self.top_k = top_k
         self.explore_ratio = explore_ratio
 
-    def pick(self, endpoints):
+    def pick(self, endpoints, key=None):
         if not endpoints:
             return None
         if random.random() < self.explore_ratio:
@@ -104,10 +109,49 @@ class Performance(Strategy):
         return random.choice(ranked[: max(1, self.top_k)])
 
 
+class SessionAffinity(Strategy):
+    """Consistent (rendezvous) hashing on a stable per-request key so
+    multi-turn chats keep returning to the same swarm — whose head
+    already holds the conversation's prefix cache — even at the HTTP
+    tier. The pin is computed over ALL registered endpoints (healthy or
+    not), so endpoints flapping in and out never remaps sessions that
+    were not pinned to them; when the pinned endpoint IS unhealthy, the
+    request falls back to ``performance`` scoring over the healthy set.
+    """
+
+    def __init__(self):
+        self._fallback = Performance()
+
+    @staticmethod
+    def _weight(key: str, url: str) -> int:
+        import hashlib
+
+        return int.from_bytes(
+            hashlib.blake2b(
+                f"{key}\x00{url}".encode(), digest_size=8
+            ).digest(),
+            "little",
+        )
+
+    def pick(self, endpoints, key=None, all_endpoints=None):
+        if not endpoints:
+            return None
+        if key is None:
+            return self._fallback.pick(endpoints)
+        pinned = max(
+            all_endpoints or endpoints,
+            key=lambda e: self._weight(key, e.url),
+        )
+        if pinned in endpoints:      # pinned endpoint is healthy
+            return pinned
+        return self._fallback.pick(endpoints)
+
+
 STRATEGIES = {
     "round_robin": RoundRobin,
     "random": Random,
     "performance": Performance,
+    "session_affinity": SessionAffinity,
 }
 
 
@@ -169,19 +213,57 @@ class Router:
 
     # -- proxy -------------------------------------------------------------
 
-    async def proxy(self, request: web.Request):
-        healthy = [e for e in self.endpoints if e.healthy]
-        ep = self.strategy.pick(healthy)
-        if ep is None:
-            return web.json_response(
-                {"error": {"message": "no healthy endpoints"}}, status=503
+    @staticmethod
+    def _affinity_key(request: web.Request, payload: dict) -> str | None:
+        """Stable per-session routing key: explicit session/user id
+        (header or body), else the leading prompt bytes — a multi-turn
+        chat's transcript grows append-only, so its head is stable."""
+        for header in ("x-session-id", "x-user-id"):
+            v = request.headers.get(header)
+            if v:
+                return v
+        for field in ("session_id", "user"):
+            v = payload.get(field)
+            if isinstance(v, str) and v:
+                return v
+        messages = payload.get("messages")
+        if isinstance(messages, list) and messages:
+            # The first USER message, not messages[0]: chat apps share
+            # one system prompt across every conversation, and keying on
+            # it would funnel ALL keyless traffic to a single endpoint.
+            # A conversation's first user turn is stable across its own
+            # follow-ups (transcripts grow append-only) yet distinct
+            # between users.
+            head = next(
+                (m for m in messages
+                 if isinstance(m, dict) and m.get("role") == "user"),
+                messages[0],
             )
+            return json.dumps(head, sort_keys=True)[:256]
+        prompt = payload.get("prompt")
+        if isinstance(prompt, str) and prompt:
+            return prompt[:256]
+        return None
+
+    async def proxy(self, request: web.Request):
         body = await request.read()
         try:
             payload = json.loads(body)
         except Exception:
             return web.json_response(
                 {"error": {"message": "invalid JSON"}}, status=400
+            )
+        healthy = [e for e in self.endpoints if e.healthy]
+        if isinstance(self.strategy, SessionAffinity):
+            ep = self.strategy.pick(
+                healthy, key=self._affinity_key(request, payload),
+                all_endpoints=list(self.endpoints),
+            )
+        else:
+            ep = self.strategy.pick(healthy)
+        if ep is None:
+            return web.json_response(
+                {"error": {"message": "no healthy endpoints"}}, status=503
             )
         ep.inflight += 1
         ep.total_requests += 1

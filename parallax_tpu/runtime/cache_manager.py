@@ -61,6 +61,7 @@ def make_cache_manager(
     linear_state: bool = False,
     on_slot_free=None,
     host_tier=None,
+    track_digests: bool = False,
 ):
     """CacheManager factory: the C++ manager (ONE ABI crossing per
     admit/grow/release — ``native.NativeCacheManager``) by default when
@@ -72,14 +73,24 @@ def make_cache_manager(
 
     A ``host_tier`` (:class:`runtime.host_cache.HostKVTier`) forces the
     Python manager: tier residency lives on radix nodes and in the
-    preemption bookkeeping, which the native structures do not model."""
+    preemption bookkeeping, which the native structures do not model.
+    ``track_digests`` (prefix-cache-aware routing) does too: the digest
+    delta log lives on the Python radix nodes — the native tree evicts
+    inside C with no per-node observability."""
     import os
 
     if use_native is None:
         use_native = (
             not os.environ.get("PARALLAX_TPU_NO_NATIVE")
             and host_tier is None
+            and not track_digests
         )
+    if track_digests and use_native:
+        logger.info(
+            "prefix-digest publishing requested: using the Python cache "
+            "manager (the native tree does not expose per-node evictions)"
+        )
+        use_native = False
     if host_tier is not None and not os.environ.get(
         "PARALLAX_TPU_NO_NATIVE"
     ):
@@ -108,6 +119,7 @@ def make_cache_manager(
         page_size, num_pages, enable_prefix_cache=enable_prefix_cache,
         max_model_len=max_model_len, linear_state=linear_state,
         on_slot_free=on_slot_free, host_tier=host_tier,
+        track_digests=track_digests,
     )
 
 
@@ -151,6 +163,7 @@ class CacheManager:
         linear_state: bool = False,
         on_slot_free=None,
         host_tier=None,
+        track_digests: bool = False,
     ):
         self.page_size = page_size
         self.num_pages = num_pages
@@ -175,6 +188,7 @@ class CacheManager:
                 (lambda h: host_tier.pool.free(h))
                 if host_tier is not None else None
             ),
+            track_digests=track_digests and enable_prefix_cache,
         )
         if host_tier is not None:
             host_tier.set_evict_cb(self.prefix_cache.drop_host_page)
@@ -451,3 +465,11 @@ class CacheManager:
 
     def reset_prefix_cache(self) -> None:
         self.allocator.free(self.prefix_cache.reset())
+
+    def digest_payload(self, full: bool = False) -> dict | None:
+        """Prefix-digest heartbeat payload for cache-aware routing (see
+        :meth:`RadixPageCache.digest_payload`); None when tracking is off
+        or the prefix cache is disabled."""
+        if not self.enable_prefix_cache:
+            return None
+        return self.prefix_cache.digest_payload(full=full)

@@ -135,6 +135,15 @@ class EngineConfig:
     # breakdown and logged. <= 0 disables slow capture (the timeline ring
     # still records).
     slow_request_ms: float = 30_000.0
+    # Prefix-cache-aware routing (scheduling/request_routing.py
+    # CacheAwareRouting): publish this stage's radix-tree block-hash
+    # digests through heartbeats so the global scheduler can route
+    # requests to the replica already holding their prefix. Off by
+    # default (zero per-insert work); workers enable it automatically
+    # when the scheduler's join/heartbeat reply asks for digests
+    # (``want_digests``). Forces the Python cache manager — the native
+    # tree evicts inside C with no per-node observability.
+    cache_digests: bool = False
 
 
 @dataclasses.dataclass
@@ -431,6 +440,7 @@ class StageEngine:
                 self._on_prefix_slot_free if self._needs_state else None
             ),
             host_tier=self.host_tier,
+            track_digests=self.cfg.cache_digests,
         )
         self.scheduler = Scheduler(
             self.cache,
@@ -860,6 +870,13 @@ class StageEngine:
         from parallax_tpu.utils.request_metrics import cache_stats_summary
 
         return cache_stats_summary(self.cache)
+
+    def cache_digest_payload(self, full: bool = False) -> dict | None:
+        """Prefix-digest delta/snapshot for cache-aware routing heartbeats
+        (None when ``cfg.cache_digests`` is off or the manager does not
+        track digests — e.g. the native manager)."""
+        fn = getattr(self.cache, "digest_payload", None)
+        return fn(full=full) if fn is not None else None
 
     # -- observability (obs/: registry series, tracing, flight) -----------
 

@@ -16,6 +16,7 @@ resume from pages alone.
 
 from __future__ import annotations
 
+import hashlib
 import time
 from typing import Callable
 
@@ -24,9 +25,47 @@ from parallax_tpu.utils import get_logger
 logger = get_logger(__name__)
 
 
+# -- block-hash digests (prefix-cache-aware routing) -----------------------
+#
+# Each full-page prefix of a token stream gets a compact rolling digest:
+# ``D_i = blake2b(D_{i-1} || tokens of page i)`` with ``D_0 = 0``. The
+# chain is stable across processes, so the scheduler-side head backend can
+# hash a prompt ONCE and compare against digests the workers' radix trees
+# published through heartbeats — digest membership implies the whole
+# prefix path exists on that worker (tree nodes always have ancestors).
+
+# Per-heartbeat delta bound: a delta larger than this collapses into a
+# full snapshot (one list instead of two, same cap below).
+MAX_DIGEST_DELTA = 4096
+# Hard cap on any published digest set. At 8 bytes/digest this bounds the
+# heartbeat payload to ~256 KiB worst case; trees are page-budget-bounded
+# in practice, so hitting the cap means a huge host tier — the truncated
+# tail only costs routing accuracy, never correctness.
+MAX_DIGEST_SNAPSHOT = 32768
+
+
+def hash_block(parent_digest: int, token_ids) -> int:
+    """Chained digest of one token block (63-bit int, msgpack-friendly)."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(parent_digest.to_bytes(8, "little"))
+    h.update(",".join(map(str, token_ids)).encode())
+    return int.from_bytes(h.digest(), "little") >> 1
+
+
+def block_hash_chain(token_ids, block_size: int) -> list[int]:
+    """Rolling digests for every full ``block_size`` prefix of the stream
+    (index ``i`` covers ``(i + 1) * block_size`` tokens)."""
+    out: list[int] = []
+    parent = 0
+    for start in range(0, len(token_ids) - block_size + 1, block_size):
+        parent = hash_block(parent, token_ids[start:start + block_size])
+        out.append(parent)
+    return out
+
+
 class _Node:
     __slots__ = ("key", "page_id", "children", "parent", "lock_ref",
-                 "last_access", "linear_slot", "host_handle")
+                 "last_access", "linear_slot", "host_handle", "digest")
 
     def __init__(self, key: tuple[int, ...], page_id: int, parent: "_Node | None"):
         self.key = key                      # the page's token ids
@@ -35,6 +74,9 @@ class _Node:
         self.parent = parent
         self.lock_ref = 0
         self.last_access = time.monotonic()
+        # Rolling block-hash digest of the prefix this node completes
+        # (None when digest tracking is off — the default).
+        self.digest: int | None = None
         # Linear-state snapshot at this node's token boundary (hybrid
         # models only; None = pages-only node).
         self.linear_slot: int | None = None
@@ -56,7 +98,8 @@ class RadixPageCache:
 
     def __init__(self, page_size: int, on_evict: Callable[[int], None] | None = None,
                  on_evict_slot: Callable[[int], None] | None = None,
-                 host_free: Callable[[int], None] | None = None):
+                 host_free: Callable[[int], None] | None = None,
+                 track_digests: bool = False):
         self.page_size = page_size
         self.on_evict = on_evict
         self.on_evict_slot = on_evict_slot
@@ -64,10 +107,18 @@ class RadixPageCache:
         # dropped from the tree (its pool page is no longer reachable).
         self.host_free = host_free
         self._root = _Node((), -1, None)
+        self._root.digest = 0
         self._num_pages = 0
         self._num_host_pages = 0
         # handle -> node, for the host pool's eviction callback.
         self._host_nodes: dict[int, _Node] = {}
+        # Prefix-digest tracking (cache-aware routing): chronological
+        # insert/drop log drained per heartbeat by ``digest_payload``.
+        # Off by default — zero per-insert work unless the scheduler's
+        # routing strategy asked for digests.
+        self.track_digests = track_digests
+        self._digest_log: list[tuple[bool, int]] = []   # (added, digest)
+        self._digest_cleared = False
 
     @property
     def num_cached_pages(self) -> int:
@@ -181,6 +232,9 @@ class RadixPageCache:
             child = node.children.get(key)
             if child is None:
                 child = _Node(key, page_ids[i], node)
+                if self.track_digests:
+                    child.digest = hash_block(node.digest or 0, key)
+                    self._digest_note(True, child.digest)
                 node.children[key] = child
                 self._num_pages += 1
             elif not child.on_device:
@@ -258,15 +312,33 @@ class RadixPageCache:
             h = handles[i] if handles else None
             if h is not None:
                 # Re-attach tier-tagged: the node's KV now lives in the
-                # host pool and future matches can still walk it.
+                # host pool and future matches can still walk it. The
+                # digest survives — host-resident prefixes still serve
+                # matches, so the routing index must keep seeing them.
                 leaf.parent.children[leaf.key] = leaf
                 leaf.page_id = -1
                 leaf.host_handle = h
                 self._host_nodes[h] = leaf
                 self._num_host_pages += 1
             else:
+                self._digest_drop(leaf)
                 self._drop_host_subtree(leaf)
         return freed
+
+    def _digest_drop(self, node: _Node) -> None:
+        """Log a node leaving the tree for the routing-digest delta."""
+        if self.track_digests and node.digest is not None:
+            self._digest_note(False, node.digest)
+
+    def _digest_note(self, added: bool, digest: int) -> None:
+        # Memory guard: if nothing drains the log (heartbeats stopped,
+        # scheduler unreachable) it must not grow with tree churn —
+        # collapse to "send a snapshot next time" instead.
+        if len(self._digest_log) >= 4 * MAX_DIGEST_DELTA:
+            self._digest_log.clear()
+            self._digest_cleared = True
+        if not self._digest_cleared:
+            self._digest_log.append((added, digest))
 
     def _drop_host_subtree(self, node: _Node) -> None:
         """Release the (all host-resident) descendants of a dropped
@@ -275,6 +347,7 @@ class RadixPageCache:
         while stack:
             n = stack.pop()
             stack.extend(n.children.values())
+            self._digest_drop(n)
             self._release_host(n)
             if n.linear_slot is not None and self.on_evict_slot:
                 self.on_evict_slot(n.linear_slot)
@@ -323,6 +396,7 @@ class RadixPageCache:
         while stack:
             n = stack.pop()
             stack.extend(n.children.values())
+            self._digest_drop(n)
             self._release_host(n)
             if n.linear_slot is not None and self.on_evict_slot:
                 self.on_evict_slot(n.linear_slot)
@@ -361,7 +435,71 @@ class RadixPageCache:
                 self.on_evict_slot(n.linear_slot)
             stack.extend(n.children.values())
         self._root = _Node((), -1, None)
+        self._root.digest = 0
         self._num_pages = 0
         self._num_host_pages = 0
         self._host_nodes.clear()
+        if self.track_digests:
+            self._digest_log.clear()
+            self._digest_cleared = True
         return pages
+
+    # -- routing digests ---------------------------------------------------
+
+    def prefix_digests(self) -> list[int]:
+        """Every cached prefix's rolling digest (device + host tiers),
+        capped at ``MAX_DIGEST_SNAPSHOT`` (warmest subtrees first)."""
+        from collections import deque
+
+        out: list[int] = []
+        queue = deque(sorted(
+            self._root.children.values(),
+            key=lambda n: n.last_access, reverse=True,
+        ))
+        while queue and len(out) < MAX_DIGEST_SNAPSHOT:
+            n = queue.popleft()
+            if n.digest is not None:
+                out.append(n.digest)
+            queue.extend(n.children.values())
+        return out
+
+    def digest_payload(self, full: bool = False) -> dict | None:
+        """Heartbeat payload for the scheduler's routing index: either a
+        full snapshot (``{"block", "full": [...]}``) or an incremental
+        delta (``{"block", "added": [...], "removed": [...]}``). Drains
+        the log. None when digest tracking is off. Bounded: deltas larger
+        than ``MAX_DIGEST_DELTA`` collapse into a (capped) snapshot."""
+        if not self.track_digests:
+            return None
+        if (
+            full or self._digest_cleared
+            or len(self._digest_log) > MAX_DIGEST_DELTA
+        ):
+            # Swap the log out BEFORE walking: tree mutations racing the
+            # walk land in the fresh log and ship as the next delta
+            # (idempotent against the snapshot). If the walk raises, arm
+            # a re-snapshot so the discarded log cannot silently diverge
+            # the scheduler mirror.
+            self._digest_log = []
+            self._digest_cleared = False
+            try:
+                snapshot = self.prefix_digests()
+            except Exception:
+                self._digest_cleared = True
+                raise
+            return {"block": self.page_size, "full": snapshot}
+        # Swap atomically instead of iterate-then-clear: an entry the
+        # step thread appends mid-iteration must land in the NEXT delta,
+        # not vanish (seq would not gap, so the scheduler could never
+        # tell the mirror diverged).
+        log, self._digest_log = self._digest_log, []
+        # Last action per digest wins: an add-then-drop-then-add within
+        # one heartbeat must land in exactly one of the two lists.
+        final: dict[int, bool] = {}
+        for added, digest in log:
+            final[digest] = added
+        return {
+            "block": self.page_size,
+            "added": [d for d, a in final.items() if a],
+            "removed": [d for d, a in final.items() if not a],
+        }

@@ -8,15 +8,24 @@ end-to-end pipelines, and routes each request along a node path (phase 2,
 (``scheduler``). Pure host-side Python — nothing here touches a device.
 """
 
-from parallax_tpu.scheduling.node import Node, RooflinePerformanceModel
+from parallax_tpu.scheduling.node import CacheIndex, Node, RooflinePerformanceModel
 from parallax_tpu.scheduling.node_management import NodeManager, NodeState, Pipeline
+from parallax_tpu.scheduling.request_routing import (
+    CacheAwareRouting,
+    RequestMeta,
+    make_router,
+)
 from parallax_tpu.scheduling.scheduler import GlobalScheduler
 
 __all__ = [
+    "CacheAwareRouting",
+    "CacheIndex",
     "Node",
+    "RequestMeta",
     "RooflinePerformanceModel",
     "NodeManager",
     "NodeState",
     "Pipeline",
     "GlobalScheduler",
+    "make_router",
 ]

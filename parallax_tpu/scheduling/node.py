@@ -9,7 +9,9 @@ measured-latency override; RTT cache).
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
+from collections import OrderedDict
 
 from parallax_tpu.config import ModelConfig
 from parallax_tpu.utils.hw import HardwareInfo
@@ -69,6 +71,119 @@ class RooflinePerformanceModel:
         return max(1, int(usable // per_layer))
 
 
+class CacheIndex:
+    """Scheduler-side mirror of one head node's prefix-cache digests.
+
+    Fed by heartbeat deltas (``RadixPageCache.digest_payload``), bounded
+    LRU, staleness-decayed. Digest membership implies the whole prefix
+    path exists on the worker (tree nodes always have ancestors), so the
+    deepest chain hit IS the predicted cached page count. Rebuilt from a
+    full snapshot whenever the delta sequence breaks (node rejoin, engine
+    reload, scheduler restart) — the worker is asked for a resync via the
+    next heartbeat reply.
+    """
+
+    def __init__(self, max_entries: int = 65536, stale_after_s: float = 30.0):
+        self.max_entries = max_entries
+        self.stale_after_s = stale_after_s
+        # Digest set with LRU ordering (values unused): the depth is the
+        # querying chain's own index, so membership is all that matters.
+        # The scheduler's event thread applies deltas while the dispatch
+        # thread predicts — every entry access takes the lock.
+        self._entries: OrderedDict[int, int] = OrderedDict()
+        self._lock = threading.Lock()
+        self.block = 0           # the worker's page size (digest granularity)
+        self.seq = -1            # last applied heartbeat sequence number
+        self.updated_at = 0.0    # monotonic time of the last apply
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.block = 0
+            self.seq = -1
+
+    def apply(self, payload: dict) -> bool:
+        """Merge one heartbeat digest payload. Returns True when the
+        payload could not be applied in sequence and the worker must be
+        asked for a full snapshot (``digests_resync``)."""
+        seq = payload.get("seq")
+        block = payload.get("block")
+        if not isinstance(seq, int) or not isinstance(block, int) or block <= 0:
+            return True
+        full = payload.get("full")
+        if full is not None:
+            with self._lock:
+                self._entries = OrderedDict((int(d), 0) for d in full)
+                self.block = block
+                self.seq = seq
+                self.updated_at = time.monotonic()
+                self._trim()
+            return False
+        if seq != self.seq + 1 or block != self.block:
+            # Missed a delta (dropped heartbeat, worker restart) or the
+            # worker changed page size: the mirror may be arbitrarily
+            # wrong — drop it and request a snapshot rather than route
+            # on fiction.
+            self.clear()
+            return True
+        with self._lock:
+            for d in payload.get("removed") or ():
+                self._entries.pop(int(d), None)
+            for d in payload.get("added") or ():
+                self._entries[int(d)] = 0
+                self._entries.move_to_end(int(d))
+            self.seq = seq
+            self.updated_at = time.monotonic()
+            self._trim()
+        return False
+
+    def _trim(self) -> None:
+        # Caller holds the lock.
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
+
+    def confidence(self) -> float:
+        """1.0 while heartbeats flow (anything fresher than half the
+        staleness horizon), then decaying linearly to 0.0 at
+        ``stale_after_s`` — a worker that stopped publishing (death,
+        reload, digests turned off) must stop attracting traffic on the
+        strength of a stale mirror. Step-shaped so steady-state
+        predictions are EXACT: the predicted-vs-actual accuracy counters
+        measure mirror fidelity, and a fractional decay on a live index
+        would pollute them with phantom error."""
+        with self._lock:
+            if not self._entries:
+                return 0.0
+        age = time.monotonic() - self.updated_at
+        if age <= self.stale_after_s / 2:
+            return 1.0
+        return max(0.0, 2.0 * (1.0 - age / self.stale_after_s))
+
+    def predict_cached_tokens(self, chain: list[int], block: int,
+                              num_prompt_tokens: int) -> int:
+        """Predicted prefix-cache hit (tokens) for a prompt whose rolling
+        block-hash chain is ``chain`` at granularity ``block``. Walks the
+        chain deepest-first; the first digest present in the mirror gives
+        the hit depth. Staleness-decayed (see :meth:`confidence`)."""
+        if not chain or block != self.block:
+            return 0
+        # The engine always recomputes >= 1 prompt token, so a full-prompt
+        # match is capped one page short (mirrors allocate_for_prompt).
+        max_pages = min(len(chain), (num_prompt_tokens - 1) // block)
+        hit = 0
+        with self._lock:
+            for depth in range(max_pages, 0, -1):
+                if chain[depth - 1] in self._entries:
+                    self._entries.move_to_end(chain[depth - 1])
+                    hit = depth * block
+                    break
+        return round(hit * self.confidence()) if hit else 0
+
+
 @dataclasses.dataclass
 class Node:
     """A swarm member as the global scheduler sees it."""
@@ -111,6 +226,13 @@ class Node:
     # {metric: {labels: {bounds, counts, sum, count}}}) — merged across
     # nodes into cluster-wide percentiles in /cluster/status.
     metrics: dict | None = None
+    # Prefix-digest mirror for cache-aware routing (fed by heartbeat
+    # ``cache_digests`` payloads; only head-stage digests matter — the
+    # head's radix cache is what admission matches against).
+    cache_index: CacheIndex = dataclasses.field(default_factory=CacheIndex)
+    # Set when a digest delta arrived out of sequence: the next heartbeat
+    # reply asks the worker for a full snapshot.
+    digests_need_resync: bool = False
 
     def __post_init__(self):
         self.perf = RooflinePerformanceModel(self.hardware, self.model)

@@ -8,8 +8,49 @@ over whatever layer ranges the active nodes currently announce.
 
 from __future__ import annotations
 
+import dataclasses
+
 from parallax_tpu.scheduling.node import Node
 from parallax_tpu.scheduling.node_management import NodeManager, Pipeline
+
+
+@dataclasses.dataclass
+class RequestMeta:
+    """Per-request routing context, built once at dispatch.
+
+    Carries the tokenized prompt so cache-aware routing can hash its
+    block chain exactly once (memoized per block size — workers may run
+    different page sizes) and compare it against the digests each head
+    node's radix tree published through heartbeats.
+    """
+
+    request_id: str
+    prompt_ids: list[int] | None = None
+    # LoRA requests never produce digest matches: workers XOR-salt the
+    # radix namespace with a per-process random salt per adapter, so the
+    # head-side chain cannot be reproduced here — skip the prediction.
+    lora_id: str | None = None
+    # Filled by the router at dispatch; compared against the actual hit
+    # the head engine reports on request_complete.
+    predicted_cached_tokens: int = 0
+    _chains: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def num_prompt_tokens(self) -> int:
+        return len(self.prompt_ids or ())
+
+    def chain(self, block_size: int) -> list[int]:
+        """Rolling block-hash chain of the prompt at ``block_size``."""
+        if self.prompt_ids is None or self.lora_id is not None:
+            return []
+        got = self._chains.get(block_size)
+        if got is None:
+            from parallax_tpu.runtime.radix_cache import block_hash_chain
+
+            got = self._chains[block_size] = block_hash_chain(
+                self.prompt_ids, block_size
+            )
+        return got
 
 
 class RoutingStrategy:
@@ -17,11 +58,21 @@ class RoutingStrategy:
     # not members of a registered pipeline (the scheduler's dynamic-join
     # gate reads this instead of matching router names).
     supports_partial_replicas = False
+    # Whether workers should publish prefix digests through heartbeats
+    # (only CacheAwareRouting reads them; everything else keeps the
+    # heartbeat payload digest-free — zero cost when the strategy is off).
+    wants_digests = False
 
     def __init__(self, manager: NodeManager):
         self.manager = manager
+        # Routing-decision counters ({chosen_by_cache, chosen_by_load,
+        # fallback_imbalance, ...}) and per-pipeline dispatch counts —
+        # surfaced in /cluster/status and mirrored into the metrics
+        # registry for /metrics.
+        self.decision_counters: dict[str, int] = {}
+        self.pipeline_dispatches: dict[int, int] = {}
 
-    def find_path(self) -> list[Node] | None:
+    def find_path(self, meta: RequestMeta | None = None) -> list[Node] | None:
         raise NotImplementedError
 
     def on_dispatch(self, path: list[Node]) -> None:
@@ -34,6 +85,54 @@ class RoutingStrategy:
             if n is not None:
                 n.load = max(0, n.load - 1)
 
+    # -- decision telemetry ------------------------------------------------
+
+    def _count_decision(self, reason: str) -> None:
+        self.decision_counters[reason] = (
+            self.decision_counters.get(reason, 0) + 1
+        )
+        try:
+            from parallax_tpu.obs.registry import get_registry
+
+            get_registry().counter(
+                "parallax_routing_decisions_total",
+                "Routing decisions per strategy reason",
+                labelnames=("reason",),
+            ).labels(reason=reason).inc()
+        except Exception:  # pragma: no cover - metrics never break routing
+            pass
+
+    def _count_pipeline(self, pipeline_id: int) -> None:
+        self.pipeline_dispatches[pipeline_id] = (
+            self.pipeline_dispatches.get(pipeline_id, 0) + 1
+        )
+        try:
+            from parallax_tpu.obs.registry import get_registry
+
+            get_registry().counter(
+                "parallax_routing_dispatch_total",
+                "Requests dispatched per registered pipeline",
+                labelnames=("pipeline",),
+            ).labels(pipeline=str(pipeline_id)).inc()
+        except Exception:  # pragma: no cover - metrics never break routing
+            pass
+
+
+def eligible_pipelines(manager: NodeManager) -> list[Pipeline]:
+    """Registered pipelines a request can be dispatched to right now:
+    every stage ready, weights at the latest refit version, admission
+    capacity available (the shared gate of RR and cache-aware routing)."""
+    pipelines = manager.pipelines
+    if not pipelines:
+        return []
+    latest_refit = max(p.min_refit_version() for p in pipelines)
+    return [
+        p for p in pipelines
+        if p.is_ready()
+        and p.min_refit_version() >= latest_refit
+        and not any(n.load >= n.max_concurrent_requests() for n in p.nodes)
+    ]
+
 
 class RoundRobinRouting(RoutingStrategy):
     """RR cursor over registered node-disjoint pipelines (reference
@@ -43,24 +142,93 @@ class RoundRobinRouting(RoutingStrategy):
         super().__init__(manager)
         self._cursor = 0
 
-    def find_path(self) -> list[Node] | None:
+    def find_path(self, meta: RequestMeta | None = None) -> list[Node] | None:
         pipelines = self.manager.pipelines
         if not pipelines:
             return None
-        latest_refit = max(p.min_refit_version() for p in pipelines)
+        ok = {p.pipeline_id for p in eligible_pipelines(self.manager)}
         for off in range(len(pipelines)):
             p = pipelines[(self._cursor + off) % len(pipelines)]
-            if not p.is_ready():
-                continue
-            if p.min_refit_version() < latest_refit:
-                continue  # stale weights: skip until refit completes
-            if any(
-                n.load >= n.max_concurrent_requests() for n in p.nodes
-            ):
+            if p.pipeline_id not in ok:
                 continue
             self._cursor = (self._cursor + off + 1) % len(pipelines)
+            self._count_pipeline(p.pipeline_id)
             return p.nodes
         return None
+
+
+class CacheAwareRouting(RoutingStrategy):
+    """Prefix-cache-aware pipeline choice (SGLang cache-aware router /
+    Mooncake KV-centric scheduling): score every eligible pipeline by
+
+        ``alpha * predicted_uncached_tokens + beta * head_load``
+
+    where the prediction walks the request's block-hash chain against the
+    head node's heartbeat-fed :class:`CacheIndex`. An imbalance guard
+    falls back to least-loaded dispatch when the in-flight spread across
+    eligible pipelines exceeds ``imbalance_threshold`` — a hot shared
+    prefix must not starve one replica while the others idle. Requests
+    without routing metadata (no prompt, LoRA-namespaced, digests not yet
+    flowing) degrade to least-loaded.
+    """
+
+    wants_digests = True
+
+    def __init__(self, manager: NodeManager, alpha: float = 1.0,
+                 beta: float = 256.0, imbalance_threshold: int = 8):
+        super().__init__(manager)
+        # alpha is per uncached prompt token, beta per in-flight request:
+        # the defaults price one queued request like 256 uncached tokens
+        # (roughly one prefill chunk), so a deep prefix hit wins against
+        # a modest load gap but never against a drained replica.
+        self.alpha = alpha
+        self.beta = beta
+        self.imbalance_threshold = imbalance_threshold
+        self._cursor = 0   # tie-break rotation so equal scores spread
+
+    def find_path(self, meta: RequestMeta | None = None) -> list[Node] | None:
+        candidates = eligible_pipelines(self.manager)
+        if not candidates:
+            return None
+        self._cursor += 1
+        loads = [p.nodes[0].load for p in candidates]
+        if max(loads) - min(loads) > self.imbalance_threshold:
+            chosen = candidates[loads.index(min(loads))]
+            self._count_decision("fallback_imbalance")
+            return self._dispatch(chosen, 0, meta)
+
+        best, best_score, best_hit = None, None, 0
+        for i, p in enumerate(candidates):
+            head = p.nodes[0]
+            hit = 0
+            if meta is not None and meta.prompt_ids:
+                index = head.cache_index
+                if index.block > 0:
+                    hit = index.predict_cached_tokens(
+                        meta.chain(index.block), index.block,
+                        meta.num_prompt_tokens,
+                    )
+            uncached = (meta.num_prompt_tokens if meta else 0) - hit
+            score = (
+                self.alpha * uncached + self.beta * head.load,
+                # Rotating tie-break: equal scores (cold cluster, no
+                # meta) must spread like round-robin, not pile onto the
+                # first pipeline.
+                (i + self._cursor) % len(candidates),
+            )
+            if best_score is None or score < best_score:
+                best, best_score, best_hit = p, score, hit
+        self._count_decision(
+            "chosen_by_cache" if best_hit > 0 else "chosen_by_load"
+        )
+        return self._dispatch(best, best_hit, meta)
+
+    def _dispatch(self, pipeline: Pipeline, predicted_hit: int,
+                  meta: RequestMeta | None) -> list[Node]:
+        if meta is not None:
+            meta.predicted_cached_tokens = predicted_hit
+        self._count_pipeline(pipeline.pipeline_id)
+        return pipeline.nodes
 
 
 class DPRouting(RoutingStrategy):
@@ -70,7 +238,7 @@ class DPRouting(RoutingStrategy):
 
     supports_partial_replicas = True
 
-    def find_path(self) -> list[Node] | None:
+    def find_path(self, meta: RequestMeta | None = None) -> list[Node] | None:
         nodes = [n for n in self.manager.nodes() if n.has_allocation and n.is_ready]
         if not nodes:
             return None
@@ -157,7 +325,7 @@ class RandomizedRouting(RoutingStrategy):
         dfs(0, [])
         return paths
 
-    def find_path(self) -> list[Node] | None:
+    def find_path(self, meta: RequestMeta | None = None) -> list[Node] | None:
         paths = self._discover()
         if not paths:
             return None
@@ -249,11 +417,13 @@ def find_turning_points(
     return turning
 
 
-def make_router(name: str, manager: NodeManager) -> RoutingStrategy:
+def make_router(name: str, manager: NodeManager, **kwargs) -> RoutingStrategy:
     if name in ("rr", "round_robin"):
         return RoundRobinRouting(manager)
     if name in ("dp", "dynamic"):
         return DPRouting(manager)
     if name in ("random", "randomized"):
         return RandomizedRouting(manager)
+    if name in ("cache_aware", "cache-aware", "prefix"):
+        return CacheAwareRouting(manager, **kwargs)
     raise ValueError(f"unknown routing strategy {name!r}")

@@ -25,7 +25,11 @@ from parallax_tpu.scheduling.layer_allocation import (
 )
 from parallax_tpu.scheduling.node import Node
 from parallax_tpu.scheduling.node_management import NodeManager, NodeState, Pipeline
-from parallax_tpu.scheduling.request_routing import RoutingStrategy, make_router
+from parallax_tpu.scheduling.request_routing import (
+    RequestMeta,
+    RoutingStrategy,
+    make_router,
+)
 from parallax_tpu.utils import get_logger
 from parallax_tpu.utils.hw import HardwareInfo
 
@@ -35,6 +39,9 @@ logger = get_logger(__name__)
 @dataclasses.dataclass
 class PendingRequest:
     request_id: str
+    # Routing context (tokenized prompt for prefix-digest matching);
+    # None keeps the pre-meta behavior for internal callers.
+    meta: "RequestMeta | None" = None
     enqueue_time: float = dataclasses.field(default_factory=time.monotonic)
     # The dispatcher retries routing until this deadline before giving up
     # (reference RequestHandler retry ladder, request_handler.py:100-245).
@@ -59,6 +66,7 @@ class GlobalScheduler:
         allocator: str = "greedy",
         routing: str = "rr",
         heartbeat_timeout_s: float = 30.0,
+        routing_kwargs: dict | None = None,
     ):
         self.model = model
         self.min_nodes = min_nodes_bootstrapping
@@ -67,9 +75,26 @@ class GlobalScheduler:
             GreedyLayerAllocator if allocator == "greedy" else DPLayerAllocator
         )
         self.allocator = alloc_cls(model.num_hidden_layers)
-        self.router: RoutingStrategy = make_router(routing, self.manager)
+        self.routing_name = routing
+        self.routing_kwargs = dict(routing_kwargs or {})
+        self.router: RoutingStrategy = make_router(
+            routing, self.manager, **self.routing_kwargs
+        )
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.bootstrapped = threading.Event()
+        # rid -> (predicted cached tokens, prompt tokens): dispatch-time
+        # predictions awaiting the head's request_complete actuals
+        # (bounded — an abandoned request must not leak an entry).
+        from collections import OrderedDict
+
+        self._predictions: OrderedDict[str, tuple[int, int]] = OrderedDict()
+        self._predictions_cap = 4096
+        # Aggregate predicted-vs-actual hit telemetry (cluster_status
+        # "routing" section + the metrics registry).
+        self.routing_accuracy = {
+            "requests": 0, "predicted_tokens": 0, "actual_tokens": 0,
+            "abs_error_tokens": 0,
+        }
 
         self._events: queue.Queue = queue.Queue()
         self._requests: queue.Queue[PendingRequest] = queue.Queue()
@@ -106,15 +131,18 @@ class GlobalScheduler:
         cache_stats: dict | None = None,
         transport: dict | None = None,
         metrics: dict | None = None,
+        cache_digests: dict | None = None,
     ) -> None:
         self._events.put(
             ("update", node_id, layer_latency_ms, load, rtt_s, is_ready,
              refit_version, lora_adapters, step_timing, cache_stats,
-             transport, metrics)
+             transport, metrics, cache_digests)
         )
 
-    def receive_request(self, request_id: str) -> PendingRequest:
-        pr = PendingRequest(request_id)
+    def receive_request(
+        self, request_id: str, meta: RequestMeta | None = None
+    ) -> PendingRequest:
+        pr = PendingRequest(request_id, meta=meta)
         self._requests.put(pr)
         return pr
 
@@ -123,12 +151,28 @@ class GlobalScheduler:
         node = self.manager.get(node_id)
         if node is None or not node.has_allocation:
             return None
-        return {
+        alloc = {
             "start_layer": node.start_layer,
             "end_layer": node.end_layer,
             "model_name": self.model.model_name,
             "refit_version": self.refit_version,
         }
+        if self.router.wants_digests:
+            # Cache-aware routing: workers build their engine with digest
+            # tracking on (the flag rides the allocation into the reload)
+            # and publish delta payloads on subsequent heartbeats.
+            alloc["want_digests"] = True
+        return alloc
+
+    def digests_resync_requested(self, node_id: str) -> bool:
+        """Consume a node's pending digest-resync flag (set when a delta
+        arrived out of sequence); the heartbeat reply relays it so the
+        worker's next beat carries a full snapshot."""
+        node = self.manager.get(node_id)
+        if node is None or not node.digests_need_resync:
+            return False
+        node.digests_need_resync = False
+        return True
 
     # -- lifecycle --------------------------------------------------------
 
@@ -183,6 +227,7 @@ class GlobalScheduler:
              cache_stats, *rest) = ev
             transport = rest[0] if rest else None
             metrics = rest[1] if len(rest) > 1 else None
+            cache_digests = rest[2] if len(rest) > 2 else None
             node = self.manager.get(node_id)
             if node is None:
                 return
@@ -207,6 +252,9 @@ class GlobalScheduler:
                 node.transport = transport
             if metrics is not None:
                 node.metrics = metrics
+            if cache_digests is not None:
+                if node.cache_index.apply(cache_digests):
+                    node.digests_need_resync = True
 
     def _try_bootstrap_or_extend(self) -> None:
         standby = self.manager.nodes(NodeState.STANDBY)
@@ -331,10 +379,23 @@ class GlobalScheduler:
             if pr.cancelled:
                 pr.event.set()
                 continue
-            path = self.router.find_path()
+            try:
+                path = self.router.find_path(pr.meta)
+            except Exception:
+                # A router bug must not kill the dispatch thread — every
+                # later request would silently time out to 503. Treat as
+                # "no path now" and let the retry ladder run.
+                logger.exception("find_path failed for %s", pr.request_id)
+                path = None
             if path is not None:
                 self.router.on_dispatch(path)
                 pr.path_ids = [n.node_id for n in path]
+                if pr.meta is not None and pr.meta.prompt_ids:
+                    self._record_prediction(
+                        pr.request_id,
+                        pr.meta.predicted_cached_tokens,
+                        pr.meta.num_prompt_tokens,
+                    )
                 pr.event.set()
             elif time.monotonic() < pr.deadline:
                 # No serviceable pipeline right now (bootstrap in flight,
@@ -344,8 +405,47 @@ class GlobalScheduler:
             else:
                 pr.event.set()
 
-    def complete_request(self, path_ids: list[str]) -> None:
+    def _record_prediction(self, request_id: str, predicted: int,
+                           prompt_tokens: int) -> None:
+        with self._lock:
+            self._predictions[request_id] = (predicted, prompt_tokens)
+            while len(self._predictions) > self._predictions_cap:
+                self._predictions.popitem(last=False)
+
+    def complete_request(self, path_ids: list[str],
+                         request_id: str | None = None,
+                         cached_tokens: int | None = None) -> None:
         self.router.on_complete(path_ids)
+        if request_id is None:
+            return
+        # Predicted-vs-actual prefix-hit telemetry: the head engine
+        # reports its real admission-time hit on request_complete; fold
+        # it against the dispatch-time prediction.
+        with self._lock:
+            pred = self._predictions.pop(request_id, None)
+            if pred is None or cached_tokens is None:
+                return
+            predicted, _prompt_tokens = pred
+            acc = self.routing_accuracy
+            acc["requests"] += 1
+            acc["predicted_tokens"] += predicted
+            acc["actual_tokens"] += int(cached_tokens)
+            acc["abs_error_tokens"] += abs(predicted - int(cached_tokens))
+        try:
+            from parallax_tpu.obs.registry import get_registry
+
+            reg = get_registry()
+            reg.counter(
+                "parallax_routing_predicted_cached_tokens_total",
+                "Dispatch-time predicted prefix-cache hit tokens",
+            ).inc(predicted)
+            reg.counter(
+                "parallax_routing_actual_cached_tokens_total",
+                "Admission-time actual prefix-cache hit tokens "
+                "(head engine, via request_complete)",
+            ).inc(int(cached_tokens))
+        except Exception:  # pragma: no cover - metrics never break serving
+            pass
 
     # -- weight refit ------------------------------------------------------
 
@@ -379,6 +479,21 @@ class GlobalScheduler:
             report["metrics"] = summarize_snapshots(
                 merge_histogram_snapshots(node_snaps)
             )
+        # Routing telemetry: strategy, per-strategy decision counters
+        # (chosen_by_cache / chosen_by_load / fallback_imbalance for the
+        # cache-aware router), per-pipeline dispatch counts and the
+        # predicted-vs-actual prefix-hit aggregate.
+        with self._lock:
+            accuracy = dict(self.routing_accuracy)
+        report["routing"] = {
+            "strategy": self.routing_name,
+            "decisions": dict(self.router.decision_counters),
+            "pipeline_dispatches": {
+                str(pid): n
+                for pid, n in self.router.pipeline_dispatches.items()
+            },
+            "predicted_vs_actual": accuracy,
+        }
         report["pipelines"] = [
             {
                 "id": p.pipeline_id,
@@ -403,6 +518,13 @@ class GlobalScheduler:
                         # (node_join capability) — which links can
                         # negotiate bf16/fp8 compression.
                         "wire_formats": list(n.wire_formats),
+                        # Scheduler-side prefix-digest mirror (cache-
+                        # aware routing): how many cached prefixes this
+                        # head advertises, at what block granularity.
+                        "cache_index": {
+                            "digests": len(n.cache_index),
+                            "block": n.cache_index.block,
+                        } if len(n.cache_index) else None,
                     }
                     for n in p.nodes
                 ],

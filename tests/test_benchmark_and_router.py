@@ -249,6 +249,103 @@ class TestRouterStrategies:
         assert 0.0 < ep.ema_ttft_s < 1.0
 
 
+class TestSessionAffinity:
+    def make_eps(self, n=3):
+        return [
+            Endpoint(url=f"http://ep{i}", healthy=True) for i in range(n)
+        ]
+
+    def test_same_key_pins_same_endpoint(self):
+        from parallax_tpu.router.lb import SessionAffinity
+
+        eps = self.make_eps()
+        strat = SessionAffinity()
+        picks = {strat.pick(eps, key="session-42").url for _ in range(10)}
+        assert len(picks) == 1
+
+    def test_keys_spread_across_endpoints(self):
+        from parallax_tpu.router.lb import SessionAffinity
+
+        eps = self.make_eps()
+        strat = SessionAffinity()
+        picks = {
+            strat.pick(eps, key=f"user-{i}").url for i in range(64)
+        }
+        assert picks == {e.url for e in eps}
+
+    def test_unhealthy_pin_falls_back_to_performance(self):
+        from parallax_tpu.router.lb import SessionAffinity
+
+        eps = self.make_eps()
+        strat = SessionAffinity()
+        strat._fallback.explore_ratio = 0.0
+        strat._fallback.top_k = 1
+        pinned = strat.pick(eps, key="sticky")
+        pinned.healthy = False
+        healthy = [e for e in eps if e.healthy]
+        best = healthy[0]
+        best.ema_ttft_s, best.ema_tpot_s = 0.01, 0.001
+        got = strat.pick(healthy, key="sticky", all_endpoints=eps)
+        assert got is not pinned
+        assert got is best   # performance scoring, not a re-hash
+
+    def test_flapping_other_endpoint_keeps_pin(self):
+        # The pin hashes over ALL registered endpoints, so an unrelated
+        # endpoint going unhealthy must not remap this session.
+        from parallax_tpu.router.lb import SessionAffinity
+
+        eps = self.make_eps()
+        strat = SessionAffinity()
+        pinned = strat.pick(eps, key="stable")
+        other = next(e for e in eps if e is not pinned)
+        other.healthy = False
+        healthy = [e for e in eps if e.healthy]
+        assert strat.pick(healthy, key="stable",
+                          all_endpoints=eps) is pinned
+
+    def test_no_key_uses_performance(self):
+        from parallax_tpu.router.lb import SessionAffinity
+
+        eps = self.make_eps()
+        eps[1].ema_ttft_s, eps[1].ema_tpot_s = 0.01, 0.001
+        strat = SessionAffinity()
+        strat._fallback.explore_ratio = 0.0
+        strat._fallback.top_k = 1
+        assert strat.pick(eps, key=None) is eps[1]
+
+    def test_affinity_key_extraction(self):
+        from parallax_tpu.router.lb import Router
+
+        class FakeReq:
+            def __init__(self, headers):
+                self.headers = headers
+
+        key = Router._affinity_key
+        assert key(FakeReq({"x-session-id": "s1"}), {}) == "s1"
+        assert key(FakeReq({}), {"user": "u9"}) == "u9"
+        # Multi-turn chat: the first USER message is the stable head of
+        # the transcript...
+        msgs = [{"role": "user", "content": "hello"}]
+        k1 = key(FakeReq({}), {"messages": msgs})
+        k2 = key(FakeReq({}), {"messages": msgs + [
+            {"role": "assistant", "content": "hi"}
+        ]})
+        assert k1 == k2
+        # ...and a SHARED system prompt must not collapse every user's
+        # conversations onto one key (that would funnel all keyless
+        # traffic to a single endpoint).
+        sys_msg = {"role": "system", "content": "you are helpful"}
+        ka = key(FakeReq({}), {"messages": [
+            sys_msg, {"role": "user", "content": "alice turn"}
+        ]})
+        kb = key(FakeReq({}), {"messages": [
+            sys_msg, {"role": "user", "content": "bob turn"}
+        ]})
+        assert ka != kb
+        assert key(FakeReq({}), {"prompt": "abc"}) == "abc"
+        assert key(FakeReq({}), {}) is None
+
+
 def test_router_proxies_to_live_backend():
     fe, runner = tiny_frontend()
 

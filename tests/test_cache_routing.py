@@ -1,0 +1,477 @@
+"""Prefix-cache-aware request routing across replica pipelines.
+
+Covers the digest plumbing end to end: rolling block-hash chains on the
+radix tree (insert/evict deltas, reset, snapshot collapse), the
+scheduler-side CacheIndex (sequencing, LRU bound, staleness decay), the
+CacheAwareRouting strategy (cache scoring, imbalance guard, decision
+counters), routing under churn (leave/rejoin invalidation, dispatch onto
+survivors, resync handshake), and the placement-only contract: token
+streams are bit-identical whichever routing strategy placed them
+(greedy + seeded, sync + overlap).
+"""
+
+import dataclasses
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from parallax_tpu.config import normalize_config
+from parallax_tpu.runtime.radix_cache import (
+    RadixPageCache,
+    block_hash_chain,
+)
+from parallax_tpu.scheduling import GlobalScheduler, NodeManager, Pipeline
+from parallax_tpu.scheduling.node import CacheIndex, Node
+from parallax_tpu.scheduling.request_routing import (
+    CacheAwareRouting,
+    RequestMeta,
+    make_router,
+)
+from parallax_tpu.utils.hw import HardwareInfo
+
+MODEL = normalize_config(dict(
+    architectures=["Qwen2ForCausalLM"],
+    hidden_size=3584, num_hidden_layers=28, num_attention_heads=28,
+    num_key_value_heads=4, intermediate_size=18944, vocab_size=152064,
+))
+
+V5E_HOST = HardwareInfo("v5e", 4, 197.0, 16.0, 819.0, 186.0)
+
+PAGE = 4
+
+
+def make_node(nid, hw=V5E_HOST, ready=True):
+    n = Node(node_id=nid, hardware=hw, model=MODEL)
+    n.is_ready = ready
+    return n
+
+
+def replica_manager(num=2):
+    """Two single-node pipelines, each covering the full model."""
+    mgr = NodeManager(28)
+    pipes = []
+    for i in range(num):
+        n = make_node(f"r{i}")
+        n.set_layers(0, 28)
+        mgr.add(n)
+        pipes.append(Pipeline(nodes=[n]))
+    mgr.register_pipelines(pipes)
+    return mgr
+
+
+def feed_index(node, chain, seq=1, block=PAGE):
+    assert node.cache_index.apply(
+        {"seq": seq, "block": block, "full": list(chain)}
+    ) is False
+
+
+class TestDigestChain:
+    def test_insert_matches_prompt_chain(self):
+        tree = RadixPageCache(PAGE, track_digests=True)
+        toks = list(range(3 * PAGE))
+        tree.insert(toks, [1, 2, 3])
+        payload = tree.digest_payload()
+        assert payload["block"] == PAGE
+        assert sorted(payload["added"]) == sorted(
+            block_hash_chain(toks, PAGE)
+        )
+        # Drained: the next delta is empty.
+        p2 = tree.digest_payload()
+        assert p2["added"] == [] and p2["removed"] == []
+
+    def test_divergent_tails_get_distinct_digests(self):
+        tree = RadixPageCache(PAGE, track_digests=True)
+        shared = list(range(PAGE))
+        tree.insert(shared + [101] * PAGE, [1, 2])
+        tree.insert(shared + [102] * PAGE, [1, 3])
+        payload = tree.digest_payload()
+        # 1 shared page + 2 divergent tails = 3 distinct digests.
+        assert len(set(payload["added"])) == 3
+
+    def test_evict_logs_removal(self):
+        tree = RadixPageCache(PAGE, track_digests=True)
+        toks = list(range(3 * PAGE))
+        chain = block_hash_chain(toks, PAGE)
+        tree.insert(toks, [1, 2, 3])
+        tree.digest_payload()
+        tree.evict(2)
+        payload = tree.digest_payload()
+        assert sorted(payload["removed"]) == sorted(chain[1:])
+        assert tree.prefix_digests() == [chain[0]]
+
+    def test_reset_collapses_to_full_snapshot(self):
+        tree = RadixPageCache(PAGE, track_digests=True)
+        tree.insert(list(range(PAGE)), [1])
+        tree.digest_payload()
+        tree.reset()
+        payload = tree.digest_payload()
+        assert payload.get("full") == []
+
+    def test_oversized_delta_collapses_to_snapshot(self, monkeypatch):
+        from parallax_tpu.runtime import radix_cache as rc
+
+        monkeypatch.setattr(rc, "MAX_DIGEST_DELTA", 2)
+        tree = RadixPageCache(PAGE, track_digests=True)
+        toks = list(range(4 * PAGE))
+        tree.insert(toks, [1, 2, 3, 4])
+        payload = tree.digest_payload()
+        assert sorted(payload["full"]) == sorted(
+            block_hash_chain(toks, PAGE)
+        )
+
+    def test_tracking_off_is_inert(self):
+        tree = RadixPageCache(PAGE)
+        tree.insert(list(range(2 * PAGE)), [1, 2])
+        assert tree.digest_payload() is None
+        assert tree._digest_log == []
+
+
+class TestCacheIndex:
+    def setup_method(self):
+        self.chain = block_hash_chain(list(range(4 * PAGE)), PAGE)
+
+    def test_full_then_delta(self):
+        ix = CacheIndex()
+        assert not ix.apply({"seq": 3, "block": PAGE,
+                             "full": self.chain[:2]})
+        assert ix.predict_cached_tokens(self.chain, PAGE, 100) == 2 * PAGE
+        assert not ix.apply({"seq": 4, "block": PAGE,
+                             "added": self.chain[2:3], "removed": []})
+        assert ix.predict_cached_tokens(self.chain, PAGE, 100) == 3 * PAGE
+        assert not ix.apply({"seq": 5, "block": PAGE, "added": [],
+                             "removed": self.chain[2:3]})
+        assert ix.predict_cached_tokens(self.chain, PAGE, 100) == 2 * PAGE
+
+    def test_seq_gap_requests_resync_and_clears(self):
+        ix = CacheIndex()
+        ix.apply({"seq": 1, "block": PAGE, "full": self.chain})
+        assert ix.apply({"seq": 3, "block": PAGE,
+                         "added": [], "removed": []}) is True
+        assert len(ix) == 0
+        assert ix.predict_cached_tokens(self.chain, PAGE, 100) == 0
+
+    def test_block_mismatch_requests_resync(self):
+        ix = CacheIndex()
+        ix.apply({"seq": 1, "block": PAGE, "full": self.chain})
+        assert ix.apply({"seq": 2, "block": PAGE * 2,
+                         "added": [], "removed": []}) is True
+
+    def test_lru_bound(self):
+        ix = CacheIndex(max_entries=3)
+        ix.apply({"seq": 1, "block": PAGE, "full": [1, 2, 3, 4, 5]})
+        assert len(ix) == 3
+
+    def test_stale_index_decays_to_zero(self):
+        ix = CacheIndex(stale_after_s=0.02)
+        ix.apply({"seq": 1, "block": PAGE, "full": self.chain})
+        assert ix.predict_cached_tokens(self.chain, PAGE, 100) > 0
+        time.sleep(0.05)
+        assert ix.predict_cached_tokens(self.chain, PAGE, 100) == 0
+
+    def test_full_prompt_caps_one_page_short(self):
+        # The engine always recomputes >= 1 token: an exactly-covered
+        # prompt must predict one page less than its chain depth.
+        ix = CacheIndex()
+        ix.apply({"seq": 1, "block": PAGE, "full": self.chain})
+        assert ix.predict_cached_tokens(
+            self.chain, PAGE, 4 * PAGE
+        ) == 3 * PAGE
+
+
+class TestCacheAwareRouting:
+    def meta(self, toks, lora=None):
+        return RequestMeta("rid", prompt_ids=list(toks), lora_id=lora)
+
+    def test_routes_to_warm_replica(self):
+        mgr = replica_manager(2)
+        router = CacheAwareRouting(mgr)
+        toks = list(range(6 * PAGE))
+        feed_index(mgr.get("r1"), block_hash_chain(toks, PAGE))
+        for _ in range(3):
+            meta = self.meta(toks)
+            path = router.find_path(meta)
+            assert path[0].node_id == "r1"
+            assert meta.predicted_cached_tokens == 5 * PAGE
+        assert router.decision_counters["chosen_by_cache"] == 3
+        assert router.pipeline_dispatches[
+            mgr.pipelines[1].pipeline_id
+        ] == 3
+
+    def test_cold_cluster_spreads_like_rr(self):
+        mgr = replica_manager(2)
+        router = CacheAwareRouting(mgr)
+        picks = {router.find_path(self.meta([1, 2, 3]))[0].node_id
+                 for _ in range(4)}
+        assert picks == {"r0", "r1"}
+        assert router.decision_counters["chosen_by_load"] == 4
+
+    def test_imbalance_guard_falls_back_to_least_loaded(self):
+        mgr = replica_manager(2)
+        router = CacheAwareRouting(mgr, imbalance_threshold=2)
+        toks = list(range(6 * PAGE))
+        feed_index(mgr.get("r1"), block_hash_chain(toks, PAGE))
+        mgr.get("r1").load = 5   # hot prefix piled onto r1
+        path = router.find_path(self.meta(toks))
+        assert path[0].node_id == "r0"
+        assert router.decision_counters["fallback_imbalance"] == 1
+
+    def test_load_beats_shallow_hit(self):
+        # beta prices one in-flight request like 256 uncached tokens: a
+        # single-page hit must not out-score an idle replica.
+        mgr = replica_manager(2)
+        router = CacheAwareRouting(mgr)
+        toks = list(range(2 * PAGE))
+        feed_index(mgr.get("r1"), block_hash_chain(toks, PAGE))
+        mgr.get("r1").load = 2
+        assert router.find_path(self.meta(toks))[0].node_id == "r0"
+
+    def test_lora_requests_skip_digest_matching(self):
+        # Workers namespace LoRA radix tokens with per-process salts the
+        # scheduler cannot reproduce; prediction must not fire.
+        mgr = replica_manager(2)
+        router = CacheAwareRouting(mgr)
+        toks = list(range(6 * PAGE))
+        feed_index(mgr.get("r1"), block_hash_chain(toks, PAGE))
+        meta = self.meta(toks, lora="tenant-a")
+        router.find_path(meta)
+        assert meta.predicted_cached_tokens == 0
+        assert router.decision_counters.get("chosen_by_cache", 0) == 0
+
+    def test_skips_not_ready_and_full_pipelines(self):
+        mgr = replica_manager(2)
+        router = CacheAwareRouting(mgr)
+        mgr.get("r0").is_ready = False
+        for _ in range(3):
+            assert router.find_path(None)[0].node_id == "r1"
+        mgr.get("r1").load = mgr.get("r1").max_concurrent_requests()
+        assert router.find_path(None) is None
+
+    def test_make_router_aliases(self):
+        mgr = replica_manager(1)
+        for name in ("cache_aware", "cache-aware", "prefix"):
+            assert isinstance(make_router(name, mgr), CacheAwareRouting)
+        with pytest.raises(ValueError):
+            make_router("nope", mgr)
+
+
+class TestChurn:
+    def wait_for(self, cond, timeout=5.0):
+        end = time.monotonic() + timeout
+        while time.monotonic() < end:
+            if cond():
+                return True
+            time.sleep(0.01)
+        return False
+
+    def scheduler(self):
+        sched = GlobalScheduler(MODEL, min_nodes_bootstrapping=1,
+                                routing="cache_aware")
+        sched.start()
+        sched.enqueue_join("n0", V5E_HOST)
+        assert self.wait_for(sched.bootstrapped.is_set)
+        sched.enqueue_join("n1", V5E_HOST)
+        assert self.wait_for(
+            lambda: len(sched.manager.pipelines) == 2
+        ), sched.cluster_status()
+        for nid in ("n0", "n1"):
+            sched.enqueue_update(nid, is_ready=True)
+        assert self.wait_for(
+            lambda: all(
+                sched.manager.get(n).is_ready for n in ("n0", "n1")
+            )
+        )
+        return sched
+
+    def test_leave_mid_dispatch_retries_onto_survivor(self):
+        sched = self.scheduler()
+        try:
+            toks = list(range(6 * PAGE))
+            chain = block_hash_chain(toks, PAGE)
+            sched.enqueue_update(
+                "n0",
+                cache_digests={"seq": 1, "block": PAGE, "full": chain},
+            )
+            assert self.wait_for(
+                lambda: len(sched.manager.get("n0").cache_index) > 0
+            )
+            # Warm dispatch goes to n0...
+            pr = sched.receive_request(
+                "warm", meta=RequestMeta("warm", prompt_ids=toks)
+            )
+            assert pr.event.wait(5.0) and pr.path_ids == ["n0"]
+            sched.complete_request(pr.path_ids)
+            # ...then n0 leaves; the same request meta must land on the
+            # survivor instead of wedging on the dead pipeline.
+            sched.enqueue_leave("n0")
+            pr2 = sched.receive_request(
+                "after-leave", meta=RequestMeta("after-leave",
+                                                prompt_ids=toks)
+            )
+            assert pr2.event.wait(8.0)
+            assert pr2.path_ids == ["n1"], pr2.path_ids
+        finally:
+            sched.stop()
+
+    def test_rejoin_invalidates_index_and_requests_resync(self):
+        sched = self.scheduler()
+        try:
+            toks = list(range(6 * PAGE))
+            chain = block_hash_chain(toks, PAGE)
+            sched.enqueue_update(
+                "n0",
+                cache_digests={"seq": 1, "block": PAGE, "full": chain},
+            )
+            assert self.wait_for(
+                lambda: len(sched.manager.get("n0").cache_index) > 0
+            )
+            sched.enqueue_leave("n0")
+            assert self.wait_for(
+                lambda: sched.manager.get("n0") is None
+            )
+            sched.enqueue_join("n0", V5E_HOST)
+            assert self.wait_for(
+                lambda: sched.manager.get("n0") is not None
+            )
+            # Fresh node object: the old mirror is gone with it.
+            assert len(sched.manager.get("n0").cache_index) == 0
+            # A mid-sequence delta from the node's previous life cannot
+            # apply; the scheduler flags a resync for the next reply.
+            sched.enqueue_update(
+                "n0",
+                cache_digests={"seq": 7, "block": PAGE,
+                               "added": chain[:1], "removed": []},
+            )
+            assert self.wait_for(
+                lambda: sched.manager.get("n0").digests_need_resync
+            )
+            assert sched.digests_resync_requested("n0") is True
+            assert sched.digests_resync_requested("n0") is False
+        finally:
+            sched.stop()
+
+    def test_want_digests_rides_allocation(self):
+        sched = self.scheduler()
+        try:
+            alloc = sched.get_node_allocation("n0")
+            assert alloc["want_digests"] is True
+        finally:
+            sched.stop()
+        rr = GlobalScheduler(MODEL, min_nodes_bootstrapping=1)
+        rr.start()
+        try:
+            rr.enqueue_join("m0", V5E_HOST)
+            assert self.wait_for(rr.bootstrapped.is_set)
+            assert "want_digests" not in rr.get_node_allocation("m0")
+        finally:
+            rr.stop()
+
+
+# -- placement-only contract: streams are bit-identical per strategy ------
+
+TINY = normalize_config(dict(
+    architectures=["Qwen2ForCausalLM"],
+    hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+    num_key_value_heads=2, intermediate_size=128, vocab_size=151,
+    max_position_embeddings=256,
+))
+
+
+def _stage_params(model):
+    return model.init_params(
+        jax.random.key(model.start_layer * 1000 + model.end_layer),
+        dtype=jnp.float32,
+    )
+
+
+def _run_swarm(routing: str, overlap: bool) -> list[list[int]]:
+    """Two full-model replicas behind a GlobalScheduler over loopback;
+    returns the token streams of a fixed greedy+seeded request set."""
+    from parallax_tpu.backend.scheduler_service import SchedulerService
+    from parallax_tpu.p2p.node import WorkerNode
+    from parallax_tpu.p2p.transport import LoopbackTransport
+    from parallax_tpu.runtime.engine import EngineConfig
+    from parallax_tpu.runtime.request import Request, SamplingParams
+
+    registry: dict = {}
+    sched = GlobalScheduler(TINY, min_nodes_bootstrapping=2,
+                            routing=routing)
+    service = SchedulerService(
+        sched, LoopbackTransport("sched", registry), join_timeout_s=30.0
+    )
+    service.start()
+    ecfg = EngineConfig(
+        page_size=8, num_pages=64, max_model_len=128, kv_dtype="float32",
+        max_num_tokens_per_batch=128, max_batch_size=4,
+        overlap_steps=overlap,
+    )
+    workers = [
+        WorkerNode(
+            transport=LoopbackTransport(f"bw{i}", registry),
+            scheduler_peer="sched",
+            model_config=TINY,
+            engine_config=dataclasses.replace(ecfg),
+            load_params=_stage_params,
+            heartbeat_interval_s=0.1,
+        )
+        for i in range(2)
+    ]
+    try:
+        starters = [threading.Thread(target=w.start) for w in workers]
+        for s in starters:
+            s.start()
+        for s in starters:
+            s.join(timeout=60.0)
+        by_id = {w.node_id: w for w in workers}
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            st = sched.cluster_status()
+            if st["num_pipelines"] >= 2 and all(
+                n["ready"] for p in st["pipelines"] for n in p["nodes"]
+            ):
+                break
+            time.sleep(0.02)
+
+        shared = [7, 8, 9, 10] * 4    # 2 shared pages at page_size=8
+        streams = []
+        for i, sp in enumerate([
+            SamplingParams(temperature=0.0, max_new_tokens=6,
+                           ignore_eos=True),
+            SamplingParams(temperature=0.8, top_k=8, seed=123,
+                           max_new_tokens=6, ignore_eos=True),
+            SamplingParams(temperature=0.0, max_new_tokens=6,
+                           ignore_eos=True),
+        ]):
+            prompt = shared + [20 + i, 21 + i, 22 + i]
+            rid = f"{routing}-{overlap}-{i}"
+            path = service.route_request(
+                rid, timeout_s=15.0, prompt_ids=list(prompt)
+            )
+            assert path, f"no path for {rid}"
+            req = Request(
+                request_id=rid, prompt_ids=list(prompt),
+                sampling_params=sp, routing_table=list(path),
+            )
+            ev = by_id[path[0]].submit(req)
+            assert ev.wait(30.0), f"{rid} stuck: {req.status}"
+            streams.append(list(req.output_ids))
+            time.sleep(0.25)   # let donations + digest heartbeats land
+        return streams
+    finally:
+        for w in workers:
+            w.stop()
+        service.stop()
+
+
+@pytest.mark.parametrize("overlap", [True, False])
+def test_streams_bit_identical_across_strategies(overlap):
+    """Routing changes placement, never results: the same greedy and
+    seeded requests produce identical token streams under round-robin
+    and cache-aware routing (replicas hold identical weights), in both
+    the sync and overlapped decode loops."""
+    rr = _run_swarm("rr", overlap)
+    ca = _run_swarm("cache_aware", overlap)
+    assert rr == ca
+    assert all(len(s) == 6 for s in rr)
