@@ -24,6 +24,10 @@ def generate_main(args) -> int:
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+    from parallax_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache(getattr(args, "compilation_cache_dir", None))
+
     import jax.numpy as jnp
 
     from parallax_tpu.backend.http_server import IncrementalDecoder
@@ -78,7 +82,8 @@ def generate_main(args) -> int:
             max_model_len=max_model_len,
             max_num_tokens_per_batch=max(2048, len(prompt_ids)),
             kv_dtype=getattr(args, "kv_dtype", "bfloat16"),
-            decode_lookahead=getattr(args, "decode_lookahead", 1) or 1,
+            # None/0 = adaptive multi-step decode (engine default).
+            decode_lookahead=getattr(args, "decode_lookahead", None) or None,
         ),
         mesh=mesh,
     )

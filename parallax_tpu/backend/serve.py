@@ -156,6 +156,12 @@ def serve_main(args) -> int:
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import jax.numpy as jnp
 
+    # Compile-time hygiene: restarts reload compiled executables from
+    # disk instead of paying a recompilation storm (docs/decode_loop.md).
+    from parallax_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache(getattr(args, "compilation_cache_dir", None))
+
     from parallax_tpu.config import load_config
     from parallax_tpu.models.loader import load_stage_params
     from parallax_tpu.models.registry import create_stage_model
@@ -302,7 +308,8 @@ def serve_main(args) -> int:
             ),
             linear_prefix_slots=getattr(args, "linear_prefix_slots", 32),
             sp_threshold=sp_threshold,
-            decode_lookahead=getattr(args, "decode_lookahead", 1) or 1,
+            # None/0 = adaptive multi-step decode (engine default).
+            decode_lookahead=getattr(args, "decode_lookahead", None) or None,
             decode_pipeline=getattr(args, "decode_pipeline", 1) or 1,
             # A configured draft model implies speculation (default k=4).
             speculative_tokens=(

@@ -61,9 +61,13 @@ def build_parser() -> argparse.ArgumentParser:
                        help="per-request adapters: name=peft_dir[,name=dir] "
                             "— requests select one via the 'lora' body "
                             "field (unmerged; batch-grouped at serving)")
-    serve.add_argument("--decode-lookahead", type=int, default=1,
-                       help="greedy decode tokens per jit dispatch "
-                            "(single-stage serving; 1 = off)")
+    serve.add_argument("--decode-lookahead", type=int, default=None,
+                       help="decode tokens per host visit (single-stage "
+                            "serving; fused forward+sample window with "
+                            "on-device stop-check). Default: adaptive — "
+                            "up to 8 whenever the batch qualifies, "
+                            "single-step while any sync-forcing feature "
+                            "is active; 1 = off")
     serve.add_argument("--decode-pipeline", type=int, default=1,
                        help="chained k-token decode windows per host "
                             "round (hides dispatch latency; 1 = off)")
@@ -100,6 +104,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="flight-recorder slow threshold: requests slower end-to-end "
              "than this are captured with their span breakdown "
              "(GET /debug/flight); <= 0 disables slow capture",
+    )
+    serve.add_argument(
+        "--compilation-cache-dir", default=None,
+        help="persistent XLA compilation cache directory (default: "
+             "$PARALLAX_TPU_COMPILE_CACHE or "
+             "~/.cache/parallax_tpu/xla_cache; 'off' disables) — "
+             "restarts reload compiled programs instead of paying a "
+             "recompilation storm",
     )
 
     run = sub.add_parser("run", help="launch the scheduler + web frontend")
@@ -211,6 +223,21 @@ def build_parser() -> argparse.ArgumentParser:
         help="flight-recorder slow threshold for this worker's head "
              "stage (<= 0 disables slow capture)",
     )
+    join.add_argument(
+        "--decode-lookahead", type=int, default=None,
+        help="decode tokens per host visit when this worker serves a "
+             "full single stage (default: adaptive up to 8; 1 = off)",
+    )
+    join.add_argument(
+        "--decode-pipeline", type=int, default=1,
+        help="chained k-token decode windows per host visit (1 = off)",
+    )
+    join.add_argument(
+        "--compilation-cache-dir", default=None,
+        help="persistent XLA compilation cache directory (default: "
+             "$PARALLAX_TPU_COMPILE_CACHE or "
+             "~/.cache/parallax_tpu/xla_cache; 'off' disables)",
+    )
 
     bench = sub.add_parser("bench", help="offline throughput benchmark")
     bench.add_argument("--config", default="qwen2-7b")
@@ -229,7 +256,15 @@ def build_parser() -> argparse.ArgumentParser:
     gen.add_argument("--tp-size", type=int, default=0)
     gen.add_argument("--kv-dtype", choices=["bfloat16", "float32"],
                      default="bfloat16")
-    gen.add_argument("--decode-lookahead", type=int, default=1)
+    gen.add_argument("--decode-lookahead", type=int, default=None,
+                     help="decode tokens per host visit (default: "
+                          "adaptive up to 8; 1 = off)")
+    gen.add_argument(
+        "--compilation-cache-dir", default=None,
+        help="persistent XLA compilation cache directory (default: "
+             "$PARALLAX_TPU_COMPILE_CACHE or "
+             "~/.cache/parallax_tpu/xla_cache; 'off' disables)",
+    )
     gen.add_argument("--quantization", choices=["int8", "int4"],
                      default=None)
     gen.add_argument("--lora-path", default=None)

@@ -39,6 +39,13 @@ def join_main(args) -> int:
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+    # Compile-time hygiene: a rejoining (or autoscaled) worker reloads
+    # its compiled stage programs from disk instead of paying a
+    # recompilation storm before serving its first token.
+    from parallax_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache(getattr(args, "compilation_cache_dir", None))
+
     from parallax_tpu.config import load_config
     from parallax_tpu.models.loader import load_stage_params
     from parallax_tpu.p2p.node import WorkerNode
@@ -154,6 +161,11 @@ def join_main(args) -> int:
         scheduler_peer=scheduler_peer,
         model_config=model_config,
         engine_config=EngineConfig(
+            # None/0 = adaptive multi-step decode (engine default); the
+            # worker's drive loop (node.py) resolves the K-step window
+            # tickets like any other overlapped step.
+            decode_lookahead=getattr(args, "decode_lookahead", None) or None,
+            decode_pipeline=getattr(args, "decode_pipeline", 1) or 1,
             sp_threshold=(
                 getattr(args, "sp_threshold", 2048)
                 if sp_size > 1 else None

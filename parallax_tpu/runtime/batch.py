@@ -49,9 +49,23 @@ class BucketSpec:
         cls, max_num_tokens: int, max_batch_size: int, max_model_len: int,
         page_size: int,
     ) -> "BucketSpec":
+        # Decode batches bucket their token count on the SEQ lattice
+        # (t == s, the decode-kernel dispatch contract). A
+        # non-power-of-two max_batch_size adds an exact-size tail bucket
+        # that the single-step AND every K-step decode program each
+        # compile separately — merge it into the next power of two when
+        # the padding is small (<= 25% dead rows at saturation). Past
+        # that, the permanent per-step compute on padded rows costs more
+        # than the one-time extra compile, so the exact tail stays.
+        seq = default_buckets(max_batch_size)
+        tail = seq[-1]
+        if tail & (tail - 1):
+            pow2 = 1 << (tail - 1).bit_length()
+            if pow2 <= tail + tail // 4:
+                seq[-1] = pow2
         return cls(
             token_buckets=default_buckets(max_num_tokens),
-            seq_buckets=default_buckets(max_batch_size),
+            seq_buckets=seq,
             pages_per_seq=(max_model_len + page_size - 1) // page_size,
         )
 

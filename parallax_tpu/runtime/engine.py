@@ -35,6 +35,10 @@ from parallax_tpu.utils import get_logger
 
 logger = get_logger(__name__)
 
+# Adaptive multi-step decode: K used per host visit when
+# ``EngineConfig.decode_lookahead`` is None and the batch qualifies.
+ADAPTIVE_DECODE_LOOKAHEAD = 8
+
 
 @dataclasses.dataclass
 class EngineConfig:
@@ -76,13 +80,26 @@ class EngineConfig:
     # ``sp_mesh`` at engine construction). None = off.
     sp_threshold: int | None = None
     # Multi-step decode: a single-stage decode batch runs this many tokens
-    # per dispatch with sampling fused into the jit (lax.scan over
-    # forward+sample) — the SURVEY's "k tokens per dispatch" lever against
-    # per-token host dispatch latency. Covers greedy AND sampled rows
-    # (temperature/top-k/top-p/min-p, seeded or not); rows needing
-    # per-step host state (penalties, logprobs, grammar, logit_bias)
-    # fall back to single-step. 1 = off.
-    decode_lookahead: int = 1
+    # per DISPATCH (one host visit) with sampling fused into the jit
+    # (lax.scan over forward+sample+feedback) and a per-row on-device
+    # stop mask (EOS, stop-token sets, max/min-new-token budgets) that
+    # freezes finished rows mid-window — the SURVEY's "k tokens per
+    # dispatch" lever against per-token host scheduling. dispatch()
+    # enqueues the window and resolve() reads all k tokens plus the stop
+    # state back in one D2H pass, so the window rides the overlapped
+    # two-phase loop like any other step. Covers greedy AND sampled rows
+    # (temperature/top-k/top-p/min-p, seeded or not); greedy and seeded
+    # streams stay bit-identical to K=1.
+    #
+    # None (the default) = ADAPTIVE: run ADAPTIVE_DECODE_LOOKAHEAD steps
+    # per visit whenever the batch qualifies, and drop to single-step
+    # automatically while any sync-forcing feature (penalties, logprobs,
+    # grammar, logit_bias, a speculative window, a prefill chunk) is in
+    # the batch. An explicit int pins K; 1 = off. The scheduler
+    # pre-allocates KV pages for the whole window and the engine falls
+    # back to K=1 when the allocator (or host-tier pressure behind it)
+    # cannot guarantee them.
+    decode_lookahead: int | None = None
     # Pipelined multi-step decode: chain this many k-token windows per
     # host round. Window j+1 is dispatched from window j's device-resident
     # carry (last token + context length) BEFORE window j's tokens are
@@ -185,12 +202,23 @@ class StepTicket:
     t0: float
     host_ms: float = 0.0
     sync_only: bool = False
+    # Monotonic dispatch-entry stamp: resolve compares it against the
+    # engine's current counter to report whether this ticket's resolve
+    # overlapped any later dispatch (empty plans count — their host work
+    # still ran while this ticket's device work was in flight).
+    dispatch_seq: int = 0
     inputs: BatchInputs | None = None
     out: jax.Array | None = None
     spec_rows: dict | None = None
     # Pre-sampled tokens (deferred fetch): the sampler was enqueued at
     # dispatch so only the readback remains at resolve.
     tokens_dev: jax.Array | None = None
+    # Multi-step decode window: the per-window [k, S] token arrays the
+    # dispatched scan chain produced (D2H copies started at dispatch)
+    # and the final on-device stop state (stopped mask, per-row
+    # produced counts).
+    ms_windows: list | None = None
+    ms_state: tuple | None = None
     outputs: "StepOutputs | None" = None
 
 
@@ -567,8 +595,11 @@ class StageEngine:
             or cfg_m.use_attention_sinks
         )
         self._base_key = jax.random.key(self.cfg.seed)
-        self._jit_multistep = None
-        self._jit_multistep_sampled = None
+        # Fused decode-window programs keyed by (k, sampled): the
+        # adaptive path and explicit overrides (bench probes mutate
+        # ``cfg.decode_lookahead`` between rounds) each get their own
+        # compile instead of silently reusing a stale-k scan.
+        self._jit_multistep: dict[tuple[int, bool], object] = {}
         # Per-request LoRA adapters (ops/lora.py); None until the first
         # load_adapter so base-only serving never touches the machinery.
         self._adapters = None
@@ -578,6 +609,7 @@ class StageEngine:
         # invariant); the device-resident last-token array feeds decode
         # rows whose sampled token has not reached the host yet.
         self._inflight: list[StepTicket] = []
+        self._dispatch_seq = 0
         self._last_token_dev = jnp.zeros(
             (self.cfg.max_batch_size,), jnp.int32
         )
@@ -591,7 +623,8 @@ class StageEngine:
 
         self._init_obs()
         self.step_timing = StepTimingAggregator(
-            host_hist=self._h_step_host, device_hist=self._h_step_device
+            host_hist=self._h_step_host, device_hist=self._h_step_device,
+            per_token_hist=self._h_step_per_token,
         )
         # Non-head stages: hidden rows waiting per request id.
         self._pending_hidden: dict[str, np.ndarray] = {}
@@ -912,6 +945,15 @@ class StageEngine:
             "Device-readback milliseconds per engine step",
             labelnames=st,
         ).labels(**lbl)
+        # Per-TOKEN twin of the per-visit host histogram: with multi-step
+        # decode a host visit commits K tokens, so the visit series alone
+        # would overstate TPOT-relevant host cost by K.
+        self._h_step_per_token = reg.histogram(
+            "parallax_step_per_token_host_ms",
+            "Host-blocking milliseconds per committed token (host-visit "
+            "cost amortized over the tokens that visit committed)",
+            labelnames=st,
+        ).labels(**lbl)
         self._h_batch_tokens = reg.histogram(
             "parallax_step_batch_tokens",
             "New tokens per dispatched engine step",
@@ -963,6 +1005,12 @@ class StageEngine:
         # engine's own reference keeps collection alive exactly as long
         # as the engine.
         reg.register_collector(self._collect_obs)
+        # Compiles-per-process counter (parallax_xla_compiles_total):
+        # a climbing count in steady state is the compile-storm signal
+        # the bucketing lattice + persistent cache exist to prevent.
+        from parallax_tpu.utils.compile_cache import register_compile_counter
+
+        register_compile_counter()
 
     def _collect_obs(self) -> None:
         """Pull-style series, refreshed at render/snapshot time."""
@@ -1085,21 +1133,42 @@ class StageEngine:
             slow_threshold_ms=self.cfg.slow_request_ms,
         )
 
-    # -- multi-step decode (k tokens per dispatch) ------------------------
+    # -- multi-step decode (k tokens per host visit) ----------------------
 
-    def _build_multistep(self, sampled: bool):
+    def _effective_lookahead(self) -> int:
+        """Resolved K for this dispatch: an explicit config value wins;
+        the adaptive default (None/0) runs ADAPTIVE_DECODE_LOOKAHEAD
+        whenever the batch qualifies — the per-batch disqualifiers in
+        ``_fused_common_ok`` drop sync-forcing batches to single-step
+        automatically, so adaptive mode never changes those streams."""
+        k = self.cfg.decode_lookahead
+        if not k:
+            k = ADAPTIVE_DECODE_LOOKAHEAD
+        return max(1, int(k))
+
+    def _build_multistep(self, k: int, sampled: bool):
         """Jit a k-step decode loop: forward -> sample -> feed back,
-        entirely on device. The page table is fixed across the window (the
-        host pre-ensures capacity), so each step only advances positions,
+        entirely on device, with a per-row stop mask in the scan carry.
+        The page table is fixed across the window (the scheduler
+        pre-allocated capacity), so each step only advances positions,
         slot mapping and kv_lens.
+
+        The stop mask freezes a row the step after it samples an
+        EOS/stop token (gated by its min_new_tokens budget) or exhausts
+        its max_new_tokens budget: frozen rows stop writing KV
+        (slot -1), stop advancing their context, and repeat their last
+        token so no phantom state ever lands past a row's stop point.
+        The final mask and per-row produced counts return with the
+        tokens, and the host reads everything back in one D2H pass at
+        resolve().
 
         ``sampled=False`` compiles the pure-argmax variant (no sort, no
         PRNG). ``sampled=True`` fuses the full filtered categorical
         sampler into the scan body: per-row temperature/top-k/top-p/min-p
-        arrays ride in a side pytree, and randomness follows the same
-        per-row key discipline as the per-step path — seeded rows draw
-        from ``fold_in(key(seed), output_step)``, so a seeded stream is
-        reproducible regardless of batch composition, and matches the
+        arrays ride in the ``ms`` side pytree, and randomness follows the
+        same per-row key discipline as the per-step path — seeded rows
+        draw from ``fold_in(key(seed), output_step)``, so a seeded stream
+        is reproducible regardless of batch composition, and matches the
         per-step path wherever the two compiled programs produce the
         same logits (bitwise on CPU; on TPU a near-tied categorical can
         flip on ulp-level fusion differences). Unseeded rows draw from
@@ -1108,17 +1177,17 @@ class StageEngine:
         import dataclasses as _dc
 
         model = self.model
-        k = self.cfg.decode_lookahead
         page_size = self.cfg.page_size
 
-        def step_inputs_at(inputs, token_ids, ctx):
+        def step_inputs_at(inputs, token_ids, ctx, stopped):
             pos = ctx - 1                           # fed token's slot
             page_of = jnp.maximum(pos, 0) // page_size
             phys = jnp.take_along_axis(
                 inputs.page_indices, page_of[:, None], axis=1
             )[:, 0]
             slots = jnp.where(
-                ctx > 0, phys * page_size + jnp.maximum(pos, 0) % page_size,
+                (ctx > 0) & ~stopped,
+                phys * page_size + jnp.maximum(pos, 0) % page_size,
                 jnp.int32(-1),
             )
             return _dc.replace(
@@ -1129,62 +1198,61 @@ class StageEngine:
                 slot_mapping=slots,
             )
 
-        if not sampled:
-            def fn(params, kv, inputs: BatchInputs):
-                def body(carry, _):
-                    kv, token_ids, ctx = carry
-                    logits, kv = model(
-                        params, kv, step_inputs_at(inputs, token_ids, ctx)
-                    )
-                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                    return (kv, nxt, ctx + 1), nxt
-
-                (kv, feed, ctx), tokens = jax.lax.scan(
-                    body, (kv, inputs.token_ids, inputs.kv_lens), None,
-                    length=k,
-                )
-                # tokens: [k, S]; (feed, ctx) is the device-resident carry
-                # the NEXT window starts from — returning it lets the host
-                # chain windows without reading tokens back in between.
-                return tokens, kv, feed, ctx
-
-            return jax.jit(self._tp_wrap_multistep(fn, 0),
-                           donate_argnums=self._donate_kv)
-
-        def fn(params, kv, inputs: BatchInputs, samp: dict):
+        def fn(params, kv, inputs: BatchInputs, ms: dict):
             def body(carry, step_i):
-                kv, token_ids, ctx = carry
+                kv, feed, ctx, stopped, produced = carry
                 logits, kv = model(
-                    params, kv, step_inputs_at(inputs, token_ids, ctx)
+                    params, kv, step_inputs_at(inputs, feed, ctx, stopped)
                 )
-                nxt = sample_tokens(
-                    logits,
-                    jax.random.fold_in(samp["key"], step_i),
-                    samp["temp"], samp["top_k"], samp["top_p"],
-                    samp["min_p"],
-                    seeds=samp["seeds"],
-                    out_steps=samp["steps"] + step_i,
+                if sampled:
+                    nxt = sample_tokens(
+                        logits,
+                        jax.random.fold_in(ms["key"], step_i),
+                        ms["temp"], ms["top_k"], ms["top_p"], ms["min_p"],
+                        seeds=ms["seeds"],
+                        out_steps=ms["steps"] + step_i,
+                    )
+                else:
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                live = ~stopped
+                nxt = jnp.where(live, nxt, feed)
+                produced = produced + live.astype(jnp.int32)
+                # Same predicate commit_token applies on the host: a
+                # stop/EOS token only finishes a row once min_new_tokens
+                # is met; the length budget always does.
+                hit_stop = jnp.logical_and(
+                    (nxt[:, None] == ms["stop_tokens"]).any(axis=1),
+                    produced >= ms["min_req"],
                 )
-                return (kv, nxt, ctx + 1), nxt
+                stopped = stopped | (
+                    live & (hit_stop | (produced >= ms["limit"]))
+                )
+                ctx = ctx + live.astype(jnp.int32)
+                return (kv, nxt, ctx, stopped, produced), nxt
 
-            (kv, feed, ctx), tokens = jax.lax.scan(
-                body, (kv, inputs.token_ids, inputs.kv_lens),
+            (kv, feed, ctx, stopped, produced), tokens = jax.lax.scan(
+                body,
+                (kv, inputs.token_ids, inputs.kv_lens,
+                 ms["stopped"], ms["produced"]),
                 jnp.arange(k, dtype=jnp.int32),
             )
-            return tokens, kv, feed, ctx
+            # tokens: [k, S]; (feed, ctx, stopped, produced) is the
+            # device-resident carry the NEXT window starts from —
+            # returning it lets the host chain windows without reading
+            # tokens back in between.
+            return tokens, kv, feed, ctx, stopped, produced
 
-        return jax.jit(self._tp_wrap_multistep(fn, 1),
+        return jax.jit(self._tp_wrap_multistep(fn),
                        donate_argnums=self._donate_kv)
 
-    def _tp_wrap_multistep(self, fn, n_extra: int):
+    def _tp_wrap_multistep(self, fn):
         """SPMD-wrap a multistep fn for a TP-sharded stage: the whole
         k-step scan runs inside ONE shard_map over the tp axis (params and
         KV pages stay in their shard layout; the per-layer psums and the
         vocab-sharded lm_head all_gather happen inside the body exactly as
         in the per-step TP path), and the sampled tokens — identical on
-        every shard after the gather — come back replicated. ``n_extra``
-        counts trailing replicated args (the sampled variant's side
-        pytree). No-op for unsharded engines."""
+        every shard after the gather — come back replicated, as do the
+        stop-state carries. No-op for unsharded engines."""
         if self.mesh is None or self.model.tp_size <= 1:
             return fn
         from jax.sharding import PartitionSpec as P
@@ -1200,83 +1268,88 @@ class StageEngine:
         return jax.shard_map(
             fn,
             mesh=self.mesh,
-            in_specs=(param_specs, kv_specs, P(), *([P()] * n_extra)),
-            out_specs=(P(), kv_specs, P(), P()),
+            in_specs=(param_specs, kv_specs, P(), P()),
+            out_specs=(P(), kv_specs, P(), P(), P(), P()),
             check_vma=False,
         )
 
-    def _try_multistep(self, plan: BatchPlan) -> int | None:
-        """Run a k-step decode window if the batch qualifies; commits
-        tokens and returns the commit count, or None for the normal path.
+    def _pack_stop_state(self, plan: BatchPlan, s: int):
+        """Per-row device stop state for a decode window chain: the
+        combined EOS + stop-token set (-1 padded; empty under
+        ``ignore_eos``, matching commit_token which ignores both then),
+        the remaining generation budget before a length freeze, and the
+        min_new_tokens gate. Budgets count the pending device-fed token
+        of overlap-fed rows (sampled by the in-flight step, not yet
+        committed). Padded bucket rows keep limit 0 and freeze at step
+        one."""
+        limits = np.zeros((s,), np.int32)
+        min_req = np.zeros((s,), np.int32)
+        sets: list[tuple[int, ...]] = []
+        jmax = 1
+        for i, seg in enumerate(plan.seqs):
+            req = seg.request
+            sp = req.sampling_params
+            pending = int(
+                seg.device_token and req.total_len < seg.context_len
+            )
+            n_out = len(req.output_ids) + pending
+            limits[i] = max(0, sp.max_new_tokens - n_out)
+            min_req[i] = max(0, sp.min_new_tokens - n_out)
+            stop: tuple[int, ...] = ()
+            if not sp.ignore_eos:
+                stop = tuple(dict.fromkeys(
+                    tuple(req.eos_token_ids) + tuple(sp.stop_token_ids)
+                ))
+            sets.append(stop)
+            jmax = max(jmax, len(stop))
+        j = 1
+        while j < jmax:     # pow2 lattice bounds stop-set recompiles
+            j *= 2
+        stop_tokens = np.full((s, j), -1, np.int32)
+        for i, stop in enumerate(sets):
+            stop_tokens[i, : len(stop)] = stop
+        return stop_tokens, limits, min_req
+
+    def _dispatch_multistep(
+        self, plan: BatchPlan, t0: float
+    ) -> StepTicket | None:
+        """ENQUEUE a chained k-step decode window over ``plan`` and
+        return its in-flight ticket, or None to use the normal path.
+        Nothing blocks on device results here: the window tokens and the
+        final stop state come back in resolve()'s single D2H pass, so a
+        driver's next dispatch overlaps the whole window's compute.
 
         Qualification: single-stage engine (the ring is local), decode
         rows with no per-step host state (penalties, logprobs, grammar,
-        logit_bias fall back), and capacity for k more tokens per
-        request. Greedy AND sampled rows qualify — an all-greedy batch
-        compiles the cheap argmax variant, a mixed/sampled batch the
-        fused-sampler variant. Requests may finish mid-window
-        (EOS/max_tokens); their surplus tokens are discarded — the KV
-        written past the finish point lies beyond the committed context,
-        so prefix-cache donation (keyed by computed tokens) never
-        exposes it.
+        logit_bias fall back), and scheduler-guaranteed KV pages for the
+        whole window (``plan_decode_window`` — allocator or host-tier
+        pressure falls back to K=1 rather than evict/preempt for
+        lookahead). Greedy AND sampled rows qualify — an all-greedy
+        batch compiles the cheap argmax variant, a mixed/sampled batch
+        the fused-sampler variant. Device-fed rows (overlap loop one
+        step ahead) join via the on-device last-token gather. Rows may
+        finish mid-window (EOS/stop/max_tokens): the on-device stop mask
+        freezes them — no KV, context or state advances past a row's
+        stop point — and resolve() rolls back the frozen tail before
+        commit.
         """
-        k = self.cfg.decode_lookahead
+        k = self._effective_lookahead()
         if k <= 1 or not self._fused_common_ok(plan, allow_state=True):
+            return None
+        m = self.scheduler.plan_decode_window(
+            plan, k,
+            max_windows=max(1, self.cfg.decode_pipeline),
+            max_model_len=self.cfg.max_model_len,
+        )
+        if m <= 0:
+            # Soft fallback to K=1 — the normal path probes +1 token
+            # itself and owns the preemption/abort decisions.
             return None
         sampled = any(
             seg.request.sampling_params.temperature > 0.0
             or seg.request.sampling_params.seed is not None
             for seg in plan.seqs
         )
-        for seg in plan.seqs:
-            # Near the context limit the window would overrun max_model_len
-            # (and the per-seq page table): fall back to single-step.
-            if seg.request.total_len + k > self.cfg.max_model_len:
-                return None
-        # Pipelined windows: chain as many full k-token windows as every
-        # request's context budget allows, capped by config.
-        m = max(1, self.cfg.decode_pipeline)
-        for seg in plan.seqs:
-            room = (self.cfg.max_model_len - seg.request.total_len) // k
-            m = min(m, room)
-        # Windows past every request's generation budget are pure waste:
-        # cap the chain at the largest remaining max_new_tokens.
-        want = max(
-            seg.request.sampling_params.max_new_tokens
-            - len(seg.request.output_ids)
-            for seg in plan.seqs
-        )
-        m = min(m, max(1, -(-want // k)))
-        if m > 1:
-            # Size the chain by pages that are free RIGHT NOW (no prefix
-            # eviction): a failed multi-window probe must not leave
-            # speculative allocations or evictions behind. ensure_capacity
-            # below then cannot fail for the chosen m.
-            def _extra_pages(mm: int) -> int:
-                return sum(
-                    max(
-                        0,
-                        self.cache.pages_needed(
-                            seg.request.total_len + mm * k
-                        ) - len(seg.request.page_ids),
-                    )
-                    for seg in plan.seqs
-                )
-
-            while m > 1 and _extra_pages(m) > self.cache.num_free_pages:
-                m -= 1
-        if not all(
-            self.cache.ensure_capacity(
-                seg.request, seg.request.total_len + m * k
-            )
-            for seg in plan.seqs
-        ):
-            # Soft disqualifier only — the normal path probes +1 token
-            # itself and owns the abort decision (aborting here and
-            # then falling through would let commit_token resurrect
-            # the request).
-            return None
-
         if self._needs_state:
             # Hybrid rows must have their state slots assigned before the
             # window (the normal path does this per step; here the whole
@@ -1299,83 +1372,158 @@ class StageEngine:
         lora = self._lora_field(plan, inputs)
         if lora is not None:
             inputs = dataclasses.replace(inputs, lora=lora)
-        samp = None
+        if any(seg.device_token for seg in plan.seqs):
+            # Overlap-fed rows: their first window token is a gather
+            # from the device-resident last-token array, enqueued after
+            # the in-flight step's sampler — no host round trip.
+            inputs = self._substitute_feed(plan, inputs)
+        s = int(inputs.kv_lens.shape[0])
+        stop_tokens, limits, min_req = self._pack_stop_state(plan, s)
+        ms = dict(
+            stop_tokens=jnp.asarray(stop_tokens),
+            limit=jnp.asarray(limits),
+            min_req=jnp.asarray(min_req),
+            stopped=jnp.asarray(limits <= 0),
+            produced=jnp.zeros((s,), jnp.int32),
+        )
+        steps0 = None
         if sampled:
-            s = int(inputs.kv_lens.shape[0])
-            temp, top_k, top_p, min_p, seeds, steps, _ = (
+            temp, top_k, top_p, min_p, seeds, steps0, _ = (
                 self._pack_base_sampling(plan, s)
             )
-            samp = dict(
+            ms.update(
                 temp=jnp.asarray(temp), top_k=jnp.asarray(top_k),
                 top_p=jnp.asarray(top_p), min_p=jnp.asarray(min_p),
                 seeds=jnp.asarray(seeds),
             )
-        if sampled and self._jit_multistep_sampled is None:
-            self._jit_multistep_sampled = self._build_multistep(True)
-        if not sampled and self._jit_multistep is None:
-            self._jit_multistep = self._build_multistep(False)
-        # Dispatch all m windows back-to-back: window j+1 consumes window
-        # j's on-device carry, so no host sync happens inside the chain
-        # (jax async dispatch keeps the device busy while earlier windows'
-        # tokens stream back below).
+            window_key = jax.random.fold_in(self._base_key, self._step_count)
+        fn = self._jit_multistep.get((k, sampled))
+        if fn is None:
+            fn = self._jit_multistep[(k, sampled)] = (
+                self._build_multistep(k, sampled)
+            )
+        # Enqueue all m windows back-to-back: window j+1 consumes window
+        # j's on-device carry (feed token, context, stop mask), so no
+        # host sync happens anywhere inside the chain — the whole thing
+        # runs behind jax async dispatch until resolve() reads it back.
         windows = []
         feed, ctx = inputs.token_ids, inputs.kv_lens
-        if sampled:
-            window_key = jax.random.fold_in(self._base_key, self._step_count)
+        stopped, produced = ms["stopped"], ms["produced"]
         for w in range(m):
             step_inputs = dataclasses.replace(
                 inputs, token_ids=feed, kv_lens=ctx
             )
+            ms_w = dict(ms, stopped=stopped, produced=produced)
             if sampled:
-                samp_w = dict(
-                    samp,
+                ms_w.update(
                     key=jax.random.fold_in(window_key, w),
-                    steps=jnp.asarray(steps + w * k),
+                    steps=jnp.asarray(steps0 + w * k),
                 )
-                tokens, self.kv, feed, ctx = self._jit_multistep_sampled(
-                    self.params, self.kv, step_inputs, samp_w
-                )
-            else:
-                tokens, self.kv, feed, ctx = self._jit_multistep(
-                    self.params, self.kv, step_inputs
-                )
+            tokens, self.kv, feed, ctx, stopped, produced = fn(
+                self.params, self.kv, step_inputs, ms_w
+            )
             windows.append(tokens)
         self._last_fused_steps = m * k
+        for arr in (*windows, produced):
+            # Start the D2H copies NOW so resolve()'s readback finds the
+            # bytes pre-staged instead of blocking the step thread.
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:  # stubbed jit call in tests
+                pass
+        # Advance scheduler bookkeeping exactly like a normal decode
+        # dispatch (+1 computed per row, rows un-ready until their
+        # tokens resolve); resolve() adds the remaining commits and
+        # rolls this back for rows that committed nothing.
+        self.scheduler.on_batch_computed(plan)
+        step_idx = self._step_count
+        self._step_count += 1
+        ticket = StepTicket(
+            plan=plan, step_idx=step_idx, t0=t0,
+            ms_windows=windows, ms_state=(stopped, produced),
+            dispatch_seq=self._dispatch_seq,
+        )
+        ticket.host_ms = (time.perf_counter() - t0) * 1000.0
+        self._inflight.append(ticket)
+        return ticket
 
-        total = 0
-        done = [False] * len(plan.seqs)
-        for tokens in windows:
-            tokens = np.asarray(tokens)                 # [k, S]
+    def _resolve_multistep(self, ticket: StepTicket) -> StepOutputs:
+        """Complete a multi-step decode window chain: ONE device->host
+        readback for all window tokens plus the final stop state
+        (copies started at dispatch), then per-token ``commit_token`` so
+        the radix/digest/trace/metrics planes see exactly the committed
+        stream. The device's per-row ``produced`` count bounds the
+        commits — tokens past a row's device stop point are feed
+        repeats and are rolled back here, never committed, and
+        ``num_computed_tokens`` only ever advances by the commit count,
+        so prefix-cache donation can never expose phantom KV. A row an
+        abort/stop-string raced mid-window commits nothing and its
+        dispatch-time +1 computed advance is rolled back too."""
+        plan = ticket.plan
+        t_r0 = time.perf_counter()
+        try:
+            tb = time.perf_counter()
+            toks = np.concatenate(
+                [np.asarray(w) for w in ticket.ms_windows], axis=0
+            )                                           # [m*k, S]
+            produced = np.asarray(ticket.ms_state[1])   # i32[S]
+            device_ms = (time.perf_counter() - tb) * 1000.0
+            total = 0
             for i, seg in enumerate(plan.seqs):
                 req = seg.request
-                if done[i]:
-                    continue
                 committed = 0
-                for step in range(k):
-                    if req.status.is_finished:
-                        done[i] = True
-                        break
-                    req.commit_token(int(tokens[step, i]))
+                quota = int(produced[i])
+                while committed < quota and not req.status.is_finished:
+                    req.commit_token(int(toks[committed, i]))
                     committed += 1
-                # Every committed token's predecessor was fed, so computed
-                # KV advances by the commit count (invariant: computed ==
+                # Every committed token's predecessor was fed, so
+                # computed KV advances by the commit count; dispatch
+                # already counted one step (invariant: computed ==
                 # len(all_token_ids) - 1 while generating).
-                req.num_computed_tokens += committed
+                req.num_computed_tokens += committed - 1
                 req.ready_for_step = not req.status.is_finished
                 total += committed
-        if self._needs_state and self.cache.enable_prefix_cache:
-            # Opportunistic decode snapshots: the on-device state is at
-            # the window end, so a snapshot fires only when that lands on
-            # an aligned boundary (per-step decode hits every boundary;
-            # fused windows hit them when (context + j*k) % page == 0).
-            # Rows that FINISHED mid-window are excluded: the device ran
-            # their state past the committed context (surplus scan
-            # steps), so a snapshot would resume a future request from an
-            # over-advanced recurrence.
-            live = [s for s in plan.seqs if not s.request.status.is_finished]
-            if live:
-                self._maybe_snapshot_state(BatchPlan(live))
-        return total
+            if self._needs_state and self.cache.enable_prefix_cache:
+                # Opportunistic decode snapshots: the on-device state is
+                # at the window end; with the stop mask frozen rows'
+                # recurrence still ran surplus scan steps (state updates
+                # are not slot-gated), so rows that FINISHED mid-window
+                # stay excluded — a snapshot would resume a future
+                # request from an over-advanced recurrence.
+                live = [
+                    s for s in plan.seqs
+                    if not s.request.status.is_finished
+                ]
+                if live:
+                    self._maybe_snapshot_state(BatchPlan(live))
+        except Exception:
+            self._abandon(plan)
+            raise
+        now = time.perf_counter()
+        dt = (now - ticket.t0) * 1000.0
+        host_ms = ticket.host_ms + (now - t_r0) * 1000.0
+        overlapped = self._dispatch_seq != ticket.dispatch_seq
+        # Amortize the latency EWMA over steps actually DELIVERED (the
+        # average committed depth per row), not the planned m*k — rows
+        # stopping early mid-window would otherwise understate the
+        # per-step latency the global scheduler uses for placement.
+        steps_done = max(1, -(-total // max(1, len(plan.seqs))))
+        self._record_latency(plan, host_ms / steps_done)
+        self.step_timing.update(host_ms, device_ms, overlapped,
+                                tokens=total)
+        if total:
+            self._h_batch_tokens.observe(total)
+        if self._traced:
+            self._trace_plan(plan, ticket.t0, now)
+        return StepOutputs(
+            forward=[],
+            finished=self._collect_finished(),
+            num_tokens=total,
+            step_time_ms=dt,
+            host_ms=host_ms,
+            device_ms=device_ms,
+            overlapped=overlapped,
+        )
 
     # -- speculative decoding (prompt-lookup) -----------------------------
 
@@ -1720,6 +1868,7 @@ class StageEngine:
                 "the oldest ticket first (one-in-flight invariant)"
             )
         t0 = time.perf_counter()
+        self._dispatch_seq += 1
 
         def _done(outputs: StepOutputs) -> StepTicket:
             return StepTicket(
@@ -1767,20 +1916,16 @@ class StageEngine:
             # on, so the default config pays one falsy check here.
             self._trace_queue_wait(plan)
         # Rows fed from the device-resident last-token array: their token
-        # value is unknown to the host, so the fused paths (which read
-        # host token ids) must not run this step.
+        # value is unknown to the host, so the speculative path (which
+        # reads host token ids for its proposals) must not run this
+        # step. The multi-step window handles fed rows natively via the
+        # on-device last-token gather.
         fed_rows = any(seg.device_token for seg in plan.seqs)
         if sp_plan is None and not fed_rows:
             committed = self._try_speculative(plan)
-            ewma_steps = 1  # speculation = one forward's worth of latency
-            if committed is None:
-                committed = self._try_multistep(plan)
-                ewma_steps = getattr(
-                    self, "_last_fused_steps", self.cfg.decode_lookahead
-                )
             if committed is not None:
                 dt = (time.perf_counter() - t0) * 1000.0
-                self._update_latency_ewma(dt / ewma_steps)
+                self._update_latency_ewma(dt)
                 self._step_count += 1
                 return _done(StepOutputs(
                     forward=[],
@@ -1789,12 +1934,18 @@ class StageEngine:
                     step_time_ms=dt,
                     host_ms=dt,
                 ))
-            if (
-                self.cfg.speculative_tokens > 0
-                and self.model.is_first
-                and not self.model.is_last
-            ):
-                self._extend_plan_pp_spec(plan)
+        if sp_plan is None:
+            ticket = self._dispatch_multistep(plan, t0)
+            if ticket is not None:
+                return ticket
+        if (
+            sp_plan is None
+            and not fed_rows
+            and self.cfg.speculative_tokens > 0
+            and self.model.is_first
+            and not self.model.is_last
+        ):
+            self._extend_plan_pp_spec(plan)
 
         hidden = None
         if not self.model.is_first:
@@ -1871,6 +2022,7 @@ class StageEngine:
             plan=plan, step_idx=step_idx, t0=t0, inputs=inputs, out=out,
             spec_rows=spec_rows or None,
             sync_only=sp_plan is not None or bool(spec_rows),
+            dispatch_seq=self._dispatch_seq,
         )
         if not self.model.is_last:
             # Start the hidden-state device->host copy NOW (the same
@@ -1921,13 +2073,16 @@ class StageEngine:
         if ticket.outputs is not None:
             o = ticket.outputs
             if o.num_tokens:
-                self.step_timing.update(o.host_ms, o.device_ms, o.overlapped)
+                self.step_timing.update(o.host_ms, o.device_ms, o.overlapped,
+                                        tokens=o.num_tokens)
                 self._h_batch_tokens.observe(o.num_tokens)
                 if self._traced:
                     self._trace_plan(
                         ticket.plan, ticket.t0, time.perf_counter()
                     )
             return o
+        if ticket.ms_windows is not None:
+            return self._resolve_multistep(ticket)
         plan = ticket.plan
         t_r0 = time.perf_counter()
         device_ms = 0.0
@@ -1958,7 +2113,7 @@ class StageEngine:
         now = time.perf_counter()
         dt = (now - ticket.t0) * 1000.0
         host_ms = ticket.host_ms + (now - t_r0) * 1000.0
-        overlapped = self._step_count != ticket.step_idx + 1
+        overlapped = self._dispatch_seq != ticket.dispatch_seq
         # Latency EWMA: an overlapped ticket's t0->resolve span covers
         # the interleaved next dispatch too; the per-iteration cost the
         # scheduler should see is the host-blocking time (which already
@@ -1966,7 +2121,13 @@ class StageEngine:
         # Sync tickets' host_ms equals their full wall, so the EWMA is
         # unchanged there.
         self._record_latency(plan, host_ms)
-        self.step_timing.update(host_ms, device_ms, overlapped)
+        # Per-token series count tokens EMITTED toward output streams
+        # this visit (one per sampling row), not prefill chunk tokens —
+        # a 2048-token prompt chunk would otherwise record near-zero
+        # "per-token" host cost into the TPOT-facing histogram.
+        emitted = sum(1 for seg in plan.seqs if self._needs_token(seg))
+        self.step_timing.update(host_ms, device_ms, overlapped,
+                                tokens=emitted)
         if plan.total_new_tokens:
             self._h_batch_tokens.observe(plan.total_new_tokens)
         if self._traced:

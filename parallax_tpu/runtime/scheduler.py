@@ -377,6 +377,70 @@ class Scheduler:
             self._decode_cursor += len(seqs)
         return seqs
 
+    # -- multi-step decode planning ---------------------------------------
+
+    def plan_decode_window(
+        self, plan: BatchPlan, k: int, max_windows: int,
+        max_model_len: int,
+    ) -> int:
+        """``decode_lookahead=K`` planning: pre-allocate KV pages for a
+        chain of up to ``max_windows`` k-token decode windows over
+        ``plan``'s rows, all-or-nothing.
+
+        Returns the number of windows (>= 1) whose pages are guaranteed
+        RIGHT NOW, or 0 when the allocator (or host-tier pressure behind
+        it) cannot guarantee even one window — the caller then falls
+        back to single-step decode, whose normal path owns preemption
+        and kv_oom decisions. Lookahead planning never preempts and the
+        chain is sized against pages free right now, so a failed probe
+        leaves no speculative allocations or evictions behind; only the
+        final single-window ``ensure_capacity`` may evict from the
+        prefix tree, exactly as a single-step +1 probe would.
+
+        The chain is clamped to every row's context room below
+        ``max_model_len`` and to the largest remaining generation budget
+        (windows past every row's ``max_new_tokens`` are pure waste);
+        device-fed rows count their pending uncommitted token.
+        """
+        m = max(1, max_windows)
+        want = 1
+        for seg in plan.seqs:
+            room = (max_model_len - seg.context_len) // k
+            if room < 1:
+                return 0
+            m = min(m, room)
+            pending = int(
+                seg.device_token
+                and seg.request.total_len < seg.context_len
+            )
+            want = max(
+                want,
+                seg.request.sampling_params.max_new_tokens
+                - len(seg.request.output_ids) - pending,
+            )
+        m = min(m, max(1, -(-want // k)))
+
+        def _extra_pages(mm: int) -> int:
+            return sum(
+                max(
+                    0,
+                    self.cache.pages_needed(seg.context_len + mm * k)
+                    - len(seg.request.page_ids),
+                )
+                for seg in plan.seqs
+            )
+
+        while m > 1 and _extra_pages(m) > self.cache.num_free_pages:
+            m -= 1
+        if not all(
+            self.cache.ensure_capacity(
+                seg.request, seg.context_len + m * k
+            )
+            for seg in plan.seqs
+        ):
+            return 0
+        return m
+
     # -- step feedback ----------------------------------------------------
 
     def on_batch_computed(self, plan: BatchPlan) -> None:

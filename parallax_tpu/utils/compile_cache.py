@@ -1,0 +1,106 @@
+"""Compile-time hygiene: persistent XLA compilation cache + compile
+observability.
+
+Production restarts and autoscale events re-trace every program in the
+engine's shape lattice; without a persistent cache each new process pays
+the full recompilation storm before serving its first token. The serving
+entrypoints (``serve``/``join``/``generate``/bench) therefore enable
+JAX's persistent compilation cache by default — executables land under a
+configurable directory and later processes load them from disk.
+
+The compile COUNT is the matching observability signal
+(``parallax_xla_compiles_total``): a healthy steady-state process
+compiles during warmup and then stops; a counter that keeps climbing
+means the bucketing lattice is leaking shapes (the compile-storm
+signal the power-of-two decode buckets exist to prevent).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from parallax_tpu.utils import get_logger
+
+logger = get_logger(__name__)
+
+_DEFAULT_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "parallax_tpu", "xla_cache"
+)
+# JAX duration events fired once per backend compilation (jaxpr tracing
+# and MLIR lowering fire their own events; only the backend compile is
+# the expensive storm signal).
+_COMPILE_EVENT = "backend_compile"
+
+_lock = threading.Lock()
+_active_path: str | None = None
+_counter_registered = False
+
+
+def enable_compilation_cache(path: str | None = None) -> str | None:
+    """Enable the persistent XLA compilation cache; returns the active
+    directory or None when disabled/unavailable. Never raises — cache
+    trouble must not take serving down.
+
+    ``path`` resolution: an explicit argument wins; else the
+    ``PARALLAX_TPU_COMPILE_CACHE`` env var; else
+    ``~/.cache/parallax_tpu/xla_cache``. Pass ``"off"`` (or ``"0"`` /
+    ``"none"`` / an empty string) to disable explicitly.
+    """
+    global _active_path
+    if path is None:
+        path = os.environ.get("PARALLAX_TPU_COMPILE_CACHE", _DEFAULT_DIR)
+    if not path or str(path).lower() in ("off", "0", "none", "disabled"):
+        return None
+    try:
+        import jax
+
+        path = os.path.abspath(os.path.expanduser(str(path)))
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # Cache small entries too: the engine's lattice is many small
+        # programs, and the storm being avoided is exactly their sum.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:  # pragma: no cover - backend/version specific
+        logger.warning("persistent compilation cache disabled: %s", e)
+        return None
+    with _lock:
+        _active_path = path
+    register_compile_counter()
+    logger.info("persistent XLA compilation cache at %s", path)
+    return path
+
+
+def active_cache_dir() -> str | None:
+    """The enabled cache directory, or None."""
+    return _active_path
+
+
+def register_compile_counter() -> None:
+    """Expose compiles-per-process as ``parallax_xla_compiles_total`` in
+    the metrics registry (idempotent; never raises). Counts JAX's
+    per-backend-compilation monitoring events, so persistent-cache HITS
+    do not count — the series measures real compile work only."""
+    global _counter_registered
+    with _lock:
+        if _counter_registered:
+            return
+        _counter_registered = True
+    try:
+        from jax import monitoring
+
+        from parallax_tpu.obs.registry import get_registry
+
+        counter = get_registry().counter(
+            "parallax_xla_compiles_total",
+            "XLA backend compilations performed by this process",
+        ).labels()
+
+        def _on_duration(event: str, duration: float, **kw) -> None:
+            if _COMPILE_EVENT in event:
+                counter.inc()
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception as e:  # pragma: no cover - defensive; obs only
+        logger.debug("compile counter unavailable: %s", e)

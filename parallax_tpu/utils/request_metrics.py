@@ -26,19 +26,31 @@ class StepTimingAggregator:
     (``obs/registry.py``) so ``/metrics`` and cluster-wide heartbeat
     merges see full distributions, not just EWMAs — one choke point for
     every resolve path (sync, deferred-sampler, fused multistep).
+
+    Multi-step decode commits K tokens per host visit, so the aggregator
+    keeps TWO series: per-HOST-VISIT cost (``host_ms_ewma`` — what a
+    dispatch/resolve pair blocks the step thread for) and per-TOKEN cost
+    (``per_token_host_ms_ewma`` — the visit cost amortized over the
+    tokens it committed, the number TPOT actually pays). Conflating the
+    two made a K-step world look K-times slower per dispatch than the
+    K=1 one it beats.
     """
 
-    def __init__(self, alpha: float = 0.2, host_hist=None, device_hist=None):
+    def __init__(self, alpha: float = 0.2, host_hist=None, device_hist=None,
+                 per_token_hist=None):
         self.alpha = alpha
         self.host_ms_ewma: float | None = None
         self.device_ms_ewma: float | None = None
+        self.per_token_host_ms_ewma: float | None = None
         self.steps = 0
+        self.tokens = 0
         self.overlapped_steps = 0
         self.host_hist = host_hist
         self.device_hist = device_hist
+        self.per_token_hist = per_token_hist
 
     def update(self, host_ms: float, device_ms: float,
-               overlapped: bool) -> None:
+               overlapped: bool, tokens: int = 1) -> None:
         a = self.alpha
         self.host_ms_ewma = (
             host_ms if self.host_ms_ewma is None
@@ -49,26 +61,43 @@ class StepTimingAggregator:
             else (1 - a) * self.device_ms_ewma + a * device_ms
         )
         self.steps += 1
+        self.tokens += max(0, tokens)
         if overlapped:
             self.overlapped_steps += 1
         if self.host_hist is not None:
             self.host_hist.observe(host_ms)
         if self.device_hist is not None:
             self.device_hist.observe(device_ms)
+        if tokens > 0:
+            per_tok = host_ms / tokens
+            self.per_token_host_ms_ewma = (
+                per_tok if self.per_token_host_ms_ewma is None
+                else (1 - a) * self.per_token_host_ms_ewma + a * per_tok
+            )
+            if self.per_token_hist is not None:
+                self.per_token_hist.observe(per_tok)
 
     def summary(self) -> dict | None:
         """Heartbeat/status payload; None before the first step."""
         if not self.steps:
             return None
-        return {
+        d = {
             "host_ms_ewma": round(self.host_ms_ewma, 3),
             "device_ms_ewma": round(self.device_ms_ewma, 3),
             "steps": self.steps,
+            "host_visits": self.steps,
+            "tokens": self.tokens,
+            "tokens_per_visit": round(self.tokens / self.steps, 2),
             "overlapped_steps": self.overlapped_steps,
             "overlap_fraction": round(
                 self.overlapped_steps / self.steps, 3
             ),
         }
+        if self.per_token_host_ms_ewma is not None:
+            d["per_token_host_ms_ewma"] = round(
+                self.per_token_host_ms_ewma, 3
+            )
+        return d
 
 
 class CacheStats:

@@ -741,9 +741,9 @@ def test_swarm_slow_peer_does_not_stall_dispatch():
         agg = head.engine.step_timing
         orig = agg.update
 
-        def record(h, d, o):
+        def record(h, d, o, tokens=1):
             host_ms.append(h)
-            orig(h, d, o)
+            orig(h, d, o, tokens=tokens)
 
         agg.update = record
         reqs, events = _submit_batch(head, "sl", n=2, max_new=6)

@@ -54,7 +54,7 @@ def test_multistep_matches_single_step_exactly():
     for b, m in zip(base, multi):
         assert m.output_ids == b.output_ids, (b.output_ids, m.output_ids)
         assert m.status == b.status
-    assert eng._jit_multistep is not None  # the path actually ran
+    assert (4, False) in eng._jit_multistep  # the path actually ran
 
 
 def test_multistep_respects_max_tokens_and_eos():
@@ -127,8 +127,8 @@ def test_multistep_sampled_seeded_matches_single_step_exactly():
     specs = [([3, 14, 15, 92], 0.9, 7), ([7, 21, 108], 1.3, 11)]
     base, beng = _run_sampled(1, specs)
     multi, meng = _run_sampled(4, specs)
-    assert meng._jit_multistep_sampled is not None  # fused path ran
-    assert beng._jit_multistep_sampled is None
+    assert (4, True) in meng._jit_multistep  # fused-sampler variant ran
+    assert not beng._jit_multistep
     for b, m in zip(base, multi):
         assert m.output_ids == b.output_ids, (b.output_ids, m.output_ids)
 
@@ -138,7 +138,7 @@ def test_multistep_sampled_mixed_greedy_rows_stay_greedy():
     variant; the greedy rows' outputs must equal the pure-greedy run."""
     specs = [([5, 6, 7, 8], 0.0, None), ([9, 10, 11], 1.0, 3)]
     mixed, meng = _run_sampled(4, specs)
-    assert meng._jit_multistep_sampled is not None
+    assert (4, True) in meng._jit_multistep
     greedy_only, _ = _run_sampled(1, [([5, 6, 7, 8], 0.0, None)])
     assert mixed[0].output_ids == greedy_only[0].output_ids
     # seeded row reproducible vs its single-step stream too
@@ -169,8 +169,7 @@ def test_multistep_falls_back_for_penalized_requests():
     pipe.run_until_complete()
     assert len(req.output_ids) == 5
     # penalties need per-step host state: neither fused variant may run
-    assert eng._jit_multistep is None
-    assert eng._jit_multistep_sampled is None
+    assert not eng._jit_multistep
 
 
 def test_multistep_mixed_arrivals():
@@ -212,7 +211,7 @@ def test_pipelined_windows_match_single_step_exactly():
     for b, m in zip(base, piped):
         assert m.output_ids == b.output_ids, (b.output_ids, m.output_ids)
         assert m.status == b.status
-    assert eng._jit_multistep is not None
+    assert (4, False) in eng._jit_multistep
     assert eng._last_fused_steps == 12  # 3 windows x k=4 actually chained
 
 
@@ -291,8 +290,10 @@ def _hybrid_run(lookahead, prompts, max_new=10, pipeline=1, seed=None,
                      decode_pipeline=pipeline),
     )
     windows = []
-    orig = eng._try_multistep
-    eng._try_multistep = lambda plan: windows.append(1) or orig(plan)
+    orig = eng._dispatch_multistep
+    eng._dispatch_multistep = (
+        lambda plan, t0: windows.append(1) or orig(plan, t0)
+    )
     pipe = InProcessPipeline([eng])
     reqs = []
     for i, p in enumerate(prompts):
@@ -385,3 +386,271 @@ def test_hybrid_mid_window_finish_never_snapshots_overrun_state():
     assert r1.output_ids == o1.output_ids
     r2 = run(eng, "r2", convo + [40, 41], 6)
     assert r2.output_ids == o2.output_ids   # over-advanced state never used
+
+
+# -- async window on the overlapped drive loop -------------------------------
+
+
+def _drive(eng, max_iters=2000):
+    """The one-in-flight loop every production driver runs."""
+    from parallax_tpu.runtime.engine import drive_step
+
+    outs_all = []
+    pending = None
+    iters = 0
+    while (eng.has_work() or pending is not None) and iters < max_iters:
+        iters += 1
+        outs, pending = drive_step(eng, pending)
+        outs_all.extend(outs)
+    assert pending is None and not eng._inflight
+    return outs_all
+
+
+def _build_engine(lookahead, overlap=True, **cfg_kw):
+    model = StageModel(CFG, 0, 2, use_pallas=False)
+    params = model.init_params(jax.random.key(0), dtype=jnp.float32)
+    defaults = dict(page_size=8, num_pages=128, max_model_len=256,
+                    kv_dtype="float32")
+    defaults.update(cfg_kw)
+    return StageEngine(model, params, EngineConfig(
+        decode_lookahead=lookahead, overlap_steps=overlap, **defaults,
+    ))
+
+
+def _drive_requests(eng, specs, max_new=11, ignore_eos=True, eos=None):
+    reqs = []
+    for i, (prompt, temp, seed) in enumerate(specs):
+        req = Request(f"r{i}", prompt_ids=list(prompt),
+                      sampling_params=SamplingParams(
+                          temperature=temp, seed=seed,
+                          max_new_tokens=max_new, ignore_eos=ignore_eos))
+        if eos is not None:
+            req.eos_token_ids = eos
+        reqs.append(req)
+        eng.submit(req)
+    outs = _drive(eng)
+    return reqs, outs
+
+
+def test_window_rides_overlap_loop_bit_identical():
+    """The K-step window is now DISPATCHED (resolve reads the tokens +
+    stop mask back in one D2H pass), so it must ride the one-in-flight
+    drive loop and still match the fully synchronous K=1 engine
+    bit-for-bit — greedy and seeded rows alike."""
+    specs = [([3, 14, 15, 92], 0.0, None), ([7, 21, 108], 0.9, 7),
+             ([42] * 5, 1.3, 11)]
+    base, _ = _drive_requests(
+        _build_engine(1, overlap=False), specs, max_new=13)
+    over, outs = _drive_requests(_build_engine(4), specs, max_new=13)
+    for b, m in zip(base, over):
+        assert m.output_ids == b.output_ids, (b.output_ids, m.output_ids)
+        assert m.status == b.status
+    # Window visits actually happened (one resolve committing a full
+    # k * batch block) and the window flew asynchronously: it resolved
+    # only after a later dispatch had already been enqueued.
+    window_outs = [o for o in outs if o.num_tokens >= 4 * len(specs)]
+    assert window_outs, [o.num_tokens for o in outs]
+    assert any(o.overlapped for o in window_outs)
+    # Sync-mode window engine agrees too (K=4, overlap off).
+    sync4, _ = _drive_requests(
+        _build_engine(4, overlap=False), specs, max_new=13)
+    for b, m in zip(base, sync4):
+        assert m.output_ids == b.output_ids
+
+
+def test_two_stage_pipeline_window_inert_and_identical():
+    """Multi-step windows need a local ring (single full stage); on a
+    two-stage pipeline the path must stay inert — never compiled — and
+    streams must equal the K=1 run exactly."""
+    def run(lookahead):
+        m0 = StageModel(CFG, 0, 1, use_pallas=False)
+        m1 = StageModel(CFG, 1, 2, use_pallas=False)
+        p0 = m0.init_params(jax.random.key(0), dtype=jnp.float32)
+        p1 = m1.init_params(jax.random.key(1), dtype=jnp.float32)
+        ecfg = dict(page_size=8, num_pages=128, max_model_len=256,
+                    kv_dtype="float32", decode_lookahead=lookahead)
+        engines = [StageEngine(m0, p0, EngineConfig(**ecfg)),
+                   StageEngine(m1, p1, EngineConfig(**ecfg))]
+        pipe = InProcessPipeline(engines)
+        reqs = []
+        for i, prompt in enumerate([[3, 14, 15], [9, 8, 7, 6]]):
+            r = Request(f"p{i}", prompt_ids=prompt,
+                        sampling_params=SamplingParams(
+                            temperature=0.0, max_new_tokens=8,
+                            ignore_eos=True))
+            reqs.append(r)
+            pipe.submit(r)
+        pipe.run_until_complete()
+        return reqs, engines
+
+    base, _ = run(1)
+    multi, engines = run(4)
+    for b, m in zip(base, multi):
+        assert m.output_ids == b.output_ids
+    for eng in engines:
+        assert not eng._jit_multistep   # never compiled on either stage
+
+
+def test_stop_token_mid_window_no_phantom_commits():
+    """A stop token landing mid-window freezes the row on device; the
+    host rolls back the frozen tail before commit. Nothing past the stop
+    point may reach the request, the computed-KV count, or the radix
+    digest plane (prefix donation)."""
+    prompts = [[5, 6, 7, 8, 9, 10, 11, 12]]
+
+    probe = _build_engine(1, overlap=False)
+    (p,), _ = _drive_requests(probe, [(prompts[0], 0.0, None)], max_new=9)
+    # A token whose FIRST occurrence lies mid-window (index >= 2), so
+    # the stop genuinely interrupts a k=4 window partway through.
+    stop_idx = next(
+        i for i in range(2, 7)
+        if p.output_ids[i] not in p.output_ids[:i]
+    )
+    stop = (p.output_ids[stop_idx],)
+
+    def run(lookahead):
+        eng = _build_engine(lookahead, overlap=True, cache_digests=True,
+                            enable_prefix_cache=True)
+        req = Request("s", prompt_ids=list(prompts[0]),
+                      sampling_params=SamplingParams(
+                          temperature=0.0, max_new_tokens=9,
+                          stop_token_ids=stop))
+        eng.submit(req)
+        _drive(eng)
+        return req, eng
+
+    base, beng = run(1)
+    multi, meng = run(4)
+    assert multi.output_ids == base.output_ids
+    assert multi.status.value == "finished_stop"
+    assert len(multi.output_ids) == stop_idx + 1
+    # KV bookkeeping: the stop token itself was never fed, so computed
+    # sits exactly one short of the committed stream.
+    assert multi.num_computed_tokens == multi.total_len - 1
+    # Digest plane: the donated prefix chains must be identical to the
+    # K=1 run's — a phantom commit would mint extra block digests.
+    bp = beng.cache_digest_payload(full=True)
+    mp = meng.cache_digest_payload(full=True)
+    assert bp is not None and mp is not None
+    assert sorted(bp["full"]) == sorted(mp["full"])
+
+
+def test_window_fallback_under_page_pressure():
+    """When the allocator cannot guarantee K steps of KV for every row,
+    the scheduler's window planning returns 0 and decode falls back to
+    single-step — streams stay bit-identical and every request finishes
+    (with the host tier absorbing the pressure, not kv_oom)."""
+    def run(lookahead, num_pages):
+        eng = _build_engine(
+            lookahead, overlap=True, num_pages=num_pages,
+            max_model_len=128, enable_prefix_cache=True,
+            host_cache_bytes=1 << 26,
+        )
+        specs = [(list(range(1 + 7 * i, 9 + 7 * i)), 0.0, None)
+                 for i in range(4)]
+        reqs, _ = _drive_requests(eng, specs, max_new=17)
+        return reqs, eng
+
+    base, _ = run(1, num_pages=128)
+    # 4 requests x (1 prompt page + ~3 decode pages): 14 pages starves
+    # the 8-step window pre-allocation for the full batch.
+    tight, teng = run(4, num_pages=14)
+    for b, t in zip(base, tight):
+        assert t.status.value != "finished_abort", t.abort_reason
+        assert t.output_ids == b.output_ids, (b.output_ids, t.output_ids)
+    stats = teng.cache.stats
+    assert stats.kv_oom_aborts == 0
+
+
+def test_adaptive_lookahead_default_and_downshift():
+    """decode_lookahead=None (the default) runs the adaptive window and
+    downshifts to single-step while a sync-forcing request (penalties)
+    is in the batch — then windows resume once it finishes. Streams
+    match the pinned K=1 engine throughout."""
+    from parallax_tpu.runtime.engine import ADAPTIVE_DECODE_LOOKAHEAD
+
+    def run(lookahead):
+        eng = _build_engine(lookahead)
+        tickets = []
+        orig = eng._dispatch_multistep
+        eng._dispatch_multistep = (
+            lambda plan, t0: tickets.append(
+                (orig(plan, t0), [s.request.request_id for s in plan.seqs])
+            ) or tickets[-1][0]
+        )
+        clean = Request("c", prompt_ids=[3, 14, 15],
+                        sampling_params=SamplingParams(
+                            temperature=0.0, max_new_tokens=24,
+                            ignore_eos=True))
+        eng.submit(clean)
+        pen = Request("p", prompt_ids=[9, 8, 7],
+                      sampling_params=SamplingParams(
+                          temperature=0.0, max_new_tokens=4,
+                          ignore_eos=True, repetition_penalty=1.3))
+        from parallax_tpu.runtime.engine import drive_step
+
+        pending = None
+        iters = 0
+        submitted = False
+        while (eng.has_work() or pending is not None) and iters < 500:
+            iters += 1
+            if not submitted and len(clean.output_ids) >= 9:
+                eng.submit(pen)
+                submitted = True
+            _, pending = drive_step(eng, pending)
+        assert submitted
+        return clean, pen, eng, tickets
+
+    clean_a, pen_a, eng, tickets = run(None)
+    clean_b, pen_b, _, _ = run(1)
+    assert clean_a.output_ids == clean_b.output_ids
+    assert pen_a.output_ids == pen_b.output_ids
+    # Adaptive K compiled at the default cap.
+    assert (ADAPTIVE_DECODE_LOOKAHEAD, False) in eng._jit_multistep
+    # Window dispatches while the penalized request shared the batch
+    # were refused (downshift); clean-only batches got windows both
+    # before and after.
+    with_pen = [t for t, rids in tickets if "p" in rids]
+    assert with_pen and all(t is None for t in with_pen)
+    solo = [t for t, rids in tickets if rids == ["c"]]
+    assert any(t is not None for t in solo)
+
+
+def test_window_respects_min_new_tokens():
+    """min_new_tokens suppresses EOS inside the device stop mask exactly
+    as commit_token does on the host."""
+    prompts = [(list([5, 6, 7, 8]), 0.0, None)]
+    probe, _ = _drive_requests(_build_engine(1, overlap=False), prompts,
+                               max_new=10)
+    eos = (probe[0].output_ids[1],)   # 2nd greedy token is EOS
+
+    def run(lookahead, min_new):
+        eng = _build_engine(lookahead)
+        req = Request("m", prompt_ids=[5, 6, 7, 8],
+                      sampling_params=SamplingParams(
+                          temperature=0.0, max_new_tokens=10,
+                          min_new_tokens=min_new))
+        req.eos_token_ids = eos
+        eng.submit(req)
+        _drive(eng)
+        return req
+
+    for min_new in (0, 5):
+        base = run(1, min_new)
+        multi = run(4, min_new)
+        assert multi.output_ids == base.output_ids, min_new
+        assert multi.status == base.status
+
+
+def test_step_timing_splits_per_visit_and_per_token():
+    """The K>1 world must report honest TPOT: per-host-visit and
+    per-token series are separate, and a window run shows multiple
+    tokens per visit."""
+    eng = _build_engine(4)
+    specs = [([3, 14, 15, 92], 0.0, None), ([7, 21, 108], 0.0, None)]
+    _drive_requests(eng, specs, max_new=9)
+    s = eng.step_timing.summary()
+    assert s["host_visits"] == s["steps"]
+    assert s["tokens"] >= 2 * 9
+    assert s["tokens_per_visit"] > 1.0
+    assert 0.0 < s["per_token_host_ms_ewma"] < s["host_ms_ewma"]
