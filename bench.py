@@ -746,6 +746,216 @@ def _routing_probe(cfg, stage_params_fn, kv_dtype, page_size):
     }
 
 
+def _churn_probe(cfg, stage_params_fn, kv_dtype, page_size):
+    """Node-churn robustness probe (docs/resilience.md): a 4-worker
+    loopback swarm forming two 2-stage pipelines behind a cache-aware
+    scheduler, serving the same greedy+seeded request set twice — once
+    clean, once with a chaos-injected kill of a pipeline's TAIL stage
+    mid-decode. The live-migration flow must absorb the kill: every
+    affected request is checkpointed off the surviving head, restored on
+    the other pipeline, and finishes with 0 aborts and streams
+    bit-identical to the clean run. Returns ``detail.churn`` with the
+    park->resume migration latency p50/p95 (the CI chaos smoke asserts
+    this whole contract)."""
+    import dataclasses as _dc
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from parallax_tpu.backend.run import SwarmClient
+    from parallax_tpu.backend.scheduler_service import SchedulerService
+    from parallax_tpu.obs.registry import get_registry, summarize_snapshots
+    from parallax_tpu.p2p.node import WorkerNode
+    from parallax_tpu.p2p.transport import LoopbackTransport
+    from parallax_tpu.runtime.engine import EngineConfig
+    from parallax_tpu.runtime.request import Request, SamplingParams
+    from parallax_tpu.scheduling import node as sched_node
+    from parallax_tpu.scheduling.scheduler import GlobalScheduler
+    from parallax_tpu.testing.chaos import ChaosController
+
+    n_req, prompt_len, gen_len = 4, 2 * page_size, 24
+    max_model_len = prompt_len + gen_len + 2 * page_size
+    split = max(1, cfg.num_hidden_layers // 2)
+
+    rng = np.random.default_rng(17)
+    requests = []
+    for i in range(n_req):
+        sp = (
+            SamplingParams(temperature=0.0, max_new_tokens=gen_len,
+                           ignore_eos=True)
+            if i % 2 == 0 else
+            SamplingParams(temperature=0.8, top_k=8, seed=97 + i,
+                           max_new_tokens=gen_len, ignore_eos=True)
+        )
+        prompt = [int(x) for x in rng.integers(
+            1, cfg.vocab_size - 1, size=prompt_len
+        )]
+        requests.append((prompt, sp))
+
+    chaos = ChaosController(seed=17)
+    registry: dict = {}
+    # Two 2-stage pipelines: cap what one node may hold at half the
+    # model so the allocator splits each pipeline across two workers.
+    orig_cap = sched_node.RooflinePerformanceModel.max_layers_in_memory
+    sched_node.RooflinePerformanceModel.max_layers_in_memory = (
+        lambda self, kv_fraction=0.35: split
+    )
+    sched = GlobalScheduler(cfg, min_nodes_bootstrapping=2,
+                            heartbeat_timeout_s=3.0,
+                            routing="cache_aware")
+    service = SchedulerService(
+        sched, chaos.wrap(LoopbackTransport("sched", registry)),
+        join_timeout_s=60.0,
+    )
+    service.start()
+    ecfg = EngineConfig(
+        page_size=page_size,
+        num_pages=n_req * (max_model_len // page_size + 2) + 16,
+        max_batch_size=n_req, max_model_len=max_model_len,
+        kv_dtype=kv_dtype, enable_prefix_cache=True,
+    )
+    workers = [
+        WorkerNode(
+            transport=chaos.wrap(LoopbackTransport(f"ch{i}", registry)),
+            scheduler_peer="sched",
+            model_config=cfg,
+            engine_config=_dc.replace(ecfg),
+            load_params=stage_params_fn,
+            heartbeat_interval_s=0.1,
+        )
+        for i in range(4)
+    ]
+    by_id = {w.node_id: w for w in workers}
+
+    def serve(tag: str, on_tokens=None) -> list:
+        reqs, evs = [], []
+        for i, (prompt, sp) in enumerate(requests):
+            rid = f"{tag}-{i}"
+            path = client.route(rid, prompt_ids=list(prompt))
+            if not path:
+                continue
+            req = Request(
+                request_id=rid, prompt_ids=list(prompt),
+                sampling_params=_dc.replace(sp),
+                routing_table=list(path),
+            )
+            evs.append(client.submit(req))
+            reqs.append(req)
+        if on_tokens is not None:
+            fired = set()
+            deadline = _time.monotonic() + 60.0
+            while len(fired) < len(reqs) and _time.monotonic() < deadline:
+                for i, req in enumerate(reqs):
+                    if i not in fired and (
+                        len(req.output_ids) >= 2
+                        or req.status.is_finished
+                    ):
+                        fired.add(i)
+                        on_tokens(req)
+                _time.sleep(0.002)
+        for ev in evs:
+            ev.wait(120.0)
+        return reqs
+
+    def summarize(reqs: list) -> dict:
+        return {
+            "requests": len(reqs),
+            "completed": sum(
+                1 for r in reqs
+                if r.status.is_finished
+                and r.status.value != "finished_abort"
+            ),
+            "aborted": sum(
+                1 for r in reqs if r.status.value == "finished_abort"
+            ),
+        }
+
+    def migrations_total() -> int:
+        try:
+            return int(get_registry().counter(
+                "parallax_migrations_total",
+                "Requests restored on this head after a live migration "
+                "or client resume",
+                labelnames=("mode",),
+            ).total)
+        except Exception:
+            return 0
+
+    try:
+        starters = [threading.Thread(target=w.start) for w in workers]
+        for s in starters:
+            s.start()
+        for s in starters:
+            s.join(timeout=120.0)
+        deadline = _time.time() + 120
+        while _time.time() < deadline:
+            st = sched.cluster_status()
+            if st["num_pipelines"] >= 2 and all(
+                n["ready"] for p in st["pipelines"] for n in p["nodes"]
+            ):
+                break
+            _time.sleep(0.02)
+        client = SwarmClient(
+            chaos.wrap(LoopbackTransport("client", registry)), service,
+            poll_interval_s=0.002,
+        )
+
+        baseline = serve("base")
+        base_streams = {
+            r.request_id.split("-", 1)[1]: list(r.output_ids)
+            for r in baseline
+        }
+
+        migrations_before = migrations_total()
+        victim: dict = {}
+        lock = threading.Lock()
+
+        def kill_tail(req):
+            with lock:
+                if victim or len(req.routing_table) < 2:
+                    return
+                tail = req.routing_table[-1]
+                victim["tail"] = tail
+                t0 = _time.perf_counter()
+                chaos.kill(by_id[tail])
+                victim["kill_s"] = _time.perf_counter() - t0
+
+        churn = serve("churn", on_tokens=kill_tail)
+        migrated = migrations_total() - migrations_before
+        bit_identical = bool(churn) and all(
+            list(r.output_ids)
+            == base_streams.get(r.request_id.split("-", 1)[1])
+            for r in churn
+        )
+        mig_ms = (
+            summarize_snapshots(get_registry().histogram_snapshots())
+            .get("parallax_migration_ms") or {}
+        ).get("", {})
+        return {
+            "workload": {
+                "requests": n_req, "prompt_len": prompt_len,
+                "gen_len": gen_len, "pipelines": 2, "stages": 2,
+            },
+            "baseline": summarize(baseline),
+            "churn": {
+                **summarize(churn),
+                "killed_node": victim.get("tail"),
+                "migrations": migrated,
+                "bit_identical": bit_identical,
+                "migration_ms": {
+                    k: mig_ms.get(k) for k in ("count", "p50", "p95")
+                } if mig_ms else {},
+            },
+        }
+    finally:
+        sched_node.RooflinePerformanceModel.max_layers_in_memory = orig_cap
+        for w in workers:
+            if not chaos.is_dead(w.node_id):
+                w.stop()
+        service.stop()
+
+
 def _obs_metrics() -> dict:
     """p50/p95/p99 summary of the process metrics registry (the series
     the engine's TTFT/TPOT/step histograms accumulated this run)."""
@@ -1297,6 +1507,22 @@ def _bench():
             ),
             kv_dtype=kv_dtype, page_size=page_size,
         )
+
+    # Node-churn robustness probe: a two-replica two-stage loopback
+    # swarm, served clean and then with a chaos-killed tail stage
+    # mid-decode. The live-migration flow must deliver 0 aborts and
+    # bit-identical streams, with park->resume latency reported as
+    # p50/p95 (the CI chaos smoke asserts the contract). Cheap on CPU
+    # (part of the smoke contract); opt-in on TPU.
+    churn_probe = None
+    if not on_tpu or os.environ.get("BENCH_CHURN"):
+        churn_probe = _churn_probe(
+            cfg, stage_params_fn=lambda m: m.init_params(
+                jax.random.key(m.start_layer * 1000 + m.end_layer),
+                dtype=dtype,
+            ),
+            kv_dtype=kv_dtype, page_size=page_size,
+        )
     total_s = time.perf_counter() - t_start
 
     # Decode throughput over the whole decode phase (wall-clock, includes
@@ -1463,6 +1689,13 @@ def _bench():
             **(
                 {"routing": routing_probe}
                 if routing_probe is not None else {}
+            ),
+            # Node-churn probe (chaos-killed tail stage mid-decode vs
+            # clean run): 0 aborts, bit-identical migrated streams,
+            # park->resume migration latency p50/p95.
+            **(
+                {"churn": churn_probe}
+                if churn_probe is not None else {}
             ),
             **(
                 {

@@ -40,6 +40,11 @@ class SwarmClient:
         self.default_head = default_head
         # rid -> head node id, for stop-string early finish.
         self._heads: dict[str, str] = {}
+        # rid -> monotonic arrival at routing time: a path that dies
+        # before the first token is transparently re-routed, and the
+        # re-enqueue must carry the ORIGINAL arrival so the retry
+        # neither jumps the FCFS ladder nor looks newly arrived.
+        self._arrivals: dict[str, float] = {}
 
     def route(self, request_id: str,
               prompt_ids: list[int] | None = None,
@@ -57,10 +62,15 @@ class SwarmClient:
             except Exception:
                 return None
             return [] if isinstance(r, dict) and r.get("ready") else None
-        return self.service.route_request(
+        self._arrivals[request_id] = time.monotonic()
+        path = self.service.route_request(
             request_id, timeout_s=10.0,
             prompt_ids=prompt_ids, lora_id=lora_id,
         )
+        if not path:
+            # No submit will follow to retire the entry via _poll_loop.
+            self._arrivals.pop(request_id, None)
+        return path
 
     def submit(self, request: Request) -> threading.Event:
         if request.routing_table:
@@ -115,22 +125,129 @@ class SwarmClient:
             self._poll_until_done(request, head, ev)
         finally:
             self._heads.pop(request.request_id, None)
+            self._arrivals.pop(request.request_id, None)
+
+    def _migrated_head(self, request_id: str) -> str | None:
+        """The scheduler's where_is table: targets report restored
+        requests there, so a poller whose OLD head died after shipping
+        still finds the new one."""
+        if self.service is None:
+            return None
+        try:
+            return self.service.scheduler.migrated_head(request_id)
+        except Exception:
+            return None
+
+    def _reroute(self, request: Request) -> str | None:
+        """Post-dispatch rung of the retry ladder: the routed path died
+        before the first token, so nothing streamed — release the dead
+        path's load charge, re-enqueue with the ORIGINAL arrival time,
+        and resubmit the request verbatim to the new head. Returns the
+        new head, or None when no pipeline is serviceable (the caller
+        then falls through to the abort)."""
+        rid = request.request_id
+        try:
+            self.service.scheduler.complete_request(
+                list(request.routing_table)
+            )
+        except Exception:
+            logger.exception("releasing dead path for %s", rid)
+        try:
+            path = self.service.route_request(
+                rid, timeout_s=10.0,
+                prompt_ids=list(request.prompt_ids),
+                lora_id=request.lora_id,
+                arrival_time=self._arrivals.get(rid),
+            )
+        except Exception:
+            logger.exception("re-route for %s failed", rid)
+            path = None
+        if not path:
+            # Charge already released above; clear the table so the
+            # caller's abort fallthrough does not release it again.
+            request.routing_table[:] = []
+            return None
+        request.routing_table[:] = path
+        head = path[0]
+        try:
+            self.transport.call(head, "chat_submit", {
+                "rid": rid,
+                "prompt_ids": request.prompt_ids,
+                "sampling_params": request.sampling_params.to_dict(),
+                "routing_table": list(path),
+                "eos_token_ids": list(request.eos_token_ids),
+                "lora_id": request.lora_id,
+            }, timeout=30.0)
+        except Exception as e:
+            logger.warning("re-routed submit of %s to %s failed: %s",
+                           rid, head, e)
+            self.service.scheduler.complete_request(list(path))
+            request.routing_table[:] = []
+            return None
+        logger.info("re-routed %s onto %s (path death before first token)",
+                    rid, head)
+        return head
 
     def _poll_until_done(self, request: Request, head: str,
                          ev: threading.Event):
+        rid = request.request_id
         failures = 0
+        reroutes = 0
+
+        def follow_migration(new_head: str) -> str:
+            """Switch polling to the head that owns the request now. The
+            OLD path's load charge was released by the source head at
+            migrate-out and the NEW path's is owned by the target, so
+            the stale table must not feed a later abort-time release."""
+            request.routing_table[:] = []
+            self._heads[rid] = new_head
+            return new_head
+
+        def try_recover() -> str | None:
+            """Head unreachable / amnesiac: follow a recorded migration
+            first; failing that, re-route pre-first-token requests
+            transparently (bounded attempts)."""
+            nonlocal reroutes
+            moved = self._migrated_head(rid)
+            if moved and moved != head:
+                return follow_migration(moved)
+            if (
+                not request.output_ids
+                and self.service is not None
+                and reroutes < 2
+            ):
+                reroutes += 1
+                return self._reroute(request)
+            return None
+
         while True:
             try:
                 r = self.transport.call(
-                    head, "chat_poll", {"rid": request.request_id}, timeout=10.0
+                    head, "chat_poll", {"rid": rid}, timeout=10.0
                 )
                 failures = 0
             except Exception as e:
                 failures += 1
+                if failures % 4 == 0:
+                    # The old head may have shipped the request away
+                    # before dying: ask the scheduler's where_is table
+                    # while the unreachable-count accumulates.
+                    moved = self._migrated_head(rid)
+                    if moved and moved != head:
+                        head = follow_migration(moved)
+                        failures = 0
+                        continue
                 if failures > 10:
+                    recovered = try_recover()
+                    if recovered:
+                        head = recovered
+                        self._heads[rid] = head
+                        failures = 0
+                        continue
                     request.abort(f"head node unreachable: {e}")
                     # The worker cannot report completion anymore; release
-                    # the path's load charge here.
+                    # the path's load charge here. (Empty after a
+                    # migration follow — the target owns that charge.)
                     if self.service is not None:
                         self.service.scheduler.complete_request(
                             request.routing_table
@@ -139,7 +256,18 @@ class SwarmClient:
                     return
                 time.sleep(0.5)
                 continue
+            if r.get("migrated"):
+                # Live migration: the request now runs on another head;
+                # keep streaming from there (docs/resilience.md).
+                head = follow_migration(str(r["migrated"]))
+                continue
             if "error" in r:
+                recovered = try_recover()
+                if recovered:
+                    head = recovered
+                    self._heads[rid] = head
+                    failures = 0
+                    continue
                 request.abort(r["error"])
                 ev.set()
                 return

@@ -35,6 +35,11 @@ class SchedulerService:
         transport.register(proto.NODE_UPDATE, self._on_update)
         transport.register(proto.NODE_LEAVE, self._on_leave)
         transport.register("request_complete", self._on_request_complete)
+        # Live migration + churn robustness (docs/resilience.md).
+        transport.register(proto.PEER_DOWN, self._on_peer_down)
+        transport.register(proto.MIGRATE_TARGET, self._on_migrate_target)
+        transport.register("migration_done", self._on_migration_done)
+        transport.register("where_is", self._on_where_is)
         transport.register("__ping__", lambda *_: "pong")
 
     def start(self) -> None:
@@ -145,6 +150,11 @@ class SchedulerService:
                 if isinstance(payload.get("cache_digests"), dict)
                 else None
             ),
+            # Engine reload/compile in progress: the sweep extends this
+            # node's grace instead of declaring a compile storm dead.
+            busy=(
+                bool(payload["busy"]) if "busy" in payload else None
+            ),
         )
         alloc = self._with_model(self.scheduler.get_node_allocation(node_id) or {})
         alloc["refit_version"] = self.scheduler.refit_version
@@ -157,6 +167,13 @@ class SchedulerService:
             # A delta arrived out of sequence: the worker's next beat
             # must carry a full digest snapshot.
             alloc["digests_resync"] = True
+        drain = self.scheduler.drain_requested(node_id)
+        if drain:
+            # A pipeline through these dead peers is dissolving: the
+            # head must checkpoint the affected requests to a surviving
+            # pipeline (it asks migrate_target for destinations) instead
+            # of aborting them.
+            alloc["drain"] = drain
         return alloc
 
     def _on_leave(self, _peer: str, payload: dict) -> str:
@@ -171,11 +188,51 @@ class SchedulerService:
         )
         return "ok"
 
+    # -- live migration ------------------------------------------------------
+
+    def _on_peer_down(self, _peer: str, payload: dict) -> str:
+        """A worker's async sender declared a next-hop peer dead: mark
+        its CacheIndex stale immediately and accelerate its sweep."""
+        self.scheduler.enqueue_peer_down(
+            str(payload.get("reporter") or _peer or "?"),
+            str(payload["peer"]),
+            str(payload.get("reason") or ""),
+        )
+        return "ok"
+
+    def _on_migrate_target(self, _peer: str, payload: dict) -> dict:
+        """Destinations for a head's parked requests, scored against
+        each surviving head's CacheIndex mirror."""
+        reqs = payload.get("requests")
+        if not isinstance(reqs, list):
+            return {"targets": {}}
+        exclude = {
+            str(x) for x in (payload.get("exclude") or ())
+        }
+        return {
+            "targets": self.scheduler.choose_migration_targets(
+                [r for r in reqs if isinstance(r, dict)], exclude
+            )
+        }
+
+    def _on_migration_done(self, _peer: str, payload: dict) -> str:
+        """A target head restored a migrated request: record where it
+        lives now so pollers that lost the old head can follow."""
+        rid, head = payload.get("rid"), payload.get("head")
+        if isinstance(rid, str) and isinstance(head, str):
+            self.scheduler.record_migration(rid, head)
+        return "ok"
+
+    def _on_where_is(self, _peer: str, payload: dict) -> dict:
+        head = self.scheduler.migrated_head(str(payload.get("rid") or ""))
+        return {"head": head} if head else {}
+
     # -- routing for the HTTP plane -----------------------------------------
 
     def route_request(self, request_id: str, timeout_s: float = 5.0,
                       prompt_ids: list[int] | None = None,
-                      lora_id: str | None = None) -> list[str] | None:
+                      lora_id: str | None = None,
+                      arrival_time: float | None = None) -> list[str] | None:
         """Block until the dispatcher assigns a node path (reference
         scheduler_manage.get_routing_table, scheduler_manage.py:287-313).
 
@@ -188,7 +245,9 @@ class SchedulerService:
         meta = RequestMeta(
             request_id, prompt_ids=prompt_ids, lora_id=lora_id,
         ) if prompt_ids else None
-        pr = self.scheduler.receive_request(request_id, meta=meta)
+        pr = self.scheduler.receive_request(
+            request_id, meta=meta, arrival_time=arrival_time,
+        )
         if not pr.event.wait(timeout_s):
             # Caller gives up: mark cancelled so a late dispatch does not
             # charge node load for a path nobody will use.

@@ -209,6 +209,12 @@ class _Family:
     def value(self) -> float:
         return self._solo().value
 
+    @property
+    def total(self) -> float:
+        """Sum of every child's value across labels (counters/gauges)."""
+        with self._lock:
+            return float(sum(c.value for c in self._children.values()))
+
     def _label_str(self, key: tuple, extra: str = "") -> str:
         parts = [
             f'{n}="{_escape_label(v)}"'

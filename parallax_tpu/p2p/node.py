@@ -47,6 +47,14 @@ logger = get_logger(__name__)
 class WorkerNode:
     """Joins a swarm, serves its layer range, forwards activations."""
 
+    # Live migration: a request parked for checkpoint shipping that finds
+    # no target pipeline within this long is aborted (the client resume
+    # ladder is the rung below).
+    MIGRATION_PARK_TIMEOUT_S = 20.0
+    # Backoff between target-query attempts while no pipeline is
+    # serviceable (bootstrap/rebalance in flight).
+    MIGRATION_RETRY_S = 1.0
+
     def __init__(
         self,
         transport: Transport,
@@ -140,6 +148,23 @@ class WorkerNode:
         # Head-node bookkeeping: finished requests awaiting pickup.
         self._finished: queue.Queue[Request] = queue.Queue()
         self._request_events: dict[str, threading.Event] = {}
+        # Live migration (docs/resilience.md). All three maps are
+        # step-thread state except _migrated_to, which pollers read from
+        # transport threads (entries are write-once strings).
+        # rid -> dead peer: flagged for parking, still draining out of
+        # the engine (in-flight steps must resolve first).
+        self._migration_pending: dict[str, str] = {}
+        # rid -> park entry (request, optional KV image, timestamps).
+        self._migration_parked: dict[str, dict] = {}
+        # rid -> target head: chat_poll redirects followers here after
+        # the request shipped away (bounded; see _record_migrated).
+        from collections import OrderedDict as _OD
+
+        self._migrated_to: "_OD[str, str]" = _OD()
+        # Engine reload/compile in progress — rides heartbeats so the
+        # scheduler sweep extends this node's grace instead of declaring
+        # a first-compile storm dead.
+        self._busy_reloading = False
         # Async sender pipeline: serialization + socket latency leave
         # the step thread entirely (per-peer bounded in-order queues);
         # overflow or send failure feeds the abort_path flow.
@@ -189,6 +214,7 @@ class WorkerNode:
         transport.register("chat_poll", self._on_chat_poll)
         transport.register("chat_stop", self._on_chat_stop)
         transport.register(proto.WIRE_CAPS, self._on_wire_caps)
+        transport.register(proto.CHECKPOINT, self._on_checkpoint)
         transport.register("__ping__", lambda *_: "pong")
         # Head-node chat requests by id (polled by the HTTP frontend;
         # reference: TransformerConnectionHandler.chat_completion proxies to
@@ -260,6 +286,13 @@ class WorkerNode:
     def _apply_allocation(self, alloc: dict) -> None:
         if "start_layer" not in alloc:
             return
+        self._busy_reloading = True
+        try:
+            self._apply_allocation_inner(alloc)
+        finally:
+            self._busy_reloading = False
+
+    def _apply_allocation_inner(self, alloc: dict) -> None:
         model_switched = self._maybe_switch_model(alloc.get("model_name"))
         # Cache-aware routing: the scheduler's join/reload replies carry
         # want_digests, and the engine must be built with digest tracking
@@ -355,14 +388,27 @@ class WorkerNode:
             return
         sched = eng.scheduler
         reqs = list(sched.running.values()) + list(sched.wait_queue.values())
+        aborted = 0
         for req in reqs:
+            if (
+                not req.status.is_finished
+                and req.request_id in self._migration_pending
+            ):
+                # Flagged for migration and the engine is going away:
+                # park the token-level state NOW (force — the engine and
+                # its KV are being discarded wholesale, so no image
+                # harvest and no in-flight hazard).
+                dead = self._migration_pending.pop(req.request_id)
+                self._park_request(eng, req, dead, force=True)
+                continue
             if not req.status.is_finished:
                 req.abort(reason)
             sched.release_request(req)
             self._finish(req)
-        if reqs:
+            aborted += 1
+        if aborted:
             logger.warning("%s: aborted %d in-flight requests (%s)",
-                           self.node_id, len(reqs), reason)
+                           self.node_id, aborted, reason)
 
     def _maybe_switch_model(self, model_name: str | None) -> bool:
         """Live model switch (/scheduler/init): the allocation names a
@@ -510,9 +556,22 @@ class WorkerNode:
                         "lora_adapters": (
                             eng.adapter_names() if eng else []
                         ),
+                        # Engine reload/compile in progress: the
+                        # scheduler's sweep extends our grace instead of
+                        # declaring the compile dead (suspect state).
+                        "busy": self._busy_reloading,
                     },
                     timeout=10.0,
                 )
+                if reply and reply.get("drain"):
+                    # A pipeline through these dead peers is dissolving:
+                    # checkpoint the affected requests to a surviving
+                    # pipeline instead of aborting them. Posted BEFORE
+                    # any reload below so the step thread parks them
+                    # while their state still exists.
+                    self._post((
+                        "drain", [str(x) for x in reply["drain"]]
+                    ))
                 if reply and reply.get("digests_resync"):
                     # The scheduler saw a sequence gap (its restart, a
                     # dropped beat): ship a full snapshot next beat.
@@ -983,6 +1042,18 @@ class WorkerNode:
             "abort_path", node=self.node_id, peer=peer, reason=reason,
         )
         self._forget_wire_dtype(peer)
+        if not self.standalone and peer != self.scheduler_peer:
+            # Tell the scheduler NOW: it marks the peer's CacheIndex
+            # stale immediately (the cache-aware router must stop
+            # scoring a dead replica's prefixes) and accelerates the
+            # heartbeat sweep, so the drain directive arrives while the
+            # affected requests are still parked here.
+            self.sender.send(
+                self.scheduler_peer, proto.PEER_DOWN,
+                {"reporter": self.node_id, "peer": peer,
+                 "reason": reason},
+                best_effort=True,
+            )
         self._post(("abort_path", peer))
 
     def _count_rx(self, peer: str, wire_req: dict) -> None:
@@ -1147,10 +1218,19 @@ class WorkerNode:
     def _on_chat_poll(self, _peer: str, payload: dict):
         req = self._chat_requests.get(payload["rid"])
         if req is None:
+            # Shipped away in a live migration: redirect the poller to
+            # the head that owns the request now (docs/resilience.md).
+            head = self._migrated_to.get(payload["rid"])
+            if head:
+                return {"migrated": head}
             return {"error": "unknown request"}
         out = {
-            "output_ids": list(req.output_ids),
-            "output_logprobs": list(req.output_logprobs),
+            # The FULL logical stream: a migrated-in request folds its
+            # pre-migration outputs into the prompt, and the poller's
+            # mirror must keep seeing them (identical to output_ids for
+            # never-migrated requests).
+            "output_ids": list(req.full_output_ids),
+            "output_logprobs": list(req.full_output_logprobs),
             "status": req.status.value,
             "finished": req.status.is_finished,
         }
@@ -1200,6 +1280,10 @@ class WorkerNode:
                     # old engine's requests were already aborted; its
                     # ticket resolves against dead state — drop it.
                     pending = None
+                if self._migration_pending or self._migration_parked:
+                    # Park drained requests as checkpoints and ship the
+                    # parked ones to their target pipelines.
+                    self._migration_tick(eng)
                 if eng is None:
                     self._wake.wait(0.01)
                     self._wake.clear()
@@ -1299,9 +1383,15 @@ class WorkerNode:
             elif kind == "stop":
                 self.engine.stop_request(item[1])
             elif kind == "abort_path":
-                # A next-hop peer is unreachable: abort everything routed
-                # through it; the normal finish flow then releases pages,
-                # fires client events and broadcasts to surviving peers.
+                # A next-hop peer is unreachable. Scheduler-managed HEAD
+                # requests are flagged for migration instead of aborted:
+                # their full state lives here, the scheduler's drain/
+                # migrate_target flow (accelerated by the peer_down
+                # report) hands them a surviving pipeline, and the parked
+                # checkpoints resume there bit-identically. Mirrors and
+                # standalone swarms keep the abort behavior — mirrors
+                # own no restartable state, and a scheduler-less swarm
+                # has nobody to pick a target.
                 # (Posted by the sender workers too, which can outlive an
                 # engine teardown — nothing to abort then.)
                 if self.engine is None:
@@ -1312,13 +1402,43 @@ class WorkerNode:
                 # negotiated wire dtype dies with it: a peer that comes
                 # back may be a different build.
                 self._forget_wire_dtype(peer)
+                migratable = (
+                    not self.standalone and self.engine.model.is_first
+                )
                 sched = self.engine.scheduler
                 for req in (
                     list(sched.running.values())
                     + list(sched.wait_queue.values())
                 ):
-                    if peer in req.routing_table and not req.status.is_finished:
+                    if peer not in req.routing_table or req.status.is_finished:
+                        continue
+                    if migratable and not getattr(req, "is_mirror", False):
+                        self._flag_for_migration(req, peer)
+                    else:
                         req.abort(f"peer {peer} unreachable")
+            elif kind == "drain":
+                # Scheduler directive (heartbeat reply): these peers are
+                # dead and our pipeline through them is dissolving —
+                # checkpoint every affected head request away.
+                if self.engine is None or not self.engine.model.is_first:
+                    continue
+                dead_peers = set(item[1])
+                sched = self.engine.scheduler
+                for req in (
+                    list(sched.running.values())
+                    + list(sched.wait_queue.values())
+                ):
+                    if req.status.is_finished or getattr(
+                        req, "is_mirror", False
+                    ):
+                        continue
+                    hit = dead_peers & set(req.routing_table)
+                    if hit:
+                        self._flag_for_migration(req, sorted(hit)[0])
+            elif kind == "restore":
+                self._restore_checkpoint(item[1], item[2])
+            elif kind == "migration_shipped":
+                self._on_migration_shipped(item[1])
             elif kind == "liveness":
                 # Standalone gossip sweep (freshness snapshot from the
                 # announcer thread): abort requests routed through peers
@@ -1382,6 +1502,467 @@ class WorkerNode:
             logger.exception("refit v%d fetch failed", version)
         finally:
             self._refit_fetching = False
+
+    # -- live migration (docs/resilience.md) ---------------------------------
+    #
+    # Node churn flow on a HEAD node: a downstream peer dies (send
+    # failure or a scheduler drain directive) -> affected requests are
+    # FLAGGED (the local scheduler stops scheduling them) -> once out of
+    # any in-flight step they are PARKED: KV preempted to the host tier
+    # and harvested into a checkpoint image where possible, the request
+    # extracted from the engine, its old-path mirrors released -> the
+    # scheduler picks a target pipeline per request (CacheIndex-scored,
+    # so the restore lands where the prefix is already cached) -> the
+    # checkpoint ships head->head over an acknowledged RPC -> the target
+    # restores it (image swap-in via the PREEMPTED/resume_from_host path,
+    # or re-prefill of the radix-uncovered suffix) and decode continues
+    # bit-identically. Pollers follow via chat_poll {"migrated": head} or
+    # the scheduler's where_is table.
+
+    def _flag_for_migration(self, req: Request, dead_peer: str) -> None:
+        rid = req.request_id
+        if rid in self._migration_pending or rid in self._migration_parked:
+            return
+        if req.sampling_params.json_schema:
+            # Grammar-DFA state is not portable yet: fail fast to the
+            # client instead of resuming with an unconstrained stream.
+            req.abort(f"peer {dead_peer} unreachable")
+            return
+        req.migrating = True
+        self._migration_pending[rid] = dead_peer
+        from parallax_tpu.obs.flight import get_flight
+
+        get_flight().event(
+            "migrate_flag", node=self.node_id, request_id=rid,
+            dead_peer=dead_peer,
+        )
+
+    def _migration_tick(self, eng) -> None:
+        """One step-loop pass of the migration state machine: park
+        flagged requests that left the in-flight window, ship parked
+        ones, abort the ones nobody could take before the deadline."""
+        now = time.monotonic()
+        if self._migration_pending and eng is not None:
+            inflight = eng.inflight_rids()
+            for rid, dead in list(self._migration_pending.items()):
+                sched = eng.scheduler
+                req = sched.running.get(rid) or sched.wait_queue.get(rid)
+                if req is None or req.status.is_finished:
+                    self._migration_pending.pop(rid, None)
+                    continue
+                if rid in inflight:
+                    continue    # its pages are being written; next pass
+                self._migration_pending.pop(rid)
+                self._park_request(eng, req, dead)
+        ready = [
+            rid for rid, e in self._migration_parked.items()
+            if not e["shipping"] and now >= e["next_attempt"]
+        ]
+        if ready:
+            for rid in ready:
+                self._migration_parked[rid]["shipping"] = True
+            entries = {
+                rid: self._migration_parked[rid] for rid in ready
+            }
+            threading.Thread(
+                target=self._ship_checkpoints, args=(entries,),
+                daemon=True, name="migrate-ship",
+            ).start()
+        for rid, e in list(self._migration_parked.items()):
+            if not e["shipping"] and now > e["deadline"]:
+                self._migration_parked.pop(rid)
+                req = e["req"]
+                req.abort("migration: no serviceable pipeline")
+                self._finish(req)
+
+    def _park_request(
+        self, eng, req: Request, dead_peer: str, force: bool = False
+    ) -> None:
+        """Checkpoint one request out of the engine. Must run on the
+        step thread (cache bookkeeping is single-threaded state)."""
+        from parallax_tpu.runtime.request import RequestStatus
+
+        rid = req.request_id
+        image = None
+        if (
+            not force
+            and req.status is RequestStatus.DECODING
+            and req.is_prefill_done
+            and eng.host_tier is not None
+        ):
+            # The committed KV image parks in the host tier exactly like
+            # a preemption (PR 2); the checkpoint serializes it so a
+            # layout-compatible target swaps it in instead of
+            # recomputing. Failure just means re-prefill at the target.
+            preempt = getattr(eng.cache, "preempt_to_host", None)
+            try:
+                if preempt is not None and preempt(req):
+                    image = eng.harvest_kv_image(req)
+            except Exception:
+                logger.exception("%s: KV harvest for %s failed (falling "
+                                 "back to re-prefill)", self.node_id, rid)
+                image = None
+        extracted = eng.extract(rid, force=force)
+        if extracted is None:
+            # Raced back into flight; re-flag and retry next pass.
+            self._migration_pending[rid] = dead_peer
+            return
+        old_table = list(req.routing_table)
+        try:
+            eng.cache.release(req)
+        except Exception:
+            logger.exception("%s: cache release for parked %s failed",
+                             self.node_id, rid)
+        # Old-path survivors drop their mirrors now, not at timeout.
+        for peer in old_table:
+            if peer != self.node_id and peer != dead_peer:
+                self.sender.send(
+                    peer, proto.RELEASE,
+                    {"rids": [rid], "abort": True}, best_effort=True,
+                )
+        now = time.monotonic()
+        self._migration_parked[rid] = {
+            "req": req,
+            "image": image,
+            "old_table": old_table,
+            "dead": dead_peer,
+            "parked_wall": time.time(),
+            "deadline": now + self.MIGRATION_PARK_TIMEOUT_S,
+            "next_attempt": now,
+            "shipping": False,
+        }
+        from parallax_tpu.obs.flight import get_flight
+
+        get_flight().event(
+            "migrate_park", node=self.node_id, request_id=rid,
+            kv_pages=(len(image.layers[0]) if image is not None else 0),
+            tokens=len(req.full_output_ids),
+        )
+
+    def _ship_checkpoints(self, entries: dict[str, dict]) -> None:
+        """Background thread: ask the scheduler for CacheIndex-scored
+        targets, ship each checkpoint over an acknowledged RPC, report
+        the outcomes back to the step thread. Reads only parked (frozen)
+        request state — the step thread stopped touching it at park.
+        Every entry ALWAYS gets a result posted — an unexpected error
+        maps to "retry", never to a permanently ``shipping`` entry that
+        the park-timeout abort ladder could no longer reach."""
+        results: dict[str, tuple] = {}
+        try:
+            self._ship_checkpoints_inner(entries, results)
+        except Exception:
+            logger.exception("%s: checkpoint ship failed", self.node_id)
+        finally:
+            for rid in entries:
+                results.setdefault(rid, ("retry", "ship error"))
+            self._post(("migration_shipped", results))
+
+    def _ship_checkpoints_inner(
+        self, entries: dict[str, dict], results: dict[str, tuple]
+    ) -> None:
+        from parallax_tpu.runtime.checkpoint import (
+            checkpoint_from_request,
+            checkpoint_to_wire,
+        )
+        from parallax_tpu.runtime.radix_cache import block_hash_chain
+
+        page = self.engine_config.page_size
+        descriptors = []
+        for rid, e in entries.items():
+            req = e["req"]
+            # The full token history for target scoring: the prompt of a
+            # previously-resumed request already folds its prior outputs
+            # in, and outputs still awaiting teacher-forced replay count
+            # too (checkpoint_from_request records them).
+            history = list(req.all_token_ids) + list(req.replay_ids)
+            d = {
+                "rid": rid,
+                "prompt_tokens": len(history),
+                "lora_id": req.lora_id,
+            }
+            if req.lora_id is None:
+                d["chains"] = {str(page): block_hash_chain(history, page)}
+            descriptors.append(d)
+        try:
+            reply = self.transport.call(
+                self.scheduler_peer, proto.MIGRATE_TARGET,
+                {
+                    "requests": descriptors,
+                    "exclude": sorted({e["dead"] for e in entries.values()}),
+                },
+                timeout=15.0,
+            )
+            targets = (reply or {}).get("targets") or {}
+        except Exception as exc:
+            logger.warning("%s: migrate_target query failed: %s",
+                           self.node_id, exc)
+            targets = {}
+        by_head: dict[str, list] = {}
+        for rid, e in entries.items():
+            t = targets.get(rid)
+            if not isinstance(t, dict) or not t.get("path"):
+                results[rid] = ("retry", "no serviceable pipeline")
+                continue
+            path = [str(x) for x in t["path"]]
+            image = e["image"]
+            # Raw-KV adoption only makes sense when the target head runs
+            # the exact same stage: a single-stage pipeline over our
+            # layer range. Anything else re-prefills (which also feeds
+            # downstream stages their chunks).
+            kv_ok = (
+                image is not None
+                and len(path) == 1
+                and list(t.get("head_layers") or [])
+                == [image.start_layer, image.end_layer]
+            )
+            ckpt = checkpoint_from_request(
+                e["req"], routing_table=path,
+                kv=image if kv_ok else None,
+            )
+            ckpt.parked_wall = e["parked_wall"]
+            by_head.setdefault(path[0], []).append(
+                (rid, path, checkpoint_to_wire(ckpt))
+            )
+        for head, batch in by_head.items():
+            try:
+                reply = self.transport.call(
+                    head, proto.CHECKPOINT,
+                    {"checkpoints": [w for _r, _p, w in batch]},
+                    timeout=30.0,
+                )
+            except Exception as exc:
+                # The chosen target died between choice and ship — the
+                # load charge must not leak, and the request retries
+                # against whatever pipeline the next query finds.
+                for rid, path, _w in batch:
+                    results[rid] = ("retry", f"target {head} unreachable")
+                    self.sender.send(
+                        self.scheduler_peer, "request_complete",
+                        {"path": path}, best_effort=True,
+                    )
+                logger.warning("%s: checkpoint ship to %s failed: %s",
+                               self.node_id, head, exc)
+                continue
+            accepted = set((reply or {}).get("accepted") or ())
+            rejected = (reply or {}).get("rejected") or {}
+            for rid, path, _w in batch:
+                if rid in accepted:
+                    results[rid] = ("ok", head)
+                else:
+                    results[rid] = (
+                        "failed",
+                        str(rejected.get(rid) or "target rejected"),
+                    )
+                    self.sender.send(
+                        self.scheduler_peer, "request_complete",
+                        {"path": path}, best_effort=True,
+                    )
+
+    def _on_migration_shipped(self, results: dict[str, tuple]) -> None:
+        for rid, (status, info) in results.items():
+            e = self._migration_parked.get(rid)
+            if e is None:
+                continue
+            if status == "ok":
+                self._migration_parked.pop(rid)
+                self._record_migrated(rid, info)
+                # The request lives on the target now: pollers get the
+                # {"migrated": head} redirect, and a direct submitter's
+                # done-event is retired unfired (finishing happens on
+                # the target; chat_poll is the follow channel).
+                self._chat_requests.pop(rid, None)
+                self._request_events.pop(rid, None)
+                # Release the OLD path's load charge; the target's own
+                # request_complete covers the new path when it finishes.
+                if not self.standalone:
+                    self.sender.send(
+                        self.scheduler_peer, "request_complete",
+                        {"path": e["old_table"] or [self.node_id]},
+                        best_effort=True,
+                    )
+                from parallax_tpu.obs.flight import get_flight
+
+                get_flight().event(
+                    "migrate_out", node=self.node_id, request_id=rid,
+                    target=info,
+                    with_kv=e["image"] is not None,
+                )
+                try:
+                    from parallax_tpu.obs.registry import get_registry
+
+                    get_registry().counter(
+                        "parallax_migration_checkpoints_total",
+                        "Requests checkpointed away from this head "
+                        "during node-churn drains",
+                    ).inc()
+                except Exception:
+                    pass
+            else:
+                # Both "retry" (target unreachable / no pipeline) and
+                # "failed" (target rejected: queue full, incompatible
+                # frame) re-enter the park loop — the next target query
+                # may pick another pipeline, and the park deadline
+                # bounds how long we keep trying before the abort rung.
+                if status == "failed":
+                    logger.warning(
+                        "%s: migration of %s rejected (%s); retrying "
+                        "until the park deadline", self.node_id, rid,
+                        info,
+                    )
+                e["shipping"] = False
+                e["next_attempt"] = (
+                    time.monotonic() + self.MIGRATION_RETRY_S
+                )
+
+    def _record_migrated(self, rid: str, head: str) -> None:
+        self._migrated_to[rid] = head
+        while len(self._migrated_to) > 4096:
+            self._migrated_to.popitem(last=False)
+
+    def _on_checkpoint(self, peer: str, payload):
+        """Target side: validate and accept a batch of migrating
+        requests. Acceptance transfers ownership — the source releases
+        its state only for acknowledged rids; a malformed frame is
+        rejected cleanly (CheckpointError) and the source falls back."""
+        from parallax_tpu.runtime.checkpoint import (
+            CheckpointError,
+            build_resumed_request,
+            checkpoint_from_wire,
+        )
+
+        accepted: list[str] = []
+        rejected: dict[str, str] = {}
+        frames = (payload or {}).get("checkpoints")
+        if not isinstance(frames, list):
+            return {"accepted": [], "rejected": {"?": "no checkpoints"}}
+        for i, wire in enumerate(frames):
+            rid = (
+                wire.get("rid") if isinstance(wire, dict) else None
+            ) or f"frame-{i}"
+            try:
+                ckpt = checkpoint_from_wire(wire)
+            except CheckpointError as e:
+                logger.warning("%s: rejected checkpoint %s from %s: %s",
+                               self.node_id, rid, peer, e)
+                rejected[str(rid)] = str(e)
+                continue
+            if self.engine is None:
+                rejected[ckpt.request_id] = "no engine"
+                continue
+            sched = self.engine.scheduler
+            if len(sched.wait_queue) >= sched.max_queue_size:
+                # Acceptance transfers ownership, so the engine submit
+                # (later, on the step thread) must be going to succeed:
+                # reject while saturated and let the source retry — on
+                # us once the queue drains, or on another pipeline.
+                rejected[ckpt.request_id] = "target queue full"
+                continue
+            if ckpt.request_id in self._chat_requests:
+                # Duplicate ship (our previous ack was lost in flight):
+                # the request is already restoring/running here — ack
+                # again WITHOUT a second submit, or the stream would
+                # decode twice.
+                accepted.append(ckpt.request_id)
+                continue
+            # Register the poll mirror BEFORE acking acceptance: the
+            # source redirects pollers here the moment the ack lands,
+            # and the actual engine submit runs later on the step
+            # thread — a poll in that window must see the parked prior
+            # stream, not {"error": "unknown request"}.
+            self._chat_requests[ckpt.request_id] = build_resumed_request(
+                ckpt
+            )
+            self._post(("restore", ckpt, peer))
+            accepted.append(ckpt.request_id)
+        return {"accepted": accepted, "rejected": rejected}
+
+    def _restore_checkpoint(self, ckpt, from_peer: str) -> None:
+        """Step thread: rebuild the request and resume it — KV-image
+        swap-in when the layouts match, else re-prefill of the ORIGINAL
+        prompt (radix-uncovered suffix only) plus teacher-forced replay
+        of the recorded outputs. Either way the continuation is
+        bit-identical (decode-shape compute everywhere the original run
+        used it; seeded draws key on the stream-relative output step the
+        checkpoint preserved)."""
+        from parallax_tpu.runtime.checkpoint import build_resumed_request
+
+        eng = self.engine
+        req = build_resumed_request(ckpt)
+        rid = req.request_id
+        adopted = False
+        if eng is None:
+            req.abort("migration target has no engine")
+            self._chat_requests[rid] = req
+            self._finish(req)
+            return
+        if ckpt.kv is not None:
+            try:
+                adopted = eng.adopt_checkpoint_kv(req, ckpt.kv)
+            except Exception:
+                logger.exception("%s: KV adoption for %s failed; "
+                                 "re-prefilling", self.node_id, rid)
+                adopted = False
+        if not adopted:
+            # No image to swap in: restart from the original prompt and
+            # replay the recorded outputs through decode steps.
+            req = build_resumed_request(ckpt, replay=True)
+        self._chat_requests[rid] = req
+        try:
+            ok = eng.submit(req)
+        except Exception as e:
+            ok = False
+            req.abort(str(e))
+        if not ok:
+            if not req.status.is_finished:
+                req.abort("migration target queue full")
+            try:
+                eng.cache.release(req)   # frees adopted handles, if any
+            except Exception:
+                logger.exception("restore cleanup failed for %s", rid)
+            self._finish(req)
+            return
+        logger.info(
+            "%s: restored migrated request %s from %s (%d prior tokens, "
+            "%s)", self.node_id, rid, from_peer, len(ckpt.output_ids),
+            "KV image adopted" if adopted else "re-prefill + replay",
+        )
+        if not self.standalone:
+            self.sender.send(
+                self.scheduler_peer, "migration_done",
+                {"rid": rid, "head": self.node_id}, best_effort=True,
+            )
+        from parallax_tpu.obs.flight import get_flight
+
+        get_flight().event(
+            "migrate_in", node=self.node_id, request_id=rid,
+            source=from_peer, kv_adopted=adopted,
+            prior_tokens=len(ckpt.output_ids),
+        )
+        self._count_migration_in(
+            "kv_image" if adopted else "replay", ckpt.parked_wall
+        )
+
+    def _count_migration_in(self, mode: str, parked_wall: float) -> None:
+        """parallax_migrations_total + the park->resume latency
+        histogram (the bench churn probe and the CI chaos smoke read
+        both)."""
+        try:
+            from parallax_tpu.obs.registry import get_registry
+
+            reg = get_registry()
+            reg.counter(
+                "parallax_migrations_total",
+                "Requests restored on this head after a live migration "
+                "or client resume",
+                labelnames=("mode",),
+            ).labels(mode=mode).inc()
+            if parked_wall:
+                reg.histogram(
+                    "parallax_migration_ms",
+                    "Park -> resume latency of migrated requests, ms",
+                ).observe(max(0.0, (time.time() - parked_wall) * 1e3))
+        except Exception:  # pragma: no cover - metrics never break serving
+            pass
 
     def _route_outputs(self, out) -> None:
         """Group packets by next hop and hand them to the sender

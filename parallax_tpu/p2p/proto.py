@@ -35,6 +35,19 @@ NODE_LEAVE = "node_leave"
 # Per-link wire-format negotiation (sender asks, receiver answers with
 # the dtype names it can decode; see docs/networking.md).
 WIRE_CAPS = "wire_caps"
+# Live migration (docs/resilience.md): a batch of RequestCheckpoint
+# frames shipped head->head when a pipeline drains around a dead node;
+# the reply acknowledges per-request acceptance, so the source only
+# releases state the target actually owns now.
+CHECKPOINT = "rpc_checkpoint"
+# Worker -> scheduler: the async sender declared a next-hop peer dead.
+# The scheduler marks the peer's CacheIndex stale immediately and puts
+# it under an accelerated heartbeat sweep.
+PEER_DOWN = "peer_down"
+# Worker -> scheduler: ask for a migration target per parked request
+# (scored against each head's CacheIndex mirror, so requests land where
+# their prefix is already cached).
+MIGRATE_TARGET = "migrate_target"
 
 
 def _build_dtype_registry() -> dict[str, np.dtype]:

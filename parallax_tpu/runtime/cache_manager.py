@@ -377,6 +377,50 @@ class CacheManager:
         self.stats.preemptions += 1
         return True
 
+    def shared_prefix_tokens(self, request_id: str) -> int:
+        """Tokens of the request's context covered by LOCKED tree-shared
+        pages (the part a preemption image does NOT carry)."""
+        _path, num_shared = self._locked.get(request_id, ([], 0))
+        return num_shared * self.page_size
+
+    def adopt_migrated(
+        self, request: Request, handles: list[int], prefix_tokens: int
+    ) -> bool:
+        """Register a migrated-in request's host-parked KV image as if
+        THIS manager had preempted it locally: lock a radix path
+        covering exactly ``prefix_tokens`` (the image starts right after
+        them) and attach the pinned handles; the request then resumes
+        through the ordinary ``resume_from_host`` admission. False (no
+        side effects — the caller frees the handles and falls back to
+        re-prefill) when the local radix does not cover the prefix with
+        on-device pages."""
+        pages_prefix = prefix_tokens // self.page_size
+        path: list = []
+        shared: list[int] = []
+        if pages_prefix:
+            if not self.enable_prefix_cache:
+                return False
+            pages, full_path = self.prefix_cache.match_prefix(
+                self._ns_tokens(request.prompt_ids, request.lora_id)
+            )
+            if len(pages) < pages_prefix:
+                return False
+            path = self.prefix_cache.slice_path(full_path, pages_prefix)
+            if any(not n.on_device for n in path):
+                # Host-resident twins would need their own swap-in
+                # orchestration; re-prefill is simpler and always right.
+                return False
+            shared = pages[:pages_prefix]
+            self.prefix_cache.lock(path)
+        request.page_ids = list(shared)
+        request.host_page_handles = (  # type: ignore[attr-defined]
+            list(handles)
+        )
+        self._locked[request.request_id] = (path, len(shared))
+        request.num_cached_tokens = prefix_tokens
+        self.stats.tokens_hit_device += prefix_tokens
+        return True
+
     def resume_from_host(self, request: Request) -> bool:
         """Swap a preempted request's KV image back into fresh device
         pages. False (request stays parked) when pages are still short."""

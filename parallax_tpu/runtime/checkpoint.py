@@ -1,0 +1,349 @@
+"""Portable request checkpoints: the unit of live migration.
+
+A :class:`RequestCheckpoint` is everything a *different* head engine
+needs to continue a request mid-decode **bit-identically**:
+
+- the token-level state (original prompt, every committed output token,
+  their logprobs) — sampling keys derive from ``fold_in(key(seed),
+  output_step)`` and greedy is deterministic, so token state alone
+  already guarantees an identical continuation via re-prefill of the
+  (radix-uncovered suffix of the) history;
+- the sampling parameters including the seed, plus the stop/eos sets;
+- optionally the committed KV image (the PR 2 preemption-to-host page
+  image, serialized) so a compatible target can swap it in through the
+  existing ``resume_from_host`` path instead of recomputing.
+
+The wire form is a msgpack-compatible dict carried by a dedicated
+``rpc_checkpoint`` frame (p2p/proto.py); :func:`checkpoint_from_wire`
+validates every field — lengths, dtypes, shape/byte agreement — and
+raises :class:`CheckpointError` on anything malformed, so a truncated
+or corrupt frame is rejected cleanly instead of poisoning the target
+engine. See docs/resilience.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from parallax_tpu.p2p import proto
+from parallax_tpu.runtime.request import Request, SamplingParams
+
+CHECKPOINT_VERSION = 1
+
+# Restored prompts fold the committed outputs in, so the hard cap is the
+# model context; anything past ~1M tokens is a corrupt frame, not a
+# request.
+_MAX_TOKENS = 1 << 20
+
+
+class CheckpointError(ValueError):
+    """A checkpoint frame failed validation (truncated, corrupt, or
+    from an incompatible build). The frame is rejected; the request
+    falls back to the next recovery rung (re-prefill / client resume)."""
+
+
+@dataclasses.dataclass
+class KVImage:
+    """The committed KV pages of one request on ONE stage, host-side.
+
+    ``layers[i]`` is ``[n_pages, *page_dims]`` for local attention layer
+    ``i`` — exactly what :meth:`HostKVTier.demote` gathers for a
+    preempted request. ``prefix_tokens`` KV tokens are NOT in the image:
+    they were radix-shared at the source, and the target must cover them
+    from its own radix (or the restore falls back to re-prefill).
+    ``computed_tokens`` is the total KV coverage including that prefix.
+    """
+
+    page_size: int
+    start_layer: int
+    end_layer: int
+    kv_dtype: str
+    prefix_tokens: int
+    computed_tokens: int
+    layers: list[np.ndarray]
+
+    @property
+    def signature(self) -> tuple:
+        """Compatibility signature: a target adopts the image only when
+        its own :meth:`StageEngine.kv_page_signature` matches."""
+        return (
+            self.page_size, self.start_layer, self.end_layer,
+            self.kv_dtype,
+            tuple(
+                (tuple(a.shape[1:]), proto.dtype_name(a.dtype))
+                for a in self.layers
+            ),
+        )
+
+
+@dataclasses.dataclass
+class RequestCheckpoint:
+    request_id: str
+    # The ORIGINAL prompt (a previously-resumed request unfolds its
+    # prior outputs back out, so checkpoints never nest).
+    prompt_ids: list[int]
+    # Every committed output token, in order.
+    output_ids: list[int]
+    output_logprobs: list[float]
+    sampling_params: dict
+    eos_token_ids: list[int]
+    lora_id: str | None
+    # The NEW pipeline path the restored request will run on (filled by
+    # the migration flow before shipping).
+    routing_table: list[str]
+    # Seconds since the request's original arrival, so the target
+    # reconstructs ``arrival_time`` on its own monotonic clock and
+    # request timeouts keep counting from the true start.
+    age_s: float
+    # Wall-clock park instant (time.time()): the park->resume migration
+    # latency metric on the target. Wall clocks skew across hosts; the
+    # histogram is a fleet observability signal, not a correctness one.
+    parked_wall: float
+    traced: bool = False
+    kv: KVImage | None = None
+
+
+def checkpoint_from_request(
+    req: Request,
+    routing_table: list[str] | None = None,
+    kv: KVImage | None = None,
+) -> RequestCheckpoint:
+    """Snapshot one head-owned request. The request may itself be a
+    resumed one: folded prior outputs (``output_offset > 0``) are peeled
+    back out of the prompt, and outputs still awaiting teacher-forced
+    replay (``replay_ids``) are appended to the committed stream — so
+    checkpoints never nest and never lose recorded tokens."""
+    orig_prompt = (
+        req.prompt_ids[: len(req.prompt_ids) - req.output_offset]
+        if req.output_offset else req.prompt_ids
+    )
+    return RequestCheckpoint(
+        request_id=req.request_id,
+        prompt_ids=list(orig_prompt),
+        output_ids=list(req.full_output_ids) + list(req.replay_ids),
+        output_logprobs=(
+            list(req.full_output_logprobs) + list(req.replay_logprobs)
+        ),
+        sampling_params=req.sampling_params.to_dict(),
+        eos_token_ids=list(req.eos_token_ids),
+        lora_id=req.lora_id,
+        routing_table=list(routing_table or ()),
+        age_s=max(0.0, time.monotonic() - req.arrival_time),
+        parked_wall=time.time(),
+        traced=req.traced,
+        kv=kv,
+    )
+
+
+def build_resumed_request(
+    ckpt: RequestCheckpoint, replay: bool = False
+) -> Request:
+    """The restored head request, in one of two bit-identical forms.
+
+    ``replay=False`` — KV-adoption intent: committed outputs fold into
+    the prompt (their KV arrives via the checkpoint's page image, which
+    the target swaps in through ``resume_from_host``), and
+    ``output_offset`` keeps every output-side accounting site
+    (generation budgets, penalty windows, the seeded ``fold_in(key(seed),
+    output_step)`` origin) counting from the ORIGINAL stream position.
+
+    ``replay=True`` — no image to adopt: the request restarts from the
+    ORIGINAL prompt (prefix-cache hits and prefill chunking match a
+    fresh serve exactly) and teacher-forces the recorded outputs through
+    ordinary decode steps via ``replay_ids`` before sampling resumes.
+    Folding them into the prompt instead would recompute their KV under
+    prefill-chunk shapes — float-reduction differences there can flip a
+    near-tied argmax, which replay makes impossible by construction."""
+    outs = list(ckpt.output_ids)
+    lps = list(ckpt.output_logprobs or ())
+    req = Request(
+        request_id=ckpt.request_id,
+        prompt_ids=(
+            list(ckpt.prompt_ids) if replay
+            else list(ckpt.prompt_ids) + outs
+        ),
+        sampling_params=SamplingParams.from_dict(ckpt.sampling_params),
+        routing_table=list(ckpt.routing_table),
+        eos_token_ids=tuple(ckpt.eos_token_ids),
+        lora_id=ckpt.lora_id,
+    )
+    if replay:
+        req.replay_ids = outs
+        # Positional alignment only holds when every recorded token has
+        # a logprob; a ragged record replays tokens alone.
+        req.replay_logprobs = lps if len(lps) == len(outs) else []
+    else:
+        req.output_offset = len(outs)
+        req.prior_output_logprobs = lps
+    req.arrival_time = time.monotonic() - max(0.0, float(ckpt.age_s))
+    req.traced = bool(ckpt.traced)
+    return req
+
+
+# -- wire form ---------------------------------------------------------------
+
+
+def checkpoint_to_wire(ckpt: RequestCheckpoint) -> dict:
+    d = {
+        "v": CHECKPOINT_VERSION,
+        "rid": ckpt.request_id,
+        "prompt_ids": list(ckpt.prompt_ids),
+        "output_ids": list(ckpt.output_ids),
+        "output_logprobs": list(ckpt.output_logprobs),
+        "sampling_params": ckpt.sampling_params,
+        "eos_token_ids": list(ckpt.eos_token_ids),
+        "lora_id": ckpt.lora_id,
+        "routing_table": list(ckpt.routing_table),
+        "age_s": float(ckpt.age_s),
+        "parked_wall": float(ckpt.parked_wall),
+        "traced": bool(ckpt.traced),
+    }
+    if ckpt.kv is not None:
+        d["kv"] = {
+            "page_size": ckpt.kv.page_size,
+            "start_layer": ckpt.kv.start_layer,
+            "end_layer": ckpt.kv.end_layer,
+            "kv_dtype": ckpt.kv.kv_dtype,
+            "prefix_tokens": ckpt.kv.prefix_tokens,
+            "computed_tokens": ckpt.kv.computed_tokens,
+            "layers": [proto.tensor_to_wire(a) for a in ckpt.kv.layers],
+        }
+    return d
+
+
+def _ids(d: dict, key: str, maximum: int = _MAX_TOKENS) -> list[int]:
+    v = d.get(key)
+    if not isinstance(v, (list, tuple)):
+        raise CheckpointError(f"checkpoint {key} is not a list")
+    if len(v) > maximum:
+        raise CheckpointError(f"checkpoint {key} oversized ({len(v)})")
+    try:
+        return [int(x) for x in v]
+    except (TypeError, ValueError) as e:
+        raise CheckpointError(f"checkpoint {key} holds non-ints: {e}")
+
+
+def _kv_from_wire(d: dict) -> KVImage:
+    try:
+        page_size = int(d["page_size"])
+        start_layer = int(d["start_layer"])
+        end_layer = int(d["end_layer"])
+        kv_dtype = str(d["kv_dtype"])
+        prefix_tokens = int(d["prefix_tokens"])
+        computed_tokens = int(d["computed_tokens"])
+        raw_layers = d["layers"]
+    except (KeyError, TypeError, ValueError) as e:
+        raise CheckpointError(f"kv image header malformed: {e}")
+    if page_size <= 0 or not 0 <= start_layer < end_layer:
+        raise CheckpointError("kv image header out of range")
+    if not 0 <= prefix_tokens <= computed_tokens <= _MAX_TOKENS:
+        raise CheckpointError("kv image token counts out of range")
+    if prefix_tokens % page_size:
+        raise CheckpointError("kv prefix not page-aligned")
+    if not isinstance(raw_layers, (list, tuple)) or not raw_layers:
+        raise CheckpointError("kv image has no layers")
+    layers: list[np.ndarray] = []
+    n_pages = None
+    for t in raw_layers:
+        if not isinstance(t, dict):
+            raise CheckpointError("kv layer frame is not a tensor dict")
+        try:
+            arr = proto.tensor_from_wire(t)
+        except (KeyError, TypeError, ValueError) as e:
+            # np.frombuffer raises on byte-count/shape disagreement —
+            # exactly the truncated-frame case.
+            raise CheckpointError(f"kv layer tensor malformed: {e}")
+        if arr is None or arr.ndim < 2:
+            raise CheckpointError("kv layer tensor has no page dim")
+        if n_pages is None:
+            n_pages = int(arr.shape[0])
+        elif int(arr.shape[0]) != n_pages:
+            raise CheckpointError("kv layers disagree on page count")
+        layers.append(arr)
+    # The image must cover its tokens; one page of slack is legal (the
+    # source allocates a page for the token the next decode step would
+    # have written).
+    image_tokens = computed_tokens - prefix_tokens
+    want = -(-image_tokens // page_size)
+    if image_tokens <= 0 or not want <= n_pages <= want + 1:
+        raise CheckpointError(
+            f"kv image pages ({n_pages}) do not cover "
+            f"{image_tokens} tokens at page_size {page_size}"
+        )
+    return KVImage(
+        page_size=page_size, start_layer=start_layer, end_layer=end_layer,
+        kv_dtype=kv_dtype, prefix_tokens=prefix_tokens,
+        computed_tokens=computed_tokens, layers=layers,
+    )
+
+
+def checkpoint_from_wire(d: dict) -> RequestCheckpoint:
+    """Strictly validated decode; raises :class:`CheckpointError` on any
+    malformed field so the restore path can reject the frame cleanly."""
+    if not isinstance(d, dict):
+        raise CheckpointError("checkpoint frame is not a map")
+    if d.get("v") != CHECKPOINT_VERSION:
+        raise CheckpointError(f"unsupported checkpoint version {d.get('v')!r}")
+    rid = d.get("rid")
+    if not isinstance(rid, str) or not rid:
+        raise CheckpointError("checkpoint has no request id")
+    prompt_ids = _ids(d, "prompt_ids")
+    if not prompt_ids:
+        raise CheckpointError("checkpoint prompt is empty")
+    output_ids = _ids(d, "output_ids")
+    lps = d.get("output_logprobs")
+    if lps is None:
+        lps = []
+    if not isinstance(lps, (list, tuple)) or len(lps) > len(output_ids):
+        raise CheckpointError("checkpoint logprobs malformed")
+    try:
+        logprobs = [float(x) for x in lps]
+    except (TypeError, ValueError) as e:
+        raise CheckpointError(f"checkpoint logprobs non-float: {e}")
+    sp = d.get("sampling_params")
+    if not isinstance(sp, dict):
+        raise CheckpointError("checkpoint sampling_params is not a map")
+    try:
+        SamplingParams.from_dict(sp)
+    except (TypeError, ValueError, AttributeError) as e:
+        raise CheckpointError(f"checkpoint sampling_params invalid: {e}")
+    lora_id = d.get("lora_id")
+    if lora_id is not None and not isinstance(lora_id, str):
+        raise CheckpointError("checkpoint lora_id is not a string")
+    table = d.get("routing_table") or []
+    if not isinstance(table, (list, tuple)) or not all(
+        isinstance(x, str) for x in table
+    ):
+        raise CheckpointError("checkpoint routing_table malformed")
+    try:
+        age_s = float(d.get("age_s") or 0.0)
+        parked_wall = float(d.get("parked_wall") or 0.0)
+    except (TypeError, ValueError) as e:
+        raise CheckpointError(f"checkpoint timestamps malformed: {e}")
+    kv = None
+    if d.get("kv") is not None:
+        if not isinstance(d["kv"], dict):
+            raise CheckpointError("checkpoint kv is not a map")
+        kv = _kv_from_wire(d["kv"])
+        total = len(prompt_ids) + len(output_ids)
+        if kv.computed_tokens > total:
+            raise CheckpointError(
+                "kv image covers more tokens than the checkpoint holds"
+            )
+    return RequestCheckpoint(
+        request_id=rid,
+        prompt_ids=prompt_ids,
+        output_ids=output_ids,
+        output_logprobs=logprobs,
+        sampling_params=sp,
+        eos_token_ids=_ids(d, "eos_token_ids", maximum=4096),
+        lora_id=lora_id,
+        routing_table=[str(x) for x in table],
+        age_s=age_s,
+        parked_wall=parked_wall,
+        traced=bool(d.get("traced", False)),
+        kv=kv,
+    )

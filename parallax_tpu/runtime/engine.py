@@ -774,7 +774,13 @@ class StageEngine:
             )
         # Clamp generation to the context budget so oversized max_tokens
         # finish at the length limit instead of dying on KV exhaustion.
-        cap = self.cfg.max_model_len - request.num_prompt_tokens
+        # A resumed request's prompt already holds ``output_offset``
+        # generated tokens that its (stream-relative) max_new budget also
+        # counts, so the cap shifts by exactly that overlap.
+        cap = (
+            self.cfg.max_model_len - request.num_prompt_tokens
+            + request.output_offset
+        )
         sp = request.sampling_params
         if sp.max_new_tokens > cap:
             sp.max_new_tokens = cap
@@ -890,6 +896,129 @@ class StageEngine:
                     req.status = RequestStatus.FINISHED_EOS
             self.scheduler.release_request(req)
             self._free_state_slot(req)
+
+    # -- live migration (runtime/checkpoint.py) ----------------------------
+
+    def inflight_rids(self) -> set[str]:
+        """Request ids scheduled in a dispatched-but-unresolved step —
+        their KV pages are being written on device right now."""
+        out: set[str] = set()
+        for t in self._inflight:
+            out.update(s.request.request_id for s in t.plan.seqs)
+        return out
+
+    def extract(self, request_id: str, force: bool = False) -> Request | None:
+        """Remove a request from this stage WITHOUT finishing it: the
+        migration flow parks it into a checkpoint instead. Refuses while
+        the request rides an in-flight step (its pages are being
+        written) unless ``force`` — the elastic-reload path forces,
+        because the engine and its KV are being discarded wholesale.
+        The caller owns the cache cleanup (harvest the KV image first,
+        then ``cache.release``)."""
+        if not force and request_id in self.inflight_rids():
+            return None
+        sched = self.scheduler
+        req = sched.running.pop(request_id, None) or sched.wait_queue.pop(
+            request_id, None
+        )
+        if req is None:
+            return None
+        self._pending_hidden.pop(request_id, None)
+        self._grammar_states.pop(request_id, None)
+        self._bias_cache.pop(request_id, None)
+        self._free_token_slot(request_id)
+        self._traced.discard(request_id)
+        self._free_state_slot(req)
+        req.device_feed_ready = False
+        return req
+
+    def kv_page_signature(self) -> tuple | None:
+        """Shape/dtype identity of one KV page across this stage's
+        layers. Two engines may exchange raw KV images only when these
+        match exactly (same layer range, page size, per-layer page
+        shapes and dtypes); None when the layout has no page-granular
+        image (hybrid linear state, sharded leaves)."""
+        if self._needs_state:
+            return None
+        kv = self.kv
+        if not isinstance(kv, (list, tuple)) or not kv:
+            return None
+        sig = []
+        for a in kv:
+            if (
+                not hasattr(a, "shape")
+                or getattr(a, "ndim", 0) < 2
+                or a.shape[0] != self.cfg.num_pages
+            ):
+                return None
+            sig.append((
+                tuple(int(x) for x in a.shape[1:]),
+                np.dtype(a.dtype).name,
+            ))
+        return (
+            self.cfg.page_size, self.model.start_layer,
+            self.model.end_layer, self.cfg.kv_dtype, tuple(sig),
+        )
+
+    def harvest_kv_image(self, request: Request):
+        """Serialize a just-preempted request's pinned host image into a
+        checkpoint :class:`KVImage` (live migration). The handles stay
+        owned by the request — ``cache.release`` frees them after the
+        checkpoint ships. None when the image is unavailable (no host
+        tier, partial demotion, unsupported layout)."""
+        from parallax_tpu.runtime.checkpoint import KVImage
+
+        handles = getattr(request, "host_page_handles", None)
+        tier = self.host_tier
+        if not handles or tier is None or any(h is None for h in handles):
+            return None
+        sig = self.kv_page_signature()
+        if sig is None:
+            return None
+        shared_fn = getattr(self.cache, "shared_prefix_tokens", None)
+        prefix = shared_fn(request.request_id) if shared_fn else 0
+        datas = [tier.pool.load(h) for h in handles]
+        layers = [
+            np.stack([d[i] for d in datas])
+            for i in range(len(datas[0]))
+        ]
+        return KVImage(
+            page_size=self.cfg.page_size,
+            start_layer=self.model.start_layer,
+            end_layer=self.model.end_layer,
+            kv_dtype=self.cfg.kv_dtype,
+            prefix_tokens=prefix,
+            computed_tokens=request.num_computed_tokens,
+            layers=layers,
+        )
+
+    def adopt_checkpoint_kv(self, request: Request, image) -> bool:
+        """Adopt a migrated-in KV image: park it pinned in the host tier
+        and register the request as PREEMPTED, so the existing
+        ``resume_from_host`` admission swaps it onto device — no
+        re-prefill. False (request untouched, image dropped) when the
+        layouts mismatch or the local radix does not cover the image's
+        shared prefix; the caller then falls back to re-prefill, which
+        is always correct."""
+        tier = self.host_tier
+        adopt = getattr(self.cache, "adopt_migrated", None)
+        if tier is None or adopt is None:
+            return False
+        if image.signature != self.kv_page_signature():
+            return False
+        total = request.num_prompt_tokens + request.num_output_tokens
+        computed = min(int(image.computed_tokens), total - 1)
+        if computed < image.prefix_tokens:
+            return False
+        handles = tier.store_image(image.layers)
+        if handles is None:
+            return False
+        if not adopt(request, handles, image.prefix_tokens):
+            tier.free(handles)
+            return False
+        request.num_computed_tokens = computed
+        request.status = RequestStatus.PREEMPTED
+        return True
 
     # -- stepping ---------------------------------------------------------
 
@@ -1292,7 +1421,7 @@ class StageEngine:
             pending = int(
                 seg.device_token and req.total_len < seg.context_len
             )
-            n_out = len(req.output_ids) + pending
+            n_out = req.num_generated + pending
             limits[i] = max(0, sp.max_new_tokens - n_out)
             min_req[i] = max(0, sp.min_new_tokens - n_out)
             stop: tuple[int, ...] = ()
@@ -1558,6 +1687,9 @@ class StageEngine:
                 or sp.logprobs
                 or sp.json_schema       # grammar mask needs per-step host state
                 or sp.logit_bias        # bias applied at the sampler
+                # Replay rows commit RECORDED tokens; an on-device window
+                # would feed its own samples forward instead.
+                or seg.request.replay_ids
             ):
                 return False
         return True
@@ -2158,6 +2290,11 @@ class StageEngine:
                 or sp.logprobs
                 or sp.json_schema
                 or sp.logit_bias
+                # Teacher-forced replay (migration restore): the commit
+                # substitutes the recorded token, so the next step MUST
+                # be fed from the host commit, never the device-parked
+                # sampled token.
+                or seg.request.replay_ids
             ):
                 return False
         return True
@@ -2214,7 +2351,7 @@ class StageEngine:
             # device-fed position strictly inside max_model_len.
             pending = 1 if seg.device_token else 0
             if (
-                len(req.output_ids) + pending + 1
+                req.num_generated + pending + 1
                 >= req.sampling_params.max_new_tokens
             ):
                 continue
@@ -2491,11 +2628,13 @@ class StageEngine:
     @staticmethod
     def _generated_ids(req: Request) -> list[int]:
         """Tokens this request has generated so far, as visible to THIS
-        stage: the head tracks output_ids; a mirror accumulates decode-token
+        stage: the head tracks output_ids (a migrated-in request's folded
+        prior outputs included, so penalty windows and the seeded step
+        origin stay stream-relative); a mirror accumulates decode-token
         arrivals (``mirror_gen_ids``)."""
         if getattr(req, "is_mirror", False):
             return getattr(req, "mirror_gen_ids", [])
-        return req.output_ids
+        return req.full_output_ids
 
     def _sample(self, logits: jax.Array, inputs: BatchInputs,
                 plan: BatchPlan, step_idx: int):

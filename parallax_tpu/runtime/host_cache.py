@@ -222,6 +222,32 @@ class HostKVTier:
             self.pool.free(h)
         self.pages_swapped_in += len(handles)
 
+    def store_image(
+        self, layers: Sequence[np.ndarray]
+    ) -> list[int] | None:
+        """Adopt an externally produced page image (live migration): the
+        per-layer ``[n_pages, ...]`` arrays a peer's checkpoint carried
+        are stored pinned, page by page, with no device gather.
+        All-or-nothing; None when the pool cannot hold them."""
+        if not layers:
+            return []
+        n = int(layers[0].shape[0])
+        if any(int(a.shape[0]) != n for a in layers):
+            return None
+        if not self.pool.ensure_room(n):
+            return None
+        handles: list[int] = []
+        for j in range(n):
+            h = self.pool.store(
+                tuple(np.asarray(a[j]) for a in layers), pinned=True
+            )
+            if h is None:  # pragma: no cover - ensure_room guarantees room
+                for hh in handles:
+                    self.pool.free(hh)
+                return None
+            handles.append(h)
+        return handles
+
     def free(self, handles: Sequence[int]) -> None:
         for h in handles:
             self.pool.free(h)

@@ -135,6 +135,35 @@ class Request:
     # the metrics registry and flight recorder. Set in commit_token (the
     # single choke point every sampling path funnels through).
     first_token_time: float | None = None
+    # Live migration (runtime/checkpoint.py): a resumed request folds its
+    # previously committed outputs into ``prompt_ids`` (their KV must be
+    # recomputed or adopted before decode continues); this counts those
+    # folded tokens so generation budgets, penalty windows and the
+    # seeded ``fold_in(key(seed), output_step)`` origin keep counting
+    # from the ORIGINAL stream position. 0 for every non-migrated
+    # request — all accounting then reduces to the pre-migration form.
+    output_offset: int = 0
+    # Logprobs of the folded prior outputs (resumed requests only).
+    prior_output_logprobs: list[float] = dataclasses.field(
+        default_factory=list
+    )
+    # Set while the migration flow is extracting this request from its
+    # engine: the local scheduler stops scheduling (and never preempts)
+    # a row that is about to be checkpointed away.
+    migrating: bool = False
+    # Replay restore (no KV image adopted): the pre-migration outputs a
+    # restored request must TEACHER-FORCE back through ordinary decode
+    # steps before free-running sampling resumes. Each commit_token pops
+    # one entry and commits IT (not the freshly sampled token): decode
+    # steps have identical shapes to the original run, so the replayed
+    # region's KV is bitwise what the dead pipeline held — re-prefilling
+    # those positions instead would recompute them under prefill-chunk
+    # shapes, whose float reductions differ enough to flip a near-tied
+    # argmax. Replay rows force the host-synchronous sample path (no
+    # device feed, no fused windows): the substituted token must be the
+    # one fed to the next step.
+    replay_ids: list[int] = dataclasses.field(default_factory=list)
+    replay_logprobs: list[float] = dataclasses.field(default_factory=list)
 
     @property
     def num_prompt_tokens(self) -> int:
@@ -143,6 +172,35 @@ class Request:
     @property
     def num_output_tokens(self) -> int:
         return len(self.output_ids)
+
+    @property
+    def num_generated(self) -> int:
+        """Output tokens in the LOGICAL stream (folded prior outputs of a
+        resumed request included) — the count every budget (min/max_new),
+        penalty window and seeded-key origin must use."""
+        return self.output_offset + len(self.output_ids)
+
+    @property
+    def prior_output_ids(self) -> list[int]:
+        """The folded prior outputs of a resumed request (tail of the
+        prompt); [] for non-migrated requests."""
+        if not self.output_offset:
+            return []
+        return self.prompt_ids[len(self.prompt_ids) - self.output_offset:]
+
+    @property
+    def full_output_ids(self) -> list[int]:
+        """The complete logical output stream: folded prior outputs plus
+        tokens committed since the (last) resume."""
+        if not self.output_offset:
+            return self.output_ids
+        return self.prior_output_ids + self.output_ids
+
+    @property
+    def full_output_logprobs(self) -> list[float]:
+        if not self.output_offset:
+            return self.output_logprobs
+        return list(self.prior_output_logprobs) + self.output_logprobs
 
     @property
     def total_len(self) -> int:
@@ -166,11 +224,19 @@ class Request:
         """
         if self.first_token_time is None:
             self.first_token_time = time.monotonic()
+        if self.replay_ids:
+            # Teacher-forced catch-up of a migrated request: the
+            # recorded stream is authoritative (the sampled token SHOULD
+            # match on equal-numerics replicas; substitution makes the
+            # contract hold even on a near-tied argmax).
+            token_id = self.replay_ids.pop(0)
+            if self.replay_logprobs:
+                logprob = self.replay_logprobs.pop(0)
         self.output_ids.append(token_id)
         if logprob is not None:
             self.output_logprobs.append(logprob)
         sp = self.sampling_params
-        if self.num_output_tokens >= sp.min_new_tokens:
+        if self.num_generated >= sp.min_new_tokens:
             if not sp.ignore_eos and (
                 token_id in self.eos_token_ids or token_id in sp.stop_token_ids
             ):
@@ -180,7 +246,7 @@ class Request:
                     else RequestStatus.FINISHED_EOS
                 )
                 return
-        if self.num_output_tokens >= sp.max_new_tokens:
+        if self.num_generated >= sp.max_new_tokens:
             self.status = RequestStatus.FINISHED_LENGTH
             return
         if self.status is not RequestStatus.PREEMPTED:

@@ -122,6 +122,11 @@ class Scheduler:
         """
         while self.wait_queue and len(self.running) < self.max_batch_size:
             rid, req = next(iter(self.wait_queue.items()))
+            if req.migrating:
+                # About to be checkpointed away: admitting (or swapping
+                # it back in) would race the extraction. The park lands
+                # within a step or two; admission resumes then.
+                break
             if req.status.is_finished:
                 # Aborted while parked (timeout / client cancel): route it
                 # through the running set so the normal finish collection
@@ -181,7 +186,7 @@ class Scheduler:
         """
         self.admit_requests()
         for req in list(self.running.values()):
-            if req.status is not RequestStatus.PREFILLING:
+            if req.status is not RequestStatus.PREFILLING or req.migrating:
                 continue
             if req.lora_id is not None:
                 # The ring-attention SP step does not carry adapter
@@ -273,7 +278,7 @@ class Scheduler:
         for req in list(self.running.values()):
             if len(seqs) >= self.max_batch_size or token_budget <= 0:
                 break
-            if req.status is not RequestStatus.PREFILLING:
+            if req.status is not RequestStatus.PREFILLING or req.migrating:
                 continue
             if req.lora_id != batch_lora:
                 continue
@@ -334,6 +339,7 @@ class Scheduler:
         candidates = [
             req for req in self.running.values()
             if req.status is RequestStatus.DECODING
+            and not req.migrating
             and (req.ready_for_step or req.device_feed_ready)
             and (any_adapter or req.lora_id == batch_lora)
         ]
@@ -416,7 +422,7 @@ class Scheduler:
             want = max(
                 want,
                 seg.request.sampling_params.max_new_tokens
-                - len(seg.request.output_ids) - pending,
+                - seg.request.num_generated - pending,
             )
         m = min(m, max(1, -(-want // k)))
 
@@ -586,6 +592,7 @@ class Scheduler:
         for r in self.running.values():
             if (
                 r is exclude
+                or r.migrating
                 or r.status is not RequestStatus.DECODING
                 or not (r.ready_for_step or r.device_feed_ready)
                 or (exclude_ids and r.request_id in exclude_ids)

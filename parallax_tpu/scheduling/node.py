@@ -233,6 +233,23 @@ class Node:
     # Set when a digest delta arrived out of sequence: the next heartbeat
     # reply asks the worker for a full snapshot.
     digests_need_resync: bool = False
+    # Live-migration drain directives pending for this node's next
+    # heartbeat reply: dead peer ids whose in-flight requests this HEAD
+    # must checkpoint away instead of aborting (docs/resilience.md).
+    pending_drain: set = dataclasses.field(default_factory=set)
+    # Last heartbeat reported an in-progress engine reload/compile: the
+    # sweep multiplies this node's grace so a first-compile storm on a
+    # fresh join is never declared dead (suspect/probation, not
+    # eviction).
+    reported_busy: bool = False
+    # A peer's async sender declared this node unreachable (dead-peer
+    # failure callback): its CacheIndex was cleared immediately and the
+    # sweep shortens its grace. Reset by the next heartbeat — a live
+    # beat disproves the report.
+    peer_down_at: float | None = None
+    # Past the base heartbeat timeout but inside the busy-probation
+    # extended grace (surfaced in /cluster/status).
+    suspect: bool = False
 
     def __post_init__(self):
         self.perf = RooflinePerformanceModel(self.hardware, self.model)

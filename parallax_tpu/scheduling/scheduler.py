@@ -59,6 +59,16 @@ class PendingRequest:
 class GlobalScheduler:
     """Assigns layers to nodes and node paths to requests."""
 
+    # Heartbeat-sweep probation: a node whose last beat reported an
+    # in-progress engine reload/compile gets this multiple of the base
+    # timeout before _handle_leave fires (first-compile storms on fresh
+    # joins must not be declared dead) ...
+    BUSY_GRACE_FACTOR = 5.0
+    # ... while a node a peer's async sender reported unreachable gets
+    # this FRACTION of it (floored at one sweep period) — the report is
+    # evidence, a missing heartbeat on top of it is confirmation.
+    PEER_DOWN_GRACE_FACTOR = 0.25
+
     def __init__(
         self,
         model: ModelConfig,
@@ -106,6 +116,13 @@ class GlobalScheduler:
         self._lock = threading.RLock()
         self.refit_version = 0
         self.refit_index: dict[str, str] = {}
+        # Live migration: rid -> the head node now serving it (reported
+        # by targets via ``migration_done``); pollers that lost their
+        # head ask ``where_is`` here before falling back to a client
+        # resume. Bounded — finished requests age out of the LRU.
+        self._migrations: "OrderedDict[str, str]" = OrderedDict()
+        self.migration_stats = {"drains": 0, "targets_chosen": 0,
+                                "recorded": 0}
 
     # -- public API (thread-safe enqueues) --------------------------------
 
@@ -132,17 +149,33 @@ class GlobalScheduler:
         transport: dict | None = None,
         metrics: dict | None = None,
         cache_digests: dict | None = None,
+        busy: bool | None = None,
     ) -> None:
         self._events.put(
             ("update", node_id, layer_latency_ms, load, rtt_s, is_ready,
              refit_version, lora_adapters, step_timing, cache_stats,
-             transport, metrics, cache_digests)
+             transport, metrics, cache_digests, busy)
         )
 
+    def enqueue_peer_down(self, reporter: str, peer: str,
+                          reason: str = "") -> None:
+        """A worker's async sender declared ``peer`` unreachable: mark
+        its CacheIndex stale NOW (the cache-aware router must stop
+        scoring a dead replica's prefixes — don't wait for the staleness
+        decay) and put it under the accelerated heartbeat sweep."""
+        self._events.put(("peer_down", reporter, peer, reason))
+
     def receive_request(
-        self, request_id: str, meta: RequestMeta | None = None
+        self, request_id: str, meta: RequestMeta | None = None,
+        arrival_time: float | None = None,
     ) -> PendingRequest:
+        """``arrival_time`` (monotonic) preserves the ORIGINAL arrival
+        when a request is re-enqueued after its dispatched path died —
+        the retry must not jump the FCFS ladder nor look newly arrived
+        to timeout accounting."""
         pr = PendingRequest(request_id, meta=meta)
+        if arrival_time is not None:
+            pr.enqueue_time = arrival_time
         self._requests.put(pr)
         return pr
 
@@ -163,6 +196,107 @@ class GlobalScheduler:
             # and publish delta payloads on subsequent heartbeats.
             alloc["want_digests"] = True
         return alloc
+
+    def drain_requested(self, node_id: str) -> list[str]:
+        """Consume a head node's pending drain directives (dead peers
+        whose in-flight requests it must checkpoint away); relayed on
+        the heartbeat reply."""
+        node = self.manager.get(node_id)
+        if node is None or not node.pending_drain:
+            return []
+        # Runs on the heartbeat handler thread while _handle_leave (event
+        # thread) may be adding; the lock makes consume-and-clear atomic
+        # so a directive added mid-consume is never wiped unsent.
+        with self._lock:
+            dead = sorted(node.pending_drain)
+            node.pending_drain.clear()
+        return dead
+
+    # -- live migration ----------------------------------------------------
+
+    def choose_migration_targets(
+        self, requests: list[dict], exclude: "set[str] | None" = None
+    ) -> dict:
+        """Pick a surviving pipeline per parked request, scored the
+        cache-aware way: ``alpha * predicted_uncached + beta *
+        head_load`` against each head's heartbeat-fed CacheIndex mirror
+        (``requests`` carry the restored prompt's block-hash chains), so
+        a migrating request lands where its prefix is already cached and
+        the restore degrades to re-prefill of only the uncovered
+        suffix. Requests without a usable chain fall back to
+        least-loaded. Charges router load per chosen path (released by
+        the target head's eventual request_complete)."""
+        from parallax_tpu.scheduling.request_routing import (
+            eligible_pipelines,
+        )
+
+        excl = set(exclude or ())
+        out: dict = {}
+        candidates = [
+            p for p in eligible_pipelines(self.manager)
+            if not (set(p.node_ids) & excl)
+        ]
+        if not candidates:
+            return out
+        for r in requests:
+            rid = r.get("rid")
+            if not isinstance(rid, str):
+                continue
+            lora = r.get("lora_id")
+            prompt_tokens = int(r.get("prompt_tokens") or 0)
+            chains = r.get("chains") or {}
+            best = best_score = None
+            best_hit = 0
+            for i, p in enumerate(candidates):
+                if lora and not all(
+                    lora in n.lora_adapters for n in p.nodes
+                ):
+                    continue
+                head = p.nodes[0]
+                hit = 0
+                idx = head.cache_index
+                chain = chains.get(idx.block) or chains.get(str(idx.block))
+                if idx.block > 0 and chain and not lora:
+                    try:
+                        hit = idx.predict_cached_tokens(
+                            [int(c) for c in chain], idx.block,
+                            prompt_tokens,
+                        )
+                    except (TypeError, ValueError):
+                        hit = 0
+                score = (
+                    max(0, prompt_tokens - hit) + 256.0 * head.load,
+                    (i + self.migration_stats["targets_chosen"])
+                    % len(candidates),
+                )
+                if best_score is None or score < best_score:
+                    best, best_score, best_hit = p, score, hit
+            if best is None:
+                continue
+            self.router.on_dispatch(best.nodes)
+            self.migration_stats["targets_chosen"] += 1
+            out[rid] = {
+                "path": list(best.node_ids),
+                "head_layers": [
+                    best.nodes[0].start_layer, best.nodes[0].end_layer,
+                ],
+                "predicted_cached_tokens": best_hit,
+            }
+        return out
+
+    def record_migration(self, request_id: str, head: str) -> None:
+        """A target head restored ``request_id``: pollers that lost the
+        old head find the new one via ``migrated_head``."""
+        with self._lock:
+            self._migrations[request_id] = head
+            self._migrations.move_to_end(request_id)
+            while len(self._migrations) > 4096:
+                self._migrations.popitem(last=False)
+            self.migration_stats["recorded"] += 1
+
+    def migrated_head(self, request_id: str) -> str | None:
+        with self._lock:
+            return self._migrations.get(request_id)
 
     def digests_resync_requested(self, node_id: str) -> bool:
         """Consume a node's pending digest-resync flag (set when a delta
@@ -222,16 +356,36 @@ class GlobalScheduler:
             self._try_bootstrap_or_extend()
         elif kind == "leave":
             self._handle_leave(ev[1])
+        elif kind == "peer_down":
+            _, reporter, peer, reason = ev
+            node = self.manager.get(peer)
+            if node is None:
+                return
+            stale = len(node.cache_index)
+            node.cache_index.clear()
+            if node.peer_down_at is None:
+                node.peer_down_at = time.monotonic()
+                logger.warning(
+                    "peer_down: %s reported %s unreachable (%s); "
+                    "%d cache-index digests dropped, sweep accelerated",
+                    reporter, peer, reason or "?", stale,
+                )
         elif kind == "update":
             (_, node_id, lat, load, rtt, ready, refit, adapters, timing,
              cache_stats, *rest) = ev
             transport = rest[0] if rest else None
             metrics = rest[1] if len(rest) > 1 else None
             cache_digests = rest[2] if len(rest) > 2 else None
+            busy = rest[3] if len(rest) > 3 else None
             node = self.manager.get(node_id)
             if node is None:
                 return
             node.touch()
+            # A live beat disproves any dead-peer report or probation.
+            node.peer_down_at = None
+            node.suspect = False
+            if busy is not None:
+                node.reported_busy = bool(busy)
             if lat is not None:
                 node.measured_layer_latency_ms = lat
             if load is not None:
@@ -340,6 +494,22 @@ class GlobalScheduler:
                 node.set_layers(layer, node.end_layer)
 
     def _handle_leave(self, node_id: str) -> None:
+        # Drain, don't abort: every pipeline through the dying node has
+        # a head that owns full request state — flag it (consumed by its
+        # next heartbeat reply) so it checkpoints its in-flight requests
+        # to a surviving pipeline instead of abort-storming them. When
+        # the head IS the dying node, the client-side resume ladder is
+        # the recovery path (SwarmClient mirrors the token stream).
+        for p in self.manager.pipelines:
+            if node_id not in p.node_ids:
+                continue
+            head = p.nodes[0]
+            if head.node_id != node_id:
+                # Locked against drain_requested's consume-and-clear on
+                # the heartbeat handler thread.
+                with self._lock:
+                    head.pending_drain.add(node_id)
+                self.migration_stats["drains"] += 1
         displaced = self.manager.remove(node_id)
         logger.info("node %s left; %d displaced", node_id, len(displaced))
         active = [n for n in self.manager.nodes(NodeState.ACTIVE)]
@@ -364,7 +534,36 @@ class GlobalScheduler:
             # Standby nodes may legitimately sit in a long blocking join;
             # give them a much longer leash before eviction.
             factor = 1.0 if node.has_allocation else 10.0
-            if node.is_stale(self.heartbeat_timeout_s * factor):
+            timeout = self.heartbeat_timeout_s * factor
+            # A dead-peer report overrides busy probation: the report is
+            # hard evidence (a send failed), and a genuinely-busy node
+            # disproves it with its next beat — don't let a stale busy
+            # flag defer the drain by BUSY_GRACE_FACTOR x timeout.
+            if node.reported_busy and node.peer_down_at is None:
+                # Probation, not eviction: an engine reload/compile can
+                # out-last the base timeout (first-compile storms on
+                # fresh joins); the node said so in its last beat.
+                extended = timeout * self.BUSY_GRACE_FACTOR
+                if node.is_stale(timeout) and not node.is_stale(extended):
+                    if not node.suspect:
+                        node.suspect = True
+                        logger.warning(
+                            "heartbeat overdue but %s reported a "
+                            "reload/compile in progress: suspect, "
+                            "grace extended x%.0f",
+                            node.node_id, self.BUSY_GRACE_FACTOR,
+                        )
+                    continue
+                timeout = extended
+            if node.peer_down_at is not None:
+                # A peer already reported it dead; a missing heartbeat
+                # on top of the report is confirmation — don't wait the
+                # full horizon to start draining its pipelines.
+                timeout = min(
+                    timeout,
+                    max(1.5, timeout * self.PEER_DOWN_GRACE_FACTOR),
+                )
+            if node.is_stale(timeout):
                 logger.warning("heartbeat timeout: %s", node.node_id)
                 self._handle_leave(node.node_id)
 
@@ -494,6 +693,9 @@ class GlobalScheduler:
             },
             "predicted_vs_actual": accuracy,
         }
+        # Node-churn robustness: drain directives issued, migration
+        # targets chosen, restores reported back by target heads.
+        report["migrations"] = dict(self.migration_stats)
         report["pipelines"] = [
             {
                 "id": p.pipeline_id,
@@ -503,6 +705,9 @@ class GlobalScheduler:
                         "layers": [n.start_layer, n.end_layer],
                         "load": n.load,
                         "ready": n.is_ready,
+                        # Probation (busy-reload grace) / dead-peer
+                        # report state from the heartbeat sweep.
+                        "suspect": n.suspect,
                         # Overlapped decode loop telemetry (host_ms /
                         # device_ms EWMAs + overlap fraction).
                         "step_timing": n.step_timing,

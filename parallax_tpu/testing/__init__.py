@@ -1,0 +1,3 @@
+"""Test-support layer: deterministic fault injection (chaos.py) for the
+churn tests, the bench churn probe and the CI chaos smoke. Not imported
+by the serving path."""

@@ -306,6 +306,14 @@ class TestChurn:
             # ...then n0 leaves; the same request meta must land on the
             # survivor instead of wedging on the dead pipeline.
             sched.enqueue_leave("n0")
+            # The leave rides the event thread while dispatch runs on
+            # its own; wait for the topology change so the routing
+            # outcome is deterministic (a dispatch that raced ahead
+            # would ride the client-side post-dispatch re-route rung
+            # instead — covered by tests/test_churn_migration.py).
+            assert self.wait_for(
+                lambda: sched.manager.get("n0") is None
+            )
             pr2 = sched.receive_request(
                 "after-leave", meta=RequestMeta("after-leave",
                                                 prompt_ids=toks)
