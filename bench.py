@@ -801,9 +801,21 @@ def _churn_probe(cfg, stage_params_fn, kv_dtype, page_size):
     sched_node.RooflinePerformanceModel.max_layers_in_memory = (
         lambda self, kv_fraction=0.35: split
     )
+    # Health plane ON for the churn probe (docs/observability.md): the
+    # goodput ledger, watchdog, timeline and SLO tracker must observe
+    # the churn episode without changing a single stream bit (the
+    # bit_identical verdict below is exactly that assertion — the clean
+    # pass ran under the same instrumentation).
+    from parallax_tpu.obs.slo import parse_slo_spec
+
     sched = GlobalScheduler(cfg, min_nodes_bootstrapping=2,
                             heartbeat_timeout_s=3.0,
-                            routing="cache_aware")
+                            routing="cache_aware",
+                            slo=parse_slo_spec(
+                                "ttft_p95_ms=60000,tpot_p95_ms=60000,"
+                                "availability=0.5",
+                                window_s=30.0,
+                            ))
     service = SchedulerService(
         sched, chaos.wrap(LoopbackTransport("sched", registry)),
         join_timeout_s=60.0,
@@ -823,6 +835,9 @@ def _churn_probe(cfg, stage_params_fn, kv_dtype, page_size):
             engine_config=_dc.replace(ecfg),
             load_params=stage_params_fn,
             heartbeat_interval_s=0.1,
+            watchdog=True,
+            watchdog_degraded_s=1.0,
+            watchdog_stalled_s=3.0,
         )
         for i in range(4)
     ]
@@ -907,6 +922,9 @@ def _churn_probe(cfg, stage_params_fn, kv_dtype, page_size):
             for r in baseline
         }
 
+        from parallax_tpu.obs.goodput import get_goodput
+
+        goodput_before = get_goodput().snapshot()
         migrations_before = migrations_total()
         victim: dict = {}
         lock = threading.Lock()
@@ -932,6 +950,62 @@ def _churn_probe(cfg, stage_params_fn, kv_dtype, page_size):
             summarize_snapshots(get_registry().histogram_snapshots())
             .get("parallax_migration_ms") or {}
         ).get("", {})
+        # Health-plane verdicts over the churn pass (the CI health smoke
+        # asserts these):
+        # (1) Goodput ledger exactness — every device-step token of the
+        #     churn pass landed in exactly one bucket. The oracle is
+        #     INDEPENDENT of the ledger: the committed bucket must equal
+        #     the token count the client actually streamed (each output
+        #     token commits exactly once — on the source head before the
+        #     kill or on the target after; the teacher-forced re-commits
+        #     land in `replayed`, the re-prefill in `preempted_rework`).
+        gp_after = get_goodput().snapshot()
+        churn_tokens = {
+            k: gp_after["tokens"][k] - goodput_before["tokens"][k]
+            for k in gp_after["tokens"]
+        }
+        churn_total = sum(churn_tokens.values())
+        churn_useful = churn_tokens.get("committed", 0)
+        client_tokens = sum(len(r.output_ids) for r in churn)
+        goodput_payload = get_goodput().payload()
+        # (2) The kill must read as a causally-ordered stall->migration
+        #     story in the merged timeline: the scheduler's peer_down/
+        #     node_leave verdicts on the victim, then the head's
+        #     migrate_park/migrate_out, then migration_done on the
+        #     survivor.
+        tl = sched.timeline.snapshot(limit=None)
+        killed = victim.get("tail")
+        order = [
+            e["kind"] for e in tl["events"]
+            if e["kind"] in ("peer_down", "node_leave", "migrate_park",
+                             "migrate_out", "migration_done")
+            and (e.get("node") == killed
+                 or e["kind"] in ("migrate_park", "migrate_out",
+                                  "migration_done"))
+        ]
+        # The stall verdict on the victim (a peer_down report from a
+        # surviving sender, or the sweep's node_leave — whichever lands
+        # first) must precede the migration completing on the survivor:
+        # that is the causally-ordered stall -> migration story.
+        stall_idx = min(
+            (order.index(k) for k in ("peer_down", "node_leave")
+             if k in order),
+            default=None,
+        )
+        stall_then_migration = (
+            stall_idx is not None
+            and "migrate_out" in order
+            and "migration_done" in order
+            and stall_idx < (
+                len(order) - 1 - order[::-1].index("migration_done")
+            )
+        )
+        status = sched.cluster_status()
+        node_health = {
+            n["node_id"]: (n.get("health") or {}).get("status")
+            for p in status.get("pipelines", ())
+            for n in p.get("nodes", ())
+        }
         return {
             "workload": {
                 "requests": n_req, "prompt_len": prompt_len,
@@ -947,6 +1021,41 @@ def _churn_probe(cfg, stage_params_fn, kv_dtype, page_size):
                     k: mig_ms.get(k) for k in ("count", "p50", "p95")
                 } if mig_ms else {},
             },
+            "health_plane": {
+                # Churn-pass goodput deltas: useful + wasted == total by
+                # ledger construction; waste > 0 proves the migration
+                # replay/rework showed up as lost goodput, not hidden
+                # inside latency.
+                "goodput": {
+                    "tokens": churn_tokens,
+                    "tokens_total": churn_total,
+                    "tokens_useful": churn_useful,
+                    "tokens_wasted": churn_total - churn_useful,
+                    # Independent oracle: the useful bucket must equal
+                    # the client-observed stream length, token for
+                    # token — double counts or drops in the engine's
+                    # classification hooks fail here.
+                    "client_tokens": client_tokens,
+                    "exact": churn_useful == client_tokens,
+                    "goodput_fraction": (
+                        round(churn_useful / churn_total, 6)
+                        if churn_total else 0.0
+                    ),
+                    "tokens_useful_per_chip_second": round(
+                        goodput_payload["tokens_useful"]
+                        / max(goodput_payload["elapsed_s"], 1e-9), 3,
+                    ),
+                },
+                "timeline": {
+                    "ingested": tl["ingested"],
+                    "gaps": tl["gaps"],
+                    "killed_node_events": order,
+                    "stall_then_migration": stall_then_migration,
+                },
+                "slo": status.get("slo"),
+                "node_health": node_health,
+                "cluster_health": status.get("health"),
+            },
         }
     finally:
         sched_node.RooflinePerformanceModel.max_layers_in_memory = orig_cap
@@ -954,6 +1063,19 @@ def _churn_probe(cfg, stage_params_fn, kv_dtype, page_size):
             if not chaos.is_dead(w.node_id):
                 w.stop()
         service.stop()
+
+
+def _goodput_payload() -> dict:
+    """The process goodput ledger's payload (tokens by usefulness
+    bucket, time taxonomy, goodput fraction) for bench JSON."""
+    try:
+        import jax as _jax
+
+        from parallax_tpu.obs.goodput import get_goodput
+
+        return get_goodput().payload(chips=_jax.local_device_count())
+    except Exception:
+        return {}
 
 
 def _obs_metrics() -> dict:
@@ -1662,6 +1784,11 @@ def _bench():
             # tokens) — the same series /metrics exposes, proving the
             # bench run populated the unified registry.
             "metrics": _obs_metrics(),
+            # Goodput ledger (obs/goodput.py): the whole run's device-
+            # step tokens by usefulness bucket plus the serve/compile/
+            # swap/migrate/idle time split — useful + wasted == total by
+            # construction.
+            "goodput": _goodput_payload(),
             # Multi-step decode probe (same engine, identical prompts,
             # K-on vs K-off): host visits, tokens/visit, per-visit and
             # amortized per-token dispatch medians side by side, plus

@@ -211,6 +211,8 @@ class OpenAIFrontend:
         stop_fn=None,
         scheduler_init_fn=None,
         adapters_fn=None,
+        healthz_fn=None,
+        timeline_fn=None,
     ):
         self.tokenizer = tokenizer
         self.submit_fn = submit_fn
@@ -235,6 +237,11 @@ class OpenAIFrontend:
         self.stop_fn = stop_fn
         self.adapters_fn = adapters_fn
         self.scheduler_init_fn = scheduler_init_fn
+        # Deep health (stall watchdog summary) and cluster timeline
+        # providers — None keeps the endpoints serving shallow/empty
+        # payloads so scrapers need no feature detection.
+        self.healthz_fn = healthz_fn
+        self.timeline_fn = timeline_fn
         self.model_name = model_name
         self.stream_poll_s = stream_poll_s
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
@@ -282,12 +289,14 @@ class OpenAIFrontend:
             web.post("/v1/completions", self.completions),
             web.get("/v1/models", self.models),
             web.get("/health", self.health),
+            web.get("/healthz", self.healthz),
             web.get("/metrics", self.metrics),
             web.get("/chat", self.chat_page),
             web.get("/cluster/status", self.cluster_status_stream),
             web.get("/cluster/status_json", self.cluster_status_json),
             web.get("/debug/trace/{request_id}", self.debug_trace),
             web.get("/debug/flight", self.debug_flight),
+            web.get("/debug/timeline", self.debug_timeline),
             web.post("/weight/refit", self.weight_refit),
             web.post("/scheduler/init", self.scheduler_init),
             web.post("/profile/start", self.profile_start),
@@ -316,6 +325,49 @@ class OpenAIFrontend:
 
     async def health(self, _req):
         return web.json_response({"status": "ok"})
+
+    async def healthz(self, _req):
+        """Deep health: the stall watchdog's per-component state machine
+        (docs/observability.md). Liveness alone is ``/health``; this one
+        answers "is the serving path actually making progress" — 503
+        when any component is stalled so orchestrators can act on
+        sick-but-alive processes. Shallow ok when no watchdog runs."""
+        if self.healthz_fn is None:
+            return web.json_response(
+                {"status": "ok", "components": {}, "causes": []}
+            )
+        try:
+            summary = self.healthz_fn()
+        except Exception as e:
+            return web.json_response(
+                {"status": "unknown", "error": str(e)}, status=500
+            )
+        status = 503 if summary.get("status") == "stalled" else 200
+        return web.json_response(summary, status=status)
+
+    async def debug_timeline(self, request):
+        """The merged cluster event timeline (obs/timeline.py): one
+        causally-ordered story of churn episodes across every node's
+        flight recorder plus the scheduler's own decisions.
+        ``?format=chrome`` exports Chrome trace-event JSON (one lane per
+        node) for chrome://tracing / Perfetto; ``?limit=`` bounds the
+        JSON event list (default 1000)."""
+        if self.timeline_fn is None:
+            return self._error(
+                404,
+                "no cluster timeline on this endpoint (serve it from "
+                "the scheduler frontend, or enable the local timeline)",
+            )
+        fmt = request.query.get("format", "json")
+        try:
+            limit = max(1, int(request.query.get("limit", "1000")))
+        except ValueError:
+            limit = 1000
+        try:
+            data = self.timeline_fn(fmt, limit)
+        except Exception as e:
+            return self._error(500, f"timeline export failed: {e}")
+        return web.json_response(data)
 
     async def metrics(self, _req):
         """Prometheus text exposition of the process-wide registry:

@@ -305,6 +305,58 @@ def build_swarm_frontend(
             if n.has_allocation and n.is_ready
         )
 
+    def timeline(fmt: str, limit: int):
+        tl = service.scheduler.timeline
+        if fmt == "chrome":
+            return tl.export_chrome()
+        return tl.snapshot(limit=limit)
+
+    def healthz():
+        # Deep cluster health: sick-but-alive detection the binary
+        # heartbeat sweep cannot provide. The top-level ``status``
+        # drives the HTTP code, and it answers "can this SERVICE still
+        # serve" — it reads ``stalled`` (503) only when every pipeline
+        # is blocked by a stalled member, so a liveness probe pointed
+        # here never restarts the healthy scheduler frontend over one
+        # sick worker among replicas. Individual sick workers surface
+        # as ``degraded`` with the per-node detail below (and in
+        # ``/cluster/status``'s health rollup).
+        from parallax_tpu.obs.watchdog import worst_status
+
+        sched = service.scheduler
+        pipelines = sched.manager.pipelines
+        nodes = {
+            n.node_id: n.health
+            for p in pipelines for n in p.nodes
+            if n.health
+        }
+        cluster = worst_status(h.get("status") for h in nodes.values())
+        pipe_status = [
+            worst_status(
+                (n.health or {}).get("status") for n in p.nodes
+            )
+            for p in pipelines
+        ]
+        if pipe_status and all(s == "stalled" for s in pipe_status):
+            status = "stalled"          # no serviceable path left
+        elif cluster != "ok":
+            status = "degraded"
+        else:
+            status = "ok"
+        return {
+            "status": status,
+            "cluster_status": cluster,
+            "bootstrapped": sched.bootstrapped.is_set(),
+            "components": {
+                nid: h.get("components") or {} for nid, h in nodes.items()
+            },
+            "causes": [
+                f"{nid}: {c}"
+                for nid, h in nodes.items()
+                for c in (h.get("causes") or ())
+            ],
+        }
+
     frontend = OpenAIFrontend(
         tokenizer,
         submit_fn=client.submit,
@@ -314,6 +366,8 @@ def build_swarm_frontend(
         model_name=model_name,
         stop_fn=client.stop,
         adapters_fn=adapters,
+        healthz_fn=healthz,
+        timeline_fn=timeline,
     )
     if resolve_model is not None:
         frontend.scheduler_init_fn = make_scheduler_init_fn(
@@ -383,13 +437,18 @@ def make_scheduler_init_fn(service: SchedulerService, resolve_model,
             raise ValueError(str(e))
         new_tokenizer = tokenizer_fn(model_name) if tokenizer_fn else None
         with lock:   # serialize concurrent switches: one stop per swap
+            old_tracker = service.scheduler.slo_tracker
             new_sched = GlobalScheduler(
                 model, min_nodes_bootstrapping=init_nodes_num,
                 # The operator's routing choice AND tuning (--routing-alpha
                 # etc.) survive a model switch.
                 routing=service.scheduler.routing_name,
                 routing_kwargs=service.scheduler.routing_kwargs,
+                # The SLO objectives (and their burn-rate history)
+                # survive a model switch too — the error budget belongs
+                # to the service, not the model.
             )
+            new_sched.slo_tracker = old_tracker
             old = service.scheduler
             new_sched.start()
             service.scheduler = new_sched
@@ -437,10 +496,21 @@ def run_main(args) -> int:
                 args, "routing_imbalance", 8
             ),
         }
+    slo_config = None
+    slo_spec = getattr(args, "slo", None)
+    if slo_spec:
+        from parallax_tpu.obs.slo import parse_slo_spec
+
+        # Fails fast on a malformed spec — a typo'd objective must not
+        # silently track nothing.
+        slo_config = parse_slo_spec(
+            slo_spec, window_s=getattr(args, "slo_window_s", 300.0),
+        )
     scheduler = GlobalScheduler(
         model, min_nodes_bootstrapping=args.min_nodes,
         routing=getattr(args, "routing", "rr"),
         routing_kwargs=routing_kwargs,
+        slo=slo_config,
     )
     transport = TcpTransport(
         "scheduler", "0.0.0.0", args.port + 1,

@@ -155,6 +155,27 @@ class SchedulerService:
             busy=(
                 bool(payload["busy"]) if "busy" in payload else None
             ),
+            # Goodput ledger payload (token usefulness buckets + time
+            # taxonomy) — cluster-merged in /cluster/status.
+            goodput=(
+                payload["goodput"]
+                if isinstance(payload.get("goodput"), dict)
+                else None
+            ),
+            # Watchdog health state machine — per-node health in
+            # /cluster/status (sick, not just dead).
+            health=(
+                payload["health"]
+                if isinstance(payload.get("health"), dict)
+                else None
+            ),
+            # Sequence-numbered flight-event batch — merged into the
+            # scheduler-side cluster timeline (/debug/timeline).
+            events=(
+                payload["events"]
+                if isinstance(payload.get("events"), dict)
+                else None
+            ),
         )
         alloc = self._with_model(self.scheduler.get_node_allocation(node_id) or {})
         alloc["refit_version"] = self.scheduler.refit_version

@@ -22,8 +22,11 @@ class LocalRunner:
     """Steps an in-process pipeline on a background thread and completes
     per-request events."""
 
-    def __init__(self, pipeline: InProcessPipeline):
+    def __init__(self, pipeline: InProcessPipeline, watchdog=None):
         self.pipeline = pipeline
+        # Optional stall watchdog (obs/watchdog.py): one beat per loop
+        # pass — a step round that hangs stops the beats.
+        self.watchdog = watchdog
         self._events: dict[str, threading.Event] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -55,6 +58,8 @@ class LocalRunner:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
+            if self.watchdog is not None:
+                self.watchdog.beat("step_loop")
             if not self.pipeline.has_work():
                 self._stop.wait(0.002)
                 continue
@@ -71,12 +76,44 @@ def build_local_frontend(
     tokenizer,
     model_name: str = "parallax-tpu",
     wire: bool = False,
+    watchdog: bool = False,
+    slo_config=None,
 ) -> tuple[OpenAIFrontend, LocalRunner]:
     """``wire=True`` routes inter-stage packets through the real wire
     format (the in-process twin of the networked hop) — exercised by the
-    observability tests so stitched traces cover the transport leg."""
+    observability tests so stitched traces cover the transport leg.
+    ``watchdog=True`` runs the stall watchdog over the runner loop and
+    each stage's admission queue (deep ``/healthz``); ``slo_config``
+    (obs/slo.py SLOConfig) adds windowed SLO attainment / burn rates to
+    the status payload."""
     pipeline = InProcessPipeline(engines, wire=wire)
-    runner = LocalRunner(pipeline)
+    wd = None
+    if watchdog:
+        from parallax_tpu.obs.watchdog import StallWatchdog
+
+        wd = StallWatchdog(node_id="local")
+        wd.register_beat(
+            "step_loop",
+            lambda: sum(e.scheduler.num_requests() for e in engines),
+        )
+        for i, e in enumerate(engines):
+            sched = e.scheduler
+
+            def _admission(sched=sched):
+                return (
+                    float(len(sched.wait_queue)),
+                    float(sched.admitted_total),
+                    f"{len(sched.running)} running",
+                )
+
+            wd.register(f"admission[{i}]", _admission)
+        wd.start()
+    slo_tracker = None
+    if slo_config is not None:
+        from parallax_tpu.obs.slo import SLOTracker
+
+        slo_tracker = SLOTracker(slo_config)
+    runner = LocalRunner(pipeline, watchdog=wd)
     runner.start()
 
     # Grammar-constrained decoding lives on the LAST stage (where sampling
@@ -93,19 +130,27 @@ def build_local_frontend(
                            "json_schema requests will be rejected", e)
 
     def status():
+        import jax as _jax
+
+        from parallax_tpu.obs.goodput import get_goodput
         from parallax_tpu.obs.registry import (
             get_registry,
             summarize_snapshots,
         )
 
-        return {
+        snaps = get_registry().histogram_snapshots()
+        goodput = get_goodput().payload(
+            chips=_jax.local_device_count()
+        )
+        out = {
             "mode": "single-host",
             # Latency percentiles (TTFT/TPOT/e2e/step timing) from the
             # process registry — the single-host twin of the swarm's
             # cluster-wide heartbeat merge.
-            "metrics": summarize_snapshots(
-                get_registry().histogram_snapshots()
-            ),
+            "metrics": summarize_snapshots(snaps),
+            # Goodput ledger: token usefulness buckets + the serve/
+            # compile/swap/migrate/idle time taxonomy.
+            "goodput": goodput,
             "stages": [
                 {
                     "layers": [e.model.start_layer, e.model.end_layer],
@@ -124,6 +169,19 @@ def build_local_frontend(
                 for e in engines
             ],
         }
+        if wd is not None:
+            out["health"] = wd.summary()
+        if slo_tracker is not None:
+            # Each status poll is one tracker sample: attainment + burn
+            # over the local histograms and the ledger's finished/
+            # aborted counts.
+            req = goodput.get("requests") or {}
+            out["slo"] = slo_tracker.observe_and_evaluate({
+                "hists": snaps,
+                "finished": req.get("finished") or 0,
+                "aborted": req.get("aborted") or 0,
+            })
+        return out
 
     def adapters():
         from parallax_tpu.ops.lora import intersect_adapter_names
@@ -132,6 +190,15 @@ def build_local_frontend(
             e.adapter_names() for e in engines
         )
 
+    from parallax_tpu.obs.timeline import LocalTimeline
+
+    local_timeline = LocalTimeline(node_id="local")
+
+    def timeline(fmt: str, limit: int):
+        if fmt == "chrome":
+            return local_timeline.export_chrome()
+        return local_timeline.snapshot(limit=limit)
+
     frontend = OpenAIFrontend(
         tokenizer,
         submit_fn=runner.submit,
@@ -139,6 +206,8 @@ def build_local_frontend(
         model_name=model_name,
         stop_fn=runner.stop_request,
         adapters_fn=adapters,
+        healthz_fn=(wd.summary if wd is not None else None),
+        timeline_fn=timeline,
     )
     return frontend, runner
 
@@ -336,8 +405,21 @@ def serve_main(args) -> int:
     ).items():
         engine.load_adapter(name, path)
     tokenizer = load_tokenizer(args.model_path)
+    slo_config = None
+    slo_spec = getattr(args, "slo", None)
+    if slo_spec:
+        from parallax_tpu.obs.slo import parse_slo_spec
+
+        # Fails fast on a malformed spec — a typo'd objective must not
+        # silently track nothing.
+        slo_config = parse_slo_spec(
+            slo_spec,
+            window_s=getattr(args, "slo_window_s", 300.0),
+        )
     frontend, _runner = build_local_frontend(
-        [engine], tokenizer, model_name=args.model_path
+        [engine], tokenizer, model_name=args.model_path,
+        watchdog=bool(getattr(args, "watchdog", False)),
+        slo_config=slo_config,
     )
     logger.info("serving %s layers [%d, %d) on :%d",
                 args.model_path, start, end, args.port)

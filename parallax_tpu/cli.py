@@ -113,6 +113,24 @@ def build_parser() -> argparse.ArgumentParser:
              "restarts reload compiled programs instead of paying a "
              "recompilation storm",
     )
+    serve.add_argument(
+        "--watchdog", action="store_true",
+        help="run the stall watchdog over the serving loop and the "
+             "admission queues: pending work whose progress counter "
+             "stops moving walks ok -> degraded -> stalled and flips "
+             "the deep GET /healthz (default: off, zero overhead)",
+    )
+    serve.add_argument(
+        "--slo", default=None,
+        help="declarative SLO objectives, e.g. "
+             "'ttft_p95_ms=500,tpot_p95_ms=50,availability=0.999' — "
+             "windowed attainment and multi-window burn rates appear "
+             "in /status and as parallax_slo_* gauges",
+    )
+    serve.add_argument(
+        "--slo-window-s", type=float, default=300.0,
+        help="short SLO window seconds (the long window is 12x)",
+    )
 
     run = sub.add_parser("run", help="launch the scheduler + web frontend")
     run.add_argument("--model-name", required=True)
@@ -148,6 +166,19 @@ def build_parser() -> argparse.ArgumentParser:
         "--relay-token", default=None,
         help="shared secret NAT'd workers must present to register a "
              "relay route (default: registration is identity-bound only)",
+    )
+    run.add_argument(
+        "--slo", default=None,
+        help="declarative cluster SLO objectives, e.g. "
+             "'ttft_p95_ms=500,tpot_p95_ms=50,availability=0.999' — "
+             "evaluated over the cluster-merged histograms; attainment "
+             "and burn rates appear in /cluster/status 'slo' and as "
+             "parallax_slo_* gauges (the admission-control hook point "
+             "for SLO-aware scheduling)",
+    )
+    run.add_argument(
+        "--slo-window-s", type=float, default=300.0,
+        help="short SLO window seconds (the long window is 12x)",
     )
 
     join = sub.add_parser("join", help="join a swarm as a worker")
@@ -237,6 +268,23 @@ def build_parser() -> argparse.ArgumentParser:
         help="persistent XLA compilation cache directory (default: "
              "$PARALLAX_TPU_COMPILE_CACHE or "
              "~/.cache/parallax_tpu/xla_cache; 'off' disables)",
+    )
+    join.add_argument(
+        "--watchdog", action="store_true",
+        help="run the stall watchdog over this worker's step loop, "
+             "sender queues, migration parks and admission queue; "
+             "health states ride heartbeats into /cluster/status "
+             "(default: off, zero overhead)",
+    )
+    join.add_argument(
+        "--watchdog-degraded-s", type=float, default=5.0,
+        help="seconds without progress (with pending work) before a "
+             "component reports degraded",
+    )
+    join.add_argument(
+        "--watchdog-stalled-s", type=float, default=15.0,
+        help="seconds without progress before a component reports "
+             "stalled (flips deep /healthz to 503)",
     )
 
     bench = sub.add_parser("bench", help="offline throughput benchmark")

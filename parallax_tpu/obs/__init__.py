@@ -1,7 +1,7 @@
 """Unified observability layer: metrics registry, request tracing,
-flight recorder.
+flight recorder, and the production health plane.
 
-Three pillars (docs/observability.md):
+Seven pillars (docs/observability.md):
 
 - :mod:`parallax_tpu.obs.registry` — thread-safe Counter/Gauge/Histogram
   primitives with Prometheus text exposition; every engine/transport/HTTP
@@ -9,16 +9,31 @@ Three pillars (docs/observability.md):
   full serving surface, and histogram snapshots ride worker heartbeats
   into cluster-wide percentiles.
 - :mod:`parallax_tpu.obs.trace` — request-lifecycle span recorder whose
-  trace context rides the FORWARD wire frames, so spans emitted on
-  different pipeline stages stitch into one Chrome-trace-viewable trace
-  (``GET /debug/trace/<request_id>``).
+  trace context rides the FORWARD wire frames (and, since PR 8, the
+  migration checkpoint frames), so spans emitted on different pipeline
+  stages — and different heads — stitch into one Chrome-trace-viewable
+  trace (``GET /debug/trace/<request_id>``).
 - :mod:`parallax_tpu.obs.flight` — bounded ring of recent request
-  timelines plus engine events (preemption, abort_path, wire-dtype
-  renegotiation, queue overflow), surfaced at ``GET /debug/flight`` and
-  auto-logging slow requests with their span breakdown.
+  timelines plus sequence-numbered engine events, surfaced at
+  ``GET /debug/flight`` and shipped in heartbeat batches to the cluster
+  timeline.
+- :mod:`parallax_tpu.obs.goodput` — the goodput ledger: every
+  device-step token classified committed / frozen_tail / replayed /
+  preempted_rework / speculative_rejected, and serving time bucketed
+  serve / compile / swap / migrate / idle; cluster-merged into
+  tokens-useful-per-chip-second.
+- :mod:`parallax_tpu.obs.watchdog` — per-component progress watchdog
+  (ok -> degraded -> stalled) feeding a deep ``/healthz`` and per-node
+  health in ``/cluster/status``.
+- :mod:`parallax_tpu.obs.timeline` — the scheduler-side merge of every
+  node's flight events into one causally-ordered swarm timeline
+  (``GET /debug/timeline``, JSON + Chrome trace).
+- :mod:`parallax_tpu.obs.slo` — declarative TTFT/TPOT/availability
+  objectives with windowed attainment and multi-window burn rates.
 """
 
 from parallax_tpu.obs.flight import FlightRecorder, get_flight
+from parallax_tpu.obs.goodput import GoodputLedger, get_goodput, merge_goodput
 from parallax_tpu.obs.registry import (
     EXPOSITION_CONTENT_TYPE,
     MetricsRegistry,
@@ -26,16 +41,29 @@ from parallax_tpu.obs.registry import (
     merge_histogram_snapshots,
     summarize_snapshots,
 )
+from parallax_tpu.obs.slo import SLOConfig, SLOTracker, parse_slo_spec
+from parallax_tpu.obs.timeline import ClusterTimeline, LocalTimeline
 from parallax_tpu.obs.trace import TraceStore, get_trace_store
+from parallax_tpu.obs.watchdog import StallWatchdog, worst_status
 
 __all__ = [
     "EXPOSITION_CONTENT_TYPE",
+    "ClusterTimeline",
     "FlightRecorder",
+    "GoodputLedger",
+    "LocalTimeline",
     "MetricsRegistry",
+    "SLOConfig",
+    "SLOTracker",
+    "StallWatchdog",
     "TraceStore",
     "get_flight",
+    "get_goodput",
     "get_registry",
     "get_trace_store",
+    "merge_goodput",
     "merge_histogram_snapshots",
+    "parse_slo_spec",
     "summarize_snapshots",
+    "worst_status",
 ]

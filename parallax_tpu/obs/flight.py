@@ -33,6 +33,10 @@ class FlightRecorder:
         self._events: deque[dict] = deque(maxlen=event_capacity)
         self._lock = threading.Lock()
         self.slow_count = 0
+        # Monotonic per-process event sequence: the cluster timeline
+        # (obs/timeline.py) merges per-node rings by it, and a gap in a
+        # node's shipped sequence is detected loudly scheduler-side.
+        self._event_seq = 0
 
     # -- recording ---------------------------------------------------------
 
@@ -86,11 +90,44 @@ class FlightRecorder:
         try:
             rec = {"kind": kind, "time": time.time(), **fields}
             with self._lock:
+                self._event_seq += 1
+                rec["seq"] = self._event_seq
                 self._events.append(rec)
         except Exception:  # pragma: no cover - defensive
             pass
 
     # -- export ------------------------------------------------------------
+
+    def events_since(
+        self, seq: int, limit: int = 256, node: str | None = None
+    ) -> tuple[list[dict], int]:
+        """Events with sequence number > ``seq`` (oldest first, at most
+        ``limit``) and the new cursor to resume from — the bounded batch
+        a worker heartbeat ships to the scheduler's cluster timeline.
+        The cursor only covers what was RETURNED, so a caller whose send
+        failed simply retries from the old cursor (the timeline dedupes
+        resends by sequence). ``node`` filters to events tagged with
+        that node id (or untagged) — in-process swarms share one ring,
+        and each member must not ship its siblings' TAGGED events under
+        its own name. Untagged events (engine/cache emitters don't know
+        a node id) match every member's filter, so an in-process swarm
+        ships them once per member — a test-harness artifact; real
+        deployments run one node per process and attribute them
+        correctly."""
+        with self._lock:
+            events = [e for e in self._events if e.get("seq", 0) > seq]
+        if node is not None:
+            events = [e for e in events if e.get("node") in (None, node)]
+        events = events[:limit]
+        return events, (events[-1]["seq"] if events else seq)
+
+    def oldest_seq(self) -> int:
+        """Sequence number of the oldest event still in the ring (0 when
+        empty). A shipper whose cursor is older than this missed events
+        to ring eviction — the loss signal the cluster timeline counts
+        loudly."""
+        with self._lock:
+            return self._events[0].get("seq", 0) if self._events else 0
 
     def snapshot(self) -> dict:
         with self._lock:

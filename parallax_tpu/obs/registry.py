@@ -56,12 +56,22 @@ DEFAULT_COUNT_BUCKETS = log_buckets(1.0, 65_536.0, per_decade=3)
 
 
 def _escape_label(value: str) -> str:
+    """Prometheus label-VALUE escaping (backslash, newline, quote).
+    Every label value the registry renders routes through here — peer
+    addresses, pipeline ids and request-derived strings are hostile
+    input as far as the exposition format is concerned."""
     return (
         str(value)
         .replace("\\", "\\\\")
         .replace("\n", "\\n")
         .replace('"', '\\"')
     )
+
+
+def _escape_help(text: str) -> str:
+    """HELP-line escaping per the exposition format (backslash and
+    newline only; quotes are legal there)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _fmt(v: float) -> str:
@@ -226,7 +236,7 @@ class _Family:
 
     def render(self) -> list[str]:
         lines = [
-            f"# HELP {self.name} {self.help}",
+            f"# HELP {self.name} {_escape_help(self.help)}",
             f"# TYPE {self.name} {self.kind}",
         ]
         with self._lock:
@@ -380,13 +390,32 @@ class MetricsRegistry:
         }
 
 
+def _count_merge_skipped(n: int = 1) -> None:
+    """Bump ``parallax_obs_merge_skipped_total`` (never raises)."""
+    try:
+        get_registry().counter(
+            "parallax_obs_merge_skipped_total",
+            "Histogram children whose bucket lattice could not be "
+            "merged bucket-for-bucket (heterogeneous-build swarm); "
+            "their sum/count still fold in, percentiles degrade loudly",
+        ).inc(n)
+    except Exception:  # pragma: no cover - metrics never break merging
+        pass
+
+
 def merge_histogram_snapshots(snaps: list[dict]) -> dict:
     """Merge per-node ``histogram_snapshots()`` payloads element-wise.
 
-    Children from different nodes merge only when their bucket bounds
-    match (they do, by the shared-lattice convention); mismatched or
-    malformed entries are skipped — cluster telemetry must survive a
-    heterogeneous-build swarm.
+    Children from different nodes merge bucket-for-bucket when their
+    bounds match (they do, by the shared-lattice convention). A child
+    whose lattice DISAGREES — a heterogeneous-build swarm — is no
+    longer dropped silently: its ``sum``/``count`` still fold into the
+    merged child, the child is flagged with ``mixed_bounds`` (how many
+    children degraded to sum/count-only merging, propagated into
+    :func:`summarize_snapshots` output), and
+    ``parallax_obs_merge_skipped_total`` counts it — cluster p50/p95/
+    p99 then degrade loudly, not silently. Children too malformed to
+    even yield a sum/count are skipped and counted.
     """
     merged: dict[str, dict] = {}
     for snap in snaps:
@@ -398,34 +427,73 @@ def merge_histogram_snapshots(snaps: list[dict]) -> dict:
             out_children = merged.setdefault(name, {})
             for label, child in children.items():
                 try:
-                    bounds = list(child["bounds"])
-                    counts = list(child["counts"])
-                    if len(counts) != len(bounds) + 1:
-                        continue
-                    cur = out_children.get(label)
-                    if cur is None:
-                        out_children[label] = {
-                            "bounds": bounds,
-                            "counts": counts,
-                            "sum": float(child["sum"]),
-                            "count": int(child["count"]),
-                        }
-                    elif cur["bounds"] == bounds:
-                        cur["counts"] = [
-                            a + b for a, b in zip(cur["counts"], counts)
-                        ]
-                        cur["sum"] += float(child["sum"])
-                        cur["count"] += int(child["count"])
+                    csum = float(child["sum"])
+                    ccount = int(child["count"])
                 except (KeyError, TypeError, ValueError):
+                    _count_merge_skipped()
                     continue
+                try:
+                    bounds = list(child["bounds"])
+                    counts = [int(c) for c in child["counts"]]
+                    if len(counts) != len(bounds) + 1:
+                        bounds = counts = None
+                except (KeyError, TypeError, ValueError):
+                    bounds = counts = None
+                cur = out_children.get(label)
+                if cur is None:
+                    if bounds is None:
+                        # Lattice unusable: carry sum/count only, with
+                        # a degenerate one-bucket lattice so downstream
+                        # percentile code stays shape-safe.
+                        _count_merge_skipped()
+                        out_children[label] = {
+                            "bounds": [], "counts": [0],
+                            "sum": csum, "count": ccount,
+                            "mixed_bounds": 1,
+                        }
+                    else:
+                        out_children[label] = {
+                            "bounds": bounds, "counts": counts,
+                            "sum": csum, "count": ccount,
+                        }
+                elif bounds is not None and cur["bounds"] == bounds:
+                    cur["counts"] = [
+                        a + b for a, b in zip(cur["counts"], counts)
+                    ]
+                    cur["sum"] += csum
+                    cur["count"] += ccount
+                elif bounds is not None and not cur["bounds"]:
+                    # The merged child so far is lattice-less (a
+                    # malformed FIRST child pinned the degenerate []
+                    # lattice): adopt this child's valid lattice so one
+                    # bad node cannot destroy percentiles for everyone
+                    # behind it — order must not change the answer.
+                    cur["bounds"] = bounds
+                    cur["counts"] = counts
+                    cur["sum"] += csum
+                    cur["count"] += ccount
+                else:
+                    # Bucket-lattice mismatch (or unusable lattice):
+                    # fall back to sum/count-only merging and say so.
+                    _count_merge_skipped()
+                    cur["sum"] += csum
+                    cur["count"] += ccount
+                    cur["mixed_bounds"] = cur.get("mixed_bounds", 0) + 1
     return merged
 
 
 def snapshot_quantile(snap: dict, q: float) -> float:
     """Estimate the q-quantile from one histogram snapshot (linear
     interpolation inside the landing bucket; the +Inf bucket reports its
-    lower bound — the honest answer bucketed data can give)."""
-    count = snap.get("count", 0)
+    lower bound — the honest answer bucketed data can give).
+
+    The quantile targets the BUCKET population (``sum(counts)``), not
+    ``count``: a mixed-bounds merge (see merge_histogram_snapshots)
+    folds sum/count-only children into ``count`` without bucket
+    attribution, and targeting the inflated count would push every
+    quantile toward the lattice max. For ordinary snapshots the two are
+    equal."""
+    count = sum(snap.get("counts") or ()) or snap.get("count", 0)
     if not count:
         return 0.0
     target = q * count
@@ -463,6 +531,12 @@ def summarize_snapshots(snaps: dict, quantiles=(0.5, 0.95, 0.99)) -> dict:
                     entry[f"p{int(q * 100)}"] = round(
                         snapshot_quantile(child, q), 3
                     )
+                mixed = child.get("mixed_bounds")
+                if mixed:
+                    # Sum/count-only children were folded in: the
+                    # percentiles cover only the bucket-compatible
+                    # population — degrade loudly.
+                    entry["mixed_bounds"] = int(mixed)
                 per[label or ""] = entry
             except (KeyError, TypeError, ValueError):
                 continue

@@ -103,6 +103,47 @@ class TraceStore:
             if merge:
                 trace["open"][(stage, name)] = span
 
+    def adopt(self, trace_id: str, spans: list[dict]) -> int:
+        """Seed a trace with spans recorded on ANOTHER host (live
+        migration: the source head ships its TraceStore spans inside the
+        checkpoint frame so ``/debug/trace/<rid>`` on the target shows
+        one stitched timeline across heads). Spans are sanitized
+        field-by-field — they arrive off the wire — and bounded by
+        ``max_spans``; returns how many were adopted. Caller owns any
+        clock rebasing (``t0`` must already be in this process's
+        ``perf_counter`` domain)."""
+        self.begin(trace_id)
+        adopted = 0
+        with self._lock:
+            trace = self._traces.get(trace_id)
+            if trace is None:
+                return 0
+            out = trace["spans"]
+            for s in spans or ():
+                if len(out) >= self.max_spans:
+                    break
+                if not isinstance(s, dict):
+                    continue
+                try:
+                    span = {
+                        "name": str(s["name"])[:64],
+                        "stage": str(s.get("stage") or "?")[:64],
+                        "t0": float(s["t0"]),
+                        "dur": max(0.0, float(s.get("dur") or 0.0)),
+                    }
+                except (KeyError, TypeError, ValueError):
+                    continue
+                args = s.get("args")
+                if isinstance(args, dict):
+                    span["args"] = {
+                        str(k)[:64]: v for k, v in list(args.items())[:16]
+                        if isinstance(v, (int, float, str, bool))
+                        or v is None
+                    }
+                out.append(span)
+                adopted += 1
+        return adopted
+
     # -- export ------------------------------------------------------------
 
     def spans(self, trace_id: str) -> list[dict] | None:

@@ -201,6 +201,11 @@ def join_main(args) -> int:
         layers=(
             (args.start_layer, args.end_layer) if standalone else None
         ),
+        # Stall watchdog (docs/observability.md): off by default — no
+        # monitor thread, no per-step work.
+        watchdog=bool(getattr(args, "watchdog", False)),
+        watchdog_degraded_s=getattr(args, "watchdog_degraded_s", 5.0),
+        watchdog_stalled_s=getattr(args, "watchdog_stalled_s", 15.0),
     )
     node.start()
     logger.info("worker %s joined %s", node.node_id, scheduler_peer)

@@ -72,6 +72,9 @@ class WorkerNode:
         lora_adapters: dict | None = None,  # name -> PEFT dir or tree
         static_peers: list[str] | None = None,
         layers: tuple[int, int] | None = None,
+        watchdog: bool = False,
+        watchdog_degraded_s: float = 5.0,
+        watchdog_stalled_s: float = 15.0,
     ):
         """``scheduler_peer=None`` enters SCHEDULER-LESS mode (reference:
         DHT announce + dijkstra routing, ``p2p/server.py:569-626``): the
@@ -165,6 +168,27 @@ class WorkerNode:
         # scheduler sweep extends this node's grace instead of declaring
         # a first-compile storm dead.
         self._busy_reloading = False
+        # Stall watchdog (obs/watchdog.py, opt-in): progress probes over
+        # the step loop, sender queues, migration parks and the admission
+        # queue. Off (the default) = no monitor thread, no per-step work.
+        self._watchdog = None
+        self._watchdog_cfg = (
+            (watchdog_degraded_s, watchdog_stalled_s) if watchdog else None
+        )
+        # Migration progress counter for the watchdog: parks, ship
+        # results and restores all count — a parked set whose counter
+        # stops moving is a wedged migration path.
+        self._migration_progress = 0
+        # Cluster timeline shipping: flight events after this cursor
+        # ride the next heartbeat in a bounded batch; the cursor only
+        # advances when the scheduler's reply lands, so a lost beat just
+        # re-ships (the scheduler-side ring dedupes by sequence).
+        # _events_assigned maps ring seq -> this node's shipped seq (see
+        # _event_batch): assignment is stable across retries so resends
+        # reuse their numbers while newer events always number higher.
+        self._events_cursor = 0
+        self._events_assigned: dict[int, int] = {}
+        self._events_seq = 0
         # Async sender pipeline: serialization + socket latency leave
         # the step thread entirely (per-peer bounded in-order queues);
         # overflow or send failure feeds the abort_path flow.
@@ -238,6 +262,8 @@ class WorkerNode:
             )
         else:
             alloc = self._join()
+        if self._watchdog_cfg is not None:
+            self._start_watchdog(*self._watchdog_cfg)
         for fn in (self._announcer_loop, self._step_loop):
             t = threading.Thread(target=fn, daemon=True, name=fn.__name__)
             t.start()
@@ -249,6 +275,8 @@ class WorkerNode:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._watchdog is not None:
+            self._watchdog.stop()
         for t in self._threads:
             t.join(timeout=3.0)
         self.sender.close()
@@ -496,6 +524,89 @@ class WorkerNode:
             dtype=dtype,
         )
 
+    # -- stall watchdog ------------------------------------------------------
+
+    def _start_watchdog(self, degraded_s: float, stalled_s: float) -> None:
+        """Build and start the per-node stall watchdog (docs/
+        observability.md): each component registers a (pending, progress)
+        probe; pending work whose progress counter stops moving walks the
+        ok -> degraded -> stalled state machine, emits flight events (so
+        the stall lands in the cluster timeline) and flips the deep
+        ``/healthz``. The probes run on the monitor thread at poll
+        cadence — the step/sender hot paths pay one dict increment."""
+        from parallax_tpu.obs.watchdog import StallWatchdog
+
+        wd = StallWatchdog(
+            node_id=self.node_id,
+            degraded_after_s=degraded_s,
+            stalled_after_s=stalled_s,
+        )
+
+        def _step_pending() -> float:
+            eng = self.engine
+            if eng is None:
+                return 0.0
+            return float(eng.scheduler.num_requests())
+
+        wd.register_beat("step_loop", _step_pending)
+
+        def _sender_probe():
+            stats = self.sender.stats()
+            pending = sum(
+                s.get("queue_depth", 0) or 0 for s in stats.values()
+            )
+            # Frames leaving the queue EITHER way is progress: a dead
+            # peer's drops route through abort_path, which is handling,
+            # not a stall.
+            progress = sum(
+                (s.get("frames_out", 0) or 0)
+                + (s.get("drops", 0) or 0)
+                + (s.get("errors", 0) or 0)
+                for s in stats.values()
+            )
+            worst = max(
+                (s.get("queue_depth", 0) or 0 for s in stats.values()),
+                default=0,
+            )
+            return float(pending), float(progress), f"deepest queue {worst}"
+
+        wd.register("sender", _sender_probe)
+
+        def _migration_probe():
+            pending = len(self._migration_pending) + len(
+                self._migration_parked
+            )
+            return (
+                float(pending), float(self._migration_progress),
+                f"{len(self._migration_parked)} parked",
+            )
+
+        wd.register("migration", _migration_probe)
+
+        def _admission_probe():
+            eng = self.engine
+            if eng is None:
+                return 0.0, 0.0, ""
+            sched = eng.scheduler
+            return (
+                float(len(sched.wait_queue)),
+                float(sched.admitted_total),
+                f"{len(sched.running)} running",
+            )
+
+        wd.register("admission", _admission_probe)
+        wd.start()
+        self._watchdog = wd
+
+    def health_summary(self) -> dict:
+        """Deep-health payload: the watchdog's component state machine
+        (or a shallow ok when the watchdog is off). Rides heartbeats and
+        backs ``/healthz`` on worker frontends."""
+        wd = self._watchdog
+        if wd is None:
+            return {"status": "ok", "components": {}, "causes": []}
+        return wd.summary()
+
     # -- announcer (heartbeat) ----------------------------------------------
 
     def _announcer_loop(self) -> None:
@@ -522,6 +633,7 @@ class WorkerNode:
                         self.node_id.rsplit("@", 1)[1]
                     )
                 eng = self.engine
+                ev_batch, ev_cursor = self._event_batch()
                 reply = self.transport.call(
                     self.scheduler_peer,
                     proto.NODE_UPDATE,
@@ -560,9 +672,34 @@ class WorkerNode:
                         # scheduler's sweep extends our grace instead of
                         # declaring the compile dead (suspect state).
                         "busy": self._busy_reloading,
+                        # Goodput ledger payload (useful/wasted token
+                        # buckets + serve/compile/swap/migrate time) —
+                        # merged cluster-wide in /cluster/status.
+                        "goodput": self._goodput_heartbeat(),
+                        # Watchdog health state machine (None when off):
+                        # the scheduler surfaces sick-but-alive nodes,
+                        # not just dead ones.
+                        "health": (
+                            self._watchdog.summary()
+                            if self._watchdog is not None else None
+                        ),
+                        # Bounded flight-event batch for the cluster
+                        # timeline (sequence-numbered; resends dedupe).
+                        "events": ev_batch,
                     },
                     timeout=10.0,
                 )
+                # The reply landed, so the scheduler ingested this batch:
+                # advance the cursor and prune the acked seq
+                # assignments. A failed beat re-ships from the old
+                # cursor with the SAME numbers (stable assignment) and
+                # the timeline dedupes by sequence.
+                self._events_cursor = ev_cursor
+                if self._events_assigned:
+                    self._events_assigned = {
+                        rs: s for rs, s in self._events_assigned.items()
+                        if rs > ev_cursor
+                    }
                 if reply and reply.get("drain"):
                     # A pipeline through these dead peers is dissolving:
                     # checkpoint the affected requests to a surviving
@@ -602,6 +739,71 @@ class WorkerNode:
             except Exception as e:
                 logger.warning("heartbeat failed: %s", e)
             self._stop.wait(self.heartbeat_interval_s)
+
+    def _event_batch(self) -> tuple[dict | None, int]:
+        """Next bounded flight-event batch for the cluster timeline,
+        plus the (ring-domain) cursor to adopt
+        once the scheduler's reply confirms the batch landed. Tagged
+        with our boot epoch so a restart resets the scheduler-side gap
+        accounting instead of counting a false gap.
+
+        Shipped events are RENUMBERED into this node's own contiguous
+        sequence: in-process swarms share one flight ring whose global
+        sequence interleaves siblings, and shipping those raw numbers
+        would make the scheduler count every interleave as a loss. The
+        ring-seq -> shipped-seq assignment (``_events_assigned``) is
+        STABLE across retries — a resend after a lost reply reuses the
+        numbers the events were first shipped under (so the timeline
+        dedupes them), while events newly recorded since always get
+        fresh, higher numbers (so the dedupe cannot swallow them even
+        if the ring evicted part of the unacked window in between).
+        Assignments are pruned on ack. Real losses — the ring evicting
+        events faster than beats ship them — surface as an explicit
+        ``lost`` count instead."""
+        try:
+            from parallax_tpu.obs.flight import get_flight
+
+            fl = get_flight()
+            events, cursor = fl.events_since(
+                self._events_cursor, limit=256, node=self.node_id
+            )
+            # Ring overrun: events between our cursor and the ring's
+            # oldest survivor were evicted before we could ship them.
+            # (In-process swarms share the ring, so this is an upper
+            # bound — sibling-tagged evictions inflate it.)
+            lost = 0
+            oldest = fl.oldest_seq()
+            if self._events_cursor and oldest > self._events_cursor + 1:
+                lost = oldest - self._events_cursor - 1
+            cursor = max(cursor, oldest - 1 if oldest else 0)
+        except Exception:  # pragma: no cover - obs never breaks beats
+            return None, self._events_cursor
+        if not events and not lost:
+            return None, cursor
+        batch = []
+        for e in events:
+            ring_seq = int(e.get("seq") or 0)
+            seq = self._events_assigned.get(ring_seq)
+            if seq is None:
+                self._events_seq += 1
+                seq = self._events_seq
+                self._events_assigned[ring_seq] = seq
+            batch.append(dict(e, seq=seq))
+        payload = {"epoch": self._epoch, "batch": batch}
+        if lost:
+            payload["lost"] = lost
+        return payload, cursor
+
+    def _goodput_heartbeat(self) -> dict | None:
+        """Per-node goodput payload (never raises)."""
+        try:
+            import jax
+
+            from parallax_tpu.obs.goodput import get_goodput
+
+            return get_goodput().payload(chips=jax.local_device_count())
+        except Exception:  # pragma: no cover - obs never breaks beats
+            return None
 
     def _digest_heartbeat(self, eng) -> dict | None:
         """Prefix-digest payload for one heartbeat: a delta normally, a
@@ -1273,6 +1475,12 @@ class WorkerNode:
         pending_engine = None
         while not self._stop.is_set():
             try:
+                wd = self._watchdog
+                if wd is not None:
+                    # One dict increment per loop pass: a drive_step that
+                    # hangs stops the beats, and the monitor thread walks
+                    # step_loop through degraded -> stalled.
+                    wd.beat("step_loop")
                 worked = self._drain_inbox()
                 eng = self.engine
                 if pending is not None and pending_engine is not eng:
@@ -1571,6 +1779,7 @@ class WorkerNode:
         for rid, e in list(self._migration_parked.items()):
             if not e["shipping"] and now > e["deadline"]:
                 self._migration_parked.pop(rid)
+                self._migration_progress += 1
                 req = e["req"]
                 req.abort("migration: no serviceable pipeline")
                 self._finish(req)
@@ -1621,6 +1830,9 @@ class WorkerNode:
                     {"rids": [rid], "abort": True}, best_effort=True,
                 )
         now = time.monotonic()
+        # NOT counted as watchdog progress: under continuous churn new
+        # parks would keep the counter moving and mask a wedged SHIP
+        # path — only ship results and deadline aborts advance it.
         self._migration_parked[rid] = {
             "req": req,
             "image": image,
@@ -1638,6 +1850,17 @@ class WorkerNode:
             kv_pages=(len(image.layers[0]) if image is not None else 0),
             tokens=len(req.full_output_ids),
         )
+        if req.traced:
+            # The park span ships with the checkpoint (spans are
+            # snapshotted at ship time), so the target's stitched trace
+            # carries the churn boundary.
+            from parallax_tpu.obs.trace import get_trace_store
+
+            get_trace_store().add(
+                rid, self.node_id, "migrate_park",
+                t0=time.perf_counter(), dur=0.0,
+                args={"dead_peer": dead_peer},
+            )
 
     def _ship_checkpoints(self, entries: dict[str, dict]) -> None:
         """Background thread: ask the scheduler for CacheIndex-scored
@@ -1759,6 +1982,7 @@ class WorkerNode:
                     )
 
     def _on_migration_shipped(self, results: dict[str, tuple]) -> None:
+        self._migration_progress += 1
         for rid, (status, info) in results.items():
             e = self._migration_parked.get(rid)
             if e is None:
@@ -1787,6 +2011,16 @@ class WorkerNode:
                     target=info,
                     with_kv=e["image"] is not None,
                 )
+                if e["req"].traced:
+                    # The linked twin of the target's migrate_in span:
+                    # the SOURCE trace records where the request went.
+                    from parallax_tpu.obs.trace import get_trace_store
+
+                    get_trace_store().add(
+                        rid, self.node_id, "migrate_out",
+                        t0=time.perf_counter(), dur=0.0,
+                        args={"target": info},
+                    )
                 try:
                     from parallax_tpu.obs.registry import get_registry
 
@@ -1938,6 +2172,25 @@ class WorkerNode:
             source=from_peer, kv_adopted=adopted,
             prior_tokens=len(ckpt.output_ids),
         )
+        if ckpt.traced:
+            # Stitch the source head's spans into this process's trace
+            # (bounded, sanitized), then link the boundary with a
+            # migrate_in span — /debug/trace/<rid> here now shows one
+            # timeline across heads.
+            try:
+                from parallax_tpu.obs.trace import get_trace_store
+                from parallax_tpu.runtime.checkpoint import spans_from_wire
+
+                store = get_trace_store()
+                if ckpt.trace_spans:
+                    store.adopt(rid, spans_from_wire(ckpt.trace_spans))
+                store.add(
+                    rid, self.node_id, "migrate_in",
+                    t0=time.perf_counter(), dur=0.0,
+                    args={"source": from_peer, "kv_adopted": adopted},
+                )
+            except Exception:  # pragma: no cover - tracing is best-effort
+                logger.exception("trace adoption failed for %s", rid)
         self._count_migration_in(
             "kv_image" if adopted else "replay", ckpt.parked_wall
         )
@@ -1957,10 +2210,16 @@ class WorkerNode:
                 labelnames=("mode",),
             ).labels(mode=mode).inc()
             if parked_wall:
+                park_s = max(0.0, time.time() - parked_wall)
                 reg.histogram(
                     "parallax_migration_ms",
                     "Park -> resume latency of migrated requests, ms",
-                ).observe(max(0.0, (time.time() - parked_wall) * 1e3))
+                ).observe(park_s * 1e3)
+                # Goodput time taxonomy: park->resume is churn overhead,
+                # not serving time.
+                from parallax_tpu.obs.goodput import get_goodput
+
+                get_goodput().add_time("migrate", park_s)
         except Exception:  # pragma: no cover - metrics never break serving
             pass
 

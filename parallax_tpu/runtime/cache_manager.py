@@ -313,6 +313,7 @@ class CacheManager:
             from parallax_tpu.obs.flight import get_flight
             from parallax_tpu.obs.trace import get_trace_store
 
+            self._goodput_swap(dur)
             get_flight().event(
                 "swap_in", request_id=request.request_id,
                 pages=len(host_nodes), ms=round(dur * 1e3, 3),
@@ -368,6 +369,7 @@ class CacheManager:
         owned = request.page_ids[num_shared:]
         if not owned:
             return False   # nothing to reclaim; preemption is pointless
+        t_swap = time.perf_counter()
         handles = self.host_tier.demote(owned, pinned=True)
         if handles is None:
             return False
@@ -375,6 +377,7 @@ class CacheManager:
         self.allocator.free(owned)
         del request.page_ids[num_shared:]
         self.stats.preemptions += 1
+        self._goodput_swap(time.perf_counter() - t_swap)
         return True
 
     def shared_prefix_tokens(self, request_id: str) -> int:
@@ -433,11 +436,24 @@ class CacheManager:
             fresh = self.allocator.alloc(len(handles))
         except OutOfPages:
             return False
+        t_swap = time.perf_counter()
         self.host_tier.promote(handles, fresh)
         request.page_ids.extend(fresh)
         del request.host_page_handles
         self.stats.resumes += 1
+        self._goodput_swap(time.perf_counter() - t_swap)
         return True
+
+    @staticmethod
+    def _goodput_swap(seconds: float) -> None:
+        """Accrue host<->device KV transfer time into the goodput time
+        taxonomy (never raises — metrics must not break serving)."""
+        try:
+            from parallax_tpu.obs.goodput import get_goodput
+
+            get_goodput().add_time("swap", seconds)
+        except Exception:  # pragma: no cover - obs only
+            pass
 
     def release(self, request: Request) -> None:
         """Return a finished/aborted request's pages.

@@ -104,6 +104,53 @@ class RequestCheckpoint:
     parked_wall: float
     traced: bool = False
     kv: KVImage | None = None
+    # Lifecycle-trace spans recorded on the SOURCE head (bounded;
+    # ``t0`` rebased to wall-clock seconds — see ``spans_to_wire``), so
+    # the target's ``/debug/trace/<rid>`` shows one stitched timeline
+    # across heads instead of losing the pre-migration history.
+    trace_spans: list | None = None
+
+
+# Span-shipping bound: a traced request's decode epochs coalesce
+# (obs/trace.py), so real traces are tens of spans; anything larger is
+# trimmed rather than bloating the checkpoint frame.
+_MAX_TRACE_SPANS = 512
+
+
+def spans_to_wire(spans: list[dict]) -> list[dict]:
+    """Wire form of TraceStore spans: ``t0`` (local ``perf_counter``
+    seconds) is rebased to wall clock (``t0w``) so the target can map it
+    into ITS perf_counter domain. Cross-host wall skew shifts the whole
+    source block together — span ordering and durations survive."""
+    wall_off = time.time() - time.perf_counter()
+    out = []
+    for s in spans[:_MAX_TRACE_SPANS]:
+        w = {
+            "name": s.get("name"),
+            "stage": s.get("stage"),
+            "t0w": float(s.get("t0") or 0.0) + wall_off,
+            "dur": s.get("dur"),
+        }
+        if isinstance(s.get("args"), dict):
+            w["args"] = s["args"]
+        out.append(w)
+    return out
+
+
+def spans_from_wire(spans: list) -> list[dict]:
+    """Back into this process's ``perf_counter`` domain; malformed
+    entries are dropped (``TraceStore.adopt`` re-sanitizes anyway)."""
+    wall_off = time.time() - time.perf_counter()
+    out = []
+    for s in spans[:_MAX_TRACE_SPANS]:
+        if not isinstance(s, dict):
+            continue
+        try:
+            t0 = float(s["t0w"]) - wall_off
+        except (KeyError, TypeError, ValueError):
+            continue
+        out.append({**s, "t0": t0})
+    return out
 
 
 def checkpoint_from_request(
@@ -120,6 +167,19 @@ def checkpoint_from_request(
         req.prompt_ids[: len(req.prompt_ids) - req.output_offset]
         if req.output_offset else req.prompt_ids
     )
+    trace_spans = None
+    if req.traced:
+        # Ship the source head's spans so the target's trace shows one
+        # stitched timeline across heads (never fails the checkpoint —
+        # tracing is best-effort by contract).
+        try:
+            from parallax_tpu.obs.trace import get_trace_store
+
+            spans = get_trace_store().spans(req.request_id)
+            if spans:
+                trace_spans = spans_to_wire(spans)
+        except Exception:
+            trace_spans = None
     return RequestCheckpoint(
         request_id=req.request_id,
         prompt_ids=list(orig_prompt),
@@ -135,6 +195,7 @@ def checkpoint_from_request(
         parked_wall=time.time(),
         traced=req.traced,
         kv=kv,
+        trace_spans=trace_spans,
     )
 
 
@@ -201,6 +262,8 @@ def checkpoint_to_wire(ckpt: RequestCheckpoint) -> dict:
         "parked_wall": float(ckpt.parked_wall),
         "traced": bool(ckpt.traced),
     }
+    if ckpt.trace_spans:
+        d["trace_spans"] = list(ckpt.trace_spans[:_MAX_TRACE_SPANS])
     if ckpt.kv is not None:
         d["kv"] = {
             "page_size": ckpt.kv.page_size,
@@ -333,6 +396,14 @@ def checkpoint_from_wire(d: dict) -> RequestCheckpoint:
             raise CheckpointError(
                 "kv image covers more tokens than the checkpoint holds"
             )
+    # Trace spans are observability freight: bounded and type-checked
+    # but never a reason to reject the frame (TraceStore.adopt
+    # sanitizes field-by-field on use).
+    trace_spans = d.get("trace_spans")
+    if not isinstance(trace_spans, (list, tuple)):
+        trace_spans = None
+    else:
+        trace_spans = list(trace_spans[:_MAX_TRACE_SPANS])
     return RequestCheckpoint(
         request_id=rid,
         prompt_ids=prompt_ids,
@@ -346,4 +417,5 @@ def checkpoint_from_wire(d: dict) -> RequestCheckpoint:
         parked_wall=parked_wall,
         traced=bool(d.get("traced", False)),
         kv=kv,
+        trace_spans=trace_spans,
     )

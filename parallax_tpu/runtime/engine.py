@@ -1051,6 +1051,7 @@ class StageEngine:
         counters are pulled lazily by a registry collector at
         render/snapshot time, never per step.
         """
+        from parallax_tpu.obs.goodput import get_goodput
         from parallax_tpu.obs.registry import (
             DEFAULT_COUNT_BUCKETS,
             get_registry,
@@ -1060,6 +1061,14 @@ class StageEngine:
             1.0, max(0.0, float(self.cfg.trace_sample_rate or 0.0))
         )
         self._traced: set[str] = set()
+        # Goodput ledger (obs/goodput.py): every device-step token this
+        # engine resolves lands in exactly one usefulness bucket, and
+        # serve/compile/swap/migrate time accrues alongside. Always on —
+        # the cost is a handful of integer adds per HOST VISIT (never per
+        # device step), and binding eagerly puts the zero-valued families
+        # in /metrics from the first scrape.
+        self._goodput = get_goodput()
+        self._goodput.bind_registry()
         model = self.model
         reg = get_registry()
         st = ("stage",)
@@ -1229,6 +1238,9 @@ class StageEngine:
             )
         if not self.model.is_first or rid.startswith("__"):
             return
+        # SLO availability input: finished vs aborted, head stage only
+        # (one count per logical request).
+        self._goodput.count_request(req.status.value)
         from parallax_tpu.obs.flight import get_flight
 
         now = time.monotonic()
@@ -1598,6 +1610,7 @@ class StageEngine:
             produced = np.asarray(ticket.ms_state[1])   # i32[S]
             device_ms = (time.perf_counter() - tb) * 1000.0
             total = 0
+            gp_committed = gp_window = 0
             for i, seg in enumerate(plan.seqs):
                 req = seg.request
                 committed = 0
@@ -1605,6 +1618,9 @@ class StageEngine:
                 while committed < quota and not req.status.is_finished:
                     req.commit_token(int(toks[committed, i]))
                     committed += 1
+                if not req.request_id.startswith("__"):
+                    gp_committed += committed
+                    gp_window += int(toks.shape[0])
                 # Every committed token's predecessor was fed, so
                 # computed KV advances by the commit count; dispatch
                 # already counted one step (invariant: computed ==
@@ -1628,6 +1644,13 @@ class StageEngine:
         except Exception:
             self._abandon(plan)
             raise
+        # Goodput: the scan computed toks.shape[0] positions for EVERY
+        # row — slots past a row's on-device stop point (and the whole
+        # window of a row an abort/stop-string raced) were computed,
+        # rolled back above, and never committed: the frozen tail.
+        # (Internal __draft rows excluded, same as the commit hook.)
+        self._goodput.count("committed", gp_committed)
+        self._goodput.count("frozen_tail", gp_window - gp_committed)
         now = time.perf_counter()
         dt = (now - ticket.t0) * 1000.0
         host_ms = ticket.host_ms + (now - t_r0) * 1000.0
@@ -1640,6 +1663,7 @@ class StageEngine:
         self._record_latency(plan, host_ms / steps_done)
         self.step_timing.update(host_ms, device_ms, overlapped,
                                 tokens=total)
+        self._goodput.add_time("serve", (host_ms + device_ms) / 1e3)
         if total:
             self._h_batch_tokens.observe(total)
         if self._traced:
@@ -2207,6 +2231,9 @@ class StageEngine:
             if o.num_tokens:
                 self.step_timing.update(o.host_ms, o.device_ms, o.overlapped,
                                         tokens=o.num_tokens)
+                self._goodput.add_time(
+                    "serve", (o.host_ms + o.device_ms) / 1e3
+                )
                 self._h_batch_tokens.observe(o.num_tokens)
                 if self._traced:
                     self._trace_plan(
@@ -2260,6 +2287,21 @@ class StageEngine:
         emitted = sum(1 for seg in plan.seqs if self._needs_token(seg))
         self.step_timing.update(host_ms, device_ms, overlapped,
                                 tokens=emitted)
+        self._goodput.add_time("serve", (host_ms + device_ms) / 1e3)
+        # Goodput: a replay-restored request's prompt re-prefill
+        # recomputes positions the dead pipeline already computed — the
+        # price of a churn event, counted as rework (head stage only;
+        # downstream mirrors cannot tell a replay chunk apart).
+        if self.model.is_first:
+            for seg in plan.seqs:
+                if (
+                    seg.request.replay_ids
+                    and seg.context_len
+                    <= seg.request.num_prompt_tokens
+                ):
+                    self._goodput.count(
+                        "preempted_rework", seg.num_new_tokens
+                    )
         if plan.total_new_tokens:
             self._h_batch_tokens.observe(plan.total_new_tokens)
         if self._traced:
@@ -2496,6 +2538,14 @@ class StageEngine:
                     break
             self.pp_spec_rounds += 1
             self.pp_spec_tokens += len(accepted)
+            # Goodput: every fed position was a device forward; the
+            # positions whose proposal lost are pure speculative waste
+            # (the accepted run is counted "committed" at the head's
+            # commit). The bonus position always commits, so rejected =
+            # fed - accepted exactly.
+            self._goodput.count(
+                "speculative_rejected", len(fed) - len(accepted)
+            )
             forwards.append(
                 IntermediateRequest(
                     request_id=req.request_id,
@@ -2894,7 +2944,18 @@ class StageEngine:
 
     def _commit(self, req: Request, token: int,
                 logprob: float | None = None) -> None:
+        # Goodput: a commit that substitutes a teacher-forced replay id
+        # (migration restore) re-delivers a token the client already
+        # streamed before the churn event — device work, not goodput.
+        # Internal requests (the draft proposer's __draft rows) stay out
+        # of the ledger: their cost is priced by the main engine's
+        # speculative accept/reject accounting.
+        replaying = bool(req.replay_ids)
         req.commit_token(token, logprob)
+        if not req.request_id.startswith("__"):
+            self._goodput.count(
+                "replayed" if replaying else "committed", 1
+            )
         self.scheduler.on_token_committed(req)
 
     def _collect_finished(self) -> list[Request]:

@@ -96,6 +96,11 @@ class Scheduler:
         self.snapshot_page_align = snapshot_page_align
         self.wait_queue: OrderedDict[str, Request] = OrderedDict()
         self.running: OrderedDict[str, Request] = OrderedDict()
+        # Monotonic count of wait-queue departures (admissions, resumes,
+        # finished-while-parked routing) — the stall watchdog's progress
+        # signal for the admission component: a non-empty queue whose
+        # counter stops moving is a wedged admission path.
+        self.admitted_total = 0
         # Round-robin cursor over adapter groups (see form_batch).
         self._lora_cursor = 0
         # Rotation cursor for budget-capped mixed decode batches.
@@ -132,6 +137,7 @@ class Scheduler:
                 # through the running set so the normal finish collection
                 # releases its state.
                 del self.wait_queue[rid]
+                self.admitted_total += 1
                 self.running[rid] = req
                 continue
             if req.status is RequestStatus.PREEMPTED:
@@ -144,6 +150,7 @@ class Scheduler:
                 if resume is None or not resume(req):
                     break
                 del self.wait_queue[rid]
+                self.admitted_total += 1
                 req.status = RequestStatus.DECODING
                 self.running[rid] = req
                 self._obs_event("swap_in", req, dur=time.perf_counter() - t0)
@@ -151,6 +158,7 @@ class Scheduler:
             if not self.cache.allocate_for_prompt(req):
                 break
             del self.wait_queue[rid]
+            self.admitted_total += 1
             head_cached = getattr(req, "mirror_head_cached", None)
             if head_cached is not None:
                 # Mirror of a head-side prefix hit: the head only forwards

@@ -250,6 +250,14 @@ class Node:
     # Past the base heartbeat timeout but inside the busy-probation
     # extended grace (surfaced in /cluster/status).
     suspect: bool = False
+    # Goodput ledger payload from heartbeats (token usefulness buckets,
+    # serve/compile/swap/migrate/idle time, goodput fraction) — merged
+    # cluster-wide in /cluster/status (obs/goodput.py).
+    goodput: dict | None = None
+    # Watchdog health payload from heartbeats ({status, components,
+    # causes}): a node can be alive (heartbeating) yet sick — a wedged
+    # step loop or stuck sender — and the sweep alone cannot tell.
+    health: dict | None = None
 
     def __post_init__(self):
         self.perf = RooflinePerformanceModel(self.hardware, self.model)

@@ -77,6 +77,7 @@ class GlobalScheduler:
         routing: str = "rr",
         heartbeat_timeout_s: float = 30.0,
         routing_kwargs: dict | None = None,
+        slo: "SLOConfig | None" = None,
     ):
         self.model = model
         self.min_nodes = min_nodes_bootstrapping
@@ -123,6 +124,23 @@ class GlobalScheduler:
         self._migrations: "OrderedDict[str, str]" = OrderedDict()
         self.migration_stats = {"drains": 0, "targets_chosen": 0,
                                 "recorded": 0}
+        # Cluster event timeline (obs/timeline.py): workers ship
+        # sequence-numbered flight-event batches in heartbeats; the ring
+        # merges them — plus the scheduler's own join/leave/peer_down
+        # decisions — into one causally-ordered swarm story served at
+        # /debug/timeline.
+        from parallax_tpu.obs.timeline import ClusterTimeline
+
+        self.timeline = ClusterTimeline()
+        # SLO tracker (obs/slo.py): declarative TTFT/TPOT/availability
+        # objectives evaluated over the cluster-merged histograms each
+        # time cluster_status() runs (the status stream's poll cadence
+        # is the sampling cadence). None = no objectives declared.
+        self.slo_tracker = None
+        if slo is not None:
+            from parallax_tpu.obs.slo import SLOTracker
+
+            self.slo_tracker = SLOTracker(slo)
 
     # -- public API (thread-safe enqueues) --------------------------------
 
@@ -150,11 +168,15 @@ class GlobalScheduler:
         metrics: dict | None = None,
         cache_digests: dict | None = None,
         busy: bool | None = None,
+        goodput: dict | None = None,
+        health: dict | None = None,
+        events: dict | None = None,
     ) -> None:
         self._events.put(
             ("update", node_id, layer_latency_ms, load, rtt_s, is_ready,
              refit_version, lora_adapters, step_timing, cache_stats,
-             transport, metrics, cache_digests, busy)
+             transport, metrics, cache_digests, busy, goodput, health,
+             events)
         )
 
     def enqueue_peer_down(self, reporter: str, peer: str,
@@ -293,6 +315,9 @@ class GlobalScheduler:
             while len(self._migrations) > 4096:
                 self._migrations.popitem(last=False)
             self.migration_stats["recorded"] += 1
+        self.timeline.record(
+            "migration_done", node=head, request_id=request_id,
+        )
 
     def migrated_head(self, request_id: str) -> str | None:
         with self._lock:
@@ -370,6 +395,10 @@ class GlobalScheduler:
                     "%d cache-index digests dropped, sweep accelerated",
                     reporter, peer, reason or "?", stale,
                 )
+                self.timeline.record(
+                    "peer_down", node=peer, reporter=reporter,
+                    reason=reason or "?",
+                )
         elif kind == "update":
             (_, node_id, lat, load, rtt, ready, refit, adapters, timing,
              cache_stats, *rest) = ev
@@ -377,6 +406,14 @@ class GlobalScheduler:
             metrics = rest[1] if len(rest) > 1 else None
             cache_digests = rest[2] if len(rest) > 2 else None
             busy = rest[3] if len(rest) > 3 else None
+            goodput = rest[4] if len(rest) > 4 else None
+            health = rest[5] if len(rest) > 5 else None
+            events = rest[6] if len(rest) > 6 else None
+            if events is not None:
+                # Merge the node's flight-event batch even for unknown
+                # nodes: a churn victim's last beats are exactly the
+                # interesting ones.
+                self.timeline.ingest(node_id, events)
             node = self.manager.get(node_id)
             if node is None:
                 return
@@ -406,6 +443,26 @@ class GlobalScheduler:
                 node.transport = transport
             if metrics is not None:
                 node.metrics = metrics
+            if goodput is not None:
+                node.goodput = goodput
+            if health is not None:
+                prev = (node.health or {}).get("status")
+                node.health = health
+                status = health.get("status")
+                if status != prev and status in ("degraded", "stalled"):
+                    # Surface sick-but-alive loudly: the node still
+                    # heartbeats (so the sweep won't touch it) but its
+                    # watchdog says a component stopped making progress.
+                    # The timeline gets the transition even if the
+                    # node's own flight batch is delayed.
+                    logger.warning(
+                        "node %s reports health %s: %s", node_id, status,
+                        "; ".join(health.get("causes") or ()) or "?",
+                    )
+                    self.timeline.record(
+                        "node_health", node=node_id, status=status,
+                        causes=list(health.get("causes") or ()),
+                    )
             if cache_digests is not None:
                 if node.cache_index.apply(cache_digests):
                     node.digests_need_resync = True
@@ -512,6 +569,9 @@ class GlobalScheduler:
                 self.migration_stats["drains"] += 1
         displaced = self.manager.remove(node_id)
         logger.info("node %s left; %d displaced", node_id, len(displaced))
+        self.timeline.record(
+            "node_leave", node=node_id, displaced=len(displaced),
+        )
         active = [n for n in self.manager.nodes(NodeState.ACTIVE)]
         if not self.manager.pipelines or self.allocator.should_global_rebalance(
             active
@@ -674,10 +734,55 @@ class GlobalScheduler:
             n.metrics for p in self.manager.pipelines for n in p.nodes
             if n.metrics
         ]
+        merged_snaps = None
         if node_snaps:
-            report["metrics"] = summarize_snapshots(
-                merge_histogram_snapshots(node_snaps)
-            )
+            merged_snaps = merge_histogram_snapshots(node_snaps)
+            report["metrics"] = summarize_snapshots(merged_snaps)
+        # Goodput: cluster-merged token usefulness (summed buckets,
+        # goodput fraction, tokens-useful-per-chip-second) — the signal
+        # autoscaling reads instead of raw throughput.
+        from parallax_tpu.obs.goodput import merge_goodput
+
+        all_nodes = [n for p in self.manager.pipelines for n in p.nodes]
+        cluster_goodput = merge_goodput(
+            [n.goodput for n in all_nodes if n.goodput]
+        )
+        if cluster_goodput is not None:
+            report["goodput"] = cluster_goodput
+        # Health rollup: worst watchdog status across the swarm plus the
+        # sick list (alive-but-stalled nodes the binary sweep misses).
+        from parallax_tpu.obs.watchdog import worst_status
+
+        health_reports = {
+            n.node_id: n.health for n in all_nodes if n.health
+        }
+        if health_reports:
+            report["health"] = {
+                "status": worst_status(
+                    h.get("status") for h in health_reports.values()
+                ),
+                "sick_nodes": sorted(
+                    nid for nid, h in health_reports.items()
+                    if h.get("status") in ("degraded", "stalled")
+                ),
+            }
+        # SLO attainment + burn rates over the merged histograms and the
+        # merged availability counts; each cluster_status() call is one
+        # tracker sample (the status stream's interval sets the cadence).
+        if self.slo_tracker is not None:
+            req_counts = (cluster_goodput or {}).get("requests") or {}
+            report["slo"] = self.slo_tracker.observe_and_evaluate({
+                "hists": merged_snaps or {},
+                "finished": req_counts.get("finished") or 0,
+                "aborted": req_counts.get("aborted") or 0,
+            })
+        # Timeline counters (the events themselves live at
+        # /debug/timeline).
+        report["timeline"] = {
+            "ingested": self.timeline.ingested,
+            "gaps": self.timeline.gaps,
+            "resets": self.timeline.resets,
+        }
         # Routing telemetry: strategy, per-strategy decision counters
         # (chosen_by_cache / chosen_by_load / fallback_imbalance for the
         # cache-aware router), per-pipeline dispatch counts and the
@@ -708,6 +813,13 @@ class GlobalScheduler:
                         # Probation (busy-reload grace) / dead-peer
                         # report state from the heartbeat sweep.
                         "suspect": n.suspect,
+                        # Watchdog health state machine (ok/degraded/
+                        # stalled + causes) from heartbeats; None until
+                        # the node reports one (watchdog off).
+                        "health": n.health,
+                        # Per-node goodput ledger payload (cluster merge
+                        # in the top-level "goodput" section).
+                        "goodput": n.goodput,
                         # Overlapped decode loop telemetry (host_ms /
                         # device_ms EWMAs + overlap fraction).
                         "step_timing": n.step_timing,

@@ -90,16 +90,22 @@ def register_compile_counter() -> None:
     try:
         from jax import monitoring
 
+        from parallax_tpu.obs.goodput import get_goodput
         from parallax_tpu.obs.registry import get_registry
 
         counter = get_registry().counter(
             "parallax_xla_compiles_total",
             "XLA backend compilations performed by this process",
         ).labels()
+        goodput = get_goodput()
 
         def _on_duration(event: str, duration: float, **kw) -> None:
             if _COMPILE_EVENT in event:
                 counter.inc()
+                # Goodput time taxonomy: compile seconds are not serve
+                # seconds — a recompile storm shows up as a goodput dip
+                # instead of hiding inside step latency.
+                goodput.add_time("compile", duration)
 
         monitoring.register_event_duration_secs_listener(_on_duration)
     except Exception as e:  # pragma: no cover - defensive; obs only
