@@ -1,0 +1,27 @@
+"""Concurrency & JAX-hazard analysis suite.
+
+Two halves (docs/static_analysis.md):
+
+- **static**: an AST lint pass with project-specific checkers —
+  lock-discipline, hot-path-sync, donation-reuse, jit-purity,
+  config-gate — run as ``python -m parallax_tpu.analysis`` (or the
+  ``parallax-tpu-lint`` console script) over the package, with
+  per-line suppressions and a ratchet-only committed baseline;
+- **dynamic**: a lock-order sanitizer (:mod:`.sanitizer`) — lockdep
+  for the Python layer — that instruments every
+  :func:`~parallax_tpu.analysis.sanitizer.make_lock` lock while
+  enabled and reports lock-graph cycles and held-too-long stalls,
+  activated under the chaos harness and the pytest
+  ``--lock-sanitizer`` flag.
+
+This package imports only the stdlib at module scope so the CLI and
+``make_lock`` stay usable in jax-free environments.
+"""
+
+from parallax_tpu.analysis.sanitizer import (  # noqa: F401
+    LockOrderSanitizer,
+    get_sanitizer,
+    make_lock,
+)
+
+__all__ = ["LockOrderSanitizer", "get_sanitizer", "make_lock"]

@@ -1,0 +1,7 @@
+"""``python -m parallax_tpu.analysis`` entry point."""
+
+import sys
+
+from parallax_tpu.analysis.cli import main
+
+sys.exit(main())

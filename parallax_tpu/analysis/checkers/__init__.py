@@ -1,0 +1,30 @@
+"""Checker registry for the parallax_tpu analysis pass.
+
+Adding a checker: subclass :class:`parallax_tpu.analysis.linter.Checker`
+in a new module here, give it a unique kebab-case ``id`` and a one-line
+``doc``, and list it in :data:`CHECKER_CLASSES`. See
+docs/static_analysis.md for the walkthrough.
+"""
+
+from __future__ import annotations
+
+from parallax_tpu.analysis.checkers.config_gates import ConfigGateChecker
+from parallax_tpu.analysis.checkers.donation import DonationChecker
+from parallax_tpu.analysis.checkers.hot_path_sync import HotPathSyncChecker
+from parallax_tpu.analysis.checkers.jit_purity import JitPurityChecker
+from parallax_tpu.analysis.checkers.lock_discipline import (
+    LockDisciplineChecker,
+)
+
+CHECKER_CLASSES = (
+    LockDisciplineChecker,
+    HotPathSyncChecker,
+    DonationChecker,
+    JitPurityChecker,
+    ConfigGateChecker,
+)
+
+
+def all_checkers():
+    """Fresh checker instances (some keep per-run state)."""
+    return [cls() for cls in CHECKER_CLASSES]

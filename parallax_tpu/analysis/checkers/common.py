@@ -1,0 +1,161 @@
+"""Shared AST utilities for the project checkers."""
+
+from __future__ import annotations
+
+import ast
+
+
+def import_aliases(tree: ast.AST) -> dict[str, str]:
+    """Map local alias -> canonical dotted module path for every import
+    in the module (``import numpy as np`` -> ``{"np": "numpy"}``;
+    ``from jax import lax`` -> ``{"lax": "jax.lax"}``)."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for nested Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def canonical_call_name(call: ast.Call,
+                        aliases: dict[str, str]) -> str | None:
+    """Dotted callee name with the leading import alias resolved
+    (``np.asarray`` -> ``numpy.asarray``)."""
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    head, _, rest = name.partition(".")
+    head = aliases.get(head, head)
+    return f"{head}.{rest}" if rest else head
+
+
+def self_attr(node: ast.AST) -> str | None:
+    """``attr`` when node is exactly ``self.attr``."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def mutation_target_attr(node: ast.AST) -> str | None:
+    """The ``self`` attribute a store/subscript-store ultimately hits:
+    ``self.x = ...`` / ``self.x[k] = ...`` / ``self.x[k]["j"] += 1``
+    all resolve to ``x``."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    return self_attr(node)
+
+
+# Methods whose call mutates the receiver in place.
+MUTATING_METHODS = frozenset({
+    "append", "extend", "insert", "remove", "pop", "clear", "sort",
+    "reverse", "add", "discard", "update", "setdefault", "popitem",
+    "appendleft", "popleft", "rotate",
+})
+
+
+def mutating_call_attr(call: ast.Call) -> str | None:
+    """``x`` for calls like ``self.x.append(...)`` /
+    ``self.x[k].update(...)`` that mutate ``self.x`` in place."""
+    func = call.func
+    if not (isinstance(func, ast.Attribute)
+            and func.attr in MUTATING_METHODS):
+        return None
+    return mutation_target_attr(func.value)
+
+
+def literal_int_tuple(node: ast.AST) -> tuple[int, ...] | None:
+    """Evaluate a literal int / tuple-of-ints AST, else None.
+    Conditional expressions resolve to the union of both arms (the
+    conservative read for donate_argnums chosen at runtime)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, ast.Tuple):
+        vals: list[int] = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, int)):
+                return None
+            vals.append(elt.value)
+        return tuple(vals)
+    if isinstance(node, ast.IfExp):
+        a = literal_int_tuple(node.body)
+        b = literal_int_tuple(node.orelse)
+        if a is None and b is None:
+            return None
+        return tuple(sorted(set(a or ()) | set(b or ())))
+    return None
+
+
+def call_str_args(call: ast.Call) -> str:
+    """Concatenated string-literal content of a call's arguments
+    (enough to pattern-match log messages built from adjacent literals
+    or % formatting)."""
+    parts: list[str] = []
+    for arg in call.args:
+        for node in ast.walk(arg):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                parts.append(node.value)
+    return " ".join(parts)
+
+
+def parent_map(tree: ast.AST) -> dict[ast.AST, ast.AST]:
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def enclosing_function(node: ast.AST,
+                       parents: dict[ast.AST, ast.AST]):
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def module_level_names(tree: ast.Module) -> set[str]:
+    """Names bound at module scope (imports, defs, classes, constants)
+    — these are stable captures, not closure-mutation hazards."""
+    names: set[str] = set()
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                names.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                names.add(a.asname or a.name)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        names.add(n.id)
+    return names

@@ -1,0 +1,188 @@
+"""donation-reuse: use-after-donate of jitted-call arguments.
+
+``jax.jit(..., donate_argnums=...)`` hands the argument's device buffer
+to XLA for reuse: the Python reference still exists but its buffer is
+deleted the moment the call runs. Reading it afterwards raises on TPU —
+or, worse, silently reads stale bytes through a cached numpy view. The
+PR 6 phantom-KV rollback was this class of bug found by hand: state
+advanced against a donated cache that the next dispatch had already
+consumed.
+
+The checker collects every donating callable visible in the module:
+
+- ``self._jit = jax.jit(f, donate_argnums=(1,))`` (attribute or name
+  binding; literal positions, including ``(1,) if cond else ()``
+  conditionals, which resolve to the union of the arms);
+- ``@functools.partial(jax.jit, donate_argnums=(0,))`` decorated
+  functions.
+
+Then, per function body, it flags any *read* of a name or ``self``
+attribute that was passed at a donated position, textually after the
+call and before any rebind of that name. Rebinding from the call's own
+result (``self.kv = self._jit(params, self.kv, ...)``) is the blessed
+pattern and produces no finding.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from parallax_tpu.analysis.checkers import common
+from parallax_tpu.analysis.linter import Checker, Finding, Module
+
+
+def _expr_key(node: ast.AST) -> str | None:
+    """Stable key for trackable argument expressions: bare names and
+    ``self.attr`` chains only."""
+    name = common.dotted_name(node)
+    if name is None:
+        return None
+    parts = name.split(".")
+    if parts[0] == "self" and len(parts) == 2:
+        return name
+    if len(parts) == 1:
+        return name
+    return None
+
+
+def _donate_positions(call: ast.Call, aliases: dict[str, str],
+                      attr_literals: dict[str, tuple[int, ...]]
+                      ) -> tuple[int, ...] | None:
+    """Donated positions of a ``jax.jit(...)`` call, or None when the
+    call does not donate / cannot be resolved."""
+    if common.canonical_call_name(call, aliases) != "jax.jit":
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        lit = common.literal_int_tuple(kw.value)
+        if lit is not None:
+            return lit
+        key = _expr_key(kw.value)
+        if key is not None and key in attr_literals:
+            return attr_literals[key]
+        return None
+    return None
+
+
+class DonationChecker(Checker):
+    id = "donation-reuse"
+    doc = "argument reused after being passed at a donate_argnums position"
+
+    def check(self, module: Module) -> list[Finding]:
+        aliases = common.import_aliases(module.tree)
+
+        # Pass 0: literal tuple bindings like
+        # ``self._donate_kv = (1,) if backend != "cpu" else ()``.
+        attr_literals: dict[str, tuple[int, ...]] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                key = _expr_key(node.targets[0])
+                if key is None:
+                    continue
+                lit = common.literal_int_tuple(node.value)
+                if lit is not None:
+                    attr_literals[key] = lit
+
+        # Pass 1: donating callables.
+        donors: dict[str, tuple[int, ...]] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                pos = _donate_positions(node.value, aliases, attr_literals)
+                if pos:
+                    for tgt in node.targets:
+                        key = _expr_key(tgt)
+                        if key is not None:
+                            donors[key] = pos
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    if not isinstance(deco, ast.Call):
+                        continue
+                    deco_name = common.canonical_call_name(deco, aliases)
+                    if deco_name == "jax.jit":
+                        pos = _donate_positions(deco, aliases,
+                                                attr_literals)
+                    elif (deco_name == "functools.partial" and deco.args
+                          and common.canonical_call_name(
+                              ast.Call(func=deco.args[0], args=[],
+                                       keywords=deco.keywords),
+                              aliases) == "jax.jit"):
+                        pos = _donate_positions(
+                            ast.Call(func=deco.args[0], args=[],
+                                     keywords=deco.keywords), aliases,
+                            attr_literals)
+                    else:
+                        continue
+                    if pos:
+                        donors[node.name] = pos
+        if not donors:
+            return []
+
+        out: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(self._check_function(module, node, donors))
+        return out
+
+    # -- per-function flow ------------------------------------------------
+
+    def _check_function(self, module: Module, fn,
+                        donors: dict[str, tuple[int, ...]]
+                        ) -> list[Finding]:
+        # Gather donated-arg events, stores and loads with line numbers.
+        # (key, call_start, call_end, donor)
+        donated: list[tuple[str, int, int, str]] = []
+        stores: dict[str, list[int]] = {}
+        loads: dict[str, list[tuple[int, ast.AST]]] = {}
+
+        own_defs = {
+            sub.name for sub in ast.walk(fn)
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and sub is not fn
+        }
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                callee = _expr_key(node.func)
+                if callee in donors and callee not in own_defs:
+                    for pos in donors[callee]:
+                        if pos < len(node.args):
+                            key = _expr_key(node.args[pos])
+                            if key is not None:
+                                donated.append((
+                                    key,
+                                    node.lineno,
+                                    node.end_lineno or node.lineno,
+                                    callee,
+                                ))
+            key = _expr_key(node)
+            if key is None:
+                continue
+            ctx = getattr(node, "ctx", None)
+            if isinstance(ctx, (ast.Store, ast.Del)):
+                stores.setdefault(key, []).append(node.lineno)
+            elif isinstance(ctx, ast.Load):
+                loads.setdefault(key, []).append((node.lineno, node))
+
+        findings: list[Finding] = []
+        for key, call_start, call_end, donor in donated:
+            # A rebind at the call itself (``self.kv = self._jit(...,
+            # self.kv, ...)`` — possibly spanning lines) is the blessed
+            # pattern: stores count from the call's FIRST line.
+            rebinds = [ln for ln in stores.get(key, ())
+                       if ln >= call_start]
+            next_rebind = min(rebinds) if rebinds else None
+            for (ln, _node) in loads.get(key, ()):
+                if ln <= call_end:
+                    continue
+                if next_rebind is not None and ln > next_rebind:
+                    continue
+                findings.append(self.finding(
+                    module, ln,
+                    f"{fn.name}: {key} is read after being donated to "
+                    f"{donor} (donate_argnums) — its device buffer is "
+                    "already consumed; rebind it from the call's result "
+                    "first",
+                ))
+        return findings

@@ -1,0 +1,140 @@
+"""hot-path-sync: host/device synchronization on the dispatch path.
+
+The overlapped decode loop (PR 1/6) only overlaps if ``dispatch()``
+returns without touching device values: any ``np.asarray`` /
+``.item()`` / ``block_until_ready`` / ``jax.device_get`` reachable from
+dispatch blocks the host on the in-flight step and silently collapses
+the pipeline back to synchronous — no test fails, tokens/s just drops.
+Same for the transport enqueue side: ``AsyncSender.send`` runs on the
+step thread; a sync there defeats the per-peer worker decoupling.
+
+The checker builds an intra-module call graph (self-method and
+module-function edges), marks everything reachable from the configured
+roots, and flags the known sync-forcing calls inside that region.
+Edges into ``resolve``/``_resolve*`` are not followed — resolve is the
+*designated* sync point of the two-phase loop.
+
+Sites that provably touch only host data (padding lists, shape tuples)
+are annotated in place::
+
+    arr = np.asarray(rows, dtype=np.int32)  # parallax: allow[hot-path-sync] host list, never a device array
+"""
+
+from __future__ import annotations
+
+import ast
+
+from parallax_tpu.analysis.checkers import common
+from parallax_tpu.analysis.linter import Checker, Finding, Module
+
+# rel-path suffix -> root callables of the hot region.
+HOT_ROOTS: dict[str, tuple[str, ...]] = {
+    "runtime/engine.py": ("dispatch",),
+    "p2p/transport.py": ("send",),
+}
+
+# Canonical call names that force a device sync.
+SYNC_CALLS = frozenset({
+    "jax.device_get",
+    "jax.block_until_ready",
+    "numpy.asarray",
+    "numpy.array",
+    "numpy.ascontiguousarray",
+})
+# Method names that force a sync on any array receiver.
+SYNC_METHODS = frozenset({"block_until_ready", "item"})
+# The sync point of the two-phase loop: never treated as hot.
+RESOLVE_PREFIXES = ("resolve", "_resolve")
+
+
+class HotPathSyncChecker(Checker):
+    id = "hot-path-sync"
+    doc = ("device-synchronizing call (np.asarray/.item()/"
+           "block_until_ready/device_get) reachable from dispatch()")
+
+    def check(self, module: Module) -> list[Finding]:
+        roots = None
+        for suffix, names in HOT_ROOTS.items():
+            if module.rel.endswith(suffix):
+                roots = names
+                break
+        if roots is None:
+            return []
+        aliases = common.import_aliases(module.tree)
+
+        # Function table: (class_name or None, func_name) -> FunctionDef.
+        table: dict[tuple[str | None, str], ast.AST] = {}
+        classes: dict[str, ast.ClassDef] = {}
+        for node in module.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                table[(None, node.name)] = node
+            elif isinstance(node, ast.ClassDef):
+                classes[node.name] = node
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        table[(node.name, sub.name)] = sub
+
+        # Seed with every class's root-named methods + module functions.
+        work: list[tuple[str | None, str]] = [
+            key for key in table if key[1] in roots
+        ]
+        reachable: set[tuple[str | None, str]] = set()
+        while work:
+            key = work.pop()
+            if key in reachable:
+                continue
+            reachable.add(key)
+            cls_name, _ = key
+            fn = table[key]
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee_attr = common.self_attr(node.func)
+                if callee_attr is not None:
+                    if callee_attr.startswith(RESOLVE_PREFIXES):
+                        continue
+                    nxt = (cls_name, callee_attr)
+                    if nxt in table:
+                        work.append(nxt)
+                    continue
+                if isinstance(node.func, ast.Name):
+                    if node.func.id.startswith(RESOLVE_PREFIXES):
+                        continue
+                    nxt = (None, node.func.id)
+                    if nxt in table:
+                        work.append(nxt)
+
+        out: list[Finding] = []
+        root_names = ", ".join(sorted(roots))
+        for (cls_name, fn_name) in sorted(
+                reachable, key=lambda k: (k[0] or "", k[1])):
+            fn = table[(cls_name, fn_name)]
+            where = f"{cls_name}.{fn_name}" if cls_name else fn_name
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                label = self._sync_label(node, aliases)
+                if label is None:
+                    continue
+                out.append(self.finding(
+                    module, node.lineno,
+                    f"{where}: {label} on the dispatch hot path "
+                    f"(reachable from {root_names}()) blocks the host on "
+                    "in-flight device work, defeating step overlap",
+                ))
+        return out
+
+    @staticmethod
+    def _sync_label(call: ast.Call, aliases: dict[str, str]) -> str | None:
+        name = common.canonical_call_name(call, aliases)
+        if name in SYNC_CALLS:
+            return f"call to {name}"
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr in SYNC_METHODS
+            and not call.args
+            and not call.keywords
+        ):
+            return f".{call.func.attr}()"
+        return None

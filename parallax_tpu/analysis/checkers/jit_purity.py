@@ -1,0 +1,210 @@
+"""jit-purity: host-side effects inside traced functions.
+
+A function handed to ``jax.jit`` / ``lax.scan`` runs as a *trace*:
+its Python body executes once per compile, then never again. Three
+classes of hazard hide there:
+
+- **impure calls** — ``time.time()``, ``random.*``, ``np.random.*``:
+  the value is frozen into the compiled program at trace time; the
+  jitted function "works" in tests and returns the same timestamp/
+  random draw forever after;
+- **mutable-closure capture** — a free variable rebound *after* the
+  ``def``: the trace captures whatever the name points at when the
+  compile happens, which depends on call order, not source order;
+- **attribute stores** — ``obj.flag = True`` inside the traced body
+  runs at trace time only (once per compile), not per call; if it is a
+  deliberate trace-time switch it must say so in place (the engine's
+  SP wrapper is the canonical annotated example).
+
+Only functions the module itself hands to jit/scan are checked —
+helpers that merely *look* jittable are out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from parallax_tpu.analysis.checkers import common
+from parallax_tpu.analysis.linter import Checker, Finding, Module
+
+IMPURE_PREFIXES = (
+    "time.time", "time.perf_counter", "time.monotonic", "time.time_ns",
+    "random.", "numpy.random.", "os.urandom", "uuid.uuid",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+)
+
+TRACE_ENTRYPOINTS = ("jax.jit", "jax.lax.scan", "lax.scan")
+
+
+class JitPurityChecker(Checker):
+    id = "jit-purity"
+    doc = ("impure call, mutable-closure capture or attribute store "
+           "inside a function handed to jax.jit / lax.scan")
+
+    def check(self, module: Module) -> list[Finding]:
+        aliases = common.import_aliases(module.tree)
+        parents = common.parent_map(module.tree)
+        module_names = common.module_level_names(module.tree)
+
+        # name -> FunctionDef for every def in the module (scoped lookup
+        # is approximated by nearest-enclosing-scope match below).
+        defs: list[ast.AST] = [
+            n for n in ast.walk(module.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+
+        jitted: dict[ast.AST, str] = {}   # FunctionDef -> entrypoint label
+
+        def resolve_local_def(name_node: ast.AST,
+                              at: ast.AST) -> ast.AST | None:
+            if not isinstance(name_node, ast.Name):
+                return None
+            # Prefer a def sharing the same enclosing function scope.
+            scope = common.enclosing_function(at, parents)
+            best = None
+            for d in defs:
+                if d.name != name_node.id:  # type: ignore[attr-defined]
+                    continue
+                if common.enclosing_function(d, parents) is scope:
+                    return d
+                best = best or d
+            return best
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                name = common.canonical_call_name(node, aliases)
+                if name == "jax.jit" and node.args:
+                    target = resolve_local_def(node.args[0], node)
+                    if target is not None:
+                        jitted.setdefault(target, "jax.jit")
+                elif name in ("jax.lax.scan", "lax.scan") and node.args:
+                    target = resolve_local_def(node.args[0], node)
+                    if target is not None:
+                        jitted.setdefault(target, "lax.scan")
+                elif (name == "functools.partial" and len(node.args) >= 2
+                      and common.dotted_name(node.args[0]) is not None):
+                    part_name = common.canonical_call_name(
+                        ast.Call(func=node.args[0], args=[], keywords=[]),
+                        aliases)
+                    if part_name in TRACE_ENTRYPOINTS:
+                        target = resolve_local_def(node.args[1], node)
+                        if target is not None:
+                            jitted.setdefault(target, part_name)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    dname = (common.dotted_name(deco)
+                             if not isinstance(deco, ast.Call)
+                             else common.canonical_call_name(deco, aliases))
+                    if dname is None:
+                        continue
+                    head, _, _ = dname.partition(".")
+                    dname = dname.replace(head, aliases.get(head, head), 1)
+                    if dname == "jax.jit" or (
+                        isinstance(deco, ast.Call)
+                        and dname == "functools.partial"
+                        and deco.args
+                        and common.canonical_call_name(
+                            ast.Call(func=deco.args[0], args=[],
+                                     keywords=[]), aliases)
+                        in TRACE_ENTRYPOINTS
+                    ):
+                        jitted.setdefault(node, "jax.jit")
+
+        out: list[Finding] = []
+        for fn, entry in jitted.items():
+            out.extend(self._check_traced_fn(
+                module, fn, entry, aliases, parents, module_names))
+        return out
+
+    # -- one traced function ---------------------------------------------
+
+    def _check_traced_fn(self, module: Module, fn, entry: str,
+                         aliases: dict[str, str],
+                         parents: dict[ast.AST, ast.AST],
+                         module_names: set[str]) -> list[Finding]:
+        out: list[Finding] = []
+        params = {a.arg for a in (
+            list(fn.args.posonlyargs) + list(fn.args.args)
+            + list(fn.args.kwonlyargs)
+        )}
+        if fn.args.vararg:
+            params.add(fn.args.vararg.arg)
+        if fn.args.kwarg:
+            params.add(fn.args.kwarg.arg)
+        local_stores = {
+            n.id for n in ast.walk(fn)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+        }
+        fn_name = fn.name
+
+        # 1) impure calls
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = common.canonical_call_name(node, aliases)
+                if name and any(
+                    name == p or (p.endswith(".") and name.startswith(p))
+                    for p in IMPURE_PREFIXES
+                ):
+                    out.append(self.finding(
+                        module, node.lineno,
+                        f"{fn_name} (traced by {entry}): call to {name} "
+                        "executes at trace time only — its value is "
+                        "frozen into the compiled program",
+                    ))
+            # 2) attribute stores / nonlocal escapes
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for tgt in targets:
+                    base = tgt
+                    while isinstance(base, ast.Subscript):
+                        base = base.value
+                    if isinstance(base, ast.Attribute):
+                        root = common.dotted_name(base)
+                        root_head = (root or "").split(".")[0]
+                        if root_head and root_head not in params:
+                            out.append(self.finding(
+                                module, tgt.lineno,
+                                f"{fn_name} (traced by {entry}): store to "
+                                f"{root} is a trace-time side effect — it "
+                                "runs once per compile, not per call",
+                            ))
+            elif isinstance(node, ast.Nonlocal):
+                out.append(self.finding(
+                    module, node.lineno,
+                    f"{fn_name} (traced by {entry}): nonlocal write "
+                    "escapes the trace — it mutates host state once per "
+                    "compile, not per call",
+                ))
+
+        # 3) mutable-closure capture: free names rebound after the def
+        # in the enclosing function.
+        encl = common.enclosing_function(fn, parents)
+        if encl is not None:
+            import builtins
+
+            free = set()
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)
+                        and node.id not in params
+                        and node.id not in local_stores
+                        and node.id not in module_names
+                        and not hasattr(builtins, node.id)):
+                    free.add(node.id)
+            if free:
+                for node in ast.walk(encl):
+                    if (isinstance(node, ast.Name)
+                            and isinstance(node.ctx, ast.Store)
+                            and node.id in free
+                            and node.lineno > (fn.end_lineno or fn.lineno)
+                            and common.enclosing_function(node, parents)
+                            is encl):
+                        out.append(self.finding(
+                            module, node.lineno,
+                            f"{fn_name} (traced by {entry}): captured "
+                            f"variable '{node.id}' is rebound after the "
+                            "def — the trace sees whichever binding "
+                            "exists at first call, not this one",
+                        ))
+        return out

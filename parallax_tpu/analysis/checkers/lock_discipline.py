@@ -1,0 +1,241 @@
+"""lock-discipline: inconsistently guarded shared attributes.
+
+For every class that owns a lock (``self._lock = threading.Lock()`` /
+``RLock()`` / ``make_lock(...)`` — any attribute whose name contains
+``lock``), infer which attributes the class *intends* to guard: an
+attribute mutated at least once inside a ``with self.<lock>:`` block.
+Then flag every mutation of such an attribute that happens **outside**
+any with-guard in a method other than ``__init__`` — the classic
+sometimes-locked race (RacerD's inconsistent-lock heuristic), which is
+exactly how stat counters and peer tables rot in a system where every
+object is touched by heartbeat, sender, watchdog and step threads.
+
+Precision rules:
+
+- only two-sided evidence fires (guarded somewhere AND unguarded
+  elsewhere); a class that never locks an attribute is out of scope;
+- ``__init__`` / ``__post_init__`` are construction-time and exempt;
+- a *locked helper* — a method whose every call site inside the class
+  textually holds the lock — has its mutations treated as guarded
+  (one propagation level);
+- nested functions (worker-thread closures) reset the held-lock set:
+  the ``with`` that lexically encloses a ``def`` does not protect the
+  body at call time.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from parallax_tpu.analysis.checkers import common
+from parallax_tpu.analysis.linter import Checker, Finding, Module
+
+LOCK_FACTORIES = (
+    "threading.Lock", "threading.RLock", "threading.Condition",
+)
+
+
+def _is_lock_factory(call: ast.Call, aliases: dict[str, str]) -> bool:
+    name = common.canonical_call_name(call, aliases)
+    if name is None:
+        return False
+    return name in LOCK_FACTORIES or name.split(".")[-1] == "make_lock"
+
+
+class _MutationSite:
+    __slots__ = ("attr", "line", "method", "held")
+
+    def __init__(self, attr: str, line: int, method: str, held: bool):
+        self.attr = attr
+        self.line = line
+        self.method = method
+        self.held = held
+
+
+class LockDisciplineChecker(Checker):
+    id = "lock-discipline"
+    doc = ("mutation of a lock-guarded attribute outside its "
+           "with-guard in a multi-thread-reachable method")
+
+    def check(self, module: Module) -> list[Finding]:
+        aliases = common.import_aliases(module.tree)
+        out: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(module, node, aliases))
+        return out
+
+    # -- per-class --------------------------------------------------------
+
+    def _check_class(self, module: Module, cls: ast.ClassDef,
+                     aliases: dict[str, str]) -> list[Finding]:
+        lock_attrs = self._lock_attrs(cls, aliases)
+        if not lock_attrs:
+            return []
+
+        sites: list[_MutationSite] = []
+        # method name -> list[bool]: held-state of each internal call site
+        call_held: dict[str, list[bool]] = {}
+        guard_locks: dict[str, set[str]] = {}   # attr -> locks seen guarding
+
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_method(
+                    stmt, stmt.name, lock_attrs, frozenset(), sites,
+                    call_held, guard_locks, top=True,
+                )
+
+        guarded_attrs = {s.attr for s in sites if s.held}
+        locked_helpers = {
+            m for m, states in call_held.items()
+            if states and all(states)
+        }
+        out: list[Finding] = []
+        seen: set[tuple[str, str, int]] = set()
+        for s in sites:
+            if s.held or s.attr not in guarded_attrs:
+                continue
+            if s.method in ("__init__", "__post_init__"):
+                continue
+            if s.method in locked_helpers:
+                continue
+            key = (s.method, s.attr, s.line)
+            if key in seen:
+                continue
+            seen.add(key)
+            lock = sorted(guard_locks.get(s.attr, {"_lock"}))[0]
+            out.append(self.finding(
+                module, s.line,
+                f"{cls.name}.{s.method}: write to self.{s.attr} without "
+                f"holding self.{lock} (this attribute is lock-guarded "
+                "elsewhere in the class)",
+            ))
+        return out
+
+    @staticmethod
+    def _lock_attrs(cls: ast.ClassDef,
+                    aliases: dict[str, str]) -> set[str]:
+        locks: set[str] = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            if not _is_lock_factory(node.value, aliases):
+                continue
+            for tgt in node.targets:
+                attr = common.self_attr(tgt)
+                if attr is not None and "lock" in attr.lower():
+                    locks.add(attr)
+        return locks
+
+    # -- held-set walker --------------------------------------------------
+
+    def _walk_method(self, fn, method_name: str, lock_attrs: set[str],
+                     held: frozenset[str], sites: list[_MutationSite],
+                     call_held: dict[str, list[bool]],
+                     guard_locks: dict[str, set[str]], top: bool) -> None:
+        for stmt in fn.body:
+            self._walk_stmt(stmt, method_name, lock_attrs, held, sites,
+                            call_held, guard_locks)
+
+    def _walk_stmt(self, stmt: ast.stmt, method: str,
+                   lock_attrs: set[str], held: frozenset[str],
+                   sites: list[_MutationSite],
+                   call_held: dict[str, list[bool]],
+                   guard_locks: dict[str, set[str]]) -> None:
+        if isinstance(stmt, ast.With):
+            newly = set()
+            for item in stmt.items:
+                attr = common.self_attr(item.context_expr)
+                if attr in lock_attrs:
+                    newly.add(attr)
+            inner = held | newly
+            for s in stmt.body:
+                self._walk_stmt(s, method, lock_attrs, inner, sites,
+                                call_held, guard_locks)
+            # the with-expression itself may contain calls/mutations
+            for item in stmt.items:
+                self._scan_expr(item.context_expr, method, lock_attrs,
+                                held, sites, call_held, guard_locks)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Closure body executes later, on whatever thread calls it —
+            # the lexical with-guard does not apply.
+            self._walk_method(stmt, method, lock_attrs, frozenset(),
+                              sites, call_held, guard_locks, top=False)
+            return
+        # Statement-level mutations.
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                self._record_target(tgt, method, lock_attrs, held, sites,
+                                    guard_locks)
+            self._scan_expr(stmt.value, method, lock_attrs, held, sites,
+                            call_held, guard_locks)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            if stmt.value is not None:
+                self._scan_expr(stmt.value, method, lock_attrs, held,
+                                sites, call_held, guard_locks)
+            self._record_target(stmt.target, method, lock_attrs, held,
+                                sites, guard_locks)
+        elif isinstance(stmt, (ast.Delete,)):
+            for tgt in stmt.targets:
+                self._record_target(tgt, method, lock_attrs, held, sites,
+                                    guard_locks)
+        elif isinstance(stmt, ast.Expr):
+            self._scan_expr(stmt.value, method, lock_attrs, held, sites,
+                            call_held, guard_locks)
+        else:
+            # Compound statements: recurse into child statements with the
+            # same held set; scan embedded expressions.
+            for field in ("test", "iter", "value", "exc", "msg"):
+                sub = getattr(stmt, field, None)
+                if isinstance(sub, ast.expr):
+                    self._scan_expr(sub, method, lock_attrs, held, sites,
+                                    call_held, guard_locks)
+            for field in ("body", "orelse", "finalbody"):
+                for s in getattr(stmt, field, ()) or ():
+                    if isinstance(s, ast.stmt):
+                        self._walk_stmt(s, method, lock_attrs, held,
+                                        sites, call_held, guard_locks)
+            for handler in getattr(stmt, "handlers", ()) or ():
+                for s in handler.body:
+                    self._walk_stmt(s, method, lock_attrs, held, sites,
+                                    call_held, guard_locks)
+
+    def _record_target(self, tgt: ast.AST, method: str,
+                       lock_attrs: set[str], held: frozenset[str],
+                       sites: list[_MutationSite],
+                       guard_locks: dict[str, set[str]]) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._record_target(elt, method, lock_attrs, held, sites,
+                                    guard_locks)
+            return
+        attr = common.mutation_target_attr(tgt)
+        if attr is None or attr in lock_attrs:
+            return
+        is_held = bool(held)
+        sites.append(_MutationSite(attr, tgt.lineno, method, is_held))
+        if is_held:
+            guard_locks.setdefault(attr, set()).update(held)
+
+    def _scan_expr(self, expr: ast.expr, method: str,
+                   lock_attrs: set[str], held: frozenset[str],
+                   sites: list[_MutationSite],
+                   call_held: dict[str, list[bool]],
+                   guard_locks: dict[str, set[str]]) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            attr = common.mutating_call_attr(node)
+            if attr is not None and attr not in lock_attrs:
+                is_held = bool(held)
+                sites.append(_MutationSite(
+                    attr, node.lineno, method, is_held))
+                if is_held:
+                    guard_locks.setdefault(attr, set()).update(held)
+            # Internal call sites for locked-helper propagation.
+            callee = common.self_attr(node.func)
+            if callee is not None:
+                call_held.setdefault(callee, []).append(bool(held))

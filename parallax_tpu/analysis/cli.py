@@ -1,0 +1,124 @@
+"""Command-line driver: ``python -m parallax_tpu.analysis`` /
+``parallax-tpu-lint``.
+
+Exit status: 0 when the pass is clean (no findings outside the
+committed baseline and suppressions), 1 otherwise. ``--strict`` (CI)
+additionally fails on stale baseline entries so the ratchet only ever
+tightens. Stdlib-only — runs without jax installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from parallax_tpu.analysis.linter import (
+    LintEngine,
+    default_baseline_path,
+    default_package_root,
+    load_baseline,
+    write_baseline,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="parallax-tpu-lint",
+        description=(
+            "Concurrency & JAX-hazard analysis for parallax_tpu "
+            "(lock discipline, hot-path syncs, donation reuse, jit "
+            "purity, config gates). See docs/static_analysis.md."
+        ),
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files or directories to lint (default: the parallax_tpu "
+             "package)")
+    parser.add_argument(
+        "--strict", action="store_true",
+        help="also fail on stale baseline entries (CI mode)")
+    parser.add_argument(
+        "--baseline", default=default_baseline_path(),
+        help="baseline JSON path (default: analysis/baseline.json)")
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="rewrite the baseline from this run's findings; refuses to "
+             "GROW the baseline (fix or suppress new findings instead) "
+             "unless --grow-baseline is also given")
+    parser.add_argument(
+        "--grow-baseline", action="store_true",
+        help="allow --write-baseline to add new fingerprints (a "
+             "deliberate, reviewed ratchet loosening)")
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable report on stdout")
+    parser.add_argument(
+        "--list-checkers", action="store_true",
+        help="print the checker catalog and exit")
+    args = parser.parse_args(argv)
+
+    engine = LintEngine()
+    if args.list_checkers:
+        for checker in engine.checkers:
+            print(f"{checker.id:18s} {checker.doc}")
+        return 0
+
+    paths = args.paths or [default_package_root()]
+    baseline = load_baseline(args.baseline)
+    result = engine.run_paths(paths, baseline=baseline)
+
+    if args.write_baseline:
+        # Ratchet guard: the committed baseline only ever shrinks. New
+        # findings are fixed or suppressed in place, not baselined —
+        # growth needs the explicit --grow-baseline acknowledgement.
+        growth = [f for f in result.findings
+                  if f.fingerprint not in baseline]
+        if growth and not args.grow_baseline:
+            for f in growth:
+                print(f.render())
+            print(
+                f"refusing to grow the baseline by {len(growth)} "
+                "fingerprint(s): fix the finding(s) above or suppress "
+                "them in place (# parallax: allow[id] reason); pass "
+                "--grow-baseline to loosen the ratchet deliberately"
+            )
+            return 1
+        data = write_baseline(args.baseline, result)
+        print(f"baseline written: {len(data['fingerprints'])} "
+              f"fingerprint(s) -> {args.baseline}")
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "files": result.files,
+            "findings": [
+                {"checker": f.checker, "path": f.path, "line": f.line,
+                 "message": f.message, "fingerprint": f.fingerprint}
+                for f in result.findings
+            ],
+            "baselined": [f.fingerprint for f in result.baselined],
+            "suppressed": len(result.suppressed),
+            "stale_baseline": result.stale_baseline,
+        }, indent=2))
+    else:
+        for f in result.findings:
+            print(f.render())
+        for f in result.baselined:
+            print(f"{f.render()}  [baselined]")
+        if result.stale_baseline:
+            for fp in result.stale_baseline:
+                print(f"stale baseline entry (fixed? remove it): {fp}")
+        print(
+            f"{result.files} file(s): {len(result.findings)} finding(s), "
+            f"{len(result.baselined)} baselined, "
+            f"{len(result.suppressed)} suppressed, "
+            f"{len(result.stale_baseline)} stale baseline entr(y/ies)"
+        )
+
+    ok = result.strict_ok() if args.strict else result.ok
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

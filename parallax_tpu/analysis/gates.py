@@ -1,0 +1,94 @@
+"""The registered feature-gate table.
+
+ROADMAP item 2 calls out a whole class of production surprises:
+"feature X silently off" — one config knob warn-disables another
+(host tier vs TP sharding, digests vs the native cache manager, SP vs
+unsupported attention) and nothing but a log line records the loss.
+This table makes every such gate an *explicit, reviewed* fact:
+
+- the config-gate checker scans the package for gate-shaped log
+  messages ("... disabled: ...", "... ignored ...", "forces the
+  Python cache manager", ...) and fails on any site not covered by a
+  ``marker`` below — adding a new gate without registering it here is
+  a lint error;
+- each entry must name a real ``EngineConfig`` field (or a CLI flag,
+  spelled ``flag:--name``) — renaming the field orphans the entry and
+  fails the pass;
+- each entry's ``doc`` file must exist and mention the feature, so the
+  operator-facing story can never silently drift from the code.
+
+Adding a gate therefore takes three deliberate steps: the warning in
+code, the entry here, and the doc paragraph — exactly the trail a
+reviewer needs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Gate:
+    """One registered warn-gate: ``feature`` is the EngineConfig field
+    (or ``flag:--cli-name``) whose requested behavior the gate can turn
+    off; ``marker`` is a distinctive substring of the log message at
+    the gate site; ``doc`` is the operator-facing page that explains
+    the tradeoff."""
+
+    feature: str
+    marker: str
+    doc: str
+    reason: str
+
+
+GATE_TABLE: tuple[Gate, ...] = (
+    Gate(
+        feature="host_cache_bytes",
+        marker="host KV tier disabled: hybrid linear-state KV",
+        doc="docs/memory.md",
+        reason="recurrent state has no page-granularity host image",
+    ),
+    Gate(
+        feature="host_cache_bytes",
+        marker="host KV tier disabled: TP-sharded KV",
+        doc="docs/memory.md",
+        reason="sharded gather/scatter transfers not implemented yet",
+    ),
+    Gate(
+        feature="host_cache_bytes",
+        marker="host KV tier disabled: unsupported KV layout",
+        doc="docs/memory.md",
+        reason="non-paged layouts and sub-page budgets cannot tier",
+    ),
+    Gate(
+        feature="host_cache_bytes",
+        marker="host KV tier enabled: using the Python cache manager",
+        doc="docs/memory.md",
+        reason="native manager does not model tier residency",
+    ),
+    Gate(
+        feature="cache_digests",
+        marker="prefix-digest publishing requested: using the Python",
+        doc="docs/scheduling.md",
+        reason="native tree evicts inside C with no per-node delta log",
+    ),
+    Gate(
+        feature="sp_threshold",
+        marker="SP prefill is disabled for",
+        doc="docs/quickstart.md",
+        reason="model class/config does not support ring-attention "
+               "prefill; sp chips run replicated",
+    ),
+    Gate(
+        feature="flag:--sp-size",
+        marker="--sp-size %d ignored",
+        doc="docs/quickstart.md",
+        reason="MLA/sparse/hybrid/window/sink attention has no SP path",
+    ),
+    Gate(
+        feature="flag:--compilation-cache-dir",
+        marker="persistent compilation cache disabled",
+        doc="docs/decode_loop.md",
+        reason="cache dir not writable or backend rejected it",
+    ),
+)

@@ -1,0 +1,324 @@
+"""Project-specific AST lint engine.
+
+Drives the checkers in :mod:`parallax_tpu.analysis.checkers` over a set
+of Python sources and reconciles their findings against two escape
+hatches:
+
+- **suppressions** — ``# parallax: allow[checker-id] reason`` on the
+  flagged line (or on a comment line directly above it) acknowledges an
+  intentional violation in place, with the reason kept next to the
+  code. A missing reason or a suppression that matches nothing is
+  itself a finding (checker id ``suppression``), so stale annotations
+  rot loudly.
+- **baseline** — a committed JSON file of finding fingerprints
+  (``analysis/baseline.json``) makes the pass ratchet-only: findings in
+  the baseline are reported but do not fail the run, anything new does.
+  Fingerprints hash checker id + file + message (no line numbers), so
+  unrelated edits do not churn the baseline. ``--strict`` additionally
+  fails on stale baseline entries, keeping the file tight as findings
+  are fixed.
+
+The engine is stdlib-only (ast + tokenize) and never imports the code
+under analysis, so ``python -m parallax_tpu.analysis`` runs in any
+environment — no jax required.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Iterable
+
+SUPPRESS_RE = re.compile(
+    r"#\s*parallax:\s*allow\[(?P<ids>[a-z0-9_,\- ]+)\]\s*(?P<reason>.*)$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One checker hit. ``message`` must be stable across unrelated
+    edits (names, not line numbers) — it feeds the baseline
+    fingerprint. ``occurrence`` disambiguates same-message duplicates
+    (assigned in source order by the engine) so one baseline entry can
+    never mask a second identical violation added later."""
+
+    checker: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    message: str
+    occurrence: int = 0
+
+    @property
+    def fingerprint(self) -> str:
+        tail = f"#{self.occurrence}" if self.occurrence else ""
+        h = hashlib.sha1(
+            f"{self.checker}|{self.path}|{self.message}{tail}".encode()
+        ).hexdigest()[:12]
+        return f"{self.checker}:{self.path}:{h}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.checker}] {self.message}"
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int              # the source line the suppression governs
+    checkers: tuple[str, ...]
+    reason: str
+    comment_line: int      # where the comment physically lives
+    used: bool = False
+
+
+class Module:
+    """One parsed source file handed to every checker."""
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.suppressions = self._parse_suppressions(source)
+
+    @staticmethod
+    def _parse_suppressions(source: str) -> list[Suppression]:
+        out: list[Suppression] = []
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(source).readline))
+        except tokenize.TokenError:  # pragma: no cover - truncated file
+            tokens = []
+        lines = source.splitlines()
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            ids = tuple(
+                s.strip() for s in m.group("ids").split(",") if s.strip()
+            )
+            comment_line = tok.start[0]
+            before = lines[comment_line - 1][: tok.start[1]].strip()
+            if before:
+                governed = comment_line        # trailing comment
+            else:
+                # Comment-only line: governs the next non-comment,
+                # non-blank source line.
+                governed = comment_line + 1
+                while governed <= len(lines) and (
+                    not lines[governed - 1].strip()
+                    or lines[governed - 1].lstrip().startswith("#")
+                ):
+                    governed += 1
+            out.append(Suppression(
+                line=governed,
+                checkers=ids,
+                reason=m.group("reason").strip(),
+                comment_line=comment_line,
+            ))
+        return out
+
+
+class Checker:
+    """Base class: subclasses set ``id``/``doc`` and implement
+    :meth:`check`."""
+
+    id: str = ""
+    doc: str = ""
+
+    def check(self, module: Module) -> list[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, module: Module, line: int, message: str) -> Finding:
+        return Finding(self.id, module.rel, line, message)
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]              # active: fail the run
+    suppressed: list[tuple[Finding, Suppression]]
+    baselined: list[Finding]
+    stale_baseline: list[str]            # fingerprints with no live finding
+    files: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def strict_ok(self) -> bool:
+        return not self.findings and not self.stale_baseline
+
+
+def default_package_root() -> str:
+    """The parallax_tpu package directory (the default lint target)."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def default_baseline_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "baseline.json"
+    )
+
+
+def iter_sources(paths: Iterable[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", ".git", ".venv")]
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    out.append(os.path.join(root, f))
+    return out
+
+
+def _rel(path: str, repo_root: str | None) -> str:
+    apath = os.path.abspath(path)
+    root = repo_root or os.getcwd()
+    try:
+        rel = os.path.relpath(apath, root)
+    except ValueError:  # pragma: no cover - windows drive mismatch
+        rel = apath
+    if rel.startswith(".."):
+        # Fall back to a stable package-relative spelling.
+        marker = "parallax_tpu" + os.sep
+        idx = apath.rfind(marker)
+        rel = apath[idx:] if idx >= 0 else os.path.basename(apath)
+    return rel
+
+
+class LintEngine:
+    def __init__(self, checkers: list[Checker] | None = None,
+                 repo_root: str | None = None):
+        if checkers is None:
+            from parallax_tpu.analysis.checkers import all_checkers
+
+            checkers = all_checkers()
+        self.checkers = checkers
+        self.repo_root = repo_root or os.path.dirname(default_package_root())
+
+    # -- running ----------------------------------------------------------
+
+    def lint_module(self, module: Module) -> tuple[
+            list[Finding], list[tuple[Finding, Suppression]]]:
+        raw: list[Finding] = []
+        for checker in self.checkers:
+            raw.extend(checker.check(module))
+        active: list[Finding] = []
+        suppressed: list[tuple[Finding, Suppression]] = []
+        for f in raw:
+            sup = self._match_suppression(module, f)
+            if sup is not None:
+                sup.used = True
+                suppressed.append((f, sup))
+            else:
+                active.append(f)
+        # Suppression hygiene: malformed (no reason) or unused
+        # annotations are findings themselves.
+        for sup in module.suppressions:
+            if not sup.reason:
+                active.append(Finding(
+                    "suppression", module.rel, sup.comment_line,
+                    "suppression "
+                    f"allow[{','.join(sup.checkers)}] has no reason "
+                    "(write: # parallax: allow[id] why this is safe)",
+                ))
+            elif not sup.used:
+                active.append(Finding(
+                    "suppression", module.rel, sup.comment_line,
+                    f"unused suppression allow[{','.join(sup.checkers)}] "
+                    "(no checker flags this line; delete it)",
+                ))
+        return active, suppressed
+
+    @staticmethod
+    def _match_suppression(module: Module,
+                           f: Finding) -> Suppression | None:
+        for sup in module.suppressions:
+            if f.checker in sup.checkers and sup.line == f.line:
+                return sup
+        return None
+
+    def run_paths(self, paths: Iterable[str],
+                  baseline: set[str] | None = None) -> LintResult:
+        files = iter_sources(paths)
+        all_active: list[Finding] = []
+        all_sup: list[tuple[Finding, Suppression]] = []
+        for path in files:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+            try:
+                module = Module(path, _rel(path, self.repo_root), source)
+            except SyntaxError as e:
+                all_active.append(Finding(
+                    "parse", _rel(path, self.repo_root),
+                    e.lineno or 1, f"syntax error: {e.msg}"))
+                continue
+            active, sup = self.lint_module(module)
+            all_active.extend(active)
+            all_sup.extend(sup)
+        # Disambiguate same-message duplicates (source order) so each
+        # occurrence carries its own fingerprint.
+        counts: dict[tuple[str, str, str], int] = {}
+        for i, f in enumerate(all_active):
+            key = (f.checker, f.path, f.message)
+            n = counts.get(key, 0)
+            counts[key] = n + 1
+            if n:
+                all_active[i] = dataclasses.replace(f, occurrence=n)
+        baseline = baseline or set()
+        live_fps = {f.fingerprint for f in all_active}
+        baselined = [f for f in all_active if f.fingerprint in baseline]
+        fresh = [f for f in all_active if f.fingerprint not in baseline]
+        stale = sorted(fp for fp in baseline if fp not in live_fps)
+        fresh.sort(key=lambda f: (f.path, f.line, f.checker))
+        return LintResult(
+            findings=fresh, suppressed=all_sup, baselined=baselined,
+            stale_baseline=stale, files=len(files),
+        )
+
+    def lint_text(self, source: str,
+                  filename: str = "<fixture>.py") -> tuple[
+            list[Finding], list[tuple[Finding, Suppression]]]:
+        """Lint a source string (test fixtures)."""
+        module = Module(filename, filename, source)
+        return self.lint_module(module)
+
+
+# -- baseline io ----------------------------------------------------------
+
+
+def load_baseline(path: str) -> set[str]:
+    if not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return set(data.get("fingerprints", ()))
+
+
+def write_baseline(path: str, result: LintResult) -> dict:
+    fps = sorted({f.fingerprint for f in result.findings}
+                 | {f.fingerprint for f in result.baselined})
+    data = {
+        "comment": (
+            "Ratchet baseline for `python -m parallax_tpu.analysis` — "
+            "findings listed here do not fail the run; new ones do. "
+            "Regenerate with --write-baseline (shrink-only; growing "
+            "it requires the explicit --grow-baseline flag)."
+        ),
+        "fingerprints": fps,
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return data

@@ -1,0 +1,310 @@
+"""Runtime lock-order sanitizer: lockdep for the Python layer.
+
+The static lock-discipline checker (checkers/lock_discipline.py) proves
+guarded-attribute hygiene; this module catches the hazard the AST cannot
+see — **lock-order inversion** between threads. Every lock created
+through :func:`make_lock` while the sanitizer is enabled is wrapped so
+that each acquisition records the set of locks the acquiring thread
+already holds. Those observations build a global *lock graph*: an edge
+``A -> B`` means some thread acquired ``B`` while holding ``A``, with
+the acquisition stack captured on first observation. A cycle in that
+graph is a potential deadlock even if the run never actually deadlocked
+— exactly lockdep's trick of turning a latent ordering bug into a
+deterministic report.
+
+The sanitizer also reports **held-too-long** acquisitions (a lock held
+across a blocking call starves every thread behind it — the watchdog
+sees the symptom, this names the lock and the stack).
+
+Zero-cost when off: :func:`make_lock` returns a plain
+``threading.Lock``/``RLock`` unless the sanitizer was enabled *before*
+the lock was created (module-level locks created at import time are
+therefore never instrumented — enable early, e.g. from the pytest
+``--lock-sanitizer`` flag or ``PARALLAX_LOCK_SANITIZER=1``). The
+serving path never pays an extra branch per acquire.
+
+Usage::
+
+    from parallax_tpu.analysis import sanitizer
+    sanitizer.enable()
+    ... run threaded workload (e.g. under testing/chaos.py) ...
+    report = sanitizer.report()
+    assert not report["cycles"]
+
+Nodes in the graph are lock *names* (the ``make_lock("node.peers")``
+argument), so every instance of a per-object lock shares one node and
+ordering is checked across instances; self-edges (two same-named locks
+nested, e.g. two different peer links) are recorded separately as
+``nested_same_name`` rather than flagged as cycles.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from typing import Any
+
+__all__ = [
+    "make_lock",
+    "enable",
+    "disable",
+    "reset",
+    "is_enabled",
+    "cycles",
+    "report",
+    "get_sanitizer",
+    "LockOrderSanitizer",
+    "SanitizedLock",
+]
+
+
+def _stack(skip: int = 3, limit: int = 12) -> list[str]:
+    """Compact acquisition stack (innermost last), trimmed of the
+    sanitizer's own frames."""
+    frames = traceback.extract_stack()[:-skip]
+    return [
+        f"{os.path.basename(f.filename)}:{f.lineno}:{f.name}"
+        for f in frames[-limit:]
+    ]
+
+
+class LockOrderSanitizer:
+    """Global lock graph + per-thread held-lock tracking.
+
+    All internal state is guarded by one *plain* lock (never
+    instrumented — the sanitizer must not observe itself)."""
+
+    def __init__(self, held_too_long_ms: float = 1000.0,
+                 max_reports: int = 200):
+        self._meta = threading.Lock()
+        self._tls = threading.local()
+        self.held_too_long_ms = float(held_too_long_ms)
+        self.max_reports = int(max_reports)
+        self.enabled = False
+        # (holder_name, acquired_name) -> {"stack": [...], "count": int}
+        self.edges: dict[tuple[str, str], dict[str, Any]] = {}
+        # name -> acquisition count
+        self.lock_names: dict[str, int] = {}
+        self.long_holds: list[dict[str, Any]] = []
+        self.nested_same_name: list[dict[str, Any]] = []
+        self.acquisitions = 0
+
+    # -- per-thread held stack -------------------------------------------
+
+    def _held(self) -> list["SanitizedLock"]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    # -- recording (called from SanitizedLock) ---------------------------
+
+    def note_acquired(self, lock: "SanitizedLock") -> None:
+        held = self._held()
+        with self._meta:
+            self.acquisitions += 1
+            self.lock_names[lock.name] = self.lock_names.get(lock.name, 0) + 1
+            for h in held:
+                if h.name == lock.name:
+                    if len(self.nested_same_name) < self.max_reports:
+                        self.nested_same_name.append({
+                            "name": lock.name,
+                            "stack": _stack(),
+                        })
+                    continue
+                edge = self.edges.get((h.name, lock.name))
+                if edge is None:
+                    self.edges[(h.name, lock.name)] = {
+                        "stack": _stack(),
+                        "count": 1,
+                    }
+                else:
+                    edge["count"] += 1
+        held.append(lock)
+
+    def note_released(self, lock: "SanitizedLock", held_s: float) -> None:
+        held = self._held()
+        # Remove the most recent entry for this lock (LIFO discipline is
+        # the common case; out-of-order release is still handled).
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                break
+        ms = held_s * 1000.0
+        if ms >= self.held_too_long_ms:
+            with self._meta:
+                if len(self.long_holds) < self.max_reports:
+                    self.long_holds.append({
+                        "name": lock.name,
+                        "held_ms": round(ms, 3),
+                        "stack": _stack(),
+                    })
+
+    # -- analysis ---------------------------------------------------------
+
+    def cycles(self) -> list[list[str]]:
+        """Simple cycles in the lock graph (each reported once, as the
+        node path ``[a, b, ..., a]``)."""
+        with self._meta:
+            adj: dict[str, list[str]] = {}
+            for (a, b) in self.edges:
+                adj.setdefault(a, []).append(b)
+        found: list[list[str]] = []
+        seen_cycles: set[frozenset[str]] = set()
+
+        def dfs(node: str, path: list[str], on_path: set[str]) -> None:
+            for nxt in adj.get(node, ()):
+                if nxt in on_path:
+                    cyc = path[path.index(nxt):] + [nxt]
+                    key = frozenset(cyc)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        found.append(cyc)
+                    continue
+                on_path.add(nxt)
+                dfs(nxt, path + [nxt], on_path)
+                on_path.discard(nxt)
+
+        for start in sorted(adj):
+            dfs(start, [start], {start})
+        return found
+
+    def report(self) -> dict[str, Any]:
+        cyc = self.cycles()
+        with self._meta:
+            return {
+                "enabled": self.enabled,
+                "locks": dict(self.lock_names),
+                "acquisitions": self.acquisitions,
+                "edges": {
+                    f"{a} -> {b}": dict(info)
+                    for (a, b), info in self.edges.items()
+                },
+                "cycles": cyc,
+                "long_holds": list(self.long_holds),
+                "nested_same_name": list(self.nested_same_name),
+            }
+
+    def reset(self) -> None:
+        with self._meta:
+            self.edges.clear()
+            self.lock_names.clear()
+            self.long_holds.clear()
+            self.nested_same_name.clear()
+            self.acquisitions = 0
+
+
+class SanitizedLock:
+    """Instrumented Lock/RLock: context-manager and acquire/release
+    compatible with ``threading.Lock``. Reentrant re-acquisitions of an
+    RLock are tracked by depth and recorded only at depth 0 (a lock
+    cannot order against itself)."""
+
+    __slots__ = ("name", "_lock", "_san", "_reentrant", "_tls")
+
+    def __init__(self, name: str, san: LockOrderSanitizer,
+                 reentrant: bool = False):
+        self.name = name
+        self._san = san
+        self._reentrant = reentrant
+        self._lock = threading.RLock() if reentrant else threading.Lock()
+        self._tls = threading.local()
+
+    def _depth(self) -> int:
+        return getattr(self._tls, "depth", 0)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            depth = self._depth()
+            self._tls.depth = depth + 1
+            if depth == 0:
+                self._tls.t0 = time.monotonic()
+                self._san.note_acquired(self)
+        return got
+
+    def release(self) -> None:
+        depth = self._depth() - 1
+        self._tls.depth = depth
+        if depth == 0:
+            t0 = getattr(self._tls, "t0", None)
+            self._san.note_released(
+                self, (time.monotonic() - t0) if t0 is not None else 0.0
+            )
+        self._lock.release()
+
+    def locked(self) -> bool:
+        inner = self._lock
+        if self._reentrant:
+            # RLock has no .locked() before 3.12; approximate via a
+            # non-blocking probe from this thread.
+            if inner.acquire(blocking=False):
+                inner.release()
+                return self._depth() > 0
+            return True
+        return inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<SanitizedLock {self.name!r} reentrant={self._reentrant}>"
+
+
+_SANITIZER = LockOrderSanitizer()
+
+
+def get_sanitizer() -> LockOrderSanitizer:
+    return _SANITIZER
+
+
+def is_enabled() -> bool:
+    return _SANITIZER.enabled
+
+
+def enable(held_too_long_ms: float | None = None) -> LockOrderSanitizer:
+    """Turn on instrumentation for locks created from now on."""
+    if held_too_long_ms is not None:
+        _SANITIZER.held_too_long_ms = float(held_too_long_ms)
+    _SANITIZER.enabled = True
+    return _SANITIZER
+
+
+def disable() -> None:
+    _SANITIZER.enabled = False
+
+
+def reset() -> None:
+    _SANITIZER.reset()
+
+
+def cycles() -> list[list[str]]:
+    return _SANITIZER.cycles()
+
+
+def report() -> dict[str, Any]:
+    return _SANITIZER.report()
+
+
+# Environment opt-in: processes (pytest workers, bench subprocesses)
+# inherit the flag without plumbing.
+if os.environ.get("PARALLAX_LOCK_SANITIZER", "") not in ("", "0"):
+    enable()
+
+
+def make_lock(name: str, reentrant: bool = False):
+    """Lock factory every parallax_tpu module uses for shared state.
+
+    Returns a plain ``threading.Lock``/``RLock`` (zero overhead) unless
+    the lock-order sanitizer is enabled, in which case the lock is
+    instrumented and participates in lock-graph recording under the
+    given name. Names are dotted ``module.role`` strings; all instances
+    sharing a name share one lock-graph node."""
+    if _SANITIZER.enabled:
+        return SanitizedLock(name, _SANITIZER, reentrant=reentrant)
+    return threading.RLock() if reentrant else threading.Lock()

@@ -17,6 +17,7 @@ from parallax_tpu.p2p.transport import TcpTransport, Transport
 from parallax_tpu.runtime.request import Request, RequestStatus
 from parallax_tpu.scheduling.scheduler import GlobalScheduler
 from parallax_tpu.utils import get_logger
+from parallax_tpu.analysis.sanitizer import make_lock
 
 logger = get_logger(__name__)
 
@@ -428,7 +429,7 @@ def make_scheduler_init_fn(service: SchedulerService, resolve_model,
     re-resolve the new model (join replies carry its name) and reload
     their stage; the frontend's tokenizer follows via ``tokenizer_fn``
     (reference scheduler_manage stop + run, backend/main.py:124-136)."""
-    lock = threading.Lock()
+    lock = make_lock("backend.run_frontend")
 
     def init(model_name: str, init_nodes_num: int) -> dict:
         try:

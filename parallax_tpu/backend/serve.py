@@ -14,6 +14,7 @@ from parallax_tpu.runtime.engine import EngineConfig, StageEngine
 from parallax_tpu.runtime.pipeline import InProcessPipeline
 from parallax_tpu.runtime.request import Request
 from parallax_tpu.utils import get_logger
+from parallax_tpu.analysis.sanitizer import make_lock
 
 logger = get_logger(__name__)
 
@@ -28,7 +29,7 @@ class LocalRunner:
         # pass — a step round that hangs stops the beats.
         self.watchdog = watchdog
         self._events: dict[str, threading.Event] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("backend.serve")
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="pipeline-runner"

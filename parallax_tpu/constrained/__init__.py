@@ -16,6 +16,7 @@ import threading
 from parallax_tpu.constrained.automaton import Dfa, compile_dfa
 from parallax_tpu.constrained.json_schema import SchemaError, compile_schema
 from parallax_tpu.constrained.vocab import TokenTable, vocab_bytes_from_tokenizer
+from parallax_tpu.analysis.sanitizer import make_lock
 
 __all__ = [
     "Dfa",
@@ -66,7 +67,7 @@ class GrammarCompiler:
         self._eos = int(eos_token_id)
         self._max = max_cached
         self._cache: dict[str, TokenTable] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("constrained.grammar")
 
     def compile(self, schema_json: str) -> TokenTable:
         key = schema_json.strip() or "{}"

@@ -27,13 +27,14 @@ import threading
 import numpy as np
 
 from parallax_tpu.utils import get_logger
+from parallax_tpu.analysis.sanitizer import make_lock
 
 logger = get_logger(__name__)
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "radix_cache.cpp")
 _LIB_PATH = os.path.join(_HERE, "libradix.so")
-_lock = threading.Lock()
+_lock = make_lock("native.build")
 _lib = None
 _build_failed = False
 

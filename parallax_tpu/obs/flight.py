@@ -19,6 +19,7 @@ import time
 from collections import deque
 
 from parallax_tpu.utils import get_logger
+from parallax_tpu.analysis.sanitizer import make_lock
 
 logger = get_logger(__name__)
 
@@ -31,7 +32,7 @@ class FlightRecorder:
         self._requests: deque[dict] = deque(maxlen=capacity)
         self._slow: deque[dict] = deque(maxlen=slow_capacity)
         self._events: deque[dict] = deque(maxlen=event_capacity)
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.flight")
         self.slow_count = 0
         # Monotonic per-process event sequence: the cluster timeline
         # (obs/timeline.py) merges per-node rings by it, and a gap in a

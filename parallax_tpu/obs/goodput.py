@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import threading
 import time
+from parallax_tpu.analysis.sanitizer import make_lock
 
 TOKEN_KINDS = (
     "committed",
@@ -57,7 +58,7 @@ class GoodputLedger:
 
     def __init__(self, registry=None, clock=time.monotonic):
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.goodput")
         self.tokens = {k: 0 for k in TOKEN_KINDS}
         self.time_s = {k: 0.0 for k in TIME_KINDS}
         self.requests = {"finished": 0, "aborted": 0}

@@ -28,8 +28,9 @@ from __future__ import annotations
 
 import bisect
 import math
-import threading
 import weakref
+
+from parallax_tpu.analysis.sanitizer import make_lock
 
 # The content type Prometheus scrapers require for text exposition.
 EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -97,7 +98,7 @@ class _Child:
     __slots__ = ("_lock",)
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.registry_child")
 
 
 class CounterChild(_Child):
@@ -181,7 +182,7 @@ class _Family:
         self.bounds = bounds  # histogram bucket lattice (None otherwise)
         self._child_factory = child_factory
         self._children: dict[tuple, _Child] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.registry_family")
 
     def labels(self, **kv) -> _Child:
         key = _labels_key(self.labelnames, kv)
@@ -281,7 +282,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._families: dict[str, _Family] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.registry")
         # Weakly-held zero-arg callables run before every render/snapshot
         # to refresh pull-style series (gauges, adopted counters).
         self._collectors: list = []

@@ -37,6 +37,7 @@ import re
 import threading
 import time
 from collections import deque
+from parallax_tpu.analysis.sanitizer import make_lock
 
 # Spec keys -> registry metric names.
 _LATENCY_METRICS = {
@@ -185,7 +186,7 @@ class SLOTracker:
                  clock=time.monotonic):
         self.config = config
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.slo")
         horizon = max(config.windows) * 1.25 + 60.0
         self._horizon = horizon
         self._history: deque[tuple[float, dict]] = deque()

@@ -29,9 +29,10 @@ times under N node names — single-node-per-process deployments don't.)
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import deque
+
+from parallax_tpu.analysis.sanitizer import make_lock
 
 
 class ClusterTimeline:
@@ -45,7 +46,7 @@ class ClusterTimeline:
         # Synthesized sequences for locally-recorded events (the
         # scheduler's own decisions don't ride heartbeats).
         self._local_seq: dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.timeline")
         self.gaps = 0
         self.resets = 0
         self.ingested = 0
@@ -212,7 +213,7 @@ class LocalTimeline:
         self._flight = flight
         self._timeline = ClusterTimeline()
         self._cursor = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.timeline_local")
 
     def _pull(self) -> None:
         flight = self._flight

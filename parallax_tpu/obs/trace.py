@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import threading
 from collections import OrderedDict
+from parallax_tpu.analysis.sanitizer import make_lock
 
 # Adjacent same-name spans on the same stage closer than this merge into
 # one epoch span (decode steps arrive every few ms; a scheduling gap
@@ -39,7 +40,7 @@ class TraceStore:
         self.capacity = capacity
         self.max_spans = max_spans
         self._traces: OrderedDict[str, list[dict]] = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.trace")
 
     # -- recording ---------------------------------------------------------
 

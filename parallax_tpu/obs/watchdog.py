@@ -35,6 +35,7 @@ import threading
 import time
 
 from parallax_tpu.utils import get_logger
+from parallax_tpu.analysis.sanitizer import make_lock
 
 logger = get_logger(__name__)
 
@@ -66,7 +67,7 @@ class StallWatchdog:
         self.poll_interval_s = poll_interval_s
         self._clock = clock
         self._flight = flight
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.watchdog")
         # component -> probe() -> (pending: float, progress: float,
         # detail: str)
         self._probes: dict = {}

@@ -40,6 +40,7 @@ from parallax_tpu.runtime.request import (
 )
 from parallax_tpu.utils import get_logger
 from parallax_tpu.utils.hw import detect_hardware
+from parallax_tpu.analysis.sanitizer import make_lock
 
 logger = get_logger(__name__)
 
@@ -116,7 +117,7 @@ class WorkerNode:
         self._epoch = _uuid.uuid4().hex[:12]
         # Gossip registry (scheduler-less): node_id -> block announcement.
         self._peer_blocks: dict[str, dict] = {}
-        self._peer_lock = threading.Lock()
+        self._peer_lock = make_lock("node.peers")
         self._gossip_pool = None
         self.peer_ttl_s = max(10.0, 5 * heartbeat_interval_s)
         self._grammar_vocab: tuple | None = None
@@ -210,7 +211,7 @@ class WorkerNode:
         # an in-flight probe. (The hot-path fresh-hit read stays
         # lock-free — a single atomic get of an immutable tuple.)
         self._wire_dtypes: dict[str, tuple[str | None, float]] = {}
-        self._wire_lock = threading.Lock()
+        self._wire_lock = make_lock("node.wire_caps")
         # Per-peer forget counts (never reset — a reset would make an
         # in-flight probe's stale snapshot match again). Ints only,
         # grown per ever-invalidated peer; per-peer so churn on one
@@ -227,7 +228,7 @@ class WorkerNode:
         # paths take the lock (same contract as the sender's per-link
         # stats_lock).
         self._rx_stats: dict[str, dict] = {}
-        self._rx_lock = threading.Lock()
+        self._rx_lock = make_lock("node.rx_stats")
 
         transport.register(proto.FORWARD, self._on_forward)
         transport.register(proto.ABORT, self._on_abort)

@@ -36,6 +36,7 @@ from typing import Any, Callable
 
 from parallax_tpu.p2p.proto import decode_frame, encode_frame
 from parallax_tpu.utils import get_logger
+from parallax_tpu.analysis.sanitizer import make_lock
 
 logger = get_logger(__name__)
 
@@ -146,7 +147,7 @@ class TcpTransport(Transport):
         self._msg_id = 0
         self._started = threading.Event()
         self._stopped = False
-        self._stop_lock = threading.Lock()
+        self._stop_lock = make_lock("transport.stop")
         # Relay role: relay-registered worker id -> reverse-connection writer.
         self._relay_routes: dict[str, asyncio.StreamWriter] = {}
         # Writers of inbound connections, so stop() can close them and let
@@ -652,7 +653,7 @@ class AsyncSender:
         self.on_failure = on_failure
         self.idle_reap_s = idle_reap_s
         self._links: dict[str, "_PeerLink"] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("transport.sender")
         self._closed = False
 
     def send(
@@ -787,7 +788,7 @@ class _PeerLink:
         # atomic, so every stats mutation/snapshot takes this lock.
         # send() acquires it while holding the sender lock; the worker
         # takes it alone — one ordering, no deadlock.
-        self.stats_lock = threading.Lock()
+        self.stats_lock = make_lock("transport.link_stats")
         self.stats = {
             "frames_out": 0,
             "bytes_out": 0,

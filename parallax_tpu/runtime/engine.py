@@ -564,10 +564,12 @@ class StageEngine:
                 model.sp_mesh = sp_mesh
 
             def _sp_stage_fn(params, kv, inputs):
+                # parallax: allow[jit-purity] deliberate trace-time switch: flips the model into SP mode for THIS trace, restored in finally
                 self.model._sp_active = True
                 try:
                     return stage_fn(params, kv, inputs)
                 finally:
+                    # parallax: allow[jit-purity] trace-time restore of the SP switch set above
                     self.model._sp_active = False
 
             self._jit_sp_step = jax.jit(
@@ -1847,6 +1849,7 @@ class StageEngine:
             for seg in plan.seqs
         )
         if all_greedy:
+            # parallax: allow[hot-path-sync] speculative verify is a sync-forcing feature by contract — its ticket resolves synchronously
             verified = np.asarray(greedy_tokens(logits))    # [T_bucket]
         else:
             # Lockstep sampled verification: every fed position draws from
@@ -1864,6 +1867,7 @@ class StageEngine:
                 self._pack_lockstep_vectors(int(logits.shape[0]), entries)
             )
             key = jax.random.fold_in(self._base_key, self._step_count)
+            # parallax: allow[hot-path-sync] speculative verify is a sync-forcing feature by contract — its ticket resolves synchronously
             verified = np.asarray(sample_tokens(
                 logits, key, temp, top_k, top_p, min_p,
                 seeds=seeds, out_steps=steps,

@@ -15,6 +15,7 @@ from collections import OrderedDict
 
 from parallax_tpu.config import ModelConfig
 from parallax_tpu.utils.hw import HardwareInfo
+from parallax_tpu.analysis.sanitizer import make_lock
 
 # Capacity-model constants shared with every surface that estimates
 # "will it fit" (the web UI's ~min-chips column imports these): fraction
@@ -91,7 +92,7 @@ class CacheIndex:
         # The scheduler's event thread applies deltas while the dispatch
         # thread predicts — every entry access takes the lock.
         self._entries: OrderedDict[int, int] = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = make_lock("scheduling.cache_index")
         self.block = 0           # the worker's page size (digest granularity)
         self.seq = -1            # last applied heartbeat sequence number
         self.updated_at = 0.0    # monotonic time of the last apply

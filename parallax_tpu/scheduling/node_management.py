@@ -13,6 +13,7 @@ import enum
 import threading
 
 from parallax_tpu.scheduling.node import Node
+from parallax_tpu.analysis.sanitizer import make_lock
 
 
 class NodeState(enum.Enum):
@@ -67,7 +68,7 @@ class NodeManager:
 
     def __init__(self, num_layers: int):
         self.num_layers = num_layers
-        self._lock = threading.RLock()
+        self._lock = make_lock("scheduling.node_management", reentrant=True)
         self._nodes: dict[str, Node] = {}
         self._state: dict[str, NodeState] = {}
         self._pipelines: list[Pipeline] = []

@@ -32,6 +32,7 @@ from parallax_tpu.scheduling.request_routing import (
 )
 from parallax_tpu.utils import get_logger
 from parallax_tpu.utils.hw import HardwareInfo
+from parallax_tpu.analysis.sanitizer import make_lock
 
 logger = get_logger(__name__)
 
@@ -114,7 +115,7 @@ class GlobalScheduler:
         # node_id -> callback payload for the next heartbeat reply
         # (layer reallocations are piggybacked on heartbeats, reference
         # p2p/server.py announcer).
-        self._lock = threading.RLock()
+        self._lock = make_lock("scheduling.scheduler", reentrant=True)
         self.refit_version = 0
         self.refit_index: dict[str, str] = {}
         # Live migration: rid -> the head node now serving it (reported
@@ -296,7 +297,10 @@ class GlobalScheduler:
             if best is None:
                 continue
             self.router.on_dispatch(best.nodes)
-            self.migration_stats["targets_chosen"] += 1
+            # migrate_target RPCs land on the service thread while the
+            # sweep/heartbeat threads read these stats for /cluster/status.
+            with self._lock:
+                self.migration_stats["targets_chosen"] += 1
             out[rid] = {
                 "path": list(best.node_ids),
                 "head_layers": [
@@ -566,7 +570,7 @@ class GlobalScheduler:
                 # the heartbeat handler thread.
                 with self._lock:
                     head.pending_drain.add(node_id)
-                self.migration_stats["drains"] += 1
+                    self.migration_stats["drains"] += 1
         displaced = self.manager.remove(node_id)
         logger.info("node %s left; %d displaced", node_id, len(displaced))
         self.timeline.record(

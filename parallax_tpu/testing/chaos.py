@@ -33,6 +33,8 @@ from typing import Any, Callable
 
 from parallax_tpu.p2p.transport import Transport, TransportError
 from parallax_tpu.utils import get_logger
+from parallax_tpu.analysis import sanitizer
+from parallax_tpu.analysis.sanitizer import make_lock
 
 logger = get_logger(__name__)
 
@@ -76,9 +78,18 @@ class ChaosController:
         ...
         chaos.drop_frames(method="node_update", src="w0")   # break beats
         chaos.kill(worker)                                  # crash
+
+    Constructing a controller also turns on the lock-order sanitizer
+    (docs/static_analysis.md): every ``make_lock`` lock created after
+    this point is instrumented, so a chaos run doubles as a lockdep
+    pass — read the verdict with :meth:`lock_report`. Pass
+    ``lock_sanitizer=False`` when the surrounding process measures
+    performance (the bench churn probe does).
     """
 
-    def __init__(self, seed: int = 0):
+    def __init__(self, seed: int = 0, lock_sanitizer: bool = True):
+        if lock_sanitizer:
+            sanitizer.enable()
         self.rng = random.Random(seed)
         self.rules: list[ChaosRule] = []
         # Peers whose transports are severed (crashed) or paused
@@ -86,9 +97,16 @@ class ChaosController:
         self._dead: set[str] = set()
         self._hung: dict[str, float] = {}
         self._slow: dict[str, float] = {}
-        self._lock = threading.RLock()
+        self._lock = make_lock("testing.chaos", reentrant=True)
         self._wrapped: dict[str, Transport] = {}
         self.stats = {"dropped": 0, "delayed": 0, "severed_calls": 0}
+
+    @staticmethod
+    def lock_report() -> dict[str, Any]:
+        """The lock-order sanitizer's verdict for this process: lock
+        graph edges, cycles (potential deadlocks), and held-too-long
+        stalls observed since the last ``sanitizer.reset()``."""
+        return sanitizer.report()
 
     # -- frame faults -----------------------------------------------------
 
@@ -223,10 +241,12 @@ class ChaosController:
         if rule is None:
             return
         if rule.action == "drop":
-            self.stats["dropped"] += 1
+            with self._lock:
+                self.stats["dropped"] += 1
             raise _ChaosDropped(
                 f"chaos: dropped {method} {src}->{dst}"
             )
         if rule.action == "delay":
-            self.stats["delayed"] += 1
+            with self._lock:
+                self.stats["delayed"] += 1
             time.sleep(min(rule.delay_s, timeout))

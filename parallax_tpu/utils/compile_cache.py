@@ -21,6 +21,7 @@ import os
 import threading
 
 from parallax_tpu.utils import get_logger
+from parallax_tpu.analysis.sanitizer import make_lock
 
 logger = get_logger(__name__)
 
@@ -32,7 +33,7 @@ _DEFAULT_DIR = os.path.join(
 # the expensive storm signal).
 _COMPILE_EVENT = "backend_compile"
 
-_lock = threading.Lock()
+_lock = make_lock("utils.compile_cache")
 _active_path: str | None = None
 _counter_registered = False
 

@@ -23,6 +23,60 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402  (import after env setup)
 import pytest  # noqa: E402
 
+from parallax_tpu.analysis import sanitizer  # noqa: E402
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--lock-sanitizer", action="store_true", default=False,
+        help="enable the lock-order sanitizer for the whole session: "
+             "every parallax_tpu make_lock() lock created after startup "
+             "is instrumented and lock-graph cycles are reported at the "
+             "end of the run (docs/static_analysis.md). Equivalent to "
+             "PARALLAX_LOCK_SANITIZER=1.",
+    )
+
+
+def pytest_configure(config):
+    # Enable BEFORE any test module constructs engines/nodes so their
+    # locks are created instrumented (enable() only affects locks made
+    # after it). The chaos harness also enables it per-controller.
+    if config.getoption("--lock-sanitizer"):
+        sanitizer.enable()
+
+
+@pytest.fixture(autouse=True)
+def _scoped_lock_sanitizer(request):
+    """Contain ChaosController's process-global sanitizer enable: when
+    the session did not opt in with --lock-sanitizer, switch it back
+    off after each test so unrelated tests keep creating plain
+    (uninstrumented) locks."""
+    yield
+    if not request.config.getoption("--lock-sanitizer"):
+        sanitizer.disable()
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    san = sanitizer.get_sanitizer()
+    rep = san.report()
+    # Print when the user opted in — or unconditionally when a cycle
+    # (potential deadlock) was observed: that must never scroll away.
+    if san.acquisitions == 0 or not (
+        config.getoption("--lock-sanitizer") or rep["cycles"]
+    ):
+        return
+    terminalreporter.section("lock-order sanitizer")
+    terminalreporter.write_line(
+        f"{rep['acquisitions']} acquisitions over "
+        f"{len(rep['locks'])} lock name(s), "
+        f"{len(rep['edges'])} order edge(s), "
+        f"{len(rep['cycles'])} cycle(s), "
+        f"{len(rep['long_holds'])} held-too-long report(s)"
+    )
+    for cyc in rep["cycles"]:
+        terminalreporter.write_line(
+            "POTENTIAL DEADLOCK: " + " -> ".join(cyc), red=True)
+
 # Jit-heavy / e2e suites (each >1 min on CPU). The fast core —
 # scheduling, cache bookkeeping, transport, interop, constrained,
 # periphery — gives signal in well under a minute with

@@ -14,6 +14,7 @@ the overlapped loop and K>1 multi-step windows, greedy and seeded.
 """
 
 import dataclasses
+import os
 import threading
 import time
 
@@ -795,8 +796,17 @@ def test_node_kill_mid_decode_migrates_bit_identically(
     the head parks them as checkpoints, the scheduler routes them to the
     surviving pipeline, the target resumes via re-prefill, and every
     stream finishes bit-identical to the unchurned baseline — zero
-    aborts, pollers follow the {"migrated": ...} redirect."""
-    chaos = ChaosController(seed=11)
+    aborts, pollers follow the {"migrated": ...} redirect.
+
+    The whole episode runs under the lock-order sanitizer
+    (docs/static_analysis.md): constructing the ChaosController enables
+    it, so every make_lock() lock the swarm creates below is
+    instrumented, and the teardown asserts the kill-migration produced
+    zero lock-graph cycles."""
+    from parallax_tpu.analysis import sanitizer
+
+    chaos = ChaosController(seed=11)          # enables the sanitizer
+    sanitizer.reset()                         # this test's window only
     sched, service, client, workers = _churn_swarm(
         monkeypatch, chaos, decode_lookahead, overlap,
     )
@@ -864,6 +874,24 @@ def test_node_kill_mid_decode_migrates_bit_identically(
         # in a SURVIVING head's radix exactly as an unchurned serve
         # would have donated them.
         _assert_digests_present(workers, dead_tail, churn)
+
+        # Concurrency hygiene of the episode itself. Dynamic: the lock
+        # graph built while heartbeat/sender/step/migration threads ran
+        # the kill-migration must be acyclic (a cycle = a latent
+        # deadlock even if this run never hit it) — and the sanitizer
+        # must actually have been watching. Static: the modules those
+        # threads share must carry zero unsuppressed cross-thread
+        # unguarded-mutation (lock-discipline) findings.
+        rep = chaos.lock_report()
+        assert rep["acquisitions"] > 0, (
+            "lock sanitizer saw no acquisitions — instrumentation "
+            "never engaged"
+        )
+        assert rep["cycles"] == [], (
+            "lock-order cycles during kill-migration (potential "
+            f"deadlock): {rep['cycles']}\nedges: {sorted(rep['edges'])}"
+        )
+        _assert_no_unguarded_mutations()
     finally:
         for w in workers:
             if not chaos.is_dead(w.node_id):
@@ -880,6 +908,31 @@ def _migrations_total() -> int:
         "or client resume",
         labelnames=("mode",),
     ).total)
+
+
+def _assert_no_unguarded_mutations():
+    """Zero cross-thread unguarded mutations, the static half: the
+    lock-discipline checker over every module the migration's threads
+    (step loop, heartbeat, sender, watchdog, migration worker) share."""
+    import parallax_tpu
+    from parallax_tpu.analysis.linter import LintEngine
+
+    pkg = os.path.dirname(parallax_tpu.__file__)
+    # Full checker set: a lock-discipline-only engine would misreport
+    # these files' jit-purity/hot-path-sync suppressions as unused.
+    engine = LintEngine()
+    result = engine.run_paths([
+        os.path.join(pkg, "runtime", "engine.py"),
+        os.path.join(pkg, "p2p", "node.py"),
+        os.path.join(pkg, "p2p", "transport.py"),
+        os.path.join(pkg, "scheduling", "scheduler.py"),
+        os.path.join(pkg, "testing", "chaos.py"),
+        os.path.join(pkg, "obs"),
+    ])
+    unguarded = [f for f in result.findings
+                 if f.checker == "lock-discipline"]
+    assert unguarded == [], "\n".join(f.render() for f in unguarded)
+    assert result.ok, "\n".join(f.render() for f in result.findings)
 
 
 def _all_migrations(workers):

@@ -1,0 +1,1062 @@
+"""The analysis suite's own tests: golden fixture snippets per checker
+(positive + suppressed + negative), suppression hygiene, the baseline
+ratchet, the CLI, the lock-order sanitizer (cycle detection, long-hold
+reporting, reentrancy, make_lock dispatch), and the meta-test that the
+committed baseline matches a fresh run over the package.
+
+Fixture sources are linted via ``LintEngine.lint_text`` with a filename
+chosen to trigger (or not trigger) path-scoped checkers — no files are
+written and no parallax_tpu runtime code is imported by the linter.
+"""
+
+import json
+import os
+import textwrap
+import threading
+import time
+
+import pytest
+
+import parallax_tpu
+from parallax_tpu.analysis import sanitizer
+from parallax_tpu.analysis.checkers import all_checkers
+from parallax_tpu.analysis.checkers.config_gates import ConfigGateChecker
+from parallax_tpu.analysis.checkers.donation import DonationChecker
+from parallax_tpu.analysis.checkers.hot_path_sync import HotPathSyncChecker
+from parallax_tpu.analysis.checkers.jit_purity import JitPurityChecker
+from parallax_tpu.analysis.checkers.lock_discipline import (
+    LockDisciplineChecker,
+)
+from parallax_tpu.analysis.cli import main as cli_main
+from parallax_tpu.analysis.linter import (
+    LintEngine,
+    default_baseline_path,
+    default_package_root,
+    load_baseline,
+)
+from parallax_tpu.analysis.sanitizer import (
+    LockOrderSanitizer,
+    SanitizedLock,
+    make_lock,
+)
+
+PKG = os.path.dirname(parallax_tpu.__file__)
+
+
+def lint(source, checker, filename="pkg/mod.py"):
+    """(active, suppressed) findings of one checker over a snippet."""
+    engine = LintEngine(checkers=[checker])
+    return engine.lint_text(textwrap.dedent(source), filename)
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+
+
+class TestLockDiscipline:
+    POSITIVE = """
+        import threading
+
+        class Stats:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                self.items = []
+
+            def hot(self):
+                with self._lock:
+                    self.count += 1
+                    self.items.append(1)
+
+            def racy(self):
+                self.count += 1
+
+            def racy_call(self):
+                self.items.append(2)
+    """
+
+    def test_positive_unguarded_writes(self):
+        active, _ = lint(self.POSITIVE, LockDisciplineChecker())
+        msgs = [f.message for f in active]
+        assert len(active) == 2, msgs
+        assert any("racy" in m and "self.count" in m for m in msgs), msgs
+        assert any("racy_call" in m and "self.items" in m
+                   for m in msgs), msgs
+        assert all("self._lock" in m for m in msgs), msgs
+
+    def test_suppressed(self):
+        src = self.POSITIVE.replace(
+            "self.count += 1\n\n            def racy_call",
+            "self.count += 1  # parallax: allow[lock-discipline] "
+            "monotonic stat, torn reads acceptable\n\n"
+            "            def racy_call",
+        )
+        active, suppressed = lint(src, LockDisciplineChecker())
+        assert len(active) == 1, [f.message for f in active]
+        assert len(suppressed) == 1
+        assert "torn reads acceptable" in suppressed[0][1].reason
+
+    def test_negative_all_guarded(self):
+        active, _ = lint(
+            """
+            import threading
+
+            class Stats:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+
+                def a(self):
+                    with self._lock:
+                        self.count += 1
+
+                def b(self):
+                    with self._lock:
+                        self.count = 0
+            """,
+            LockDisciplineChecker(),
+        )
+        assert active == []
+
+    def test_negative_never_locked_attr_out_of_scope(self):
+        # One-sided evidence: an attribute never written under the lock
+        # is not flagged (no intent to guard it was ever expressed).
+        active, _ = lint(
+            """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.free = 0
+
+                def a(self):
+                    self.free += 1
+
+                def b(self):
+                    self.free = 2
+            """,
+            LockDisciplineChecker(),
+        )
+        assert active == []
+
+    def test_init_exempt(self):
+        active, _ = lint(
+            """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.n += 1
+            """,
+            LockDisciplineChecker(),
+        )
+        assert active == []
+
+    def test_locked_helper_propagation(self):
+        # _bump mutates unguarded, but its every internal call site
+        # holds the lock -> treated as guarded (one propagation level).
+        active, _ = lint(
+            """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def _bump(self):
+                    self.n += 1
+
+                def a(self):
+                    with self._lock:
+                        self._bump()
+
+                def b(self):
+                    with self._lock:
+                        self.n = 0
+                        self._bump()
+            """,
+            LockDisciplineChecker(),
+        )
+        assert active == []
+
+    def test_closure_resets_held_set(self):
+        # The with-guard lexically encloses the def, but the closure
+        # body runs later on another thread -> flagged.
+        active, _ = lint(
+            """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def guarded(self):
+                    with self._lock:
+                        self.n += 1
+
+                def spawn(self):
+                    with self._lock:
+                        def worker():
+                            self.n += 1
+                        return worker
+            """,
+            LockDisciplineChecker(),
+        )
+        assert len(active) == 1, [f.message for f in active]
+        assert "self.n" in active[0].message
+
+    def test_make_lock_counts_as_lock_factory(self):
+        active, _ = lint(
+            """
+            from parallax_tpu.analysis.sanitizer import make_lock
+
+            class C:
+                def __init__(self):
+                    self._lock = make_lock("c")
+                    self.n = 0
+
+                def a(self):
+                    with self._lock:
+                        self.n += 1
+
+                def b(self):
+                    self.n = 5
+            """,
+            LockDisciplineChecker(),
+        )
+        assert len(active) == 1
+
+
+# ---------------------------------------------------------------------------
+# hot-path-sync
+
+
+class TestHotPathSync:
+    def test_positive_transitive_reach(self):
+        active, _ = lint(
+            """
+            import numpy as np
+
+            class Engine:
+                def dispatch(self, batch):
+                    rows = self._pack(batch)
+                    return rows
+
+                def _pack(self, batch):
+                    return np.asarray(batch.tokens)
+            """,
+            HotPathSyncChecker(),
+            filename="parallax_tpu/runtime/engine.py",
+        )
+        assert len(active) == 1, [f.message for f in active]
+        assert "numpy.asarray" in active[0].message
+        assert "dispatch" in active[0].message
+
+    def test_positive_item_call(self):
+        active, _ = lint(
+            """
+            class Engine:
+                def dispatch(self, tok):
+                    return int(tok.item())
+            """,
+            HotPathSyncChecker(),
+            filename="parallax_tpu/runtime/engine.py",
+        )
+        assert len(active) == 1
+        assert ".item()" in active[0].message
+
+    def test_suppressed(self):
+        active, suppressed = lint(
+            """
+            import numpy as np
+
+            class Engine:
+                def dispatch(self, batch):
+                    return np.asarray(batch.host_rows)  # parallax: allow[hot-path-sync] host list, never a device array
+            """,
+            HotPathSyncChecker(),
+            filename="parallax_tpu/runtime/engine.py",
+        )
+        assert active == []
+        assert len(suppressed) == 1
+
+    def test_negative_resolve_is_the_sync_point(self):
+        active, _ = lint(
+            """
+            import numpy as np
+
+            class Engine:
+                def dispatch(self, batch):
+                    self.resolve()
+
+                def resolve(self):
+                    return np.asarray(self.pending)
+            """,
+            HotPathSyncChecker(),
+            filename="parallax_tpu/runtime/engine.py",
+        )
+        assert active == []
+
+    def test_negative_unreachable_helper(self):
+        active, _ = lint(
+            """
+            import numpy as np
+
+            class Engine:
+                def dispatch(self, batch):
+                    return batch
+
+                def debug_dump(self):
+                    return np.asarray(self.kv)
+            """,
+            HotPathSyncChecker(),
+            filename="parallax_tpu/runtime/engine.py",
+        )
+        assert active == []
+
+    def test_negative_other_files_out_of_scope(self):
+        active, _ = lint(
+            """
+            import numpy as np
+
+            class Engine:
+                def dispatch(self, batch):
+                    return np.asarray(batch)
+            """,
+            HotPathSyncChecker(),
+            filename="parallax_tpu/obs/metrics.py",
+        )
+        assert active == []
+
+    def test_transport_send_root(self):
+        active, _ = lint(
+            """
+            class AsyncSender:
+                def send(self, frame):
+                    return frame.payload.block_until_ready()
+            """,
+            HotPathSyncChecker(),
+            filename="parallax_tpu/p2p/transport.py",
+        )
+        assert len(active) == 1
+        assert "block_until_ready" in active[0].message
+
+
+# ---------------------------------------------------------------------------
+# donation-reuse
+
+
+class TestDonationReuse:
+    def test_positive_attr_read_after_donate(self):
+        active, _ = lint(
+            """
+            import jax
+
+            class Eng:
+                def setup(self, fn):
+                    self._step = jax.jit(fn, donate_argnums=(1,))
+
+                def run(self, params):
+                    out = self._step(params, self.kv)
+                    leak = self.kv
+                    return out, leak
+            """,
+            DonationChecker(),
+        )
+        assert len(active) == 1, [f.message for f in active]
+        assert "self.kv" in active[0].message
+        assert "donate_argnums" in active[0].message
+
+    def test_negative_rebind_from_result(self):
+        active, _ = lint(
+            """
+            import jax
+
+            class Eng:
+                def setup(self, fn):
+                    self._step = jax.jit(fn, donate_argnums=(1,))
+
+                def run(self, params):
+                    self.kv = self._step(params, self.kv)
+                    return self.kv
+            """,
+            DonationChecker(),
+        )
+        assert active == []
+
+    def test_suppressed(self):
+        active, suppressed = lint(
+            """
+            import jax
+
+            class Eng:
+                def setup(self, fn):
+                    self._step = jax.jit(fn, donate_argnums=(1,))
+
+                def run(self, params):
+                    out = self._step(params, self.kv)
+                    shape = self.kv  # parallax: allow[donation-reuse] reads .shape metadata only, buffer untouched
+                    return out, shape
+            """,
+            DonationChecker(),
+        )
+        assert active == []
+        assert len(suppressed) == 1
+
+    def test_decorated_partial_form(self):
+        active, _ = lint(
+            """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step(kv, x):
+                return kv + x
+
+            def drive(kv, xs):
+                out = step(kv, xs)
+                return out + kv.sum()
+            """,
+            DonationChecker(),
+        )
+        assert len(active) == 1
+        assert "kv" in active[0].message
+
+    def test_conditional_donation_tuple(self):
+        # (1,) if cond else () resolves to the union of the arms.
+        active, _ = lint(
+            """
+            import jax
+
+            class Eng:
+                def setup(self, fn, on_tpu):
+                    self._step = jax.jit(
+                        fn, donate_argnums=(1,) if on_tpu else ())
+
+                def run(self, params):
+                    out = self._step(params, self.kv)
+                    return out, self.kv
+            """,
+            DonationChecker(),
+        )
+        assert len(active) == 1
+
+    def test_negative_no_donation(self):
+        active, _ = lint(
+            """
+            import jax
+
+            class Eng:
+                def setup(self, fn):
+                    self._step = jax.jit(fn)
+
+                def run(self, params):
+                    out = self._step(params, self.kv)
+                    return out, self.kv
+            """,
+            DonationChecker(),
+        )
+        assert active == []
+
+
+# ---------------------------------------------------------------------------
+# jit-purity
+
+
+class TestJitPurity:
+    def test_positive_impure_call(self):
+        active, _ = lint(
+            """
+            import time
+            import jax
+
+            def build():
+                def step(x):
+                    return x + time.time()
+                return jax.jit(step)
+            """,
+            JitPurityChecker(),
+        )
+        assert len(active) == 1, [f.message for f in active]
+        assert "time.time" in active[0].message
+        assert "trace time" in active[0].message
+
+    def test_positive_closure_rebind(self):
+        active, _ = lint(
+            """
+            import jax
+
+            def build():
+                scale = 1.0
+
+                def step(x):
+                    return x * scale
+
+                f = jax.jit(step)
+                scale = 2.0
+                return f
+            """,
+            JitPurityChecker(),
+        )
+        assert len(active) == 1
+        assert "scale" in active[0].message
+        assert "rebound after the def" in active[0].message
+
+    def test_positive_attribute_store(self):
+        active, _ = lint(
+            """
+            import jax
+
+            class Model:
+                pass
+
+            model = Model()
+
+            def step(x):
+                model.flag = True
+                return x
+
+            g = jax.jit(step)
+            """,
+            JitPurityChecker(),
+        )
+        assert len(active) == 1
+        assert "model.flag" in active[0].message
+
+    def test_suppressed_trace_time_switch(self):
+        active, suppressed = lint(
+            """
+            import jax
+
+            class Model:
+                pass
+
+            model = Model()
+
+            def step(x):
+                # parallax: allow[jit-purity] deliberate trace-time switch
+                model.flag = True
+                return x
+
+            g = jax.jit(step)
+            """,
+            JitPurityChecker(),
+        )
+        assert active == []
+        assert len(suppressed) == 1
+
+    def test_negative_impure_outside_trace(self):
+        active, _ = lint(
+            """
+            import time
+            import jax
+
+            def step(x):
+                return x + 1
+
+            def drive():
+                t0 = time.time()
+                return jax.jit(step), t0
+            """,
+            JitPurityChecker(),
+        )
+        assert active == []
+
+    def test_lax_scan_body_checked(self):
+        active, _ = lint(
+            """
+            import random
+            import jax
+            from jax import lax
+
+            def run(xs):
+                def body(carry, x):
+                    return carry + random.random(), x
+                return lax.scan(body, 0.0, xs)
+            """,
+            JitPurityChecker(),
+        )
+        assert len(active) == 1
+        assert "random.random" in active[0].message
+
+
+# ---------------------------------------------------------------------------
+# config-gate
+
+
+class TestConfigGate:
+    def test_positive_unregistered_gate(self):
+        active, _ = lint(
+            """
+            import logging
+
+            logger = logging.getLogger(__name__)
+
+            def f():
+                logger.warning("frobnication disabled: no quantum flux")
+            """,
+            ConfigGateChecker(),
+        )
+        assert len(active) == 1, [f.message for f in active]
+        assert "GATE_TABLE" in active[0].message
+
+    def test_negative_registered_marker(self):
+        active, _ = lint(
+            """
+            import logging
+
+            logger = logging.getLogger(__name__)
+
+            def f(reason):
+                logger.warning("SP prefill is disabled for %s", reason)
+            """,
+            ConfigGateChecker(),
+        )
+        assert active == []
+
+    def test_negative_non_gate_message(self):
+        active, _ = lint(
+            """
+            import logging
+
+            logger = logging.getLogger(__name__)
+
+            def f():
+                logger.info("node joined the swarm")
+            """,
+            ConfigGateChecker(),
+        )
+        assert active == []
+
+    def test_suppressed(self):
+        active, suppressed = lint(
+            """
+            import logging
+
+            logger = logging.getLogger(__name__)
+
+            def f():
+                logger.warning("debug overlay disabled: dev build")  # parallax: allow[config-gate] dev-only overlay, not an operator feature
+            """,
+            ConfigGateChecker(),
+        )
+        assert active == []
+        assert len(suppressed) == 1
+
+    def test_table_drift_detected(self, monkeypatch):
+        """A gate entry whose field, doc, and marker all drifted yields
+        one finding per drift when gates.py itself is linted."""
+        from parallax_tpu.analysis import gates
+
+        monkeypatch.setattr(gates, "GATE_TABLE", (
+            gates.Gate(feature="no_such_config_field",
+                       marker="definitely not a live marker zzz",
+                       doc="docs/no_such_doc.md",
+                       reason="test"),
+        ))
+        engine = LintEngine(checkers=[ConfigGateChecker()])
+        result = engine.run_paths(
+            [os.path.join(PKG, "analysis", "gates.py")])
+        msgs = [f.message for f in result.findings]
+        assert any("not an EngineConfig field" in m for m in msgs), msgs
+        assert any("missing doc" in m for m in msgs), msgs
+        assert any("matches no log call" in m for m in msgs), msgs
+
+
+# ---------------------------------------------------------------------------
+# suppression hygiene
+
+
+class TestSuppressionHygiene:
+    def test_missing_reason_is_a_finding(self):
+        active, _ = lint(
+            """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def a(self):
+                    with self._lock:
+                        self.n += 1
+
+                def b(self):
+                    self.n += 1  # parallax: allow[lock-discipline]
+            """,
+            LockDisciplineChecker(),
+        )
+        assert len(active) == 1
+        assert active[0].checker == "suppression"
+        assert "has no reason" in active[0].message
+
+    def test_unused_suppression_is_a_finding(self):
+        active, _ = lint(
+            """
+            def clean():
+                return 1  # parallax: allow[lock-discipline] nothing wrong here
+            """,
+            LockDisciplineChecker(),
+        )
+        assert len(active) == 1
+        assert active[0].checker == "suppression"
+        assert "unused suppression" in active[0].message
+
+    def test_comment_line_governs_next_statement(self):
+        active, suppressed = lint(
+            """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def a(self):
+                    with self._lock:
+                        self.n += 1
+
+                def b(self):
+                    # parallax: allow[lock-discipline] monotonic counter
+                    self.n += 1
+            """,
+            LockDisciplineChecker(),
+        )
+        assert active == []
+        assert len(suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# baseline ratchet + CLI
+
+
+BAD_SNIPPET = textwrap.dedent("""
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+
+        def a(self):
+            with self._lock:
+                self.n += 1
+
+        def b(self):
+            self.n += 1
+""")
+
+
+class TestBaselineAndCli:
+    def test_baseline_masks_known_findings(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(BAD_SNIPPET)
+        engine = LintEngine(checkers=[LockDisciplineChecker()],
+                            repo_root=str(tmp_path))
+        fresh = engine.run_paths([str(bad)])
+        assert len(fresh.findings) == 1
+        fp = fresh.findings[0].fingerprint
+
+        with_baseline = engine.run_paths([str(bad)], baseline={fp})
+        assert with_baseline.ok
+        assert [f.fingerprint for f in with_baseline.baselined] == [fp]
+        assert with_baseline.stale_baseline == []
+
+    def test_stale_baseline_fails_strict_only(self, tmp_path):
+        good = tmp_path / "good.py"
+        good.write_text("x = 1\n")
+        engine = LintEngine(checkers=[LockDisciplineChecker()],
+                            repo_root=str(tmp_path))
+        result = engine.run_paths([str(good)],
+                                  baseline={"lock-discipline:gone:abc"})
+        assert result.ok
+        assert not result.strict_ok()
+        assert result.stale_baseline == ["lock-discipline:gone:abc"]
+
+    def test_fingerprint_stable_across_line_moves(self, tmp_path):
+        a = tmp_path / "a.py"
+        a.write_text(BAD_SNIPPET)
+        engine = LintEngine(checkers=[LockDisciplineChecker()],
+                            repo_root=str(tmp_path))
+        fp1 = engine.run_paths([str(a)]).findings[0].fingerprint
+        a.write_text("# a leading comment shifts every line\n"
+                     + BAD_SNIPPET)
+        fp2 = engine.run_paths([str(a)]).findings[0].fingerprint
+        assert fp1 == fp2
+
+    def test_duplicate_findings_get_distinct_fingerprints(self, tmp_path):
+        """Two identical-message violations must not share a
+        fingerprint — else baselining one silently masks adding the
+        other (hole in the ratchet)."""
+        dup = tmp_path / "dup.py"
+        dup.write_text(textwrap.dedent("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def a(self):
+                    with self._lock:
+                        self.n += 1
+
+                def b(self):
+                    self.n += 1
+                    self.n += 1
+        """))
+        engine = LintEngine(checkers=[LockDisciplineChecker()],
+                            repo_root=str(tmp_path))
+        fresh = engine.run_paths([str(dup)])
+        assert len(fresh.findings) == 2
+        fps = [f.fingerprint for f in fresh.findings]
+        assert len(set(fps)) == 2, fps
+        # Baselining the first occurrence still fails on the second.
+        result = engine.run_paths([str(dup)], baseline={fps[0]})
+        assert len(result.findings) == 1
+        assert result.findings[0].fingerprint == fps[1]
+
+    def test_cli_end_to_end_ratchet(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(BAD_SNIPPET)
+        baseline = tmp_path / "baseline.json"
+
+        assert cli_main([str(bad), "--baseline", str(baseline)]) == 1
+        # Shrink-only ratchet: baselining a NEW finding is refused
+        # unless the loosening is explicit.
+        assert cli_main([str(bad), "--baseline", str(baseline),
+                         "--write-baseline"]) == 1
+        assert not baseline.exists()
+        assert cli_main([str(bad), "--baseline", str(baseline),
+                         "--write-baseline", "--grow-baseline"]) == 0
+        assert cli_main([str(bad), "--baseline", str(baseline)]) == 0
+        # Fixing the finding leaves a stale entry: plain run still 0,
+        # --strict demands the baseline shrink.
+        bad.write_text("x = 1\n")
+        assert cli_main([str(bad), "--baseline", str(baseline)]) == 0
+        assert cli_main([str(bad), "--baseline", str(baseline),
+                         "--strict"]) == 1
+        # Shrinking needs no flag: regenerate and strict is green again.
+        assert cli_main([str(bad), "--baseline", str(baseline),
+                         "--write-baseline"]) == 0
+        assert cli_main([str(bad), "--baseline", str(baseline),
+                         "--strict"]) == 0
+        capsys.readouterr()
+
+    def test_cli_json_output(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(BAD_SNIPPET)
+        baseline = tmp_path / "baseline.json"
+        rc = cli_main([str(bad), "--baseline", str(baseline), "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert out["files"] == 1
+        assert len(out["findings"]) == 1
+        assert out["findings"][0]["checker"] == "lock-discipline"
+
+    def test_cli_list_checkers(self, capsys):
+        assert cli_main(["--list-checkers"]) == 0
+        out = capsys.readouterr().out
+        for cid in ("lock-discipline", "hot-path-sync", "donation-reuse",
+                    "jit-purity", "config-gate"):
+            assert cid in out
+
+
+# ---------------------------------------------------------------------------
+# meta: the committed pass over the real package is clean
+
+
+class TestCommittedPass:
+    def test_package_lints_clean_against_committed_baseline(self):
+        """`python -m parallax_tpu.analysis --strict` stays green: zero
+        findings outside the committed baseline AND zero stale entries —
+        a fresh run exactly matches the checked-in state."""
+        engine = LintEngine()
+        result = engine.run_paths(
+            [default_package_root()],
+            baseline=load_baseline(default_baseline_path()),
+        )
+        assert result.files > 50   # the walk really covered the package
+        assert result.findings == [], "\n".join(
+            f.render() for f in result.findings)
+        assert result.stale_baseline == []
+
+    def test_checker_catalog_is_documented(self):
+        doc = os.path.join(os.path.dirname(PKG), "docs",
+                           "static_analysis.md")
+        text = open(doc, encoding="utf-8").read()
+        for checker in all_checkers():
+            assert checker.id in text, (
+                f"docs/static_analysis.md misses checker {checker.id}")
+
+
+# ---------------------------------------------------------------------------
+# lock-order sanitizer
+
+
+@pytest.fixture
+def isolated_global_sanitizer():
+    """Snapshot + restore the process-global sanitizer around tests
+    that flip its enabled flag."""
+    san = sanitizer.get_sanitizer()
+    was_enabled = san.enabled
+    yield san
+    san.enabled = was_enabled
+    sanitizer.reset()
+
+
+class TestLockSanitizer:
+    def test_inversion_builds_a_cycle(self):
+        san = LockOrderSanitizer()
+        a = SanitizedLock("A", san)
+        b = SanitizedLock("B", san)
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        cycles = san.cycles()
+        assert len(cycles) == 1
+        assert set(cycles[0]) == {"A", "B"}
+        # The first-observation stack is kept for the report.
+        rep = san.report()
+        assert rep["edges"]["A -> B"]["stack"]
+        assert rep["edges"]["A -> B"]["count"] == 1
+
+    def test_consistent_order_is_clean(self):
+        san = LockOrderSanitizer()
+        a = SanitizedLock("A", san)
+        b = SanitizedLock("B", san)
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert san.cycles() == []
+        assert san.report()["edges"]["A -> B"]["count"] == 3
+
+    def test_three_lock_cycle(self):
+        san = LockOrderSanitizer()
+        a, b, c = (SanitizedLock(n, san) for n in "ABC")
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with c:
+            with a:
+                pass
+        cycles = san.cycles()
+        assert len(cycles) == 1
+        assert set(cycles[0]) == {"A", "B", "C"}
+
+    def test_cross_thread_inversion_detected(self):
+        """The canonical deadlock setup — two threads taking the same
+        pair in opposite orders — is reported even though this run never
+        actually deadlocks (the threads run one after the other)."""
+        san = LockOrderSanitizer()
+        a = SanitizedLock("A", san)
+        b = SanitizedLock("B", san)
+
+        def order_ab():
+            with a:
+                with b:
+                    pass
+
+        def order_ba():
+            with b:
+                with a:
+                    pass
+
+        for fn in (order_ab, order_ba):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+        assert len(san.cycles()) == 1
+
+    def test_same_name_nesting_is_not_a_cycle(self):
+        # Two per-peer locks share one graph node; nesting them is
+        # recorded separately, not reported as a self-deadlock.
+        san = LockOrderSanitizer()
+        l1 = SanitizedLock("peer", san)
+        l2 = SanitizedLock("peer", san)
+        with l1:
+            with l2:
+                pass
+        assert san.cycles() == []
+        assert len(san.report()["nested_same_name"]) == 1
+
+    def test_held_too_long_reported(self):
+        san = LockOrderSanitizer(held_too_long_ms=1.0)
+        lock = SanitizedLock("slowpoke", san)
+        with lock:
+            time.sleep(0.01)
+        holds = san.report()["long_holds"]
+        assert len(holds) == 1
+        assert holds[0]["name"] == "slowpoke"
+        assert holds[0]["held_ms"] >= 1.0
+
+    def test_reentrant_depth_records_once(self):
+        san = LockOrderSanitizer()
+        r = SanitizedLock("R", san, reentrant=True)
+        with r:
+            with r:
+                pass
+        assert san.acquisitions == 1
+        assert san.report()["nested_same_name"] == []
+
+    def test_acquire_release_protocol(self):
+        san = LockOrderSanitizer()
+        lock = SanitizedLock("L", san)
+        assert lock.acquire() is True
+        assert lock.locked()
+        assert lock.acquire(blocking=False) is False
+        lock.release()
+        assert not lock.locked()
+
+    def test_make_lock_dispatch(self, isolated_global_sanitizer):
+        san = isolated_global_sanitizer
+        san.enabled = False
+        plain = make_lock("x")
+        assert not isinstance(plain, SanitizedLock)
+        san.enabled = True
+        inst = make_lock("x")
+        assert isinstance(inst, SanitizedLock)
+        rlock = make_lock("y", reentrant=True)
+        assert isinstance(rlock, SanitizedLock) and rlock._reentrant
+
+    def test_reset_clears_state(self):
+        san = LockOrderSanitizer()
+        a = SanitizedLock("A", san)
+        b = SanitizedLock("B", san)
+        with a:
+            with b:
+                pass
+        san.reset()
+        rep = san.report()
+        assert rep["edges"] == {} and rep["acquisitions"] == 0
+
+    def test_chaos_controller_enables_and_reports(
+            self, isolated_global_sanitizer):
+        from parallax_tpu.testing.chaos import ChaosController
+
+        san = isolated_global_sanitizer
+        san.enabled = False
+        sanitizer.reset()
+        chaos = ChaosController(seed=1)
+        assert sanitizer.is_enabled()
+        lock = make_lock("chaos.test")
+        assert isinstance(lock, SanitizedLock)
+        with lock:
+            pass
+        assert chaos.lock_report()["acquisitions"] >= 1
