@@ -1067,6 +1067,310 @@ def _churn_probe(cfg, stage_params_fn, kv_dtype, page_size):
         service.stop()
 
 
+def _disagg_probe(cfg, stage_params_fn, kv_dtype, page_size):
+    """Disaggregated prefill/decode probe (docs/disaggregation.md): two
+    single-stage full-model replicas behind a cache-aware scheduler
+    serve the SAME long-prefill + chatty-decode + interactive workload
+    twice — once as a mixed pool (both replicas serve both phases,
+    round-robin interference) and once disaggregated (a prefill
+    specialist handing finished prompts to a decode specialist over the
+    layer-chunked KV-transfer lane). Reports interactive TTFT p50/p95
+    and chatty TPOT per mode, kv_transfer telemetry (frames/bytes/ms +
+    fallbacks + handoffs by mode), and the bit-identity verdict across
+    modes (the CI disaggregation smoke asserts the contract)."""
+    import dataclasses as _dc
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from parallax_tpu.backend.run import SwarmClient
+    from parallax_tpu.backend.scheduler_service import SchedulerService
+    from parallax_tpu.obs.registry import get_registry, summarize_snapshots
+    from parallax_tpu.p2p.node import WorkerNode
+    from parallax_tpu.p2p.transport import LoopbackTransport
+    from parallax_tpu.runtime.engine import EngineConfig
+    from parallax_tpu.runtime.request import Request, SamplingParams
+    from parallax_tpu.scheduling.scheduler import GlobalScheduler
+
+    n_chatty, n_long, n_inter = 3, 2, 6
+    chatty_gen, long_gen, inter_gen = 64, 4, 8
+    chatty_pages, long_pages, inter_pages = 1, 16, 2
+    max_model_len = (long_pages + 2) * page_size + chatty_gen
+    rng = np.random.default_rng(23)
+
+    def prompt(pages, salt):
+        p = [int(x) for x in rng.integers(
+            1, cfg.vocab_size - 1, size=pages * page_size
+        )]
+        p[-1] = salt % (cfg.vocab_size - 2) + 1
+        return p
+
+    # (key, prompt, sampling, class) — same set both modes; greedy and
+    # seeded rows so the bit-identity verdict covers both samplers.
+    workload = []
+    for i in range(n_chatty):
+        sp = (
+            SamplingParams(temperature=0.0, max_new_tokens=chatty_gen,
+                           ignore_eos=True)
+            if i % 2 == 0 else
+            SamplingParams(temperature=0.8, top_k=8, seed=31 + i,
+                           max_new_tokens=chatty_gen, ignore_eos=True)
+        )
+        workload.append((f"chat{i}", prompt(chatty_pages, i), sp, "chatty"))
+    for i in range(n_long):
+        workload.append((
+            f"long{i}", prompt(long_pages, 100 + i),
+            SamplingParams(temperature=0.0, max_new_tokens=long_gen,
+                           ignore_eos=True),
+            "long",
+        ))
+    for i in range(n_inter):
+        sp = (
+            SamplingParams(temperature=0.0, max_new_tokens=inter_gen,
+                           ignore_eos=True)
+            if i % 2 == 0 else
+            SamplingParams(temperature=0.7, top_k=8, seed=61 + i,
+                           max_new_tokens=inter_gen, ignore_eos=True)
+        )
+        workload.append((
+            f"inter{i}", prompt(inter_pages, 200 + i), sp, "interactive",
+        ))
+
+    def counter_total(name, labelnames):
+        try:
+            return int(get_registry().counter(
+                name, "", labelnames=labelnames
+            ).total)
+        except Exception:
+            return 0
+
+    def run(tag: str, roles: list) -> dict:
+        registry: dict = {}
+        sched = GlobalScheduler(cfg, min_nodes_bootstrapping=len(roles),
+                                heartbeat_timeout_s=5.0,
+                                routing="cache_aware")
+        service = SchedulerService(
+            sched, LoopbackTransport("sched", registry),
+            join_timeout_s=60.0,
+        )
+        service.start()
+        ecfg = EngineConfig(
+            page_size=page_size,
+            num_pages=(
+                n_chatty * (chatty_pages + chatty_gen // page_size + 2)
+                + n_long * (long_pages + 2)
+                + n_inter * (inter_pages + 2) + 24
+            ),
+            max_batch_size=n_chatty + n_long + n_inter,
+            max_model_len=max_model_len,
+            kv_dtype=kv_dtype,
+            enable_prefix_cache=True,
+            # The handoff ships the PR 2 pinned host image; both modes
+            # run the tier so the bit-identity comparison is
+            # apples-to-apples.
+            host_cache_bytes=1 << 26,
+        )
+        workers = [
+            WorkerNode(
+                transport=LoopbackTransport(f"{tag}{i}", registry),
+                scheduler_peer="sched",
+                model_config=cfg,
+                engine_config=_dc.replace(ecfg),
+                load_params=stage_params_fn,
+                heartbeat_interval_s=0.1,
+                role=role,
+            )
+            for i, role in enumerate(roles)
+        ]
+        try:
+            starters = [threading.Thread(target=w.start) for w in workers]
+            for s in starters:
+                s.start()
+            for s in starters:
+                s.join(timeout=120.0)
+            deadline = _time.time() + 120
+            while _time.time() < deadline:
+                st = sched.cluster_status()
+                if st["num_pipelines"] >= len(roles) and all(
+                    n["ready"] for p in st["pipelines"] for n in p["nodes"]
+                ):
+                    break
+                _time.sleep(0.02)
+            client = SwarmClient(
+                LoopbackTransport("client", registry), service,
+                poll_interval_s=0.002,
+            )
+
+            reqs: dict[str, Request] = {}
+            evs: dict[str, threading.Event] = {}
+            t_submit: dict[str, float] = {}
+            t_first: dict[str, float] = {}
+            t_last: dict[str, float] = {}
+            watch_stop = threading.Event()
+
+            def watcher():
+                while not watch_stop.is_set():
+                    now = _time.perf_counter()
+                    for key, r in list(reqs.items()):
+                        if r.output_ids and key not in t_first:
+                            t_first[key] = now
+                        if r.output_ids:
+                            t_last[key] = now
+                    _time.sleep(0.001)
+
+            wt = threading.Thread(target=watcher, daemon=True)
+            wt.start()
+
+            def submit(key, p, sp):
+                rid = f"{tag}-{key}"
+                path = client.route(rid, prompt_ids=list(p))
+                if not path:
+                    return
+                req = Request(
+                    request_id=rid, prompt_ids=list(p),
+                    sampling_params=_dc.replace(sp),
+                    routing_table=list(path),
+                )
+                t_submit[key] = _time.perf_counter()
+                evs[key] = client.submit(req)
+                reqs[key] = req
+
+            by_class = {}
+            for key, p, sp, cls in workload:
+                by_class.setdefault(cls, []).append((key, p, sp))
+            # Phase 1: chatty sessions first; wait until they are deep
+            # in decode (the interference the decode pool exists to
+            # shield).
+            for key, p, sp in by_class["chatty"]:
+                submit(key, p, sp)
+            deadline = _time.monotonic() + 60.0
+            while _time.monotonic() < deadline and not all(
+                len(r.output_ids) >= 4
+                for k, r in reqs.items() if k.startswith("chat")
+            ):
+                _time.sleep(0.002)
+            # Phase 2: long prefills land, then interactive prompts
+            # trickle in while the longs are still being computed.
+            for key, p, sp in by_class["long"]:
+                submit(key, p, sp)
+            _time.sleep(0.02)
+            for key, p, sp in by_class["interactive"]:
+                submit(key, p, sp)
+                _time.sleep(0.015)
+            for key, ev in evs.items():
+                ev.wait(120.0)
+            watch_stop.set()
+            wt.join(timeout=2.0)
+
+            def pct(vals, q):
+                if not vals:
+                    return 0.0
+                vals = sorted(vals)
+                idx = min(len(vals) - 1, int(round(q * (len(vals) - 1))))
+                return round(vals[idx], 2)
+
+            inter_ttfts = [
+                (t_first[k] - t_submit[k]) * 1e3
+                for k in t_first if k.startswith("inter")
+            ]
+            chatty_tpots = [
+                (t_last[k] - t_first[k]) * 1e3
+                / max(1, len(reqs[k].output_ids) - 1)
+                for k in t_first
+                if k.startswith("chat") and k in t_last
+            ]
+            return {
+                "requests": len(reqs),
+                "completed": sum(
+                    1 for r in reqs.values()
+                    if r.status.is_finished
+                    and r.status.value != "finished_abort"
+                ),
+                "aborted": sum(
+                    1 for r in reqs.values()
+                    if r.status.value == "finished_abort"
+                ),
+                "interactive": {
+                    "ttft_p50_ms": pct(inter_ttfts, 0.5),
+                    "ttft_p95_ms": pct(inter_ttfts, 0.95),
+                },
+                "chatty": {
+                    "tpot_p50_ms": pct(chatty_tpots, 0.5),
+                },
+                "streams": {
+                    k: list(r.output_ids) for k, r in reqs.items()
+                },
+            }
+        finally:
+            for w in workers:
+                w.stop()
+            service.stop()
+
+    mixed = run("mx", [None, None])
+
+    kv_before = {
+        "frames": counter_total(
+            "parallax_kv_transfer_frames_total", ("direction",)
+        ),
+        "bytes": counter_total(
+            "parallax_kv_transfer_bytes_total", ("direction",)
+        ),
+        "fallbacks": counter_total(
+            "parallax_kv_transfer_fallbacks_total", ("reason",)
+        ),
+        "handoffs": counter_total(
+            "parallax_kv_handoffs_total", ("mode",)
+        ),
+    }
+    disagg = run("dg", ["prefill", "decode"])
+    kv_ms = (
+        summarize_snapshots(get_registry().histogram_snapshots())
+        .get("parallax_kv_transfer_ms") or {}
+    ).get("", {})
+    kv_transfer = {
+        "frames": counter_total(
+            "parallax_kv_transfer_frames_total", ("direction",)
+        ) - kv_before["frames"],
+        "bytes": counter_total(
+            "parallax_kv_transfer_bytes_total", ("direction",)
+        ) - kv_before["bytes"],
+        "fallbacks": counter_total(
+            "parallax_kv_transfer_fallbacks_total", ("reason",)
+        ) - kv_before["fallbacks"],
+        "kv_transfer_ms": {
+            k: kv_ms.get(k) for k in ("count", "p50", "p95")
+        } if kv_ms else {},
+    }
+    handoffs = counter_total(
+        "parallax_kv_handoffs_total", ("mode",)
+    ) - kv_before["handoffs"]
+
+    mixed_streams = mixed.pop("streams")
+    disagg_streams = disagg.pop("streams")
+    bit_identical = (
+        set(mixed_streams) == set(disagg_streams)
+        and all(
+            mixed_streams[k] == disagg_streams[k] for k in mixed_streams
+        )
+    )
+    return {
+        "workload": {
+            "chatty": n_chatty, "long_prefill": n_long,
+            "interactive": n_inter, "long_pages": long_pages,
+            "page_size": page_size, "chatty_gen": chatty_gen,
+        },
+        "mixed": mixed,
+        "disagg": {**disagg, "handoffs": handoffs,
+                   "kv_transfer": kv_transfer},
+        "bit_identical": bit_identical,
+        "interactive_ttft_p95_improved": (
+            disagg["interactive"]["ttft_p95_ms"]
+            < mixed["interactive"]["ttft_p95_ms"]
+        ),
+    }
+
+
 def _goodput_payload() -> dict:
     """The process goodput ledger's payload (tokens by usefulness
     bucket, time taxonomy, goodput fraction) for bench JSON."""
@@ -1647,6 +1951,23 @@ def _bench():
             ),
             kv_dtype=kv_dtype, page_size=page_size,
         )
+
+    # Disaggregated prefill/decode probe: the same long-prefill +
+    # chatty-decode workload served by a mixed pool and by a prefill
+    # specialist handing requests to a decode specialist over the
+    # KV-transfer lane. Mixed and disaggregated streams must be
+    # bit-identical, with zero aborts and kv_transfer telemetry
+    # populated (the CI disaggregation smoke asserts the contract).
+    # Cheap on CPU (part of the smoke contract); opt-in on TPU.
+    disagg_probe = None
+    if not on_tpu or os.environ.get("BENCH_DISAGG"):
+        disagg_probe = _disagg_probe(
+            cfg, stage_params_fn=lambda m: m.init_params(
+                jax.random.key(m.start_layer * 1000 + m.end_layer),
+                dtype=dtype,
+            ),
+            kv_dtype=kv_dtype, page_size=page_size,
+        )
     total_s = time.perf_counter() - t_start
 
     # Decode throughput over the whole decode phase (wall-clock, includes
@@ -1825,6 +2146,15 @@ def _bench():
             **(
                 {"churn": churn_probe}
                 if churn_probe is not None else {}
+            ),
+            # Disaggregated prefill/decode probe (mixed pool vs prefill
+            # specialist + decode specialist on the same long-prefill +
+            # chatty-decode workload): interactive TTFT p50/p95 and
+            # chatty TPOT per mode, kv_transfer frames/bytes/ms +
+            # handoffs, bit-identity across modes.
+            **(
+                {"disagg": disagg_probe}
+                if disagg_probe is not None else {}
             ),
             **(
                 {

@@ -91,4 +91,19 @@ GATE_TABLE: tuple[Gate, ...] = (
         doc="docs/decode_loop.md",
         reason="cache dir not writable or backend rejected it",
     ),
+    Gate(
+        feature="flag:--role",
+        marker="kv-image handoff disabled: no host KV tier",
+        doc="docs/disaggregation.md",
+        reason="page shipping harvests the PR 2 pinned host image; "
+               "without a host tier handoffs ship checkpoints only and "
+               "the decode pool re-prefills",
+    ),
+    Gate(
+        feature="flag:--role",
+        marker="ignored in scheduler-less mode",
+        doc="docs/disaggregation.md",
+        reason="handoff targets come from the scheduler's decode-pool "
+               "chooser; a gossip swarm has nobody to pick them",
+    ),
 )

@@ -140,12 +140,16 @@ class SwarmClient:
             return None
 
     def _reroute(self, request: Request) -> str | None:
-        """Post-dispatch rung of the retry ladder: the routed path died
-        before the first token, so nothing streamed — release the dead
-        path's load charge, re-enqueue with the ORIGINAL arrival time,
-        and resubmit the request verbatim to the new head. Returns the
-        new head, or None when no pipeline is serviceable (the caller
-        then falls through to the abort)."""
+        """Post-dispatch rung of the retry ladder: the routed path died,
+        so release the dead path's load charge, re-enqueue with the
+        ORIGINAL arrival time, and resubmit to the new head. A request
+        that had already streamed tokens resubmits with ``replay_ids``
+        — the mirror's streamed tokens teacher-forced through decode
+        steps on the new head (docs/disaggregation.md client resume
+        rung: a prefill head dying mid-handoff re-prefills on whatever
+        pool survives, bit-identically, zero tokens re-sampled). Returns
+        the new head, or None when no pipeline is serviceable (the
+        caller then falls through to the abort)."""
         rid = request.request_id
         try:
             self.service.scheduler.complete_request(
@@ -170,23 +174,32 @@ class SwarmClient:
             return None
         request.routing_table[:] = path
         head = path[0]
+        payload = {
+            "rid": rid,
+            "prompt_ids": request.prompt_ids,
+            "sampling_params": request.sampling_params.to_dict(),
+            "routing_table": list(path),
+            "eos_token_ids": list(request.eos_token_ids),
+            "lora_id": request.lora_id,
+        }
+        streamed = list(request.output_ids)
+        if streamed:
+            payload["replay_ids"] = streamed
+            if len(request.output_logprobs) == len(streamed):
+                payload["replay_logprobs"] = list(request.output_logprobs)
         try:
-            self.transport.call(head, "chat_submit", {
-                "rid": rid,
-                "prompt_ids": request.prompt_ids,
-                "sampling_params": request.sampling_params.to_dict(),
-                "routing_table": list(path),
-                "eos_token_ids": list(request.eos_token_ids),
-                "lora_id": request.lora_id,
-            }, timeout=30.0)
+            self.transport.call(head, "chat_submit", payload, timeout=30.0)
         except Exception as e:
             logger.warning("re-routed submit of %s to %s failed: %s",
                            rid, head, e)
             self.service.scheduler.complete_request(list(path))
             request.routing_table[:] = []
             return None
-        logger.info("re-routed %s onto %s (path death before first token)",
-                    rid, head)
+        logger.info(
+            "re-routed %s onto %s (%s)", rid, head,
+            f"replaying {len(streamed)} streamed tokens" if streamed
+            else "path death before first token",
+        )
         return head
 
     def _poll_until_done(self, request: Request, head: str,
@@ -206,20 +219,34 @@ class SwarmClient:
 
         def try_recover() -> str | None:
             """Head unreachable / amnesiac: follow a recorded migration
-            first; failing that, re-route pre-first-token requests
-            transparently (bounded attempts)."""
+            first; failing that, re-route transparently (bounded
+            attempts). Requests that already streamed tokens re-submit
+            with those tokens as ``replay_ids`` — teacher-forced on the
+            new head, so the continuation stays bit-identical and the
+            stream never repeats or re-samples a token. Mid-stream
+            re-routing additionally requires the SCHEDULER to have lost
+            the head: a client-side partition to a head the scheduler
+            still trusts must not fork the request onto a second
+            pipeline while the first keeps decoding it (duplicate
+            compute + a double load release when both finish)."""
             nonlocal reroutes
             moved = self._migrated_head(rid)
             if moved and moved != head:
                 return follow_migration(moved)
-            if (
-                not request.output_ids
-                and self.service is not None
-                and reroutes < 2
-            ):
-                reroutes += 1
-                return self._reroute(request)
-            return None
+            if self.service is None or reroutes >= 2:
+                return None
+            if request.output_ids:
+                try:
+                    head_known = (
+                        self.service.scheduler.manager.get(head)
+                        is not None
+                    )
+                except Exception:
+                    head_known = False
+                if head_known:
+                    return None
+            reroutes += 1
+            return self._reroute(request)
 
         while True:
             try:

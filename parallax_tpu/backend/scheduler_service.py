@@ -38,6 +38,9 @@ class SchedulerService:
         # Live migration + churn robustness (docs/resilience.md).
         transport.register(proto.PEER_DOWN, self._on_peer_down)
         transport.register(proto.MIGRATE_TARGET, self._on_migrate_target)
+        # Disaggregated serving (docs/disaggregation.md): decode-pool
+        # targets for prefill-head KV handoffs.
+        transport.register(proto.DISAGG_TARGET, self._on_disagg_target)
         transport.register("migration_done", self._on_migration_done)
         transport.register("where_is", self._on_where_is)
         transport.register("__ping__", lambda *_: "pong")
@@ -67,6 +70,12 @@ class SchedulerService:
                 [str(f) for f in payload["wire_formats"]]
                 if isinstance(payload.get("wire_formats"), (list, tuple))
                 else None
+            ),
+            # Phase specialization (docs/disaggregation.md): prefill /
+            # decode / mixed; absent on older builds -> mixed.
+            role=(
+                str(payload["role"])
+                if isinstance(payload.get("role"), str) else None
             ),
         )
         deadline = time.monotonic() + self.join_timeout_s
@@ -233,6 +242,22 @@ class SchedulerService:
         return {
             "targets": self.scheduler.choose_migration_targets(
                 [r for r in reqs if isinstance(r, dict)], exclude
+            )
+        }
+
+    def _on_disagg_target(self, _peer: str, payload: dict) -> dict:
+        """Decode-pool destinations for a prefill head's finished
+        prompts (KV handoff, docs/disaggregation.md): same CacheIndex
+        scoring as migrate_target, restricted to decode/mixed pipelines.
+        An empty map tells the head to keep the request local."""
+        reqs = payload.get("requests")
+        if not isinstance(reqs, list):
+            return {"targets": {}}
+        exclude = {str(x) for x in (payload.get("exclude") or ())}
+        return {
+            "targets": self.scheduler.choose_migration_targets(
+                [r for r in reqs if isinstance(r, dict)], exclude,
+                pool="decode",
             )
         }
 

@@ -220,6 +220,22 @@ def build_parser() -> argparse.ArgumentParser:
              "(must match the scheduler's --relay-token)",
     )
     join.add_argument(
+        "--role", default=None, choices=["prefill", "decode", "mixed"],
+        help="phase specialization for disaggregated serving "
+             "(docs/disaggregation.md): 'prefill' computes prompts and "
+             "hands finished requests to the decode pool over the "
+             "KV-transfer lane; 'decode' runs deep continuous batches "
+             "prompts never interrupt; default 'mixed' serves both "
+             "phases (no handoffs). Pipelines stay role-homogeneous "
+             "and /cluster/status breaks out per-pool saturation",
+    )
+    join.add_argument(
+        "--kv-transfer-chunk-bytes", type=int, default=None,
+        help="target payload bytes per layer-chunked KV_TRANSFER frame "
+             "on the handoff lane (default 4 MiB): smaller frames "
+             "overlap the transfer more, larger frames amortize framing",
+    )
+    join.add_argument(
         "--lora-adapters", default=None,
         help="per-request adapters this worker serves: "
              "name=peft_dir[,name=dir]",

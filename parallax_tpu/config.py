@@ -343,6 +343,28 @@ def resolve_wire_dtype(
     return canon
 
 
+# Disaggregated prefill/decode serving (docs/disaggregation.md): a
+# worker joins the swarm tagged with the phase it specializes in. The
+# scheduler keeps pipelines role-homogeneous, routes the prompt phase to
+# the prefill pool, and prefill heads hand finished prompts to
+# CacheIndex-scored decode replicas over the KV-transfer lane.
+NODE_ROLES = ("prefill", "decode", "mixed")
+
+
+def resolve_role(role: str | None) -> str:
+    """Canonical phase role for a worker (``--role`` / ``WorkerNode``
+    config). None/"" mean ``mixed`` — the pre-disaggregation behavior:
+    the node serves both phases and never initiates handoffs."""
+    if role in (None, ""):
+        return "mixed"
+    key = str(role).lower()
+    if key not in NODE_ROLES:
+        raise ValueError(
+            f"unknown node role {role!r} (want one of {NODE_ROLES})"
+        )
+    return key
+
+
 def normalize_config(raw: dict, model_name: str = "") -> ModelConfig:
     """Build a :class:`ModelConfig` from a HF ``config.json`` dict.
 

@@ -44,8 +44,12 @@ TOKEN_KINDS = (
     "speculative_rejected",
 )
 # "idle" is derived (wall elapsed minus the explicit buckets), never
-# recorded directly.
-TIME_KINDS = ("serve", "compile", "swap", "migrate")
+# recorded directly. "kv_transfer" is the disaggregation handoff lane
+# (docs/disaggregation.md): on a prefill head, wall time from first
+# KV frame enqueued to the decode head's accept/reject; on a decode
+# head, begin-frame receipt to image assembly — the per-node cost of
+# moving prompts between phase pools.
+TIME_KINDS = ("serve", "compile", "swap", "migrate", "kv_transfer")
 
 # Token kinds that served users. Replayed tokens are NOT useful: the
 # client already streamed them before the migration; recomputing them
@@ -94,7 +98,8 @@ class GoodputLedger:
         tim = registry.counter(
             "parallax_goodput_time_seconds_total",
             "Host-visit and device seconds by activity bucket "
-            "(serve / compile / swap / migrate; idle is derived)",
+            "(serve / compile / swap / migrate / kv_transfer; idle is "
+            "derived)",
             labelnames=("bucket",),
         )
         self._time_counters = {k: tim.labels(bucket=k) for k in TIME_KINDS}

@@ -206,6 +206,12 @@ def join_main(args) -> int:
         watchdog=bool(getattr(args, "watchdog", False)),
         watchdog_degraded_s=getattr(args, "watchdog_degraded_s", 5.0),
         watchdog_stalled_s=getattr(args, "watchdog_stalled_s", 15.0),
+        # Disaggregated serving (docs/disaggregation.md): phase role +
+        # the KV-transfer lane's frame-chunking target.
+        role=getattr(args, "role", None),
+        kv_transfer_chunk_bytes=getattr(
+            args, "kv_transfer_chunk_bytes", None
+        ),
     )
     node.start()
     logger.info("worker %s joined %s", node.node_id, scheduler_peer)

@@ -23,7 +23,7 @@ import time
 import jax
 import jax.numpy as jnp
 
-from parallax_tpu.config import ModelConfig, resolve_wire_dtype
+from parallax_tpu.config import ModelConfig, resolve_role, resolve_wire_dtype
 from parallax_tpu.models.base import StageModel
 from parallax_tpu.models.registry import create_stage_model
 from parallax_tpu.p2p import proto
@@ -55,6 +55,17 @@ class WorkerNode:
     # Backoff between target-query attempts while no pipeline is
     # serviceable (bootstrap/rebalance in flight).
     MIGRATION_RETRY_S = 1.0
+    # Disaggregation handoff (docs/disaggregation.md): a prefill head's
+    # parked request that has not landed on a decode replica within this
+    # long restores LOCALLY (mixed-mode decode) — never aborts.
+    HANDOFF_PARK_TIMEOUT_S = 20.0
+    # Backoff between ship attempts after a retryable failure.
+    HANDOFF_RETRY_S = 0.5
+    # A KV transfer whose decode-side result has not arrived within this
+    # long is presumed lost (target death, lane failure): fall back to a
+    # checkpoint-only re-ship. The target acks duplicates without a
+    # second submit, so a merely-lost result cannot double-decode.
+    HANDOFF_RESULT_TIMEOUT_S = 15.0
 
     def __init__(
         self,
@@ -76,6 +87,8 @@ class WorkerNode:
         watchdog: bool = False,
         watchdog_degraded_s: float = 5.0,
         watchdog_stalled_s: float = 15.0,
+        role: str | None = None,
+        kv_transfer_chunk_bytes: int | None = None,
     ):
         """``scheduler_peer=None`` enters SCHEDULER-LESS mode (reference:
         DHT announce + dijkstra routing, ``p2p/server.py:569-626``): the
@@ -107,6 +120,18 @@ class WorkerNode:
             raise ValueError(
                 "scheduler-less mode requires explicit layers=(start, end)"
             )
+        # Phase specialization (docs/disaggregation.md): "prefill" heads
+        # hand finished prompts to the decode pool over the KV-transfer
+        # lane; "decode" nodes advertise themselves as handoff targets;
+        # "mixed" (default) serves both phases with no handoffs.
+        self.role = resolve_role(role)
+        if self.standalone and self.role != "mixed":
+            logger.warning(
+                "--role %s ignored in scheduler-less mode: no scheduler "
+                "to assign decode-pool targets; this worker serves both "
+                "phases", self.role,
+            )
+            self.role = "mixed"
         self._self_layers = layers
         # Boot epoch: travels in gossip announcements so peers can tell
         # a restarted process (possibly a different build — different
@@ -196,6 +221,37 @@ class WorkerNode:
         self.sender = AsyncSender(
             transport, on_failure=self._on_send_failure
         )
+        # Disaggregation KV-handoff state (docs/disaggregation.md).
+        # The transfer lane is a SECOND AsyncSender: KV page bulk rides
+        # its own per-peer FIFOs, so a multi-megabyte handoff can never
+        # head-of-line block FORWARD/RELEASE traffic (or vice versa —
+        # the data plane keeps its own queue-depth failure horizon).
+        from parallax_tpu.runtime.kv_handoff import (
+            DEFAULT_CHUNK_BYTES,
+            HandoffAssembler,
+        )
+
+        self.kv_transfer_chunk_bytes = int(
+            kv_transfer_chunk_bytes or DEFAULT_CHUNK_BYTES
+        )
+        self.kv_sender = AsyncSender(
+            transport, max_queue=64,
+            on_failure=self._on_kv_send_failure, name="kv",
+        )
+        # Inbound transfer reassembly (this node as a decode target);
+        # swept from the announcer so orphaned partials never linger.
+        self._kv_assembler = HandoffAssembler()
+        # Source-side ledger (this node as a prefill head). Step-thread
+        # state, mirroring the migration maps: rid -> flag time for
+        # rows draining out of the in-flight window, rid -> park entry
+        # for checkpointed requests moving through the ship ladder.
+        self._handoff_pending: dict[str, float] = {}
+        self._handoff_parked: dict[str, dict] = {}
+        # Watchdog progress for the kv_shipper component: ship results,
+        # transfer results and local restores count — parks do not (a
+        # churning park stream must not mask a wedged ship path).
+        self._handoff_progress = 0
+        self._handoff_warned = False
         # Fail fast on a bad wire dtype: deferred to the sender workers
         # it would masquerade as per-frame link failures and abort
         # traffic with a misleading "peer unreachable" reason.
@@ -240,6 +296,8 @@ class WorkerNode:
         transport.register("chat_stop", self._on_chat_stop)
         transport.register(proto.WIRE_CAPS, self._on_wire_caps)
         transport.register(proto.CHECKPOINT, self._on_checkpoint)
+        transport.register(proto.KV_TRANSFER, self._on_kv_transfer)
+        transport.register(proto.KV_RESULT, self._on_kv_result)
         transport.register("__ping__", lambda *_: "pong")
         # Head-node chat requests by id (polled by the HTTP frontend;
         # reference: TransformerConnectionHandler.chat_completion proxies to
@@ -281,6 +339,7 @@ class WorkerNode:
         for t in self._threads:
             t.join(timeout=3.0)
         self.sender.close()
+        self.kv_sender.close()
         if self._gossip_pool is not None:
             self._gossip_pool.shutdown(wait=False, cancel_futures=True)
         if not self.standalone:
@@ -305,6 +364,9 @@ class WorkerNode:
                 # this build can decode on activation frames (per-link
                 # senders re-confirm via wire_caps before compressing).
                 "wire_formats": list(proto.WIRE_DTYPES),
+                # Phase specialization: the scheduler keeps pipelines
+                # role-homogeneous and phase-filters routing pools.
+                "role": self.role,
             },
             timeout=300.0,
         )
@@ -366,6 +428,20 @@ class WorkerNode:
             except (ValueError, OSError) as e:
                 logger.warning("adapter %r failed to load: %s", name, e)
         self.engine = engine
+        if (
+            self.role == "prefill"
+            and engine.host_tier is None
+            and not self._handoff_warned
+        ):
+            # Registered gate (analysis/gates.py): page shipping needs
+            # the PR 2 host tier on the source to harvest images.
+            self._handoff_warned = True
+            logger.warning(
+                "%s: kv-image handoff disabled: no host KV tier on this "
+                "prefill-role worker — handoffs ship checkpoints only "
+                "and the decode pool re-prefills (set --host-cache-bytes "
+                "to enable page shipping)", self.node_id,
+            )
         # Fresh engine = empty radix tree: the scheduler's digest mirror
         # for this node is stale; the next heartbeat ships a snapshot.
         self._digests_full_next = True
@@ -552,7 +628,12 @@ class WorkerNode:
         wd.register_beat("step_loop", _step_pending)
 
         def _sender_probe():
-            stats = self.sender.stats()
+            # Both lanes: the data plane and the KV-transfer lane — a
+            # wedged kv lane stalls handoffs exactly like a wedged
+            # FORWARD link stalls decode.
+            stats = dict(self.sender.stats())
+            for p, s in self.kv_sender.stats().items():
+                stats[f"kv:{p}"] = s
             pending = sum(
                 s.get("queue_depth", 0) or 0 for s in stats.values()
             )
@@ -583,6 +664,38 @@ class WorkerNode:
             )
 
         wd.register("migration", _migration_probe)
+
+        def _kv_shipper_probe():
+            # Disaggregation handoff path: flagged + parked requests on
+            # this (prefill) head plus inbound transfers assembling on
+            # this (decode) head. Progress counts ship rounds, transfer
+            # results and local restores PLUS frame-level movement both
+            # ways (outbound lane frames_out, inbound assembler feeds):
+            # a large image legitimately spends many seconds in flight,
+            # and frames moving steadily must read as progress — only a
+            # parked/assembling set with NOTHING moving is a wedged
+            # shipper lane (the PR 8 false-instant-stall lesson).
+            pending = (
+                len(self._handoff_pending)
+                + len(self._handoff_parked)
+                + self._kv_assembler.partial_count()
+            )
+            frames_out = sum(
+                (s.get("frames_out", 0) or 0)
+                for s in self.kv_sender.stats().values()
+            )
+            progress = (
+                self._handoff_progress
+                + self._kv_assembler.frames_total
+                + frames_out
+            )
+            return (
+                float(pending), float(progress),
+                f"{len(self._handoff_parked)} parked, "
+                f"{self._kv_assembler.partial_count()} assembling",
+            )
+
+        wd.register("kv_shipper", _kv_shipper_probe)
 
         def _admission_probe():
             eng = self.engine
@@ -623,6 +736,10 @@ class WorkerNode:
         while not self._stop.is_set():
             try:
                 self._reap_rx_stats()
+                # Inbound KV transfers whose source died mid-flight are
+                # discarded here (the request recovers through the
+                # source's result timeout / the client resume ladder).
+                self._kv_assembler.sweep()
                 logger.debug("%s: heartbeat", self.node_id)
                 if self.node_id.startswith("relay:") and hasattr(
                     self.transport, "register_at_relay"
@@ -1296,6 +1413,11 @@ class WorkerNode:
         into the metrics registry so a worker's ``/metrics`` (and the
         single-process swarm probes) expose transport series."""
         links = self.sender.stats()
+        # KV-transfer lane telemetry rides the same payload under a
+        # "kv:" peer prefix, so /cluster/status shows the handoff lane's
+        # bytes/queue separately from the data plane's.
+        for p, s in self.kv_sender.stats().items():
+            links[f"kv:{p}"] = s
         with self._rx_lock:
             rx_snapshot = {p: dict(rx) for p, rx in self._rx_stats.items()}
         for peer, rx in rx_snapshot.items():
@@ -1408,6 +1530,21 @@ class WorkerNode:
             eos_token_ids=tuple(payload.get("eos_token_ids") or ()),
             lora_id=payload.get("lora_id"),
         )
+        replay = payload.get("replay_ids")
+        if replay:
+            # Client resume rung (docs/disaggregation.md): the
+            # submitting frontend mirrors tokens it already streamed
+            # from a head that died (e.g. a prefill node mid-handoff).
+            # Teacher-forcing them through ordinary decode steps makes
+            # the continuation bit-identical and the user never sees a
+            # re-sampled token — the same replay machinery checkpoint
+            # restores use.
+            req.replay_ids = [int(x) for x in replay]
+            lps = payload.get("replay_logprobs") or []
+            req.replay_logprobs = (
+                [float(x) for x in lps]
+                if len(lps) == len(req.replay_ids) else []
+            )
         self._chat_requests[req.request_id] = req
         self.submit(req)
         return "ok"
@@ -1493,6 +1630,10 @@ class WorkerNode:
                     # Park drained requests as checkpoints and ship the
                     # parked ones to their target pipelines.
                     self._migration_tick(eng)
+                if self.role == "prefill" and not self.standalone:
+                    # Disaggregation: move finished prompts to the
+                    # decode pool (flag -> park -> ship -> result).
+                    self._handoff_tick(eng)
                 if eng is None:
                     self._wake.wait(0.01)
                     self._wake.clear()
@@ -1648,6 +1789,37 @@ class WorkerNode:
                 self._restore_checkpoint(item[1], item[2])
             elif kind == "migration_shipped":
                 self._on_migration_shipped(item[1])
+            elif kind == "handoff_shipped":
+                self._on_handoff_shipped(item[1])
+            elif kind == "handoff_result":
+                self._on_handoff_result(item[1])
+            elif kind == "handoff_confirmed":
+                # Park-deadline ownership check came back (the entry is
+                # already out of the parked map).
+                rid, e, owner = item[1], item[2], item[3]
+                if isinstance(owner, str) and owner != self.node_id:
+                    # The transfer DID land there: the target's finish
+                    # releases the retained path charge; ours releases
+                    # the old path via _finish_handoff.
+                    e.pop("pinned_charged", None)
+                    self._finish_handoff(rid, e, owner, with_kv=True)
+                else:
+                    self._handoff_restore_local(e, "park deadline")
+            elif kind == "kv_lane_down":
+                # The transfer lane to a decode head died: transfers
+                # awaiting its result cannot complete — fall back to a
+                # checkpoint-only re-ship now instead of waiting out
+                # the result timeout.
+                peer = item[1]
+                now = time.monotonic()
+                for rid, e in self._handoff_parked.items():
+                    if (
+                        e.get("awaiting_since") is not None
+                        and e.get("target") == peer
+                    ):
+                        self._handoff_transfer_failed(
+                            rid, e, "transfer_failed", now
+                        )
             elif kind == "liveness":
                 # Standalone gossip sweep (freshness snapshot from the
                 # announcer thread): abort requests routed through peers
@@ -1731,6 +1903,10 @@ class WorkerNode:
     def _flag_for_migration(self, req: Request, dead_peer: str) -> None:
         rid = req.request_id
         if rid in self._migration_pending or rid in self._migration_parked:
+            return
+        if rid in self._handoff_pending or rid in self._handoff_parked:
+            # Already leaving through the disaggregation handoff path —
+            # its own ladder (re-ship / local restore) recovers it.
             return
         if req.sampling_params.json_schema:
             # Grammar-DFA state is not portable yet: fail fast to the
@@ -1881,6 +2057,25 @@ class WorkerNode:
                 results.setdefault(rid, ("retry", "ship error"))
             self._post(("migration_shipped", results))
 
+    def _target_descriptor(self, req: Request, page: int) -> dict:
+        """CacheIndex-scoring descriptor for one parked request (shared
+        by the migration and handoff target queries): the FULL token
+        history — a previously-resumed request's prompt already folds
+        prior outputs in, and outputs still awaiting teacher-forced
+        replay count too — so the scheduler's chain prediction sees the
+        same tokens the restore will re-prefill."""
+        from parallax_tpu.runtime.radix_cache import block_hash_chain
+
+        history = list(req.all_token_ids) + list(req.replay_ids)
+        d = {
+            "rid": req.request_id,
+            "prompt_tokens": len(history),
+            "lora_id": req.lora_id,
+        }
+        if req.lora_id is None:
+            d["chains"] = {str(page): block_hash_chain(history, page)}
+        return d
+
     def _ship_checkpoints_inner(
         self, entries: dict[str, dict], results: dict[str, tuple]
     ) -> None:
@@ -1888,25 +2083,12 @@ class WorkerNode:
             checkpoint_from_request,
             checkpoint_to_wire,
         )
-        from parallax_tpu.runtime.radix_cache import block_hash_chain
 
         page = self.engine_config.page_size
-        descriptors = []
-        for rid, e in entries.items():
-            req = e["req"]
-            # The full token history for target scoring: the prompt of a
-            # previously-resumed request already folds its prior outputs
-            # in, and outputs still awaiting teacher-forced replay count
-            # too (checkpoint_from_request records them).
-            history = list(req.all_token_ids) + list(req.replay_ids)
-            d = {
-                "rid": rid,
-                "prompt_tokens": len(history),
-                "lora_id": req.lora_id,
-            }
-            if req.lora_id is None:
-                d["chains"] = {str(page): block_hash_chain(history, page)}
-            descriptors.append(d)
+        descriptors = [
+            self._target_descriptor(e["req"], page)
+            for e in entries.values()
+        ]
         try:
             reply = self.transport.call(
                 self.scheduler_peer, proto.MIGRATE_TARGET,
@@ -2054,6 +2236,665 @@ class WorkerNode:
         while len(self._migrated_to) > 4096:
             self._migrated_to.popitem(last=False)
 
+    # -- disaggregated prefill/decode handoff (docs/disaggregation.md) -------
+    #
+    # Prefill-role head flow, one step-loop pass at a time: a request
+    # crosses the prefill/decode boundary (prompt KV computed, first
+    # token committed) -> FLAGGED (``migrating`` stops the local
+    # scheduler from planning it into further decode steps) -> once out
+    # of the in-flight window it is PARKED exactly like a migration
+    # (KV preempted to the host tier and harvested into an image,
+    # request extracted, pages released) -> the scheduler picks a
+    # CacheIndex-scored DECODE-POOL target -> the image streams over the
+    # dedicated kv lane as layer-chunked KV_TRANSFER frames (begin /
+    # layers / end) -> the decode head assembles, validates through the
+    # strict checkpoint decoder, admits the request like a preempted
+    # resume (all-or-nothing page reservation; PREEMPTED parking under
+    # pressure) and answers KV_RESULT. Fallback ladder on any miss:
+    # checkpoint-only re-ship (re-prefill from the target's radix +
+    # teacher-forced replay), then local restore (mixed-mode decode
+    # here), then — only if the engine itself is gone — abort.
+
+    def _handoff_tick(self, eng) -> None:
+        """One step-loop pass of the handoff state machine: flag, park,
+        ship, resolve result timeouts and the park deadline."""
+        now = time.monotonic()
+        if eng is not None and eng.model.is_first:
+            for rid in eng.handoff_ready_rids():
+                if (
+                    rid in self._handoff_pending
+                    or rid in self._handoff_parked
+                    or rid in self._migration_pending
+                ):
+                    continue
+                req = eng.scheduler.running.get(rid)
+                if req is None or req.status.is_finished:
+                    continue
+                if req.sampling_params.json_schema or getattr(
+                    req, "handoff_local", False
+                ):
+                    # Grammar-DFA state is not portable: decode locally
+                    # (mixed behavior) rather than hand off or abort.
+                    req.handoff_local = True  # type: ignore[attr-defined]
+                    continue
+                req.migrating = True
+                self._handoff_pending[rid] = now
+                from parallax_tpu.obs.flight import get_flight
+
+                get_flight().event(
+                    "handoff_flag", node=self.node_id, request_id=rid,
+                )
+        if self._handoff_pending and eng is not None:
+            inflight = eng.inflight_rids()
+            for rid in list(self._handoff_pending):
+                sched = eng.scheduler
+                req = sched.running.get(rid) or sched.wait_queue.get(rid)
+                if req is None or req.status.is_finished:
+                    self._handoff_pending.pop(rid, None)
+                    continue
+                if rid in inflight:
+                    continue    # pages still being written; next pass
+                self._handoff_pending.pop(rid)
+                self._park_for_handoff(eng, req)
+        ready = [
+            rid for rid, e in self._handoff_parked.items()
+            if not e["shipping"] and e["awaiting_since"] is None
+            and now >= e["next_attempt"]
+        ]
+        if ready:
+            for rid in ready:
+                self._handoff_parked[rid]["shipping"] = True
+            entries = {rid: self._handoff_parked[rid] for rid in ready}
+            threading.Thread(
+                target=self._ship_handoffs, args=(entries,),
+                daemon=True, name="kv-handoff-ship",
+            ).start()
+        for rid, e in list(self._handoff_parked.items()):
+            if (
+                e["awaiting_since"] is not None
+                and now - e["awaiting_since"] > self.HANDOFF_RESULT_TIMEOUT_S
+            ):
+                self._handoff_transfer_failed(rid, e, "result_timeout", now)
+            elif (
+                not e["shipping"]
+                and e["awaiting_since"] is None
+                and now > e["deadline"]
+            ):
+                # Park deadline: nobody (provably) took it — decode it
+                # HERE. The mixed-mode rung, never an abort. Entries
+                # that ever had a pinned target first confirm ownership
+                # against the scheduler's where_is table: under an
+                # asymmetric partition the target may have accepted the
+                # transfer (and reported migration_done) while every
+                # result/re-ship back to us was lost — restoring
+                # locally then would fork the request onto two heads.
+                self._handoff_parked.pop(rid)
+                self._handoff_progress += 1
+                if e.get("pinned_target"):
+                    threading.Thread(
+                        target=self._confirm_then_restore_local,
+                        args=(rid, e), daemon=True,
+                        name="kv-handoff-confirm",
+                    ).start()
+                else:
+                    self._handoff_restore_local(e, "park deadline")
+
+    def _confirm_then_restore_local(self, rid: str, e: dict) -> None:
+        """Background thread (the where_is RPC must not block the step
+        thread): if the scheduler records another head owning ``rid``,
+        the earlier transfer actually landed — finish the handoff
+        instead of forking a local copy. Unknown/unreachable answers
+        restore locally (availability first)."""
+        owner = None
+        try:
+            reply = self.transport.call(
+                self.scheduler_peer, "where_is", {"rid": rid},
+                timeout=5.0,
+            )
+            owner = (reply or {}).get("head")
+        except Exception:
+            owner = None
+        self._post(("handoff_confirmed", rid, e, owner))
+
+    def _handoff_transfer_failed(
+        self, rid: str, e: dict, reason: str, now: float,
+        pin: bool = True,
+    ) -> None:
+        """A KV transfer died (nack, lane failure, result timeout):
+        release the charged target path and drop to the checkpoint-only
+        rung on the next ship attempt.
+
+        ``pin`` (timeouts and lane failures — anywhere the target's
+        verdict is UNKNOWN) routes that re-ship back to the SAME
+        target: if the slow transfer actually succeeded there, the
+        duplicate ack resolves it in place, whereas a fresh target
+        would leave two heads decoding the same request. An explicit
+        nack from the target (it does NOT own the request) re-ships
+        pin-free."""
+        from parallax_tpu.runtime import kv_handoff
+
+        kv_handoff.record_fallback(reason)
+        path = e.get("target_path")
+        if pin and e.get("target"):
+            # Verdict unknown: the target MAY own (and later finish)
+            # the request, and its finish releases the path charge —
+            # releasing here too would double-decrement the decode
+            # head's load and over-admit onto it. Retain the charge
+            # with the pin; it is released only once the pinned re-ship
+            # proves the target does NOT own the request (reject /
+            # unreachable) or the park deadline restores locally.
+            e["pinned_target"] = e["target"]
+            e["pinned_path"] = list(path or [e["target"]])
+            e["pinned_charged"] = bool(path)
+        elif path:
+            # Explicit nack (or no known target): the target never took
+            # ownership, so nothing else releases the router charge the
+            # scheduler made when it chose this path.
+            self.sender.send(
+                self.scheduler_peer, "request_complete",
+                {"path": list(path)}, best_effort=True,
+            )
+        e["awaiting_since"] = None
+        e["target"] = None
+        e["target_path"] = None
+        e["kv_failed"] = True
+        e["next_attempt"] = now
+
+    def _release_pinned_charge(self, e: dict) -> None:
+        """Release the router charge retained across a pinned re-ship —
+        called exactly once, when the pinned target is proven NOT to
+        own the request (reject/unreachable) or the request restores
+        locally."""
+        if e.pop("pinned_charged", False) and e.get("pinned_path"):
+            self.sender.send(
+                self.scheduler_peer, "request_complete",
+                {"path": list(e["pinned_path"])}, best_effort=True,
+            )
+
+    def _park_for_handoff(self, eng, req: Request) -> None:
+        """Checkpoint one finished prompt out of the prefill engine
+        (step thread — cache bookkeeping is single-threaded state).
+        Identical mechanics to a migration park: host-tier preempt +
+        image harvest where possible, extract, release."""
+        from parallax_tpu.runtime.request import RequestStatus
+
+        rid = req.request_id
+        image = None
+        if (
+            req.status is RequestStatus.DECODING
+            and req.is_prefill_done
+            and eng.host_tier is not None
+        ):
+            preempt = getattr(eng.cache, "preempt_to_host", None)
+            try:
+                if preempt is not None and preempt(req):
+                    image = eng.harvest_kv_image(req)
+            except Exception:
+                logger.exception(
+                    "%s: KV harvest for handoff of %s failed (decode "
+                    "pool will re-prefill)", self.node_id, rid,
+                )
+                image = None
+        extracted = eng.extract(rid)
+        if extracted is None:
+            # Raced back into flight; re-flag and retry next pass.
+            self._handoff_pending[rid] = time.monotonic()
+            return
+        old_table = list(req.routing_table)
+        try:
+            eng.cache.release(req)
+        except Exception:
+            logger.exception("%s: cache release for handoff %s failed",
+                             self.node_id, rid)
+        # Multi-stage prefill pipeline: downstream mirrors drop now.
+        for peer in old_table:
+            if peer != self.node_id:
+                self.sender.send(
+                    peer, proto.RELEASE,
+                    {"rids": [rid], "abort": True}, best_effort=True,
+                )
+        now = time.monotonic()
+        self._handoff_parked[rid] = {
+            "req": req,
+            "image": image,
+            "old_table": old_table,
+            "parked_wall": time.time(),
+            "deadline": now + self.HANDOFF_PARK_TIMEOUT_S,
+            "next_attempt": now,
+            "shipping": False,
+            "awaiting_since": None,
+            "target": None,
+            "target_path": None,
+            "t_ship": None,
+            "kv_failed": False,
+            # Set by a result-timeout/lane failure: the next ship goes
+            # back to this target (checkpoint-only) so a slow-but-
+            # successful transfer resolves via the duplicate ack
+            # instead of double-decoding on a fresh target.
+            "pinned_target": None,
+            "pinned_path": None,
+            # Static ladder rungs already counted for this entry: the
+            # retry loop re-derives the same reason every attempt, and
+            # re-counting would inflate the fallback telemetry ~40x
+            # over a full park window.
+            "fallbacks_counted": set(),
+        }
+        from parallax_tpu.obs.flight import get_flight
+
+        get_flight().event(
+            "handoff_park", node=self.node_id, request_id=rid,
+            kv_pages=(len(image.layers[0]) if image is not None else 0),
+            tokens=len(req.full_output_ids),
+        )
+        if req.traced:
+            from parallax_tpu.obs.trace import get_trace_store
+
+            get_trace_store().add(
+                rid, self.node_id, "kv_handoff_park",
+                t0=time.perf_counter(), dur=0.0, args={},
+            )
+
+    def _ship_handoffs(self, entries: dict[str, dict]) -> None:
+        """Background thread: decode-pool targets from the scheduler,
+        then per request either stream the KV image over the kv lane or
+        ship the checkpoint inline (re-prefill rungs). Reads only parked
+        (frozen) state; every entry ALWAYS gets a result posted."""
+        results: dict[str, tuple] = {}
+        try:
+            self._ship_handoffs_inner(entries, results)
+        except Exception:
+            logger.exception("%s: handoff ship failed", self.node_id)
+        finally:
+            for rid in entries:
+                results.setdefault(rid, ("retry", "ship error"))
+            self._post(("handoff_shipped", results))
+
+    def _ship_handoffs_inner(
+        self, entries: dict[str, dict], results: dict[str, tuple]
+    ) -> None:
+        from parallax_tpu.runtime import kv_handoff
+        from parallax_tpu.runtime.checkpoint import checkpoint_to_wire
+
+        page = self.engine_config.page_size
+        descriptors = [
+            self._target_descriptor(e["req"], page)
+            for e in entries.values()
+            if not e.get("pinned_target")   # known target: no query
+        ]
+        targets = {}
+        if descriptors:
+            try:
+                reply = self.transport.call(
+                    self.scheduler_peer, proto.DISAGG_TARGET,
+                    {"requests": descriptors, "exclude": [self.node_id]},
+                    timeout=15.0,
+                )
+                targets = (reply or {}).get("targets") or {}
+            except Exception as exc:
+                logger.warning("%s: disagg_target query failed: %s",
+                               self.node_id, exc)
+        for rid, e in entries.items():
+            pinned = e.get("pinned_target")
+            if pinned:
+                # Post-timeout re-ship: BACK to the original target,
+                # checkpoint-only. If the slow transfer succeeded
+                # there, the duplicate ack resolves it in place; no
+                # fresh router charge was made for this path.
+                path = [str(x) for x in (e.get("pinned_path") or [pinned])]
+                head, kv_ok, charged = path[0], False, False
+            else:
+                t = targets.get(rid)
+                if not isinstance(t, dict) or not t.get("path"):
+                    # No decode/mixed pipeline serviceable: keep it
+                    # local (mixed-mode decode) — visible in the
+                    # scheduler's disagg.no_target counter, never a
+                    # queue nobody sees.
+                    results[rid] = (
+                        "local", "no serviceable decode pipeline"
+                    )
+                    continue
+                path = [str(x) for x in t["path"]]
+                head = path[0]
+                charged = True
+                image = e["image"]
+                predicted = int(t.get("predicted_cached_tokens") or 0)
+                reason = None
+                if image is None:
+                    reason = "no_image"   # no host tier / partial park
+                elif e["kv_failed"]:
+                    pass                  # counted at the failure site
+                elif len(path) != 1 or list(
+                    t.get("head_layers") or []
+                ) != [image.start_layer, image.end_layer]:
+                    reason = "layout"     # raw pages cannot adopt there
+                elif predicted >= image.computed_tokens - page:
+                    # Smart skip: the target's radix already covers
+                    # (within a page of) everything the image holds —
+                    # re-prefilling there is ~one page of compute,
+                    # cheaper than the wire.
+                    reason = "prefix_warm"
+                kv_ok = (
+                    image is not None and not e["kv_failed"]
+                    and reason is None
+                )
+                if reason is not None and reason not in e["fallbacks_counted"]:
+                    e["fallbacks_counted"].add(reason)
+                    kv_handoff.record_fallback(reason)
+            ckpt = kv_handoff.handoff_checkpoint(e["req"], path, kv=None)
+            ckpt.parked_wall = e["parked_wall"]
+            wire = checkpoint_to_wire(ckpt)
+            if kv_ok:
+                frames = kv_handoff.image_to_frames(
+                    rid, wire, image, self.kv_transfer_chunk_bytes
+                )
+                total_b = sum(b for _f, b in frames)
+                if not self._enqueue_kv_frames(head, frames):
+                    # Backpressure deadline hit (lane wedged or the
+                    # image simply outruns the link): the assembler's
+                    # sequence check nacks whatever partial landed, and
+                    # this request takes the checkpoint-only rung NOW.
+                    kv_handoff.record_fallback("transfer_failed")
+                    e["kv_failed"] = True
+                    results[rid] = ("retry", "kv lane backpressure")
+                    self.sender.send(
+                        self.scheduler_peer, "request_complete",
+                        {"path": path}, best_effort=True,
+                    )
+                    continue
+                kv_handoff.record_transfer(
+                    "out", frames=len(frames), nbytes=total_b,
+                )
+                results[rid] = ("sent", (head, path))
+            else:
+                # Checkpoint-only rung: the acknowledged migration wire;
+                # the target re-prefills from its own radix and
+                # teacher-forces the recorded tokens.
+                try:
+                    reply = self.transport.call(
+                        head, proto.CHECKPOINT,
+                        {"checkpoints": [wire]}, timeout=30.0,
+                    )
+                except Exception:
+                    results[rid] = ("retry", f"target {head} unreachable")
+                    if charged:
+                        self.sender.send(
+                            self.scheduler_peer, "request_complete",
+                            {"path": path}, best_effort=True,
+                        )
+                    # A pinned target stays pinned on an UNREACHABLE
+                    # outcome: a call timeout to a live-but-overloaded
+                    # head is indistinguishable from death here, and
+                    # shipping to a fresh target while the pinned one
+                    # may own the request would fork it onto two heads.
+                    # A genuinely dead target resolves at the park
+                    # deadline (local restore); its retained charge
+                    # dies with the node the scheduler evicts.
+                    continue
+                accepted = set((reply or {}).get("accepted") or ())
+                if rid in accepted:
+                    results[rid] = ("ok", head)
+                else:
+                    rejected = (reply or {}).get("rejected") or {}
+                    results[rid] = (
+                        "retry",
+                        str(rejected.get(rid) or "target rejected"),
+                    )
+                    if charged:
+                        self.sender.send(
+                            self.scheduler_peer, "request_complete",
+                            {"path": path}, best_effort=True,
+                        )
+                    if pinned:
+                        # Explicit rejection: the pinned target does
+                        # NOT own the request — release the retained
+                        # charge and free the next round to pick any
+                        # decode replica.
+                        self._release_pinned_charge(e)
+                        e["pinned_target"] = None
+                        e["pinned_path"] = None
+
+    # Ship-thread backpressure on the kv lane: stop enqueueing while
+    # the peer's queue holds this many frames (well under the lane's
+    # max_queue of 64, so bursts from concurrent ship batches still
+    # fit) and give a wedged lane this long before falling back.
+    KV_LANE_HIGH_WATER = 32
+    KV_LANE_DRAIN_TIMEOUT_S = 60.0
+
+    def _enqueue_kv_frames(self, head: str, frames: list) -> bool:
+        """Feed one transfer's frames onto the kv lane WITH
+        backpressure (runs on the ship thread, which may block): an
+        unbounded enqueue of a many-frame image would overflow the
+        lane's bounded queue — destroying the transfer and falsely
+        reporting a healthy decode head as peer-down — because enqueue
+        is instantaneous while the drain runs at wire speed. False on
+        deadline; the caller falls back to checkpoint-only."""
+        deadline = time.monotonic() + self.KV_LANE_DRAIN_TIMEOUT_S
+        for f, b in frames:
+            while self.kv_sender.queue_depth(head) >= self.KV_LANE_HIGH_WATER:
+                if time.monotonic() > deadline or self._stop.is_set():
+                    return False
+                time.sleep(0.005)
+            # Lazy tuple payload feeds the lane's telemetry; frames are
+            # already serialized dicts (built on the ship thread, never
+            # the step thread), so the worker only packs.
+            self.kv_sender.send(
+                head, proto.KV_TRANSFER, (lambda f=f, b=b: (f, b, b)),
+            )
+        return True
+
+    def _on_handoff_shipped(self, results: dict[str, tuple]) -> None:
+        """Step thread: fold one ship round's outcomes back into the
+        parked ledger."""
+        from parallax_tpu.runtime import kv_handoff
+
+        self._handoff_progress += 1
+        now = time.monotonic()
+        for rid, (status, info) in results.items():
+            e = self._handoff_parked.get(rid)
+            if e is None:
+                continue
+            e["shipping"] = False
+            if status == "ok":
+                self._handoff_parked.pop(rid)
+                self._finish_handoff(rid, e, info, with_kv=False)
+            elif status == "sent":
+                head, path = info
+                e["awaiting_since"] = now
+                e["t_ship"] = now
+                e["target"] = head
+                e["target_path"] = list(path)
+                early = e.pop("early_result", None)
+                if early is not None:
+                    # The decode head answered before this ship round's
+                    # results event landed (loopback dispatch is
+                    # synchronous; TCP can race too): consume the
+                    # stashed result now instead of stalling to the
+                    # result timeout and re-shipping a request the
+                    # target already owns.
+                    self._on_handoff_result(early)
+            elif status == "local":
+                self._handoff_parked.pop(rid)
+                kv_handoff.record_fallback("no_decode_pool")
+                self._handoff_restore_local(e, str(info))
+            else:   # retry
+                e["next_attempt"] = now + self.HANDOFF_RETRY_S
+
+    def _on_handoff_result(self, payload: dict) -> None:
+        """Step thread: a decode head's KV_RESULT for one transfer."""
+        from parallax_tpu.runtime import kv_handoff
+
+        rid = str(payload.get("rid") or "")
+        e = self._handoff_parked.get(rid)
+        if e is None:
+            return      # late/duplicate result; already resolved
+        if e["awaiting_since"] is None:
+            if e["shipping"]:
+                # Raced ahead of the ship round's own results event:
+                # stash it — the "sent" transition consumes it.
+                e["early_result"] = dict(payload)
+            return
+        self._handoff_progress += 1
+        if payload.get("ok"):
+            self._handoff_parked.pop(rid)
+            if e["t_ship"] is not None:
+                # Out-leg latency: first frame enqueued -> accept.
+                kv_handoff.record_transfer(
+                    "out", frames=0, nbytes=0,
+                    ms=(time.monotonic() - e["t_ship"]) * 1e3,
+                )
+            self._finish_handoff(
+                rid, e, e.get("target") or "?", with_kv=True
+            )
+        else:
+            logger.warning(
+                "%s: kv transfer of %s rejected by %s (%s); falling "
+                "back to checkpoint-only", self.node_id, rid,
+                e.get("target"), payload.get("reason") or "?",
+            )
+            # Explicit nack: the target does NOT own the request —
+            # the re-ship is free to pick any decode replica.
+            self._handoff_transfer_failed(
+                rid, e, "transfer_failed", time.monotonic(), pin=False,
+            )
+
+    def _finish_handoff(
+        self, rid: str, e: dict, head: str, with_kv: bool
+    ) -> None:
+        """The decode head owns the request now: redirect pollers,
+        release the old (prefill) path's load charge, count it."""
+        self._record_migrated(rid, head)
+        self._chat_requests.pop(rid, None)
+        self._request_events.pop(rid, None)
+        if not self.standalone:
+            self.sender.send(
+                self.scheduler_peer, "request_complete",
+                {"path": e["old_table"] or [self.node_id]},
+                best_effort=True,
+            )
+        from parallax_tpu.obs.flight import get_flight
+
+        get_flight().event(
+            "handoff_out", node=self.node_id, request_id=rid,
+            target=head, with_kv=with_kv,
+        )
+        if e["req"].traced:
+            from parallax_tpu.obs.trace import get_trace_store
+
+            get_trace_store().add(
+                rid, self.node_id, "kv_handoff_out",
+                t0=time.perf_counter(), dur=0.0,
+                args={"target": head, "with_kv": with_kv},
+            )
+
+    def _handoff_restore_local(self, e: dict, reason: str) -> None:
+        """Mixed-mode rung: decode the parked request HERE. Goes through
+        the same checkpoint-restore path a decode target runs (including
+        KV-image re-adoption via the host tier), so the continuation is
+        bit-identical whichever rung serves it.
+
+        The restored request keeps its ORIGINAL routing table: on a
+        multi-stage prefill pipeline the head only hosts its own layer
+        slice, so decode must still flow through the downstream stages
+        (whose mirrors the replay re-prefill rebuilds), and the finish
+        then releases exactly the path the dispatcher charged. The KV
+        image is only re-adopted on a single-stage head — adopting it
+        on a multi-stage head would skip the re-prefill that feeds the
+        downstream stages their KV."""
+        from parallax_tpu.runtime import kv_handoff
+
+        req = e["req"]
+        rid = req.request_id
+        logger.info("%s: restoring handoff of %s locally (%s)",
+                    self.node_id, rid, reason)
+        self._release_pinned_charge(e)
+        table = list(e["old_table"] or [self.node_id])
+        ckpt = kv_handoff.handoff_checkpoint(
+            req, table, kv=e["image"] if len(table) == 1 else None
+        )
+        ckpt.parked_wall = e["parked_wall"]
+        self._restore_checkpoint(ckpt, self.node_id)
+
+    def _on_kv_transfer(self, peer: str, payload):
+        """Decode-target side of the kv lane: assemble layer-chunked
+        frames; on the end frame, admit like an rpc_checkpoint batch and
+        answer KV_RESULT (the source releases its state only on ok)."""
+        res = self._kv_assembler.feed(peer, payload)
+        if res is None:
+            return "ok"
+        kind, val = res
+        rid = payload.get("rid") if isinstance(payload, dict) else None
+        if kind == "error":
+            logger.warning("%s: kv transfer from %s rejected: %s",
+                           self.node_id, peer, val)
+            if rid:
+                self.sender.send(
+                    peer, proto.KV_RESULT,
+                    {"rid": str(rid), "ok": False, "reason": str(val)},
+                    best_effort=True,
+                )
+            return "ok"
+        ckpt = val
+        ok, reason = self._admit_restore(ckpt, peer)
+        self.sender.send(
+            peer, proto.KV_RESULT,
+            {"rid": ckpt.request_id, "ok": ok, "reason": reason},
+            best_effort=True,
+        )
+        return "ok"
+
+    def _on_kv_result(self, _peer: str, payload: dict):
+        self._post(("handoff_result", dict(payload or {})))
+        return "ok"
+
+    def _on_kv_send_failure(self, peer: str, reason: str) -> None:
+        """KV-transfer lane failure. Unlike the data-plane sender,
+        nothing routed through ``peer`` still runs here — handed-off
+        requests were parked/extracted first — so no abort_path scan.
+        Report the peer down (evidence for the sweep) and fail the
+        awaiting transfers over to the checkpoint-only rung."""
+        logger.error("%s: kv lane to %s failed: %s",
+                     self.node_id, peer, reason)
+        from parallax_tpu.obs.flight import get_flight
+
+        get_flight().event(
+            "kv_lane_down", node=self.node_id, peer=peer, reason=reason,
+        )
+        if not self.standalone and peer != self.scheduler_peer:
+            self.sender.send(
+                self.scheduler_peer, proto.PEER_DOWN,
+                {"reporter": self.node_id, "peer": peer,
+                 "reason": f"kv lane: {reason}"},
+                best_effort=True,
+            )
+        self._post(("kv_lane_down", peer))
+
+    def _admit_restore(self, ckpt, peer: str) -> tuple[bool, str]:
+        """Shared admission gate for migrated/handed-off checkpoints
+        (inline rpc_checkpoint batches and assembled KV transfers):
+        duplicate ships ack WITHOUT a second submit, saturation rejects
+        so the source retries elsewhere, and the poll mirror registers
+        BEFORE the ack so redirected pollers never see "unknown
+        request"."""
+        from parallax_tpu.runtime.checkpoint import build_resumed_request
+
+        if self.engine is None:
+            return False, "no engine"
+        if ckpt.request_id in self._chat_requests:
+            # Duplicate ship (our previous ack was lost in flight): the
+            # request is already restoring/running here — ack again
+            # WITHOUT a second submit, or the stream would decode twice.
+            return True, "duplicate"
+        sched = self.engine.scheduler
+        if len(sched.wait_queue) >= sched.max_queue_size:
+            # Acceptance transfers ownership, so the engine submit
+            # (later, on the step thread) must be going to succeed:
+            # reject while saturated and let the source retry — on us
+            # once the queue drains, or on another pipeline.
+            return False, "target queue full"
+        self._chat_requests[ckpt.request_id] = build_resumed_request(ckpt)
+        self._post(("restore", ckpt, peer))
+        return True, ""
+
     def _on_checkpoint(self, peer: str, payload):
         """Target side: validate and accept a batch of migrating
         requests. Acceptance transfers ownership — the source releases
@@ -2061,7 +2902,6 @@ class WorkerNode:
         rejected cleanly (CheckpointError) and the source falls back."""
         from parallax_tpu.runtime.checkpoint import (
             CheckpointError,
-            build_resumed_request,
             checkpoint_from_wire,
         )
 
@@ -2081,34 +2921,11 @@ class WorkerNode:
                                self.node_id, rid, peer, e)
                 rejected[str(rid)] = str(e)
                 continue
-            if self.engine is None:
-                rejected[ckpt.request_id] = "no engine"
-                continue
-            sched = self.engine.scheduler
-            if len(sched.wait_queue) >= sched.max_queue_size:
-                # Acceptance transfers ownership, so the engine submit
-                # (later, on the step thread) must be going to succeed:
-                # reject while saturated and let the source retry — on
-                # us once the queue drains, or on another pipeline.
-                rejected[ckpt.request_id] = "target queue full"
-                continue
-            if ckpt.request_id in self._chat_requests:
-                # Duplicate ship (our previous ack was lost in flight):
-                # the request is already restoring/running here — ack
-                # again WITHOUT a second submit, or the stream would
-                # decode twice.
+            ok, reason = self._admit_restore(ckpt, peer)
+            if ok:
                 accepted.append(ckpt.request_id)
-                continue
-            # Register the poll mirror BEFORE acking acceptance: the
-            # source redirects pollers here the moment the ack lands,
-            # and the actual engine submit runs later on the step
-            # thread — a poll in that window must see the parked prior
-            # stream, not {"error": "unknown request"}.
-            self._chat_requests[ckpt.request_id] = build_resumed_request(
-                ckpt
-            )
-            self._post(("restore", ckpt, peer))
-            accepted.append(ckpt.request_id)
+            else:
+                rejected[ckpt.request_id] = reason
         return {"accepted": accepted, "rejected": rejected}
 
     def _restore_checkpoint(self, ckpt, from_peer: str) -> None:
@@ -2141,6 +2958,13 @@ class WorkerNode:
             # No image to swap in: restart from the original prompt and
             # replay the recorded outputs through decode steps.
             req = build_resumed_request(ckpt, replay=True)
+        if getattr(ckpt, "handoff", False) and from_peer == self.node_id:
+            # Local-restore rung: this PREFILL head is decoding the
+            # request itself (no decode pool). Pin it local or the next
+            # handoff tick would re-flag it the moment it resumes —
+            # a park/restore ping-pong that decodes one token per
+            # scheduler round trip.
+            req.handoff_local = True  # type: ignore[attr-defined]
         self._chat_requests[rid] = req
         try:
             ok = eng.submit(req)
@@ -2156,12 +2980,16 @@ class WorkerNode:
                 logger.exception("restore cleanup failed for %s", rid)
             self._finish(req)
             return
+        handoff = bool(getattr(ckpt, "handoff", False))
         logger.info(
-            "%s: restored migrated request %s from %s (%d prior tokens, "
-            "%s)", self.node_id, rid, from_peer, len(ckpt.output_ids),
+            "%s: restored %s request %s from %s (%d prior tokens, %s)",
+            self.node_id, "handed-off" if handoff else "migrated", rid,
+            from_peer, len(ckpt.output_ids),
             "KV image adopted" if adopted else "re-prefill + replay",
         )
         if not self.standalone:
+            # Handoffs report through the same where_is table: pollers
+            # that lose the prefill head still find the decode head.
             self.sender.send(
                 self.scheduler_peer, "migration_done",
                 {"rid": rid, "head": self.node_id}, best_effort=True,
@@ -2169,7 +2997,8 @@ class WorkerNode:
         from parallax_tpu.obs.flight import get_flight
 
         get_flight().event(
-            "migrate_in", node=self.node_id, request_id=rid,
+            "handoff_in" if handoff else "migrate_in",
+            node=self.node_id, request_id=rid,
             source=from_peer, kv_adopted=adopted,
             prior_tokens=len(ckpt.output_ids),
         )
@@ -2186,15 +3015,26 @@ class WorkerNode:
                 if ckpt.trace_spans:
                     store.adopt(rid, spans_from_wire(ckpt.trace_spans))
                 store.add(
-                    rid, self.node_id, "migrate_in",
+                    rid, self.node_id,
+                    "kv_handoff_in" if handoff else "migrate_in",
                     t0=time.perf_counter(), dur=0.0,
                     args={"source": from_peer, "kv_adopted": adopted},
                 )
             except Exception:  # pragma: no cover - tracing is best-effort
                 logger.exception("trace adoption failed for %s", rid)
-        self._count_migration_in(
-            "kv_image" if adopted else "replay", ckpt.parked_wall
-        )
+        if handoff:
+            # Planned phase handoffs count under their own families so
+            # churn dashboards (parallax_migrations_*) stay churn-only.
+            from parallax_tpu.runtime import kv_handoff as _kvh
+
+            _kvh.record_handoff(
+                "local" if from_peer == self.node_id
+                else ("kv_image" if adopted else "reprefill")
+            )
+        else:
+            self._count_migration_in(
+                "kv_image" if adopted else "replay", ckpt.parked_wall
+            )
 
     def _count_migration_in(self, mode: str, parked_wall: float) -> None:
         """parallax_migrations_total + the park->resume latency

@@ -48,6 +48,23 @@ PEER_DOWN = "peer_down"
 # (scored against each head's CacheIndex mirror, so requests land where
 # their prefix is already cached).
 MIGRATE_TARGET = "migrate_target"
+# Disaggregated prefill/decode serving (docs/disaggregation.md):
+# layer-chunked KV-page handoff frames shipped prefill-head -> decode-
+# head over a DEDICATED AsyncSender lane (so KV bulk never head-of-line
+# blocks FORWARD/control traffic). A transfer is a begin frame (the
+# request checkpoint sans KV + the image header), N layer-chunk frames,
+# and an end frame; the receiver assembles, validates through the strict
+# checkpoint decoder, and admits the request like a preempted resume.
+KV_TRANSFER = "rpc_kv_transfer"
+# Decode head -> prefill head: the outcome of one KV transfer (accepted
+# and queued for restore, or rejected with a reason). The source releases
+# its parked state only on an ok; anything else falls back down the
+# re-prefill ladder.
+KV_RESULT = "kv_handoff_result"
+# Prefill head -> scheduler: decode-pool targets for finished prompts
+# (same CacheIndex scoring as migrate_target, restricted to pipelines
+# whose role admits the decode phase).
+DISAGG_TARGET = "disagg_target"
 
 
 def _build_dtype_registry() -> dict[str, np.dtype]:

@@ -647,11 +647,18 @@ class AsyncSender:
         max_queue: int = 256,
         on_failure: Callable[[str, str], None] | None = None,
         idle_reap_s: float = 300.0,
+        name: str = "",
     ):
         self.transport = transport
         self.max_queue = max_queue
         self.on_failure = on_failure
         self.idle_reap_s = idle_reap_s
+        # Lane label for multi-sender processes (e.g. the disaggregation
+        # KV-transfer lane rides a second AsyncSender so bulk KV frames
+        # never head-of-line block FORWARD/control traffic): prefixes
+        # failure logs and worker thread names so an operator can tell
+        # WHICH lane to a peer failed.
+        self.name = name
         self._links: dict[str, "_PeerLink"] = {}
         self._lock = make_lock("transport.sender")
         self._closed = False
@@ -716,7 +723,8 @@ class AsyncSender:
             )
 
     def _fail(self, peer: str, reason: str) -> None:
-        logger.error("sender: link to %s failed: %s", peer, reason)
+        logger.error("sender%s: link to %s failed: %s",
+                     f"[{self.name}]" if self.name else "", peer, reason)
         if self.on_failure is not None:
             try:
                 self.on_failure(peer, reason)
@@ -800,7 +808,11 @@ class _PeerLink:
             "errors": 0,
         }
         self.thread = threading.Thread(
-            target=self._drain, daemon=True, name=f"sender-{peer}"
+            target=self._drain, daemon=True,
+            name=(
+                f"sender-{sender.name}-{peer}" if sender.name
+                else f"sender-{peer}"
+            ),
         )
         self.thread.start()
 

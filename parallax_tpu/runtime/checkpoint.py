@@ -109,6 +109,11 @@ class RequestCheckpoint:
     # the target's ``/debug/trace/<rid>`` shows one stitched timeline
     # across heads instead of losing the pre-migration history.
     trace_spans: list | None = None
+    # True when this checkpoint is a planned prefill->decode handoff
+    # (docs/disaggregation.md) rather than a churn migration: the target
+    # accounts it under parallax_kv_handoffs_* instead of the migration
+    # families, so churn dashboards stay churn-only.
+    handoff: bool = False
 
 
 # Span-shipping bound: a traced request's decode epochs coalesce
@@ -261,6 +266,7 @@ def checkpoint_to_wire(ckpt: RequestCheckpoint) -> dict:
         "age_s": float(ckpt.age_s),
         "parked_wall": float(ckpt.parked_wall),
         "traced": bool(ckpt.traced),
+        "handoff": bool(ckpt.handoff),
     }
     if ckpt.trace_spans:
         d["trace_spans"] = list(ckpt.trace_spans[:_MAX_TRACE_SPANS])
@@ -418,4 +424,5 @@ def checkpoint_from_wire(d: dict) -> RequestCheckpoint:
         traced=bool(d.get("traced", False)),
         kv=kv,
         trace_spans=trace_spans,
+        handoff=bool(d.get("handoff", False)),
     )

@@ -1,0 +1,362 @@
+"""KV-page handoff: the disaggregation wire between phase pools.
+
+Disaggregated prefill/decode serving (the DistServe / Mooncake pattern;
+docs/disaggregation.md) splits the swarm into phase-specialized replica
+pools: a prefill head computes the prompt, then ships the request — its
+token-level checkpoint (PR 7's :class:`RequestCheckpoint`) plus the
+committed KV pages (PR 2's pinned host image) — to a CacheIndex-scored
+decode replica, which admits it exactly like a preempted resume. This
+module owns the WIRE of that handoff:
+
+- :func:`image_to_frames` splits one :class:`KVImage` into layer-chunked
+  ``KV_TRANSFER`` frames (begin / layers / end) sized to
+  ``chunk_bytes``, so the transfer streams over the dedicated
+  ``AsyncSender`` lane frame by frame — the prefill engine keeps
+  serving (and the decode head starts assembling) while later layers
+  are still in flight, and a mid-transfer failure wastes at most the
+  frames already sent, never a blocked step thread.
+- :class:`HandoffAssembler` reassembles frames on the decode side,
+  enforcing per-transfer deadlines (a source that dies mid-transfer is
+  swept, its partial state discarded — the request recovers through the
+  re-prefill ladder) and validating the completed transfer through the
+  STRICT checkpoint decoder (:func:`checkpoint_from_wire`), so a
+  truncated or corrupt transfer is rejected exactly like a corrupt
+  ``rpc_checkpoint`` frame.
+- The ``parallax_kv_transfer_*`` metric helpers (bytes/frames by
+  direction, transfer-latency histogram, fallback-to-reprefill
+  counters, completed handoffs by restore mode) — all best-effort:
+  telemetry never breaks a transfer.
+
+The fallback ladder (each rung strictly correct, each cheaper to reach):
+prefix-warm target -> checkpoint-only ship (the target re-prefills from
+its own radix, usually a page); transfer failed / rejected / timed out
+-> checkpoint-only re-ship (re-prefill + teacher-forced replay); no
+decode pool -> restore locally (the prefill head decodes it, mixed-mode
+behavior); engine gone -> abort (the only rung that drops the request).
+"""
+
+from __future__ import annotations
+
+import time
+
+from parallax_tpu.p2p import proto
+from parallax_tpu.runtime.checkpoint import (
+    CheckpointError,
+    KVImage,
+    RequestCheckpoint,
+    checkpoint_from_wire,
+)
+from parallax_tpu.utils import get_logger
+from parallax_tpu.analysis.sanitizer import make_lock
+
+logger = get_logger(__name__)
+
+# A transfer whose begin frame arrived but whose end frame has not
+# within this horizon is presumed orphaned (source death, lane failure):
+# the partial state is discarded and the request recovers through the
+# source's own result-timeout / the client resume ladder.
+ASSEMBLY_TIMEOUT_S = 30.0
+
+# Default per-frame payload target for layer chunking. Small enough
+# that a frame serializes in well under a heartbeat on DCN, large
+# enough that a 7B-class stage ships in a handful of frames.
+DEFAULT_CHUNK_BYTES = 4 << 20
+
+
+# -- wire framing ------------------------------------------------------------
+
+
+def image_to_frames(
+    rid: str,
+    ckpt_wire: dict,
+    image: KVImage,
+    chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+) -> list[tuple[dict, int]]:
+    """Split one transfer into ``KV_TRANSFER`` frame payloads.
+
+    Returns ``[(frame, payload_bytes), ...]``: a begin frame carrying
+    the checkpoint (sans KV — that is what the layer frames are for)
+    and the image header, one or more layer-chunk frames grouped to at
+    most ``chunk_bytes`` of tensor payload each (always at least one
+    layer per frame), and an end frame with the expected layer count.
+    Tensors ship at native precision — handoff streams must stay
+    bit-identical to mixed-mode serving, so KV never rides the lossy
+    activation wire dtypes.
+    """
+    ckpt_wire = dict(ckpt_wire)
+    ckpt_wire.pop("kv", None)
+    frames: list[tuple[dict, int]] = [(
+        {
+            "rid": rid,
+            "kind": "begin",
+            "ckpt": ckpt_wire,
+            "header": {
+                "page_size": image.page_size,
+                "start_layer": image.start_layer,
+                "end_layer": image.end_layer,
+                "kv_dtype": image.kv_dtype,
+                "prefix_tokens": image.prefix_tokens,
+                "computed_tokens": image.computed_tokens,
+                "num_layers": len(image.layers),
+            },
+        },
+        0,
+    )]
+    batch: list[dict] = []
+    batch_bytes = 0
+    batch_start = 0
+    for i, arr in enumerate(image.layers):
+        t = proto.tensor_to_wire(arr)
+        nbytes = proto.tensor_nbytes(t)
+        if batch and batch_bytes + nbytes > chunk_bytes:
+            frames.append((
+                {"rid": rid, "kind": "layers", "idx": batch_start,
+                 "layers": batch},
+                batch_bytes,
+            ))
+            batch, batch_bytes, batch_start = [], 0, i
+        batch.append(t)
+        batch_bytes += nbytes
+    if batch:
+        frames.append((
+            {"rid": rid, "kind": "layers", "idx": batch_start,
+             "layers": batch},
+            batch_bytes,
+        ))
+    frames.append((
+        {"rid": rid, "kind": "end", "num_layers": len(image.layers)},
+        0,
+    ))
+    return frames
+
+
+# -- decode-side reassembly --------------------------------------------------
+
+
+class HandoffAssembler:
+    """Per-request reassembly of in-flight KV transfers (decode head).
+
+    Frames for one transfer arrive IN ORDER (the source's kv lane is a
+    per-peer FIFO), but transfers from different sources interleave
+    freely — state is keyed by request id. Thread-safe: transport
+    dispatch threads feed frames while the announcer thread sweeps
+    deadlines.
+    """
+
+    def __init__(self, timeout_s: float = ASSEMBLY_TIMEOUT_S):
+        self.timeout_s = timeout_s
+        self._partial: dict[str, dict] = {}
+        self._lock = make_lock("runtime.kv_handoff")
+        # Monotonic frames-fed counter: the watchdog's progress signal
+        # while a large transfer assembles — frames arriving steadily
+        # IS progress, and a probe that only counted completed
+        # transfers would false-stall a healthy slow link.
+        self.frames_total = 0
+
+    def partial_count(self) -> int:
+        with self._lock:
+            return len(self._partial)
+
+    def feed(
+        self, peer: str, frame: dict
+    ) -> tuple[str, object] | None:
+        """Consume one ``KV_TRANSFER`` frame.
+
+        Returns None while the transfer is still assembling,
+        ``("done", RequestCheckpoint)`` when the end frame completes a
+        valid transfer, or ``("error", reason)`` when the transfer is
+        malformed (the caller nacks the source, which falls back to a
+        checkpoint-only re-ship)."""
+        if not isinstance(frame, dict):
+            return ("error", "frame is not a map")
+        rid = frame.get("rid")
+        if not isinstance(rid, str) or not rid:
+            return ("error", "frame has no request id")
+        kind = frame.get("kind")
+        now = time.monotonic()
+        with self._lock:
+            self.frames_total += 1
+            if kind == "begin":
+                # A duplicate begin (source retry) restarts the
+                # transfer; stale bytes from the first attempt must not
+                # leak into the second.
+                self._partial[rid] = {
+                    "peer": peer,
+                    "ckpt": frame.get("ckpt"),
+                    "header": frame.get("header") or {},
+                    "layers": [],
+                    "bytes": 0,
+                    "frames": 1,
+                    "t0": now,
+                    "deadline": now + self.timeout_s,
+                }
+                return None
+            entry = self._partial.get(rid)
+            if entry is None:
+                # Layer/end frames for a transfer we never began (swept
+                # partial, process restart): reject so the source falls
+                # back instead of waiting for a result that cannot come.
+                return ("error", f"no transfer in progress for {rid}")
+            entry["frames"] += 1
+            if kind == "layers":
+                layers = frame.get("layers")
+                if not isinstance(layers, list):
+                    del self._partial[rid]
+                    return ("error", "layer frame without tensors")
+                if frame.get("idx") != len(entry["layers"]):
+                    # The lane is a FIFO, so a gap means frames were
+                    # dropped (overflow) — the transfer cannot complete.
+                    del self._partial[rid]
+                    return ("error", "layer frames out of sequence")
+                entry["layers"].extend(layers)
+                entry["bytes"] += sum(
+                    proto.tensor_nbytes(t) for t in layers
+                    if isinstance(t, dict)
+                )
+                return None
+            if kind == "end":
+                entry = self._partial.pop(rid)
+            else:
+                del self._partial[rid]
+                return ("error", f"unknown frame kind {kind!r}")
+        # End frame: validate OUTSIDE the lock (numpy reshapes of
+        # multi-MB payloads must not serialize other transfers).
+        want = frame.get("num_layers")
+        if want != len(entry["layers"]):
+            return ("error", (
+                f"transfer truncated: {len(entry['layers'])} of "
+                f"{want} layers"
+            ))
+        ckpt_wire = entry["ckpt"]
+        if not isinstance(ckpt_wire, dict):
+            return ("error", "begin frame carried no checkpoint")
+        ckpt_wire = dict(ckpt_wire)
+        kv_wire = dict(entry["header"], layers=entry["layers"])
+        kv_wire.pop("num_layers", None)
+        ckpt_wire["kv"] = kv_wire
+        try:
+            # The strict checkpoint decoder validates EVERYTHING —
+            # header ranges, per-layer shape/byte agreement, page
+            # coverage — exactly as an inline rpc_checkpoint frame.
+            ckpt = checkpoint_from_wire(ckpt_wire)
+        except CheckpointError as e:
+            return ("error", str(e))
+        ms = (time.monotonic() - entry["t0"]) * 1e3
+        record_transfer(
+            "in", frames=entry["frames"], nbytes=entry["bytes"], ms=ms,
+        )
+        return ("done", ckpt)
+
+    def sweep(self) -> list[tuple[str, str]]:
+        """Discard transfers whose deadline passed (orphaned by a dead
+        source or a failed lane). Returns ``[(rid, peer), ...]`` for
+        logging — the request itself recovers through the source's
+        result timeout or the client resume ladder."""
+        now = time.monotonic()
+        out: list[tuple[str, str]] = []
+        with self._lock:
+            for rid in [
+                r for r, e in self._partial.items()
+                if now > e["deadline"]
+            ]:
+                e = self._partial.pop(rid)
+                out.append((rid, e["peer"]))
+        for rid, peer in out:
+            logger.warning(
+                "kv handoff: transfer of %s from %s abandoned "
+                "mid-flight (no end frame within %.0fs); partial state "
+                "discarded", rid, peer, self.timeout_s,
+            )
+            record_fallback("transfer_abandoned")
+        return out
+
+
+# -- checkpoint helpers ------------------------------------------------------
+
+
+def handoff_checkpoint(
+    req, routing_table: list[str], kv: KVImage | None
+) -> RequestCheckpoint:
+    """A :class:`RequestCheckpoint` marked as a planned handoff (the
+    target accounts it under ``parallax_kv_handoffs_*``, not the churn
+    migration families)."""
+    from parallax_tpu.runtime.checkpoint import checkpoint_from_request
+
+    ckpt = checkpoint_from_request(req, routing_table=routing_table, kv=kv)
+    ckpt.handoff = True
+    return ckpt
+
+
+# -- telemetry (best-effort, never raises) -----------------------------------
+
+
+def record_transfer(
+    direction: str, frames: int, nbytes: int, ms: float | None = None
+) -> None:
+    """Count one completed transfer leg: ``parallax_kv_transfer_bytes/
+    frames_total{direction}`` plus the latency histogram and the
+    goodput ``kv_transfer`` time bucket when ``ms`` is known."""
+    try:
+        from parallax_tpu.obs.registry import get_registry
+
+        reg = get_registry()
+        reg.counter(
+            "parallax_kv_transfer_bytes_total",
+            "KV-page handoff payload bytes over the transfer lane",
+            labelnames=("direction",),
+        ).labels(direction=direction).inc(nbytes)
+        reg.counter(
+            "parallax_kv_transfer_frames_total",
+            "KV_TRANSFER frames over the transfer lane",
+            labelnames=("direction",),
+        ).labels(direction=direction).inc(frames)
+        if ms is not None:
+            reg.histogram(
+                "parallax_kv_transfer_ms",
+                "KV handoff transfer latency, ms (out: first frame "
+                "enqueued -> decode-head result; in: begin frame -> "
+                "image assembled)",
+            ).observe(ms)
+            from parallax_tpu.obs.goodput import get_goodput
+
+            get_goodput().add_time("kv_transfer", ms / 1e3)
+    except Exception:  # pragma: no cover - metrics never break handoffs
+        pass
+
+
+def record_fallback(reason: str) -> None:
+    """One rung down the re-prefill ladder: ``parallax_kv_transfer_
+    fallbacks_total{reason}``. Reasons: prefix_warm (smart skip — the
+    target's radix already covers the image), no_image (nothing to
+    ship: no host tier / partial demotion / multi-stage), layout (the
+    target cannot adopt raw pages), transfer_failed, result_timeout,
+    transfer_abandoned, no_decode_pool (restored locally)."""
+    try:
+        from parallax_tpu.obs.registry import get_registry
+
+        get_registry().counter(
+            "parallax_kv_transfer_fallbacks_total",
+            "KV handoffs that fell back down the re-prefill ladder, "
+            "by rung",
+            labelnames=("reason",),
+        ).labels(reason=reason).inc()
+    except Exception:  # pragma: no cover - metrics never break handoffs
+        pass
+
+
+def record_handoff(mode: str) -> None:
+    """One request restored on a decode head after a planned handoff:
+    ``parallax_kv_handoffs_total{mode}`` with mode ``kv_image`` (raw
+    pages adopted, no re-prefill), ``reprefill`` (checkpoint-only
+    restore), or ``local`` (no decode pool — the prefill head kept
+    it)."""
+    try:
+        from parallax_tpu.obs.registry import get_registry
+
+        get_registry().counter(
+            "parallax_kv_handoffs_total",
+            "Prefill->decode handoffs completed, by restore mode",
+            labelnames=("mode",),
+        ).labels(mode=mode).inc()
+    except Exception:  # pragma: no cover - metrics never break handoffs
+        pass

@@ -90,6 +90,29 @@ class BaseLayerAllocator:
     def allocate(self, standby: list[Node]) -> list[Pipeline]:
         raise NotImplementedError
 
+    def allocate_role_aware(self, standby: list[Node]) -> list[Pipeline]:
+        """Allocate within each phase pool separately so pipelines stay
+        role-homogeneous (docs/disaggregation.md): a pipeline mixing a
+        prefill specialist with a decode specialist could satisfy
+        neither phase's routing restriction. Mixed nodes allocate first
+        — they carry bootstrap (a swarm of only specialists that cannot
+        each complete a pipeline stays unbootstrapped, loudly). Roles
+        partition capacity: a prefill node's layers never complete a
+        decode pipeline."""
+        groups: dict[str, list[Node]] = {}
+        for n in standby:
+            groups.setdefault(getattr(n, "role", "mixed"), []).append(n)
+        out: list[Pipeline] = []
+        for role in ("mixed", "prefill", "decode"):
+            nodes = groups.pop(role, None)
+            if nodes:
+                out.extend(self.allocate(nodes))
+        # Unknown roles (future builds): allocate them among themselves
+        # rather than silently dropping the nodes.
+        for nodes in groups.values():
+            out.extend(self.allocate(nodes))
+        return out
+
     # -- shared machinery -------------------------------------------------
 
     def _build_pipeline(self, group: list[Node]) -> Pipeline | None:

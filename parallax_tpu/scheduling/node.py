@@ -223,6 +223,14 @@ class Node:
     # Wire-format capability list from node_join (dtype names this
     # node's build can decode on activation frames).
     wire_formats: tuple = ()
+    # Phase specialization from node_join (docs/disaggregation.md):
+    # "prefill" nodes compute prompts and hand finished requests to the
+    # decode pool over the KV-transfer lane; "decode" nodes run deep
+    # continuous batches the prompt phase never interrupts; "mixed" (the
+    # default) serves both phases — the pre-disaggregation behavior.
+    # Pipelines are kept role-homogeneous by the allocator, and routing
+    # restricts the prompt phase to prefill/mixed pools.
+    role: str = "mixed"
     # Histogram snapshots from heartbeats (obs/registry.py payload:
     # {metric: {labels: {bounds, counts, sum, count}}}) — merged across
     # nodes into cluster-wide percentiles in /cluster/status.

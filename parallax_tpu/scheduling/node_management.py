@@ -50,6 +50,16 @@ class Pipeline:
     def node_ids(self) -> list[str]:
         return [n.node_id for n in self.nodes]
 
+    @property
+    def role(self) -> str:
+        """Phase pool this pipeline belongs to (docs/disaggregation.md):
+        the members' shared role, or "mixed" when they disagree (the
+        allocator keeps pipelines role-homogeneous, so disagreement only
+        happens on hand-built pipelines — mixed is the safe reading:
+        such a pipeline can serve either phase)."""
+        roles = {getattr(n, "role", "mixed") for n in self.nodes}
+        return roles.pop() if len(roles) == 1 else "mixed"
+
     def latency_ms(self, batch_size: int = 8) -> float:
         total = sum(n.stage_latency_ms(batch_size) for n in self.nodes)
         for a, b in zip(self.nodes, self.nodes[1:]):

@@ -118,20 +118,49 @@ class RoutingStrategy:
             pass
 
 
-def eligible_pipelines(manager: NodeManager) -> list[Pipeline]:
+# Pipeline roles each request phase may dispatch to (docs/
+# disaggregation.md). The prompt phase avoids decode specialists so a
+# long prefill can never interrupt a decode pool's deep batches; the
+# decode phase (KV-handoff targets) avoids prefill specialists so a
+# handed-off request never lands back in the prompt queue.
+_PHASE_ROLES = {
+    "prompt": ("prefill", "mixed"),
+    "decode": ("decode", "mixed"),
+}
+
+
+def eligible_pipelines(
+    manager: NodeManager, phase: str | None = None
+) -> list[Pipeline]:
     """Registered pipelines a request can be dispatched to right now:
     every stage ready, weights at the latest refit version, admission
-    capacity available (the shared gate of RR and cache-aware routing)."""
+    capacity available (the shared gate of RR and cache-aware routing).
+
+    ``phase`` restricts the set to the matching phase pool when the
+    swarm runs disaggregated. The prompt phase FALLS BACK to every
+    eligible pipeline when its pool is empty (prefill specialists all
+    dead or saturated): re-prefilling on the decode pool beats a 503 —
+    availability over specialization, and exactly the chaos contract
+    when the last prefill node dies mid-handoff. The decode phase does
+    NOT fall back to prefill specialists: the caller (handoff ship)
+    keeps the request local instead, which is always correct."""
     pipelines = manager.pipelines
     if not pipelines:
         return []
     latest_refit = max(p.min_refit_version() for p in pipelines)
-    return [
+    ok = [
         p for p in pipelines
         if p.is_ready()
         and p.min_refit_version() >= latest_refit
         and not any(n.load >= n.max_concurrent_requests() for n in p.nodes)
     ]
+    roles = _PHASE_ROLES.get(phase or "")
+    if roles is None:
+        return ok
+    pool = [p for p in ok if p.role in roles]
+    if not pool and phase == "prompt":
+        return ok
+    return pool
 
 
 class RoundRobinRouting(RoutingStrategy):
@@ -146,7 +175,12 @@ class RoundRobinRouting(RoutingStrategy):
         pipelines = self.manager.pipelines
         if not pipelines:
             return None
-        ok = {p.pipeline_id for p in eligible_pipelines(self.manager)}
+        # Initial dispatch IS the prompt phase: decode specialists are
+        # skipped while a prefill/mixed pool is serviceable.
+        ok = {
+            p.pipeline_id
+            for p in eligible_pipelines(self.manager, phase="prompt")
+        }
         for off in range(len(pipelines)):
             p = pipelines[(self._cursor + off) % len(pipelines)]
             if p.pipeline_id not in ok:
@@ -187,7 +221,10 @@ class CacheAwareRouting(RoutingStrategy):
         self._cursor = 0   # tie-break rotation so equal scores spread
 
     def find_path(self, meta: RequestMeta | None = None) -> list[Node] | None:
-        candidates = eligible_pipelines(self.manager)
+        # Initial dispatch IS the prompt phase (docs/disaggregation.md):
+        # decode specialists are skipped while a prefill/mixed pool is
+        # serviceable; the handoff chooses the decode replica later.
+        candidates = eligible_pipelines(self.manager, phase="prompt")
         if not candidates:
             return None
         self._cursor += 1

@@ -125,6 +125,12 @@ class GlobalScheduler:
         self._migrations: "OrderedDict[str, str]" = OrderedDict()
         self.migration_stats = {"drains": 0, "targets_chosen": 0,
                                 "recorded": 0}
+        # Disaggregation handoff telemetry (docs/disaggregation.md):
+        # decode-pool target queries from prefill heads, targets chosen,
+        # and queries that found no serviceable decode/mixed pipeline
+        # (the head then keeps the request local).
+        self.disagg_stats = {"target_queries": 0, "targets_chosen": 0,
+                             "no_target": 0}
         # Cluster event timeline (obs/timeline.py): workers ship
         # sequence-numbered flight-event batches in heartbeats; the ring
         # merges them — plus the scheduler's own join/leave/peer_down
@@ -147,9 +153,9 @@ class GlobalScheduler:
 
     def enqueue_join(
         self, node_id: str, hardware: HardwareInfo,
-        wire_formats: list | None = None,
+        wire_formats: list | None = None, role: str | None = None,
     ) -> None:
-        self._events.put(("join", node_id, hardware, wire_formats))
+        self._events.put(("join", node_id, hardware, wire_formats, role))
 
     def enqueue_leave(self, node_id: str) -> None:
         self._events.put(("leave", node_id))
@@ -238,7 +244,8 @@ class GlobalScheduler:
     # -- live migration ----------------------------------------------------
 
     def choose_migration_targets(
-        self, requests: list[dict], exclude: "set[str] | None" = None
+        self, requests: list[dict], exclude: "set[str] | None" = None,
+        pool: str | None = None,
     ) -> dict:
         """Pick a surviving pipeline per parked request, scored the
         cache-aware way: ``alpha * predicted_uncached + beta *
@@ -248,7 +255,12 @@ class GlobalScheduler:
         the restore degrades to re-prefill of only the uncovered
         suffix. Requests without a usable chain fall back to
         least-loaded. Charges router load per chosen path (released by
-        the target head's eventual request_complete)."""
+        the target head's eventual request_complete).
+
+        ``pool="decode"`` restricts candidates to the decode phase pool
+        (disaggregation handoff targets, docs/disaggregation.md): the
+        decode phase never falls back to prefill specialists — an empty
+        result tells the prefill head to keep the request local."""
         from parallax_tpu.scheduling.request_routing import (
             eligible_pipelines,
         )
@@ -256,9 +268,14 @@ class GlobalScheduler:
         excl = set(exclude or ())
         out: dict = {}
         candidates = [
-            p for p in eligible_pipelines(self.manager)
+            p for p in eligible_pipelines(self.manager, phase=pool)
             if not (set(p.node_ids) & excl)
         ]
+        if pool == "decode":
+            with self._lock:
+                self.disagg_stats["target_queries"] += len(requests)
+                if not candidates:
+                    self.disagg_stats["no_target"] += len(requests)
         if not candidates:
             return out
         for r in requests:
@@ -297,10 +314,14 @@ class GlobalScheduler:
             if best is None:
                 continue
             self.router.on_dispatch(best.nodes)
-            # migrate_target RPCs land on the service thread while the
-            # sweep/heartbeat threads read these stats for /cluster/status.
+            # migrate_target / disagg_target RPCs land on the service
+            # thread while the sweep/heartbeat threads read these stats
+            # for /cluster/status.
             with self._lock:
-                self.migration_stats["targets_chosen"] += 1
+                if pool == "decode":
+                    self.disagg_stats["targets_chosen"] += 1
+                else:
+                    self.migration_stats["targets_chosen"] += 1
             out[rid] = {
                 "path": list(best.node_ids),
                 "head_layers": [
@@ -379,9 +400,20 @@ class GlobalScheduler:
             node = Node(node_id=node_id, hardware=hardware, model=self.model)
             if rest and rest[0]:
                 node.wire_formats = tuple(rest[0])
+            if len(rest) > 1 and rest[1]:
+                # Phase specialization (docs/disaggregation.md): the
+                # allocator keeps pipelines role-homogeneous and the
+                # router phase-filters pools. Unknown strings degrade
+                # to mixed — a newer worker build must still serve.
+                role = str(rest[1]).lower()
+                node.role = (
+                    role if role in ("prefill", "decode", "mixed")
+                    else "mixed"
+                )
             self.manager.add(node)
-            logger.info("node %s joined (%s x%d)", node_id,
-                        hardware.device_kind, hardware.num_chips)
+            logger.info("node %s joined (%s x%d, role=%s)", node_id,
+                        hardware.device_kind, hardware.num_chips,
+                        node.role)
             self._try_bootstrap_or_extend()
         elif kind == "leave":
             self._handle_leave(ev[1])
@@ -476,7 +508,7 @@ class GlobalScheduler:
         if not self.bootstrapped.is_set():
             if len(self.manager) < self.min_nodes:
                 return
-            pipelines = self.allocator.allocate(standby)
+            pipelines = self.allocator.allocate_role_aware(standby)
             if not pipelines:
                 return
             self.manager.register_pipelines(pipelines)
@@ -485,7 +517,7 @@ class GlobalScheduler:
         else:
             # Serving already: extend with new pipelines when standby nodes
             # suffice (reference RR extend path).
-            pipelines = self.allocator.allocate(standby)
+            pipelines = self.allocator.allocate_role_aware(standby)
             if pipelines:
                 self.manager.register_pipelines(pipelines)
                 self._log_allocation("extend")
@@ -793,6 +825,30 @@ class GlobalScheduler:
         # predicted-vs-actual prefix-hit aggregate.
         with self._lock:
             accuracy = dict(self.routing_accuracy)
+            disagg = dict(self.disagg_stats)
+        # Per-phase pool breakdown (docs/disaggregation.md): operators
+        # must see prefill-pool vs decode-pool saturation SEPARATELY —
+        # a swarm can be prompt-bound with an idle decode pool (or vice
+        # versa) while the aggregate load looks healthy. ``in_flight``
+        # is the heads' heartbeat-reported engine depth (running + the
+        # worker-side wait queue), so it IS the pool's queue depth;
+        # ``queued_unrouted`` counts requests still waiting for a path.
+        pools: dict[str, dict] = {}
+        for p in self.manager.pipelines:
+            d = pools.setdefault(
+                p.role,
+                {"pipelines": 0, "in_flight": 0, "capacity": 0},
+            )
+            d["pipelines"] += 1
+            d["in_flight"] += p.nodes[0].load
+            d["capacity"] += min(
+                n.max_concurrent_requests() for n in p.nodes
+            )
+        for d in pools.values():
+            d["utilization"] = (
+                round(d["in_flight"] / d["capacity"], 4)
+                if d["capacity"] else 0.0
+            )
         report["routing"] = {
             "strategy": self.routing_name,
             "decisions": dict(self.router.decision_counters),
@@ -801,6 +857,16 @@ class GlobalScheduler:
                 for pid, n in self.router.pipeline_dispatches.items()
             },
             "predicted_vs_actual": accuracy,
+            "pools": pools,
+            "queued_unrouted": self._requests.qsize(),
+        }
+        # Disaggregated serving rollup: active when a prefill pool and a
+        # decode-capable pool are both registered; handoff counters from
+        # the decode-pool target chooser.
+        report["disagg"] = {
+            "active": "prefill" in pools
+            and any(r in pools for r in ("decode", "mixed")),
+            **disagg,
         }
         # Node-churn robustness: drain directives issued, migration
         # targets chosen, restores reported back by target heads.
@@ -808,12 +874,16 @@ class GlobalScheduler:
         report["pipelines"] = [
             {
                 "id": p.pipeline_id,
+                # Phase pool this pipeline serves (docs/disaggregation.md).
+                "role": p.role,
                 "nodes": [
                     {
                         "node_id": n.node_id,
                         "layers": [n.start_layer, n.end_layer],
                         "load": n.load,
                         "ready": n.is_ready,
+                        # Phase specialization from node_join.
+                        "role": n.role,
                         # Probation (busy-reload grace) / dead-peer
                         # report state from the heartbeat sweep.
                         "suspect": n.suspect,

@@ -1,0 +1,746 @@
+"""Disaggregated prefill/decode serving (docs/disaggregation.md).
+
+Covers the whole stack: phase roles on nodes/pipelines and the
+role-homogeneous allocator, phase-filtered routing pools (prompt phase
+avoids decode specialists, falls back for availability), decode-pool
+target choice, the KV-transfer wire (layer-chunked frame round trip,
+corrupt/truncated transfers rejected through the strict checkpoint
+decoder, orphan sweeping), the client resume rung (replay_ids on
+chat_submit), and the end-to-end contract: a prefill+decode swarm serves
+greedy and seeded streams BIT-IDENTICAL to a mixed swarm — sync and
+overlapped, K=1 and K>1 — with handoffs observable in the
+parallax_kv_handoffs/kv_transfer families; killing the prefill node
+mid-transfer drops zero requests (re-prefill on the decode pool).
+"""
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from parallax_tpu.config import normalize_config, resolve_role
+from parallax_tpu.runtime.checkpoint import (
+    KVImage,
+    RequestCheckpoint,
+    checkpoint_from_wire,
+    checkpoint_to_wire,
+)
+from parallax_tpu.runtime.kv_handoff import (
+    HandoffAssembler,
+    image_to_frames,
+)
+from parallax_tpu.runtime.request import Request, SamplingParams
+from parallax_tpu.scheduling.scheduler import GlobalScheduler
+from parallax_tpu.utils.hw import HardwareInfo
+
+TINY = normalize_config(dict(
+    architectures=["Qwen2ForCausalLM"],
+    hidden_size=64, num_hidden_layers=4, num_attention_heads=4,
+    num_key_value_heads=2, intermediate_size=128, vocab_size=151,
+    max_position_embeddings=256,
+))
+
+V5E = HardwareInfo("v5e", 1, 197.0, 16.0, 819.0, 186.0)
+
+
+def wait_for(cond, timeout=10.0, interval=0.01):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# -- roles + pools -----------------------------------------------------------
+
+
+def test_resolve_role():
+    assert resolve_role(None) == "mixed"
+    assert resolve_role("") == "mixed"
+    assert resolve_role("Prefill") == "prefill"
+    with pytest.raises(ValueError):
+        resolve_role("both")
+
+
+def _node(nid, role="mixed", ready=True, layers=(0, 4)):
+    from parallax_tpu.scheduling.node import Node
+
+    n = Node(node_id=nid, hardware=V5E, model=TINY, role=role)
+    n.set_layers(*layers)
+    n.is_ready = ready
+    return n
+
+
+def _manager(*pipes):
+    """NodeManager with hand-registered single/multi-stage pipelines:
+    each arg is a list of (nid, role) stage tuples."""
+    from parallax_tpu.scheduling.node_management import (
+        NodeManager,
+        Pipeline,
+    )
+
+    mgr = NodeManager(TINY.num_hidden_layers)
+    for stages in pipes:
+        nodes = []
+        per = TINY.num_hidden_layers // len(stages)
+        for i, (nid, role) in enumerate(stages):
+            n = _node(nid, role=role, layers=(i * per, (i + 1) * per))
+            mgr.add(n)
+            nodes.append(n)
+        mgr.register_pipelines([Pipeline(nodes=nodes)])
+    return mgr
+
+
+def test_pipeline_role_derivation():
+    mgr = _manager(
+        [("p0", "prefill"), ("p1", "prefill")],
+        [("d0", "decode")],
+        [("x0", "prefill"), ("x1", "decode")],
+    )
+    roles = [p.role for p in mgr.pipelines]
+    assert roles == ["prefill", "decode", "mixed"]
+
+
+def test_phase_filtered_eligibility_and_prompt_fallback():
+    from parallax_tpu.scheduling.request_routing import eligible_pipelines
+
+    mgr = _manager([("p0", "prefill")], [("d0", "decode")],
+                   [("m0", "mixed")])
+    ids = lambda ps: sorted(p.nodes[0].node_id for p in ps)
+    assert ids(eligible_pipelines(mgr)) == ["d0", "m0", "p0"]
+    assert ids(eligible_pipelines(mgr, phase="prompt")) == ["m0", "p0"]
+    assert ids(eligible_pipelines(mgr, phase="decode")) == ["d0", "m0"]
+    # Prompt phase falls back to EVERYTHING eligible when its pool is
+    # gone (availability over specialization — the chaos contract);
+    # the decode phase does not (the caller keeps the request local).
+    mgr2 = _manager([("d0", "decode")])
+    assert ids(eligible_pipelines(mgr2, phase="prompt")) == ["d0"]
+    assert eligible_pipelines(mgr2, phase="decode")
+    mgr3 = _manager([("p0", "prefill")])
+    assert ids(eligible_pipelines(mgr3, phase="prompt")) == ["p0"]
+    assert eligible_pipelines(mgr3, phase="decode") == []
+
+
+def test_role_aware_allocation_keeps_pools_separate():
+    from parallax_tpu.scheduling.layer_allocation import (
+        GreedyLayerAllocator,
+    )
+
+    nodes = [
+        _node("p0", "prefill", layers=(-1, -1)),
+        _node("d0", "decode", layers=(-1, -1)),
+        _node("m0", "mixed", layers=(-1, -1)),
+    ]
+    for n in nodes:
+        n.clear_layers()
+    pipes = GreedyLayerAllocator(TINY.num_hidden_layers).allocate_role_aware(
+        nodes
+    )
+    assert len(pipes) == 3
+    assert sorted(p.role for p in pipes) == ["decode", "mixed", "prefill"]
+    for p in pipes:
+        assert len({n.role for n in p.nodes}) == 1
+
+
+def test_scheduler_join_role_and_status_pools():
+    sched = GlobalScheduler(TINY, min_nodes_bootstrapping=2)
+    sched.start()
+    try:
+        sched.enqueue_join("p0", V5E, role="prefill")
+        sched.enqueue_join("d0", V5E, role="decode")
+        assert wait_for(lambda: len(sched.manager.pipelines) >= 2)
+        for nid in ("p0", "d0"):
+            sched.enqueue_update(nid, is_ready=True)
+        assert wait_for(
+            lambda: all(
+                sched.manager.get(n).is_ready for n in ("p0", "d0")
+            )
+        )
+        st = sched.cluster_status()
+        assert {p["role"] for p in st["pipelines"]} == {
+            "prefill", "decode",
+        }
+        pools = st["routing"]["pools"]
+        assert set(pools) == {"prefill", "decode"}
+        for d in pools.values():
+            assert d["pipelines"] == 1
+            assert d["capacity"] > 0
+            assert "utilization" in d and "in_flight" in d
+        assert st["disagg"]["active"] is True
+        assert "queued_unrouted" in st["routing"]
+    finally:
+        sched.stop()
+
+
+def test_decode_pool_targets_exclude_prefill():
+    sched = GlobalScheduler(TINY, min_nodes_bootstrapping=2)
+    sched.start()
+    try:
+        sched.enqueue_join("p0", V5E, role="prefill")
+        sched.enqueue_join("d0", V5E, role="decode")
+        assert wait_for(lambda: len(sched.manager.pipelines) >= 2)
+        for nid in ("p0", "d0"):
+            sched.enqueue_update(nid, is_ready=True)
+        assert wait_for(
+            lambda: all(
+                sched.manager.get(n).is_ready for n in ("p0", "d0")
+            )
+        )
+        t = sched.choose_migration_targets(
+            [{"rid": "r1", "prompt_tokens": 16, "lora_id": None}],
+            exclude={"p0"}, pool="decode",
+        )
+        assert t["r1"]["path"] == ["d0"]
+        assert sched.disagg_stats["targets_chosen"] == 1
+        # A prefill-only swarm has NO decode targets — the head keeps
+        # the request local instead of bouncing it back to a prompt
+        # queue.
+        t2 = sched.choose_migration_targets(
+            [{"rid": "r2", "prompt_tokens": 16, "lora_id": None}],
+            exclude={"d0"}, pool="decode",
+        )
+        assert t2 == {}
+        assert sched.disagg_stats["no_target"] == 1
+    finally:
+        sched.stop()
+
+
+# -- KV-transfer wire --------------------------------------------------------
+
+
+def _image(n_layers=4, n_pages=3, page=4):
+    rng = np.random.default_rng(7)
+    return KVImage(
+        page_size=page, start_layer=0, end_layer=n_layers,
+        kv_dtype="float32", prefix_tokens=0, computed_tokens=n_pages * page,
+        layers=[
+            rng.standard_normal((n_pages, 2, page, 2, 8), dtype=np.float32)
+            for _ in range(n_layers)
+        ],
+    )
+
+
+def _ckpt_wire(rid="h-1"):
+    return checkpoint_to_wire(RequestCheckpoint(
+        request_id=rid, prompt_ids=list(range(5, 17)),
+        output_ids=[20, 21], output_logprobs=[-0.5, -0.25],
+        sampling_params=SamplingParams(
+            temperature=0.0, max_new_tokens=16,
+        ).to_dict(),
+        eos_token_ids=[0], lora_id=None, routing_table=["d0"],
+        age_s=0.5, parked_wall=1.0, handoff=True,
+    ))
+
+
+class TestKVTransferWire:
+    def test_frames_roundtrip_bitwise(self):
+        image = _image()
+        frames = image_to_frames("h-1", _ckpt_wire(), image,
+                                 chunk_bytes=1)   # one layer per frame
+        kinds = [f["kind"] for f, _b in frames]
+        assert kinds[0] == "begin" and kinds[-1] == "end"
+        assert kinds.count("layers") == len(image.layers)
+        asm = HandoffAssembler()
+        done = None
+        for f, _b in frames:
+            res = asm.feed("p0", f)
+            if res is not None:
+                assert res[0] == "done", res
+                done = res[1]
+        assert done is not None and asm.partial_count() == 0
+        assert done.handoff is True
+        assert done.kv is not None
+        assert done.kv.computed_tokens == image.computed_tokens
+        for a, b in zip(done.kv.layers, image.layers):
+            assert a.dtype == b.dtype and (a == b).all()
+
+    def test_chunking_groups_layers(self):
+        image = _image(n_layers=4)
+        per_layer = image.layers[0].nbytes
+        frames = image_to_frames("h-1", _ckpt_wire(), image,
+                                 chunk_bytes=2 * per_layer)
+        layer_frames = [f for f, _b in frames if f["kind"] == "layers"]
+        assert len(layer_frames) == 2
+        assert [f["idx"] for f in layer_frames] == [0, 2]
+
+    def test_truncated_transfer_rejected(self):
+        image = _image()
+        frames = image_to_frames("h-1", _ckpt_wire(), image, chunk_bytes=1)
+        asm = HandoffAssembler()
+        # Drop one layer frame: the gap must reject the transfer (the
+        # first out-of-order frame kills it; later frames then see no
+        # transfer in progress — also an error, never a silent accept).
+        errors = []
+        for f, _b in frames[:2] + frames[3:]:
+            res = asm.feed("p0", f)
+            if res is not None:
+                assert res[0] == "error", res
+                errors.append(res[1])
+        assert any(
+            "out of sequence" in e or "truncated" in e for e in errors
+        ), errors
+        assert asm.partial_count() == 0
+
+    def test_corrupt_tensor_rejected_by_checkpoint_decoder(self):
+        image = _image()
+        frames = image_to_frames("h-1", _ckpt_wire(), image, chunk_bytes=1)
+        # Truncate one tensor's bytes: shape/byte disagreement.
+        frames[2][0]["layers"][0]["data"] = (
+            frames[2][0]["layers"][0]["data"][:-8]
+        )
+        asm = HandoffAssembler()
+        res = None
+        for f, _b in frames:
+            res = asm.feed("p0", f)
+        assert res is not None and res[0] == "error"
+
+    def test_unknown_rid_and_unknown_kind(self):
+        asm = HandoffAssembler()
+        res = asm.feed("p0", {"rid": "x", "kind": "layers", "idx": 0,
+                              "layers": []})
+        assert res == ("error", "no transfer in progress for x")
+        asm.feed("p0", {"rid": "x", "kind": "begin", "ckpt": {},
+                        "header": {}})
+        res = asm.feed("p0", {"rid": "x", "kind": "bogus"})
+        assert res is not None and res[0] == "error"
+
+    def test_interleaved_transfers(self):
+        asm = HandoffAssembler()
+        img = _image(n_layers=2)
+        fa = image_to_frames("a", _ckpt_wire("a"), img, chunk_bytes=1)
+        fb = image_to_frames("b", _ckpt_wire("b"), img, chunk_bytes=1)
+        done = {}
+        for f, _b in [x for pair in zip(fa, fb) for x in pair]:
+            res = asm.feed("p0", f)
+            if res is not None:
+                assert res[0] == "done"
+                done[res[1].request_id] = res[1]
+        assert set(done) == {"a", "b"}
+
+    def test_sweep_discards_orphans(self):
+        asm = HandoffAssembler(timeout_s=0.0)
+        asm.feed("p0", {"rid": "x", "kind": "begin", "ckpt": {},
+                        "header": {}})
+        assert asm.partial_count() == 1
+        swept = asm.sweep()
+        assert swept == [("x", "p0")]
+        assert asm.partial_count() == 0
+
+
+# -- e2e swarm helpers -------------------------------------------------------
+
+
+def _stage_params(model):
+    return model.init_params(
+        jax.random.key(model.start_layer * 1000 + model.end_layer),
+        dtype=jnp.float32,
+    )
+
+
+GEN = 16
+
+
+def _request_set(n=4):
+    base = [7, 8, 9, 10] * 4
+    out = []
+    for i in range(n):
+        sp = (
+            SamplingParams(temperature=0.0, max_new_tokens=GEN,
+                           ignore_eos=True)
+            if i % 2 == 0 else
+            SamplingParams(temperature=0.8, top_k=8, seed=55 + i,
+                           max_new_tokens=GEN, ignore_eos=True)
+        )
+        out.append((base + [30 + i, 40 + i, 50 + i], sp))
+    return out
+
+
+def _swarm(chaos, roles, decode_lookahead=1, overlap=True,
+           host_cache=1 << 24, chunk_bytes=1 << 20, min_pipelines=None):
+    """len(roles) workers behind a cache-aware scheduler, each tagged
+    with its phase role (single-stage full-model pipelines unless the
+    caller capped per-node layer capacity — then ``min_pipelines``
+    says how many pipelines bootstrap must form)."""
+    from parallax_tpu.backend.run import SwarmClient
+    from parallax_tpu.backend.scheduler_service import SchedulerService
+    from parallax_tpu.p2p.node import WorkerNode
+    from parallax_tpu.p2p.transport import LoopbackTransport
+
+    registry: dict = {}
+    sched = GlobalScheduler(TINY, min_nodes_bootstrapping=len(roles),
+                            heartbeat_timeout_s=2.0,
+                            routing="cache_aware")
+    wrap = chaos.wrap if chaos is not None else (lambda t: t)
+    service = SchedulerService(
+        sched, wrap(LoopbackTransport("sched", registry)),
+        join_timeout_s=30.0,
+    )
+    service.start()
+    from parallax_tpu.runtime.engine import EngineConfig
+
+    ecfg = EngineConfig(
+        page_size=8, num_pages=96, max_model_len=192,
+        kv_dtype="float32", max_num_tokens_per_batch=192,
+        max_batch_size=4, overlap_steps=overlap,
+        decode_lookahead=decode_lookahead,
+        host_cache_bytes=host_cache, cache_digests=True,
+    )
+    workers = [
+        WorkerNode(
+            transport=wrap(LoopbackTransport(f"dg{i}", registry)),
+            scheduler_peer="sched",
+            model_config=TINY,
+            engine_config=dataclasses.replace(ecfg),
+            load_params=_stage_params,
+            heartbeat_interval_s=0.1,
+            role=role,
+            kv_transfer_chunk_bytes=chunk_bytes,
+        )
+        for i, role in enumerate(roles)
+    ]
+    starters = [threading.Thread(target=w.start) for w in workers]
+    for s in starters:
+        s.start()
+    for s in starters:
+        s.join(timeout=120.0)
+    want_pipes = (
+        min_pipelines if min_pipelines is not None else len(roles)
+    )
+    assert wait_for(
+        lambda: (
+            len(sched.manager.pipelines) >= want_pipes
+            and all(
+                n.is_ready
+                for p in sched.manager.pipelines for n in p.nodes
+            )
+        ),
+        timeout=60.0,
+    ), sched.cluster_status()
+    client = SwarmClient(
+        wrap(LoopbackTransport("client", registry)), service,
+        poll_interval_s=0.002,
+    )
+    return sched, service, client, workers
+
+
+def _serve(client, tag, prompts_and_sp, on_tokens=None):
+    reqs, evs = [], []
+    for i, (prompt, sp) in enumerate(prompts_and_sp):
+        rid = f"{tag}-{i}"
+        path = client.route(rid, prompt_ids=list(prompt))
+        assert path, f"no path for {rid}"
+        req = Request(
+            request_id=rid, prompt_ids=list(prompt),
+            sampling_params=dataclasses.replace(sp),
+            routing_table=list(path),
+        )
+        evs.append(client.submit(req))
+        reqs.append(req)
+    if on_tokens is not None:
+        fired = set()
+        deadline = time.monotonic() + 60.0
+        while len(fired) < len(reqs) and time.monotonic() < deadline:
+            for i, req in enumerate(reqs):
+                if i not in fired and (
+                    len(req.output_ids) >= 1 or req.status.is_finished
+                ):
+                    fired.add(i)
+                    on_tokens(i, req)
+            time.sleep(0.002)
+    for ev, req in zip(evs, reqs):
+        assert ev.wait(90.0), (
+            f"{req.request_id} stuck: {req.status} "
+            f"({len(req.output_ids)} tokens)"
+        )
+    return reqs
+
+
+def _counter_total(name, labelnames):
+    from parallax_tpu.obs.registry import get_registry
+
+    try:
+        return int(get_registry().counter(
+            name, "", labelnames=labelnames
+        ).total)
+    except Exception:
+        return 0
+
+
+def _handoffs_total():
+    return _counter_total("parallax_kv_handoffs_total", ("mode",))
+
+
+# -- e2e: disaggregated == mixed, bit for bit --------------------------------
+
+
+@pytest.mark.parametrize("decode_lookahead,overlap", [
+    (1, True),
+    (4, True),
+    pytest.param(1, False, marks=pytest.mark.slow),
+    pytest.param(4, False, marks=pytest.mark.slow),
+], ids=["overlap-k1", "multistep-k4", "sync-k1", "sync-k4"])
+def test_disaggregated_streams_bit_identical_to_mixed(
+    decode_lookahead, overlap,
+):
+    """A prefill+decode swarm must produce byte-identical greedy and
+    seeded streams to a mixed swarm serving the same requests, with
+    every request handed off to (and finished on) the decode head."""
+    requests = _request_set()
+
+    sched, service, client, workers = _swarm(
+        None, [None, None], decode_lookahead, overlap,
+    )
+    try:
+        baseline = _serve(client, "mx", requests)
+        base_streams = {
+            r.request_id.split("-", 1)[1]: list(r.output_ids)
+            for r in baseline
+        }
+        assert all(
+            r.status.value != "finished_abort" for r in baseline
+        )
+    finally:
+        for w in workers:
+            w.stop()
+        service.stop()
+
+    before = _handoffs_total()
+    sched, service, client, workers = _swarm(
+        None, ["prefill", "decode"], decode_lookahead, overlap,
+    )
+    try:
+        decode_id = workers[1].node_id
+        disagg = _serve(client, "dg", requests)
+        assert all(
+            r.status.is_finished
+            and r.status.value != "finished_abort" for r in disagg
+        )
+        for r in disagg:
+            key = r.request_id.split("-", 1)[1]
+            assert list(r.output_ids) == base_streams[key], (
+                r.request_id
+            )
+        # Every request crossed the phase boundary: counted handoffs,
+        # and the where_is table points at the decode head.
+        assert _handoffs_total() - before == len(requests)
+        moved = [
+            sched.migrated_head(r.request_id) for r in disagg
+        ]
+        assert all(h == decode_id for h in moved), moved
+        # KV transfer telemetry populated (image path, not re-prefill:
+        # the decode head was cold, layouts identical).
+        assert _counter_total(
+            "parallax_kv_transfer_frames_total", ("direction",)
+        ) > 0
+        st = sched.cluster_status()
+        assert st["disagg"]["active"] is True
+        assert st["disagg"]["targets_chosen"] >= len(requests)
+    finally:
+        for w in workers:
+            w.stop()
+        service.stop()
+
+
+def test_handoff_restores_locally_without_decode_pool():
+    """A prefill-only swarm (operator error / decode pool died) must
+    keep serving: handoffs find no target and restore locally — the
+    mixed-mode rung, zero aborts, streams still exact."""
+    requests = _request_set(2)
+    sched, service, client, workers = _swarm(None, [None])
+    try:
+        baseline = _serve(client, "b", requests)
+        base = {
+            r.request_id.split("-", 1)[1]: list(r.output_ids)
+            for r in baseline
+        }
+    finally:
+        for w in workers:
+            w.stop()
+        service.stop()
+
+    before = _counter_total(
+        "parallax_kv_transfer_fallbacks_total", ("reason",)
+    )
+    handoffs_before = _handoffs_total()
+    sched, service, client, workers = _swarm(None, ["prefill"])
+    try:
+        reqs = _serve(client, "p", requests)
+        assert all(
+            r.status.is_finished
+            and r.status.value != "finished_abort" for r in reqs
+        )
+        for r in reqs:
+            key = r.request_id.split("-", 1)[1]
+            assert list(r.output_ids) == base[key]
+        assert _counter_total(
+            "parallax_kv_transfer_fallbacks_total", ("reason",)
+        ) > before
+        # EXACTLY one local restore per request: the restored request
+        # is pinned local, so the tick never re-flags it into a
+        # park/restore ping-pong.
+        assert _handoffs_total() - handoffs_before == len(requests)
+    finally:
+        for w in workers:
+            w.stop()
+        service.stop()
+
+
+def test_multistage_prefill_pipeline_restores_locally(monkeypatch):
+    """A MULTI-STAGE prefill pipeline with no decode pool must still
+    serve: the local-restore rung keeps the ORIGINAL routing table (the
+    head only hosts its own layer slice — decode must still flow
+    through the downstream stage) and takes the replay path (adopting
+    the KV image on the head alone would starve the downstream stage's
+    KV). Streams must match a mixed multi-stage baseline exactly."""
+    from parallax_tpu.scheduling import node as node_mod
+
+    monkeypatch.setattr(
+        node_mod.RooflinePerformanceModel, "max_layers_in_memory",
+        lambda self, kv_fraction=0.35: 2,
+    )
+    requests = _request_set(2)
+
+    sched, service, client, workers = _swarm(
+        None, [None, None], min_pipelines=1
+    )
+    try:
+        assert len(sched.manager.pipelines[0].nodes) == 2
+        baseline = _serve(client, "mb", requests)
+        base = {
+            r.request_id.split("-", 1)[1]: list(r.output_ids)
+            for r in baseline
+        }
+    finally:
+        for w in workers:
+            w.stop()
+        service.stop()
+
+    sched, service, client, workers = _swarm(
+        None, ["prefill", "prefill"], min_pipelines=1
+    )
+    try:
+        pipes = sched.manager.pipelines
+        assert len(pipes) == 1 and pipes[0].role == "prefill"
+        assert len(pipes[0].nodes) == 2
+        reqs = _serve(client, "mp", requests)
+        assert all(
+            r.status.is_finished
+            and r.status.value != "finished_abort" for r in reqs
+        ), [(r.request_id, r.status) for r in reqs]
+        for r in reqs:
+            key = r.request_id.split("-", 1)[1]
+            assert list(r.output_ids) == base[key], r.request_id
+    finally:
+        for w in workers:
+            w.stop()
+        service.stop()
+
+
+@pytest.mark.slow
+def test_kill_prefill_node_mid_transfer_zero_aborts():
+    """Chaos contract (docs/disaggregation.md): the prefill node dies
+    while KV transfers are in flight. Nothing may abort — pollers
+    recover via where_is (transfer completed) or the client resume rung
+    (re-route + replay onto the surviving decode pool), and every
+    stream stays bit-identical to the healthy baseline."""
+    from parallax_tpu.testing.chaos import ChaosController
+
+    requests = _request_set()
+
+    sched, service, client, workers = _swarm(
+        None, [None, None],
+    )
+    try:
+        baseline = _serve(client, "cb", requests)
+        base = {
+            r.request_id.split("-", 1)[1]: list(r.output_ids)
+            for r in baseline
+        }
+    finally:
+        for w in workers:
+            w.stop()
+        service.stop()
+
+    chaos = ChaosController(seed=5, lock_sanitizer=False)
+    # Tiny chunks + per-frame delay: transfers take ~1s+, so the kill
+    # below lands mid-flight.
+    sched, service, client, workers = _swarm(
+        chaos, ["prefill", "decode"], chunk_bytes=1,
+    )
+    from parallax_tpu.p2p import proto
+
+    chaos.delay_frames(0.15, method=proto.KV_TRANSFER)
+    killed = {}
+    lock = threading.Lock()
+
+    def kill_prefill(_i, _req):
+        with lock:
+            if killed:
+                return
+            killed["node"] = workers[0].node_id
+            # Let the handoff start shipping, then sever the source.
+            time.sleep(0.3)
+            chaos.kill(workers[0])
+
+    try:
+        reqs = _serve(client, "ck", requests, on_tokens=kill_prefill)
+        assert killed, "prefill node was never killed"
+        aborted = [
+            r.request_id for r in reqs
+            if r.status.value == "finished_abort"
+        ]
+        assert aborted == [], aborted
+        for r in reqs:
+            key = r.request_id.split("-", 1)[1]
+            assert list(r.output_ids) == base[key], r.request_id
+    finally:
+        for w in workers:
+            if not chaos.is_dead(w.node_id):
+                w.stop()
+        service.stop()
+
+
+# -- client resume rung ------------------------------------------------------
+
+
+def test_chat_submit_replay_ids_teacher_forces():
+    """The client resume rung: a chat_submit carrying replay_ids must
+    teacher-force exactly those tokens (the stream the dead head
+    already produced) before free-running — bit-identical to an
+    uninterrupted serve."""
+    sched, service, client, workers = _swarm(None, [None])
+    try:
+        prompt, sp = _request_set(1)[0]
+        base = _serve(client, "rb", [(prompt, sp)])[0]
+        stream = list(base.output_ids)
+        assert len(stream) == GEN
+        cut = GEN // 2
+        w = workers[0]
+        w.transport.call(w.node_id, "chat_submit", {
+            "rid": "replayed-1",
+            "prompt_ids": list(prompt),
+            "sampling_params": dataclasses.replace(sp).to_dict(),
+            "routing_table": [w.node_id],
+            "eos_token_ids": [],
+            "replay_ids": stream[:cut],
+        }, timeout=10.0)
+        assert wait_for(
+            lambda: (
+                w._chat_requests.get("replayed-1") is None
+                or w._chat_requests["replayed-1"].status.is_finished
+            ),
+            timeout=60.0,
+        )
+        req = w._chat_requests.get("replayed-1")
+        assert req is not None and req.status.is_finished
+        assert list(req.output_ids) == stream
+    finally:
+        for w in workers:
+            w.stop()
+        service.stop()
