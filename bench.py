@@ -1371,6 +1371,170 @@ def _disagg_probe(cfg, stage_params_fn, kv_dtype, page_size):
     }
 
 
+def _kernel_probe(page_size: int) -> dict:
+    """Decode-kernel microbench (detail.kernel): per-token device ms and
+    tokens/s/chip for the three decode attention implementations on ONE
+    identical ragged batch — ``pallas-fused`` (KV append inside the
+    attention kernel + sort-free fused sampling, one program chain),
+    ``pallas-split`` (the legacy page-grid attention kernel + separate
+    XLA scatter + sort-based sampler) and ``xla`` (the reference path).
+
+    Off-TPU the Pallas impls run in interpret mode — the CI contract
+    asserts fused stays strictly below split there (the fused kernels
+    stream only each row's valid pages and skip the full-vocab sort,
+    the split grid visits every page slot of every row), and that the
+    fused and XLA token streams agree bit-for-bit (greedy + seeded).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from parallax_tpu.ops.attention import _ragged_paged_attention_xla
+    from parallax_tpu.ops.attention_pallas import gqa_decode_attention_pallas
+    from parallax_tpu.ops.decode_fused_pallas import (
+        fused_sample_topk_pallas,
+        gqa_fused_decode_pallas,
+    )
+    from parallax_tpu.ops.kernel_select import fused_interpret
+    from parallax_tpu.ops.kv_cache_ops import reshape_and_cache
+    from parallax_tpu.ops.sampling import row_gumbel, sample_tokens
+
+    interp = fused_interpret()
+    rng = np.random.default_rng(42)
+    s, hq, hkv, d, v, layers = 8, 4, 2, 32, 512, 2
+    page = max(8, page_size)
+    # Ragged context lengths straddling page boundaries; the page table
+    # is what a production decode batch looks like mid-stream.
+    lens = np.array(
+        [17, 4 * page, 33, 5 * page - 1, 9, 6 * page, 50, 70], np.int32
+    )[:s]
+    pps = int(max(lens) // page + 2)
+    num_pages = s * pps + 1
+    pages = np.zeros((s, pps), np.int32)
+    used = 1
+    for i, n in enumerate(lens):
+        npg = (int(n) + page - 1) // page
+        pages[i, :npg] = np.arange(used, used + npg)
+        used += npg
+    slot = np.array(
+        [pages[i, (int(n) - 1) // page] * page + (int(n) - 1) % page
+         for i, n in enumerate(lens)], np.int32,
+    )
+    q = jnp.asarray(rng.normal(size=(s, hq, d)), jnp.float32)
+    k_new = jnp.asarray(rng.normal(size=(s, hkv, d)), jnp.float32)
+    v_new = jnp.asarray(rng.normal(size=(s, hkv, d)), jnp.float32)
+    cache0 = jnp.asarray(
+        rng.normal(size=(num_pages, page, 2 * hkv, d)), jnp.float32
+    )
+    logits = jnp.asarray(rng.normal(size=(s, v)) * 3.0, jnp.float32)
+    lens_j, pages_j, slot_j = (
+        jnp.asarray(lens), jnp.asarray(pages), jnp.asarray(slot)
+    )
+    cu = jnp.arange(s + 1, dtype=jnp.int32)
+    ns = jnp.asarray([s], jnp.int32)
+    temp = jnp.asarray([0.0, 0.8, 0.0, 1.1, 0.7, 0.0, 0.9, 1.0], jnp.float32)
+    top_k = jnp.asarray([0, 8, 0, 16, 4, 0, 8, 0], jnp.int32)
+    ones, zeros = jnp.ones((s,), jnp.float32), jnp.zeros((s,), jnp.float32)
+    seeds = jnp.asarray([11, 12, 13, 14, 15, 16, 17, 18], jnp.int32)
+    steps = jnp.zeros((s,), jnp.int32)
+    key = jax.random.key(9)
+    sm = d ** -0.5
+
+    @jax.jit
+    def chain_fused(cache):
+        out = None
+        for _ in range(layers):
+            out, cache = gqa_fused_decode_pallas(
+                q, k_new, v_new, cache, lens_j, pages_j, slot_j, None,
+                sm_scale=sm, interpret=interp,
+            )
+        gumbel = row_gumbel(key, s, v, seeds, steps)
+        toks = fused_sample_topk_pallas(
+            logits, gumbel, temp, top_k, interpret=interp
+        )
+        return out, toks, cache
+
+    @jax.jit
+    def chain_split(cache):
+        out = None
+        for _ in range(layers):
+            cache = reshape_and_cache(cache, k_new, v_new, slot_j)
+            out = gqa_decode_attention_pallas(
+                q, cache, lens_j, pages_j, None, sm_scale=sm,
+                interpret=interp,
+            )
+        toks = sample_tokens(
+            logits, key, temp, top_k, ones, zeros,
+            seeds=seeds, out_steps=steps,
+        )
+        return out, toks, cache
+
+    @jax.jit
+    def chain_xla(cache):
+        out = None
+        for _ in range(layers):
+            cache = reshape_and_cache(cache, k_new, v_new, slot_j)
+            out = _ragged_paged_attention_xla(
+                q, cache, lens_j, pages_j, cu, ns,
+                sm_scale=sm, sliding_window=None, soft_cap=None,
+                sinks=None,
+            )
+        toks = sample_tokens(
+            logits, key, temp, top_k, ones, zeros,
+            seeds=seeds, out_steps=steps,
+        )
+        return out, toks, cache
+
+    def measure(fn):
+        outs = toks = None
+        for _ in range(3):   # warmup: compile + caches hot
+            outs, toks, _ = fn(cache0)
+            jax.block_until_ready(outs)
+        walls = []
+        for _ in range(9):
+            t0 = time.perf_counter()
+            outs, toks, cend = fn(cache0)
+            jax.block_until_ready((outs, toks, cend))
+            walls.append((time.perf_counter() - t0) * 1000.0)
+        med = statistics.median(walls)
+        return {
+            "device_ms_median": round(med, 3),
+            "per_token_device_ms": round(med / s, 4),
+            "tokens_per_sec_per_chip": round(s / (med / 1000.0), 1),
+        }, np.asarray(outs), np.asarray(toks)
+
+    impls = {}
+    impls["pallas-fused"], out_f, toks_f = measure(chain_fused)
+    impls["pallas-split"], out_s, toks_s = measure(chain_split)
+    impls["xla"], out_x, toks_x = measure(chain_xla)
+    greedy_rows = np.asarray(temp) <= 0.0
+    return {
+        "batch": s,
+        "layers": layers,
+        "page_size": page,
+        "context_lens": [int(x) for x in lens],
+        "interpret_mode": interp,
+        "impls": impls,
+        # The acceptance contract: one fused program chain beats the
+        # split dispatch chain on the same batch, and the fused draws
+        # match the XLA reference bit-for-bit.
+        "fused_below_split": (
+            impls["pallas-fused"]["per_token_device_ms"]
+            < impls["pallas-split"]["per_token_device_ms"]
+        ),
+        "tokens_fused_vs_xla_identical": bool(
+            np.array_equal(toks_f, toks_x)
+        ),
+        "greedy_rows_identical_all_impls": bool(
+            np.array_equal(toks_f[greedy_rows], toks_s[greedy_rows])
+            and np.array_equal(toks_f[greedy_rows], toks_x[greedy_rows])
+        ),
+        "attn_out_close_fused_vs_xla": bool(
+            np.allclose(out_f, out_x, atol=5e-5, rtol=5e-5)
+        ),
+    }
+
+
 def _goodput_payload() -> dict:
     """The process goodput ledger's payload (tokens by usefulness
     bucket, time taxonomy, goodput fraction) for bench JSON."""
@@ -1952,6 +2116,17 @@ def _bench():
             kv_dtype=kv_dtype, page_size=page_size,
         )
 
+    # Decode-kernel microbench: fused vs split vs XLA attention(+append
+    # +sampling) chains on one identical ragged batch — per-token device
+    # ms and tokens/s/chip per impl, plus the fused-below-split and
+    # fused-vs-XLA bit-identity verdicts the CI fused-decode smoke
+    # asserts. Cheap on CPU (interpret mode, part of the smoke
+    # contract); opt-in on TPU (BENCH_KERNEL) where it compiles the
+    # real kernels.
+    kernel_probe = None
+    if not on_tpu or os.environ.get("BENCH_KERNEL"):
+        kernel_probe = _kernel_probe(page_size)
+
     # Disaggregated prefill/decode probe: the same long-prefill +
     # chatty-decode workload served by a mixed pool and by a prefill
     # specialist handing requests to a decode specialist over the
@@ -2048,6 +2223,8 @@ def _bench():
             else "output tokens/sec/chip (CPU smoke, tiny model)"
         )
 
+    # One consistent snapshot feeds both kernel fields below.
+    kernel_summary = engine.kernel_dispatch_summary()
     result = {
         "metric": metric,
         "value": round(tokens_per_sec_per_chip, 1),
@@ -2086,6 +2263,11 @@ def _bench():
             # lives on as decode_step_wall_ms_median. Note an overlapped
             # ticket's wall spans its interleaved next dispatch too.
             "overlap_steps": overlap_on,
+            # Which attention-kernel impl produced the main metric line
+            # (pallas-fused / pallas-split / xla) + the engine's
+            # dispatch counts by (impl, path) — docs/kernels.md.
+            "attn_impl": kernel_summary["impl"],
+            "kernel_dispatches": kernel_summary["dispatch_total"],
             "host_ms_median": round(step_ms, 2),
             "decode_step_wall_ms_median": round(
                 statistics.median(r["wall_times"])
@@ -2155,6 +2337,12 @@ def _bench():
             **(
                 {"disagg": disagg_probe}
                 if disagg_probe is not None else {}
+            ),
+            # Decode-kernel microbench (fused vs split vs XLA per-token
+            # device ms + bit-identity verdicts on one ragged batch).
+            **(
+                {"kernel": kernel_probe}
+                if kernel_probe is not None else {}
             ),
             **(
                 {

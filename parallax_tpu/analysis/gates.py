@@ -100,6 +100,23 @@ GATE_TABLE: tuple[Gate, ...] = (
                "the decode pool re-prefills",
     ),
     Gate(
+        feature="decode_fused",
+        marker="decode-fused sampling disabled",
+        doc="docs/kernels.md",
+        reason="top-p/min-p, top_k beyond FUSED_SAMPLE_TOPK_MAX, and "
+               "host-side logits features (penalties, logprobs, grammar, "
+               "logit_bias) need the sort-based / host-synchronous "
+               "sampler; fused attention stays active",
+    ),
+    Gate(
+        feature="decode_fused",
+        marker="decode-fused kernels disabled: non-TPU backend",
+        doc="docs/kernels.md",
+        reason="auto mode keeps the XLA reference attention path off-TPU; "
+               "--decode-fused forces the fused kernels in Pallas "
+               "interpret mode (CI parity, not a serving configuration)",
+    ),
+    Gate(
         feature="flag:--role",
         marker="ignored in scheduler-less mode",
         doc="docs/disaggregation.md",

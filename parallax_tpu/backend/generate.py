@@ -84,6 +84,7 @@ def generate_main(args) -> int:
             kv_dtype=getattr(args, "kv_dtype", "bfloat16"),
             # None/0 = adaptive multi-step decode (engine default).
             decode_lookahead=getattr(args, "decode_lookahead", None) or None,
+            decode_fused=getattr(args, "decode_fused", None),
         ),
         mesh=mesh,
     )

@@ -137,6 +137,13 @@ class SchedulerService:
                 if isinstance(payload.get("cache_stats"), dict)
                 else None
             ),
+            # Attention-kernel impl + dispatch counts (pallas-fused /
+            # pallas-split / xla) — surfaced per node in /cluster/status.
+            kernel=(
+                payload["kernel"]
+                if isinstance(payload.get("kernel"), dict)
+                else None
+            ),
             # Per-link activation-transport telemetry (bytes each way,
             # serialize/send ms, queue depth, compression ratio) —
             # surfaced per node in /cluster/status.

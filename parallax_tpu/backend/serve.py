@@ -166,6 +166,11 @@ def build_local_frontend(
                     # split device/host, occupancy, demotions,
                     # swap-ins, preemptions).
                     "cache_stats": e.cache_stats(),
+                    # Active attention-kernel impl (pallas-fused /
+                    # pallas-split / xla) + per-path dispatch counts —
+                    # a silent fallback to the split or XLA path is
+                    # visible here (docs/kernels.md).
+                    "kernel": e.kernel_dispatch_summary(),
                 }
                 for e in engines
             ],
@@ -381,6 +386,8 @@ def serve_main(args) -> int:
             # None/0 = adaptive multi-step decode (engine default).
             decode_lookahead=getattr(args, "decode_lookahead", None) or None,
             decode_pipeline=getattr(args, "decode_pipeline", 1) or 1,
+            # Fused decode kernels (None = auto-on-TPU; docs/kernels.md).
+            decode_fused=getattr(args, "decode_fused", None),
             # A configured draft model implies speculation (default k=4).
             speculative_tokens=(
                 (getattr(args, "speculative_tokens", 0) or 0)

@@ -71,6 +71,15 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--decode-pipeline", type=int, default=1,
                        help="chained k-token decode windows per host "
                             "round (hides dispatch latency; 1 = off)")
+    serve.add_argument("--decode-fused", action=argparse.BooleanOptionalAction,
+                       default=None,
+                       help="fused Pallas decode kernels: KV append + "
+                            "attention in one program per layer + "
+                            "sort-free greedy/top-k sampling "
+                            "(docs/kernels.md). Default: auto — on on "
+                            "TPU, XLA reference path elsewhere; "
+                            "--decode-fused off-TPU runs interpret mode "
+                            "(parity testing only)")
     serve.add_argument("--speculative-tokens", type=int, default=0,
                        help="prompt-lookup speculative decoding: propose "
                             "up to N continuation tokens from n-gram "
@@ -280,6 +289,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="chained k-token decode windows per host visit (1 = off)",
     )
     join.add_argument(
+        "--decode-fused", action=argparse.BooleanOptionalAction,
+        default=None,
+        help="fused Pallas decode kernels (KV append + attention + "
+             "fused sampling; default auto-on-TPU — see docs/kernels.md)",
+    )
+    join.add_argument(
         "--compilation-cache-dir", default=None,
         help="persistent XLA compilation cache directory (default: "
              "$PARALLAX_TPU_COMPILE_CACHE or "
@@ -323,6 +338,10 @@ def build_parser() -> argparse.ArgumentParser:
     gen.add_argument("--decode-lookahead", type=int, default=None,
                      help="decode tokens per host visit (default: "
                           "adaptive up to 8; 1 = off)")
+    gen.add_argument("--decode-fused", action=argparse.BooleanOptionalAction,
+                     default=None,
+                     help="fused Pallas decode kernels (default "
+                          "auto-on-TPU — see docs/kernels.md)")
     gen.add_argument(
         "--compilation-cache-dir", default=None,
         help="persistent XLA compilation cache directory (default: "

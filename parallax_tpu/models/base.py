@@ -55,6 +55,13 @@ class BatchInputs:
     decode_only: bool = dataclasses.field(
         default=False, metadata=dict(static=True)
     )
+    # STATIC: fused decode program (EngineConfig.decode_fused): attention
+    # layers append this step's K/V inside the Pallas decode kernel
+    # (ops/decode_fused_pallas.py) instead of a separate scatter dispatch.
+    # Only meaningful with decode_only; part of the jit cache key.
+    decode_fused: bool = dataclasses.field(
+        default=False, metadata=dict(static=True)
+    )
 
 
 class StageModel:
@@ -314,6 +321,7 @@ class StageModel:
             sp_mesh=self.sp_mesh if self._sp_active else None,
             sp_in_mesh=self.sp_in_mesh if self._sp_active else 0,
             decode_only=inputs.decode_only,
+            decode_fused=inputs.decode_fused,
         )
 
     def _decoder_layer(

@@ -22,10 +22,9 @@ from parallax_tpu.models.base import BatchInputs, StageModel
 from parallax_tpu.models.qwen3_moe import MoEStageModel
 from parallax_tpu.models.registry import register_model
 from parallax_tpu.ops.mla import (
-    mla_ragged_attention,
+    mla_append_and_attend,
     mla_rope_permute,
     new_mla_pages,
-    store_mla_cache,
 )
 from parallax_tpu.ops.rope import apply_rope
 
@@ -153,19 +152,22 @@ class DeepseekStageModel(MoEStageModel):
         q_latent, q_pe, latent, k_pe, w_uv, _qr, hq = self._mla_qkv(
             p, x, inputs
         )
-        cache = store_mla_cache(cache, latent, k_pe, inputs.slot_mapping)
-        out_latent = mla_ragged_attention(
+        out_latent, cache = mla_append_and_attend(
             q_latent,
             q_pe,
+            latent,
+            k_pe,
             cache,
             inputs.kv_lens,
             inputs.page_indices,
             inputs.cu_q_lens,
             inputs.num_seqs,
+            inputs.slot_mapping,
             sm_scale=self.sm_scale,
             kv_lora_rank=self.config.mla.kv_lora_rank,
             decode_only=inputs.decode_only,
             use_pallas=self.use_pallas,
+            decode_fused=inputs.decode_fused,
         )
         return self._mla_out(p, out_latent, w_uv, hq), cache
 

@@ -26,11 +26,10 @@ from parallax_tpu.models.base import BatchInputs
 from parallax_tpu.models.deepseek_v3 import DeepseekStageModel
 from parallax_tpu.models.registry import register_model
 from parallax_tpu.ops.dsa import (
-    dsa_indexer_scores,
+    dsa_store_and_score,
     dsa_topk_indices,
     mla_ragged_sparse_attention_xla,
     new_index_pages,
-    store_index_cache,
 )
 from parallax_tpu.ops.mla import new_mla_pages, store_mla_cache
 from parallax_tpu.ops.rope import apply_rope, apply_rope_interleaved
@@ -131,16 +130,19 @@ class DeepseekV32StageModel(DeepseekStageModel):
         q = jnp.concatenate([q_pe, q_nope], axis=-1)
         k = jnp.concatenate([k_pe, k_nope], axis=-1)
 
-        index_cache = store_index_cache(index_cache, k, inputs.slot_mapping)
-
         weights = L.linear(x, p["weights_proj"]).astype(jnp.float32) * (
             d.index_n_heads ** -0.5 * self._idx_softmax_scale
         )
-        scores = dsa_indexer_scores(
-            q, weights, index_cache,
+        # Index-key cache write + full-context scoring through the fused
+        # facade: one Pallas program on the fused decode path, scatter +
+        # split scorer otherwise.
+        scores, index_cache = dsa_store_and_score(
+            q, weights, k, index_cache,
             inputs.kv_lens, inputs.page_indices, inputs.cu_q_lens,
+            inputs.slot_mapping,
             decode_only=inputs.decode_only,
             use_pallas=self.use_pallas,
+            decode_fused=inputs.decode_fused,
         )
         return dsa_topk_indices(scores, index_topk=d.index_topk), index_cache
 

@@ -13,7 +13,8 @@ import jax
 import jax.numpy as jnp
 
 from parallax_tpu.config import ModelConfig
-from parallax_tpu.ops import apply_rope, ragged_paged_attention, reshape_and_cache
+from parallax_tpu.ops import apply_rope, reshape_and_cache
+from parallax_tpu.ops.attention import append_and_attend
 
 
 def rms_norm(
@@ -228,6 +229,7 @@ def paged_attention_block(
     sp_mesh=None,
     sp_in_mesh: int = 0,
     decode_only: bool = False,
+    decode_fused: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """GQA attention over the paged cache: project, rope, scatter, attend.
 
@@ -262,7 +264,8 @@ def paged_attention_block(
     q = rope_fn(q, positions, cos_table, sin_table)
     k = rope_fn(k, positions, cos_table, sin_table)
 
-    kv_pages = reshape_and_cache(kv_pages, k, v, slot_mapping)
+    if sp_in_mesh > 1 or sp_mesh is not None:
+        kv_pages = reshape_and_cache(kv_pages, k, v, slot_mapping)
     if sp_in_mesh > 1:
         # SP x TP composition: we are ALREADY inside the TP stage's
         # shard_map (mesh axes ("sp", "tp"); everything here replicated
@@ -293,18 +296,23 @@ def paged_attention_block(
             sp_mesh, q, k, v, positions, sm_scale=d**-0.5,
         )
     else:
-        out = ragged_paged_attention(
-            q,
-            kv_pages,
+        # The common path: cache write + attention through the single
+        # append_and_attend facade — one fused Pallas program per layer
+        # when ``decode_fused`` is active on a decode batch, the split
+        # scatter-then-attend dispatch chain otherwise.
+        out, kv_pages = append_and_attend(
+            q, k, v, kv_pages,
             kv_lens,
             page_indices,
             cu_q_lens,
             num_seqs,
+            slot_mapping,
             sm_scale=d**-0.5,
             sliding_window=sliding_window,
             sinks=p.get("sinks"),
             use_pallas=use_pallas,
             decode_only=decode_only,
+            decode_fused=decode_fused,
         )
     out = row_parallel_linear(out.reshape(t, hq * d), p["o_proj"], axis_name)
     return out, kv_pages

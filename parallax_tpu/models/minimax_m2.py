@@ -17,8 +17,7 @@ from parallax_tpu.models import layers as L
 from parallax_tpu.models.base import BatchInputs
 from parallax_tpu.models.qwen3_moe import MoEStageModel
 from parallax_tpu.models.registry import register_model
-from parallax_tpu.ops.attention import ragged_paged_attention
-from parallax_tpu.ops.kv_cache_ops import reshape_and_cache
+from parallax_tpu.ops.attention import append_and_attend
 
 
 @register_model("MiniMaxM2ForCausalLM")
@@ -61,11 +60,12 @@ class MiniMaxM2StageModel(MoEStageModel):
 
         q = self.rope_fn(q, inputs.positions, self.cos_table, self.sin_table)
         k = self.rope_fn(k, inputs.positions, self.cos_table, self.sin_table)
-        kv = reshape_and_cache(kv, k, v, inputs.slot_mapping)
-        out = ragged_paged_attention(
-            q, kv, inputs.kv_lens, inputs.page_indices, inputs.cu_q_lens,
-            inputs.num_seqs, sm_scale=d**-0.5, sliding_window=window,
+        out, kv = append_and_attend(
+            q, k, v, kv, inputs.kv_lens, inputs.page_indices,
+            inputs.cu_q_lens, inputs.num_seqs, inputs.slot_mapping,
+            sm_scale=d**-0.5, sliding_window=window,
             use_pallas=self.use_pallas, decode_only=inputs.decode_only,
+            decode_fused=inputs.decode_fused,
         )
         return (
             L.row_parallel_linear(out.reshape(t, hq * d), p["o_proj"],

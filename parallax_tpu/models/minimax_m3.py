@@ -24,12 +24,11 @@ from parallax_tpu.models.moe import moe_ffn
 from parallax_tpu.models.qwen3_moe import MoEStageModel
 from parallax_tpu.models.registry import register_model
 from parallax_tpu.ops.kv_cache_ops import new_kv_pages, reshape_and_cache
-from parallax_tpu.ops.attention import ragged_paged_attention
+from parallax_tpu.ops.attention import append_and_attend
 from parallax_tpu.ops.msa import (
-    msa_sparse_positions,
+    msa_store_and_positions,
     new_index_pages,
     paged_sparse_gqa_attention_xla,
-    store_index_cache,
 )
 
 
@@ -122,9 +121,10 @@ class MiniMaxM3StageModel(MoEStageModel):
             kv_pages, index_pages = kv
         else:
             kv_pages, index_pages = kv, None
-        kv_pages = reshape_and_cache(kv_pages, k, v, inputs.slot_mapping)
 
         if sparse:
+            kv_pages = reshape_and_cache(kv_pages, k, v,
+                                         inputs.slot_mapping)
             msa = cfg.msa
             idx_q = L.linear(h, p["index_q_proj"]).reshape(
                 t, msa.index_n_heads, msa.index_head_dim
@@ -138,11 +138,13 @@ class MiniMaxM3StageModel(MoEStageModel):
                                  self.sin_table)
             idx_k = self.rope_fn(idx_k, inputs.positions, self.cos_table,
                                  self.sin_table)
-            index_pages = store_index_cache(index_pages, idx_k,
-                                            inputs.slot_mapping)
-            positions = msa_sparse_positions(
-                idx_q, index_pages,
+            # Index-key cache write + block scoring through the fused
+            # facade: one Pallas program on the fused decode path,
+            # scatter + split scorer otherwise.
+            positions, index_pages = msa_store_and_positions(
+                idx_q, idx_k, index_pages,
                 inputs.kv_lens, inputs.page_indices, inputs.cu_q_lens,
+                inputs.slot_mapping,
                 block_size=msa.block_size,
                 topk_blocks=msa.topk_blocks,
                 init_blocks=msa.init_blocks,
@@ -150,6 +152,7 @@ class MiniMaxM3StageModel(MoEStageModel):
                 sm_scale=d ** -0.5,
                 decode_only=inputs.decode_only,
                 use_pallas=self.use_pallas,
+                decode_fused=inputs.decode_fused,
             )
             out = paged_sparse_gqa_attention_xla(
                 q, kv_pages,
@@ -158,12 +161,13 @@ class MiniMaxM3StageModel(MoEStageModel):
             )
             new_kv = (kv_pages, index_pages)
         else:
-            out = ragged_paged_attention(
-                q, kv_pages,
+            out, kv_pages = append_and_attend(
+                q, k, v, kv_pages,
                 inputs.kv_lens, inputs.page_indices, inputs.cu_q_lens,
-                inputs.num_seqs, sm_scale=d ** -0.5,
+                inputs.num_seqs, inputs.slot_mapping, sm_scale=d ** -0.5,
                 sliding_window=None, use_pallas=self.use_pallas,
                 decode_only=inputs.decode_only,
+                decode_fused=inputs.decode_fused,
             )
             new_kv = kv_pages
         out = L.row_parallel_linear(

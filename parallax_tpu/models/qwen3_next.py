@@ -19,7 +19,7 @@ from parallax_tpu.models import layers as L
 from parallax_tpu.models.base import BatchInputs
 from parallax_tpu.models.qwen3_moe import MoEStageModel
 from parallax_tpu.models.registry import register_model
-from parallax_tpu.ops import ragged_paged_attention, reshape_and_cache
+from parallax_tpu.ops.attention import append_and_attend
 from parallax_tpu.ops.linear_attn import (
     causal_conv_update,
     gated_delta_rule_scan,
@@ -135,11 +135,12 @@ class Qwen3NextStageModel(MoEStageModel):
         k = self._rms(k, p["k_norm"]["weight"])
         q = self.rope_fn(q, inputs.positions, self.cos_table, self.sin_table)
         k = self.rope_fn(k, inputs.positions, self.cos_table, self.sin_table)
-        kv_pages = reshape_and_cache(kv_pages, k, v, inputs.slot_mapping)
-        out = ragged_paged_attention(
-            q, kv_pages, inputs.kv_lens, inputs.page_indices,
-            inputs.cu_q_lens, inputs.num_seqs,
+        out, kv_pages = append_and_attend(
+            q, k, v, kv_pages, inputs.kv_lens, inputs.page_indices,
+            inputs.cu_q_lens, inputs.num_seqs, inputs.slot_mapping,
             sm_scale=d**-0.5, use_pallas=self.use_pallas,
+            decode_only=inputs.decode_only,
+            decode_fused=inputs.decode_fused,
         )
         hq = q.shape[1]
         out = out.reshape(t, hq * d) * jax.nn.sigmoid(

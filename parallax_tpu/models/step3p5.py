@@ -18,8 +18,7 @@ from parallax_tpu.models import layers as L
 from parallax_tpu.models.base import BatchInputs, StageModel
 from parallax_tpu.models.qwen3_moe import MoEStageModel
 from parallax_tpu.models.registry import register_model
-from parallax_tpu.ops.attention import ragged_paged_attention
-from parallax_tpu.ops.kv_cache_ops import reshape_and_cache
+from parallax_tpu.ops.attention import append_and_attend
 
 
 @register_model("Step3p5ForCausalLM")
@@ -51,11 +50,12 @@ class Step3p5StageModel(MoEStageModel):
             k = L.rms_norm(k, p["k_norm"]["weight"], cfg.rms_norm_eps)
         q = self.rope_fn(q, inputs.positions, self.cos_table, self.sin_table)
         k = self.rope_fn(k, inputs.positions, self.cos_table, self.sin_table)
-        kv = reshape_and_cache(kv, k, v, inputs.slot_mapping)
-        out = ragged_paged_attention(
-            q, kv, inputs.kv_lens, inputs.page_indices, inputs.cu_q_lens,
-            inputs.num_seqs, sm_scale=d**-0.5, sliding_window=window,
+        out, kv = append_and_attend(
+            q, k, v, kv, inputs.kv_lens, inputs.page_indices,
+            inputs.cu_q_lens, inputs.num_seqs, inputs.slot_mapping,
+            sm_scale=d**-0.5, sliding_window=window,
             use_pallas=self.use_pallas, decode_only=inputs.decode_only,
+            decode_fused=inputs.decode_fused,
         )
         if "g_proj" in p:
             # Head-wise attention gate (reference step3p5.py:133-135).

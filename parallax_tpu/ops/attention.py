@@ -51,11 +51,67 @@ def _rpa_block_overrides() -> dict:
 
 
 
-def _tpu_available() -> bool:
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:
-        return False
+# Kernel-choice policy (TPU detection, use_pallas resolution, fused-mode
+# resolution) lives in ops/kernel_select.py — the single helper the old
+# per-file `_tpu_available()` copies collapsed into.
+
+
+def append_and_attend(
+    q: jax.Array,             # [T, num_q_heads, head_dim]
+    k: jax.Array,             # [T, num_kv_heads, head_dim] (pre-rope'd)
+    v: jax.Array,             # [T, num_kv_heads, head_dim]
+    kv_pages: jax.Array,
+    kv_lens: jax.Array,
+    page_indices: jax.Array,
+    cu_q_lens: jax.Array,
+    num_seqs: jax.Array,
+    slot_mapping: jax.Array,  # i32[T]; < 0 = padding, not written
+    *,
+    sm_scale: float = 1.0,
+    sliding_window: int | None = None,
+    soft_cap: float | None = None,
+    sinks: jax.Array | None = None,
+    use_pallas: bool | None = None,
+    decode_only: bool = False,
+    decode_fused: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Write this step's K/V into the paged cache and attend — the one
+    facade every GQA model calls (``models/layers.py`` and the model
+    classes with bespoke attention blocks).
+
+    With ``decode_fused`` on a decode-only batch (one query token per
+    sequence) this is ONE fused Pallas program per layer: the append is
+    a single-row DMA inside the attention kernel
+    (``decode_fused_pallas.gqa_fused_decode_pallas``), subsuming the
+    separate ``reshape_and_cache`` scatter dispatch. Every other shape
+    (prefill, mixed batches, fused off) keeps the split path:
+    scatter, then :func:`ragged_paged_attention`. Returns
+    ``(out, kv_pages)``.
+    """
+    from parallax_tpu.ops.kernel_select import fused_interpret
+
+    if decode_fused and decode_only and q.shape[0] == kv_lens.shape[0]:
+        from parallax_tpu.ops.decode_fused_pallas import (
+            gqa_fused_decode_pallas,
+        )
+
+        return gqa_fused_decode_pallas(
+            q, k, v, kv_pages, kv_lens, page_indices, slot_mapping,
+            sinks,
+            sm_scale=sm_scale, sliding_window=sliding_window,
+            soft_cap=soft_cap, use_sinks=sinks is not None,
+            interpret=fused_interpret(),
+        )
+    from parallax_tpu.ops.kv_cache_ops import reshape_and_cache
+
+    kv_pages = reshape_and_cache(kv_pages, k, v, slot_mapping)
+    out = ragged_paged_attention(
+        q, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs,
+        sm_scale=sm_scale, sliding_window=sliding_window,
+        soft_cap=soft_cap, sinks=sinks, use_pallas=use_pallas,
+        decode_only=decode_only,
+    )
+    return out, kv_pages
 
 
 def ragged_paged_attention(
@@ -98,8 +154,9 @@ def ragged_paged_attention(
     Returns:
       [T, num_q_heads, head_dim] attention output.
     """
-    if use_pallas is None:
-        use_pallas = _tpu_available()
+    from parallax_tpu.ops.kernel_select import resolve_use_pallas
+
+    use_pallas = resolve_use_pallas(use_pallas)
     if use_pallas and sinks is not None:
         if decode_only and q.shape[0] == kv_lens.shape[0]:
             # Custom flash decode kernel with sink + window support

@@ -1,16 +1,22 @@
 """Pallas TPU kernel: GQA flash decode with attention sinks + sliding
-window.
+window — the SPLIT-dispatch kernel (attention only; the KV append runs
+as a separate XLA scatter and sampling as a separate op).
 
 Completes the coverage the bundled
 ``jax.experimental.pallas.ops.tpu.ragged_paged_attention`` kernel lacks:
 gpt-oss attention sinks (one virtual key per head joining the softmax with
 no value payload — reference ``src/parallax_extensions/ops.py:556-572``)
-and the alternating sliding windows that go with them. Same shape as the
-MLA decode kernel (``ops/mla_pallas.py``): grid ``(num_seqs,
-pages_per_seq)``, one query token per sequence, online-softmax over pages
-streamed via the scalar-prefetched page table. The sink logit enters the
+and the alternating sliding windows that go with them. Grid
+``(num_seqs, pages_per_seq)`` — one query token per sequence, every page
+slot visited — built on the shared page-grid scaffold and online-softmax
+core in ``ops/decode_fused_pallas.py``. The sink logit enters the
 running max/denominator at init, which is numerically identical to
 appending a virtual key.
+
+The fused successor (``decode_fused_pallas.gqa_fused_decode_pallas``)
+streams only the valid pages and appends the new token's K/V in the same
+program; this kernel remains the split fallback and the microbench
+baseline (docs/kernels.md).
 """
 
 from __future__ import annotations
@@ -21,6 +27,12 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from parallax_tpu.ops.decode_fused_pallas import (
+    decode_page_grid_spec,
+    online_softmax_finish,
+    online_softmax_update,
+)
 
 _NEG = -1e30
 
@@ -92,33 +104,23 @@ def _gqa_decode_kernel(
                 preferred_element_type=jnp.float32,
             ))                                     # [G, page]
         scores = jnp.concatenate(score_rows, axis=0) * sm_scale  # [Hq, page]
-        scores = jnp.where(valid, scores, _NEG)
 
-        m_prev = m_ref[:, 0]
-        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(scores - m_new[:, None])
-        p = jnp.where(valid, p, 0.0)
-        l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
+        def weighted(p):
+            out_rows = []
+            for h in range(num_kv_heads):
+                ph = jax.lax.dynamic_slice_in_dim(p, h * group, group, 0)
+                vh = kv[:, 2 * h + 1, :]           # [page, D]
+                out_rows.append(jax.lax.dot_general(
+                    ph.astype(vh.dtype), vh, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ))                                 # [G, D]
+            return jnp.concatenate(out_rows, axis=0)
 
-        out_rows = []
-        for h in range(num_kv_heads):
-            ph = jax.lax.dynamic_slice_in_dim(p, h * group, group, 0)
-            vh = kv[:, 2 * h + 1, :]               # [page, D]
-            out_rows.append(jax.lax.dot_general(
-                ph.astype(vh.dtype), vh, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ))                                     # [G, D]
-        o_ref[:, :] = o_ref[:, :] * alpha[:, None] + jnp.concatenate(
-            out_rows, axis=0
-        )
-        m_ref[:, 0] = m_new
+        online_softmax_update(m_ref, l_ref, o_ref, scores, valid, weighted)
 
     @pl.when(j == pl.num_programs(1) - 1)
     def _finish():
-        out_ref[0, :, :] = (
-            o_ref[:, :] / jnp.maximum(l_ref[:, 0], 1e-30)[:, None]
-        ).astype(out_ref.dtype)
+        online_softmax_finish(l_ref, o_ref, out_ref)
 
 
 @functools.partial(
@@ -146,9 +148,8 @@ def gqa_decode_attention_pallas(
         sinks = jnp.zeros((hq,), jnp.float32)
     sinks = sinks.reshape(1, hq).astype(jnp.float32)
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(s, pages_per_seq),
+    grid_spec = decode_page_grid_spec(
+        s, pages_per_seq,
         in_specs=[
             pl.BlockSpec((1, hq, d), lambda i, j, pages, lens: (i, 0, 0)),
             pl.BlockSpec(

@@ -1,0 +1,621 @@
+"""The fused Pallas ragged decode kernel family + the shared
+page-streaming core every decode kernel builds on.
+
+This is the TPU analogue of the reference L1 fused kernel set
+(``paged_attention`` v1/v2 + ``reshape_and_cache`` in C++/Metal,
+PAPER.md): one Pallas program per attention layer consumes the page
+table directly, handles per-row ragged context lengths in one grid,
+and *appends the new token's K/V into the paged cache inside the same
+kernel* — eliminating the separate scatter dispatch the split path
+pays per layer. A sort-free filtered top-k/greedy sampling kernel
+(:func:`fused_sample_topk_pallas`) completes the chain, so a K-step
+decode window (``engine._dispatch_multistep``) is one device program
+whose per-step work is kernel-only.
+
+Two grid disciplines live here:
+
+- **Streamed (fused) kernels** — grid ``(num_seqs,)``; each program
+  DMAs only the row's *valid* pages HBM->VMEM (``ceil(kv_len/page)``
+  of them, window-clipped when sliding) and folds each into a VMEM
+  accumulator. The split kernels' grid ``(S, pages_per_seq)`` visits —
+  and block-copies — every page slot of every row, valid or not; on
+  ragged decode batches the streamed form does strictly less memory
+  traffic, and the fused append (a one-row DMA into the page the
+  table already names) replaces a full-cache XLA scatter.
+- **Legacy page-grid helpers** — :func:`decode_page_grid_spec` and the
+  :func:`online_softmax_update` / :func:`online_softmax_finish` pair
+  are the shared scaffold for the split decode kernels
+  (``ops/attention_pallas.py``, ``ops/mla_pallas.py``,
+  ``ops/dsa_pallas.py``, ``ops/msa_pallas.py``), which previously
+  each carried a private copy of the same grid/accumulator logic.
+
+Everything supports ``interpret=True`` (Pallas interpreter), which is
+how the CPU CI proves parity against the XLA reference paths
+(``ops/attention.py::_ragged_paged_attention_xla``,
+``ops/sampling.py``) and how ``bench.py``'s ``detail.kernel``
+microbench compares fused vs split vs XLA off-TPU.
+
+Cache-write safety: the cache rides through the kernel as an
+input/output-aliased ``ANY``-memory-space ref; all page reads go
+through the *output* alias so the appended row is visible to the same
+program's attention (the new token attends to itself). Appends target
+each row's private tail slot (``slot_mapping``), never a shared
+prefix page, so sequential grid iteration needs no cross-row
+synchronization. ``slot < 0`` (padding / frozen multi-step rows)
+skips the append while attention still runs over the row's committed
+context.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30
+_NEG_INF = float("-inf")
+# Keep in sync with ops/sampling.NEG_INF (the sampler-parity contract).
+_SAMPLE_NEG_INF = -1e10
+
+# Largest per-row top_k the fused sampler accepts: its k-th-value
+# threshold is k-1 sequential masked-max passes over the vocab, so cost
+# grows O(top_k * vocab) where the sort-based sampler pays one
+# O(vocab log vocab) sort regardless of k. Past this bound the engine
+# keeps the split sampler (fused attention stays active).
+FUSED_SAMPLE_TOPK_MAX = 64
+
+
+# --------------------------------------------------------------------------
+# Shared helpers for the legacy (S, pages_per_seq)-grid split kernels.
+# --------------------------------------------------------------------------
+
+
+def decode_page_grid_spec(
+    num_seqs: int,
+    pages_per_seq: int,
+    in_specs: list,
+    out_specs,
+    scratch_shapes: list | None = None,
+):
+    """The split decode kernels' common grid: one program per (row,
+    page-slot), with the page table + context lengths scalar-prefetched
+    so each block's DMA address is known before the body runs."""
+    return pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(num_seqs, pages_per_seq),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch_shapes or [],
+    )
+
+
+def online_softmax_update(
+    m_ref, l_ref, o_ref, scores, valid, weighted_values
+) -> None:
+    """One online-softmax accumulation step over a page of scores.
+
+    ``scores``: f32[H, page] masked-input logits; ``valid``: bool
+    broadcastable to scores; ``weighted_values(p)`` maps the f32[H,
+    page] softmax numerators to the [H, D] value contribution (callers
+    own the GQA/MLA head grouping). Accumulators are VMEM scratch
+    ``m/l: f32[H, 1]``, ``o: f32[H, D]``.
+    """
+    scores = jnp.where(valid, scores, _NEG)
+    m_prev = m_ref[:, 0]
+    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(scores - m_new[:, None])
+    p = jnp.where(valid, p, 0.0)
+    l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
+    o_ref[:, :] = o_ref[:, :] * alpha[:, None] + weighted_values(p)
+    m_ref[:, 0] = m_new
+
+
+def online_softmax_finish(l_ref, o_ref, out_ref) -> None:
+    """Divide the accumulated numerator by the running denominator and
+    write the row output (zeros for padding rows, whose l is 0)."""
+    out_ref[0, :, :] = (
+        o_ref[:, :] / jnp.maximum(l_ref[:, 0], 1e-30)[:, None]
+    ).astype(out_ref.dtype)
+
+
+# --------------------------------------------------------------------------
+# The streamed (fused) core: grid (S,), DMA only the valid pages.
+# --------------------------------------------------------------------------
+
+
+def paged_decode_stream(
+    cache: jax.Array,          # [P, page, C, W]
+    kv_lens: jax.Array,        # i32[S] context length INCLUDING new token
+    page_indices: jax.Array,   # i32[S, pages_per_seq]
+    slot_mapping: jax.Array,   # i32[S] flat append slot; < 0 skips append
+    operands: list,            # [(array, row_indexed: bool), ...]
+    *,
+    out_shapes: list,          # [(per-row block shape sans leading 1, dtype)]
+    acc_shapes: list,          # [(shape, dtype)] VMEM accumulators
+    init,                      # fn(accs, qs, outs) -> None
+    fold,                      # fn(accs, qs, outs, rows, base, kv_len) -> None
+    finalize,                  # fn(accs, qs, outs, kv_len) -> None
+    append: jax.Array | None = None,   # [S, C, W] rows (cache dtype)
+    first_page=None,           # fn(kv_len) -> first page index (window clip)
+    interpret: bool = False,
+):
+    """Build + invoke the streamed decode program.
+
+    One grid step per row: (1) if ``append`` is given and the row's
+    slot is live, DMA its new-token row into the cache page the slot
+    names; (2) ``fori_loop`` over the row's valid pages, DMAing each
+    into a VMEM scratch page and calling ``fold``; (3) ``finalize``
+    writes the row's output block(s). Returns ``(outs..., cache)``
+    when appending (cache input/output-aliased — donate it), else
+    ``outs...``; single-element outputs are unwrapped.
+    """
+    s, pages_per_seq = page_indices.shape
+    _, page_size, c, w = cache.shape
+    n_ops = len(operands)
+    with_append = append is not None
+
+    def kernel(pages_ref, lens_ref, slots_ref, *refs):
+        qs = refs[:n_ops]
+        pos = n_ops
+        if with_append:
+            append_ref = refs[pos]
+            pos += 1
+        cache_in_ref = refs[pos]
+        pos += 1
+        outs = refs[pos : pos + len(out_shapes)]
+        pos += len(out_shapes)
+        if with_append:
+            cache_ref = refs[pos]       # output alias: reads see appends
+            pos += 1
+        else:
+            cache_ref = cache_in_ref
+        n_acc = len(acc_shapes)
+        accs = refs[pos : pos + n_acc]
+        page_scratch = refs[pos + n_acc]
+        read_sem = refs[pos + n_acc + 1]
+        i = pl.program_id(0)
+        n = lens_ref[i]
+
+        if with_append:
+            write_sem = refs[pos + n_acc + 2]
+            slot = slots_ref[i]
+
+            @pl.when(slot >= 0)
+            def _append():
+                cp = pltpu.make_async_copy(
+                    append_ref.at[0],
+                    cache_ref.at[slot // page_size, slot % page_size],
+                    write_sem,
+                )
+                cp.start()
+                cp.wait()
+
+        init(accs, qs, outs)
+        start = first_page(n) if first_page is not None else 0
+
+        def body(j, carry):
+            cp = pltpu.make_async_copy(
+                cache_ref.at[pages_ref[i, j]], page_scratch, read_sem
+            )
+            cp.start()
+            cp.wait()
+            fold(accs, qs, outs, page_scratch[...], j * page_size, n)
+            return carry
+
+        jax.lax.fori_loop(
+            start, (n + page_size - 1) // page_size, body, 0
+        )
+        finalize(accs, qs, outs, n)
+
+    in_specs = []
+    inputs = []
+    for arr, row_indexed in operands:
+        blk = (1, *arr.shape[1:])
+        if row_indexed:
+            in_specs.append(pl.BlockSpec(
+                blk,
+                lambda i, pages, lens, slots, nd=len(blk): (
+                    (i,) + (0,) * (nd - 1)
+                ),
+            ))
+        else:
+            in_specs.append(pl.BlockSpec(
+                blk,
+                lambda i, pages, lens, slots, nd=len(blk): (0,) * nd,
+            ))
+        inputs.append(arr)
+    if with_append:
+        in_specs.append(pl.BlockSpec(
+            (1, c, w), lambda i, pages, lens, slots: (i, 0, 0)
+        ))
+        inputs.append(append)
+    in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+    inputs.append(cache)
+
+    out_specs = []
+    out_shape_structs = []
+    for shape, dtype in out_shapes:
+        blk = (1, *shape)
+        # Per-row output blocks: leading dim is the grid row.
+        out_specs.append(pl.BlockSpec(
+            blk,
+            lambda i, pages, lens, slots, nd=len(blk): (
+                (i,) + (0,) * (nd - 1)
+            ),
+        ))
+        out_shape_structs.append(
+            jax.ShapeDtypeStruct((s, *shape), dtype)
+        )
+    aliases = {}
+    if with_append:
+        out_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+        out_shape_structs.append(
+            jax.ShapeDtypeStruct(cache.shape, cache.dtype)
+        )
+        # cache operand position: 3 scalar-prefetch + q operands + append.
+        aliases = {3 + n_ops + 1: len(out_shapes)}
+
+    scratch = [pltpu.VMEM(shape, dtype) for shape, dtype in acc_shapes]
+    scratch.append(pltpu.VMEM((page_size, c, w), cache.dtype))
+    scratch.append(pltpu.SemaphoreType.DMA)
+    if with_append:
+        scratch.append(pltpu.SemaphoreType.DMA)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(s,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape_structs,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(page_indices, kv_lens, slot_mapping, *inputs)
+    if len(out) == 1:
+        return out[0]
+    return tuple(out)
+
+
+# --------------------------------------------------------------------------
+# Fused GQA decode: append + flash attention (sinks/window/soft-cap).
+# --------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "sm_scale", "sliding_window", "soft_cap", "use_sinks", "interpret",
+    ),
+)
+def gqa_fused_decode_pallas(
+    q: jax.Array,             # [S, Hq, D] — ONE query token per sequence
+    k_new: jax.Array,         # [S, Hkv, D] this step's keys (pre-rope'd)
+    v_new: jax.Array,         # [S, Hkv, D]
+    kv_pages: jax.Array,      # [P, page, 2*Hkv, D] (donate for in-place)
+    kv_lens: jax.Array,       # i32[S] INCLUDING the new token
+    page_indices: jax.Array,  # i32[S, pages_per_seq]
+    slot_mapping: jax.Array,  # i32[S]; < 0 = no append (padding/frozen)
+    sinks: jax.Array | None,  # f32[Hq] or None
+    *,
+    sm_scale: float,
+    sliding_window: int | None = None,
+    soft_cap: float | None = None,
+    use_sinks: bool = False,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """One fused program: KV append + GQA flash decode. Returns
+    ``(out [S, Hq, D], kv_pages)``."""
+    s, hq, d = q.shape
+    _, page_size, combined, _ = kv_pages.shape
+    num_kv_heads = combined // 2
+    group = hq // num_kv_heads
+    if sinks is None:
+        sinks = jnp.zeros((hq,), jnp.float32)
+    sinks = sinks.reshape(1, hq).astype(jnp.float32)
+
+    from parallax_tpu.ops.kv_cache_ops import interleave_kv
+
+    append = interleave_kv(k_new, v_new).astype(kv_pages.dtype)
+
+    def init(accs, qs, outs):
+        m_ref, l_ref, o_ref = accs
+        if use_sinks:
+            # The sink is a virtual key with logit sinks[h]: seeding the
+            # running max/denominator with it is numerically identical
+            # to appending a key with no value payload.
+            m_ref[:] = qs[1][0].reshape(hq, 1)
+            l_ref[:] = jnp.ones_like(l_ref)
+        else:
+            m_ref[:] = jnp.full_like(m_ref, _NEG)
+            l_ref[:] = jnp.zeros_like(l_ref)
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    def fold(accs, qs, outs, rows, base, n):
+        m_ref, l_ref, o_ref = accs
+        qrow = qs[0][0]                               # [Hq, D]
+        pos = base + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+        valid = pos < n
+        if sliding_window is not None:
+            valid = jnp.logical_and(valid, pos >= n - sliding_window)
+        score_rows = []
+        for h in range(num_kv_heads):
+            qh = jax.lax.dynamic_slice_in_dim(qrow, h * group, group, 0)
+            kh = rows[:, 2 * h, :]                    # [page, D]
+            score_rows.append(jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ))                                        # [G, page]
+        scores = jnp.concatenate(score_rows, axis=0) * sm_scale
+        if soft_cap is not None:
+            scores = soft_cap * jnp.tanh(scores / soft_cap)
+
+        def weighted(p):
+            out_rows = []
+            for h in range(num_kv_heads):
+                ph = jax.lax.dynamic_slice_in_dim(p, h * group, group, 0)
+                vh = rows[:, 2 * h + 1, :]            # [page, D]
+                out_rows.append(jax.lax.dot_general(
+                    ph.astype(vh.dtype), vh, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ))                                    # [G, D]
+            return jnp.concatenate(out_rows, axis=0)
+
+        online_softmax_update(m_ref, l_ref, o_ref, scores, valid, weighted)
+
+    def finalize(accs, qs, outs, n):
+        _, l_ref, o_ref = accs
+        online_softmax_finish(l_ref, o_ref, outs[0])
+
+    first = None
+    if sliding_window is not None:
+        def first(n):
+            return jnp.maximum(n - sliding_window, 0) // page_size
+
+    out, kv_pages = paged_decode_stream(
+        kv_pages, kv_lens, page_indices, slot_mapping,
+        [(q, True), (sinks, False)],
+        out_shapes=[((hq, d), q.dtype)],
+        acc_shapes=[
+            ((hq, 1), jnp.float32),
+            ((hq, 1), jnp.float32),
+            ((hq, d), jnp.float32),
+        ],
+        init=init, fold=fold, finalize=finalize,
+        append=append, first_page=first, interpret=interpret,
+    )
+    return out, kv_pages
+
+
+# --------------------------------------------------------------------------
+# Fused MLA decode: latent append + flash decode over the latent cache.
+# --------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sm_scale", "kv_lora_rank", "interpret")
+)
+def mla_fused_decode_pallas(
+    q_latent: jax.Array,      # [S, Hq, R]
+    q_pe: jax.Array,          # [S, Hq, Dr]
+    latent_new: jax.Array,    # [S, R] this step's compressed latent
+    k_pe_new: jax.Array,      # [S, Dr] this step's rope key
+    cache: jax.Array,         # [P, page, 1, R+Dr] (donate for in-place)
+    kv_lens: jax.Array,       # i32[S]
+    page_indices: jax.Array,  # i32[S, pages_per_seq]
+    slot_mapping: jax.Array,  # i32[S]
+    *,
+    sm_scale: float,
+    kv_lora_rank: int,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """One fused program: latent-cache append + MLA flash decode.
+    Returns ``(out [S, Hq, R], cache)``."""
+    s, hq, r = q_latent.shape
+    _, page_size, _, width = cache.shape
+    append = jnp.concatenate(
+        [latent_new, k_pe_new], axis=-1
+    ).astype(cache.dtype)[:, None, :]                 # [S, 1, W]
+
+    def init(accs, qs, outs):
+        m_ref, l_ref, o_ref = accs
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    def fold(accs, qs, outs, rows, base, n):
+        m_ref, l_ref, o_ref = accs
+        page_rows = rows[:, 0, :]                     # [page, W]
+        latent = page_rows[:, :kv_lora_rank]
+        rope = page_rows[:, kv_lora_rank:]
+        ql = qs[0][0]                                 # [Hq, R]
+        qp = qs[1][0]                                 # [Hq, Dr]
+        scores = (
+            jax.lax.dot_general(
+                ql, latent, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            + jax.lax.dot_general(
+                qp, rope, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        ) * sm_scale                                  # [Hq, page]
+        pos = base + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+        valid = pos < n
+
+        def weighted(p):
+            return jax.lax.dot_general(
+                p.astype(latent.dtype), latent, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        online_softmax_update(m_ref, l_ref, o_ref, scores, valid, weighted)
+
+    def finalize(accs, qs, outs, n):
+        _, l_ref, o_ref = accs
+        online_softmax_finish(l_ref, o_ref, outs[0])
+
+    out, cache = paged_decode_stream(
+        cache, kv_lens, page_indices, slot_mapping,
+        [(q_latent, True), (q_pe, True)],
+        out_shapes=[((hq, r), q_latent.dtype)],
+        acc_shapes=[
+            ((hq, 1), jnp.float32),
+            ((hq, 1), jnp.float32),
+            ((hq, r), jnp.float32),
+        ],
+        init=init, fold=fold, finalize=finalize,
+        append=append, interpret=interpret,
+    )
+    return out, cache
+
+
+# --------------------------------------------------------------------------
+# Fused sparse-indexer scoring (DSA / MSA): index-key append + full-context
+# token scores in one streamed program.
+# --------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit, static_argnames=("reduce_kind", "sm_scale", "interpret")
+)
+def indexer_scores_fused_pallas(
+    q: jax.Array,             # [S, Hi, D] — ONE query token per sequence
+    weights: jax.Array | None,  # f32[S, Hi] (DSA) or None (MSA)
+    k_new: jax.Array,         # [S, D] this step's index key
+    index_cache: jax.Array,   # [P, page, 1, D] (donate for in-place)
+    kv_lens: jax.Array,       # i32[S]
+    page_indices: jax.Array,  # i32[S, pages_per_seq]
+    slot_mapping: jax.Array,  # i32[S]
+    *,
+    reduce_kind: str,         # "dsa" (relu-weighted sum) | "msa" (max)
+    sm_scale: float = 1.0,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """One fused program: index-key append + per-token indexer scores.
+    Returns ``(scores f32[S, pages_per_seq*page], index_cache)`` with
+    exact ``-inf`` beyond each row's context (the top-k facades'
+    dense-row detection relies on it)."""
+    s, hi, d = q.shape
+    _, page_size, _, _ = index_cache.shape
+    _, pages_per_seq = page_indices.shape
+    kv_cap = pages_per_seq * page_size
+    append = k_new.astype(index_cache.dtype)[:, None, :]   # [S, 1, D]
+    operands = [(q, True)]
+    if reduce_kind == "dsa":
+        operands.append((weights.astype(jnp.float32), True))
+
+    def init(accs, qs, outs):
+        outs[0][...] = jnp.full((1, kv_cap), _NEG_INF, jnp.float32)
+
+    def fold(accs, qs, outs, rows, base, n):
+        keys = rows[:, 0, :]                          # [page, D]
+        dots = jax.lax.dot_general(
+            qs[0][0], keys, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                             # [Hi, page]
+        if reduce_kind == "dsa":
+            w = qs[1][0]                              # [Hi]
+            sc = jnp.sum(w[:, None] * jnp.maximum(dots, 0.0), axis=0)
+        else:
+            # Max over index heads; the (positive) scale commutes.
+            sc = jnp.max(dots, axis=0) * sm_scale
+        pos = base + jax.lax.broadcasted_iota(
+            jnp.int32, (page_size,), 0
+        )
+        outs[0][0, pl.ds(base, page_size)] = jnp.where(
+            pos < n, sc, _NEG_INF
+        )
+
+    def finalize(accs, qs, outs, n):
+        pass
+
+    scores, index_cache = paged_decode_stream(
+        index_cache, kv_lens, page_indices, slot_mapping,
+        operands,
+        out_shapes=[((kv_cap,), jnp.float32)],
+        acc_shapes=[],
+        init=init, fold=fold, finalize=finalize,
+        append=append, interpret=interpret,
+    )
+    return scores, index_cache
+
+
+# --------------------------------------------------------------------------
+# Fused sampling: sort-free greedy / filtered top-k in one kernel.
+# --------------------------------------------------------------------------
+
+
+def _sample_kernel(logits_ref, gumbel_ref, temp_ref, topk_ref, out_ref):
+    lg = logits_ref[...]                              # [1, V] f32
+    v = lg.shape[1]
+    greedy = jnp.argmax(lg, axis=1).astype(jnp.int32)  # [1]
+    t = temp_ref[0, 0]
+    k = topk_ref[0, 0]
+    scaled = lg / jnp.maximum(t, 1e-6)
+    # k-th largest by iterative max extraction (k-1 removals): identical
+    # to descending-sort[k-1] including duplicate handling, no sort.
+    need = jnp.logical_and(k > 0, k < v)
+    iters = jnp.where(need, jnp.maximum(k - 1, 0), 0)
+
+    def drop_max(_, cur):
+        idx = jnp.argmax(cur, axis=1)
+        iota = jax.lax.broadcasted_iota(jnp.int32, cur.shape, 1)
+        return jnp.where(iota == idx[:, None], _NEG, cur)
+
+    red = jax.lax.fori_loop(0, iters, drop_max, scaled)
+    kth = jnp.max(red, axis=1)                        # [1]
+    thresh = jnp.where(need, kth, jnp.float32(_NEG))
+    # Value-threshold top-k (ties at the k-th value included) — the
+    # exact filter ops/sampling.sample_tokens applies, so fused and
+    # split draws agree bit-for-bit on the same logits.
+    keep = scaled >= thresh[:, None]
+    filtered = jnp.where(keep, scaled, _SAMPLE_NEG_INF)
+    choice = jnp.argmax(filtered + gumbel_ref[...], axis=1).astype(
+        jnp.int32
+    )
+    out_ref[0, 0] = jnp.where(t <= 0.0, greedy, choice)[0]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_sample_topk_pallas(
+    logits: jax.Array,        # [S, V] float
+    gumbel: jax.Array,        # f32[S, V] per-token-id gumbel noise
+    temperature: jax.Array,   # f32[S]; <= 0 = greedy
+    top_k: jax.Array,         # i32[S]; <= 0 disables the filter
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Sample one token per row without the full-vocab sort: i32[S].
+
+    Gumbel noise is indexed by token id and generated OUTSIDE the
+    kernel (``ops/sampling.row_gumbel``) so the draw is bit-identical
+    to the XLA sampler's — the kernel only filters and arg-maxes.
+    Rows needing top-p/min-p/penalties take the split sampler instead
+    (the engine gates them; see analysis/gates.py).
+    """
+    s, v = logits.shape
+    logits = logits.astype(jnp.float32)
+    temp = temperature.reshape(s, 1).astype(jnp.float32)
+    tk = top_k.reshape(s, 1).astype(jnp.int32)
+    out = pl.pallas_call(
+        _sample_kernel,
+        grid=(s,),
+        in_specs=[
+            pl.BlockSpec((1, v), lambda i: (i, 0)),
+            pl.BlockSpec((1, v), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((s, 1), jnp.int32),
+        interpret=interpret,
+    )(logits, gumbel.astype(jnp.float32), temp, tk)
+    return out[:, 0]

@@ -60,6 +60,47 @@ def store_index_cache(
     return flat.reshape(p, page, 1, d)
 
 
+def dsa_store_and_score(
+    q: jax.Array,             # [T, Hi, D_idx]
+    weights: jax.Array,       # f32[T, Hi]
+    k_new: jax.Array,         # [T, D_idx] this step's index key
+    index_cache: jax.Array,
+    kv_lens: jax.Array,
+    page_indices: jax.Array,
+    cu_q_lens: jax.Array,
+    slot_mapping: jax.Array,
+    *,
+    decode_only: bool = False,
+    use_pallas: bool | None = None,
+    decode_fused: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Write this step's index key into the paged index cache and score
+    the full context — the indexer twin of
+    ``ops/attention.append_and_attend``. With ``decode_fused`` on a
+    decode-only batch the key append rides inside the fused streaming
+    scorer (``decode_fused_pallas.indexer_scores_fused_pallas``);
+    otherwise the split path scatters (:func:`store_index_cache`) then
+    dispatches :func:`dsa_indexer_scores`. Returns
+    ``(scores, index_cache)``."""
+    if decode_only and decode_fused and q.shape[0] == kv_lens.shape[0]:
+        from parallax_tpu.ops.decode_fused_pallas import (
+            indexer_scores_fused_pallas,
+        )
+        from parallax_tpu.ops.kernel_select import fused_interpret
+
+        return indexer_scores_fused_pallas(
+            q, weights, k_new, index_cache, kv_lens, page_indices,
+            slot_mapping, reduce_kind="dsa",
+            interpret=fused_interpret(),
+        )
+    index_cache = store_index_cache(index_cache, k_new, slot_mapping)
+    scores = dsa_indexer_scores(
+        q, weights, index_cache, kv_lens, page_indices, cu_q_lens,
+        decode_only=decode_only, use_pallas=use_pallas,
+    )
+    return scores, index_cache
+
+
 def dsa_indexer_scores(
     q: jax.Array,
     weights: jax.Array,
@@ -74,10 +115,9 @@ def dsa_indexer_scores(
     """Indexer-score dispatcher: the Pallas page-streaming kernel on TPU
     for decode-only batches (one query per sequence), the chunked XLA
     path otherwise (prefill / CPU / oracle)."""
-    if use_pallas is None:
-        from parallax_tpu.ops.attention import _tpu_available
+    from parallax_tpu.ops.kernel_select import resolve_use_pallas
 
-        use_pallas = _tpu_available()
+    use_pallas = resolve_use_pallas(use_pallas)
     if decode_only and use_pallas and q.shape[0] == kv_lens.shape[0]:
         from parallax_tpu.ops.dsa_pallas import (
             dsa_indexer_scores_decode_pallas,

@@ -30,7 +30,8 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+from parallax_tpu.ops.decode_fused_pallas import decode_page_grid_spec
 
 _NEG_INF = float("-inf")
 
@@ -90,9 +91,8 @@ def paged_token_scores_decode(
     ))
     operands.append(index_cache)
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(s, pages_per_seq),
+    grid_spec = decode_page_grid_spec(
+        s, pages_per_seq,
         in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, page_size), lambda i, j, pages, lens: (i, j)

@@ -1,0 +1,97 @@
+"""Kernel-selection policy shared by every attention-family op.
+
+One place answers the three questions the ops facades
+(``ops/attention.py``, ``ops/mla.py``, ``ops/dsa.py``, ``ops/msa.py``)
+used to answer each for themselves:
+
+- ``tpu_available()`` — is the default backend a TPU (the only backend
+  the non-interpret Pallas kernels compile for)?
+- ``resolve_use_pallas(flag)`` — the per-op kernel choice: an explicit
+  caller flag wins, ``None`` means "Pallas iff TPU".
+- ``resolve_decode_fused(flag)`` — the engine-level fused-decode-program
+  choice (``EngineConfig.decode_fused`` / ``--decode-fused``): ``None``
+  means auto (on on TPU, off elsewhere), ``True`` forces the fused
+  kernels even off-TPU (they then run in Pallas interpret mode — the CI
+  parity/microbench path), ``False`` pins the split dispatch chain.
+
+The impl names returned by :func:`decode_attn_impl` are the canonical
+labels for the ``parallax_attn_kernel_dispatch_total{impl,path}``
+counter and the ``kernel`` sections of ``/status`` and
+``/cluster/status`` — keep them in sync with docs/kernels.md.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from parallax_tpu.utils import get_logger
+
+logger = get_logger(__name__)
+
+# Canonical impl labels (docs/kernels.md "Kernel catalog").
+IMPL_FUSED = "pallas-fused"
+IMPL_SPLIT = "pallas-split"
+IMPL_XLA = "xla"
+
+_warned_non_tpu_fused = False
+_warned_auto_off = False
+
+
+def tpu_available() -> bool:
+    """True when the default JAX backend is a TPU."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def resolve_use_pallas(use_pallas: bool | None) -> bool:
+    """Per-op kernel choice: explicit flag wins, None = TPU autodetect."""
+    if use_pallas is None:
+        return tpu_available()
+    return bool(use_pallas)
+
+
+def fused_interpret() -> bool:
+    """Whether fused Pallas kernels must run in interpret mode (any
+    non-TPU backend: the CPU CI parity path)."""
+    return not tpu_available()
+
+
+def resolve_decode_fused(decode_fused: bool | None) -> bool:
+    """Engine-level fused-decode choice: None = auto-on-TPU; True forces
+    the fused kernels anywhere (interpret mode off-TPU); False = split.
+
+    The single warning site for the non-TPU downgrade: auto mode on a
+    CPU/GPU backend keeps the XLA reference path and says so once.
+    """
+    global _warned_non_tpu_fused, _warned_auto_off
+    if decode_fused is None:
+        on = tpu_available()
+        if not on and not _warned_auto_off:
+            _warned_auto_off = True
+            logger.info(
+                "decode-fused kernels disabled: non-TPU backend keeps "
+                "the XLA reference attention path (--decode-fused forces "
+                "the fused kernels in Pallas interpret mode)",
+            )
+        return on
+    if decode_fused and not tpu_available() and not _warned_non_tpu_fused:
+        _warned_non_tpu_fused = True
+        logger.info(
+            "decode_fused forced on a non-TPU backend: fused Pallas "
+            "kernels run in interpret mode (correct but slow — the CI "
+            "parity configuration, not a serving one)",
+        )
+    return bool(decode_fused)
+
+
+def decode_attn_impl(
+    decode_fused: bool, use_pallas: bool | None
+) -> str:
+    """The canonical impl label for a stage's decode attention path."""
+    if decode_fused:
+        return IMPL_FUSED
+    if resolve_use_pallas(use_pallas):
+        return IMPL_SPLIT
+    return IMPL_XLA

@@ -50,6 +50,55 @@ def store_mla_cache(
     return flat.reshape(p, page, 1, width)
 
 
+def mla_append_and_attend(
+    q_latent: jax.Array,      # [T, Hq, R]
+    q_pe: jax.Array,          # [T, Hq, Dr]
+    latent: jax.Array,        # [T, R] this step's compressed latent
+    k_pe: jax.Array,          # [T, Dr] this step's rope key
+    cache: jax.Array,
+    kv_lens: jax.Array,
+    page_indices: jax.Array,
+    cu_q_lens: jax.Array,
+    num_seqs: jax.Array,
+    slot_mapping: jax.Array,
+    *,
+    sm_scale: float,
+    kv_lora_rank: int,
+    decode_only: bool = False,
+    use_pallas: bool | None = None,
+    decode_fused: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Write this step's latent+rope row into the paged cache and attend
+    — the MLA twin of ``ops/attention.append_and_attend``. With
+    ``decode_fused`` on a decode-only batch the append happens inside
+    the fused Pallas program
+    (``decode_fused_pallas.mla_fused_decode_pallas``); otherwise the
+    split path scatters (:func:`store_mla_cache`) then dispatches
+    :func:`mla_ragged_attention`. Returns ``(out, cache)``."""
+    if (
+        decode_fused
+        and decode_only
+        and q_latent.shape[0] == kv_lens.shape[0]
+    ):
+        from parallax_tpu.ops.decode_fused_pallas import (
+            mla_fused_decode_pallas,
+        )
+        from parallax_tpu.ops.kernel_select import fused_interpret
+
+        return mla_fused_decode_pallas(
+            q_latent, q_pe, latent, k_pe, cache, kv_lens, page_indices,
+            slot_mapping, sm_scale=sm_scale, kv_lora_rank=kv_lora_rank,
+            interpret=fused_interpret(),
+        )
+    cache = store_mla_cache(cache, latent, k_pe, slot_mapping)
+    out = mla_ragged_attention(
+        q_latent, q_pe, cache, kv_lens, page_indices, cu_q_lens, num_seqs,
+        sm_scale=sm_scale, kv_lora_rank=kv_lora_rank,
+        decode_only=decode_only, use_pallas=use_pallas,
+    )
+    return out, cache
+
+
 def mla_ragged_attention(
     q_latent: jax.Array,
     q_pe: jax.Array,
@@ -68,10 +117,9 @@ def mla_ragged_attention(
     decode-only batches (one query per sequence — reference kernel contract
     ``kernels/mla/mla.cpp``), the XLA gather path otherwise (prefill /
     CPU / oracle)."""
-    if use_pallas is None:
-        from parallax_tpu.ops.attention import _tpu_available
+    from parallax_tpu.ops.kernel_select import resolve_use_pallas
 
-        use_pallas = _tpu_available()
+    use_pallas = resolve_use_pallas(use_pallas)
     if (
         decode_only
         and use_pallas

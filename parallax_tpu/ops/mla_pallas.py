@@ -1,5 +1,6 @@
 """Pallas TPU kernel: flash-style MLA decode over the compressed latent
-cache.
+cache — the SPLIT-dispatch kernel (attention only; the latent append
+runs as a separate XLA scatter).
 
 Capability parity: reference MLA decode kernel
 (``src/parallax_extensions/kernels/mla/mla.cpp:1-138``, facade
@@ -8,13 +9,19 @@ latent`` per sequence, one query token each. The XLA gather path in
 ``ops/mla.py`` stays as the oracle (tests compare bit-for-bit semantics)
 and the prefill path.
 
-Kernel shape: grid ``(num_seqs, pages_per_seq)``; each step streams one
-latent page from HBM into VMEM via the page table (scalar-prefetched so
-the DMA address is known before the body runs) and folds it into an
-online-softmax accumulator held in VMEM scratch. The two matmuls per page
-([Hq, R] x [R, page] and [Hq, page] x [page, R]) land on the MXU; per-page
-masking handles ragged context lengths, so padding sequences (kv_len 0)
-produce zeros.
+Kernel shape: grid ``(num_seqs, pages_per_seq)`` on the shared
+page-grid scaffold (``ops/decode_fused_pallas.decode_page_grid_spec``);
+each step streams one latent page from HBM into VMEM via the
+scalar-prefetched page table and folds it into the shared
+online-softmax accumulator (``online_softmax_update``). The two matmuls
+per page ([Hq, R] x [R, page] and [Hq, page] x [page, R]) land on the
+MXU; per-page masking handles ragged context lengths, so padding
+sequences (kv_len 0) produce zeros.
+
+The fused successor (``decode_fused_pallas.mla_fused_decode_pallas``)
+streams only the valid pages and appends the new latent row in the same
+program; this kernel remains the split fallback and the microbench
+baseline (docs/kernels.md).
 """
 
 from __future__ import annotations
@@ -25,6 +32,12 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from parallax_tpu.ops.decode_fused_pallas import (
+    decode_page_grid_spec,
+    online_softmax_finish,
+    online_softmax_update,
+)
 
 _NEG = -1e30
 
@@ -80,25 +93,18 @@ def _mla_decode_kernel(
             jnp.int32, scores.shape, 1
         )
         valid = pos < kv_len                         # decode: q at kv_len-1
-        scores = jnp.where(valid, scores, _NEG)
 
-        m_prev = m_ref[:, 0]
-        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(scores - m_new[:, None])
-        p = jnp.where(valid, p, 0.0)
-        l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
-        o_ref[:, :] = o_ref[:, :] * alpha[:, None] + jax.lax.dot_general(
-            p.astype(latent.dtype), latent, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_ref[:, 0] = m_new
+        def weighted(p):
+            return jax.lax.dot_general(
+                p.astype(latent.dtype), latent, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        online_softmax_update(m_ref, l_ref, o_ref, scores, valid, weighted)
 
     @pl.when(j == pl.num_programs(1) - 1)
     def _finish():
-        out_ref[0, :, :] = (
-            o_ref[:, :] / jnp.maximum(l_ref[:, 0], 1e-30)[:, None]
-        ).astype(out_ref.dtype)
+        online_softmax_finish(l_ref, o_ref, out_ref)
 
 
 @functools.partial(
@@ -121,9 +127,8 @@ def mla_decode_attention_pallas(
     p, page_size, _, width = cache.shape
     _, pages_per_seq = page_indices.shape
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(s, pages_per_seq),
+    grid_spec = decode_page_grid_spec(
+        s, pages_per_seq,
         in_specs=[
             pl.BlockSpec((1, hq, r), lambda i, j, pages, lens: (i, 0, 0)),
             pl.BlockSpec(

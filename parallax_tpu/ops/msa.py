@@ -169,6 +169,63 @@ def topk_block_positions(
     return pos
 
 
+def msa_store_and_positions(
+    idx_q: jax.Array,         # [T, Hi, D]
+    idx_k: jax.Array,         # [T, D] this step's index key
+    index_cache: jax.Array,
+    kv_lens: jax.Array,
+    page_indices: jax.Array,
+    cu_q_lens: jax.Array,
+    slot_mapping: jax.Array,
+    *,
+    block_size: int,
+    topk_blocks: int,
+    init_blocks: int,
+    local_blocks: int,
+    sm_scale: float,
+    decode_only: bool = False,
+    use_pallas: bool | None = None,
+    decode_fused: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Write this step's index key into the paged index cache and pick
+    the sparse block positions — the MSA twin of
+    ``ops/attention.append_and_attend``. With ``decode_fused`` on a
+    decode-only batch the key append rides inside the fused streaming
+    scorer; otherwise the split path scatters then dispatches
+    :func:`msa_sparse_positions`. Returns ``(positions, index_cache)``."""
+    if (
+        decode_fused
+        and decode_only
+        and idx_q.shape[0] == kv_lens.shape[0]
+    ):
+        from parallax_tpu.ops.decode_fused_pallas import (
+            indexer_scores_fused_pallas,
+        )
+        from parallax_tpu.ops.kernel_select import fused_interpret
+
+        scores, index_cache = indexer_scores_fused_pallas(
+            idx_q, None, idx_k, index_cache, kv_lens, page_indices,
+            slot_mapping, reduce_kind="msa", sm_scale=sm_scale,
+            interpret=fused_interpret(),
+        )
+        positions = topk_block_positions(
+            scores, kv_lens - 1,
+            block_size=block_size, topk_blocks=topk_blocks,
+            init_blocks=init_blocks, local_blocks=local_blocks,
+        )
+        return positions, index_cache
+    from parallax_tpu.ops.dsa import store_index_cache
+
+    index_cache = store_index_cache(index_cache, idx_k, slot_mapping)
+    positions = msa_sparse_positions(
+        idx_q, index_cache, kv_lens, page_indices, cu_q_lens,
+        block_size=block_size, topk_blocks=topk_blocks,
+        init_blocks=init_blocks, local_blocks=local_blocks,
+        sm_scale=sm_scale, decode_only=decode_only, use_pallas=use_pallas,
+    )
+    return positions, index_cache
+
+
 def msa_sparse_positions(
     idx_q: jax.Array,
     index_cache: jax.Array,
@@ -187,10 +244,9 @@ def msa_sparse_positions(
     """Indexer dispatcher: the Pallas page-streaming token-score kernel on
     TPU for decode-only batches (one query per sequence), the chunked XLA
     path otherwise (prefill / CPU / oracle)."""
-    if use_pallas is None:
-        from parallax_tpu.ops.attention import _tpu_available
+    from parallax_tpu.ops.kernel_select import resolve_use_pallas
 
-        use_pallas = _tpu_available()
+    use_pallas = resolve_use_pallas(use_pallas)
     if decode_only and use_pallas and idx_q.shape[0] == kv_lens.shape[0]:
         from parallax_tpu.ops.msa_pallas import msa_token_scores_decode_pallas
 

@@ -31,6 +31,46 @@ def greedy_tokens(logits: jax.Array) -> jax.Array:
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
+def row_gumbel(
+    key: jax.Array,
+    b: int,
+    v: int,
+    seeds: jax.Array | None = None,      # i32[B]; <0 = unseeded row
+    out_steps: jax.Array | None = None,  # i32[B]; output index per row
+) -> jax.Array:
+    """Per-row gumbel noise indexed by TOKEN ID: f32[B, V].
+
+    THE single noise source for every sampler: the XLA path
+    (:func:`sample_tokens`) and the fused Pallas kernel
+    (``ops/decode_fused_pallas.fused_sample_topk_pallas``) both consume
+    this exact tensor, which is what makes fused and split draws
+    bit-identical on the same logits. Seeded rows draw from
+    ``fold_in(key(seed), step)`` so the k-th output token of a seeded
+    request is reproducible regardless of batch composition or engine
+    step count; unseeded rows use the engine's per-step key folded with
+    the row index.
+    """
+    if seeds is None:
+        return jax.random.gumbel(key, (b, v), dtype=jnp.float32)
+    steps = out_steps if out_steps is not None else jnp.zeros(
+        (b,), jnp.int32
+    )
+
+    def _row_key(seed, step, i):
+        return jax.lax.cond(
+            seed >= 0,
+            lambda: jax.random.fold_in(jax.random.key(seed), step),
+            lambda: jax.random.fold_in(key, i),
+        )
+
+    row_keys = jax.vmap(_row_key)(
+        seeds, steps, jnp.arange(b, dtype=jnp.int32)
+    )
+    return jax.vmap(
+        lambda k: jax.random.gumbel(k, (v,), dtype=jnp.float32)
+    )(row_keys)
+
+
 @functools.partial(jax.jit, donate_argnums=())
 def sample_tokens(
     logits: jax.Array,            # [B, V] float
@@ -44,10 +84,12 @@ def sample_tokens(
 ) -> jax.Array:
     """Sample one token per row. Returns i32[B].
 
-    Seeded rows (``seeds[i] >= 0``) draw from ``fold_in(key(seed), step)``
-    so the k-th output token of a seeded request is reproducible regardless
-    of batch composition or engine step count; unseeded rows use the
-    engine's per-step key folded with the row index.
+    The filter masks are built in sorted space (one descending sort
+    powers top-k, top-p and min-p at once) but the gumbel-max draw
+    happens in TOKEN-ID space over :func:`row_gumbel` noise — the
+    contract that lets the fused decode sampler reproduce the exact
+    same choice without sorting. Top-k keeps by VALUE threshold (ties
+    at the k-th value included), for the same reason.
     """
     b, v = logits.shape
     logits = logits.astype(jnp.float32)
@@ -62,44 +104,30 @@ def sample_tokens(
     )
     sorted_logits = -sorted_logits
     probs = jax.nn.softmax(sorted_logits, axis=-1)
-    ranks = jnp.arange(v, dtype=jnp.int32)[None, :]
 
     keep = jnp.ones((b, v), dtype=bool)
-    # top-k: keep the k highest-ranked entries.
-    k = jnp.where(top_k <= 0, v, top_k)[:, None]
-    keep &= ranks < k
-    # top-p: smallest prefix with cumulative prob >= p (always keep rank 0).
+    # top-k by value threshold: keep everything >= the k-th largest
+    # (identical to the fused kernel's sort-free filter, ties included).
+    k = jnp.clip(jnp.where(top_k <= 0, v, top_k), 1, v)
+    kth = jnp.take_along_axis(sorted_logits, (k - 1)[:, None], axis=-1)
+    keep &= sorted_logits >= kth
+    # top-p: smallest prefix with cumulative prob >= p (always keep rank
+    # 0). top_p >= 1 must be an exact no-op: f32 cumsum can round to 1.0
+    # before the last rank, which would mask tail tokens the fused
+    # sampler (which applies no top-p filter) keeps — breaking the
+    # fused-vs-split bit-identity contract for qualifying rows.
     cum = jnp.cumsum(probs, axis=-1)
-    keep &= (cum - probs) < top_p[:, None]
+    keep &= ((cum - probs) < top_p[:, None]) | (top_p[:, None] >= 1.0)
     # min-p: drop tokens below min_p * max_prob.
     keep &= probs >= min_p[:, None] * probs[:, 0:1]
 
-    filtered = jnp.where(keep, sorted_logits, NEG_INF)
-    # Gumbel-max over the filtered sorted logits.
-    if seeds is None:
-        gumbel = jax.random.gumbel(key, (b, v), dtype=jnp.float32)
-    else:
-        steps = out_steps if out_steps is not None else jnp.zeros(
-            (b,), jnp.int32
-        )
-
-        def _row_key(seed, step, i):
-            return jax.lax.cond(
-                seed >= 0,
-                lambda: jax.random.fold_in(jax.random.key(seed), step),
-                lambda: jax.random.fold_in(key, i),
-            )
-
-        row_keys = jax.vmap(_row_key)(
-            seeds, steps, jnp.arange(b, dtype=jnp.int32)
-        )
-        gumbel = jax.vmap(
-            lambda k: jax.random.gumbel(k, (v,), dtype=jnp.float32)
-        )(row_keys)
-    choice_rank = jnp.argmax(filtered + gumbel, axis=-1)
-    sampled_ids = jnp.take_along_axis(
-        sorted_idx, choice_rank[:, None], axis=-1
-    )[:, 0].astype(jnp.int32)
+    # Scatter the sorted-space keep mask back to token-id space and draw
+    # there: gumbel noise attaches to token IDs, not ranks.
+    rows = jnp.broadcast_to(jnp.arange(b, dtype=jnp.int32)[:, None], (b, v))
+    keep_tok = jnp.zeros((b, v), bool).at[rows, sorted_idx].set(keep)
+    filtered = jnp.where(keep_tok, scaled, NEG_INF)
+    gumbel = row_gumbel(key, b, v, seeds, out_steps)
+    sampled_ids = jnp.argmax(filtered + gumbel, axis=-1).astype(jnp.int32)
 
     return jnp.where(temperature <= 0.0, greedy_ids, sampled_ids)
 

@@ -165,6 +165,7 @@ def join_main(args) -> int:
             # worker's drive loop (node.py) resolves the K-step window
             # tickets like any other overlapped step.
             decode_lookahead=getattr(args, "decode_lookahead", None) or None,
+            decode_fused=getattr(args, "decode_fused", None),
             decode_pipeline=getattr(args, "decode_pipeline", 1) or 1,
             sp_threshold=(
                 getattr(args, "sp_threshold", 2048)

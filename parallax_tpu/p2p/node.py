@@ -772,6 +772,12 @@ class WorkerNode:
                         "cache_stats": (
                             eng.cache_stats() if eng else None
                         ),
+                        # Active attention-kernel impl + per-path
+                        # dispatch counts (pallas-fused / pallas-split /
+                        # xla) — surfaced per node in /cluster/status.
+                        "kernel": (
+                            eng.kernel_dispatch_summary() if eng else None
+                        ),
                         # Per-link activation-transport telemetry
                         # (bytes/frames each way, serialize/send ms,
                         # queue depth, compression ratio) — surfaced in

@@ -79,6 +79,7 @@ def assemble(
     pad_position: int = 0,
     decode_only: bool = False,
     gather_all_logits: bool = False,
+    decode_fused: bool = False,
 ) -> BatchInputs:
     """Build fixed-shape arrays from a ragged plan.
 
@@ -155,6 +156,10 @@ def assemble(
 
     return BatchInputs(
         decode_only=decode_only,
+        # Fused decode program (static jit-key flag): attention layers
+        # append this step's K/V inside the Pallas kernel, reading the
+        # page-table/ragged-lens layout assembled above directly.
+        decode_fused=decode_fused and decode_only,
         state_slots=state_slots,
         dense_map=dense_map,
         q_lens=q_lens_arr,

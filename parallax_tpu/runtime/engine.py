@@ -152,6 +152,22 @@ class EngineConfig:
     # breakdown and logged. <= 0 disables slow capture (the timeline ring
     # still records).
     slow_request_ms: float = 30_000.0
+    # Fused decode kernels (ops/decode_fused_pallas.py, docs/kernels.md):
+    # each decode-step attention layer appends the new token's K/V into
+    # the paged cache INSIDE the Pallas decode kernel (the
+    # reshape_and_cache analogue fused away) and the common greedy /
+    # filtered-top-k sampling path runs as a sort-free fused kernel, so
+    # a K-step decode window is one device program whose per-step work
+    # is kernel-only. None (default) = auto: on on TPU, off elsewhere
+    # (the XLA reference path stays the numerics oracle). True forces
+    # the fused kernels anywhere — off-TPU they run in Pallas interpret
+    # mode (the CI parity/microbench configuration). Rows needing
+    # top-p/min-p (and the per-step host-sampling features: penalties,
+    # logprobs, grammar, logit_bias) keep the split sampler; non-TPU
+    # auto keeps XLA — both fallbacks are registered gates
+    # (analysis/gates.py) and visible in /status `kernel` and the
+    # parallax_attn_kernel_dispatch_total{impl,path} counter.
+    decode_fused: bool | None = None
     # Prefix-cache-aware routing (scheduling/request_routing.py
     # CacheAwareRouting): publish this stage's radix-tree block-hash
     # digests through heartbeats so the global scheduler can route
@@ -596,12 +612,42 @@ class StageEngine:
             cfg_m.is_mla or cfg_m.msa is not None
             or cfg_m.use_attention_sinks
         )
+        # Fused decode kernels (EngineConfig.decode_fused, None = auto on
+        # TPU): decode batches compile the fused variant (KV append inside
+        # the Pallas attention kernel + sort-free fused sampling); the
+        # impl label feeds /status and the kernel-dispatch counter.
+        from parallax_tpu.ops.kernel_select import (
+            decode_attn_impl,
+            resolve_decode_fused,
+            resolve_use_pallas,
+        )
+        from parallax_tpu.ops.kernel_select import (
+            IMPL_SPLIT as _IMPL_SPLIT,
+            IMPL_XLA as _IMPL_XLA,
+        )
+
+        self._decode_fused = resolve_decode_fused(self.cfg.decode_fused)
+        self._attn_impl = decode_attn_impl(
+            self._decode_fused, model.use_pallas
+        )
+        self._prefill_impl = (
+            _IMPL_SPLIT if resolve_use_pallas(model.use_pallas)
+            else _IMPL_XLA
+        )
+        # Fused decode sets the decode_only flag for EVERY model (the
+        # fused kernels dispatch on it), not just the classes with a
+        # decode-specialized split kernel.
+        if self._decode_fused:
+            self._use_decode_flag = True
+        self._warned_split_sampling = False
         self._base_key = jax.random.key(self.cfg.seed)
-        # Fused decode-window programs keyed by (k, sampled): the
-        # adaptive path and explicit overrides (bench probes mutate
-        # ``cfg.decode_lookahead`` between rounds) each get their own
-        # compile instead of silently reusing a stale-k scan.
-        self._jit_multistep: dict[tuple[int, bool], object] = {}
+        # Fused decode-window programs keyed by (k, sampled,
+        # fused_sample): the adaptive path and explicit overrides (bench
+        # probes mutate ``cfg.decode_lookahead`` between rounds) each
+        # get their own compile instead of silently reusing a stale-k
+        # scan, and the fused-sampler variant never aliases the
+        # sort-based one.
+        self._jit_multistep: dict[tuple[int, bool, bool], object] = {}
         # Per-request LoRA adapters (ops/lora.py); None until the first
         # load_adapter so base-only serving never touches the machinery.
         self._adapters = None
@@ -1145,6 +1191,24 @@ class StageEngine:
             "parallax_kv_pages_evicted_total",
             "Device pages reclaimed from the prefix tree", labelnames=st,
         ).labels(**lbl)
+        # Kernel-choice observability (docs/kernels.md): which attention
+        # implementation served each engine dispatch. ``impl`` is
+        # pallas-fused / pallas-split / xla, ``path`` is prefill /
+        # decode / multistep; one count per DISPATCH (not per layer).
+        # An operator watching this sees at a glance when a model
+        # silently fell back to the split or XLA path.
+        self._c_kernel = reg.counter(
+            "parallax_attn_kernel_dispatch_total",
+            "Engine dispatches by attention kernel implementation",
+            labelnames=("stage", "impl", "path"),
+        )
+        from parallax_tpu.analysis.sanitizer import make_lock
+
+        # Bumped on the dispatch thread, summarized from heartbeat /
+        # /status threads — same sharing shape as node._rx_stats.
+        self._kernel_lock = make_lock("engine.kernel_counts")
+        with self._kernel_lock:
+            self._kernel_counts: dict[tuple[str, str], int] = {}
         if model.is_first:
             self._h_ttft = reg.histogram(
                 "parallax_ttft_ms",
@@ -1186,6 +1250,49 @@ class StageEngine:
             self._c_resumes.set_total(stats.resumes)
             self._c_kv_oom.set_total(stats.kv_oom_aborts)
             self._c_evicted.set_total(stats.pages_evicted)
+
+    def _count_kernel_dispatch(
+        self, path: str, impl: str | None = None
+    ) -> None:
+        """One attention-kernel dispatch on ``path`` (prefill / decode /
+        multistep) with the given impl (default: the stage's resolved
+        decode impl). A dict increment + a registry counter bump — cheap
+        enough for the dispatch hot path."""
+        impl = impl or self._attn_impl
+        self._c_kernel.labels(
+            stage=self._obs_stage, impl=impl, path=path
+        ).inc()
+        key = (impl, path)
+        with self._kernel_lock:
+            self._kernel_counts[key] = self._kernel_counts.get(key, 0) + 1
+
+    def kernel_dispatch_summary(self) -> dict:
+        """The ``kernel`` payload for /status, heartbeats and
+        /cluster/status: the active decode impl + per-(impl, path)
+        dispatch counts, so a silent fallback to the split or XLA path
+        is operator-visible."""
+        with self._kernel_lock:
+            counts = dict(self._kernel_counts)
+        return {
+            "impl": self._attn_impl,
+            "decode_fused": self._decode_fused,
+            "dispatch_total": {
+                f"{impl}/{path}": n
+                for (impl, path), n in sorted(counts.items())
+            },
+        }
+
+    def _warn_split_sampling(self, reason: str) -> None:
+        """Warn-once gate site: fused decode is active but this batch's
+        rows force the split (sort-based / host-side) sampler. Fused
+        attention still runs; only the sampling fusion is lost."""
+        if self._warned_split_sampling:
+            return
+        self._warned_split_sampling = True
+        logger.warning(
+            "decode-fused sampling disabled: %s rows force the split "
+            "sampler (fused attention kernels stay active)", reason,
+        )
 
     def _trace_begin(self, req: Request) -> None:
         from parallax_tpu.obs.trace import get_trace_store
@@ -1307,7 +1414,8 @@ class StageEngine:
             k = ADAPTIVE_DECODE_LOOKAHEAD
         return max(1, int(k))
 
-    def _build_multistep(self, k: int, sampled: bool):
+    def _build_multistep(self, k: int, sampled: bool,
+                         fused_sample: bool = False):
         """Jit a k-step decode loop: forward -> sample -> feed back,
         entirely on device, with a per-row stop mask in the scan carry.
         The page table is fixed across the window (the scheduler
@@ -1334,6 +1442,14 @@ class StageEngine:
         same logits (bitwise on CPU; on TPU a near-tied categorical can
         flip on ulp-level fusion differences). Unseeded rows draw from
         the window key folded with the scan step and row index.
+
+        ``fused_sample=True`` (decode_fused engines, every sampled row
+        greedy or plain temperature/top-k) swaps the sort-based sampler
+        for the sort-free fused Pallas kernel
+        (``decode_fused_pallas.fused_sample_topk_pallas``). The gumbel
+        noise comes from the SAME ``ops/sampling.row_gumbel`` source the
+        XLA sampler consumes, so fused-on and fused-off draws are
+        bit-identical on the same logits.
         """
         import dataclasses as _dc
 
@@ -1365,7 +1481,25 @@ class StageEngine:
                 logits, kv = model(
                     params, kv, step_inputs_at(inputs, feed, ctx, stopped)
                 )
-                if sampled:
+                if sampled and fused_sample:
+                    from parallax_tpu.ops.decode_fused_pallas import (
+                        fused_sample_topk_pallas,
+                    )
+                    from parallax_tpu.ops.kernel_select import (
+                        fused_interpret,
+                    )
+                    from parallax_tpu.ops.sampling import row_gumbel
+
+                    gumbel = row_gumbel(
+                        jax.random.fold_in(ms["key"], step_i),
+                        logits.shape[0], logits.shape[1],
+                        ms["seeds"], ms["steps"] + step_i,
+                    )
+                    nxt = fused_sample_topk_pallas(
+                        logits, gumbel, ms["temp"], ms["top_k"],
+                        interpret=fused_interpret(),
+                    )
+                elif sampled:
                     nxt = sample_tokens(
                         logits,
                         jax.random.fold_in(ms["key"], step_i),
@@ -1511,6 +1645,28 @@ class StageEngine:
             or seg.request.sampling_params.seed is not None
             for seg in plan.seqs
         )
+        # Fused sampling covers the common path only: greedy rows and
+        # plain temperature/top-k rows with a bounded k (the fused
+        # kernel's threshold extraction is O(top_k * vocab) — a huge k
+        # would cost more than the sort it replaces). A top-p/min-p or
+        # large-top-k row anywhere in the batch drops the whole batch
+        # to the split (sort-based) sampler — fused attention stays
+        # active (registered gate, analysis/gates.py).
+        fused_sample = False
+        if sampled and self._decode_fused:
+            from parallax_tpu.ops.decode_fused_pallas import (
+                FUSED_SAMPLE_TOPK_MAX,
+            )
+
+            fused_sample = all(
+                seg.request.sampling_params.top_p >= 1.0
+                and seg.request.sampling_params.min_p <= 0.0
+                and seg.request.sampling_params.top_k
+                <= FUSED_SAMPLE_TOPK_MAX
+                for seg in plan.seqs
+            )
+            if not fused_sample:
+                self._warn_split_sampling("top-p/min-p/large-top-k")
         if self._needs_state:
             # Hybrid rows must have their state slots assigned before the
             # window (the normal path does this per step; here the whole
@@ -1529,7 +1685,9 @@ class StageEngine:
         inputs = assemble(
             plan, self.spec, self.cfg.page_size, decode_only=True,
             with_dense_map=self._needs_state,
+            decode_fused=self._decode_fused,
         )
+        self._count_kernel_dispatch("multistep")
         lora = self._lora_field(plan, inputs)
         if lora is not None:
             inputs = dataclasses.replace(inputs, lora=lora)
@@ -1558,10 +1716,10 @@ class StageEngine:
                 seeds=jnp.asarray(seeds),
             )
             window_key = jax.random.fold_in(self._base_key, self._step_count)
-        fn = self._jit_multistep.get((k, sampled))
+        fn = self._jit_multistep.get((k, sampled, fused_sample))
         if fn is None:
-            fn = self._jit_multistep[(k, sampled)] = (
-                self._build_multistep(k, sampled)
+            fn = self._jit_multistep[(k, sampled, fused_sample)] = (
+                self._build_multistep(k, sampled, fused_sample)
             )
         # Enqueue all m windows back-to-back: window j+1 consumes window
         # j's on-device carry (feed token, context, stop mask), so no
@@ -2165,20 +2323,25 @@ class StageEngine:
                 plan, self._sp_spec, self.cfg.page_size,
                 hidden_states=hidden, pad_position=-1,
             )
+            self._count_kernel_dispatch("prefill", self._prefill_impl)
             out, self.kv = self._jit_sp_step(self.params, self.kv, inputs)
         else:
             # Decode-only batches compile their own variant (static flag)
-            # so decode-specialized Pallas kernels can dispatch. Only set
-            # for models that HAVE such a kernel (plain MLA, sink models) —
-            # for everyone else the extra variant would be pure compile
-            # waste.
-            decode_only = self._use_decode_flag and all(
-                s.num_new_tokens == 1 for s in plan.seqs
-            )
+            # so decode-specialized Pallas kernels can dispatch. Set for
+            # models that HAVE such a kernel (plain MLA, sink models) and
+            # for every model under fused decode — for everyone else the
+            # extra variant would be pure compile waste.
+            one_token = all(s.num_new_tokens == 1 for s in plan.seqs)
+            decode_only = self._use_decode_flag and one_token
             inputs = assemble(
                 plan, self.spec, self.cfg.page_size, hidden_states=hidden,
                 with_dense_map=self._needs_state, decode_only=decode_only,
                 gather_all_logits=bool(spec_rows),
+                decode_fused=self._decode_fused and decode_only,
+            )
+            self._count_kernel_dispatch(
+                "decode" if one_token else "prefill",
+                self._attn_impl if decode_only else self._prefill_impl,
             )
             lora = self._lora_field(plan, inputs)
             if lora is not None:
@@ -2237,6 +2400,15 @@ class StageEngine:
             # Host-synchronous logits processing (penalties, logprobs,
             # grammar, logit_bias): the driver must resolve before the
             # next dispatch so the histories these rows need are complete.
+            if (
+                self._decode_fused
+                and sp_plan is None
+                and inputs.decode_only
+                and not self._overlap_sample_ok(plan)
+            ):
+                self._warn_split_sampling(
+                    "penalties/logprobs/grammar/logit-bias"
+                )
             ticket.sync_only = True
         ticket.host_ms = (time.perf_counter() - t0) * 1000.0
         self._inflight.append(ticket)

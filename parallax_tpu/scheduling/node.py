@@ -216,6 +216,10 @@ class Node:
     # split device/host tier, occupancy, demotion/swap-in/preemption
     # counts); surfaced in /cluster/status.
     cache_stats: dict | None = None
+    # Attention-kernel dispatch summary from heartbeats (active impl:
+    # pallas-fused / pallas-split / xla + per-path counts); surfaced in
+    # /cluster/status so a silent kernel fallback is operator-visible.
+    kernel: dict | None = None
     # Per-link activation-transport telemetry from heartbeats (bytes in/
     # out, serialize/send ms, queue depth, compression ratio per peer);
     # surfaced in /cluster/status.

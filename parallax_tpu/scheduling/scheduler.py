@@ -178,12 +178,13 @@ class GlobalScheduler:
         goodput: dict | None = None,
         health: dict | None = None,
         events: dict | None = None,
+        kernel: dict | None = None,
     ) -> None:
         self._events.put(
             ("update", node_id, layer_latency_ms, load, rtt_s, is_ready,
              refit_version, lora_adapters, step_timing, cache_stats,
              transport, metrics, cache_digests, busy, goodput, health,
-             events)
+             events, kernel)
         )
 
     def enqueue_peer_down(self, reporter: str, peer: str,
@@ -445,6 +446,7 @@ class GlobalScheduler:
             goodput = rest[4] if len(rest) > 4 else None
             health = rest[5] if len(rest) > 5 else None
             events = rest[6] if len(rest) > 6 else None
+            kernel = rest[7] if len(rest) > 7 else None
             if events is not None:
                 # Merge the node's flight-event batch even for unknown
                 # nodes: a churn victim's last beats are exactly the
@@ -475,6 +477,8 @@ class GlobalScheduler:
                 node.step_timing = timing
             if cache_stats is not None:
                 node.cache_stats = cache_stats
+            if kernel is not None:
+                node.kernel = kernel
             if transport is not None:
                 node.transport = transport
             if metrics is not None:
@@ -901,6 +905,10 @@ class GlobalScheduler:
                         # rates, occupancy, demotions, swap-ins,
                         # preemptions) from heartbeats.
                         "cache_stats": n.cache_stats,
+                        # Attention-kernel impl (pallas-fused /
+                        # pallas-split / xla) + per-path dispatch
+                        # counts from heartbeats (docs/kernels.md).
+                        "kernel": n.kernel,
                         # Per-link activation-transport telemetry
                         # (bytes each way, serialize/send ms, queue
                         # depth, compression ratio) from heartbeats.

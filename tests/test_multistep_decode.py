@@ -54,7 +54,7 @@ def test_multistep_matches_single_step_exactly():
     for b, m in zip(base, multi):
         assert m.output_ids == b.output_ids, (b.output_ids, m.output_ids)
         assert m.status == b.status
-    assert (4, False) in eng._jit_multistep  # the path actually ran
+    assert (4, False, False) in eng._jit_multistep  # the path actually ran
 
 
 def test_multistep_respects_max_tokens_and_eos():
@@ -127,7 +127,7 @@ def test_multistep_sampled_seeded_matches_single_step_exactly():
     specs = [([3, 14, 15, 92], 0.9, 7), ([7, 21, 108], 1.3, 11)]
     base, beng = _run_sampled(1, specs)
     multi, meng = _run_sampled(4, specs)
-    assert (4, True) in meng._jit_multistep  # fused-sampler variant ran
+    assert (4, True, False) in meng._jit_multistep  # fused-sampler variant ran
     assert not beng._jit_multistep
     for b, m in zip(base, multi):
         assert m.output_ids == b.output_ids, (b.output_ids, m.output_ids)
@@ -138,7 +138,7 @@ def test_multistep_sampled_mixed_greedy_rows_stay_greedy():
     variant; the greedy rows' outputs must equal the pure-greedy run."""
     specs = [([5, 6, 7, 8], 0.0, None), ([9, 10, 11], 1.0, 3)]
     mixed, meng = _run_sampled(4, specs)
-    assert (4, True) in meng._jit_multistep
+    assert (4, True, False) in meng._jit_multistep
     greedy_only, _ = _run_sampled(1, [([5, 6, 7, 8], 0.0, None)])
     assert mixed[0].output_ids == greedy_only[0].output_ids
     # seeded row reproducible vs its single-step stream too
@@ -211,7 +211,7 @@ def test_pipelined_windows_match_single_step_exactly():
     for b, m in zip(base, piped):
         assert m.output_ids == b.output_ids, (b.output_ids, m.output_ids)
         assert m.status == b.status
-    assert (4, False) in eng._jit_multistep
+    assert (4, False, False) in eng._jit_multistep
     assert eng._last_fused_steps == 12  # 3 windows x k=4 actually chained
 
 
@@ -606,7 +606,7 @@ def test_adaptive_lookahead_default_and_downshift():
     assert clean_a.output_ids == clean_b.output_ids
     assert pen_a.output_ids == pen_b.output_ids
     # Adaptive K compiled at the default cap.
-    assert (ADAPTIVE_DECODE_LOOKAHEAD, False) in eng._jit_multistep
+    assert (ADAPTIVE_DECODE_LOOKAHEAD, False, False) in eng._jit_multistep
     # Window dispatches while the penalized request shared the batch
     # were refused (downshift); clean-only batches got windows both
     # before and after.
