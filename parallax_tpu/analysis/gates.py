@@ -123,4 +123,20 @@ GATE_TABLE: tuple[Gate, ...] = (
         reason="handoff targets come from the scheduler's decode-pool "
                "chooser; a gossip swarm has nobody to pick them",
     ),
+    Gate(
+        feature="qos",
+        marker="qos park enforcement disabled: no host KV tier",
+        doc="docs/qos.md",
+        reason="shed enforcement parks running batch decodes through "
+               "the PR 2 preempt-to-host path; without the tier, "
+               "shedding can only hold NEW admissions",
+    ),
+    Gate(
+        feature="flag:--qos",
+        marker="qos autoscaler disabled: single-host serving",
+        doc="docs/qos.md",
+        reason="the autoscaler re-roles pipelines between the swarm's "
+               "prefill/decode pools; a single-host engine has no "
+               "pools to rebalance",
+    ),
 )

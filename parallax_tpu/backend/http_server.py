@@ -213,6 +213,7 @@ class OpenAIFrontend:
         adapters_fn=None,
         healthz_fn=None,
         timeline_fn=None,
+        qos_config=None,
     ):
         self.tokenizer = tokenizer
         self.submit_fn = submit_fn
@@ -223,13 +224,17 @@ class OpenAIFrontend:
         # workers' published prefix digests. Older single-arg callables
         # (tests, custom frontends) keep working.
         self._route_takes_meta = False
+        self._route_takes_tenant = False
         if route_fn is not None:
             try:
                 import inspect
 
-                self._route_takes_meta = (
-                    "prompt_ids" in inspect.signature(route_fn).parameters
-                )
+                params = inspect.signature(route_fn).parameters
+                self._route_takes_meta = "prompt_ids" in params
+                # Per-tenant routing fairness (docs/qos.md): newer route
+                # callables accept the request's tenant so the
+                # cache-aware router can charge its fairness term.
+                self._route_takes_tenant = "tenant_id" in params
             except (TypeError, ValueError):  # builtins / C callables
                 pass
         self.status_fn = status_fn
@@ -242,6 +247,14 @@ class OpenAIFrontend:
         # payloads so scrapers need no feature detection.
         self.healthz_fn = healthz_fn
         self.timeline_fn = timeline_fn
+        # Multi-tenant QoS (parallax_tpu/qos, docs/qos.md): when a
+        # QoSConfig is wired, requests carry a class (header
+        # ``x-parallax-qos-class`` / body ``qos_class``), a deadline
+        # (``x-parallax-deadline-ms`` / ``deadline_ms``) and a tenant
+        # (``x-parallax-tenant`` / ``tenant``; defaults to the LoRA
+        # adapter). None = QoS off — no parsing, untagged requests,
+        # bit-identical behavior.
+        self.qos_config = qos_config
         self.model_name = model_name
         self.stream_poll_s = stream_poll_s
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
@@ -670,15 +683,35 @@ class OpenAIFrontend:
         if n_choices > 1 and body.get("stream"):
             return self._error(400, "streaming with n > 1 is not supported")
 
+        # Multi-tenant QoS (docs/qos.md): class / deadline / tenant from
+        # headers and body. All None while QoS is off.
+        lora_id = self._request_lora(body)
+        qos_class = deadline = tenant_id = None
+        if self.qos_config is not None:
+            from parallax_tpu.qos import qos_from_http
+
+            try:
+                qos_class, deadline_ms, tenant_id = qos_from_http(
+                    http_request.headers, body, self.qos_config,
+                )
+            except (TypeError, ValueError) as e:
+                return self._error(400, f"invalid QoS parameter: {e}")
+            deadline = time.monotonic() + deadline_ms / 1e3
+            if tenant_id is None:
+                tenant_id = lora_id
+
         # Routing with retry ladder (reference request_handler.py:100-245:
         # None path -> 503 after retries; engine full -> 429).
-        lora_id = self._request_lora(body)
         routing_table: list[str] = []
         if self.route_fn is not None:
             if self._route_takes_meta:
+                kwargs = {"prompt_ids": list(prompt_ids),
+                          "lora_id": lora_id}
+                if self._route_takes_tenant:
+                    kwargs["tenant_id"] = tenant_id
+                    kwargs["qos_class"] = qos_class
                 path = await asyncio.to_thread(
-                    self.route_fn, rid,
-                    prompt_ids=list(prompt_ids), lora_id=lora_id,
+                    self.route_fn, rid, **kwargs,
                 )
             else:
                 path = await asyncio.to_thread(self.route_fn, rid)
@@ -690,6 +723,7 @@ class OpenAIFrontend:
             return await self._generate_n(
                 rid, body, prompt_ids, sampling_params, routing_table,
                 chat, n_choices,
+                qos=(qos_class, deadline, tenant_id),
             )
 
         req = Request(
@@ -701,6 +735,9 @@ class OpenAIFrontend:
             # Per-request adapter (reference Req.lora_path): "lora" in
             # the body or the <model>:<adapter> model-name convention.
             lora_id=lora_id,
+            qos_class=qos_class,
+            deadline=deadline,
+            tenant_id=tenant_id,
         )
         # Count at accept time, not in usage formatting: client disconnects
         # mid-stream must still be visible in /metrics.
@@ -745,7 +782,8 @@ class OpenAIFrontend:
             self._count_completion(req, t_start)
 
     async def _generate_n(self, rid, body, prompt_ids, sampling_params,
-                          routing_table, chat, n_choices):
+                          routing_table, chat, n_choices,
+                          qos=(None, None, None)):
         """OpenAI ``n`` > 1: n independent generations on one pipeline path,
         merged into one choices array. (The reference's engine protocol has
         no multi-choice support; the vllm-rs frontend expands client-side
@@ -773,6 +811,9 @@ class OpenAIFrontend:
                 routing_table=list(routing_table),
                 eos_token_ids=tuple(self.tokenizer.eos_token_ids),
                 lora_id=self._request_lora(body),
+                qos_class=qos[0],
+                deadline=qos[1],
+                tenant_id=qos[2],
             )
             try:
                 done = await asyncio.to_thread(self.submit_fn, req)

@@ -49,7 +49,9 @@ class SwarmClient:
 
     def route(self, request_id: str,
               prompt_ids: list[int] | None = None,
-              lora_id: str | None = None) -> list[str] | None:
+              lora_id: str | None = None,
+              tenant_id: str | None = None,
+              qos_class: str | None = None) -> list[str] | None:
         if self.service is None:
             # Chat-host mode: probe the head's readiness so a still-loading
             # or route-less swarm maps to the frontend's retryable 503
@@ -67,6 +69,7 @@ class SwarmClient:
         path = self.service.route_request(
             request_id, timeout_s=10.0,
             prompt_ids=prompt_ids, lora_id=lora_id,
+            tenant_id=tenant_id, qos_class=qos_class,
         )
         if not path:
             # No submit will follow to retire the entry via _poll_loop.
@@ -88,6 +91,7 @@ class SwarmClient:
                 "routing_table": request.routing_table,
                 "eos_token_ids": list(request.eos_token_ids),
                 "lora_id": request.lora_id,
+                **self._qos_payload(request),
             }, timeout=30.0)
         except Exception:
             # The workers never saw this request; release the load the
@@ -104,6 +108,23 @@ class SwarmClient:
         )
         t.start()
         return ev
+
+    @staticmethod
+    def _qos_payload(request: Request) -> dict:
+        """QoS context for a head submit (docs/qos.md): class/tenant
+        verbatim, the deadline converted to a REMAINING budget so it
+        survives the process hop (absolute monotonic values do not).
+        Empty for untagged requests — older heads never see the keys."""
+        out: dict = {}
+        if request.qos_class is not None:
+            out["qos_class"] = request.qos_class
+        if request.deadline is not None:
+            out["deadline_ms"] = max(
+                0.0, (request.deadline - time.monotonic()) * 1e3
+            )
+        if request.tenant_id is not None:
+            out["tenant"] = request.tenant_id
+        return out
 
     def stop(self, request_id: str) -> None:
         """Ask the head node to finish a request early (stop-string match).
@@ -181,6 +202,7 @@ class SwarmClient:
             "routing_table": list(path),
             "eos_token_ids": list(request.eos_token_ids),
             "lora_id": request.lora_id,
+            **self._qos_payload(request),
         }
         streamed = list(request.output_ids)
         if streamed:
@@ -319,6 +341,7 @@ def build_swarm_frontend(
     model_name: str,
     resolve_model=None,
     tokenizer_fn=None,
+    qos_config=None,
 ) -> tuple[OpenAIFrontend, SchedulerService, SwarmClient]:
     service = SchedulerService(scheduler, transport)
     client = SwarmClient(transport, service)
@@ -396,6 +419,7 @@ def build_swarm_frontend(
         adapters_fn=adapters,
         healthz_fn=healthz,
         timeline_fn=timeline,
+        qos_config=qos_config,
     )
     if resolve_model is not None:
         frontend.scheduler_init_fn = make_scheduler_init_fn(
@@ -474,7 +498,10 @@ def make_scheduler_init_fn(service: SchedulerService, resolve_model,
                 routing_kwargs=service.scheduler.routing_kwargs,
                 # The SLO objectives (and their burn-rate history)
                 # survive a model switch too — the error budget belongs
-                # to the service, not the model.
+                # to the service, not the model. Same for the QoS
+                # control plane: classes and autoscaler config are
+                # service policy.
+                qos=service.scheduler.qos_config,
             )
             new_sched.slo_tracker = old_tracker
             old = service.scheduler
@@ -523,6 +550,8 @@ def run_main(args) -> int:
             "imbalance_threshold": getattr(
                 args, "routing_imbalance", 8
             ),
+            # Per-tenant fairness term (docs/qos.md); 0 = off.
+            "gamma": getattr(args, "routing_gamma", 0.0) or 0.0,
         }
     slo_config = None
     slo_spec = getattr(args, "slo", None)
@@ -534,11 +563,19 @@ def run_main(args) -> int:
         slo_config = parse_slo_spec(
             slo_spec, window_s=getattr(args, "slo_window_s", 300.0),
         )
+    qos_config = None
+    qos_spec = getattr(args, "qos", None)
+    if qos_spec:
+        from parallax_tpu.qos import parse_qos_spec
+
+        # Fails fast on a malformed spec, like --slo.
+        qos_config = parse_qos_spec(qos_spec)
     scheduler = GlobalScheduler(
         model, min_nodes_bootstrapping=args.min_nodes,
         routing=getattr(args, "routing", "rr"),
         routing_kwargs=routing_kwargs,
         slo=slo_config,
+        qos=qos_config,
     )
     transport = TcpTransport(
         "scheduler", "0.0.0.0", args.port + 1,
@@ -550,6 +587,7 @@ def run_main(args) -> int:
         tokenizer_fn=lambda name: load_tokenizer(
             name if os.path.isdir(name) else None
         ),
+        qos_config=qos_config,
     )
     service.start()
     logger.info(

@@ -285,7 +285,9 @@ class SchedulerService:
     def route_request(self, request_id: str, timeout_s: float = 5.0,
                       prompt_ids: list[int] | None = None,
                       lora_id: str | None = None,
-                      arrival_time: float | None = None) -> list[str] | None:
+                      arrival_time: float | None = None,
+                      tenant_id: str | None = None,
+                      qos_class: str | None = None) -> list[str] | None:
         """Block until the dispatcher assigns a node path (reference
         scheduler_manage.get_routing_table, scheduler_manage.py:287-313).
 
@@ -297,6 +299,7 @@ class SchedulerService:
 
         meta = RequestMeta(
             request_id, prompt_ids=prompt_ids, lora_id=lora_id,
+            tenant_id=tenant_id, qos_class=qos_class,
         ) if prompt_ids else None
         pr = self.scheduler.receive_request(
             request_id, meta=meta, arrival_time=arrival_time,

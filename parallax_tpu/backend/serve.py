@@ -79,6 +79,7 @@ def build_local_frontend(
     wire: bool = False,
     watchdog: bool = False,
     slo_config=None,
+    qos_config=None,
 ) -> tuple[OpenAIFrontend, LocalRunner]:
     """``wire=True`` routes inter-stage packets through the real wire
     format (the in-process twin of the networked hop) — exercised by the
@@ -187,6 +188,11 @@ def build_local_frontend(
                 "finished": req.get("finished") or 0,
                 "aborted": req.get("aborted") or 0,
             })
+        # Multi-tenant QoS (docs/qos.md): the head stage's class table,
+        # shed/burn state and admission/shed/park counters.
+        head_qos = engines[0].scheduler.qos
+        if head_qos is not None:
+            out["qos"] = head_qos.payload()
         return out
 
     def adapters():
@@ -214,6 +220,7 @@ def build_local_frontend(
         adapters_fn=adapters,
         healthz_fn=(wd.summary if wd is not None else None),
         timeline_fn=timeline,
+        qos_config=qos_config,
     )
     return frontend, runner
 
@@ -401,6 +408,11 @@ def serve_main(args) -> int:
             # flight threshold (docs/observability.md).
             trace_sample_rate=getattr(args, "trace_sample_rate", 0.0) or 0.0,
             slow_request_ms=getattr(args, "slow_request_ms", 30_000.0),
+            # Multi-tenant QoS spec (docs/qos.md): classes + deadline
+            # EDF + shed/park on this engine's local scheduler. The
+            # default "off" wires no policy — zero per-step cost.
+            qos=getattr(args, "qos", None),
+            lora_max_adapters=getattr(args, "lora_max_adapters", 0) or 0,
         ),
         mesh=mesh,
         sp_mesh=sp_mesh,
@@ -424,10 +436,27 @@ def serve_main(args) -> int:
             slo_spec,
             window_s=getattr(args, "slo_window_s", 300.0),
         )
+    qos_config = None
+    qos_spec = getattr(args, "qos", None)
+    if qos_spec:
+        from parallax_tpu.qos import parse_qos_spec
+
+        # Fails fast on a malformed spec, like --slo.
+        qos_config = parse_qos_spec(qos_spec)
+        if qos_config is not None and qos_config.autoscale:
+            # Registered gate (analysis/gates.py): the pool autoscaler
+            # re-roles pipelines between the swarm's phase pools — a
+            # single-host engine has no pools to rebalance.
+            logger.warning(
+                "qos autoscaler disabled: single-host serving has no "
+                "phase pools to re-role (run a swarm scheduler with "
+                "--qos ...,autoscale=1 for pool autoscaling)"
+            )
     frontend, _runner = build_local_frontend(
         [engine], tokenizer, model_name=args.model_path,
         watchdog=bool(getattr(args, "watchdog", False)),
         slo_config=slo_config,
+        qos_config=qos_config,
     )
     logger.info("serving %s layers [%d, %d) on :%d",
                 args.model_path, start, end, args.port)

@@ -140,6 +140,20 @@ def build_parser() -> argparse.ArgumentParser:
         "--slo-window-s", type=float, default=300.0,
         help="short SLO window seconds (the long window is 12x)",
     )
+    serve.add_argument(
+        "--qos", default=None,
+        help="multi-tenant QoS (docs/qos.md): 'on' or a key=value spec "
+             "(e.g. 'interactive_ms=500,batch_ms=60000,shed_burn=2') "
+             "enables request classes, deadline-aware EDF scheduling "
+             "and shed/park admission control; default off is provably "
+             "inert (zero per-step cost, bit-identical streams)",
+    )
+    serve.add_argument(
+        "--lora-max-adapters", type=int, default=0,
+        help="LoRA hot-load LRU cap: registering past it evicts the "
+             "least-recently-batched adapter (never one in flight); "
+             "0 = unbounded",
+    )
 
     run = sub.add_parser("run", help="launch the scheduler + web frontend")
     run.add_argument("--model-name", required=True)
@@ -172,6 +186,12 @@ def build_parser() -> argparse.ArgumentParser:
              "hot prefix cannot starve a replica",
     )
     run.add_argument(
+        "--routing-gamma", type=float, default=0.0,
+        help="cache_aware: per-tenant fairness — cost per unit of the "
+             "tenant's own recent-dispatch share on a pipeline "
+             "(docs/qos.md); 0 disables the term",
+    )
+    run.add_argument(
         "--relay-token", default=None,
         help="shared secret NAT'd workers must present to register a "
              "relay route (default: registration is identity-bound only)",
@@ -188,6 +208,15 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument(
         "--slo-window-s", type=float, default=300.0,
         help="short SLO window seconds (the long window is 12x)",
+    )
+    run.add_argument(
+        "--qos", default=None,
+        help="multi-tenant QoS control plane (docs/qos.md): 'on' or a "
+             "key=value spec. Adds request classes + deadlines at the "
+             "HTTP frontend, a cluster admission controller relaying "
+             "shed verdicts through heartbeats, and (with "
+             "'autoscale=1') the goodput-driven pool autoscaler that "
+             "re-roles pipelines between the prefill/decode pools",
     )
 
     join = sub.add_parser("join", help="join a swarm as a worker")
@@ -316,6 +345,18 @@ def build_parser() -> argparse.ArgumentParser:
         "--watchdog-stalled-s", type=float, default=15.0,
         help="seconds without progress before a component reports "
              "stalled (flips deep /healthz to 503)",
+    )
+    join.add_argument(
+        "--qos", default=None,
+        help="multi-tenant QoS on this worker's local scheduler "
+             "(docs/qos.md): 'on' or a key=value spec — deadline EDF "
+             "scheduling + shed/park enforcement; the scheduler's "
+             "cluster shed verdict (relayed in heartbeat replies) ORs "
+             "with the local controller. Default off = inert",
+    )
+    join.add_argument(
+        "--lora-max-adapters", type=int, default=0,
+        help="LoRA hot-load LRU cap (0 = unbounded)",
     )
 
     bench = sub.add_parser("bench", help="offline throughput benchmark")

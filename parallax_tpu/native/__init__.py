@@ -331,7 +331,9 @@ class NativeCacheManager:
         self.allocator = NativePageAllocator(num_pages)
         # rid -> number of tree-shared pages (for release's unlock walk).
         self._shared: dict[str, int] = {}
-        # Per-adapter prefix-cache namespaces (cache_manager.ns_salt).
+        # Per-adapter prefix-cache namespaces (cache_manager.ns_salt:
+        # deterministic per adapter id, so replicas agree and routing
+        # digests reproduce scheduler-side).
         self._ns_salts: dict[str, int] = {}
         # Observability counters (utils.request_metrics.cache_stats_summary
         # reads these; the native tier has no host cache, so host/preempt

@@ -119,11 +119,30 @@ class AdapterSet:
     adapters into ``[num_slots, ...]`` device arrays, which changes the
     lora pytree's shapes and thus retraces the step on the next lora
     batch — steady-state serving pays nothing.
+
+    **Hot-load/evict LRU** (docs/qos.md): with ``max_adapters > 0`` the
+    set is a managed cache — registering past the cap evicts the
+    least-recently-USED adapter (use = appearing in a dispatched
+    batch), never one the caller marks ``active`` (in-flight requests
+    must keep their weights). Eviction frees the stacked device arrays
+    on the next rebuild and drops the name from heartbeat advertising;
+    the adapter's prefix-cache digest namespace
+    (``cache_manager.derive_ns_salt``) is deterministic, so a re-load
+    later re-joins the same namespace and its surviving radix pages hit
+    again. 0 (the default) = unbounded, the pre-LRU behavior.
     """
 
-    def __init__(self):
+    def __init__(self, max_adapters: int = 0):
+        self.max_adapters = max_adapters
         self._adapters: "OrderedDict[str, dict]" = OrderedDict()
         self._stacked = None   # {"layers": {...}} device pytree
+        self.evicted_total = 0
+        # LRU recency lives OUTSIDE the adapter dict: ``slot_of`` and
+        # ``_stack`` both key off the dict's insertion order, so a
+        # use-time reorder would desync a batch's slot index from the
+        # stacked arrays (wrong adapter applied in-graph).
+        self._use_clock = 0
+        self._last_used: dict[str, int] = {}
 
     def __contains__(self, name: str) -> bool:
         return name in self._adapters
@@ -132,16 +151,55 @@ class AdapterSet:
     def names(self) -> list[str]:
         return list(self._adapters)
 
-    def register(self, name: str, tree: dict) -> None:
-        """``tree``: {local_layer: {"group.proj": (A, B, scale)}}."""
+    def touch(self, name: str | None) -> None:
+        """LRU bump on batch use (cheap: one counter write; never
+        reorders the slot-defining dict)."""
+        if name is not None and name in self._adapters:
+            self._use_clock += 1
+            self._last_used[name] = self._use_clock
+
+    def register(self, name: str, tree: dict,
+                 active=()) -> list[str]:
+        """``tree``: {local_layer: {"group.proj": (A, B, scale)}}.
+        Returns the names evicted to stay under ``max_adapters``
+        (never ``name`` itself and never a member of ``active``)."""
         for layer_tree in tree.values():
             for path in layer_tree:
                 if path not in SUPPORTED_PROJS:
                     raise ValueError(f"unsupported adapter path {path!r}")
         self._adapters[name] = tree
+        self.touch(name)
+        evicted: list[str] = []
+        if self.max_adapters > 0:
+            keep = set(active) | {name}
+            victims = sorted(
+                (n for n in self._adapters if n not in keep),
+                key=lambda n: self._last_used.get(n, 0),
+            )
+            while len(self._adapters) > self.max_adapters and victims:
+                cand = victims.pop(0)
+                del self._adapters[cand]
+                self._last_used.pop(cand, None)
+                evicted.append(cand)
+                self.evicted_total += 1
         self._stacked = None
+        if evicted:
+            logger.info(
+                "LoRA LRU evicted %s (cap %d); slots rebuild on next "
+                "adapter batch", evicted, self.max_adapters,
+            )
+            try:
+                from parallax_tpu.obs.registry import get_registry
+
+                get_registry().counter(
+                    "parallax_lora_adapter_evictions_total",
+                    "Adapters evicted by the hot-load LRU cache",
+                ).inc(len(evicted))
+            except Exception:  # pragma: no cover - metrics never break
+                pass
         logger.info("registered LoRA adapter %r (%d total)", name,
                     len(self._adapters))
+        return evicted
 
     def slot_of(self, name: str) -> int:
         return list(self._adapters).index(name)
@@ -151,6 +209,7 @@ class AdapterSet:
         ``{"slot": i32[], "layers": {li: {path: {"A","B","s"}}}}``."""
         import jax.numpy as jnp
 
+        self.touch(name)
         if self._stacked is None:
             self._stacked = self._stack()
         return {
@@ -175,6 +234,7 @@ class AdapterSet:
     def token_slot(self, name: str | None) -> int:
         """Row slot for mixed batches; base rows (None) get the null slot
         one past the last adapter — its one-hot is all-zero."""
+        self.touch(name)
         return self.slot_of(name) if name is not None else len(self._adapters)
 
     def _stack(self) -> dict:

@@ -184,6 +184,12 @@ def join_main(args) -> int:
             # (docs/observability.md).
             trace_sample_rate=getattr(args, "trace_sample_rate", 0.0) or 0.0,
             slow_request_ms=getattr(args, "slow_request_ms", 30_000.0),
+            # Multi-tenant QoS on this worker's local scheduler
+            # (docs/qos.md): deadline EDF + shed/park enforcement;
+            # the cluster controller's shed verdict arrives via
+            # heartbeat replies and ORs with the local one.
+            qos=getattr(args, "qos", None),
+            lora_max_adapters=getattr(args, "lora_max_adapters", 0) or 0,
         ),
         load_params=load_params,
         mesh=mesh,

@@ -837,6 +837,36 @@ class WorkerNode:
                     # The scheduler saw a sequence gap (its restart, a
                     # dropped beat): ship a full snapshot next beat.
                     self._digests_full_next = True
+                if (
+                    reply and isinstance(reply.get("role"), str)
+                    and reply["role"] in ("prefill", "decode", "mixed")
+                    and reply["role"] != self.role
+                ):
+                    # QoS autoscaler re-role (docs/qos.md): adopt the
+                    # new phase in place — same layers, no reload. A
+                    # decode->prefill move drains its in-flight decodes
+                    # through the ordinary handoff machinery on the
+                    # next step-loop passes (zero aborts).
+                    old_role = self.role
+                    self.role = reply["role"]
+                    logger.warning(
+                        "%s: re-roled %s -> %s by the scheduler",
+                        self.node_id, old_role, self.role,
+                    )
+                    from parallax_tpu.obs.flight import get_flight
+
+                    get_flight().event(
+                        "qos_rerole", node=self.node_id,
+                        role=self.role, prev=old_role,
+                    )
+                if reply and "qos_shed" in reply:
+                    # Cluster shed verdict: OR'd with the engine's own
+                    # local controller (docs/qos.md).
+                    eng = self.engine
+                    if eng is not None and eng.scheduler.qos is not None:
+                        eng.scheduler.qos.set_remote_shed(
+                            bool(reply["qos_shed"])
+                        )
                 if reply and reply.get("rejoin"):
                     # Scheduler lost us (restart or heartbeat eviction):
                     # auto-rejoin (reference rpc_connection_handler.py:71-113).
@@ -1535,6 +1565,15 @@ class WorkerNode:
             routing_table=list(payload.get("routing_table") or []),
             eos_token_ids=tuple(payload.get("eos_token_ids") or ()),
             lora_id=payload.get("lora_id"),
+            # QoS context (docs/qos.md): the deadline ships as a
+            # REMAINING budget and re-anchors on this process's
+            # monotonic clock (absolute values don't cross processes).
+            qos_class=payload.get("qos_class"),
+            deadline=(
+                time.monotonic() + float(payload["deadline_ms"]) / 1e3
+                if payload.get("deadline_ms") is not None else None
+            ),
+            tenant_id=payload.get("tenant"),
         )
         replay = payload.get("replay_ids")
         if replay:
@@ -2070,6 +2109,7 @@ class WorkerNode:
         prior outputs in, and outputs still awaiting teacher-forced
         replay count too — so the scheduler's chain prediction sees the
         same tokens the restore will re-prefill."""
+        from parallax_tpu.runtime.cache_manager import derive_ns_salt
         from parallax_tpu.runtime.radix_cache import block_hash_chain
 
         history = list(req.all_token_ids) + list(req.replay_ids)
@@ -2078,8 +2118,14 @@ class WorkerNode:
             "prompt_tokens": len(history),
             "lora_id": req.lora_id,
         }
-        if req.lora_id is None:
-            d["chains"] = {str(page): block_hash_chain(history, page)}
+        if req.lora_id is not None:
+            # Adapter requests hash in the adapter's own digest
+            # namespace — deterministic per adapter id, so the
+            # scheduler's CacheIndex mirrors (fed from equally-salted
+            # radix trees on every replica) can score them too.
+            salt = derive_ns_salt(req.lora_id)
+            history = [t ^ salt for t in history]
+        d["chains"] = {str(page): block_hash_chain(history, page)}
         return d
 
     def _ship_checkpoints_inner(

@@ -217,6 +217,9 @@ def ireq_to_wire(
         # Trace context (obs/trace.py): sampled requests carry the flag
         # across stage hops so spans stitch into one trace.
         "trace": ireq.trace,
+        # QoS class tag (docs/qos.md): downstream stages order mirror
+        # work by the head's class. Omitted (None) when QoS is off.
+        "qos": ireq.qos_class,
     }
 
 
@@ -238,6 +241,7 @@ def ireq_from_wire(d: dict) -> IntermediateRequest:
         cached_prefix_ids=d.get("cached_prefix_ids"),
         lora_id=d.get("lora_id"),
         trace=bool(d.get("trace", False)),
+        qos_class=d.get("qos"),
     )
 
 

@@ -123,22 +123,62 @@ def make_cache_manager(
     )
 
 
+_NS_SECRET_NOTED = False
+
+
+def derive_ns_salt(lora_id: str) -> int:
+    """Deterministic 31-bit prefix-cache namespace salt for one
+    adapter: ``blake2s(secret + adapter id)``, never 0 (an all-zero
+    salt would alias the base namespace).
+
+    Deterministic BY DESIGN (it used to be process-random): every
+    replica salts the same adapter identically, so the block-hash
+    digests workers publish for adapter-namespaced prefixes are
+    reproducible scheduler-side — cache-aware routing and migration
+    targeting can score adapter tenants' warm replicas instead of
+    skipping the prediction (RequestMeta.chain). Namespaces stay
+    pairwise distinct, but without a secret they are COMPUTABLE: a
+    caller who can submit raw token ids (library/swarm surfaces — the
+    HTTP plane tokenizes text) could craft a stream landing in another
+    adapter's namespace. Deployments that need unguessable namespaces
+    set ``PARALLAX_NS_SECRET`` (same value cluster-wide — the salt
+    must agree across replicas for routing to work); the first
+    adapter-salt derivation logs which mode is in effect."""
+    import hashlib
+    import os
+
+    secret = os.environ.get("PARALLAX_NS_SECRET", "")
+    global _NS_SECRET_NOTED
+    if not _NS_SECRET_NOTED:
+        _NS_SECRET_NOTED = True
+        if not secret:
+            logger.info(
+                "adapter prefix-cache namespaces derived without "
+                "PARALLAX_NS_SECRET: deterministic and distinct per "
+                "adapter, but computable by anyone who knows the "
+                "adapter id (set the secret cluster-wide for "
+                "unguessable namespaces; docs/qos.md)"
+            )
+    digest = hashlib.blake2s(
+        f"{secret}:{lora_id}".encode("utf-8", "surrogatepass")
+    ).digest()
+    return (int.from_bytes(digest[:4], "little") & 0x7FFFFFFF) or 1
+
+
 def ns_salt(salts: dict[str, int], lora_id: str | None) -> int | None:
-    """Process-random 31-bit prefix-cache namespace salt per adapter.
+    """Memoized per-adapter namespace salt (see ``derive_ns_salt``).
 
     KV contents depend on the LoRA adapter, so tenants must never
     prefix-hit each other's pages. XOR-salting the token stream keeps
     its length (page alignment intact), fits the native backend's int32
     tokens, and is identical for both radix implementations.
     Cross-tenant collisions require an entire page of positionwise-
-    colliding tokens against an unguessable salt."""
+    colliding tokens between two distinct adapters' namespaces."""
     if lora_id is None:
         return None
     salt = salts.get(lora_id)
     if salt is None:
-        import random
-
-        salt = salts[lora_id] = random.getrandbits(31)
+        salt = salts[lora_id] = derive_ns_salt(lora_id)
     return salt
 
 

@@ -177,6 +177,18 @@ class EngineConfig:
     # (``want_digests``). Forces the Python cache manager — the native
     # tree evicts inside C with no per-node observability.
     cache_digests: bool = False
+    # Multi-tenant QoS spec (parallax_tpu/qos, docs/qos.md): "on" or a
+    # key=value spec enables request classes, deadline-aware EDF
+    # admission/scheduling and shed/park enforcement on this stage's
+    # local scheduler. None/"off" (the default) wires NO policy — the
+    # scheduler keeps the pre-QoS arrival-order paths with zero
+    # per-step cost and bit-identical streams.
+    qos: str | None = None
+    # LoRA adapter hot-load LRU cap (ops/lora.py AdapterSet): > 0 bounds
+    # how many adapters stay stacked on device — registering past the
+    # cap evicts the least-recently-batched adapter (never one with
+    # in-flight requests). 0 = unbounded (the pre-LRU behavior).
+    lora_max_adapters: int = 0
 
 
 @dataclasses.dataclass
@@ -486,6 +498,15 @@ class StageEngine:
             host_tier=self.host_tier,
             track_digests=self.cfg.cache_digests,
         )
+        qos_policy = None
+        if self.cfg.qos:
+            from parallax_tpu.qos import QoSPolicy, parse_qos_spec
+
+            qos_config = parse_qos_spec(self.cfg.qos)
+            if qos_config is not None:
+                qos_policy = QoSPolicy(
+                    qos_config, stage_name=self._obs_stage,
+                )
         self.scheduler = Scheduler(
             self.cache,
             max_batch_size=self.cfg.max_batch_size,
@@ -499,6 +520,7 @@ class StageEngine:
                 else None
             ),
             stage_name=self._obs_stage,
+            qos=qos_policy,
         )
         self.spec = BucketSpec.build(
             self.cfg.max_num_tokens_per_batch,
@@ -744,7 +766,9 @@ class StageEngine:
         )
 
         if self._adapters is None:
-            self._adapters = AdapterSet()
+            self._adapters = AdapterSet(
+                max_adapters=self.cfg.lora_max_adapters
+            )
         tree = source
         if isinstance(source, str):
             tree = adapter_tree_from_peft(
@@ -754,7 +778,32 @@ class StageEngine:
         # refuse adapters whose dims cannot split rather than failing at
         # trace time mid-request.
         validate_tp_shardable(tree, self.model.tp_size)
-        self._adapters.register(name, tree)
+        # The LRU must never evict an adapter with in-flight requests:
+        # their next batch would have no weights to select. Hot-loads
+        # arrive on a control thread while the step thread mutates the
+        # scheduler dicts, so the snapshot retries on a concurrent
+        # resize and degrades to "everything is active" (no eviction
+        # this round — strictly safe) if it keeps racing. A request
+        # submitted in the window AFTER the snapshot can still lose its
+        # adapter; that narrow race degrades to a clean per-request
+        # abort at batch formation, never a wrong-weights batch.
+        active = None
+        for _ in range(8):
+            try:
+                active = {
+                    r.lora_id
+                    for r in (
+                        list(self.scheduler.running.values())
+                        + list(self.scheduler.wait_queue.values())
+                    )
+                    if r.lora_id is not None
+                }
+                break
+            except RuntimeError:   # dict resized mid-snapshot
+                continue
+        if active is None:
+            active = set(self._adapters.names)
+        self._adapters.register(name, tree, active=active)
 
     def has_adapter(self, name: str) -> bool:
         return self._adapters is not None and name in self._adapters
@@ -865,6 +914,9 @@ class StageEngine:
                 sampling_params=SamplingParams.from_dict(ireq.sampling_params or {}),
                 routing_table=list(ireq.routing_table),
                 lora_id=ireq.lora_id,
+                # QoS class rides the wire so this stage's EDF ordering
+                # (when enabled here) matches the head's (docs/qos.md).
+                qos_class=ireq.qos_class,
             )
             req.is_mirror = True  # type: ignore[attr-defined]
             # This stage MUST start computing at exactly this offset — rows
@@ -1382,6 +1434,10 @@ class StageEngine:
                     (now - req.first_token_time) * 1e3 / (n - 1)
                 )
         self._h_e2e.observe(e2e_ms)
+        if self.scheduler.qos is not None:
+            # Per-class TTFT histogram + the admission controller's
+            # burn-rate input (docs/qos.md).
+            self.scheduler.qos.observe_finish(req, ttft_ms)
         breakdown = store.breakdown(rid) if store is not None else None
         if breakdown is None and ttft_ms is not None:
             breakdown = {
@@ -3111,6 +3167,7 @@ class StageEngine:
                     cached_prefix_ids=prefix_ids,
                     lora_id=req.lora_id,
                     trace=req.traced,
+                    qos_class=getattr(req, "qos_class", None),
                 )
             )
             row += n

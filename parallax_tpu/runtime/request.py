@@ -151,6 +151,16 @@ class Request:
     # engine: the local scheduler stops scheduling (and never preempts)
     # a row that is about to be checkpointed away.
     migrating: bool = False
+    # Multi-tenant QoS (parallax_tpu/qos, docs/qos.md): the request's
+    # class tag (interactive / agent / batch), its absolute deadline on
+    # THIS process's monotonic clock (None = derive from the class
+    # budget at order time; re-anchored from a relative budget on every
+    # process hop), and the tenant the per-tenant routing fairness term
+    # charges. All None when QoS is off — the scheduler then never
+    # reads them.
+    qos_class: str | None = None
+    deadline: float | None = None
+    tenant_id: str | None = None
     # Replay restore (no KV image adopted): the pre-migration outputs a
     # restored request must TEACHER-FORCE back through ordinary decode
     # steps before free-running sampling resumes. Each commit_token pops
@@ -303,6 +313,10 @@ class IntermediateRequest:
     # tracing — receiving stages record their spans under the request id
     # so multi-stage traces stitch.
     trace: bool = False
+    # QoS class tag (docs/qos.md): downstream stages order their mirror
+    # work by the same class budgets the head uses. None = untagged
+    # (QoS off, or an older peer's frame).
+    qos_class: str | None = None
 
     @property
     def is_prefill(self) -> bool:

@@ -79,6 +79,7 @@ class Scheduler:
         is_first_stage: bool = True,
         snapshot_page_align: int | None = None,
         stage_name: str = "stage",
+        qos: "QoSPolicy | None" = None,
     ):
         # Observability: the stage label this scheduler's flight-recorder
         # events and trace spans carry (preempt / swap-in / kv_oom).
@@ -105,6 +106,13 @@ class Scheduler:
         self._lora_cursor = 0
         # Rotation cursor for budget-capped mixed decode batches.
         self._decode_cursor = 0
+        # Multi-tenant QoS policy (parallax_tpu/qos, docs/qos.md):
+        # deadline-aware admission/ordering + shed/park enforcement.
+        # None (the default, --qos off) keeps every path below on the
+        # pre-QoS arrival-order behavior — each hook is one attribute
+        # check, so off-mode per-step cost is zero and streams are
+        # bit-identical.
+        self.qos = qos
 
     # -- intake -----------------------------------------------------------
 
@@ -124,63 +132,147 @@ class Scheduler:
 
         Reference: ``admit_requests`` (scheduler.py:251-312) — FCFS, stops at
         the first request that does not fit to preserve ordering fairness.
+        With a QoS policy attached the iteration order becomes
+        earliest-deadline-first (with the starvation guard) and the shed
+        gate can hold sheddable classes back; the per-request admission
+        mechanics (``_admit_one``) are shared so the two modes can never
+        drift.
         """
+        if self.qos is not None:
+            self._admit_requests_qos()
+            return
         while self.wait_queue and len(self.running) < self.max_batch_size:
             rid, req = next(iter(self.wait_queue.items()))
-            if req.migrating:
-                # About to be checkpointed away: admitting (or swapping
-                # it back in) would race the extraction. The park lands
-                # within a step or two; admission resumes then.
+            if not self._admit_one(rid, req):
                 break
-            if req.status.is_finished:
-                # Aborted while parked (timeout / client cancel): route it
-                # through the running set so the normal finish collection
-                # releases its state.
-                del self.wait_queue[rid]
-                self.admitted_total += 1
-                self.running[rid] = req
-                continue
-            if req.status is RequestStatus.PREEMPTED:
-                # Preempted-to-host: swap the KV image back in instead of
-                # re-allocating a prompt. FCFS discipline is unchanged —
-                # a resume that does not fit blocks admission like any
-                # other head-of-queue request.
-                resume = getattr(self.cache, "resume_from_host", None)
-                t0 = time.perf_counter()
-                if resume is None or not resume(req):
-                    break
-                del self.wait_queue[rid]
-                self.admitted_total += 1
-                req.status = RequestStatus.DECODING
-                self.running[rid] = req
-                self._obs_event("swap_in", req, dur=time.perf_counter() - t0)
-                continue
-            if not self.cache.allocate_for_prompt(req):
-                break
+
+    def _admit_one(self, rid: str, req: Request) -> bool:
+        """Try to admit one wait-queue request. Returns False when
+        admission must STOP (the request blocks: migrating, or capacity
+        ran out) — later queue entries must not leapfrog it, whatever
+        ordering discipline chose it."""
+        if req.migrating:
+            # About to be checkpointed away: admitting (or swapping
+            # it back in) would race the extraction. The park lands
+            # within a step or two; admission resumes then.
+            return False
+        if req.status.is_finished:
+            # Aborted while parked (timeout / client cancel): route it
+            # through the running set so the normal finish collection
+            # releases its state.
             del self.wait_queue[rid]
             self.admitted_total += 1
-            head_cached = getattr(req, "mirror_head_cached", None)
-            if head_cached is not None:
-                # Mirror of a head-side prefix hit: the head only forwards
-                # hidden rows from ``head_cached`` on. A SHORTER local
-                # match means this stage would need rows that never arrive
-                # — abort loudly rather than stall or serve garbage
-                # (asymmetric eviction between stages; rare). A LONGER
-                # local match is clamped down: the overlap rows recompute
-                # into the shared pages deterministically (same inputs,
-                # same values).
-                if req.num_computed_tokens < head_cached:
-                    logger.warning(
-                        "%s: downstream prefix-cache miss (head skipped "
-                        "%d, local match %d) — aborting", rid,
-                        head_cached, req.num_computed_tokens,
-                    )
-                    req.abort("downstream_prefix_cache_miss")
-                    self.running[rid] = req   # collected + released next step
-                    continue
-                req.num_computed_tokens = head_cached
-            req.status = RequestStatus.PREFILLING
             self.running[rid] = req
+            return True
+        if req.status is RequestStatus.PREEMPTED:
+            # Preempted-to-host: swap the KV image back in instead of
+            # re-allocating a prompt. FCFS discipline is unchanged —
+            # a resume that does not fit blocks admission like any
+            # other head-of-queue request.
+            resume = getattr(self.cache, "resume_from_host", None)
+            t0 = time.perf_counter()
+            if resume is None or not resume(req):
+                return False
+            del self.wait_queue[rid]
+            self.admitted_total += 1
+            req.status = RequestStatus.DECODING
+            self.running[rid] = req
+            self._obs_event("swap_in", req, dur=time.perf_counter() - t0)
+            return True
+        if not self.cache.allocate_for_prompt(req):
+            return False
+        del self.wait_queue[rid]
+        self.admitted_total += 1
+        head_cached = getattr(req, "mirror_head_cached", None)
+        if head_cached is not None:
+            # Mirror of a head-side prefix hit: the head only forwards
+            # hidden rows from ``head_cached`` on. A SHORTER local
+            # match means this stage would need rows that never arrive
+            # — abort loudly rather than stall or serve garbage
+            # (asymmetric eviction between stages; rare). A LONGER
+            # local match is clamped down: the overlap rows recompute
+            # into the shared pages deterministically (same inputs,
+            # same values).
+            if req.num_computed_tokens < head_cached:
+                logger.warning(
+                    "%s: downstream prefix-cache miss (head skipped "
+                    "%d, local match %d) — aborting", rid,
+                    head_cached, req.num_computed_tokens,
+                )
+                req.abort("downstream_prefix_cache_miss")
+                self.running[rid] = req   # collected + released next step
+                return True
+            req.num_computed_tokens = head_cached
+        req.status = RequestStatus.PREFILLING
+        self.running[rid] = req
+        return True
+
+    def _admit_requests_qos(self) -> None:
+        """QoS admission: EDF order with the starvation guard, the shed
+        gate holding sheddable classes while the admission controller
+        sheds, and park enforcement over the running set. Mechanics per
+        request are ``_admit_one`` — identical to FCFS mode."""
+        pol = self.qos
+        now = time.monotonic()
+        pol.maybe_tick(now, self)
+        self._qos_enforce(pol)
+        if pol.controller.active:
+            # Shed-held accounting covers EVERY gated request, not just
+            # the ones the capacity-bounded loop below happens to
+            # visit (count_shed is once-per-request).
+            for req in self.wait_queue.values():
+                if not req.status.is_finished and pol.blocks_admission(req):
+                    pol.count_shed(req)
+        for rid, req in pol.admit_order(self.wait_queue, now):
+            if len(self.running) >= self.max_batch_size:
+                break
+            if self.wait_queue.get(rid) is not req:
+                continue   # admitted/parked by an earlier iteration
+            if not req.status.is_finished and pol.blocks_admission(req):
+                # Held, not dropped: the request stays queued (already
+                # counted by the full-queue sweep above) and resumes
+                # through this same gate when the shed lifts.
+                continue
+            was_finished = req.status.is_finished
+            if not self._admit_one(rid, req):
+                break
+            if (
+                not was_finished
+                and not req.status.is_finished
+                and rid in self.running
+            ):
+                pol.on_admit(req, now)
+
+    def _qos_enforce(self, pol) -> None:
+        """Shed enforcement over the RUNNING set: park sheddable-class
+        decodes to the host tier (the PR 2 PREEMPTED path — they resume
+        bit-identically when the shed releases; enforcement never
+        aborts). Uses the same safety tests as memory-pressure
+        preemption: only committed/device-fed decode rows park, never
+        mirrors, in-flight rows, state-slot holders or migrating
+        requests."""
+        if not pol.controller.active:
+            return
+        preempt = getattr(self.cache, "preempt_to_host", None)
+        if preempt is None or getattr(self.cache, "host_tier", None) is None:
+            # No tier (or a manager without the preempt path, e.g. the
+            # native backend): enforcement can only hold admissions.
+            pol.warn_no_tier_once()
+            return
+        for req in list(self.running.values()):
+            if (
+                not pol.parkable(req)
+                or req.migrating
+                or req.status is not RequestStatus.DECODING
+                or not (req.ready_for_step or req.device_feed_ready)
+                or getattr(req, "is_mirror", False)
+                or getattr(req, "state_slot", None) is not None
+            ):
+                continue
+            if not preempt(req):
+                continue   # host tier full: the request keeps running
+            self._park(req)
+            pol.count_park(req)
 
     def take_sp_prefill(self, threshold: int) -> BatchPlan | None:
         """Pick one whole long prompt for a sequence-parallel prefill step.
@@ -282,8 +374,18 @@ class Scheduler:
 
         # Prefill chunks first (including re-chunked long prompts).
         # Snapshot: preemption-to-host can move a running request to the
-        # wait queue mid-iteration.
-        for req in list(self.running.values()):
+        # wait queue mid-iteration. With QoS on, earliest deadline
+        # first (guard=False: the starvation guard is a WAIT-QUEUE
+        # notion — see QoSPolicy.order_key): under a token-budget
+        # squeeze the urgent prompt's chunk ships this step, not the
+        # flood's.
+        running = list(self.running.values())
+        if self.qos is not None:
+            now = time.monotonic()
+            running.sort(
+                key=lambda r: self.qos.order_key(r, now, guard=False)
+            )
+        for req in running:
             if len(seqs) >= self.max_batch_size or token_budget <= 0:
                 break
             if req.status is not RequestStatus.PREFILLING or req.migrating:
@@ -351,7 +453,19 @@ class Scheduler:
             and (req.ready_for_step or req.device_feed_ready)
             and (any_adapter or req.lora_id == batch_lora)
         ]
-        if any_adapter and candidates:
+        if self.qos is not None and candidates:
+            # EDF decode-batch formation: when the batch/token budget
+            # caps the step, the rows with the least deadline slack
+            # decode first. guard=False — running rows are being
+            # served, so the wait-queue starvation guard must not put
+            # every old batch row ahead of fresh interactive deadlines;
+            # batch rows overtake naturally as their own slack decays.
+            # Replaces the rotation fairness below.
+            now = time.monotonic()
+            candidates.sort(
+                key=lambda r: self.qos.order_key(r, now, guard=False)
+            )
+        elif any_adapter and candidates:
             # The mixed path returns before form_batch's group rotation,
             # so fairness must live here: when the budget caps the batch,
             # a fixed iteration order would serve the same head-of-line

@@ -26,10 +26,21 @@ class RequestMeta:
 
     request_id: str
     prompt_ids: list[int] | None = None
-    # LoRA requests never produce digest matches: workers XOR-salt the
-    # radix namespace with a per-process random salt per adapter, so the
-    # head-side chain cannot be reproduced here — skip the prediction.
+    # LoRA requests hash into the adapter's own digest namespace:
+    # workers XOR-salt the radix tree's tokens with the DETERMINISTIC
+    # per-adapter salt (cache_manager.derive_ns_salt — same adapter id,
+    # same salt, on every replica), so the head-side chain reproduces
+    # here and adapter-heavy tenants route to the replica already
+    # holding their warm prefixes.
     lora_id: str | None = None
+    # Tenant for the router's per-tenant fairness term (docs/qos.md);
+    # defaults to the adapter at the HTTP layer. None = no fairness
+    # charge (QoS off / untagged).
+    tenant_id: str | None = None
+    # QoS class tag (docs/qos.md), carried on the PendingRequest's meta
+    # so dispatch-time telemetry and future class-aware routing see it.
+    # None = untagged (QoS off).
+    qos_class: str | None = None
     # Filled by the router at dispatch; compared against the actual hit
     # the head engine reports on request_complete.
     predicted_cached_tokens: int = 0
@@ -40,15 +51,22 @@ class RequestMeta:
         return len(self.prompt_ids or ())
 
     def chain(self, block_size: int) -> list[int]:
-        """Rolling block-hash chain of the prompt at ``block_size``."""
-        if self.prompt_ids is None or self.lora_id is not None:
+        """Rolling block-hash chain of the prompt at ``block_size``,
+        namespaced into the adapter's digest namespace for LoRA
+        requests (matching what the worker's radix tree publishes)."""
+        if self.prompt_ids is None:
             return []
         got = self._chains.get(block_size)
         if got is None:
+            from parallax_tpu.runtime.cache_manager import derive_ns_salt
             from parallax_tpu.runtime.radix_cache import block_hash_chain
 
+            tokens = self.prompt_ids
+            if self.lora_id is not None:
+                salt = derive_ns_salt(self.lora_id)
+                tokens = [t ^ salt for t in tokens]
             got = self._chains[block_size] = block_hash_chain(
-                self.prompt_ids, block_size
+                tokens, block_size
             )
         return got
 
@@ -209,7 +227,8 @@ class CacheAwareRouting(RoutingStrategy):
     wants_digests = True
 
     def __init__(self, manager: NodeManager, alpha: float = 1.0,
-                 beta: float = 256.0, imbalance_threshold: int = 8):
+                 beta: float = 256.0, imbalance_threshold: int = 8,
+                 gamma: float = 0.0, fairness_halflife_s: float = 30.0):
         super().__init__(manager)
         # alpha is per uncached prompt token, beta per in-flight request:
         # the defaults price one queued request like 256 uncached tokens
@@ -218,6 +237,17 @@ class CacheAwareRouting(RoutingStrategy):
         self.alpha = alpha
         self.beta = beta
         self.imbalance_threshold = imbalance_threshold
+        # Per-tenant fairness (docs/qos.md): gamma prices one unit of a
+        # tenant's own recent-dispatch share on a pipeline like gamma
+        # uncached tokens, so a chatty tenant's requests spread across
+        # replicas instead of monopolizing the one holding its warm
+        # prefixes while other tenants' hits sit cold behind its queue.
+        # 0.0 (the default) disables the term — scoring is bit-identical
+        # to the pre-fairness router.
+        self.gamma = gamma
+        self.fairness_halflife_s = fairness_halflife_s
+        # (pipeline_id, tenant) -> [decayed dispatch share, last stamp].
+        self._tenant_share: dict[tuple[int, str], list] = {}
         self._cursor = 0   # tie-break rotation so equal scores spread
 
     def find_path(self, meta: RequestMeta | None = None) -> list[Node] | None:
@@ -246,8 +276,13 @@ class CacheAwareRouting(RoutingStrategy):
                         meta.num_prompt_tokens,
                     )
             uncached = (meta.num_prompt_tokens if meta else 0) - hit
+            cost = self.alpha * uncached + self.beta * head.load
+            if self.gamma > 0.0 and meta is not None and meta.tenant_id:
+                cost += self.gamma * self._tenant_recent(
+                    p.pipeline_id, meta.tenant_id
+                )
             score = (
-                self.alpha * uncached + self.beta * head.load,
+                cost,
                 # Rotating tie-break: equal scores (cold cluster, no
                 # meta) must spread like round-robin, not pile onto the
                 # first pipeline.
@@ -260,10 +295,42 @@ class CacheAwareRouting(RoutingStrategy):
         )
         return self._dispatch(best, best_hit, meta)
 
+    def _tenant_recent(self, pipeline_id: int, tenant: str,
+                       charge: float = 0.0) -> float:
+        """Exponentially-decayed recent-dispatch share of ``tenant`` on
+        ``pipeline_id`` (half-life ``fairness_halflife_s``); ``charge``
+        adds to it (dispatch time). O(1) per query — decay is applied
+        lazily on access."""
+        import math
+        import time as _time
+
+        now = _time.monotonic()
+        ent = self._tenant_share.get((pipeline_id, tenant))
+        if ent is None:
+            ent = self._tenant_share[(pipeline_id, tenant)] = [0.0, now]
+        value, stamp = ent
+        value *= math.exp(
+            -(now - stamp) * math.log(2.0)
+            / max(1e-6, self.fairness_halflife_s)
+        )
+        value += charge
+        ent[0], ent[1] = value, now
+        if len(self._tenant_share) > 65536:
+            # Bounded: drop the stalest entries (decayed to noise).
+            for key, e in sorted(
+                self._tenant_share.items(), key=lambda kv: kv[1][1]
+            )[: len(self._tenant_share) // 2]:
+                del self._tenant_share[key]
+        return value
+
     def _dispatch(self, pipeline: Pipeline, predicted_hit: int,
                   meta: RequestMeta | None) -> list[Node]:
         if meta is not None:
             meta.predicted_cached_tokens = predicted_hit
+            if self.gamma > 0.0 and meta.tenant_id:
+                self._tenant_recent(
+                    pipeline.pipeline_id, meta.tenant_id, charge=1.0
+                )
         self._count_pipeline(pipeline.pipeline_id)
         return pipeline.nodes
 

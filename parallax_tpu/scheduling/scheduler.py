@@ -79,6 +79,7 @@ class GlobalScheduler:
         heartbeat_timeout_s: float = 30.0,
         routing_kwargs: dict | None = None,
         slo: "SLOConfig | None" = None,
+        qos: "QoSConfig | None" = None,
     ):
         self.model = model
         self.min_nodes = min_nodes_bootstrapping
@@ -148,6 +149,26 @@ class GlobalScheduler:
             from parallax_tpu.obs.slo import SLOTracker
 
             self.slo_tracker = SLOTracker(slo)
+        # Multi-tenant QoS control plane (parallax_tpu/qos, docs/qos.md):
+        # the cluster-scope admission controller watches the merged
+        # per-class TTFT histograms workers ship in heartbeats and
+        # relays its shed verdict back through heartbeat replies
+        # (``qos_shed``); the pool autoscaler re-roles pipelines between
+        # the prefill/decode pools from queue depth + goodput-per-chip.
+        # Both tick on the event thread. None = QoS off (no work, no
+        # reply fields).
+        self.qos_config = qos
+        self.qos_controller = None
+        self.autoscaler = None
+        self._qos_last_sample = 0.0
+        if qos is not None:
+            from parallax_tpu.qos import AdmissionController, PoolAutoscaler
+
+            self.qos_controller = AdmissionController(qos, scope="cluster")
+            if qos.autoscale:
+                self.autoscaler = PoolAutoscaler(
+                    self.manager, qos, timeline=self.timeline,
+                )
 
     # -- public API (thread-safe enqueues) --------------------------------
 
@@ -225,6 +246,16 @@ class GlobalScheduler:
             # tracking on (the flag rides the allocation into the reload)
             # and publish delta payloads on subsequent heartbeats.
             alloc["want_digests"] = True
+        # Phase role: normally the worker's own join-time choice echoed
+        # back, but the QoS autoscaler may have re-roled this node's
+        # pipeline — the worker adopts the new role in place (same
+        # layers, no reload; docs/qos.md).
+        alloc["role"] = node.role
+        if self.qos_controller is not None:
+            # Cluster shed verdict: workers OR it with their local
+            # controller so a cluster-wide interactive burn protects
+            # every head at once.
+            alloc["qos_shed"] = self.qos_controller.shedding
         return alloc
 
     def drain_requested(self, node_id: str) -> list[str]:
@@ -297,7 +328,11 @@ class GlobalScheduler:
                 hit = 0
                 idx = head.cache_index
                 chain = chains.get(idx.block) or chains.get(str(idx.block))
-                if idx.block > 0 and chain and not lora:
+                # Adapter requests score too: their chains arrive
+                # pre-namespaced with the deterministic per-adapter
+                # salt, matching the digests the target's radix tree
+                # publishes (cache_manager.derive_ns_salt).
+                if idx.block > 0 and chain:
                     try:
                         hit = idx.predict_cached_tokens(
                             [int(c) for c in chain], idx.block,
@@ -392,6 +427,7 @@ class GlobalScheduler:
             now = time.monotonic()
             if now - last_sweep > 1.0:
                 self._sweep_heartbeats()
+                self._qos_tick(now)
                 last_sweep = now
 
     def _handle_event(self, ev: tuple) -> None:
@@ -589,6 +625,48 @@ class GlobalScheduler:
                     layer, node.end_layer,
                 )
                 node.set_layers(layer, node.end_layer)
+
+    def _qos_tick(self, now: float) -> None:
+        """QoS control-plane pass (event thread, ~1 Hz): feed the
+        cluster admission controller the merged per-class TTFT counts
+        from heartbeat histogram snapshots, run its hysteresis, and
+        tick the pool autoscaler. The shed verdict reaches workers via
+        their next heartbeat reply (``qos_shed``)."""
+        ctl = self.qos_controller
+        if ctl is None:
+            return
+        under, total = self._qos_cluster_counts()
+        if total:
+            ctl.observe_cumulative(under, total, now)
+        if ctl.tick(now):
+            self.timeline.record(
+                "qos_shed" if ctl.shedding else "qos_release",
+                burn=round(ctl.last_burn, 3),
+            )
+        if self.autoscaler is not None:
+            self.autoscaler.tick(now)
+
+    def _qos_cluster_counts(self) -> tuple[float, int]:
+        """Cluster-cumulative (under-budget, total) counts of the
+        protected class's TTFT, summed over every pipeline member's
+        heartbeat-shipped ``parallax_qos_ttft_ms`` children."""
+        from parallax_tpu.obs.slo import fraction_below
+
+        ctl = self.qos_controller
+        budget = ctl.protected.deadline_ms
+        under, total = 0.0, 0
+        for p in self.manager.pipelines:
+            for n in p.nodes:
+                children = (n.metrics or {}).get("parallax_qos_ttft_ms")
+                if not isinstance(children, dict):
+                    continue
+                for label, snap in children.items():
+                    if ctl.protected.name not in str(label):
+                        continue
+                    u, t = fraction_below(snap, budget)
+                    under += u
+                    total += t
+        return under, total
 
     def _handle_leave(self, node_id: str) -> None:
         # Drain, don't abort: every pipeline through the dying node has
@@ -837,22 +915,12 @@ class GlobalScheduler:
         # is the heads' heartbeat-reported engine depth (running + the
         # worker-side wait queue), so it IS the pool's queue depth;
         # ``queued_unrouted`` counts requests still waiting for a path.
-        pools: dict[str, dict] = {}
-        for p in self.manager.pipelines:
-            d = pools.setdefault(
-                p.role,
-                {"pipelines": 0, "in_flight": 0, "capacity": 0},
-            )
-            d["pipelines"] += 1
-            d["in_flight"] += p.nodes[0].load
-            d["capacity"] += min(
-                n.max_concurrent_requests() for n in p.nodes
-            )
-        for d in pools.values():
-            d["utilization"] = (
-                round(d["in_flight"] / d["capacity"], 4)
-                if d["capacity"] else 0.0
-            )
+        from parallax_tpu.qos.autoscaler import pool_report
+
+        # Shared with the QoS autoscaler (qos/autoscaler.py) so the
+        # numbers operators read here are exactly what the re-roling
+        # loop acts on (adds goodput_per_chip per pool).
+        pools = pool_report(self.manager.pipelines)
         report["routing"] = {
             "strategy": self.routing_name,
             "decisions": dict(self.router.decision_counters),
@@ -875,6 +943,25 @@ class GlobalScheduler:
         # Node-churn robustness: drain directives issued, migration
         # targets chosen, restores reported back by target heads.
         report["migrations"] = dict(self.migration_stats)
+        # Multi-tenant QoS control plane (docs/qos.md): cluster shed
+        # state + burn, class table, and the autoscaler's re-role
+        # ledger. Absent entirely when QoS is off.
+        if self.qos_controller is not None:
+            report["qos"] = {
+                "enabled": True,
+                "classes": [
+                    {"name": c.name, "priority": c.priority,
+                     "deadline_ms": c.deadline_ms,
+                     "sheddable": c.sheddable}
+                    for c in self.qos_config.classes
+                ],
+                "admission": self.qos_controller.payload(),
+                "autoscaler": (
+                    self.autoscaler.payload()
+                    if self.autoscaler is not None
+                    else {"enabled": False}
+                ),
+            }
         report["pipelines"] = [
             {
                 "id": p.pipeline_id,

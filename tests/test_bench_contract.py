@@ -63,6 +63,28 @@ def test_bench_cpu_smoke_prints_one_json_line():
         assert kp["impls"][name]["per_token_device_ms"] > 0, kp
     assert kp["tokens_fused_vs_xla_identical"], kp
     assert kp["greedy_rows_identical_all_impls"], kp
+    # Multi-tenant QoS probe (detail.qos, docs/qos.md): structural keys
+    # plus the deterministic acceptance contract — QoS on sheds AND
+    # parks the batch flood (enforcement, never abort: everything
+    # completes), holds interactive p99 TTFT within the 2x-of-unloaded
+    # budget, and streams are bit-identical to the QoS-off run. The
+    # off-vs-on TTFT improvement (wall-clock) is asserted in the CI qos
+    # smoke step, not here.
+    q = rec["detail"]["qos"]
+    for run in ("unloaded", "off", "on"):
+        for key in ("requests", "completed", "aborted", "interactive",
+                    "batch"):
+            assert key in q[run], (run, q[run])
+        assert q[run]["aborted"] == 0, q
+        assert q[run]["completed"] == q[run]["requests"], q
+    assert q["bit_identical"] is True, q
+    assert q["interactive_p99_within_2x"] is True, q
+    assert q["on"]["sheds"] > 0, q
+    assert q["on"]["parks"] > 0, q
+    assert q["on"]["shed_transitions"]["sheds"] >= 1, q
+    assert q["on"]["shed_transitions"]["releases"] >= 1, q
+    assert q["on"]["batch"]["tokens"] > 0, q           # never starved
+    assert q["on"]["batch"]["tokens"] == q["off"]["batch"]["tokens"], q
 
 
 def test_bench_dsa_mode_cpu_smoke():

@@ -228,17 +228,40 @@ class TestCacheAwareRouting:
         mgr.get("r1").load = 2
         assert router.find_path(self.meta(toks))[0].node_id == "r0"
 
-    def test_lora_requests_skip_digest_matching(self):
-        # Workers namespace LoRA radix tokens with per-process salts the
-        # scheduler cannot reproduce; prediction must not fire.
+    def test_lora_requests_match_their_own_namespace(self):
+        # Adapter digest namespaces are DETERMINISTIC per adapter id
+        # (cache_manager.derive_ns_salt), so the scheduler reproduces a
+        # worker's salted chain and adapter tenants route to their warm
+        # replica — but never off the base namespace or another
+        # adapter's.
+        from parallax_tpu.runtime.cache_manager import derive_ns_salt
+
         mgr = replica_manager(2)
         router = CacheAwareRouting(mgr)
         toks = list(range(6 * PAGE))
+        salt = derive_ns_salt("tenant-a")
+        salted_chain = block_hash_chain([t ^ salt for t in toks], PAGE)
+
+        # Base-namespace digests must NOT match an adapter request.
         feed_index(mgr.get("r1"), block_hash_chain(toks, PAGE))
         meta = self.meta(toks, lora="tenant-a")
         router.find_path(meta)
         assert meta.predicted_cached_tokens == 0
-        assert router.decision_counters.get("chosen_by_cache", 0) == 0
+
+        # The adapter's own namespace matches (warm-replica routing) ...
+        feed_index(mgr.get("r1"), salted_chain, seq=2)
+        meta = self.meta(toks, lora="tenant-a")
+        assert router.find_path(meta)[0].node_id == "r1"
+        assert meta.predicted_cached_tokens > 0
+        assert router.decision_counters.get("chosen_by_cache", 0) == 1
+
+        # ... and stays invisible to other adapters and to base.
+        meta_b = self.meta(toks, lora="tenant-b")
+        router.find_path(meta_b)
+        assert meta_b.predicted_cached_tokens == 0
+        meta_base = self.meta(toks)
+        router.find_path(meta_base)
+        assert meta_base.predicted_cached_tokens == 0
 
     def test_skips_not_ready_and_full_pipelines(self):
         mgr = replica_manager(2)

@@ -100,6 +100,66 @@ def test_chat_completion_non_stream(frontend):
     with_client(frontend.app, fn)
 
 
+def test_qos_headers_tag_requests_and_reject_unknown_class():
+    """QoS-enabled frontend (docs/qos.md): class/deadline/tenant parse
+    from headers into the submitted Request (tenant defaults to the
+    adapter), unknown classes 400, and a QoS-off frontend leaves every
+    request untagged (off-inertness at the HTTP layer)."""
+    from parallax_tpu.qos import parse_qos_spec
+
+    seen = []
+
+    for qos_cfg in (parse_qos_spec("on"), None):
+        fe, runner = build_local_frontend(
+            build_engines([(0, 2)]), SimpleTokenizer(), model_name="tiny",
+            qos_config=qos_cfg,
+        )
+        real_submit = fe.submit_fn
+
+        def submit(req, _real=real_submit):
+            seen.append(req)
+            return _real(req)
+
+        fe.submit_fn = submit
+
+        async def fn(client):
+            t0 = time.monotonic()
+            resp = await client.request(
+                "POST", "/v1/completions",
+                json={"prompt": "hello", "max_tokens": 2,
+                      "temperature": 0},
+                headers={"x-parallax-qos-class": "batch",
+                         "x-parallax-deadline-ms": "1500",
+                         "x-parallax-tenant": "acme"},
+            )
+            assert resp.status == 200, await resp.text()
+            if fe.qos_config is not None:
+                resp = await client.request(
+                    "POST", "/v1/completions",
+                    json={"prompt": "hello", "max_tokens": 2},
+                    headers={"x-parallax-qos-class": "platinum"},
+                )
+                assert resp.status == 400
+                body = await resp.json()
+                assert "QoS" in body["error"]["message"]
+            return t0
+
+        try:
+            t0 = with_client(fe.app, fn)
+        finally:
+            runner.stop()
+        req = seen[-1]
+        if qos_cfg is not None:
+            assert req.qos_class == "batch"
+            assert req.tenant_id == "acme"
+            assert req.deadline is not None
+            assert 0 < req.deadline - t0 < 2.0
+        else:
+            assert req.qos_class is None
+            assert req.deadline is None
+            assert req.tenant_id is None
+
+
 def test_completions_endpoint(frontend):
     async def fn(client):
         status, body = await _json(client, "POST", "/v1/completions",

@@ -1,0 +1,1082 @@
+"""Multi-tenant QoS control plane (docs/qos.md).
+
+Covers: spec parsing and HTTP extraction; the EDF order key with its
+starvation guard; the admission controller's shed/park/release
+hysteresis (burn + queue-pressure triggers, cumulative cluster input
+with regression re-anchor); scheduler integration (EDF admission, shed
+gate holding batch, park enforcement through the host tier, release
+resuming bit-identically); end-to-end off-vs-on stream bit-identity
+(greedy + seeded, sync + overlap, K=1/K>1); class propagation across
+stages and the wire; the LoRA adapter LRU; the per-tenant routing
+fairness term; and the pool autoscaler — decision logic plus a live
+loopback-swarm re-role (under the chaos harness) that drains its
+in-flight decodes through the handoff machinery with zero aborts.
+"""
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from parallax_tpu.config import normalize_config
+from parallax_tpu.qos import (
+    AdmissionController,
+    PoolAutoscaler,
+    QoSConfig,
+    QoSPolicy,
+    RequestClass,
+    parse_qos_spec,
+    qos_from_http,
+)
+from parallax_tpu.runtime.request import (
+    IntermediateRequest,
+    Request,
+    RequestStatus,
+    SamplingParams,
+)
+from parallax_tpu.utils.hw import HardwareInfo
+
+TINY = normalize_config(dict(
+    architectures=["Qwen2ForCausalLM"],
+    hidden_size=64, num_hidden_layers=4, num_attention_heads=4,
+    num_key_value_heads=2, intermediate_size=128, vocab_size=151,
+    max_position_embeddings=512,
+))
+
+V5E = HardwareInfo("v5e", 1, 197.0, 16.0, 819.0, 186.0)
+
+PAGE = 8
+
+
+def wait_for(cond, timeout=10.0, interval=0.01):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# -- spec + HTTP parsing -----------------------------------------------------
+
+
+class TestSpec:
+    def test_off_values(self):
+        for v in (None, "", "off", "0", "false", "none"):
+            assert parse_qos_spec(v) is None
+
+    def test_on_defaults(self):
+        cfg = parse_qos_spec("on")
+        assert [c.name for c in cfg.classes] == [
+            "interactive", "agent", "batch",
+        ]
+        assert cfg.class_named("batch").sheddable
+        assert not cfg.class_named("interactive").sheddable
+        assert not cfg.autoscale
+
+    def test_overrides(self):
+        cfg = parse_qos_spec(
+            "interactive_ms=500,batch_ms=60000,shed_burn=3,"
+            "release_burn=0.5,starvation_s=4,autoscale=1,"
+            "gold_ms=2000"
+        )
+        assert cfg.class_named("interactive").deadline_ms == 500
+        assert cfg.class_named("batch").deadline_ms == 60000
+        assert cfg.class_named("gold").deadline_ms == 2000
+        assert not cfg.class_named("gold").sheddable
+        assert cfg.shed_burn == 3 and cfg.release_burn == 0.5
+        assert cfg.autoscale
+
+    def test_malformed_specs_raise(self):
+        for bad in ("interactive=500", "nope_s=1", "shed_burn=x",
+                    "shed_burn=1,release_burn=2", "zzz_sheddable=1"):
+            with pytest.raises(ValueError):
+                parse_qos_spec(bad)
+
+    def test_class_of_degrades_unknown_to_default(self):
+        cfg = parse_qos_spec("on")
+        assert cfg.class_of("batch").name == "batch"
+        assert cfg.class_of(None).name == "interactive"
+        assert cfg.class_of("from-the-future").name == "interactive"
+
+    def test_qos_from_http(self):
+        cfg = parse_qos_spec("on")
+        cls, dl, tenant = qos_from_http({}, {}, cfg)
+        assert cls == "interactive" and dl == 1000.0 and tenant is None
+        cls, dl, tenant = qos_from_http(
+            {"x-parallax-qos-class": "batch",
+             "x-parallax-deadline-ms": "2500",
+             "x-parallax-tenant": "acme"},
+            {}, cfg,
+        )
+        assert (cls, dl, tenant) == ("batch", 2500.0, "acme")
+        cls, dl, tenant = qos_from_http(
+            {}, {"qos_class": "agent", "deadline_ms": 800,
+                 "tenant": "t2"}, cfg,
+        )
+        assert (cls, dl, tenant) == ("agent", 800.0, "t2")
+        with pytest.raises(ValueError):
+            qos_from_http({"x-parallax-qos-class": "gold"}, {}, cfg)
+        with pytest.raises(ValueError):
+            qos_from_http({}, {"deadline_ms": -1}, cfg)
+
+
+# -- EDF order key -----------------------------------------------------------
+
+
+def _req(rid, qos_class=None, deadline=None, arrival=None, **kw):
+    r = Request(rid, prompt_ids=[1, 2, 3], qos_class=qos_class,
+                deadline=deadline, **kw)
+    if arrival is not None:
+        r.arrival_time = arrival
+    return r
+
+
+class TestOrderKey:
+    def policy(self, **kw):
+        return QoSPolicy(parse_qos_spec("on"), stage_name="t-order")
+
+    def test_interactive_beats_batch_despite_later_arrival(self):
+        pol = self.policy()
+        now = 100.0
+        batch = _req("b", "batch", arrival=now - 1.0)
+        inter = _req("i", "interactive", arrival=now)
+        assert pol.order_key(inter, now) < pol.order_key(batch, now)
+
+    def test_explicit_deadline_overrides_class_budget(self):
+        pol = self.policy()
+        now = 100.0
+        urgent_batch = _req("b", "batch", deadline=now + 0.1, arrival=now)
+        inter = _req("i", "interactive", arrival=now)
+        assert pol.order_key(urgent_batch, now) < pol.order_key(inter, now)
+
+    def test_starvation_guard_promotes_old_batch(self):
+        pol = self.policy()
+        now = 100.0
+        starved = _req("b", "batch", arrival=now - 11.0)  # > starvation_s
+        inter = _req("i", "interactive", arrival=now)
+        assert pol.order_key(starved, now) < pol.order_key(inter, now)
+
+    def test_running_rows_skip_starvation_guard(self):
+        # The guard is a WAIT-QUEUE notion: batch-formation ordering
+        # (guard=False) must keep fresh interactive deadlines ahead of
+        # old RUNNING batch rows — age is not wait-time for a row
+        # being served.
+        pol = self.policy()
+        now = 100.0
+        old_batch = _req("b", "batch", arrival=now - 30.0)
+        inter = _req("i", "interactive", arrival=now)
+        assert pol.order_key(old_batch, now) < pol.order_key(inter, now)
+        assert (
+            pol.order_key(inter, now, guard=False)
+            < pol.order_key(old_batch, now, guard=False)
+        )
+
+    def test_untagged_orders_as_default_class(self):
+        pol = self.policy()
+        now = 100.0
+        untagged = _req("u", None, arrival=now)
+        inter = _req("i", "interactive", arrival=now)
+        assert (
+            pol.order_key(untagged, now)[1]
+            == pol.order_key(inter, now)[1]
+        )
+
+
+# -- admission controller ----------------------------------------------------
+
+
+class TestController:
+    def make(self, spec="on", t0=1000.0):
+        clock = {"t": t0}
+        cfg = parse_qos_spec(spec)
+        ctl = AdmissionController(
+            cfg, scope="t-ctl", clock=lambda: clock["t"],
+        )
+        return ctl, clock, cfg.class_named("interactive")
+
+    def test_burn_sheds_and_hysteresis_releases(self):
+        ctl, clock, inter = self.make(
+            "burn_window_s=10,min_shed_s=2,shed_burn=2,release_burn=1"
+        )
+        # 10 in-budget finishes: no shed.
+        for _ in range(10):
+            ctl.observe_ttft(inter, 100.0)
+        assert ctl.tick() is False and not ctl.shedding
+        # Flood of violations: burn spikes, shed flips once.
+        for _ in range(10):
+            ctl.observe_ttft(inter, 5000.0)
+        assert ctl.tick() is True and ctl.shedding
+        assert ctl.tick() is False and ctl.shedding   # no re-transition
+        # Recovery: violations age out of the window...
+        clock["t"] += 11.0
+        for _ in range(20):
+            ctl.observe_ttft(inter, 50.0)
+        # ...but min_shed_s already passed, so release fires now.
+        assert ctl.tick() is True and not ctl.shedding
+        assert ctl.transitions == {"sheds": 1, "releases": 1}
+
+    def test_min_shed_holds_release(self):
+        ctl, clock, inter = self.make(
+            "burn_window_s=1,min_shed_s=60,shed_burn=2,release_burn=1"
+        )
+        for _ in range(5):
+            ctl.observe_ttft(inter, 9000.0)
+        assert ctl.tick() is True
+        clock["t"] += 5.0          # violations aged out, burn 0...
+        assert ctl.burn_rate() == 0.0
+        assert ctl.tick() is False and ctl.shedding   # ...held by min_shed_s
+
+    def test_single_violation_cannot_trip_burn_shed(self):
+        # A first-compile TTFT (one huge violating sample) must not
+        # hold batch work for a whole burn window: burn-triggered sheds
+        # need min_burn_samples finishes. Queue pressure still works.
+        ctl, clock, inter = self.make("min_burn_samples=5")
+        ctl.observe_ttft(inter, 1e6)
+        assert ctl.burn_rate() > 2.0           # estimate IS high...
+        assert ctl.tick() is False and not ctl.shedding   # ...but gated
+        for _ in range(5):
+            ctl.observe_ttft(inter, 1e6)
+        assert ctl.tick() is True and ctl.shedding
+
+    def test_queue_pressure_sheds_without_finishes(self):
+        ctl, clock, _ = self.make()
+        ctl.set_queue_pressure(True)
+        assert ctl.tick() is True and ctl.shedding
+
+    def test_non_protected_classes_ignored(self):
+        ctl, clock, _ = self.make()
+        batch = RequestClass("batch", 2, 1.0, sheddable=True)
+        for _ in range(50):
+            ctl.observe_ttft(batch, 1e9)
+        assert ctl.burn_rate() == 0.0
+
+    def test_cumulative_input_and_regression_reanchor(self):
+        ctl, clock, _ = self.make("burn_window_s=10")
+        ctl.observe_cumulative(100.0, 100)
+        clock["t"] += 5.0
+        ctl.observe_cumulative(100.0, 200)   # 100 new, all violating
+        assert ctl.burn_rate() > 2.0
+        # A node restart shrinks the totals: re-anchor, not negative.
+        clock["t"] += 1.0
+        ctl.observe_cumulative(50.0, 60)
+        clock["t"] += 1.0
+        ctl.observe_cumulative(60.0, 70)     # 10 new, all within
+        assert ctl.burn_rate() == 0.0
+
+    def test_remote_verdict_ors_with_local(self):
+        ctl, clock, _ = self.make()
+        assert not ctl.active
+        ctl.set_remote(True)
+        assert ctl.active and not ctl.shedding
+        ctl.set_remote(False)
+        assert not ctl.active
+
+
+# -- scheduler integration ---------------------------------------------------
+
+
+def _cache(num_pages=64, host_bytes=0):
+    """CacheManager, optionally with a host tier over a fake numpy
+    'device' (the test_host_cache pattern — bookkeeping without an
+    accelerator)."""
+    from parallax_tpu.runtime.cache_manager import CacheManager
+    from parallax_tpu.runtime.host_cache import HostKVTier
+
+    tier = None
+    if host_bytes:
+        dev = np.zeros((num_pages, PAGE * 2), np.float32)
+        nbytes = dev[0].nbytes
+
+        def gather(ids):
+            return [dev[np.asarray(ids)].copy()]
+
+        def scatter(ids, layers):
+            dev[np.asarray(ids)] = layers[0]
+
+        tier = HostKVTier(host_bytes, nbytes, gather, scatter)
+    return CacheManager(
+        PAGE, num_pages, enable_prefix_cache=False, max_model_len=256,
+        host_tier=tier,
+    )
+
+
+class TestSchedulerQoS:
+    def spec(self, extra=""):
+        return parse_qos_spec(
+            "interactive_ms=200,tick_interval_s=0.0,starvation_s=60"
+            + ("," + extra if extra else "")
+        )
+
+    def enqueue(self, sched, rid, qos_class, n_prompt=8, arrival=None):
+        r = Request(
+            rid, prompt_ids=list(range(1, n_prompt + 1)),
+            sampling_params=SamplingParams(max_new_tokens=16,
+                                           ignore_eos=True),
+            qos_class=qos_class,
+        )
+        if arrival is not None:
+            r.arrival_time = arrival
+        assert sched.enqueue(r)
+        return r
+
+    def test_off_mode_admits_fcfs(self):
+        from parallax_tpu.runtime.scheduler import Scheduler
+
+        sched = Scheduler(_cache(), max_batch_size=2)
+        self.enqueue(sched, "b1", "batch")
+        self.enqueue(sched, "i1", "interactive")
+        self.enqueue(sched, "b2", "batch")
+        sched.admit_requests()
+        assert list(sched.running) == ["b1", "i1"]   # arrival order
+
+    def test_edf_admits_interactive_first(self):
+        from parallax_tpu.runtime.scheduler import Scheduler
+
+        pol = QoSPolicy(self.spec(), stage_name="t-edf")
+        sched = Scheduler(_cache(), max_batch_size=2, qos=pol)
+        now = time.monotonic()
+        self.enqueue(sched, "b1", "batch", arrival=now - 1.0)
+        self.enqueue(sched, "b2", "batch", arrival=now - 0.5)
+        self.enqueue(sched, "i1", "interactive", arrival=now)
+        sched.admit_requests()
+        assert "i1" in sched.running
+        assert len(sched.running) == 2 and "b2" not in sched.running
+        assert pol.counters["admitted"] == {"interactive": 1, "batch": 1}
+
+    def test_shed_holds_batch_and_releases(self):
+        from parallax_tpu.runtime.scheduler import Scheduler
+
+        pol = QoSPolicy(self.spec(), stage_name="t-shed")
+        sched = Scheduler(_cache(), max_batch_size=4, qos=pol)
+        pol.controller.shedding = True
+        self.enqueue(sched, "b1", "batch")
+        self.enqueue(sched, "i1", "interactive")
+        sched.admit_requests()
+        assert "i1" in sched.running and "b1" not in sched.running
+        assert pol.counters["shed_held"] == {"batch": 1}
+        pol.controller.shedding = False
+        pol.controller.remote_shed = False
+        sched.admit_requests()
+        assert "b1" in sched.running
+
+    def test_remote_shed_verdict_blocks_batch(self):
+        from parallax_tpu.runtime.scheduler import Scheduler
+
+        pol = QoSPolicy(self.spec(), stage_name="t-remote")
+        sched = Scheduler(_cache(), max_batch_size=4, qos=pol)
+        pol.set_remote_shed(True)
+        self.enqueue(sched, "b1", "batch")
+        sched.admit_requests()
+        assert "b1" not in sched.running
+
+    def test_enforce_parks_running_batch_decodes(self):
+        from parallax_tpu.runtime.scheduler import Scheduler
+
+        pol = QoSPolicy(self.spec(), stage_name="t-park")
+        sched = Scheduler(
+            _cache(num_pages=64, host_bytes=1 << 22), max_batch_size=4,
+            qos=pol,
+        )
+        b = self.enqueue(sched, "b1", "batch")
+        i = self.enqueue(sched, "i1", "interactive")
+        sched.admit_requests()
+        # Drive both to DECODING.
+        for r in (b, i):
+            r.num_computed_tokens = r.num_prompt_tokens
+            r.status = RequestStatus.DECODING
+            r.output_ids.append(5)
+            r.ready_for_step = True
+        pol.controller.shedding = True
+        sched.admit_requests()   # runs _qos_enforce
+        assert b.status is RequestStatus.PREEMPTED
+        assert "b1" in sched.wait_queue          # parked, not aborted
+        assert i.status is RequestStatus.DECODING  # protected class stays
+        assert pol.counters["parked"] == {"batch": 1}
+        # Release: the park resumes through the normal swap-in path.
+        pol.controller.shedding = False
+        sched.admit_requests()
+        assert b.status is RequestStatus.DECODING
+        assert "b1" in sched.running
+
+    def test_enforce_without_tier_warns_once_and_holds_admissions_only(
+        self,
+    ):
+        from parallax_tpu.runtime.scheduler import Scheduler
+
+        pol = QoSPolicy(self.spec(), stage_name="t-notier")
+        sched = Scheduler(_cache(host_bytes=0), max_batch_size=4, qos=pol)
+        b = self.enqueue(sched, "b1", "batch")
+        sched.admit_requests()
+        b.num_computed_tokens = b.num_prompt_tokens
+        b.status = RequestStatus.DECODING
+        b.ready_for_step = True
+        pol.controller.shedding = True
+        sched.admit_requests()
+        sched.admit_requests()
+        assert b.status is RequestStatus.DECODING   # nothing parked
+        assert pol.counters["parked"] == {}
+        # The registered gate warning fired exactly once (the flag is
+        # what rate-limits the log line).
+        assert pol._warned_no_tier is True
+
+
+# -- end-to-end: off-inertness + shed/park/release bit-identity --------------
+
+
+def _engine(qos, overlap, lookahead, num_pages, host_bytes,
+            max_batch=4, seed=0):
+    from parallax_tpu.runtime.engine import EngineConfig, StageEngine
+    from parallax_tpu.models.registry import create_stage_model
+
+    model = create_stage_model(TINY, 0, TINY.num_hidden_layers)
+    params = model.init_params(jax.random.key(seed), dtype=jnp.float32)
+    return StageEngine(model, params, EngineConfig(
+        page_size=PAGE, num_pages=num_pages, max_batch_size=max_batch,
+        max_model_len=256, kv_dtype="float32",
+        enable_prefix_cache=True, host_cache_bytes=host_bytes,
+        overlap_steps=overlap, decode_lookahead=lookahead,
+        qos=qos,
+    ))
+
+
+QOS_SPEC = (
+    "interactive_ms=200,tick_interval_s=0.01,min_shed_s=0.05,"
+    "burn_window_s=1.0,starvation_s=60"
+)
+
+
+def _mixed_workload(flood_gen=24):
+    """4 batch-flood rows (greedy + seeded) then 2 interactive rows."""
+    rng = np.random.default_rng(11)
+
+    def prompt(salt):
+        p = [int(x) for x in rng.integers(1, TINY.vocab_size - 1,
+                                          size=2 * PAGE)]
+        p[-1] = salt + 1
+        return p
+
+    flood = []
+    for i in range(4):
+        sp = (
+            SamplingParams(temperature=0.0, max_new_tokens=flood_gen,
+                           ignore_eos=True)
+            if i % 2 == 0 else
+            SamplingParams(temperature=0.8, top_k=8, seed=41 + i,
+                           max_new_tokens=flood_gen, ignore_eos=True)
+        )
+        flood.append((f"batch{i}", prompt(i), sp, "batch"))
+    inter = []
+    for i in range(2):
+        sp = (
+            SamplingParams(temperature=0.0, max_new_tokens=6,
+                           ignore_eos=True)
+            if i % 2 == 0 else
+            SamplingParams(temperature=0.7, top_k=8, seed=97,
+                           max_new_tokens=6, ignore_eos=True)
+        )
+        inter.append((f"inter{i}", prompt(20 + i), sp, "interactive"))
+    return flood, inter
+
+
+def _run_mixed(qos, overlap, lookahead):
+    """Serve the mixed workload (flood first, interactive arriving once
+    the flood decodes) to completion; returns per-request streams and
+    the engine."""
+    from parallax_tpu.runtime.engine import drive_step
+
+    eng = _engine(qos, overlap, lookahead,
+                  num_pages=4 * 6 + 3, host_bytes=1 << 24)
+    flood, inter = _mixed_workload()
+    reqs = {}
+    for rid, p, sp, cls in flood:
+        r = Request(rid, prompt_ids=list(p),
+                    sampling_params=dataclasses.replace(sp),
+                    qos_class=cls)
+        reqs[rid] = r
+        assert eng.submit(r)
+    pending = None
+    guard = 0
+    # Let the flood reach decode before interactive arrives.
+    while guard < 5000 and not all(
+        r.output_ids for r in reqs.values()
+    ):
+        guard += 1
+        _outs, pending = drive_step(eng, pending)
+    for rid, p, sp, cls in inter:
+        r = Request(rid, prompt_ids=list(p),
+                    sampling_params=dataclasses.replace(sp),
+                    qos_class=cls)
+        reqs[rid] = r
+        assert eng.submit(r)
+    deadline = time.monotonic() + 120.0
+    while (eng.has_work() or pending is not None) and (
+        time.monotonic() < deadline
+    ):
+        _outs, pending = drive_step(eng, pending)
+    return {rid: list(r.output_ids) for rid, r in reqs.items()}, reqs, eng
+
+
+@pytest.mark.parametrize("overlap,lookahead", [
+    (False, 1), (True, 1), (True, 4),
+])
+def test_streams_bit_identical_qos_on_vs_off(overlap, lookahead):
+    """The acceptance contract: the SAME workload streams bit-identical
+    tokens with QoS off and QoS on (greedy + seeded rows, sync +
+    overlap, K=1 and K>1) — QoS changes WHEN work runs, never what it
+    computes — and every request completes un-aborted in both modes."""
+    off_streams, off_reqs, _ = _run_mixed(None, overlap, lookahead)
+    on_streams, on_reqs, eng = _run_mixed(QOS_SPEC, overlap, lookahead)
+    for reqs in (off_reqs, on_reqs):
+        for r in reqs.values():
+            assert r.status.is_finished, r
+            assert r.status is not RequestStatus.FINISHED_ABORT, r
+            assert len(r.output_ids) == r.sampling_params.max_new_tokens
+    assert on_streams == off_streams
+    # Off-inertness the other way round: the off-mode engine wired NO
+    # policy object at all.
+    off_eng = _engine(None, overlap, lookahead, 32, 0)
+    assert off_eng.scheduler.qos is None
+
+
+def test_pressure_sheds_parks_and_releases_bit_identically():
+    """Under a page budget the flood saturates, the interactive
+    arrivals trip queue pressure: batch decodes PARK to the host tier
+    (never abort), interactive jumps in, and on release the parked
+    rows resume and finish their exact streams."""
+    from parallax_tpu.runtime.engine import drive_step
+
+    def run(qos):
+        eng = _engine(qos, True, 1, num_pages=4 * 15 + 9,
+                      host_bytes=1 << 24, max_batch=4)
+        flood, inter = _mixed_workload(flood_gen=96)
+        reqs = {}
+        pending = None
+        for rid, p, sp, cls in flood:
+            r = Request(rid, prompt_ids=list(p),
+                        sampling_params=dataclasses.replace(sp),
+                        qos_class=cls)
+            reqs[rid] = r
+            assert eng.submit(r)
+        guard = 0
+        while guard < 5000 and not all(
+            r.output_ids for r in reqs.values()
+        ):
+            guard += 1
+            _outs, pending = drive_step(eng, pending)
+        for rid, p, sp, cls in inter:
+            r = Request(rid, prompt_ids=list(p),
+                        sampling_params=dataclasses.replace(sp),
+                        qos_class=cls)
+            reqs[rid] = r
+            assert eng.submit(r)
+        # The shed trigger needs the interactive wait to become
+        # pressing: hold the queue until the policy trips (or the
+        # budget passes) while the flood keeps decoding.
+        deadline = time.monotonic() + 120.0
+        while (eng.has_work() or pending is not None) and (
+            time.monotonic() < deadline
+        ):
+            _outs, pending = drive_step(eng, pending)
+        return {rid: list(r.output_ids) for rid, r in reqs.items()}, \
+            reqs, eng
+
+    # max_batch_size 4 is fully held by the flood: the interactive
+    # arrivals CANNOT admit until a slot frees — with QoS on, the shed
+    # parks flood decodes instead of making them wait the flood out.
+    # shed_burn=1000 proves the QUEUE-PRESSURE trigger alone drives it;
+    # the tight interactive budget makes the wait pressing while the
+    # 96-token flood is still mid-decode.
+    off_streams, off_reqs, _ = run(None)
+    on_streams, on_reqs, eng = run(
+        "interactive_ms=60,tick_interval_s=0.005,min_shed_s=0.02,"
+        "burn_window_s=0.5,starvation_s=60,shed_burn=1000"
+    )
+    pol = eng.scheduler.qos
+    assert sum(pol.counters["parked"].values()) > 0
+    assert sum(pol.counters["shed_held"].values()) > 0
+    assert pol.controller.transitions["sheds"] >= 1
+    assert pol.controller.transitions["releases"] >= 1
+    for r in on_reqs.values():
+        assert r.status.is_finished
+        assert r.status is not RequestStatus.FINISHED_ABORT
+        assert len(r.output_ids) == r.sampling_params.max_new_tokens
+    # Parked-and-resumed flood streams are bit-identical to the
+    # untouched off-mode run.
+    assert on_streams == off_streams
+
+
+# -- class propagation -------------------------------------------------------
+
+
+class TestPropagation:
+    def test_proto_roundtrip_carries_qos(self):
+        from parallax_tpu.p2p import proto
+
+        ireq = IntermediateRequest(
+            request_id="r1", routing_table=["a", "b"], context_len=4,
+            num_new_tokens=4, token_ids=[1, 2, 3, 4],
+            qos_class="agent",
+        )
+        back = proto.ireq_from_wire(proto.ireq_to_wire(ireq))
+        assert back.qos_class == "agent"
+        # Older frames without the field decode to None.
+        wire = proto.ireq_to_wire(ireq)
+        wire.pop("qos")
+        assert proto.ireq_from_wire(wire).qos_class is None
+
+    def test_mirror_inherits_class(self):
+        from parallax_tpu.runtime.engine import EngineConfig, StageEngine
+        from parallax_tpu.models.registry import create_stage_model
+
+        model = create_stage_model(TINY, 2, 4)   # downstream stage
+        params = model.init_params(jax.random.key(7), dtype=jnp.float32)
+        eng = StageEngine(model, params, EngineConfig(
+            page_size=PAGE, num_pages=32, max_batch_size=2,
+            max_model_len=128, kv_dtype="float32",
+        ))
+        ireq = IntermediateRequest(
+            request_id="m1", routing_table=[], context_len=4,
+            num_new_tokens=4, token_ids=[1, 2, 3, 4],
+            sampling_params=SamplingParams().to_dict(),
+            is_last_chunk=False, qos_class="batch",
+        )
+        eng.submit_intermediate(ireq)
+        req = eng.scheduler.wait_queue.get("m1") or (
+            eng.scheduler.running.get("m1")
+        )
+        assert req is not None and req.qos_class == "batch"
+
+    def test_emitted_forward_packets_carry_class(self):
+        """Head stage of a 2-stage pipeline stamps its qos tag on the
+        hidden-state packets it forwards."""
+        from parallax_tpu.runtime.engine import (
+            EngineConfig,
+            StageEngine,
+            drive_step,
+        )
+        from parallax_tpu.models.registry import create_stage_model
+
+        model = create_stage_model(TINY, 0, 2)
+        params = model.init_params(jax.random.key(3), dtype=jnp.float32)
+        eng = StageEngine(model, params, EngineConfig(
+            page_size=PAGE, num_pages=32, max_batch_size=2,
+            max_model_len=128, kv_dtype="float32",
+        ))
+        r = Request("fwd1", prompt_ids=list(range(1, 9)),
+                    sampling_params=SamplingParams(max_new_tokens=2),
+                    routing_table=["h", "t"], qos_class="interactive")
+        assert eng.submit(r)
+        outs, pending = drive_step(eng, None)
+        if pending is not None:
+            outs2, _ = drive_step(eng, pending)
+            outs = list(outs) + list(outs2)
+        fwds = [i for o in outs for i in o.forward]
+        assert fwds and all(i.qos_class == "interactive" for i in fwds)
+
+    def test_swarm_client_ships_remaining_deadline(self):
+        from parallax_tpu.backend.run import SwarmClient
+
+        r = Request("q1", prompt_ids=[1], qos_class="batch",
+                    deadline=time.monotonic() + 1.0, tenant_id="acme")
+        p = SwarmClient._qos_payload(r)
+        assert p["qos_class"] == "batch" and p["tenant"] == "acme"
+        assert 0.0 < p["deadline_ms"] <= 1000.0
+        assert SwarmClient._qos_payload(Request("q2", prompt_ids=[1])) == {}
+
+
+# -- LoRA adapter LRU --------------------------------------------------------
+
+
+class TestAdapterLRU:
+    def tree(self, r=2):
+        a = np.ones((r, 8), np.float32)
+        b = np.ones((4, r), np.float32)
+        return {0: {"self_attn.q_proj": (a, b, 1.0)}}
+
+    def test_eviction_order_and_active_protection(self):
+        from parallax_tpu.ops.lora import AdapterSet
+
+        s = AdapterSet(max_adapters=2)
+        assert s.register("a", self.tree()) == []
+        assert s.register("b", self.tree()) == []
+        s.touch("a")                       # b becomes LRU
+        assert s.register("c", self.tree()) == ["b"]
+        assert s.names == ["a", "c"]
+        # "a" is LRU now but active: "c" (only other candidate) evicts.
+        assert s.register("d", self.tree(), active={"a"}) == ["c"]
+        assert sorted(s.names) == ["a", "d"]
+        assert s.evicted_total == 2
+
+    def test_unbounded_never_evicts(self):
+        from parallax_tpu.ops.lora import AdapterSet
+
+        s = AdapterSet()
+        for n in "abcdef":
+            assert s.register(n, self.tree()) == []
+        assert len(s.names) == 6
+
+    def test_slots_stay_consistent_after_eviction(self):
+        from parallax_tpu.ops.lora import AdapterSet
+
+        s = AdapterSet(max_adapters=2)
+        s.register("a", self.tree())
+        s.register("b", self.tree())
+        s.register("c", self.tree())       # evicts "a"
+        for name in s.names:
+            field = s.batch_field(name)
+            assert int(field["slot"]) == s.slot_of(name)
+            # Every stacked array's slot axis matches the live set.
+            leaf = field["layers"]["0"]["self_attn.q_proj"]["A"]
+            assert leaf.shape[0] == len(s.names)
+
+    def test_deterministic_namespace_salt(self):
+        from parallax_tpu.runtime.cache_manager import (
+            derive_ns_salt,
+            ns_salt,
+        )
+
+        assert derive_ns_salt("t1") == derive_ns_salt("t1")
+        assert derive_ns_salt("t1") != derive_ns_salt("t2")
+        assert 0 < derive_ns_salt("t1") < 2 ** 31
+        memo = {}
+        assert ns_salt(memo, "t1") == derive_ns_salt("t1")
+        assert ns_salt(memo, None) is None
+
+
+# -- per-tenant routing fairness ---------------------------------------------
+
+
+class TestTenantFairness:
+    def replicas(self, num=2):
+        from parallax_tpu.scheduling.node import Node
+        from parallax_tpu.scheduling.node_management import (
+            NodeManager,
+            Pipeline,
+        )
+
+        mgr = NodeManager(TINY.num_hidden_layers)
+        for i in range(num):
+            n = Node(node_id=f"r{i}", hardware=V5E, model=TINY)
+            n.set_layers(0, TINY.num_hidden_layers)
+            n.is_ready = True
+            mgr.add(n)
+            mgr.register_pipelines([Pipeline(nodes=[n])])
+        return mgr
+
+    def meta(self, toks, tenant):
+        from parallax_tpu.scheduling.request_routing import RequestMeta
+
+        return RequestMeta("r", prompt_ids=list(toks), tenant_id=tenant)
+
+    def test_gamma_spreads_a_monopolizing_tenant(self):
+        from parallax_tpu.runtime.radix_cache import block_hash_chain
+        from parallax_tpu.scheduling.request_routing import (
+            CacheAwareRouting,
+        )
+
+        toks = list(range(6 * PAGE))
+        chain = block_hash_chain(toks, PAGE)
+
+        def run(gamma):
+            mgr = self.replicas()
+            router = CacheAwareRouting(mgr, gamma=gamma)
+            assert mgr.get("r0").cache_index.apply(
+                {"seq": 0, "block": PAGE, "full": chain}
+            ) is False
+            chosen = []
+            for _ in range(8):
+                path = router.find_path(self.meta(toks, "acme"))
+                chosen.append(path[0].node_id)
+            return chosen
+
+        # Cache affinity alone pins every dispatch to the warm replica.
+        assert set(run(0.0)) == {"r0"}
+        # The fairness term overflows the tenant onto the cold one.
+        assert "r1" in set(run(10_000.0))
+
+    def test_untagged_requests_pay_no_fairness_cost(self):
+        from parallax_tpu.scheduling.request_routing import (
+            CacheAwareRouting,
+        )
+
+        mgr = self.replicas()
+        router = CacheAwareRouting(mgr, gamma=10_000.0)
+        # No tenant: behaves like the plain cache-aware router.
+        for _ in range(4):
+            assert router.find_path(self.meta(list(range(16)), None))
+        assert router._tenant_share == {}
+
+
+# -- pool autoscaler ---------------------------------------------------------
+
+
+def _pool_manager(spec):
+    """NodeManager from [(nid, role, load), ...] single-stage pipelines."""
+    from parallax_tpu.scheduling.node import Node
+    from parallax_tpu.scheduling.node_management import (
+        NodeManager,
+        Pipeline,
+    )
+
+    mgr = NodeManager(TINY.num_hidden_layers)
+    for nid, role, load in spec:
+        n = Node(node_id=nid, hardware=V5E, model=TINY, role=role)
+        n.set_layers(0, TINY.num_hidden_layers)
+        n.is_ready = True
+        n.load = load
+        mgr.add(n)
+        mgr.register_pipelines([Pipeline(nodes=[n])])
+    return mgr
+
+
+class TestAutoscaler:
+    def config(self, **kw):
+        base = dict(
+            autoscale=True, autoscale_interval_s=0.0,
+            autoscale_cooldown_s=0.0,
+            autoscale_util_high=0.5, autoscale_util_low=0.25,
+        )
+        base.update(kw)
+        return dataclasses.replace(parse_qos_spec("on"), **base)
+
+    def cap(self, mgr, nid):
+        return mgr.get(nid).max_concurrent_requests()
+
+    def test_reroles_idle_decode_to_starved_prefill(self):
+        mgr = _pool_manager([("p0", "prefill", 0),
+                             ("d0", "decode", 0), ("d1", "decode", 0)])
+        mgr.get("p0").load = self.cap(mgr, "p0")   # prefill saturated
+        clock = {"t": 100.0}
+        scaler = PoolAutoscaler(mgr, self.config(),
+                                clock=lambda: clock["t"])
+        action = scaler.tick()
+        assert action is not None
+        assert action["direction"] == "decode->prefill"
+        assert mgr.get(action["nodes"][0]).role == "prefill"
+        roles = sorted(p.role for p in mgr.pipelines)
+        assert roles == ["decode", "prefill", "prefill"]
+        assert scaler.stats["reroles"] == 1
+
+    def test_never_empties_the_donor_pool(self):
+        mgr = _pool_manager([("p0", "prefill", 0), ("d0", "decode", 0)])
+        mgr.get("p0").load = self.cap(mgr, "p0")
+        scaler = PoolAutoscaler(mgr, self.config(), clock=lambda: 100.0)
+        assert scaler.tick() is None   # decode pool has one pipeline
+
+    def test_hysteresis_band_blocks_action(self):
+        mgr = _pool_manager([("p0", "prefill", 0),
+                             ("d0", "decode", 0), ("d1", "decode", 0)])
+        # Prefill busy but under util_high: no action.
+        mgr.get("p0").load = int(self.cap(mgr, "p0") * 0.4)
+        scaler = PoolAutoscaler(mgr, self.config(), clock=lambda: 100.0)
+        assert scaler.tick() is None
+
+    def test_cooldown_spaces_actions(self):
+        mgr = _pool_manager([("p0", "prefill", 0),
+                             ("d0", "decode", 0), ("d1", "decode", 0),
+                             ("d2", "decode", 0)])
+        mgr.get("p0").load = self.cap(mgr, "p0")
+        clock = {"t": 100.0}
+        scaler = PoolAutoscaler(
+            mgr, self.config(autoscale_cooldown_s=30.0),
+            clock=lambda: clock["t"],
+        )
+        assert scaler.tick() is not None
+        clock["t"] += 1.0
+        assert scaler.tick() is None      # cooldown
+        clock["t"] += 60.0
+        assert scaler.tick() is not None
+
+    def test_requires_both_pools(self):
+        mgr = _pool_manager([("m0", "mixed", 0), ("m1", "mixed", 0)])
+        mgr.get("m0").load = self.cap(mgr, "m0")
+        scaler = PoolAutoscaler(mgr, self.config(), clock=lambda: 100.0)
+        assert scaler.tick() is None
+
+
+# -- live swarm re-role (chaos harness, zero aborts) -------------------------
+
+
+def _stage_params(model):
+    return model.init_params(
+        jax.random.key(model.start_layer * 1000 + model.end_layer),
+        dtype=jnp.float32,
+    )
+
+
+@pytest.mark.slow
+def test_autoscaler_reroles_live_swarm_with_zero_aborts():
+    """A prefill-starved disaggregated swarm under the chaos harness
+    (lock sanitizer on): the autoscaler re-roles one decode pipeline to
+    prefill; the worker adopts the role from its heartbeat reply
+    without a reload, its in-flight decode drains through the handoff
+    machinery to the surviving decode pipeline, and every request —
+    flood and chatty — completes with zero aborts."""
+    from parallax_tpu.backend.run import SwarmClient
+    from parallax_tpu.backend.scheduler_service import SchedulerService
+    from parallax_tpu.p2p.node import WorkerNode
+    from parallax_tpu.p2p.transport import LoopbackTransport
+    from parallax_tpu.runtime.engine import EngineConfig
+    from parallax_tpu.scheduling.scheduler import GlobalScheduler
+    from parallax_tpu.testing.chaos import ChaosController
+
+    chaos = ChaosController(seed=5)
+    registry: dict = {}
+    qos = dataclasses.replace(
+        parse_qos_spec("on"),
+        autoscale=True, autoscale_interval_s=0.5,
+        autoscale_cooldown_s=600.0,
+        # Tiny absolute thresholds: real loads on a toy swarm sit far
+        # under the KV-derived capacity (~thousands of requests). The
+        # two decode pipelines' summed capacity doubles the
+        # denominator, so 2 chatty decodes sit well under util_low
+        # while ~3 queued prompts push the lone prefill pipeline over
+        # util_high.
+        autoscale_util_high=0.0006, autoscale_util_low=0.0003,
+    )
+    sched = GlobalScheduler(TINY, min_nodes_bootstrapping=3,
+                            heartbeat_timeout_s=5.0,
+                            routing="cache_aware", qos=qos)
+    service = SchedulerService(
+        sched, chaos.wrap(LoopbackTransport("sched", registry)),
+        join_timeout_s=30.0,
+    )
+    service.start()
+    ecfg = EngineConfig(
+        page_size=PAGE, num_pages=96, max_model_len=384,
+        kv_dtype="float32", max_num_tokens_per_batch=192,
+        max_batch_size=8, host_cache_bytes=1 << 24, cache_digests=True,
+    )
+    roles = ["prefill", "decode", "decode"]
+    workers = [
+        WorkerNode(
+            transport=chaos.wrap(LoopbackTransport(f"qs{i}", registry)),
+            scheduler_peer="sched",
+            model_config=TINY,
+            engine_config=dataclasses.replace(ecfg),
+            load_params=_stage_params,
+            heartbeat_interval_s=0.1,
+            role=role,
+        )
+        for i, role in enumerate(roles)
+    ]
+    try:
+        starters = [threading.Thread(target=w.start) for w in workers]
+        for s in starters:
+            s.start()
+        for s in starters:
+            s.join(timeout=120.0)
+        assert wait_for(
+            lambda: len(sched.manager.pipelines) >= 3 and all(
+                n.is_ready
+                for p in sched.manager.pipelines for n in p.nodes
+            ),
+            timeout=60.0,
+        ), sched.cluster_status()
+
+        client = SwarmClient(
+            chaos.wrap(LoopbackTransport("client", registry)), service,
+            poll_interval_s=0.002,
+        )
+        rng = np.random.default_rng(3)
+
+        def submit(rid, n_prompt, max_new, seed=None):
+            p = [int(x) for x in rng.integers(
+                1, TINY.vocab_size - 1, size=n_prompt
+            )]
+            path = client.route(rid, prompt_ids=p)
+            assert path, rid
+            req = Request(
+                rid, prompt_ids=p,
+                sampling_params=SamplingParams(
+                    temperature=0.0 if seed is None else 0.8,
+                    top_k=-1 if seed is None else 8,
+                    seed=seed, max_new_tokens=max_new, ignore_eos=True,
+                ),
+                routing_table=list(path),
+            )
+            return req, client.submit(req)
+
+        # Two chatty sessions: handed off to the decode pool, still
+        # decoding when the re-role fires — the drain they must survive.
+        chatty = [submit(f"chat{i}", PAGE, 160, seed=(None, 71)[i])
+                  for i in range(2)]
+        assert wait_for(
+            lambda: all(len(r.output_ids) >= 2 for r, _ in chatty),
+            timeout=60.0,
+        ), {r.request_id: r.status for r, _ in chatty}
+
+        # Prompt flood: saturates the single prefill pipeline while the
+        # decode pool idles under util_low -> the autoscaler re-roles.
+        flood_done = []
+        stop_flood = threading.Event()
+
+        def flood():
+            i = 0
+            while not stop_flood.is_set() and i < 400:
+                try:
+                    flood_done.append(
+                        submit(f"flood{i}", 2 * PAGE, 1)
+                    )
+                except AssertionError:
+                    pass
+                i += 1
+                time.sleep(0.002)
+
+        ft = threading.Thread(target=flood, daemon=True)
+        ft.start()
+        try:
+            assert wait_for(
+                lambda: (sched.cluster_status().get("qos", {})
+                         .get("autoscaler", {}).get("reroles", 0)) >= 1,
+                timeout=60.0, interval=0.2,
+            ), sched.cluster_status().get("qos")
+        finally:
+            stop_flood.set()
+            ft.join(timeout=10.0)
+
+        # The worker adopted the role in place (no reload).
+        assert wait_for(
+            lambda: sum(1 for w in workers if w.role == "prefill") == 2,
+            timeout=20.0,
+        ), [w.role for w in workers]
+        roles_now = sorted(p.role for p in sched.manager.pipelines)
+        assert roles_now == ["decode", "prefill", "prefill"]
+
+        # Chaos kill on top of the re-role: the one REMAINING decode
+        # specialist dies. Any chatty stream still decoding there
+        # (including the one the re-roled pipeline just drained onto
+        # it) recovers through the migration / client-resume ladder
+        # onto the surviving pool — the re-roled topology must absorb
+        # the kill exactly like a stable one: zero aborts.
+        victim = next(w for w in workers if w.role == "decode")
+        chaos.kill(victim)
+
+        # Everything completes: the re-roled pipeline's in-flight
+        # decode drained through the handoff machinery, and the kill
+        # cost nothing but latency — zero aborts.
+        for r, ev in chatty:
+            assert ev.wait(120.0), (r.request_id, r.status)
+            assert r.status.is_finished
+            assert r.status is not RequestStatus.FINISHED_ABORT, (
+                r.request_id, r.abort_reason,
+            )
+            assert len(r.output_ids) == 160
+        for r, ev in flood_done:
+            assert ev.wait(60.0), r.request_id
+            assert r.status is not RequestStatus.FINISHED_ABORT, (
+                r.request_id, r.abort_reason,
+            )
+        # Chaos harness bonus: the lock-order sanitizer saw the whole
+        # episode — no cycles.
+        assert chaos.lock_report()["cycles"] == []
+    finally:
+        for w in workers:
+            w.stop()
+        service.stop()
