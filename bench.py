@@ -793,9 +793,11 @@ def _churn_probe(cfg, stage_params_fn, kv_dtype, page_size):
         )]
         requests.append((prompt, sp))
 
-    # lock_sanitizer=False: the probe measures migration latency; the
-    # instrumented locks would tax every acquisition in this process.
-    chaos = ChaosController(seed=17, lock_sanitizer=False)
+    # lock_sanitizer=False / conformance=False: the probe measures
+    # migration latency; instrumented locks would tax every acquisition
+    # and conformance hooks every transition/frame in this process.
+    chaos = ChaosController(seed=17, lock_sanitizer=False,
+                            conformance=False)
     registry: dict = {}
     # Two 2-stage pipelines: cap what one node may hold at half the
     # model so the allocator splits each pipeline across two workers.

@@ -1,21 +1,27 @@
-"""Concurrency & JAX-hazard analysis suite.
+"""Concurrency, JAX-hazard & protocol analysis suite.
 
-Two halves (docs/static_analysis.md):
+Three parts (docs/static_analysis.md):
 
 - **static**: an AST lint pass with project-specific checkers —
   lock-discipline, hot-path-sync, donation-reuse, jit-purity,
-  config-gate — run as ``python -m parallax_tpu.analysis`` (or the
-  ``parallax-tpu-lint`` console script) over the package, with
-  per-line suppressions and a ratchet-only committed baseline;
+  config-gate, status-transition, frame-drift, metric-hygiene — run as
+  ``python -m parallax_tpu.analysis`` (or the ``parallax-tpu-lint``
+  console script) over the package, with per-line suppressions and a
+  ratchet-only committed baseline;
+- **declared model**: :mod:`.protocol` — the request-lifecycle FSM and
+  the wire-frame schema registry the protocol checkers enforce, plus
+  the generated FSM table/dot (``parallax-tpu-lint --fsm-table`` /
+  ``--fsm-dot``);
 - **dynamic**: a lock-order sanitizer (:mod:`.sanitizer`) — lockdep
-  for the Python layer — that instruments every
-  :func:`~parallax_tpu.analysis.sanitizer.make_lock` lock while
-  enabled and reports lock-graph cycles and held-too-long stalls,
-  activated under the chaos harness and the pytest
-  ``--lock-sanitizer`` flag.
+  for the Python layer — and a protocol-conformance sanitizer
+  (:mod:`.conformance`) that checks live status transitions, head
+  ownership, router load charges and frame traffic against the
+  declared model; both are activated under the chaos harness and the
+  pytest ``--lock-sanitizer`` / ``--conformance-sanitizer`` flags.
 
-This package imports only the stdlib at module scope so the CLI and
-``make_lock`` stay usable in jax-free environments.
+This package imports only the stdlib at module scope so the CLI,
+``make_lock`` and the conformance hooks stay usable in jax-free
+environments.
 """
 
 from parallax_tpu.analysis.sanitizer import (  # noqa: F401

@@ -10,10 +10,17 @@ from __future__ import annotations
 
 from parallax_tpu.analysis.checkers.config_gates import ConfigGateChecker
 from parallax_tpu.analysis.checkers.donation import DonationChecker
+from parallax_tpu.analysis.checkers.frame_drift import FrameDriftChecker
 from parallax_tpu.analysis.checkers.hot_path_sync import HotPathSyncChecker
 from parallax_tpu.analysis.checkers.jit_purity import JitPurityChecker
 from parallax_tpu.analysis.checkers.lock_discipline import (
     LockDisciplineChecker,
+)
+from parallax_tpu.analysis.checkers.metric_hygiene import (
+    MetricHygieneChecker,
+)
+from parallax_tpu.analysis.checkers.status_transition import (
+    StatusTransitionChecker,
 )
 
 CHECKER_CLASSES = (
@@ -22,6 +29,9 @@ CHECKER_CLASSES = (
     DonationChecker,
     JitPurityChecker,
     ConfigGateChecker,
+    StatusTransitionChecker,
+    FrameDriftChecker,
+    MetricHygieneChecker,
 )
 
 

@@ -1,0 +1,548 @@
+"""frame-drift: senders, handlers and the frame schema must agree.
+
+The wire-frame schema registry (:data:`parallax_tpu.analysis.protocol.
+FRAME_SCHEMAS`) declares, per RPC frame type, the payload fields
+senders set and receivers may read. This checker cross-references the
+whole package against it (one aggregate pass, pinned to
+``p2p/proto.py`` so findings are stable):
+
+- a frame type **constructed** anywhere (``transport.call/send`` or an
+  ``AsyncSender.send`` with a ``proto.X``/literal method) with no
+  ``transport.register`` handler anywhere is a finding (frames into
+  the void);
+- a constructed or registered frame type missing from the schema
+  registry — or a registry entry whose type no longer appears in the
+  code — is a finding (the registry is the reviewed contract, not a
+  suggestion);
+- a handler that reads a payload field the schema does not declare,
+  or a sender that sets an undeclared field, is a finding (silent
+  drift: the other side will never see/fill it);
+- a declared field that no handler reads and no sender sets is a stale
+  entry; a field **read but never set** by any in-tree sender is a
+  ghost field (finding unless declared ``compat=True`` with a reason);
+- the nested ``IntermediateRequest``/``RequestCheckpoint`` wire maps
+  are held to ``REQ_FIELDS``/``CKPT_FIELDS``: ``ireq_to_wire`` writes,
+  ``ireq_from_wire`` reads and the declaration must agree exactly
+  (same for ``checkpoint_to_wire``/``checkpoint_from_wire``).
+
+Transport-internal ``__dunder__`` frames (hello/relay/ping/reply
+envelopes) are outside the registry by design and skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+
+from parallax_tpu.analysis import protocol
+from parallax_tpu.analysis.checkers import common
+from parallax_tpu.analysis.linter import Checker, Finding, Module
+
+# Receivers whose .call/.send construct wire frames / whose .register
+# binds handlers. Matched on the LAST dotted segment.
+_SENDER_SEGMENTS = ("transport", "sender", "kv_sender")
+
+
+def _receiver_tail(func: ast.Attribute) -> str | None:
+    name = common.dotted_name(func.value)
+    if not name:
+        return None
+    return name.rsplit(".", 1)[-1]
+
+
+@dataclasses.dataclass
+class _Site:
+    rel: str
+    line: int
+
+
+@dataclasses.dataclass
+class _Scan:
+    """One aggregate pass over the package."""
+
+    # frame_type -> construction sites
+    constructed: dict[str, list[_Site]] = dataclasses.field(
+        default_factory=dict)
+    # frame_type -> registration sites
+    registered: dict[str, list[_Site]] = dataclasses.field(
+        default_factory=dict)
+    # frame_type -> {field: [site, ...]} payload keys set by senders
+    writes: dict[str, dict[str, list[_Site]]] = dataclasses.field(
+        default_factory=dict)
+    # frame_type -> {field: [site, ...]} payload keys read by handlers
+    reads: dict[str, dict[str, list[_Site]]] = dataclasses.field(
+        default_factory=dict)
+    # proto.py constant name -> frame type value
+    consts: dict[str, str] = dataclasses.field(default_factory=dict)
+    # function-qualname-suffix sites: "rel:qualname" -> FunctionDef
+    functions: dict[str, tuple[str, ast.AST]] = dataclasses.field(
+        default_factory=dict)
+
+
+def _payload_param(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> str | None:
+    args = fn.args.posonlyargs + fn.args.args
+    if not args:
+        return None
+    return args[-1].arg
+
+
+def _key_reads(body: ast.AST, var: str) -> dict[str, int]:
+    """Payload-field reads on ``var``: ``var["k"]``, ``var.get("k")``,
+    and ``helper(var, "k", ...)`` (validation helpers that take the
+    payload and a key)."""
+    out: dict[str, int] = {}
+
+    def note(key: object, line: int) -> None:
+        if isinstance(key, str):
+            out.setdefault(key, line)
+
+    for n in ast.walk(body):
+        if (
+            isinstance(n, ast.Subscript)
+            and isinstance(n.value, ast.Name)
+            and n.value.id == var
+            and isinstance(n.slice, ast.Constant)
+        ):
+            note(n.slice.value, n.lineno)
+        elif isinstance(n, ast.Call):
+            f = n.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in ("get", "pop")
+                and isinstance(f.value, ast.Name)
+                and f.value.id == var
+                and n.args
+                and isinstance(n.args[0], ast.Constant)
+            ):
+                note(n.args[0].value, n.lineno)
+            elif (
+                isinstance(f, ast.Name)
+                and len(n.args) >= 2
+                and isinstance(n.args[0], ast.Name)
+                and n.args[0].id == var
+                and isinstance(n.args[1], ast.Constant)
+            ):
+                note(n.args[1].value, n.lineno)
+        elif (
+            isinstance(n, ast.Compare)
+            and isinstance(n.left, ast.Constant)
+            and len(n.ops) == 1
+            and isinstance(n.ops[0], (ast.In, ast.NotIn))
+            and isinstance(n.comparators[0], ast.Name)
+            and n.comparators[0].id == var
+        ):
+            note(n.left.value, n.lineno)
+    return out
+
+
+def _dict_literal_keys(node: ast.Dict) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for k in node.keys:
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            out.setdefault(k.value, node.lineno)
+    return out
+
+
+def _payload_writes(call: ast.Call, payload_arg: ast.AST,
+                    fn: ast.AST | None) -> dict[str, int] | None:
+    """Keys a send site statically sets, or None when the payload is
+    opaque (lambda / builder call / unresolvable)."""
+    if isinstance(payload_arg, ast.Dict):
+        return _dict_literal_keys(payload_arg)
+    if isinstance(payload_arg, ast.Name) and fn is not None:
+        keys: dict[str, int] = {}
+        found = False
+        for n in ast.walk(fn):
+            if (
+                isinstance(n, ast.Assign)
+                and isinstance(n.value, ast.Dict)
+                and any(
+                    isinstance(t, ast.Name) and t.id == payload_arg.id
+                    for t in n.targets
+                )
+            ):
+                found = True
+                keys.update(_dict_literal_keys(n.value))
+            elif (
+                isinstance(n, ast.Assign)
+                and any(
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == payload_arg.id
+                    and isinstance(t.slice, ast.Constant)
+                    and isinstance(t.slice.value, str)
+                    for t in n.targets
+                )
+            ):
+                for t in n.targets:
+                    if (
+                        isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == payload_arg.id
+                        and isinstance(t.slice, ast.Constant)
+                    ):
+                        keys.setdefault(t.slice.value, n.lineno)
+        return keys if found else None
+    return None
+
+
+class FrameDriftChecker(Checker):
+    id = "frame-drift"
+    doc = ("wire-frame field set by no sender / read by no handler / "
+           "undeclared in the protocol schema registry, or a frame "
+           "type with no registered handler")
+
+    def __init__(self) -> None:
+        self._done = False
+
+    def check(self, module: Module) -> list[Finding]:
+        if self._done or not module.rel.endswith("p2p/proto.py"):
+            return []
+        self._done = True
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(module.path)))
+        scan = self._scan(pkg_root)
+        return self._reconcile(module, scan)
+
+    # -- aggregate package scan --------------------------------------------
+
+    def _scan(self, pkg_root: str) -> _Scan:
+        scan = _Scan()
+        trees: dict[str, ast.Module] = {}
+        for root, dirs, files in os.walk(pkg_root):
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", "analysis")]
+            for fname in sorted(files):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(root, fname)
+                rel = os.path.relpath(
+                    path, os.path.dirname(pkg_root)).replace(os.sep, "/")
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        trees[rel] = ast.parse(f.read())
+                except (OSError, SyntaxError):  # pragma: no cover
+                    continue
+        # Frame-type constants (proto.py module-level UPPER string
+        # assignments).
+        proto_rel = next(
+            (r for r in trees if r.endswith("p2p/proto.py")), None)
+        if proto_rel:
+            for node in trees[proto_rel].body:
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id.isupper()
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)
+                ):
+                    scan.consts[node.targets[0].id] = node.value.value
+        # Function index for schema extra_sites.
+        for rel, tree in trees.items():
+            parents = common.parent_map(tree)
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    qual = node.name
+                    p = parents.get(node)
+                    while p is not None:
+                        if isinstance(p, ast.ClassDef):
+                            qual = f"{p.name}.{qual}"
+                        p = parents.get(p)
+                    scan.functions[f"{rel}:{qual}"] = (rel, node)
+        for rel, tree in trees.items():
+            self._scan_module(rel, tree, scan)
+        return scan
+
+    def _frame_type_of(self, arg: ast.AST, scan: _Scan) -> str | None:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+        if (
+            isinstance(arg, ast.Attribute)
+            and isinstance(arg.value, ast.Name)
+            and arg.value.id == "proto"
+        ):
+            return scan.consts.get(arg.attr)
+        return None
+
+    def _scan_module(self, rel: str, tree: ast.Module,
+                     scan: _Scan) -> None:
+        parents = common.parent_map(tree)
+        # Handler registrations + frame constructions.
+        handler_fns: list[tuple[str, ast.AST | None]] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            tail = _receiver_tail(func)
+            if tail is None or not any(
+                tail == s or tail.endswith("_" + s)
+                for s in _SENDER_SEGMENTS
+            ):
+                continue
+            if func.attr == "register" and len(node.args) >= 2:
+                ftype = self._frame_type_of(node.args[0], scan)
+                if ftype is None or protocol.is_internal_frame(ftype):
+                    continue
+                scan.registered.setdefault(ftype, []).append(
+                    _Site(rel, node.lineno))
+                h = node.args[1]
+                if (
+                    isinstance(h, ast.Attribute)
+                    and isinstance(h.value, ast.Name)
+                    and h.value.id == "self"
+                ):
+                    handler_fns.append((ftype, self._find_def(
+                        tree, h.attr)))
+                elif isinstance(h, ast.Name):
+                    handler_fns.append((ftype, self._find_def(
+                        tree, h.id)))
+            elif func.attr in ("call", "send") and len(node.args) >= 2:
+                ftype = self._frame_type_of(node.args[1], scan)
+                if ftype is None or protocol.is_internal_frame(ftype):
+                    continue
+                scan.constructed.setdefault(ftype, []).append(
+                    _Site(rel, node.lineno))
+                if len(node.args) >= 3:
+                    fn = common.enclosing_function(node, parents)
+                    keys = _payload_writes(node, node.args[2], fn)
+                    if keys:
+                        dst = scan.writes.setdefault(ftype, {})
+                        for k, line in keys.items():
+                            dst.setdefault(k, []).append(_Site(rel, line))
+        # Handler payload reads.
+        for ftype, fn in handler_fns:
+            if fn is None:
+                continue
+            var = _payload_param(fn)
+            if var is None:
+                continue
+            dst = scan.reads.setdefault(ftype, {})
+            for k, line in _key_reads(fn, var).items():
+                dst.setdefault(k, []).append(_Site(rel, line))
+
+    @staticmethod
+    def _find_def(tree: ast.Module, name: str):
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == name
+            ):
+                return node
+        return None
+
+    # -- reconciliation against the registry --------------------------------
+
+    def _fold_extra_sites(self, scan: _Scan) -> None:
+        """Schema-declared builder/consumer functions contribute their
+        payload writes and payload-var reads. Writes are dict literals
+        carrying every REQUIRED field of the schema (a builder's
+        internal bookkeeping dicts and nested sub-maps do not qualify)
+        plus string-key subscript stores (the ``out["k"] = ...`` builder
+        idiom)."""
+        for schema in protocol.FRAME_SCHEMAS:
+            required = {f.name for f in schema.fields if f.required}
+            for site in schema.extra_sites:
+                match = next(
+                    (v for k, v in scan.functions.items()
+                     if k.endswith(site)), None)
+                if match is None:
+                    continue
+                rel, fn = match
+                # Subscript stores only count on dicts the builder
+                # RETURNS — internal bookkeeping maps stay invisible.
+                returned: set[str] = set()
+                for n in ast.walk(fn):
+                    if isinstance(n, ast.Return) and n.value is not None:
+                        for sub in ast.walk(n.value):
+                            if isinstance(sub, ast.Name):
+                                returned.add(sub.id)
+                w = scan.writes.setdefault(schema.frame_type, {})
+                for n in ast.walk(fn):
+                    if isinstance(n, ast.Dict):
+                        keys = _dict_literal_keys(n)
+                        if required and not required <= set(keys):
+                            continue
+                        for k, line in keys.items():
+                            w.setdefault(k, []).append(_Site(rel, line))
+                    elif isinstance(n, ast.Assign):
+                        for t in n.targets:
+                            if (
+                                isinstance(t, ast.Subscript)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id in returned
+                                and isinstance(t.slice, ast.Constant)
+                                and isinstance(t.slice.value, str)
+                            ):
+                                w.setdefault(t.slice.value, []).append(
+                                    _Site(rel, n.lineno))
+                var = _payload_param(fn)
+                if var:
+                    r = scan.reads.setdefault(schema.frame_type, {})
+                    for k, line in _key_reads(fn, var).items():
+                        r.setdefault(k, []).append(_Site(rel, line))
+
+    def _reconcile(self, module: Module, scan: _Scan) -> list[Finding]:
+        out: list[Finding] = []
+        self._fold_extra_sites(scan)
+        declared = {s.frame_type: s for s in protocol.FRAME_SCHEMAS}
+        live = set(scan.constructed) | set(scan.registered)
+        for ftype in sorted(set(scan.constructed) - set(scan.registered)):
+            sites = scan.constructed[ftype]
+            out.append(self.finding(
+                module, sites[0].line,
+                f"frame type {ftype!r} is constructed "
+                f"({sites[0].rel}) but no transport.register handler "
+                "exists anywhere — frames into the void",
+            ))
+        for ftype in sorted(live - set(declared)):
+            out.append(self.finding(
+                module, 1,
+                f"frame type {ftype!r} is on the wire but has no "
+                "FrameSchema in analysis/protocol.py — declare its "
+                "fields",
+            ))
+        for cname, ftype in sorted(scan.consts.items()):
+            if ftype not in live and ftype not in declared:
+                out.append(self.finding(
+                    module, 1,
+                    f"proto.py constant {cname} = {ftype!r} is neither "
+                    "sent, handled nor declared — dead wire surface; "
+                    "delete it",
+                ))
+        for ftype, schema in sorted(declared.items()):
+            if ftype not in live:
+                out.append(self.finding(
+                    module, 1,
+                    f"FrameSchema {ftype!r} matches no construction or "
+                    "registration site — stale registry entry",
+                ))
+                continue
+            if schema.payload != "map":
+                continue
+            fields = {f.name: f for f in schema.fields}
+            reads = scan.reads.get(ftype, {})
+            writes = scan.writes.get(ftype, {})
+            for k in sorted(set(reads) - set(fields)):
+                site = reads[k][0]
+                out.append(self.finding(
+                    module, site.line,
+                    f"{ftype!r} handler ({site.rel}) reads undeclared "
+                    f"payload field {k!r} — declare it in the "
+                    "FrameSchema or stop reading it",
+                ))
+            for k in sorted(set(writes) - set(fields)):
+                site = writes[k][0]
+                out.append(self.finding(
+                    module, site.line,
+                    f"{ftype!r} sender ({site.rel}) sets undeclared "
+                    f"payload field {k!r} — declare it in the "
+                    "FrameSchema or stop sending it",
+                ))
+            for name, field in sorted(fields.items()):
+                if name not in reads and name not in writes:
+                    out.append(self.finding(
+                        module, 1,
+                        f"FrameSchema {ftype!r} declares field "
+                        f"{name!r} but no sender sets it and no "
+                        "handler reads it — stale field",
+                    ))
+                elif (
+                    name in reads and name not in writes
+                    and writes and not field.compat
+                ):
+                    out.append(self.finding(
+                        module, 1,
+                        f"{ftype!r} field {name!r} is read by a "
+                        "handler but set by no in-tree sender — ghost "
+                        "field (fix the sender, or declare compat=True "
+                        "with the cross-build reason)",
+                    ))
+        out.extend(self._check_nested(module, scan))
+        return out
+
+    def _check_nested(self, module: Module,
+                      scan: _Scan) -> list[Finding]:
+        """ireq/checkpoint wire maps: writer keys == reader keys ==
+        declaration, byte for byte."""
+        out: list[Finding] = []
+        for label, declared, writer, reader, optional in (
+            (
+                "IntermediateRequest", set(protocol.REQ_FIELDS),
+                "p2p/proto.py:ireq_to_wire",
+                "p2p/proto.py:ireq_from_wire",
+                frozenset(),
+            ),
+            (
+                "RequestCheckpoint", set(protocol.CKPT_FIELDS),
+                "runtime/checkpoint.py:checkpoint_to_wire",
+                "runtime/checkpoint.py:checkpoint_from_wire",
+                # Optional sections: written/validated only when
+                # present (the reader handles absence).
+                frozenset({"kv", "trace_spans"}),
+            ),
+        ):
+            wmatch = next((v for k, v in scan.functions.items()
+                           if k.endswith(writer)), None)
+            rmatch = next((v for k, v in scan.functions.items()
+                           if k.endswith(reader)), None)
+            if wmatch is None or rmatch is None:
+                out.append(self.finding(
+                    module, 1,
+                    f"{label} wire codec functions not found "
+                    f"({writer} / {reader}) — update the frame-drift "
+                    "checker's codec map",
+                ))
+                continue
+            _, wfn = wmatch
+            _, rfn = rmatch
+            wkeys: set[str] = set()
+            for n in ast.walk(wfn):
+                if isinstance(n, ast.Dict):
+                    wkeys.update(_dict_literal_keys(n))
+                elif (
+                    isinstance(n, ast.Assign)
+                    and any(
+                        isinstance(t, ast.Subscript)
+                        and isinstance(t.slice, ast.Constant)
+                        and isinstance(t.slice.value, str)
+                        for t in n.targets
+                    )
+                ):
+                    for t in n.targets:
+                        if isinstance(t, ast.Subscript) and isinstance(
+                            t.slice, ast.Constant
+                        ):
+                            wkeys.add(t.slice.value)
+            var = _payload_param(rfn)
+            rkeys = set(_key_reads(rfn, var)) if var else set()
+            # The writer may emit nested sub-map keys (kv header); only
+            # compare keys that are declared or top-level reads.
+            for k in sorted((wkeys & declared) ^ declared):
+                if k in optional and k in rkeys:
+                    continue
+                out.append(self.finding(
+                    module, 1,
+                    f"{label} wire drift: declared field {k!r} is not "
+                    f"written by {writer.split(':')[1]} — writer and "
+                    "declaration must agree",
+                ))
+            for k in sorted(rkeys - declared):
+                out.append(self.finding(
+                    module, 1,
+                    f"{label} wire drift: {reader.split(':')[1]} reads "
+                    f"{k!r}, which is not declared — reader and "
+                    "declaration must agree",
+                ))
+            for k in sorted(declared - rkeys):
+                out.append(self.finding(
+                    module, 1,
+                    f"{label} wire drift: declared field {k!r} is "
+                    f"never read by {reader.split(':')[1]} — stale "
+                    "declaration or dropped field",
+                ))
+        return out

@@ -1,0 +1,187 @@
+"""metric-hygiene: every ``parallax_*`` metric name is a declared
+constant.
+
+:mod:`parallax_tpu.obs.names` is the single source of truth for metric
+names (a constant + HELP text per series). This checker enforces it:
+
+- a string literal that IS a metric name (full match on
+  ``parallax_[a-z0-9_]+``, excluding the bare package name) anywhere
+  outside ``obs/names.py`` is a finding — reference the constant, so a
+  rename is one edit and the docs/exposition can never drift from the
+  code;
+- the declaration itself is validated (once per run, pinned to
+  ``obs/names.py``): duplicate names, a constant without a HELP entry,
+  a HELP key that is not a declared constant, a declared name never
+  referenced by the package, and a declared name undocumented in
+  docs/observability.md are all findings.
+
+Docstrings are exempt (prose may name series); the analysis package is
+exempt (it quotes names in checker messages and fixtures).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from parallax_tpu.analysis.linter import Checker, Finding, Module
+
+METRIC_NAME_RE = re.compile(r"parallax_[a-z0-9_]+\Z")
+
+# The bare package name appears in logger roots, cache paths and module
+# strings — it is not a metric.
+_NON_METRICS = frozenset({"parallax_tpu"})
+
+OBS_DOC = "docs/observability.md"
+
+
+def _docstring_nodes(tree: ast.Module) -> set[int]:
+    """ids of Constant nodes that are docstrings."""
+    out: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = getattr(node, "body", [])
+            if (
+                body
+                and isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and isinstance(body[0].value.value, str)
+            ):
+                out.add(id(body[0].value))
+    return out
+
+
+class MetricHygieneChecker(Checker):
+    id = "metric-hygiene"
+    doc = ("parallax_* metric-name literal outside obs/names.py, or a "
+           "declared name without HELP text / docs / any reference")
+
+    def __init__(self) -> None:
+        self._table_checked = False
+        self._corpus: str | None = None
+
+    def check(self, module: Module) -> list[Finding]:
+        if module.rel.endswith("obs/names.py"):
+            if self._table_checked:
+                return []
+            self._table_checked = True
+            return self._check_table(module)
+        out: list[Finding] = []
+        docstrings = _docstring_nodes(module.tree)
+        for node in ast.walk(module.tree):
+            if not (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and METRIC_NAME_RE.fullmatch(node.value)
+                and node.value not in _NON_METRICS
+            ):
+                continue
+            if id(node) in docstrings:
+                continue
+            out.append(self.finding(
+                module, node.lineno,
+                f"metric-name literal {node.value!r} — use the "
+                "obs/names.py constant (single source of truth for "
+                "exposition and docs)",
+            ))
+        return out
+
+    # -- declaration validation (pinned to obs/names.py) --------------------
+
+    def _check_table(self, module: Module) -> list[Finding]:
+        out: list[Finding] = []
+        consts: dict[str, str] = {}      # constant name -> metric name
+        help_keys: list[str] = []        # HELP dict keys (constant names)
+        for node in module.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                target = node.target
+            else:
+                continue
+            if isinstance(target, ast.Name) and target.id.isupper():
+                tname = target.id
+                if tname == "HELP" and isinstance(node.value, ast.Dict):
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Name):
+                            help_keys.append(k.id)
+                        else:
+                            out.append(self.finding(
+                                module, k.lineno if k else node.lineno,
+                                "HELP keys must be the declared name "
+                                "constants, not fresh literals",
+                            ))
+                elif isinstance(node.value, ast.Constant) and isinstance(
+                    node.value.value, str
+                ):
+                    if node.value.value in consts.values():
+                        out.append(self.finding(
+                            module, node.lineno,
+                            f"duplicate metric name "
+                            f"{node.value.value!r} — one series, one "
+                            "constant",
+                        ))
+                    consts[tname] = node.value.value
+        for tname in sorted(set(consts) - set(help_keys)):
+            out.append(self.finding(
+                module, 1,
+                f"metric constant {tname} has no HELP entry — every "
+                "series declares its exposition text here",
+            ))
+        for tname in sorted(set(help_keys) - set(consts)):
+            out.append(self.finding(
+                module, 1,
+                f"HELP entry {tname} is not a declared metric "
+                "constant — stale entry",
+            ))
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(module.path)))
+        repo_root = os.path.dirname(pkg_root)
+        corpus = self._package_corpus(pkg_root, module.path)
+        for tname in sorted(consts):
+            if not re.search(rf"\b{re.escape(tname)}\b", corpus):
+                out.append(self.finding(
+                    module, 1,
+                    f"metric constant {tname} is referenced nowhere in "
+                    "the package — dead series; delete it (and its "
+                    "docs row)",
+                ))
+        doc_path = os.path.join(repo_root, OBS_DOC)
+        if not os.path.exists(doc_path):
+            out.append(self.finding(
+                module, 1, f"{OBS_DOC} is missing — the metric table "
+                "lives there"))
+            return out
+        with open(doc_path, encoding="utf-8") as f:
+            doc_text = f.read()
+        for tname, value in sorted(consts.items()):
+            if value not in doc_text:
+                out.append(self.finding(
+                    module, 1,
+                    f"metric {value!r} ({tname}) is not documented in "
+                    f"{OBS_DOC} — add it to the series table",
+                ))
+        return out
+
+    def _package_corpus(self, pkg_root: str, names_path: str) -> str:
+        if self._corpus is not None:
+            return self._corpus
+        parts: list[str] = []
+        names_abs = os.path.abspath(names_path)
+        for root, dirs, files in os.walk(pkg_root):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            for fname in files:
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(root, fname)
+                if os.path.abspath(path) == names_abs:
+                    continue
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        parts.append(f.read())
+                except OSError:  # pragma: no cover
+                    continue
+        self._corpus = "\x00".join(parts)
+        return self._corpus

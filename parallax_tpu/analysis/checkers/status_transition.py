@@ -1,0 +1,220 @@
+"""status-transition: every RequestStatus mutation is a declared edge.
+
+The request lifecycle FSM lives in :mod:`parallax_tpu.analysis.protocol`
+(:data:`FSM_EDGES`); the runtime funnels every mutation through
+``Request.set_status(dst, edge)``. This checker holds the code to the
+declaration:
+
+- a **raw assignment** to a ``.status`` attribute whose value involves
+  ``RequestStatus`` anywhere outside ``Request.set_status`` itself is a
+  finding (an unregistered mutation site — the conformance sanitizer
+  cannot see it and the FSM silently grows an edge);
+- every ``set_status(RequestStatus.X, "edge")`` call is validated:
+  the edge tag must be a declared owner, ``X`` must be a declared
+  destination of that owner, the call must live in the owner's declared
+  module, and the tag must be a string literal (a computed tag defeats
+  the declaration);
+- a dynamically-computed destination (``RequestStatus(wire_value)``)
+  is only legal for owners listed in ``DYNAMIC_DST_OWNERS``;
+- the declaration itself is checked for drift (once per run, pinned to
+  ``analysis/protocol.py``): an edge owner with no live ``set_status``
+  site in its declared module means the site was deleted or moved —
+  drop or fix the edge.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from parallax_tpu.analysis import protocol
+from parallax_tpu.analysis.checkers import common
+from parallax_tpu.analysis.linter import Checker, Finding, Module
+
+
+def _mentions_request_status(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id == "RequestStatus":
+            return True
+    return False
+
+
+def _dst_names(node: ast.AST) -> list[str]:
+    """``RequestStatus.X`` member names referenced inside an
+    expression (every branch of a conditional counts)."""
+    out = []
+    for n in ast.walk(node):
+        if (
+            isinstance(n, ast.Attribute)
+            and isinstance(n.value, ast.Name)
+            and n.value.id == "RequestStatus"
+        ):
+            out.append(n.attr)
+    return out
+
+
+class StatusTransitionChecker(Checker):
+    id = "status-transition"
+    doc = ("RequestStatus mutated outside Request.set_status, or a "
+           "set_status edge that is not declared in analysis/protocol.py")
+
+    def __init__(self) -> None:
+        self._decl_checked = False
+        # module-suffix -> set of owner literals with a live call site
+        # (built lazily for the declaration-drift pass).
+        self._live_sites: dict[str, set[str]] | None = None
+
+    def check(self, module: Module) -> list[Finding]:
+        out: list[Finding] = []
+        in_request_py = module.rel.endswith("runtime/request.py")
+        parents = common.parent_map(module.tree)
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                if node.value is None or not any(
+                    isinstance(t, ast.Attribute) and t.attr == "status"
+                    for t in targets
+                ):
+                    continue
+                if not _mentions_request_status(node.value):
+                    continue
+                fn = common.enclosing_function(node, parents)
+                if in_request_py and fn is not None and fn.name == "set_status":
+                    continue   # the single registered raw-mutation site
+                out.append(self.finding(
+                    module, node.lineno,
+                    "raw RequestStatus assignment to .status — route it "
+                    "through Request.set_status(dst, edge) so the "
+                    "transition is a declared FSM edge the conformance "
+                    "sanitizer can observe",
+                ))
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if not (isinstance(func, ast.Attribute)
+                        and func.attr == "set_status"):
+                    continue
+                out.extend(self._check_call(module, node))
+        if module.rel.endswith("analysis/protocol.py") and not self._decl_checked:
+            self._decl_checked = True
+            out.extend(self._check_declaration(module))
+        return out
+
+    def _check_call(self, module: Module,
+                    call: ast.Call) -> list[Finding]:
+        out: list[Finding] = []
+        if len(call.args) < 2:
+            out.append(self.finding(
+                module, call.lineno,
+                "set_status call without an edge tag — pass the "
+                "declared FSM edge as the second argument",
+            ))
+            return out
+        owner_node = call.args[1]
+        if not (isinstance(owner_node, ast.Constant)
+                and isinstance(owner_node.value, str)):
+            out.append(self.finding(
+                module, call.lineno,
+                "set_status edge tag must be a string literal (a "
+                "computed tag defeats the FSM declaration)",
+            ))
+            return out
+        owner = owner_node.value
+        if owner not in protocol.edge_owners():
+            out.append(self.finding(
+                module, call.lineno,
+                f"set_status edge {owner!r} is not declared in "
+                "analysis/protocol.py FSM_EDGES — declare the edge "
+                "(owner, src, dst, module) or use an existing one",
+            ))
+            return out
+        dsts = _dst_names(call.args[0])
+        if not dsts and owner not in protocol.DYNAMIC_DST_OWNERS:
+            out.append(self.finding(
+                module, call.lineno,
+                f"set_status({owner!r}) destination is computed at "
+                "runtime but the owner is not in DYNAMIC_DST_OWNERS — "
+                "name the RequestStatus member or declare the owner "
+                "dynamic",
+            ))
+        allowed = protocol.owner_dsts(owner)
+        for d in dsts:
+            if d not in allowed:
+                out.append(self.finding(
+                    module, call.lineno,
+                    f"set_status edge {owner!r} does not declare "
+                    f"destination {d} — the FSM in analysis/protocol.py "
+                    f"allows {sorted(allowed)}",
+                ))
+        if not any(
+            module.rel.endswith(m) for m in protocol.owner_modules(owner)
+        ):
+            out.append(self.finding(
+                module, call.lineno,
+                f"set_status edge {owner!r} is declared for "
+                f"{sorted(protocol.owner_modules(owner))}, not this "
+                "module — move the mutation or extend the declaration",
+            ))
+        return out
+
+    # -- declaration drift (pinned to analysis/protocol.py) -----------------
+
+    def _scan_live_sites(self, pkg_root: str) -> dict[str, set[str]]:
+        live: dict[str, set[str]] = {}
+        for root, dirs, files in os.walk(pkg_root):
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", "analysis")]
+            for fname in files:
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(root, fname)
+                rel = os.path.relpath(path, os.path.dirname(pkg_root))
+                rel = rel.replace(os.sep, "/")
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        tree = ast.parse(f.read())
+                except (OSError, SyntaxError):  # pragma: no cover
+                    continue
+                for node in ast.walk(tree):
+                    if not (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "set_status"
+                        and len(node.args) >= 2
+                        and isinstance(node.args[1], ast.Constant)
+                        and isinstance(node.args[1].value, str)
+                    ):
+                        continue
+                    live.setdefault(rel, set()).add(node.args[1].value)
+        return live
+
+    def _check_declaration(self, module: Module) -> list[Finding]:
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(module.path)))
+        if self._live_sites is None:
+            self._live_sites = self._scan_live_sites(pkg_root)
+        out: list[Finding] = []
+        for e in protocol.FSM_EDGES:
+            for s in (e.src, e.dst):
+                if s not in protocol.STATES:
+                    out.append(self.finding(
+                        module, 1,
+                        f"FSM edge {e.owner!r} names unknown state "
+                        f"{s!r} — STATES must mirror RequestStatus",
+                    ))
+        for owner in protocol.edge_owners():
+            for mod in protocol.owner_modules(owner):
+                if not any(
+                    rel.endswith(mod) and owner in owners
+                    for rel, owners in self._live_sites.items()
+                ):
+                    out.append(self.finding(
+                        module, 1,
+                        f"FSM edge {owner!r} declares a mutation site "
+                        f"in {mod} but no set_status({owner!r}) call "
+                        "lives there — the site moved or was deleted; "
+                        "fix the declaration",
+                    ))
+        return out

@@ -26,9 +26,10 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="parallax-tpu-lint",
         description=(
-            "Concurrency & JAX-hazard analysis for parallax_tpu "
-            "(lock discipline, hot-path syncs, donation reuse, jit "
-            "purity, config gates). See docs/static_analysis.md."
+            "Concurrency, JAX-hazard & protocol analysis for "
+            "parallax_tpu (lock discipline, hot-path syncs, donation "
+            "reuse, jit purity, config gates, status transitions, "
+            "frame drift, metric hygiene). See docs/static_analysis.md."
         ),
     )
     parser.add_argument(
@@ -56,7 +57,23 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--list-checkers", action="store_true",
         help="print the checker catalog and exit")
+    parser.add_argument(
+        "--fsm-table", action="store_true",
+        help="print the declared request-lifecycle FSM as a markdown "
+             "table (the docs/static_analysis.md table is generated "
+             "from this) and exit")
+    parser.add_argument(
+        "--fsm-dot", action="store_true",
+        help="print the declared request-lifecycle FSM as graphviz dot "
+             "and exit")
     args = parser.parse_args(argv)
+
+    if args.fsm_table or args.fsm_dot:
+        from parallax_tpu.analysis import protocol
+
+        print(protocol.fsm_markdown() if args.fsm_table
+              else protocol.fsm_dot())
+        return 0
 
     engine = LintEngine()
     if args.list_checkers:

@@ -1,0 +1,301 @@
+"""Runtime protocol-conformance sanitizer: the FSM, live.
+
+Sibling of the lock-order sanitizer (:mod:`.sanitizer`, PR 9): the
+static ``status-transition``/``frame-drift`` checkers prove every
+mutation *site* is declared; this module checks the *sequences* those
+sites produce at runtime against the declared model in
+:mod:`parallax_tpu.analysis.protocol`. While enabled it records, across
+the whole in-process swarm:
+
+- every ``Request.set_status`` transition per request id, asserting the
+  concrete ``(src, dst)`` pair is a declared edge of the owning
+  subsystem (**FSM conformance**);
+- every token commit, asserting none lands on a finished request
+  (**no-commit-after-finish**);
+- head ownership claims (engine submit / extract / release), asserting
+  at most one head serves a request id at a time — the migration and
+  KV-handoff handshakes transfer ownership, never duplicate it
+  (**single ownership**);
+- router load charges and releases per node (**load-charge balance**):
+  the final per-node imbalance and any over-releases are reported for
+  quiesced-swarm assertions (over-release alone is not a violation —
+  direct-to-head submits legitimately finish without a dispatcher
+  charge, which is why the router clamps at zero);
+- frame traffic per ``(direction, type)``, asserting every
+  non-internal frame type is in the schema registry.
+
+Zero-cost off, same contract as ``make_lock``: every hook's first
+action is one module-global ``enabled`` check; the serving path pays a
+predicated call per *lifecycle event* (not per token dispatched) and
+nothing at all allocates until :func:`enable` runs. Violations are
+recorded, never raised — the report is the verdict, and the pytest
+``--conformance-sanitizer`` flag (plus the chaos harness) asserts it
+clean at teardown. Instrumentation must be inert: streams stay
+bit-identical with the sanitizer on.
+
+Usage::
+
+    from parallax_tpu.analysis import conformance
+    conformance.enable()
+    ... run a swarm workload ...
+    report = conformance.report()
+    assert not report["violations"]
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+from typing import Any, Iterable
+
+from parallax_tpu.analysis import protocol
+
+# Ownership tokens: monotonically unique per holder (never a raw id()
+# — CPython reuses object ids after GC, and a churn test's replacement
+# scheduler landing on a dead one's id would silently defeat the
+# double-ownership check).
+_TOKENS = itertools.count(1)
+
+
+def new_token() -> int:
+    """A process-unique ownership token (Scheduler grabs one at
+    construction and uses it for every own/disown hook)."""
+    return next(_TOKENS)
+
+__all__ = [
+    "ConformanceSanitizer",
+    "new_token",
+    "enable",
+    "disable",
+    "reset",
+    "is_enabled",
+    "report",
+    "violations",
+    "assert_clean",
+    "get_sanitizer",
+    "on_status",
+    "on_commit",
+    "on_own",
+    "on_disown",
+    "on_frame",
+    "on_route_charge",
+    "on_route_release",
+]
+
+
+class ConformanceSanitizer:
+    """Global conformance state. One plain lock guards everything — the
+    sanitizer must never route through its own instrumented paths."""
+
+    def __init__(self, max_reports: int = 200):
+        self._meta = threading.Lock()
+        self.enabled = False
+        self.max_reports = int(max_reports)
+        # owner(edge) -> transition count.
+        self.transitions: dict[str, int] = {}
+        # rid -> (owner_token, label) of the head currently serving it.
+        self.owners: dict[str, tuple[int, str]] = {}
+        self.ownership_events = 0
+        # (direction, frame_type) -> count.
+        self.frames: dict[tuple[str, str], int] = {}
+        # node_id -> outstanding (charged - released) router load.
+        self.route_balance: dict[str, int] = {}
+        # Releases that exceeded their node's charges. NOT a violation:
+        # a head sends request_complete for its path whenever a request
+        # finishes, and a request submitted directly to the head (the
+        # client resume rung, standalone serving) never passed through
+        # the dispatcher's charge — the router clamps at zero for
+        # exactly this reason. Tracked so a quiesced-swarm test can
+        # still assert the dispatcher's own books balance.
+        self.route_over_releases: dict[str, int] = {}
+        self.commits = 0
+        self.violations_list: list[dict[str, Any]] = []
+
+    # -- recording ---------------------------------------------------------
+
+    def _violate(self, kind: str, **info: Any) -> None:
+        if len(self.violations_list) < self.max_reports:
+            self.violations_list.append({"kind": kind, **info})
+
+    def note_status(self, rid: str, src: str, dst: str,
+                    owner: str) -> None:
+        """One transition. ``src`` is read from the Request object
+        itself (the authoritative state — an in-process swarm holds
+        several Request objects per rid: head, downstream mirrors, the
+        frontend's poll mirror; each walks its own declared path)."""
+        with self._meta:
+            self.transitions[owner] = self.transitions.get(owner, 0) + 1
+            if not protocol.is_legal(src, dst, owner):
+                self._violate(
+                    "illegal_edge", rid=rid, owner=owner, src=src,
+                    dst=dst,
+                )
+
+    def note_commit(self, rid: str, status: str) -> None:
+        with self._meta:
+            self.commits += 1
+            if status.startswith("FINISHED"):
+                self._violate(
+                    "commit_after_finish", rid=rid, status=status,
+                )
+
+    def note_own(self, rid: str, token: int, label: str) -> None:
+        with self._meta:
+            self.ownership_events += 1
+            cur = self.owners.get(rid)
+            if cur is not None and cur[0] != token:
+                self._violate(
+                    "double_ownership", rid=rid, holder=cur[1],
+                    claimant=label,
+                )
+            self.owners[rid] = (token, label)
+
+    def note_disown(self, rid: str, token: int) -> None:
+        with self._meta:
+            cur = self.owners.get(rid)
+            if cur is not None and cur[0] == token:
+                del self.owners[rid]
+
+    def note_frame(self, direction: str, frame_type: str) -> None:
+        if protocol.is_internal_frame(frame_type):
+            return
+        with self._meta:
+            key = (direction, frame_type)
+            self.frames[key] = self.frames.get(key, 0) + 1
+            if protocol.schema_for(frame_type) is None:
+                self._violate(
+                    "unknown_frame", direction=direction,
+                    frame_type=frame_type,
+                )
+
+    def note_route(self, node_ids: Iterable[str], delta: int) -> None:
+        with self._meta:
+            for nid in node_ids:
+                bal = self.route_balance.get(nid, 0) + delta
+                if bal < 0:
+                    self.route_over_releases[nid] = (
+                        self.route_over_releases.get(nid, 0) + 1
+                    )
+                    bal = 0
+                self.route_balance[nid] = bal
+
+    # -- reporting ---------------------------------------------------------
+
+    def report(self) -> dict[str, Any]:
+        with self._meta:
+            return {
+                "enabled": self.enabled,
+                "transitions": dict(self.transitions),
+                "commits": self.commits,
+                "ownership_events": self.ownership_events,
+                "live_owners": {
+                    rid: label for rid, (_t, label) in self.owners.items()
+                },
+                "frames": {
+                    f"{d}:{t}": n for (d, t), n in sorted(self.frames.items())
+                },
+                "route_imbalance": {
+                    nid: bal for nid, bal in sorted(
+                        self.route_balance.items()
+                    ) if bal
+                },
+                "route_over_releases": dict(self.route_over_releases),
+                "violations": list(self.violations_list),
+            }
+
+    def reset(self) -> None:
+        with self._meta:
+            self.transitions.clear()
+            self.owners.clear()
+            self.frames.clear()
+            self.route_balance.clear()
+            self.route_over_releases.clear()
+            self.commits = 0
+            self.ownership_events = 0
+            self.violations_list.clear()
+
+
+_SANITIZER = ConformanceSanitizer()
+
+
+def get_sanitizer() -> ConformanceSanitizer:
+    return _SANITIZER
+
+
+def is_enabled() -> bool:
+    return _SANITIZER.enabled
+
+
+def enable() -> ConformanceSanitizer:
+    _SANITIZER.enabled = True
+    return _SANITIZER
+
+
+def disable() -> None:
+    _SANITIZER.enabled = False
+
+
+def reset() -> None:
+    _SANITIZER.reset()
+
+
+def report() -> dict[str, Any]:
+    return _SANITIZER.report()
+
+
+def violations() -> list[dict[str, Any]]:
+    return _SANITIZER.report()["violations"]
+
+
+def assert_clean(context: str = "") -> None:
+    v = violations()
+    assert not v, (
+        f"protocol conformance violations{f' ({context})' if context else ''}: "
+        f"{v}"
+    )
+
+
+# -- hook functions (call sites pay one global load + branch when off) -------
+
+
+def on_status(rid: str, src, dst, owner: str) -> None:
+    """One Request.set_status transition; src/dst are RequestStatus
+    members (recorded by NAME so the model stays import-light)."""
+    if _SANITIZER.enabled:
+        _SANITIZER.note_status(rid, src.name, dst.name, owner)
+
+
+def on_commit(rid: str, status) -> None:
+    if _SANITIZER.enabled:
+        _SANITIZER.note_commit(rid, status.name)
+
+
+def on_own(rid: str, token: int, label: str = "") -> None:
+    if _SANITIZER.enabled:
+        _SANITIZER.note_own(rid, token, label)
+
+
+def on_disown(rid: str, token: int) -> None:
+    if _SANITIZER.enabled:
+        _SANITIZER.note_disown(rid, token)
+
+
+def on_frame(direction: str, frame_type: str) -> None:
+    if _SANITIZER.enabled:
+        _SANITIZER.note_frame(direction, frame_type)
+
+
+def on_route_charge(node_ids: Iterable[str]) -> None:
+    if _SANITIZER.enabled:
+        _SANITIZER.note_route(node_ids, +1)
+
+
+def on_route_release(node_ids: Iterable[str]) -> None:
+    if _SANITIZER.enabled:
+        _SANITIZER.note_route(node_ids, -1)
+
+
+# Environment opt-in, mirroring PARALLAX_LOCK_SANITIZER.
+if os.environ.get("PARALLAX_CONFORMANCE_SANITIZER", "") not in ("", "0"):
+    enable()

@@ -1,0 +1,572 @@
+"""Declared protocol model: request-lifecycle FSM + wire-frame schemas.
+
+The Parallax control surface grew to a dozen cross-node frame types and
+a request state machine mutated from five modules — and until this
+module, both existed only in reviewers' heads. This file is the single
+reviewed declaration of:
+
+- the **request lifecycle FSM** (:data:`FSM_EDGES`): every legal
+  ``RequestStatus`` transition, tagged with the *owning subsystem*
+  (the ``edge`` argument of ``Request.set_status``) and the module
+  allowed to perform it;
+- the **wire-frame schema registry** (:data:`FRAME_SCHEMAS`): for each
+  frame type on the RPC surface, the payload fields senders set and
+  receivers may read.
+
+Three AST checkers (``status-transition``, ``frame-drift``,
+``metric-hygiene``; see ``analysis/checkers/``) hold the code to this
+model statically, and the runtime conformance sanitizer
+(:mod:`parallax_tpu.analysis.conformance`) holds the live swarm to it
+under the chaos/migration/handoff/QoS e2e tests. The FSM table in
+docs/static_analysis.md is generated from here (:func:`fsm_markdown` /
+:func:`fsm_dot` via ``parallax-tpu-lint --fsm-table``); a test asserts
+the committed table matches.
+
+Stdlib-only: states are ``RequestStatus`` member NAMES as strings so
+the jax-free lint pass never imports runtime code
+(tests/test_protocol_conformance.py cross-checks them against the real
+enum).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# -- request lifecycle FSM ---------------------------------------------------
+
+# RequestStatus member names (runtime/request.py). Order is display
+# order for the generated table/dot.
+STATES: tuple[str, ...] = (
+    "PENDING",
+    "PREFILLING",
+    "DECODING",
+    "PREEMPTED",
+    "FINISHED_EOS",
+    "FINISHED_LENGTH",
+    "FINISHED_STOP",
+    "FINISHED_ABORT",
+)
+
+FINISHED_STATES: tuple[str, ...] = tuple(
+    s for s in STATES if s.startswith("FINISHED")
+)
+LIVE_STATES: tuple[str, ...] = tuple(
+    s for s in STATES if not s.startswith("FINISHED")
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class FsmEdge:
+    """One legal (src -> dst) transition of one owning subsystem."""
+
+    owner: str    # the edge tag Request.set_status() is called with
+    src: str      # RequestStatus member name
+    dst: str
+    module: str   # repo-relative module that owns the mutation site
+    doc: str = ""
+
+
+def _edges(owner: str, srcs, dsts, module: str, doc: str) -> list[FsmEdge]:
+    return [
+        FsmEdge(owner, s, d, module, doc) for s in srcs for d in dsts
+    ]
+
+
+FSM_EDGES: tuple[FsmEdge, ...] = tuple(
+    # Admission: wait-queue -> running with KV allocated. A downstream
+    # mirror may already sit in PREFILLING when admitted (its chunks
+    # arrive over the wire before admission), hence the self-edge.
+    _edges("admission", ("PENDING", "PREFILLING"), ("PREFILLING",),
+           "runtime/scheduler.py",
+           "wait-queue request admitted with prompt KV allocated")
+    # Preempt-to-host: a running decode parked to the host KV tier
+    # (memory pressure or QoS shed enforcement).
+    + _edges("preempt", ("DECODING",), ("PREEMPTED",),
+             "runtime/scheduler.py",
+             "running decode swapped out to the host KV tier")
+    # Swap-in resume of a preempted request (pages restored).
+    + _edges("swap-in", ("PREEMPTED",), ("DECODING",),
+             "runtime/scheduler.py",
+             "preempted request's KV image swapped back in")
+    # Prefill completion: the final prompt chunk computed.
+    + _edges("prefill-complete", ("PREFILLING",), ("DECODING",),
+             "runtime/scheduler.py",
+             "last prompt chunk computed; generation begins")
+    # Token commit (Request.commit_token): the single choke point every
+    # sampling path funnels through. A parked (PREEMPTED) row can still
+    # receive the commit of a step that was in flight when it was
+    # swapped out — it may finish, but never silently resumes DECODING.
+    # PENDING is a legal src: Request is a public type and the
+    # standalone library path (unit drivers, client-side bookkeeping)
+    # commits without a scheduler having admitted the request first;
+    # inside an engine, admission always runs before the first commit.
+    + _edges("commit", ("PENDING", "PREFILLING", "DECODING"),
+             ("DECODING", "FINISHED_EOS", "FINISHED_STOP",
+              "FINISHED_LENGTH"),
+             "runtime/request.py",
+             "one generated token committed; may finish on EOS/stop/"
+             "length")
+    + _edges("commit", ("PREEMPTED",),
+             ("FINISHED_EOS", "FINISHED_STOP", "FINISHED_LENGTH"),
+             "runtime/request.py",
+             "in-flight commit lands on a parked row and finishes it")
+    # Abort (Request.abort): timeout, client cancel, kv_oom, shed-free
+    # failure paths. Any live state may abort; finished states must not
+    # (no-commit-after-finish's sibling invariant).
+    + _edges("abort", LIVE_STATES, ("FINISHED_ABORT",),
+             "runtime/request.py",
+             "request aborted (timeout / cancel / kv_oom / release)")
+    # Release broadcast on a downstream-stage mirror: the head finished
+    # the request; the mirror is finalized so its pages donate/free.
+    + _edges("release", LIVE_STATES, ("FINISHED_EOS",),
+             "runtime/engine.py",
+             "finish broadcast finalizes a downstream mirror")
+    # Stop-string early finish (StageEngine.stop_request).
+    + _edges("stop", LIVE_STATES, ("FINISHED_STOP",),
+             "runtime/engine.py",
+             "stop-string match gracefully finishes the request")
+    # Mirror chunk ingestion (StageEngine.submit_intermediate): each
+    # FORWARD packet extends the mirror's prompt; decode mirrors cycle
+    # back through PREFILLING for every new token's "chunk".
+    + _edges("mirror-chunk", ("PENDING", "PREFILLING", "DECODING"),
+             ("PREFILLING",),
+             "runtime/engine.py",
+             "inter-stage packet extends a mirror's prompt")
+    # Migration/handoff restore adopting a raw KV image: the rebuilt
+    # request parks as PREEMPTED and resumes via the ordinary swap-in
+    # path (StageEngine.adopt_kv_image).
+    + _edges("restore-adopt", ("PENDING",), ("PREEMPTED",),
+             "runtime/engine.py",
+             "restored checkpoint adopted a KV image; resumes via "
+             "swap-in")
+    # Client-side finish: the SwarmClient's passive request mirror
+    # adopts the head-reported terminal state from the poll reply.
+    + _edges("client-finish", ("PENDING",), FINISHED_STATES,
+             "backend/run.py",
+             "poll reply finishes the frontend's request mirror")
+)
+
+# Owners whose set_status dst is computed at runtime (e.g.
+# ``RequestStatus(wire_value)``) — the static checker cannot resolve the
+# dst and accepts the call iff the owner is listed here; the runtime
+# sanitizer still checks the concrete (src, dst) pair.
+DYNAMIC_DST_OWNERS: frozenset[str] = frozenset({"client-finish"})
+
+
+# Precomputed lookups: the conformance sanitizer consults these per
+# status transition / frame under one global lock, so they must be
+# O(1) dict probes, not per-call scans over the declarations.
+_PAIRS_BY_OWNER: dict[str, frozenset[tuple[str, str]]] = {}
+_DSTS_BY_OWNER: dict[str, frozenset[str]] = {}
+_MODULES_BY_OWNER: dict[str, frozenset[str]] = {}
+for _e in FSM_EDGES:
+    _PAIRS_BY_OWNER.setdefault(_e.owner, frozenset())
+for _owner in _PAIRS_BY_OWNER:
+    _PAIRS_BY_OWNER[_owner] = frozenset(
+        (e.src, e.dst) for e in FSM_EDGES if e.owner == _owner
+    )
+    _DSTS_BY_OWNER[_owner] = frozenset(
+        e.dst for e in FSM_EDGES if e.owner == _owner
+    )
+    _MODULES_BY_OWNER[_owner] = frozenset(
+        e.module for e in FSM_EDGES if e.owner == _owner
+    )
+
+_EMPTY: frozenset = frozenset()
+
+
+def edge_owners() -> tuple[str, ...]:
+    return tuple(_PAIRS_BY_OWNER)
+
+
+def owner_dsts(owner: str) -> frozenset[str]:
+    return _DSTS_BY_OWNER.get(owner, _EMPTY)
+
+
+def owner_modules(owner: str) -> frozenset[str]:
+    return _MODULES_BY_OWNER.get(owner, _EMPTY)
+
+
+def legal_pairs(owner: str) -> frozenset[tuple[str, str]]:
+    return _PAIRS_BY_OWNER.get(owner, _EMPTY)
+
+
+def is_legal(src: str, dst: str, owner: str) -> bool:
+    return (src, dst) in _PAIRS_BY_OWNER.get(owner, _EMPTY)
+
+
+def fsm_markdown() -> str:
+    """The FSM as a markdown table (embedded in docs/static_analysis.md;
+    regenerate with ``parallax-tpu-lint --fsm-table``)."""
+    lines = [
+        "| owner | transition | module | meaning |",
+        "| --- | --- | --- | --- |",
+    ]
+    for owner in edge_owners():
+        edges = [e for e in FSM_EDGES if e.owner == owner]
+        # Compress src sets sharing a dst set into one row.
+        by_dst: dict[tuple[str, ...], list[str]] = {}
+        for e in edges:
+            dsts = tuple(sorted({x.dst for x in edges if x.src == e.src}))
+            by_dst.setdefault(dsts, [])
+            if e.src not in by_dst[dsts]:
+                by_dst[dsts].append(e.src)
+        for dsts, srcs in by_dst.items():
+            lines.append(
+                f"| `{owner}` | "
+                f"{', '.join(srcs)} → {', '.join(dsts)} | "
+                f"`{edges[0].module}` | {edges[0].doc} |"
+            )
+    return "\n".join(lines)
+
+
+def fsm_dot() -> str:
+    """The FSM as graphviz dot (``parallax-tpu-lint --fsm-dot``)."""
+    out = [
+        "digraph request_fsm {",
+        "  rankdir=LR;",
+        '  node [shape=box, fontname="Helvetica"];',
+    ]
+    for s in STATES:
+        shape = "doubleoctagon" if s.startswith("FINISHED") else "box"
+        out.append(f"  {s} [shape={shape}];")
+    seen: set[tuple[str, str, str]] = set()
+    for e in FSM_EDGES:
+        key = (e.src, e.dst, e.owner)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(f'  {e.src} -> {e.dst} [label="{e.owner}"];')
+    out.append("}")
+    return "\n".join(out)
+
+
+# -- wire-frame schema registry ----------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FrameField:
+    """One payload field: senders set it, receivers may read it.
+    ``required`` fields appear on every frame of the type; optional
+    fields may be absent (receivers read them via ``.get``).
+    ``example`` feeds the registry-driven round-trip test. ``compat``
+    marks a field the receiver accepts for cross-build compatibility
+    with no sender in THIS build — exempt from the frame-drift
+    checker's read-but-never-set rule, loudly declared here instead."""
+
+    name: str
+    required: bool = True
+    doc: str = ""
+    example: object = None
+    compat: bool = False
+
+
+def _f(name: str, example, required: bool = True,
+       doc: str = "", compat: bool = False) -> FrameField:
+    return FrameField(name, required=required, doc=doc, example=example,
+                      compat=compat)
+
+
+@dataclasses.dataclass(frozen=True)
+class FrameSchema:
+    """Schema of one RPC frame type's REQUEST payload. Reply shapes are
+    documented in ``doc`` (replies ride the transport's ``__reply__``
+    envelope and stay receiver-defined)."""
+
+    const: str                      # constant name in p2p/proto.py
+    frame_type: str                 # the wire value
+    doc: str
+    fields: tuple[FrameField, ...] = ()
+    # "map": payload is a dict of the declared fields; "none": payload
+    # is None (capability probes); "opaque": payload bytes belong to an
+    # interop/legacy codec and field checks do not apply.
+    payload: str = "map"
+    # Additional functions (``module-suffix:qualname-tail``) whose
+    # bodies build or consume this frame's payload away from the
+    # send/handler sites — e.g. the KV_TRANSFER frames are built by
+    # kv_handoff.image_to_frames and consumed by HandoffAssembler.feed.
+    # The frame-drift checker folds their field reads/writes in.
+    extra_sites: tuple[str, ...] = ()
+
+
+FRAME_SCHEMAS: tuple[FrameSchema, ...] = (
+    FrameSchema(
+        "FORWARD", "rpc_pp_forward",
+        "Inter-stage activation/token hop. ``reqs`` is a list of "
+        "IntermediateRequest wire maps (REQ_FIELDS below). A raw-bytes "
+        "payload is a reference-protocol protobuf ForwardRequest "
+        "(p2p/interop.py).",
+        (
+            _f("reqs", [
+                {"rid": "r1", "routing_table": [], "context_len": 4,
+                 "num_new_tokens": 1, "token_ids": [7],
+                 "hidden_states": None, "next_token_id": None,
+                 "token_logprob": None, "sampling_params": None,
+                 "is_last_chunk": True, "abort": False, "spec_len": 0,
+                 "spec_accepted": None, "cached_prefix_ids": None,
+                 "lora_id": None, "trace": False, "qos": None},
+            ]),
+        ),
+    ),
+    FrameSchema(
+        "ABORT", "rpc_abort",
+        "Abort broadcast: every stage drops the listed requests. A "
+        "raw-bytes payload is a reference-protocol AbortRequest.",
+        (_f("rids", ["r1", "r2"]),),
+    ),
+    FrameSchema(
+        "RELEASE", "rpc_release",
+        "Finish/abort release broadcast freeing per-stage state; "
+        "``abort`` distinguishes free-outright from donate-to-cache.",
+        (
+            _f("rids", ["r1"]),
+            _f("abort", True, required=False),
+        ),
+    ),
+    FrameSchema(
+        "CHAT_SUBMIT", "chat_submit",
+        "Frontend -> head: submit one request for serving (reply: "
+        "\"ok\"). ``deadline_ms`` is a REMAINING budget re-anchored on "
+        "the head's clock; ``replay_ids`` teacher-force the client "
+        "resume rung.",
+        (
+            _f("rid", "r1"),
+            _f("prompt_ids", [1, 2, 3]),
+            _f("sampling_params", {"max_new_tokens": 4}, required=False),
+            _f("routing_table", ["n0"], required=False),
+            _f("eos_token_ids", [0], required=False),
+            _f("lora_id", None, required=False),
+            _f("qos_class", "interactive", required=False),
+            _f("deadline_ms", 250.0, required=False),
+            _f("tenant", "t0", required=False),
+            _f("replay_ids", [5, 6], required=False),
+            _f("replay_logprobs", [-0.1, -0.2], required=False),
+        ),
+        extra_sites=("backend/run.py:SwarmClient._qos_payload",),
+    ),
+    FrameSchema(
+        "CHAT_POLL", "chat_poll",
+        "Frontend -> head: poll one request's progress (reply: output "
+        "ids/logprobs + finished/migrated markers).",
+        (_f("rid", "r1"),),
+    ),
+    FrameSchema(
+        "CHAT_STOP", "chat_stop",
+        "Frontend -> head: stop-string early finish (text stands).",
+        (_f("rid", "r1"),),
+    ),
+    FrameSchema(
+        "CHAT_READY", "chat_ready",
+        "Frontend -> head readiness probe (reply is the ack).",
+        payload="none",
+    ),
+    FrameSchema(
+        "NODE_JOIN", "node_join",
+        "Worker -> scheduler: join the swarm (blocks until an "
+        "allocation or standby ack).",
+        (
+            _f("node_id", "n0"),
+            _f("hardware", {"chip": "cpu"}),
+            _f("wire_formats", ["float32"], required=False),
+            _f("role", "mixed", required=False),
+        ),
+    ),
+    FrameSchema(
+        "NODE_UPDATE", "node_update",
+        "Worker -> scheduler heartbeat; the reply piggybacks the "
+        "current allocation, refit index, drain orders and resync "
+        "flags.",
+        (
+            _f("node_id", "n0"),
+            _f("cache_digests", None, required=False),
+            _f("is_ready", True, required=False),
+            _f("load", 0, required=False),
+            _f("layer_latency_ms", 1.0, required=False),
+            _f("step_timing", None, required=False),
+            _f("rtt_s", None, required=False, compat=True,
+               doc="accepted for external RTT probes; no in-tree "
+                   "sender — heartbeats must stay ping-free"),
+            _f("cache_stats", None, required=False),
+            _f("kernel", None, required=False),
+            _f("transport", None, required=False),
+            _f("metrics", None, required=False),
+            _f("refit_version", 0, required=False),
+            _f("lora_adapters", [], required=False),
+            _f("busy", False, required=False),
+            _f("goodput", None, required=False),
+            _f("health", None, required=False),
+            _f("events", None, required=False),
+            _f("hardware", None, required=False, compat=True,
+               doc="auto-rejoin escape hatch: a beat from an evicted "
+                   "node may re-enroll it without a full join; no "
+                   "in-tree sender ships it today"),
+        ),
+    ),
+    FrameSchema(
+        "NODE_LEAVE", "node_leave",
+        "Worker -> scheduler: clean departure.",
+        (_f("node_id", "n0"),),
+    ),
+    FrameSchema(
+        "WIRE_CAPS", "wire_caps",
+        "Per-link wire-format negotiation probe (reply: {formats: "
+        "[dtype names]}).",
+        payload="none",
+    ),
+    FrameSchema(
+        "CHECKPOINT", "rpc_checkpoint",
+        "Head -> head: a batch of RequestCheckpoint wire maps "
+        "(CKPT_FIELDS below) migrating parked requests; the reply "
+        "carries per-request accepted/rejected verdicts.",
+        (
+            _f("checkpoints", [
+                {"v": 1, "rid": "r1", "prompt_ids": [1],
+                 "output_ids": [], "output_logprobs": [],
+                 "sampling_params": {}, "eos_token_ids": [],
+                 "lora_id": None, "routing_table": ["n0"],
+                 "age_s": 0.0, "parked_wall": 0.0, "traced": False,
+                 "handoff": False},
+            ]),
+        ),
+    ),
+    FrameSchema(
+        "PEER_DOWN", "peer_down",
+        "Worker -> scheduler: the async sender declared a next-hop "
+        "peer dead; its CacheIndex goes stale and its sweep "
+        "accelerates.",
+        (
+            _f("reporter", "n0", required=False),
+            _f("peer", "n1"),
+            _f("reason", "connection reset", required=False),
+        ),
+    ),
+    FrameSchema(
+        "MIGRATE_TARGET", "migrate_target",
+        "Head -> scheduler: destinations for parked requests, scored "
+        "against surviving heads' CacheIndex mirrors (reply: {targets: "
+        "{rid: {path, head_layers}}}).",
+        (
+            _f("requests", [{"rid": "r1"}]),
+            _f("exclude", ["n1"], required=False),
+        ),
+    ),
+    FrameSchema(
+        "DISAGG_TARGET", "disagg_target",
+        "Prefill head -> scheduler: decode-pool targets for finished "
+        "prompts (same scoring as migrate_target, decode pool only).",
+        (
+            _f("requests", [{"rid": "r1"}]),
+            _f("exclude", [], required=False),
+        ),
+    ),
+    FrameSchema(
+        "KV_TRANSFER", "rpc_kv_transfer",
+        "Prefill head -> decode head, dedicated lane: one layer-chunked "
+        "KV handoff as a begin / layers* / end frame sequence; "
+        "``kind`` selects which of the optional fields apply.",
+        (
+            _f("rid", "r1"),
+            _f("kind", "begin",
+               doc="begin | layers | end"),
+            _f("ckpt", {"v": 1, "rid": "r1"}, required=False,
+               doc="begin: checkpoint sans kv"),
+            _f("header", {"page_size": 16}, required=False,
+               doc="begin: image header"),
+            _f("idx", 0, required=False,
+               doc="layers: first layer index of this chunk"),
+            _f("layers", [], required=False,
+               doc="layers: tensor wire maps"),
+            _f("num_layers", 1, required=False,
+               doc="end: expected layer count"),
+        ),
+        extra_sites=(
+            "runtime/kv_handoff.py:image_to_frames",
+            "runtime/kv_handoff.py:HandoffAssembler.feed",
+        ),
+    ),
+    FrameSchema(
+        "KV_RESULT", "kv_handoff_result",
+        "Decode head -> prefill head: outcome of one KV transfer; the "
+        "source releases parked state only on ok.",
+        (
+            _f("rid", "r1"),
+            _f("ok", True),
+            _f("reason", "", required=False),
+        ),
+    ),
+    FrameSchema(
+        "REQUEST_COMPLETE", "request_complete",
+        "Head -> scheduler: release the router load charge for a "
+        "finished/failed path; optionally folds the admission-time "
+        "prefix-hit into routing accuracy telemetry.",
+        (
+            _f("path", ["n0", "n1"]),
+            _f("rid", "r1", required=False),
+            _f("cached_tokens", 0, required=False),
+        ),
+    ),
+    FrameSchema(
+        "MIGRATION_DONE", "migration_done",
+        "Target head -> scheduler: a migrated request restored here; "
+        "pollers that lost the old head follow via where_is.",
+        (
+            _f("rid", "r1"),
+            _f("head", "n2"),
+        ),
+    ),
+    FrameSchema(
+        "WHERE_IS", "where_is",
+        "Anyone -> scheduler: where does a migrated request live now "
+        "(reply: {head} or {}).",
+        (_f("rid", "r1"),),
+    ),
+)
+
+# The nested IntermediateRequest wire map (FORWARD ``reqs`` entries):
+# ireq_to_wire writes exactly these keys and ireq_from_wire reads
+# exactly these keys — the frame-drift checker holds all three to
+# byte-for-byte agreement.
+REQ_FIELDS: tuple[str, ...] = (
+    "rid", "routing_table", "context_len", "num_new_tokens",
+    "token_ids", "hidden_states", "next_token_id", "token_logprob",
+    "sampling_params", "is_last_chunk", "abort", "spec_len",
+    "spec_accepted", "cached_prefix_ids", "lora_id", "trace", "qos",
+)
+
+# The RequestCheckpoint wire map (CHECKPOINT ``checkpoints`` entries and
+# KV_TRANSFER begin-frame ``ckpt``): checkpoint_to_wire writes these;
+# checkpoint_from_wire may read them (kv/trace_spans are optional).
+CKPT_FIELDS: tuple[str, ...] = (
+    "v", "rid", "prompt_ids", "output_ids", "output_logprobs",
+    "sampling_params", "eos_token_ids", "lora_id", "routing_table",
+    "age_s", "parked_wall", "traced", "handoff", "trace_spans", "kv",
+)
+
+
+# O(1) probe for the sanitizer's per-frame schema-membership check.
+_SCHEMA_BY_TYPE: dict[str, FrameSchema] = {
+    s.frame_type: s for s in FRAME_SCHEMAS
+}
+
+
+def frame_types() -> tuple[str, ...]:
+    return tuple(_SCHEMA_BY_TYPE)
+
+
+def schema_for(frame_type: str) -> FrameSchema | None:
+    return _SCHEMA_BY_TYPE.get(frame_type)
+
+
+def is_internal_frame(frame_type: str) -> bool:
+    """Transport-internal envelope/probe types (``__hello__``,
+    ``__relay__``, ``__ping__``, ...) — outside the schema registry by
+    design."""
+    return frame_type.startswith("__")
+
+
+def example_payload(schema: FrameSchema) -> object:
+    """A representative request payload for one frame type, built from
+    the declared field examples (drives the registry round-trip test)."""
+    if schema.payload == "none":
+        return None
+    return {f.name: f.example for f in schema.fields}

@@ -31,6 +31,7 @@ logger = get_logger(__name__)
 # SimpleTokenizer / load_tokenizer live in utils.tokenizer (shared with
 # frontend-less swarm workers); re-exported here for compatibility.
 from parallax_tpu.utils.tokenizer import SimpleTokenizer, load_tokenizer  # noqa: E402,F401
+from parallax_tpu.obs import names as mnames
 
 
 def _schema_from_body(body: dict) -> str | None:
@@ -269,26 +270,26 @@ class OpenAIFrontend:
 
         reg = get_registry()
         self._m_requests = reg.counter(
-            "parallax_tpu_requests_total",
+            mnames.HTTP_REQUESTS_TOTAL,
             "Generation requests accepted by the HTTP frontend",
         )
         self._m_prompt_tokens = reg.counter(
-            "parallax_tpu_prompt_tokens_total",
+            mnames.HTTP_PROMPT_TOKENS_TOTAL,
             "Prompt tokens across accepted requests",
         )
         self._m_completion_tokens = reg.counter(
-            "parallax_tpu_completion_tokens_total",
+            mnames.HTTP_COMPLETION_TOKENS_TOTAL,
             "Completion tokens generated (counted at request end)",
         )
         self._m_uptime = reg.gauge(
-            "parallax_tpu_uptime_seconds", "Frontend process uptime",
+            mnames.HTTP_UPTIME_SECONDS, "Frontend process uptime",
         )
         self._m_http_ttft = reg.histogram(
-            "parallax_http_ttft_ms",
+            mnames.HTTP_TTFT_MS,
             "Client-observed time to first streamed token, milliseconds",
         )
         self._m_http_e2e = reg.histogram(
-            "parallax_http_e2e_ms",
+            mnames.HTTP_E2E_MS,
             "Client-observed request latency, milliseconds",
         )
         # Strong ref on self: the registry holds only a weakref.

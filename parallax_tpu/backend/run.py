@@ -13,6 +13,7 @@ import time
 
 from parallax_tpu.backend.http_server import OpenAIFrontend, load_tokenizer
 from parallax_tpu.backend.scheduler_service import SchedulerService
+from parallax_tpu.p2p import proto
 from parallax_tpu.p2p.transport import TcpTransport, Transport
 from parallax_tpu.runtime.request import Request, RequestStatus
 from parallax_tpu.scheduling.scheduler import GlobalScheduler
@@ -60,7 +61,7 @@ class SwarmClient:
                 return None
             try:
                 r = self.transport.call(
-                    self.default_head, "chat_ready", None, timeout=5.0
+                    self.default_head, proto.CHAT_READY, None, timeout=5.0
                 )
             except Exception:
                 return None
@@ -84,7 +85,7 @@ class SwarmClient:
         else:
             raise RuntimeError("request has no routing table")
         try:
-            self.transport.call(head, "chat_submit", {
+            self.transport.call(head, proto.CHAT_SUBMIT, {
                 "rid": request.request_id,
                 "prompt_ids": request.prompt_ids,
                 "sampling_params": request.sampling_params.to_dict(),
@@ -137,7 +138,7 @@ class SwarmClient:
             return
         try:
             self.transport.call(
-                head, "chat_stop", {"rid": request_id}, timeout=10.0
+                head, proto.CHAT_STOP, {"rid": request_id}, timeout=10.0
             )
         except Exception as e:
             logger.warning("chat_stop failed for %s: %s", request_id, e)
@@ -210,7 +211,7 @@ class SwarmClient:
             if len(request.output_logprobs) == len(streamed):
                 payload["replay_logprobs"] = list(request.output_logprobs)
         try:
-            self.transport.call(head, "chat_submit", payload, timeout=30.0)
+            self.transport.call(head, proto.CHAT_SUBMIT, payload, timeout=30.0)
         except Exception as e:
             logger.warning("re-routed submit of %s to %s failed: %s",
                            rid, head, e)
@@ -273,7 +274,7 @@ class SwarmClient:
         while True:
             try:
                 r = self.transport.call(
-                    head, "chat_poll", {"rid": rid}, timeout=10.0
+                    head, proto.CHAT_POLL, {"rid": rid}, timeout=10.0
                 )
                 failures = 0
             except Exception as e:
@@ -328,7 +329,8 @@ class SwarmClient:
                 if lps:
                     request.output_logprobs[:] = lps
             if r["finished"]:
-                request.status = RequestStatus(r["status"])
+                request.set_status(RequestStatus(r["status"]),
+                                   "client-finish")
                 ev.set()
                 return
             time.sleep(self.poll_interval_s)

@@ -34,15 +34,15 @@ class SchedulerService:
         transport.register(proto.NODE_JOIN, self._on_join)
         transport.register(proto.NODE_UPDATE, self._on_update)
         transport.register(proto.NODE_LEAVE, self._on_leave)
-        transport.register("request_complete", self._on_request_complete)
+        transport.register(proto.REQUEST_COMPLETE, self._on_request_complete)
         # Live migration + churn robustness (docs/resilience.md).
         transport.register(proto.PEER_DOWN, self._on_peer_down)
         transport.register(proto.MIGRATE_TARGET, self._on_migrate_target)
         # Disaggregated serving (docs/disaggregation.md): decode-pool
         # targets for prefill-head KV handoffs.
         transport.register(proto.DISAGG_TARGET, self._on_disagg_target)
-        transport.register("migration_done", self._on_migration_done)
-        transport.register("where_is", self._on_where_is)
+        transport.register(proto.MIGRATION_DONE, self._on_migration_done)
+        transport.register(proto.WHERE_IS, self._on_where_is)
         transport.register("__ping__", lambda *_: "pong")
 
     def start(self) -> None:

@@ -14,6 +14,7 @@ import argparse
 import asyncio
 import dataclasses
 import json
+import math
 import random
 import time
 
@@ -290,7 +291,7 @@ async def run_benchmark(
     base_url: str,
     specs: list[RequestSpec],
     model: str = "parallax-tpu",
-    request_rate: float = float("inf"),
+    request_rate: float = math.inf,
     burstiness: float = 1.0,
     max_concurrency: int | None = None,
     seed: int = 0,

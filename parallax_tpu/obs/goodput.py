@@ -35,6 +35,7 @@ from __future__ import annotations
 import threading
 import time
 from parallax_tpu.analysis.sanitizer import make_lock
+from parallax_tpu.obs import names as mnames
 
 TOKEN_KINDS = (
     "committed",
@@ -88,7 +89,7 @@ class GoodputLedger:
             registry = get_registry()
         self._registry = registry
         tok = registry.counter(
-            "parallax_goodput_tokens_total",
+            mnames.GOODPUT_TOKENS_TOTAL,
             "Device-step tokens classified by usefulness "
             "(committed / frozen_tail / replayed / preempted_rework / "
             "speculative_rejected)",
@@ -96,7 +97,7 @@ class GoodputLedger:
         )
         self._token_counters = {k: tok.labels(kind=k) for k in TOKEN_KINDS}
         tim = registry.counter(
-            "parallax_goodput_time_seconds_total",
+            mnames.GOODPUT_TIME_SECONDS_TOTAL,
             "Host-visit and device seconds by activity bucket "
             "(serve / compile / swap / migrate / kv_transfer; idle is "
             "derived)",
@@ -104,12 +105,12 @@ class GoodputLedger:
         )
         self._time_counters = {k: tim.labels(bucket=k) for k in TIME_KINDS}
         self._g_fraction = registry.gauge(
-            "parallax_goodput_fraction",
+            mnames.GOODPUT_FRACTION,
             "Committed fraction of all classified device-step tokens "
             "on this node (0..1; 0 before any device work)",
         )
         req = registry.counter(
-            "parallax_requests_finished_total",
+            mnames.REQUESTS_FINISHED_TOTAL,
             "Requests finished on this node's head stage, by outcome",
             labelnames=("outcome",),
         )

@@ -1,0 +1,274 @@
+"""Canonical ``parallax_*`` metric names: the single source of truth.
+
+Every metric the package exposes is declared here ONCE — a constant for
+code to reference plus a HELP entry for exposition and docs. The
+``metric-hygiene`` checker (docs/static_analysis.md) enforces the
+contract mechanically:
+
+- a ``"parallax_..."`` string literal anywhere else in the package is a
+  finding (use the constant — literals drift when a series is renamed);
+- a constant declared here without a HELP entry, or with a duplicate
+  name, is a finding against this file;
+- every declared name must be documented in docs/observability.md and
+  referenced somewhere in the package (stale entries rot loudly).
+
+Import-light by design (stdlib only, no package imports): any module —
+including :mod:`parallax_tpu.obs.registry` itself and the jax-free
+analysis pass — can import it without cycles.
+
+Naming conventions: ``parallax_<subsystem>_<what>[_total|_ms|_bytes|
+_seconds]``. Counters end in ``_total``; latency histograms in ``_ms``;
+gauges name the instantaneous quantity. The ``parallax_tpu_*`` family is
+the HTTP frontend's public surface (name preserved from the first
+release; do not "fix" it to ``parallax_http_*``).
+"""
+
+from __future__ import annotations
+
+# -- engine step / request latency (runtime/engine.py) ----------------------
+TTFT_MS = "parallax_ttft_ms"
+TPOT_MS = "parallax_tpot_ms"
+E2E_MS = "parallax_e2e_ms"
+STEP_HOST_MS = "parallax_step_host_ms"
+STEP_DEVICE_MS = "parallax_step_device_ms"
+STEP_PER_TOKEN_HOST_MS = "parallax_step_per_token_host_ms"
+STEP_BATCH_TOKENS = "parallax_step_batch_tokens"
+QUEUE_DEPTH = "parallax_queue_depth"
+RUNNING_REQUESTS = "parallax_running_requests"
+ATTN_KERNEL_DISPATCH_TOTAL = "parallax_attn_kernel_dispatch_total"
+
+# -- KV memory tier (runtime/engine.py) -------------------------------------
+KV_PAGE_OCCUPANCY = "parallax_kv_page_occupancy"
+KV_PREEMPTIONS_TOTAL = "parallax_kv_preemptions_total"
+KV_RESUMES_TOTAL = "parallax_kv_resumes_total"
+KV_OOM_TOTAL = "parallax_kv_oom_total"
+KV_PAGES_EVICTED_TOTAL = "parallax_kv_pages_evicted_total"
+
+# -- activation transport (p2p/node.py) -------------------------------------
+TRANSPORT_BYTES_OUT_TOTAL = "parallax_transport_bytes_out_total"
+TRANSPORT_BYTES_IN_TOTAL = "parallax_transport_bytes_in_total"
+TRANSPORT_FRAMES_OUT_TOTAL = "parallax_transport_frames_out_total"
+TRANSPORT_DROPS_TOTAL = "parallax_transport_drops_total"
+TRANSPORT_QUEUE_DEPTH = "parallax_transport_queue_depth"
+
+# -- live migration (p2p/node.py) -------------------------------------------
+MIGRATIONS_TOTAL = "parallax_migrations_total"
+MIGRATION_MS = "parallax_migration_ms"
+MIGRATION_CHECKPOINTS_TOTAL = "parallax_migration_checkpoints_total"
+
+# -- disaggregated KV handoff (runtime/kv_handoff.py) ------------------------
+KV_TRANSFER_BYTES_TOTAL = "parallax_kv_transfer_bytes_total"
+KV_TRANSFER_FRAMES_TOTAL = "parallax_kv_transfer_frames_total"
+KV_TRANSFER_MS = "parallax_kv_transfer_ms"
+KV_TRANSFER_FALLBACKS_TOTAL = "parallax_kv_transfer_fallbacks_total"
+KV_HANDOFFS_TOTAL = "parallax_kv_handoffs_total"
+
+# -- cache-aware routing (scheduling/) ---------------------------------------
+ROUTING_DECISIONS_TOTAL = "parallax_routing_decisions_total"
+ROUTING_DISPATCH_TOTAL = "parallax_routing_dispatch_total"
+ROUTING_PREDICTED_CACHED_TOKENS_TOTAL = (
+    "parallax_routing_predicted_cached_tokens_total"
+)
+ROUTING_ACTUAL_CACHED_TOKENS_TOTAL = (
+    "parallax_routing_actual_cached_tokens_total"
+)
+
+# -- multi-tenant QoS (qos/) -------------------------------------------------
+QOS_SHEDDING = "parallax_qos_shedding"
+QOS_BURN_RATE = "parallax_qos_burn_rate"
+QOS_SHED_TRANSITIONS_TOTAL = "parallax_qos_shed_transitions_total"
+QOS_ADMISSIONS_TOTAL = "parallax_qos_admissions_total"
+QOS_SHEDS_TOTAL = "parallax_qos_sheds_total"
+QOS_PARKS_TOTAL = "parallax_qos_parks_total"
+QOS_DEADLINE_SLACK_MS = "parallax_qos_deadline_slack_ms"
+QOS_TTFT_MS = "parallax_qos_ttft_ms"
+QOS_REROLES_TOTAL = "parallax_qos_reroles_total"
+
+# -- goodput ledger / SLO / health plane (obs/) ------------------------------
+GOODPUT_TOKENS_TOTAL = "parallax_goodput_tokens_total"
+GOODPUT_TIME_SECONDS_TOTAL = "parallax_goodput_time_seconds_total"
+GOODPUT_FRACTION = "parallax_goodput_fraction"
+REQUESTS_FINISHED_TOTAL = "parallax_requests_finished_total"
+WATCHDOG_TRANSITIONS_TOTAL = "parallax_watchdog_transitions_total"
+HEALTH_STATE = "parallax_health_state"
+TIMELINE_EVENTS_TOTAL = "parallax_timeline_events_total"
+TIMELINE_GAPS_TOTAL = "parallax_timeline_gaps_total"
+SLO_ATTAINMENT = "parallax_slo_attainment"
+SLO_BURN_RATE = "parallax_slo_burn_rate"
+OBS_MERGE_SKIPPED_TOTAL = "parallax_obs_merge_skipped_total"
+
+# -- misc subsystems ---------------------------------------------------------
+LORA_ADAPTER_EVICTIONS_TOTAL = "parallax_lora_adapter_evictions_total"
+XLA_COMPILES_TOTAL = "parallax_xla_compiles_total"
+
+# -- HTTP frontend (backend/http_server.py) ----------------------------------
+HTTP_REQUESTS_TOTAL = "parallax_tpu_requests_total"
+HTTP_PROMPT_TOKENS_TOTAL = "parallax_tpu_prompt_tokens_total"
+HTTP_COMPLETION_TOKENS_TOTAL = "parallax_tpu_completion_tokens_total"
+HTTP_UPTIME_SECONDS = "parallax_tpu_uptime_seconds"
+HTTP_TTFT_MS = "parallax_http_ttft_ms"
+HTTP_E2E_MS = "parallax_http_e2e_ms"
+
+# HELP text per metric — the exposition string registration sites pass
+# and the table docs/observability.md mirrors. One entry per constant
+# above; the metric-hygiene checker fails the pass on a missing or
+# orphaned entry.
+HELP: dict[str, str] = {
+    TTFT_MS: "Time to first token, milliseconds",
+    TPOT_MS: "Time per output token after the first, milliseconds",
+    E2E_MS: "End-to-end request latency, milliseconds",
+    STEP_HOST_MS: "Host-blocking milliseconds per engine step",
+    STEP_DEVICE_MS: "Device-readback milliseconds per engine step",
+    STEP_PER_TOKEN_HOST_MS: (
+        "Host-blocking milliseconds per committed token (host-visit "
+        "cost amortized over the tokens that visit committed)"
+    ),
+    STEP_BATCH_TOKENS: "New tokens per dispatched engine step",
+    QUEUE_DEPTH: "Requests parked in the stage wait queue",
+    RUNNING_REQUESTS: "Requests admitted into the running set",
+    ATTN_KERNEL_DISPATCH_TOTAL: (
+        "Engine dispatches by attention kernel implementation"
+    ),
+    KV_PAGE_OCCUPANCY: "Fraction of KV pages in use (0..1)",
+    KV_PREEMPTIONS_TOTAL: "Decode-OOM preemptions to the host KV tier",
+    KV_RESUMES_TOTAL: "Preempted requests swapped back in",
+    KV_OOM_TOTAL: "Last-resort kv_oom aborts",
+    KV_PAGES_EVICTED_TOTAL: "Device pages reclaimed from the prefix tree",
+    TRANSPORT_BYTES_OUT_TOTAL: "Wire bytes sent per link",
+    TRANSPORT_BYTES_IN_TOTAL: "Wire bytes received per link",
+    TRANSPORT_FRAMES_OUT_TOTAL: "Frames sent per link",
+    TRANSPORT_DROPS_TOTAL: "Frames dropped per link (overflow / dead peer)",
+    TRANSPORT_QUEUE_DEPTH: "Sender frames currently queued per link",
+    MIGRATIONS_TOTAL: (
+        "Requests restored on this head after a live migration or "
+        "client resume"
+    ),
+    MIGRATION_MS: "Park -> resume latency of migrated requests, ms",
+    MIGRATION_CHECKPOINTS_TOTAL: (
+        "Requests checkpointed away from this head during node-churn "
+        "drains"
+    ),
+    KV_TRANSFER_BYTES_TOTAL: (
+        "KV-page handoff payload bytes over the transfer lane"
+    ),
+    KV_TRANSFER_FRAMES_TOTAL: "KV_TRANSFER frames over the transfer lane",
+    KV_TRANSFER_MS: (
+        "KV handoff transfer latency, ms (out: first frame enqueued -> "
+        "decode-head result; in: begin frame -> image assembled)"
+    ),
+    KV_TRANSFER_FALLBACKS_TOTAL: (
+        "KV handoffs that fell back down the re-prefill ladder, by rung"
+    ),
+    KV_HANDOFFS_TOTAL: (
+        "Prefill->decode handoffs completed, by restore mode"
+    ),
+    ROUTING_DECISIONS_TOTAL: "Routing decisions per strategy reason",
+    ROUTING_DISPATCH_TOTAL: "Requests dispatched per registered pipeline",
+    ROUTING_PREDICTED_CACHED_TOKENS_TOTAL: (
+        "Dispatch-time predicted prefix-cache hit tokens"
+    ),
+    ROUTING_ACTUAL_CACHED_TOKENS_TOTAL: (
+        "Admission-time actual prefix-cache hit tokens (head engine, "
+        "via request_complete)"
+    ),
+    QOS_SHEDDING: (
+        "1 while admission control is shedding sheddable-class work "
+        "(0 otherwise)"
+    ),
+    QOS_BURN_RATE: (
+        "Windowed burn rate of the protected class's TTFT budget "
+        "((1 - attainment) / (1 - target))"
+    ),
+    QOS_SHED_TRANSITIONS_TOTAL: "Admission-control state transitions",
+    QOS_ADMISSIONS_TOTAL: (
+        "Requests admitted into the running set, by QoS class"
+    ),
+    QOS_SHEDS_TOTAL: (
+        "Requests held back in admission by shed state, by QoS class"
+    ),
+    QOS_PARKS_TOTAL: (
+        "Running decodes parked to the host tier by shed enforcement, "
+        "by QoS class"
+    ),
+    QOS_DEADLINE_SLACK_MS: (
+        "Deadline slack at admission, milliseconds (negative slack is "
+        "clamped into the first bucket)"
+    ),
+    QOS_TTFT_MS: (
+        "Time to first token by QoS class, milliseconds (the admission "
+        "controller's burn-rate input)"
+    ),
+    QOS_REROLES_TOTAL: (
+        "Pipelines re-roled between phase pools by the autoscaler"
+    ),
+    GOODPUT_TOKENS_TOTAL: (
+        "Device-step tokens classified by usefulness (committed / "
+        "frozen_tail / replayed / preempted_rework / "
+        "speculative_rejected)"
+    ),
+    GOODPUT_TIME_SECONDS_TOTAL: (
+        "Host-visit and device seconds by activity bucket (serve / "
+        "compile / swap / migrate / kv_transfer; idle is derived)"
+    ),
+    GOODPUT_FRACTION: (
+        "Committed fraction of all classified device-step tokens on "
+        "this node (0..1; 0 before any device work)"
+    ),
+    REQUESTS_FINISHED_TOTAL: (
+        "Requests finished on this node's head stage, by outcome"
+    ),
+    WATCHDOG_TRANSITIONS_TOTAL: (
+        "Health state-machine transitions per component"
+    ),
+    HEALTH_STATE: (
+        "Current component health (0 = ok, 1 = degraded, 2 = stalled)"
+    ),
+    TIMELINE_EVENTS_TOTAL: "Flight events merged into the cluster timeline",
+    TIMELINE_GAPS_TOTAL: (
+        "Flight-event sequence gaps detected while merging node "
+        "timelines (dropped heartbeats / ring overruns)"
+    ),
+    SLO_ATTAINMENT: (
+        "Windowed SLO attainment per objective (fraction of the "
+        "window's requests inside the objective; 1.0 with no traffic)"
+    ),
+    SLO_BURN_RATE: (
+        "Windowed error-budget burn rate per objective "
+        "((1 - attainment) / (1 - target); > 1 burns faster than the "
+        "budget accrues)"
+    ),
+    OBS_MERGE_SKIPPED_TOTAL: (
+        "Histogram children whose bucket lattice could not be merged "
+        "bucket-for-bucket (heterogeneous-build swarm); their "
+        "sum/count still fold in, percentiles degrade loudly"
+    ),
+    LORA_ADAPTER_EVICTIONS_TOTAL: (
+        "Adapters evicted by the hot-load LRU cache"
+    ),
+    XLA_COMPILES_TOTAL: (
+        "XLA backend compilations performed by this process"
+    ),
+    HTTP_REQUESTS_TOTAL: (
+        "Generation requests accepted by the HTTP frontend"
+    ),
+    HTTP_PROMPT_TOKENS_TOTAL: "Prompt tokens across accepted requests",
+    HTTP_COMPLETION_TOKENS_TOTAL: (
+        "Completion tokens generated (counted at request end)"
+    ),
+    HTTP_UPTIME_SECONDS: "Frontend process uptime",
+    HTTP_TTFT_MS: (
+        "Client-observed time to first streamed token, milliseconds"
+    ),
+    HTTP_E2E_MS: "Client-observed request latency, milliseconds",
+}
+
+
+def all_names() -> tuple[str, ...]:
+    """Every declared metric name, sorted (docs/tests iterate this)."""
+    return tuple(sorted(HELP))
+
+
+def help_text(name: str) -> str:
+    """The declared HELP string for a metric name (KeyError on an
+    undeclared name — registration sites must not invent series)."""
+    return HELP[name]

@@ -31,6 +31,7 @@ import math
 import weakref
 
 from parallax_tpu.analysis.sanitizer import make_lock
+from parallax_tpu.obs import names as mnames
 
 # The content type Prometheus scrapers require for text exposition.
 EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -395,7 +396,7 @@ def _count_merge_skipped(n: int = 1) -> None:
     """Bump ``parallax_obs_merge_skipped_total`` (never raises)."""
     try:
         get_registry().counter(
-            "parallax_obs_merge_skipped_total",
+            mnames.OBS_MERGE_SKIPPED_TOTAL,
             "Histogram children whose bucket lattice could not be "
             "merged bucket-for-bucket (heterogeneous-build swarm); "
             "their sum/count still fold in, percentiles degrade loudly",

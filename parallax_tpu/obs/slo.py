@@ -38,12 +38,13 @@ import threading
 import time
 from collections import deque
 from parallax_tpu.analysis.sanitizer import make_lock
+from parallax_tpu.obs import names as mnames
 
 # Spec keys -> registry metric names.
 _LATENCY_METRICS = {
-    "ttft": "parallax_ttft_ms",
-    "tpot": "parallax_tpot_ms",
-    "e2e": "parallax_e2e_ms",
+    "ttft": mnames.TTFT_MS,
+    "tpot": mnames.TPOT_MS,
+    "e2e": mnames.E2E_MS,
 }
 
 _LAT_RE = re.compile(r"^(ttft|tpot|e2e)_p(\d{1,2})_ms$")
@@ -202,13 +203,13 @@ class SLOTracker:
             registry = get_registry()
         lbl = ("objective", "window")
         self._g_attainment = registry.gauge(
-            "parallax_slo_attainment",
+            mnames.SLO_ATTAINMENT,
             "Windowed SLO attainment per objective (fraction of the "
             "window's requests inside the objective; 1.0 with no "
             "traffic)", labelnames=lbl,
         )
         self._g_burn = registry.gauge(
-            "parallax_slo_burn_rate",
+            mnames.SLO_BURN_RATE,
             "Windowed error-budget burn rate per objective "
             "((1 - attainment) / (1 - target); > 1 burns faster than "
             "the budget accrues)", labelnames=lbl,

@@ -33,6 +33,7 @@ import time
 from collections import deque
 
 from parallax_tpu.analysis.sanitizer import make_lock
+from parallax_tpu.obs import names as mnames
 
 
 class ClusterTimeline:
@@ -55,12 +56,12 @@ class ClusterTimeline:
 
             registry = get_registry()
         self._c_gaps = registry.counter(
-            "parallax_timeline_gaps_total",
+            mnames.TIMELINE_GAPS_TOTAL,
             "Flight-event sequence gaps detected while merging node "
             "timelines (dropped heartbeats / ring overruns)",
         )
         self._c_events = registry.counter(
-            "parallax_timeline_events_total",
+            mnames.TIMELINE_EVENTS_TOTAL,
             "Flight events merged into the cluster timeline",
         )
 

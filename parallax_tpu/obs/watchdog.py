@@ -36,6 +36,7 @@ import time
 
 from parallax_tpu.utils import get_logger
 from parallax_tpu.analysis.sanitizer import make_lock
+from parallax_tpu.obs import names as mnames
 
 logger = get_logger(__name__)
 
@@ -82,12 +83,12 @@ class StallWatchdog:
 
             registry = get_registry()
         self._c_transitions = registry.counter(
-            "parallax_watchdog_transitions_total",
+            mnames.WATCHDOG_TRANSITIONS_TOTAL,
             "Health state-machine transitions per component",
             labelnames=("component", "to"),
         )
         self._g_state = registry.gauge(
-            "parallax_health_state",
+            mnames.HEALTH_STATE,
             "Current component health (0 = ok, 1 = degraded, 2 = stalled)",
             labelnames=("component",),
         )

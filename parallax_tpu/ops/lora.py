@@ -24,6 +24,7 @@ from collections import OrderedDict
 import numpy as np
 
 from parallax_tpu.utils import get_logger
+from parallax_tpu.obs import names as mnames
 
 logger = get_logger(__name__)
 
@@ -192,7 +193,7 @@ class AdapterSet:
                 from parallax_tpu.obs.registry import get_registry
 
                 get_registry().counter(
-                    "parallax_lora_adapter_evictions_total",
+                    mnames.LORA_ADAPTER_EVICTIONS_TOTAL,
                     "Adapters evicted by the hot-load LRU cache",
                 ).inc(len(evicted))
             except Exception:  # pragma: no cover - metrics never break

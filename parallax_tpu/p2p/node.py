@@ -41,6 +41,7 @@ from parallax_tpu.runtime.request import (
 from parallax_tpu.utils import get_logger
 from parallax_tpu.utils.hw import detect_hardware
 from parallax_tpu.analysis.sanitizer import make_lock
+from parallax_tpu.obs import names as mnames
 
 logger = get_logger(__name__)
 
@@ -290,10 +291,10 @@ class WorkerNode:
         transport.register(proto.ABORT, self._on_abort)
         transport.register(proto.RELEASE, self._on_release)
         transport.register("__announce__", self._on_announce)
-        transport.register("chat_ready", self._on_chat_ready)
-        transport.register("chat_submit", self._on_chat_submit)
-        transport.register("chat_poll", self._on_chat_poll)
-        transport.register("chat_stop", self._on_chat_stop)
+        transport.register(proto.CHAT_READY, self._on_chat_ready)
+        transport.register(proto.CHAT_SUBMIT, self._on_chat_submit)
+        transport.register(proto.CHAT_POLL, self._on_chat_poll)
+        transport.register(proto.CHAT_STOP, self._on_chat_stop)
         transport.register(proto.WIRE_CAPS, self._on_wire_caps)
         transport.register(proto.CHECKPOINT, self._on_checkpoint)
         transport.register(proto.KV_TRANSFER, self._on_kv_transfer)
@@ -1471,24 +1472,24 @@ class WorkerNode:
         reg = get_registry()
         peers = ("peer",)
         c_bytes_out = reg.counter(
-            "parallax_transport_bytes_out_total",
+            mnames.TRANSPORT_BYTES_OUT_TOTAL,
             "Wire bytes sent per link", labelnames=peers,
         )
         c_bytes_in = reg.counter(
-            "parallax_transport_bytes_in_total",
+            mnames.TRANSPORT_BYTES_IN_TOTAL,
             "Wire bytes received per link", labelnames=peers,
         )
         c_frames_out = reg.counter(
-            "parallax_transport_frames_out_total",
+            mnames.TRANSPORT_FRAMES_OUT_TOTAL,
             "Frames sent per link", labelnames=peers,
         )
         c_drops = reg.counter(
-            "parallax_transport_drops_total",
+            mnames.TRANSPORT_DROPS_TOTAL,
             "Frames dropped per link (overflow / dead peer)",
             labelnames=peers,
         )
         g_depth = reg.gauge(
-            "parallax_transport_queue_depth",
+            mnames.TRANSPORT_QUEUE_DEPTH,
             "Sender frames currently queued per link", labelnames=peers,
         )
         for peer, s in links.items():
@@ -2195,7 +2196,7 @@ class WorkerNode:
                 for rid, path, _w in batch:
                     results[rid] = ("retry", f"target {head} unreachable")
                     self.sender.send(
-                        self.scheduler_peer, "request_complete",
+                        self.scheduler_peer, proto.REQUEST_COMPLETE,
                         {"path": path}, best_effort=True,
                     )
                 logger.warning("%s: checkpoint ship to %s failed: %s",
@@ -2212,7 +2213,7 @@ class WorkerNode:
                         str(rejected.get(rid) or "target rejected"),
                     )
                     self.sender.send(
-                        self.scheduler_peer, "request_complete",
+                        self.scheduler_peer, proto.REQUEST_COMPLETE,
                         {"path": path}, best_effort=True,
                     )
 
@@ -2235,7 +2236,7 @@ class WorkerNode:
                 # request_complete covers the new path when it finishes.
                 if not self.standalone:
                     self.sender.send(
-                        self.scheduler_peer, "request_complete",
+                        self.scheduler_peer, proto.REQUEST_COMPLETE,
                         {"path": e["old_table"] or [self.node_id]},
                         best_effort=True,
                     )
@@ -2260,7 +2261,7 @@ class WorkerNode:
                     from parallax_tpu.obs.registry import get_registry
 
                     get_registry().counter(
-                        "parallax_migration_checkpoints_total",
+                        mnames.MIGRATION_CHECKPOINTS_TOTAL,
                         "Requests checkpointed away from this head "
                         "during node-churn drains",
                     ).inc()
@@ -2400,7 +2401,7 @@ class WorkerNode:
         owner = None
         try:
             reply = self.transport.call(
-                self.scheduler_peer, "where_is", {"rid": rid},
+                self.scheduler_peer, proto.WHERE_IS, {"rid": rid},
                 timeout=5.0,
             )
             owner = (reply or {}).get("head")
@@ -2443,7 +2444,7 @@ class WorkerNode:
             # ownership, so nothing else releases the router charge the
             # scheduler made when it chose this path.
             self.sender.send(
-                self.scheduler_peer, "request_complete",
+                self.scheduler_peer, proto.REQUEST_COMPLETE,
                 {"path": list(path)}, best_effort=True,
             )
         e["awaiting_since"] = None
@@ -2459,7 +2460,7 @@ class WorkerNode:
         locally."""
         if e.pop("pinned_charged", False) and e.get("pinned_path"):
             self.sender.send(
-                self.scheduler_peer, "request_complete",
+                self.scheduler_peer, proto.REQUEST_COMPLETE,
                 {"path": list(e["pinned_path"])}, best_effort=True,
             )
 
@@ -2649,7 +2650,7 @@ class WorkerNode:
                     e["kv_failed"] = True
                     results[rid] = ("retry", "kv lane backpressure")
                     self.sender.send(
-                        self.scheduler_peer, "request_complete",
+                        self.scheduler_peer, proto.REQUEST_COMPLETE,
                         {"path": path}, best_effort=True,
                     )
                     continue
@@ -2670,7 +2671,7 @@ class WorkerNode:
                     results[rid] = ("retry", f"target {head} unreachable")
                     if charged:
                         self.sender.send(
-                            self.scheduler_peer, "request_complete",
+                            self.scheduler_peer, proto.REQUEST_COMPLETE,
                             {"path": path}, best_effort=True,
                         )
                     # A pinned target stays pinned on an UNREACHABLE
@@ -2693,7 +2694,7 @@ class WorkerNode:
                     )
                     if charged:
                         self.sender.send(
-                            self.scheduler_peer, "request_complete",
+                            self.scheduler_peer, proto.REQUEST_COMPLETE,
                             {"path": path}, best_effort=True,
                         )
                     if pinned:
@@ -2819,7 +2820,7 @@ class WorkerNode:
         self._request_events.pop(rid, None)
         if not self.standalone:
             self.sender.send(
-                self.scheduler_peer, "request_complete",
+                self.scheduler_peer, proto.REQUEST_COMPLETE,
                 {"path": e["old_table"] or [self.node_id]},
                 best_effort=True,
             )
@@ -3043,7 +3044,7 @@ class WorkerNode:
             # Handoffs report through the same where_is table: pollers
             # that lose the prefill head still find the decode head.
             self.sender.send(
-                self.scheduler_peer, "migration_done",
+                self.scheduler_peer, proto.MIGRATION_DONE,
                 {"rid": rid, "head": self.node_id}, best_effort=True,
             )
         from parallax_tpu.obs.flight import get_flight
@@ -3097,7 +3098,7 @@ class WorkerNode:
 
             reg = get_registry()
             reg.counter(
-                "parallax_migrations_total",
+                mnames.MIGRATIONS_TOTAL,
                 "Requests restored on this head after a live migration "
                 "or client resume",
                 labelnames=("mode",),
@@ -3105,7 +3106,7 @@ class WorkerNode:
             if parked_wall:
                 park_s = max(0.0, time.time() - parked_wall)
                 reg.histogram(
-                    "parallax_migration_ms",
+                    mnames.MIGRATION_MS,
                     "Park -> resume latency of migrated requests, ms",
                 ).observe(park_s * 1e3)
                 # Goodput time taxonomy: park->resume is churn overhead,
@@ -3217,7 +3218,7 @@ class WorkerNode:
             # Fire-and-forget: the scheduler's round trip happens on its
             # link's sender worker.
             self.sender.send(
-                self.scheduler_peer, "request_complete",
+                self.scheduler_peer, proto.REQUEST_COMPLETE,
                 {
                     "path": req.routing_table or [self.node_id],
                     # Predicted-vs-actual routing telemetry: this head's

@@ -25,13 +25,27 @@ import numpy as np
 from parallax_tpu.runtime.request import IntermediateRequest
 
 # Frame types (the RPC surface, names preserved from the reference).
+# Every type has a FrameSchema in analysis/protocol.py; the frame-drift
+# checker fails the lint pass on a constant with no schema, no sender
+# or no registered handler.
 FORWARD = "rpc_pp_forward"
 ABORT = "rpc_abort"
 RELEASE = "rpc_release"
-CHAT_COMPLETION = "chat_completion"
 NODE_JOIN = "node_join"
 NODE_UPDATE = "node_update"
 NODE_LEAVE = "node_leave"
+# Frontend <-> head request serving (submit / poll / stop / readiness).
+CHAT_SUBMIT = "chat_submit"
+CHAT_POLL = "chat_poll"
+CHAT_STOP = "chat_stop"
+CHAT_READY = "chat_ready"
+# Head -> scheduler: release the router load charge for a path (and
+# fold the admission-time prefix hit into routing accuracy).
+REQUEST_COMPLETE = "request_complete"
+# Target head -> scheduler / anyone -> scheduler: migrated-request
+# forwarding records and lookups.
+MIGRATION_DONE = "migration_done"
+WHERE_IS = "where_is"
 # Per-link wire-format negotiation (sender asks, receiver answers with
 # the dtype names it can decode; see docs/networking.md).
 WIRE_CAPS = "wire_caps"

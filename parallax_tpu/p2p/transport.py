@@ -36,6 +36,7 @@ from typing import Any, Callable
 
 from parallax_tpu.p2p.proto import decode_frame, encode_frame
 from parallax_tpu.utils import get_logger
+from parallax_tpu.analysis import conformance
 from parallax_tpu.analysis.sanitizer import make_lock
 
 logger = get_logger(__name__)
@@ -64,6 +65,10 @@ class Transport:
         self._handlers[method] = handler
 
     def _dispatch(self, method: str, from_peer: str, payload: Any) -> Any:
+        # Conformance sanitizer (analysis/conformance.py): every
+        # delivered frame funnels through here on both backends —
+        # one predicated call when enabled, a global load when not.
+        conformance.on_frame("rx", method)
         handler = self._handlers.get(method)
         if handler is None:
             raise TransportError(
@@ -101,6 +106,7 @@ class LoopbackTransport(Transport):
 
     def call(self, peer: str, method: str, payload: Any,
              timeout: float = 30.0) -> Any:
+        conformance.on_frame("tx", method)
         target = self._registry.get(peer)
         if target is None:
             raise TransportError(f"unknown peer {peer}")
@@ -570,6 +576,7 @@ class TcpTransport(Transport):
 
     def call(self, peer: str, method: str, payload: Any,
              timeout: float = 30.0) -> Any:
+        conformance.on_frame("tx", method)
         fut = asyncio.run_coroutine_threadsafe(
             self._call_async(peer, method, payload, timeout), self._loop
         )
@@ -579,6 +586,7 @@ class TcpTransport(Transport):
         return result
 
     def send(self, peer: str, method: str, payload: Any) -> None:
+        conformance.on_frame("tx", method)
         data = encode_frame(method, payload, msg_id=0)
         fut = asyncio.run_coroutine_threadsafe(
             self._send_async(peer, data), self._loop

@@ -32,12 +32,16 @@ _COLUMN = {
 }
 _ROW = {"o_proj", "down_proj", "out_proj"}
 
+# Shared empty default for the col_vecs parameters (a call in a default
+# argument — even an immutable one — trips the B008 ratchet).
+_NO_COL_VECS: frozenset = frozenset()
+
 
 def _spec_for(
     path: tuple[str, ...],
     leaf_value=None,
     tp: int | None = None,
-    col_vecs: frozenset = frozenset(),
+    col_vecs: frozenset = _NO_COL_VECS,
 ) -> P:
     if len(path) >= 2:
         parent, leaf = path[-2], path[-1]
@@ -86,7 +90,7 @@ def _tree_map_with_path(fn, tree, path=()):
 
 
 def stage_param_specs(
-    params: dict, tp: int | None = None, col_vecs: frozenset = frozenset()
+    params: dict, tp: int | None = None, col_vecs: frozenset = _NO_COL_VECS
 ) -> dict:
     """PartitionSpec pytree matching a stage param tree."""
     return _tree_map_with_path(
@@ -144,7 +148,7 @@ def kv_partition_specs(model) -> list:
 
 
 def shard_params(
-    params: dict, mesh: Mesh, col_vecs: frozenset = frozenset()
+    params: dict, mesh: Mesh, col_vecs: frozenset = _NO_COL_VECS
 ) -> dict:
     """Place a (host/global) param tree onto the mesh with TP sharding."""
     specs = stage_param_specs(params, tp=mesh.shape["tp"], col_vecs=col_vecs)

@@ -36,6 +36,7 @@ from collections import deque
 from parallax_tpu.qos.classes import QoSConfig, RequestClass
 from parallax_tpu.utils import get_logger
 from parallax_tpu.analysis.sanitizer import make_lock
+from parallax_tpu.obs import names as mnames
 
 logger = get_logger(__name__)
 
@@ -84,17 +85,17 @@ class AdmissionController:
 
             registry = get_registry()
         self._g_shedding = registry.gauge(
-            "parallax_qos_shedding",
+            mnames.QOS_SHEDDING,
             "1 while admission control is shedding sheddable-class work "
             "(0 otherwise)", labelnames=("scope",),
         ).labels(scope=scope)
         self._g_burn = registry.gauge(
-            "parallax_qos_burn_rate",
+            mnames.QOS_BURN_RATE,
             "Windowed burn rate of the protected class's TTFT budget "
             "((1 - attainment) / (1 - target))", labelnames=("scope",),
         ).labels(scope=scope)
         self._c_transitions = registry.counter(
-            "parallax_qos_shed_transitions_total",
+            mnames.QOS_SHED_TRANSITIONS_TOTAL,
             "Admission-control state transitions", labelnames=(
                 "scope", "kind",
             ),
@@ -272,27 +273,27 @@ class QoSPolicy:
             registry = get_registry()
         lbl = ("stage", "qos_class")
         self._c_admissions = registry.counter(
-            "parallax_qos_admissions_total",
+            mnames.QOS_ADMISSIONS_TOTAL,
             "Requests admitted into the running set, by QoS class",
             labelnames=lbl,
         )
         self._c_sheds = registry.counter(
-            "parallax_qos_sheds_total",
+            mnames.QOS_SHEDS_TOTAL,
             "Requests held back in admission by shed state, by QoS class",
             labelnames=lbl,
         )
         self._c_parks = registry.counter(
-            "parallax_qos_parks_total",
+            mnames.QOS_PARKS_TOTAL,
             "Running decodes parked to the host tier by shed "
             "enforcement, by QoS class", labelnames=lbl,
         )
         self._h_slack = registry.histogram(
-            "parallax_qos_deadline_slack_ms",
+            mnames.QOS_DEADLINE_SLACK_MS,
             "Deadline slack at admission, milliseconds (negative slack "
             "is clamped into the first bucket)", labelnames=("stage",),
         ).labels(stage=stage_name)
         self._h_ttft = registry.histogram(
-            "parallax_qos_ttft_ms",
+            mnames.QOS_TTFT_MS,
             "Time to first token by QoS class, milliseconds "
             "(the admission controller's burn-rate input)",
             labelnames=("qos_class",),
@@ -309,7 +310,8 @@ class QoSPolicy:
             return dl
         return req.arrival_time + self.class_of(req).deadline_ms / 1e3
 
-    def order_key(self, req, now: float, guard: bool = True):
+    def order_key(self, req, now: float,
+                  guard: bool = True) -> tuple[int, float, int, float]:
         """Earliest-deadline-first; with ``guard`` (the WAIT-QUEUE
         admission path), requests waiting past ``starvation_s`` form a
         head bucket served FCFS so batch work under a permanent

@@ -32,6 +32,7 @@ import time
 
 from parallax_tpu.qos.classes import QoSConfig
 from parallax_tpu.utils import get_logger
+from parallax_tpu.obs import names as mnames
 
 logger = get_logger(__name__)
 
@@ -86,7 +87,7 @@ class PoolAutoscaler:
 
             registry = get_registry()
         self._c_reroles = registry.counter(
-            "parallax_qos_reroles_total",
+            mnames.QOS_REROLES_TOTAL,
             "Pipelines re-roled between phase pools by the autoscaler",
             labelnames=("direction",),
         )

@@ -226,7 +226,9 @@ def parse_qos_spec(spec: str | None) -> QoSConfig | None:
     return cfg
 
 
-def qos_from_http(headers, body: dict, config: QoSConfig):
+def qos_from_http(
+    headers, body: dict, config: QoSConfig
+) -> tuple[str, float, str | None]:
     """Extract ``(qos_class, deadline_ms, tenant)`` from an HTTP
     request: ``x-parallax-qos-class`` / body ``qos_class``,
     ``x-parallax-deadline-ms`` / body ``deadline_ms``,

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from parallax_tpu.analysis import conformance
 from parallax_tpu.config import ModelConfig
 from parallax_tpu.models.base import BatchInputs, StageModel
 from parallax_tpu.ops.sampling import sample_tokens
@@ -32,6 +33,7 @@ from parallax_tpu.runtime.request import (
 )
 from parallax_tpu.runtime.scheduler import BatchPlan, ScheduledSeq, Scheduler
 from parallax_tpu.utils import get_logger
+from parallax_tpu.obs import names as mnames
 
 logger = get_logger(__name__)
 
@@ -887,7 +889,16 @@ class StageEngine:
             self._trace_rate > 0.0 and random.random() < self._trace_rate
         ):
             self._trace_begin(request)
-        return self.scheduler.enqueue(request)
+        accepted = self.scheduler.enqueue(request)
+        if accepted:
+            # Conformance: this head now serves the request — at most
+            # one head per rid at a time (migration/handoff transfer
+            # ownership via extract -> restore, never duplicate it).
+            conformance.on_own(
+                request.request_id, self.scheduler.conf_token,
+                self.scheduler.stage_name,
+            )
+        return accepted
 
     def submit_intermediate(self, ireq: IntermediateRequest) -> None:
         """Non-head stage: accept an inter-stage packet.
@@ -951,7 +962,7 @@ class StageEngine:
                     getattr(req, "mirror_gen_ids", []) + list(new_tokens)
                 )
             req.prompt_ids.extend(new_tokens)
-            req.status = RequestStatus.PREFILLING
+            req.set_status(RequestStatus.PREFILLING, "mirror-chunk")
             req.ready_for_step = True
         if ireq.trace and req.request_id not in self._traced:
             # An upstream stage sampled this request for tracing: record
@@ -993,7 +1004,7 @@ class StageEngine:
                 if abort:
                     req.abort("released")
                 else:
-                    req.status = RequestStatus.FINISHED_EOS
+                    req.set_status(RequestStatus.FINISHED_EOS, "release")
             self.scheduler.release_request(req)
             self._free_state_slot(req)
 
@@ -1030,6 +1041,9 @@ class StageEngine:
         self._traced.discard(request_id)
         self._free_state_slot(req)
         req.device_feed_ready = False
+        # Conformance: extraction ends this head's ownership; the
+        # migration/handoff target re-owns on restore submit.
+        conformance.on_disown(request_id, sched.conf_token)
         return req
 
     def handoff_ready_rids(self) -> list[str]:
@@ -1135,7 +1149,7 @@ class StageEngine:
             tier.free(handles)
             return False
         request.num_computed_tokens = computed
-        request.status = RequestStatus.PREEMPTED
+        request.set_status(RequestStatus.PREEMPTED, "restore-adopt")
         return True
 
     # -- stepping ---------------------------------------------------------
@@ -1192,12 +1206,12 @@ class StageEngine:
         st = ("stage",)
         lbl = {"stage": self._obs_stage}
         self._h_step_host = reg.histogram(
-            "parallax_step_host_ms",
+            mnames.STEP_HOST_MS,
             "Host-blocking milliseconds per engine step",
             labelnames=st,
         ).labels(**lbl)
         self._h_step_device = reg.histogram(
-            "parallax_step_device_ms",
+            mnames.STEP_DEVICE_MS,
             "Device-readback milliseconds per engine step",
             labelnames=st,
         ).labels(**lbl)
@@ -1205,42 +1219,42 @@ class StageEngine:
         # decode a host visit commits K tokens, so the visit series alone
         # would overstate TPOT-relevant host cost by K.
         self._h_step_per_token = reg.histogram(
-            "parallax_step_per_token_host_ms",
+            mnames.STEP_PER_TOKEN_HOST_MS,
             "Host-blocking milliseconds per committed token (host-visit "
             "cost amortized over the tokens that visit committed)",
             labelnames=st,
         ).labels(**lbl)
         self._h_batch_tokens = reg.histogram(
-            "parallax_step_batch_tokens",
+            mnames.STEP_BATCH_TOKENS,
             "New tokens per dispatched engine step",
             buckets=DEFAULT_COUNT_BUCKETS, labelnames=st,
         ).labels(**lbl)
         self._g_queue = reg.gauge(
-            "parallax_queue_depth",
+            mnames.QUEUE_DEPTH,
             "Requests parked in the stage wait queue", labelnames=st,
         ).labels(**lbl)
         self._g_running = reg.gauge(
-            "parallax_running_requests",
+            mnames.RUNNING_REQUESTS,
             "Requests admitted into the running set", labelnames=st,
         ).labels(**lbl)
         self._g_occupancy = reg.gauge(
-            "parallax_kv_page_occupancy",
+            mnames.KV_PAGE_OCCUPANCY,
             "Fraction of KV pages in use (0..1)", labelnames=st,
         ).labels(**lbl)
         self._c_preempt = reg.counter(
-            "parallax_kv_preemptions_total",
+            mnames.KV_PREEMPTIONS_TOTAL,
             "Decode-OOM preemptions to the host KV tier", labelnames=st,
         ).labels(**lbl)
         self._c_resumes = reg.counter(
-            "parallax_kv_resumes_total",
+            mnames.KV_RESUMES_TOTAL,
             "Preempted requests swapped back in", labelnames=st,
         ).labels(**lbl)
         self._c_kv_oom = reg.counter(
-            "parallax_kv_oom_total",
+            mnames.KV_OOM_TOTAL,
             "Last-resort kv_oom aborts", labelnames=st,
         ).labels(**lbl)
         self._c_evicted = reg.counter(
-            "parallax_kv_pages_evicted_total",
+            mnames.KV_PAGES_EVICTED_TOTAL,
             "Device pages reclaimed from the prefix tree", labelnames=st,
         ).labels(**lbl)
         # Kernel-choice observability (docs/kernels.md): which attention
@@ -1250,7 +1264,7 @@ class StageEngine:
         # An operator watching this sees at a glance when a model
         # silently fell back to the split or XLA path.
         self._c_kernel = reg.counter(
-            "parallax_attn_kernel_dispatch_total",
+            mnames.ATTN_KERNEL_DISPATCH_TOTAL,
             "Engine dispatches by attention kernel implementation",
             labelnames=("stage", "impl", "path"),
         )
@@ -1263,16 +1277,16 @@ class StageEngine:
             self._kernel_counts: dict[tuple[str, str], int] = {}
         if model.is_first:
             self._h_ttft = reg.histogram(
-                "parallax_ttft_ms",
+                mnames.TTFT_MS,
                 "Time to first token, milliseconds", labelnames=st,
             ).labels(**lbl)
             self._h_tpot = reg.histogram(
-                "parallax_tpot_ms",
+                mnames.TPOT_MS,
                 "Time per output token after the first, milliseconds",
                 labelnames=st,
             ).labels(**lbl)
             self._h_e2e = reg.histogram(
-                "parallax_e2e_ms",
+                mnames.E2E_MS,
                 "End-to-end request latency, milliseconds", labelnames=st,
             ).labels(**lbl)
         # The registry holds only a weakref to this bound method; the
@@ -3191,7 +3205,7 @@ class StageEngine:
             self.scheduler.wait_queue.get(request_id)
         )
         if req is not None and not req.status.is_finished:
-            req.status = RequestStatus.FINISHED_STOP
+            req.set_status(RequestStatus.FINISHED_STOP, "stop")
 
     def _commit(self, req: Request, token: int,
                 logprob: float | None = None) -> None:

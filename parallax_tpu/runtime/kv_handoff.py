@@ -48,6 +48,7 @@ from parallax_tpu.runtime.checkpoint import (
 )
 from parallax_tpu.utils import get_logger
 from parallax_tpu.analysis.sanitizer import make_lock
+from parallax_tpu.obs import names as mnames
 
 logger = get_logger(__name__)
 
@@ -301,18 +302,18 @@ def record_transfer(
 
         reg = get_registry()
         reg.counter(
-            "parallax_kv_transfer_bytes_total",
+            mnames.KV_TRANSFER_BYTES_TOTAL,
             "KV-page handoff payload bytes over the transfer lane",
             labelnames=("direction",),
         ).labels(direction=direction).inc(nbytes)
         reg.counter(
-            "parallax_kv_transfer_frames_total",
+            mnames.KV_TRANSFER_FRAMES_TOTAL,
             "KV_TRANSFER frames over the transfer lane",
             labelnames=("direction",),
         ).labels(direction=direction).inc(frames)
         if ms is not None:
             reg.histogram(
-                "parallax_kv_transfer_ms",
+                mnames.KV_TRANSFER_MS,
                 "KV handoff transfer latency, ms (out: first frame "
                 "enqueued -> decode-head result; in: begin frame -> "
                 "image assembled)",
@@ -335,7 +336,7 @@ def record_fallback(reason: str) -> None:
         from parallax_tpu.obs.registry import get_registry
 
         get_registry().counter(
-            "parallax_kv_transfer_fallbacks_total",
+            mnames.KV_TRANSFER_FALLBACKS_TOTAL,
             "KV handoffs that fell back down the re-prefill ladder, "
             "by rung",
             labelnames=("reason",),
@@ -354,7 +355,7 @@ def record_handoff(mode: str) -> None:
         from parallax_tpu.obs.registry import get_registry
 
         get_registry().counter(
-            "parallax_kv_handoffs_total",
+            mnames.KV_HANDOFFS_TOTAL,
             "Prefill->decode handoffs completed, by restore mode",
             labelnames=("mode",),
         ).labels(mode=mode).inc()

@@ -22,6 +22,8 @@ from typing import Any
 
 import numpy as np
 
+from parallax_tpu.analysis import conformance
+
 
 class RequestStatus(enum.Enum):
     """Lifecycle states (reference: request.py:71-80)."""
@@ -175,6 +177,17 @@ class Request:
     replay_ids: list[int] = dataclasses.field(default_factory=list)
     replay_logprobs: list[float] = dataclasses.field(default_factory=list)
 
+    def set_status(self, dst: RequestStatus, edge: str) -> None:
+        """The single status-mutation funnel. ``edge`` names the owning
+        FSM edge declared in ``analysis/protocol.py`` — the
+        status-transition checker validates every call site against the
+        declaration, and the conformance sanitizer (when enabled)
+        checks the concrete (src, dst) pair at runtime. Zero-cost when
+        the sanitizer is off: one global load + branch."""
+        prev = self.status
+        self.status = dst
+        conformance.on_status(self.request_id, prev, dst, edge)
+
     @property
     def num_prompt_tokens(self) -> int:
         return len(self.prompt_ids)
@@ -232,6 +245,7 @@ class Request:
 
         Reference: ``InitialRequest.commit_new_token`` (request.py:230-249).
         """
+        conformance.on_commit(self.request_id, self.status)
         if self.first_token_time is None:
             self.first_token_time = time.monotonic()
         if self.replay_ids:
@@ -250,23 +264,24 @@ class Request:
             if not sp.ignore_eos and (
                 token_id in self.eos_token_ids or token_id in sp.stop_token_ids
             ):
-                self.status = (
+                self.set_status(
                     RequestStatus.FINISHED_STOP
                     if token_id in sp.stop_token_ids
-                    else RequestStatus.FINISHED_EOS
+                    else RequestStatus.FINISHED_EOS,
+                    "commit",
                 )
                 return
         if self.num_generated >= sp.max_new_tokens:
-            self.status = RequestStatus.FINISHED_LENGTH
+            self.set_status(RequestStatus.FINISHED_LENGTH, "commit")
             return
         if self.status is not RequestStatus.PREEMPTED:
             # A preempted request can still receive the commit of a step
             # that was in flight when it was swapped out; the token is
             # recorded but the request stays parked until swap-in.
-            self.status = RequestStatus.DECODING
+            self.set_status(RequestStatus.DECODING, "commit")
 
     def abort(self, reason: str = "") -> None:
-        self.status = RequestStatus.FINISHED_ABORT
+        self.set_status(RequestStatus.FINISHED_ABORT, "abort")
         self.abort_reason = reason or None
 
 

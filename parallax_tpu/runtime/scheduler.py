@@ -13,6 +13,7 @@ import dataclasses
 import time
 from collections import OrderedDict
 
+from parallax_tpu.analysis import conformance
 from parallax_tpu.runtime.cache_manager import CacheManager
 from parallax_tpu.runtime.request import Request, RequestStatus
 from parallax_tpu.utils import get_logger
@@ -84,6 +85,10 @@ class Scheduler:
         # Observability: the stage label this scheduler's flight-recorder
         # events and trace spans carry (preempt / swap-in / kv_oom).
         self.stage_name = stage_name
+        # Conformance ownership token (analysis/conformance.py): unique
+        # per scheduler for the sanitizer's one-head-per-rid check —
+        # never id(self), which CPython reuses after GC.
+        self.conf_token = conformance.new_token()
         self.cache = cache_manager
         self.max_batch_size = max_batch_size
         self.max_num_tokens_per_batch = max_num_tokens_per_batch
@@ -175,7 +180,7 @@ class Scheduler:
                 return False
             del self.wait_queue[rid]
             self.admitted_total += 1
-            req.status = RequestStatus.DECODING
+            req.set_status(RequestStatus.DECODING, "swap-in")
             self.running[rid] = req
             self._obs_event("swap_in", req, dur=time.perf_counter() - t0)
             return True
@@ -203,7 +208,7 @@ class Scheduler:
                 self.running[rid] = req   # collected + released next step
                 return True
             req.num_computed_tokens = head_cached
-        req.status = RequestStatus.PREFILLING
+        req.set_status(RequestStatus.PREFILLING, "admission")
         self.running[rid] = req
         return True
 
@@ -582,7 +587,8 @@ class Scheduler:
             if req.status is RequestStatus.PREFILLING:
                 req.num_computed_tokens += s.num_new_tokens
                 if req.is_prefill_done:
-                    req.status = RequestStatus.DECODING
+                    req.set_status(RequestStatus.DECODING,
+                                   "prefill-complete")
                     req.ready_for_step = False
             elif req.status is RequestStatus.DECODING:
                 # The fed token's KV was written this step, so the computed
@@ -615,6 +621,7 @@ class Scheduler:
         self.running.pop(request.request_id, None)
         self.wait_queue.pop(request.request_id, None)
         self.cache.release(request)
+        conformance.on_disown(request.request_id, self.conf_token)
 
     def _abort_on_oom(self, req: Request) -> None:
         logger.warning("decode OOM: aborting %s", req.request_id)
@@ -733,7 +740,7 @@ class Scheduler:
         ``ready_for_step`` is preserved: a parked row with a commit still
         in flight is re-armed by ``on_token_committed`` when it lands."""
         self.running.pop(req.request_id, None)
-        req.status = RequestStatus.PREEMPTED
+        req.set_status(RequestStatus.PREEMPTED, "preempt")
         req.device_feed_ready = False
         self.wait_queue[req.request_id] = req
         self.wait_queue.move_to_end(req.request_id, last=False)
@@ -745,6 +752,12 @@ class Scheduler:
         now = time.monotonic()
         timed_out = []
         for req in list(self.running.values()) + list(self.wait_queue.values()):
+            # Already-finished rows awaiting collection must not be
+            # re-aborted: FINISHED_* is terminal in the declared FSM,
+            # and a timeout "abort" here would overwrite the real
+            # outcome of a request that finished on time.
+            if req.status.is_finished:
+                continue
             if now - req.arrival_time > self.request_timeout_s:
                 req.abort("timeout")
                 timed_out.append(req)

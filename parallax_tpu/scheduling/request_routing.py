@@ -10,8 +10,10 @@ from __future__ import annotations
 
 import dataclasses
 
+from parallax_tpu.analysis import conformance
 from parallax_tpu.scheduling.node import Node
 from parallax_tpu.scheduling.node_management import NodeManager, Pipeline
+from parallax_tpu.obs import names as mnames
 
 
 @dataclasses.dataclass
@@ -96,12 +98,14 @@ class RoutingStrategy:
     def on_dispatch(self, path: list[Node]) -> None:
         for n in path:
             n.load += 1
+        conformance.on_route_charge(n.node_id for n in path)
 
     def on_complete(self, path_ids: list[str]) -> None:
         for nid in path_ids:
             n = self.manager.get(nid)
             if n is not None:
                 n.load = max(0, n.load - 1)
+        conformance.on_route_release(path_ids)
 
     # -- decision telemetry ------------------------------------------------
 
@@ -113,7 +117,7 @@ class RoutingStrategy:
             from parallax_tpu.obs.registry import get_registry
 
             get_registry().counter(
-                "parallax_routing_decisions_total",
+                mnames.ROUTING_DECISIONS_TOTAL,
                 "Routing decisions per strategy reason",
                 labelnames=("reason",),
             ).labels(reason=reason).inc()
@@ -128,7 +132,7 @@ class RoutingStrategy:
             from parallax_tpu.obs.registry import get_registry
 
             get_registry().counter(
-                "parallax_routing_dispatch_total",
+                mnames.ROUTING_DISPATCH_TOTAL,
                 "Requests dispatched per registered pipeline",
                 labelnames=("pipeline",),
             ).labels(pipeline=str(pipeline_id)).inc()

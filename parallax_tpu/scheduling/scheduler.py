@@ -33,6 +33,7 @@ from parallax_tpu.scheduling.request_routing import (
 from parallax_tpu.utils import get_logger
 from parallax_tpu.utils.hw import HardwareInfo
 from parallax_tpu.analysis.sanitizer import make_lock
+from parallax_tpu.obs import names as mnames
 
 logger = get_logger(__name__)
 
@@ -657,7 +658,7 @@ class GlobalScheduler:
         under, total = 0.0, 0
         for p in self.manager.pipelines:
             for n in p.nodes:
-                children = (n.metrics or {}).get("parallax_qos_ttft_ms")
+                children = (n.metrics or {}).get(mnames.QOS_TTFT_MS)
                 if not isinstance(children, dict):
                     continue
                 for label, snap in children.items():
@@ -690,7 +691,7 @@ class GlobalScheduler:
         self.timeline.record(
             "node_leave", node=node_id, displaced=len(displaced),
         )
-        active = [n for n in self.manager.nodes(NodeState.ACTIVE)]
+        active = list(self.manager.nodes(NodeState.ACTIVE))
         if not self.manager.pipelines or self.allocator.should_global_rebalance(
             active
         ):
@@ -813,11 +814,11 @@ class GlobalScheduler:
 
             reg = get_registry()
             reg.counter(
-                "parallax_routing_predicted_cached_tokens_total",
+                mnames.ROUTING_PREDICTED_CACHED_TOKENS_TOTAL,
                 "Dispatch-time predicted prefix-cache hit tokens",
             ).inc(predicted)
             reg.counter(
-                "parallax_routing_actual_cached_tokens_total",
+                mnames.ROUTING_ACTUAL_CACHED_TOKENS_TOTAL,
                 "Admission-time actual prefix-cache hit tokens "
                 "(head engine, via request_complete)",
             ).inc(int(cached_tokens))

@@ -33,6 +33,7 @@ from typing import Any, Callable
 
 from parallax_tpu.p2p.transport import Transport, TransportError
 from parallax_tpu.utils import get_logger
+from parallax_tpu.analysis import conformance as _conformance
 from parallax_tpu.analysis import sanitizer
 from parallax_tpu.analysis.sanitizer import make_lock
 
@@ -82,14 +83,22 @@ class ChaosController:
     Constructing a controller also turns on the lock-order sanitizer
     (docs/static_analysis.md): every ``make_lock`` lock created after
     this point is instrumented, so a chaos run doubles as a lockdep
-    pass — read the verdict with :meth:`lock_report`. Pass
-    ``lock_sanitizer=False`` when the surrounding process measures
+    pass — read the verdict with :meth:`lock_report`. It likewise turns
+    on the protocol-conformance sanitizer
+    (analysis/conformance.py): every status transition, head-ownership
+    claim and wire frame under the chaos run is checked against the
+    declared FSM/schema model — read the verdict with
+    :meth:`conformance_report`. Pass ``lock_sanitizer=False`` /
+    ``conformance=False`` when the surrounding process measures
     performance (the bench churn probe does).
     """
 
-    def __init__(self, seed: int = 0, lock_sanitizer: bool = True):
+    def __init__(self, seed: int = 0, lock_sanitizer: bool = True,
+                 conformance: bool = True):
         if lock_sanitizer:
             sanitizer.enable()
+        if conformance:
+            _conformance.enable()
         self.rng = random.Random(seed)
         self.rules: list[ChaosRule] = []
         # Peers whose transports are severed (crashed) or paused
@@ -107,6 +116,13 @@ class ChaosController:
         graph edges, cycles (potential deadlocks), and held-too-long
         stalls observed since the last ``sanitizer.reset()``."""
         return sanitizer.report()
+
+    @staticmethod
+    def conformance_report() -> dict[str, Any]:
+        """The protocol-conformance sanitizer's verdict: FSM
+        transitions, ownership events, frame traffic and violations
+        observed since the last ``conformance.reset()``."""
+        return _conformance.report()
 
     # -- frame faults -----------------------------------------------------
 

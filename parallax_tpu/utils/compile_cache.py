@@ -22,6 +22,7 @@ import threading
 
 from parallax_tpu.utils import get_logger
 from parallax_tpu.analysis.sanitizer import make_lock
+from parallax_tpu.obs import names as mnames
 
 logger = get_logger(__name__)
 
@@ -95,7 +96,7 @@ def register_compile_counter() -> None:
         from parallax_tpu.obs.registry import get_registry
 
         counter = get_registry().counter(
-            "parallax_xla_compiles_total",
+            mnames.XLA_COMPILES_TOTAL,
             "XLA backend compilations performed by this process",
         ).labels()
         goodput = get_goodput()

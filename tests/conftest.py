@@ -23,6 +23,7 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402  (import after env setup)
 import pytest  # noqa: E402
 
+from parallax_tpu.analysis import conformance  # noqa: E402
 from parallax_tpu.analysis import sanitizer  # noqa: E402
 
 
@@ -35,6 +36,17 @@ def pytest_addoption(parser):
              "end of the run (docs/static_analysis.md). Equivalent to "
              "PARALLAX_LOCK_SANITIZER=1.",
     )
+    parser.addoption(
+        "--conformance-sanitizer", action="store_true", default=False,
+        help="enable the protocol-conformance sanitizer for the whole "
+             "session: every Request status transition, head-ownership "
+             "claim, router load charge and wire frame is checked "
+             "against the declared FSM/schema model in "
+             "analysis/protocol.py, and the swarm e2e tests "
+             "(chaos/migration/handoff/QoS) assert a clean report per "
+             "test (docs/static_analysis.md). Equivalent to "
+             "PARALLAX_CONFORMANCE_SANITIZER=1.",
+    )
 
 
 def pytest_configure(config):
@@ -43,6 +55,8 @@ def pytest_configure(config):
     # after it). The chaos harness also enables it per-controller.
     if config.getoption("--lock-sanitizer"):
         sanitizer.enable()
+    if config.getoption("--conformance-sanitizer"):
+        conformance.enable()
 
 
 @pytest.fixture(autouse=True)
@@ -56,7 +70,40 @@ def _scoped_lock_sanitizer(request):
         sanitizer.disable()
 
 
+# Swarm e2e modules whose tests must leave a clean conformance report
+# when the session opted in with --conformance-sanitizer (the CI
+# chaos/migration/handoff/QoS smoke steps run exactly these).
+CONFORMANCE_E2E_MODULES = {
+    "test_churn_migration", "test_disaggregation", "test_qos",
+    "test_swarm_e2e", "test_swarm_scale",
+}
+
+
+@pytest.fixture(autouse=True)
+def _scoped_conformance_sanitizer(request):
+    """Per-test conformance verdict + containment. With the flag on,
+    each e2e swarm test starts from a clean slate and must end with
+    zero violations; without it, ChaosController's process-global
+    enable is switched back off after each test (mirroring the lock
+    sanitizer's containment)."""
+    opted = request.config.getoption("--conformance-sanitizer")
+    mod = request.module.__name__.rsplit(".", 1)[-1]
+    guard = opted and mod in CONFORMANCE_E2E_MODULES
+    if guard:
+        conformance.reset()
+    yield
+    if guard:
+        rep = conformance.report()
+        assert not rep["violations"], (
+            f"protocol conformance violations in {mod}: "
+            f"{rep['violations']}"
+        )
+    if not opted:
+        conformance.disable()
+
+
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    _conformance_summary(terminalreporter, config)
     san = sanitizer.get_sanitizer()
     rep = san.report()
     # Print when the user opted in — or unconditionally when a cycle
@@ -76,6 +123,31 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
     for cyc in rep["cycles"]:
         terminalreporter.write_line(
             "POTENTIAL DEADLOCK: " + " -> ".join(cyc), red=True)
+
+
+def _conformance_summary(terminalreporter, config):
+    rep = conformance.report()
+    total = sum(rep["transitions"].values())
+    # Violations print unconditionally — they must never scroll away,
+    # even from a run that recorded no status transitions (frame-only
+    # or ownership-only violations). Otherwise print only when the
+    # user opted in and there was activity to summarize.
+    if not rep["violations"] and not (
+        config.getoption("--conformance-sanitizer") and total
+    ):
+        return
+    terminalreporter.section("protocol-conformance sanitizer")
+    terminalreporter.write_line(
+        f"{total} status transitions over "
+        f"{len(rep['transitions'])} FSM edge owner(s), "
+        f"{rep['commits']} commits, "
+        f"{rep['ownership_events']} ownership claims, "
+        f"{sum(rep['frames'].values())} frames, "
+        f"{len(rep['violations'])} violation(s)"
+    )
+    for v in rep["violations"]:
+        terminalreporter.write_line(
+            f"PROTOCOL VIOLATION: {v}", red=True)
 
 # Jit-heavy / e2e suites (each >1 min on CPU). The fast core —
 # scheduling, cache bookkeeping, transport, interop, constrained,

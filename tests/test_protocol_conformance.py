@@ -1,0 +1,357 @@
+"""Protocol & lifecycle conformance suite tests.
+
+Covers the declared model (analysis/protocol.py) against the real
+runtime types, the registry-driven wire round-trip for EVERY frame type
+(build -> wire -> parse -> equal, plus truncated/corrupt rejection),
+the runtime conformance sanitizer (FSM edges, no-commit-after-finish,
+single ownership, route-charge balance, frame schema membership, and
+the zero-cost-off contract), and the generated FSM docs artifacts.
+"""
+
+import os
+
+import msgpack
+import pytest
+
+import parallax_tpu
+from parallax_tpu.analysis import conformance, protocol
+from parallax_tpu.p2p import proto
+from parallax_tpu.runtime.checkpoint import (
+    CheckpointError,
+    checkpoint_from_wire,
+)
+from parallax_tpu.runtime.request import (
+    IntermediateRequest,
+    Request,
+    RequestStatus,
+    SamplingParams,
+)
+
+PKG = os.path.dirname(parallax_tpu.__file__)
+REPO = os.path.dirname(PKG)
+
+
+# ---------------------------------------------------------------------------
+# the declared model vs the runtime types
+
+
+class TestDeclaredModel:
+    def test_states_mirror_request_status(self):
+        assert set(protocol.STATES) == {s.name for s in RequestStatus}
+        assert set(protocol.FINISHED_STATES) == {
+            s.name for s in RequestStatus if s.is_finished
+        }
+
+    def test_every_edge_names_real_states(self):
+        for e in protocol.FSM_EDGES:
+            assert e.src in protocol.STATES, e
+            assert e.dst in protocol.STATES, e
+            assert e.module and e.owner and e.doc, e
+
+    def test_finished_states_are_terminal(self):
+        """No declared edge leaves a FINISHED_* state — terminality is
+        a model invariant, not a convention."""
+        for e in protocol.FSM_EDGES:
+            assert not e.src.startswith("FINISHED"), e
+
+    def test_dynamic_owners_are_declared_edges(self):
+        owners = set(protocol.edge_owners())
+        assert protocol.DYNAMIC_DST_OWNERS <= owners
+
+    def test_frame_schema_constants_match_proto(self):
+        """Every schema's ``const`` names a real proto.py constant with
+        the declared wire value — the registry can never drift from the
+        constants it documents."""
+        for schema in protocol.FRAME_SCHEMAS:
+            assert hasattr(proto, schema.const), schema.const
+            assert getattr(proto, schema.const) == schema.frame_type
+
+    def test_req_fields_match_ireq_wire(self):
+        ireq = IntermediateRequest(
+            request_id="r1", routing_table=["n0"], context_len=3,
+            num_new_tokens=1, token_ids=[5],
+        )
+        wire = proto.ireq_to_wire(ireq)
+        assert set(wire) == set(protocol.REQ_FIELDS)
+        back = proto.ireq_from_wire(wire)
+        assert back.request_id == "r1"
+        assert back.token_ids == [5]
+
+
+# ---------------------------------------------------------------------------
+# registry-driven wire round-trip: every frame type
+
+
+class TestFrameRoundTrip:
+    @pytest.mark.parametrize(
+        "schema", protocol.FRAME_SCHEMAS,
+        ids=[s.frame_type for s in protocol.FRAME_SCHEMAS])
+    def test_build_wire_parse_equal(self, schema):
+        payload = protocol.example_payload(schema)
+        data = proto.encode_frame(schema.frame_type, payload, msg_id=7)
+        frame = proto.decode_frame(data)
+        assert frame["t"] == schema.frame_type
+        assert frame["id"] == 7
+        assert frame["p"] == payload
+
+    @pytest.mark.parametrize(
+        "schema", protocol.FRAME_SCHEMAS,
+        ids=[s.frame_type for s in protocol.FRAME_SCHEMAS])
+    def test_truncated_frame_rejected(self, schema):
+        data = proto.encode_frame(
+            schema.frame_type, protocol.example_payload(schema))
+        for cut in (1, len(data) // 2, len(data) - 1):
+            with pytest.raises(Exception):
+                proto.decode_frame(data[:cut])
+
+    def test_corrupt_frame_rejected(self):
+        data = proto.encode_frame(
+            proto.FORWARD,
+            protocol.example_payload(protocol.schema_for(proto.FORWARD)),
+        )
+        corrupt = b"\xc1" + data[1:]   # 0xc1 is never-used in msgpack
+        with pytest.raises(Exception):
+            msgpack.unpackb(corrupt, raw=False)
+
+    def test_required_fields_present_in_examples(self):
+        for schema in protocol.FRAME_SCHEMAS:
+            if schema.payload != "map":
+                continue
+            payload = protocol.example_payload(schema)
+            for f in schema.fields:
+                if f.required:
+                    assert f.name in payload, (schema.frame_type, f.name)
+
+    def test_checkpoint_truncated_and_corrupt_rejected(self):
+        good = {
+            "v": 1, "rid": "r1", "prompt_ids": [1, 2],
+            "output_ids": [3], "output_logprobs": [],
+            "sampling_params": {}, "eos_token_ids": [],
+            "lora_id": None, "routing_table": ["n0"],
+            "age_s": 0.0, "parked_wall": 0.0,
+        }
+        assert checkpoint_from_wire(dict(good)).request_id == "r1"
+        for missing in ("v", "rid", "prompt_ids", "sampling_params"):
+            bad = {k: v for k, v in good.items() if k != missing}
+            with pytest.raises(CheckpointError):
+                checkpoint_from_wire(bad)
+        with pytest.raises(CheckpointError):
+            checkpoint_from_wire(dict(good, prompt_ids="oops"))
+        with pytest.raises(CheckpointError):
+            checkpoint_from_wire(dict(good, output_logprobs=[0.1, 0.2]))
+
+
+# ---------------------------------------------------------------------------
+# runtime conformance sanitizer
+
+
+@pytest.fixture
+def clean_sanitizer():
+    conformance.reset()
+    conformance.enable()
+    yield conformance.get_sanitizer()
+    conformance.disable()
+    conformance.reset()
+
+
+class TestConformanceSanitizer:
+    def _request(self, rid="r1", max_new=4):
+        return Request(
+            request_id=rid, prompt_ids=[1, 2, 3],
+            sampling_params=SamplingParams(max_new_tokens=max_new),
+        )
+
+    def test_legal_lifecycle_is_clean(self, clean_sanitizer):
+        req = self._request()
+        req.set_status(RequestStatus.PREFILLING, "admission")
+        req.set_status(RequestStatus.DECODING, "prefill-complete")
+        req.commit_token(7)
+        req.set_status(RequestStatus.PREEMPTED, "preempt")
+        req.set_status(RequestStatus.DECODING, "swap-in")
+        while not req.status.is_finished:
+            req.commit_token(8)
+        rep = conformance.report()
+        assert rep["violations"] == []
+        assert rep["transitions"]["commit"] >= 2
+        conformance.assert_clean()
+
+    def test_illegal_edge_flagged(self, clean_sanitizer):
+        req = self._request()
+        # PENDING -> DECODING is not an admission edge.
+        req.set_status(RequestStatus.DECODING, "admission")
+        v = conformance.violations()
+        assert v and v[0]["kind"] == "illegal_edge"
+        assert v[0]["src"] == "PENDING" and v[0]["dst"] == "DECODING"
+        with pytest.raises(AssertionError):
+            conformance.assert_clean()
+
+    def test_undeclared_owner_flagged(self, clean_sanitizer):
+        req = self._request()
+        req.set_status(RequestStatus.PREFILLING, "not-an-edge")
+        v = conformance.violations()
+        assert v and v[0]["kind"] == "illegal_edge"
+
+    def test_commit_after_finish_flagged(self, clean_sanitizer):
+        req = self._request()
+        req.abort("test")
+        req.commit_token(9)   # the bug the engine guard prevents
+        kinds = [v["kind"] for v in conformance.violations()]
+        assert "commit_after_finish" in kinds
+
+    def test_single_ownership(self, clean_sanitizer):
+        conformance.on_own("r1", 100, "head-a")
+        conformance.on_disown("r1", 100)
+        conformance.on_own("r1", 200, "head-b")    # clean handover
+        assert conformance.violations() == []
+        conformance.on_own("r1", 300, "head-c")    # double claim
+        v = conformance.violations()
+        assert v and v[0]["kind"] == "double_ownership"
+        assert v[0]["holder"] == "head-b" and v[0]["claimant"] == "head-c"
+
+    def test_disown_by_non_owner_is_ignored(self, clean_sanitizer):
+        conformance.on_own("r1", 100, "head-a")
+        conformance.on_disown("r1", 999)   # a mirror's release
+        assert conformance.report()["live_owners"] == {"r1": "head-a"}
+
+    def test_route_charge_balance(self, clean_sanitizer):
+        conformance.on_route_charge(["n0", "n1"])
+        conformance.on_route_release(["n0", "n1"])
+        assert conformance.violations() == []
+        assert conformance.report()["route_imbalance"] == {}
+        # Over-release is an anomaly counter, not a violation: a
+        # direct-to-head submit finishes without a dispatcher charge.
+        conformance.on_route_release(["n0"])
+        rep = conformance.report()
+        assert rep["violations"] == []
+        assert rep["route_over_releases"] == {"n0": 1}
+        assert rep["route_imbalance"] == {}
+        # A leaked charge shows up as imbalance for quiesced asserts.
+        conformance.on_route_charge(["n2"])
+        assert conformance.report()["route_imbalance"] == {"n2": 1}
+
+    def test_frame_schema_membership(self, clean_sanitizer):
+        conformance.on_frame("rx", proto.FORWARD)
+        conformance.on_frame("tx", proto.KV_RESULT)
+        conformance.on_frame("rx", "__ping__")     # internal: skipped
+        assert conformance.violations() == []
+        conformance.on_frame("rx", "mystery_frame")
+        v = conformance.violations()
+        assert v and v[0]["kind"] == "unknown_frame"
+
+    def test_zero_cost_when_disabled(self):
+        conformance.disable()
+        conformance.reset()
+        req = self._request()
+        req.set_status(RequestStatus.DECODING, "bogus-edge")
+        req.commit_token(1)
+        conformance.on_own("r1", 1, "x")
+        conformance.on_frame("rx", "mystery_frame")
+        rep = conformance.report()
+        assert rep["violations"] == []
+        assert rep["transitions"] == {} and rep["commits"] == 0
+
+    def test_report_shape(self, clean_sanitizer):
+        req = self._request()
+        req.set_status(RequestStatus.PREFILLING, "admission")
+        rep = conformance.report()
+        assert rep["enabled"] is True
+        assert set(rep) >= {
+            "transitions", "commits", "ownership_events", "frames",
+            "route_imbalance", "violations", "live_owners",
+        }
+
+
+# ---------------------------------------------------------------------------
+# regression: FSM fixes surfaced by the checkers
+
+
+class TestCheckerSurfacedFixes:
+    def test_timeout_does_not_reabort_finished_requests(self):
+        """check_timeouts used to abort ALREADY-FINISHED rows awaiting
+        collection, overwriting the real outcome with FINISHED_ABORT
+        (flagged by the FSM: FINISHED_* is terminal)."""
+        from parallax_tpu.runtime.cache_manager import CacheManager
+        from parallax_tpu.runtime.scheduler import Scheduler
+
+        sched = Scheduler(
+            CacheManager(num_pages=8, page_size=16, max_model_len=128),
+            request_timeout_s=0.0,
+        )
+        req = Request(request_id="r1", prompt_ids=[1])
+        req.set_status(RequestStatus.PREFILLING, "admission")
+        req.set_status(RequestStatus.DECODING, "prefill-complete")
+        req.commit_token(5)
+        req.set_status(RequestStatus.FINISHED_STOP, "stop")
+        sched.running["r1"] = req
+        import time as _t
+        _t.sleep(0.01)
+        timed_out = sched.check_timeouts()
+        assert timed_out == []
+        assert req.status is RequestStatus.FINISHED_STOP
+
+    def test_dead_chat_completion_constant_removed(self):
+        assert not hasattr(proto, "CHAT_COMPLETION")
+
+
+# ---------------------------------------------------------------------------
+# generated FSM docs artifacts
+
+
+class TestFsmArtifacts:
+    def test_markdown_covers_every_owner(self):
+        table = protocol.fsm_markdown()
+        for owner in protocol.edge_owners():
+            assert f"`{owner}`" in table, owner
+
+    def test_dot_is_well_formed(self):
+        dot = protocol.fsm_dot()
+        assert dot.startswith("digraph request_fsm {")
+        assert dot.rstrip().endswith("}")
+        for s in protocol.STATES:
+            assert s in dot
+
+    def test_docs_table_matches_generated(self):
+        """docs/static_analysis.md embeds the GENERATED table — stale
+        docs fail here; regenerate with `parallax-tpu-lint
+        --fsm-table`."""
+        doc = os.path.join(REPO, "docs", "static_analysis.md")
+        text = open(doc, encoding="utf-8").read()
+        for line in protocol.fsm_markdown().splitlines():
+            assert line in text, (
+                "docs/static_analysis.md FSM table is stale; "
+                f"missing: {line}"
+            )
+
+    def test_cli_fsm_flags(self, capsys):
+        from parallax_tpu.analysis.cli import main as cli_main
+
+        assert cli_main(["--fsm-table"]) == 0
+        out = capsys.readouterr().out
+        assert "| owner | transition |" in out
+        assert cli_main(["--fsm-dot"]) == 0
+        out = capsys.readouterr().out
+        assert "digraph request_fsm" in out
+
+
+# ---------------------------------------------------------------------------
+# metric-name registry sanity (the sweep's single source of truth)
+
+
+class TestMetricNames:
+    def test_every_name_has_help(self):
+        from parallax_tpu.obs import names
+
+        for n in names.all_names():
+            assert names.help_text(n)
+            assert n.startswith("parallax_")
+
+    def test_registry_accepts_declared_names(self):
+        from parallax_tpu.obs import names
+        from parallax_tpu.obs.registry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        c = reg.counter(names.REQUESTS_FINISHED_TOTAL,
+                        names.help_text(names.REQUESTS_FINISHED_TOTAL),
+                        labelnames=("outcome",))
+        c.labels(outcome="ok").inc()
+        assert names.REQUESTS_FINISHED_TOTAL in reg.render()

@@ -1060,3 +1060,298 @@ class TestLockSanitizer:
         with lock:
             pass
         assert chaos.lock_report()["acquisitions"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# status-transition
+
+
+class TestStatusTransition:
+    def _lint(self, src, filename):
+        from parallax_tpu.analysis.checkers.status_transition import (
+            StatusTransitionChecker,
+        )
+
+        return lint(src, StatusTransitionChecker(), filename)
+
+    def test_positive_raw_assignment(self):
+        active, _ = self._lint(
+            """
+            from parallax_tpu.runtime.request import RequestStatus
+
+            def park(req):
+                req.status = RequestStatus.PREEMPTED
+            """,
+            "parallax_tpu/runtime/scheduler.py",
+        )
+        assert len(active) == 1
+        assert "route it through Request.set_status" in active[0].message
+
+    def test_suppressed_raw_assignment(self):
+        active, suppressed = self._lint(
+            """
+            from parallax_tpu.runtime.request import RequestStatus
+
+            def park(req):
+                req.status = RequestStatus.PREEMPTED  # parallax: allow[status-transition] fixture exercising the escape hatch
+            """,
+            "parallax_tpu/runtime/scheduler.py",
+        )
+        assert active == [] and len(suppressed) == 1
+
+    def test_negative_declared_edge_in_declared_module(self):
+        active, _ = self._lint(
+            """
+            from parallax_tpu.runtime.request import RequestStatus
+
+            def park(req):
+                req.set_status(RequestStatus.PREEMPTED, "preempt")
+            """,
+            "parallax_tpu/runtime/scheduler.py",
+        )
+        assert active == [], [f.message for f in active]
+
+    def test_positive_undeclared_owner(self):
+        active, _ = self._lint(
+            """
+            from parallax_tpu.runtime.request import RequestStatus
+
+            def park(req):
+                req.set_status(RequestStatus.PREEMPTED, "yolo")
+            """,
+            "parallax_tpu/runtime/scheduler.py",
+        )
+        assert len(active) == 1
+        assert "is not declared" in active[0].message
+
+    def test_positive_wrong_destination(self):
+        active, _ = self._lint(
+            """
+            from parallax_tpu.runtime.request import RequestStatus
+
+            def park(req):
+                req.set_status(RequestStatus.DECODING, "preempt")
+            """,
+            "parallax_tpu/runtime/scheduler.py",
+        )
+        assert len(active) == 1
+        assert "does not declare destination DECODING" in active[0].message
+
+    def test_positive_wrong_module(self):
+        active, _ = self._lint(
+            """
+            from parallax_tpu.runtime.request import RequestStatus
+
+            def park(req):
+                req.set_status(RequestStatus.PREEMPTED, "preempt")
+            """,
+            "parallax_tpu/p2p/node.py",
+        )
+        assert len(active) == 1
+        assert "not this module" in active[0].message
+
+    def test_positive_dynamic_dst_needs_declaration(self):
+        active, _ = self._lint(
+            """
+            from parallax_tpu.runtime.request import RequestStatus
+
+            def park(req, wire):
+                req.set_status(RequestStatus(wire), "preempt")
+            """,
+            "parallax_tpu/runtime/scheduler.py",
+        )
+        assert any("DYNAMIC_DST_OWNERS" in f.message for f in active)
+
+    def test_negative_dynamic_owner_allowed(self):
+        active, _ = self._lint(
+            """
+            from parallax_tpu.runtime.request import RequestStatus
+
+            def adopt(req, wire):
+                req.set_status(RequestStatus(wire), "client-finish")
+            """,
+            "parallax_tpu/backend/run.py",
+        )
+        assert active == [], [f.message for f in active]
+
+    def test_positive_missing_edge_tag(self):
+        active, _ = self._lint(
+            """
+            from parallax_tpu.runtime.request import RequestStatus
+
+            def park(req):
+                req.set_status(RequestStatus.PREEMPTED)
+            """,
+            "parallax_tpu/runtime/scheduler.py",
+        )
+        assert len(active) == 1
+        assert "without an edge tag" in active[0].message
+
+
+# ---------------------------------------------------------------------------
+# frame-drift (aggregate scan over a synthetic mini-package)
+
+
+class TestFrameDrift:
+    def _run(self, tmp_path, node_src):
+        """Build pkg/p2p/proto.py (real constants) + pkg/p2p/node.py
+        (fixture) and run the checker pinned to proto.py."""
+        import shutil
+
+        from parallax_tpu.analysis.checkers.frame_drift import (
+            FrameDriftChecker,
+        )
+
+        pkg = tmp_path / "pkg"
+        (pkg / "p2p").mkdir(parents=True)
+        shutil.copy(os.path.join(PKG, "p2p", "proto.py"),
+                    pkg / "p2p" / "proto.py")
+        (pkg / "p2p" / "node.py").write_text(textwrap.dedent(node_src))
+        engine = LintEngine(checkers=[FrameDriftChecker()],
+                            repo_root=str(tmp_path))
+        result = engine.run_paths([str(pkg / "p2p" / "proto.py")])
+        return [f.message for f in result.findings]
+
+    def test_positive_constructed_without_handler(self, tmp_path):
+        msgs = self._run(tmp_path, """
+            class Node:
+                def ship(self, peer):
+                    self.transport.send(peer, "bogus_frame", {"x": 1})
+        """)
+        assert any("'bogus_frame'" in m and "no transport.register" in m
+                   for m in msgs), msgs
+        assert any("'bogus_frame'" in m and "no\nFrameSchema" in m
+                   or "'bogus_frame'" in m and "FrameSchema" in m
+                   for m in msgs), msgs
+
+    def test_positive_handler_reads_undeclared_field(self, tmp_path):
+        msgs = self._run(tmp_path, """
+            from pkg.p2p import proto
+
+            class Node:
+                def __init__(self, transport):
+                    transport.register(proto.WHERE_IS, self._on_where_is)
+                    transport.register(proto.ABORT, self._on_abort)
+
+                def _on_where_is(self, _peer, payload):
+                    return {"head": payload["rid"], "x": payload["nope"]}
+
+                def _on_abort(self, _peer, payload):
+                    return payload["rids"]
+        """)
+        assert any("reads undeclared payload field 'nope'" in m
+                   for m in msgs), msgs
+        assert not any("'rids'" in m for m in msgs), msgs
+
+    def test_positive_sender_sets_undeclared_field(self, tmp_path):
+        msgs = self._run(tmp_path, """
+            from pkg.p2p import proto
+
+            class Node:
+                def ship(self, peer):
+                    self.transport.send(
+                        peer, proto.NODE_LEAVE,
+                        {"node_id": "n0", "extra": 1},
+                    )
+        """)
+        assert any("sets undeclared payload field 'extra'" in m
+                   for m in msgs), msgs
+
+    def test_dead_constant_flagged(self, tmp_path):
+        import shutil
+
+        from parallax_tpu.analysis.checkers.frame_drift import (
+            FrameDriftChecker,
+        )
+
+        pkg = tmp_path / "pkg"
+        (pkg / "p2p").mkdir(parents=True)
+        proto_src = open(os.path.join(PKG, "p2p", "proto.py")).read()
+        proto_src += '\nDEAD_FRAME = "rpc_never_used"\n'
+        (pkg / "p2p" / "proto.py").write_text(proto_src)
+        engine = LintEngine(checkers=[FrameDriftChecker()],
+                            repo_root=str(tmp_path))
+        result = engine.run_paths([str(pkg / "p2p" / "proto.py")])
+        msgs = [f.message for f in result.findings]
+        assert any("DEAD_FRAME" in m and "dead wire surface" in m
+                   for m in msgs), msgs
+
+
+# ---------------------------------------------------------------------------
+# metric-hygiene
+
+
+class TestMetricHygiene:
+    def _lint(self, src, filename="parallax_tpu/obs/goodput.py"):
+        from parallax_tpu.analysis.checkers.metric_hygiene import (
+            MetricHygieneChecker,
+        )
+
+        return lint(src, MetricHygieneChecker(), filename)
+
+    def test_positive_literal_outside_names(self):
+        active, _ = self._lint(
+            """
+            def publish(reg):
+                reg.counter("parallax_widgets_total", "help").inc()
+            """,
+        )
+        assert len(active) == 1
+        assert "use the\nobs/names.py constant" in active[0].message or \
+            "obs/names.py constant" in active[0].message
+
+    def test_suppressed(self):
+        active, suppressed = self._lint(
+            """
+            def publish(reg):
+                reg.counter("parallax_widgets_total", "h").inc()  # parallax: allow[metric-hygiene] fixture exercising the escape hatch
+            """,
+        )
+        assert active == [] and len(suppressed) == 1
+
+    def test_negative_package_name_and_docstrings(self):
+        active, _ = self._lint(
+            '''
+            """Mentions parallax_widgets_total in prose — fine."""
+
+            import logging
+
+            def get():
+                return logging.getLogger("parallax_tpu")
+            ''',
+        )
+        assert active == [], [f.message for f in active]
+
+    def test_negative_constant_reference(self):
+        active, _ = self._lint(
+            """
+            from parallax_tpu.obs import names as mnames
+
+            def publish(reg):
+                reg.counter(mnames.TTFT_MS, "help").inc()
+            """,
+        )
+        assert active == []
+
+    def test_table_validates_duplicates_and_help(self, tmp_path):
+        from parallax_tpu.analysis.checkers.metric_hygiene import (
+            MetricHygieneChecker,
+        )
+
+        src = textwrap.dedent('''
+            """Fixture names table."""
+
+            A_TOTAL = "parallax_a_total"
+            B_TOTAL = "parallax_a_total"
+            C_TOTAL = "parallax_c_total"
+
+            HELP = {
+                A_TOTAL: "a help",
+            }
+        ''')
+        engine = LintEngine(checkers=[MetricHygieneChecker()],
+                            repo_root=str(tmp_path))
+        active, _ = engine.lint_text(src, "parallax_tpu/obs/names.py")
+        msgs = [f.message for f in active]
+        assert any("duplicate metric name" in m for m in msgs), msgs
+        assert any("C_TOTAL has no HELP entry" in m for m in msgs), msgs
