@@ -1602,6 +1602,227 @@ def _qos_probe(cfg, dtype, kv_dtype, page_size) -> dict:
     }
 
 
+def _spec_probe(model, params, kv_dtype: str) -> dict:
+    """Speculative-decoding probe (detail.spec, docs/decode_loop.md):
+    the acceptance-rate x speedup matrix — spec on/off x K=1/K=8 x
+    repetitive/random prompts on one single-stage engine geometry —
+    plus the goodput accepted-vs-rejected split per round.
+
+    Workloads: "repetitive" selects, from a batch of constant-token
+    candidate prompts, the one whose greedy continuation is the most
+    periodic (the candidates round doubles as the K=8 spec-off warmup),
+    then serves 8 copies of it — the regime prompt-lookup proposals are
+    built for. "random" serves seeded uniform prompts — the adversarial
+    regime where acceptance collapses and speculation is expected to
+    COST (reported honestly; the goodput ledger charges the discarded
+    verify positions to ``speculative_rejected``).
+
+    Timing is decode-phase wall clock amortized per committed token,
+    with every engine warmed by a full identical round first (the spec
+    window's proposal buffer rides a fixed per-config length, so warm
+    and measured rounds share every compile). The CI spec smoke asserts
+    spec-on strictly below spec-off at K=8 on the repetitive workload
+    and bit-identical greedy+seeded streams; the structural keys are
+    pinned by test_bench_contract.
+    """
+    import numpy as np
+
+    from parallax_tpu.obs.goodput import get_goodput
+    from parallax_tpu.runtime.engine import (
+        EngineConfig,
+        StageEngine,
+        drive_step,
+    )
+    from parallax_tpu.runtime.request import Request, SamplingParams
+
+    vocab = int(model.config.vocab_size)
+    batch, prompt_len, gen_len = 8, 16, 128
+    page_size = 16
+    max_len = prompt_len + gen_len + 3 * page_size
+    spec_width, ngram = 4, 2
+    lookahead_hi = 8
+
+    def make_engine(spec: int, k: int) -> StageEngine:
+        return StageEngine(model, params, EngineConfig(
+            page_size=page_size,
+            num_pages=batch * ((max_len + page_size - 1) // page_size + 1),
+            max_batch_size=batch,
+            max_model_len=max_len,
+            kv_dtype=kv_dtype,
+            enable_prefix_cache=False,
+            speculative_tokens=spec,
+            speculative_ngram=ngram,
+            decode_lookahead=k,
+        ))
+
+    def run_round(eng, tag, prompts, temp=0.0, seed=None, overlap=True):
+        """One full batch to completion through the drive loop;
+        returns decode-phase per-token wall ms, the streams, and the
+        round's goodput-ledger delta. The K=8 rounds run the serving
+        default (overlap); the K=1 rounds run SYNC — under overlap a
+        K=1 decode row is device-fed (its token never reaches the
+        host), so the host-synchronous verify fallback those rounds
+        exist to measure could never engage."""
+        eng.cfg.overlap_steps = overlap
+        gp0 = get_goodput().snapshot()["tokens"]
+        reqs = []
+        for i, prompt in enumerate(prompts):
+            req = Request(
+                f"spec-{tag}-{i}", prompt_ids=list(prompt),
+                sampling_params=SamplingParams(
+                    temperature=temp, seed=seed,
+                    max_new_tokens=gen_len, ignore_eos=True,
+                ),
+            )
+            reqs.append(req)
+            eng.submit(req)
+        total = 0
+        decode_t0 = None
+        tokens_at_decode = 0
+        t0 = time.perf_counter()
+        pending = None
+        while eng.has_work() or pending is not None:
+            outs, pending = drive_step(eng, pending)
+            for out in outs:
+                total += out.num_tokens
+                if decode_t0 is None:
+                    running = eng.scheduler.running
+                    if (
+                        not eng.scheduler.wait_queue
+                        and running
+                        and all(r.output_ids for r in running.values())
+                    ):
+                        decode_t0 = time.perf_counter()
+                        tokens_at_decode = total
+        wall_s = time.perf_counter() - (decode_t0 or t0)
+        gp1 = get_goodput().snapshot()["tokens"]
+        return {
+            "per_token_ms": round(
+                wall_s * 1000.0 / max(1, total - tokens_at_decode), 4
+            ),
+            "decode_tokens": total - tokens_at_decode,
+            "outputs": [list(r.output_ids) for r in reqs],
+            "goodput": {
+                k: gp1[k] - gp0[k]
+                for k in ("committed", "speculative_rejected")
+            },
+        }
+
+    def stability(out: list) -> float:
+        """Fraction of positions continuing a period<=4 pattern."""
+        return max(
+            sum(out[i] == out[i - p] for i in range(p, len(out)))
+            / max(1, len(out) - p)
+            for p in range(1, 5)
+        )
+
+    engines = {
+        (0, lookahead_hi): make_engine(0, lookahead_hi),
+        (spec_width, lookahead_hi): make_engine(spec_width, lookahead_hi),
+        (0, 1): make_engine(0, 1),
+        (spec_width, 1): make_engine(spec_width, 1),
+    }
+    # Candidate selection: constant-token prompts, scored on how
+    # periodic their greedy continuation stays (this IS the spec-off
+    # K=8 warm round). Deterministic given the weights.
+    prng = np.random.default_rng(11)
+    cand_tokens = [int(x) for x in prng.integers(1, vocab - 1, size=8)]
+    cands = [[t] * prompt_len for t in cand_tokens]
+    sel = run_round(engines[(0, lookahead_hi)], "sel", cands)
+    best = max(range(len(cands)), key=lambda i: stability(sel["outputs"][i]))
+    workloads = {
+        "repetitive": [list(cands[best]) for _ in range(batch)],
+        "random": [
+            [int(x) for x in prng.integers(1, vocab - 1, size=prompt_len)]
+            for _ in range(batch)
+        ],
+    }
+
+    result: dict = {
+        "speculative_tokens": spec_width,
+        "speculative_ngram": ngram,
+        "k": lookahead_hi,
+        "repetitive_stability": round(stability(sel["outputs"][best]), 3),
+    }
+    warmed: set = set()
+    for wl, prompts in workloads.items():
+        rounds = {}
+        for label, (spec, k) in (
+            ("off_k8", (0, lookahead_hi)),
+            ("on_k8", (spec_width, lookahead_hi)),
+            ("off_k1", (0, 1)),
+            ("on_k1", (spec_width, 1)),
+        ):
+            eng = engines[(spec, k)]
+            overlap = k > 1
+            if (spec, k) not in warmed:
+                # Full-shape warm round: identical gen/batch so every
+                # compile (window program, K=1 path, deferred sampler)
+                # lands before the measured rounds.
+                run_round(eng, f"warm-{label}", prompts, overlap=overlap)
+                warmed.add((spec, k))
+            # Per-ROUND spec ledger deltas (spec_summary is engine-
+            # cumulative; the warm + other-workload rounds must not
+            # leak into this cell's acceptance rate).
+            s0 = eng.spec_summary() or {}
+            r = run_round(eng, f"{wl}-{label}", prompts, overlap=overlap)
+            s1 = eng.spec_summary() or {}
+            acc = s1.get("accepted", 0) - s0.get("accepted", 0)
+            rej = s1.get("rejected", 0) - s0.get("rejected", 0)
+            rounds[label] = {
+                "per_token_ms": r["per_token_ms"],
+                "decode_tokens": r["decode_tokens"],
+                "goodput": r["goodput"],
+                **(
+                    {
+                        "acceptance_rate": (
+                            round(acc / (acc + rej), 4)
+                            if acc + rej else 0.0
+                        ),
+                        "accepted": acc,
+                        "rejected": rej,
+                        "proposals": (
+                            s1.get("proposals", 0)
+                            - s0.get("proposals", 0)
+                        ),
+                    }
+                    if spec else {}
+                ),
+                "outputs": r["outputs"],
+            }
+        bit = (
+            rounds["off_k8"]["outputs"] == rounds["on_k8"]["outputs"]
+            == rounds["off_k1"]["outputs"] == rounds["on_k1"]["outputs"]
+        )
+        entry = {
+            k2: {kk: vv for kk, vv in v.items() if kk != "outputs"}
+            for k2, v in rounds.items()
+        }
+        entry["bit_identical"] = bit
+        entry["speedup_k8"] = round(
+            rounds["off_k8"]["per_token_ms"]
+            / max(1e-9, rounds["on_k8"]["per_token_ms"]), 3,
+        )
+        entry["speedup_k1"] = round(
+            rounds["off_k1"]["per_token_ms"]
+            / max(1e-9, rounds["on_k1"]["per_token_ms"]), 3,
+        )
+        result[wl] = entry
+    # Seeded pair (K=8, repetitive): the lockstep verifier must leave a
+    # seeded sampled stream bitwise unchanged.
+    rep = workloads["repetitive"]
+    s_off = run_round(engines[(0, lookahead_hi)], "seed-off", rep,
+                      temp=0.8, seed=1234)
+    run_round(engines[(spec_width, lookahead_hi)], "seed-warm", rep,
+              temp=0.8, seed=1234)
+    s_on = run_round(engines[(spec_width, lookahead_hi)], "seed-on", rep,
+                     temp=0.8, seed=1234)
+    result["repetitive"]["seeded_bit_identical"] = (
+        s_off["outputs"] == s_on["outputs"]
+    )
+    return result
+
+
 def _kernel_probe(page_size: int) -> dict:
     """Decode-kernel microbench (detail.kernel): per-token device ms and
     tokens/s/chip for the three decode attention implementations on ONE
@@ -2358,6 +2579,16 @@ def _bench():
     if not on_tpu or os.environ.get("BENCH_QOS"):
         qos_probe = _qos_probe(cfg, dtype, kv_dtype, page_size)
 
+    # Speculative-decoding probe: the acceptance-rate x speedup matrix
+    # (spec on/off x K=1/K=8 x repetitive/random prompts) with the
+    # goodput accepted-vs-rejected split, greedy + seeded bit-identity.
+    # The CI spec smoke asserts spec-on strictly below spec-off at K=8
+    # on the repetitive workload. Cheap on CPU (part of the smoke
+    # contract); opt-in on TPU (BENCH_SPEC).
+    spec_probe = None
+    if not on_tpu or os.environ.get("BENCH_SPEC"):
+        spec_probe = _spec_probe(model, params, kv_dtype)
+
     # Decode-kernel microbench: fused vs split vs XLA attention(+append
     # +sampling) chains on one identical ragged batch — per-token device
     # ms and tokens/s/chip per impl, plus the fused-below-split and
@@ -2587,6 +2818,13 @@ def _bench():
             **(
                 {"qos": qos_probe}
                 if qos_probe is not None else {}
+            ),
+            # Speculative-decoding probe (acceptance-rate x speedup
+            # matrix, goodput accepted-vs-rejected split, greedy +
+            # seeded bit-identity — docs/decode_loop.md).
+            **(
+                {"spec": spec_probe}
+                if spec_probe is not None else {}
             ),
             # Decode-kernel microbench (fused vs split vs XLA per-token
             # device ms + bit-identity verdicts on one ragged batch).

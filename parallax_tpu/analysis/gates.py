@@ -124,6 +124,31 @@ GATE_TABLE: tuple[Gate, ...] = (
                "chooser; a gossip swarm has nobody to pick them",
     ),
     Gate(
+        feature="speculative_tokens",
+        marker="speculative decode windows disabled: multi-stage",
+        doc="docs/decode_loop.md",
+        reason="the on-device draft-verify window needs the whole ring "
+               "local; pipelines speculate via pp-spec, whose "
+               "last-stage verify forces a synchronous resolve",
+    ),
+    Gate(
+        feature="speculative_tokens",
+        marker="speculative decoding disabled: penalties/logprobs",
+        doc="docs/decode_loop.md",
+        reason="per-step host state (penalties, logprobs, grammar "
+               "masks, logit_bias, teacher-forced replay) cannot be "
+               "advanced inside a multi-token verify; those batches "
+               "decode one token per step",
+    ),
+    Gate(
+        feature="decode_fused",
+        marker="decode-fused kernels disabled for speculative windows",
+        doc="docs/kernels.md",
+        reason="the spec window's verify forward is multi-token ragged; "
+               "fused append and fused sampling are single-token by "
+               "construction — plain windows keep the fused kernels",
+    ),
+    Gate(
         feature="qos",
         marker="qos park enforcement disabled: no host KV tier",
         doc="docs/qos.md",

@@ -388,6 +388,7 @@ FRAME_SCHEMAS: tuple[FrameSchema, ...] = (
                    "sender — heartbeats must stay ping-free"),
             _f("cache_stats", None, required=False),
             _f("kernel", None, required=False),
+            _f("spec", None, required=False),
             _f("transport", None, required=False),
             _f("metrics", None, required=False),
             _f("refit_version", 0, required=False),

@@ -144,6 +144,14 @@ class SchedulerService:
                 if isinstance(payload.get("kernel"), dict)
                 else None
             ),
+            # Speculative-decoding ledger (proposed/accepted/rejected by
+            # source, acceptance rate, accepted tokens per chip-second)
+            # — surfaced per node in /cluster/status.
+            spec=(
+                payload["spec"]
+                if isinstance(payload.get("spec"), dict)
+                else None
+            ),
             # Per-link activation-transport telemetry (bytes each way,
             # serialize/send ms, queue depth, compression ratio) —
             # surfaced per node in /cluster/status.

@@ -172,6 +172,11 @@ def build_local_frontend(
                     # a silent fallback to the split or XLA path is
                     # visible here (docs/kernels.md).
                     "kernel": e.kernel_dispatch_summary(),
+                    # Speculative-decoding ledger: per-source proposed/
+                    # accepted/rejected, acceptance rate (the tuning
+                    # signal, docs/decode_loop.md) and accepted tokens
+                    # per chip-second. None while speculation is off.
+                    "spec": e.spec_summary(),
                 }
                 for e in engines
             ],
@@ -244,7 +249,10 @@ def serve_main(args) -> int:
 
     enable_compilation_cache(getattr(args, "compilation_cache_dir", None))
 
-    from parallax_tpu.config import load_config
+    from parallax_tpu.config import (
+        load_config,
+        resolve_speculative_tokens,
+    )
     from parallax_tpu.models.loader import load_stage_params
     from parallax_tpu.models.registry import create_stage_model
     from parallax_tpu.runtime.cache_manager import derive_num_pages
@@ -330,6 +338,11 @@ def serve_main(args) -> int:
         if config.linear_attn is not None:
             raise ValueError("--draft-model-path does not support hybrid "
                              "linear-attention main models")
+        # Built AFTER enable_compilation_cache() above: the draft
+        # engine re-traces its own prefill/decode lattice, and without
+        # the persistent cache enabling speculation would pay a SECOND
+        # compile storm on every restart (DraftProposer asserts the
+        # reuse; tests/test_speculative.py pins it).
         draft_cfg = load_config(draft_path)
         draft_model = create_stage_model(
             draft_cfg, 0, draft_cfg.num_hidden_layers
@@ -396,10 +409,11 @@ def serve_main(args) -> int:
             # Fused decode kernels (None = auto-on-TPU; docs/kernels.md).
             decode_fused=getattr(args, "decode_fused", None),
             # A configured draft model implies speculation (default k=4).
-            speculative_tokens=(
-                (getattr(args, "speculative_tokens", 0) or 0)
-                or (4 if draft is not None else 0)
+            speculative_tokens=resolve_speculative_tokens(
+                getattr(args, "speculative_tokens", 0),
+                has_draft=draft is not None,
             ),
+            speculative_ngram=getattr(args, "speculative_ngram", 3) or 3,
             # Single-host serving has no network hop; carried so a
             # worker spawned from this config inherits the operator's
             # wire choice (docs/networking.md).

@@ -81,9 +81,17 @@ def build_parser() -> argparse.ArgumentParser:
                             "--decode-fused off-TPU runs interpret mode "
                             "(parity testing only)")
     serve.add_argument("--speculative-tokens", type=int, default=0,
-                       help="prompt-lookup speculative decoding: propose "
-                            "up to N continuation tokens from n-gram "
-                            "matches, verified in one forward (0 = off)")
+                       help="speculative decoding: verify up to N "
+                            "proposed continuation tokens per decode "
+                            "step (0 = off). With decode-lookahead > 1 "
+                            "the draft-verify loop runs on device inside "
+                            "the K-step window; K=1 falls back to one "
+                            "host-synchronous verify round per visit")
+    serve.add_argument("--speculative-ngram", type=int, default=3,
+                       help="prompt-lookup proposal n-gram length: match "
+                            "the trailing N tokens against earlier "
+                            "context and propose what followed (used "
+                            "when no draft model is configured)")
     serve.add_argument("--draft-model-path", default=None,
                        help="small draft checkpoint for speculative "
                             "decoding (proposals verified by the main "
@@ -312,6 +320,17 @@ def build_parser() -> argparse.ArgumentParser:
         "--decode-lookahead", type=int, default=None,
         help="decode tokens per host visit when this worker serves a "
              "full single stage (default: adaptive up to 8; 1 = off)",
+    )
+    join.add_argument(
+        "--speculative-tokens", type=int, default=0,
+        help="speculative decoding on this worker's single-stage decode "
+             "windows: verify up to N prompt-lookup proposal tokens per "
+             "step inside the K-step window (0 = off; the decode pool's "
+             "TPOT lever — docs/decode_loop.md)",
+    )
+    join.add_argument(
+        "--speculative-ngram", type=int, default=3,
+        help="prompt-lookup proposal n-gram length for this worker",
     )
     join.add_argument(
         "--decode-pipeline", type=int, default=1,

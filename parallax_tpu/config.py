@@ -343,6 +343,24 @@ def resolve_wire_dtype(
     return canon
 
 
+def resolve_speculative_tokens(
+    tokens: int | None, has_draft: bool = False
+) -> int:
+    """Canonical speculative verify width (``--speculative-tokens`` /
+    ``EngineConfig.speculative_tokens``). 0/None = off — unless a draft
+    model is configured, which implies speculation at the default width
+    of 4 (loading draft weights that can never fire would silently
+    waste HBM). Negative widths are a config error, not a silent off."""
+    n = int(tokens or 0)
+    if n < 0:
+        raise ValueError(
+            f"speculative_tokens must be >= 0, got {tokens!r}"
+        )
+    if n == 0 and has_draft:
+        return 4
+    return n
+
+
 # Disaggregated prefill/decode serving (docs/disaggregation.md): a
 # worker joins the swarm tagged with the phase it specializes in. The
 # scheduler keeps pipelines role-homogeneous, routes the prompt phase to

@@ -84,6 +84,13 @@ QOS_DEADLINE_SLACK_MS = "parallax_qos_deadline_slack_ms"
 QOS_TTFT_MS = "parallax_qos_ttft_ms"
 QOS_REROLES_TOTAL = "parallax_qos_reroles_total"
 
+# -- speculative decoding (runtime/engine.py) --------------------------------
+SPEC_PROPOSALS_TOTAL = "parallax_spec_proposals_total"
+SPEC_ACCEPTED_TOTAL = "parallax_spec_accepted_total"
+SPEC_REJECTED_TOTAL = "parallax_spec_rejected_total"
+SPEC_ACCEPTANCE_RATE = "parallax_spec_acceptance_rate"
+SPEC_PROPOSE_MS = "parallax_spec_propose_ms"
+
 # -- goodput ledger / SLO / health plane (obs/) ------------------------------
 GOODPUT_TOKENS_TOTAL = "parallax_goodput_tokens_total"
 GOODPUT_TIME_SECONDS_TOTAL = "parallax_goodput_time_seconds_total"
@@ -200,6 +207,27 @@ HELP: dict[str, str] = {
     ),
     QOS_REROLES_TOTAL: (
         "Pipelines re-roled between phase pools by the autoscaler"
+    ),
+    SPEC_PROPOSALS_TOTAL: (
+        "Speculative continuation tokens staged for verification, by "
+        "proposal source (ngram / draft)"
+    ),
+    SPEC_ACCEPTED_TOTAL: (
+        "Proposed tokens that survived target-model verification and "
+        "committed, by proposal source"
+    ),
+    SPEC_REJECTED_TOTAL: (
+        "Proposed tokens the target model rejected (computed and "
+        "discarded), by proposal source"
+    ),
+    SPEC_ACCEPTANCE_RATE: (
+        "Accepted fraction of verified proposal tokens on this stage "
+        "(0..1; 0 before any verification) — the speculation tuning "
+        "signal"
+    ),
+    SPEC_PROPOSE_MS: (
+        "Host milliseconds spent staging one round of speculative "
+        "proposals, by source"
     ),
     GOODPUT_TOKENS_TOTAL: (
         "Device-step tokens classified by usefulness (committed / "

@@ -95,3 +95,18 @@ def decode_attn_impl(
     if resolve_use_pallas(use_pallas):
         return IMPL_SPLIT
     return IMPL_XLA
+
+
+def spec_window_impl(use_pallas: bool | None) -> str:
+    """Impl label for the speculative decode window's verify forward.
+
+    The window feeds 1+P tokens per row and gathers logits at every
+    position — a multi-token ragged program the decode-fused kernels
+    (single-token by construction: in-kernel append keys one slot per
+    sequence, fused sampling reads one logits row per sequence) cannot
+    serve. Fused engines therefore drop to the split-Pallas/XLA
+    prefill-style path for spec windows; the engine registers the gate
+    (analysis/gates.py) and counts the dispatch under ``path="spec"``
+    so the fallback is operator-visible.
+    """
+    return IMPL_SPLIT if resolve_use_pallas(use_pallas) else IMPL_XLA

@@ -132,6 +132,55 @@ def sample_tokens(
     return jnp.where(temperature <= 0.0, greedy_ids, sampled_ids)
 
 
+def speculative_accept(
+    targets: jax.Array,      # i32[S, 1+P] verified tokens per fed position
+    proposals: jax.Array,    # i32[S, P] fed proposal tokens (-1 = none)
+    produced: jax.Array,     # i32[S] tokens committed so far this window
+    stop_tokens: jax.Array,  # i32[S, J] stop/EOS sets, -1 padded
+    min_req: jax.Array,      # i32[S] min_new_tokens gate on stop finishes
+    limit: jax.Array,        # i32[S] remaining max_new budget
+    stopped: jax.Array,      # bool[S] rows frozen before this round
+) -> tuple[jax.Array, jax.Array]:
+    """The vectorized speculative acceptance rule (one verify round).
+
+    Leviathan et al.'s agreement rule specialized to the engine's
+    deterministic verifiers: ``targets[j]`` is what the TARGET model
+    sampled at fed position ``j`` (greedy argmax, or the lockstep
+    seeded draw), so a proposal is accepted while it equals the target
+    at its position, and the first disagreeing position's target
+    commits as the correction/bonus token — the committed run is
+    bitwise what sequential decoding would have produced, whatever the
+    proposals were.
+
+    The commit run is additionally truncated by the same per-row stop
+    predicate the plain multistep scan applies (an EOS/stop-set token
+    gated by ``min_new_tokens``, or the ``max_new`` budget): the
+    stopping token itself commits, nothing after it does.
+
+    Returns ``(commit_count i32[S], froze bool[S])`` — the number of
+    leading ``targets`` entries to commit per row (0 for frozen rows)
+    and whether a committed token froze the row.
+    """
+    s, w = targets.shape
+    js = jnp.arange(w, dtype=jnp.int32)
+    match = proposals == targets[:, : w - 1]
+    agree = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+    cand = js[None, :] <= agree[:, None]
+    prod_j = produced[:, None] + js[None, :] + 1
+    hit = jnp.logical_and(
+        (targets[:, :, None] == stop_tokens[:, None, :]).any(axis=2),
+        prod_j >= min_req[:, None],
+    )
+    stops = hit | (prod_j >= limit[:, None])
+    prior = jnp.cumsum(stops.astype(jnp.int32), axis=1) - stops.astype(
+        jnp.int32
+    )
+    commit = cand & (prior == 0) & ~stopped[:, None]
+    c = commit.sum(axis=1).astype(jnp.int32)
+    froze = (commit & stops).any(axis=1)
+    return c, froze
+
+
 @jax.jit
 def penalize_logits(
     logits: jax.Array,       # [B, V]

@@ -46,7 +46,10 @@ def join_main(args) -> int:
 
     enable_compilation_cache(getattr(args, "compilation_cache_dir", None))
 
-    from parallax_tpu.config import load_config
+    from parallax_tpu.config import (
+        load_config,
+        resolve_speculative_tokens,
+    )
     from parallax_tpu.models.loader import load_stage_params
     from parallax_tpu.p2p.node import WorkerNode
     from parallax_tpu.parallel import make_mesh
@@ -167,6 +170,14 @@ def join_main(args) -> int:
             decode_lookahead=getattr(args, "decode_lookahead", None) or None,
             decode_fused=getattr(args, "decode_fused", None),
             decode_pipeline=getattr(args, "decode_pipeline", 1) or 1,
+            # On-device speculative decoding inside the K-step window
+            # (prompt-lookup proposals; docs/decode_loop.md). A decode-
+            # pool worker is where this pays: TPOT is the whole game
+            # there and the window keeps speculation off the host.
+            speculative_tokens=resolve_speculative_tokens(
+                getattr(args, "speculative_tokens", 0)
+            ),
+            speculative_ngram=getattr(args, "speculative_ngram", 3) or 3,
             sp_threshold=(
                 getattr(args, "sp_threshold", 2048)
                 if sp_size > 1 else None

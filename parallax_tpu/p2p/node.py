@@ -779,6 +779,12 @@ class WorkerNode:
                         "kernel": (
                             eng.kernel_dispatch_summary() if eng else None
                         ),
+                        # Speculative-decoding ledger (acceptance rate +
+                        # accepted-tokens/chip-s; None while spec is
+                        # off) — surfaced per node in /cluster/status.
+                        "spec": (
+                            eng.spec_summary() if eng else None
+                        ),
                         # Per-link activation-transport telemetry
                         # (bytes/frames each way, serialize/send ms,
                         # queue depth, compression ratio) — surfaced in

@@ -192,6 +192,51 @@ def _gather_feed(token_ids, last_tokens, slots):
     return jnp.where(slots >= 0, fed, token_ids)
 
 
+def widen_for_spec_window(
+    inputs: BatchInputs, width: int, num_real_seqs: int
+) -> BatchInputs:
+    """Re-shape a decode-only [S]-row template into the speculative
+    window's ragged multi-token layout: every bucket row owns ``width``
+    contiguous token slots (``t = S * width``), real rows' spans are
+    registered in ``cu_q_lens`` exactly as :func:`assemble` would for a
+    ``width``-token segment, and logits are gathered at EVERY fed
+    position (the window verifies all of them). The per-iteration
+    fields — token ids, positions, slot mapping, kv lens — are
+    placeholders the jitted window rebuilds from its scan carry each
+    step, so the static shapes here are the whole contract.
+
+    The widened batch is a multi-token ragged forward: ``decode_only``
+    (and with it the decode-fused Pallas kernels, which are single-token
+    by construction) turns off for the window's forward.
+    """
+    s = int(inputs.kv_lens.shape[0])
+    t = s * width
+    n = min(num_real_seqs, s)
+    cu = np.zeros((s + 1,), np.int32)
+    cu[1 : n + 1] = (np.arange(n, dtype=np.int32) + 1) * width
+    cu[n + 1 :] = cu[n]
+    return dataclasses.replace(
+        inputs,
+        decode_only=False,
+        decode_fused=False,
+        token_ids=jnp.zeros((t,), jnp.int32),
+        positions=jnp.zeros((t,), jnp.int32),
+        slot_mapping=jnp.full((t,), -1, jnp.int32),
+        cu_q_lens=jnp.asarray(cu),
+        logits_indices=jnp.arange(t, dtype=jnp.int32),
+    )
+
+
+def gather_device_feed(host_tokens, last_tokens, feed_slots):
+    """Per-ROW twin of :func:`substitute_device_tokens` for the
+    speculative window's [S]-shaped feed carry: rows with a
+    non-negative slot gather their first window token from the
+    device-resident last-token array; host rows keep their committed
+    token id. Enqueued between the in-flight step's sampler and the
+    window's first forward — no host round trip."""
+    return _gather_feed(host_tokens, last_tokens, feed_slots)
+
+
 def substitute_device_tokens(
     inputs: BatchInputs, last_tokens, feed_slots
 ) -> BatchInputs:

@@ -96,11 +96,12 @@ class EngineConfig:
     # None (the default) = ADAPTIVE: run ADAPTIVE_DECODE_LOOKAHEAD steps
     # per visit whenever the batch qualifies, and drop to single-step
     # automatically while any sync-forcing feature (penalties, logprobs,
-    # grammar, logit_bias, a speculative window, a prefill chunk) is in
-    # the batch. An explicit int pins K; 1 = off. The scheduler
-    # pre-allocates KV pages for the whole window and the engine falls
-    # back to K=1 when the allocator (or host-tier pressure behind it)
-    # cannot guarantee them.
+    # grammar, logit_bias, a prefill chunk) is in the batch. Speculative
+    # rows no longer downshift the window: proposals verify INSIDE the
+    # scan (the speculative window below). An explicit int pins K;
+    # 1 = off. The scheduler pre-allocates KV pages for the whole
+    # window and the engine falls back to K=1 when the allocator (or
+    # host-tier pressure behind it) cannot guarantee them.
     decode_lookahead: int | None = None
     # Pipelined multi-step decode: chain this many k-token windows per
     # host round. Window j+1 is dispatched from window j's device-resident
@@ -111,14 +112,27 @@ class EngineConfig:
     # a single window — surplus tokens past a mid-chain finish are
     # discarded). 1 = off.
     decode_pipeline: int = 1
-    # Speculative decoding: propose up to this many continuation tokens
-    # and verify them in ONE forward (greedy acceptance). 0 = off.
-    # Proposals come from prompt-lookup n-gram matches, or from a draft
-    # model when the engine was built with ``draft=`` (reference parity:
-    # the reference delegates speculation to its backends; here both
-    # proposers are native). Composes with the same eligibility rules as
-    # decode_lookahead; speculation wins when a proposal exists,
-    # lookahead otherwise.
+    # Speculative decoding: verify up to this many proposed continuation
+    # tokens per decode step. 0 = off. Proposals come from prompt-lookup
+    # n-gram matches over the committed context, or from a draft model
+    # when the engine was built with ``draft=`` (reference parity: the
+    # reference delegates speculation to its backends; here both
+    # proposers are native). On a single-stage engine with K > 1 the
+    # draft-verify loop runs ON DEVICE inside the K-step decode window:
+    # proposals are staged at dispatch, every scan iteration feeds
+    # 1 + speculative_tokens positions per row, verifies them in one
+    # ragged multi-token forward (greedy compare, or lockstep
+    # target-distribution sampling under the fold_in(key(seed),
+    # output_step) discipline for seeded rows), commits the
+    # longest-agreeing prefix plus the bonus token on device, and
+    # rewinds the context pointer past rejections exactly as the
+    # frozen-row rollback does — so speculation composes with
+    # overlapped dispatch, adaptive K, migration checkpoints and the
+    # disaggregated decode pool. K = 1 (or a window the planner cannot
+    # page) falls back to the host-synchronous single-round verify;
+    # multi-stage pipelines speculate via pp-spec (sync resolve); rows
+    # needing per-step host state do not speculate at all — both
+    # registered gates (analysis/gates.py, docs/decode_loop.md).
     speculative_tokens: int = 0
     speculative_ngram: int = 3
     # Overlapped decode: step() splits into dispatch() (form plan,
@@ -222,10 +236,10 @@ class StepTicket:
     device arrays.
 
     ``outputs`` is pre-filled for steps that resolved synchronously
-    inside dispatch (empty plans, fused multistep/speculative windows);
-    ``sync_only`` marks tickets whose rows need host-synchronous logits
-    processing — the driver loop must resolve them before dispatching
-    again."""
+    inside dispatch (empty plans); ``sync_only`` marks tickets whose
+    rows need host-synchronous logits processing (incl. the
+    speculative verify fallback) — the driver loop must resolve them
+    before dispatching again."""
 
     plan: BatchPlan
     step_idx: int
@@ -249,6 +263,16 @@ class StepTicket:
     # produced counts).
     ms_windows: list | None = None
     ms_state: tuple | None = None
+    # Speculative decode window: per-window [k, S] commit-count arrays
+    # (each scan iteration's tokens are [S, 1+spec]; counts bound the
+    # commits) plus staging metadata (width, per-row proposal source,
+    # per-source proposed-token counts) for the resolve-side ledgers.
+    ms_counts: list | None = None
+    spec_meta: dict | None = None
+    # Host-sync speculative verify fallback (K=1 / unpaged windows):
+    # (spec_plan, proposals) — the logits readback + accept loop runs
+    # at resolve, the designated sync point.
+    spec_verify: tuple | None = None
     outputs: "StepOutputs | None" = None
 
 
@@ -323,6 +347,16 @@ class DraftProposer:
         # sampler + device token feedback would be pure per-step overhead
         # inside the propose budget — run the draft engine sync.
         engine.cfg.overlap_steps = False
+        # Compile hygiene: the draft engine shares whatever persistent
+        # XLA compilation cache the process already activated (the
+        # serving entrypoints enable it BEFORE building the proposer),
+        # so enabling speculation never pays a second compile storm on
+        # restart. The proposer only records the active directory — it
+        # must never (re)point the cache itself; an embedder's explicit
+        # choice stands. tests/test_speculative.py pins this.
+        from parallax_tpu.utils.compile_cache import active_cache_dir
+
+        self.compile_cache_dir = active_cache_dir()
         self.max_propose_ms = max_propose_ms
         self._counter = 0
 
@@ -672,6 +706,36 @@ class StageEngine:
         # scan, and the fused-sampler variant never aliases the
         # sort-based one.
         self._jit_multistep: dict[tuple[int, bool, bool], object] = {}
+        # Speculative decode-window programs, keyed by (k, sampled,
+        # spec_width, proposal_buffer_len) — the proposal buffer length
+        # rides a pow2 lattice so staging-depth jitter never storms the
+        # compile cache.
+        self._jit_spec_multistep: dict[tuple[int, bool, int, int], object] = {}
+        # Speculation telemetry: proposed/accepted/rejected token counts
+        # by proposal source ({ngram, draft}), bumped on the resolve
+        # thread and summarized from heartbeat / /status threads.
+        from parallax_tpu.analysis.sanitizer import make_lock as _mk
+
+        self._spec_lock = _mk("engine.spec_counts")
+        with self._spec_lock:
+            self._spec_stats: dict[str, dict[str, int]] = {}
+        self._spec_t0 = time.monotonic()
+        from parallax_tpu.ops.kernel_select import spec_window_impl
+
+        self._spec_window_impl = spec_window_impl(model.use_pallas)
+        self._warned_spec_fused = False
+        self._warned_spec_host_state = False
+        if self.cfg.speculative_tokens > 0 and not (
+            model.is_first and model.is_last
+        ):
+            # Registered gate (analysis/gates.py): the on-device window
+            # needs the whole ring local; pipelines speculate through
+            # pp-spec, whose last-stage verify forces a sync resolve.
+            logger.warning(
+                "speculative decode windows disabled: multi-stage "
+                "pipeline verifies proposals via pp-spec with a "
+                "synchronous resolve",
+            )
         # Per-request LoRA adapters (ops/lora.py); None until the first
         # load_adapter so base-only serving never touches the machinery.
         self._adapters = None
@@ -1275,6 +1339,38 @@ class StageEngine:
         self._kernel_lock = make_lock("engine.kernel_counts")
         with self._kernel_lock:
             self._kernel_counts: dict[tuple[str, str], int] = {}
+        # Speculative decoding observability (docs/decode_loop.md): how
+        # many tokens each proposal source staged, how many survived
+        # verification, and how long proposing took — the operator's
+        # acceptance-rate tuning signal. Counters bump at resolve (the
+        # host already holds the window's counts there); the gauge is
+        # derived at collect time.
+        spec_lbl = ("stage", "source")
+        self._c_spec_proposed = reg.counter(
+            mnames.SPEC_PROPOSALS_TOTAL,
+            mnames.help_text(mnames.SPEC_PROPOSALS_TOTAL),
+            labelnames=spec_lbl,
+        )
+        self._c_spec_accepted = reg.counter(
+            mnames.SPEC_ACCEPTED_TOTAL,
+            mnames.help_text(mnames.SPEC_ACCEPTED_TOTAL),
+            labelnames=spec_lbl,
+        )
+        self._c_spec_rejected = reg.counter(
+            mnames.SPEC_REJECTED_TOTAL,
+            mnames.help_text(mnames.SPEC_REJECTED_TOTAL),
+            labelnames=spec_lbl,
+        )
+        self._h_spec_propose = reg.histogram(
+            mnames.SPEC_PROPOSE_MS,
+            mnames.help_text(mnames.SPEC_PROPOSE_MS),
+            labelnames=spec_lbl,
+        )
+        self._g_spec_accept = reg.gauge(
+            mnames.SPEC_ACCEPTANCE_RATE,
+            mnames.help_text(mnames.SPEC_ACCEPTANCE_RATE),
+            labelnames=st,
+        ).labels(**lbl)
         if model.is_first:
             self._h_ttft = reg.histogram(
                 mnames.TTFT_MS,
@@ -1316,6 +1412,13 @@ class StageEngine:
             self._c_resumes.set_total(stats.resumes)
             self._c_kv_oom.set_total(stats.kv_oom_aborts)
             self._c_evicted.set_total(stats.pages_evicted)
+        with self._spec_lock:
+            acc = sum(s.get("accepted", 0)
+                      for s in self._spec_stats.values())
+            rej = sum(s.get("rejected", 0)
+                      for s in self._spec_stats.values())
+        if acc + rej:
+            self._g_spec_accept.set(round(acc / (acc + rej), 6))
 
     def _count_kernel_dispatch(
         self, path: str, impl: str | None = None
@@ -1346,6 +1449,71 @@ class StageEngine:
                 f"{impl}/{path}": n
                 for (impl, path), n in sorted(counts.items())
             },
+        }
+
+    def _count_spec_proposed(self, source: str, n: int,
+                             propose_ms: float) -> None:
+        """``n`` proposal tokens staged from ``source`` ({ngram, draft})
+        plus the host milliseconds the staging pass took."""
+        if n <= 0:
+            return
+        self._c_spec_proposed.labels(
+            stage=self._obs_stage, source=source
+        ).inc(n)
+        self._h_spec_propose.labels(
+            stage=self._obs_stage, source=source
+        ).observe(propose_ms)
+        with self._spec_lock:
+            ent = self._spec_stats.setdefault(
+                source, {"proposals": 0, "accepted": 0, "rejected": 0}
+            )
+            ent["proposals"] += int(n)
+
+    def _count_spec_result(self, source: str, accepted: int,
+                           rejected: int) -> None:
+        """Verification outcome for one row's window: ``accepted``
+        proposal tokens survived (committed), ``rejected`` verify
+        positions were computed and discarded."""
+        if accepted:
+            self._c_spec_accepted.labels(
+                stage=self._obs_stage, source=source
+            ).inc(accepted)
+        if rejected:
+            self._c_spec_rejected.labels(
+                stage=self._obs_stage, source=source
+            ).inc(rejected)
+        with self._spec_lock:
+            ent = self._spec_stats.setdefault(
+                source, {"proposals": 0, "accepted": 0, "rejected": 0}
+            )
+            ent["accepted"] += int(accepted)
+            ent["rejected"] += int(rejected)
+
+    def spec_summary(self) -> dict | None:
+        """The ``spec`` payload for /status, heartbeats and
+        /cluster/status: per-source proposed/accepted/rejected totals,
+        the acceptance rate the tuning note keys off, and
+        accepted-tokens-per-chip-second (the goodput-honest headline —
+        rejected verify positions burn the same chip). None while
+        speculation is off (no payload bytes on the wire)."""
+        if self.cfg.speculative_tokens <= 0:
+            return None
+        with self._spec_lock:
+            by_source = {k: dict(v) for k, v in self._spec_stats.items()}
+        acc = sum(s["accepted"] for s in by_source.values())
+        rej = sum(s["rejected"] for s in by_source.values())
+        elapsed = max(1e-9, time.monotonic() - self._spec_t0)
+        return {
+            "enabled": True,
+            "width": self.cfg.speculative_tokens,
+            "proposals": sum(s["proposals"] for s in by_source.values()),
+            "accepted": acc,
+            "rejected": rej,
+            "acceptance_rate": (
+                round(acc / (acc + rej), 4) if acc + rej else 0.0
+            ),
+            "accepted_tokens_per_chip_second": round(acc / elapsed, 3),
+            "by_source": by_source,
         }
 
     def _warn_split_sampling(self, reason: str) -> None:
@@ -1610,14 +1778,17 @@ class StageEngine:
         return jax.jit(self._tp_wrap_multistep(fn),
                        donate_argnums=self._donate_kv)
 
-    def _tp_wrap_multistep(self, fn):
+    def _tp_wrap_multistep(self, fn, lead: int = 1):
         """SPMD-wrap a multistep fn for a TP-sharded stage: the whole
         k-step scan runs inside ONE shard_map over the tp axis (params and
         KV pages stay in their shard layout; the per-layer psums and the
         vocab-sharded lm_head all_gather happen inside the body exactly as
         in the per-step TP path), and the sampled tokens — identical on
         every shard after the gather — come back replicated, as do the
-        stop-state carries. No-op for unsharded engines."""
+        stop-state carries. ``lead`` counts the replicated token outputs
+        before the KV pytree in the fn's return tuple (1 for the plain
+        window, 2 for the speculative window's tokens + commit counts).
+        No-op for unsharded engines."""
         if self.mesh is None or self.model.tp_size <= 1:
             return fn
         from jax.sharding import PartitionSpec as P
@@ -1634,7 +1805,7 @@ class StageEngine:
             fn,
             mesh=self.mesh,
             in_specs=(param_specs, kv_specs, P(), P()),
-            out_specs=(P(), kv_specs, P(), P(), P(), P()),
+            out_specs=(P(),) * lead + (kv_specs, P(), P(), P(), P()),
             check_vma=False,
         )
 
@@ -1675,6 +1846,360 @@ class StageEngine:
             stop_tokens[i, : len(stop)] = stop
         return stop_tokens, limits, min_req
 
+    def _build_spec_multistep(self, k: int, sampled: bool, spec: int,
+                              prop_len: int):
+        """Jit a k-iteration SPECULATIVE decode window: the draft-verify
+        loop fused into the scan.
+
+        Every iteration feeds each row ``1 + spec`` tokens — the current
+        feed token plus the next ``spec`` entries of the row's staged
+        proposal buffer (indexed by the in-window ``produced`` count, so
+        a buffer that has stayed exact keeps predicting, and one the
+        stream diverged from simply stops matching) — runs ONE ragged
+        multi-token forward over the widened batch (logits gathered at
+        every fed position), derives the target token at each position
+        (argmax for the greedy variant; the lockstep filtered
+        categorical under the ``fold_in(key(seed), output_step)``
+        discipline for the sampled variant, ``output_step = steps0 +
+        produced + j``), and applies the vectorized acceptance rule
+        (:func:`ops.sampling.speculative_accept`): commit the longest
+        agreeing prefix plus the bonus/correction token, truncated by
+        the same stop/budget predicate the plain window applies.
+
+        Rejected positions' KV was appended past the live context; the
+        carry advances ``ctx`` only by the commit count, so the next
+        iteration overwrites those slots position-by-position — the
+        exact context-pointer rewind the frozen-row rollback uses, and
+        the reason no rejected token can ever leak into committed KV.
+        Frozen rows write nothing (slot -1), keep their context, and
+        repeat their feed.
+
+        Returns ``(tokens [k, S, 1+spec], counts [k, S], kv, feed, ctx,
+        stopped, produced)`` — the trailing five chain the next window
+        without any host sync, exactly like the plain window.
+        """
+        import dataclasses as _dc
+
+        from parallax_tpu.ops.sampling import speculative_accept
+
+        model = self.model
+        page_size = self.cfg.page_size
+        w = spec + 1
+
+        def step_inputs_at(inputs, fed, ctx, stopped):
+            js = jnp.arange(w, dtype=jnp.int32)
+            pos = (ctx - 1)[:, None] + js[None, :]          # [S, w]
+            safe = jnp.maximum(pos, 0)
+            page_of = jnp.minimum(
+                safe // page_size, inputs.page_indices.shape[1] - 1
+            )
+            phys = jnp.take_along_axis(inputs.page_indices, page_of,
+                                       axis=1)
+            live = ((ctx > 0) & ~stopped)[:, None]
+            slots = jnp.where(
+                live, phys * page_size + safe % page_size, jnp.int32(-1)
+            )
+            return _dc.replace(
+                inputs,
+                # -1 (no proposal) must still embed; it can never match
+                # a sampled target at the accept compare, which sees the
+                # raw -1.
+                token_ids=jnp.maximum(fed, 0).reshape(-1),
+                positions=pos.reshape(-1),
+                kv_lens=jnp.where(stopped, ctx, ctx + spec),
+                slot_mapping=slots.reshape(-1),
+            )
+
+        def fn(params, kv, inputs: BatchInputs, ms: dict):
+            s = inputs.kv_lens.shape[0]
+
+            def body(carry, step_i):
+                kv, feed, ctx, stopped, produced = carry
+                js = jnp.arange(spec, dtype=jnp.int32)
+                pidx = produced[:, None] + js[None, :]
+                props = jnp.where(
+                    pidx < prop_len,
+                    jnp.take_along_axis(
+                        ms["props"],
+                        jnp.clip(pidx, 0, prop_len - 1), axis=1,
+                    ),
+                    jnp.int32(-1),
+                )
+                fed = jnp.concatenate([feed[:, None], props], axis=1)
+                logits, kv = model(
+                    params, kv, step_inputs_at(inputs, fed, ctx, stopped)
+                )
+                logits = logits[: s * w]
+                if sampled:
+                    steps = (
+                        ms["steps"][:, None] + produced[:, None]
+                        + jnp.arange(w, dtype=jnp.int32)[None, :]
+                    ).reshape(-1)
+                    g = sample_tokens(
+                        logits,
+                        jax.random.fold_in(ms["key"], step_i),
+                        jnp.repeat(ms["temp"], w),
+                        jnp.repeat(ms["top_k"], w),
+                        jnp.repeat(ms["top_p"], w),
+                        jnp.repeat(ms["min_p"], w),
+                        seeds=jnp.repeat(ms["seeds"], w),
+                        out_steps=steps,
+                    ).reshape(s, w)
+                else:
+                    g = jnp.argmax(logits, axis=-1).astype(
+                        jnp.int32
+                    ).reshape(s, w)
+                c, froze = speculative_accept(
+                    g, props, produced, ms["stop_tokens"],
+                    ms["min_req"], ms["limit"], stopped,
+                )
+                produced = produced + c
+                ctx = ctx + c
+                stopped = stopped | froze
+                feed = jnp.where(
+                    c > 0,
+                    jnp.take_along_axis(
+                        g, jnp.maximum(c - 1, 0)[:, None], axis=1
+                    )[:, 0],
+                    feed,
+                )
+                return (kv, feed, ctx, stopped, produced), (g, c)
+
+            (kv, feed, ctx, stopped, produced), (toks, counts) = (
+                jax.lax.scan(
+                    body,
+                    (kv, ms["feed"], ms["ctx"], ms["stopped"],
+                     ms["produced"]),
+                    jnp.arange(k, dtype=jnp.int32),
+                )
+            )
+            return toks, counts, kv, feed, ctx, stopped, produced
+
+        return jax.jit(self._tp_wrap_multistep(fn, lead=2),
+                       donate_argnums=self._donate_kv)
+
+    def _spec_window_width(self, plan: BatchPlan, k: int,
+                           s_bucket: int) -> int:
+        """Eligible verify width for a speculative window over ``plan``
+        (0 = plain window): speculation on, single full stage, no
+        recurrent state (it cannot rewind), no mixed-adapter batch
+        (per-token slot vectors are one per row), and the 1+width token
+        rows must fit the batch token budget. CHEAP — no proposal work
+        happens until the scheduler has actually reserved the window's
+        pages (``_stage_spec_proposals``), so page pressure never burns
+        a draft-model forward per visit."""
+        p = self.cfg.speculative_tokens
+        if (
+            p <= 0
+            or self._needs_state
+            or plan.mixed_lora
+            or not (self.model.is_first and self.model.is_last)
+        ):
+            return 0
+        while p > 0 and s_bucket * (1 + p) > \
+                self.cfg.max_num_tokens_per_batch:
+            p -= 1
+        return max(0, p)
+
+    def _stage_spec_proposals(self, plan: BatchPlan, k: int, p: int):
+        """Stage per-row proposal buffers for an already-paged
+        speculative window. Returns ``(props [s_real, L] | None,
+        sources, propose_ms)`` — None when no proposal hit anywhere
+        (the caller then runs the plain window on the reservation it
+        already holds).
+
+        Proposals continue the host-committed context, so device-fed
+        rows (their last token lives only on device) stage an empty
+        buffer and ride the window at plain-decode behavior. The buffer
+        is capped at the most the window can consume
+        (``k * (1 + width) - 1`` tokens) and at each row's context/
+        generation budget; its padded length is the config cap's pow2
+        so staging depth never storms the compile cache.
+        """
+        t0 = time.perf_counter()
+        cap = k * (1 + p) - 1
+        budgets: list[int] = []
+        for seg in plan.seqs:
+            req = seg.request
+            sp = req.sampling_params
+            if seg.device_token:
+                budgets.append(0)
+                continue
+            budgets.append(max(0, min(
+                cap,
+                self.cfg.max_model_len - req.total_len - 1,
+                sp.max_new_tokens - req.num_generated - 1,
+            )))
+        proposals: list[list[int]] = []
+        sources: list[str | None] = []
+        if self.draft is not None:
+            rows = [i for i, b in enumerate(budgets) if b > 0]
+            drafted = self.draft.propose_batch(
+                [plan.seqs[i].request.all_token_ids for i in rows],
+                [budgets[i] for i in rows],
+            ) if rows else []
+            by_row = dict(zip(rows, drafted))
+            for i, seg in enumerate(plan.seqs):
+                prop = list(by_row.get(i, ()))[: budgets[i]]
+                proposals.append(prop)
+                sources.append("draft" if prop else None)
+        else:
+            for seg, budget in zip(plan.seqs, budgets):
+                prop = (
+                    self._ngram_proposal(
+                        seg.request.all_token_ids,
+                        self.cfg.speculative_ngram, budget,
+                    )
+                    if budget > 0 else []
+                )
+                proposals.append(list(prop)[: budget])
+                sources.append("ngram" if proposals[-1] else None)
+        propose_ms = (time.perf_counter() - t0) * 1000.0
+        longest = max((len(pr) for pr in proposals), default=0)
+        if longest <= 0:
+            return None, None, propose_ms
+        # Buffer length pinned to the CONFIG cap's pow2, not the staged
+        # depth: one compiled window program per (k, sampled, p) instead
+        # of one per proposal-length bucket (staging depth varies every
+        # window; the padding is a few hundred masked int32s).
+        length = 1
+        while length < cap:
+            length *= 2
+        props = np.full((len(plan.seqs), length), -1, np.int32)
+        staged: dict[str, int] = {}
+        for i, prop in enumerate(proposals):
+            if prop:
+                props[i, : len(prop)] = prop
+                staged[sources[i]] = staged.get(sources[i], 0) + len(prop)
+        for src, n in staged.items():
+            self._count_spec_proposed(src, n, propose_ms)
+        return props, sources, propose_ms
+
+    def _warn_spec_window_fused(self) -> None:
+        """Warn-once gate site (analysis/gates.py): a decode-fused
+        engine is running a speculative window — the multi-token verify
+        forward cannot dispatch the single-token fused kernel family."""
+        if self._warned_spec_fused:
+            return
+        self._warned_spec_fused = True
+        logger.warning(
+            "decode-fused kernels disabled for speculative windows: the "
+            "multi-token verify forward runs the split/XLA ragged path "
+            "(fused append and sampling are single-token by "
+            "construction); plain windows keep the fused kernels",
+        )
+
+    def _dispatch_spec_window(
+        self, plan: BatchPlan, t0: float, k: int, m: int, spec: int,
+        props: np.ndarray, sources: list, propose_ms: float,
+    ) -> StepTicket:
+        """ENQUEUE a chain of ``m`` speculative k-iteration decode
+        windows (see :meth:`_build_spec_multistep`) and return the
+        in-flight ticket. Mirrors the plain window's dispatch contract:
+        nothing blocks here, D2H copies start immediately, and the
+        driver's next dispatch overlaps the whole chain's compute."""
+        from parallax_tpu.runtime.batch import (
+            gather_device_feed,
+            widen_for_spec_window,
+        )
+
+        sampled = any(
+            seg.request.sampling_params.temperature > 0.0
+            or seg.request.sampling_params.seed is not None
+            for seg in plan.seqs
+        )
+        inputs0 = assemble(
+            plan, self.spec, self.cfg.page_size, decode_only=True,
+        )
+        lora = self._lora_field(plan, inputs0)
+        if lora is not None:
+            inputs0 = dataclasses.replace(inputs0, lora=lora)
+        s = int(inputs0.kv_lens.shape[0])
+        w = spec + 1
+        inputs = widen_for_spec_window(inputs0, w, len(plan.seqs))
+        if self._decode_fused:
+            self._warn_spec_window_fused()
+        self._count_kernel_dispatch("spec", self._spec_window_impl)
+        stop_tokens, limits, min_req = self._pack_stop_state(plan, s)
+        props_pad = np.full((s, props.shape[1]), -1, np.int32)
+        props_pad[: props.shape[0]] = props
+        host_feed = np.zeros((s,), np.int32)
+        feed_slots = np.full((s,), -1, np.int32)
+        any_fed = False
+        for i, seg in enumerate(plan.seqs):
+            if seg.device_token:
+                feed_slots[i] = self._token_slots[seg.request.request_id]
+                any_fed = True
+            else:
+                host_feed[i] = seg.token_ids[0]
+        feed = jnp.asarray(host_feed)
+        if any_fed:
+            feed = gather_device_feed(
+                feed, self._last_token_dev, jnp.asarray(feed_slots)
+            )
+        ms = dict(
+            stop_tokens=jnp.asarray(stop_tokens),
+            limit=jnp.asarray(limits),
+            min_req=jnp.asarray(min_req),
+            props=jnp.asarray(props_pad),
+        )
+        steps0 = None
+        if sampled:
+            temp, top_k, top_p, min_p, seeds, steps0, _ = (
+                self._pack_base_sampling(plan, s)
+            )
+            ms.update(
+                temp=jnp.asarray(temp), top_k=jnp.asarray(top_k),
+                top_p=jnp.asarray(top_p), min_p=jnp.asarray(min_p),
+                seeds=jnp.asarray(seeds), steps=jnp.asarray(steps0),
+            )
+            window_key = jax.random.fold_in(self._base_key,
+                                            self._step_count)
+        prop_len = int(props_pad.shape[1])
+        key = (k, sampled, spec, prop_len)
+        fn = self._jit_spec_multistep.get(key)
+        if fn is None:
+            fn = self._jit_spec_multistep[key] = (
+                self._build_spec_multistep(k, sampled, spec, prop_len)
+            )
+        windows: list = []
+        counts: list = []
+        ctx = inputs0.kv_lens
+        stopped = jnp.asarray(limits <= 0)
+        produced = jnp.zeros((s,), jnp.int32)
+        for wdx in range(m):
+            ms_w = dict(ms, feed=feed, ctx=ctx, stopped=stopped,
+                        produced=produced)
+            if sampled:
+                ms_w["key"] = jax.random.fold_in(window_key, wdx)
+            toks, cnts, self.kv, feed, ctx, stopped, produced = fn(
+                self.params, self.kv, inputs, ms_w
+            )
+            windows.append(toks)
+            counts.append(cnts)
+        self._last_fused_steps = m * k
+        for arr in (*windows, *counts, produced):
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:  # stubbed jit call in tests
+                pass
+        self.scheduler.on_batch_computed(plan)
+        step_idx = self._step_count
+        self._step_count += 1
+        ticket = StepTicket(
+            plan=plan, step_idx=step_idx, t0=t0,
+            ms_windows=windows, ms_counts=counts,
+            ms_state=(stopped, produced),
+            spec_meta={"width": spec, "sources": sources,
+                       "props": props,
+                       "lengths": (props >= 0).sum(axis=1).tolist(),
+                       "propose_ms": propose_ms},
+            dispatch_seq=self._dispatch_seq,
+        )
+        ticket.host_ms = (time.perf_counter() - t0) * 1000.0
+        self._inflight.append(ticket)
+        return ticket
+
     def _dispatch_multistep(
         self, plan: BatchPlan, t0: float
     ) -> StepTicket | None:
@@ -1701,15 +2226,46 @@ class StageEngine:
         k = self._effective_lookahead()
         if k <= 1 or not self._fused_common_ok(plan, allow_state=True):
             return None
-        m = self.scheduler.plan_decode_window(
-            plan, k,
-            max_windows=max(1, self.cfg.decode_pipeline),
-            max_model_len=self.cfg.max_model_len,
-        )
+        from parallax_tpu.runtime.batch import next_bucket
+
+        s_bucket = next_bucket(max(len(plan.seqs), 1),
+                               self.spec.seq_buckets)
+        spec_w = self._spec_window_width(plan, k, s_bucket)
+        m = 0
+        if spec_w > 0:
+            # Worst-case reservation: K * (1 + spec) tokens per row per
+            # window. Graceful downshift — a window the planner cannot
+            # page at spec width retries plain before dropping to K=1.
+            m = self.scheduler.plan_decode_window(
+                plan, k,
+                max_windows=max(1, self.cfg.decode_pipeline),
+                max_model_len=self.cfg.max_model_len, spec=spec_w,
+            )
+            if m <= 0:
+                spec_w = 0
+        if m <= 0:
+            m = self.scheduler.plan_decode_window(
+                plan, k,
+                max_windows=max(1, self.cfg.decode_pipeline),
+                max_model_len=self.cfg.max_model_len,
+            )
         if m <= 0:
             # Soft fallback to K=1 — the normal path probes +1 token
             # itself and owns the preemption/abort decisions.
             return None
+        if spec_w > 0:
+            # Proposals are staged only now, AFTER the reservation
+            # succeeded — page pressure must never burn a draft-model
+            # forward (or the counters) on a window that cannot run.
+            props, sources, propose_ms = self._stage_spec_proposals(
+                plan, k, spec_w
+            )
+            if props is not None:
+                return self._dispatch_spec_window(
+                    plan, t0, k, m, spec_w, props, sources, propose_ms
+                )
+            # No proposal hit anywhere: run the plain window on the
+            # (slightly larger) reservation already held.
         sampled = any(
             seg.request.sampling_params.temperature > 0.0
             or seg.request.sampling_params.seed is not None
@@ -1899,6 +2455,17 @@ class StageEngine:
         # (Internal __draft rows excluded, same as the commit hook.)
         self._goodput.count("committed", gp_committed)
         self._goodput.count("frozen_tail", gp_window - gp_committed)
+        return self._multistep_outputs(ticket, plan, total, t_r0,
+                                       device_ms)
+
+    def _multistep_outputs(
+        self, ticket: StepTicket, plan: BatchPlan, total: int,
+        t_r0: float, device_ms: float,
+    ) -> StepOutputs:
+        """The shared telemetry tail of the window resolvers (plain and
+        speculative): latency EWMA amortized over steps actually
+        delivered, per-visit/per-token timing, serve-time goodput,
+        traces, finish collection."""
         now = time.perf_counter()
         dt = (now - ticket.t0) * 1000.0
         host_ms = ticket.host_ms + (now - t_r0) * 1000.0
@@ -1925,6 +2492,106 @@ class StageEngine:
             device_ms=device_ms,
             overlapped=overlapped,
         )
+
+    def _resolve_spec_multistep(self, ticket: StepTicket) -> StepOutputs:
+        """Complete a speculative decode window chain: ONE D2H pass for
+        every iteration's target tokens ``[k, S, 1+spec]`` and commit
+        counts ``[k, S]`` (copies started at dispatch), then per-token
+        ``commit_token`` bounded by the device's counts — so the radix/
+        digest/trace/metrics planes see exactly the accepted stream and
+        phantom KV can never donate, the same rollback contract as the
+        plain window. Goodput classifies every computed position
+        exactly once: committed, ``speculative_rejected`` (live verify
+        positions whose proposal lost), or ``frozen_tail`` (slots past
+        a row's stop point, plus any device-committed tokens a raced
+        host abort rolled back)."""
+        plan = ticket.plan
+        t_r0 = time.perf_counter()
+        meta = ticket.spec_meta or {}
+        sources = meta.get("sources") or []
+        try:
+            tb = time.perf_counter()
+            toks = np.concatenate(
+                [np.asarray(x) for x in ticket.ms_windows], axis=0
+            )                                           # [m*k, S, w]
+            cnts = np.concatenate(
+                [np.asarray(x) for x in ticket.ms_counts], axis=0
+            )                                           # [m*k, S]
+            device_ms = (time.perf_counter() - tb) * 1000.0
+            w = int(toks.shape[2])
+            iters = int(toks.shape[0])
+            total = 0
+            gp_committed = gp_dev_committed = gp_live_pos = 0
+            gp_window = 0
+            lengths = meta.get("lengths") or []
+            props = meta.get("props")
+            for i, seg in enumerate(plan.seqs):
+                req = seg.request
+                committed = 0
+                dev_committed = 0
+                live_iters = 0
+                fed_props = 0
+                accepted = 0
+                plen = lengths[i] if i < len(lengths) else 0
+                for it in range(iters):
+                    c = int(cnts[it, i])
+                    if c <= 0:
+                        # Stopped rows stay stopped: the remaining
+                        # iterations are frozen tail for this row.
+                        continue
+                    live_iters += 1
+                    fed_props += min(w - 1, max(0, plen - dev_committed))
+                    for j in range(c):
+                        # A committed token at window-output index d was
+                        # an ACCEPTED proposal iff it equals the staged
+                        # buffer entry the device fed at that index —
+                        # exact even when a stop token truncates the
+                        # run with no bonus committed that iteration.
+                        d = dev_committed + j
+                        if (
+                            props is not None and d < plen
+                            and int(toks[it, i, j]) == int(props[i, d])
+                        ):
+                            accepted += 1
+                    dev_committed += c
+                    for j in range(c):
+                        if req.status.is_finished:
+                            break
+                        req.commit_token(int(toks[it, i, j]))
+                        committed += 1
+                    if req.status.is_finished:
+                        break
+                internal = req.request_id.startswith("__")
+                if not internal:
+                    gp_committed += committed
+                    gp_dev_committed += dev_committed
+                    gp_live_pos += live_iters * w
+                    gp_window += iters * w
+                src = sources[i] if i < len(sources) else None
+                if src is not None and not internal:
+                    accepted = min(accepted, fed_props)
+                    self._count_spec_result(
+                        src, accepted, fed_props - accepted,
+                    )
+                # Every committed token's predecessor was fed; dispatch
+                # counted one step (same invariant as the plain window).
+                req.num_computed_tokens += committed - 1
+                req.ready_for_step = not req.status.is_finished
+                total += committed
+        except Exception:
+            self._abandon(plan)
+            raise
+        self._goodput.count("committed", gp_committed)
+        self._goodput.count(
+            "speculative_rejected", gp_live_pos - gp_dev_committed
+        )
+        self._goodput.count(
+            "frozen_tail",
+            (gp_window - gp_live_pos)
+            + (gp_dev_committed - gp_committed),
+        )
+        return self._multistep_outputs(ticket, plan, total, t_r0,
+                                       device_ms)
 
     # -- speculative decoding (prompt-lookup) -----------------------------
 
@@ -1969,8 +2636,9 @@ class StageEngine:
     def _greedy_fast_path_ok(self, plan: BatchPlan) -> bool:
         """Pure greedy decode: acceptance can compare argmaxes (used by
         the pipeline-speculative path, whose last-stage verifier is
-        greedy). The single-stage speculative path no longer needs this —
-        sampled rows verify in lockstep (see _try_speculative)."""
+        greedy). The single-stage speculative paths no longer need this
+        — sampled rows verify in lockstep (see _dispatch_speculative and
+        the spec window)."""
         if not self._fused_common_ok(plan):
             return False
         for seg in plan.seqs:
@@ -1989,49 +2657,98 @@ class StageEngine:
         """Propose up to ``k`` continuation tokens: find the most recent
         earlier occurrence of the trailing ``n``-gram within the lookback
         window and copy what followed it (prompt-lookup decoding — exact
-        for repetitive spans, free to verify)."""
-        if len(tokens) <= n:
+        for repetitive spans, free to verify).
+
+        A match whose continuation runs to the end of the sequence means
+        the stream is periodic with the match distance as its period —
+        the copied span then CYCLES to fill ``k`` (the continuation of a
+        periodic sequence is periodic), so a tight output loop proposes
+        a full window instead of one period's worth. Wrong proposals
+        only cost acceptance, never correctness."""
+        if k <= 0 or len(tokens) <= n:
             return []
         window = tokens[-cls._SPEC_LOOKBACK:]
         tail = window[-n:]
         for start in range(len(window) - n - 1, -1, -1):
             if window[start:start + n] == tail:
                 follow = window[start + n : start + n + k]
-                if follow:
-                    return list(follow)
+                if not follow:
+                    continue
+                if len(follow) < k and start + n + len(follow) == len(window):
+                    d = len(window) - n - start
+                    follow = [
+                        window[start + n + (j % d)] for j in range(k)
+                    ]
+                return list(follow)[:k]
         return []
 
-    def _try_speculative(self, plan: BatchPlan) -> int | None:
-        """Speculative decode: extend each decode row with its proposal,
-        verify all positions in one forward, commit the longest agreeing
-        prefix plus the bonus token. Returns the commit count, or None to
-        use another path.
+    def _maybe_warn_spec_host_state(self, plan: BatchPlan) -> None:
+        """Warn-once gate site (analysis/gates.py): speculation is
+        configured but this decode batch's rows need per-step host
+        state, so neither the windowed nor the sync verify path may
+        run — the batch decodes one token per step."""
+        if self._warned_spec_host_state:
+            return
+        for seg in plan.seqs:
+            sp = seg.request.sampling_params
+            if (
+                seg.request.status is RequestStatus.DECODING
+                and (
+                    sp.presence_penalty or sp.frequency_penalty
+                    or sp.repetition_penalty != 1.0 or sp.logprobs
+                    or sp.json_schema or sp.logit_bias
+                    or seg.request.replay_ids
+                )
+            ):
+                self._warned_spec_host_state = True
+                logger.warning(
+                    "speculative decoding disabled: penalties/logprobs/"
+                    "grammar/logit-bias/replay rows need per-step host "
+                    "state — those batches decode on the synchronous "
+                    "single-token path",
+                )
+                return
+
+    def _dispatch_speculative(self, plan: BatchPlan,
+                              t0: float) -> StepTicket | None:
+        """The host-sync speculative FALLBACK (K=1, or a window the
+        planner could not page): extend each decode row with its
+        proposal, ENQUEUE one verify forward over the ragged multi-token
+        batch, and return a ``sync_only`` ticket —
+        :meth:`_resolve_speculative` reads the logits back, applies the
+        acceptance rule and commits, at the designated sync point. The
+        driver resolves the ticket before dispatching again, exactly
+        like every other host-state batch. Returns None to use another
+        path.
 
         Exactness (greedy rows): position ``j``'s argmax depends only on
         tokens before it, which match the true greedy stream up to the
         first proposal mismatch — everything committed is exactly what
         single-step greedy would have produced.
 
-        Exactness (sampled rows): verification samples each position from
-        the TARGET distribution under the engine's deterministic key
-        discipline (seeded rows: ``fold_in(key(seed), output_step)`` —
-        the same stream the per-step and fused-multistep paths draw), and
-        accepts while the proposal agrees with the *sampled* token. The
-        committed tokens are therefore bitwise the tokens sequential
-        sampling would have produced: speculation changes wall-clock,
-        never the distribution (and for seeded rows, not even the draw).
-        The reference has no sampled speculation; its executor is
-        per-token (base_executor.py:634-769).
+        Exactness (sampled rows): verification samples each position
+        from the TARGET distribution under the engine's deterministic
+        key discipline (seeded rows: ``fold_in(key(seed), output_step)``
+        — the same stream the per-step and fused-multistep paths draw),
+        and accepts while the proposal agrees with the *sampled* token:
+        speculation changes wall-clock, never the distribution (and for
+        seeded rows, not even the draw). The reference has no sampled
+        speculation; its executor is per-token
+        (base_executor.py:634-769).
 
         KV written for rejected suffixes lies past the committed context
         and is overwritten position-by-position by later steps.
         """
         k = self.cfg.speculative_tokens
-        if k <= 0 or not self._fused_common_ok(plan):
+        if k <= 0:
+            return None
+        if not self._fused_common_ok(plan):
+            self._maybe_warn_spec_host_state(plan)
             return None
 
         # Each row feeds >= 1 token; proposals must also fit the batch
         # token budget (and thus the largest assemble bucket).
+        t0p = time.perf_counter()
         spare = self.cfg.max_num_tokens_per_batch - len(plan.seqs)
         budgets = []
         for seg in plan.seqs:
@@ -2040,15 +2757,17 @@ class StageEngine:
                 k, max(0, spare), self.cfg.max_model_len - req.total_len - 1
             ))
         if self.draft is not None:
+            source = "draft"
             proposals = self.draft.propose_batch(
                 [seg.request.all_token_ids for seg in plan.seqs], budgets
             )
             # Clamp to the shared token budget in row order.
             for i, prop in enumerate(proposals):
-                take = min(len(prop), max(0, spare))
+                take = min(len(prop), max(0, spare), max(0, budgets[i]))
                 proposals[i] = prop[:take]
                 spare -= take
         else:
+            source = "ngram"
             proposals = []
             for seg, budget in zip(plan.seqs, budgets):
                 budget = min(budget, max(0, spare))
@@ -2059,6 +2778,7 @@ class StageEngine:
                     )
                     if budget > 0 else []
                 )
+                prop = list(prop)[: max(0, budget)]
                 spare -= len(prop)
                 proposals.append(prop)
         if not any(proposals):
@@ -2068,6 +2788,10 @@ class StageEngine:
                 seg.request, seg.request.total_len + len(prop)
             ):
                 return None   # soft fallback; normal path owns aborts
+        self._count_spec_proposed(
+            source, sum(len(p) for p in proposals),
+            (time.perf_counter() - t0p) * 1000.0,
+        )
 
         spec_segs = [
             ScheduledSeq(
@@ -2083,63 +2807,121 @@ class StageEngine:
         inputs = assemble(
             spec_plan, self.spec, self.cfg.page_size, gather_all_logits=True
         )
+        self._count_kernel_dispatch("spec", self._spec_window_impl)
         lora = self._lora_field(spec_plan, inputs)
         if lora is not None:
             inputs = dataclasses.replace(inputs, lora=lora)
-        logits, self.kv = self._jit_step(self.params, self.kv, inputs)
+        out, self.kv = self._jit_step(self.params, self.kv, inputs)
+        try:
+            out.copy_to_host_async()
+        except AttributeError:  # stubbed jit call in tests
+            pass
+        step_idx = self._step_count
+        self._step_count += 1
+        ticket = StepTicket(
+            plan=plan, step_idx=step_idx, t0=t0, inputs=inputs, out=out,
+            spec_verify=(spec_plan, proposals, source),
+            sync_only=True,
+            dispatch_seq=self._dispatch_seq,
+        )
+        ticket.host_ms = (time.perf_counter() - t0) * 1000.0
+        self._inflight.append(ticket)
+        return ticket
+
+    def _resolve_speculative(self, ticket: StepTicket) -> StepOutputs:
+        """Complete a sync-fallback speculative verify: read the logits
+        back (the designated sync point), derive per-position targets —
+        greedy argmax, or the lockstep seeded draw — and commit each
+        row's longest agreeing prefix plus the bonus token. Rejected
+        positions land in the goodput ledger's ``speculative_rejected``
+        bucket; their KV lies past the committed context and is
+        overwritten by later steps."""
         from parallax_tpu.ops.sampling import greedy_tokens, sample_tokens
 
-        all_greedy = all(
-            seg.request.sampling_params.temperature <= 0.0
-            and seg.request.sampling_params.seed is None
-            for seg in plan.seqs
-        )
-        if all_greedy:
-            # parallax: allow[hot-path-sync] speculative verify is a sync-forcing feature by contract — its ticket resolves synchronously
-            verified = np.asarray(greedy_tokens(logits))    # [T_bucket]
-        else:
-            # Lockstep sampled verification: every fed position draws from
-            # the TARGET distribution with the row's params and the SAME
-            # per-output-index key a sequential decode would use. Padded
-            # positions keep temp=0 (argmax, discarded).
-            entries = []
-            row = 0
-            for seg in spec_segs:
-                n_fed = seg.num_new_tokens
-                origin = self._row_sampling_fields(seg.request)[-1]
-                entries.append((seg.request, row, row + n_fed, origin))
-                row += n_fed
-            temp, top_k, top_p, min_p, seeds, steps = (
-                self._pack_lockstep_vectors(int(logits.shape[0]), entries)
+        plan = ticket.plan
+        spec_plan, proposals, source = ticket.spec_verify
+        spec_segs = spec_plan.seqs
+        t_r0 = time.perf_counter()
+        try:
+            all_greedy = all(
+                seg.request.sampling_params.temperature <= 0.0
+                and seg.request.sampling_params.seed is None
+                for seg in spec_segs
             )
-            key = jax.random.fold_in(self._base_key, self._step_count)
-            # parallax: allow[hot-path-sync] speculative verify is a sync-forcing feature by contract — its ticket resolves synchronously
-            verified = np.asarray(sample_tokens(
-                logits, key, temp, top_k, top_p, min_p,
-                seeds=seeds, out_steps=steps,
-            ))
+            tb = time.perf_counter()
+            if all_greedy:
+                verified = np.asarray(greedy_tokens(ticket.out))
+            else:
+                # Lockstep sampled verification: every fed position
+                # draws from the TARGET distribution with the row's
+                # params and the SAME per-output-index key a sequential
+                # decode would use. Padded positions keep temp=0
+                # (argmax, discarded).
+                entries = []
+                row = 0
+                for seg in spec_segs:
+                    n_fed = seg.num_new_tokens
+                    origin = self._row_sampling_fields(seg.request)[-1]
+                    entries.append((seg.request, row, row + n_fed, origin))
+                    row += n_fed
+                temp, top_k, top_p, min_p, seeds, steps = (
+                    self._pack_lockstep_vectors(
+                        int(ticket.out.shape[0]), entries
+                    )
+                )
+                key = jax.random.fold_in(self._base_key, ticket.step_idx)
+                verified = np.asarray(sample_tokens(
+                    ticket.out, key, temp, top_k, top_p, min_p,
+                    seeds=seeds, out_steps=steps,
+                ))
+            device_ms = (time.perf_counter() - tb) * 1000.0
 
-        total = 0
-        row = 0
-        for seg, prop in zip(spec_segs, proposals):
-            req = seg.request
-            n_fed = seg.num_new_tokens
-            g = verified[row : row + n_fed]
-            row += n_fed
-            committed = 0
-            for j in range(n_fed):
-                if req.status.is_finished:
-                    break
-                req.commit_token(int(g[j]))
-                committed += 1
-                # Keep accepting while the next fed token agrees with what
-                # verification just produced at this position.
-                if j < len(prop) and prop[j] != int(g[j]):
-                    break
-            req.num_computed_tokens += committed
-            req.ready_for_step = not req.status.is_finished
-            total += committed
-        return total
+            total = 0
+            fed_total = accepted_total = 0
+            row = 0
+            for seg, prop in zip(spec_segs, proposals):
+                req = seg.request
+                n_fed = seg.num_new_tokens
+                g = verified[row : row + n_fed]
+                row += n_fed
+                committed = 0
+                for j in range(n_fed):
+                    if req.status.is_finished:
+                        break
+                    req.commit_token(int(g[j]))
+                    committed += 1
+                    # Keep accepting while the next fed token agrees
+                    # with what verification produced at this position.
+                    if j < len(prop) and prop[j] != int(g[j]):
+                        break
+                req.num_computed_tokens += committed
+                req.ready_for_step = not req.status.is_finished
+                total += committed
+                if not req.request_id.startswith("__"):
+                    self._goodput.count("committed", committed)
+                    self._goodput.count(
+                        "speculative_rejected", n_fed - committed
+                    )
+                    if prop:
+                        # Exact accepted count: a committed token was an
+                        # accepted proposal iff it equals the proposal
+                        # at its position (a stop token truncating the
+                        # run on a matching proposal still counts).
+                        acc = sum(
+                            1 for j in range(min(committed, len(prop)))
+                            if int(g[j]) == prop[j]
+                        )
+                        fed_total += len(prop)
+                        accepted_total += acc
+            if fed_total:
+                self._count_spec_result(
+                    source, accepted_total, fed_total - accepted_total
+                )
+        except Exception:
+            self._abandon(plan)
+            raise
+        return self._multistep_outputs(ticket, plan, total, t_r0,
+                                       device_ms)
 
     def _extend_plan_pp_spec(self, plan: BatchPlan) -> None:
         """Multi-stage head: extend eligible decode rows with speculative
@@ -2321,27 +3103,29 @@ class StageEngine:
             # Tracing-off fast path: the set is empty unless sampling is
             # on, so the default config pays one falsy check here.
             self._trace_queue_wait(plan)
-        # Rows fed from the device-resident last-token array: their token
-        # value is unknown to the host, so the speculative path (which
-        # reads host token ids for its proposals) must not run this
-        # step. The multi-step window handles fed rows natively via the
-        # on-device last-token gather.
+        # The fused window path runs FIRST: with speculation configured
+        # it stages proposals and verifies them INSIDE the K-step scan
+        # (spec rows no longer downshift the window), and with
+        # speculation off it is the plain PR 6 window.
         fed_rows = any(seg.device_token for seg in plan.seqs)
-        if sp_plan is None and not fed_rows:
-            committed = self._try_speculative(plan)
-            if committed is not None:
-                dt = (time.perf_counter() - t0) * 1000.0
-                self._update_latency_ewma(dt)
-                self._step_count += 1
-                return _done(StepOutputs(
-                    forward=[],
-                    finished=self._collect_finished(),
-                    num_tokens=committed,
-                    step_time_ms=dt,
-                    host_ms=dt,
-                ))
         if sp_plan is None:
             ticket = self._dispatch_multistep(plan, t0)
+            if ticket is not None:
+                return ticket
+        # Host-sync verify fallback: K=1 (or a window the planner could
+        # not page) still speculates, one round per host visit. Rows fed
+        # from the device-resident last-token array are excluded — their
+        # token value is unknown to the host, so no proposal can
+        # continue their context (the window path handles fed rows
+        # natively via the on-device gather).
+        if (
+            sp_plan is None
+            and not fed_rows
+            and self.cfg.speculative_tokens > 0
+            and self.model.is_first
+            and self.model.is_last
+        ):
+            ticket = self._dispatch_speculative(plan, t0)
             if ticket is not None:
                 return ticket
         if (
@@ -2504,8 +3288,12 @@ class StageEngine:
                         ticket.plan, ticket.t0, time.perf_counter()
                     )
             return o
+        if ticket.ms_counts is not None:
+            return self._resolve_spec_multistep(ticket)
         if ticket.ms_windows is not None:
             return self._resolve_multistep(ticket)
+        if ticket.spec_verify is not None:
+            return self._resolve_speculative(ticket)
         plan = ticket.plan
         t_r0 = time.perf_counter()
         device_ms = 0.0

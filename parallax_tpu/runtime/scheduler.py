@@ -514,7 +514,7 @@ class Scheduler:
 
     def plan_decode_window(
         self, plan: BatchPlan, k: int, max_windows: int,
-        max_model_len: int,
+        max_model_len: int, spec: int = 0,
     ) -> int:
         """``decode_lookahead=K`` planning: pre-allocate KV pages for a
         chain of up to ``max_windows`` k-token decode windows over
@@ -530,15 +530,26 @@ class Scheduler:
         final single-window ``ensure_capacity`` may evict from the
         prefix tree, exactly as a single-step +1 probe would.
 
+        ``spec > 0`` plans a SPECULATIVE window: every scan iteration
+        feeds ``1 + spec`` tokens per row (the current feed plus the
+        staged proposals), so the worst case — every proposal accepted
+        everywhere — commits ``k * (1 + spec)`` tokens per window and
+        the reservation must cover it. The engine downshifts gracefully
+        on a 0 here: first to a plain window (``spec=0``), then to
+        single-step.
+
         The chain is clamped to every row's context room below
         ``max_model_len`` and to the largest remaining generation budget
-        (windows past every row's ``max_new_tokens`` are pure waste);
+        (windows past every row's ``max_new_tokens`` are pure waste —
+        under speculation a window still commits at least ``k`` tokens
+        per live row, so the plain-window clamp stays conservative);
         device-fed rows count their pending uncommitted token.
         """
+        k_eff = k * (1 + max(0, spec))
         m = max(1, max_windows)
         want = 1
         for seg in plan.seqs:
-            room = (max_model_len - seg.context_len) // k
+            room = (max_model_len - seg.context_len) // k_eff
             if room < 1:
                 return 0
             m = min(m, room)
@@ -557,7 +568,7 @@ class Scheduler:
             return sum(
                 max(
                     0,
-                    self.cache.pages_needed(seg.context_len + mm * k)
+                    self.cache.pages_needed(seg.context_len + mm * k_eff)
                     - len(seg.request.page_ids),
                 )
                 for seg in plan.seqs
@@ -567,7 +578,7 @@ class Scheduler:
             m -= 1
         if not all(
             self.cache.ensure_capacity(
-                seg.request, seg.context_len + m * k
+                seg.request, seg.context_len + m * k_eff
             )
             for seg in plan.seqs
         ):

@@ -220,6 +220,11 @@ class Node:
     # pallas-fused / pallas-split / xla + per-path counts); surfaced in
     # /cluster/status so a silent kernel fallback is operator-visible.
     kernel: dict | None = None
+    # Speculative-decoding ledger from heartbeats (per-source proposed/
+    # accepted/rejected totals, acceptance rate, accepted tokens per
+    # chip-second); surfaced in /cluster/status. None while speculation
+    # is off on the node.
+    spec: dict | None = None
     # Per-link activation-transport telemetry from heartbeats (bytes in/
     # out, serialize/send ms, queue depth, compression ratio per peer);
     # surfaced in /cluster/status.

@@ -201,12 +201,13 @@ class GlobalScheduler:
         health: dict | None = None,
         events: dict | None = None,
         kernel: dict | None = None,
+        spec: dict | None = None,
     ) -> None:
         self._events.put(
             ("update", node_id, layer_latency_ms, load, rtt_s, is_ready,
              refit_version, lora_adapters, step_timing, cache_stats,
              transport, metrics, cache_digests, busy, goodput, health,
-             events, kernel)
+             events, kernel, spec)
         )
 
     def enqueue_peer_down(self, reporter: str, peer: str,
@@ -484,6 +485,7 @@ class GlobalScheduler:
             health = rest[5] if len(rest) > 5 else None
             events = rest[6] if len(rest) > 6 else None
             kernel = rest[7] if len(rest) > 7 else None
+            spec = rest[8] if len(rest) > 8 else None
             if events is not None:
                 # Merge the node's flight-event batch even for unknown
                 # nodes: a churn victim's last beats are exactly the
@@ -516,6 +518,8 @@ class GlobalScheduler:
                 node.cache_stats = cache_stats
             if kernel is not None:
                 node.kernel = kernel
+            if spec is not None:
+                node.spec = spec
             if transport is not None:
                 node.transport = transport
             if metrics is not None:
@@ -997,6 +1001,12 @@ class GlobalScheduler:
                         # pallas-split / xla) + per-path dispatch
                         # counts from heartbeats (docs/kernels.md).
                         "kernel": n.kernel,
+                        # Speculative-decoding ledger from heartbeats:
+                        # per-source proposed/accepted/rejected totals,
+                        # acceptance rate, accepted tokens per
+                        # chip-second (docs/decode_loop.md). None while
+                        # speculation is off on the node.
+                        "spec": n.spec,
                         # Per-link activation-transport telemetry
                         # (bytes each way, serialize/send ms, queue
                         # depth, compression ratio) from heartbeats.

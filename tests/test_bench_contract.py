@@ -70,6 +70,31 @@ def test_bench_cpu_smoke_prints_one_json_line():
     # budget, and streams are bit-identical to the QoS-off run. The
     # off-vs-on TTFT improvement (wall-clock) is asserted in the CI qos
     # smoke step, not here.
+    # Speculative-decoding probe (detail.spec, docs/decode_loop.md):
+    # structural keys + the deterministic bit-identity verdicts for
+    # every cell of the on/off x K=1/K=8 x repetitive/random matrix.
+    # The wall-clock speedup comparison (spec-on strictly below
+    # spec-off at K=8 on the repetitive workload) is asserted in the
+    # CI spec smoke step, not here.
+    sp = rec["detail"]["spec"]
+    assert sp["speculative_tokens"] > 0, sp
+    for wl in ("repetitive", "random"):
+        for run in ("off_k8", "on_k8", "off_k1", "on_k1"):
+            cell = sp[wl][run]
+            assert cell["per_token_ms"] > 0, (wl, run, cell)
+            assert cell["decode_tokens"] > 0, (wl, run, cell)
+            assert "goodput" in cell, (wl, run, cell)
+        assert sp[wl]["bit_identical"] is True, sp[wl]
+        for run in ("on_k8", "on_k1"):
+            assert 0.0 <= sp[wl][run]["acceptance_rate"] <= 1.0, sp[wl]
+    assert sp["repetitive"]["seeded_bit_identical"] is True, sp
+    on_rep = sp["repetitive"]["on_k8"]
+    assert on_rep["proposals"] > 0, on_rep
+    assert on_rep["accepted"] > 0, on_rep
+    # Rejected verify positions land in the goodput ledger's
+    # speculative_rejected bucket — the honest waste accounting.
+    assert on_rep["goodput"]["speculative_rejected"] > 0, on_rep
+    assert on_rep["goodput"]["committed"] > 0, on_rep
     q = rec["detail"]["qos"]
     for run in ("unloaded", "off", "on"):
         for key in ("requests", "completed", "aborted", "interactive",
