@@ -1987,6 +1987,277 @@ def _kernel_probe(page_size: int) -> dict:
     }
 
 
+def _prefill_probe(page_size: int) -> dict:
+    """Prefill-roofline probe (detail.prefill, docs/kernels.md): three
+    sub-measurements on deterministic workloads.
+
+    ``kernel`` — the fused ragged chunked-prefill kernel vs the XLA
+    reference on ONE identical ragged chunk batch, with page CAPACITY
+    far above the valid span: the XLA reference scans full capacity
+    while the fused kernel streams only each row's valid pages, so the
+    per-token gap shows even in CPU interpret mode. The CI
+    fused-prefill smoke asserts fused strictly below XLA per token plus
+    the bit-identity verdicts.
+
+    ``warm_prefix`` — warm-prefix re-prefill with chunk skipping on vs
+    off: a donor prompt releases into the radix tree while a sharer
+    (admitted earlier, budget-starved) waits; with
+    ``prefill_chunk_skip`` on, the sharer's chunk planning re-consults
+    the tree and recomputes ZERO covered chunks. Streams must be
+    bit-identical either way.
+
+    ``interactive_under_long_prefill`` — interactive TTFT p50/p95 while
+    a long prompt chunk-prefills on the same engine (the mixed-pool
+    number; detail.disagg reports the disaggregated-pool counterpart
+    and the mixed-vs-disagg improvement verdict).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from parallax_tpu.config import normalize_config
+    from parallax_tpu.models.base import StageModel
+    from parallax_tpu.ops.attention import _ragged_paged_attention_xla
+    from parallax_tpu.ops.kernel_select import fused_interpret
+    from parallax_tpu.ops.kv_cache_ops import reshape_and_cache
+    from parallax_tpu.ops.prefill_fused_pallas import (
+        gqa_fused_prefill_pallas,
+    )
+    from parallax_tpu.runtime.engine import (
+        EngineConfig,
+        StageEngine,
+        drive_step,
+    )
+    from parallax_tpu.runtime.request import Request, SamplingParams
+
+    interp = fused_interpret()
+    rng = np.random.default_rng(21)
+
+    # -- fused vs XLA prefill chain on one ragged chunk batch ----------
+    hq, hkv, d, layers = 4, 2, 32, 2
+    page = max(8, page_size)
+    q_lens = [17, 2 * page, 33]          # ragged, one page-exact
+    cached = [0, 2 * page, 5]            # warm prefixes mid-stream
+    s = len(q_lens)
+    kv_lens = np.array([c + n for c, n in zip(cached, q_lens)], np.int32)
+    cu = np.concatenate([[0], np.cumsum(q_lens)]).astype(np.int32)
+    t = int(cu[-1])
+    tp = max(64, 1 << (t - 1).bit_length())
+    pps = 48                             # capacity >> valid pages
+    valid_pages = int(sum((int(n) + page - 1) // page for n in kv_lens))
+    num_pages = s * pps + 1
+    pages = (
+        np.arange(s * pps, dtype=np.int32).reshape(s, pps) + 1
+    )
+    slots = np.full((tp,), -1, np.int32)
+    for i in range(s):
+        for j in range(q_lens[i]):
+            pos = cached[i] + j
+            slots[cu[i] + j] = pages[i, pos // page] * page + pos % page
+    q = jnp.asarray(rng.normal(size=(tp, hq, d)), jnp.float32)
+    k_new = jnp.asarray(rng.normal(size=(tp, hkv, d)), jnp.float32)
+    v_new = jnp.asarray(rng.normal(size=(tp, hkv, d)), jnp.float32)
+    cache0 = jnp.asarray(
+        rng.normal(size=(num_pages, page, 2 * hkv, d)), jnp.float32
+    )
+    sinks = jnp.asarray(rng.normal(size=(hq,)), jnp.float32)
+    kv_lens_j, pages_j, cu_j, slots_j = (
+        jnp.asarray(kv_lens), jnp.asarray(pages), jnp.asarray(cu),
+        jnp.asarray(slots),
+    )
+    ns = jnp.asarray([s], jnp.int32)
+    sm = d ** -0.5
+
+    @jax.jit
+    def chain_fused(cache):
+        out = None
+        for _ in range(layers):
+            out, cache = gqa_fused_prefill_pallas(
+                q, k_new, v_new, cache, kv_lens_j, pages_j, cu_j, ns,
+                slots_j, sinks, sm_scale=sm, use_sinks=True,
+                q_block=32, interpret=interp,
+            )
+        return out, cache
+
+    @jax.jit
+    def chain_xla(cache):
+        out = None
+        for _ in range(layers):
+            cache = reshape_and_cache(cache, k_new, v_new, slots_j)
+            out = _ragged_paged_attention_xla(
+                q, cache, kv_lens_j, pages_j, cu_j, ns,
+                sm_scale=sm, sliding_window=None, soft_cap=None,
+                sinks=sinks,
+            )
+        return out, cache
+
+    def measure(fn):
+        outs = cend = None
+        for _ in range(3):   # warmup: compile + caches hot
+            outs, cend = fn(cache0)
+            jax.block_until_ready(outs)
+        walls = []
+        for _ in range(9):
+            t0 = time.perf_counter()
+            outs, cend = fn(cache0)
+            jax.block_until_ready((outs, cend))
+            walls.append((time.perf_counter() - t0) * 1000.0)
+        med = statistics.median(walls)
+        return {
+            "device_ms_median": round(med, 3),
+            "per_token_device_ms": round(med / t, 4),
+            "tokens_per_sec_per_chip": round(t / (med / 1000.0), 1),
+        }, np.asarray(outs), np.asarray(cend)
+
+    impls = {}
+    impls["pallas-fused"], out_f, cache_f = measure(chain_fused)
+    impls["xla"], out_x, cache_x = measure(chain_xla)
+    kernel = {
+        "batch_tokens": t,
+        "layers": layers,
+        "page_size": page,
+        "valid_pages": valid_pages,
+        "capacity_pages": s * pps,
+        "interpret_mode": interp,
+        "impls": impls,
+        "fused_below_xla": (
+            impls["pallas-fused"]["per_token_device_ms"]
+            < impls["xla"]["per_token_device_ms"]
+        ),
+        "cache_fused_vs_xla_identical": bool(
+            np.array_equal(cache_f, cache_x)
+        ),
+        "attn_out_close_fused_vs_xla": bool(
+            np.allclose(out_f[:t], out_x[:t], atol=5e-5, rtol=5e-5)
+        ),
+    }
+
+    # -- engine workloads: a tiny GQA stage, mode-independent ----------
+    cfg = normalize_config(dict(
+        architectures=["Qwen2ForCausalLM"], hidden_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=128, vocab_size=199,
+        max_position_embeddings=1024, tie_word_embeddings=False,
+    ))
+    model = StageModel(cfg, 0, 2, use_pallas=False)
+    params = model.init_params(jax.random.key(0), dtype=jnp.float32)
+
+    def drive(eng, reqs, first_token_wall=None):
+        t0 = time.perf_counter()
+        pending = None
+        while eng.has_work() or pending is not None:
+            _outs, pending = drive_step(eng, pending)
+            if first_token_wall is not None:
+                now = time.perf_counter()
+                for req in reqs:
+                    if req.request_id not in first_token_wall and (
+                            req.output_ids):
+                        first_token_wall[req.request_id] = (
+                            now - t0
+                        ) * 1000.0
+        return time.perf_counter() - t0
+
+    # Warm-prefix chunk skipping: donor a (16 exact pages) prefills in
+    # one 256-token step and releases immediately (max_new=1); sharer b
+    # (admitted the same step, zero budget left) plans its first chunk
+    # AFTER the release — the radix re-consult covers the whole donor
+    # prefix.
+    pg = 16
+    covered = 16 * pg
+    a_ids = [int(x) for x in rng.integers(1, 198, covered)]
+    b_ids = a_ids + [int(x) for x in rng.integers(1, 198, 64)]
+
+    def warm_run(chunk_skip: bool):
+        eng = StageEngine(model, params, EngineConfig(
+            page_size=pg, num_pages=96, max_model_len=512,
+            kv_dtype="float32", max_num_tokens_per_batch=covered,
+            overlap_steps=False, enable_prefix_cache=True,
+            prefill_chunk_skip=chunk_skip,
+        ))
+        a = Request("warm-a", prompt_ids=list(a_ids),
+                    sampling_params=SamplingParams(
+                        temperature=0.0, max_new_tokens=1,
+                        ignore_eos=True))
+        b = Request("warm-b", prompt_ids=list(b_ids),
+                    sampling_params=SamplingParams(
+                        temperature=0.0, max_new_tokens=4,
+                        ignore_eos=True))
+        eng.submit(a)
+        eng.submit(b)
+        wall = drive(eng, [a, b])
+        return eng, (a.output_ids, b.output_ids), wall
+
+    eng_on, streams_on, wall_on = warm_run(True)
+    eng_off, streams_off, wall_off = warm_run(False)
+    skipped_on = int(eng_on.cache.stats.tokens_chunk_skipped)
+    warm_prefix = {
+        "covered_tokens": covered,
+        "tokens_chunk_skipped_on": skipped_on,
+        "tokens_chunk_skipped_off": int(
+            eng_off.cache.stats.tokens_chunk_skipped
+        ),
+        "covered_tokens_recomputed_on": covered - skipped_on,
+        "wall_s_on": round(wall_on, 3),
+        "wall_s_off": round(wall_off, 3),
+        "re_prefill_speedup_wall": round(
+            wall_off / max(wall_on, 1e-9), 3
+        ),
+        "streams_bit_identical": streams_on == streams_off,
+    }
+
+    # Interactive TTFT while a 512-token prompt chunk-prefills (64
+    # tokens/step) on the same engine: the mixed-pool head-of-line
+    # number (detail.disagg carries the disaggregated counterpart).
+    long_ids = [int(x) for x in rng.integers(1, 198, 512)]
+    eng = StageEngine(model, params, EngineConfig(
+        page_size=pg, num_pages=128, max_model_len=768,
+        kv_dtype="float32", max_num_tokens_per_batch=64,
+        max_batch_size=8, enable_prefix_cache=False,
+    ))
+    long_req = Request("long", prompt_ids=list(long_ids),
+                       sampling_params=SamplingParams(
+                           temperature=0.0, max_new_tokens=4,
+                           ignore_eos=True))
+    inter = [
+        Request(f"inter-{i}",
+                prompt_ids=[int(x) for x in rng.integers(1, 198, 16)],
+                sampling_params=SamplingParams(
+                    temperature=0.0, max_new_tokens=4, ignore_eos=True))
+        for i in range(6)
+    ]
+    eng.submit(long_req)
+    for req in inter:
+        eng.submit(req)
+    ttfts: dict[str, float] = {}
+    drive(eng, inter + [long_req], first_token_wall=ttfts)
+    inter_ttfts = sorted(
+        v for k, v in ttfts.items() if k.startswith("inter-")
+    )
+
+    def pct(xs, p):
+        if not xs:
+            return 0.0
+        return round(xs[min(len(xs) - 1, int(p * len(xs)))], 2)
+
+    interactive = {
+        "long_prompt_tokens": len(long_ids),
+        "chunk_tokens": 64,
+        "requests": len(inter),
+        "completed": sum(
+            1 for r in inter if r.status.is_finished
+        ),
+        "ttft_p50_ms": pct(inter_ttfts, 0.5),
+        "ttft_p95_ms": pct(inter_ttfts, 0.95),
+        "long_ttft_ms": round(ttfts.get("long", 0.0), 2),
+    }
+
+    return {
+        "kernel": kernel,
+        "warm_prefix": warm_prefix,
+        "interactive_under_long_prefill": interactive,
+    }
+
+
 def _goodput_payload() -> dict:
     """The process goodput ledger's payload (tokens by usefulness
     bucket, time taxonomy, goodput fraction) for bench JSON."""
@@ -2600,6 +2871,17 @@ def _bench():
     if not on_tpu or os.environ.get("BENCH_KERNEL"):
         kernel_probe = _kernel_probe(page_size)
 
+    # Prefill-roofline probe: fused vs XLA prefill chains on one ragged
+    # chunk batch (capacity >> valid pages), warm-prefix re-prefill with
+    # chunk skipping on/off, and interactive TTFT under a long chunked
+    # prefill — the CI fused-prefill smoke asserts fused strictly below
+    # XLA per token, zero covered chunks recomputed, and stream
+    # bit-identity. Cheap on CPU (interpret mode, part of the smoke
+    # contract); opt-in on TPU (BENCH_PREFILL).
+    prefill_probe = None
+    if not on_tpu or os.environ.get("BENCH_PREFILL"):
+        prefill_probe = _prefill_probe(page_size)
+
     # Disaggregated prefill/decode probe: the same long-prefill +
     # chatty-decode workload served by a mixed pool and by a prefill
     # specialist handing requests to a decode specialist over the
@@ -2831,6 +3113,13 @@ def _bench():
             **(
                 {"kernel": kernel_probe}
                 if kernel_probe is not None else {}
+            ),
+            # Prefill roofline (fused vs XLA per-token device ms,
+            # warm-prefix chunk-skip recompute, interactive TTFT under
+            # a long chunked prefill).
+            **(
+                {"prefill": prefill_probe}
+                if prefill_probe is not None else {}
             ),
             **(
                 {

@@ -149,6 +149,48 @@ GATE_TABLE: tuple[Gate, ...] = (
                "construction — plain windows keep the fused kernels",
     ),
     Gate(
+        feature="prefill_fused",
+        marker="prefill-fused kernels disabled: non-TPU backend",
+        doc="docs/kernels.md",
+        reason="auto mode keeps the split/XLA prefill attention path "
+               "off-TPU; --prefill-fused forces the fused ragged-prefill "
+               "kernel in Pallas interpret mode (CI parity, not a "
+               "serving configuration)",
+    ),
+    Gate(
+        feature="prefill_fused",
+        marker="prefill_fused forced on a non-TPU backend",
+        doc="docs/kernels.md",
+        reason="explicit opt-in runs the fused ragged-prefill kernel in "
+               "interpret mode — correct but slow; the CI parity "
+               "configuration",
+    ),
+    Gate(
+        feature="prefill_fused",
+        marker="prefill-fused kernel unavailable for this model family",
+        doc="docs/kernels.md",
+        reason="MLA latent-page and MSA sparse-index prefill have their "
+               "own dispatch chains; the fused ragged-prefill kernel "
+               "covers the GQA page layout only",
+    ),
+    Gate(
+        feature="prefill_seq_parallel",
+        marker="sequence-parallel prefill disabled: single-chip stage",
+        doc="docs/kernels.md",
+        reason="sharding one prompt's chunks needs an sp mesh axis with "
+               "more than one chip; ordinary chunked prefill proceeds "
+               "on the single chip",
+    ),
+    Gate(
+        feature="prefill_chunk_skip",
+        marker="prefill chunk skipping disabled",
+        doc="docs/kernels.md",
+        reason="A-B safety knob: turning skipping off forces the Python "
+               "cache manager so admission prefix reuse stays off too — "
+               "strictly-recompute-everything semantics for digest "
+               "comparison",
+    ),
+    Gate(
         feature="qos",
         marker="qos park enforcement disabled: no host KV tier",
         doc="docs/qos.md",

@@ -79,13 +79,18 @@ FSM_EDGES: tuple[FsmEdge, ...] = tuple(
     _edges("admission", ("PENDING", "PREFILLING"), ("PREFILLING",),
            "runtime/scheduler.py",
            "wait-queue request admitted with prompt KV allocated")
-    # Preempt-to-host: a running decode parked to the host KV tier
-    # (memory pressure or QoS shed enforcement).
-    + _edges("preempt", ("DECODING",), ("PREEMPTED",),
+    # Preempt-to-host: a running request parked to the host KV tier.
+    # DECODING src: memory pressure or QoS shed enforcement (capacity
+    # preemption only ever picks decode victims). PREFILLING src:
+    # migration/handoff parks a mid-prefill request with a partial KV
+    # image (resumable partial-prefill checkpoints, docs/migration.md).
+    + _edges("preempt", ("DECODING", "PREFILLING"), ("PREEMPTED",),
              "runtime/scheduler.py",
-             "running decode swapped out to the host KV tier")
-    # Swap-in resume of a preempted request (pages restored).
-    + _edges("swap-in", ("PREEMPTED",), ("DECODING",),
+             "running request swapped out to the host KV tier")
+    # Swap-in resume of a preempted request (pages restored). Resumes
+    # into DECODING when prefill had finished, else back into PREFILLING
+    # at the computed-token mark (the chunk loop continues from there).
+    + _edges("swap-in", ("PREEMPTED",), ("DECODING", "PREFILLING"),
              "runtime/scheduler.py",
              "preempted request's KV image swapped back in")
     # Prefill completion: the final prompt chunk computed.
@@ -541,6 +546,7 @@ CKPT_FIELDS: tuple[str, ...] = (
     "v", "rid", "prompt_ids", "output_ids", "output_logprobs",
     "sampling_params", "eos_token_ids", "lora_id", "routing_table",
     "age_s", "parked_wall", "traced", "handoff", "trace_spans", "kv",
+    "prefill_computed_tokens",
 )
 
 

@@ -85,6 +85,7 @@ def generate_main(args) -> int:
             # None/0 = adaptive multi-step decode (engine default).
             decode_lookahead=getattr(args, "decode_lookahead", None) or None,
             decode_fused=getattr(args, "decode_fused", None),
+            prefill_fused=getattr(args, "prefill_fused", None),
         ),
         mesh=mesh,
     )

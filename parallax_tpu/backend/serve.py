@@ -275,6 +275,14 @@ def serve_main(args) -> int:
 
     tp_size = getattr(args, "tp_size", 0)
     sp_size = getattr(args, "sp_size", 0) or 0
+    prefill_seq_parallel = bool(getattr(args, "prefill_seq_parallel", False))
+    if prefill_seq_parallel and sp_size <= 1 and (tp_size or 0) <= 1:
+        # One-knob sequence-parallel prefill: claim every local chip for
+        # the seq axis when neither --sp-size nor TP spoke for them. The
+        # engine gates the single-chip case with a registered warning.
+        import jax as _jax
+
+        sp_size = len(_jax.local_devices())
     from parallax_tpu.parallel.sp import sp_eligible
 
     if sp_size > 1 and not sp_eligible(config):
@@ -408,6 +416,11 @@ def serve_main(args) -> int:
             decode_pipeline=getattr(args, "decode_pipeline", 1) or 1,
             # Fused decode kernels (None = auto-on-TPU; docs/kernels.md).
             decode_fused=getattr(args, "decode_fused", None),
+            # Fused ragged-prefill kernel + prefix-aware chunk skipping
+            # + seq-parallel long-context prefill (docs/kernels.md).
+            prefill_fused=getattr(args, "prefill_fused", None),
+            prefill_chunk_skip=getattr(args, "prefill_chunk_skip", True),
+            prefill_seq_parallel=prefill_seq_parallel,
             # A configured draft model implies speculation (default k=4).
             speculative_tokens=resolve_speculative_tokens(
                 getattr(args, "speculative_tokens", 0),

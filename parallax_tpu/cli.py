@@ -80,6 +80,32 @@ def build_parser() -> argparse.ArgumentParser:
                             "TPU, XLA reference path elsewhere; "
                             "--decode-fused off-TPU runs interpret mode "
                             "(parity testing only)")
+    serve.add_argument("--prefill-fused",
+                       action=argparse.BooleanOptionalAction,
+                       default=None,
+                       help="fused ragged chunked-prefill Pallas kernel: "
+                            "KV append + flash attention over the paged "
+                            "context in one program per layer "
+                            "(docs/kernels.md). Default: auto — on on "
+                            "TPU, split/XLA path elsewhere; "
+                            "--prefill-fused off-TPU runs interpret mode "
+                            "(parity testing only)")
+    serve.add_argument("--prefill-chunk-skip",
+                       action=argparse.BooleanOptionalAction,
+                       default=True,
+                       help="prefix-aware chunk skipping: re-consult the "
+                            "radix tree at chunk-planning time so a warm "
+                            "prefix that landed after admission skips its "
+                            "covered chunks (docs/kernels.md). "
+                            "--no-prefill-chunk-skip forces the Python "
+                            "cache manager with admission reuse off (A-B "
+                            "digest comparison)")
+    serve.add_argument("--prefill-seq-parallel", action="store_true",
+                       help="shard one long prompt's prefill across this "
+                            "stage's chips over the mesh seq axis "
+                            "(one-knob alternative to --sp-size: claims "
+                            "all local devices when tp is off; "
+                            "docs/kernels.md)")
     serve.add_argument("--speculative-tokens", type=int, default=0,
                        help="speculative decoding: verify up to N "
                             "proposed continuation tokens per decode "
@@ -343,6 +369,20 @@ def build_parser() -> argparse.ArgumentParser:
              "fused sampling; default auto-on-TPU — see docs/kernels.md)",
     )
     join.add_argument(
+        "--prefill-fused", action=argparse.BooleanOptionalAction,
+        default=None,
+        help="fused ragged chunked-prefill Pallas kernel (KV append + "
+             "flash attention over the paged context in one program; "
+             "default auto-on-TPU — see docs/kernels.md)",
+    )
+    join.add_argument(
+        "--prefill-chunk-skip", action=argparse.BooleanOptionalAction,
+        default=True,
+        help="prefix-aware chunk skipping at chunk-planning time "
+             "(docs/kernels.md); --no-prefill-chunk-skip forces the "
+             "Python cache manager with admission reuse off",
+    )
+    join.add_argument(
         "--compilation-cache-dir", default=None,
         help="persistent XLA compilation cache directory (default: "
              "$PARALLAX_TPU_COMPILE_CACHE or "
@@ -402,6 +442,10 @@ def build_parser() -> argparse.ArgumentParser:
                      default=None,
                      help="fused Pallas decode kernels (default "
                           "auto-on-TPU — see docs/kernels.md)")
+    gen.add_argument("--prefill-fused",
+                     action=argparse.BooleanOptionalAction, default=None,
+                     help="fused ragged chunked-prefill Pallas kernel "
+                          "(default auto-on-TPU — see docs/kernels.md)")
     gen.add_argument(
         "--compilation-cache-dir", default=None,
         help="persistent XLA compilation cache directory (default: "

@@ -62,6 +62,15 @@ class BatchInputs:
     decode_fused: bool = dataclasses.field(
         default=False, metadata=dict(static=True)
     )
+    # STATIC: fused prefill program (EngineConfig.prefill_fused): GQA
+    # attention layers append the chunk's K/V inside the ragged Pallas
+    # prefill kernel (ops/prefill_fused_pallas.py) instead of a separate
+    # scatter dispatch. Covers every multi-token ragged shape (prefill,
+    # chunked prefill, mixed batches); mutually exclusive with
+    # decode_fused per batch. Part of the jit cache key.
+    prefill_fused: bool = dataclasses.field(
+        default=False, metadata=dict(static=True)
+    )
 
 
 class StageModel:
@@ -322,6 +331,7 @@ class StageModel:
             sp_in_mesh=self.sp_in_mesh if self._sp_active else 0,
             decode_only=inputs.decode_only,
             decode_fused=inputs.decode_fused,
+            prefill_fused=inputs.prefill_fused,
         )
 
     def _decoder_layer(

@@ -230,6 +230,7 @@ def paged_attention_block(
     sp_in_mesh: int = 0,
     decode_only: bool = False,
     decode_fused: bool = False,
+    prefill_fused: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """GQA attention over the paged cache: project, rope, scatter, attend.
 
@@ -313,6 +314,7 @@ def paged_attention_block(
             use_pallas=use_pallas,
             decode_only=decode_only,
             decode_fused=decode_fused,
+            prefill_fused=prefill_fused,
         )
     out = row_parallel_linear(out.reshape(t, hq * d), p["o_proj"], axis_name)
     return out, kv_pages

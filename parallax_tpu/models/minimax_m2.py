@@ -66,6 +66,7 @@ class MiniMaxM2StageModel(MoEStageModel):
             sm_scale=d**-0.5, sliding_window=window,
             use_pallas=self.use_pallas, decode_only=inputs.decode_only,
             decode_fused=inputs.decode_fused,
+            prefill_fused=inputs.prefill_fused,
         )
         return (
             L.row_parallel_linear(out.reshape(t, hq * d), p["o_proj"],

@@ -168,6 +168,7 @@ class MiniMaxM3StageModel(MoEStageModel):
                 sliding_window=None, use_pallas=self.use_pallas,
                 decode_only=inputs.decode_only,
                 decode_fused=inputs.decode_fused,
+                prefill_fused=inputs.prefill_fused,
             )
             new_kv = kv_pages
         out = L.row_parallel_linear(

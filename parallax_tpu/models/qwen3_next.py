@@ -141,6 +141,7 @@ class Qwen3NextStageModel(MoEStageModel):
             sm_scale=d**-0.5, use_pallas=self.use_pallas,
             decode_only=inputs.decode_only,
             decode_fused=inputs.decode_fused,
+            prefill_fused=inputs.prefill_fused,
         )
         hq = q.shape[1]
         out = out.reshape(t, hq * d) * jax.nn.sigmoid(

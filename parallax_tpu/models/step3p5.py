@@ -56,6 +56,7 @@ class Step3p5StageModel(MoEStageModel):
             sm_scale=d**-0.5, sliding_window=window,
             use_pallas=self.use_pallas, decode_only=inputs.decode_only,
             decode_fused=inputs.decode_fused,
+            prefill_fused=inputs.prefill_fused,
         )
         if "g_proj" in p:
             # Head-wise attention gate (reference step3p5.py:133-135).

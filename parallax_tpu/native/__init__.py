@@ -412,6 +412,59 @@ class NativeCacheManager:
         request.page_ids.extend(out[:need].tolist())
         return True
 
+    def extend_prefix_match(self, request) -> int:
+        """Mid-prefill chunk skipping — semantics mirror
+        ``CacheManager.extend_prefix_match`` (the behavioral oracle).
+        This is a rare per-request event (a donor released after this
+        request was admitted), not the admit/grow/release hot path, so
+        per-call ABI crossings are fine here. The native tree has no
+        host tier, so there is no host-node truncation case."""
+        if not self.enable_prefix_cache:
+            return 0
+        if self.linear_state:
+            # Linear-state skips need the recurrence snapshot wired at
+            # the skip boundary, which only the admission match sets up.
+            return 0
+        if getattr(request, "mirror_head_cached", None) is not None:
+            # Mirrors may only skip what the head skipped.
+            return 0
+        num_shared = self._shared.get(request.request_id)
+        if num_shared is None:
+            return 0
+        prompt_len = request.num_prompt_tokens
+        if prompt_len <= 1:
+            return 0
+        tokens = self._ns_i32(
+            request.prompt_ids, getattr(request, "lora_id", None)
+        )
+        pages, full_path = self.prefix_cache.match_prefix(tokens)
+        usable = min(len(pages), (prompt_len - 1) // self.page_size)
+        if usable <= num_shared:
+            return 0
+        new_shared = pages[:usable]
+        if new_shared[:num_shared] != request.page_ids[:num_shared]:
+            # The tree's page chain diverged from what this request
+            # pinned at admission — refuse rather than corrupt.
+            return 0
+        # Lock the longer path before unlocking the old one so shared
+        # ancestors never drop to zero refs in between. The old locked
+        # path is the num_shared-prefix of the same token stream.
+        self.prefix_cache.lock(
+            self.prefix_cache.slice_path(full_path, usable)
+        )
+        self.prefix_cache.unlock(
+            self.prefix_cache.slice_path(full_path, num_shared)
+        )
+        self.allocator.free(request.page_ids[num_shared:usable])
+        request.page_ids = new_shared + request.page_ids[usable:]
+        request.num_cached_tokens = usable * self.page_size
+        request.num_computed_tokens = usable * self.page_size
+        self._shared[request.request_id] = usable
+        skipped = (usable - num_shared) * self.page_size
+        self.stats.tokens_hit_device += skipped
+        self.stats.tokens_chunk_skipped += skipped
+        return skipped
+
     def release(self, request) -> None:
         n_shared = self._shared.pop(request.request_id, 0)
         snapshots = list(getattr(request, "state_snapshots", {}).values())

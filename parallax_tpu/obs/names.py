@@ -43,6 +43,7 @@ KV_PREEMPTIONS_TOTAL = "parallax_kv_preemptions_total"
 KV_RESUMES_TOTAL = "parallax_kv_resumes_total"
 KV_OOM_TOTAL = "parallax_kv_oom_total"
 KV_PAGES_EVICTED_TOTAL = "parallax_kv_pages_evicted_total"
+PREFILL_TOKENS_SKIPPED_TOTAL = "parallax_prefill_tokens_skipped_total"
 
 # -- activation transport (p2p/node.py) -------------------------------------
 TRANSPORT_BYTES_OUT_TOTAL = "parallax_transport_bytes_out_total"
@@ -141,6 +142,10 @@ HELP: dict[str, str] = {
     KV_RESUMES_TOTAL: "Preempted requests swapped back in",
     KV_OOM_TOTAL: "Last-resort kv_oom aborts",
     KV_PAGES_EVICTED_TOTAL: "Device pages reclaimed from the prefix tree",
+    PREFILL_TOKENS_SKIPPED_TOTAL: (
+        "Prompt tokens skipped by mid-prefill prefix-cache chunk "
+        "skipping (radix re-consult after admission)"
+    ),
     TRANSPORT_BYTES_OUT_TOTAL: "Wire bytes sent per link",
     TRANSPORT_BYTES_IN_TOTAL: "Wire bytes received per link",
     TRANSPORT_FRAMES_OUT_TOTAL: "Frames sent per link",

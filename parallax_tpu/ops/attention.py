@@ -74,6 +74,7 @@ def append_and_attend(
     use_pallas: bool | None = None,
     decode_only: bool = False,
     decode_fused: bool = False,
+    prefill_fused: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """Write this step's K/V into the paged cache and attend — the one
     facade every GQA model calls (``models/layers.py`` and the model
@@ -83,10 +84,13 @@ def append_and_attend(
     sequence) this is ONE fused Pallas program per layer: the append is
     a single-row DMA inside the attention kernel
     (``decode_fused_pallas.gqa_fused_decode_pallas``), subsuming the
-    separate ``reshape_and_cache`` scatter dispatch. Every other shape
-    (prefill, mixed batches, fused off) keeps the split path:
-    scatter, then :func:`ragged_paged_attention`. Returns
-    ``(out, kv_pages)``.
+    separate ``reshape_and_cache`` scatter dispatch. ``prefill_fused``
+    does the same for every multi-token ragged shape (prefill, chunked
+    prefill, mixed batches, speculative windows) via
+    ``prefill_fused_pallas.gqa_fused_prefill_pallas`` — per-row block
+    DMAs replace the scatter and the attention streams only valid
+    pages. With both off, the split path: scatter, then
+    :func:`ragged_paged_attention`. Returns ``(out, kv_pages)``.
     """
     from parallax_tpu.ops.kernel_select import fused_interpret
 
@@ -98,6 +102,18 @@ def append_and_attend(
         return gqa_fused_decode_pallas(
             q, k, v, kv_pages, kv_lens, page_indices, slot_mapping,
             sinks,
+            sm_scale=sm_scale, sliding_window=sliding_window,
+            soft_cap=soft_cap, use_sinks=sinks is not None,
+            interpret=fused_interpret(),
+        )
+    if prefill_fused:
+        from parallax_tpu.ops.prefill_fused_pallas import (
+            gqa_fused_prefill_pallas,
+        )
+
+        return gqa_fused_prefill_pallas(
+            q, k, v, kv_pages, kv_lens, page_indices, cu_q_lens,
+            num_seqs, slot_mapping, sinks,
             sm_scale=sm_scale, sliding_window=sliding_window,
             soft_cap=soft_cap, use_sinks=sinks is not None,
             interpret=fused_interpret(),
@@ -171,15 +187,27 @@ def ragged_paged_attention(
                 sm_scale=sm_scale, sliding_window=sliding_window,
                 use_sinks=True,
             )
-        # Prefill with sinks: fall back loudly — the XLA path materializes
-        # per-token KV copies; chunked prefill bounds the blowup.
-        import warnings
-
-        warnings.warn(
-            "attention sinks in prefill on TPU: using the XLA fallback "
-            "attention path (memory-heavy); bounded by chunked prefill",
-            stacklevel=2,
+        # Prefill with sinks: the fused ragged-prefill kernel handles
+        # sinks natively in attend-only mode (the chunk's K/V are
+        # already in the cache here), retiring the old warn-once
+        # memory-heavy XLA fallback. Off-TPU callers never reach this
+        # branch (use_pallas is False) and keep the XLA reference path
+        # below — that downgrade is the registered ``prefill_fused``
+        # gate (analysis/gates.py).
+        from parallax_tpu.ops.kernel_select import fused_interpret
+        from parallax_tpu.ops.prefill_fused_pallas import (
+            gqa_fused_prefill_pallas,
         )
+
+        out, _ = gqa_fused_prefill_pallas(
+            q, None, None, kv_pages, kv_lens, page_indices, cu_q_lens,
+            num_seqs,
+            jnp.full((q.shape[0],), -1, jnp.int32), sinks,
+            sm_scale=sm_scale, sliding_window=sliding_window,
+            soft_cap=soft_cap, use_sinks=True,
+            interpret=fused_interpret(),
+        )
+        return out
     if use_pallas and sinks is None:
         from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (
             ragged_paged_attention as _pallas_rpa,

@@ -12,12 +12,19 @@ pays per layer. A sort-free filtered top-k/greedy sampling kernel
 decode window (``engine._dispatch_multistep``) is one device program
 whose per-step work is kernel-only.
 
-Fusion boundary: every kernel here is single-token-per-sequence by
-construction (the in-kernel append targets one slot per row, the fused
-sampler reads one logits row per row). The SPECULATIVE decode window
-feeds ``1 + speculative_tokens`` positions per row and verifies them
-all, so its forward runs the split-Pallas/XLA ragged multi-token path
-instead (``ops/kernel_select.spec_window_impl`` — a registered gate,
+Fusion boundary: every kernel in THIS module is single-token-per-
+sequence by construction (the in-kernel append targets one slot per
+row, the fused sampler reads one logits row per row). Multi-token
+ragged prefill batches have their own fused twin —
+``ops/prefill_fused_pallas.py`` reuses :func:`online_softmax_update`
+over a flattened token-block grid and appends whole chunks in-kernel —
+so between the two modules every non-speculative batch shape has a
+fused path. The SPECULATIVE decode window feeds
+``1 + speculative_tokens`` positions per row and verifies them all,
+which neither fused form models (the decode append is one slot per
+row; the prefill kernel has no fused sampler), so its forward runs
+the split-Pallas/XLA ragged multi-token path instead
+(``ops/kernel_select.spec_window_impl`` — a registered gate,
 ``analysis/gates.py``); the fused family resumes the moment the batch
 drops back to plain windows or single-step decode.
 

@@ -35,6 +35,8 @@ IMPL_XLA = "xla"
 
 _warned_non_tpu_fused = False
 _warned_auto_off = False
+_warned_non_tpu_prefill = False
+_warned_prefill_auto_off = False
 
 
 def tpu_available() -> bool:
@@ -86,11 +88,54 @@ def resolve_decode_fused(decode_fused: bool | None) -> bool:
     return bool(decode_fused)
 
 
+def resolve_prefill_fused(prefill_fused: bool | None) -> bool:
+    """Engine-level fused-prefill choice, mirroring
+    :func:`resolve_decode_fused`: None = auto-on-TPU; True forces the
+    fused ragged-prefill kernel anywhere (interpret mode off-TPU — the
+    CI parity path); False keeps the split scatter + ragged-attention
+    chain.
+
+    The single warning site for the non-TPU downgrade — registered as
+    the ``prefill_fused`` gate in analysis/gates.py.
+    """
+    global _warned_non_tpu_prefill, _warned_prefill_auto_off
+    if prefill_fused is None:
+        on = tpu_available()
+        if not on and not _warned_prefill_auto_off:
+            _warned_prefill_auto_off = True
+            logger.info(
+                "prefill-fused kernels disabled: non-TPU backend keeps "
+                "the split prefill attention path (--prefill-fused "
+                "forces the fused kernel in Pallas interpret mode)",
+            )
+        return on
+    if prefill_fused and not tpu_available() and not _warned_non_tpu_prefill:
+        _warned_non_tpu_prefill = True
+        logger.info(
+            "prefill_fused forced on a non-TPU backend: the fused "
+            "ragged-prefill Pallas kernel runs in interpret mode "
+            "(correct but slow — the CI parity configuration, not a "
+            "serving one)",
+        )
+    return bool(prefill_fused)
+
+
 def decode_attn_impl(
     decode_fused: bool, use_pallas: bool | None
 ) -> str:
     """The canonical impl label for a stage's decode attention path."""
     if decode_fused:
+        return IMPL_FUSED
+    if resolve_use_pallas(use_pallas):
+        return IMPL_SPLIT
+    return IMPL_XLA
+
+
+def prefill_attn_impl(
+    prefill_fused: bool, use_pallas: bool | None
+) -> str:
+    """The canonical impl label for a stage's prefill attention path."""
+    if prefill_fused:
         return IMPL_FUSED
     if resolve_use_pallas(use_pallas):
         return IMPL_SPLIT

@@ -169,6 +169,11 @@ def join_main(args) -> int:
             # tickets like any other overlapped step.
             decode_lookahead=getattr(args, "decode_lookahead", None) or None,
             decode_fused=getattr(args, "decode_fused", None),
+            # Fused ragged-prefill kernel + prefix-aware chunk skipping
+            # (docs/kernels.md); the seq-parallel knob stays flag-driven
+            # through --sp-size on workers (the mesh is carved above).
+            prefill_fused=getattr(args, "prefill_fused", None),
+            prefill_chunk_skip=getattr(args, "prefill_chunk_skip", True),
             decode_pipeline=getattr(args, "decode_pipeline", 1) or 1,
             # On-device speculative decoding inside the K-step window
             # (prompt-lookup proposals; docs/decode_loop.md). A decode-

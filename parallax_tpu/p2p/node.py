@@ -2013,6 +2013,26 @@ class WorkerNode:
                 req.abort("migration: no serviceable pipeline")
                 self._finish(req)
 
+    @staticmethod
+    def _harvestable(req: Request) -> bool:
+        """Whether a park can carry this request's KV as a checkpoint
+        image: a decode row past prefill (the classic case), or a
+        MID-PREFILL row with computed tokens of its own — its partial
+        image lets the target resume the chunked prefill at the
+        computed-token mark instead of recomputing from token zero
+        (resumable partial-prefill checkpoints). A PREFILLING row whose
+        computed span is all radix-shared has nothing of its own to
+        ship (``preempt_to_host`` would refuse anyway); PREEMPTED rows
+        already live in the host tier and restore via replay."""
+        from parallax_tpu.runtime.request import RequestStatus
+
+        return (
+            req.status is RequestStatus.DECODING and req.is_prefill_done
+        ) or (
+            req.status is RequestStatus.PREFILLING
+            and req.num_computed_tokens > 0
+        )
+
     def _park_request(
         self, eng, req: Request, dead_peer: str, force: bool = False
     ) -> None:
@@ -2022,18 +2042,21 @@ class WorkerNode:
 
         rid = req.request_id
         image = None
-        if (
-            not force
-            and req.status is RequestStatus.DECODING
-            and req.is_prefill_done
-            and eng.host_tier is not None
-        ):
+        if not force and eng.host_tier is not None and self._harvestable(req):
             # The committed KV image parks in the host tier exactly like
             # a preemption (PR 2); the checkpoint serializes it so a
             # layout-compatible target swaps it in instead of
             # recomputing. Failure just means re-prefill at the target.
+            # A mid-prefill park (resumable partial-prefill checkpoints)
+            # first trims the owned pages down to the computed span —
+            # prompt pages were allocated upfront, and the ones holding
+            # no KV yet must not ship.
             preempt = getattr(eng.cache, "preempt_to_host", None)
             try:
+                if req.status is RequestStatus.PREFILLING:
+                    trim = getattr(eng.cache, "trim_uncomputed_pages", None)
+                    if trim is not None:
+                        trim(req)
                 if preempt is not None and preempt(req):
                     image = eng.harvest_kv_image(req)
             except Exception:
@@ -2479,13 +2502,13 @@ class WorkerNode:
 
         rid = req.request_id
         image = None
-        if (
-            req.status is RequestStatus.DECODING
-            and req.is_prefill_done
-            and eng.host_tier is not None
-        ):
+        if eng.host_tier is not None and self._harvestable(req):
             preempt = getattr(eng.cache, "preempt_to_host", None)
             try:
+                if req.status is RequestStatus.PREFILLING:
+                    trim = getattr(eng.cache, "trim_uncomputed_pages", None)
+                    if trim is not None:
+                        trim(req)
                 if preempt is not None and preempt(req):
                     image = eng.harvest_kv_image(req)
             except Exception:

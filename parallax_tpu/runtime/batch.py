@@ -80,6 +80,7 @@ def assemble(
     decode_only: bool = False,
     gather_all_logits: bool = False,
     decode_fused: bool = False,
+    prefill_fused: bool = False,
 ) -> BatchInputs:
     """Build fixed-shape arrays from a ragged plan.
 
@@ -160,6 +161,13 @@ def assemble(
         # append this step's K/V inside the Pallas kernel, reading the
         # page-table/ragged-lens layout assembled above directly.
         decode_fused=decode_fused and decode_only,
+        # Fused prefill program: the multi-token twin — attention layers
+        # run the ragged Pallas prefill kernel with the in-kernel append.
+        # Chunk-skipped prefixes are already encoded in the layout above
+        # (query rows offset past cached_len, kv_lens/page_indices
+        # spanning the full cached context), so the kernel needs no
+        # extra signal.
+        prefill_fused=prefill_fused and not decode_only,
         state_slots=state_slots,
         dense_map=dense_map,
         q_lens=q_lens_arr,
@@ -219,6 +227,7 @@ def widen_for_spec_window(
         inputs,
         decode_only=False,
         decode_fused=False,
+        prefill_fused=False,
         token_ids=jnp.zeros((t,), jnp.int32),
         positions=jnp.zeros((t,), jnp.int32),
         slot_mapping=jnp.full((t,), -1, jnp.int32),

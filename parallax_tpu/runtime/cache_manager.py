@@ -62,6 +62,7 @@ def make_cache_manager(
     on_slot_free=None,
     host_tier=None,
     track_digests: bool = False,
+    prefill_chunk_skip: bool = True,
 ):
     """CacheManager factory: the C++ manager (ONE ABI crossing per
     admit/grow/release — ``native.NativeCacheManager``) by default when
@@ -89,6 +90,15 @@ def make_cache_manager(
         logger.info(
             "prefix-digest publishing requested: using the Python cache "
             "manager (the native tree does not expose per-node evictions)"
+        )
+        use_native = False
+    if not prefill_chunk_skip and use_native:
+        # The native manager matches/pins inside C on admission; only the
+        # Python manager can keep inserting (digest parity) while
+        # declining to reuse. Registered gate (analysis/gates.py).
+        logger.info(
+            "prefill chunk skipping disabled: using the Python cache "
+            "manager (radix inserts still populate, admission reuse off)"
         )
         use_native = False
     if host_tier is not None and not os.environ.get(
@@ -120,6 +130,7 @@ def make_cache_manager(
         max_model_len=max_model_len, linear_state=linear_state,
         on_slot_free=on_slot_free, host_tier=host_tier,
         track_digests=track_digests,
+        prefill_chunk_skip=prefill_chunk_skip,
     )
 
 
@@ -204,11 +215,17 @@ class CacheManager:
         on_slot_free=None,
         host_tier=None,
         track_digests: bool = False,
+        prefill_chunk_skip: bool = True,
     ):
         self.page_size = page_size
         self.num_pages = num_pages
         self.max_model_len = max_model_len
         self.enable_prefix_cache = enable_prefix_cache
+        # Prefix-aware chunk skipping (EngineConfig.prefill_chunk_skip):
+        # False keeps the radix tree populating on release (digest
+        # parity, routing) but admission and mid-prefill planning stop
+        # REUSING matches — every chunk recomputes. A/B + debug knob.
+        self.prefill_chunk_skip = prefill_chunk_skip
         # Hybrid models: prefix hits additionally need a linear-state
         # snapshot at the skip boundary (reference linear prefix slots,
         # cache_manager.py:96-103,422-447); matches truncate to the deepest
@@ -289,7 +306,11 @@ class CacheManager:
         path = []  # empty match path (both impls accept [] for lock/unlock)
         if self.linear_state and hasattr(request, "restore_state_from"):
             del request.restore_state_from  # stale from a failed admit
-        if self.enable_prefix_cache and prompt_len > 1:
+        if (
+            self.enable_prefix_cache
+            and self.prefill_chunk_skip
+            and prompt_len > 1
+        ):
             pages, full_path = self.prefix_cache.match_prefix(
                 self._ns_tokens(request.prompt_ids, request.lora_id)
             )
@@ -374,6 +395,81 @@ class CacheManager:
         )
         return True
 
+    def extend_prefix_match(self, request: Request) -> int:
+        """Mid-prefill chunk skipping: re-consult the radix tree before a
+        request's FIRST chunk and grow its shared prefix if a donor
+        finished (and inserted) after this request was admitted.
+
+        Radix insertion only happens at :meth:`release`, so a request
+        admitted while its prefix donor was still running gets a shallow
+        admission match; by the time its first chunk is planned the tree
+        may cover far more. The extension stays a pure prefix-growth —
+        the request's own fresh pages over the newly covered span are
+        freed and replaced by tree-shared (locked) pages, preserving the
+        contiguous shared-prefix invariant every preemption/release path
+        relies on (``owned = page_ids[num_shared:]``).
+
+        Callers must only invoke this while
+        ``num_computed_tokens == num_cached_tokens`` (no chunk computed
+        past the admission skip — anything deeper is no longer a prefix
+        swap). Returns the number of newly skipped tokens (0 = no
+        change). Never allocates; only frees.
+        """
+        if not (self.enable_prefix_cache and self.prefill_chunk_skip):
+            return 0
+        if self.linear_state:
+            # Linear-state skips need the recurrence snapshot wired at
+            # the skip boundary (restore_state_from), which assemble
+            # only honors on the request's first chunk dispatch — the
+            # admission-time match is the one that set it up; keep it.
+            return 0
+        if getattr(request, "mirror_head_cached", None) is not None:
+            # Mirror stages may only skip what the head skipped: rows
+            # before the head's boundary never arrive on the wire.
+            return 0
+        entry = self._locked.get(request.request_id)
+        if entry is None:
+            return 0
+        old_path, num_shared = entry
+        prompt_len = request.num_prompt_tokens
+        if prompt_len <= 1:
+            return 0
+        pages, full_path = self.prefix_cache.match_prefix(
+            self._ns_tokens(request.prompt_ids, request.lora_id)
+        )
+        usable = min(len(pages), (prompt_len - 1) // self.page_size)
+        # Host-resident nodes in the extension would need a swap-in
+        # allocation; truncate the growth at the first one (the
+        # admission path owns swap-in orchestration).
+        new_path = self.prefix_cache.slice_path(full_path, usable)
+        for i, node in enumerate(new_path[num_shared:], start=num_shared):
+            if not node.on_device:
+                usable = i
+                new_path = self.prefix_cache.slice_path(full_path, usable)
+                break
+        if usable <= num_shared:
+            return 0
+        new_shared = pages[:usable]
+        if new_shared[:num_shared] != request.page_ids[:num_shared]:
+            # The tree's page chain diverged from what this request
+            # pinned at admission (should not happen while locked) —
+            # refuse rather than corrupt.
+            return 0
+        # Lock the longer path before unlocking the old one so shared
+        # ancestors never drop to zero refs in between.
+        self.prefix_cache.lock(new_path)
+        self.prefix_cache.unlock(old_path)
+        replaced = request.page_ids[num_shared:usable]
+        self.allocator.free(replaced)
+        request.page_ids = new_shared + request.page_ids[usable:]
+        request.num_cached_tokens = usable * self.page_size
+        request.num_computed_tokens = usable * self.page_size
+        self._locked[request.request_id] = (new_path, usable)
+        skipped = (usable - num_shared) * self.page_size
+        self.stats.tokens_hit_device += skipped
+        self.stats.tokens_chunk_skipped += skipped
+        return skipped
+
     def ensure_capacity(self, request: Request, new_total_tokens: int) -> bool:
         """Grow the page list to cover ``new_total_tokens`` (decode append).
 
@@ -389,6 +485,26 @@ class CacheManager:
         except OutOfPages:
             return False
         return True
+
+    def trim_uncomputed_pages(self, request: Request) -> int:
+        """Free a mid-prefill request's owned pages past its computed
+        span. ``allocate_for_prompt`` allocates the WHOLE prompt's pages
+        upfront, so a request parked mid-prefill owns pages holding no
+        KV yet; a preemption image that demoted them would ship garbage
+        and overrun the checkpoint wire bound (one page of slack past
+        the computed tokens). The prefill chunk loop re-grows the list
+        through ``ensure_capacity`` after resume. Returns the number of
+        pages freed."""
+        keep = max(
+            self.pages_needed(request.num_computed_tokens),
+            self._locked.get(request.request_id, ([], 0))[1],
+        )
+        tail = request.page_ids[keep:]
+        if not tail:
+            return 0
+        self.allocator.free(tail)
+        del request.page_ids[keep:]
+        return len(tail)
 
     # -- preemption (decode OOM -> host tier, not abort) ------------------
 

@@ -114,6 +114,14 @@ class RequestCheckpoint:
     # accounts it under parallax_kv_handoffs_* instead of the migration
     # families, so churn dashboards stay churn-only.
     handoff: bool = False
+    # Resumable partial-prefill progress: prompt tokens whose KV was
+    # computed at park time, or 0 when prefill had finished (the decode
+    # cases carry no mark — the whole prompt is implied). A target that
+    # adopts the KV image resumes the chunked prefill AT this mark
+    # instead of recomputing from token zero; without an image the
+    # replay path re-prefills from scratch, which is always correct.
+    # Cross-checked against ``kv.computed_tokens`` at decode.
+    prefill_computed_tokens: int = 0
 
 
 # Span-shipping bound: a traced request's decode epochs coalesce
@@ -201,6 +209,9 @@ def checkpoint_from_request(
         traced=req.traced,
         kv=kv,
         trace_spans=trace_spans,
+        prefill_computed_tokens=(
+            0 if req.is_prefill_done else req.num_computed_tokens
+        ),
     )
 
 
@@ -267,6 +278,7 @@ def checkpoint_to_wire(ckpt: RequestCheckpoint) -> dict:
         "parked_wall": float(ckpt.parked_wall),
         "traced": bool(ckpt.traced),
         "handoff": bool(ckpt.handoff),
+        "prefill_computed_tokens": int(ckpt.prefill_computed_tokens),
     }
     if ckpt.trace_spans:
         d["trace_spans"] = list(ckpt.trace_spans[:_MAX_TRACE_SPANS])
@@ -402,6 +414,21 @@ def checkpoint_from_wire(d: dict) -> RequestCheckpoint:
             raise CheckpointError(
                 "kv image covers more tokens than the checkpoint holds"
             )
+    try:
+        prefill_computed = int(d.get("prefill_computed_tokens") or 0)
+    except (TypeError, ValueError) as e:
+        raise CheckpointError(f"prefill progress malformed: {e}")
+    if prefill_computed:
+        # Mid-prefill park: the mark must sit strictly inside the
+        # restored prompt (folded outputs included) and agree with the
+        # KV image when one shipped — a disagreement means a corrupt or
+        # mixed-up frame, not a resumable request.
+        if not 0 < prefill_computed < len(prompt_ids) + len(output_ids):
+            raise CheckpointError("prefill progress out of range")
+        if kv is not None and kv.computed_tokens != prefill_computed:
+            raise CheckpointError(
+                "prefill progress disagrees with the kv image"
+            )
     # Trace spans are observability freight: bounded and type-checked
     # but never a reason to reject the frame (TraceStore.adopt
     # sanitizes field-by-field on use).
@@ -425,4 +452,5 @@ def checkpoint_from_wire(d: dict) -> RequestCheckpoint:
         kv=kv,
         trace_spans=trace_spans,
         handoff=bool(d.get("handoff", False)),
+        prefill_computed_tokens=prefill_computed,
     )

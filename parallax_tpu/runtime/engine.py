@@ -184,6 +184,36 @@ class EngineConfig:
     # (analysis/gates.py) and visible in /status `kernel` and the
     # parallax_attn_kernel_dispatch_total{impl,path} counter.
     decode_fused: bool | None = None
+    # Fused prefill kernel (ops/prefill_fused_pallas.py, docs/kernels.md):
+    # multi-token ragged batches (prefill, chunked prefill, mixed) run
+    # the flash-style fused Pallas kernel — the chunk's K/V append
+    # happens inside the attention program, only valid KV pages are
+    # streamed, and GQA sinks / sliding windows / soft caps are handled
+    # natively (retiring the old memory-heavy XLA sink-prefill
+    # fallback). None (default) = auto: on on TPU, off elsewhere. True
+    # forces the kernel anywhere (Pallas interpret mode off-TPU — the
+    # CI parity/microbench configuration). MLA/MSA model families keep
+    # the split path (their prefill kernels are bespoke); all fallbacks
+    # are registered gates (analysis/gates.py) and visible in /status
+    # `kernel` and parallax_attn_kernel_dispatch_total{impl,path}.
+    prefill_fused: bool | None = None
+    # Prefix-cache chunk skipping (docs/kernels.md "Chunk skipping"):
+    # admission AND mid-prefill chunk planning consult the radix tree so
+    # a warm prefix hit never re-feeds covered chunks — query rows start
+    # past cached_len while attention spans the full cached page table.
+    # Streams stay bit-identical with strictly fewer prefill FLOPs;
+    # False recomputes every chunk (A/B + debugging knob; the radix tree
+    # itself still populates, so digests stay equal).
+    prefill_chunk_skip: bool = True
+    # Sequence-parallel long-context prefill (docs/kernels.md "The seq
+    # axis"): shard one giant prompt's prefill across the stage's chips
+    # over the mesh ``sp`` axis with an all-gathered KV append, instead
+    # of head-of-line blocking a single chip. True asks serve.py to
+    # carve the sp axis from the stage's local devices when --sp-size
+    # was not given (and defaults sp_threshold); on a single-chip stage
+    # the engine falls back to ordinary chunked prefill — a registered
+    # gate (analysis/gates.py).
+    prefill_seq_parallel: bool = False
     # Prefix-cache-aware routing (scheduling/request_routing.py
     # CacheAwareRouting): publish this stage's radix-tree block-hash
     # digests through heartbeats so the global scheduler can route
@@ -533,6 +563,7 @@ class StageEngine:
             ),
             host_tier=self.host_tier,
             track_digests=self.cfg.cache_digests,
+            prefill_chunk_skip=self.cfg.prefill_chunk_skip,
         )
         qos_policy = None
         if self.cfg.qos:
@@ -615,11 +646,32 @@ class StageEngine:
         # shard_map).
         mesh_sp = int(mesh.shape.get("sp", 1)) if mesh is not None else 1
         sp_in_mesh = mesh_sp if model.tp_size > 1 else 1
+        if (
+            self.cfg.prefill_seq_parallel
+            and (sp_mesh is not None or sp_in_mesh > 1)
+            and self.cfg.sp_threshold is None
+        ):
+            # prefill_seq_parallel is the one-knob form: an sp axis was
+            # carved (serve.py) but no explicit threshold given — long
+            # prompts past the default shard across the stage's chips.
+            self.cfg.sp_threshold = 2048
         self._sp_enabled = (
             (sp_mesh is not None or sp_in_mesh > 1)
             and self.cfg.sp_threshold is not None
             and self._model_supports_sp(model, in_mesh=sp_in_mesh > 1)
         )
+        if self.cfg.prefill_seq_parallel and not (
+            sp_mesh is not None or sp_in_mesh > 1
+        ):
+            # Registered gate (analysis/gates.py): the knob asks for
+            # sequence-parallel prefill but the stage has no sp axis to
+            # shard over (single chip, or all chips taken by TP) —
+            # ordinary chunked prefill proceeds on one chip.
+            logger.warning(
+                "sequence-parallel prefill disabled: single-chip stage "
+                "(prefill_seq_parallel needs an sp mesh axis; ordinary "
+                "chunked prefill proceeds)",
+            )
         if (mesh_sp > 1 or sp_mesh is not None) and not self._sp_enabled:
             # Engine-level refusal (model class / config / threshold):
             # the sp chips then run fully replicated — loud, not silent.
@@ -676,7 +728,9 @@ class StageEngine:
         # impl label feeds /status and the kernel-dispatch counter.
         from parallax_tpu.ops.kernel_select import (
             decode_attn_impl,
+            prefill_attn_impl,
             resolve_decode_fused,
+            resolve_prefill_fused,
             resolve_use_pallas,
         )
         from parallax_tpu.ops.kernel_select import (
@@ -688,7 +742,27 @@ class StageEngine:
         self._attn_impl = decode_attn_impl(
             self._decode_fused, model.use_pallas
         )
-        self._prefill_impl = (
+        # Fused prefill (EngineConfig.prefill_fused, None = auto on TPU):
+        # multi-token ragged batches run the in-kernel-append flash
+        # prefill program. The GQA paged-attention block is the consumer;
+        # MLA/MSA families keep their split prefill chain (registered
+        # gate, analysis/gates.py).
+        self._prefill_fused = resolve_prefill_fused(self.cfg.prefill_fused)
+        if self._prefill_fused and (
+            model.config.is_mla or model.config.msa is not None
+        ):
+            logger.info(
+                "prefill-fused kernel unavailable for this model family "
+                "(MLA/MSA prefill keeps the split dispatch chain)",
+            )
+            self._prefill_fused = False
+        self._prefill_impl = prefill_attn_impl(
+            self._prefill_fused, model.use_pallas
+        )
+        # SP long-prefill steps bypass the paged-attention facade (ring
+        # attention over the sp axis), so their dispatches keep the
+        # split/XLA label regardless of prefill_fused.
+        self._sp_prefill_impl = (
             _IMPL_SPLIT if resolve_use_pallas(model.use_pallas)
             else _IMPL_XLA
         )
@@ -1321,6 +1395,11 @@ class StageEngine:
             mnames.KV_PAGES_EVICTED_TOTAL,
             "Device pages reclaimed from the prefix tree", labelnames=st,
         ).labels(**lbl)
+        self._c_chunk_skip = reg.counter(
+            mnames.PREFILL_TOKENS_SKIPPED_TOTAL,
+            mnames.help_text(mnames.PREFILL_TOKENS_SKIPPED_TOTAL),
+            labelnames=st,
+        ).labels(**lbl)
         # Kernel-choice observability (docs/kernels.md): which attention
         # implementation served each engine dispatch. ``impl`` is
         # pallas-fused / pallas-split / xla, ``path`` is prefill /
@@ -1412,6 +1491,9 @@ class StageEngine:
             self._c_resumes.set_total(stats.resumes)
             self._c_kv_oom.set_total(stats.kv_oom_aborts)
             self._c_evicted.set_total(stats.pages_evicted)
+            self._c_chunk_skip.set_total(
+                getattr(stats, "tokens_chunk_skipped", 0)
+            )
         with self._spec_lock:
             acc = sum(s.get("accepted", 0)
                       for s in self._spec_stats.values())
@@ -1445,6 +1527,8 @@ class StageEngine:
         return {
             "impl": self._attn_impl,
             "decode_fused": self._decode_fused,
+            "prefill_impl": self._prefill_impl,
+            "prefill_fused": self._prefill_fused,
             "dispatch_total": {
                 f"{impl}/{path}": n
                 for (impl, path), n in sorted(counts.items())
@@ -3177,7 +3261,7 @@ class StageEngine:
                 plan, self._sp_spec, self.cfg.page_size,
                 hidden_states=hidden, pad_position=-1,
             )
-            self._count_kernel_dispatch("prefill", self._prefill_impl)
+            self._count_kernel_dispatch("prefill", self._sp_prefill_impl)
             out, self.kv = self._jit_sp_step(self.params, self.kv, inputs)
         else:
             # Decode-only batches compile their own variant (static flag)
@@ -3192,6 +3276,7 @@ class StageEngine:
                 with_dense_map=self._needs_state, decode_only=decode_only,
                 gather_all_logits=bool(spec_rows),
                 decode_fused=self._decode_fused and decode_only,
+                prefill_fused=self._prefill_fused and not decode_only,
             )
             self._count_kernel_dispatch(
                 "decode" if one_token else "prefill",

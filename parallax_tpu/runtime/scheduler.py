@@ -180,7 +180,15 @@ class Scheduler:
                 return False
             del self.wait_queue[rid]
             self.admitted_total += 1
-            req.set_status(RequestStatus.DECODING, "swap-in")
+            # A mid-prefill park resumes into PREFILLING: the chunk loop
+            # picks up at num_computed_tokens (the restored KV image
+            # covers exactly that span). Completed prefills resume
+            # straight into decode as before.
+            req.set_status(
+                RequestStatus.DECODING if req.is_prefill_done
+                else RequestStatus.PREFILLING,
+                "swap-in",
+            )
             self.running[rid] = req
             self._obs_event("swap_in", req, dur=time.perf_counter() - t0)
             return True
@@ -397,6 +405,24 @@ class Scheduler:
                 continue
             if req.lora_id != batch_lora:
                 continue
+            # Prefix-aware chunk skipping: before this request's FIRST
+            # chunk ships, re-consult the radix tree — a donor that
+            # released after this request was admitted may now cover far
+            # more of the prompt than the admission-time match did. Only
+            # while nothing has been computed past the cached prefix
+            # (num_computed == num_cached): once a chunk dispatched, the
+            # covered span is no longer a pure prefix swap. The guard is
+            # race-free because on_batch_computed advances
+            # num_computed_tokens at dispatch time, not completion.
+            extend = getattr(self.cache, "extend_prefix_match", None)
+            if (extend is not None
+                    and req.num_computed_tokens == req.num_cached_tokens):
+                if extend(req):
+                    # parallax_prefill_tokens_skipped_total is collected
+                    # pull-style from CacheStats (same shape as the
+                    # preemption counters) — only the flight/trace event
+                    # is emitted here.
+                    self._obs_event("chunk_skip", req)
             remaining = req.remaining_prompt_tokens()
             if remaining <= 0:
                 continue
@@ -745,9 +771,12 @@ class Scheduler:
         return best
 
     def _park(self, req: Request) -> None:
-        """Move a preempted (always DECODING) request to the wait-queue
-        FRONT: preempted requests carry the oldest arrivals among waiting
-        work, so FCFS resume order falls out of front insertion.
+        """Move a preempted request to the wait-queue FRONT: preempted
+        requests carry the oldest arrivals among waiting work, so FCFS
+        resume order falls out of front insertion. Capacity preemption
+        only ever parks DECODING rows (see _preemption_victim); node-level
+        migration parks can also preempt a mid-prefill request, which
+        swap-in later resumes into PREFILLING at its computed-token mark.
         ``ready_for_step`` is preserved: a parked row with a commit still
         in flight is re-armed by ``on_token_committed`` when it lands."""
         self.running.pop(req.request_id, None)

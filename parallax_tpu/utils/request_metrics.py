@@ -110,13 +110,16 @@ class CacheStats:
     """
 
     __slots__ = ("tokens_admitted", "tokens_hit_device", "tokens_hit_host",
-                 "pages_evicted", "preemptions", "resumes",
-                 "kv_oom_aborts")
+                 "tokens_chunk_skipped", "pages_evicted", "preemptions",
+                 "resumes", "kv_oom_aborts")
 
     def __init__(self):
         self.tokens_admitted = 0     # prompt tokens of admitted requests
         self.tokens_hit_device = 0   # skipped via HBM-resident prefixes
         self.tokens_hit_host = 0     # skipped via host-tier swap-ins
+        self.tokens_chunk_skipped = 0  # subset of hit_device: skipped by a
+        #                                mid-prefill radix re-consult (a
+        #                                donor released after admission)
         self.pages_evicted = 0       # device pages reclaimed from the tree
         self.preemptions = 0         # decode-OOM swap-outs to host
         self.resumes = 0             # preempted requests swapped back in
@@ -138,6 +141,9 @@ def cache_stats_summary(cache) -> dict | None:
             "tokens_admitted": admitted,
             "tokens_hit_device": stats.tokens_hit_device,
             "tokens_hit_host": stats.tokens_hit_host,
+            "tokens_chunk_skipped": getattr(
+                stats, "tokens_chunk_skipped", 0
+            ),
             "prefix_hit_rate": round(hit / admitted, 4) if admitted else 0.0,
             "host_hit_rate": (
                 round(stats.tokens_hit_host / admitted, 4) if admitted

@@ -95,6 +95,28 @@ def test_bench_cpu_smoke_prints_one_json_line():
     # speculative_rejected bucket — the honest waste accounting.
     assert on_rep["goodput"]["speculative_rejected"] > 0, on_rep
     assert on_rep["goodput"]["committed"] > 0, on_rep
+    # Prefill-roofline probe (detail.prefill, docs/kernels.md):
+    # structural keys + the deterministic verdicts — cache bit-equality
+    # and attention closeness fused-vs-XLA, warm-prefix chunk skipping
+    # recomputing ZERO covered chunks with bit-identical streams, and
+    # the interactive workload completing under the long chunked
+    # prefill. The fused-below-XLA TIMING comparison is asserted in the
+    # CI fused-prefill smoke step only (the warm-prefix wall ratio is
+    # informational — one-off JIT compile dominates it on CPU).
+    pp = rec["detail"]["prefill"]
+    for name in ("pallas-fused", "xla"):
+        assert pp["kernel"]["impls"][name]["per_token_device_ms"] > 0, pp
+    assert pp["kernel"]["cache_fused_vs_xla_identical"], pp
+    assert pp["kernel"]["attn_out_close_fused_vs_xla"], pp
+    wp = pp["warm_prefix"]
+    assert wp["tokens_chunk_skipped_on"] == wp["covered_tokens"], wp
+    assert wp["tokens_chunk_skipped_off"] == 0, wp
+    assert wp["covered_tokens_recomputed_on"] == 0, wp
+    assert wp["streams_bit_identical"] is True, wp
+    ip = pp["interactive_under_long_prefill"]
+    assert ip["completed"] == ip["requests"], ip
+    assert ip["ttft_p95_ms"] > 0, ip
+    assert ip["long_ttft_ms"] > 0, ip
     q = rec["detail"]["qos"]
     for run in ("unloaded", "off", "on"):
         for key in ("requests", "completed", "aborted", "interactive",
