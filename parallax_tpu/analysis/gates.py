@@ -206,4 +206,12 @@ GATE_TABLE: tuple[Gate, ...] = (
                "prefill/decode pools; a single-host engine has no "
                "pools to rebalance",
     ),
+    Gate(
+        feature="flag:--scheduler-standby",
+        marker="standby disabled: no --scheduler-standby",
+        doc="docs/ha.md",
+        reason="without a standby address list the scheduler journals "
+               "nothing and a primary crash stalls routing until a "
+               "manual restart — warm-standby HA is strictly opt-in",
+    ),
 )

@@ -402,6 +402,11 @@ FRAME_SCHEMAS: tuple[FrameSchema, ...] = (
             _f("goodput", None, required=False),
             _f("health", None, required=False),
             _f("events", None, required=False),
+            _f("epoch", 0, required=False,
+               doc="highest scheduler epoch the worker's failover "
+                   "wrapper has seen; a primary hearing a higher epoch "
+                   "than its own fences itself (split-brain guard, "
+                   "docs/ha.md)"),
             _f("hardware", None, required=False, compat=True,
                doc="auto-rejoin escape hatch: a beat from an evicted "
                    "node may re-enroll it without a full join; no "
@@ -525,6 +530,56 @@ FRAME_SCHEMAS: tuple[FrameSchema, ...] = (
         "Anyone -> scheduler: where does a migrated request live now "
         "(reply: {head} or {}).",
         (_f("rid", "r1"),),
+    ),
+    FrameSchema(
+        "HA_JOURNAL", "ha_journal",
+        "Primary scheduler -> standby: one state-mutating journal "
+        "record streamed by the StateJournal replicator (push "
+        "replication; docs/ha.md). Every record is built by the single "
+        "StateJournal.record choke-point. The reply acks the standby's "
+        "applied seq or asks for a pull resync.",
+        (
+            _f("seq", 1, doc="journal sequence number (contiguous)"),
+            _f("kind", "join",
+               doc="snapshot | join | leave | peer_down | hb | "
+                   "pipelines | migration_done | refit | epoch"),
+            _f("ts", 0.0, doc="primary wall time of the mutation"),
+            _f("data", {"node_id": "n0"},
+               doc="kind-specific payload (see ha/journal.py)"),
+            _f("epoch", 1, doc="primary's scheduler epoch"),
+        ),
+        extra_sites=("ha/journal.py:StateJournal.record",),
+    ),
+    FrameSchema(
+        "HA_SYNC", "ha_sync",
+        "Standby -> primary: pull the journal suffix past the standby's "
+        "applied seq (reply: {epoch, seq, records} — or {snapshot} when "
+        "the ring evicted the window). Doubles as the lease probe and "
+        "registers the standby for push replication.",
+        (
+            _f("from_seq", 0),
+            _f("node_id", "standby"),
+        ),
+    ),
+    FrameSchema(
+        "ROUTE_REQUEST", "route_request",
+        "Client -> scheduler: route one request over RPC (reply: "
+        "{path, epoch} or {}). Only used when the client's in-process "
+        "scheduler handle is passive/fenced/absent — after a standby "
+        "promotion the SwarmClient keeps admitting through the promoted "
+        "peer.",
+        (
+            _f("rid", "r1"),
+            _f("prompt_ids", [1, 2, 3], required=False),
+            _f("lora_id", None, required=False),
+            _f("tenant_id", None, required=False),
+            _f("qos_class", None, required=False),
+            _f("arrival_age_ms", 0.0, required=False,
+               doc="ms since the client first saw the request — "
+                   "re-anchored on the scheduler's clock so retries "
+                   "keep their FCFS position"),
+            _f("timeout_s", 10.0, required=False),
+        ),
     ),
 )
 

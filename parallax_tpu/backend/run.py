@@ -32,7 +32,8 @@ class SwarmClient:
     def __init__(self, transport: Transport,
                  service: SchedulerService | None,
                  poll_interval_s: float = 0.02,
-                 default_head: str | None = None):
+                 default_head: str | None = None,
+                 scheduler_peers: list[str] | None = None):
         self.transport = transport
         # None = no scheduler anywhere (standalone chat host fronting a
         # scheduler-less swarm): requests go to ``default_head`` with an
@@ -40,6 +41,17 @@ class SwarmClient:
         self.service = service
         self.poll_interval_s = poll_interval_s
         self.default_head = default_head
+        # Scheduler HA (docs/ha.md): when the in-process scheduler goes
+        # passive/fenced (a standby elsewhere promoted), route / release
+        # / where_is fall back to RPC against this failover rotation, so
+        # the HTTP frontend keeps admitting through the promoted peer.
+        self.sched_transport = None
+        if scheduler_peers:
+            from parallax_tpu.ha.failover import SchedulerFailover
+
+            self.sched_transport = SchedulerFailover(
+                transport, scheduler_peers
+            )
         # rid -> head node id, for stop-string early finish.
         self._heads: dict[str, str] = {}
         # rid -> monotonic arrival at routing time: a path that dies
@@ -48,12 +60,20 @@ class SwarmClient:
         # neither jumps the FCFS ladder nor looks newly arrived.
         self._arrivals: dict[str, float] = {}
 
+    def _local_primary(self) -> bool:
+        """True while the in-process scheduler may route/mutate: not a
+        passive standby mirror, not fenced off by a promotion."""
+        svc = self.service
+        return svc is not None and not (
+            svc.scheduler.passive or svc.scheduler.fenced
+        )
+
     def route(self, request_id: str,
               prompt_ids: list[int] | None = None,
               lora_id: str | None = None,
               tenant_id: str | None = None,
               qos_class: str | None = None) -> list[str] | None:
-        if self.service is None:
+        if self.service is None and self.sched_transport is None:
             # Chat-host mode: probe the head's readiness so a still-loading
             # or route-less swarm maps to the frontend's retryable 503
             # instead of a post-submit hard failure.
@@ -67,15 +87,80 @@ class SwarmClient:
                 return None
             return [] if isinstance(r, dict) and r.get("ready") else None
         self._arrivals[request_id] = time.monotonic()
-        path = self.service.route_request(
-            request_id, timeout_s=10.0,
-            prompt_ids=prompt_ids, lora_id=lora_id,
+        path = self._route_any(
+            request_id, prompt_ids=prompt_ids, lora_id=lora_id,
             tenant_id=tenant_id, qos_class=qos_class,
         )
         if not path:
             # No submit will follow to retire the entry via _poll_loop.
             self._arrivals.pop(request_id, None)
         return path
+
+    def _route_any(self, request_id: str,
+                   prompt_ids: list[int] | None = None,
+                   lora_id: str | None = None,
+                   tenant_id: str | None = None,
+                   qos_class: str | None = None,
+                   arrival_time: float | None = None) -> list[str] | None:
+        """Route in-process while the local scheduler is primary, over
+        RPC against the failover rotation otherwise (docs/ha.md)."""
+        if self._local_primary():
+            return self.service.route_request(
+                request_id, timeout_s=10.0,
+                prompt_ids=prompt_ids, lora_id=lora_id,
+                tenant_id=tenant_id, qos_class=qos_class,
+                arrival_time=arrival_time,
+            )
+        if self.sched_transport is None:
+            return None
+        age_ms = 0.0
+        if arrival_time is not None:
+            age_ms = max(0.0, (time.monotonic() - arrival_time) * 1e3)
+        try:
+            reply = self.sched_transport.call(
+                self.sched_transport.active_peer, proto.ROUTE_REQUEST,
+                {
+                    "rid": request_id,
+                    "prompt_ids": prompt_ids,
+                    "lora_id": lora_id,
+                    "tenant_id": tenant_id,
+                    "qos_class": qos_class,
+                    # Monotonic clocks do not survive the process hop:
+                    # ship the AGE so the scheduler re-anchors arrival
+                    # (FCFS position + deadline accounting carry over).
+                    "arrival_age_ms": age_ms,
+                    "timeout_s": 10.0,
+                },
+                timeout=15.0,
+            )
+        except Exception as e:
+            logger.warning("route_request RPC failed: %s", e)
+            return None
+        path = (reply or {}).get("path")
+        return [str(x) for x in path] if path else None
+
+    def _release_path(self, path: list[str] | None) -> None:
+        """Release a routed path's load charge — in-process while the
+        local scheduler is primary, over RPC otherwise (the charge lives
+        on whichever scheduler routed/inherited the request; a promoted
+        standby rebuilt it from the journal)."""
+        if not path:
+            return
+        if self._local_primary():
+            try:
+                self.service.scheduler.complete_request(list(path))
+            except Exception:
+                logger.exception("releasing path %s", path)
+            return
+        if self.sched_transport is None:
+            return
+        try:
+            self.sched_transport.call(
+                self.sched_transport.active_peer, proto.REQUEST_COMPLETE,
+                {"path": list(path)}, timeout=5.0,
+            )
+        except Exception as e:
+            logger.warning("request_complete RPC failed: %s", e)
 
     def submit(self, request: Request) -> threading.Event:
         if request.routing_table:
@@ -97,10 +182,7 @@ class SwarmClient:
         except Exception:
             # The workers never saw this request; release the load the
             # dispatcher charged for the path.
-            if self.service is not None:
-                self.service.scheduler.complete_request(
-                    request.routing_table
-                )
+            self._release_path(list(request.routing_table))
             raise RuntimeError(f"head node {head} unreachable")
         ev = threading.Event()
         self._heads[request.request_id] = head
@@ -153,11 +235,25 @@ class SwarmClient:
     def _migrated_head(self, request_id: str) -> str | None:
         """The scheduler's where_is table: targets report restored
         requests there, so a poller whose OLD head died after shipping
-        still finds the new one."""
-        if self.service is None:
+        still finds the new one. A local PASSIVE mirror may answer too
+        (migration_done records replicate through the journal); falls
+        back to the where_is RPC against the failover rotation."""
+        if self.service is not None:
+            try:
+                moved = self.service.scheduler.migrated_head(request_id)
+                if moved:
+                    return moved
+            except Exception:
+                pass
+        if self.sched_transport is None or self._local_primary():
             return None
         try:
-            return self.service.scheduler.migrated_head(request_id)
+            reply = self.sched_transport.call(
+                self.sched_transport.active_peer, proto.WHERE_IS,
+                {"rid": request_id}, timeout=5.0,
+            )
+            head = (reply or {}).get("head")
+            return str(head) if head else None
         except Exception:
             return None
 
@@ -173,15 +269,10 @@ class SwarmClient:
         the new head, or None when no pipeline is serviceable (the
         caller then falls through to the abort)."""
         rid = request.request_id
+        self._release_path(list(request.routing_table))
         try:
-            self.service.scheduler.complete_request(
-                list(request.routing_table)
-            )
-        except Exception:
-            logger.exception("releasing dead path for %s", rid)
-        try:
-            path = self.service.route_request(
-                rid, timeout_s=10.0,
+            path = self._route_any(
+                rid,
                 prompt_ids=list(request.prompt_ids),
                 lora_id=request.lora_id,
                 arrival_time=self._arrivals.get(rid),
@@ -215,7 +306,7 @@ class SwarmClient:
         except Exception as e:
             logger.warning("re-routed submit of %s to %s failed: %s",
                            rid, head, e)
-            self.service.scheduler.complete_request(list(path))
+            self._release_path(list(path))
             request.routing_table[:] = []
             return None
         logger.info(
@@ -230,6 +321,7 @@ class SwarmClient:
         rid = request.request_id
         failures = 0
         reroutes = 0
+        retry = None   # lazy Backoff, reset to None on a good poll
 
         def follow_migration(new_head: str) -> str:
             """Switch polling to the head that owns the request now. The
@@ -256,12 +348,15 @@ class SwarmClient:
             moved = self._migrated_head(rid)
             if moved and moved != head:
                 return follow_migration(moved)
-            if self.service is None or reroutes >= 2:
+            if (
+                self.service is None and self.sched_transport is None
+            ) or reroutes >= 2:
                 return None
             if request.output_ids:
                 try:
                     head_known = (
-                        self.service.scheduler.manager.get(head)
+                        self.service is not None
+                        and self.service.scheduler.manager.get(head)
                         is not None
                     )
                 except Exception:
@@ -277,6 +372,7 @@ class SwarmClient:
                     head, proto.CHAT_POLL, {"rid": rid}, timeout=10.0
                 )
                 failures = 0
+                retry = None
             except Exception as e:
                 failures += 1
                 if failures % 4 == 0:
@@ -299,13 +395,20 @@ class SwarmClient:
                     # The worker cannot report completion anymore; release
                     # the path's load charge here. (Empty after a
                     # migration follow — the target owns that charge.)
-                    if self.service is not None:
-                        self.service.scheduler.complete_request(
-                            request.routing_table
-                        )
+                    self._release_path(list(request.routing_table))
                     ev.set()
                     return
-                time.sleep(0.5)
+                # Jittered exponential backoff between failed polls: a
+                # head blip with hundreds of concurrent pollers must not
+                # thundering-herd its recovery (docs/ha.md).
+                if retry is None:
+                    from parallax_tpu.ha.backoff import (
+                        Backoff,
+                        BackoffPolicy,
+                    )
+
+                    retry = Backoff(BackoffPolicy(base_s=0.25, cap_s=2.0))
+                retry.wait()
                 continue
             if r.get("migrated"):
                 # Live migration: the request now runs on another head;
@@ -344,9 +447,18 @@ def build_swarm_frontend(
     resolve_model=None,
     tokenizer_fn=None,
     qos_config=None,
+    standby_addrs: list[str] | None = None,
 ) -> tuple[OpenAIFrontend, SchedulerService, SwarmClient]:
-    service = SchedulerService(scheduler, transport)
-    client = SwarmClient(transport, service)
+    service = SchedulerService(
+        scheduler, transport, standby_addrs=standby_addrs
+    )
+    # With standbys configured the client gets the failover rotation:
+    # when the in-process scheduler fences (a standby promoted past
+    # it), routing falls back to RPC against the promoted peer instead
+    # of 503ing the frontend (docs/ha.md).
+    client = SwarmClient(
+        transport, service, scheduler_peers=list(standby_addrs or []) or None
+    )
     # Bind through the service so a live model switch (which swaps
     # service.scheduler) redirects every control-plane call.
     def adapters():
@@ -572,12 +684,23 @@ def run_main(args) -> int:
 
         # Fails fast on a malformed spec, like --slo.
         qos_config = parse_qos_spec(qos_spec)
+    # Scheduler HA (docs/ha.md): --scheduler-standby names the warm
+    # standbys this primary replicates to (and advertises to workers);
+    # --standby-of flips this process INTO a standby mirror tailing the
+    # named primary, promoting itself when the lease expires.
+    standby_addrs = [
+        p.strip()
+        for p in (getattr(args, "scheduler_standby", None) or "").split(",")
+        if p.strip()
+    ]
+    standby_of = getattr(args, "standby_of", None) or None
     scheduler = GlobalScheduler(
         model, min_nodes_bootstrapping=args.min_nodes,
         routing=getattr(args, "routing", "rr"),
         routing_kwargs=routing_kwargs,
         slo=slo_config,
         qos=qos_config,
+        passive=bool(standby_of),
     )
     transport = TcpTransport(
         "scheduler", "0.0.0.0", args.port + 1,
@@ -590,8 +713,39 @@ def run_main(args) -> int:
             name if os.path.isdir(name) else None
         ),
         qos_config=qos_config,
+        standby_addrs=standby_addrs or None,
     )
+    standby_ctl = None
+    if standby_of:
+        from parallax_tpu.ha.standby import StandbyScheduler
+
+        standby_ctl = StandbyScheduler(
+            scheduler, transport=transport, primary=standby_of,
+            lease_s=getattr(args, "ha_lease_s", None) or 6.0,
+        )
+    elif standby_addrs:
+        from parallax_tpu.ha.journal import StateJournal, install_journal
+
+        journal = StateJournal(epoch=scheduler.epoch)
+        journal.bind(transport)
+        install_journal(scheduler, journal)
+    else:
+        # Registered gate (analysis/gates.py): without standbys the
+        # scheduler remains the swarm's single point of failure — a
+        # crash aborts nothing in flight on the workers, but no new
+        # requests route until it restarts and the workers rejoin.
+        logger.info(
+            "scheduler HA standby disabled: no --scheduler-standby "
+            "addresses configured — a scheduler crash stalls routing "
+            "until restart (docs/ha.md)"
+        )
     service.start()
+    if standby_ctl is not None:
+        standby_ctl.start()
+        logger.info(
+            "warm standby of %s: mirroring journal, HTTP on :%d "
+            "(promotes on lease expiry)", standby_of, args.port,
+        )
     logger.info(
         "scheduler RPC on :%d, HTTP on :%d (min_nodes=%d)",
         args.port + 1, args.port, args.min_nodes,

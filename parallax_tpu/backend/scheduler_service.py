@@ -4,6 +4,15 @@ Capability parity: reference ``src/backend/server/rpc_connection_handler.py``
 (node_join blocking until allocation <=300 s, node_update heartbeat with
 reallocation piggyback + auto-rejoin, node_leave) and the
 ``SchedulerManage`` glue (scheduler_manage.py:185-200).
+
+Scheduler HA (docs/ha.md): every MUTATING handler is guarded by
+:meth:`SchedulerService._ha_blocked` — a passive (warm-standby mirror)
+or fenced (superseded old primary) scheduler answers
+``{"not_primary": True, "epoch": N}`` and the workers' failover wrapper
+rotates to the promoted peer. Read-only lookups (``where_is``) stay
+open on a mirror. Heartbeats carry the worker's highest-seen epoch; a
+primary hearing a higher epoch than its own fences itself before
+touching state (split-brain guard).
 """
 
 from __future__ import annotations
@@ -27,10 +36,15 @@ class SchedulerService:
         scheduler: GlobalScheduler,
         transport: Transport,
         join_timeout_s: float = 300.0,
+        standby_addrs: "list[str] | None" = None,
     ):
         self.scheduler = scheduler
         self.transport = transport
         self.join_timeout_s = join_timeout_s
+        # Standby address list advertised on allocations/heartbeat
+        # replies so every worker learns the failover targets from the
+        # primary itself (--scheduler-standby).
+        self.standby_addrs = list(standby_addrs or [])
         transport.register(proto.NODE_JOIN, self._on_join)
         transport.register(proto.NODE_UPDATE, self._on_update)
         transport.register(proto.NODE_LEAVE, self._on_leave)
@@ -43,15 +57,32 @@ class SchedulerService:
         transport.register(proto.DISAGG_TARGET, self._on_disagg_target)
         transport.register(proto.MIGRATION_DONE, self._on_migration_done)
         transport.register(proto.WHERE_IS, self._on_where_is)
+        # Scheduler HA (docs/ha.md): standby journal pull + RPC routing
+        # for clients whose in-process scheduler handle went passive.
+        transport.register(proto.HA_SYNC, self._on_ha_sync)
+        transport.register(proto.ROUTE_REQUEST, self._on_route_request)
         transport.register("__ping__", lambda *_: "pong")
 
     def start(self) -> None:
         self.transport.start()
-        self.scheduler.start()
+        if not self.scheduler.passive:
+            # A standby's scheduler threads start at promotion, not here
+            # — the mirror must not sweep heartbeats it never receives.
+            self.scheduler.start()
 
     def stop(self) -> None:
         self.scheduler.stop()
         self.transport.stop()
+
+    # -- HA guards ----------------------------------------------------------
+
+    def _ha_blocked(self) -> bool:
+        """True when this scheduler must refuse mutations: a passive
+        standby mirror, or an old primary fenced off by a promotion."""
+        return self.scheduler.passive or self.scheduler.fenced
+
+    def _not_primary(self) -> dict:
+        return {"not_primary": True, "epoch": self.scheduler.epoch}
 
     # -- handlers (run on transport worker threads) -------------------------
 
@@ -62,6 +93,8 @@ class SchedulerService:
         the topology changes (reference keeps joiners pending in
         rpc_connection_handler.py:33-58; standby-acking instead keeps the
         heartbeat channel alive during long waits)."""
+        if self._ha_blocked():
+            return self._not_primary()
         node_id = payload["node_id"]
         hw = HardwareInfo.from_dict(payload["hardware"])
         self.scheduler.enqueue_join(
@@ -90,7 +123,7 @@ class SchedulerService:
                     if alloc is not None:
                         return self._with_model(alloc)
                     time.sleep(0.05)
-                return {"standby": True}
+                return self._with_epoch({"standby": True})
             time.sleep(0.05)
         return {"error": "no allocation within timeout"}
 
@@ -99,9 +132,29 @@ class SchedulerService:
         a live model switch and re-resolve their stage config."""
         alloc = dict(alloc)
         alloc["model_name"] = self.scheduler.model.model_name
-        return alloc
+        return self._with_epoch(alloc)
+
+    def _with_epoch(self, reply: dict) -> dict:
+        """Every scheduler reply carries the epoch (fencing signal for
+        workers' failover wrappers) and the standby address list."""
+        reply["epoch"] = self.scheduler.epoch
+        if self.standby_addrs:
+            reply["standbys"] = list(self.standby_addrs)
+        return reply
 
     def _on_update(self, _peer: str, payload: dict) -> dict:
+        # Fencing check BEFORE the guard: a worker echoing an epoch
+        # higher than ours is proof a standby promoted past us — we must
+        # fence even (especially) if we still think we are primary.
+        echoed = payload.get("epoch")
+        if (
+            isinstance(echoed, int)
+            and echoed > self.scheduler.epoch
+            and not self.scheduler.passive
+        ):
+            self.scheduler.fence(echoed)
+        if self._ha_blocked():
+            return self._not_primary()
         node_id = payload["node_id"]
         if self.scheduler.manager.get(node_id) is None:
             # Auto-rejoin after scheduler restart/eviction (reference
@@ -110,7 +163,7 @@ class SchedulerService:
                 self.scheduler.enqueue_join(
                     node_id, HardwareInfo.from_dict(payload["hardware"])
                 )
-            return {"rejoin": True}
+            return self._with_epoch({"rejoin": True})
         self.scheduler.enqueue_update(
             node_id,
             layer_latency_ms=payload.get("layer_latency_ms"),
@@ -221,11 +274,15 @@ class SchedulerService:
             alloc["drain"] = drain
         return alloc
 
-    def _on_leave(self, _peer: str, payload: dict) -> str:
+    def _on_leave(self, _peer: str, payload: dict):
+        if self._ha_blocked():
+            return self._not_primary()
         self.scheduler.enqueue_leave(payload["node_id"])
         return "ok"
 
-    def _on_request_complete(self, _peer: str, payload: dict) -> str:
+    def _on_request_complete(self, _peer: str, payload: dict):
+        if self._ha_blocked():
+            return self._not_primary()
         self.scheduler.complete_request(
             payload.get("path") or [],
             request_id=payload.get("rid"),
@@ -235,9 +292,11 @@ class SchedulerService:
 
     # -- live migration ------------------------------------------------------
 
-    def _on_peer_down(self, _peer: str, payload: dict) -> str:
+    def _on_peer_down(self, _peer: str, payload: dict):
         """A worker's async sender declared a next-hop peer dead: mark
         its CacheIndex stale immediately and accelerate its sweep."""
+        if self._ha_blocked():
+            return self._not_primary()
         self.scheduler.enqueue_peer_down(
             str(payload.get("reporter") or _peer or "?"),
             str(payload["peer"]),
@@ -248,6 +307,8 @@ class SchedulerService:
     def _on_migrate_target(self, _peer: str, payload: dict) -> dict:
         """Destinations for a head's parked requests, scored against
         each surviving head's CacheIndex mirror."""
+        if self._ha_blocked():
+            return self._not_primary()
         reqs = payload.get("requests")
         if not isinstance(reqs, list):
             return {"targets": {}}
@@ -265,6 +326,8 @@ class SchedulerService:
         prompts (KV handoff, docs/disaggregation.md): same CacheIndex
         scoring as migrate_target, restricted to decode/mixed pipelines.
         An empty map tells the head to keep the request local."""
+        if self._ha_blocked():
+            return self._not_primary()
         reqs = payload.get("requests")
         if not isinstance(reqs, list):
             return {"targets": {}}
@@ -276,17 +339,92 @@ class SchedulerService:
             )
         }
 
-    def _on_migration_done(self, _peer: str, payload: dict) -> str:
+    def _on_migration_done(self, _peer: str, payload: dict):
         """A target head restored a migrated request: record where it
         lives now so pollers that lost the old head can follow."""
+        if self._ha_blocked():
+            return self._not_primary()
         rid, head = payload.get("rid"), payload.get("head")
         if isinstance(rid, str) and isinstance(head, str):
             self.scheduler.record_migration(rid, head)
         return "ok"
 
     def _on_where_is(self, _peer: str, payload: dict) -> dict:
+        # Deliberately NOT guarded: the migration table is read-only
+        # here, and a standby's mirror answering pollers during the
+        # promotion window shortens the stream gap.
         head = self.scheduler.migrated_head(str(payload.get("rid") or ""))
         return {"head": head} if head else {}
+
+    # -- scheduler HA (docs/ha.md) -------------------------------------------
+
+    def _on_ha_sync(self, _peer: str, payload: dict) -> dict:
+        """Standby journal pull (doubles as the lease probe): reply with
+        the journal records past the standby's applied seq, or a full
+        snapshot when the ring already evicted that window; register the
+        caller for push replication either way."""
+        if self._ha_blocked():
+            return self._not_primary()
+        journal = self.scheduler.journal
+        if journal is None:
+            return {"error": "journal not enabled on this scheduler"}
+        try:
+            from_seq = int(payload.get("from_seq") or 0)
+        except (TypeError, ValueError):
+            from_seq = 0
+        standby_id = str(payload.get("node_id") or _peer or "standby")
+        journal.attach(standby_id)
+        records, contiguous = journal.records_since(from_seq)
+        if not contiguous:
+            from parallax_tpu.ha.journal import snapshot_state
+
+            return self._with_epoch(
+                {"snapshot": snapshot_state(self.scheduler)}
+            )
+        return self._with_epoch({"seq": journal.seq, "records": records})
+
+    def _on_route_request(self, _peer: str, payload: dict) -> dict:
+        """RPC twin of :meth:`route_request` for clients whose
+        in-process scheduler handle is passive/fenced/absent (the
+        SwarmClient after a standby promotion)."""
+        if self._ha_blocked():
+            return self._not_primary()
+        rid = payload.get("rid")
+        if not isinstance(rid, str):
+            return {}
+        try:
+            age_ms = float(payload.get("arrival_age_ms") or 0.0)
+        except (TypeError, ValueError):
+            age_ms = 0.0
+        try:
+            timeout_s = float(payload.get("timeout_s") or 10.0)
+        except (TypeError, ValueError):
+            timeout_s = 10.0
+        prompt_ids = payload.get("prompt_ids")
+        path = self.route_request(
+            rid,
+            timeout_s=min(timeout_s, self.join_timeout_s),
+            prompt_ids=(
+                [int(t) for t in prompt_ids]
+                if isinstance(prompt_ids, (list, tuple)) else None
+            ),
+            lora_id=(
+                str(payload["lora_id"])
+                if isinstance(payload.get("lora_id"), str) else None
+            ),
+            arrival_time=time.monotonic() - age_ms / 1e3,
+            tenant_id=(
+                str(payload["tenant_id"])
+                if isinstance(payload.get("tenant_id"), str) else None
+            ),
+            qos_class=(
+                str(payload["qos_class"])
+                if isinstance(payload.get("qos_class"), str) else None
+            ),
+        )
+        if path is None:
+            return self._with_epoch({})
+        return self._with_epoch({"path": path})
 
     # -- routing for the HTTP plane -----------------------------------------
 
@@ -303,6 +441,10 @@ class SchedulerService:
         cache-aware router: the dispatcher hashes the prompt's block
         chain once and scores pipelines against each head's digest index.
         """
+        if self._ha_blocked():
+            # A mirror's dispatch thread isn't running; blocking here
+            # would burn the caller's whole timeout for nothing.
+            return None
         from parallax_tpu.scheduling.request_routing import RequestMeta
 
         meta = RequestMeta(

@@ -252,6 +252,28 @@ def build_parser() -> argparse.ArgumentParser:
              "'autoscale=1') the goodput-driven pool autoscaler that "
              "re-roles pipelines between the prefill/decode pools",
     )
+    run.add_argument(
+        "--scheduler-standby", default=None,
+        help="scheduler HA (docs/ha.md): comma-separated warm-standby "
+             "scheduler RPC addresses. The primary streams its state "
+             "journal to them and advertises the list to every worker "
+             "and client, so scheduler RPCs fail over to a promoted "
+             "standby; omit to run without HA (a scheduler crash "
+             "stalls routing until restart)",
+    )
+    run.add_argument(
+        "--standby-of", default=None,
+        help="scheduler HA (docs/ha.md): run THIS process as a warm "
+             "standby mirroring the given primary scheduler RPC "
+             "address; it serves read-only lookups, tails the "
+             "snapshot+journal stream, and promotes itself (bumping "
+             "the scheduler epoch) when the primary's lease expires",
+    )
+    run.add_argument(
+        "--ha-lease-s", type=float, default=6.0,
+        help="scheduler HA: seconds without journal progress from the "
+             "primary before a standby promotes itself (docs/ha.md)",
+    )
 
     join = sub.add_parser("join", help="join a swarm as a worker")
     join.add_argument("--scheduler-addr", default=None,
@@ -261,6 +283,14 @@ def build_parser() -> argparse.ArgumentParser:
     join.add_argument("--peers", default=None,
                       help="scheduler-less mode: comma-separated worker "
                            "addresses to gossip block announcements with")
+    join.add_argument(
+        "--scheduler-standby", default=None,
+        help="scheduler HA (docs/ha.md): comma-separated warm-standby "
+             "scheduler addresses to fail over to when the primary "
+             "dies (the primary also advertises its list through "
+             "allocations/heartbeat replies, so this seed is optional "
+             "when workers join before any failover)",
+    )
     join.add_argument("--start-layer", type=int, default=None,
                       help="scheduler-less mode: this worker's first layer. "
                            "Blocks chain only at EXACT boundaries (a stage "

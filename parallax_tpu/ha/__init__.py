@@ -1,0 +1,53 @@
+"""Control-plane survivability: warm-standby scheduler HA (docs/ha.md).
+
+The global scheduler is the one process whose death orphans the whole
+swarm — every join, heartbeat, digest delta, route decision, QoS
+verdict and migration verdict flows through it. This package makes its
+state survive:
+
+- :mod:`.journal` — a versioned snapshot codec of the GlobalScheduler's
+  replicated state plus an append-only journal of state-mutating
+  events, written through one choke-point (``StateJournal.record``) so
+  the frame-drift checker can enforce replication coverage;
+- :mod:`.standby` — a warm standby that tails snapshot+journal over the
+  existing RPC plane (or a shared JSONL file in single-host mode),
+  holds a read-only mirror, and promotes itself on lease expiry of the
+  primary, bumping the scheduler **epoch** that fences a revived old
+  primary off (split-brain guard);
+- :mod:`.failover` — the Transport-shaped scheduler-RPC wrapper workers
+  and the SwarmClient route through: peer rotation over the standby
+  address list, ``not_primary`` redirect handling, epoch adoption;
+- :mod:`.backoff` — exponential backoff with full jitter and a shared
+  deadline for every scheduler-RPC retry loop (a fixed-interval retry
+  herd must not hammer a freshly-promoted standby).
+
+Import-light by design: nothing here imports the wire codec (msgpack)
+or jax at module level, so the virtual-time churn harness
+(:mod:`parallax_tpu.testing.churn`) and the jax-free CI lane can drive
+the real scheduler + HA code with no accelerator stack installed.
+"""
+
+from parallax_tpu.ha.backoff import Backoff, BackoffPolicy
+from parallax_tpu.ha.failover import SchedulerFailover
+from parallax_tpu.ha.journal import (
+    SNAPSHOT_VERSION,
+    StateJournal,
+    restore_state,
+    snapshot_state,
+    soft_state_fingerprint,
+    state_fingerprint,
+)
+from parallax_tpu.ha.standby import StandbyScheduler
+
+__all__ = [
+    "Backoff",
+    "BackoffPolicy",
+    "SchedulerFailover",
+    "SNAPSHOT_VERSION",
+    "StateJournal",
+    "StandbyScheduler",
+    "restore_state",
+    "snapshot_state",
+    "soft_state_fingerprint",
+    "state_fingerprint",
+]

@@ -105,6 +105,26 @@ SLO_ATTAINMENT = "parallax_slo_attainment"
 SLO_BURN_RATE = "parallax_slo_burn_rate"
 OBS_MERGE_SKIPPED_TOTAL = "parallax_obs_merge_skipped_total"
 
+# -- global scheduler control plane (scheduling/scheduler.py) ----------------
+SCHEDULER_EVENTS_TOTAL = "parallax_scheduler_events_total"
+SCHEDULER_REBALANCES_TOTAL = "parallax_scheduler_rebalances_total"
+SCHEDULER_HEARTBEAT_EVICTIONS_TOTAL = (
+    "parallax_scheduler_heartbeat_evictions_total"
+)
+SCHEDULER_DRAINS_TOTAL = "parallax_scheduler_drains_total"
+SCHEDULER_MIGRATION_TARGETS_TOTAL = (
+    "parallax_scheduler_migration_targets_total"
+)
+SCHEDULER_MIGRATIONS_RECORDED_TOTAL = (
+    "parallax_scheduler_migrations_recorded_total"
+)
+SCHEDULER_DISAGG_TARGETS_TOTAL = "parallax_scheduler_disagg_targets_total"
+
+# -- scheduler HA (parallax_tpu/ha, docs/ha.md) ------------------------------
+HA_PROMOTIONS_TOTAL = "parallax_ha_promotions_total"
+HA_JOURNAL_RECORDS_TOTAL = "parallax_ha_journal_records_total"
+HA_REPLAY_MS = "parallax_ha_replay_ms"
+
 # -- misc subsystems ---------------------------------------------------------
 LORA_ADAPTER_EVICTIONS_TOTAL = "parallax_lora_adapter_evictions_total"
 XLA_COMPILES_TOTAL = "parallax_xla_compiles_total"
@@ -274,6 +294,41 @@ HELP: dict[str, str] = {
         "Histogram children whose bucket lattice could not be merged "
         "bucket-for-bucket (heterogeneous-build swarm); their "
         "sum/count still fold in, percentiles degrade loudly"
+    ),
+    SCHEDULER_EVENTS_TOTAL: (
+        "Topology events handled by the scheduler event thread, by kind "
+        "(join / leave / peer_down / update)"
+    ),
+    SCHEDULER_REBALANCES_TOTAL: (
+        "Global rebalances (full teardown + re-allocation of every "
+        "pipeline)"
+    ),
+    SCHEDULER_HEARTBEAT_EVICTIONS_TOTAL: (
+        "Nodes evicted by the heartbeat sweep (missed-beat leaves, as "
+        "opposed to clean node_leave departures)"
+    ),
+    SCHEDULER_DRAINS_TOTAL: (
+        "Drain directives issued to pipeline heads around dead peers"
+    ),
+    SCHEDULER_MIGRATION_TARGETS_TOTAL: (
+        "Migration targets chosen for parked requests (CacheIndex-"
+        "scored)"
+    ),
+    SCHEDULER_MIGRATIONS_RECORDED_TOTAL: (
+        "migration_done reports recorded into the where_is table"
+    ),
+    SCHEDULER_DISAGG_TARGETS_TOTAL: (
+        "Decode-pool handoff targets chosen for finished prompts"
+    ),
+    HA_PROMOTIONS_TOTAL: (
+        "Warm-standby scheduler promotions (lease expiries acted on)"
+    ),
+    HA_JOURNAL_RECORDS_TOTAL: (
+        "State-mutating events appended to the scheduler HA journal"
+    ),
+    HA_REPLAY_MS: (
+        "Promotion latency: journal/lease decision to active scheduler "
+        "(ms)"
     ),
     LORA_ADAPTER_EVICTIONS_TOTAL: (
         "Adapters evicted by the hot-load LRU cache"

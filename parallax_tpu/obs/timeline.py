@@ -137,6 +137,34 @@ class ClusterTimeline:
         except Exception:  # pragma: no cover - obs must never raise
             pass
 
+    # -- HA replication (parallax_tpu/ha) ---------------------------------
+
+    def export_cursors(self) -> dict:
+        """High-water merge cursors for the HA snapshot codec: a
+        promoted standby that adopts them dedupes heartbeat-batch
+        resends exactly where the dead primary left off (the events
+        themselves are observability, not replicated state)."""
+        with self._lock:
+            return {
+                "cursors": {n: dict(c) for n, c in self._cursors.items()},
+                "local_seq": dict(self._local_seq),
+            }
+
+    def adopt_cursors(self, snap: dict) -> None:
+        with self._lock:
+            for n, c in (snap.get("cursors") or {}).items():
+                if isinstance(c, dict) and "seq" in c:
+                    self._cursors[n] = {
+                        "epoch": c.get("epoch"), "seq": int(c["seq"]),
+                    }
+            for n, s in (snap.get("local_seq") or {}).items():
+                try:
+                    self._local_seq[n] = max(
+                        self._local_seq.get(n, 0), int(s)
+                    )
+                except (TypeError, ValueError):
+                    continue
+
     # -- export -----------------------------------------------------------
 
     def _sorted_events(self) -> list[dict]:

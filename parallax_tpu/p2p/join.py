@@ -235,6 +235,15 @@ def join_main(args) -> int:
         kv_transfer_chunk_bytes=getattr(
             args, "kv_transfer_chunk_bytes", None
         ),
+        # Scheduler HA (docs/ha.md): seed standby addresses for the
+        # failover rotation; the primary's replies extend the list.
+        scheduler_standby=[
+            p.strip()
+            for p in (
+                getattr(args, "scheduler_standby", None) or ""
+            ).split(",")
+            if p.strip()
+        ],
     )
     node.start()
     logger.info("worker %s joined %s", node.node_id, scheduler_peer)

@@ -90,6 +90,7 @@ class WorkerNode:
         watchdog_stalled_s: float = 15.0,
         role: str | None = None,
         kv_transfer_chunk_bytes: int | None = None,
+        scheduler_standby: list[str] | None = None,
     ):
         """``scheduler_peer=None`` enters SCHEDULER-LESS mode (reference:
         DHT announce + dijkstra routing, ``p2p/server.py:569-626``): the
@@ -117,6 +118,19 @@ class WorkerNode:
         self.lora_adapters = dict(lora_adapters or {})
         self.static_peers = list(static_peers or [])
         self.standalone = scheduler_peer is None
+        # Scheduler HA (docs/ha.md): every scheduler RPC routes through
+        # a failover wrapper that retries with jittered exponential
+        # backoff under the caller's deadline and rotates to a promoted
+        # standby on connection failure or a not_primary redirect. The
+        # wrapper also tracks the highest scheduler epoch seen; we echo
+        # it on heartbeats so a superseded old primary fences itself.
+        self.sched_transport = None
+        if not self.standalone:
+            from parallax_tpu.ha.failover import SchedulerFailover
+
+            self.sched_transport = SchedulerFailover(
+                transport, [scheduler_peer, *(scheduler_standby or [])],
+            )
         if self.standalone and layers is None:
             raise ValueError(
                 "scheduler-less mode requires explicit layers=(start, end)"
@@ -345,17 +359,38 @@ class WorkerNode:
             self._gossip_pool.shutdown(wait=False, cancel_futures=True)
         if not self.standalone:
             try:
-                self.transport.call(self.scheduler_peer, proto.NODE_LEAVE,
-                                    {"node_id": self.node_id}, timeout=5.0)
+                self.sched_transport.call(
+                    self.scheduler_peer, proto.NODE_LEAVE,
+                    {"node_id": self.node_id}, timeout=5.0,
+                )
             except Exception:
                 pass
         self.transport.stop()
 
     # -- join + elastic reload ----------------------------------------------
 
+    def _sched_peer(self) -> str:
+        """Current scheduler address for fire-and-forget sender traffic
+        (PEER_DOWN / REQUEST_COMPLETE / MIGRATION_DONE ride the async
+        sender, which has no retry-rotate loop of its own — so they at
+        least target whichever peer the failover wrapper last proved
+        alive; a frame lost across the promotion window is best_effort
+        by contract)."""
+        st = self.sched_transport
+        return st.active_peer if st is not None else self.scheduler_peer
+
+    def _is_scheduler(self, peer: str) -> bool:
+        """True for the primary OR any standby: scheduler addresses are
+        exempt from peer_down reporting (the failover wrapper handles
+        scheduler death; reporting the scheduler to itself is noise)."""
+        st = self.sched_transport
+        if st is not None:
+            return peer in st.peers
+        return peer == self.scheduler_peer
+
     def _join(self) -> dict:
         hw = detect_hardware()
-        reply = self.transport.call(
+        reply = self.sched_transport.call(
             self.scheduler_peer,
             proto.NODE_JOIN,
             {
@@ -753,11 +788,17 @@ class WorkerNode:
                     )
                 eng = self.engine
                 ev_batch, ev_cursor = self._event_batch()
-                reply = self.transport.call(
+                reply = self.sched_transport.call(
                     self.scheduler_peer,
                     proto.NODE_UPDATE,
                     {
                         "node_id": self.node_id,
+                        # Highest scheduler epoch this worker has seen:
+                        # the fencing signal — a primary hearing a
+                        # higher epoch than its own knows a standby
+                        # promoted past it and refuses further
+                        # mutations (docs/ha.md).
+                        "epoch": self.sched_transport.epoch,
                         # Prefix-digest delta for the scheduler's routing
                         # index (None unless cache-aware routing enabled
                         # digest tracking via the allocation).
@@ -1405,14 +1446,14 @@ class WorkerNode:
             "abort_path", node=self.node_id, peer=peer, reason=reason,
         )
         self._forget_wire_dtype(peer)
-        if not self.standalone and peer != self.scheduler_peer:
+        if not self.standalone and not self._is_scheduler(peer):
             # Tell the scheduler NOW: it marks the peer's CacheIndex
             # stale immediately (the cache-aware router must stop
             # scoring a dead replica's prefixes) and accelerates the
             # heartbeat sweep, so the drain directive arrives while the
             # affected requests are still parked here.
             self.sender.send(
-                self.scheduler_peer, proto.PEER_DOWN,
+                self._sched_peer(), proto.PEER_DOWN,
                 {"reporter": self.node_id, "peer": peer,
                  "reason": reason},
                 best_effort=True,
@@ -2172,7 +2213,7 @@ class WorkerNode:
             for e in entries.values()
         ]
         try:
-            reply = self.transport.call(
+            reply = self.sched_transport.call(
                 self.scheduler_peer, proto.MIGRATE_TARGET,
                 {
                     "requests": descriptors,
@@ -2225,7 +2266,7 @@ class WorkerNode:
                 for rid, path, _w in batch:
                     results[rid] = ("retry", f"target {head} unreachable")
                     self.sender.send(
-                        self.scheduler_peer, proto.REQUEST_COMPLETE,
+                        self._sched_peer(), proto.REQUEST_COMPLETE,
                         {"path": path}, best_effort=True,
                     )
                 logger.warning("%s: checkpoint ship to %s failed: %s",
@@ -2242,7 +2283,7 @@ class WorkerNode:
                         str(rejected.get(rid) or "target rejected"),
                     )
                     self.sender.send(
-                        self.scheduler_peer, proto.REQUEST_COMPLETE,
+                        self._sched_peer(), proto.REQUEST_COMPLETE,
                         {"path": path}, best_effort=True,
                     )
 
@@ -2265,7 +2306,7 @@ class WorkerNode:
                 # request_complete covers the new path when it finishes.
                 if not self.standalone:
                     self.sender.send(
-                        self.scheduler_peer, proto.REQUEST_COMPLETE,
+                        self._sched_peer(), proto.REQUEST_COMPLETE,
                         {"path": e["old_table"] or [self.node_id]},
                         best_effort=True,
                     )
@@ -2429,7 +2470,7 @@ class WorkerNode:
         restore locally (availability first)."""
         owner = None
         try:
-            reply = self.transport.call(
+            reply = self.sched_transport.call(
                 self.scheduler_peer, proto.WHERE_IS, {"rid": rid},
                 timeout=5.0,
             )
@@ -2473,7 +2514,7 @@ class WorkerNode:
             # ownership, so nothing else releases the router charge the
             # scheduler made when it chose this path.
             self.sender.send(
-                self.scheduler_peer, proto.REQUEST_COMPLETE,
+                self._sched_peer(), proto.REQUEST_COMPLETE,
                 {"path": list(path)}, best_effort=True,
             )
         e["awaiting_since"] = None
@@ -2489,7 +2530,7 @@ class WorkerNode:
         locally."""
         if e.pop("pinned_charged", False) and e.get("pinned_path"):
             self.sender.send(
-                self.scheduler_peer, proto.REQUEST_COMPLETE,
+                self._sched_peer(), proto.REQUEST_COMPLETE,
                 {"path": list(e["pinned_path"])}, best_effort=True,
             )
 
@@ -2606,7 +2647,7 @@ class WorkerNode:
         targets = {}
         if descriptors:
             try:
-                reply = self.transport.call(
+                reply = self.sched_transport.call(
                     self.scheduler_peer, proto.DISAGG_TARGET,
                     {"requests": descriptors, "exclude": [self.node_id]},
                     timeout=15.0,
@@ -2679,7 +2720,7 @@ class WorkerNode:
                     e["kv_failed"] = True
                     results[rid] = ("retry", "kv lane backpressure")
                     self.sender.send(
-                        self.scheduler_peer, proto.REQUEST_COMPLETE,
+                        self._sched_peer(), proto.REQUEST_COMPLETE,
                         {"path": path}, best_effort=True,
                     )
                     continue
@@ -2700,7 +2741,7 @@ class WorkerNode:
                     results[rid] = ("retry", f"target {head} unreachable")
                     if charged:
                         self.sender.send(
-                            self.scheduler_peer, proto.REQUEST_COMPLETE,
+                            self._sched_peer(), proto.REQUEST_COMPLETE,
                             {"path": path}, best_effort=True,
                         )
                     # A pinned target stays pinned on an UNREACHABLE
@@ -2723,7 +2764,7 @@ class WorkerNode:
                     )
                     if charged:
                         self.sender.send(
-                            self.scheduler_peer, proto.REQUEST_COMPLETE,
+                            self._sched_peer(), proto.REQUEST_COMPLETE,
                             {"path": path}, best_effort=True,
                         )
                     if pinned:
@@ -2849,7 +2890,7 @@ class WorkerNode:
         self._request_events.pop(rid, None)
         if not self.standalone:
             self.sender.send(
-                self.scheduler_peer, proto.REQUEST_COMPLETE,
+                self._sched_peer(), proto.REQUEST_COMPLETE,
                 {"path": e["old_table"] or [self.node_id]},
                 best_effort=True,
             )
@@ -2941,9 +2982,9 @@ class WorkerNode:
         get_flight().event(
             "kv_lane_down", node=self.node_id, peer=peer, reason=reason,
         )
-        if not self.standalone and peer != self.scheduler_peer:
+        if not self.standalone and not self._is_scheduler(peer):
             self.sender.send(
-                self.scheduler_peer, proto.PEER_DOWN,
+                self._sched_peer(), proto.PEER_DOWN,
                 {"reporter": self.node_id, "peer": peer,
                  "reason": f"kv lane: {reason}"},
                 best_effort=True,
@@ -3073,7 +3114,7 @@ class WorkerNode:
             # Handoffs report through the same where_is table: pollers
             # that lose the prefill head still find the decode head.
             self.sender.send(
-                self.scheduler_peer, proto.MIGRATION_DONE,
+                self._sched_peer(), proto.MIGRATION_DONE,
                 {"rid": rid, "head": self.node_id}, best_effort=True,
             )
         from parallax_tpu.obs.flight import get_flight
@@ -3247,7 +3288,7 @@ class WorkerNode:
             # Fire-and-forget: the scheduler's round trip happens on its
             # link's sender worker.
             self.sender.send(
-                self.scheduler_peer, proto.REQUEST_COMPLETE,
+                self._sched_peer(), proto.REQUEST_COMPLETE,
                 {
                     "path": req.routing_table or [self.node_id],
                     # Predicted-vs-actual routing telemetry: this head's

@@ -79,6 +79,18 @@ KV_RESULT = "kv_handoff_result"
 # (same CacheIndex scoring as migrate_target, restricted to pipelines
 # whose role admits the decode phase).
 DISAGG_TARGET = "disagg_target"
+# Scheduler HA (docs/ha.md): the primary's StateJournal streams
+# state-mutating records to attached standbys (push replication)...
+HA_JOURNAL = "ha_journal"
+# ... and a standby pulls the journal suffix past its applied seq —
+# doubling as the lease probe; the reply falls back to a full snapshot
+# when the journal ring already evicted the requested window.
+HA_SYNC = "ha_sync"
+# Client -> scheduler: route one request over RPC. Only used when the
+# client's in-process scheduler handle is passive/fenced/absent (after
+# a standby promotion the SwarmClient keeps admitting through the
+# promoted peer instead of 503ing).
+ROUTE_REQUEST = "route_request"
 
 
 def _build_dtype_registry() -> dict[str, np.dtype]:

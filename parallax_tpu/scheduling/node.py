@@ -147,6 +147,37 @@ class CacheIndex:
         while len(self._entries) > self.max_entries:
             self._entries.popitem(last=False)
 
+    def export(self) -> dict:
+        """HA snapshot codec (parallax_tpu/ha): the digest set in LRU
+        order plus the delta cursor, with the staleness clock shipped as
+        an AGE (the standby's monotonic clock is not ours)."""
+        with self._lock:
+            return {
+                "entries": list(self._entries),
+                "block": self.block,
+                "seq": self.seq,
+                "age_s": (
+                    max(0.0, time.monotonic() - self.updated_at)
+                    if self._entries else None
+                ),
+            }
+
+    def adopt(self, snap: dict) -> None:
+        """Restore an :meth:`export` payload, re-anchoring the staleness
+        clock on the local monotonic clock. The delta cursor carries
+        over so the worker's NEXT in-sequence delta applies cleanly — a
+        promotion alone must not force a digest resync."""
+        entries = snap.get("entries") or ()
+        age = snap.get("age_s")
+        with self._lock:
+            self._entries = OrderedDict((int(d), 0) for d in entries)
+            self.block = int(snap.get("block") or 0)
+            self.seq = int(snap.get("seq", -1))
+            self.updated_at = (
+                time.monotonic() - float(age) if age is not None else 0.0
+            )
+            self._trim()
+
     def confidence(self) -> float:
         """1.0 while heartbeats flow (anything fresher than half the
         staleness horizon), then decaying linearly to 0.0 at

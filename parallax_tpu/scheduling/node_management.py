@@ -162,6 +162,30 @@ class NodeManager:
                     self._state[n.node_id] = NodeState.ACTIVE
             self._pipelines.extend(pipelines)
 
+    def adopt_pipelines(self, pipelines: list[Pipeline],
+                        next_pipeline_id: int) -> None:
+        """HA restore path (parallax_tpu/ha): REPLACE the pipeline table
+        with one replicated from a primary, keeping the primary's
+        pipeline ids (register_pipelines would renumber them, and
+        worker-visible ids must survive a promotion). Members go ACTIVE;
+        every other known node drops to STANDBY."""
+        with self._lock:
+            members = {n.node_id for p in pipelines for n in p.nodes}
+            for nid in self._state:
+                self._state[nid] = (
+                    NodeState.ACTIVE if nid in members else NodeState.STANDBY
+                )
+            self._pipelines = list(pipelines)
+            self._next_pipeline_id = max(
+                next_pipeline_id,
+                max((p.pipeline_id + 1 for p in pipelines), default=0),
+            )
+
+    @property
+    def next_pipeline_id(self) -> int:
+        with self._lock:
+            return self._next_pipeline_id
+
     @property
     def pipelines(self) -> list[Pipeline]:
         with self._lock:

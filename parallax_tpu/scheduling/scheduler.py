@@ -81,8 +81,23 @@ class GlobalScheduler:
         routing_kwargs: dict | None = None,
         slo: "SLOConfig | None" = None,
         qos: "QoSConfig | None" = None,
+        passive: bool = False,
     ):
         self.model = model
+        # Scheduler HA (parallax_tpu/ha, docs/ha.md): ``epoch`` rides
+        # heartbeat replies and fences a revived old primary; a
+        # ``passive`` scheduler is a warm-standby mirror — its event/
+        # dispatch threads stay parked and the service refuses mutating
+        # RPCs until StandbyScheduler.promote() flips it active; a
+        # ``fenced`` scheduler saw proof (a worker echoing a higher
+        # epoch) that a standby promoted past it and refuses to mutate.
+        self.epoch = 1
+        self.passive = passive
+        self.fenced = False
+        # Installed by ha.journal.install_journal; None = HA off (every
+        # _journal() hook is a no-op).
+        self.journal = None
+        self._journaled_pipelines = None
         self.min_nodes = min_nodes_bootstrapping
         self.manager = NodeManager(model.num_hidden_layers)
         alloc_cls: type[BaseLayerAllocator] = (
@@ -170,6 +185,48 @@ class GlobalScheduler:
                 self.autoscaler = PoolAutoscaler(
                     self.manager, qos, timeline=self.timeline,
                 )
+        # Control-plane counters whose running totals already live in
+        # the stats dicts above: adopted at scrape time (set_total) so
+        # the hot paths stay metric-free. The registry holds collectors
+        # by weakref — the strong ref on self keeps ours alive.
+        try:
+            from parallax_tpu.obs.registry import get_registry
+
+            reg = get_registry()
+            c_drains = reg.counter(
+                mnames.SCHEDULER_DRAINS_TOTAL,
+                "Drain directives issued to pipeline heads around dead "
+                "peers",
+            )
+            c_targets = reg.counter(
+                mnames.SCHEDULER_MIGRATION_TARGETS_TOTAL,
+                "Migration targets chosen for parked requests "
+                "(CacheIndex-scored)",
+            )
+            c_recorded = reg.counter(
+                mnames.SCHEDULER_MIGRATIONS_RECORDED_TOTAL,
+                "migration_done reports recorded into the where_is "
+                "table",
+            )
+            c_disagg = reg.counter(
+                mnames.SCHEDULER_DISAGG_TARGETS_TOTAL,
+                "Decode-pool handoff targets chosen for finished "
+                "prompts",
+            )
+
+            def _collect_scheduler_stats() -> None:
+                with self._lock:
+                    mig = dict(self.migration_stats)
+                    dis = dict(self.disagg_stats)
+                c_drains.set_total(mig.get("drains") or 0)
+                c_targets.set_total(mig.get("targets_chosen") or 0)
+                c_recorded.set_total(mig.get("recorded") or 0)
+                c_disagg.set_total(dis.get("targets_chosen") or 0)
+
+            self._metrics_collector = _collect_scheduler_stats
+            reg.register_collector(_collect_scheduler_stats)
+        except Exception:  # pragma: no cover - metrics never break serving
+            self._metrics_collector = None
 
     # -- public API (thread-safe enqueues) --------------------------------
 
@@ -381,6 +438,7 @@ class GlobalScheduler:
         self.timeline.record(
             "migration_done", node=head, request_id=request_id,
         )
+        self._journal("migration_done", {"rid": request_id, "head": head})
 
     def migrated_head(self, request_id: str) -> str | None:
         with self._lock:
@@ -394,6 +452,106 @@ class GlobalScheduler:
         if node is None or not node.digests_need_resync:
             return False
         node.digests_need_resync = False
+        return True
+
+    # -- scheduler HA (parallax_tpu/ha, docs/ha.md) ------------------------
+
+    def fence(self, epoch: int) -> None:
+        """A worker echoed a scheduler epoch higher than ours: a standby
+        promoted while we were partitioned/paused. Stop mutating — the
+        promoted scheduler owns the swarm now (split-brain guard)."""
+        if self.fenced:
+            return
+        self.fenced = True
+        logger.warning(
+            "scheduler fenced: worker echoed epoch %d > our %d — a "
+            "standby promoted past us; refusing further mutations",
+            epoch, self.epoch,
+        )
+        self.timeline.record("ha_fenced", epoch=epoch, our_epoch=self.epoch)
+
+    def _journal(self, kind: str, data: dict) -> None:
+        """Replicate one state mutation (no-op while HA is off)."""
+        if self.journal is None:
+            return
+        try:
+            self.journal.record(kind, data)
+        except Exception:  # pragma: no cover - HA must never break serving
+            logger.exception("journal record %r failed", kind)
+
+    def _journal_pipelines(self) -> None:
+        """Journal the pipeline/allocation table when it changed since
+        the last call. Allocation is DERIVED state (the allocator is
+        deterministic only given identical arrival order), so the
+        primary's actual decision is replicated rather than recomputed
+        by the standby — covering bootstrap, extend, dynamic-join
+        replicas, turning-point trims, rebalances and autoscaler
+        re-roles through one diff point."""
+        if self.journal is None:
+            return
+        members: set[str] = set()
+        pipelines = []
+        for p in self.manager.pipelines:
+            pipelines.append({
+                "id": p.pipeline_id,
+                "nodes": [
+                    [n.node_id, n.start_layer, n.end_layer, n.role]
+                    for n in p.nodes
+                ],
+            })
+            members.update(p.node_ids)
+        replicas = [
+            [n.node_id, n.start_layer, n.end_layer]
+            for n in self.manager.nodes(NodeState.ACTIVE)
+            if n.node_id not in members and n.has_allocation
+        ]
+        cur = {
+            "bootstrapped": self.bootstrapped.is_set(),
+            "next_id": self.manager.next_pipeline_id,
+            "pipelines": pipelines,
+            "replicas": replicas,
+        }
+        if cur != self._journaled_pipelines:
+            self._journaled_pipelines = cur
+            self._journal("pipelines", cur)
+
+    # -- synchronous drivers (standby mirror + virtual-time harness) -------
+
+    def apply_event(self, ev: tuple) -> None:
+        """Apply one topology event synchronously — the churn harness
+        drives the REAL handler without the event thread."""
+        self._handle_event(ev)
+
+    def drain_events(self) -> int:
+        """Drain and handle every queued event now (synchronous twin of
+        one _event_loop pass). Returns the number handled."""
+        n = 0
+        while True:
+            try:
+                ev = self._events.get_nowait()
+            except queue.Empty:
+                return n
+            try:
+                self._handle_event(ev)
+            except Exception:
+                logger.exception("event %r failed", ev[0])
+            n += 1
+
+    def sweep_once(self) -> None:
+        """One heartbeat-sweep + QoS-tick + journal-diff pass
+        (synchronous twin of the _event_loop's 1 Hz housekeeping)."""
+        self._sweep_heartbeats()
+        self._qos_tick(time.monotonic())
+        self._journal_pipelines()
+
+    def dispatch_once(self) -> bool:
+        """Route one queued request now (synchronous twin of one
+        _dispatch_loop pass). Returns False when the queue was empty."""
+        try:
+            pr = self._requests.get_nowait()
+        except queue.Empty:
+            return False
+        self._dispatch_one(pr)
         return True
 
     # -- lifecycle --------------------------------------------------------
@@ -430,10 +588,29 @@ class GlobalScheduler:
             if now - last_sweep > 1.0:
                 self._sweep_heartbeats()
                 self._qos_tick(now)
+                # Autoscaler re-roles and sweep-driven churn change the
+                # allocation table off the join/leave paths; the 1 Hz
+                # diff catches them for the HA journal.
+                self._journal_pipelines()
                 last_sweep = now
 
     def _handle_event(self, ev: tuple) -> None:
         kind = ev[0]
+        if self.fenced:
+            # A promoted standby owns the swarm; a fenced old primary
+            # mutating its registry would fork the control plane.
+            return
+        try:
+            from parallax_tpu.obs.registry import get_registry
+
+            get_registry().counter(
+                mnames.SCHEDULER_EVENTS_TOTAL,
+                "Topology events handled by the scheduler event thread, "
+                "by kind (join / leave / peer_down / update)",
+                labelnames=("kind",),
+            ).labels(kind=kind).inc()
+        except Exception:  # pragma: no cover - metrics never break serving
+            pass
         if kind == "join":
             _, node_id, hardware, *rest = ev
             node = Node(node_id=node_id, hardware=hardware, model=self.model)
@@ -453,7 +630,14 @@ class GlobalScheduler:
             logger.info("node %s joined (%s x%d, role=%s)", node_id,
                         hardware.device_kind, hardware.num_chips,
                         node.role)
+            self._journal("join", {
+                "node_id": node_id,
+                "hardware": hardware.to_dict(),
+                "wire_formats": list(node.wire_formats),
+                "role": node.role,
+            })
             self._try_bootstrap_or_extend()
+            self._journal_pipelines()
         elif kind == "leave":
             self._handle_leave(ev[1])
         elif kind == "peer_down":
@@ -474,6 +658,10 @@ class GlobalScheduler:
                     "peer_down", node=peer, reporter=reporter,
                     reason=reason or "?",
                 )
+                self._journal("peer_down", {
+                    "reporter": reporter, "peer": peer,
+                    "reason": reason or "",
+                })
         elif kind == "update":
             (_, node_id, lat, load, rtt, ready, refit, adapters, timing,
              cache_stats, *rest) = ev
@@ -547,6 +735,19 @@ class GlobalScheduler:
             if cache_digests is not None:
                 if node.cache_index.apply(cache_digests):
                     node.digests_need_resync = True
+            # Bounded heartbeat-replay window: a promoted standby
+            # re-derives soft state (load charges, readiness, digest
+            # continuity) from these instead of trusting a snapshot of
+            # someone else's clocks.
+            self._journal("hb", {
+                "node_id": node_id,
+                "load": load,
+                "ready": ready,
+                "busy": busy,
+                "latency_ms": lat,
+                "refit_version": refit,
+                "digests": cache_digests,
+            })
 
     def _try_bootstrap_or_extend(self) -> None:
         standby = self.manager.nodes(NodeState.STANDBY)
@@ -695,6 +896,7 @@ class GlobalScheduler:
         self.timeline.record(
             "node_leave", node=node_id, displaced=len(displaced),
         )
+        self._journal("leave", {"node_id": node_id})
         active = list(self.manager.nodes(NodeState.ACTIVE))
         if not self.manager.pipelines or self.allocator.should_global_rebalance(
             active
@@ -702,12 +904,23 @@ class GlobalScheduler:
             self._global_rebalance()
         else:
             self._try_bootstrap_or_extend()
+        self._journal_pipelines()
 
     def _global_rebalance(self) -> None:
         """Tear everything down and re-allocate from scratch (reference
         scheduler.py:581-636). Workers detect new ranges via heartbeat
         replies and reload."""
         logger.info("global rebalance")
+        try:
+            from parallax_tpu.obs.registry import get_registry
+
+            get_registry().counter(
+                mnames.SCHEDULER_REBALANCES_TOTAL,
+                "Global rebalances (full teardown + re-allocation of "
+                "every pipeline)",
+            ).inc()
+        except Exception:  # pragma: no cover - metrics never break serving
+            pass
         self.manager.standby_all()
         self.bootstrapped.clear()
         self._try_bootstrap_or_extend()
@@ -748,6 +961,17 @@ class GlobalScheduler:
                 )
             if node.is_stale(timeout):
                 logger.warning("heartbeat timeout: %s", node.node_id)
+                try:
+                    from parallax_tpu.obs.registry import get_registry
+
+                    get_registry().counter(
+                        mnames.SCHEDULER_HEARTBEAT_EVICTIONS_TOTAL,
+                        "Nodes evicted by the heartbeat sweep "
+                        "(missed-beat leaves, as opposed to clean "
+                        "node_leave departures)",
+                    ).inc()
+                except Exception:  # pragma: no cover
+                    pass
                 self._handle_leave(node.node_id)
 
     # -- dispatch loop ----------------------------------------------------
@@ -758,34 +982,42 @@ class GlobalScheduler:
                 pr = self._requests.get(timeout=0.05)
             except queue.Empty:
                 continue
-            if pr.cancelled:
-                pr.event.set()
-                continue
-            try:
-                path = self.router.find_path(pr.meta)
-            except Exception:
-                # A router bug must not kill the dispatch thread — every
-                # later request would silently time out to 503. Treat as
-                # "no path now" and let the retry ladder run.
-                logger.exception("find_path failed for %s", pr.request_id)
-                path = None
-            if path is not None:
-                self.router.on_dispatch(path)
-                pr.path_ids = [n.node_id for n in path]
-                if pr.meta is not None and pr.meta.prompt_ids:
-                    self._record_prediction(
-                        pr.request_id,
-                        pr.meta.predicted_cached_tokens,
-                        pr.meta.num_prompt_tokens,
-                    )
-                pr.event.set()
-            elif time.monotonic() < pr.deadline:
-                # No serviceable pipeline right now (bootstrap in flight,
-                # all busy, refit) — retry until the deadline.
-                self._requests.put(pr)
+            if not self._dispatch_one(pr):
                 time.sleep(0.02)
-            else:
-                pr.event.set()
+
+    def _dispatch_one(self, pr: PendingRequest) -> bool:
+        """Route one pending request (shared by the dispatch thread and
+        the synchronous :meth:`dispatch_once` driver). Returns False
+        when the request was re-queued for a later retry."""
+        if pr.cancelled:
+            pr.event.set()
+            return True
+        try:
+            path = self.router.find_path(pr.meta)
+        except Exception:
+            # A router bug must not kill the dispatch thread — every
+            # later request would silently time out to 503. Treat as
+            # "no path now" and let the retry ladder run.
+            logger.exception("find_path failed for %s", pr.request_id)
+            path = None
+        if path is not None:
+            self.router.on_dispatch(path)
+            pr.path_ids = [n.node_id for n in path]
+            if pr.meta is not None and pr.meta.prompt_ids:
+                self._record_prediction(
+                    pr.request_id,
+                    pr.meta.predicted_cached_tokens,
+                    pr.meta.num_prompt_tokens,
+                )
+            pr.event.set()
+            return True
+        if time.monotonic() < pr.deadline:
+            # No serviceable pipeline right now (bootstrap in flight,
+            # all busy, refit) — retry until the deadline.
+            self._requests.put(pr)
+            return False
+        pr.event.set()
+        return True
 
     def _record_prediction(self, request_id: str, predicted: int,
                            prompt_tokens: int) -> None:
@@ -837,7 +1069,10 @@ class GlobalScheduler:
         with self._lock:
             self.refit_version += 1
             self.refit_index = dict(index_map)
-            return self.refit_version
+            version = self.refit_version
+        self._journal("refit", {"version": version,
+                                "index": dict(index_map)})
+        return version
 
     # -- introspection ----------------------------------------------------
 

@@ -74,8 +74,8 @@ def _scoped_lock_sanitizer(request):
 # when the session opted in with --conformance-sanitizer (the CI
 # chaos/migration/handoff/QoS smoke steps run exactly these).
 CONFORMANCE_E2E_MODULES = {
-    "test_churn_migration", "test_disaggregation", "test_qos",
-    "test_swarm_e2e", "test_swarm_scale",
+    "test_churn_migration", "test_disaggregation", "test_ha_failover",
+    "test_qos", "test_swarm_e2e", "test_swarm_scale",
 }
 
 
@@ -155,7 +155,8 @@ def _conformance_summary(terminalreporter, config):
 # ``pytest -m "not slow"``; CI and the driver run everything.
 SLOW_MODULES = {
     "test_deepseek_mla", "test_dsa", "test_engine_e2e",
-    "test_glm4_gptoss", "test_http_serving", "test_linear_prefix_cache",
+    "test_glm4_gptoss", "test_ha_failover", "test_http_serving",
+    "test_linear_prefix_cache",
     "test_lora_serving", "test_mla_pallas", "test_moe", "test_msa",
     "test_multistep_decode", "test_ops_attention", "test_pp_speculative",
     "test_quantization", "test_qwen3_next", "test_ring_attention",

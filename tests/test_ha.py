@@ -1,0 +1,340 @@
+"""Scheduler HA units: journal codec, standby promotion, fencing,
+failover rotation, digest resync after promotion (docs/ha.md).
+
+Deliberately jax-free (fast lane): everything here exercises the
+control plane's snapshot/journal/promotion machinery without an
+engine.
+"""
+
+import random
+
+import pytest
+
+from parallax_tpu.config import normalize_config
+from parallax_tpu.ha.backoff import Backoff, BackoffPolicy
+from parallax_tpu.ha.failover import SchedulerFailover
+from parallax_tpu.ha.journal import (
+    StateJournal,
+    install_journal,
+    snapshot_state,
+    restore_state,
+    soft_state_fingerprint,
+    state_fingerprint,
+)
+from parallax_tpu.ha.standby import StandbyScheduler
+from parallax_tpu.scheduling.scheduler import GlobalScheduler
+from parallax_tpu.utils.hw import TPU_CHIP_DB, HardwareInfo
+
+TINY = normalize_config(dict(
+    architectures=["Qwen2ForCausalLM"],
+    hidden_size=64, num_hidden_layers=4, num_attention_heads=4,
+    num_key_value_heads=2, intermediate_size=128, vocab_size=151,
+    max_position_embeddings=256,
+))
+
+
+def _hw(kind="v5e", chips=4):
+    t, g, b, i = TPU_CHIP_DB[kind]
+    return HardwareInfo(kind, chips, t, g, b, i)
+
+
+def _serving_scheduler(n=2, journal_path=None):
+    """A bootstrapped scheduler with ``n`` ready nodes, driven through
+    the synchronous twins (no threads)."""
+    sched = GlobalScheduler(TINY, min_nodes_bootstrapping=1)
+    if journal_path is not None:
+        journal = StateJournal(sink_path=journal_path, epoch=sched.epoch)
+        install_journal(sched, journal)
+    for i in range(n):
+        sched.enqueue_join(f"w{i}", _hw())
+    sched.drain_events()
+    for i in range(n):
+        sched.enqueue_update(
+            f"w{i}", is_ready=True, load=i, layer_latency_ms=8.0,
+            busy=False,
+        )
+    sched.drain_events()
+    sched.sweep_once()
+    assert sched.bootstrapped.is_set()
+    return sched
+
+
+# -- backoff -----------------------------------------------------------------
+
+
+def test_backoff_full_jitter_under_cap_and_deadline():
+    clock = [0.0]
+    slept = []
+
+    def sleep(s):
+        slept.append(s)
+        clock[0] += s
+
+    b = Backoff(
+        BackoffPolicy(base_s=1.0, cap_s=4.0, multiplier=2.0),
+        deadline_s=10.0, rng=random.Random(3), clock=lambda: clock[0],
+        sleep=sleep,
+    )
+    # Jitter ceiling grows 1, 2, 4, then pins at the cap.
+    delays = [b.next_delay() for _ in range(6)]
+    assert all(d <= 4.0 for d in delays)
+    assert b.attempts == 6
+    # wait() never sleeps past the shared deadline and reports
+    # exhaustion instead of looping forever.
+    b2 = Backoff(
+        BackoffPolicy(base_s=8.0, cap_s=8.0), deadline_s=2.0,
+        rng=random.Random(1), clock=lambda: clock[0], sleep=sleep,
+    )
+    ok = True
+    rounds = 0
+    while ok and rounds < 50:
+        ok = b2.wait()
+        rounds += 1
+    assert not ok and rounds < 50
+    assert max(slept) <= 8.0
+
+
+# -- failover wrapper --------------------------------------------------------
+
+
+class _ScriptedTransport:
+    """Transport-shaped stub: per-peer reply scripts."""
+
+    def __init__(self, scripts):
+        self.scripts = {k: list(v) for k, v in scripts.items()}
+        self.calls = []
+
+    def call(self, peer, method, payload, timeout=10.0):
+        self.calls.append((peer, method))
+        script = self.scripts.get(peer) or [ConnectionError(peer)]
+        step = script.pop(0) if len(script) > 1 else script[0]
+        if isinstance(step, Exception):
+            raise step
+        return step
+
+
+def test_failover_rotates_on_transport_error():
+    t = _ScriptedTransport({
+        "primary": [ConnectionError("down")],
+        "standby": [{"ok": True, "epoch": 2}],
+    })
+    fo = SchedulerFailover(
+        t, ["primary", "standby"],
+        policy=BackoffPolicy(base_s=0.0, cap_s=0.0),
+    )
+    reply = fo.call("primary", "node_update", {"node_id": "w0"})
+    assert reply == {"ok": True, "epoch": 2}
+    assert fo.active_peer == "standby"
+    assert fo.epoch == 2
+
+
+def test_failover_rotates_on_not_primary_and_learns_standbys():
+    t = _ScriptedTransport({
+        "primary": [{"not_primary": True, "epoch": 3,
+                     "standbys": ["standby"]}],
+        "standby": [{"ok": True, "epoch": 3}],
+    })
+    # The wrapper starts knowing ONLY the primary; the redirect reply
+    # advertises the standby and the retry lands there.
+    fo = SchedulerFailover(
+        t, ["primary"], policy=BackoffPolicy(base_s=0.0, cap_s=0.0),
+    )
+    reply = fo.call("primary", "node_update", {"node_id": "w0"})
+    assert reply == {"ok": True, "epoch": 3}
+    assert fo.peers == ["primary", "standby"]
+    assert fo.epoch == 3
+
+
+def test_failover_exhausts_deadline_with_original_error():
+    t = _ScriptedTransport({"only": [ConnectionError("down")]})
+    fo = SchedulerFailover(
+        t, ["only"], policy=BackoffPolicy(base_s=0.05, cap_s=0.05),
+    )
+    with pytest.raises(ConnectionError):
+        fo.call("only", "node_update", {"node_id": "w0"}, timeout=0.2)
+
+
+# -- snapshot codec ----------------------------------------------------------
+
+
+def test_snapshot_roundtrip_preserves_fingerprint():
+    sched = _serving_scheduler()
+    sched.record_migration("r1", "w0")
+    snap = snapshot_state(sched)
+    mirror = GlobalScheduler(TINY, min_nodes_bootstrapping=1, passive=True)
+    restore_state(mirror, snap)
+    assert (
+        state_fingerprint(mirror) == state_fingerprint(sched)
+    )
+    assert soft_state_fingerprint(mirror) == soft_state_fingerprint(sched)
+    # Pipeline ids survive verbatim: the router's dispatch ledger and
+    # worker-visible ids stay stable across a promotion.
+    assert (
+        [p.pipeline_id for p in mirror.manager.pipelines]
+        == [p.pipeline_id for p in sched.manager.pipelines]
+    )
+
+
+def test_snapshot_version_and_model_guard():
+    sched = _serving_scheduler(n=1)
+    snap = snapshot_state(sched)
+    mirror = GlobalScheduler(TINY, min_nodes_bootstrapping=1, passive=True)
+    bad = dict(snap, v=99)
+    with pytest.raises(ValueError):
+        restore_state(mirror, bad)
+    bad = dict(snap, model="other-model")
+    with pytest.raises(ValueError):
+        restore_state(mirror, bad)
+
+
+# -- journal replay + promotion ---------------------------------------------
+
+
+def test_file_journal_replay_promotes_equivalent_state(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    sched = _serving_scheduler(journal_path=path)
+    sched.record_migration("r7", "w1")
+    # Post-install churn must flow through the journal too.
+    sched.enqueue_join("w2", _hw("v5e", 2))
+    sched.drain_events()
+    sched.enqueue_update("w2", is_ready=True, load=0, layer_latency_ms=9.0)
+    sched.drain_events()
+    sched.sweep_once()
+
+    mirror = GlobalScheduler(TINY, min_nodes_bootstrapping=1, passive=True)
+    standby = StandbyScheduler(
+        mirror, journal_path=path, auto_promote=False,
+    )
+    assert standby.sync_once()
+    assert state_fingerprint(mirror) == state_fingerprint(sched)
+    assert soft_state_fingerprint(mirror) == soft_state_fingerprint(sched)
+
+    epoch = standby.promote(start_threads=False)
+    assert epoch == sched.epoch + 1
+    assert mirror.epoch == epoch
+    assert not mirror.passive and not mirror.fenced
+    # The promoted scheduler owns a fresh journal seeded with its own
+    # snapshot + epoch record — a second standby can tail IT now.
+    assert mirror.journal is not None and mirror.journal.seq >= 2
+    # Promotion is idempotent.
+    assert standby.promote(start_threads=False) == epoch
+
+
+def test_journal_ring_eviction_reports_discontiguity():
+    j = StateJournal(capacity=4)
+    for i in range(10):
+        j.record("hb", {"i": i})
+    recs, contiguous = j.records_since(0)
+    assert not contiguous          # seqs 1..6 were evicted
+    recs, contiguous = j.records_since(6)
+    assert contiguous and [r["seq"] for r in recs] == [7, 8, 9, 10]
+
+
+# -- fencing -----------------------------------------------------------------
+
+
+def test_fenced_scheduler_refuses_mutations():
+    sched = _serving_scheduler()
+    before = state_fingerprint(sched)
+    sched.fence(7)
+    assert sched.fenced
+    sched.enqueue_join("zombie", _hw())
+    sched.enqueue_update("w0", is_ready=False, load=99)
+    sched.drain_events()
+    assert state_fingerprint(sched) == before
+    assert sched.manager.get("zombie") is None
+
+
+def test_service_fences_on_higher_echoed_epoch():
+    from parallax_tpu.backend.scheduler_service import SchedulerService
+
+    class _T:
+        def register(self, *_a, **_k):
+            pass
+
+    sched = _serving_scheduler()
+    service = SchedulerService(sched, _T(), standby_addrs=["sb:1"])
+    # Normal beat: mutates and advertises epoch + standby list.
+    reply = service._on_update("w0", {"node_id": "w0", "load": 1})
+    assert reply.get("epoch") == sched.epoch
+    assert reply.get("standbys") == ["sb:1"]
+    # A worker echoing a higher epoch proves a standby promoted past
+    # us: the service fences BEFORE handling and refuses the mutation.
+    reply = service._on_update(
+        "w0", {"node_id": "w0", "load": 5, "epoch": sched.epoch + 1},
+    )
+    assert reply.get("not_primary") and sched.fenced
+    # Every mutating frame now bounces; reads still answer.
+    assert service._on_join("w9", {"node_id": "w9"}).get("not_primary")
+    assert service.route_request("r1", timeout_s=0.01) is None
+
+
+# -- digest continuity across promotion (no full-snapshot storm) -------------
+
+
+def test_digest_seq_gap_after_promotion_asks_one_resync(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    sched = _serving_scheduler(journal_path=path)
+    # The worker's digest feed: full snapshot then one delta, all
+    # journaled as hb records.
+    sched.enqueue_update(
+        "w0", cache_digests={"seq": 0, "block": 16, "full": [1, 2, 3]},
+    )
+    sched.enqueue_update(
+        "w0", cache_digests={"seq": 1, "block": 16, "added": [4]},
+    )
+    sched.drain_events()
+
+    mirror = GlobalScheduler(TINY, min_nodes_bootstrapping=1, passive=True)
+    standby = StandbyScheduler(mirror, journal_path=path,
+                               auto_promote=False)
+    assert standby.sync_once()
+    node = mirror.manager.get("w0")
+    assert node.cache_index.seq == 1
+    standby.promote(start_threads=False)
+
+    # Delta seq 2 died with the old primary; the worker's next beat
+    # carries seq 3 — a gap. The promoted scheduler must ask for ONE
+    # resync, not storm.
+    mirror.enqueue_update(
+        "w0", cache_digests={"seq": 3, "block": 16, "added": [6]},
+    )
+    mirror.drain_events()
+    assert node.digests_need_resync
+    assert mirror.digests_resync_requested("w0") is True
+    # Consumed: no repeat ask while the worker prepares the snapshot.
+    assert mirror.digests_resync_requested("w0") is False
+    # The worker answers with a full export and the mirror rebuilds.
+    mirror.enqueue_update(
+        "w0",
+        cache_digests={"seq": 3, "block": 16, "full": [1, 2, 3, 4, 6]},
+    )
+    mirror.drain_events()
+    assert node.cache_index.seq == 3
+    assert sorted(node.cache_index.export()["entries"]) == [1, 2, 3, 4, 6]
+    assert not node.digests_need_resync
+    assert mirror.digests_resync_requested("w0") is False
+
+
+# -- churn harness -----------------------------------------------------------
+
+
+def test_churn_replay_is_deterministic(tmp_path):
+    from parallax_tpu.testing.churn import run_churn
+
+    def one():
+        path = str(tmp_path / "churn.jsonl")
+        import os
+
+        if os.path.exists(path):
+            os.unlink(path)
+        return run_churn(
+            nodes=40, seed=11, duration_s=200.0, journal_path=path,
+            promote_at_s=120.0,
+        )
+
+    a, b = one(), one()
+    assert a.ok, a.errors
+    assert a.routed > 0 and a.routed == a.completed
+    assert a.promotion_epoch == 2
+    assert a.log == b.log

@@ -1,0 +1,259 @@
+"""E2E scheduler failover over real TCP: kill the primary mid-decode,
+a warm standby promotes within the lease, and the streams never notice
+(docs/ha.md).
+
+Same swarm shape as test_swarm_e2e (scheduler + 2 workers over
+localhost TCP frames), plus a second scheduler process-worth of state:
+a passive mirror + StandbyScheduler tailing the primary's journal over
+the RPC plane. The test asserts the acceptance story end to end:
+
+- an in-flight greedy request keeps streaming through the kill and
+  finishes **bit-identically** to an in-process reference;
+- the standby promotes within the lease and the workers' failover
+  wrappers land their heartbeats (and the echoed epoch) on it;
+- a post-promotion SEEDED request routes against the promoted
+  scheduler and is bit-identical too — K=1 and K>1 decode both;
+- a revived old primary fences itself on the first echoed higher
+  epoch and can no longer mutate;
+- ``parallax_ha_promotions_total`` moved by exactly one.
+"""
+
+import dataclasses
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from parallax_tpu.backend.scheduler_service import SchedulerService
+from parallax_tpu.config import normalize_config
+from parallax_tpu.ha.journal import StateJournal, install_journal
+from parallax_tpu.ha.standby import StandbyScheduler
+from parallax_tpu.models.base import StageModel
+from parallax_tpu.obs import names as mnames
+from parallax_tpu.obs.registry import get_registry
+from parallax_tpu.p2p.node import WorkerNode
+from parallax_tpu.p2p.transport import TcpTransport
+from parallax_tpu.runtime.engine import EngineConfig, StageEngine
+from parallax_tpu.runtime.pipeline import InProcessPipeline
+from parallax_tpu.runtime.request import Request, SamplingParams
+from parallax_tpu.scheduling.scheduler import GlobalScheduler
+from parallax_tpu.utils.hw import HardwareInfo
+
+TINY = normalize_config(dict(
+    architectures=["Qwen2ForCausalLM"],
+    hidden_size=64, num_hidden_layers=4, num_attention_heads=4,
+    num_key_value_heads=2, intermediate_size=128, vocab_size=151,
+    max_position_embeddings=256,
+))
+
+ENGINE_CFG = EngineConfig(
+    page_size=8, num_pages=64, max_model_len=128, kv_dtype="float32",
+    max_num_tokens_per_batch=128, max_batch_size=8,
+)
+
+
+def stage_params(model: StageModel):
+    return model.init_params(
+        jax.random.key(model.start_layer * 1000 + model.end_layer),
+        dtype=jnp.float32,
+    )
+
+
+@pytest.fixture(params=[1, 4], ids=["K1", "K4"])
+def ha_swarm(request, monkeypatch):
+    """Primary + warm standby + 2 workers over TCP; K=1 and K>1
+    decode windows."""
+    cfg = dataclasses.replace(
+        ENGINE_CFG, decode_lookahead=request.param,
+    )
+    from parallax_tpu.scheduling import node as node_mod
+
+    monkeypatch.setattr(
+        node_mod.RooflinePerformanceModel, "max_layers_in_memory",
+        lambda self, kv_fraction=0.35: 2,
+    )
+
+    # Standby first: the primary advertises its address in every reply.
+    mirror = GlobalScheduler(TINY, min_nodes_bootstrapping=2, passive=True)
+    standby_transport = TcpTransport("standby", "127.0.0.1")
+    standby_service = SchedulerService(mirror, standby_transport)
+    standby_service.start()        # passive: no scheduler threads yet
+    standby_addr = standby_transport.address
+
+    sched = GlobalScheduler(TINY, min_nodes_bootstrapping=2)
+    sched_transport = TcpTransport("scheduler", "127.0.0.1")
+    service = SchedulerService(
+        sched, sched_transport, join_timeout_s=30.0,
+        standby_addrs=[standby_addr],
+    )
+    service.start()
+    primary_addr = sched_transport.address
+
+    journal = StateJournal(epoch=sched.epoch)
+    journal.bind(sched_transport)
+    install_journal(sched, journal)
+
+    standby = StandbyScheduler(
+        mirror, transport=standby_transport, primary=primary_addr,
+        lease_s=1.5, sync_interval_s=0.25, node_id=standby_addr,
+    )
+    standby.start()
+
+    workers = []
+    for _ in range(2):
+        t = TcpTransport("", "127.0.0.1")
+        t.start()
+        t.peer_id = t.address
+        workers.append(WorkerNode(
+            transport=t,
+            scheduler_peer=primary_addr,
+            scheduler_standby=[standby_addr],
+            model_config=TINY,
+            engine_config=cfg,
+            load_params=stage_params,
+            heartbeat_interval_s=0.2,
+        ))
+    starters = [threading.Thread(target=w.start) for w in workers]
+    for s in starters:
+        s.start()
+    for s in starters:
+        s.join(timeout=60.0)
+
+    yield service, standby_service, standby, workers, cfg
+    for w in workers:
+        w.stop()
+    standby.stop()
+    journal.stop()
+    standby_service.stop()
+    service.stop()
+
+
+def wait_ready(service, timeout=15.0):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        status = service.scheduler.cluster_status()
+        if status["num_pipelines"] >= 1 and all(
+            node["ready"]
+            for p in status["pipelines"] for node in p["nodes"]
+        ):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _reference_outputs(workers, path, cfg, prompt_ids, sampling):
+    bounds = sorted(
+        (w.start_layer, w.end_layer) for w in workers
+        if w.node_id in path
+    )
+    engines = []
+    for s, e in bounds:
+        m = StageModel(TINY, s, e, use_pallas=False)
+        engines.append(StageEngine(m, stage_params(m), cfg))
+    pipe = InProcessPipeline(engines)
+    ref = Request(
+        request_id="ref", prompt_ids=list(prompt_ids),
+        sampling_params=sampling,
+    )
+    pipe.submit(ref)
+    pipe.run_until_complete()
+    return ref.output_ids
+
+
+def test_failover_mid_decode_streams_survive(ha_swarm):
+    service, standby_service, standby, workers, cfg = ha_swarm
+    sched = service.scheduler
+    mirror = standby_service.scheduler
+    promoted_before = get_registry().counter(
+        mnames.HA_PROMOTIONS_TOTAL,
+        "Warm-standby scheduler promotions (lease expiries acted on)",
+    ).total
+    assert wait_ready(service), sched.cluster_status()
+
+    # 1) an in-flight greedy request, killed-primary mid-decode.
+    path = service.route_request("req-ha", timeout_s=10.0)
+    assert path is not None and len(path) == 2
+    greedy = SamplingParams(temperature=0.0, max_new_tokens=24,
+                            ignore_eos=True)
+    head = next(w for w in workers if w.node_id == path[0])
+    req = Request(
+        request_id="req-ha", prompt_ids=[1, 2, 3, 4, 5, 6, 7],
+        sampling_params=greedy, routing_table=list(path),
+    )
+    done = head.submit(req)
+
+    # Kill the primary: scheduler threads AND its transport die. Token
+    # frames ride worker->worker links, so decode continues.
+    service.stop()
+
+    # 2) the standby promotes within the lease.
+    end = time.monotonic() + 20.0
+    while time.monotonic() < end and not standby.promoted:
+        time.sleep(0.05)
+    assert standby.promoted, "standby never promoted after primary death"
+    assert not mirror.passive and mirror.epoch == 2
+    # Journal replication carried the whole registry across.
+    assert {w.node_id for w in workers} <= {
+        n.node_id for n in mirror.manager.nodes()
+    }
+    assert len(mirror.manager.pipelines) >= 1
+
+    # 3) the in-flight stream finished bit-identically.
+    assert done.wait(90.0), f"request did not survive failover: {req.status}"
+    assert req.output_ids == _reference_outputs(
+        workers, path, cfg, [1, 2, 3, 4, 5, 6, 7], greedy,
+    )
+
+    # 4) workers fail their heartbeats over and echo the new epoch.
+    end = time.monotonic() + 15.0
+    while time.monotonic() < end and not all(
+        w.sched_transport.epoch == mirror.epoch for w in workers
+    ):
+        time.sleep(0.1)
+    assert all(w.sched_transport.epoch == mirror.epoch for w in workers)
+
+    # 5) a seeded request routes against the PROMOTED scheduler and is
+    # bit-identical to the in-process reference.
+    seeded = SamplingParams(temperature=0.8, top_k=20, seed=1234,
+                            max_new_tokens=10, ignore_eos=True)
+    path2 = standby_service.route_request("req-ha-2", timeout_s=15.0)
+    assert path2 is not None and len(path2) == 2
+    head2 = next(w for w in workers if w.node_id == path2[0])
+    req2 = Request(
+        request_id="req-ha-2", prompt_ids=[9, 8, 7, 6, 5],
+        sampling_params=seeded, routing_table=list(path2),
+    )
+    done2 = head2.submit(req2)
+    assert done2.wait(90.0), f"post-failover request: {req2.status}"
+    assert req2.output_ids == _reference_outputs(
+        workers, path2, cfg, [9, 8, 7, 6, 5], seeded,
+    )
+
+    # 6) load charges drain back to zero on the promoted scheduler
+    # (request_complete RPCs failed over with everything else).
+    end = time.monotonic() + 15.0
+    while time.monotonic() < end and sum(
+        n.load for n in mirror.manager.nodes()
+    ) > 0:
+        time.sleep(0.1)
+    assert sum(n.load for n in mirror.manager.nodes()) == 0
+
+    # 7) a revived old primary fences itself on the first beat echoing
+    # the promoted epoch, and refuses every later mutation.
+    nodes_before = {n.node_id for n in sched.manager.nodes()}
+    reply = service._on_update(
+        "w0", {"node_id": path[0], "load": 9, "epoch": mirror.epoch},
+    )
+    assert reply.get("not_primary") and sched.fenced
+    assert service._on_join("z", {"node_id": "z"}).get("not_primary")
+    sched.drain_events()
+    assert {n.node_id for n in sched.manager.nodes()} == nodes_before
+
+    # 8) exactly one promotion was counted.
+    promoted_after = get_registry().counter(
+        mnames.HA_PROMOTIONS_TOTAL,
+        "Warm-standby scheduler promotions (lease expiries acted on)",
+    ).total
+    assert promoted_after - promoted_before == 1
