@@ -1823,6 +1823,155 @@ def _spec_probe(model, params, kv_dtype: str) -> dict:
     return result
 
 
+def _constrained_probe(model, params, kv_dtype: str) -> dict:
+    """Constrained-decoding probe (detail.constrained,
+    docs/decode_loop.md): JSON-schema-constrained vs unconstrained
+    decode on one K=8 engine geometry. The grammar mask runs INSIDE the
+    fused decode window (dense device transition table + packed bitsets,
+    DFA state in the scan carry), so constrained rows must hold >=80%
+    of the unconstrained tokens/s — and the committed streams must be
+    bit-identical to the K=1 host-synchronous sampler, valid under the
+    schema, with ZERO host-sync fallbacks on the window engine. The CI
+    constrained-decode smoke asserts exactly those verdicts; the
+    structural keys are pinned by test_bench_contract.
+    """
+    import json as _json
+
+    from parallax_tpu.runtime.engine import (
+        EngineConfig,
+        StageEngine,
+        drive_step,
+    )
+    from parallax_tpu.runtime.request import Request, SamplingParams
+
+    vocab = int(model.config.vocab_size)
+    eos = vocab - 1
+    n_bytes = min(256, vocab - 1)
+    grammar_vocab = (
+        [bytes([i]) for i in range(n_bytes)]
+        + [b""] * (vocab - n_bytes)
+    )
+    schema = _json.dumps({
+        "type": "object",
+        "properties": {"v": {"enum": ["x", "y"]}},
+        "required": ["v"],
+    })
+    batch, prompt_len, gen_len = 8, 16, 96
+    page_size = 16
+    max_len = prompt_len + gen_len + 3 * page_size
+    lookahead_hi = 8
+
+    def make_engine(k: int) -> StageEngine:
+        eng = StageEngine(model, params, EngineConfig(
+            page_size=page_size,
+            num_pages=batch * ((max_len + page_size - 1) // page_size + 1),
+            max_batch_size=batch,
+            max_model_len=max_len,
+            kv_dtype=kv_dtype,
+            enable_prefix_cache=False,
+            decode_lookahead=k,
+        ))
+        eng.set_grammar_vocab(grammar_vocab, eos)
+        return eng
+
+    def run_round(eng, tag, constrained, overlap=True):
+        """One full batch to completion; decode-phase wall ms per
+        committed token (same amortization as the spec probe). The K=8
+        rounds run the serving default (overlap); the K=1 oracle round
+        runs SYNC so every token goes through the host sampler."""
+        eng.cfg.overlap_steps = overlap
+        reqs = []
+        for i in range(batch):
+            prompt = [1 + (7 * i + j) % (vocab - 2)
+                      for j in range(prompt_len)]
+            # ignore_eos on BOTH rounds: every row decodes the full
+            # budget, so the per-token timing compares identical batch
+            # shapes (constrained rows park in the grammar's EOS-only
+            # failsafe after the object closes; the validity check
+            # strips those trailing ids).
+            reqs.append(Request(
+                f"con-{tag}-{i}", prompt_ids=prompt,
+                sampling_params=SamplingParams(
+                    temperature=0.0, max_new_tokens=gen_len,
+                    json_schema=schema if constrained else None,
+                    ignore_eos=True,
+                ),
+            ))
+            eng.submit(reqs[-1])
+        total = 0
+        decode_t0 = None
+        tokens_at_decode = 0
+        t0 = time.perf_counter()
+        pending = None
+        while eng.has_work() or pending is not None:
+            outs, pending = drive_step(eng, pending)
+            for out in outs:
+                total += out.num_tokens
+                if decode_t0 is None:
+                    running = eng.scheduler.running
+                    if (
+                        not eng.scheduler.wait_queue
+                        and running
+                        and all(r.output_ids for r in running.values())
+                    ):
+                        decode_t0 = time.perf_counter()
+                        tokens_at_decode = total
+        wall_s = time.perf_counter() - (decode_t0 or t0)
+        return {
+            "per_token_ms": round(
+                wall_s * 1000.0 / max(1, total - tokens_at_decode), 4
+            ),
+            "decode_tokens": total - tokens_at_decode,
+            "outputs": [list(r.output_ids) for r in reqs],
+        }
+
+    eng_win = make_engine(lookahead_hi)
+    eng_sync = make_engine(1)
+    # Full-shape warm rounds: every compile (plain window, gram window
+    # variant, device-table build, K=1 sampler) lands before timing.
+    run_round(eng_win, "warm-u", constrained=False)
+    run_round(eng_win, "warm-c", constrained=True)
+    run_round(eng_sync, "warm-s", constrained=True, overlap=False)
+
+    uncon = run_round(eng_win, "uncon", constrained=False)
+    con = run_round(eng_win, "con", constrained=True)
+    oracle = run_round(eng_sync, "sync", constrained=True, overlap=False)
+
+    def _valid(out):
+        try:
+            body = bytes(t for t in out if t < n_bytes)
+            return _json.loads(body)["v"] in ("x", "y")
+        except (ValueError, KeyError, TypeError):
+            return False
+
+    s = eng_win.constrained_summary() or {}
+    ratio = round(
+        uncon["per_token_ms"] / max(1e-9, con["per_token_ms"]), 3
+    )
+    return {
+        "k": lookahead_hi,
+        "batch": batch,
+        "gen_len": gen_len,
+        "unconstrained": {
+            k2: v for k2, v in uncon.items() if k2 != "outputs"
+        },
+        "constrained": {
+            k2: v for k2, v in con.items() if k2 != "outputs"
+        },
+        "throughput_ratio": ratio,
+        "throughput_within_80pct": ratio >= 0.8,
+        "bit_identical": con["outputs"] == oracle["outputs"],
+        "all_valid_json": all(_valid(o) for o in con["outputs"]),
+        "summary": {
+            k2: s.get(k2) for k2 in (
+                "window_rows", "mask_steps", "table_builds",
+                "table_cache_hits", "fallbacks",
+            )
+        },
+        "zero_fallbacks": s.get("fallbacks", 1) == 0,
+    }
+
+
 def _kernel_probe(page_size: int) -> dict:
     """Decode-kernel microbench (detail.kernel): per-token device ms and
     tokens/s/chip for the three decode attention implementations on ONE
@@ -2860,6 +3009,16 @@ def _bench():
     if not on_tpu or os.environ.get("BENCH_SPEC"):
         spec_probe = _spec_probe(model, params, kv_dtype)
 
+    # Constrained-decoding probe: JSON-schema-constrained vs
+    # unconstrained decode on one K=8 engine — grammar masking inside
+    # the fused window must hold >=80% of unconstrained tokens/s with
+    # streams bit-identical to the K=1 host-sync sampler and zero
+    # fallbacks. Cheap on CPU (part of the smoke contract); opt-in on
+    # TPU (BENCH_CONSTRAINED).
+    constrained_probe = None
+    if not on_tpu or os.environ.get("BENCH_CONSTRAINED"):
+        constrained_probe = _constrained_probe(model, params, kv_dtype)
+
     # Decode-kernel microbench: fused vs split vs XLA attention(+append
     # +sampling) chains on one identical ragged batch — per-token device
     # ms and tokens/s/chip per impl, plus the fused-below-split and
@@ -3107,6 +3266,13 @@ def _bench():
             **(
                 {"spec": spec_probe}
                 if spec_probe is not None else {}
+            ),
+            # Constrained-decoding probe (schema-constrained vs
+            # unconstrained tokens/s ratio, K=1 bit-identity, schema
+            # validity, zero-fallback verdict — docs/decode_loop.md).
+            **(
+                {"constrained": constrained_probe}
+                if constrained_probe is not None else {}
             ),
             # Decode-kernel microbench (fused vs split vs XLA per-token
             # device ms + bit-identity verdicts on one ragged batch).

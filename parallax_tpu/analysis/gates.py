@@ -103,10 +103,11 @@ GATE_TABLE: tuple[Gate, ...] = (
         feature="decode_fused",
         marker="decode-fused sampling disabled",
         doc="docs/kernels.md",
-        reason="top-p/min-p, top_k beyond FUSED_SAMPLE_TOPK_MAX, and "
-               "host-side logits features (penalties, logprobs, grammar, "
-               "logit_bias) need the sort-based / host-synchronous "
-               "sampler; fused attention stays active",
+        reason="top-p/min-p and top_k beyond FUSED_SAMPLE_TOPK_MAX need "
+               "the sort-based sampler; logits features (penalties, "
+               "logprobs, grammar, logit_bias) now run in-window as "
+               "scan-carry state and no longer downshift; fused "
+               "attention stays active",
     ),
     Gate(
         feature="decode_fused",
@@ -132,13 +133,14 @@ GATE_TABLE: tuple[Gate, ...] = (
                "last-stage verify forces a synchronous resolve",
     ),
     Gate(
-        feature="speculative_tokens",
-        marker="speculative decoding disabled: penalties/logprobs",
+        feature="constrained_window",
+        marker="constrained decode windows disabled",
         doc="docs/decode_loop.md",
-        reason="per-step host state (penalties, logprobs, grammar "
-               "masks, logit_bias, teacher-forced replay) cannot be "
-               "advanced inside a multi-token verify; those batches "
-               "decode one token per step",
+        reason="grammar masking inside the fused K-step window needs a "
+               "dense device transition table; when the knob is off or "
+               "the grammar's state-x-vocab product exceeds "
+               "DEVICE_TABLE_MAX_CELLS, grammar batches decode on the "
+               "host-synchronous sampler",
     ),
     Gate(
         feature="decode_fused",

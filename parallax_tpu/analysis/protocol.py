@@ -394,6 +394,7 @@ FRAME_SCHEMAS: tuple[FrameSchema, ...] = (
             _f("cache_stats", None, required=False),
             _f("kernel", None, required=False),
             _f("spec", None, required=False),
+            _f("constrained", None, required=False),
             _f("transport", None, required=False),
             _f("metrics", None, required=False),
             _f("refit_version", 0, required=False),
@@ -601,7 +602,7 @@ CKPT_FIELDS: tuple[str, ...] = (
     "v", "rid", "prompt_ids", "output_ids", "output_logprobs",
     "sampling_params", "eos_token_ids", "lora_id", "routing_table",
     "age_s", "parked_wall", "traced", "handoff", "trace_spans", "kv",
-    "prefill_computed_tokens",
+    "prefill_computed_tokens", "dfa_state", "grammar_hash",
 )
 
 

@@ -205,6 +205,14 @@ class SchedulerService:
                 if isinstance(payload.get("spec"), dict)
                 else None
             ),
+            # Constrained-decoding ledger (in-window grammar rows, mask
+            # steps, table builds/cache hits, host-sync fallbacks) —
+            # surfaced per node in /cluster/status.
+            constrained=(
+                payload["constrained"]
+                if isinstance(payload.get("constrained"), dict)
+                else None
+            ),
             # Per-link activation-transport telemetry (bytes each way,
             # serialize/send ms, queue depth, compression ratio) —
             # surfaced per node in /cluster/status.

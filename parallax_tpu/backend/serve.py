@@ -177,6 +177,12 @@ def build_local_frontend(
                     # signal, docs/decode_loop.md) and accepted tokens
                     # per chip-second. None while speculation is off.
                     "spec": e.spec_summary(),
+                    # Constrained-decoding ledger: in-window feature
+                    # rows, device mask steps, grammar-table builds vs
+                    # cache hits, spec mask rejections and host-sync
+                    # fallbacks (docs/decode_loop.md). None until a
+                    # feature batch runs.
+                    "constrained": e.constrained_summary(),
                 }
                 for e in engines
             ],

@@ -92,6 +92,19 @@ SPEC_REJECTED_TOTAL = "parallax_spec_rejected_total"
 SPEC_ACCEPTANCE_RATE = "parallax_spec_acceptance_rate"
 SPEC_PROPOSE_MS = "parallax_spec_propose_ms"
 
+# -- constrained decoding in the fused window (runtime/engine.py) ------------
+CONSTRAINED_ACTIVE_ROWS = "parallax_constrained_active_rows"
+CONSTRAINED_WINDOW_ROWS_TOTAL = "parallax_constrained_window_rows_total"
+CONSTRAINED_MASK_STEPS_TOTAL = "parallax_constrained_mask_steps_total"
+CONSTRAINED_TABLE_BUILDS_TOTAL = "parallax_constrained_table_builds_total"
+CONSTRAINED_TABLE_CACHE_HITS_TOTAL = (
+    "parallax_constrained_table_cache_hits_total"
+)
+CONSTRAINED_SPEC_MASK_REJECTIONS_TOTAL = (
+    "parallax_constrained_spec_mask_rejections_total"
+)
+CONSTRAINED_FALLBACKS_TOTAL = "parallax_constrained_fallbacks_total"
+
 # -- goodput ledger / SLO / health plane (obs/) ------------------------------
 GOODPUT_TOKENS_TOTAL = "parallax_goodput_tokens_total"
 GOODPUT_TIME_SECONDS_TOTAL = "parallax_goodput_time_seconds_total"
@@ -253,6 +266,32 @@ HELP: dict[str, str] = {
     SPEC_PROPOSE_MS: (
         "Host milliseconds spent staging one round of speculative "
         "proposals, by source"
+    ),
+    CONSTRAINED_ACTIVE_ROWS: (
+        "Running requests with live grammar-DFA state on this stage"
+    ),
+    CONSTRAINED_WINDOW_ROWS_TOTAL: (
+        "Feature rows (grammar / penalties / logprobs / logit_bias) "
+        "dispatched into fused K-step decode windows"
+    ),
+    CONSTRAINED_MASK_STEPS_TOTAL: (
+        "Grammar mask applications executed inside jitted decode "
+        "windows (rows x scan steps)"
+    ),
+    CONSTRAINED_TABLE_BUILDS_TOTAL: (
+        "Dense device grammar tables compiled (one all-states sweep "
+        "per distinct schema)"
+    ),
+    CONSTRAINED_TABLE_CACHE_HITS_TOTAL: (
+        "Grammar device-table lookups served from the compiler cache"
+    ),
+    CONSTRAINED_SPEC_MASK_REJECTIONS_TOTAL: (
+        "Speculative proposal tokens rejected because the grammar mask "
+        "excluded them at their position"
+    ),
+    CONSTRAINED_FALLBACKS_TOTAL: (
+        "Feature batches that fell back to the host-sync sampler "
+        "(constrained_window off, or an oversized grammar)"
     ),
     GOODPUT_TOKENS_TOTAL: (
         "Device-step tokens classified by usefulness (committed / "

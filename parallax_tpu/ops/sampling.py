@@ -181,6 +181,23 @@ def speculative_accept(
     return c, froze
 
 
+def output_token_counts(out_ids: jax.Array, v: int) -> jax.Array:
+    """Scatter padded per-row generated-id lists (i32[B, L], -1 padded)
+    into a dense i32[B, V] count matrix on device. The host passes the
+    (small) id lists; the fused decode window also calls this once at
+    dispatch to seed the scan-carried count table the in-window penalty
+    updates advance."""
+    b = out_ids.shape[0]
+    valid = out_ids >= 0
+    ids = jnp.where(valid, out_ids, 0)
+    rows = jnp.broadcast_to(
+        jnp.arange(b, dtype=jnp.int32)[:, None], out_ids.shape
+    )
+    return jnp.zeros((b, v), jnp.int32).at[rows, ids].add(
+        valid.astype(jnp.int32)
+    )
+
+
 @jax.jit
 def penalize_logits(
     logits: jax.Array,       # [B, V]
@@ -194,15 +211,7 @@ def penalize_logits(
     The host passes the (small) padded id lists instead of a dense [B, V]
     count matrix — the scatter-add happens on device.
     """
-    b, v = logits.shape
-    valid = out_ids >= 0
-    ids = jnp.where(valid, out_ids, 0)
-    rows = jnp.broadcast_to(
-        jnp.arange(b, dtype=jnp.int32)[:, None], out_ids.shape
-    )
-    counts = jnp.zeros((b, v), jnp.int32).at[rows, ids].add(
-        valid.astype(jnp.int32)
-    )
+    counts = output_token_counts(out_ids, logits.shape[1])
     return apply_penalties(
         logits, counts, presence_penalty, frequency_penalty,
         repetition_penalty,
@@ -253,6 +262,50 @@ def apply_grammar_mask(
     full = jnp.ones(logits.shape, bool)
     full = full.at[rows].set(allowed, mode="drop")
     return jnp.where(full, logits, NEG_INF)
+
+
+def unpack_token_masks(bits: jax.Array, v: int) -> jax.Array:
+    """Packed u32[B, W] per-row token bitsets -> bool[B, v] allow masks
+    (bit ``t % 32`` of word ``t // 32`` = token ``t``; see
+    ``constrained/device_table.pack_bool_rows``). Tokens at or beyond
+    the packed width (model vocab padded past the grammar's tokenizer
+    vocab) unpack to False — exactly how the host sampler masks columns
+    past the token table."""
+    b, w = bits.shape
+    unpacked = (
+        (bits[:, :, None] >> jnp.arange(32, dtype=jnp.uint32)) & 1
+    ).astype(bool).reshape(b, w * 32)
+    if w * 32 >= v:
+        return unpacked[:, :v]
+    return jnp.concatenate(
+        [unpacked, jnp.zeros((b, v - w * 32), bool)], axis=1
+    )
+
+
+def mask_logits_packed(
+    logits: jax.Array,        # [B, V]
+    bits: jax.Array,          # u32[B, W] packed allow masks
+    constrained: jax.Array,   # bool[B]; False rows pass through
+) -> jax.Array:
+    """The fused decode window's grammar mask: disallowed tokens of
+    constrained rows go to NEG_INF; unconstrained rows pass through —
+    the same where(allowed, logits, NEG_INF) the host-path
+    :func:`apply_grammar_mask` applies, so streams stay bit-identical."""
+    allowed = unpack_token_masks(bits, logits.shape[1])
+    full = jnp.where(constrained[:, None], allowed, True)
+    return jnp.where(full, logits, NEG_INF)
+
+
+def token_in_mask(bits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Per-row single-token bit test against packed masks: bool[B].
+    Used by the speculative window to count proposals rejected BY THE
+    GRAMMAR MASK (vs ordinary target disagreement). Out-of-range tokens
+    (including the -1 no-proposal sentinel) test False."""
+    b, w = bits.shape
+    tok = jnp.clip(tokens, 0, w * 32 - 1)
+    word = jnp.take_along_axis(bits, (tok // 32)[:, None], axis=1)[:, 0]
+    bit = (word >> (tok % 32).astype(jnp.uint32)) & 1
+    return bit.astype(bool) & (tokens >= 0) & (tokens < w * 32)
 
 
 @jax.jit

@@ -826,6 +826,13 @@ class WorkerNode:
                         "spec": (
                             eng.spec_summary() if eng else None
                         ),
+                        # Constrained-decoding ledger (in-window grammar
+                        # rows, mask steps, table builds/cache hits,
+                        # sync fallbacks; None until a feature batch
+                        # runs) — surfaced per node in /cluster/status.
+                        "constrained": (
+                            eng.constrained_summary() if eng else None
+                        ),
                         # Per-link activation-transport telemetry
                         # (bytes/frames each way, serialize/send ms,
                         # queue depth, compression ratio) — surfaced in
@@ -2001,11 +2008,6 @@ class WorkerNode:
             # Already leaving through the disaggregation handoff path —
             # its own ladder (re-ship / local restore) recovers it.
             return
-        if req.sampling_params.json_schema:
-            # Grammar-DFA state is not portable yet: fail fast to the
-            # client instead of resuming with an unconstrained stream.
-            req.abort(f"peer {dead_peer} unreachable")
-            return
         req.migrating = True
         self._migration_pending[rid] = dead_peer
         from parallax_tpu.obs.flight import get_flight
@@ -2244,9 +2246,17 @@ class WorkerNode:
                 and list(t.get("head_layers") or [])
                 == [image.start_layer, image.end_layer]
             )
+            grammar = None
+            eng = self.engine
+            if eng is not None and e["req"].sampling_params.json_schema:
+                # Harvest the head's grammar-DFA mirror so the target
+                # can restore the automaton position without replaying
+                # the stream (hash-validated on adoption).
+                grammar = eng.grammar_checkpoint_fields(rid)
             ckpt = checkpoint_from_request(
                 e["req"], routing_table=path,
                 kv=image if kv_ok else None,
+                grammar=grammar,
             )
             ckpt.parked_wall = e["parked_wall"]
             by_head.setdefault(path[0], []).append(
@@ -2393,12 +2403,7 @@ class WorkerNode:
                 req = eng.scheduler.running.get(rid)
                 if req is None or req.status.is_finished:
                     continue
-                if req.sampling_params.json_schema or getattr(
-                    req, "handoff_local", False
-                ):
-                    # Grammar-DFA state is not portable: decode locally
-                    # (mixed behavior) rather than hand off or abort.
-                    req.handoff_local = True  # type: ignore[attr-defined]
+                if getattr(req, "handoff_local", False):
                     continue
                 req.migrating = True
                 self._handoff_pending[rid] = now

@@ -122,6 +122,16 @@ class RequestCheckpoint:
     # replay path re-prefills from scratch, which is always correct.
     # Cross-checked against ``kv.computed_tokens`` at decode.
     prefill_computed_tokens: int = 0
+    # Grammar-DFA progress of a constrained (json_schema) request: the
+    # source head's host-mirror state plus a short hash of the schema
+    # text it was computed under. The restoring stage trusts the int
+    # only when ITS compile of the schema hashes identically (state
+    # numbering is a pure function of the schema text); otherwise it
+    # recomputes by advancing from state 0 through the recorded stream
+    # — always correct, just O(output) table lookups. None/"" for
+    # unconstrained requests and pre-dfa_state frames.
+    dfa_state: int | None = None
+    grammar_hash: str = ""
 
 
 # Span-shipping bound: a traced request's decode epochs coalesce
@@ -170,6 +180,7 @@ def checkpoint_from_request(
     req: Request,
     routing_table: list[str] | None = None,
     kv: KVImage | None = None,
+    grammar: tuple[int, str] | None = None,
 ) -> RequestCheckpoint:
     """Snapshot one head-owned request. The request may itself be a
     resumed one: folded prior outputs (``output_offset > 0``) are peeled
@@ -212,6 +223,8 @@ def checkpoint_from_request(
         prefill_computed_tokens=(
             0 if req.is_prefill_done else req.num_computed_tokens
         ),
+        dfa_state=(int(grammar[0]) if grammar is not None else None),
+        grammar_hash=(str(grammar[1]) if grammar is not None else ""),
     )
 
 
@@ -257,6 +270,17 @@ def build_resumed_request(
         req.prior_output_logprobs = lps
     req.arrival_time = time.monotonic() - max(0.0, float(ckpt.age_s))
     req.traced = bool(ckpt.traced)
+    if not replay and ckpt.dfa_state is not None and ckpt.grammar_hash:
+        # Grammar-DFA restore intent (ADOPT mode only): the adopting
+        # engine's _grammar_initial_state validates the hash against
+        # its own compile and falls back to stream recompute on
+        # mismatch. Replay mode must NOT pre-seed the state: its
+        # committed stream restarts empty and the DFA mirror advances
+        # through the teacher-forced commits, landing on exactly the
+        # checkpointed state when replay drains — seeding it would
+        # double-count every replayed token.
+        req.grammar_dfa_state = int(ckpt.dfa_state)
+        req.grammar_hash = str(ckpt.grammar_hash)
     return req
 
 
@@ -282,6 +306,9 @@ def checkpoint_to_wire(ckpt: RequestCheckpoint) -> dict:
     }
     if ckpt.trace_spans:
         d["trace_spans"] = list(ckpt.trace_spans[:_MAX_TRACE_SPANS])
+    if ckpt.dfa_state is not None and ckpt.grammar_hash:
+        d["dfa_state"] = int(ckpt.dfa_state)
+        d["grammar_hash"] = str(ckpt.grammar_hash)
     if ckpt.kv is not None:
         d["kv"] = {
             "page_size": ckpt.kv.page_size,
@@ -429,6 +456,26 @@ def checkpoint_from_wire(d: dict) -> RequestCheckpoint:
             raise CheckpointError(
                 "prefill progress disagrees with the kv image"
             )
+    dfa_state = d.get("dfa_state")
+    grammar_hash = d.get("grammar_hash") or ""
+    if dfa_state is not None:
+        try:
+            dfa_state = int(dfa_state)
+        except (TypeError, ValueError) as e:
+            raise CheckpointError(f"checkpoint dfa_state malformed: {e}")
+        # -1 is the host-side dead state; huge values are corrupt
+        # frames, not automata (state counts are bounded well below the
+        # token cap by the device-table budget).
+        if not -1 <= dfa_state <= _MAX_TOKENS:
+            raise CheckpointError("checkpoint dfa_state out of range")
+        if not isinstance(grammar_hash, str) or not (
+            0 < len(grammar_hash) <= 64
+        ):
+            raise CheckpointError("checkpoint grammar_hash malformed")
+        if not SamplingParams.from_dict(sp).json_schema:
+            raise CheckpointError(
+                "checkpoint carries dfa_state without a json_schema"
+            )
     # Trace spans are observability freight: bounded and type-checked
     # but never a reason to reject the frame (TraceStore.adopt
     # sanitizes field-by-field on use).
@@ -453,4 +500,6 @@ def checkpoint_from_wire(d: dict) -> RequestCheckpoint:
         trace_spans=trace_spans,
         handoff=bool(d.get("handoff", False)),
         prefill_computed_tokens=prefill_computed,
+        dfa_state=dfa_state,
+        grammar_hash=str(grammar_hash) if dfa_state is not None else "",
     )

@@ -129,12 +129,28 @@ class EngineConfig:
     # frozen-row rollback does — so speculation composes with
     # overlapped dispatch, adaptive K, migration checkpoints and the
     # disaggregated decode pool. K = 1 (or a window the planner cannot
-    # page) falls back to the host-synchronous single-round verify;
-    # multi-stage pipelines speculate via pp-spec (sync resolve); rows
-    # needing per-step host state do not speculate at all — both
-    # registered gates (analysis/gates.py, docs/decode_loop.md).
+    # page) falls back to the host-synchronous single-round verify
+    # (which keeps feature rows on the plain sampler); multi-stage
+    # pipelines speculate via pp-spec (sync resolve) — a registered
+    # gate (analysis/gates.py, docs/decode_loop.md). Sampling features
+    # ride the spec window as scan-carry state.
     speculative_tokens: int = 0
     speculative_ngram: int = 3
+    # Device-native constrained decoding (docs/decode_loop.md "The
+    # constrained window"): grammar DFAs compile to dense device
+    # transition tables + packed per-state token masks, penalties and
+    # logit_bias vectorize as scan-carry state, and chosen-token
+    # logprobs are captured into the window's D2H buffer — so
+    # json_schema / penalty / logprob / logit_bias rows ride the fused
+    # K-step decode window (and its speculative variant) instead of
+    # forcing the host-synchronous K=1 sampler. Streams stay
+    # bit-identical to the sync path for greedy and seeded rows (the
+    # correctness gate in tests/test_constrained_window.py). False
+    # restores the downshift-to-sync behavior (A/B + debugging knob; a
+    # registered gate, analysis/gates.py). Grammars whose state×vocab
+    # product exceeds constrained/device_table.DEVICE_TABLE_MAX_CELLS
+    # fall back per-batch the same way.
+    constrained_window: bool = True
     # Overlapped decode: step() splits into dispatch() (form plan,
     # assemble inputs, ENQUEUE the jit call — returns an in-flight
     # ticket) and resolve(ticket) (block on outputs, sample/emit, advance
@@ -299,6 +315,11 @@ class StepTicket:
     # per-source proposed-token counts) for the resolve-side ledgers.
     ms_counts: list | None = None
     spec_meta: dict | None = None
+    # Per-window chosen-token logprob arrays captured inside the scan
+    # ([k, S] plain windows, [k, S, 1+spec] speculative), present only
+    # when the batch carried logprob rows; resolve() threads the values
+    # into commit_token alongside the tokens.
+    ms_lp: list | None = None
     # Host-sync speculative verify fallback (K=1 / unpaged windows):
     # (spec_plan, proposals) — the logits readback + accept loop runs
     # at resolve, the designated sync point.
@@ -774,17 +795,21 @@ class StageEngine:
         self._warned_split_sampling = False
         self._base_key = jax.random.key(self.cfg.seed)
         # Fused decode-window programs keyed by (k, sampled,
-        # fused_sample): the adaptive path and explicit overrides (bench
-        # probes mutate ``cfg.decode_lookahead`` between rounds) each
-        # get their own compile instead of silently reusing a stale-k
-        # scan, and the fused-sampler variant never aliases the
-        # sort-based one.
-        self._jit_multistep: dict[tuple[int, bool, bool], object] = {}
+        # fused_sample, feats): the adaptive path and explicit overrides
+        # (bench probes mutate ``cfg.decode_lookahead`` between rounds)
+        # each get their own compile instead of silently reusing a
+        # stale-k scan, the fused-sampler variant never aliases the
+        # sort-based one, and ``feats`` (the sorted tuple of active
+        # device-side sampling features: "pen", "bias", "gram", "lp")
+        # keeps the feature-free variant byte-for-byte the program it
+        # always was — a batch with no host-state rows compiles and runs
+        # exactly the pre-constrained-window scan.
+        self._jit_multistep: dict[tuple, object] = {}
         # Speculative decode-window programs, keyed by (k, sampled,
-        # spec_width, proposal_buffer_len) — the proposal buffer length
-        # rides a pow2 lattice so staging-depth jitter never storms the
-        # compile cache.
-        self._jit_spec_multistep: dict[tuple[int, bool, int, int], object] = {}
+        # spec_width, proposal_buffer_len, feats) — the proposal buffer
+        # length rides a pow2 lattice so staging-depth jitter never
+        # storms the compile cache.
+        self._jit_spec_multistep: dict[tuple, object] = {}
         # Speculation telemetry: proposed/accepted/rejected token counts
         # by proposal source ({ngram, draft}), bumped on the resolve
         # thread and summarized from heartbeat / /status threads.
@@ -794,11 +819,24 @@ class StageEngine:
         with self._spec_lock:
             self._spec_stats: dict[str, dict[str, int]] = {}
         self._spec_t0 = time.monotonic()
+        # Constrained-window telemetry (docs/decode_loop.md): rows whose
+        # grammar/penalty/logprob/bias state rode a fused window, mask
+        # applications inside scans, DFA device-table builds vs cache
+        # hits, and speculative proposals the grammar mask rejected.
+        # Bumped on dispatch/resolve threads, summarized from heartbeat
+        # and /status threads — same sharing shape as _spec_stats.
+        self._constrained_lock = _mk("engine.constrained_counts")
+        with self._constrained_lock:
+            self._constrained_stats: dict[str, int] = {}
+        # Per-batch grammar-table combinations: the concatenated device
+        # transition/mask arrays (jnp, uploaded once) for a tuple of
+        # grammar cache keys, plus each grammar's state-row offset.
+        self._gram_combo_cache: dict[tuple, tuple] = {}
+        self._warned_constrained_off = False
         from parallax_tpu.ops.kernel_select import spec_window_impl
 
         self._spec_window_impl = spec_window_impl(model.use_pallas)
         self._warned_spec_fused = False
-        self._warned_spec_host_state = False
         if self.cfg.speculative_tokens > 0 and not (
             model.is_first and model.is_last
         ):
@@ -880,9 +918,306 @@ class StageEngine:
             except ValueError as e:
                 req.abort(f"json_schema rejected: {e}")
                 return None
-            ent = (table, 0)
+            ent = (table, self._grammar_initial_state(req, table))
             self._grammar_states[req.request_id] = ent
         return ent
+
+    def _grammar_initial_state(self, req, table) -> int:
+        """First-sight DFA state for a constrained request. Fresh
+        requests start at 0. A migrated-in request restores the
+        checkpointed ``dfa_state`` when its grammar hash matches the
+        schema this stage compiled (state numbering is schema-derived,
+        so a match makes the int portable); otherwise — stale hash,
+        out-of-range state, or a pre-dfa_state checkpoint — the state is
+        recomputed by advancing from 0 through the tokens already in
+        the stream (adopt mode folds prior outputs into
+        ``full_output_ids``; replay mode starts empty and advances
+        per-commit like any live request). Recompute is the safe path:
+        the DFA state is a pure function of (schema, committed stream)."""
+        from parallax_tpu.constrained import grammar_state_hash
+
+        ckpt_state = getattr(req, "grammar_dfa_state", None)
+        if ckpt_state is not None:
+            sp = req.sampling_params
+            if (
+                getattr(req, "grammar_hash", "")
+                == grammar_state_hash(sp.json_schema)
+                and -1 <= int(ckpt_state) < table.dfa.n_states
+            ):
+                return int(ckpt_state)
+        state = 0
+        for tok in self._generated_ids(req):
+            state = table.advance(state, int(tok))
+        return state
+
+    def grammar_checkpoint_fields(
+        self, request_id: str
+    ) -> tuple[int, str] | None:
+        """(dfa_state, grammar_hash) for a live constrained request, or
+        None when this stage holds no grammar state for it (not
+        constrained, or a multi-stage head whose grammar lives on the
+        last stage — the restoring side then recomputes from the token
+        stream). Consumed by the migration/handoff checkpoint harvest
+        (p2p/node.py)."""
+        ent = self._grammar_states.get(request_id)
+        if ent is None:
+            return None
+        from parallax_tpu.constrained import grammar_state_hash
+
+        table, state = ent
+        req = self.scheduler.running.get(request_id)
+        schema = (
+            req.sampling_params.json_schema if req is not None else None
+        )
+        if not schema:
+            return None
+        return int(state), grammar_state_hash(schema)
+
+    def _advance_grammar(self, req, token: int) -> None:
+        """Advance a request's host-mirror DFA state by one committed
+        token (no-op for unconstrained requests). The mirror is what
+        checkpoints harvest and what the sync sampler reads if the
+        request ever drops off the window path — it must track the
+        COMMITTED stream exactly."""
+        ent = self._grammar_states.get(req.request_id)
+        if ent is not None:
+            table, state = ent
+            self._grammar_states[req.request_id] = (
+                table, table.advance(state, int(token))
+            )
+
+    def _warn_constrained_off(self, reason: str) -> None:
+        """Warn-once gate site (analysis/gates.py): a grammar batch
+        cannot ride the fused decode window and decodes on the
+        host-synchronous path instead."""
+        if self._warned_constrained_off:
+            return
+        self._warned_constrained_off = True
+        logger.warning(
+            "constrained decode windows disabled: %s — grammar batches "
+            "decode on the host-synchronous path "
+            "(config: constrained_window / "
+            "constrained.DEVICE_TABLE_MAX_CELLS)", reason,
+        )
+
+    @staticmethod
+    def _row_has_features(req) -> bool:
+        """Does this request sample with any host-state feature
+        (penalties / logprobs / grammar / logit_bias)? Telemetry's
+        definition of a 'feature row'."""
+        sp = req.sampling_params
+        return bool(
+            sp.presence_penalty or sp.frequency_penalty
+            or sp.repetition_penalty != 1.0 or sp.logprobs
+            or sp.json_schema or sp.logit_bias
+        )
+
+    def _window_feature_flags(self, plan: BatchPlan) -> tuple | None:
+        """The batch's sampling-feature set as a sorted name tuple —
+        the static component of the window jit key (one compiled
+        program per feature combination; a feature-free batch compiles
+        exactly the pre-feature program). ``()`` = no features. None =
+        this batch cannot ride the window (constrained decoding off, or
+        a grammar too large for a dense device table) and must fall
+        back to the host-sync sampler."""
+        feats = set()
+        for seg in plan.seqs:
+            sp = seg.request.sampling_params
+            if (
+                sp.presence_penalty or sp.frequency_penalty
+                or sp.repetition_penalty != 1.0
+            ):
+                feats.add("pen")
+            if sp.logit_bias:
+                feats.add("bias")
+            if sp.logprobs:
+                feats.add("lp")
+            if sp.json_schema:
+                feats.add("gram")
+        if "gram" in feats:
+            if not self.cfg.constrained_window or self.grammar is None:
+                self._warn_constrained_off(
+                    "constrained_window is off"
+                    if self.grammar is not None
+                    else "no grammar vocabulary wired"
+                )
+                self._count_constrained(fallbacks=1)
+                return None
+            for seg in plan.seqs:
+                sp = seg.request.sampling_params
+                if not sp.json_schema:
+                    continue
+                # Ensure the host entry exists (aborts on a bad schema
+                # — the normal path then owns the finish) and the dense
+                # device table compiles within budget.
+                if self._grammar_entry(seg.request) is None:
+                    return None
+                try:
+                    dev, built = self.grammar.device_table(sp.json_schema)
+                except ValueError:
+                    return None     # host entry compiled; schema cached
+                self._count_constrained(
+                    builds=int(built), cache_hits=int(not built)
+                )
+                if dev is None:
+                    self._warn_constrained_off(
+                        "grammar state x vocab exceeds the device-table "
+                        "budget"
+                    )
+                    self._count_constrained(fallbacks=1)
+                    return None
+        return tuple(sorted(feats))
+
+    def _grammar_combined_tables(self, plan: BatchPlan):
+        """Batch-combined dense grammar tables + per-row state vectors
+        for a constrained window. Distinct grammars concatenate along
+        the state axis (per-grammar row offsets baked into both the
+        row placement AND the transition values), so ONE [R, Vg] gather
+        serves every row regardless of which schema it decodes. The
+        jnp uploads are cached per grammar combination
+        (``_gram_combo_cache``) — one H2D per new combination, not per
+        window."""
+        rows_of: dict[str, tuple] = {}      # schema key -> (dev, offset)
+        keys: list[str] = []
+        from parallax_tpu.constrained import grammar_cache_key
+
+        for seg in plan.seqs:
+            schema = seg.request.sampling_params.json_schema
+            if not schema:
+                continue
+            key = grammar_cache_key(schema)
+            if key not in rows_of:
+                rows_of[key] = (self.grammar.device_table(schema)[0], 0)
+                keys.append(key)
+        combo_key = tuple(sorted(keys))
+        cached = self._gram_combo_cache.get(combo_key)
+        if cached is None:
+            trans_parts, allowed_parts, offsets = [], [], {}
+            off = 0
+            for key in combo_key:
+                dev = rows_of[key][0]
+                offsets[key] = off
+                trans_parts.append(dev.trans + np.int32(off))
+                allowed_parts.append(dev.allowed)
+                off += dev.trans.shape[0]
+            cached = (
+                jnp.asarray(np.concatenate(trans_parts, axis=0)),
+                jnp.asarray(np.concatenate(allowed_parts, axis=0)),
+                offsets,
+            )
+            if len(self._gram_combo_cache) >= 16:
+                self._gram_combo_cache.pop(
+                    next(iter(self._gram_combo_cache))
+                )
+            self._gram_combo_cache[combo_key] = cached
+        return rows_of, cached
+
+    def _pack_window_features(self, plan: BatchPlan, s: int,
+                              feats: tuple):
+        """Device-side state for a feature window: the ms-dict arrays
+        the compiled scan reads (penalty strengths, bias vectors,
+        combined grammar tables, per-row constrained flags) plus the
+        INITIAL scan-carry feature state (per-row output-token counts
+        seeded from the committed stream; per-row DFA rows). Every
+        array replicates the host sampler's packing exactly — neutral
+        rows carry neutral params (0/0/1.0 penalties, bias row -1,
+        constrained False), which the feature math leaves bit-identical
+        untouched, so one compiled program serves mixed batches."""
+        from parallax_tpu.constrained import grammar_cache_key
+
+        v = int(self.model.config.vocab_size)
+        ms_extra: dict = {}
+        fcarry: dict = {}
+        if "pen" in feats:
+            from parallax_tpu.ops.sampling import output_token_counts
+
+            pres = np.zeros((s,), np.float32)
+            freq = np.zeros((s,), np.float32)
+            rep = np.ones((s,), np.float32)
+            gen_lists: dict[int, list[int]] = {}
+            for i, seg in enumerate(plan.seqs):
+                sp = seg.request.sampling_params
+                if sp.presence_penalty or sp.frequency_penalty or (
+                    sp.repetition_penalty != 1.0
+                ):
+                    pres[i] = sp.presence_penalty
+                    freq[i] = sp.frequency_penalty
+                    rep[i] = sp.repetition_penalty
+                    gen_lists[i] = self._generated_ids(seg.request)
+            max_len = max(
+                (len(g) for g in gen_lists.values()), default=0
+            )
+            bucket = 8
+            while bucket < max_len:
+                bucket *= 2
+            out_ids = np.full((s, bucket), -1, np.int32)
+            for i, gen in gen_lists.items():
+                if gen:
+                    out_ids[i, : len(gen)] = gen
+            ms_extra.update(
+                pen_pres=jnp.asarray(pres), pen_freq=jnp.asarray(freq),
+                pen_rep=jnp.asarray(rep),
+            )
+            fcarry["pen_counts"] = output_token_counts(
+                jnp.asarray(out_ids), v
+            )
+        if "bias" in feats:
+            b_rows, b_vecs = [], []
+            for i, seg in enumerate(plan.seqs):
+                lb = seg.request.sampling_params.logit_bias
+                if not lb:
+                    continue
+                rid = seg.request.request_id
+                vec = self._bias_cache.get(rid)
+                if vec is None or vec.shape[0] != v:
+                    vec = np.zeros((v,), np.float32)
+                    for tid, bias in lb.items():
+                        tid = int(tid)
+                        if 0 <= tid < v:
+                            vec[tid] = float(bias)
+                    self._bias_cache[rid] = vec
+                b_rows.append(i)
+                b_vecs.append(vec)
+            bucket = 1
+            while bucket < len(b_rows):
+                bucket *= 2
+            rows = np.full((bucket,), -1, np.int32)
+            rows[: len(b_rows)] = b_rows
+            vecs = np.zeros((bucket, v), np.float32)
+            for j, vec in enumerate(b_vecs):
+                vecs[j] = vec
+            ms_extra.update(
+                bias_rows=jnp.asarray(rows), bias_vecs=jnp.asarray(vecs),
+            )
+        if "gram" in feats:
+            rows_of, (g_trans, g_allowed, offsets) = (
+                self._grammar_combined_tables(plan)
+            )
+            dfa0 = np.zeros((s,), np.int32)
+            dead = np.zeros((s,), np.int32)
+            constrained = np.zeros((s,), bool)
+            n_con = 0
+            for i, seg in enumerate(plan.seqs):
+                req = seg.request
+                schema = req.sampling_params.json_schema
+                if not schema:
+                    continue
+                ent = self._grammar_states.get(req.request_id)
+                if ent is None:
+                    continue
+                dev = rows_of[grammar_cache_key(schema)][0]
+                off = offsets[grammar_cache_key(schema)]
+                dfa0[i] = off + dev.device_state(int(ent[1]))
+                dead[i] = off + dev.dead_state
+                constrained[i] = True
+                n_con += 1
+            ms_extra.update(
+                g_trans=g_trans, g_allowed=g_allowed,
+                g_constrained=jnp.asarray(constrained),
+                g_dead=jnp.asarray(dead),
+            )
+            fcarry["dfa"] = jnp.asarray(dfa0)
+        return ms_extra, fcarry
 
     def _stage_fn(self, params, kv, inputs: BatchInputs):
         return self.model(params, kv, inputs)
@@ -1450,6 +1785,50 @@ class StageEngine:
             mnames.help_text(mnames.SPEC_ACCEPTANCE_RATE),
             labelnames=st,
         ).labels(**lbl)
+        # Constrained-window observability (docs/decode_loop.md "The
+        # constrained window"): the operator's view of structured-output
+        # traffic on the fast path — rows riding windows with device-side
+        # grammar/penalty/logprob/bias state, per-step mask applications,
+        # DFA device-table builds vs cache reuse, speculative proposals
+        # the grammar mask rejected, and batches that fell back to the
+        # host-sync sampler (flag off, oversized grammar).
+        self._c_con_rows = reg.counter(
+            mnames.CONSTRAINED_WINDOW_ROWS_TOTAL,
+            mnames.help_text(mnames.CONSTRAINED_WINDOW_ROWS_TOTAL),
+            labelnames=st,
+        ).labels(**lbl)
+        self._c_con_masks = reg.counter(
+            mnames.CONSTRAINED_MASK_STEPS_TOTAL,
+            mnames.help_text(mnames.CONSTRAINED_MASK_STEPS_TOTAL),
+            labelnames=st,
+        ).labels(**lbl)
+        self._c_con_builds = reg.counter(
+            mnames.CONSTRAINED_TABLE_BUILDS_TOTAL,
+            mnames.help_text(mnames.CONSTRAINED_TABLE_BUILDS_TOTAL),
+            labelnames=st,
+        ).labels(**lbl)
+        self._c_con_hits = reg.counter(
+            mnames.CONSTRAINED_TABLE_CACHE_HITS_TOTAL,
+            mnames.help_text(mnames.CONSTRAINED_TABLE_CACHE_HITS_TOTAL),
+            labelnames=st,
+        ).labels(**lbl)
+        self._c_con_spec_rej = reg.counter(
+            mnames.CONSTRAINED_SPEC_MASK_REJECTIONS_TOTAL,
+            mnames.help_text(
+                mnames.CONSTRAINED_SPEC_MASK_REJECTIONS_TOTAL
+            ),
+            labelnames=st,
+        ).labels(**lbl)
+        self._c_con_fallbacks = reg.counter(
+            mnames.CONSTRAINED_FALLBACKS_TOTAL,
+            mnames.help_text(mnames.CONSTRAINED_FALLBACKS_TOTAL),
+            labelnames=st,
+        ).labels(**lbl)
+        self._g_con_active = reg.gauge(
+            mnames.CONSTRAINED_ACTIVE_ROWS,
+            mnames.help_text(mnames.CONSTRAINED_ACTIVE_ROWS),
+            labelnames=st,
+        ).labels(**lbl)
         if model.is_first:
             self._h_ttft = reg.histogram(
                 mnames.TTFT_MS,
@@ -1501,6 +1880,10 @@ class StageEngine:
                       for s in self._spec_stats.values())
         if acc + rej:
             self._g_spec_accept.set(round(acc / (acc + rej), 6))
+        self._g_con_active.set(sum(
+            1 for rid in list(self._grammar_states)
+            if rid in self.scheduler.running
+        ))
 
     def _count_kernel_dispatch(
         self, path: str, impl: str | None = None
@@ -1598,6 +1981,67 @@ class StageEngine:
             ),
             "accepted_tokens_per_chip_second": round(acc / elapsed, 3),
             "by_source": by_source,
+        }
+
+    def _count_constrained(
+        self, *, rows: int = 0, mask_steps: int = 0, builds: int = 0,
+        cache_hits: int = 0, spec_mask_rejections: int = 0,
+        fallbacks: int = 0,
+    ) -> None:
+        """Bump the constrained-decoding ledger (registry counters + the
+        summary dict). ``rows``/``mask_steps`` count at dispatch (rows
+        with device-side feature state entering a window; grammar-mask
+        applications the window's scan will run), table builds/hits when
+        a grammar's device table is resolved, ``spec_mask_rejections``
+        at the speculative resolve, ``fallbacks`` when a feature batch
+        dropped to the host-sync sampler."""
+        if rows:
+            self._c_con_rows.inc(rows)
+        if mask_steps:
+            self._c_con_masks.inc(mask_steps)
+        if builds:
+            self._c_con_builds.inc(builds)
+        if cache_hits:
+            self._c_con_hits.inc(cache_hits)
+        if spec_mask_rejections:
+            self._c_con_spec_rej.inc(spec_mask_rejections)
+        if fallbacks:
+            self._c_con_fallbacks.inc(fallbacks)
+        with self._constrained_lock:
+            st = self._constrained_stats
+            for key, n in (
+                ("window_rows", rows), ("mask_steps", mask_steps),
+                ("table_builds", builds),
+                ("table_cache_hits", cache_hits),
+                ("spec_mask_rejections", spec_mask_rejections),
+                ("fallbacks", fallbacks),
+            ):
+                if n:
+                    st[key] = st.get(key, 0) + int(n)
+
+    def constrained_summary(self) -> dict | None:
+        """The ``constrained`` payload for /status, heartbeats and
+        /cluster/status: how much structured-output / penalized /
+        logprob traffic rode the fused window, grammar device-table
+        cache behavior, and mask-driven speculative rejections. None
+        until the stage has seen a constrained/feature row (no payload
+        bytes on the wire for plain traffic)."""
+        with self._constrained_lock:
+            if not self._constrained_stats:
+                return None
+            stats = dict(self._constrained_stats)
+        return {
+            "enabled": bool(self.cfg.constrained_window),
+            "active_rows": sum(
+                1 for rid in list(self._grammar_states)
+                if rid in self.scheduler.running
+            ),
+            "window_rows": stats.get("window_rows", 0),
+            "mask_steps": stats.get("mask_steps", 0),
+            "table_builds": stats.get("table_builds", 0),
+            "table_cache_hits": stats.get("table_cache_hits", 0),
+            "spec_mask_rejections": stats.get("spec_mask_rejections", 0),
+            "fallbacks": stats.get("fallbacks", 0),
         }
 
     def _warn_split_sampling(self, reason: str) -> None:
@@ -1737,12 +2181,27 @@ class StageEngine:
         return max(1, int(k))
 
     def _build_multistep(self, k: int, sampled: bool,
-                         fused_sample: bool = False):
+                         fused_sample: bool = False,
+                         feats: tuple = ()):
         """Jit a k-step decode loop: forward -> sample -> feed back,
         entirely on device, with a per-row stop mask in the scan carry.
         The page table is fixed across the window (the scheduler
         pre-allocated capacity), so each step only advances positions,
         slot mapping and kv_lens.
+
+        ``feats`` (static, part of the jit key) names the sampling
+        features compiled INTO the scan body, replicating the host
+        sampler's exact transform order (``_sample``): penalties on the
+        raw logits (``"pen"`` — per-row output-token counts ride the
+        scan carry and advance as tokens commit), then logit_bias
+        (``"bias"``), then the packed grammar mask (``"gram"`` — per-row
+        DFA state is an int32 in the carry, advanced through the dense
+        device transition table after each sample), then the sampler,
+        then chosen-token logprobs off the FINAL logits (``"lp"``,
+        captured per position into the window's D2H buffer). Neutral
+        rows carry neutral parameters the math leaves bit-identical, so
+        a mixed batch shares one program. ``()`` compiles exactly the
+        feature-free program.
 
         The stop mask freezes a row the step after it samples an
         EOS/stop token (gated by its min_new_tokens budget) or exhausts
@@ -1797,12 +2256,41 @@ class StageEngine:
                 slot_mapping=slots,
             )
 
+        has_pen = "pen" in feats
+        has_bias = "bias" in feats
+        has_gram = "gram" in feats
+        has_lp = "lp" in feats
+
         def fn(params, kv, inputs: BatchInputs, ms: dict):
             def body(carry, step_i):
-                kv, feed, ctx, stopped, produced = carry
+                kv, feed, ctx, stopped, produced, fstate = carry
                 logits, kv = model(
                     params, kv, step_inputs_at(inputs, feed, ctx, stopped)
                 )
+                # Feature transforms in the host sampler's exact order
+                # (_sample): penalties -> bias -> grammar mask.
+                if has_pen:
+                    from parallax_tpu.ops.sampling import apply_penalties
+
+                    logits = apply_penalties(
+                        logits, fstate["pen_counts"], ms["pen_pres"],
+                        ms["pen_freq"], ms["pen_rep"],
+                    )
+                if has_bias:
+                    from parallax_tpu.ops.sampling import bias_logits
+
+                    logits = bias_logits(
+                        logits, ms["bias_rows"], ms["bias_vecs"]
+                    )
+                if has_gram:
+                    from parallax_tpu.ops.sampling import (
+                        mask_logits_packed,
+                    )
+
+                    logits = mask_logits_packed(
+                        logits, ms["g_allowed"][fstate["dfa"]],
+                        ms["g_constrained"],
+                    )
                 if sampled and fused_sample:
                     from parallax_tpu.ops.decode_fused_pallas import (
                         fused_sample_topk_pallas,
@@ -1833,6 +2321,34 @@ class StageEngine:
                     nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 live = ~stopped
                 nxt = jnp.where(live, nxt, feed)
+                ys = {"toks": nxt}
+                if has_lp:
+                    from parallax_tpu.ops.sampling import token_logprobs
+
+                    # Chosen-token logprob off the FINAL (penalized,
+                    # biased, masked) logits — the host sampler's
+                    # _logprobs_for contract, captured per position.
+                    ys["lp"] = token_logprobs(logits, nxt)
+                if has_pen or has_gram:
+                    fstate = dict(fstate)
+                if has_pen:
+                    s_rows = jnp.arange(nxt.shape[0], dtype=jnp.int32)
+                    fstate["pen_counts"] = fstate["pen_counts"].at[
+                        s_rows, nxt
+                    ].add(live.astype(jnp.int32))
+                if has_gram:
+                    vg = ms["g_trans"].shape[1]
+                    adv = ms["g_trans"][
+                        fstate["dfa"], jnp.clip(nxt, 0, vg - 1)
+                    ]
+                    # Tokens past the grammar vocab kill the automaton
+                    # (TokenTable.advance) — unreachable for live
+                    # constrained rows (the mask zeroed those columns)
+                    # but kept exact anyway.
+                    adv = jnp.where(nxt < vg, adv, ms["g_dead"])
+                    fstate["dfa"] = jnp.where(
+                        ms["g_constrained"] & live, adv, fstate["dfa"]
+                    )
                 produced = produced + live.astype(jnp.int32)
                 # Same predicate commit_token applies on the host: a
                 # stop/EOS token only finishes a row once min_new_tokens
@@ -1845,34 +2361,41 @@ class StageEngine:
                     live & (hit_stop | (produced >= ms["limit"]))
                 )
                 ctx = ctx + live.astype(jnp.int32)
-                return (kv, nxt, ctx, stopped, produced), nxt
+                return (kv, nxt, ctx, stopped, produced, fstate), ys
 
-            (kv, feed, ctx, stopped, produced), tokens = jax.lax.scan(
+            fstate0 = {}
+            if has_pen:
+                fstate0["pen_counts"] = ms["pen_counts"]
+            if has_gram:
+                fstate0["dfa"] = ms["dfa"]
+            (kv, feed, ctx, stopped, produced, fstate), ys = jax.lax.scan(
                 body,
                 (kv, inputs.token_ids, inputs.kv_lens,
-                 ms["stopped"], ms["produced"]),
+                 ms["stopped"], ms["produced"], fstate0),
                 jnp.arange(k, dtype=jnp.int32),
             )
-            # tokens: [k, S]; (feed, ctx, stopped, produced) is the
-            # device-resident carry the NEXT window starts from —
-            # returning it lets the host chain windows without reading
-            # tokens back in between.
-            return tokens, kv, feed, ctx, stopped, produced
+            # ys["toks"]: [k, S] (+ optional "lp" [k, S]); the carry
+            # dict is the device-resident state the NEXT window starts
+            # from — returning it lets the host chain windows without
+            # reading tokens back in between.
+            carry = dict(feed=feed, ctx=ctx, stopped=stopped,
+                         produced=produced, **fstate)
+            return ys, kv, carry
 
         return jax.jit(self._tp_wrap_multistep(fn),
                        donate_argnums=self._donate_kv)
 
-    def _tp_wrap_multistep(self, fn, lead: int = 1):
+    def _tp_wrap_multistep(self, fn):
         """SPMD-wrap a multistep fn for a TP-sharded stage: the whole
         k-step scan runs inside ONE shard_map over the tp axis (params and
         KV pages stay in their shard layout; the per-layer psums and the
         vocab-sharded lm_head all_gather happen inside the body exactly as
         in the per-step TP path), and the sampled tokens — identical on
         every shard after the gather — come back replicated, as do the
-        stop-state carries. ``lead`` counts the replicated token outputs
-        before the KV pytree in the fn's return tuple (1 for the plain
-        window, 2 for the speculative window's tokens + commit counts).
-        No-op for unsharded engines."""
+        carry dict's stop/feature states. The window fns share one
+        return contract — ``(ys dict, kv pytree, carry dict)`` — so the
+        out_specs are a fixed pytree prefix. No-op for unsharded
+        engines."""
         if self.mesh is None or self.model.tp_size <= 1:
             return fn
         from jax.sharding import PartitionSpec as P
@@ -1889,7 +2412,7 @@ class StageEngine:
             fn,
             mesh=self.mesh,
             in_specs=(param_specs, kv_specs, P(), P()),
-            out_specs=(P(),) * lead + (kv_specs, P(), P(), P(), P()),
+            out_specs=(P(), kv_specs, P()),
             check_vma=False,
         )
 
@@ -1931,7 +2454,7 @@ class StageEngine:
         return stop_tokens, limits, min_req
 
     def _build_spec_multistep(self, k: int, sampled: bool, spec: int,
-                              prop_len: int):
+                              prop_len: int, feats: tuple = ()):
         """Jit a k-iteration SPECULATIVE decode window: the draft-verify
         loop fused into the scan.
 
@@ -1958,9 +2481,24 @@ class StageEngine:
         Frozen rows write nothing (slot -1), keep their context, and
         repeat their feed.
 
-        Returns ``(tokens [k, S, 1+spec], counts [k, S], kv, feed, ctx,
-        stopped, produced)`` — the trailing five chain the next window
-        without any host sync, exactly like the plain window.
+        Returns ``(ys, kv, carry)`` like the plain window: ``ys`` holds
+        tokens ``[k, S, 1+spec]`` and commit counts ``[k, S]`` (plus
+        per-position logprobs and mask-rejection flags under features);
+        the carry dict chains the next window without any host sync.
+
+        ``feats`` compiles the feature variant: each iteration walks the
+        ``1+spec`` fed positions SEQUENTIALLY (an unrolled inner loop —
+        position j's penalties/mask depend on the tokens committed
+        before it), advancing a provisional count/DFA state through the
+        FED tokens. That provisional walk is exact for every position
+        the acceptance rule can commit: position j commits only when
+        all earlier proposals matched their targets, i.e. when the fed
+        prefix IS the committed prefix. After ``speculative_accept``
+        picks the commit count, the carry state is recomputed from the
+        actually-committed tokens (mask-aware accept: a proposal the
+        DFA mask excludes can never equal the masked target draw, so it
+        rejects at its position and states only ever advance through
+        accepted tokens).
         """
         import dataclasses as _dc
 
@@ -1994,11 +2532,16 @@ class StageEngine:
                 slot_mapping=slots.reshape(-1),
             )
 
+        has_pen = "pen" in feats
+        has_bias = "bias" in feats
+        has_gram = "gram" in feats
+        has_lp = "lp" in feats
+
         def fn(params, kv, inputs: BatchInputs, ms: dict):
             s = inputs.kv_lens.shape[0]
 
             def body(carry, step_i):
-                kv, feed, ctx, stopped, produced = carry
+                kv, feed, ctx, stopped, produced, fstate = carry
                 js = jnp.arange(spec, dtype=jnp.int32)
                 pidx = produced[:, None] + js[None, :]
                 props = jnp.where(
@@ -2014,29 +2557,163 @@ class StageEngine:
                     params, kv, step_inputs_at(inputs, fed, ctx, stopped)
                 )
                 logits = logits[: s * w]
-                if sampled:
-                    steps = (
-                        ms["steps"][:, None] + produced[:, None]
-                        + jnp.arange(w, dtype=jnp.int32)[None, :]
-                    ).reshape(-1)
-                    g = sample_tokens(
-                        logits,
-                        jax.random.fold_in(ms["key"], step_i),
-                        jnp.repeat(ms["temp"], w),
-                        jnp.repeat(ms["top_k"], w),
-                        jnp.repeat(ms["top_p"], w),
-                        jnp.repeat(ms["min_p"], w),
-                        seeds=jnp.repeat(ms["seeds"], w),
-                        out_steps=steps,
-                    ).reshape(s, w)
+                ys = {}
+                if not feats:
+                    if sampled:
+                        steps = (
+                            ms["steps"][:, None] + produced[:, None]
+                            + jnp.arange(w, dtype=jnp.int32)[None, :]
+                        ).reshape(-1)
+                        g = sample_tokens(
+                            logits,
+                            jax.random.fold_in(ms["key"], step_i),
+                            jnp.repeat(ms["temp"], w),
+                            jnp.repeat(ms["top_k"], w),
+                            jnp.repeat(ms["top_p"], w),
+                            jnp.repeat(ms["min_p"], w),
+                            seeds=jnp.repeat(ms["seeds"], w),
+                            out_steps=steps,
+                        ).reshape(s, w)
+                    else:
+                        g = jnp.argmax(logits, axis=-1).astype(
+                            jnp.int32
+                        ).reshape(s, w)
                 else:
-                    g = jnp.argmax(logits, axis=-1).astype(
-                        jnp.int32
-                    ).reshape(s, w)
+                    # Feature variant: per-position transform + draw,
+                    # the provisional count/DFA state advanced through
+                    # the FED token ahead of each next position (exact
+                    # wherever acceptance can commit — see docstring).
+                    from parallax_tpu.ops.sampling import (
+                        apply_penalties,
+                        bias_logits,
+                        mask_logits_packed,
+                        token_in_mask,
+                        token_logprobs,
+                    )
+
+                    logits3 = logits.reshape(s, w, logits.shape[-1])
+                    counts_j = fstate.get("pen_counts")
+                    dfa_j = fstate.get("dfa")
+                    s_rows = jnp.arange(s, dtype=jnp.int32)
+                    g_cols, lp_cols, dfa_traj = [], [], []
+                    for j in range(w):
+                        lj = logits3[:, j]
+                        if has_pen:
+                            lj = apply_penalties(
+                                lj, counts_j, ms["pen_pres"],
+                                ms["pen_freq"], ms["pen_rep"],
+                            )
+                        if has_bias:
+                            lj = bias_logits(
+                                lj, ms["bias_rows"], ms["bias_vecs"]
+                            )
+                        if has_gram:
+                            dfa_traj.append(dfa_j)
+                            lj = mask_logits_packed(
+                                lj, ms["g_allowed"][dfa_j],
+                                ms["g_constrained"],
+                            )
+                        if sampled:
+                            gj = sample_tokens(
+                                lj,
+                                jax.random.fold_in(
+                                    jax.random.fold_in(ms["key"],
+                                                       step_i), j,
+                                ),
+                                ms["temp"], ms["top_k"], ms["top_p"],
+                                ms["min_p"], seeds=ms["seeds"],
+                                out_steps=ms["steps"] + produced + j,
+                            )
+                        else:
+                            gj = jnp.argmax(lj, axis=-1).astype(
+                                jnp.int32
+                            )
+                        g_cols.append(gj)
+                        if has_lp:
+                            lp_cols.append(token_logprobs(lj, gj))
+                        if j < w - 1:
+                            fed_next = fed[:, j + 1]
+                            valid = fed_next >= 0
+                            if has_pen:
+                                counts_j = counts_j.at[
+                                    s_rows, jnp.maximum(fed_next, 0)
+                                ].add(valid.astype(jnp.int32))
+                            if has_gram:
+                                vg = ms["g_trans"].shape[1]
+                                adv = ms["g_trans"][
+                                    dfa_j,
+                                    jnp.clip(fed_next, 0, vg - 1),
+                                ]
+                                adv = jnp.where(
+                                    fed_next < vg, adv, ms["g_dead"]
+                                )
+                                dfa_j = jnp.where(
+                                    ms["g_constrained"] & valid,
+                                    adv, dfa_j,
+                                )
+                    g = jnp.stack(g_cols, axis=1)
+                    if has_lp:
+                        ys["lp"] = jnp.stack(lp_cols, axis=1)
                 c, froze = speculative_accept(
                     g, props, produced, ms["stop_tokens"],
                     ms["min_req"], ms["limit"], stopped,
                 )
+                if has_pen or has_gram:
+                    # Recompute the carry state from the tokens that
+                    # ACTUALLY committed (g[:, :c]) — the provisional
+                    # fed-token walk above diverges past the correction
+                    # position.
+                    fstate = dict(fstate)
+                    s_rows = jnp.arange(s, dtype=jnp.int32)
+                    if has_pen:
+                        counts = fstate["pen_counts"]
+                        for j in range(w):
+                            commit_j = (jnp.int32(j) < c)
+                            counts = counts.at[s_rows, g[:, j]].add(
+                                commit_j.astype(jnp.int32)
+                            )
+                        fstate["pen_counts"] = counts
+                    if has_gram:
+                        dfa = fstate["dfa"]
+                        vg = ms["g_trans"].shape[1]
+                        for j in range(w):
+                            commit_j = (
+                                (jnp.int32(j) < c) & ms["g_constrained"]
+                            )
+                            adv = ms["g_trans"][
+                                dfa, jnp.clip(g[:, j], 0, vg - 1)
+                            ]
+                            adv = jnp.where(
+                                g[:, j] < vg, adv, ms["g_dead"]
+                            )
+                            dfa = jnp.where(commit_j, adv, dfa)
+                        fstate["dfa"] = dfa
+                        # Mask-rejection telemetry: the correction
+                        # position had a real proposal the grammar mask
+                        # excluded (the masked target could then never
+                        # match it).
+                        cm1 = jnp.maximum(c - 1, 0)
+                        prop_at = jnp.take_along_axis(
+                            props, jnp.minimum(cm1, spec - 1)[:, None],
+                            axis=1,
+                        )[:, 0] if spec > 0 else jnp.full(
+                            (s,), -1, jnp.int32
+                        )
+                        g_at = jnp.take_along_axis(
+                            g, cm1[:, None], axis=1
+                        )[:, 0]
+                        dfa_at = jnp.take_along_axis(
+                            jnp.stack(dfa_traj, axis=1),
+                            cm1[:, None], axis=1,
+                        )[:, 0]
+                        ys["rej"] = (
+                            ms["g_constrained"] & (c > 0)
+                            & (cm1 < spec) & (prop_at >= 0)
+                            & (prop_at != g_at)
+                            & ~token_in_mask(
+                                ms["g_allowed"][dfa_at], prop_at
+                            )
+                        ).astype(jnp.int32)
                 produced = produced + c
                 ctx = ctx + c
                 stopped = stopped | froze
@@ -2047,19 +2724,27 @@ class StageEngine:
                     )[:, 0],
                     feed,
                 )
-                return (kv, feed, ctx, stopped, produced), (g, c)
+                ys.update(toks=g, counts=c)
+                return (kv, feed, ctx, stopped, produced, fstate), ys
 
-            (kv, feed, ctx, stopped, produced), (toks, counts) = (
+            fstate0 = {}
+            if has_pen:
+                fstate0["pen_counts"] = ms["pen_counts"]
+            if has_gram:
+                fstate0["dfa"] = ms["dfa"]
+            (kv, feed, ctx, stopped, produced, fstate), ys = (
                 jax.lax.scan(
                     body,
                     (kv, ms["feed"], ms["ctx"], ms["stopped"],
-                     ms["produced"]),
+                     ms["produced"], fstate0),
                     jnp.arange(k, dtype=jnp.int32),
                 )
             )
-            return toks, counts, kv, feed, ctx, stopped, produced
+            carry = dict(feed=feed, ctx=ctx, stopped=stopped,
+                         produced=produced, **fstate)
+            return ys, kv, carry
 
-        return jax.jit(self._tp_wrap_multistep(fn, lead=2),
+        return jax.jit(self._tp_wrap_multistep(fn),
                        donate_argnums=self._donate_kv)
 
     def _spec_window_width(self, plan: BatchPlan, k: int,
@@ -2176,6 +2861,7 @@ class StageEngine:
     def _dispatch_spec_window(
         self, plan: BatchPlan, t0: float, k: int, m: int, spec: int,
         props: np.ndarray, sources: list, propose_ms: float,
+        feats: tuple = (),
     ) -> StepTicket:
         """ENQUEUE a chain of ``m`` speculative k-iteration decode
         windows (see :meth:`_build_spec_multistep`) and return the
@@ -2239,30 +2925,59 @@ class StageEngine:
             )
             window_key = jax.random.fold_in(self._base_key,
                                             self._step_count)
+        fextra = {}
+        if feats:
+            ms_extra, fextra = self._pack_window_features(plan, s, feats)
+            ms.update(ms_extra)
+            self._count_constrained(
+                rows=sum(
+                    1 for seg in plan.seqs
+                    if self._row_has_features(seg.request)
+                ),
+                mask_steps=(
+                    sum(
+                        1 for seg in plan.seqs
+                        if seg.request.sampling_params.json_schema
+                    ) * m * k * (spec + 1) if "gram" in feats else 0
+                ),
+            )
         prop_len = int(props_pad.shape[1])
-        key = (k, sampled, spec, prop_len)
+        key = (k, sampled, spec, prop_len, feats)
         fn = self._jit_spec_multistep.get(key)
         if fn is None:
             fn = self._jit_spec_multistep[key] = (
-                self._build_spec_multistep(k, sampled, spec, prop_len)
+                self._build_spec_multistep(k, sampled, spec, prop_len,
+                                           feats)
             )
         windows: list = []
         counts: list = []
+        lps: list | None = [] if "lp" in feats else None
+        rejs: list = []
         ctx = inputs0.kv_lens
         stopped = jnp.asarray(limits <= 0)
         produced = jnp.zeros((s,), jnp.int32)
         for wdx in range(m):
             ms_w = dict(ms, feed=feed, ctx=ctx, stopped=stopped,
-                        produced=produced)
+                        produced=produced, **fextra)
             if sampled:
                 ms_w["key"] = jax.random.fold_in(window_key, wdx)
-            toks, cnts, self.kv, feed, ctx, stopped, produced = fn(
+            ys, self.kv, carry = fn(
                 self.params, self.kv, inputs, ms_w
             )
-            windows.append(toks)
-            counts.append(cnts)
+            windows.append(ys["toks"])
+            counts.append(ys["counts"])
+            if lps is not None:
+                lps.append(ys["lp"])
+            if "rej" in ys:
+                rejs.append(ys["rej"])
+            feed, ctx = carry["feed"], carry["ctx"]
+            stopped, produced = carry["stopped"], carry["produced"]
+            fextra = {
+                key2: carry[key2] for key2 in ("pen_counts", "dfa")
+                if key2 in carry
+            }
         self._last_fused_steps = m * k
-        for arr in (*windows, *counts, produced):
+        for arr in (*windows, *counts, *(lps or ()), *rejs, produced):
             try:
                 arr.copy_to_host_async()
             except AttributeError:  # stubbed jit call in tests
@@ -2274,10 +2989,12 @@ class StageEngine:
             plan=plan, step_idx=step_idx, t0=t0,
             ms_windows=windows, ms_counts=counts,
             ms_state=(stopped, produced),
+            ms_lp=lps,
             spec_meta={"width": spec, "sources": sources,
                        "props": props,
                        "lengths": (props >= 0).sum(axis=1).tolist(),
-                       "propose_ms": propose_ms},
+                       "propose_ms": propose_ms,
+                       "rejs": rejs or None},
             dispatch_seq=self._dispatch_seq,
         )
         ticket.host_ms = (time.perf_counter() - t0) * 1000.0
@@ -2308,7 +3025,17 @@ class StageEngine:
         commit.
         """
         k = self._effective_lookahead()
-        if k <= 1 or not self._fused_common_ok(plan, allow_state=True):
+        if k <= 1 or not self._fused_common_ok(
+            plan, allow_state=True, allow_features=True
+        ):
+            return None
+        # Sampling features (penalties / logprobs / grammar masks /
+        # logit_bias) are first-class window citizens: the feature set
+        # becomes a static jit-key component and the per-row state rides
+        # the scan carry. None = this batch cannot (constrained decoding
+        # gated off, or an oversized grammar) and falls back host-sync.
+        feats = self._window_feature_flags(plan)
+        if feats is None:
             return None
         from parallax_tpu.runtime.batch import next_bucket
 
@@ -2346,7 +3073,8 @@ class StageEngine:
             )
             if props is not None:
                 return self._dispatch_spec_window(
-                    plan, t0, k, m, spec_w, props, sources, propose_ms
+                    plan, t0, k, m, spec_w, props, sources, propose_ms,
+                    feats,
                 )
             # No proposal hit anywhere: run the plain window on the
             # (slightly larger) reservation already held.
@@ -2426,34 +3154,60 @@ class StageEngine:
                 seeds=jnp.asarray(seeds),
             )
             window_key = jax.random.fold_in(self._base_key, self._step_count)
-        fn = self._jit_multistep.get((k, sampled, fused_sample))
+        fextra = {}
+        if feats:
+            ms_extra, fextra = self._pack_window_features(plan, s, feats)
+            ms.update(ms_extra)
+            self._count_constrained(
+                rows=sum(
+                    1 for seg in plan.seqs
+                    if self._row_has_features(seg.request)
+                ),
+                mask_steps=(
+                    sum(
+                        1 for seg in plan.seqs
+                        if seg.request.sampling_params.json_schema
+                    ) * m * k if "gram" in feats else 0
+                ),
+            )
+        fn = self._jit_multistep.get((k, sampled, fused_sample, feats))
         if fn is None:
-            fn = self._jit_multistep[(k, sampled, fused_sample)] = (
-                self._build_multistep(k, sampled, fused_sample)
+            fn = self._jit_multistep[(k, sampled, fused_sample, feats)] = (
+                self._build_multistep(k, sampled, fused_sample, feats)
             )
         # Enqueue all m windows back-to-back: window j+1 consumes window
-        # j's on-device carry (feed token, context, stop mask), so no
-        # host sync happens anywhere inside the chain — the whole thing
-        # runs behind jax async dispatch until resolve() reads it back.
+        # j's on-device carry (feed token, context, stop mask, feature
+        # state), so no host sync happens anywhere inside the chain —
+        # the whole thing runs behind jax async dispatch until resolve()
+        # reads it back.
         windows = []
+        lps = [] if "lp" in feats else None
         feed, ctx = inputs.token_ids, inputs.kv_lens
         stopped, produced = ms["stopped"], ms["produced"]
         for w in range(m):
             step_inputs = dataclasses.replace(
                 inputs, token_ids=feed, kv_lens=ctx
             )
-            ms_w = dict(ms, stopped=stopped, produced=produced)
+            ms_w = dict(ms, stopped=stopped, produced=produced, **fextra)
             if sampled:
                 ms_w.update(
                     key=jax.random.fold_in(window_key, w),
                     steps=jnp.asarray(steps0 + w * k),
                 )
-            tokens, self.kv, feed, ctx, stopped, produced = fn(
+            ys, self.kv, carry = fn(
                 self.params, self.kv, step_inputs, ms_w
             )
-            windows.append(tokens)
+            windows.append(ys["toks"])
+            if lps is not None:
+                lps.append(ys["lp"])
+            feed, ctx = carry["feed"], carry["ctx"]
+            stopped, produced = carry["stopped"], carry["produced"]
+            fextra = {
+                key: carry[key] for key in ("pen_counts", "dfa")
+                if key in carry
+            }
         self._last_fused_steps = m * k
-        for arr in (*windows, produced):
+        for arr in (*windows, *(lps or ()), produced):
             # Start the D2H copies NOW so resolve()'s readback finds the
             # bytes pre-staged instead of blocking the step thread.
             try:
@@ -2470,6 +3224,7 @@ class StageEngine:
         ticket = StepTicket(
             plan=plan, step_idx=step_idx, t0=t0,
             ms_windows=windows, ms_state=(stopped, produced),
+            ms_lp=lps,
             dispatch_seq=self._dispatch_seq,
         )
         ticket.host_ms = (time.perf_counter() - t0) * 1000.0
@@ -2495,16 +3250,30 @@ class StageEngine:
             toks = np.concatenate(
                 [np.asarray(w) for w in ticket.ms_windows], axis=0
             )                                           # [m*k, S]
+            lp = (
+                np.concatenate(
+                    [np.asarray(x) for x in ticket.ms_lp], axis=0
+                )                                       # f32[m*k, S]
+                if ticket.ms_lp else None
+            )
             produced = np.asarray(ticket.ms_state[1])   # i32[S]
             device_ms = (time.perf_counter() - tb) * 1000.0
             total = 0
             gp_committed = gp_window = 0
             for i, seg in enumerate(plan.seqs):
                 req = seg.request
+                want_lp = (
+                    lp is not None and req.sampling_params.logprobs
+                )
                 committed = 0
                 quota = int(produced[i])
                 while committed < quota and not req.status.is_finished:
-                    req.commit_token(int(toks[committed, i]))
+                    tok = int(toks[committed, i])
+                    req.commit_token(
+                        tok,
+                        float(lp[committed, i]) if want_lp else None,
+                    )
+                    self._advance_grammar(req, tok)
                     committed += 1
                 if not req.request_id.startswith("__"):
                     gp_committed += committed
@@ -2601,6 +3370,21 @@ class StageEngine:
             cnts = np.concatenate(
                 [np.asarray(x) for x in ticket.ms_counts], axis=0
             )                                           # [m*k, S]
+            lp = (
+                np.concatenate(
+                    [np.asarray(x) for x in ticket.ms_lp], axis=0
+                )                                       # f32[m*k, S, w]
+                if ticket.ms_lp else None
+            )
+            rejs = meta.get("rejs")
+            if rejs:
+                rej_total = int(
+                    sum(int(np.asarray(r).sum()) for r in rejs)
+                )
+                if rej_total:
+                    self._count_constrained(
+                        spec_mask_rejections=rej_total
+                    )
             device_ms = (time.perf_counter() - tb) * 1000.0
             w = int(toks.shape[2])
             iters = int(toks.shape[0])
@@ -2638,10 +3422,18 @@ class StageEngine:
                         ):
                             accepted += 1
                     dev_committed += c
+                    want_lp = (
+                        lp is not None and req.sampling_params.logprobs
+                    )
                     for j in range(c):
                         if req.status.is_finished:
                             break
-                        req.commit_token(int(toks[it, i, j]))
+                        tok = int(toks[it, i, j])
+                        req.commit_token(
+                            tok,
+                            float(lp[it, i, j]) if want_lp else None,
+                        )
+                        self._advance_grammar(req, tok)
                         committed += 1
                     if req.status.is_finished:
                         break
@@ -2680,10 +3472,18 @@ class StageEngine:
     # -- speculative decoding (prompt-lookup) -----------------------------
 
     def _fused_common_ok(self, plan: BatchPlan,
-                         allow_state: bool = False) -> bool:
+                         allow_state: bool = False,
+                         allow_features: bool = False) -> bool:
         """Shared disqualifier for the fused decode paths (multistep,
-        speculative): single-stage engine, decode-only rows, nothing
-        needing per-step host state (penalties/logprobs/grammar/bias).
+        speculative): single-stage engine, decode-only rows.
+
+        ``allow_features=True`` (the window path) admits rows with
+        sampling FEATURES — penalties, logprobs, grammar masks,
+        logit_bias — which the window runs as scan-carry state (see
+        ``_pack_window_features``). The host-sync speculative fallback
+        and the pipeline-spec path keep the default False: their verify
+        loops have no feature state, so those rows decode on the plain
+        synchronous single-token path.
 
         Hybrid (linear-state) models fuse fine in the MULTISTEP scan —
         per-row state slots, dense map and q_lens are constant across a
@@ -2704,15 +3504,18 @@ class StageEngine:
                 # would re-zero hybrid state at every scan step, and
                 # prefill bookkeeping differs).
                 or seg.request.status is not RequestStatus.DECODING
-                or sp.presence_penalty
-                or sp.frequency_penalty
-                or sp.repetition_penalty != 1.0
-                or sp.logprobs
-                or sp.json_schema       # grammar mask needs per-step host state
-                or sp.logit_bias        # bias applied at the sampler
                 # Replay rows commit RECORDED tokens; an on-device window
                 # would feed its own samples forward instead.
                 or seg.request.replay_ids
+            ):
+                return False
+            if not allow_features and (
+                sp.presence_penalty
+                or sp.frequency_penalty
+                or sp.repetition_penalty != 1.0
+                or sp.logprobs
+                or sp.json_schema
+                or sp.logit_bias
             ):
                 return False
         return True
@@ -2766,33 +3569,6 @@ class StageEngine:
                 return list(follow)[:k]
         return []
 
-    def _maybe_warn_spec_host_state(self, plan: BatchPlan) -> None:
-        """Warn-once gate site (analysis/gates.py): speculation is
-        configured but this decode batch's rows need per-step host
-        state, so neither the windowed nor the sync verify path may
-        run — the batch decodes one token per step."""
-        if self._warned_spec_host_state:
-            return
-        for seg in plan.seqs:
-            sp = seg.request.sampling_params
-            if (
-                seg.request.status is RequestStatus.DECODING
-                and (
-                    sp.presence_penalty or sp.frequency_penalty
-                    or sp.repetition_penalty != 1.0 or sp.logprobs
-                    or sp.json_schema or sp.logit_bias
-                    or seg.request.replay_ids
-                )
-            ):
-                self._warned_spec_host_state = True
-                logger.warning(
-                    "speculative decoding disabled: penalties/logprobs/"
-                    "grammar/logit-bias/replay rows need per-step host "
-                    "state — those batches decode on the synchronous "
-                    "single-token path",
-                )
-                return
-
     def _dispatch_speculative(self, plan: BatchPlan,
                               t0: float) -> StepTicket | None:
         """The host-sync speculative FALLBACK (K=1, or a window the
@@ -2827,7 +3603,10 @@ class StageEngine:
         if k <= 0:
             return None
         if not self._fused_common_ok(plan):
-            self._maybe_warn_spec_host_state(plan)
+            # Feature rows (penalties/logprobs/grammar/bias) no longer
+            # have a K=1 spec story — at K>1 they ride the windowed
+            # verify with feature state; here they take the plain sync
+            # single-token path.
             return None
 
         # Each row feeds >= 1 token; proposals must also fit the batch
@@ -3337,17 +4116,10 @@ class StageEngine:
                 pass
         elif self.model.is_last:
             # Host-synchronous logits processing (penalties, logprobs,
-            # grammar, logit_bias): the driver must resolve before the
-            # next dispatch so the histories these rows need are complete.
-            if (
-                self._decode_fused
-                and sp_plan is None
-                and inputs.decode_only
-                and not self._overlap_sample_ok(plan)
-            ):
-                self._warn_split_sampling(
-                    "penalties/logprobs/grammar/logit-bias"
-                )
+            # grammar, logit_bias at K=1, replay): the driver must
+            # resolve before the next dispatch so the histories these
+            # rows need are complete. At K>1 these rows ride the fused
+            # window with feature state instead of landing here.
             ticket.sync_only = True
         ticket.host_ms = (time.perf_counter() - t0) * 1000.0
         self._inflight.append(ticket)
@@ -3980,12 +4752,6 @@ class StageEngine:
                 # would clobber the abort status.
                 continue
             token = int(tokens[i])
-            ent = self._grammar_states.get(req.request_id)
-            if ent is not None:
-                table, state = ent
-                self._grammar_states[req.request_id] = (
-                    table, table.advance(state, token)
-                )
             lp = (
                 float(logprobs[i])
                 if logprobs is not None and req.sampling_params.logprobs
@@ -3993,8 +4759,20 @@ class StageEngine:
             )
             if self.model.is_first:
                 # Single-stage: commit locally, ring closed trivially.
+                # Commit FIRST, then advance the grammar with the token
+                # that actually landed in the stream — under teacher-
+                # forced replay ``commit_token`` substitutes the replay
+                # id, and advancing with the sampled token would desync
+                # the DFA from the committed text.
                 self._commit(req, token, lp)
+                if req.full_output_ids:
+                    self._advance_grammar(
+                        req, int(req.full_output_ids[-1])
+                    )
             else:
+                # Mirror stages never replay: the sampled token IS the
+                # committed token.
+                self._advance_grammar(req, token)
                 forwards.append(
                     IntermediateRequest(
                         request_id=req.request_id,

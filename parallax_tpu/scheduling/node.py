@@ -256,6 +256,11 @@ class Node:
     # chip-second); surfaced in /cluster/status. None while speculation
     # is off on the node.
     spec: dict | None = None
+    # Constrained-decoding ledger from heartbeats (in-window grammar
+    # rows, device mask steps, table builds vs cache hits, host-sync
+    # fallbacks); surfaced in /cluster/status. None until the node
+    # serves a feature batch.
+    constrained: dict | None = None
     # Per-link activation-transport telemetry from heartbeats (bytes in/
     # out, serialize/send ms, queue depth, compression ratio per peer);
     # surfaced in /cluster/status.

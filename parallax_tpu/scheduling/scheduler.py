@@ -259,12 +259,13 @@ class GlobalScheduler:
         events: dict | None = None,
         kernel: dict | None = None,
         spec: dict | None = None,
+        constrained: dict | None = None,
     ) -> None:
         self._events.put(
             ("update", node_id, layer_latency_ms, load, rtt_s, is_ready,
              refit_version, lora_adapters, step_timing, cache_stats,
              transport, metrics, cache_digests, busy, goodput, health,
-             events, kernel, spec)
+             events, kernel, spec, constrained)
         )
 
     def enqueue_peer_down(self, reporter: str, peer: str,
@@ -674,6 +675,7 @@ class GlobalScheduler:
             events = rest[6] if len(rest) > 6 else None
             kernel = rest[7] if len(rest) > 7 else None
             spec = rest[8] if len(rest) > 8 else None
+            constrained = rest[9] if len(rest) > 9 else None
             if events is not None:
                 # Merge the node's flight-event batch even for unknown
                 # nodes: a churn victim's last beats are exactly the
@@ -708,6 +710,8 @@ class GlobalScheduler:
                 node.kernel = kernel
             if spec is not None:
                 node.spec = spec
+            if constrained is not None:
+                node.constrained = constrained
             if transport is not None:
                 node.transport = transport
             if metrics is not None:
@@ -1242,6 +1246,12 @@ class GlobalScheduler:
                         # chip-second (docs/decode_loop.md). None while
                         # speculation is off on the node.
                         "spec": n.spec,
+                        # Constrained-decoding ledger from heartbeats:
+                        # in-window grammar rows, device mask steps,
+                        # table builds vs cache hits, host-sync
+                        # fallbacks (docs/decode_loop.md). None until
+                        # the node serves a feature batch.
+                        "constrained": n.constrained,
                         # Per-link activation-transport telemetry
                         # (bytes each way, serialize/send ms, queue
                         # depth, compression ratio) from heartbeats.

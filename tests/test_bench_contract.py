@@ -95,6 +95,25 @@ def test_bench_cpu_smoke_prints_one_json_line():
     # speculative_rejected bucket — the honest waste accounting.
     assert on_rep["goodput"]["speculative_rejected"] > 0, on_rep
     assert on_rep["goodput"]["committed"] > 0, on_rep
+    # Constrained-decoding probe (detail.constrained,
+    # docs/decode_loop.md): structural keys + the deterministic
+    # verdicts — schema-constrained K=8 streams bit-identical to the
+    # K=1 host-sync sampler, every output valid under the schema, and
+    # zero host-sync fallbacks (the mask ran in-window). The >=80%
+    # tokens/s ratio is asserted in the CI constrained smoke step, not
+    # here (wall-clock).
+    cp = rec["detail"]["constrained"]
+    assert cp["k"] > 1, cp
+    for side in ("unconstrained", "constrained"):
+        assert cp[side]["per_token_ms"] > 0, (side, cp)
+        assert cp[side]["decode_tokens"] > 0, (side, cp)
+    assert cp["throughput_ratio"] > 0, cp
+    assert cp["bit_identical"] is True, cp
+    assert cp["all_valid_json"] is True, cp
+    assert cp["zero_fallbacks"] is True, cp
+    assert cp["summary"]["window_rows"] > 0, cp
+    assert cp["summary"]["mask_steps"] > 0, cp
+    assert cp["summary"]["table_builds"] >= 1, cp
     # Prefill-roofline probe (detail.prefill, docs/kernels.md):
     # structural keys + the deterministic verdicts — cache bit-equality
     # and attention closeness fused-vs-XLA, warm-prefix chunk skipping

@@ -963,3 +963,140 @@ def _assert_digests_present(workers, dead_tail, churn_reqs):
         assert any(
             chain[0] in dig for _nid, dig in digest_sets
         ), f"{r.request_id}: no surviving radix holds its first block"
+
+
+# -- grammar-DFA checkpoint portability (PR 18) ----------------------------
+
+
+_G_SCHEMA = (
+    '{"type": "object", "properties": {"v": {"enum": ["x", "y"]}}, '
+    '"required": ["v"]}'
+)
+_G_VOCAB = [bytes([i]) for i in range(256)] + [b"", b""]
+_G_EOS = 257
+
+
+def _grammar_ckpt(dfa_state=3):
+    from parallax_tpu.constrained import grammar_state_hash
+
+    ck = _mk_ckpt(with_kv=False)
+    ck.sampling_params = SamplingParams(
+        temperature=0.0, max_new_tokens=32, json_schema=_G_SCHEMA,
+    ).to_dict()
+    ck.dfa_state = dfa_state
+    ck.grammar_hash = grammar_state_hash(_G_SCHEMA)
+    return ck
+
+
+class TestGrammarCheckpoint:
+    def test_wire_roundtrip(self):
+        import msgpack
+
+        ck = _grammar_ckpt()
+        wire = msgpack.unpackb(
+            msgpack.packb(checkpoint_to_wire(ck), use_bin_type=True),
+            raw=False,
+        )
+        back = checkpoint_from_wire(wire)
+        assert back.dfa_state == ck.dfa_state
+        assert back.grammar_hash == ck.grammar_hash
+        # Unconstrained frames carry no grammar fields at all.
+        plain = checkpoint_to_wire(_mk_ckpt(with_kv=False))
+        assert "dfa_state" not in plain and "grammar_hash" not in plain
+        assert checkpoint_from_wire(plain).dfa_state is None
+
+    @pytest.mark.parametrize("mutate,desc", [
+        (lambda d: d.update(dfa_state="x"), "non-int state"),
+        (lambda d: d.update(dfa_state=1 << 40), "state out of range"),
+        (lambda d: d.update(grammar_hash=""), "state without hash"),
+        (lambda d: d.update(grammar_hash="h" * 99), "oversized hash"),
+        (lambda d: d.update(
+            sampling_params=SamplingParams(max_new_tokens=8).to_dict()
+        ), "dfa_state without json_schema"),
+    ])
+    def test_corrupt_grammar_frames_rejected(self, mutate, desc):
+        d = checkpoint_to_wire(_grammar_ckpt())
+        mutate(d)
+        with pytest.raises(CheckpointError):
+            checkpoint_from_wire(d)
+        checkpoint_from_wire(checkpoint_to_wire(_grammar_ckpt()))
+
+    def test_replay_does_not_preseed_state(self):
+        """Replay mode re-commits the stream from scratch — the DFA
+        mirror must advance through the teacher-forced commits from 0,
+        not start at the checkpointed (post-stream) state."""
+        adopt = build_resumed_request(_grammar_ckpt())
+        assert getattr(adopt, "grammar_dfa_state", None) == 3
+        rep = build_resumed_request(_grammar_ckpt(), replay=True)
+        assert getattr(rep, "grammar_dfa_state", None) is None
+
+    def test_initial_state_validates_hash(self, tiny_model_and_params):
+        """The adopting engine trusts the checkpointed state only when
+        its own compile of the schema hashes identically; a stale hash
+        or out-of-range state recomputes from the committed stream."""
+        eng = _mk_engine(tiny_model_and_params)
+        eng.set_grammar_vocab(_G_VOCAB, _G_EOS)
+        table = eng.grammar.compile(_G_SCHEMA)
+        from parallax_tpu.constrained import grammar_state_hash
+
+        def mk_req(**attrs):
+            r = Request("gr", prompt_ids=[1, 2],
+                        sampling_params=SamplingParams(
+                            max_new_tokens=8, json_schema=_G_SCHEMA))
+            for k, v in attrs.items():
+                setattr(r, k, v)
+            return r
+
+        good = mk_req(grammar_dfa_state=2,
+                      grammar_hash=grammar_state_hash(_G_SCHEMA))
+        assert eng._grammar_initial_state(good, table) == 2
+        stale = mk_req(grammar_dfa_state=2, grammar_hash="deadbeef")
+        assert eng._grammar_initial_state(stale, table) == 0
+        oob = mk_req(grammar_dfa_state=table.dfa.n_states + 7,
+                     grammar_hash=grammar_state_hash(_G_SCHEMA))
+        assert eng._grammar_initial_state(oob, table) == 0
+
+    def test_constrained_migration_bit_identical(
+        self, tiny_model_and_params
+    ):
+        """The PR 17 fail-fast is gone: a constrained request parked
+        mid-decode replays on a fresh engine and finishes bit-identically
+        to an unchurned run, with the grammar enforced throughout."""
+        import json as _json
+
+        sp = SamplingParams(temperature=0.0, max_new_tokens=36,
+                            json_schema=_G_SCHEMA)
+
+        eng0 = _mk_engine(tiny_model_and_params, decode_lookahead=8)
+        eng0.set_grammar_vocab(_G_VOCAB, _G_EOS)
+        base = Request("base", prompt_ids=[1, 2, 3],
+                       sampling_params=dataclasses.replace(sp))
+        eng0.submit(base)
+        _drive(eng0)
+        assert base.status.is_finished
+        _json.loads(bytes(t for t in base.output_ids if t < 256))
+
+        eng_a = _mk_engine(tiny_model_and_params, decode_lookahead=8)
+        eng_a.set_grammar_vocab(_G_VOCAB, _G_EOS)
+        mig = Request("mig", prompt_ids=[1, 2, 3],
+                      sampling_params=dataclasses.replace(sp))
+        eng_a.submit(mig)
+        _drive_tokens(eng_a, mig, 4)
+        assert not mig.status.is_finished
+        grammar = eng_a.grammar_checkpoint_fields("mig")
+        assert grammar is not None and grammar[0] >= 0
+        eng_a.extract("mig")
+        ckpt = checkpoint_from_request(mig, routing_table=["B"],
+                                       grammar=grammar)
+        eng_a.cache.release(mig)
+        wire = checkpoint_from_wire(checkpoint_to_wire(ckpt))
+        assert wire.dfa_state == grammar[0]
+
+        eng_b = _mk_engine(tiny_model_and_params, decode_lookahead=8)
+        eng_b.set_grammar_vocab(_G_VOCAB, _G_EOS)
+        res = build_resumed_request(wire, replay=True)
+        assert eng_b.submit(res)
+        _drive(eng_b)
+        assert res.status.is_finished
+        assert res.full_output_ids == base.output_ids
+        _json.loads(bytes(t for t in res.full_output_ids if t < 256))

@@ -1,0 +1,277 @@
+"""Device-native constrained decoding: features inside the K-step window.
+
+The tentpole contract (docs/decode_loop.md): penalties, logit_bias,
+grammar masks and logprobs run INSIDE the fused decode window as
+scan-carry state, and every committed stream is bit-identical to the
+K=1 host-synchronous sampler — greedy and seeded, sync and overlapped,
+with and without speculation. The host-sync ``_sample`` is the oracle;
+these tests hold the window to it token-for-token.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parallax_tpu.config import normalize_config
+from parallax_tpu.constrained import (
+    DEVICE_TABLE_MAX_CELLS,
+    GrammarCompiler,
+    build_device_table,
+    grammar_state_hash,
+)
+from parallax_tpu.models.base import StageModel
+from parallax_tpu.runtime.engine import EngineConfig, StageEngine, drive_step
+from parallax_tpu.runtime.pipeline import InProcessPipeline
+from parallax_tpu.runtime.request import Request, SamplingParams
+
+BYTE_VOCAB = [bytes([i]) for i in range(256)] + [b"", b""]
+EOS = 257
+
+TINY = normalize_config(dict(
+    architectures=["Qwen2ForCausalLM"],
+    hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+    num_key_value_heads=2, intermediate_size=128, vocab_size=258,
+    max_position_embeddings=512,
+))
+
+SCHEMA = json.dumps({
+    "type": "object",
+    "properties": {"v": {"enum": ["x", "y"]}},
+    "required": ["v"],
+})
+
+_MODEL = StageModel(TINY, 0, 2, use_pallas=False)
+_PARAMS = _MODEL.init_params(jax.random.key(0), dtype=jnp.float32)
+
+
+def _engine(lookahead, spec=0, **cfg_kw):
+    defaults = dict(page_size=8, num_pages=128, max_model_len=256,
+                    kv_dtype="float32")
+    defaults.update(cfg_kw)
+    eng = StageEngine(_MODEL, _PARAMS, EngineConfig(
+        decode_lookahead=lookahead, speculative_tokens=spec, **defaults,
+    ))
+    eng.set_grammar_vocab(BYTE_VOCAB, EOS)
+    return eng
+
+
+# The feature mix every matrix cell carries: a grammar row, a penalized
+# row, a biased row that also wants logprobs, and a clean control row.
+def _feature_requests(temp, max_new=12):
+    seeded = temp > 0
+    return [
+        Request("gram", prompt_ids=[1, 2, 3], sampling_params=SamplingParams(
+            temperature=temp, max_new_tokens=3 * max_new,
+            json_schema=SCHEMA, seed=5 if seeded else None)),
+        Request("pen", prompt_ids=[9, 8, 7], sampling_params=SamplingParams(
+            temperature=temp, max_new_tokens=max_new, ignore_eos=True,
+            repetition_penalty=1.3, presence_penalty=0.5,
+            frequency_penalty=0.2, seed=7 if seeded else None)),
+        Request("bias", prompt_ids=[4, 5, 6], sampling_params=SamplingParams(
+            temperature=temp, max_new_tokens=max_new, ignore_eos=True,
+            logit_bias={11: 4.0, 23: -6.0}, logprobs=True,
+            seed=11 if seeded else None)),
+        Request("free", prompt_ids=[42, 43], sampling_params=SamplingParams(
+            temperature=temp, max_new_tokens=max_new, ignore_eos=True,
+            seed=13 if seeded else None)),
+    ]
+
+
+def _drive(eng, reqs, overlap=False):
+    for r in reqs:
+        eng.submit(r)
+    if overlap:
+        eng.cfg.overlap_steps = True
+        pending = None
+        guard = 0
+        while (eng.has_work() or pending is not None) and guard < 20000:
+            _, pending = drive_step(eng, pending)
+            guard += 1
+    else:
+        InProcessPipeline([eng]).run_until_complete()
+    return reqs
+
+
+# -- the bit-identity matrix ----------------------------------------------
+
+@pytest.mark.parametrize("temp", [0.0, 0.9])
+@pytest.mark.parametrize("overlap", [False, True])
+@pytest.mark.parametrize("spec", [0, 2])
+def test_feature_window_bit_identity(temp, overlap, spec):
+    """greedy+seeded x sync/overlap x +-spec: K=8 feature windows commit
+    exactly the K=1 host-synchronous stream, logprobs included."""
+    base = _drive(_engine(1, spec=spec), _feature_requests(temp),
+                  overlap=overlap)
+    win = _drive(_engine(8, spec=spec), _feature_requests(temp),
+                 overlap=overlap)
+    for b, m in zip(base, win):
+        assert m.output_ids == b.output_ids, (
+            b.request_id, b.output_ids, m.output_ids)
+        assert m.status == b.status
+        assert m.output_logprobs == b.output_logprobs
+    out = bytes(t for t in win[0].output_ids if t < 256)
+    assert json.loads(out)["v"] in ("x", "y"), out
+
+
+def test_feature_window_actually_fused():
+    """The matrix above is vacuous if the feature batches silently fell
+    back to K=1 — assert the feature variants really compiled and the
+    ledger saw in-window grammar rows."""
+    eng = _engine(8)
+    _drive(eng, _feature_requests(0.0))
+    feats_seen = {key[3] for key in eng._jit_multistep}
+    assert any("gram" in f for f in feats_seen), eng._jit_multistep.keys()
+    assert any("pen" in f for f in feats_seen)
+    assert any("bias" in f and "lp" in f for f in feats_seen)
+    s = eng.constrained_summary()
+    assert s is not None and s["window_rows"] > 0
+    assert s["mask_steps"] > 0 and s["fallbacks"] == 0
+
+
+# -- adversarial DFA cases ------------------------------------------------
+
+def test_window_mask_overrides_argmax():
+    """The grammar's opening state allows only whitespace or '{' — and
+    the free-running model's greedy pick is NOT in that set. The first
+    committed token proves the in-scan mask beat the raw argmax, and
+    the stream stays identical to the sync sampler's."""
+    allowed0 = np.asarray(
+        GrammarCompiler(BYTE_VOCAB, EOS).compile(SCHEMA).allowed_mask(0)
+    )
+    free = _drive(_engine(8), [Request(
+        "f", prompt_ids=[1, 2, 3], sampling_params=SamplingParams(
+            temperature=0.0, max_new_tokens=4, ignore_eos=True))])
+    assert not allowed0[free[0].output_ids[0]]   # adversarial premise
+    gram = _drive(_engine(8), [Request(
+        "g", prompt_ids=[1, 2, 3], sampling_params=SamplingParams(
+            temperature=0.0, max_new_tokens=36, json_schema=SCHEMA))])
+    assert allowed0[gram[0].output_ids[0]]
+    assert gram[0].output_ids[0] != free[0].output_ids[0]
+    sync = _drive(_engine(1), [Request(
+        "g", prompt_ids=[1, 2, 3], sampling_params=SamplingParams(
+            temperature=0.0, max_new_tokens=36, json_schema=SCHEMA))])
+    assert gram[0].output_ids == sync[0].output_ids
+
+
+def test_window_terminal_state_stops():
+    """Terminal-state stop inside a window: a +20 bias makes EOS the
+    argmax wherever the grammar ALLOWS it — i.e. only at accepting
+    states (the mask must keep beating the bias everywhere else). The
+    request finishes mid-window, well under its budget, the moment the
+    JSON object closes."""
+    def mk():
+        return [Request("g", prompt_ids=[3, 1], eos_token_ids=(EOS,),
+                        sampling_params=SamplingParams(
+                            temperature=0.0, max_new_tokens=64,
+                            json_schema=SCHEMA,
+                            logit_bias={EOS: 20.0}))]
+    win = _drive(_engine(8), mk())
+    out = bytes(t for t in win[0].output_ids if t < 256)
+    assert json.loads(out)["v"] in ("x", "y")
+    assert len(win[0].output_ids) < 64
+    assert win[0].status.name == "FINISHED_EOS"
+    sync = _drive(_engine(1), mk())
+    assert win[0].output_ids == sync[0].output_ids
+
+
+def test_window_bias_penalty_grammar_stack():
+    """All features on ONE row: the window must apply them in the exact
+    host order (penalties -> bias -> mask -> sample -> logprobs); any
+    reordering diverges from the K=1 oracle within a few tokens."""
+    def mk():
+        return [Request("s", prompt_ids=[2, 4, 6],
+                        sampling_params=SamplingParams(
+                            temperature=0.8, seed=3, max_new_tokens=40,
+                            json_schema=SCHEMA, logprobs=True,
+                            repetition_penalty=1.2, presence_penalty=0.3,
+                            logit_bias={ord("x"): 2.5}))]
+    base = _drive(_engine(1), mk())
+    win = _drive(_engine(8), mk())
+    assert win[0].output_ids == base[0].output_ids
+    assert win[0].output_logprobs == base[0].output_logprobs
+    assert len(base[0].output_logprobs) == len(base[0].output_ids)
+    json.loads(bytes(t for t in win[0].output_ids if t < 256))
+
+
+# -- device-table units ---------------------------------------------------
+
+def _unpack(bits, v):
+    out = np.zeros(v, bool)
+    for t in range(v):
+        out[t] = bool((int(bits[t // 32]) >> (t % 32)) & 1)
+    return out
+
+
+def test_device_table_matches_host_table():
+    """The dense device tables are bit-for-bit the host TokenTable:
+    every state's packed mask unpacks to ``allowed_mask`` and every
+    transition equals ``advance`` — including the appended dead sink."""
+    gc = GrammarCompiler(BYTE_VOCAB, EOS)
+    table = gc.compile(SCHEMA)
+    dev, built = gc.device_table(SCHEMA)
+    assert built and dev is not None
+    v = dev.vocab_size
+    for s in range(dev.n_states):
+        np.testing.assert_array_equal(
+            _unpack(dev.allowed[s], v), np.asarray(table.allowed_mask(s)))
+        for t in range(v):
+            want = table.advance(s, t)
+            assert dev.host_state(int(dev.trans[s, t])) == want, (s, t)
+    dead = dev.dead_state
+    assert _unpack(dev.allowed[dead], v).sum() == 1       # EOS failsafe
+    assert _unpack(dev.allowed[dead], v)[EOS]
+    no_eos = [t for t in range(v) if t != EOS]
+    assert (dev.trans[dead, no_eos] == dead).all()
+    assert dev.trans[dead, EOS] == dead                   # identity column
+    assert dev.device_state(-1) == dead
+    assert dev.host_state(dead) == -1
+
+
+def test_device_table_budget_gate():
+    gc = GrammarCompiler(BYTE_VOCAB, EOS)
+    table = gc.compile(SCHEMA)
+    assert build_device_table(table, max_cells=16) is None
+    assert build_device_table(table, DEVICE_TABLE_MAX_CELLS) is not None
+
+
+def test_device_table_cache_reuse():
+    """One build per grammar per engine lifetime: the second request
+    with the same schema reuses the compiled table (ledger: one build,
+    the rest cache hits) and the jit cache holds ONE gram variant."""
+    eng = _engine(8)
+    for i in range(2):
+        _drive(eng, [Request(
+            f"g{i}", prompt_ids=[1, 2, 3 + i],
+            sampling_params=SamplingParams(
+                temperature=0.0, max_new_tokens=36,
+                json_schema=SCHEMA))])
+    s = eng.constrained_summary()
+    assert s["table_builds"] == 1
+    assert s["table_cache_hits"] >= 1
+    gram_keys = [k for k in eng._jit_multistep if "gram" in k[3]]
+    assert len(gram_keys) == 1
+
+
+def test_constrained_window_off_falls_back():
+    """constrained_window=False is the registered gate: grammar batches
+    decode host-synchronously (ledger counts the fallback), streams
+    still valid and identical to the window path."""
+    off = _engine(8, constrained_window=False)
+    reqs = _drive(off, [Request(
+        "g", prompt_ids=[1, 2, 3], sampling_params=SamplingParams(
+            temperature=0.0, max_new_tokens=36, json_schema=SCHEMA))])
+    assert not any("gram" in k[3] for k in off._jit_multistep)
+    s = off.constrained_summary()
+    assert s["enabled"] is False and s["fallbacks"] >= 1
+    on = _drive(_engine(8), [Request(
+        "g", prompt_ids=[1, 2, 3], sampling_params=SamplingParams(
+            temperature=0.0, max_new_tokens=36, json_schema=SCHEMA))])
+    assert reqs[0].output_ids == on[0].output_ids
+
+
+def test_grammar_hash_is_schema_derived():
+    assert grammar_state_hash(SCHEMA) == grammar_state_hash(" " + SCHEMA)
+    assert grammar_state_hash(SCHEMA) != grammar_state_hash("{}")

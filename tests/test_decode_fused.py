@@ -281,7 +281,7 @@ def test_engine_streams_bit_identical(gqa_model, lookahead, overlap,
     if lookahead > 1:
         # The fused-sampler multistep variant (or argmax variant for
         # greedy) actually compiled and ran.
-        assert (8, temp > 0.0, temp > 0.0) in eng._jit_multistep
+        assert (8, temp > 0.0, temp > 0.0, ()) in eng._jit_multistep
         assert any(
             path == "multistep" and impl == "pallas-fused"
             for impl, path in eng._kernel_counts
@@ -302,7 +302,7 @@ def test_engine_top_p_rows_force_split_sampler(gqa_model):
     assert on == off
     # Split-sampler multistep variant (fused_sample=False) compiled,
     # and the warn-once gate site fired.
-    assert (8, True, False) in eng._jit_multistep
+    assert (8, True, False, ()) in eng._jit_multistep
     assert eng._warned_split_sampling
 
 
@@ -337,7 +337,7 @@ def test_engine_large_top_k_rows_force_split_sampler(gqa_model):
     on, eng = run(True)
     off, _ = run(False)
     assert on == off
-    assert (8, True, False) in eng._jit_multistep   # split-sampler variant
+    assert (8, True, False, ()) in eng._jit_multistep   # split-sampler variant
     assert eng._warned_split_sampling
 
 

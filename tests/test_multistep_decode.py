@@ -54,7 +54,7 @@ def test_multistep_matches_single_step_exactly():
     for b, m in zip(base, multi):
         assert m.output_ids == b.output_ids, (b.output_ids, m.output_ids)
         assert m.status == b.status
-    assert (4, False, False) in eng._jit_multistep  # the path actually ran
+    assert (4, False, False, ()) in eng._jit_multistep  # the path actually ran
 
 
 def test_multistep_respects_max_tokens_and_eos():
@@ -127,7 +127,7 @@ def test_multistep_sampled_seeded_matches_single_step_exactly():
     specs = [([3, 14, 15, 92], 0.9, 7), ([7, 21, 108], 1.3, 11)]
     base, beng = _run_sampled(1, specs)
     multi, meng = _run_sampled(4, specs)
-    assert (4, True, False) in meng._jit_multistep  # fused-sampler variant ran
+    assert (4, True, False, ()) in meng._jit_multistep  # fused-sampler variant ran
     assert not beng._jit_multistep
     for b, m in zip(base, multi):
         assert m.output_ids == b.output_ids, (b.output_ids, m.output_ids)
@@ -138,7 +138,7 @@ def test_multistep_sampled_mixed_greedy_rows_stay_greedy():
     variant; the greedy rows' outputs must equal the pure-greedy run."""
     specs = [([5, 6, 7, 8], 0.0, None), ([9, 10, 11], 1.0, 3)]
     mixed, meng = _run_sampled(4, specs)
-    assert (4, True, False) in meng._jit_multistep
+    assert (4, True, False, ()) in meng._jit_multistep
     greedy_only, _ = _run_sampled(1, [([5, 6, 7, 8], 0.0, None)])
     assert mixed[0].output_ids == greedy_only[0].output_ids
     # seeded row reproducible vs its single-step stream too
@@ -153,23 +153,34 @@ def test_multistep_sampled_pipelined_windows_match():
     assert multi[0].output_ids == base[0].output_ids
 
 
-def test_multistep_falls_back_for_penalized_requests():
-    model = StageModel(CFG, 0, 2, use_pallas=False)
-    p = model.init_params(jax.random.key(0), dtype=jnp.float32)
-    eng = StageEngine(model, p, EngineConfig(
-        page_size=8, num_pages=128, max_model_len=256,
-        kv_dtype="float32", decode_lookahead=4,
-    ))
-    pipe = InProcessPipeline([eng])
-    req = Request("s", prompt_ids=[1, 2, 3],
-                  sampling_params=SamplingParams(
-                      temperature=1.0, max_new_tokens=5, seed=3,
-                      repetition_penalty=1.3))
-    pipe.submit(req)
-    pipe.run_until_complete()
-    assert len(req.output_ids) == 5
-    # penalties need per-step host state: neither fused variant may run
-    assert not eng._jit_multistep
+def test_multistep_runs_penalized_requests_in_window():
+    """Penalties are scan-carry state now: penalized rows ride the fused
+    window (the "pen" feature variant compiles) and the stream is
+    bit-identical to the K=1 host-synchronous sampler."""
+    def run(lookahead):
+        model = StageModel(CFG, 0, 2, use_pallas=False)
+        p = model.init_params(jax.random.key(0), dtype=jnp.float32)
+        eng = StageEngine(model, p, EngineConfig(
+            page_size=8, num_pages=128, max_model_len=256,
+            kv_dtype="float32", decode_lookahead=lookahead,
+        ))
+        pipe = InProcessPipeline([eng])
+        req = Request("s", prompt_ids=[1, 2, 3],
+                      sampling_params=SamplingParams(
+                          temperature=1.0, max_new_tokens=8, seed=3,
+                          repetition_penalty=1.3,
+                          presence_penalty=0.4,
+                          frequency_penalty=0.2))
+        pipe.submit(req)
+        pipe.run_until_complete()
+        return req, eng
+
+    base, beng = run(1)
+    multi, meng = run(4)
+    assert len(base.output_ids) == 8
+    assert not beng._jit_multistep
+    assert (4, True, False, ("pen",)) in meng._jit_multistep
+    assert multi.output_ids == base.output_ids
 
 
 def test_multistep_mixed_arrivals():
@@ -211,7 +222,7 @@ def test_pipelined_windows_match_single_step_exactly():
     for b, m in zip(base, piped):
         assert m.output_ids == b.output_ids, (b.output_ids, m.output_ids)
         assert m.status == b.status
-    assert (4, False, False) in eng._jit_multistep
+    assert (4, False, False, ()) in eng._jit_multistep
     assert eng._last_fused_steps == 12  # 3 windows x k=4 actually chained
 
 
@@ -562,11 +573,11 @@ def test_window_fallback_under_page_pressure():
     assert stats.kv_oom_aborts == 0
 
 
-def test_adaptive_lookahead_default_and_downshift():
-    """decode_lookahead=None (the default) runs the adaptive window and
-    downshifts to single-step while a sync-forcing request (penalties)
-    is in the batch — then windows resume once it finishes. Streams
-    match the pinned K=1 engine throughout."""
+def test_adaptive_lookahead_default_and_feature_windows():
+    """decode_lookahead=None (the default) runs the adaptive window; a
+    penalized request joining the batch no longer downshifts it — the
+    window recompiles with the "pen" scan-carry variant and keeps
+    fusing. Streams match the pinned K=1 engine throughout."""
     from parallax_tpu.runtime.engine import ADAPTIVE_DECODE_LOOKAHEAD
 
     def run(lookahead):
@@ -606,12 +617,15 @@ def test_adaptive_lookahead_default_and_downshift():
     assert clean_a.output_ids == clean_b.output_ids
     assert pen_a.output_ids == pen_b.output_ids
     # Adaptive K compiled at the default cap.
-    assert (ADAPTIVE_DECODE_LOOKAHEAD, False, False) in eng._jit_multistep
-    # Window dispatches while the penalized request shared the batch
-    # were refused (downshift); clean-only batches got windows both
-    # before and after.
+    assert (ADAPTIVE_DECODE_LOOKAHEAD, False, False, ()) in eng._jit_multistep
+    # Batches sharing the penalized request still got windows — the
+    # "pen" feature variant compiled instead of a downshift refusal.
+    # (Its FIRST batch is the prefill step, which never fuses.)
     with_pen = [t for t, rids in tickets if "p" in rids]
-    assert with_pen and all(t is None for t in with_pen)
+    assert with_pen and any(t is not None for t in with_pen)
+    assert (
+        ADAPTIVE_DECODE_LOOKAHEAD, False, False, ("pen",)
+    ) in eng._jit_multistep
     solo = [t for t, rids in tickets if rids == ["c"]]
     assert any(t is not None for t in solo)
 

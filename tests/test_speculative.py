@@ -620,14 +620,22 @@ def test_spec_rows_no_longer_downshift_adaptive_windows():
     assert spec[0].output_ids == base[0].output_ids
 
 
-def test_host_state_rows_fall_back_to_plain_decode():
-    """Penalized/replayed rows cannot speculate (per-step host state) —
-    the registered gate — and streams still match the non-spec engine."""
-    prompts = [[1, 2, 3]]
+def test_host_state_rows_ride_the_spec_window():
+    """Penalized rows are scan-carry state now: they speculate inside
+    the window (the "pen" spec variant compiles) and streams still
+    match the non-spec engine token-for-token."""
+    prompts = [[7, 8, 9, 10, 7, 8, 9, 10, 7, 8, 9]]
     kws = [dict(temperature=1.0, seed=3, repetition_penalty=1.3)]
-    base, _ = _run(0, prompts, max_new=5, lookahead=1, sp_kw=kws)
-    spec, eng = _run(4, prompts, max_new=5, lookahead=8, sp_kw=kws)
-    assert not eng._jit_spec_multistep
-    assert not eng._jit_multistep
-    assert not _spec_engaged(eng)
+    base, _ = _run(0, prompts, max_new=12, lookahead=1, sp_kw=kws)
+    spec, eng = _run(4, prompts, max_new=12, lookahead=8, sp_kw=kws,
+                     adversarial=[1, 2, 3])
+    assert any(key[4] == ("pen",) for key in eng._jit_spec_multistep), (
+        eng._jit_spec_multistep.keys()
+    )
     assert spec[0].output_ids == base[0].output_ids
+    # The host-sync verify fallback (K=1) still has no feature state:
+    # those batches decode one token per step, streams unchanged.
+    sync, seng = _run(4, prompts, max_new=12, lookahead=1, sp_kw=kws)
+    assert not seng._jit_spec_multistep
+    assert not _spec_engaged(seng)
+    assert sync[0].output_ids == base[0].output_ids

@@ -95,7 +95,7 @@ def test_tp_fused_multistep_matches_single_step():
     specs = [([1, 2, 3, 4, 5], 0.0, None), ([100, 90, 80], 0.0, None)]
     base, _ = run_engine_fused(2, specs, lookahead=1)
     fused, eng = run_engine_fused(2, specs, lookahead=4, pipeline=2)
-    assert (4, False, False) in eng._jit_multistep   # fused path ran under TP
+    assert (4, False, False, ()) in eng._jit_multistep   # fused path ran under TP
     assert fused == base
 
 
@@ -105,7 +105,7 @@ def test_tp_fused_sampled_seeded_matches_single_step():
     specs = [([5, 6, 7], 0.9, 17), ([8, 9, 10, 11], 0.0, None)]
     base, _ = run_engine_fused(2, specs, lookahead=1)
     fused, eng = run_engine_fused(2, specs, lookahead=3)
-    assert (3, True, False) in eng._jit_multistep
+    assert (3, True, False, ()) in eng._jit_multistep
     assert fused == base
 
 
