@@ -2420,6 +2420,20 @@ def _goodput_payload() -> dict:
         return {}
 
 
+def _device_payload() -> dict:
+    """The device attribution plane's payload (obs/device.py): the HBM
+    ledger with its invariant verdict, the compile observatory's
+    per-program-family cause split, and per-program device-time shares.
+    The CI device-attribution smoke asserts the ledger invariant holds
+    and that steady-state decode explains every compile."""
+    try:
+        from parallax_tpu.obs.device import get_device_plane
+
+        return get_device_plane().payload()
+    except Exception:
+        return {}
+
+
 def _obs_metrics() -> dict:
     """p50/p95/p99 summary of the process metrics registry (the series
     the engine's TTFT/TPOT/step histograms accumulated this run)."""
@@ -3208,6 +3222,12 @@ def _bench():
             # swap/migrate/idle time split — useful + wasted == total by
             # construction.
             "goodput": _goodput_payload(),
+            # Device attribution plane (obs/device.py): HBM ledger
+            # classes + invariant, per-family compiles by recompile
+            # cause, per-program device-time split. The device smoke
+            # asserts invariant_ok and zero unexplained steady-state
+            # compiles.
+            "device": _device_payload(),
             # Multi-step decode probe (same engine, identical prompts,
             # K-on vs K-off): host visits, tokens/visit, per-visit and
             # amortized per-token dispatch medians side by side, plus

@@ -401,6 +401,10 @@ FRAME_SCHEMAS: tuple[FrameSchema, ...] = (
             _f("lora_adapters", [], required=False),
             _f("busy", False, required=False),
             _f("goodput", None, required=False),
+            _f("device", None, required=False,
+               doc="device attribution payload (obs/device.py): HBM "
+                   "ledger classes, compile observatory, per-program "
+                   "device-time — merged into /cluster/status"),
             _f("health", None, required=False),
             _f("events", None, required=False),
             _f("epoch", 0, required=False,
@@ -580,6 +584,22 @@ FRAME_SCHEMAS: tuple[FrameSchema, ...] = (
                    "re-anchored on the scheduler's clock so retries "
                    "keep their FCFS position"),
             _f("timeout_s", 10.0, required=False),
+        ),
+    ),
+    FrameSchema(
+        "PROFILE", "rpc_profile",
+        "Frontend -> worker: start/stop a JAX device profile on one "
+        "pipeline stage (the cluster-scope POST /profile/start "
+        "fanout). Every stage of a pipeline traces the same wall-clock "
+        "window; the reply carries {node_id, profiling, dir} — or "
+        "{error} — for the per-node trace-dir manifest.",
+        (
+            _f("action", "start", doc="start | stop"),
+            _f("dir", "/tmp/parallax-profile", required=False,
+               doc="start: trace output dir on the worker's host"),
+            _f("max_seconds", 120.0, required=False,
+               doc="start: auto-stop deadline (a forgotten cluster "
+                   "profile must not buffer device events unbounded)"),
         ),
     ),
 )

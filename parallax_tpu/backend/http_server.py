@@ -215,6 +215,8 @@ class OpenAIFrontend:
         healthz_fn=None,
         timeline_fn=None,
         qos_config=None,
+        device_fn=None,
+        profile_cluster_fn=None,
     ):
         self.tokenizer = tokenizer
         self.submit_fn = submit_fn
@@ -248,6 +250,16 @@ class OpenAIFrontend:
         # payloads so scrapers need no feature detection.
         self.healthz_fn = healthz_fn
         self.timeline_fn = timeline_fn
+        # Device attribution plane (obs/device.py): ``device_fn``
+        # overrides the local plane payload for GET /debug/device — the
+        # scheduler frontend wires the cluster merge here. None serves
+        # the process-local payload (single-host serve, worker nodes).
+        self.device_fn = device_fn
+        # Cluster-scope profiling: ``profile_cluster_fn(action, pipeline,
+        # dir, max_seconds) -> manifest`` fans the JAX profiler to every
+        # stage of a pipeline over RPC. None = single-process profiling
+        # only (a {"pipeline": ...} body 501s).
+        self.profile_cluster_fn = profile_cluster_fn
         # Multi-tenant QoS (parallax_tpu/qos, docs/qos.md): when a
         # QoSConfig is wired, requests carry a class (header
         # ``x-parallax-qos-class`` / body ``qos_class``), a deadline
@@ -309,6 +321,7 @@ class OpenAIFrontend:
             web.get("/cluster/status", self.cluster_status_stream),
             web.get("/cluster/status_json", self.cluster_status_json),
             web.get("/debug/trace/{request_id}", self.debug_trace),
+            web.get("/debug/device", self.debug_device),
             web.get("/debug/flight", self.debug_flight),
             web.get("/debug/timeline", self.debug_timeline),
             web.post("/weight/refit", self.weight_refit),
@@ -414,6 +427,21 @@ class OpenAIFrontend:
                 "set trace_sample_rate > 0)",
             )
         return web.json_response(data)
+
+    async def debug_device(self, _req):
+        """Device attribution plane (docs/memory.md, docs/kernels.md):
+        the HBM ledger (per-class device bytes + headroom + invariant),
+        the compile observatory (per-program-family compiles by cause)
+        and per-program device-time shares. On the scheduler frontend
+        this is the cluster merge; elsewhere the process-local plane."""
+        if self.device_fn is not None:
+            try:
+                return web.json_response(self.device_fn() or {})
+            except Exception as e:
+                return self._error(500, f"device payload failed: {e}")
+        from parallax_tpu.obs.device import get_device_plane
+
+        return web.json_response(get_device_plane().payload())
 
     async def debug_flight(self, _req):
         """Flight recorder dump: recent request timelines, the slow ring,
@@ -568,7 +596,14 @@ class OpenAIFrontend:
         ``max_seconds`` (body, default 120) is an auto-stop deadline: a
         forgotten ``start_trace`` buffers device events without bound, so
         an unattended profile now ends itself; an explicit
-        ``/profile/stop`` before the deadline cancels the timer."""
+        ``/profile/stop`` before the deadline cancels the timer.
+
+        Cluster scope: a ``{"pipeline": <id>}`` body fans the start to
+        EVERY stage of that pipeline over RPC (``"all"`` = every
+        pipeline) so the whole serving path traces one wall-clock
+        window; the response is a per-node trace-dir manifest instead
+        of the single-process ack. Each worker arms its own
+        ``max_seconds`` auto-stop."""
         import jax
 
         try:
@@ -582,6 +617,10 @@ class OpenAIFrontend:
             return self._error(400, "max_seconds must be a number")
         if max_seconds <= 0:
             return self._error(400, "max_seconds must be > 0")
+        if body.get("pipeline") is not None:
+            return await self._profile_cluster(
+                "start", body["pipeline"], out_dir, max_seconds
+            )
         # Check AFTER the awaits: no suspension between test and set.
         if self._profiling:
             return self._error(409, "profiler already running")
@@ -614,9 +653,43 @@ class OpenAIFrontend:
         finally:
             self._profiling = False
 
-    async def profile_stop(self, _request):
+    async def _profile_cluster(self, action, pipeline, out_dir,
+                               max_seconds):
+        """Fan a profiler action to a pipeline's stages; reply is the
+        per-node manifest ({node_id, profiling, dir} or {error} rows)."""
+        if self.profile_cluster_fn is None:
+            return web.json_response(
+                {"error": "cluster-scope profiling unavailable in this "
+                          "mode (no swarm scheduler on this frontend)"},
+                status=501,
+            )
+        try:
+            manifest = await asyncio.to_thread(
+                self.profile_cluster_fn, action, pipeline, out_dir,
+                max_seconds,
+            )
+        except ValueError as e:
+            return self._error(400, str(e))
+        except Exception as e:
+            logger.exception("cluster profile %s failed", action)
+            return self._error(500, f"cluster profile failed: {e}")
+        return web.json_response({
+            "profiling": action == "start",
+            "pipeline": pipeline,
+            "nodes": manifest,
+        })
+
+    async def profile_stop(self, request):
         import jax
 
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        if body.get("pipeline") is not None:
+            return await self._profile_cluster(
+                "stop", body["pipeline"], None, 0.0
+            )
         if not self._profiling:
             return self._error(409, "profiler not running")
         if self._profile_deadline_handle is not None:

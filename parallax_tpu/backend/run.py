@@ -522,6 +522,67 @@ def build_swarm_frontend(
             ],
         }
 
+    def device():
+        # GET /debug/device on the scheduler frontend: the cluster
+        # merge (classes/families unioned across nodes, invariants
+        # ANDed) plus each node's raw heartbeat payload for drill-down.
+        from parallax_tpu.obs.device import merge_device
+
+        sched = service.scheduler
+        nodes = [n for p in sched.manager.pipelines for n in p.nodes]
+        return {
+            "cluster": merge_device([n.device for n in nodes]),
+            "nodes": {
+                n.node_id: n.device for n in nodes if n.device
+            },
+        }
+
+    def profile_cluster(action: str, pipeline, out_dir, max_seconds):
+        # POST /profile/start {"pipeline": ...} fanout: every stage of
+        # the pipeline starts/stops its JAX device trace over RPC so
+        # the whole serving path profiles ONE wall-clock window. Per-
+        # node failures land in the manifest instead of aborting the
+        # fanout — a half-started profile must still be stoppable.
+        sched = service.scheduler
+        pipelines = sched.manager.pipelines
+        if pipeline in ("all", "*"):
+            chosen = list(pipelines)
+        else:
+            chosen = [
+                p for p in pipelines
+                if str(p.pipeline_id) == str(pipeline)
+            ]
+            if not chosen:
+                raise ValueError(
+                    f"unknown pipeline {pipeline!r} (have: "
+                    f"{[str(p.pipeline_id) for p in pipelines]} or "
+                    f"\"all\")"
+                )
+        targets, seen = [], set()
+        for p in chosen:
+            for n in p.nodes:
+                if n.node_id not in seen:
+                    seen.add(n.node_id)
+                    targets.append(n)
+        if not targets:
+            raise ValueError("no pipeline stages to profile")
+        manifest = []
+        for n in targets:
+            payload = {"action": action}
+            if action == "start":
+                payload["dir"] = out_dir
+                payload["max_seconds"] = max_seconds
+            try:
+                r = transport.call(
+                    n.node_id, proto.PROFILE, payload, timeout=15.0
+                )
+            except Exception as e:
+                r = {"node_id": n.node_id, "error": str(e)}
+            if not isinstance(r, dict):
+                r = {"node_id": n.node_id, "error": f"bad reply {r!r}"}
+            manifest.append(r)
+        return manifest
+
     frontend = OpenAIFrontend(
         tokenizer,
         submit_fn=client.submit,
@@ -534,6 +595,8 @@ def build_swarm_frontend(
         healthz_fn=healthz,
         timeline_fn=timeline,
         qos_config=qos_config,
+        device_fn=device,
+        profile_cluster_fn=profile_cluster,
     )
     if resolve_model is not None:
         frontend.scheduler_init_fn = make_scheduler_init_fn(

@@ -247,6 +247,14 @@ class SchedulerService:
                 if isinstance(payload.get("goodput"), dict)
                 else None
             ),
+            # Device attribution payload (HBM ledger classes, compile
+            # observatory, per-program device time) — cluster-merged in
+            # /cluster/status and served raw at GET /debug/device.
+            device=(
+                payload["device"]
+                if isinstance(payload.get("device"), dict)
+                else None
+            ),
             # Watchdog health state machine — per-node health in
             # /cluster/status (sick, not just dead).
             health=(

@@ -134,6 +134,7 @@ def build_local_frontend(
     def status():
         import jax as _jax
 
+        from parallax_tpu.obs.device import get_device_plane
         from parallax_tpu.obs.goodput import get_goodput
         from parallax_tpu.obs.registry import (
             get_registry,
@@ -146,6 +147,11 @@ def build_local_frontend(
         )
         out = {
             "mode": "single-host",
+            # Device attribution plane: the HBM ledger (per-class
+            # bytes, headroom, invariant), compile observatory and
+            # per-program device-time split — the single-host twin of
+            # the swarm's /cluster/status device merge (obs/device.py).
+            "device": get_device_plane().payload(),
             # Latency percentiles (TTFT/TPOT/e2e/step timing) from the
             # process registry — the single-host twin of the swarm's
             # cluster-wide heartbeat merge.

@@ -134,3 +134,12 @@ class GrammarCompiler:
                 self._dev_cache.pop(next(iter(self._dev_cache)))
             self._dev_cache[key] = dev
         return dev, True
+
+    def device_table_bytes(self) -> int:
+        """Total device bytes held by cached dense grammar tables — the
+        HBM ledger's ``grammar_tables`` allocation class."""
+        with self._lock:
+            return sum(
+                t.nbytes() for t in self._dev_cache.values()
+                if t is not None
+            )

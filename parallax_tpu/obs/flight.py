@@ -54,6 +54,7 @@ class FlightRecorder:
         stage: str = "",
         breakdown: dict | None = None,
         slow_threshold_ms: float = 0.0,
+        trace_id: str | None = None,
     ) -> None:
         rec = {
             "request_id": request_id,
@@ -69,6 +70,10 @@ class FlightRecorder:
             rec["abort_reason"] = abort_reason
         if breakdown:
             rec["breakdown"] = breakdown
+        if trace_id:
+            # Trace-sampled request: the slow-ring entry links straight
+            # to its full span timeline at /debug/trace/<trace_id>.
+            rec["trace_id"] = trace_id
         slow = slow_threshold_ms > 0 and e2e_ms >= slow_threshold_ms
         with self._lock:
             self._requests.append(rec)

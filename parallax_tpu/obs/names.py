@@ -138,6 +138,16 @@ HA_PROMOTIONS_TOTAL = "parallax_ha_promotions_total"
 HA_JOURNAL_RECORDS_TOTAL = "parallax_ha_journal_records_total"
 HA_REPLAY_MS = "parallax_ha_replay_ms"
 
+# -- device attribution plane (obs/device.py, utils/compile_cache.py) --------
+HBM_BYTES = "parallax_hbm_bytes"
+HBM_HEADROOM_BYTES = "parallax_hbm_headroom_bytes"
+HBM_HIGH_WATERMARK_BYTES = "parallax_hbm_high_watermark_bytes"
+DEVICE_TIME_SECONDS_TOTAL = "parallax_device_time_seconds_total"
+XLA_COMPILE_MS_TOTAL = "parallax_xla_compile_ms_total"
+XLA_LIVE_EXECUTABLES = "parallax_xla_live_executables"
+XLA_COMPILE_STORMS_TOTAL = "parallax_xla_compile_storms_total"
+DEVICE_MERGE_SKIPPED_TOTAL = "parallax_device_merge_skipped_total"
+
 # -- misc subsystems ---------------------------------------------------------
 LORA_ADAPTER_EVICTIONS_TOTAL = "parallax_lora_adapter_evictions_total"
 XLA_COMPILES_TOTAL = "parallax_xla_compiles_total"
@@ -369,11 +379,51 @@ HELP: dict[str, str] = {
         "Promotion latency: journal/lease decision to active scheduler "
         "(ms)"
     ),
+    HBM_BYTES: (
+        "Device HBM bytes by allocation class (weights_<dtype> / "
+        "kv_pages / host_staging / spec_draft / grammar_tables / "
+        "sampling_workspace / compile_headroom / untracked); the "
+        "ledger invariant sum(classes) + untracked == device_total "
+        "is asserted on every refresh"
+    ),
+    HBM_HEADROOM_BYTES: (
+        "Device HBM bytes still unclaimed by any allocation class "
+        "(capacity minus tracked minus untracked)"
+    ),
+    HBM_HIGH_WATERMARK_BYTES: (
+        "Highest total device HBM occupancy observed since process "
+        "start (tracked + untracked)"
+    ),
+    DEVICE_TIME_SECONDS_TOTAL: (
+        "Device/host-visit seconds by dispatched program family "
+        "(prefill / decode / decode_window / spec_window / "
+        "spec_verify / sp_prefill / swap_gather / swap_scatter) — "
+        "splits the goodput ledger's serve bucket"
+    ),
+    XLA_COMPILE_MS_TOTAL: (
+        "Cumulative XLA backend compile milliseconds by program "
+        "family"
+    ),
+    XLA_LIVE_EXECUTABLES: (
+        "Live compiled executables currently cached, by program "
+        "family"
+    ),
+    XLA_COMPILE_STORMS_TOTAL: (
+        "Recompile storms detected (N same-family compiles inside "
+        "the sliding window), by program family"
+    ),
+    DEVICE_MERGE_SKIPPED_TOTAL: (
+        "Heartbeat device payloads skipped by the cluster merge "
+        "(node missing the device section — old build); the merged "
+        "view degrades loudly instead of silently narrowing"
+    ),
     LORA_ADAPTER_EVICTIONS_TOTAL: (
         "Adapters evicted by the hot-load LRU cache"
     ),
     XLA_COMPILES_TOTAL: (
-        "XLA backend compilations performed by this process"
+        "XLA backend compilations by program family and recompile "
+        "cause (first / new_shape_bucket / k_change / "
+        "sampling_feature / spec_toggle / other)"
     ),
     HTTP_REQUESTS_TOTAL: (
         "Generation requests accepted by the HTTP frontend"

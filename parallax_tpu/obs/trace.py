@@ -50,7 +50,8 @@ class TraceStore:
         with self._lock:
             if trace_id in self._traces:
                 return
-            self._traces[trace_id] = {"spans": [], "open": {}}
+            self._traces[trace_id] = {"spans": [], "open": {},
+                                      "counters": []}
             while len(self._traces) > self.capacity:
                 self._traces.popitem(last=False)
 
@@ -104,6 +105,33 @@ class TraceStore:
             if merge:
                 trace["open"][(stage, name)] = span
 
+    def counter(
+        self,
+        trace_id: str,
+        stage: str,
+        name: str,
+        t0: float,
+        values: dict,
+    ) -> None:
+        """Record one counter sample (device attribution plane: HBM
+        headroom, per-program device-time share). Exports as a Chrome
+        counter track (``ph: "C"``) alongside the span lanes; bounded by
+        ``max_spans`` like everything else in the store."""
+        with self._lock:
+            trace = self._traces.get(trace_id)
+            if trace is None:
+                return
+            counters = trace.setdefault("counters", [])
+            if len(counters) >= self.max_spans:
+                return
+            counters.append({
+                "name": name, "stage": stage, "t0": t0,
+                "values": {
+                    str(k): v for k, v in values.items()
+                    if isinstance(v, (int, float))
+                },
+            })
+
     def adopt(self, trace_id: str, spans: list[dict]) -> int:
         """Seed a trace with spans recorded on ANOTHER host (live
         migration: the source head ships its TraceStore spans inside the
@@ -154,13 +182,24 @@ class TraceStore:
                 return None
             return [dict(s) for s in trace["spans"]]
 
+    def counters(self, trace_id: str) -> list[dict]:
+        with self._lock:
+            trace = self._traces.get(trace_id)
+            if trace is None:
+                return []
+            return [dict(c) for c in trace.get("counters", ())]
+
     def export_chrome(self, trace_id: str) -> dict | None:
         """Chrome trace-event JSON (``chrome://tracing`` / Perfetto):
-        complete ("X") events, one thread lane per pipeline stage."""
+        complete ("X") events, one thread lane per pipeline stage, plus
+        counter ("C") tracks for the device attribution samples."""
         spans = self.spans(trace_id)
         if spans is None:
             return None
-        base = min((s["t0"] for s in spans), default=0.0)
+        counters = self.counters(trace_id)
+        base = min(
+            (s["t0"] for s in spans + counters), default=0.0
+        )
         events = [
             {
                 "name": s["name"],
@@ -174,6 +213,18 @@ class TraceStore:
             }
             for s in sorted(spans, key=lambda s: s["t0"])
         ]
+        events.extend(
+            {
+                "name": c["name"],
+                "cat": "device",
+                "ph": "C",
+                "ts": round((c["t0"] - base) * 1e6, 3),
+                "pid": 1,
+                "tid": c["stage"],
+                "args": c["values"],
+            }
+            for c in sorted(counters, key=lambda c: c["t0"])
+        )
         return {
             "traceEvents": events,
             "displayTimeUnit": "ms",

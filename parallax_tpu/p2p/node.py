@@ -313,7 +313,16 @@ class WorkerNode:
         transport.register(proto.CHECKPOINT, self._on_checkpoint)
         transport.register(proto.KV_TRANSFER, self._on_kv_transfer)
         transport.register(proto.KV_RESULT, self._on_kv_result)
+        transport.register(proto.PROFILE, self._on_profile)
         transport.register("__ping__", lambda *_: "pong")
+        # Cluster-scope profiling (POST /profile/start {"pipeline": ...}):
+        # whether THIS stage currently runs a JAX device trace, plus the
+        # auto-stop deadline timer (a forgotten cluster profile must not
+        # buffer device events without bound).
+        self._profiling = False
+        self._profile_dir: str | None = None
+        self._profile_timer: threading.Timer | None = None
+        self._profile_lock = make_lock("node.profile")
         # Head-node chat requests by id (polled by the HTTP frontend;
         # reference: TransformerConnectionHandler.chat_completion proxies to
         # the local HTTP frontend, p2p/server.py:185-221).
@@ -745,6 +754,14 @@ class WorkerNode:
             )
 
         wd.register("admission", _admission_probe)
+
+        # Recompile-storm probe: the device plane's compile observatory
+        # advances progress only while no program family is storming, so
+        # a storm freezes the counter and walks ok -> degraded ->
+        # stalled like any other wedged component (docs/kernels.md).
+        from parallax_tpu.obs.device import get_device_plane
+
+        wd.register("compile", get_device_plane().compile.probe)
         wd.start()
         self._watchdog = wd
 
@@ -855,6 +872,11 @@ class WorkerNode:
                         # buckets + serve/compile/swap/migrate time) —
                         # merged cluster-wide in /cluster/status.
                         "goodput": self._goodput_heartbeat(),
+                        # Device attribution plane (HBM ledger classes,
+                        # compile observatory, per-program device time)
+                        # — merged cluster-wide in /cluster/status and
+                        # served raw via GET /debug/device.
+                        "device": self._device_heartbeat(),
                         # Watchdog health state machine (None when off):
                         # the scheduler surfaces sick-but-alive nodes,
                         # not just dead ones.
@@ -1011,6 +1033,15 @@ class WorkerNode:
             from parallax_tpu.obs.goodput import get_goodput
 
             return get_goodput().payload(chips=jax.local_device_count())
+        except Exception:  # pragma: no cover - obs never breaks beats
+            return None
+
+    def _device_heartbeat(self) -> dict | None:
+        """Per-node device-attribution payload (never raises)."""
+        try:
+            from parallax_tpu.obs.device import get_device_plane
+
+            return get_device_plane().payload()
         except Exception:  # pragma: no cover - obs never breaks beats
             return None
 
@@ -1262,6 +1293,87 @@ class WorkerNode:
         decode. A sender only compresses a link after the receiving peer
         lists the requested wire dtype here."""
         return {"formats": list(proto.WIRE_DTYPES)}
+
+    def _on_profile(self, _peer: str, payload: dict):
+        """Cluster-scope profiling fanout target: start/stop a JAX device
+        trace on THIS stage. The frontend fans the same action to every
+        node of a pipeline so all stages trace one wall-clock window;
+        the reply feeds the per-node trace-dir manifest. ``max_seconds``
+        arms a local auto-stop timer — a frontend that dies mid-profile
+        must not leave workers buffering device events forever."""
+        payload = payload or {}
+        action = str(payload.get("action") or "")
+        try:
+            import jax
+        except Exception as e:  # pragma: no cover - jax always present
+            return {"node_id": self.node_id, "error": str(e)}
+        with self._profile_lock:
+            if action == "start":
+                if self._profiling:
+                    return {
+                        "node_id": self.node_id,
+                        "error": "profiler already running",
+                        "dir": self._profile_dir,
+                    }
+                out_dir = str(payload.get("dir") or "/tmp/parallax-profile")
+                try:
+                    max_seconds = float(payload.get("max_seconds") or 120.0)
+                except (TypeError, ValueError):
+                    max_seconds = 120.0
+                try:
+                    jax.profiler.start_trace(out_dir)
+                except Exception as e:
+                    return {"node_id": self.node_id, "error": str(e)}
+                self._profiling = True
+                self._profile_dir = out_dir
+                self._profile_timer = threading.Timer(
+                    max(1.0, max_seconds), self._profile_autostop
+                )
+                self._profile_timer.daemon = True
+                self._profile_timer.start()
+                return {
+                    "node_id": self.node_id, "profiling": True,
+                    "dir": out_dir,
+                }
+            if action == "stop":
+                if not self._profiling:
+                    return {
+                        "node_id": self.node_id,
+                        "error": "profiler not running",
+                    }
+                if self._profile_timer is not None:
+                    self._profile_timer.cancel()
+                    self._profile_timer = None
+                try:
+                    jax.profiler.stop_trace()
+                except Exception as e:
+                    return {"node_id": self.node_id, "error": str(e)}
+                finally:
+                    self._profiling = False
+                return {
+                    "node_id": self.node_id, "profiling": False,
+                    "dir": self._profile_dir,
+                }
+        return {"node_id": self.node_id,
+                "error": f"unknown action {action!r}"}
+
+    def _profile_autostop(self) -> None:
+        """max_seconds deadline fired without an explicit stop."""
+        with self._profile_lock:
+            if not self._profiling:
+                return
+            self._profiling = False
+            self._profile_timer = None
+            logger.warning(
+                "%s: profiler auto-stop: max_seconds deadline reached",
+                self.node_id,
+            )
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:  # pragma: no cover - trace teardown races
+                logger.exception("profiler auto-stop failed")
 
     # Cached wire-dtype decisions re-probe after this long. Gossip mode
     # catches a restarted peer through its boot epoch; scheduler mode

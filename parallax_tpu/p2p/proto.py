@@ -91,6 +91,11 @@ HA_SYNC = "ha_sync"
 # a standby promotion the SwarmClient keeps admitting through the
 # promoted peer instead of 503ing).
 ROUTE_REQUEST = "route_request"
+# Frontend -> worker: start/stop a JAX device profile on one pipeline
+# stage (the cluster-scope POST /profile/start fanout — every stage of
+# a pipeline traces the SAME wall-clock window; the reply carries the
+# node's local trace dir for the manifest).
+PROFILE = "rpc_profile"
 
 
 def _build_dtype_registry() -> dict[str, np.dtype]:

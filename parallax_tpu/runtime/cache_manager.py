@@ -533,7 +533,7 @@ class CacheManager:
         self.allocator.free(owned)
         del request.page_ids[num_shared:]
         self.stats.preemptions += 1
-        self._goodput_swap(time.perf_counter() - t_swap)
+        self._goodput_swap(time.perf_counter() - t_swap, "swap_gather")
         return True
 
     def shared_prefix_tokens(self, request_id: str) -> int:
@@ -601,13 +601,18 @@ class CacheManager:
         return True
 
     @staticmethod
-    def _goodput_swap(seconds: float) -> None:
+    def _goodput_swap(seconds: float, program: str = "swap_scatter") -> None:
         """Accrue host<->device KV transfer time into the goodput time
-        taxonomy (never raises — metrics must not break serving)."""
+        taxonomy and the per-program device-time split — ``swap_gather``
+        is device->host (preemption park), ``swap_scatter`` is
+        host->device (resume / admission swap-in). Never raises —
+        metrics must not break serving."""
         try:
+            from parallax_tpu.obs.device import get_device_plane
             from parallax_tpu.obs.goodput import get_goodput
 
             get_goodput().add_time("swap", seconds)
+            get_device_plane().time.add(program, seconds)
         except Exception:  # pragma: no cover - obs only
             pass
 

@@ -12,6 +12,7 @@ O(tokens) numpy; sampling is a second fused jit call.
 from __future__ import annotations
 
 import dataclasses
+import os
 import random
 import time
 
@@ -325,6 +326,10 @@ class StepTicket:
     # at resolve, the designated sync point.
     spec_verify: tuple | None = None
     outputs: "StepOutputs | None" = None
+    # Program family this dispatch ran (prefill / decode / decode_window
+    # / spec_window / spec_verify / sp_prefill) — resolve() attributes
+    # the visit's serve seconds to it in the device attribution plane.
+    program: str = ""
 
 
 def drive_step(
@@ -1674,6 +1679,21 @@ class StageEngine:
         # in /metrics from the first scrape.
         self._goodput = get_goodput()
         self._goodput.bind_registry()
+        # Device attribution plane (obs/device.py): HBM ledger, compile
+        # observatory and per-program device-time split. Always on, same
+        # cost contract as the goodput ledger — one dict add per host
+        # visit for time, a set-membership check per dispatch for the
+        # compile observatory, ledger refreshes at collect cadence only.
+        from parallax_tpu.obs.device import get_device_plane
+
+        self._device_plane = get_device_plane()
+        self._device_plane.bind_registry()
+        self._dev_time = self._device_plane.time
+        self._compile_obs = self._device_plane.compile
+        # (family, frozen key) pairs already declared to the observatory:
+        # the dispatch hot path pays one set lookup, note_program runs
+        # only on a genuinely new jit key (i.e. right before a compile).
+        self._noted_program_keys: set[tuple] = set()
         model = self.model
         reg = get_registry()
         st = ("stage",)
@@ -1853,6 +1873,7 @@ class StageEngine:
         from parallax_tpu.utils.compile_cache import register_compile_counter
 
         register_compile_counter()
+        self._refresh_hbm()
 
     def _collect_obs(self) -> None:
         """Pull-style series, refreshed at render/snapshot time."""
@@ -1884,6 +1905,99 @@ class StageEngine:
             1 for rid in list(self._grammar_states)
             if rid in self.scheduler.running
         ))
+        self._refresh_hbm()
+
+    def _refresh_hbm(self) -> None:
+        """Re-measure this stage's device allocation classes into the
+        HBM ledger (obs/device.py). Runs at collect/heartbeat cadence —
+        never on the step path — and walks the params/KV pytrees for
+        their actual byte footprints; never raises."""
+        try:
+            plane = self._device_plane
+        except AttributeError:  # _init_obs not run yet
+            return
+        hbm = plane.hbm
+        owner = self._obs_stage
+        try:
+            by_dtype: dict[str, int] = {}
+            for leaf in jax.tree_util.tree_leaves(self.params):
+                nb = getattr(leaf, "nbytes", 0)
+                if nb:
+                    dt = str(getattr(leaf, "dtype", "unknown"))
+                    by_dtype[dt] = by_dtype.get(dt, 0) + int(nb)
+            for dt, nb in by_dtype.items():
+                hbm.set_class(f"weights_{dt}", nb, owner=owner)
+            hbm.set_class(
+                "kv_pages",
+                sum(
+                    int(getattr(leaf, "nbytes", 0) or 0)
+                    for leaf in jax.tree_util.tree_leaves(self.kv)
+                ),
+                owner=owner,
+            )
+            draft = getattr(self, "draft", None)
+            if draft is not None:
+                de = draft.engine
+                hbm.set_class(
+                    "spec_draft",
+                    sum(
+                        int(getattr(leaf, "nbytes", 0) or 0)
+                        for leaf in jax.tree_util.tree_leaves(
+                            (de.params, de.kv)
+                        )
+                    ),
+                    owner=owner,
+                )
+            grammar = getattr(self, "grammar", None)
+            if grammar is not None:
+                hbm.set_class(
+                    "grammar_tables",
+                    grammar.device_table_bytes(),
+                    owner=owner,
+                )
+            tier = getattr(self, "host_tier", None)
+            if tier is not None:
+                pool = getattr(tier, "pool", None)
+                if pool is not None:
+                    hbm.set_class(
+                        "host_staging",
+                        tier.num_host_pages() * pool.page_nbytes,
+                        owner=owner,
+                    )
+            # Declared workspaces (reservations, not measurements): one
+            # [max_batch, vocab] f32 logits scratch for sampling, and the
+            # XLA compile workspace headroom knob.
+            vocab = int(
+                getattr(self.model.config, "vocab_size", 0) or 0
+            )
+            hbm.set_class(
+                "sampling_workspace",
+                self.cfg.max_batch_size * vocab * 4,
+                owner=owner,
+            )
+            hbm.set_class(
+                "compile_headroom",
+                int(os.environ.get(
+                    "PARALLAX_TPU_COMPILE_HEADROOM_BYTES", 0
+                ) or 0),
+                owner=owner,
+            )
+            hbm.refresh_from_device()
+        except Exception:  # pragma: no cover - obs must never take
+            pass           # down the path it observes
+
+    def _note_program(self, family: str, **key) -> None:
+        """Declare a jit key to the compile observatory the first time
+        this engine dispatches it; steady state pays one set lookup."""
+        kt = (family, tuple(sorted(key.items())))
+        if kt in self._noted_program_keys:
+            return
+        self._noted_program_keys.add(kt)
+        self._compile_obs.note_program(family, key)
+        self._compile_obs.set_live_executables(
+            family,
+            sum(1 for f, _ in self._noted_program_keys if f == family),
+        )
 
     def _count_kernel_dispatch(
         self, path: str, impl: str | None = None
@@ -2088,10 +2202,27 @@ class StageEngine:
         from parallax_tpu.obs.trace import get_trace_store
 
         store = get_trace_store()
+        # Device attribution counter tracks (ph:"C" in the Chrome
+        # export): HBM headroom and per-program device-time share,
+        # sampled once per traced host visit alongside the span lanes.
+        hbm = self._device_plane.hbm.snapshot()
+        share = self._dev_time.snapshot()["share"]
+        counter_values = {
+            "hbm_headroom_mb": round(hbm["headroom_bytes"] / 2**20, 3),
+            "hbm_tracked_mb": round(hbm["tracked_bytes"] / 2**20, 3),
+            **{
+                f"device_share_{prog}": frac
+                for prog, frac in share.items()
+            },
+        }
         for seg in plan.seqs:
             req = seg.request
             if not req.traced:
                 continue
+            store.counter(
+                req.request_id, self._obs_stage, "device", t0=t1,
+                values=counter_values,
+            )
             if getattr(req, "is_mirror", False):
                 decode = seg.num_new_tokens == 1 and getattr(
                     req, "last_chunk_flag", False
@@ -2165,6 +2296,7 @@ class StageEngine:
             stage=self._obs_stage,
             breakdown=breakdown,
             slow_threshold_ms=self.cfg.slow_request_ms,
+            trace_id=rid if traced else None,
         )
 
     # -- multi-step decode (k tokens per host visit) ----------------------
@@ -2949,6 +3081,10 @@ class StageEngine:
                 self._build_spec_multistep(k, sampled, spec, prop_len,
                                            feats)
             )
+        self._note_program(
+            "spec_window", k=k, sampled=sampled, spec=spec,
+            feats="+".join(feats), prop_len=prop_len, seq=s,
+        )
         windows: list = []
         counts: list = []
         lps: list | None = [] if "lp" in feats else None
@@ -2996,6 +3132,7 @@ class StageEngine:
                        "propose_ms": propose_ms,
                        "rejs": rejs or None},
             dispatch_seq=self._dispatch_seq,
+            program="spec_window",
         )
         ticket.host_ms = (time.perf_counter() - t0) * 1000.0
         self._inflight.append(ticket)
@@ -3115,6 +3252,7 @@ class StageEngine:
                     seg.request.state_slot = self._slot_alloc.alloc() + 1
                     src = getattr(seg.request, "restore_state_from", None)
                     if src is not None:
+                        self._note_program("copy_state")
                         self.kv = self._jit_copy_state(
                             self.kv, jnp.int32(src),
                             jnp.int32(seg.request.state_slot),
@@ -3175,6 +3313,12 @@ class StageEngine:
             fn = self._jit_multistep[(k, sampled, fused_sample, feats)] = (
                 self._build_multistep(k, sampled, fused_sample, feats)
             )
+        # Compile observatory: the jit key that is about to (maybe)
+        # compile — fn variant plus the shape bucket jax keys on.
+        self._note_program(
+            "decode_window", k=k, sampled=sampled,
+            fused_sample=fused_sample, feats="+".join(feats), seq=s,
+        )
         # Enqueue all m windows back-to-back: window j+1 consumes window
         # j's on-device carry (feed token, context, stop mask, feature
         # state), so no host sync happens anywhere inside the chain —
@@ -3226,6 +3370,7 @@ class StageEngine:
             ms_windows=windows, ms_state=(stopped, produced),
             ms_lp=lps,
             dispatch_seq=self._dispatch_seq,
+            program="decode_window",
         )
         ticket.host_ms = (time.perf_counter() - t0) * 1000.0
         self._inflight.append(ticket)
@@ -3332,6 +3477,9 @@ class StageEngine:
         self.step_timing.update(host_ms, device_ms, overlapped,
                                 tokens=total)
         self._goodput.add_time("serve", (host_ms + device_ms) / 1e3)
+        self._dev_time.add(
+            ticket.program or "decode_window", (host_ms + device_ms) / 1e3
+        )
         if total:
             self._h_batch_tokens.observe(total)
         if self._traced:
@@ -3674,6 +3822,10 @@ class StageEngine:
         lora = self._lora_field(spec_plan, inputs)
         if lora is not None:
             inputs = dataclasses.replace(inputs, lora=lora)
+        self._note_program(
+            "spec_verify", tokens=int(inputs.token_ids.shape[0]),
+            seq=int(inputs.kv_lens.shape[0]),
+        )
         out, self.kv = self._jit_step(self.params, self.kv, inputs)
         try:
             out.copy_to_host_async()
@@ -3686,6 +3838,7 @@ class StageEngine:
             spec_verify=(spec_plan, proposals, source),
             sync_only=True,
             dispatch_seq=self._dispatch_seq,
+            program="spec_verify",
         )
         ticket.host_ms = (time.perf_counter() - t0) * 1000.0
         self._inflight.append(ticket)
@@ -4020,6 +4173,7 @@ class StageEngine:
                     # reset flag stays 0 and the copied state stands).
                     src = getattr(seg.request, "restore_state_from", None)
                     if src is not None:
+                        self._note_program("copy_state")
                         self.kv = self._jit_copy_state(
                             self.kv, jnp.int32(src),
                             jnp.int32(seg.request.state_slot),
@@ -4041,6 +4195,11 @@ class StageEngine:
                 hidden_states=hidden, pad_position=-1,
             )
             self._count_kernel_dispatch("prefill", self._sp_prefill_impl)
+            program = "sp_prefill"
+            self._note_program(
+                program, tokens=int(inputs.token_ids.shape[0]),
+                seq=int(inputs.kv_lens.shape[0]),
+            )
             out, self.kv = self._jit_sp_step(self.params, self.kv, inputs)
         else:
             # Decode-only batches compile their own variant (static flag)
@@ -4066,6 +4225,12 @@ class StageEngine:
                 inputs = dataclasses.replace(inputs, lora=lora)
             if fed_rows:
                 inputs = self._substitute_feed(plan, inputs)
+            program = "decode" if one_token else "prefill"
+            self._note_program(
+                program, tokens=int(inputs.token_ids.shape[0]),
+                seq=int(inputs.kv_lens.shape[0]),
+                decode_only=decode_only,
+            )
             out, self.kv = self._jit_step(self.params, self.kv, inputs)
 
         # Advance scheduler state first: a locally-committed sampled token
@@ -4082,6 +4247,7 @@ class StageEngine:
             spec_rows=spec_rows or None,
             sync_only=sp_plan is not None or bool(spec_rows),
             dispatch_seq=self._dispatch_seq,
+            program=program,
         )
         if not self.model.is_last:
             # Start the hidden-state device->host copy NOW (the same
@@ -4138,6 +4304,10 @@ class StageEngine:
                                         tokens=o.num_tokens)
                 self._goodput.add_time(
                     "serve", (o.host_ms + o.device_ms) / 1e3
+                )
+                self._dev_time.add(
+                    ticket.program or "decode",
+                    (o.host_ms + o.device_ms) / 1e3,
                 )
                 self._h_batch_tokens.observe(o.num_tokens)
                 if self._traced:
@@ -4197,6 +4367,9 @@ class StageEngine:
         self.step_timing.update(host_ms, device_ms, overlapped,
                                 tokens=emitted)
         self._goodput.add_time("serve", (host_ms + device_ms) / 1e3)
+        self._dev_time.add(
+            ticket.program or "decode", (host_ms + device_ms) / 1e3
+        )
         # Goodput: a replay-restored request's prompt re-prefill
         # recomputes positions the dead pipeline already computed — the
         # price of a churn event, counted as rework (head stage only;
@@ -4973,6 +5146,7 @@ class StageEngine:
                         continue
             else:
                 slot = snap[1]
+            self._note_program("copy_state")
             self.kv = self._jit_copy_state(
                 self.kv, jnp.int32(req.state_slot), jnp.int32(slot)
             )

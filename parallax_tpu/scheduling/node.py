@@ -308,6 +308,10 @@ class Node:
     # serve/compile/swap/migrate/idle time, goodput fraction) — merged
     # cluster-wide in /cluster/status (obs/goodput.py).
     goodput: dict | None = None
+    # Device attribution payload from heartbeats (HBM ledger classes,
+    # compile observatory by program family, per-program device time) —
+    # merged cluster-wide in /cluster/status (obs/device.py).
+    device: dict | None = None
     # Watchdog health payload from heartbeats ({status, components,
     # causes}): a node can be alive (heartbeating) yet sick — a wedged
     # step loop or stuck sender — and the sweep alone cannot tell.

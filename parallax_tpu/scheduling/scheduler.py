@@ -260,12 +260,13 @@ class GlobalScheduler:
         kernel: dict | None = None,
         spec: dict | None = None,
         constrained: dict | None = None,
+        device: dict | None = None,
     ) -> None:
         self._events.put(
             ("update", node_id, layer_latency_ms, load, rtt_s, is_ready,
              refit_version, lora_adapters, step_timing, cache_stats,
              transport, metrics, cache_digests, busy, goodput, health,
-             events, kernel, spec, constrained)
+             events, kernel, spec, constrained, device)
         )
 
     def enqueue_peer_down(self, reporter: str, peer: str,
@@ -676,6 +677,7 @@ class GlobalScheduler:
             kernel = rest[7] if len(rest) > 7 else None
             spec = rest[8] if len(rest) > 8 else None
             constrained = rest[9] if len(rest) > 9 else None
+            device = rest[10] if len(rest) > 10 else None
             if events is not None:
                 # Merge the node's flight-event batch even for unknown
                 # nodes: a churn victim's last beats are exactly the
@@ -718,6 +720,8 @@ class GlobalScheduler:
                 node.metrics = metrics
             if goodput is not None:
                 node.goodput = goodput
+            if device is not None:
+                node.device = device
             if health is not None:
                 prev = (node.health or {}).get("status")
                 node.health = health
@@ -1111,6 +1115,18 @@ class GlobalScheduler:
         )
         if cluster_goodput is not None:
             report["goodput"] = cluster_goodput
+        # Device attribution: cluster-merged HBM ledger (classes
+        # unioned, capacity/tracked/untracked summed, invariants ANDed),
+        # compile observatory (per-family compiles by cause) and
+        # per-program device time — heterogeneous nodes contribute
+        # disjoint classes/families and the merge unions them; nodes
+        # without a device payload are counted as skips (mirrors
+        # parallax_obs_merge_skipped_total semantics).
+        from parallax_tpu.obs.device import merge_device
+
+        cluster_device = merge_device([n.device for n in all_nodes])
+        if cluster_device is not None:
+            report["device"] = cluster_device
         # Health rollup: worst watchdog status across the swarm plus the
         # sick list (alive-but-stalled nodes the binary sweep misses).
         from parallax_tpu.obs.watchdog import worst_status
@@ -1229,6 +1245,11 @@ class GlobalScheduler:
                         # Per-node goodput ledger payload (cluster merge
                         # in the top-level "goodput" section).
                         "goodput": n.goodput,
+                        # Per-node device attribution payload (HBM
+                        # ledger, compile observatory, device time);
+                        # cluster merge in the top-level "device"
+                        # section (obs/device.py).
+                        "device": n.device,
                         # Overlapped decode loop telemetry (host_ms /
                         # device_ms EWMAs + overlap fraction).
                         "step_timing": n.step_timing,

@@ -1,5 +1,5 @@
-"""Compile-time hygiene: persistent XLA compilation cache + compile
-observability.
+"""Compile-time hygiene: persistent XLA compilation cache + the compile
+observatory hookup.
 
 Production restarts and autoscale events re-trace every program in the
 engine's shape lattice; without a persistent cache each new process pays
@@ -8,11 +8,18 @@ entrypoints (``serve``/``join``/``generate``/bench) therefore enable
 JAX's persistent compilation cache by default — executables land under a
 configurable directory and later processes load them from disk.
 
-The compile COUNT is the matching observability signal
-(``parallax_xla_compiles_total``): a healthy steady-state process
-compiles during warmup and then stops; a counter that keeps climbing
-means the bucketing lattice is leaking shapes (the compile-storm
-signal the power-of-two decode buckets exist to prevent).
+Compile OBSERVABILITY lives in :class:`parallax_tpu.obs.device
+.CompileObservatory`: this module's JAX monitoring listener feeds every
+``backend_compile`` event into it, where the compile is attributed to a
+program family and recompile *cause* (the jit sites declare their keys
+via ``note_program``), exported as ``parallax_xla_compiles_total
+{program,cause}`` plus cumulative compile ms, live executables, and the
+recompile-storm detector. A healthy steady-state process compiles during
+warmup and then stops; per-family cause labels say WHICH program leaked
+a shape when the counter keeps climbing. Compile seconds still land in
+the goodput ledger's ``compile`` bucket (a storm shows as a goodput dip
+instead of hiding inside step latency); the observatory splits them by
+family.
 """
 
 from __future__ import annotations
@@ -22,7 +29,6 @@ import threading
 
 from parallax_tpu.utils import get_logger
 from parallax_tpu.analysis.sanitizer import make_lock
-from parallax_tpu.obs import names as mnames
 
 logger = get_logger(__name__)
 
@@ -80,10 +86,12 @@ def active_cache_dir() -> str | None:
 
 
 def register_compile_counter() -> None:
-    """Expose compiles-per-process as ``parallax_xla_compiles_total`` in
-    the metrics registry (idempotent; never raises). Counts JAX's
-    per-backend-compilation monitoring events, so persistent-cache HITS
-    do not count — the series measures real compile work only."""
+    """Wire JAX's per-backend-compilation monitoring events into the
+    compile observatory (idempotent; never raises). Persistent-cache
+    HITS fire no event and so do not count — the series measures real
+    compile work only. Each event is attributed to the program family /
+    cause most recently declared via ``note_program`` and its duration
+    lands in the goodput ledger's ``compile`` bucket."""
     global _counter_registered
     with _lock:
         if _counter_registered:
@@ -92,18 +100,16 @@ def register_compile_counter() -> None:
     try:
         from jax import monitoring
 
+        from parallax_tpu.obs.device import get_device_plane
         from parallax_tpu.obs.goodput import get_goodput
-        from parallax_tpu.obs.registry import get_registry
 
-        counter = get_registry().counter(
-            mnames.XLA_COMPILES_TOTAL,
-            "XLA backend compilations performed by this process",
-        ).labels()
+        plane = get_device_plane()
+        plane.bind_registry()
         goodput = get_goodput()
 
         def _on_duration(event: str, duration: float, **kw) -> None:
             if _COMPILE_EVENT in event:
-                counter.inc()
+                plane.compile.on_compile(duration)
                 # Goodput time taxonomy: compile seconds are not serve
                 # seconds — a recompile storm shows up as a goodput dip
                 # instead of hiding inside step latency.

@@ -151,6 +151,29 @@ def test_bench_cpu_smoke_prints_one_json_line():
     assert q["on"]["shed_transitions"]["releases"] >= 1, q
     assert q["on"]["batch"]["tokens"] > 0, q           # never starved
     assert q["on"]["batch"]["tokens"] == q["off"]["batch"]["tokens"], q
+    # Device attribution plane (detail.device, obs/device.py): the HBM
+    # ledger invariant must hold, the compile observatory must explain
+    # every compile (zero cause="unknown" — that would mean a jit site
+    # the engine never declared), and the decode run must attribute
+    # device time to at least one program family.
+    dev = rec["detail"]["device"]
+    hbm = dev["hbm"]
+    for key in ("classes", "tracked_bytes", "untracked_bytes",
+                "capacity_bytes", "headroom_bytes",
+                "high_watermark_bytes", "invariant_ok"):
+        assert key in hbm, hbm
+    assert hbm["invariant_ok"] is True, hbm
+    assert hbm["classes"].get("kv_pages", 0) > 0, hbm
+    assert any(c.startswith("weights") for c in hbm["classes"]), hbm
+    comp = dev["compile"]
+    for key in ("programs", "compiles_total", "unexplained_compiles",
+                "compile_ms_total", "storms_total"):
+        assert key in comp, comp
+    progs = dev["programs"]
+    assert progs["seconds_total"] > 0, progs
+    assert progs["seconds"], progs
+    for fam, share in progs["share"].items():
+        assert 0.0 <= share <= 1.0, (fam, progs)
 
 
 def test_bench_dsa_mode_cpu_smoke():

@@ -198,9 +198,17 @@ def test_two_stage_wire_trace_stitching():
     chrome = store.export_chrome("trace-stitch")
     assert chrome["metadata"]["trace_id"] == "trace-stitch"
     events = chrome["traceEvents"]
-    assert len(events) == len(spans)
-    assert all(e["ph"] == "X" for e in events)
-    assert {e["tid"] for e in events} == stages
+    # Span lanes export as complete ("X") events one-for-one; the device
+    # attribution plane adds counter ("C") tracks alongside them.
+    span_events = [e for e in events if e["ph"] == "X"]
+    counter_events = [e for e in events if e["ph"] == "C"]
+    assert len(span_events) == len(spans)
+    assert len(span_events) + len(counter_events) == len(events)
+    assert counter_events, "traced visit recorded no device counters"
+    assert all(
+        "hbm_headroom_mb" in e["args"] for e in counter_events
+    )
+    assert {e["tid"] for e in span_events} == stages
     assert min(e["ts"] for e in events) == 0.0
     assert req.output_ids  # the traced run actually generated
 
